@@ -1,0 +1,77 @@
+"""ray_tpu: a TPU-native distributed AI runtime.
+
+A brand-new framework with the capability surface of the reference Ray
+runtime (tasks, actors, a distributed object plane, topology-aware cluster
+scheduling, and the library suite: data / train / tune / serve / rl), designed
+TPU-first: collectives are XLA programs over ICI/DCN meshes, gang placement is
+slice-aware, and every hot compute path is jit/pallas.
+"""
+
+from ray_tpu._private.ids import ActorID, JobID, NodeID, ObjectID, TaskID  # noqa: F401
+from ray_tpu.core.actor import ActorClass, ActorHandle, method  # noqa: F401
+from ray_tpu.core.api import RemoteFunction, remote  # noqa: F401
+from ray_tpu.core.object_ref import ObjectRef  # noqa: F401
+from ray_tpu.core.worker import (  # noqa: F401
+    global_worker,
+    init,
+    is_initialized,
+    shutdown,
+)
+from ray_tpu.runtime_context import get_runtime_context  # noqa: F401
+from ray_tpu import exceptions  # noqa: F401
+
+__version__ = "0.1.0"
+
+
+def put(value):
+    """Store ``value`` in the object plane; returns an ObjectRef."""
+    return global_worker().put(value)
+
+
+def get(refs, *, timeout=None):
+    """Fetch the value(s) of ObjectRef(s), blocking until available."""
+    return global_worker().get(refs, timeout)
+
+
+def wait(refs, *, num_returns=1, timeout=None, fetch_local=True):
+    """Block until ``num_returns`` of ``refs`` are ready."""
+    return global_worker().wait(refs, num_returns, timeout)
+
+
+def kill(actor, *, no_restart=True):
+    """Forcibly terminate an actor."""
+    from ray_tpu.core.actor import ActorHandle as _AH
+
+    if not isinstance(actor, _AH):
+        raise TypeError("kill() expects an ActorHandle")
+    global_worker()._require_backend().kill_actor(actor._actor_id, no_restart)
+
+
+def cancel(ref, *, force=False):
+    """Request cancellation of the task that produces ``ref``."""
+    global_worker()._require_backend().cancel(ref, force)
+
+
+def get_actor(name, namespace=None):
+    """Look up a named actor."""
+    return global_worker()._require_backend().get_actor_handle(name, namespace)
+
+
+def cluster_resources():
+    return global_worker()._require_backend().cluster_resources()
+
+
+def available_resources():
+    return global_worker()._require_backend().available_resources()
+
+
+def nodes():
+    return global_worker()._require_backend().nodes()
+
+
+__all__ = [
+    "init", "shutdown", "is_initialized", "remote", "method", "put", "get",
+    "wait", "kill", "cancel", "get_actor", "cluster_resources",
+    "available_resources", "nodes", "get_runtime_context", "ObjectRef",
+    "ActorClass", "ActorHandle", "RemoteFunction", "exceptions",
+]

@@ -1,0 +1,57 @@
+"""TPU autodetection for node resource defaults.
+
+Equivalent of the reference's ``python/ray/_private/accelerator.py``
+(``_autodetect_num_tpus :153`` — counts ``/dev/accel*`` / vfio entries;
+version probing via GCE metadata ``:175-220``). Metadata probing is omitted
+(zero-egress environments); the generation can be supplied via env or labels.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+from typing import Dict, Optional
+
+TPU_VERSION_ENV = "RT_TPU_VERSION"          # e.g. "v5p", "v5e"
+NUM_TPUS_ENV = "RT_NUM_TPUS"
+SLICE_NAME_ENV = "RT_TPU_SLICE_NAME"
+SLICE_TOPOLOGY_ENV = "RT_TPU_SLICE_TOPOLOGY"
+WORKER_ID_ENV = "RT_TPU_WORKER_ID"
+
+
+def autodetect_num_tpu_chips() -> int:
+    if NUM_TPUS_ENV in os.environ:
+        return int(os.environ[NUM_TPUS_ENV])
+    accel = glob.glob("/dev/accel*")
+    if accel:
+        return len(accel)
+    vfio = glob.glob("/dev/vfio/[0-9]*")
+    if vfio:
+        return len(vfio)
+    return 0
+
+
+def tpu_node_labels() -> Dict[str, str]:
+    from ray_tpu.core import resources as res
+
+    labels: Dict[str, str] = {}
+    version = os.environ.get(TPU_VERSION_ENV)
+    if version:
+        labels[res.LABEL_ACCELERATOR_TYPE] = f"TPU-{version.upper()}"
+    if SLICE_NAME_ENV in os.environ:
+        labels[res.LABEL_SLICE_NAME] = os.environ[SLICE_NAME_ENV]
+    if SLICE_TOPOLOGY_ENV in os.environ:
+        labels[res.LABEL_SLICE_TOPOLOGY] = os.environ[SLICE_TOPOLOGY_ENV]
+    if WORKER_ID_ENV in os.environ:
+        labels[res.LABEL_WORKER_ID_IN_SLICE] = os.environ[WORKER_ID_ENV]
+    return labels
+
+
+def set_visible_chips(chip_indices, env: Optional[dict] = None) -> None:
+    """Pin a worker process to specific chips (reference:
+    ``TPU_VISIBLE_CHIPS`` handling, ``ray_constants.py:407``,
+    ``worker.py:430`` — the TPU analog of CUDA_VISIBLE_DEVICES)."""
+    from ray_tpu._private.config import get_config
+
+    target = env if env is not None else os.environ
+    target[get_config().tpu_visible_chips_env] = ",".join(str(i) for i in chip_indices)

@@ -1,0 +1,119 @@
+"""Env-overridable configuration flags.
+
+TPU-native equivalent of the reference's ``RayConfig`` flag system
+(``src/ray/common/ray_config_def.h`` — 207 ``RAY_CONFIG(type, name, default)``
+entries, each overridable via a ``RAY_<name>`` env var). Here every flag is a
+typed attribute on :class:`Config`, overridable via ``RT_<NAME>`` env vars, and
+a single immutable snapshot is taken at import so all processes of a session
+see consistent values (the snapshot is also serialized to spawned workers).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any
+
+_ENV_PREFIX = "RT_"
+
+
+def _coerce(value: str, typ: type) -> Any:
+    if typ is bool:
+        return value.lower() in ("1", "true", "yes", "on")
+    if typ is int:
+        return int(value)
+    if typ is float:
+        return float(value)
+    return value
+
+
+@dataclasses.dataclass
+class Config:
+    """All runtime flags. Override any field with ``RT_<UPPERCASE_NAME>``."""
+
+    # ---- session / process tree -------------------------------------------
+    session_dir_root: str = "/tmp/ray_tpu"
+    head_port: int = 0  # 0 = pick a free port
+    node_manager_port: int = 0
+    num_workers_soft_limit: int = 0  # 0 = num_cpus of the node
+    worker_register_timeout_s: float = 30.0
+    process_startup_timeout_s: float = 30.0
+    graceful_shutdown_timeout_s: float = 5.0
+
+    # ---- scheduling --------------------------------------------------------
+    # Hybrid policy knobs (reference: raylet/scheduling/policy/
+    # hybrid_scheduling_policy.h:29-48): prefer available nodes, rank by
+    # critical-resource utilization, spill above this threshold.
+    scheduler_spread_threshold: float = 0.5
+    scheduler_top_k_fraction: float = 0.2
+    lease_timeout_s: float = 10.0
+    max_pending_lease_requests_per_key: int = 10
+
+    # ---- object store ------------------------------------------------------
+    # Objects <= this many bytes are stored in the owner's in-process memory
+    # store and travel inline in RPCs (reference: core_worker memory store).
+    max_direct_call_object_size: int = 100 * 1024
+    object_store_memory_bytes: int = 0  # 0 = 30% of system memory, capped
+    object_store_default_cap_bytes: int = 2 * 1024**3
+    object_transfer_chunk_bytes: int = 8 * 1024**2
+    object_spilling_dir: str = ""  # "" = <session_dir>/spill
+    object_spill_threshold: float = 0.8
+
+    # ---- health / fault tolerance -----------------------------------------
+    heartbeat_interval_s: float = 1.0
+    node_death_timeout_s: float = 10.0
+    actor_restart_backoff_s: float = 0.5
+    task_max_retries_default: int = 3
+
+    # ---- gcs ---------------------------------------------------------------
+    gcs_rpc_timeout_s: float = 30.0
+    pubsub_poll_timeout_s: float = 30.0
+
+    # ---- TPU / accelerator -------------------------------------------------
+    # Chips per TPU-VM host (v4/v5p hosts expose 4 chips; v5e hosts 1/4/8).
+    tpu_chips_per_host: int = 4
+    tpu_visible_chips_env: str = "TPU_VISIBLE_CHIPS"
+    coordinator_port: int = 0
+
+    # ---- logging / metrics -------------------------------------------------
+    log_to_driver: bool = True
+    event_buffer_size: int = 10000
+    metrics_report_interval_s: float = 5.0
+
+    @classmethod
+    def from_env(cls) -> "Config":
+        cfg = cls()
+        for f in dataclasses.fields(cls):
+            env_key = _ENV_PREFIX + f.name.upper()
+            if env_key in os.environ:
+                setattr(cfg, f.name, _coerce(os.environ[env_key], f.type if isinstance(f.type, type) else type(getattr(cfg, f.name))))
+        return cfg
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self))
+
+    @classmethod
+    def from_json(cls, data: str) -> "Config":
+        cfg = cls()
+        for k, v in json.loads(data).items():
+            if hasattr(cfg, k):
+                setattr(cfg, k, v)
+        return cfg
+
+
+_config: Config | None = None
+
+
+def get_config() -> Config:
+    """The process-wide config snapshot (lazy; env read once)."""
+    global _config
+    if _config is None:
+        env_blob = os.environ.get("RT_CONFIG_JSON")
+        _config = Config.from_json(env_blob) if env_blob else Config.from_env()
+    return _config
+
+
+def set_config(cfg: Config) -> None:
+    global _config
+    _config = cfg

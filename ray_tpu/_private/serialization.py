@@ -1,0 +1,124 @@
+"""Serialization: cloudpickle in-band + pickle-5 out-of-band zero-copy buffers.
+
+Equivalent of the reference's ``python/ray/_private/serialization.py``
+(``SerializationContext``): values are cloudpickled with protocol 5 so large
+contiguous buffers (numpy arrays, jax host arrays, bytes) are emitted
+out-of-band and can live in shared memory without a copy on the read side.
+ObjectRefs and ActorHandles found inside a value are swapped for lightweight
+descriptors at pickle time and rehydrated at unpickle time, and the set of
+contained refs is recorded so the owner can keep them alive (the reference's
+contained-ref tracking, ``serialization.py:183-192``).
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+import struct
+from typing import Any, Callable, List, Tuple
+
+import cloudpickle
+
+# Header layout of a serialized payload:
+#   u32 metadata_len | metadata(pickled in-band bytes) | u32 nbuffers |
+#   [u64 buffer_len | buffer bytes] * nbuffers
+_U32 = struct.Struct("<I")
+_U64 = struct.Struct("<Q")
+
+
+class SerializedObject:
+    """A serialized value: in-band pickle bytes + out-of-band buffers."""
+
+    __slots__ = ("inband", "buffers", "contained_refs")
+
+    def __init__(self, inband: bytes, buffers: List[memoryview], contained_refs):
+        self.inband = inband
+        self.buffers = buffers
+        self.contained_refs = contained_refs
+
+    def total_bytes(self) -> int:
+        return (
+            _U32.size * 2
+            + len(self.inband)
+            + sum(_U64.size + len(b) for b in self.buffers)
+        )
+
+    def write_to(self, buf: memoryview) -> int:
+        """Pack into a contiguous writable buffer; returns bytes written."""
+        off = 0
+        buf[off : off + 4] = _U32.pack(len(self.inband)); off += 4
+        buf[off : off + len(self.inband)] = self.inband; off += len(self.inband)
+        buf[off : off + 4] = _U32.pack(len(self.buffers)); off += 4
+        for b in self.buffers:
+            n = len(b)
+            buf[off : off + 8] = _U64.pack(n); off += 8
+            buf[off : off + n] = b; off += n
+        return off
+
+    def to_bytes(self) -> bytes:
+        out = bytearray(self.total_bytes())
+        self.write_to(memoryview(out))
+        return bytes(out)
+
+
+def unpack_payload(buf: memoryview) -> Tuple[bytes, List[memoryview]]:
+    """Split a packed payload back into (inband, buffers) with zero copies."""
+    off = 0
+    (n_inband,) = _U32.unpack(buf[off : off + 4]); off += 4
+    inband = bytes(buf[off : off + n_inband]); off += n_inband
+    (nbuf,) = _U32.unpack(buf[off : off + 4]); off += 4
+    buffers: List[memoryview] = []
+    for _ in range(nbuf):
+        (n,) = _U64.unpack(buf[off : off + 8]); off += 8
+        buffers.append(buf[off : off + n]); off += n
+    return inband, buffers
+
+
+class SerializationContext:
+    """Per-worker serializer with ref/handle reducers.
+
+    ``ref_reducer`` / ``ref_reconstructor`` are installed by the worker so that
+    ObjectRefs and ActorHandles survive crossing process boundaries while the
+    set of contained refs is captured for ownership accounting.
+    """
+
+    def __init__(self):
+        self._custom_reducers: dict[type, Callable] = {}
+
+    def register_reducer(self, typ: type, reducer: Callable) -> None:
+        self._custom_reducers[typ] = reducer
+
+    def serialize(self, value: Any) -> SerializedObject:
+        buffers: List[memoryview] = []
+        contained_refs: list = []
+
+        # Import here to avoid a cycle at module load.
+        from ray_tpu.core.object_ref import ObjectRef
+        from ray_tpu.core.actor import ActorHandle
+
+        class _Pickler(cloudpickle.Pickler):
+            def reducer_override(self, obj):
+                if isinstance(obj, ObjectRef):
+                    contained_refs.append(obj)
+                    return (ObjectRef._rehydrate, (obj._descriptor(),))
+                if isinstance(obj, ActorHandle):
+                    return (ActorHandle._rehydrate, (obj._descriptor(),))
+                for typ, red in self_ctx._custom_reducers.items():
+                    if isinstance(obj, typ):
+                        return red(obj)
+                # Delegate to cloudpickle's own handling (closures, lambdas,
+                # locally-defined classes).
+                return super().reducer_override(obj)
+
+        self_ctx = self
+        sink = io.BytesIO()
+        pickler = _Pickler(sink, protocol=5, buffer_callback=lambda b: buffers.append(b.raw()))
+        pickler.dump(value)
+        return SerializedObject(sink.getvalue(), buffers, contained_refs)
+
+    def deserialize(self, inband: bytes, buffers: List[memoryview]) -> Any:
+        return pickle.loads(inband, buffers=buffers)
+
+    def deserialize_payload(self, payload: memoryview) -> Any:
+        inband, buffers = unpack_payload(payload)
+        return self.deserialize(inband, buffers)

@@ -1,0 +1,160 @@
+"""The resource algebra: predefined + custom resources, TPU first-class.
+
+TPU-native redesign of the reference's resource model
+(``src/ray/common/scheduling/``): there, CPU/GPU/memory are predefined C++
+resources (``scheduling_ids.h:43-46``) and TPU is bolted on as a custom string
+resource from Python (``_private/accelerator.py``). Here ``TPU`` is predefined
+alongside CPU/memory, with per-instance accounting (which chip indices a task
+holds → ``TPU_VISIBLE_CHIPS``) and topology labels (accelerator generation,
+slice name/topology) carried on the node so slice-aware gang placement can be
+expressed natively.
+
+Quantities are fixed-point (10^-4 granularity) like the reference's
+``FixedPoint`` (``fixed_point.h``) so fractional resources compare exactly.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Mapping, Optional
+
+CPU = "CPU"
+TPU = "TPU"
+GPU = "GPU"
+MEMORY = "memory"
+OBJECT_STORE_MEMORY = "object_store_memory"
+
+PREDEFINED = (CPU, TPU, GPU, MEMORY, OBJECT_STORE_MEMORY)
+
+# Node labels describing TPU topology (reference analog: accelerator_type
+# custom resources + GCE metadata probing in _private/accelerator.py:153-220).
+LABEL_ACCELERATOR_TYPE = "accelerator-type"      # e.g. "TPU-V5P"
+LABEL_SLICE_NAME = "tpu-slice-name"              # pod slice this host is in
+LABEL_SLICE_TOPOLOGY = "tpu-slice-topology"      # e.g. "2x2x2"
+LABEL_WORKER_ID_IN_SLICE = "tpu-worker-id"       # host index within the slice
+
+GRANULARITY = 10000  # fixed-point denominator
+
+
+def _fp(x: float) -> int:
+    return round(x * GRANULARITY)
+
+
+class ResourceSet:
+    """An immutable bag of resource quantities (fixed-point internally)."""
+
+    __slots__ = ("_q",)
+
+    def __init__(self, quantities: Optional[Mapping[str, float]] = None):
+        self._q: Dict[str, int] = {}
+        for name, val in (quantities or {}).items():
+            fv = _fp(val)
+            if fv < 0:
+                raise ValueError(f"negative resource {name}={val}")
+            if fv:
+                self._q[name] = fv
+
+    @classmethod
+    def _from_fp(cls, q: Dict[str, int]) -> "ResourceSet":
+        rs = cls()
+        rs._q = {k: v for k, v in q.items() if v}
+        return rs
+
+    def get(self, name: str) -> float:
+        return self._q.get(name, 0) / GRANULARITY
+
+    def names(self):
+        return self._q.keys()
+
+    def is_empty(self) -> bool:
+        return not self._q
+
+    def is_subset_of(self, other: "ResourceSet") -> bool:
+        return all(other._q.get(k, 0) >= v for k, v in self._q.items())
+
+    def add(self, other: "ResourceSet") -> "ResourceSet":
+        q = dict(self._q)
+        for k, v in other._q.items():
+            q[k] = q.get(k, 0) + v
+        return ResourceSet._from_fp(q)
+
+    def subtract(self, other: "ResourceSet") -> "ResourceSet":
+        q = dict(self._q)
+        for k, v in other._q.items():
+            nv = q.get(k, 0) - v
+            if nv < 0:
+                raise ValueError(f"resource {k} would go negative")
+            q[k] = nv
+        return ResourceSet._from_fp(q)
+
+    def to_dict(self) -> Dict[str, float]:
+        return {k: v / GRANULARITY for k, v in self._q.items()}
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, ResourceSet) and other._q == self._q
+
+    def __repr__(self) -> str:
+        return f"ResourceSet({self.to_dict()})"
+
+
+class NodeResources:
+    """A node's total and available resources plus per-instance TPU state.
+
+    Reference analog: ``NodeResources`` + ``ResourceInstanceSet``
+    (``cluster_resource_data.h``) — the per-instance part is what lets a task
+    holding ``num_tpus=2`` be pinned to specific chip indices.
+    """
+
+    def __init__(self, total: Mapping[str, float], labels: Optional[Mapping[str, str]] = None):
+        self.total = ResourceSet(total)
+        self.available = ResourceSet(total)
+        self.labels: Dict[str, str] = dict(labels or {})
+        n_tpu = int(self.total.get(TPU))
+        self._free_tpu_chips: List[int] = list(range(n_tpu))
+
+    def can_fit(self, req: ResourceSet) -> bool:
+        return req.is_subset_of(self.available)
+
+    def is_feasible(self, req: ResourceSet) -> bool:
+        return req.is_subset_of(self.total)
+
+    def allocate(self, req: ResourceSet) -> Dict[str, List[int]]:
+        """Deduct ``req``; returns instance assignment (TPU chip indices)."""
+        self.available = self.available.subtract(req)
+        assignment: Dict[str, List[int]] = {}
+        n_tpu = int(req.get(TPU))
+        if n_tpu:
+            if len(self._free_tpu_chips) < n_tpu:
+                # undo and fail — should not happen if can_fit() was checked
+                self.available = self.available.add(req)
+                raise ValueError("TPU instance accounting out of sync")
+            assignment[TPU] = self._free_tpu_chips[:n_tpu]
+            del self._free_tpu_chips[:n_tpu]
+        return assignment
+
+    def release(self, req: ResourceSet, assignment: Optional[Dict[str, List[int]]] = None) -> None:
+        self.available = self.available.add(req)
+        if assignment and TPU in assignment:
+            self._free_tpu_chips.extend(assignment[TPU])
+            self._free_tpu_chips.sort()
+
+    def utilization(self, req: ResourceSet) -> float:
+        """Critical-resource utilization if ``req`` were placed here.
+
+        Reference analog: the scorer inside ``HybridSchedulingPolicy``
+        (``hybrid_scheduling_policy.h:29-48``).
+        """
+        util = 0.0
+        after = self.available.subtract(req) if req.is_subset_of(self.available) else ResourceSet()
+        for name in set(self.total.names()) | set(req.names()):
+            tot = self.total.get(name)
+            if tot <= 0:
+                continue
+            util = max(util, 1.0 - after.get(name) / tot)
+        return util
+
+    def to_dict(self) -> Dict:
+        return {
+            "total": self.total.to_dict(),
+            "available": self.available.to_dict(),
+            "labels": dict(self.labels),
+        }

@@ -1,0 +1,106 @@
+"""Public exception types.
+
+Mirrors the capability surface of the reference's ``python/ray/exceptions.py``:
+task errors wrap the remote traceback, actor death and object loss are
+distinguishable, and ``get`` timeouts are their own type.
+"""
+
+from __future__ import annotations
+
+import traceback
+from typing import Optional
+
+
+class RayTpuError(Exception):
+    """Base class for all framework errors."""
+
+
+class TaskError(RayTpuError):
+    """A task raised an exception; re-raised at ``get`` with the remote trace.
+
+    Equivalent of the reference's ``RayTaskError``.
+    """
+
+    def __init__(self, function_name: str, cause: BaseException, remote_tb: Optional[str] = None):
+        self.function_name = function_name
+        self.cause = cause
+        self.remote_traceback = remote_tb or "".join(
+            traceback.format_exception(type(cause), cause, cause.__traceback__)
+        )
+        super().__init__(
+            f"task {function_name} failed: {type(cause).__name__}: {cause}\n"
+            f"--- remote traceback ---\n{self.remote_traceback}"
+        )
+
+    def __reduce__(self):
+        return (
+            _rebuild_task_error,
+            (self.function_name, type(self.cause).__name__, str(self.cause), self.remote_traceback),
+        )
+
+
+class _RemoteCause(Exception):
+    """Stand-in for a remote exception type that may not import locally."""
+
+    def __init__(self, type_name: str, msg: str):
+        self.type_name = type_name
+        super().__init__(f"{type_name}: {msg}")
+
+
+def _rebuild_task_error(fn, cause_type, cause_msg, tb):
+    return TaskError(fn, _RemoteCause(cause_type, cause_msg), tb)
+
+
+class ActorError(RayTpuError):
+    pass
+
+
+class ActorDiedError(ActorError):
+    """The actor is dead (crashed, killed, or out of restarts)."""
+
+    def __init__(self, actor_id=None, reason: str = ""):
+        self.actor_id = actor_id
+        self.reason = reason
+        super().__init__(f"actor {actor_id} died: {reason}")
+
+
+class ActorUnavailableError(ActorError):
+    """The actor is temporarily unreachable (e.g. restarting)."""
+
+
+class ObjectLostError(RayTpuError):
+    """All copies of an object were lost and it could not be reconstructed."""
+
+    def __init__(self, object_id=None):
+        self.object_id = object_id
+        super().__init__(f"object {object_id} lost")
+
+
+class OwnerDiedError(ObjectLostError):
+    pass
+
+
+class GetTimeoutError(RayTpuError, TimeoutError):
+    """``get`` exceeded its timeout."""
+
+
+class TaskCancelledError(RayTpuError):
+    def __init__(self, task_id=None):
+        self.task_id = task_id
+        super().__init__(f"task {task_id} cancelled")
+
+
+class WorkerCrashedError(RayTpuError):
+    """The worker process executing the task died unexpectedly."""
+
+
+class RuntimeEnvSetupError(RayTpuError):
+    pass
+
+
+class PendingCallsLimitExceeded(RayTpuError):
+    pass
+
+
+class NodeDiedError(RayTpuError):
+    pass

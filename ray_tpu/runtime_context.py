@@ -1,0 +1,55 @@
+"""Runtime context introspection (reference: ``python/ray/runtime_context.py``)."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from ray_tpu.core.worker import global_worker
+
+
+class RuntimeContext:
+    @property
+    def job_id(self):
+        return global_worker().job_id
+
+    @property
+    def task_id(self):
+        return global_worker().current_task_id()
+
+    @property
+    def actor_id(self):
+        return global_worker().current_actor_id()
+
+    def get_job_id(self) -> str:
+        return global_worker().job_id.hex()
+
+    def get_task_id(self) -> Optional[str]:
+        tid = global_worker().current_task_id()
+        return tid.hex() if tid else None
+
+    def get_actor_id(self) -> Optional[str]:
+        aid = global_worker().current_actor_id()
+        return aid.hex() if aid else None
+
+    def get_node_id(self) -> str:
+        nodes = global_worker().backend.nodes()
+        return nodes[0]["node_id"] if nodes else ""
+
+    def get_tpu_ids(self) -> List[int]:
+        """Chip indices assigned to the current worker (the TPU analog of the
+        reference's ``get_gpu_ids``), parsed from TPU_VISIBLE_CHIPS."""
+        import os
+
+        from ray_tpu._private.config import get_config
+
+        raw = os.environ.get(get_config().tpu_visible_chips_env)
+        if not raw:
+            return []
+        return [int(x) for x in raw.split(",") if x != ""]
+
+    def get_assigned_resources(self) -> Dict[str, float]:
+        return {}
+
+
+def get_runtime_context() -> RuntimeContext:
+    return RuntimeContext()

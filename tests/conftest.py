@@ -1,0 +1,44 @@
+"""Test configuration.
+
+Forces JAX onto a virtual 8-device CPU platform *before* jax is imported so
+multi-chip sharding tests run anywhere (the analog of the reference's
+fake-resource cluster trick, SURVEY.md §4: tests schedule "GPU" tasks with no
+GPUs; here tests build 8-device meshes with no TPUs).
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rt_local():
+    """A fresh in-process runtime per test."""
+    import ray_tpu
+
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    ray_tpu.init(local_mode=True, num_cpus=4, num_tpus=4)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def rt_cluster():
+    """A fresh single-node multiprocess cluster per test."""
+    import ray_tpu
+
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=4, num_tpus=4)
+    yield ray_tpu
+    ray_tpu.shutdown()

@@ -1,0 +1,293 @@
+"""Core API tests against the in-process backend.
+
+Models the reference's ``python/ray/tests/test_basic.py`` coverage: remote
+functions, multiple returns, ref passing, actors (state, ordering, named,
+async), error propagation, wait/timeout semantics.
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.exceptions import ActorDiedError, GetTimeoutError, TaskError
+
+
+def test_put_get(rt_local):
+    ref = ray_tpu.put({"a": 1})
+    assert ray_tpu.get(ref) == {"a": 1}
+
+
+def test_simple_task(rt_local):
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    assert ray_tpu.get(add.remote(1, 2)) == 3
+
+
+def test_task_with_options(rt_local):
+    @ray_tpu.remote(num_cpus=2)
+    def f():
+        return "ok"
+
+    assert ray_tpu.get(f.options(num_cpus=1).remote()) == "ok"
+
+
+def test_multiple_returns(rt_local):
+    @ray_tpu.remote(num_returns=2)
+    def two():
+        return 1, 2
+
+    r1, r2 = two.remote()
+    assert ray_tpu.get(r1) == 1
+    assert ray_tpu.get(r2) == 2
+
+
+def test_ref_as_argument(rt_local):
+    @ray_tpu.remote
+    def double(x):
+        return 2 * x
+
+    a = double.remote(2)
+    b = double.remote(a)
+    assert ray_tpu.get(b) == 8
+
+
+def test_put_ref_as_argument(rt_local):
+    @ray_tpu.remote
+    def identity(x):
+        return x
+
+    assert ray_tpu.get(identity.remote(ray_tpu.put(41))) == 41
+
+
+def test_task_error_propagates(rt_local):
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("expected failure")
+
+    with pytest.raises(TaskError, match="expected failure"):
+        ray_tpu.get(boom.remote())
+
+
+def test_chained_error_propagates(rt_local):
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("root cause")
+
+    @ray_tpu.remote
+    def consume(x):
+        return x
+
+    with pytest.raises(TaskError, match="root cause"):
+        ray_tpu.get(consume.remote(boom.remote()))
+
+
+def test_get_timeout(rt_local):
+    @ray_tpu.remote
+    def slow():
+        time.sleep(5)
+
+    with pytest.raises(GetTimeoutError):
+        ray_tpu.get(slow.remote(), timeout=0.1)
+
+
+def test_wait(rt_local):
+    @ray_tpu.remote
+    def sleepy(t):
+        time.sleep(t)
+        return t
+
+    fast = sleepy.remote(0.01)
+    slow = sleepy.remote(5)
+    ready, not_ready = ray_tpu.wait([fast, slow], num_returns=1, timeout=2)
+    assert ready == [fast]
+    assert not_ready == [slow]
+
+
+def test_wait_timeout_returns_fewer(rt_local):
+    @ray_tpu.remote
+    def slow():
+        time.sleep(5)
+
+    ready, not_ready = ray_tpu.wait([slow.remote()], num_returns=1, timeout=0.05)
+    assert ready == []
+    assert len(not_ready) == 1
+
+
+def test_actor_state(rt_local):
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self, start=0):
+            self.n = start
+
+        def inc(self, k=1):
+            self.n += k
+            return self.n
+
+        def value(self):
+            return self.n
+
+    c = Counter.remote(10)
+    assert ray_tpu.get(c.inc.remote()) == 11
+    assert ray_tpu.get(c.inc.remote(5)) == 16
+    assert ray_tpu.get(c.value.remote()) == 16
+
+
+def test_actor_method_ordering(rt_local):
+    @ray_tpu.remote
+    class Appender:
+        def __init__(self):
+            self.log = []
+
+        def append(self, x):
+            self.log.append(x)
+
+        def get_log(self):
+            return list(self.log)
+
+    a = Appender.remote()
+    for i in range(20):
+        a.append.remote(i)
+    assert ray_tpu.get(a.get_log.remote()) == list(range(20))
+
+
+def test_named_actor(rt_local):
+    @ray_tpu.remote
+    class Svc:
+        def ping(self):
+            return "pong"
+
+    Svc.options(name="svc").remote()
+    handle = ray_tpu.get_actor("svc")
+    assert ray_tpu.get(handle.ping.remote()) == "pong"
+
+
+def test_named_actor_get_if_exists(rt_local):
+    @ray_tpu.remote
+    class Svc:
+        def ping(self):
+            return "pong"
+
+    h1 = Svc.options(name="x").remote()
+    h2 = Svc.options(name="x", get_if_exists=True).remote()
+    assert h1._actor_id == h2._actor_id
+
+
+def test_actor_init_failure(rt_local):
+    @ray_tpu.remote
+    class Bad:
+        def __init__(self):
+            raise RuntimeError("init fails")
+
+        def m(self):
+            return 1
+
+    b = Bad.remote()
+    with pytest.raises((ActorDiedError, TaskError)):
+        ray_tpu.get(b.m.remote())
+
+
+def test_kill_actor(rt_local):
+    @ray_tpu.remote
+    class A:
+        def m(self):
+            return 1
+
+    a = A.remote()
+    assert ray_tpu.get(a.m.remote()) == 1
+    ray_tpu.kill(a)
+    with pytest.raises(ActorDiedError):
+        ray_tpu.get(a.m.remote())
+
+
+def test_async_actor(rt_local):
+    @ray_tpu.remote
+    class AsyncWorker:
+        async def work(self, x):
+            import asyncio
+
+            await asyncio.sleep(0.01)
+            return x * 2
+
+    w = AsyncWorker.remote()
+    refs = [w.work.remote(i) for i in range(5)]
+    assert ray_tpu.get(refs) == [0, 2, 4, 6, 8]
+
+
+def test_actor_handle_passed_to_task(rt_local):
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def inc(self):
+            self.n += 1
+            return self.n
+
+    @ray_tpu.remote
+    def use(counter):
+        return ray_tpu.get(counter.inc.remote())
+
+    c = Counter.remote()
+    assert ray_tpu.get(use.remote(c)) == 1
+    assert ray_tpu.get(c.inc.remote()) == 2
+
+
+def test_nested_tasks(rt_local):
+    @ray_tpu.remote
+    def inner(x):
+        return x + 1
+
+    @ray_tpu.remote
+    def outer(x):
+        return ray_tpu.get(inner.remote(x)) + 10
+
+    assert ray_tpu.get(outer.remote(0)) == 11
+
+
+def test_resources_reported(rt_local):
+    total = ray_tpu.cluster_resources()
+    assert total["CPU"] == 4
+    assert total["TPU"] == 4
+
+
+def test_options_validation(rt_local):
+    with pytest.raises(ValueError):
+        @ray_tpu.remote(bogus_option=1)
+        def f():
+            pass
+
+    with pytest.raises(ValueError):
+        @ray_tpu.remote(num_tpus=1.5)
+        def g():
+            pass
+
+    # fractional < 1 is fine (time-sliced chip)
+    @ray_tpu.remote(num_tpus=0.5)
+    def h():
+        return 1
+
+
+def test_parallel_tasks_actually_parallel(rt_local):
+    @ray_tpu.remote
+    def sleep_task():
+        time.sleep(0.3)
+        return 1
+
+    start = time.monotonic()
+    assert sum(ray_tpu.get([sleep_task.remote() for _ in range(4)])) == 4
+    elapsed = time.monotonic() - start
+    assert elapsed < 1.0, f"tasks serialized: {elapsed:.2f}s"
+
+
+def test_runtime_context(rt_local):
+    ctx = ray_tpu.get_runtime_context()
+    assert len(ctx.get_job_id()) == 8
+
+    @ray_tpu.remote
+    def my_task_id():
+        return ray_tpu.get_runtime_context().get_task_id()
+
+    assert ray_tpu.get(my_task_id.remote()) != ctx.get_task_id()

@@ -1,0 +1,90 @@
+"""Unit tests for IDs and the serialization layer."""
+
+import numpy as np
+import pytest
+
+from ray_tpu._private.ids import ActorID, JobID, ObjectID, TaskID
+from ray_tpu._private.serialization import SerializationContext, unpack_payload
+
+
+def test_id_embedding():
+    job = JobID.from_random()
+    actor = ActorID.of(job)
+    assert actor.job_id() == job
+    task = TaskID.for_actor_task(actor)
+    assert task.actor_id() == actor
+    assert task.job_id() == job
+    obj = ObjectID.for_return(task, 3)
+    assert obj.task_id() == task
+    assert obj.index() == 3
+
+
+def test_put_vs_return_ids_disjoint():
+    task = TaskID.for_task(JobID.from_random())
+    assert ObjectID.for_put(task, 1) != ObjectID.for_return(task, 1)
+
+
+def test_id_roundtrip():
+    n = TaskID.for_task(JobID.from_random())
+    assert TaskID.from_hex(n.hex()) == n
+    import pickle
+
+    assert pickle.loads(pickle.dumps(n)) == n
+
+
+def test_id_size_validation():
+    with pytest.raises(ValueError):
+        JobID(b"too long for a job id")
+
+
+def test_serialize_roundtrip_plain():
+    ctx = SerializationContext()
+    s = ctx.serialize({"x": [1, 2, 3], "y": "hello"})
+    assert ctx.deserialize(s.inband, s.buffers) == {"x": [1, 2, 3], "y": "hello"}
+
+
+def test_serialize_numpy_out_of_band():
+    ctx = SerializationContext()
+    arr = np.arange(100000, dtype=np.float32)
+    s = ctx.serialize(arr)
+    # The array data must be out-of-band, not embedded in the pickle stream.
+    assert len(s.inband) < 10000
+    assert sum(len(b) for b in s.buffers) >= arr.nbytes
+    out = ctx.deserialize(s.inband, s.buffers)
+    np.testing.assert_array_equal(out, arr)
+
+
+def test_payload_pack_unpack_zero_copy():
+    ctx = SerializationContext()
+    arr = np.arange(1000, dtype=np.int64)
+    s = ctx.serialize({"arr": arr, "tag": 7})
+    payload = s.to_bytes()
+    inband, buffers = unpack_payload(memoryview(payload))
+    out = ctx.deserialize(inband, buffers)
+    np.testing.assert_array_equal(out["arr"], arr)
+    assert out["tag"] == 7
+
+
+def test_serialize_closure():
+    ctx = SerializationContext()
+    k = 42
+
+    def fn(x):
+        return x + k
+
+    s = ctx.serialize(fn)
+    fn2 = ctx.deserialize(s.inband, s.buffers)
+    assert fn2(1) == 43
+
+
+def test_object_ref_in_value(rt_local):
+    import ray_tpu
+    from ray_tpu.core.object_ref import ObjectRef
+
+    ctx = SerializationContext()
+    ref = ray_tpu.put(5)
+    s = ctx.serialize({"ref": ref})
+    assert s.contained_refs == [ref]
+    out = ctx.deserialize(s.inband, s.buffers)
+    assert isinstance(out["ref"], ObjectRef)
+    assert out["ref"] == ref
