@@ -1,0 +1,97 @@
+"""Headline benchmark: Llama train-step throughput on real hardware.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Metric: training tokens/sec/chip on the largest preset that fits the chip
+(BASELINE.md configs 1-3 collapse to this on a single-chip environment; the
+reference publishes no tokens/sec numbers — `published: {}` — so
+``vs_baseline`` is the ratio to the recorded best from prior rounds when
+present in BENCH_BASELINE.json, else 1.0).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models import llama
+    from ray_tpu.parallel import train_step as ts
+
+    devices = jax.devices()
+    n_dev = len(devices)
+    platform = devices[0].platform
+
+    # Pick preset/batch by available memory: ~410M params trains comfortably
+    # in 16 GB HBM (v5e); scale down on CPU test runs.
+    if platform == "cpu":
+        preset, batch, seq, steps = "debug", 8, 128, 3
+    else:
+        preset, batch, seq, steps = "410m", 8, 2048, 10
+        if os.environ.get("BENCH_PRESET"):
+            preset = os.environ["BENCH_PRESET"]
+
+    cfg = llama.PRESETS[preset]
+    seq = min(seq, cfg.max_seq_len)
+
+    if n_dev > 1:
+        mesh, _ = ts.auto_mesh(n_dev, devices)
+    else:
+        from ray_tpu.parallel.mesh import MeshConfig, make_mesh
+
+        mesh = make_mesh(MeshConfig(), devices)
+
+    optimizer = ts.default_optimizer(total_steps=1000)
+    params, opt_state = ts.init_sharded_state(jax.random.key(0), cfg, mesh, optimizer)
+    step = ts.make_train_step(cfg, optimizer)
+
+    rng = jax.random.key(1)
+    tokens = jax.random.randint(rng, (batch, seq + 1), 0, cfg.vocab_size,
+                                dtype=jnp.int32)
+    batch_data = ts.shard_batch({"tokens": tokens}, mesh)
+
+    # Warmup / compile.
+    params, opt_state, metrics = step(params, opt_state, batch_data)
+    jax.block_until_ready(metrics["loss"])
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, opt_state, metrics = step(params, opt_state, batch_data)
+    jax.block_until_ready(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    tokens_per_step = batch * seq
+    tok_s = tokens_per_step * steps / dt
+    tok_s_chip = tok_s / n_dev
+
+    # Model FLOPs utilization (6 * N * tokens fwd+bwd estimate).
+    flops_per_tok = 6 * cfg.num_params()
+    peak = {"tpu": 197e12, "cpu": 1e11}.get(platform, 1e12)  # v5e bf16 peak
+    mfu = (tok_s_chip * flops_per_tok) / peak
+
+    baseline = None
+    if os.path.exists("BENCH_BASELINE.json"):
+        try:
+            baseline = json.load(open("BENCH_BASELINE.json")).get("value")
+        except Exception:
+            baseline = None
+    vs = (tok_s_chip / baseline) if baseline else 1.0
+
+    print(json.dumps({
+        "metric": f"llama_{preset}_train_tokens_per_sec_per_chip",
+        "value": round(tok_s_chip, 2),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(vs, 4),
+        "details": {"platform": platform, "devices": n_dev, "batch": batch,
+                    "seq": seq, "steps": steps, "loss": float(metrics["loss"]),
+                    "mfu_est": round(mfu, 4),
+                    "params_m": round(cfg.num_params() / 1e6, 1)},
+    }))
+
+
+if __name__ == "__main__":
+    main()

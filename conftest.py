@@ -1,0 +1,7 @@
+"""Root conftest: path setup only (platform scrubbing is in
+``rt_test_platform.py``, loaded as an early ``-p`` plugin via pytest.ini)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))

@@ -1,0 +1,370 @@
+"""GCS: the cluster control plane (head-node metadata + actor lifecycle).
+
+Reference analog: ``src/ray/gcs/gcs_server/`` — node membership + health
+(``GcsNodeManager``, ``GcsHealthCheckManager``), actor lifecycle + restart
+(``GcsActorManager``/``GcsActorScheduler``), internal KV (``GcsKvManager``,
+also the function table), the object directory, and named actors. State is
+in-memory (a Redis-backed store client is a later round's HA concern).
+
+Long-poll futures replace the reference's pubsub channels for the two hot
+subscriptions (actor-alive, object-location): O(#waiters) wakeups, no
+polling.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import pickle
+import time
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+from ray_tpu._private.config import get_config
+from ray_tpu.core.resources import NodeResources, ResourceSet
+from ray_tpu.cluster.rpc import ConnectionPool
+from ray_tpu.scheduler.policy import pick_node
+
+ACTOR_PENDING = "PENDING_CREATION"
+ACTOR_ALIVE = "ALIVE"
+ACTOR_RESTARTING = "RESTARTING"
+ACTOR_DEAD = "DEAD"
+
+
+class _NodeEntry:
+    def __init__(self, node_id: str, address: str, resources: Dict[str, float],
+                 labels: Dict[str, str]):
+        self.node_id = node_id
+        self.address = address
+        self.view = NodeResources(resources, labels)
+        self.alive = True
+        self.last_heartbeat = time.monotonic()
+
+
+class _ActorEntry:
+    def __init__(self, actor_id: str, spec: Dict[str, Any]):
+        self.actor_id = actor_id
+        self.spec = spec                      # picklable creation spec
+        self.state = ACTOR_PENDING
+        self.address: Optional[str] = None
+        self.node_id: Optional[str] = None
+        self.num_restarts = 0
+        self.death_reason = ""
+        self.waiters: List[asyncio.Future] = []
+
+    def info(self) -> Dict[str, Any]:
+        return {
+            "actor_id": self.actor_id, "state": self.state,
+            "address": self.address, "node_id": self.node_id,
+            "name": self.spec.get("name"), "namespace": self.spec.get("namespace"),
+            "class_name": self.spec.get("class_name"),
+            "num_restarts": self.num_restarts,
+            "death_reason": self.death_reason,
+            "max_task_retries": self.spec.get("max_task_retries", 0),
+        }
+
+
+class GcsServer:
+    def __init__(self):
+        self.nodes: Dict[str, _NodeEntry] = {}
+        self.kv: Dict[str, bytes] = {}
+        self.actors: Dict[str, _ActorEntry] = {}
+        self.named_actors: Dict[Tuple[str, str], str] = {}
+        self.object_locations: Dict[str, Set[str]] = {}
+        self.object_sizes: Dict[str, int] = {}
+        self._location_waiters: Dict[str, List[asyncio.Future]] = {}
+        self._pool = ConnectionPool(peer_id="gcs")
+        self._monitor_task: Optional[asyncio.Task] = None
+        self._job_counter = 0
+
+    def start_monitor(self) -> None:
+        self._monitor_task = asyncio.ensure_future(self._monitor_loop())
+
+    # ---- nodes ------------------------------------------------------------
+    async def rpc_register_node(self, p):
+        entry = _NodeEntry(p["node_id"], p["address"], p["resources"],
+                           p.get("labels", {}))
+        self.nodes[p["node_id"]] = entry
+        return {"ok": True}
+
+    async def rpc_heartbeat(self, p):
+        entry = self.nodes.get(p["node_id"])
+        if entry is None:
+            return {"ok": False, "unknown": True}
+        entry.last_heartbeat = time.monotonic()
+        if "available" in p:
+            entry.view.available = ResourceSet(p["available"])
+        return {"ok": True}
+
+    async def rpc_list_nodes(self, p):
+        return [{
+            "node_id": n.node_id, "address": n.address, "alive": n.alive,
+            "resources": n.view.total.to_dict(),
+            "available": n.view.available.to_dict(),
+            "labels": dict(n.view.labels),
+        } for n in self.nodes.values()]
+
+    async def rpc_drain_node(self, p):
+        entry = self.nodes.get(p["node_id"])
+        if entry:
+            await self._mark_node_dead(entry, "drained")
+        return {"ok": True}
+
+    async def _monitor_loop(self) -> None:
+        cfg = get_config()
+        while True:
+            await asyncio.sleep(cfg.heartbeat_interval_s)
+            now = time.monotonic()
+            for entry in list(self.nodes.values()):
+                if entry.alive and now - entry.last_heartbeat > cfg.node_death_timeout_s:
+                    await self._mark_node_dead(entry, "heartbeat timeout")
+
+    async def _mark_node_dead(self, entry: _NodeEntry, reason: str) -> None:
+        entry.alive = False
+        # Objects whose only copy was there are lost (lineage reconstruction
+        # is a later round); actors there restart elsewhere if budgeted.
+        for oid, locs in list(self.object_locations.items()):
+            locs.discard(entry.node_id)
+        for actor in list(self.actors.values()):
+            if actor.node_id == entry.node_id and actor.state in (
+                    ACTOR_ALIVE, ACTOR_PENDING, ACTOR_RESTARTING):
+                await self._handle_actor_failure(actor, f"node died: {reason}")
+
+    # ---- kv / function table ----------------------------------------------
+    async def rpc_kv_put(self, p):
+        self.kv[p["key"]] = p["value"]
+        return {"ok": True}
+
+    async def rpc_kv_get(self, p):
+        return {"value": self.kv.get(p["key"])}
+
+    async def rpc_kv_del(self, p):
+        self.kv.pop(p["key"], None)
+        return {"ok": True}
+
+    async def rpc_kv_keys(self, p):
+        return {"keys": [k for k in self.kv if k.startswith(p["prefix"])]}
+
+    # ---- object directory --------------------------------------------------
+    async def rpc_add_object_location(self, p):
+        oid, node_id = p["oid"], p["node_id"]
+        self.object_locations.setdefault(oid, set()).add(node_id)
+        if "size" in p:
+            self.object_sizes[oid] = p["size"]
+        for fut in self._location_waiters.pop(oid, []):
+            if not fut.done():
+                fut.set_result(True)
+        return {"ok": True}
+
+    async def rpc_remove_object_location(self, p):
+        locs = self.object_locations.get(p["oid"])
+        if locs:
+            locs.discard(p["node_id"])
+        return {"ok": True}
+
+    async def rpc_get_object_locations(self, p):
+        oid = p["oid"]
+        timeout = p.get("timeout")
+        locs = self.object_locations.get(oid)
+        if not locs and p.get("wait"):
+            fut = asyncio.get_running_loop().create_future()
+            self._location_waiters.setdefault(oid, []).append(fut)
+            try:
+                await asyncio.wait_for(fut, timeout)
+            except asyncio.TimeoutError:
+                pass
+            locs = self.object_locations.get(oid)
+        alive = [n for n in (locs or ()) if self.nodes.get(n) and self.nodes[n].alive]
+        return {
+            "locations": [{"node_id": n, "address": self.nodes[n].address}
+                          for n in alive],
+            "size": self.object_sizes.get(oid),
+        }
+
+    # ---- actors ------------------------------------------------------------
+    async def rpc_register_actor(self, p):
+        spec = p["spec"]
+        actor_id = spec["actor_id"]
+        name, ns = spec.get("name"), spec.get("namespace", "default")
+        if name is not None:
+            existing = self.named_actors.get((ns, name))
+            if existing is not None:
+                if spec.get("get_if_exists"):
+                    return {"actor_id": existing, "existing": True,
+                            "info": self.actors[existing].info(),
+                            "method_meta": self.actors[existing].spec.get("method_meta")}
+                return {"error": f"actor name {name!r} taken in namespace {ns!r}"}
+        entry = _ActorEntry(actor_id, spec)
+        self.actors[actor_id] = entry
+        if name is not None:
+            self.named_actors[(ns, name)] = actor_id
+        asyncio.ensure_future(self._schedule_actor(entry))
+        return {"actor_id": actor_id, "existing": False}
+
+    async def _schedule_actor(self, entry: _ActorEntry,
+                              backoff: float = 0.0) -> None:
+        if backoff:
+            await asyncio.sleep(backoff)
+        req = ResourceSet(entry.spec.get("resources", {}))
+        strategy = entry.spec.get("scheduling_strategy")
+        deadline = time.monotonic() + 3600.0
+        while time.monotonic() < deadline:
+            if entry.state == ACTOR_DEAD:
+                return  # killed while pending/restarting
+            views = {nid: n.view for nid, n in self.nodes.items() if n.alive}
+            node_id = pick_node(strategy, views, req)
+            if node_id is None:
+                await asyncio.sleep(0.2)  # infeasible now; wait for nodes
+                continue
+            node = self.nodes[node_id]
+            try:
+                client = await self._pool.get(node.address)
+                reply = await client.call("create_actor", {
+                    "actor_id": entry.actor_id, "spec": entry.spec})
+                if entry.state == ACTOR_DEAD:
+                    # Killed during creation: reap the just-created worker.
+                    if reply.get("ok"):
+                        await client.call("kill_actor",
+                                          {"actor_id": entry.actor_id})
+                    return
+                if reply.get("ok"):
+                    entry.node_id = node_id
+                    return  # raylet reports actor_update(ALIVE) when ready
+                if reply.get("retry"):
+                    await asyncio.sleep(0.2)
+                    continue
+                await self._finalize_actor_death(
+                    entry, reply.get("error", "creation failed"))
+                return
+            except Exception as e:  # node unreachable — try another
+                self._pool.invalidate(node.address)
+                await asyncio.sleep(0.2)
+        await self._finalize_actor_death(entry, "scheduling timed out")
+
+    async def rpc_actor_update(self, p):
+        entry = self.actors.get(p["actor_id"])
+        if entry is None:
+            return {"ok": False}
+        state = p["state"]
+        if state == ACTOR_ALIVE:
+            if entry.state == ACTOR_DEAD:
+                # Killed while the raylet was creating it — don't resurrect;
+                # tell the raylet to reap the worker.
+                node = self.nodes.get(p.get("node_id", ""))
+                if node is not None:
+                    try:
+                        client = await self._pool.get(node.address)
+                        await client.call("kill_actor",
+                                          {"actor_id": entry.actor_id})
+                    except Exception:
+                        pass
+                return {"ok": True}
+            entry.state = ACTOR_ALIVE
+            entry.address = p.get("address")
+            entry.node_id = p.get("node_id", entry.node_id)
+            self._wake_actor_waiters(entry)
+        elif state == ACTOR_DEAD:
+            await self._handle_actor_failure(entry, p.get("reason", "worker died"))
+        return {"ok": True}
+
+    async def _handle_actor_failure(self, entry: _ActorEntry, reason: str) -> None:
+        if entry.state == ACTOR_DEAD:
+            return
+        max_restarts = entry.spec.get("max_restarts", 0)
+        if entry.spec.get("_explicit_kill"):
+            max_restarts = 0
+        if max_restarts == -1 or entry.num_restarts < max_restarts:
+            entry.num_restarts += 1
+            entry.state = ACTOR_RESTARTING
+            entry.address = None
+            # Backoff happens inside the spawned task — this path runs on the
+            # monitor loop and must not stall node-death handling.
+            asyncio.ensure_future(self._schedule_actor(
+                entry, backoff=get_config().actor_restart_backoff_s))
+        else:
+            await self._finalize_actor_death(entry, reason)
+
+    async def _finalize_actor_death(self, entry: _ActorEntry, reason: str) -> None:
+        entry.state = ACTOR_DEAD
+        entry.death_reason = reason
+        name, ns = entry.spec.get("name"), entry.spec.get("namespace", "default")
+        if name is not None and self.named_actors.get((ns, name)) == entry.actor_id:
+            del self.named_actors[(ns, name)]
+        self._wake_actor_waiters(entry)
+
+    def _wake_actor_waiters(self, entry: _ActorEntry) -> None:
+        for fut in entry.waiters:
+            if not fut.done():
+                fut.set_result(True)
+        entry.waiters.clear()
+
+    async def rpc_get_actor_info(self, p):
+        entry = self.actors.get(p["actor_id"])
+        if entry is None:
+            return {"error": "unknown actor"}
+        if p.get("wait_alive"):
+            deadline = time.monotonic() + p.get("timeout", 60.0)
+            while entry.state in (ACTOR_PENDING, ACTOR_RESTARTING):
+                fut = asyncio.get_running_loop().create_future()
+                entry.waiters.append(fut)
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                try:
+                    await asyncio.wait_for(fut, remaining)
+                except asyncio.TimeoutError:
+                    break
+        return {"info": entry.info(),
+                "method_meta": entry.spec.get("method_meta")}
+
+    async def rpc_get_named_actor(self, p):
+        actor_id = self.named_actors.get((p.get("namespace", "default"), p["name"]))
+        if actor_id is None:
+            return {"error": f"no actor named {p['name']!r}"}
+        entry = self.actors[actor_id]
+        return {"actor_id": actor_id, "info": entry.info(),
+                "method_meta": entry.spec.get("method_meta")}
+
+    async def rpc_kill_actor(self, p):
+        entry = self.actors.get(p["actor_id"])
+        if entry is None:
+            return {"ok": False}
+        entry.spec["_explicit_kill"] = True
+        if entry.address and entry.node_id:
+            node = self.nodes.get(entry.node_id)
+            if node:
+                try:
+                    client = await self._pool.get(node.address)
+                    await client.call("kill_actor", {"actor_id": entry.actor_id})
+                except Exception:
+                    pass
+        await self._finalize_actor_death(entry, "killed via kill()")
+        return {"ok": True}
+
+    async def rpc_list_actors(self, p):
+        return [a.info() for a in self.actors.values()]
+
+    # ---- task routing (spillback target selection) -------------------------
+    async def rpc_route_task(self, p):
+        req = ResourceSet(p["resources"])
+        views = {nid: n.view for nid, n in self.nodes.items() if n.alive}
+        node_id = pick_node(p.get("strategy"), views, req,
+                            preferred=p.get("preferred"))
+        if node_id is None:
+            return {"error": "infeasible", "node_id": None}
+        return {"node_id": node_id, "address": self.nodes[node_id].address}
+
+    # ---- cluster info -------------------------------------------------------
+    async def rpc_cluster_resources(self, p):
+        total: Dict[str, float] = {}
+        avail: Dict[str, float] = {}
+        for n in self.nodes.values():
+            if not n.alive:
+                continue
+            for k, v in n.view.total.to_dict().items():
+                total[k] = total.get(k, 0) + v
+            for k, v in n.view.available.to_dict().items():
+                avail[k] = avail.get(k, 0) + v
+        return {"total": total, "available": avail}
+
+    async def rpc_next_job_id(self, p):
+        self._job_counter += 1
+        return {"job_index": self._job_counter}

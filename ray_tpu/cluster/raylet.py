@@ -1,0 +1,392 @@
+"""Raylet: the per-node control plane (worker pool + local scheduler + object
+plane endpoints).
+
+Reference analog: ``src/ray/raylet/`` — ``NodeManager`` (lease/dispatch RPCs),
+``WorkerPool`` (process spawning + idle reuse keyed by environment),
+``LocalTaskManager`` (resource-gated FIFO dispatch), ``ObjectManager``
+(node-to-node transfer by directory lookup). Redesigns:
+  - Tasks are pushed raylet→worker and the submitter's RPC is held open until
+    completion, so small results ride the reply chain back to the OWNER's
+    memory store (the reference gets the same effect with worker→worker
+    ``PushNormalTask`` after a lease; fewer moving parts here, same ownership
+    semantics).
+  - TPU chips are per-instance resources: a task/actor holding chips gets a
+    dedicated worker process pinned via TPU_VISIBLE_CHIPS at spawn, cached
+    keyed by its chip set (reference: worker cache keyed by runtime-env hash).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import subprocess
+import sys
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu._private.config import get_config
+from ray_tpu.core.resources import NodeResources, ResourceSet, TPU
+from ray_tpu.cluster.object_store import PlasmaStore
+from ray_tpu.cluster.rpc import ConnectionPool, RpcClient, RpcServer
+from ray_tpu.exceptions import WorkerCrashedError
+
+
+class _WorkerEntry:
+    def __init__(self, worker_id: str, proc: subprocess.Popen, key: Tuple,
+                 loop: asyncio.AbstractEventLoop):
+        self.worker_id = worker_id
+        self.proc = proc
+        self.key = key                      # (chip_tuple, runtime_env_hash)
+        self.address: Optional[str] = None
+        self.client: Optional[RpcClient] = None
+        self.ready = loop.create_future()
+        self.busy = False
+        self.is_actor_worker = False
+        self.actor_id: Optional[str] = None
+        self.assignment: Dict[str, List[int]] = {}
+
+
+class Raylet:
+    def __init__(self, node_id: str, session_name: str, gcs_address: str,
+                 resources: Dict[str, float], labels: Dict[str, str],
+                 loop: asyncio.AbstractEventLoop):
+        self.node_id = node_id
+        self.session_name = session_name
+        self.gcs_address = gcs_address
+        self.node = NodeResources(resources, labels)
+        self.loop = loop
+        self.store = PlasmaStore(session_name)
+        self.server = RpcServer(loop)
+        self.server.register_object(self)
+        self.server.set_disconnect_handler(self._on_peer_disconnect)
+        self._gcs: Optional[RpcClient] = None
+        self._pool = ConnectionPool(peer_id=f"raylet:{node_id}")
+        self._workers: Dict[str, _WorkerEntry] = {}
+        self._idle: Dict[Tuple, List[_WorkerEntry]] = {}
+        self._queue: List[Dict] = []          # pending task payloads + futures
+        self._inflight: Dict[str, Dict] = {}  # task_id -> resource state
+        self._dispatch_event = asyncio.Event()
+        self._local_objects: set = set()
+        self._tasks: List[asyncio.Task] = []
+        self._stopped = False
+
+    # ---- lifecycle ----------------------------------------------------------
+    async def start(self, port: int = 0) -> str:
+        await self.server.start(port)
+        self._gcs = RpcClient(self.gcs_address, peer_id=f"raylet:{self.node_id}")
+        await self._gcs.connect()
+        await self._gcs.call("register_node", {
+            "node_id": self.node_id, "address": self.server.address,
+            "resources": self.node.total.to_dict(),
+            "labels": dict(self.node.labels)})
+        self._tasks.append(asyncio.ensure_future(self._heartbeat_loop()))
+        self._tasks.append(asyncio.ensure_future(self._dispatch_loop()))
+        self._tasks.append(asyncio.ensure_future(self._reap_loop()))
+        return self.server.address
+
+    async def stop(self, destroy_store: bool = False) -> None:
+        self._stopped = True
+        for t in self._tasks:
+            t.cancel()
+        for w in list(self._workers.values()):
+            try:
+                w.proc.terminate()
+            except ProcessLookupError:
+                pass
+        await self.server.stop()
+        # The shm session dir is SHARED by all nodes of the session (same
+        # host); only the session owner destroys it (ClusterHandle.shutdown).
+        if destroy_store:
+            self.store.destroy()
+
+    async def _heartbeat_loop(self) -> None:
+        cfg = get_config()
+        while True:
+            await asyncio.sleep(cfg.heartbeat_interval_s)
+            try:
+                await self._gcs.call("heartbeat", {
+                    "node_id": self.node_id,
+                    "available": self.node.available.to_dict()})
+            except Exception:
+                pass
+
+    # ---- worker pool --------------------------------------------------------
+    def _spawn_worker(self, key: Tuple, chips: List[int]) -> _WorkerEntry:
+        worker_id = os.urandom(8).hex()
+        env = dict(os.environ)
+        env["RT_WORKER_ID"] = worker_id
+        env["RT_RAYLET_ADDR"] = self.server.address
+        env["RT_GCS_ADDR"] = self.gcs_address
+        env["RT_NODE_ID"] = self.node_id
+        env["RT_SESSION_NAME"] = self.session_name
+        env["RT_CONFIG_JSON"] = get_config().to_json()
+        if chips:
+            env[get_config().tpu_visible_chips_env] = ",".join(map(str, chips))
+        log_dir = os.path.join(get_config().session_dir_root,
+                               self.session_name, "logs")
+        os.makedirs(log_dir, exist_ok=True)
+        log_file = open(os.path.join(log_dir, f"worker-{worker_id}.log"), "wb")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu.cluster.worker_main"],
+            env=env, stdout=log_file, stderr=subprocess.STDOUT)
+        log_file.close()
+        entry = _WorkerEntry(worker_id, proc, key, self.loop)
+        self._workers[worker_id] = entry
+        return entry
+
+    async def rpc_worker_ready(self, p):
+        entry = self._workers.get(p["worker_id"])
+        if entry is None:
+            return {"ok": False}
+        entry.address = p["address"]
+        entry.client = await self._pool.get(p["address"])
+        if not entry.ready.done():
+            entry.ready.set_result(True)
+        return {"ok": True, "node_id": self.node_id}
+
+    async def _get_worker(self, key: Tuple, chips: List[int]) -> _WorkerEntry:
+        idle = self._idle.get(key)
+        while idle:
+            entry = idle.pop()
+            if entry.proc.poll() is None:
+                return entry
+            self._workers.pop(entry.worker_id, None)
+        entry = self._spawn_worker(key, chips)
+        await asyncio.wait_for(entry.ready,
+                               get_config().process_startup_timeout_s)
+        return entry
+
+    def _release_worker(self, entry: _WorkerEntry) -> None:
+        entry.busy = False
+        if entry.proc.poll() is None and not entry.is_actor_worker:
+            self._idle.setdefault(entry.key, []).append(entry)
+
+    async def _reap_loop(self) -> None:
+        """Detect dead worker processes (reference: worker death via local
+        socket disconnect)."""
+        while True:
+            await asyncio.sleep(0.5)
+            for entry in list(self._workers.values()):
+                if entry.proc.poll() is not None:
+                    self._workers.pop(entry.worker_id, None)
+                    if entry.is_actor_worker and entry.actor_id:
+                        self.node.release(
+                            ResourceSet(entry_spec_resources(entry)), entry.assignment)
+                        await self._gcs.call("actor_update", {
+                            "actor_id": entry.actor_id, "state": "DEAD",
+                            "reason": f"worker exited with code {entry.proc.returncode}"})
+                        entry.is_actor_worker = False
+
+    async def _on_peer_disconnect(self, peer_id: str) -> None:
+        pass
+
+    # ---- task submission / dispatch ----------------------------------------
+    async def rpc_submit_task(self, p):
+        """Held open until the task completes; reply carries results meta."""
+        req = ResourceSet(p["resources"])
+        if not self.node.is_feasible(req) or p.get("spillback_hint"):
+            return await self._spill(p)
+        fut = asyncio.get_running_loop().create_future()
+        self._queue.append({"payload": p, "future": fut})
+        self._dispatch_event.set()
+        return await fut
+
+    async def _spill(self, p):
+        """Route an infeasible task through the GCS to a node that fits
+        (reference: spillback reply in ``HandleRequestWorkerLease``; here the
+        raylet forwards and proxies the reply instead)."""
+        p = dict(p)
+        p.pop("spillback_hint", None)
+        route = await self._gcs.call("route_task", {
+            "resources": p["resources"], "strategy": p.get("strategy"),
+            "preferred": None})
+        if not route.get("address"):
+            return {"error": "infeasible",
+                    "message": f"no node can ever run task requiring {p['resources']}"}
+        client = await self._pool.get(route["address"])
+        return await client.call("submit_task", p)
+
+    async def _dispatch_loop(self) -> None:
+        while True:
+            await self._dispatch_event.wait()
+            self._dispatch_event.clear()
+            remaining = []
+            for item in self._queue:
+                req = ResourceSet(item["payload"]["resources"])
+                if self.node.can_fit(req):
+                    assignment = self.node.allocate(req)
+                    asyncio.ensure_future(self._run_task(item, req, assignment))
+                else:
+                    remaining.append(item)
+            self._queue = remaining
+
+    async def _run_task(self, item, req: ResourceSet, assignment) -> None:
+        payload, fut = item["payload"], item["future"]
+        task_id = payload["task_id"]
+        chips = assignment.get(TPU, [])
+        key = (tuple(chips),)
+        self._inflight[task_id] = {"req": req, "released": ResourceSet()}
+        try:
+            worker = await self._get_worker(key, chips)
+            worker.busy = True
+            try:
+                reply = await worker.client.call("push_task", payload)
+            finally:
+                self._release_worker(worker)
+            if not fut.done():
+                fut.set_result(reply)
+        except Exception as e:  # worker crashed mid-task or failed to start
+            if not fut.done():
+                fut.set_result({"error": "worker_crashed", "message": repr(e)})
+        finally:
+            state = self._inflight.pop(task_id)
+            self.node.release(state["req"].subtract(state["released"]), assignment)
+            self._dispatch_event.set()
+
+    async def rpc_task_blocked(self, p):
+        """A worker entered a blocking ``get`` inside a task: return its CPU
+        to the pool so dependent tasks can run (the reference's
+        blocked-worker CPU release — prevents parent-waits-on-child
+        deadlock). The CPU is not re-acquired on unblock; it flows back when
+        the task finishes."""
+        from ray_tpu.core.resources import CPU
+
+        state = self._inflight.get(p["task_id"])
+        if state is None or not state["released"].is_empty():
+            return {"ok": False}
+        cpu_part = ResourceSet({CPU: state["req"].get(CPU)})
+        if cpu_part.is_empty():
+            return {"ok": False}
+        state["released"] = cpu_part
+        self.node.release(cpu_part)
+        self._dispatch_event.set()
+        return {"ok": True}
+
+    # ---- actors -------------------------------------------------------------
+    async def rpc_create_actor(self, p):
+        spec = p["spec"]
+        req = ResourceSet(spec.get("resources", {}))
+        if not self.node.can_fit(req):
+            return {"ok": False, "retry": True}
+        assignment = self.node.allocate(req)
+        chips = assignment.get(TPU, [])
+        worker = None
+        try:
+            worker = self._spawn_worker((("actor", p["actor_id"]),), chips)
+            worker.is_actor_worker = True
+            worker.actor_id = p["actor_id"]
+            worker.assignment = assignment
+            worker._spec_resources = spec.get("resources", {})
+            await asyncio.wait_for(worker.ready,
+                                   get_config().process_startup_timeout_s)
+            reply = await worker.client.call("create_actor", p)
+            if not reply.get("ok"):
+                # Unmark before releasing so _reap_loop doesn't release the
+                # same resources a second time (double-release would corrupt
+                # chip accounting).
+                worker.is_actor_worker = False
+                self._workers.pop(worker.worker_id, None)
+                self.node.release(req, assignment)
+                try:
+                    worker.proc.terminate()
+                except ProcessLookupError:
+                    pass
+                await self._gcs.call("actor_update", {
+                    "actor_id": p["actor_id"], "state": "DEAD",
+                    "reason": reply.get("error", "actor __init__ failed")})
+                return {"ok": False, "error": reply.get("error")}
+            await self._gcs.call("actor_update", {
+                "actor_id": p["actor_id"], "state": "ALIVE",
+                "address": reply["address"], "node_id": self.node_id})
+            return {"ok": True}
+        except Exception as e:
+            if worker is not None:
+                worker.is_actor_worker = False
+                self._workers.pop(worker.worker_id, None)
+                try:
+                    worker.proc.terminate()
+                except ProcessLookupError:
+                    pass
+            self.node.release(req, assignment)
+            return {"ok": False, "error": repr(e)}
+
+    async def rpc_kill_actor(self, p):
+        for entry in list(self._workers.values()):
+            if entry.actor_id == p["actor_id"]:
+                entry.is_actor_worker = False  # suppress DEAD re-report
+                self.node.release(ResourceSet(entry_spec_resources(entry)),
+                                  entry.assignment)
+                try:
+                    entry.proc.terminate()
+                except ProcessLookupError:
+                    pass
+                self._workers.pop(entry.worker_id, None)
+        return {"ok": True}
+
+    # ---- object plane -------------------------------------------------------
+    async def rpc_seal_object(self, p):
+        oid_hex = p["oid"]
+        self._local_objects.add(oid_hex)
+        await self._gcs.call("add_object_location", {
+            "oid": oid_hex, "node_id": self.node_id, "size": p.get("size", 0)})
+        return {"ok": True}
+
+    async def rpc_get_object_payload(self, p):
+        from ray_tpu._private.ids import ObjectID
+
+        view = self.store.read(ObjectID.from_hex(p["oid"]))
+        if view is None:
+            return {"error": "not found"}
+        return {"payload": bytes(view)}
+
+    async def rpc_fetch_object(self, p):
+        """Pull an object to this node's store (reference: PullManager →
+        remote ObjectManager chunked push)."""
+        from ray_tpu._private.ids import ObjectID
+
+        oid_hex = p["oid"]
+        oid = ObjectID.from_hex(oid_hex)
+        if self.store.contains(oid):
+            return {"ok": True}
+        reply = await self._gcs.call("get_object_locations", {
+            "oid": oid_hex, "wait": True, "timeout": p.get("timeout", 30.0)})
+        for loc in reply["locations"]:
+            if loc["node_id"] == self.node_id:
+                continue
+            try:
+                client = await self._pool.get(loc["address"])
+                data = await client.call("get_object_payload", {"oid": oid_hex})
+                if "payload" in data:
+                    self.store.write_whole(oid, data["payload"])
+                    await self.rpc_seal_object({"oid": oid_hex,
+                                                "size": len(data["payload"])})
+                    return {"ok": True}
+            except Exception:
+                continue
+        if self.store.contains(oid):
+            return {"ok": True}
+        return {"error": "unavailable", "oid": oid_hex}
+
+    async def rpc_free_objects(self, p):
+        from ray_tpu._private.ids import ObjectID
+
+        for oid_hex in p["oids"]:
+            self.store.delete(ObjectID.from_hex(oid_hex))
+            self._local_objects.discard(oid_hex)
+            await self._gcs.call("remove_object_location", {
+                "oid": oid_hex, "node_id": self.node_id})
+        return {"ok": True}
+
+    async def rpc_node_stats(self, p):
+        return {
+            "node_id": self.node_id,
+            "workers": len(self._workers),
+            "idle": sum(len(v) for v in self._idle.values()),
+            "queued": len(self._queue),
+            "object_store_bytes": self.store.used_bytes(),
+            "available": self.node.available.to_dict(),
+        }
+
+
+def entry_spec_resources(entry) -> Dict[str, float]:
+    return getattr(entry, "_spec_resources", {})

@@ -1,0 +1,271 @@
+"""Asyncio TCP RPC: the framework's gRPC-equivalent plumbing.
+
+Fills the role of the reference's ``src/ray/rpc/`` (``GrpcServer``,
+``ClientCall`` with connection pooling): every process runs one ``RpcServer``
+on its background event-loop thread; ``RpcClient`` multiplexes concurrent
+calls over a single connection with correlation ids. ``SyncRpcProxy`` adapts
+the async client for synchronous callers (the driver main thread, task code).
+
+Frame format: 4-byte LE length | pickled (kind, msg_id, method, payload).
+Payloads are plain picklable values — large tensors never travel here; they
+go through the shm object plane.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import pickle
+import struct
+import threading
+from typing import Any, Callable, Dict, Optional, Tuple
+
+_LEN = struct.Struct("<I")
+_REQ, _REP, _ERR = 0, 1, 2
+
+MAX_FRAME = 512 * 1024 * 1024
+
+
+class RpcError(Exception):
+    pass
+
+
+class ConnectionLost(RpcError):
+    pass
+
+
+async def _read_frame(reader: asyncio.StreamReader) -> Any:
+    header = await reader.readexactly(_LEN.size)
+    (n,) = _LEN.unpack(header)
+    if n > MAX_FRAME:
+        raise RpcError(f"frame too large: {n}")
+    body = await reader.readexactly(n)
+    return pickle.loads(body)
+
+
+def _write_frame(writer: asyncio.StreamWriter, msg: Any) -> None:
+    body = pickle.dumps(msg, protocol=5)
+    writer.write(_LEN.pack(len(body)) + body)
+
+
+class RpcServer:
+    """Dispatches ``method`` to registered async handlers.
+
+    Handlers are ``async def handler(payload) -> reply``. A handler may take
+    arbitrarily long; other requests on the same connection are not blocked.
+    """
+
+    def __init__(self, loop: asyncio.AbstractEventLoop, host: str = "127.0.0.1"):
+        self._loop = loop
+        self._host = host
+        self._handlers: Dict[str, Callable] = {}
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._on_disconnect: Optional[Callable] = None
+        self._writers: set = set()
+        self.port: int = 0
+
+    def register(self, method: str, handler: Callable) -> None:
+        self._handlers[method] = handler
+
+    def register_object(self, obj: Any, prefix: str = "") -> None:
+        """Register every ``rpc_*`` coroutine method of ``obj``."""
+        for name in dir(obj):
+            if name.startswith("rpc_"):
+                self.register(prefix + name[4:], getattr(obj, name))
+
+    def set_disconnect_handler(self, fn: Callable) -> None:
+        """fn(peer_id) called when a connection identified via 'hello' drops."""
+        self._on_disconnect = fn
+
+    async def start(self, port: int = 0) -> int:
+        self._server = await asyncio.start_server(self._handle_conn, self._host, port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        return self.port
+
+    @property
+    def address(self) -> str:
+        return f"{self._host}:{self.port}"
+
+    async def _handle_conn(self, reader: asyncio.StreamReader,
+                           writer: asyncio.StreamWriter) -> None:
+        peer_id: Optional[str] = None
+        write_lock = asyncio.Lock()
+        self._writers.add(writer)
+        try:
+            while True:
+                kind, msg_id, method, payload = await _read_frame(reader)
+                if method == "hello":
+                    peer_id = payload.get("peer_id")
+                handler = self._handlers.get(method)
+                if handler is None and method == "hello":
+                    async def handler(p):  # default hello ack
+                        return {"ok": True}
+                asyncio.ensure_future(
+                    self._run_handler(handler, method, msg_id, payload,
+                                      writer, write_lock))
+        except (asyncio.IncompleteReadError, ConnectionResetError, BrokenPipeError):
+            pass
+        finally:
+            self._writers.discard(writer)
+            if peer_id and self._on_disconnect:
+                try:
+                    res = self._on_disconnect(peer_id)
+                    if asyncio.iscoroutine(res):
+                        await res
+                except Exception:
+                    pass
+            writer.close()
+
+    async def _run_handler(self, handler, method, msg_id, payload, writer, lock):
+        try:
+            if handler is None:
+                raise RpcError(f"no handler for method {method!r}")
+            reply = await handler(payload)
+            kind, body = _REP, reply
+        except BaseException as e:  # noqa: BLE001 — errors cross the wire
+            kind, body = _ERR, e
+        try:
+            async with lock:
+                try:
+                    _write_frame(writer, (kind, msg_id, method, body))
+                except Exception as pickle_err:
+                    # Reply (or raised exception) was unpicklable — the caller
+                    # must still get a frame or its future waits forever.
+                    _write_frame(writer, (_ERR, msg_id, method,
+                                          RpcError(f"unserializable reply for "
+                                                   f"{method!r}: {pickle_err!r}")))
+                await writer.drain()
+        except (ConnectionResetError, BrokenPipeError):
+            pass
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            # Force-close live connections; 3.12's wait_closed() would block
+            # on our long-lived per-connection read loops.
+            for w in list(self._writers):
+                try:
+                    w.close()
+                except Exception:
+                    pass
+            self._writers.clear()
+
+
+class RpcClient:
+    """One multiplexed connection to a server; safe for concurrent calls."""
+
+    def __init__(self, address: str, peer_id: str = ""):
+        self.address = address
+        self._peer_id = peer_id
+        self._reader: Optional[asyncio.StreamReader] = None
+        self._writer: Optional[asyncio.StreamWriter] = None
+        self._pending: Dict[int, asyncio.Future] = {}
+        self._next_id = 0
+        self._lock: Optional[asyncio.Lock] = None
+        self._closed = False
+
+    async def connect(self) -> None:
+        host, port = self.address.rsplit(":", 1)
+        self._reader, self._writer = await asyncio.open_connection(host, int(port))
+        self._lock = asyncio.Lock()
+        asyncio.ensure_future(self._read_loop())
+        if self._peer_id:
+            await self.call("hello", {"peer_id": self._peer_id})
+
+    async def _read_loop(self) -> None:
+        try:
+            while True:
+                kind, msg_id, method, body = await _read_frame(self._reader)
+                fut = self._pending.pop(msg_id, None)
+                if fut is None or fut.done():
+                    continue
+                if kind == _ERR:
+                    fut.set_exception(body if isinstance(body, BaseException)
+                                      else RpcError(str(body)))
+                else:
+                    fut.set_result(body)
+        except (asyncio.IncompleteReadError, ConnectionResetError, BrokenPipeError,
+                asyncio.CancelledError):
+            pass
+        finally:
+            self._closed = True
+            for fut in self._pending.values():
+                if not fut.done():
+                    fut.set_exception(ConnectionLost(f"connection to {self.address} lost"))
+            self._pending.clear()
+
+    async def call(self, method: str, payload: Any = None,
+                   timeout: Optional[float] = None) -> Any:
+        if self._closed:
+            raise ConnectionLost(f"connection to {self.address} closed")
+        fut = asyncio.get_running_loop().create_future()
+        async with self._lock:
+            msg_id = self._next_id
+            self._next_id += 1
+            self._pending[msg_id] = fut
+            _write_frame(self._writer, (_REQ, msg_id, method, payload))
+            await self._writer.drain()
+        if timeout is None:
+            return await fut
+        return await asyncio.wait_for(fut, timeout)
+
+    async def close(self) -> None:
+        self._closed = True
+        if self._writer is not None:
+            self._writer.close()
+
+
+class EventLoopThread:
+    """A dedicated background asyncio loop — the process's io_service
+    (reference: ``instrumented_io_context``)."""
+
+    def __init__(self, name: str = "rt-io"):
+        self.loop = asyncio.new_event_loop()
+        self._thread = threading.Thread(target=self._run, daemon=True, name=name)
+        self._thread.start()
+
+    def _run(self) -> None:
+        asyncio.set_event_loop(self.loop)
+        self.loop.run_forever()
+
+    def run(self, coro, timeout: Optional[float] = None):
+        """Run a coroutine on the loop from a sync thread; block for result."""
+        fut = asyncio.run_coroutine_threadsafe(coro, self.loop)
+        return fut.result(timeout)
+
+    def spawn(self, coro) -> None:
+        asyncio.run_coroutine_threadsafe(coro, self.loop)
+
+    def stop(self) -> None:
+        self.loop.call_soon_threadsafe(self.loop.stop)
+        self._thread.join(timeout=2)
+
+
+class ConnectionPool:
+    """Address -> RpcClient cache (reference: core_worker_client pool)."""
+
+    def __init__(self, peer_id: str = ""):
+        self._peer_id = peer_id
+        self._clients: Dict[str, RpcClient] = {}
+        self._locks: Dict[str, asyncio.Lock] = {}
+
+    async def get(self, address: str) -> RpcClient:
+        client = self._clients.get(address)
+        if client is not None and not client._closed:
+            return client
+        lock = self._locks.setdefault(address, asyncio.Lock())
+        async with lock:
+            client = self._clients.get(address)
+            if client is not None and not client._closed:
+                return client
+            client = RpcClient(address, self._peer_id)
+            await client.connect()
+            self._clients[address] = client
+            return client
+
+    def invalidate(self, address: str) -> None:
+        self._clients.pop(address, None)
+
+    async def close_all(self) -> None:
+        for c in self._clients.values():
+            await c.close()
+        self._clients.clear()

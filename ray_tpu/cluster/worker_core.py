@@ -1,0 +1,591 @@
+"""ClusterBackend: the per-process core-worker library.
+
+Reference analog: ``src/ray/core_worker/`` embedded in every driver/worker —
+task submission (``CoreWorkerDirectTaskSubmitter``), direct actor calls with
+per-caller ordering (``CoreWorkerDirectActorTaskSubmitter`` +
+``SequentialActorSubmitQueue``), the in-process memory store for small
+objects, and plasma access for large ones. One instance lives in the driver
+and one in every worker process; task-executing code sees the same
+``ray_tpu.*`` API through it.
+
+Object resolution order on ``get`` (mirrors the reference's
+memory-store → plasma → owner/directory path, SURVEY.md §3.2):
+  1. local memory store (we own it, or cached),
+  2. local shm store (zero-copy),
+  3. owner's memory store over RPC (ref carries the owner address),
+  4. location directory → raylet pull → local shm.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import cloudpickle
+
+from ray_tpu._private.config import get_config
+from ray_tpu._private.ids import ActorID, JobID, ObjectID, TaskID
+from ray_tpu._private.serialization import SerializationContext, unpack_payload
+from ray_tpu.core.actor import ActorHandle
+from ray_tpu.core.backend import RuntimeBackend
+from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.core.task_spec import resources_from_options, validate_options
+from ray_tpu.cluster.object_store import PlasmaStore
+from ray_tpu.cluster.rpc import (
+    ConnectionLost,
+    ConnectionPool,
+    EventLoopThread,
+    RpcClient,
+    RpcServer,
+)
+from ray_tpu.exceptions import (
+    ActorDiedError,
+    GetTimeoutError,
+    ObjectLostError,
+    TaskError,
+    WorkerCrashedError,
+)
+
+_SMALL = lambda: get_config().max_direct_call_object_size
+
+
+class _MemoryStore:
+    """Owner-side store of serialized payloads with async readiness events."""
+
+    def __init__(self, loop: asyncio.AbstractEventLoop):
+        self._loop = loop
+        self._data: Dict[str, bytes] = {}
+        self._events: Dict[str, asyncio.Event] = {}
+        self._lock = threading.Lock()
+
+    def register_pending(self, oid_hex: str) -> None:
+        with self._lock:
+            if oid_hex not in self._events and oid_hex not in self._data:
+                self._events[oid_hex] = asyncio.Event()
+
+    def put(self, oid_hex: str, payload: bytes) -> None:
+        with self._lock:
+            self._data[oid_hex] = payload
+            ev = self._events.pop(oid_hex, None)
+        if ev is not None:
+            self._loop.call_soon_threadsafe(ev.set)
+
+    def mark_external(self, oid_hex: str) -> None:
+        """The value went to plasma; wake waiters with no inline payload."""
+        with self._lock:
+            ev = self._events.pop(oid_hex, None)
+        if ev is not None:
+            self._loop.call_soon_threadsafe(ev.set)
+
+    def get(self, oid_hex: str) -> Optional[bytes]:
+        with self._lock:
+            return self._data.get(oid_hex)
+
+    def is_pending(self, oid_hex: str) -> bool:
+        with self._lock:
+            return oid_hex in self._events
+
+    async def wait_ready(self, oid_hex: str, timeout: Optional[float]) -> bool:
+        with self._lock:
+            if oid_hex in self._data:
+                return True
+            ev = self._events.get(oid_hex)
+        if ev is None:
+            return True
+        try:
+            await asyncio.wait_for(ev.wait(), timeout)
+            return True
+        except asyncio.TimeoutError:
+            return False
+
+    def delete(self, oid_hex: str) -> None:
+        with self._lock:
+            self._data.pop(oid_hex, None)
+
+
+class _ActorConn:
+    """Ordered submission pipe to one actor (per-caller FIFO)."""
+
+    def __init__(self, actor_id_hex: str):
+        self.actor_id_hex = actor_id_hex
+        self.address: Optional[str] = None
+        self.send_lock: Optional[asyncio.Lock] = None
+        self.dead_reason: Optional[str] = None
+        self.max_task_retries: int = 0
+
+
+class ClusterBackend(RuntimeBackend):
+    def __init__(self, *, gcs_address: str, raylet_address: str, node_id: str,
+                 session_name: str, job_id: JobID, role: str = "driver",
+                 namespace: Optional[str] = None,
+                 loop_thread: Optional[EventLoopThread] = None):
+        self.role = role
+        self.job_id = job_id
+        self.namespace = namespace or "default"
+        self.node_id = node_id
+        self.session_name = session_name
+        self.gcs_address = gcs_address
+        self.raylet_address = raylet_address
+        self.serde = SerializationContext()
+        self.io = loop_thread or EventLoopThread(name=f"rt-{role}-io")
+        self.loop = self.io.loop
+        self.plasma = PlasmaStore(session_name, create_dir=True)
+        self.memory_store = _MemoryStore(self.loop)
+        self.server = RpcServer(self.loop)
+        self.server.register("get_object", self._rpc_get_object)
+        self._pool = ConnectionPool(peer_id=f"{role}:{job_id.hex()}")
+        self._gcs: Optional[RpcClient] = None
+        self._raylet: Optional[RpcClient] = None
+        self._exported_fns: set = set()
+        self._fn_cache: Dict[str, Any] = {}
+        self._actor_conns: Dict[str, _ActorConn] = {}
+        self._shutdown = False
+        self._cluster_shutdown_hook = None
+        self._current_task_id: Optional[str] = None  # set by worker_main
+        self._blocked_notified: set = set()
+
+    # ---- bootstrap ----------------------------------------------------------
+    def connect(self) -> None:
+        async def _go():
+            await self.server.start()
+            self._gcs = RpcClient(self.gcs_address, peer_id=self.role)
+            await self._gcs.connect()
+            self._raylet = RpcClient(self.raylet_address, peer_id=self.role)
+            await self._raylet.connect()
+
+        self.io.run(_go(), timeout=get_config().gcs_rpc_timeout_s)
+
+    @property
+    def address(self) -> str:
+        return self.server.address
+
+    # ---- serialization helpers ---------------------------------------------
+    def _serialize_arg(self, value: Any) -> Tuple:
+        if isinstance(value, ObjectRef):
+            return ("ref", value._descriptor())
+        payload = self.serde.serialize(value).to_bytes()
+        if len(payload) > _SMALL():
+            ref = self._put_payload_plasma(payload)
+            return ("ref", ref._descriptor())
+        return ("val", payload)
+
+    def _put_payload_plasma(self, payload: bytes,
+                            oid: Optional[ObjectID] = None) -> ObjectRef:
+        from ray_tpu.core.worker import global_worker
+
+        oid = oid or global_worker().next_put_id()
+        self.plasma.write_whole(oid, payload)
+        self.io.run(self._raylet.call("seal_object",
+                                      {"oid": oid.hex(), "size": len(payload)}))
+        return ObjectRef(oid, owner=self.address)
+
+    # ---- objects ------------------------------------------------------------
+    def put(self, value: Any) -> ObjectRef:
+        from ray_tpu.core.worker import global_worker
+
+        payload = self.serde.serialize(value).to_bytes()
+        oid = global_worker().next_put_id()
+        if len(payload) > _SMALL():
+            return self._put_payload_plasma(payload, oid)
+        self.memory_store.put(oid.hex(), payload)
+        return ObjectRef(oid, owner=self.address)
+
+    async def _resolve_payload(self, ref: ObjectRef,
+                               timeout: Optional[float]) -> memoryview:
+        """The 4-step resolution; returns the serialized payload."""
+        oid_hex = ref.hex()
+        deadline = None if timeout is None else time.monotonic() + timeout
+
+        def remaining():
+            if deadline is None:
+                return None
+            r = deadline - time.monotonic()
+            if r <= 0:
+                raise GetTimeoutError(f"timed out resolving {ref}")
+            return r
+
+        while True:
+            payload = self.memory_store.get(oid_hex)
+            if payload is not None:
+                return memoryview(payload)
+            view = self.plasma.read(ref.id())
+            if view is not None:
+                return view
+            if self.memory_store.is_pending(oid_hex):
+                if not await self.memory_store.wait_ready(oid_hex, remaining()):
+                    raise GetTimeoutError(f"timed out waiting for {ref}")
+                continue
+            owner = ref.owner_address()
+            if owner and owner != self.address:
+                try:
+                    client = await self._pool.get(owner)
+                    reply = await client.call(
+                        "get_object", {"oid": oid_hex, "timeout": remaining()},
+                        timeout=remaining())
+                    if "payload" in reply:
+                        return memoryview(reply["payload"])
+                    if reply.get("in_plasma"):
+                        pass  # fall through to the directory pull
+                    elif reply.get("pending"):
+                        continue
+                    else:
+                        raise ObjectLostError(ref.id())
+                except (ConnectionLost, ConnectionError, OSError):
+                    raise ObjectLostError(ref.id()) from None
+            reply = await self._raylet.call(
+                "fetch_object", {"oid": oid_hex, "timeout": remaining() or 30.0},
+                timeout=remaining())
+            if reply.get("ok"):
+                view = self.plasma.read(ref.id())
+                if view is not None:
+                    return view
+            raise ObjectLostError(ref.id())
+
+    def _deserialize_result(self, payload: memoryview) -> Any:
+        value = self.serde.deserialize_payload(payload)
+        if isinstance(value, BaseException):
+            raise value
+        return value
+
+    def get(self, refs: Sequence[ObjectRef], timeout: Optional[float]) -> List[Any]:
+        self._notify_blocked()
+
+        async def _gather():
+            return await asyncio.gather(
+                *[self._resolve_payload(r, timeout) for r in refs])
+
+        payloads = self.io.run(_gather(), timeout=None if timeout is None
+                               else timeout + 5.0)
+        return [self._deserialize_result(p) for p in payloads]
+
+    def _notify_blocked(self) -> None:
+        """Inside a task, a blocking get returns the task's CPU to the raylet
+        so children can run (prevents parent-waits-on-child deadlock)."""
+        tid = self._current_task_id
+        if tid is None or tid in self._blocked_notified:
+            return
+        self._blocked_notified.add(tid)
+        self.io.spawn(self._raylet.call("task_blocked", {"task_id": tid}))
+
+    def wait(self, refs, num_returns, timeout):
+        async def _wait():
+            futs = {asyncio.ensure_future(self._resolve_payload(r, None)): r
+                    for r in refs}
+            ready: List[ObjectRef] = []
+            deadline = None if timeout is None else time.monotonic() + timeout
+            pending = set(futs)
+            while len(ready) < num_returns and pending:
+                to = None if deadline is None else max(0.0, deadline - time.monotonic())
+                done, pending = await asyncio.wait(
+                    pending, timeout=to, return_when=asyncio.FIRST_COMPLETED)
+                if not done:
+                    break
+                for f in done:
+                    ready.append(futs[f])
+            for f in pending:
+                f.cancel()
+            ready_set = set(ready[:num_returns])
+            return ([r for r in refs if r in ready_set],
+                    [r for r in refs if r not in ready_set])
+
+        return self.io.run(_wait())
+
+    async def _rpc_get_object(self, p):
+        """Serve our memory store to borrowers (long-poll while pending)."""
+        oid_hex = p["oid"]
+        if self.memory_store.is_pending(oid_hex):
+            await self.memory_store.wait_ready(oid_hex, p.get("timeout") or 30.0)
+        payload = self.memory_store.get(oid_hex)
+        if payload is not None:
+            return {"payload": payload}
+        if self.plasma.contains(ObjectID.from_hex(oid_hex)):
+            return {"in_plasma": True}
+        return {"not_found": True}
+
+    def free_objects(self, refs: Sequence[ObjectRef]) -> None:
+        for r in refs:
+            self.memory_store.delete(r.hex())
+        self.io.run(self._raylet.call(
+            "free_objects", {"oids": [r.hex() for r in refs]}))
+
+    # ---- function/class export ---------------------------------------------
+    def _export(self, kind: str, obj: Any) -> str:
+        blob = cloudpickle.dumps(obj)
+        fid = f"{kind}:{hashlib.sha1(blob).hexdigest()}"
+        if fid not in self._exported_fns:
+            self.io.run(self._gcs.call("kv_put", {"key": f"@fn/{fid}",
+                                                  "value": blob}))
+            self._exported_fns.add(fid)
+        return fid
+
+    def load_function(self, fid: str) -> Any:
+        fn = self._fn_cache.get(fid)
+        if fn is None:
+            reply = self.io.run(self._gcs.call("kv_get", {"key": f"@fn/{fid}"}))
+            if reply["value"] is None:
+                raise RuntimeError(f"function {fid} not found in GCS")
+            fn = cloudpickle.loads(reply["value"])
+            self._fn_cache[fid] = fn
+        return fn
+
+    async def load_function_async(self, fid: str) -> Any:
+        fn = self._fn_cache.get(fid)
+        if fn is None:
+            reply = await self._gcs.call("kv_get", {"key": f"@fn/{fid}"})
+            if reply["value"] is None:
+                raise RuntimeError(f"function {fid} not found in GCS")
+            fn = cloudpickle.loads(reply["value"])
+            self._fn_cache[fid] = fn
+        return fn
+
+    # ---- tasks --------------------------------------------------------------
+    def submit_task(self, fn, options, args, kwargs):
+        validate_options(options, for_actor=False)
+        req = resources_from_options(options, default_num_cpus=1)
+        num_returns = options.get("num_returns", 1)
+        fid = self._export("fn", fn)
+        task_id = TaskID.for_task(self.job_id)
+        refs = [ObjectRef(ObjectID.for_return(task_id, i), owner=self.address)
+                for i in range(num_returns)]
+        for r in refs:
+            self.memory_store.register_pending(r.hex())
+        payload = {
+            "task_id": task_id.hex(),
+            "job_id": self.job_id.hex(),
+            "fn_id": fid,
+            "fn_name": getattr(fn, "__name__", "anonymous"),
+            "args": [self._serialize_arg(a) for a in args],
+            "kwargs": {k: self._serialize_arg(v) for k, v in kwargs.items()},
+            "num_returns": num_returns,
+            "resources": req.to_dict(),
+            "strategy": options.get("scheduling_strategy"),
+            "owner": self.address,
+            "max_retries": options.get("max_retries",
+                                       get_config().task_max_retries_default),
+        }
+        self.io.spawn(self._submit_and_collect(payload, refs))
+        return refs[0] if num_returns == 1 else refs
+
+    async def _submit_and_collect(self, payload, refs: List[ObjectRef]) -> None:
+        retries = payload.get("max_retries", 0)
+        attempt = 0
+        while True:
+            try:
+                reply = await self._raylet.call("submit_task", payload)
+            except Exception as e:
+                reply = {"error": "submit_failed", "message": repr(e)}
+            if reply.get("error") == "worker_crashed" and attempt < retries:
+                attempt += 1
+                continue
+            break
+        self._apply_task_reply(reply, refs, payload["fn_name"])
+
+    def _apply_task_reply(self, reply, refs: List[ObjectRef], fn_name: str) -> None:
+        if reply.get("error"):
+            err = WorkerCrashedError(
+                f"task {fn_name} failed: {reply.get('message', reply['error'])}")
+            blob = self.serde.serialize(err).to_bytes()
+            for r in refs:
+                self.memory_store.put(r.hex(), blob)
+            return
+        returns = reply.get("returns", [])
+        for r, ret in zip(refs, returns):
+            kind, data = ret
+            if kind == "val":
+                self.memory_store.put(r.hex(), data)
+            else:  # "plasma": sealed by the executor; location registered
+                self.memory_store.mark_external(r.hex())
+
+    # ---- actors -------------------------------------------------------------
+    def create_actor(self, cls, options, args, kwargs, method_meta):
+        validate_options(options, for_actor=True)
+        req = resources_from_options(options, default_num_cpus=0)
+        cid = self._export("cls", cls)
+        actor_id = ActorID.of(self.job_id)
+        spec = {
+            "actor_id": actor_id.hex(),
+            "job_id": self.job_id.hex(),
+            "class_id": cid,
+            "class_name": cls.__name__,
+            "args": [self._serialize_arg(a) for a in args],
+            "kwargs": {k: self._serialize_arg(v) for k, v in kwargs.items()},
+            "resources": req.to_dict(),
+            "max_restarts": options.get("max_restarts", 0),
+            "max_task_retries": options.get("max_task_retries", 0),
+            "max_concurrency": options.get("max_concurrency") or 1,
+            "name": options.get("name"),
+            "namespace": options.get("namespace") or self.namespace,
+            "lifetime": options.get("lifetime"),
+            "get_if_exists": options.get("get_if_exists", False),
+            "scheduling_strategy": options.get("scheduling_strategy"),
+            "method_meta": method_meta,
+            "owner": self.address,
+        }
+        reply = self.io.run(self._gcs.call("register_actor", {"spec": spec}))
+        if reply.get("error"):
+            raise ValueError(reply["error"])
+        if reply.get("existing"):
+            return ActorHandle(ActorID.from_hex(reply["actor_id"]),
+                               cls.__name__, dict(reply["method_meta"] or {}))
+        return ActorHandle(actor_id, cls.__name__, method_meta,
+                           original_handle=True)
+
+    def _actor_conn(self, actor_id_hex: str) -> _ActorConn:
+        conn = self._actor_conns.get(actor_id_hex)
+        if conn is None:
+            conn = _ActorConn(actor_id_hex)
+            conn.send_lock = asyncio.Lock()
+            self._actor_conns[actor_id_hex] = conn
+        return conn
+
+    async def _resolve_actor(self, conn: _ActorConn, timeout: float = 60.0) -> str:
+        reply = await self._gcs.call("get_actor_info", {
+            "actor_id": conn.actor_id_hex, "wait_alive": True,
+            "timeout": timeout})
+        info = reply.get("info")
+        if info is None:
+            raise ActorDiedError(conn.actor_id_hex, "unknown actor")
+        if info["state"] == "DEAD":
+            conn.dead_reason = info.get("death_reason", "dead")
+            raise ActorDiedError(conn.actor_id_hex, conn.dead_reason)
+        if info["state"] != "ALIVE":
+            raise ActorDiedError(conn.actor_id_hex,
+                                 f"not alive within timeout: {info['state']}")
+        conn.address = info["address"]
+        conn.max_task_retries = info.get("max_task_retries", 0)
+        return conn.address
+
+    def submit_actor_task(self, actor_id: ActorID, method_name, args, kwargs,
+                          num_returns: int = 1):
+        task_id = TaskID.for_actor_task(actor_id)
+        refs = [ObjectRef(ObjectID.for_return(task_id, i), owner=self.address)
+                for i in range(num_returns)]
+        for r in refs:
+            self.memory_store.register_pending(r.hex())
+        payload = {
+            "actor_id": actor_id.hex(),
+            "task_id": task_id.hex(),
+            "method": method_name,
+            "args": [self._serialize_arg(a) for a in args],
+            "kwargs": {k: self._serialize_arg(v) for k, v in kwargs.items()},
+            "num_returns": num_returns,
+            "owner": self.address,
+        }
+        self.io.spawn(self._submit_actor_and_collect(payload, refs, method_name))
+        return refs[0] if num_returns == 1 else refs
+
+    async def _submit_actor_and_collect(self, payload, refs, method_name) -> None:
+        conn = self._actor_conn(payload["actor_id"])
+        # Delivery semantics (reference parity, actor.py:333-352): connection
+        # failures BEFORE the call is written are always safe to retry; once
+        # delivered, a lost connection fails the call unless the actor was
+        # created with max_task_retries > 0 (the call may have side effects).
+        task_retries_left: Optional[int] = None
+        connect_attempts = 0
+        while True:
+            try:
+                # The send lock makes submission order == delivery order per
+                # caller (reference: SequentialActorSubmitQueue); execution
+                # ordering is the actor worker's arrival-ordered queue.
+                async with conn.send_lock:
+                    if conn.dead_reason:
+                        raise ActorDiedError(payload["actor_id"], conn.dead_reason)
+                    if conn.address is None:
+                        await self._resolve_actor(conn)
+                    if task_retries_left is None:
+                        task_retries_left = conn.max_task_retries
+                    try:
+                        client = await self._pool.get(conn.address)
+                    except (ConnectionLost, ConnectionError, OSError):
+                        # Never delivered — free retry (actor restarting).
+                        conn.address = None
+                        connect_attempts += 1
+                        if connect_attempts > 10:
+                            raise ActorDiedError(payload["actor_id"],
+                                                 "unreachable") from None
+                        await asyncio.sleep(get_config().actor_restart_backoff_s)
+                        continue
+                    fut = asyncio.ensure_future(
+                        client.call("actor_call", payload))
+                reply = await fut
+                self._apply_task_reply(reply, refs, method_name)
+                return
+            except ActorDiedError as e:
+                blob = self.serde.serialize(e).to_bytes()
+                for r in refs:
+                    self.memory_store.put(r.hex(), blob)
+                return
+            except (ConnectionLost, ConnectionError, OSError):
+                conn.address = None  # delivered but connection dropped
+                if task_retries_left and task_retries_left > 0:
+                    task_retries_left -= 1
+                    await asyncio.sleep(get_config().actor_restart_backoff_s)
+                    continue
+                err = ActorDiedError(
+                    payload["actor_id"],
+                    f"connection lost during {method_name!r} (actor died or "
+                    f"restarting); set max_task_retries to retry actor tasks")
+                blob = self.serde.serialize(err).to_bytes()
+                for r in refs:
+                    self.memory_store.put(r.hex(), blob)
+                return
+
+    def kill_actor(self, actor_id: ActorID, no_restart: bool) -> None:
+        conn = self._actor_conns.get(actor_id.hex())
+        if conn:
+            conn.address = None
+            conn.dead_reason = "killed via kill()"
+        self.io.run(self._gcs.call("kill_actor", {"actor_id": actor_id.hex()}))
+
+    def get_actor_handle(self, name, namespace):
+        reply = self.io.run(self._gcs.call("get_named_actor", {
+            "name": name, "namespace": namespace or self.namespace}))
+        if reply.get("error"):
+            raise ValueError(reply["error"])
+        return ActorHandle(ActorID.from_hex(reply["actor_id"]),
+                           reply["info"]["class_name"],
+                           dict(reply["method_meta"] or {}))
+
+    # ---- cluster info / kv --------------------------------------------------
+    def cancel(self, ref, force=False):
+        pass  # cooperative cancellation lands with the lease redesign
+
+    def cluster_resources(self):
+        return self.io.run(self._gcs.call("cluster_resources", {}))["total"]
+
+    def available_resources(self):
+        return self.io.run(self._gcs.call("cluster_resources", {}))["available"]
+
+    def nodes(self):
+        return self.io.run(self._gcs.call("list_nodes", {}))
+
+    def kv_put(self, key, value):
+        self.io.run(self._gcs.call("kv_put", {"key": key, "value": value}))
+
+    def kv_get(self, key):
+        return self.io.run(self._gcs.call("kv_get", {"key": key}))["value"]
+
+    def kv_del(self, key):
+        self.io.run(self._gcs.call("kv_del", {"key": key}))
+
+    def kv_keys(self, prefix):
+        return self.io.run(self._gcs.call("kv_keys", {"prefix": prefix}))["keys"]
+
+    def shutdown(self):
+        if self._shutdown:
+            return
+        self._shutdown = True
+        hook = self._cluster_shutdown_hook
+        if hook is not None:
+            try:
+                hook()
+            except Exception:
+                pass
+        try:
+            self.io.run(self.server.stop(), timeout=2)
+        except Exception:
+            pass
+        self.io.stop()

@@ -1,0 +1,254 @@
+"""Worker process entry: task execution loop + actor hosting.
+
+Reference analog: ``python/ray/_private/workers/default_worker.py`` plus the
+execution side of the core worker (``execute_task`` in ``_raylet.pyx:1444``,
+``CoreWorkerDirectTaskReceiver`` and the actor scheduling queues). A worker:
+  - builds its own ClusterBackend so user code can call ``ray_tpu.*``;
+  - serves ``push_task`` (normal tasks, one at a time — the raylet gates
+    concurrency by resources);
+  - serves ``create_actor``/``actor_call`` with arrival-ordered execution and
+    ``max_concurrency`` consumers (sync methods on threads, async methods as
+    coroutines — the reference's three queue flavors);
+  - exits when its raylet connection drops.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import inspect
+import os
+import sys
+import threading
+import traceback
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu._private.config import get_config
+from ray_tpu._private.ids import ActorID, JobID, ObjectID, TaskID
+from ray_tpu.cluster.rpc import RpcClient
+from ray_tpu.cluster.worker_core import ClusterBackend
+from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.exceptions import TaskError
+
+
+class WorkerProcess:
+    def __init__(self):
+        self.worker_id = os.environ["RT_WORKER_ID"]
+        self.backend = ClusterBackend(
+            gcs_address=os.environ["RT_GCS_ADDR"],
+            raylet_address=os.environ["RT_RAYLET_ADDR"],
+            node_id=os.environ["RT_NODE_ID"],
+            session_name=os.environ["RT_SESSION_NAME"],
+            job_id=JobID.from_int(0),
+            role="worker")
+        self._task_pool = ThreadPoolExecutor(max_workers=1,
+                                             thread_name_prefix="rt-exec")
+        # Actor state
+        self._actor_instance: Any = None
+        self._actor_id: Optional[str] = None
+        self._actor_queue: Optional[asyncio.Queue] = None
+        self._actor_threads: Optional[ThreadPoolExecutor] = None
+
+    def start(self) -> None:
+        from ray_tpu.core.worker import global_worker
+
+        self.backend.connect()
+        srv = self.backend.server
+        srv.register("push_task", self.rpc_push_task)
+        srv.register("create_actor", self.rpc_create_actor)
+        srv.register("actor_call", self.rpc_actor_call)
+        srv.register("exit", self.rpc_exit)
+        global_worker().connect(self.backend, self.backend.job_id, "worker")
+        self.backend.io.run(self.backend._raylet.call("worker_ready", {
+            "worker_id": self.worker_id,
+            "address": self.backend.server.address}))
+        # Exit when the raylet goes away.
+        self.backend.io.spawn(self._watch_raylet())
+
+    async def _watch_raylet(self) -> None:
+        while True:
+            await asyncio.sleep(1.0)
+            if self.backend._raylet._closed:
+                os._exit(0)
+
+    async def rpc_exit(self, p):
+        asyncio.get_running_loop().call_later(0.1, os._exit, 0)
+        return {"ok": True}
+
+    # ---- argument / return marshalling -------------------------------------
+    def _resolve_args(self, wire_args: List[Tuple], wire_kwargs: Dict[str, Tuple]):
+        """Deserialize inline values and fetch refs (dependency resolution)."""
+        refs: List[ObjectRef] = []
+        slots: List[Tuple[str, Any]] = []
+
+        def scan(item):
+            kind, data = item
+            if kind == "ref":
+                ref = ObjectRef._rehydrate(data)
+                refs.append(ref)
+                return ("ref", len(refs) - 1)
+            return ("val", self.backend.serde.deserialize_payload(memoryview(data)))
+
+        arg_slots = [scan(a) for a in wire_args]
+        kwarg_slots = {k: scan(v) for k, v in wire_kwargs.items()}
+        values = self.backend.get(refs, timeout=None) if refs else []
+
+        def fill(slot):
+            kind, v = slot
+            return values[v] if kind == "ref" else v
+
+        return [fill(s) for s in arg_slots], {k: fill(s) for k, s in kwarg_slots.items()}
+
+    def _pack_returns(self, result: Any, task_id: TaskID, num_returns: int):
+        if num_returns == 1:
+            values = [result]
+        else:
+            values = list(result)
+            if len(values) != num_returns:
+                raise ValueError(
+                    f"expected {num_returns} return values, got {len(values)}")
+        out = []
+        small_limit = get_config().max_direct_call_object_size
+        for i, v in enumerate(values):
+            payload = self.backend.serde.serialize(v).to_bytes()
+            if len(payload) > small_limit:
+                oid = ObjectID.for_return(task_id, i)
+                self.backend.plasma.write_whole(oid, payload)
+                self.backend.io.run(self.backend._raylet.call(
+                    "seal_object", {"oid": oid.hex(), "size": len(payload)}))
+                out.append(("plasma", len(payload)))
+            else:
+                out.append(("val", payload))
+        return out
+
+    def _error_returns(self, err: BaseException, num_returns: int):
+        payload = self.backend.serde.serialize(err).to_bytes()
+        return [("val", payload)] * num_returns
+
+    # ---- normal tasks -------------------------------------------------------
+    async def rpc_push_task(self, p):
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(self._task_pool,
+                                          self._execute_task_sync, p)
+
+    def _execute_task_sync(self, p) -> Dict:
+        from ray_tpu.core.worker import global_worker
+
+        task_id = TaskID.from_hex(p["task_id"])
+        self.backend.job_id = JobID.from_hex(p["job_id"])
+        worker = global_worker()
+        worker.job_id = self.backend.job_id
+        token = worker.enter_task_context(task_id)
+        self.backend._current_task_id = p["task_id"]
+        try:
+            fn = self.backend.load_function(p["fn_id"])
+            args, kwargs = self._resolve_args(p["args"], p["kwargs"])
+            result = fn(*args, **kwargs)
+            returns = self._pack_returns(result, task_id, p["num_returns"])
+            return {"returns": returns}
+        except TaskError as e:
+            return {"returns": self._error_returns(e, p["num_returns"])}
+        except BaseException as e:  # noqa: BLE001
+            traceback.print_exc()
+            return {"returns": self._error_returns(
+                TaskError(p["fn_name"], e), p["num_returns"])}
+        finally:
+            self.backend._current_task_id = None
+            worker.exit_task_context(token)
+
+    # ---- actors -------------------------------------------------------------
+    async def rpc_create_actor(self, p):
+        spec = p["spec"]
+        loop = asyncio.get_running_loop()
+        self._actor_id = spec["actor_id"]
+        max_conc = spec.get("max_concurrency", 1)
+        self._actor_queue = asyncio.Queue()
+        self._actor_threads = ThreadPoolExecutor(
+            max_workers=max_conc, thread_name_prefix="rt-actor")
+        for _ in range(max_conc):
+            asyncio.ensure_future(self._actor_consumer())
+
+        def build():
+            from ray_tpu.core.worker import global_worker
+
+            self.backend.job_id = JobID.from_hex(spec["job_id"])
+            global_worker().job_id = self.backend.job_id
+            cls = self.backend.load_function(spec["class_id"])
+            args, kwargs = self._resolve_args(spec["args"], spec["kwargs"])
+            return cls(*args, **kwargs)
+
+        try:
+            self._actor_instance = await loop.run_in_executor(
+                self._actor_threads, build)
+            return {"ok": True, "address": self.backend.server.address}
+        except BaseException as e:  # noqa: BLE001
+            traceback.print_exc()
+            return {"ok": False, "error": f"__init__ failed: {e!r}"}
+
+    async def _actor_consumer(self) -> None:
+        while True:
+            coro, fut = await self._actor_queue.get()
+            try:
+                result = await coro
+                if not fut.done():
+                    fut.set_result(result)
+            except BaseException as e:  # noqa: BLE001
+                if not fut.done():
+                    fut.set_exception(e)
+
+    async def rpc_actor_call(self, p):
+        loop = asyncio.get_running_loop()
+        fut = loop.create_future()
+        await self._actor_queue.put((self._run_actor_method(p), fut))
+        return await fut
+
+    async def _run_actor_method(self, p) -> Dict:
+        loop = asyncio.get_running_loop()
+        task_id = TaskID.from_hex(p["task_id"])
+        method_name = p["method"]
+        method = getattr(self._actor_instance, method_name, None)
+        if method is None:
+            err = TaskError(method_name, AttributeError(
+                f"actor has no method {method_name!r}"))
+            return {"returns": self._error_returns(err, p["num_returns"])}
+        if inspect.iscoroutinefunction(method):
+            try:
+                args, kwargs = await loop.run_in_executor(
+                    self._actor_threads, self._resolve_args, p["args"], p["kwargs"])
+                result = await method(*args, **kwargs)
+                return {"returns": await loop.run_in_executor(
+                    self._actor_threads, self._pack_returns, result, task_id,
+                    p["num_returns"])}
+            except BaseException as e:  # noqa: BLE001
+                return {"returns": self._error_returns(
+                    TaskError(method_name, e), p["num_returns"])}
+        return await loop.run_in_executor(
+            self._actor_threads, self._execute_actor_method_sync, p, method, task_id)
+
+    def _execute_actor_method_sync(self, p, method, task_id: TaskID) -> Dict:
+        from ray_tpu.core.worker import global_worker
+
+        worker = global_worker()
+        token = worker.enter_task_context(
+            task_id, ActorID.from_hex(p["actor_id"]))
+        try:
+            args, kwargs = self._resolve_args(p["args"], p["kwargs"])
+            result = method(*args, **kwargs)
+            return {"returns": self._pack_returns(result, task_id,
+                                                  p["num_returns"])}
+        except BaseException as e:  # noqa: BLE001
+            traceback.print_exc()
+            return {"returns": self._error_returns(
+                TaskError(p["method"], e), p["num_returns"])}
+        finally:
+            worker.exit_task_context(token)
+
+
+def main() -> None:
+    wp = WorkerProcess()
+    wp.start()
+    threading.Event().wait()  # io loop thread does the work
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,3 @@
+"""Model zoo: pure-pytree JAX models designed for sharding-annotated jit."""
+
+from ray_tpu.models.llama import LlamaConfig  # noqa: F401

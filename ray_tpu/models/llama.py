@@ -1,0 +1,185 @@
+"""Llama-family decoder-only transformer, TPU-first.
+
+Design choices (vs. a torch port):
+  - Params are a plain pytree; layers are STACKED along a leading axis and the
+    forward pass is one ``lax.scan`` over them — a single compiled layer body
+    regardless of depth (fast compiles, friendly to pipeline partitioning).
+  - bf16 compute / fp32 params + fp32 softmax+loss accumulation.
+  - ``jax.checkpoint`` (remat) around the scanned block body with a
+    dots-saveable policy: trades HBM for recompute, the standard TPU recipe.
+  - Sharding is declarative: ``sharding_rules()`` returns rules mapping the
+    param tree onto a (dp, fsdp, tp) mesh; batch rides (dp, fsdp), matrices
+    shard (fsdp, tp). XLA inserts the collectives.
+
+Capability parity note: the reference has no model zoo of its own — its Train
+library wraps torch models (SURVEY.md §2.3). Here models are first-class
+because the flagship benchmark (BASELINE.md config 3: Llama-7B tokens/s/chip)
+lives inside the framework.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.ops.attention import mha
+from ray_tpu.ops.norms import rmsnorm
+from ray_tpu.ops.rope import apply_rope, rope_angles
+from ray_tpu.parallel.sharding import ShardingRules
+from jax.sharding import PartitionSpec as P
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    d_model: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 32
+    d_ff: int = 11008
+    max_seq_len: int = 4096
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-5
+    tie_embeddings: bool = False
+    param_dtype: Any = jnp.float32
+    compute_dtype: Any = jnp.bfloat16
+    remat: bool = True
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    def num_params(self) -> int:
+        d, f, v, l = self.d_model, self.d_ff, self.vocab_size, self.n_layers
+        hd = self.head_dim
+        per_layer = (d * self.n_heads * hd + 2 * d * self.n_kv_heads * hd
+                     + self.n_heads * hd * d + 3 * d * f + 2 * d)
+        head = 0 if self.tie_embeddings else d * v
+        return v * d + l * per_layer + d + head
+
+
+PRESETS: Dict[str, LlamaConfig] = {
+    "debug": LlamaConfig(vocab_size=256, d_model=64, n_layers=2, n_heads=4,
+                         n_kv_heads=2, d_ff=128, max_seq_len=128),
+    "160m": LlamaConfig(vocab_size=32000, d_model=768, n_layers=12, n_heads=12,
+                        n_kv_heads=12, d_ff=2048, max_seq_len=2048),
+    "410m": LlamaConfig(vocab_size=32000, d_model=1024, n_layers=24, n_heads=16,
+                        n_kv_heads=16, d_ff=2816, max_seq_len=2048),
+    "1b": LlamaConfig(vocab_size=32000, d_model=2048, n_layers=22, n_heads=32,
+                      n_kv_heads=4, d_ff=5632, max_seq_len=2048),
+    "7b": LlamaConfig(),
+}
+
+
+def init_params(rng: jax.Array, cfg: LlamaConfig) -> Params:
+    """Scaled-normal init; layer params stacked on a leading [n_layers] axis."""
+    d, f = cfg.d_model, cfg.d_ff
+    hq, hkv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    L = cfg.n_layers
+    keys = jax.random.split(rng, 8)
+
+    def norm_init(key, shape, fan_in):
+        return (jax.random.normal(key, shape, jnp.float32)
+                * (1.0 / math.sqrt(fan_in))).astype(cfg.param_dtype)
+
+    params: Params = {
+        "embed": norm_init(keys[0], (cfg.vocab_size, d), d),
+        "layers": {
+            "attn_norm": jnp.ones((L, d), cfg.param_dtype),
+            "wq": norm_init(keys[1], (L, d, hq * hd), d),
+            "wk": norm_init(keys[2], (L, d, hkv * hd), d),
+            "wv": norm_init(keys[3], (L, d, hkv * hd), d),
+            "wo": norm_init(keys[4], (L, hq * hd, d), hq * hd),
+            "mlp_norm": jnp.ones((L, d), cfg.param_dtype),
+            "w_gate": norm_init(keys[5], (L, d, f), d),
+            "w_up": norm_init(keys[6], (L, d, f), d),
+            "w_down": norm_init(keys[7], (L, f, d), f),
+        },
+        "final_norm": jnp.ones((d,), cfg.param_dtype),
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = norm_init(jax.random.fold_in(rng, 99), (d, cfg.vocab_size), d)
+    return params
+
+
+def _block(cfg: LlamaConfig, x: jax.Array, layer: Params,
+           sin: jax.Array, cos: jax.Array,
+           segment_ids: Optional[jax.Array]) -> jax.Array:
+    """One decoder block: pre-norm attn + pre-norm SwiGLU MLP."""
+    b, s, d = x.shape
+    hq, hkv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    cdt = cfg.compute_dtype
+
+    h = rmsnorm(x, layer["attn_norm"].astype(cdt), cfg.norm_eps)
+    q = (h @ layer["wq"].astype(cdt)).reshape(b, s, hq, hd)
+    k = (h @ layer["wk"].astype(cdt)).reshape(b, s, hkv, hd)
+    v = (h @ layer["wv"].astype(cdt)).reshape(b, s, hkv, hd)
+    q = apply_rope(q, sin, cos)
+    k = apply_rope(k, sin, cos)
+    attn = mha(q, k, v, causal=True, segment_ids=segment_ids)
+    x = x + attn.reshape(b, s, hq * hd) @ layer["wo"].astype(cdt)
+
+    h = rmsnorm(x, layer["mlp_norm"].astype(cdt), cfg.norm_eps)
+    gate = jax.nn.silu(h @ layer["w_gate"].astype(cdt))
+    up = h @ layer["w_up"].astype(cdt)
+    x = x + (gate * up) @ layer["w_down"].astype(cdt)
+    return x
+
+
+def forward(params: Params, tokens: jax.Array, cfg: LlamaConfig,
+            segment_ids: Optional[jax.Array] = None) -> jax.Array:
+    """tokens [batch, seq] -> logits [batch, seq, vocab] (fp32)."""
+    cdt = cfg.compute_dtype
+    x = params["embed"].astype(cdt)[tokens]
+    sin, cos = rope_angles(tokens.shape[1], cfg.head_dim, cfg.rope_theta, cdt)
+
+    body = lambda x, layer: (_block(cfg, x, layer, sin, cos, segment_ids), None)
+    if cfg.remat:
+        body = jax.checkpoint(
+            body, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+    x, _ = jax.lax.scan(body, x, params["layers"])
+
+    x = rmsnorm(x, params["final_norm"].astype(cdt), cfg.norm_eps)
+    head = (params["embed"].T if cfg.tie_embeddings else params["lm_head"]).astype(cdt)
+    return (x @ head).astype(jnp.float32)
+
+
+def lm_loss(params: Params, batch: Dict[str, jax.Array], cfg: LlamaConfig) -> jax.Array:
+    """Next-token cross entropy; ``batch`` has tokens [B, S+1] (+opt. mask)."""
+    tokens = batch["tokens"]
+    inputs, targets = tokens[:, :-1], tokens[:, 1:]
+    logits = forward(params, inputs, cfg, batch.get("segment_ids"))
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    mask = batch.get("loss_mask")
+    if mask is None:
+        return nll.mean()
+    return (nll * mask).sum() / jnp.maximum(mask.sum(), 1)
+
+
+def sharding_rules() -> ShardingRules:
+    """Param partitioning over the (dp, fsdp, tp) mesh (scaling-book layout).
+
+    The leading stacked-layer axis is never sharded; matrices put their
+    contracting/output dims on (fsdp, tp) so forward matmuls all-gather over
+    fsdp (ZeRO-3) and reduce over tp.
+    """
+    return ShardingRules([
+        (r"embed$", P("tp", "fsdp")),
+        (r"lm_head$", P("fsdp", "tp")),
+        (r"layers/w[qkv]$", P(None, "fsdp", "tp")),
+        (r"layers/wo$", P(None, "tp", "fsdp")),
+        (r"layers/w_(gate|up)$", P(None, "fsdp", "tp")),
+        (r"layers/w_down$", P(None, "tp", "fsdp")),
+        (r"norm", P()),
+    ])
+
+
+def data_rules() -> ShardingRules:
+    return ShardingRules([(r".*", P(("dp", "fsdp"), None))])

@@ -1,0 +1,10 @@
+"""TPU compute ops: the kernels under every model.
+
+Plain-JAX reference implementations first (XLA fuses elementwise chains into
+matmuls on its own); pallas kernels override the hot paths where XLA's
+fusion isn't enough (flash attention, ring attention).
+"""
+
+from ray_tpu.ops.norms import rmsnorm  # noqa: F401
+from ray_tpu.ops.rope import apply_rope, rope_angles  # noqa: F401
+from ray_tpu.ops.attention import mha  # noqa: F401

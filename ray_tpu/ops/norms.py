@@ -1,0 +1,18 @@
+"""Normalization ops."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def rmsnorm(x: jax.Array, weight: jax.Array, eps: float = 1e-6) -> jax.Array:
+    """RMSNorm in fp32 accumulation regardless of input dtype.
+
+    The variance is computed in float32 (bf16 squares underflow), the scale
+    applied in the input dtype so the op fuses into the adjacent matmul.
+    """
+    dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    rms = jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
+    return (xf * rms).astype(dtype) * weight

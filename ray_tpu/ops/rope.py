@@ -1,0 +1,35 @@
+"""Rotary position embeddings."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def rope_angles(seq_len: int, head_dim: int, theta: float = 10000.0,
+                dtype=jnp.float32):
+    """(sin, cos) tables of shape [seq_len, head_dim // 2]."""
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    pos = jnp.arange(seq_len, dtype=jnp.float32)
+    angles = jnp.outer(pos, inv_freq)
+    return jnp.sin(angles).astype(dtype), jnp.cos(angles).astype(dtype)
+
+
+def apply_rope(x: jax.Array, sin: jax.Array, cos: jax.Array,
+               positions: jax.Array | None = None) -> jax.Array:
+    """Rotate pairs (x[..., ::2], x[..., 1::2]).
+
+    x: [batch, seq, heads, head_dim]; sin/cos: [max_seq, head_dim//2] tables,
+    gathered at ``positions`` ([batch, seq], defaults to arange) — the gather
+    form supports decode-time offsets without retracing.
+    """
+    if positions is None:
+        s = sin[: x.shape[1]][None, :, None, :]
+        c = cos[: x.shape[1]][None, :, None, :]
+    else:
+        s = sin[positions][:, :, None, :]
+        c = cos[positions][:, :, None, :]
+    x1 = x[..., ::2]
+    x2 = x[..., 1::2]
+    rotated = jnp.stack([x1 * c - x2 * s, x1 * s + x2 * c], axis=-1)
+    return rotated.reshape(x.shape).astype(x.dtype)

@@ -1,0 +1,15 @@
+"""Parallelism as meshes + shardings.
+
+Where the reference reaches for torch DDP/FSDP/DeepSpeed process groups
+(``python/ray/train/torch/config.py:64``, ``train_loop_utils.py:91-100``),
+this framework expresses every strategy — DP, FSDP/ZeRO, TP, SP/CP, EP, PP —
+as a `jax.sharding.Mesh` plus partition rules, letting XLA insert the
+ICI/DCN collectives.
+"""
+
+from ray_tpu.parallel.mesh import MeshConfig, make_mesh  # noqa: F401
+from ray_tpu.parallel.sharding import (  # noqa: F401
+    ShardingRules,
+    named_sharding,
+    shard_pytree,
+)

@@ -1,0 +1,79 @@
+"""Device mesh construction for all parallelism strategies.
+
+Axis convention (the scaling-book layout):
+  - ``dp``   — pure data parallel (params replicated), maps to DCN across
+               slices in multi-slice jobs;
+  - ``fsdp`` — data axis whose lanes ALSO shard parameters/optimizer state
+               (ZeRO-3); maps to ICI within a slice;
+  - ``tp``   — tensor parallel (activations sharded on hidden dims), innermost
+               so its all-reduces ride the fastest ICI links;
+  - ``sp``   — sequence/context parallel for ring attention;
+  - ``ep``   — expert parallel for MoE;
+  - ``pp``   — pipeline stages.
+
+Batch is sharded over (dp, fsdp) [and sp for long-context]; params over
+(fsdp, tp). Unused axes have size 1 and cost nothing.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+AXIS_ORDER = ("pp", "dp", "fsdp", "sp", "ep", "tp")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    dp: int = 1
+    fsdp: int = 1
+    tp: int = 1
+    sp: int = 1
+    ep: int = 1
+    pp: int = 1
+
+    @property
+    def num_devices(self) -> int:
+        return self.dp * self.fsdp * self.tp * self.sp * self.ep * self.pp
+
+    def axis_sizes(self) -> Tuple[int, ...]:
+        return tuple(getattr(self, a) for a in AXIS_ORDER)
+
+    @classmethod
+    def for_devices(cls, n: int, *, tp: int = 1, sp: int = 1, ep: int = 1,
+                    pp: int = 1, dp: int = 1) -> "MeshConfig":
+        """Fill the fsdp axis with whatever ``n`` leaves over."""
+        denom = tp * sp * ep * pp * dp
+        if n % denom:
+            raise ValueError(f"{n} devices not divisible by tp*sp*ep*pp*dp={denom}")
+        return cls(dp=dp, fsdp=n // denom, tp=tp, sp=sp, ep=ep, pp=pp)
+
+
+def make_mesh(config: MeshConfig,
+              devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    devices = list(devices) if devices is not None else jax.devices()
+    n = config.num_devices
+    if len(devices) < n:
+        raise ValueError(f"mesh needs {n} devices, have {len(devices)}")
+    arr = np.array(devices[:n]).reshape(config.axis_sizes())
+    return Mesh(arr, AXIS_ORDER)
+
+
+def balanced_factors(n: int, k: int = 3) -> Tuple[int, ...]:
+    """Split n into k roughly-balanced integer factors (largest first)."""
+    factors = [1] * k
+    remaining = n
+    for i in range(k - 1):
+        f = int(round(remaining ** (1 / (k - i))))
+        while f > 1 and remaining % f:
+            f -= 1
+        factors[i] = max(f, 1)
+        remaining //= factors[i]
+    factors[k - 1] = remaining
+    assert math.prod(factors) == n
+    return tuple(sorted(factors, reverse=True))

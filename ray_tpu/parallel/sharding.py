@@ -1,0 +1,73 @@
+"""Partition rules: pytree path patterns → PartitionSpecs.
+
+The declarative replacement for the reference's per-framework wrapper classes
+(DDP/FSDP wrapping in ``train/torch/train_loop_utils.py:91-100``): a model
+ships a list of ``(path_regex, spec)`` rules; applying them to a params
+pytree yields NamedShardings for ``jax.jit`` in/out shardings. XLA then emits
+the all-gathers/reduce-scatters that DDP/FSDP would do by hand.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, List, Optional, Sequence, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def _path_str(path) -> str:
+    parts = []
+    for p in path:
+        if hasattr(p, "key"):
+            parts.append(str(p.key))
+        elif hasattr(p, "idx"):
+            parts.append(str(p.idx))
+        else:
+            parts.append(str(p))
+    return "/".join(parts)
+
+
+class ShardingRules:
+    """Ordered (regex, PartitionSpec) rules; first match wins."""
+
+    def __init__(self, rules: Sequence[Tuple[str, P]], default: P = P()):
+        self._rules = [(re.compile(pat), spec) for pat, spec in rules]
+        self._default = default
+
+    def spec_for(self, path_string: str) -> P:
+        for pat, spec in self._rules:
+            if pat.search(path_string):
+                return spec
+        return self._default
+
+    def tree_specs(self, tree: Any):
+        """A pytree of PartitionSpecs matching ``tree``'s structure."""
+        return jax.tree_util.tree_map_with_path(
+            lambda path, leaf: self.spec_for(_path_str(path)), tree)
+
+    def tree_shardings(self, tree: Any, mesh: Mesh):
+        return jax.tree_util.tree_map_with_path(
+            lambda path, leaf: NamedSharding(mesh, self.spec_for(_path_str(path))),
+            tree)
+
+
+def named_sharding(mesh: Mesh, *axes) -> NamedSharding:
+    return NamedSharding(mesh, P(*axes))
+
+
+def shard_pytree(tree: Any, mesh: Mesh, rules: ShardingRules):
+    """Device-put a pytree according to the rules (used at init/restore)."""
+    shardings = rules.tree_shardings(tree, mesh)
+    return jax.tree.map(jax.device_put, tree, shardings)
+
+
+# Spec fragments shared by transformer models. Conventions:
+#   batch axis   -> ("dp", "fsdp")      [+ "sp" shards sequence]
+#   param matrices -> ("fsdp" on one dim, "tp" on the other)
+BATCH_AXES = ("dp", "fsdp")
+
+
+def data_spec(extra_seq_axis: Optional[str] = None) -> P:
+    """[batch, seq, ...] inputs: batch over data axes, seq over sp if used."""
+    return P(BATCH_AXES, extra_seq_axis)

@@ -1,0 +1,88 @@
+"""Sharded train-step construction: init, step, and mesh auto-layout.
+
+The jit-compiled training step that every trainer in the Train layer runs.
+Parameters/optimizer state are sharded by the model's rules; GSPMD propagates
+those shardings through ``optimizer.init`` and the step function, inserting
+all-gathers (fsdp), reduce-scatters (grads), and all-reduces (tp) on ICI.
+Gradient synchronization never touches the object plane — the property the
+reference maintains with NCCL outside Ray (SURVEY.md §3.4), achieved here by
+construction.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ray_tpu.models import llama
+from ray_tpu.parallel.mesh import MeshConfig, make_mesh
+from ray_tpu.parallel.sharding import ShardingRules
+
+
+def default_optimizer(lr: float = 3e-4, weight_decay: float = 0.1,
+                      warmup_steps: int = 100, total_steps: int = 10000,
+                      grad_clip: float = 1.0) -> optax.GradientTransformation:
+    schedule = optax.warmup_cosine_decay_schedule(
+        0.0, lr, warmup_steps, max(total_steps, warmup_steps + 1))
+    return optax.chain(
+        optax.clip_by_global_norm(grad_clip),
+        optax.adamw(schedule, b1=0.9, b2=0.95, weight_decay=weight_decay),
+    )
+
+
+def init_sharded_state(rng: jax.Array, cfg: llama.LlamaConfig, mesh: Mesh,
+                       optimizer: optax.GradientTransformation,
+                       rules: Optional[ShardingRules] = None):
+    """Initialize params+opt state directly into their target shardings.
+
+    Params are produced BY a jitted init with explicit out_shardings, so no
+    host-side full copy ever materializes (essential for 7B+); the optimizer
+    state inherits the param shardings through GSPMD propagation.
+    """
+    rules = rules or llama.sharding_rules()
+    abstract = jax.eval_shape(lambda r: llama.init_params(r, cfg), rng)
+    out_shardings = rules.tree_shardings(abstract, mesh)
+    params = jax.jit(lambda r: llama.init_params(r, cfg),
+                     out_shardings=out_shardings)(rng)
+    opt_state = jax.jit(optimizer.init)(params)
+    return params, opt_state
+
+
+def make_train_step(cfg: llama.LlamaConfig,
+                    optimizer: optax.GradientTransformation,
+                    loss_fn: Callable = None) -> Callable:
+    """(params, opt_state, batch) -> (params, opt_state, metrics), donated."""
+    loss_fn = loss_fn or llama.lm_loss
+
+    def step(params, opt_state, batch):
+        loss, grads = jax.value_and_grad(
+            lambda p: loss_fn(p, batch, cfg))(params)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        gnorm = optax.global_norm(grads)
+        return params, opt_state, {"loss": loss, "grad_norm": gnorm}
+
+    return jax.jit(step, donate_argnums=(0, 1))
+
+
+def shard_batch(batch: Dict[str, jax.Array], mesh: Mesh) -> Dict[str, jax.Array]:
+    """Place a host batch onto the mesh: batch dim over (dp, fsdp)."""
+    sharding = NamedSharding(mesh, P(("dp", "fsdp")))
+    return jax.tree.map(lambda x: jax.device_put(x, sharding), batch)
+
+
+def auto_mesh(n_devices: int, devices=None) -> Tuple[Mesh, MeshConfig]:
+    """A sensible (dp, fsdp, tp) layout for n devices: fsdp-dominant with a
+    tp=min(4, n) inner axis when n allows — the FSDP+TP sweet spot for
+    models at the 7B scale."""
+    tp = 1
+    for cand in (4, 2):
+        if n_devices % cand == 0 and n_devices >= cand * 2:
+            tp = cand
+            break
+    cfg = MeshConfig.for_devices(n_devices, tp=tp)
+    return make_mesh(cfg, devices), cfg

@@ -32,7 +32,11 @@ class RuntimeContext:
         return aid.hex() if aid else None
 
     def get_node_id(self) -> str:
-        nodes = global_worker().backend.nodes()
+        backend = global_worker().backend
+        node_id = getattr(backend, "node_id", None)
+        if node_id is not None:
+            return node_id
+        nodes = backend.nodes()
         return nodes[0]["node_id"] if nodes else ""
 
     def get_tpu_ids(self) -> List[int]:

@@ -1,0 +1,95 @@
+"""Node-selection policies.
+
+Reference analog: ``src/ray/raylet/scheduling/policy/`` — the hybrid policy's
+rationale (``hybrid_scheduling_policy.h:29-48``) is kept: prefer nodes that
+can run the task NOW over merely-feasible ones; rank by critical-resource
+utilization truncated below a spread threshold (so lightly-loaded nodes tie
+and small tasks pack rather than fragment); break ties randomly among the
+top candidates with the local/preferred node winning outright ties.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Dict, List, Optional, Tuple
+
+from ray_tpu._private.config import get_config
+from ray_tpu.core.resources import NodeResources, ResourceSet
+
+
+class HybridPolicy:
+    def pick(self, nodes: Dict[str, NodeResources], req: ResourceSet,
+             preferred: Optional[str] = None,
+             rng: Optional[random.Random] = None) -> Optional[str]:
+        cfg = get_config()
+        rng = rng or random
+        available: List[Tuple[float, str]] = []
+        feasible: List[str] = []
+        for node_id, nr in nodes.items():
+            if not nr.is_feasible(req):
+                continue
+            feasible.append(node_id)
+            if nr.can_fit(req):
+                util = nr.utilization(req)
+                if util < cfg.scheduler_spread_threshold:
+                    util = 0.0  # truncate: lightly-loaded nodes tie
+                available.append((util, node_id))
+        if available:
+            best = min(u for u, _ in available)
+            candidates = [n for u, n in available if u == best]
+            if preferred in candidates:
+                return preferred
+            return rng.choice(candidates)
+        if feasible:
+            # Nothing can run it now; queue at a feasible node (prefer local).
+            if preferred in feasible:
+                return preferred
+            return rng.choice(feasible)
+        return None
+
+
+class SpreadPolicy:
+    """Round-robin across nodes that can fit (reference:
+    ``spread_scheduling_policy.h:27``)."""
+
+    def __init__(self):
+        self._rr = 0
+
+    def pick(self, nodes, req, preferred=None, rng=None):
+        fitting = sorted(n for n, nr in nodes.items() if nr.can_fit(req))
+        if not fitting:
+            fitting = sorted(n for n, nr in nodes.items() if nr.is_feasible(req))
+        if not fitting:
+            return None
+        self._rr += 1
+        return fitting[self._rr % len(fitting)]
+
+
+class NodeAffinityPolicy:
+    def __init__(self, node_id: str, soft: bool):
+        self.node_id = node_id
+        self.soft = soft
+
+    def pick(self, nodes, req, preferred=None, rng=None):
+        nr = nodes.get(self.node_id)
+        if nr is not None and nr.is_feasible(req):
+            return self.node_id
+        if self.soft:
+            return HybridPolicy().pick(nodes, req, preferred, rng)
+        return None
+
+
+# Module-level instance so the round-robin counter persists across calls.
+_SPREAD = SpreadPolicy()
+
+
+def pick_node(strategy, nodes: Dict[str, NodeResources], req: ResourceSet,
+              preferred: Optional[str] = None) -> Optional[str]:
+    """Dispatch on a TaskSpec SchedulingStrategy."""
+    kind = getattr(strategy, "kind", "DEFAULT")
+    if kind == "SPREAD":
+        return _SPREAD.pick(nodes, req, preferred)
+    if kind == "NODE_AFFINITY":
+        return NodeAffinityPolicy(strategy.node_id_hex, strategy.soft).pick(
+            nodes, req, preferred)
+    return HybridPolicy().pick(nodes, req, preferred)

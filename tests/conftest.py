@@ -1,19 +1,7 @@
-"""Test configuration.
-
-Forces JAX onto a virtual 8-device CPU platform *before* jax is imported so
-multi-chip sharding tests run anywhere (the analog of the reference's
-fake-resource cluster trick, SURVEY.md §4: tests schedule "GPU" tasks with no
-GPUs; here tests build 8-device meshes with no TPUs).
-"""
+"""Shared fixtures. Platform scrubbing happens in the repo-root conftest."""
 
 import os
 import sys
-
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8").strip()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
