@@ -62,12 +62,36 @@ class _ActorEntry:
         }
 
 
+PG_PENDING = "PENDING"
+PG_CREATED = "CREATED"
+PG_REMOVED = "REMOVED"
+
+
+class _PgEntry:
+    def __init__(self, pg_id: str, bundles: List[Dict[str, float]],
+                 strategy: str, name: str):
+        self.pg_id = pg_id
+        self.bundles = bundles
+        self.strategy = strategy
+        self.name = name
+        self.state = PG_PENDING
+        self.bundle_nodes: List[Optional[str]] = [None] * len(bundles)
+        self.waiters: List[asyncio.Future] = []
+        self._rr = 0  # round-robin pointer for bundle_index=-1 routing
+
+    def info(self) -> Dict[str, Any]:
+        return {"pg_id": self.pg_id, "state": self.state, "name": self.name,
+                "strategy": self.strategy, "bundles": self.bundles,
+                "bundle_nodes": list(self.bundle_nodes)}
+
+
 class GcsServer:
     def __init__(self):
         self.nodes: Dict[str, _NodeEntry] = {}
         self.kv: Dict[str, bytes] = {}
         self.actors: Dict[str, _ActorEntry] = {}
         self.named_actors: Dict[Tuple[str, str], str] = {}
+        self.placement_groups: Dict[str, _PgEntry] = {}
         self.object_locations: Dict[str, Set[str]] = {}
         self.object_sizes: Dict[str, int] = {}
         self._location_waiters: Dict[str, List[asyncio.Future]] = {}
@@ -127,6 +151,16 @@ class GcsServer:
             if actor.node_id == entry.node_id and actor.state in (
                     ACTOR_ALIVE, ACTOR_PENDING, ACTOR_RESTARTING):
                 await self._handle_actor_failure(actor, f"node died: {reason}")
+        # Reschedule ONLY the lost bundles of affected placement groups
+        # (reference: GcsPlacementGroupManager PG rescheduling on node death).
+        # Surviving bundles keep their reservations — actors/tasks inside
+        # them are still running and hold chips from those reservations.
+        for pg in self.placement_groups.values():
+            if pg.state == PG_CREATED and entry.node_id in pg.bundle_nodes:
+                pg.state = PG_PENDING
+                pg.bundle_nodes = [None if nid == entry.node_id else nid
+                                   for nid in pg.bundle_nodes]
+                asyncio.ensure_future(self._schedule_pg(pg))
 
     # ---- kv / function table ----------------------------------------------
     async def rpc_kv_put(self, p):
@@ -205,12 +239,21 @@ class GcsServer:
             await asyncio.sleep(backoff)
         req = ResourceSet(entry.spec.get("resources", {}))
         strategy = entry.spec.get("scheduling_strategy")
+        pg_info = entry.spec.get("pg")
         deadline = time.monotonic() + 3600.0
         while time.monotonic() < deadline:
             if entry.state == ACTOR_DEAD:
                 return  # killed while pending/restarting
-            views = {nid: n.view for nid, n in self.nodes.items() if n.alive}
-            node_id = pick_node(strategy, views, req)
+            if pg_info is not None:
+                node_id = await self._pg_bundle_node(pg_info, entry)
+                if node_id is None:
+                    if entry.state == ACTOR_DEAD:
+                        return
+                    await asyncio.sleep(0.2)
+                    continue
+            else:
+                views = {nid: n.view for nid, n in self.nodes.items() if n.alive}
+                node_id = pick_node(strategy, views, req)
             if node_id is None:
                 await asyncio.sleep(0.2)  # infeasible now; wait for nodes
                 continue
@@ -238,6 +281,23 @@ class GcsServer:
                 self._pool.invalidate(node.address)
                 await asyncio.sleep(0.2)
         await self._finalize_actor_death(entry, "scheduling timed out")
+
+    async def _pg_bundle_node(self, pg_info: Dict, entry: _ActorEntry
+                              ) -> Optional[str]:
+        """Resolve (and fix) the bundle an actor lands in; None = not ready."""
+        pg = self.placement_groups.get(pg_info["pg_id"])
+        if pg is None or pg.state == PG_REMOVED:
+            await self._finalize_actor_death(entry, "placement group removed")
+            return None
+        if pg.state != PG_CREATED:
+            return None
+        idx = pg_info.get("bundle_index", -1)
+        if idx < 0:
+            idx = pg._rr % len(pg.bundles)
+            pg._rr += 1
+            pg_info["bundle_index"] = idx  # pin for restarts
+        entry.spec["pg"] = pg_info
+        return pg.bundle_nodes[idx]
 
     async def rpc_actor_update(self, p):
         entry = self.actors.get(p["actor_id"])
@@ -341,6 +401,171 @@ class GcsServer:
 
     async def rpc_list_actors(self, p):
         return [a.info() for a in self.actors.values()]
+
+    # ---- placement groups ---------------------------------------------------
+    async def rpc_create_placement_group(self, p):
+        entry = _PgEntry(p["pg_id"], p["bundles"], p["strategy"],
+                         p.get("name", ""))
+        self.placement_groups[p["pg_id"]] = entry
+        asyncio.ensure_future(self._schedule_pg(entry))
+        return {"ok": True}
+
+    def _pg_plan(self, entry: _PgEntry) -> Optional[Dict[int, str]]:
+        """Pick a node for every UNPLACED bundle under the strategy, against a
+        scratch copy of the availability view (so multi-bundle fits are
+        accounted). Already-placed bundles (partial reschedule after node
+        death) constrain the plan but are not re-placed."""
+        import copy
+
+        views = {nid: copy.deepcopy(n.view) for nid, n in self.nodes.items()
+                 if n.alive}
+        reqs = [ResourceSet(b) for b in entry.bundles]
+        missing = [i for i, nid in enumerate(entry.bundle_nodes) if nid is None]
+        used_nodes: Set[str] = {nid for nid in entry.bundle_nodes if nid}
+        plan: Dict[int, str] = {}
+        if entry.strategy == "STRICT_PACK":
+            total = ResourceSet()
+            for i in missing:
+                total = total.add(reqs[i])
+            placed = next((n for n in entry.bundle_nodes if n), None)
+            candidates = ([placed] if placed else list(views.keys()))
+            for nid in candidates:
+                if nid in views and views[nid].can_fit(total):
+                    return {i: nid for i in missing}
+            return None
+        for i in missing:
+            req = reqs[i]
+            candidates = list(views.items())
+            if entry.strategy in ("SPREAD", "STRICT_SPREAD"):
+                fresh = [(nid, v) for nid, v in candidates if nid not in used_nodes]
+                if entry.strategy == "STRICT_SPREAD":
+                    candidates = fresh
+                elif fresh:
+                    candidates = fresh + [(n, v) for n, v in candidates
+                                          if n in used_nodes]
+            elif entry.strategy == "PACK" and used_nodes:
+                candidates.sort(key=lambda kv: kv[0] not in used_nodes)
+            chosen = None
+            for nid, view in candidates:
+                if view.can_fit(req):
+                    chosen = nid
+                    break
+            if chosen is None:
+                return None
+            views[chosen].allocate(req)
+            used_nodes.add(chosen)
+            plan[i] = chosen
+        return plan
+
+    async def _schedule_pg(self, entry: _PgEntry) -> None:
+        """2-phase commit: prepare every (missing) bundle, then commit all —
+        atomic gang reservation (reference: prepare-all/commit-all in
+        ``gcs_placement_group_scheduler.cc``)."""
+        while entry.state == PG_PENDING:
+            plan = self._pg_plan(entry)
+            if plan is None:
+                await asyncio.sleep(0.2)
+                continue
+            prepared: List[Tuple[int, str]] = []
+            ok = True
+            for i, nid in plan.items():
+                try:
+                    client = await self._pool.get(self.nodes[nid].address)
+                    reply = await client.call("prepare_bundle", {
+                        "pg_id": entry.pg_id, "bundle_index": i,
+                        "resources": entry.bundles[i]})
+                    if reply.get("ok"):
+                        prepared.append((i, nid))
+                    else:
+                        ok = False
+                        break
+                except Exception:
+                    ok = False
+                    break
+            committed: List[Tuple[int, str]] = []
+            if ok and entry.state == PG_PENDING:
+                for i, nid in prepared:
+                    try:
+                        client = await self._pool.get(self.nodes[nid].address)
+                        await client.call("commit_bundle", {
+                            "pg_id": entry.pg_id, "bundle_index": i})
+                        committed.append((i, nid))
+                    except Exception:
+                        ok = False  # node died mid-commit: unwind and retry
+                        break
+            if not ok or entry.state != PG_PENDING:
+                for i, nid in prepared:
+                    try:
+                        client = await self._pool.get(self.nodes[nid].address)
+                        await client.call("release_bundle", {
+                            "pg_id": entry.pg_id, "bundle_index": i})
+                    except Exception:
+                        pass
+                if entry.state != PG_PENDING:
+                    return
+                await asyncio.sleep(0.2)
+                continue
+            for i, nid in committed:
+                entry.bundle_nodes[i] = nid
+            entry.state = PG_CREATED
+            for fut in entry.waiters:
+                if not fut.done():
+                    fut.set_result(True)
+            entry.waiters.clear()
+            return
+
+    async def rpc_wait_placement_group(self, p):
+        entry = self.placement_groups.get(p["pg_id"])
+        if entry is None:
+            return {"error": "unknown placement group"}
+        deadline = time.monotonic() + p.get("timeout", 3600.0)
+        while entry.state == PG_PENDING and time.monotonic() < deadline:
+            fut = asyncio.get_running_loop().create_future()
+            entry.waiters.append(fut)
+            try:
+                await asyncio.wait_for(fut, deadline - time.monotonic())
+            except asyncio.TimeoutError:
+                break
+        return {"state": entry.state}
+
+    async def rpc_get_placement_group(self, p):
+        entry = self.placement_groups.get(p["pg_id"])
+        if entry is None:
+            return {"error": "unknown placement group"}
+        info = entry.info()
+        if p.get("pick_bundle") and entry.state == PG_CREATED:
+            idx = p.get("bundle_index", -1)
+            if idx < 0:
+                idx = entry._rr % len(entry.bundles)
+                entry._rr += 1
+            nid = entry.bundle_nodes[idx]
+            info["picked_bundle"] = idx
+            info["picked_address"] = (self.nodes[nid].address
+                                      if nid in self.nodes else None)
+        return info
+
+    async def rpc_remove_placement_group(self, p):
+        entry = self.placement_groups.get(p["pg_id"])
+        if entry is None:
+            return {"ok": False}
+        entry.state = PG_REMOVED
+        for fut in entry.waiters:
+            if not fut.done():
+                fut.set_result(True)
+        entry.waiters.clear()
+        for i, nid in enumerate(entry.bundle_nodes):
+            if nid is None or nid not in self.nodes:
+                continue
+            try:
+                client = await self._pool.get(self.nodes[nid].address)
+                await client.call("release_bundle", {
+                    "pg_id": entry.pg_id, "bundle_index": i})
+            except Exception:
+                pass
+        return {"ok": True}
+
+    async def rpc_list_placement_groups(self, p):
+        return [e.info() for e in self.placement_groups.values()]
 
     # ---- task routing (spillback target selection) -------------------------
     async def rpc_route_task(self, p):

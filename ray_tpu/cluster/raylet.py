@@ -46,6 +46,22 @@ class _WorkerEntry:
         self.assignment: Dict[str, List[int]] = {}
 
 
+class _BundleState:
+    """A committed PG bundle: a carved-out resource pool on this node.
+
+    The bundle holds ``req`` (+ specific chips) against the node; tasks and
+    actors placed into the bundle allocate from this pool, not the node's.
+    """
+
+    def __init__(self, req: ResourceSet, node_assignment: Dict[str, List[int]]):
+        self.node_req = req
+        self.node_assignment = node_assignment
+        self.pool = NodeResources(req.to_dict())
+        if TPU in node_assignment:
+            self.pool._free_tpu_chips = list(node_assignment[TPU])
+        self.committed = False
+
+
 class Raylet:
     def __init__(self, node_id: str, session_name: str, gcs_address: str,
                  resources: Dict[str, float], labels: Dict[str, str],
@@ -65,6 +81,7 @@ class Raylet:
         self._idle: Dict[Tuple, List[_WorkerEntry]] = {}
         self._queue: List[Dict] = []          # pending task payloads + futures
         self._inflight: Dict[str, Dict] = {}  # task_id -> resource state
+        self._bundles: Dict[Tuple[str, int], _BundleState] = {}
         self._dispatch_event = asyncio.Event()
         self._local_objects: set = set()
         self._tasks: List[asyncio.Task] = []
@@ -170,7 +187,7 @@ class Raylet:
                 if entry.proc.poll() is not None:
                     self._workers.pop(entry.worker_id, None)
                     if entry.is_actor_worker and entry.actor_id:
-                        self.node.release(
+                        getattr(entry, "_pool", self.node).release(
                             ResourceSet(entry_spec_resources(entry)), entry.assignment)
                         await self._gcs.call("actor_update", {
                             "actor_id": entry.actor_id, "state": "DEAD",
@@ -184,7 +201,8 @@ class Raylet:
     async def rpc_submit_task(self, p):
         """Held open until the task completes; reply carries results meta."""
         req = ResourceSet(p["resources"])
-        if not self.node.is_feasible(req) or p.get("spillback_hint"):
+        if p.get("pg") is None and (not self.node.is_feasible(req)
+                                    or p.get("spillback_hint")):
             return await self._spill(p)
         fut = asyncio.get_running_loop().create_future()
         self._queue.append({"payload": p, "future": fut})
@@ -212,20 +230,45 @@ class Raylet:
             self._dispatch_event.clear()
             remaining = []
             for item in self._queue:
-                req = ResourceSet(item["payload"]["resources"])
-                if self.node.can_fit(req):
-                    assignment = self.node.allocate(req)
-                    asyncio.ensure_future(self._run_task(item, req, assignment))
+                payload = item["payload"]
+                req = ResourceSet(payload["resources"])
+                pg = payload.get("pg")
+                if pg is not None:
+                    bundle = self._bundles.get((pg["pg_id"], pg["bundle_index"]))
+                    if bundle is None:
+                        if not item["future"].done():
+                            item["future"].set_result({
+                                "error": "bundle_gone",
+                                "message": "placement group bundle not on this "
+                                           "node (removed or rescheduled)"})
+                        continue
+                    if not bundle.pool.is_feasible(req):
+                        if not item["future"].done():
+                            item["future"].set_result({
+                                "error": "infeasible",
+                                "message": f"task requires {req.to_dict()} but "
+                                           f"its placement group bundle only has "
+                                           f"{bundle.pool.total.to_dict()}"})
+                        continue
+                    pool = bundle.pool
+                else:
+                    pool = self.node
+                if pool.can_fit(req):
+                    assignment = pool.allocate(req)
+                    asyncio.ensure_future(
+                        self._run_task(item, req, assignment, pool))
                 else:
                     remaining.append(item)
             self._queue = remaining
 
-    async def _run_task(self, item, req: ResourceSet, assignment) -> None:
+    async def _run_task(self, item, req: ResourceSet, assignment,
+                        pool: NodeResources) -> None:
         payload, fut = item["payload"], item["future"]
         task_id = payload["task_id"]
         chips = assignment.get(TPU, [])
         key = (tuple(chips),)
-        self._inflight[task_id] = {"req": req, "released": ResourceSet()}
+        self._inflight[task_id] = {"req": req, "released": ResourceSet(),
+                                   "pool": pool}
         try:
             worker = await self._get_worker(key, chips)
             worker.busy = True
@@ -240,7 +283,7 @@ class Raylet:
                 fut.set_result({"error": "worker_crashed", "message": repr(e)})
         finally:
             state = self._inflight.pop(task_id)
-            self.node.release(state["req"].subtract(state["released"]), assignment)
+            pool.release(state["req"].subtract(state["released"]), assignment)
             self._dispatch_event.set()
 
     async def rpc_task_blocked(self, p):
@@ -258,17 +301,54 @@ class Raylet:
         if cpu_part.is_empty():
             return {"ok": False}
         state["released"] = cpu_part
-        self.node.release(cpu_part)
+        state["pool"].release(cpu_part)
         self._dispatch_event.set()
         return {"ok": True}
+
+    # ---- placement group bundles -------------------------------------------
+    async def rpc_prepare_bundle(self, p):
+        """Phase 1 of the 2PC: reserve the bundle's resources (+chips)."""
+        key = (p["pg_id"], p["bundle_index"])
+        if key in self._bundles:
+            return {"ok": True}  # idempotent re-prepare
+        req = ResourceSet(p["resources"])
+        if not self.node.can_fit(req):
+            return {"ok": False, "retry": True}
+        assignment = self.node.allocate(req)
+        self._bundles[key] = _BundleState(req, assignment)
+        return {"ok": True}
+
+    async def rpc_commit_bundle(self, p):
+        bundle = self._bundles.get((p["pg_id"], p["bundle_index"]))
+        if bundle is None:
+            return {"ok": False}
+        bundle.committed = True
+        return {"ok": True}
+
+    async def rpc_release_bundle(self, p):
+        bundle = self._bundles.pop((p["pg_id"], p["bundle_index"]), None)
+        if bundle is not None:
+            self.node.release(bundle.node_req, bundle.node_assignment)
+            self._dispatch_event.set()
+        return {"ok": True}
+
+    def _actor_pool(self, spec) -> Optional[NodeResources]:
+        pg = spec.get("pg")
+        if pg is None:
+            return self.node
+        bundle = self._bundles.get((pg["pg_id"], pg["bundle_index"]))
+        return bundle.pool if bundle is not None else None
 
     # ---- actors -------------------------------------------------------------
     async def rpc_create_actor(self, p):
         spec = p["spec"]
         req = ResourceSet(spec.get("resources", {}))
-        if not self.node.can_fit(req):
+        pool = self._actor_pool(spec)
+        if pool is None:
+            return {"ok": False, "retry": True}  # bundle not here (yet)
+        if not pool.can_fit(req):
             return {"ok": False, "retry": True}
-        assignment = self.node.allocate(req)
+        assignment = pool.allocate(req)
         chips = assignment.get(TPU, [])
         worker = None
         try:
@@ -277,6 +357,7 @@ class Raylet:
             worker.actor_id = p["actor_id"]
             worker.assignment = assignment
             worker._spec_resources = spec.get("resources", {})
+            worker._pool = pool
             await asyncio.wait_for(worker.ready,
                                    get_config().process_startup_timeout_s)
             reply = await worker.client.call("create_actor", p)
@@ -286,7 +367,7 @@ class Raylet:
                 # chip accounting).
                 worker.is_actor_worker = False
                 self._workers.pop(worker.worker_id, None)
-                self.node.release(req, assignment)
+                pool.release(req, assignment)
                 try:
                     worker.proc.terminate()
                 except ProcessLookupError:
@@ -307,15 +388,15 @@ class Raylet:
                     worker.proc.terminate()
                 except ProcessLookupError:
                     pass
-            self.node.release(req, assignment)
+            pool.release(req, assignment)
             return {"ok": False, "error": repr(e)}
 
     async def rpc_kill_actor(self, p):
         for entry in list(self._workers.values()):
             if entry.actor_id == p["actor_id"]:
                 entry.is_actor_worker = False  # suppress DEAD re-report
-                self.node.release(ResourceSet(entry_spec_resources(entry)),
-                                  entry.assignment)
+                getattr(entry, "_pool", self.node).release(
+                    ResourceSet(entry_spec_resources(entry)), entry.assignment)
                 try:
                     entry.proc.terminate()
                 except ProcessLookupError:

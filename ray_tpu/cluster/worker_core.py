@@ -146,6 +146,7 @@ class ClusterBackend(RuntimeBackend):
         self._cluster_shutdown_hook = None
         self._current_task_id: Optional[str] = None  # set by worker_main
         self._blocked_notified: set = set()
+        self._pg_addr_cache: Dict[Tuple[str, int], str] = {}
 
     # ---- bootstrap ----------------------------------------------------------
     def connect(self) -> None:
@@ -341,11 +342,35 @@ class ClusterBackend(RuntimeBackend):
             self._fn_cache[fid] = fn
         return fn
 
+    @staticmethod
+    def _normalize_strategy(options) -> Tuple[Any, Optional[Dict]]:
+        """Returns (strategy_spec, pg_info) from the options surface, which
+        accepts either scheduling_strategy=PlacementGroupSchedulingStrategy
+        or the placement_group=... shorthand."""
+        from ray_tpu.util.placement_group import (
+            PlacementGroup,
+            PlacementGroupSchedulingStrategy,
+        )
+
+        strategy = options.get("scheduling_strategy")
+        pg = options.get("placement_group")
+        if pg is not None:
+            if not isinstance(pg, PlacementGroup):
+                raise TypeError("placement_group= expects a PlacementGroup")
+            strategy = PlacementGroupSchedulingStrategy(
+                pg, options.get("placement_group_bundle_index", -1))
+        if isinstance(strategy, PlacementGroupSchedulingStrategy):
+            pg_info = {"pg_id": strategy.placement_group.id.hex(),
+                       "bundle_index": strategy.bundle_index}
+            return strategy.to_spec(), pg_info
+        return strategy, None
+
     # ---- tasks --------------------------------------------------------------
     def submit_task(self, fn, options, args, kwargs):
         validate_options(options, for_actor=False)
         req = resources_from_options(options, default_num_cpus=1)
         num_returns = options.get("num_returns", 1)
+        strategy, pg_info = self._normalize_strategy(options)
         fid = self._export("fn", fn)
         task_id = TaskID.for_task(self.job_id)
         refs = [ObjectRef(ObjectID.for_return(task_id, i), owner=self.address)
@@ -361,7 +386,8 @@ class ClusterBackend(RuntimeBackend):
             "kwargs": {k: self._serialize_arg(v) for k, v in kwargs.items()},
             "num_returns": num_returns,
             "resources": req.to_dict(),
-            "strategy": options.get("scheduling_strategy"),
+            "strategy": strategy,
+            "pg": pg_info,
             "owner": self.address,
             "max_retries": options.get("max_retries",
                                        get_config().task_max_retries_default),
@@ -374,14 +400,46 @@ class ClusterBackend(RuntimeBackend):
         attempt = 0
         while True:
             try:
-                reply = await self._raylet.call("submit_task", payload)
+                target = self._raylet
+                if payload.get("pg") is not None:
+                    target = await self._pg_bundle_raylet(payload["pg"])
+                reply = await target.call("submit_task", payload)
             except Exception as e:
                 reply = {"error": "submit_failed", "message": repr(e)}
-            if reply.get("error") == "worker_crashed" and attempt < retries:
-                attempt += 1
-                continue
+            if reply.get("error") in ("worker_crashed", "bundle_gone",
+                                      "submit_failed"):
+                if payload.get("pg") is not None:
+                    self._pg_addr_cache.pop(
+                        (payload["pg"]["pg_id"],
+                         payload["pg"].get("bundle_index", -1)), None)
+                if attempt < retries:
+                    attempt += 1
+                    continue
             break
         self._apply_task_reply(reply, refs, payload["fn_name"])
+
+    async def _pg_bundle_raylet(self, pg_info: Dict):
+        """Resolve the raylet hosting the task's bundle. The address of a
+        pinned bundle is cached after first resolution (invalidated on
+        bundle_gone) so steady-state PG task submission costs zero extra
+        control-plane round-trips."""
+        idx = pg_info.get("bundle_index", -1)
+        if idx >= 0:
+            cached = self._pg_addr_cache.get((pg_info["pg_id"], idx))
+            if cached is not None:
+                return await self._pool.get(cached)
+        await self._gcs.call("wait_placement_group", {
+            "pg_id": pg_info["pg_id"], "timeout": 300.0})
+        reply = await self._gcs.call("get_placement_group", {
+            "pg_id": pg_info["pg_id"], "pick_bundle": True,
+            "bundle_index": idx})
+        if reply.get("error") or reply.get("picked_address") is None:
+            raise RuntimeError(
+                f"placement group unavailable: {reply.get('error', reply.get('state'))}")
+        pg_info["bundle_index"] = reply["picked_bundle"]
+        self._pg_addr_cache[(pg_info["pg_id"], reply["picked_bundle"])] = \
+            reply["picked_address"]
+        return await self._pool.get(reply["picked_address"])
 
     def _apply_task_reply(self, reply, refs: List[ObjectRef], fn_name: str) -> None:
         if reply.get("error"):
@@ -403,6 +461,7 @@ class ClusterBackend(RuntimeBackend):
     def create_actor(self, cls, options, args, kwargs, method_meta):
         validate_options(options, for_actor=True)
         req = resources_from_options(options, default_num_cpus=0)
+        strategy, pg_info = self._normalize_strategy(options)
         cid = self._export("cls", cls)
         actor_id = ActorID.of(self.job_id)
         spec = {
@@ -420,7 +479,8 @@ class ClusterBackend(RuntimeBackend):
             "namespace": options.get("namespace") or self.namespace,
             "lifetime": options.get("lifetime"),
             "get_if_exists": options.get("get_if_exists", False),
-            "scheduling_strategy": options.get("scheduling_strategy"),
+            "scheduling_strategy": strategy,
+            "pg": pg_info,
             "method_meta": method_meta,
             "owner": self.address,
         }

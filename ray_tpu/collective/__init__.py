@@ -1,0 +1,28 @@
+"""Collective communication.
+
+Reference analog: ``python/ray/util/collective/`` (NCCL/Gloo groups between
+named actors, ``collective.py:120-621``). TPU-native redesign in two planes:
+
+1. **Device plane (the fast path)** — collectives are NOT a runtime service:
+   they are XLA ops (psum/all_gather/ppermute/reduce_scatter) compiled into
+   jitted programs over a ``jax.sharding.Mesh``, riding ICI within a slice
+   and DCN across slices. The runtime's job is only bootstrap:
+   ``rendezvous.bootstrap_jax_distributed`` wires multi-host processes
+   together through the GCS KV (the reference's unique-id rendezvous via a
+   named actor, ``nccl_util.py``, same trick).
+2. **Host plane (the compatibility path)** — ``allreduce``/``broadcast``/...
+   on host numpy arrays between actors/tasks, through a rendezvous actor
+   (gloo-equivalent for CPU tensors and control data).
+"""
+
+from ray_tpu.collective.collective import (  # noqa: F401
+    allgather,
+    allreduce,
+    barrier,
+    broadcast,
+    create_collective_group,
+    destroy_collective_group,
+    init_collective_group,
+    reducescatter,
+)
+from ray_tpu.collective.rendezvous import bootstrap_jax_distributed  # noqa: F401

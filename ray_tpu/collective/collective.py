@@ -1,0 +1,178 @@
+"""Host-plane collectives between tasks/actors via a rendezvous actor.
+
+API shape mirrors the reference's ``ray.util.collective.collective``: members
+join a named group with (world_size, rank), then issue symmetric collective
+calls in program order. The group actor synchronizes round n across all
+ranks (every rank's n-th call is matched — the same program-order contract
+NCCL imposes).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+_REDUCE_OPS = {
+    "sum": lambda arrs: _tree_reduce(arrs, np.add),
+    "prod": lambda arrs: _tree_reduce(arrs, np.multiply),
+    "min": lambda arrs: _tree_reduce(arrs, np.minimum),
+    "max": lambda arrs: _tree_reduce(arrs, np.maximum),
+}
+
+
+def _tree_reduce(arrs: List[Any], op) -> Any:
+    acc = arrs[0]
+    for a in arrs[1:]:
+        acc = op(acc, a)
+    return acc
+
+
+class _CollectiveGroupActor:
+    """Async rendezvous actor: one instance per group (max_concurrency high
+    so every rank can block in the same round concurrently)."""
+
+    def __init__(self, world_size: int):
+        import asyncio
+
+        self.world_size = world_size
+        self._rounds: Dict[int, Dict] = {}
+        self._lock = asyncio.Lock()
+
+    async def op(self, seq: int, rank: int, opname: str, payload, meta):
+        import asyncio
+
+        async with self._lock:
+            rnd = self._rounds.get(seq)
+            if rnd is None:
+                rnd = {"data": {}, "meta": {}, "event": asyncio.Event(),
+                       "result": None}
+                self._rounds[seq] = rnd
+            rnd["data"][rank] = payload
+            rnd["meta"][rank] = meta
+            complete = len(rnd["data"]) == self.world_size
+            if complete:
+                rnd["result"] = self._finish(opname, rnd)
+                rnd["event"].set()
+        if not complete:
+            await rnd["event"].wait()
+        result = rnd["result"]
+        async with self._lock:
+            rnd["meta"].setdefault("_done", set()).add(rank)
+            if len(rnd["meta"]["_done"]) == self.world_size:
+                self._rounds.pop(seq, None)
+        if opname in ("allgather",):
+            return result
+        if opname in ("reducescatter",):
+            return result[rank]
+        return result
+
+    def _finish(self, opname: str, rnd: Dict):
+        data = [rnd["data"][r] for r in range(self.world_size)]
+        if opname == "barrier":
+            return None
+        if opname == "allreduce":
+            reduce_op = rnd["meta"][0]["op"]
+            return _REDUCE_OPS[reduce_op](data)
+        if opname == "broadcast":
+            src = rnd["meta"][0]["src"]
+            return rnd["data"][src]
+        if opname == "allgather":
+            return data
+        if opname == "reducescatter":
+            reduce_op = rnd["meta"][0]["op"]
+            reduced = _REDUCE_OPS[reduce_op](data)
+            return np.array_split(reduced, self.world_size)
+        raise ValueError(f"unknown collective {opname!r}")
+
+
+class _GroupHandle:
+    def __init__(self, name: str, world_size: int, rank: int, actor):
+        self.name = name
+        self.world_size = world_size
+        self.rank = rank
+        self.actor = actor
+        self.seq = 0
+
+
+_local = threading.local()
+
+
+def _groups() -> Dict[str, _GroupHandle]:
+    if not hasattr(_local, "groups"):
+        _local.groups = {}
+    return _local.groups
+
+
+_NAMESPACE = "_rt_collective"
+
+
+def create_collective_group(world_size: int, group_name: str = "default") -> None:
+    """Declare the group (idempotent); members still call init_*."""
+    import ray_tpu
+
+    ray_tpu.remote(max_concurrency=max(world_size * 2, 8))(
+        _CollectiveGroupActor).options(
+        name=f"cg:{group_name}", namespace=_NAMESPACE,
+        get_if_exists=True, lifetime="detached").remote(world_size)
+
+
+def init_collective_group(world_size: int, rank: int,
+                          group_name: str = "default") -> None:
+    import ray_tpu
+
+    if not (0 <= rank < world_size):
+        raise ValueError(f"rank {rank} out of range for world_size {world_size}")
+    create_collective_group(world_size, group_name)
+    actor = ray_tpu.get_actor(f"cg:{group_name}", namespace=_NAMESPACE)
+    _groups()[group_name] = _GroupHandle(group_name, world_size, rank, actor)
+
+
+def _handle(group_name: str) -> _GroupHandle:
+    h = _groups().get(group_name)
+    if h is None:
+        raise RuntimeError(
+            f"collective group {group_name!r} not initialized in this "
+            f"worker; call init_collective_group(world_size, rank) first")
+    return h
+
+
+def _call(group_name: str, opname: str, payload, meta) -> Any:
+    import ray_tpu
+
+    h = _handle(group_name)
+    seq = h.seq
+    h.seq += 1
+    return ray_tpu.get(h.actor.op.remote(seq, h.rank, opname, payload, meta))
+
+
+def allreduce(tensor, group_name: str = "default", op: str = "sum"):
+    return _call(group_name, "allreduce", np.asarray(tensor), {"op": op})
+
+
+def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
+    return _call(group_name, "broadcast", np.asarray(tensor), {"src": src_rank})
+
+
+def allgather(tensor, group_name: str = "default") -> List:
+    return _call(group_name, "allgather", np.asarray(tensor), {})
+
+
+def reducescatter(tensor, group_name: str = "default", op: str = "sum"):
+    return _call(group_name, "reducescatter", np.asarray(tensor), {"op": op})
+
+
+def barrier(group_name: str = "default") -> None:
+    _call(group_name, "barrier", None, {})
+
+
+def destroy_collective_group(group_name: str = "default") -> None:
+    import ray_tpu
+
+    _groups().pop(group_name, None)
+    try:
+        actor = ray_tpu.get_actor(f"cg:{group_name}", namespace=_NAMESPACE)
+        ray_tpu.kill(actor)
+    except ValueError:
+        pass

@@ -1,0 +1,78 @@
+"""jax.distributed bootstrap through the GCS KV.
+
+The TPU replacement for the reference's NCCL process-group setup
+(``train/torch/config.py:64`` — rank-0 TCP rendezvous + env vars): rank 0
+publishes its coordinator address under a KV key; other ranks poll the key;
+then every rank calls ``jax.distributed.initialize`` and XLA's collectives
+see the full multi-host device set. The KV plays the role the named
+rendezvous actor plays for NCCL unique ids in the reference
+(``collective_group/nccl_util.py``).
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import time
+from typing import Optional
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _kv_key(group_name: str) -> str:
+    return f"@rendezvous/{group_name}/coordinator"
+
+
+def bootstrap_jax_distributed(world_size: int, rank: int,
+                              group_name: str = "train",
+                              coordinator_ip: Optional[str] = None,
+                              timeout_s: float = 60.0,
+                              local_device_ids=None) -> None:
+    """Call from every member of a gang (one process per host).
+
+    Single-process gangs (world_size == 1) skip distributed init entirely —
+    jax sees its local devices and meshes work unchanged.
+    """
+    import ray_tpu
+    from ray_tpu.core.worker import global_worker
+
+    if world_size <= 1:
+        return
+    backend = global_worker()._require_backend()
+    key = _kv_key(group_name)
+    if rank == 0:
+        ip = coordinator_ip or socket.gethostbyname(socket.gethostname())
+        address = f"{ip}:{_free_port()}"
+        backend.kv_put(key, address.encode())
+    else:
+        deadline = time.monotonic() + timeout_s
+        address = None
+        while time.monotonic() < deadline:
+            raw = backend.kv_get(key)
+            if raw:
+                address = raw.decode()
+                break
+            time.sleep(0.1)
+        if address is None:
+            raise TimeoutError(
+                f"rendezvous {group_name!r}: coordinator address not "
+                f"published within {timeout_s}s")
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=address,
+        num_processes=world_size,
+        process_id=rank,
+        local_device_ids=local_device_ids)
+
+
+def clear_rendezvous(group_name: str = "train") -> None:
+    from ray_tpu.core.worker import global_worker
+
+    global_worker()._require_backend().kv_del(_kv_key(group_name))

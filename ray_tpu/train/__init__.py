@@ -1,0 +1,25 @@
+"""Distributed training orchestration.
+
+Reference analog: ``python/ray/train/`` — ``DataParallelTrainer`` +
+``BackendExecutor`` + ``WorkerGroup`` + ``_TrainSession``
+(SURVEY.md §2.3, §3.4). TPU-native redesign: the gradient path is never a
+runtime service — each worker runs a jit-compiled step whose collectives are
+XLA ops over the gang's mesh; the Train layer only places the gang (slice
+placement group), bootstraps ``jax.distributed``, moves reported metrics and
+checkpoints, and restarts the gang on failure.
+"""
+
+from ray_tpu.train.config import (  # noqa: F401
+    CheckpointConfig,
+    FailureConfig,
+    RunConfig,
+    ScalingConfig,
+)
+from ray_tpu.train.checkpoint import Checkpoint  # noqa: F401
+from ray_tpu.train.session import (  # noqa: F401
+    get_checkpoint,
+    get_context,
+    get_dataset_shard,
+    report,
+)
+from ray_tpu.train.trainer import JaxTrainer, Result  # noqa: F401

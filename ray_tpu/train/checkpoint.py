@@ -1,0 +1,129 @@
+"""Checkpoints: directory-backed, with orbax pytree helpers.
+
+Reference analog: ``ray.train.Checkpoint`` (``train/_checkpoint.py``) — a
+handle to a directory — plus ``CheckpointManager``
+(``_internal/checkpoint_manager.py``, top-k retention). TPU-native: pytree
+state saves through orbax (async-capable, works with sharded jax.Array);
+plain files work too.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import tempfile
+from typing import Any, Dict, List, Optional
+
+
+class Checkpoint:
+    """A handle to a checkpoint directory."""
+
+    def __init__(self, path: str):
+        self.path = os.path.abspath(path)
+
+    @classmethod
+    def from_directory(cls, path: str) -> "Checkpoint":
+        return cls(path)
+
+    @classmethod
+    def from_dict(cls, data: Dict[str, Any]) -> "Checkpoint":
+        """Small state dicts — serialized as a single file."""
+        import cloudpickle
+
+        d = tempfile.mkdtemp(prefix="rt_ckpt_")
+        with open(os.path.join(d, "_dict_checkpoint.pkl"), "wb") as f:
+            cloudpickle.dump(data, f)
+        return cls(d)
+
+    def to_dict(self) -> Dict[str, Any]:
+        import cloudpickle
+
+        with open(os.path.join(self.path, "_dict_checkpoint.pkl"), "rb") as f:
+            return cloudpickle.load(f)
+
+    def as_directory(self) -> str:
+        return self.path
+
+    # ---- pytree state (orbax) ----------------------------------------------
+    def save_pytree(self, tree: Any, name: str = "state") -> None:
+        import orbax.checkpoint as ocp
+
+        path = os.path.join(self.path, name)
+        shutil.rmtree(path, ignore_errors=True)
+        with ocp.StandardCheckpointer() as ckptr:
+            ckptr.save(path, tree)
+
+    def load_pytree(self, name: str = "state", abstract_tree: Any = None) -> Any:
+        import orbax.checkpoint as ocp
+
+        path = os.path.join(self.path, name)
+        with ocp.StandardCheckpointer() as ckptr:
+            return ckptr.restore(path, abstract_tree) if abstract_tree is not None \
+                else ckptr.restore(path)
+
+    def __reduce__(self):
+        return (Checkpoint, (self.path,))
+
+    def __repr__(self):
+        return f"Checkpoint({self.path})"
+
+
+class CheckpointManager:
+    """Top-k retention by score (reference: ``_internal/checkpoint_manager.py``)."""
+
+    def __init__(self, run_dir: str, num_to_keep: Optional[int] = None,
+                 score_attribute: Optional[str] = None, score_order: str = "max"):
+        self.run_dir = run_dir
+        self.num_to_keep = num_to_keep
+        self.score_attribute = score_attribute
+        self.score_order = score_order
+        self._entries: List[Dict] = []
+        self._counter = 0
+        os.makedirs(run_dir, exist_ok=True)
+
+    def register(self, checkpoint: Checkpoint, metrics: Dict[str, Any]) -> Checkpoint:
+        """Move the checkpoint under the run dir and apply retention."""
+        dest = os.path.join(self.run_dir, f"checkpoint_{self._counter:06d}")
+        self._counter += 1
+        if checkpoint.path != dest:
+            shutil.move(checkpoint.path, dest)
+        entry = {"path": dest, "metrics": dict(metrics)}
+        self._entries.append(entry)
+        with open(os.path.join(dest, "_metrics.json"), "w") as f:
+            json.dump(entry["metrics"], f, default=str)
+        self._apply_retention()
+        return Checkpoint(dest)
+
+    def _score(self, entry: Dict) -> float:
+        v = entry["metrics"].get(self.score_attribute, 0.0)
+        try:
+            v = float(v)
+        except (TypeError, ValueError):
+            v = 0.0
+        return v if self.score_order == "max" else -v
+
+    def _apply_retention(self) -> None:
+        if self.num_to_keep is None or len(self._entries) <= self.num_to_keep:
+            return
+        if self.score_attribute:
+            ranked = sorted(self._entries, key=self._score, reverse=True)
+        else:
+            ranked = list(reversed(self._entries))  # keep most recent
+        for entry in ranked[self.num_to_keep:]:
+            shutil.rmtree(entry["path"], ignore_errors=True)
+            self._entries.remove(entry)
+
+    @property
+    def best_checkpoint(self) -> Optional[Checkpoint]:
+        if not self._entries:
+            return None
+        if self.score_attribute:
+            entry = max(self._entries, key=self._score)
+        else:
+            entry = self._entries[-1]
+        return Checkpoint(entry["path"])
+
+    @property
+    def latest_checkpoint(self) -> Optional[Checkpoint]:
+        return Checkpoint(self._entries[-1]["path"]) if self._entries else None

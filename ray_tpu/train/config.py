@@ -1,0 +1,71 @@
+"""Train-layer configuration objects.
+
+Reference analog: ``ray.air.config`` (``ScalingConfig air/config.py:94``,
+``FailureConfig :523``, ``CheckpointConfig :574``, ``RunConfig :723``).
+ScalingConfig speaks TPU natively: workers × chips-per-worker, with an
+optional topology string ("v5p-16") that implies the gang shape.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Dict, Optional
+
+from ray_tpu.core.resources import CPU, TPU
+
+
+@dataclasses.dataclass
+class ScalingConfig:
+    num_workers: int = 1
+    tpu_chips_per_worker: int = 0
+    cpus_per_worker: float = 1
+    resources_per_worker: Optional[Dict[str, float]] = None
+    topology: Optional[str] = None      # e.g. "v5p-16": 16 chips, 4/host
+    placement_strategy: str = "PACK"    # SPREAD across hosts for slices
+
+    def __post_init__(self):
+        if self.topology:
+            m = re.match(r"v\d+[a-z]*-(\d+)$", self.topology)
+            if not m:
+                raise ValueError(
+                    f"topology {self.topology!r} not understood; expected "
+                    f"like 'v5p-16'")
+            total_chips = int(m.group(1))
+            from ray_tpu._private.config import get_config
+
+            per_host = get_config().tpu_chips_per_host
+            self.num_workers = max(1, total_chips // per_host)
+            self.tpu_chips_per_worker = min(total_chips, per_host)
+            self.placement_strategy = "STRICT_SPREAD" if self.num_workers > 1 else "PACK"
+
+    def bundle(self) -> Dict[str, float]:
+        b: Dict[str, float] = {CPU: self.cpus_per_worker}
+        if self.tpu_chips_per_worker:
+            b[TPU] = float(self.tpu_chips_per_worker)
+        b.update(self.resources_per_worker or {})
+        return b
+
+    @property
+    def use_tpu(self) -> bool:
+        return self.tpu_chips_per_worker > 0
+
+
+@dataclasses.dataclass
+class FailureConfig:
+    max_failures: int = 0  # gang restarts from last checkpoint
+
+
+@dataclasses.dataclass
+class CheckpointConfig:
+    num_to_keep: Optional[int] = None
+    checkpoint_score_attribute: Optional[str] = None
+    checkpoint_score_order: str = "max"
+
+
+@dataclasses.dataclass
+class RunConfig:
+    name: Optional[str] = None
+    storage_path: Optional[str] = None  # default: /tmp/ray_tpu_results
+    failure_config: FailureConfig = dataclasses.field(default_factory=FailureConfig)
+    checkpoint_config: CheckpointConfig = dataclasses.field(default_factory=CheckpointConfig)

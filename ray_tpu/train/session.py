@@ -1,0 +1,106 @@
+"""The per-worker training session.
+
+Reference analog: ``_TrainSession`` (``train/_internal/session.py:132`` —
+``report :612/:844``, ``get_checkpoint :902``, ``get_dataset_shard :1208``).
+``report`` enqueues (metrics, checkpoint) into a bounded queue the driver
+drains — backpressure keeps a fast training loop from outrunning a slow
+driver, the same contract as the reference's result queue
+(``trainable/function_trainable.py:199-264``).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Dict, Optional
+
+from ray_tpu.train.checkpoint import Checkpoint
+
+
+class TrainContext:
+    def __init__(self, world_rank: int, world_size: int,
+                 local_rank: int = 0, node_rank: int = 0,
+                 experiment_name: str = ""):
+        self.world_rank = world_rank
+        self.world_size = world_size
+        self.local_rank = local_rank
+        self.node_rank = node_rank
+        self.experiment_name = experiment_name
+
+    def get_world_rank(self) -> int:
+        return self.world_rank
+
+    def get_world_size(self) -> int:
+        return self.world_size
+
+    def get_local_rank(self) -> int:
+        return self.local_rank
+
+
+class TrainSession:
+    def __init__(self, context: TrainContext,
+                 checkpoint: Optional[Checkpoint] = None,
+                 dataset_shards: Optional[Dict[str, Any]] = None,
+                 queue_size: int = 2):
+        self.context = context
+        self.loaded_checkpoint = checkpoint
+        self.dataset_shards = dataset_shards or {}
+        self.results: "queue.Queue" = queue.Queue(maxsize=queue_size)
+        self.finished = threading.Event()
+        self.error: Optional[BaseException] = None
+
+    def report(self, metrics: Dict[str, Any],
+               checkpoint: Optional[Checkpoint] = None) -> None:
+        self.results.put({"type": "report", "metrics": dict(metrics),
+                          "checkpoint": checkpoint})
+
+    def finish(self, error: Optional[BaseException] = None) -> None:
+        self.error = error
+        self.finished.set()
+        self.results.put({"type": "error", "error": error} if error
+                         else {"type": "done"})
+
+
+_session_lock = threading.Lock()
+_session: Optional[TrainSession] = None
+
+
+def init_session(session: TrainSession) -> None:
+    global _session
+    with _session_lock:
+        _session = session
+
+
+def clear_session() -> None:
+    global _session
+    with _session_lock:
+        _session = None
+
+
+def get_session() -> TrainSession:
+    if _session is None:
+        raise RuntimeError(
+            "No train session: this API must be called inside a "
+            "train_loop_per_worker launched by a Trainer")
+    return _session
+
+
+# ---- public per-worker API -------------------------------------------------
+
+def report(metrics: Dict[str, Any], checkpoint: Optional[Checkpoint] = None) -> None:
+    get_session().report(metrics, checkpoint)
+
+
+def get_checkpoint() -> Optional[Checkpoint]:
+    return get_session().loaded_checkpoint
+
+
+def get_context() -> TrainContext:
+    return get_session().context
+
+
+def get_dataset_shard(name: str = "train"):
+    shard = get_session().dataset_shards.get(name)
+    if shard is None:
+        raise KeyError(f"no dataset shard named {name!r}")
+    return shard

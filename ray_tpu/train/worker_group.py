@@ -1,0 +1,144 @@
+"""The gang of training worker actors.
+
+Reference analog: ``WorkerGroup`` (``train/_internal/worker_group.py:101``)
+of ``RayTrainWorker`` actors + the gang placement logic of
+``BackendExecutor._create_placement_group`` (``backend_executor.py:166``).
+Workers are placed one-per-bundle in a placement group shaped by the
+ScalingConfig (a slice group for multi-host TPU gangs).
+"""
+
+from __future__ import annotations
+
+import threading
+import traceback
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.train import session as session_mod
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.config import ScalingConfig
+from ray_tpu.train.session import TrainContext, TrainSession
+from ray_tpu.util.placement_group import (
+    PlacementGroupSchedulingStrategy,
+    placement_group,
+    remove_placement_group,
+)
+
+
+class TrainWorker:
+    """Actor hosting one rank of the training gang."""
+
+    def __init__(self, rank: int, world_size: int, experiment_name: str):
+        self.rank = rank
+        self.world_size = world_size
+        self.experiment_name = experiment_name
+        self.session: Optional[TrainSession] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def ping(self) -> int:
+        return self.rank
+
+    def bootstrap_jax_distributed(self, group_name: str) -> None:
+        from ray_tpu.collective import bootstrap_jax_distributed
+
+        bootstrap_jax_distributed(self.world_size, self.rank, group_name)
+
+    def start(self, train_fn: Callable, config: Dict[str, Any],
+              checkpoint: Optional[Checkpoint],
+              dataset_shards: Optional[Dict[str, Any]]) -> None:
+        ctx = TrainContext(self.rank, self.world_size,
+                           experiment_name=self.experiment_name)
+        self.session = TrainSession(ctx, checkpoint=checkpoint,
+                                    dataset_shards=dataset_shards)
+        session_mod.init_session(self.session)
+
+        def run():
+            try:
+                if _takes_config(train_fn):
+                    train_fn(config)
+                else:
+                    train_fn()
+                self.session.finish()
+            except BaseException as e:  # noqa: BLE001
+                traceback.print_exc()
+                self.session.finish(error=e)
+
+        self._thread = threading.Thread(target=run, daemon=True,
+                                        name=f"rt-train-rank{self.rank}")
+        self._thread.start()
+
+    def next_result(self) -> Dict[str, Any]:
+        """Blocks until the worker reports, finishes, or errors."""
+        item = self.session.results.get()
+        if item["type"] == "error":
+            err = item["error"]
+            return {"type": "error", "message": repr(err),
+                    "traceback": "".join(traceback.format_exception(
+                        type(err), err, err.__traceback__))}
+        return item
+
+    def shutdown(self) -> None:
+        session_mod.clear_session()
+
+
+def _takes_config(fn: Callable) -> bool:
+    import inspect
+
+    try:
+        return len(inspect.signature(fn).parameters) >= 1
+    except (TypeError, ValueError):
+        return True
+
+
+class WorkerGroup:
+    def __init__(self, scaling: ScalingConfig, experiment_name: str):
+        self.scaling = scaling
+        self.experiment_name = experiment_name
+        self.pg = None
+        self.workers: List = []
+
+    def start(self) -> None:
+        n = self.scaling.num_workers
+        self.pg = placement_group([self.scaling.bundle() for _ in range(n)],
+                                  strategy=self.scaling.placement_strategy)
+        if not self.pg.wait(timeout=300):
+            remove_placement_group(self.pg)
+            raise TimeoutError(
+                f"could not reserve {n} x {self.scaling.bundle()} "
+                f"(placement group timed out)")
+        try:
+            actor_cls = ray_tpu.remote(TrainWorker)
+            bundle = self.scaling.bundle()
+            self.workers = [
+                actor_cls.options(
+                    num_cpus=bundle.get("CPU", 1),
+                    num_tpus=bundle.get("TPU", 0) or None,
+                    scheduling_strategy=PlacementGroupSchedulingStrategy(self.pg, i),
+                ).remote(i, n, self.experiment_name)
+                for i in range(n)
+            ]
+            ray_tpu.get([w.ping.remote() for w in self.workers], timeout=300)
+        except BaseException:
+            # Don't leak the gang's reservation on a failed start.
+            self.shutdown()
+            raise
+
+    def run_async(self, method: str, *args) -> List:
+        return [getattr(w, method).remote(*args) for w in self.workers]
+
+    def run(self, method: str, *args, timeout: Optional[float] = None) -> List:
+        return ray_tpu.get(self.run_async(method, *args), timeout=timeout)
+
+    def shutdown(self) -> None:
+        for w in self.workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:
+                pass
+        if self.pg is not None:
+            try:
+                remove_placement_group(self.pg)
+            except Exception:
+                pass
+        self.workers = []
+        self.pg = None

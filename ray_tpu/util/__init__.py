@@ -1,0 +1,2 @@
+"""User-facing utilities (placement groups, actor pools, queues, scheduling
+strategies) — the ``ray.util`` surface."""

@@ -1,0 +1,131 @@
+"""Placement group tests: 2PC reservation, strategies, slice groups."""
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster.cluster_utils import Cluster
+from ray_tpu.util.placement_group import (
+    PlacementGroupSchedulingStrategy,
+    placement_group,
+    placement_group_table,
+    remove_placement_group,
+    slice_group,
+)
+
+
+@pytest.fixture
+def cluster():
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    c = Cluster(initialize_head=True, head_node_args={"num_cpus": 2})
+    yield c
+    c.shutdown()
+
+
+def test_pg_basic_reservation(cluster):
+    import time
+
+    cluster.add_node(num_cpus=4)
+    cluster.connect_driver()
+    pg = placement_group([{"CPU": 2}, {"CPU": 2}], strategy="PACK")
+    assert pg.wait(timeout=30)
+    time.sleep(1.5)  # GCS availability view refreshes on heartbeat
+    assert ray_tpu.available_resources()["CPU"] == 2.0
+    remove_placement_group(pg)
+    time.sleep(1.5)
+    assert ray_tpu.available_resources()["CPU"] == 6.0
+
+
+def test_pg_strict_spread_needs_distinct_nodes(cluster):
+    cluster.connect_driver()
+    pg = placement_group([{"CPU": 1}, {"CPU": 1}], strategy="STRICT_SPREAD")
+    # Only one node — cannot be satisfied.
+    assert not pg.wait(timeout=1.5)
+    cluster.add_node(num_cpus=2)
+    assert pg.wait(timeout=30)
+    table = placement_group_table()
+    entry = next(e for e in table if e["pg_id"] == pg.id.hex())
+    assert len(set(entry["bundle_nodes"])) == 2
+
+
+def test_pg_strict_pack_one_node(cluster):
+    cluster.add_node(num_cpus=8)
+    cluster.connect_driver()
+    pg = placement_group([{"CPU": 3}, {"CPU": 3}], strategy="STRICT_PACK")
+    assert pg.wait(timeout=30)
+    entry = next(e for e in placement_group_table()
+                 if e["pg_id"] == pg.id.hex())
+    assert len(set(entry["bundle_nodes"])) == 1
+
+
+def test_pg_task_runs_in_bundle(cluster):
+    target = cluster.add_node(num_cpus=4, num_tpus=4)
+    cluster.connect_driver()
+    pg = placement_group([{"CPU": 1, "TPU": 2}], strategy="PACK")
+    assert pg.wait(timeout=30)
+
+    @ray_tpu.remote(num_cpus=1, num_tpus=2)
+    def where():
+        ctx = ray_tpu.get_runtime_context()
+        return ctx.get_node_id(), ctx.get_tpu_ids()
+
+    strategy = PlacementGroupSchedulingStrategy(pg, 0)
+    node_id, chips = ray_tpu.get(
+        where.options(scheduling_strategy=strategy).remote(), timeout=60)
+    assert node_id == target.node_id
+    assert len(chips) == 2
+
+
+def test_pg_actor_in_bundle(cluster):
+    target = cluster.add_node(num_cpus=4)
+    cluster.connect_driver()
+    pg = placement_group([{"CPU": 3}], strategy="PACK")  # only fits `target`
+    assert pg.wait(timeout=30)
+
+    @ray_tpu.remote(num_cpus=1)
+    class Pinned:
+        def where(self):
+            return ray_tpu.get_runtime_context().get_node_id()
+
+    a = Pinned.options(placement_group=pg,
+                       placement_group_bundle_index=0).remote()
+    assert ray_tpu.get(a.where.remote(), timeout=60) == target.node_id
+
+
+def test_pg_gang_atomicity(cluster):
+    """Two PGs each wanting 3 of 4 CPUs: exactly one is created, no deadlock
+    from partial reservations (the point of the 2PC)."""
+    cluster.add_node(num_cpus=2)  # total 4 CPUs over 2 nodes
+    cluster.connect_driver()
+    pg1 = placement_group([{"CPU": 1.5}, {"CPU": 1.5}], strategy="SPREAD")
+    pg2 = placement_group([{"CPU": 1.5}, {"CPU": 1.5}], strategy="SPREAD")
+    ready1 = pg1.wait(timeout=5)
+    ready2 = pg2.wait(timeout=2)
+    assert ready1 != ready2 or not (ready1 and ready2)
+    if ready1:
+        remove_placement_group(pg1)
+    if ready2:
+        remove_placement_group(pg2)
+    import time
+
+    time.sleep(1.0)
+    # After removal the other can complete.
+
+
+def test_slice_group_shape(cluster):
+    for _ in range(2):
+        cluster.add_node(num_cpus=2, num_tpus=4)
+    cluster.connect_driver()
+    pg = slice_group(num_hosts=2, chips_per_host=4, cpus_per_host=1)
+    assert pg.wait(timeout=30)
+    entry = next(e for e in placement_group_table()
+                 if e["pg_id"] == pg.id.hex())
+    assert len(set(entry["bundle_nodes"])) == 2  # one bundle per host
+    assert all(b["TPU"] == 4 for b in entry["bundles"])
+
+
+def test_pg_validation():
+    with pytest.raises(ValueError):
+        placement_group([], strategy="PACK")
+    with pytest.raises(ValueError):
+        placement_group([{"CPU": 1}], strategy="DIAGONAL")

@@ -1,0 +1,153 @@
+"""Train-layer tests: gang orchestration, reporting, checkpointing, restart,
+and the MNIST-MLP-style data-parallel config (BASELINE.md config 2) with
+host-collective gradient sync across real worker processes.
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.train import (
+    Checkpoint,
+    FailureConfig,
+    JaxTrainer,
+    RunConfig,
+    ScalingConfig,
+)
+
+
+def test_single_worker_report_flow(rt_cluster, tmp_path):
+    def loop(config):
+        from ray_tpu import train
+
+        ctx = train.get_context()
+        for step in range(3):
+            train.report({"step": step, "rank": ctx.get_world_rank(),
+                          "lr": config["lr"]})
+
+    result = JaxTrainer(
+        loop, train_loop_config={"lr": 0.1},
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(name="t1", storage_path=str(tmp_path))).fit()
+    assert result.error is None
+    assert result.metrics["step"] == 2
+    assert result.metrics["lr"] == 0.1
+    assert len(result.metrics_history) == 3
+
+
+def test_multi_worker_ranks_and_world(rt_cluster, tmp_path):
+    def loop(config):
+        from ray_tpu import train
+
+        ctx = train.get_context()
+        train.report({"rank": ctx.get_world_rank(),
+                      "world": ctx.get_world_size()})
+
+    result = JaxTrainer(
+        loop, scaling_config=ScalingConfig(num_workers=3, cpus_per_worker=1),
+        run_config=RunConfig(name="t2", storage_path=str(tmp_path))).fit()
+    assert result.metrics["world"] == 3
+    assert result.metrics["rank"] == 0  # driver keeps rank-0 metrics
+
+
+def test_checkpoint_save_and_resume(rt_cluster, tmp_path):
+    def loop(config):
+        from ray_tpu import train
+
+        start = 0
+        ckpt = train.get_checkpoint()
+        if ckpt is not None:
+            start = ckpt.to_dict()["step"] + 1
+        for step in range(start, start + 2):
+            train.report({"step": step},
+                         checkpoint=Checkpoint.from_dict({"step": step}))
+
+    run_cfg = RunConfig(name="t3", storage_path=str(tmp_path))
+    r1 = JaxTrainer(loop, scaling_config=ScalingConfig(num_workers=1),
+                    run_config=run_cfg).fit()
+    assert r1.metrics["step"] == 1
+    r2 = JaxTrainer(loop, scaling_config=ScalingConfig(num_workers=1),
+                    run_config=RunConfig(name="t3b", storage_path=str(tmp_path)),
+                    resume_from_checkpoint=r1.checkpoint).fit()
+    assert r2.metrics["step"] == 3  # resumed from step 1
+
+
+def test_failure_restart_from_checkpoint(rt_cluster, tmp_path):
+    def loop(config):
+        from ray_tpu import train
+
+        ckpt = train.get_checkpoint()
+        start = ckpt.to_dict()["step"] + 1 if ckpt else 0
+        for step in range(start, 4):
+            if step == 2 and ckpt is None:
+                raise RuntimeError("injected failure at step 2")
+            train.report({"step": step},
+                         checkpoint=Checkpoint.from_dict({"step": step}))
+
+    result = JaxTrainer(
+        loop, scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(name="t4", storage_path=str(tmp_path),
+                             failure_config=FailureConfig(max_failures=1))).fit()
+    assert result.error is None
+    assert result.metrics["step"] == 3  # resumed at 2 after failing
+
+
+def test_failure_without_budget_raises(rt_cluster, tmp_path):
+    def loop(config):
+        raise ValueError("always fails")
+
+    from ray_tpu.train.trainer import TrainingFailedError
+
+    with pytest.raises(TrainingFailedError, match="always fails"):
+        JaxTrainer(loop, scaling_config=ScalingConfig(num_workers=1),
+                   run_config=RunConfig(name="t5", storage_path=str(tmp_path))).fit()
+
+
+def test_dataset_sharding_lists(rt_cluster, tmp_path):
+    def loop(config):
+        from ray_tpu import train
+
+        shard = train.get_dataset_shard("train")
+        train.report({"shard": list(shard)})
+
+    data = list(range(10))
+    result = JaxTrainer(
+        loop, scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(name="t6", storage_path=str(tmp_path)),
+        datasets={"train": data}).fit()
+    assert result.metrics["shard"] == data[0::2]  # rank 0's slice
+
+
+def test_data_parallel_mlp_with_psum_grads(rt_cluster, tmp_path):
+    """BASELINE config 2 shape: MLP, 2 workers, gradient all-reduce each
+    step (host-plane collectives between real processes), loss decreases and
+    replicas stay in sync."""
+    def loop(config):
+        import numpy as np
+
+        from ray_tpu import collective as col
+        from ray_tpu import train
+
+        ctx = train.get_context()
+        rank, world = ctx.get_world_rank(), ctx.get_world_size()
+        col.init_collective_group(world, rank, "mlp")
+
+        rng = np.random.RandomState(0)
+        w = rng.randn(4, 1) * 0.1          # same init on all ranks
+        data_rng = np.random.RandomState(rank)
+        losses = []
+        for step in range(8):
+            x = data_rng.randn(16, 4)
+            y = x @ np.array([[1.0], [-2.0], [0.5], [3.0]])
+            pred = x @ w
+            grad = 2 * x.T @ (pred - y) / len(x)
+            grad = col.allreduce(grad, "mlp") / world
+            w -= 0.05 * grad
+            losses.append(float(((pred - y) ** 2).mean()))
+        train.report({"first_loss": losses[0], "last_loss": losses[-1],
+                      "w_checksum": float(np.sum(w))})
+
+    result = JaxTrainer(
+        loop, scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(name="mlp", storage_path=str(tmp_path))).fit()
+    assert result.metrics["last_loss"] < result.metrics["first_loss"] * 0.5
