@@ -1,0 +1,26 @@
+"""Durable workflows: run a task DAG with per-task checkpoints and resume.
+
+Capability parity with the reference's ``python/ray/workflow/`` (``workflow.run
+:120`` / ``run_async :174`` in ``workflow/api.py``; per-task durable
+checkpoints in ``workflow_storage.py``; ``WorkflowExecutor`` in
+``workflow_executor.py``). Each DAG node's result is checkpointed to storage
+as it completes; ``resume()`` re-executes only the nodes whose checkpoints are
+missing, so a crashed workflow continues where it left off.
+"""
+
+from ray_tpu.workflow.api import (  # noqa: F401
+    WorkflowStatus,
+    cancel,
+    get_output,
+    get_status,
+    init,
+    list_all,
+    resume,
+    run,
+    run_async,
+)
+
+__all__ = [
+    "init", "run", "run_async", "resume", "cancel", "get_status",
+    "get_output", "list_all", "WorkflowStatus",
+]

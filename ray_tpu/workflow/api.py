@@ -1,0 +1,305 @@
+"""Workflow execution engine and storage.
+
+Reference parity: ``python/ray/workflow/workflow_executor.py:32``
+(``WorkflowExecutor``), ``workflow_storage.py`` (durable task results),
+``workflow_access.py:88`` (management actor — here a module-level registry
+since workflows are driver-scoped). Node keys are deterministic (function
+name + topological position) so a resumed run maps checkpoints back onto the
+same DAG.
+"""
+
+from __future__ import annotations
+
+import enum
+import json
+import os
+
+import cloudpickle as pickle
+import tempfile
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.dag.dag_node import (
+    ClassMethodNode,
+    ClassNode,
+    DAGNode,
+    FunctionNode,
+    InputAttributeNode,
+    InputNode,
+    _InputValue,
+    _resolve,
+)
+
+
+class WorkflowStatus(str, enum.Enum):
+    RUNNING = "RUNNING"
+    SUCCESSFUL = "SUCCESSFUL"
+    FAILED = "FAILED"
+    RESUMABLE = "RESUMABLE"
+    CANCELED = "CANCELED"
+
+
+_storage_dir: Optional[str] = None
+_running: Dict[str, threading.Thread] = {}
+_cancel_flags: Dict[str, threading.Event] = {}
+_lock = threading.Lock()
+
+
+def init(storage: Optional[str] = None) -> None:
+    """Set the durable storage root (default: a per-user tmp dir)."""
+    global _storage_dir
+    _storage_dir = storage or os.path.join(
+        tempfile.gettempdir(), "ray_tpu_workflows")
+    os.makedirs(_storage_dir, exist_ok=True)
+
+
+def _root() -> str:
+    if _storage_dir is None:
+        init()
+    return _storage_dir  # type: ignore[return-value]
+
+
+def _wf_dir(workflow_id: str) -> str:
+    d = os.path.join(_root(), workflow_id)
+    os.makedirs(os.path.join(d, "tasks"), exist_ok=True)
+    return d
+
+
+def _write_status(workflow_id: str, status: WorkflowStatus,
+                  error: Optional[str] = None) -> None:
+    with open(os.path.join(_wf_dir(workflow_id), "status.json"), "w") as f:
+        json.dump({"status": status.value, "error": error,
+                   "updated_at": time.time()}, f)
+
+
+def _read_status(workflow_id: str) -> Dict[str, Any]:
+    path = os.path.join(_root(), workflow_id, "status.json")
+    if not os.path.exists(path):
+        raise ValueError(f"no workflow with id {workflow_id!r}")
+    with open(path) as f:
+        return json.load(f)
+
+
+def _node_keys(dag: DAGNode) -> Dict[int, str]:
+    """Deterministic per-node checkpoint keys: depth-first traversal order +
+    callable name. Stable across runs of the same DAG-building code."""
+    keys: Dict[int, str] = {}
+    counter = [0]
+
+    def walk(n: DAGNode):
+        if id(n) in keys:
+            return
+        for c in n._children():
+            walk(c)
+        if isinstance(n, FunctionNode):
+            name = n._remote_fn.underlying_function.__name__
+        elif isinstance(n, ClassMethodNode):
+            name = n._method_name
+        elif isinstance(n, ClassNode):
+            name = n._actor_cls.underlying_class.__name__
+        else:
+            name = type(n).__name__
+        keys[id(n)] = f"{counter[0]:04d}_{name}"
+        counter[0] += 1
+
+    walk(dag)
+    return keys
+
+
+def _save_dag(workflow_id: str, dag: DAGNode, args: tuple, kwargs: dict) -> None:
+    with open(os.path.join(_wf_dir(workflow_id), "dag.pkl"), "wb") as f:
+        pickle.dump({"dag": dag, "args": args, "kwargs": kwargs}, f)
+
+
+def _load_dag(workflow_id: str):
+    with open(os.path.join(_root(), workflow_id, "dag.pkl"), "rb") as f:
+        return pickle.load(f)
+
+
+class _DurableExecutor:
+    """Executes a DAG bottom-up, checkpointing each task's result."""
+
+    def __init__(self, workflow_id: str, dag: DAGNode, input_val: _InputValue,
+                 cancel_flag: threading.Event):
+        self.workflow_id = workflow_id
+        self.dag = dag
+        self.input_val = input_val
+        self.keys = _node_keys(dag)
+        self.tasks_dir = os.path.join(_wf_dir(workflow_id), "tasks")
+        self.cancel_flag = cancel_flag
+        self._cache: Dict[int, Any] = {}
+
+    def _ckpt_path(self, node: DAGNode) -> str:
+        return os.path.join(self.tasks_dir, self.keys[id(node)] + ".pkl")
+
+    def run(self) -> Any:
+        return self._exec(self.dag)
+
+    def _exec(self, node: DAGNode) -> Any:
+        if id(node) in self._cache:
+            return self._cache[id(node)]
+        if self.cancel_flag.is_set():
+            raise _Canceled()
+        # Input nodes are re-evaluated, never checkpointed.
+        if isinstance(node, (InputNode, InputAttributeNode)):
+            val = node._execute_impl((), {}, self.input_val)
+            self._cache[id(node)] = val
+            return val
+        path = self._ckpt_path(node)
+        if os.path.exists(path) and not isinstance(node, ClassNode):
+            with open(path, "rb") as f:
+                val = pickle.load(f)
+            self._cache[id(node)] = val
+            return val
+        args = _resolve_with(self, node._bound_args)
+        kwargs = _resolve_with(self, node._bound_kwargs)
+        if isinstance(node, ClassNode):
+            # Actors are live state, not checkpointable: re-create on resume.
+            val = node._execute_impl(args, kwargs, self.input_val)
+        elif isinstance(node, ClassMethodNode):
+            handle = (self._exec(node._class_node)
+                      if isinstance(node._class_node, DAGNode)
+                      else node._class_node)
+            from ray_tpu.core.worker import global_worker
+
+            ref = getattr(handle, node._method_name).remote(*args, **kwargs)
+            val = global_worker().get(ref)
+            self._checkpoint(path, val)
+        elif isinstance(node, FunctionNode):
+            from ray_tpu.core.worker import global_worker
+
+            ref = node._execute_impl(args, kwargs, self.input_val)
+            val = global_worker().get(ref)
+            self._checkpoint(path, val)
+        else:
+            val = node._execute_impl(args, kwargs, self.input_val)
+        self._cache[id(node)] = val
+        return val
+
+    def _checkpoint(self, path: str, val: Any) -> None:
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump(val, f)
+        os.replace(tmp, path)  # atomic: a partial write never reads as done
+
+
+def _resolve_with(ex: _DurableExecutor, value):
+    if isinstance(value, DAGNode):
+        return ex._exec(value)
+    if isinstance(value, tuple):
+        return tuple(_resolve_with(ex, v) for v in value)
+    if isinstance(value, list):
+        return [_resolve_with(ex, v) for v in value]
+    if isinstance(value, dict):
+        return {k: _resolve_with(ex, v) for k, v in value.items()}
+    return value
+
+
+class _Canceled(Exception):
+    pass
+
+
+def run(dag: DAGNode, *args, workflow_id: Optional[str] = None, **kwargs) -> Any:
+    """Execute the DAG durably, blocking until the final result."""
+    return _run_impl(dag, args, kwargs, workflow_id, wait=True)
+
+
+def run_async(dag: DAGNode, *args, workflow_id: Optional[str] = None, **kwargs):
+    """Start the workflow in the background; returns the workflow_id."""
+    return _run_impl(dag, args, kwargs, workflow_id, wait=False)
+
+
+def _run_impl(dag: DAGNode, args: tuple, kwargs: dict,
+              workflow_id: Optional[str], wait: bool):
+    if workflow_id is None:
+        workflow_id = f"workflow-{int(time.time() * 1e6):x}"
+    _save_dag(workflow_id, dag, args, kwargs)
+    _write_status(workflow_id, WorkflowStatus.RUNNING)
+    cancel_flag = threading.Event()
+    with _lock:
+        _cancel_flags[workflow_id] = cancel_flag
+
+    def body():
+        ex = _DurableExecutor(workflow_id, dag, _InputValue(args, kwargs),
+                              cancel_flag)
+        try:
+            result = ex.run()
+        except _Canceled:
+            _write_status(workflow_id, WorkflowStatus.CANCELED)
+            raise
+        except BaseException as e:  # noqa: BLE001 — recorded then re-raised
+            _write_status(workflow_id, WorkflowStatus.RESUMABLE, error=repr(e))
+            raise
+        with open(os.path.join(_wf_dir(workflow_id), "output.pkl"), "wb") as f:
+            pickle.dump(result, f)
+        _write_status(workflow_id, WorkflowStatus.SUCCESSFUL)
+        return result
+
+    if wait:
+        return body()
+    t = threading.Thread(target=_suppress(body), daemon=True,
+                         name=f"workflow-{workflow_id}")
+    with _lock:
+        _running[workflow_id] = t
+    t.start()
+    return workflow_id
+
+
+def _suppress(fn):
+    def inner():
+        try:
+            fn()
+        except BaseException:  # noqa: BLE001 — status already recorded
+            pass
+
+    return inner
+
+
+def resume(workflow_id: str) -> Any:
+    """Re-run a RESUMABLE/CANCELED workflow; completed tasks load from
+    checkpoints instead of re-executing."""
+    saved = _load_dag(workflow_id)
+    return _run_impl(saved["dag"], saved["args"], saved["kwargs"],
+                     workflow_id, wait=True)
+
+
+def cancel(workflow_id: str) -> None:
+    with _lock:
+        flag = _cancel_flags.get(workflow_id)
+    if flag is not None:
+        flag.set()
+    _write_status(workflow_id, WorkflowStatus.CANCELED)
+
+
+def get_status(workflow_id: str) -> WorkflowStatus:
+    return WorkflowStatus(_read_status(workflow_id)["status"])
+
+
+def get_output(workflow_id: str, *, timeout: Optional[float] = None) -> Any:
+    """Block until the workflow finishes, then return its result."""
+    deadline = None if timeout is None else time.monotonic() + timeout
+    while True:
+        st = get_status(workflow_id)
+        if st == WorkflowStatus.SUCCESSFUL:
+            with open(os.path.join(_root(), workflow_id, "output.pkl"), "rb") as f:
+                return pickle.load(f)
+        if st in (WorkflowStatus.FAILED, WorkflowStatus.RESUMABLE,
+                  WorkflowStatus.CANCELED):
+            err = _read_status(workflow_id).get("error")
+            raise RuntimeError(f"workflow {workflow_id} is {st.value}: {err}")
+        if deadline is not None and time.monotonic() > deadline:
+            raise TimeoutError(f"workflow {workflow_id} still {st.value}")
+        time.sleep(0.02)
+
+
+def list_all() -> List[Dict[str, Any]]:
+    out = []
+    root = _root()
+    for wid in sorted(os.listdir(root)):
+        try:
+            st = _read_status(wid)
+        except (ValueError, json.JSONDecodeError):
+            continue
+        out.append({"workflow_id": wid, **st})
+    return out

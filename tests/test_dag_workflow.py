@@ -1,0 +1,124 @@
+"""DAG composition + durable workflow execution."""
+
+import os
+import tempfile
+
+import pytest
+
+import ray_tpu
+from ray_tpu import remote
+from ray_tpu import workflow
+from ray_tpu.dag import InputNode
+
+
+@pytest.fixture()
+def local_rt():
+    ray_tpu.init(local_mode=True, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+@remote
+def _add(a, b):
+    return a + b
+
+
+@remote
+def _double(x):
+    return 2 * x
+
+
+class TestDag:
+    def test_function_dag(self, local_rt):
+        dag = _add.bind(_double.bind(3), _double.bind(4))
+        assert ray_tpu.get(dag.execute()) == 14
+
+    def test_input_node(self, local_rt):
+        with InputNode() as inp:
+            dag = _add.bind(_double.bind(inp), 1)
+        assert ray_tpu.get(dag.execute(10)) == 21
+        assert ray_tpu.get(dag.execute(0)) == 1
+
+    def test_diamond_shares_node(self, local_rt):
+        # The shared upstream node must execute once, not twice.
+        up = _double.bind(5)
+        dag = _add.bind(up, up)
+        assert ray_tpu.get(dag.execute()) == 20
+
+    def test_actor_dag(self, local_rt):
+        @remote
+        class Counter:
+            def __init__(self, start):
+                self.v = start
+
+            def add(self, x):
+                self.v += x
+                return self.v
+
+        node = Counter.bind(100)
+        dag = _add.bind(node.add.bind(1), 0)
+        assert ray_tpu.get(dag.execute()) == 101
+
+    def test_input_index(self, local_rt):
+        with InputNode() as inp:
+            dag = _add.bind(inp[0], inp[1])
+        assert ray_tpu.get(dag.execute(3, 4)) == 7
+
+
+_FAIL_MARKER = None
+
+
+@remote
+def _flaky(x):
+    # Fails while the marker file exists; succeeds after it is removed.
+    if _FAIL_MARKER and os.path.exists(_FAIL_MARKER):
+        raise RuntimeError("injected failure")
+    return x + 1
+
+
+@remote
+def _record(x, path):
+    # Append a line so the test can count executions across resume.
+    with open(path, "a") as f:
+        f.write("x\n")
+    return x * 10
+
+
+class TestWorkflow:
+    def test_run_and_status(self, local_rt, tmp_path):
+        workflow.init(str(tmp_path))
+        dag = _add.bind(_double.bind(6), 1)
+        assert workflow.run(dag, workflow_id="wf1") == 13
+        assert workflow.get_status("wf1") == workflow.WorkflowStatus.SUCCESSFUL
+        assert workflow.get_output("wf1") == 13
+
+    def test_run_async(self, local_rt, tmp_path):
+        workflow.init(str(tmp_path))
+        wid = workflow.run_async(_double.bind(21))
+        assert workflow.get_output(wid, timeout=30) == 42
+
+    def test_resume_skips_checkpointed(self, local_rt, tmp_path):
+        global _FAIL_MARKER
+        workflow.init(str(tmp_path))
+        marker = str(tmp_path / "fail_marker")
+        record_path = str(tmp_path / "record.txt")
+        open(marker, "w").close()
+        _FAIL_MARKER = marker
+
+        side = _record.bind(5, record_path)   # succeeds, checkpointed
+        dag = _add.bind(side, _flaky.bind(1))  # _flaky fails first run
+        with pytest.raises(ray_tpu.exceptions.TaskError):
+            workflow.run(dag, workflow_id="wf-resume")
+        assert workflow.get_status("wf-resume") == workflow.WorkflowStatus.RESUMABLE
+
+        os.remove(marker)
+        assert workflow.resume("wf-resume") == 52
+        # _record ran exactly once: its checkpoint was reused on resume.
+        with open(record_path) as f:
+            assert len(f.readlines()) == 1
+
+    def test_list_all(self, local_rt, tmp_path):
+        workflow.init(str(tmp_path))
+        workflow.run(_double.bind(1), workflow_id="wf-a")
+        entries = workflow.list_all()
+        assert any(e["workflow_id"] == "wf-a" for e in entries)
