@@ -155,11 +155,17 @@ class GcsServer:
         # (reference: GcsPlacementGroupManager PG rescheduling on node death).
         # Surviving bundles keep their reservations — actors/tasks inside
         # them are still running and hold chips from those reservations.
+        # PENDING groups are cleared too (a second death mid-reschedule must
+        # not leave the dead node's id pinned in bundle_nodes); their
+        # already-running _schedule_pg loop replans the now-missing slots.
         for pg in self.placement_groups.values():
-            if pg.state == PG_CREATED and entry.node_id in pg.bundle_nodes:
+            if pg.state == PG_REMOVED or entry.node_id not in pg.bundle_nodes:
+                continue
+            was_created = pg.state == PG_CREATED
+            pg.bundle_nodes = [None if nid == entry.node_id else nid
+                               for nid in pg.bundle_nodes]
+            if was_created:
                 pg.state = PG_PENDING
-                pg.bundle_nodes = [None if nid == entry.node_id else nid
-                                   for nid in pg.bundle_nodes]
                 asyncio.ensure_future(self._schedule_pg(pg))
 
     # ---- kv / function table ----------------------------------------------
@@ -466,16 +472,21 @@ class GcsServer:
             if plan is None:
                 await asyncio.sleep(0.2)
                 continue
+            # `prepared` tracks every bundle a prepare RPC was *sent* for —
+            # a lost reply may still have reserved resources on the raylet,
+            # so the unwind must release those too (release is idempotent).
             prepared: List[Tuple[int, str]] = []
+            confirmed: List[Tuple[int, str]] = []
             ok = True
             for i, nid in plan.items():
                 try:
                     client = await self._pool.get(self.nodes[nid].address)
+                    prepared.append((i, nid))
                     reply = await client.call("prepare_bundle", {
                         "pg_id": entry.pg_id, "bundle_index": i,
                         "resources": entry.bundles[i]})
                     if reply.get("ok"):
-                        prepared.append((i, nid))
+                        confirmed.append((i, nid))
                     else:
                         ok = False
                         break
@@ -484,7 +495,7 @@ class GcsServer:
                     break
             committed: List[Tuple[int, str]] = []
             if ok and entry.state == PG_PENDING:
-                for i, nid in prepared:
+                for i, nid in confirmed:
                     try:
                         client = await self._pool.get(self.nodes[nid].address)
                         await client.call("commit_bundle", {
@@ -507,6 +518,13 @@ class GcsServer:
                 continue
             for i, nid in committed:
                 entry.bundle_nodes[i] = nid
+            if any(nid is None for nid in entry.bundle_nodes):
+                # A node holding an already-placed bundle died while this
+                # iteration was preparing/committing (the death handler nulls
+                # the slot but spawns no new loop for PENDING groups) —
+                # replan the now-missing slots before declaring CREATED.
+                await asyncio.sleep(0.2)
+                continue
             entry.state = PG_CREATED
             for fut in entry.waiters:
                 if not fut.done():
@@ -553,6 +571,25 @@ class GcsServer:
             if not fut.done():
                 fut.set_result(True)
         entry.waiters.clear()
+        # Kill actors living in this PG's bundles BEFORE the bundle resources
+        # (and chip assignments) are returned to the nodes — otherwise the
+        # next scheduled task shares chips with a still-running actor
+        # (reference: PG removal destroys all actors/tasks in the group).
+        for actor in list(self.actors.values()):
+            actor_pg = (actor.spec or {}).get("pg") or {}
+            if actor_pg.get("pg_id") != entry.pg_id:
+                continue
+            if actor.state not in (ACTOR_ALIVE, ACTOR_PENDING, ACTOR_RESTARTING):
+                continue
+            actor.spec["_explicit_kill"] = True
+            if actor.node_id and actor.node_id in self.nodes:
+                try:
+                    client = await self._pool.get(
+                        self.nodes[actor.node_id].address)
+                    await client.call("kill_actor", {"actor_id": actor.actor_id})
+                except Exception:
+                    pass
+            await self._finalize_actor_death(actor, "placement group removed")
         for i, nid in enumerate(entry.bundle_nodes):
             if nid is None or nid not in self.nodes:
                 continue

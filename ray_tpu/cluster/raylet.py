@@ -81,6 +81,8 @@ class Raylet:
         self._idle: Dict[Tuple, List[_WorkerEntry]] = {}
         self._queue: List[Dict] = []          # pending task payloads + futures
         self._inflight: Dict[str, Dict] = {}  # task_id -> resource state
+        self._task_futures: Dict[str, "asyncio.Future"] = {}  # dedup joins
+        self._replies: Dict[str, Dict] = {}  # task_id -> successful reply
         self._bundles: Dict[Tuple[str, int], _BundleState] = {}
         self._dispatch_event = asyncio.Event()
         self._local_objects: set = set()
@@ -199,15 +201,53 @@ class Raylet:
 
     # ---- task submission / dispatch ----------------------------------------
     async def rpc_submit_task(self, p):
-        """Held open until the task completes; reply carries results meta."""
+        """Held open until the task completes; reply carries results meta.
+
+        Duplicate submissions of the same task_id (owner retried after a
+        dropped connection) join the in-flight execution or get the cached
+        successful reply — the task body never runs twice for a transport
+        failure. A genuine execution failure is NOT cached, so a retry after
+        ``worker_crashed`` re-executes as intended.
+        """
+        task_id = p["task_id"]
+        cached = self._replies.get(task_id)
+        if cached is not None:
+            return cached
+        existing = self._task_futures.get(task_id)
+        if existing is not None:
+            return await asyncio.shield(existing)
+        fut = asyncio.get_running_loop().create_future()
+        self._task_futures[task_id] = fut
+
+        def _on_done(f, _tid=task_id):
+            # Runs even if this handler's connection dropped mid-await.
+            self._task_futures.pop(_tid, None)
+            if not f.cancelled() and f.exception() is None:
+                reply = f.result()
+                if not reply.get("error"):
+                    self._replies[_tid] = reply
+                    while len(self._replies) > 4096:
+                        self._replies.pop(next(iter(self._replies)))
+
+        fut.add_done_callback(_on_done)
         req = ResourceSet(p["resources"])
         if p.get("pg") is None and (not self.node.is_feasible(req)
                                     or p.get("spillback_hint")):
-            return await self._spill(p)
-        fut = asyncio.get_running_loop().create_future()
+            # Spilled tasks get the same dedup: a retry while the forwarded
+            # submit is in flight joins it instead of spilling a second copy.
+            async def _do_spill():
+                try:
+                    reply = await self._spill(p)
+                except Exception as e:
+                    reply = {"error": "submit_failed", "message": repr(e)}
+                if not fut.done():
+                    fut.set_result(reply)
+
+            asyncio.ensure_future(_do_spill())
+            return await asyncio.shield(fut)
         self._queue.append({"payload": p, "future": fut})
         self._dispatch_event.set()
-        return await fut
+        return await asyncio.shield(fut)
 
     async def _spill(self, p):
         """Route an infeasible task through the GCS to a node that fits

@@ -33,11 +33,20 @@ def bootstrap_jax_distributed(world_size: int, rank: int,
                               group_name: str = "train",
                               coordinator_ip: Optional[str] = None,
                               timeout_s: float = 60.0,
-                              local_device_ids=None) -> None:
+                              local_device_ids=None,
+                              instance_token: Optional[str] = None) -> None:
     """Call from every member of a gang (one process per host).
 
     Single-process gangs (world_size == 1) skip distributed init entirely —
     jax sees its local devices and meshes work unchanged.
+
+    ``instance_token`` MUST be a fresh value shared by all members of one
+    gang instance (the launcher generates it — JaxTrainer does this per
+    restart). It namespaces the rendezvous key so a rank can never pick up
+    the coordinator address a *previous* gang with the same group_name left
+    in the KV. Without a token, the key is deleted after a successful init
+    (rank 0, once every rank has connected) to keep sequential reuse of the
+    default name safe.
     """
     import ray_tpu
     from ray_tpu.core.worker import global_worker
@@ -45,7 +54,8 @@ def bootstrap_jax_distributed(world_size: int, rank: int,
     if world_size <= 1:
         return
     backend = global_worker()._require_backend()
-    key = _kv_key(group_name)
+    key = _kv_key(group_name if instance_token is None
+                  else f"{group_name}/{instance_token}")
     if rank == 0:
         ip = coordinator_ip or socket.gethostbyname(socket.gethostname())
         address = f"{ip}:{_free_port()}"
@@ -70,6 +80,13 @@ def bootstrap_jax_distributed(world_size: int, rank: int,
         num_processes=world_size,
         process_id=rank,
         local_device_ids=local_device_ids)
+    if rank == 0:
+        # initialize() returns only after every process connected, so all
+        # ranks have read the key — safe to clear it now.
+        try:
+            backend.kv_del(key)
+        except Exception:
+            pass
 
 
 def clear_rendezvous(group_name: str = "train") -> None:

@@ -129,6 +129,20 @@ class _DurableExecutor:
         self.tasks_dir = os.path.join(_wf_dir(workflow_id), "tasks")
         self.cancel_flag = cancel_flag
         self._cache: Dict[int, Any] = {}
+        # Actor state is rebuilt from scratch on resume, so loading SOME of a
+        # ClassNode's method-call checkpoints while re-executing others would
+        # run the re-executed calls against stale state. If any method call
+        # of a ClassNode must re-execute, replay ALL of that node's calls
+        # (methods are assumed deterministic, like workflow tasks).
+        self._replay_class_nodes: set = set()
+        by_class: Dict[int, List[ClassMethodNode]] = {}
+        for n in dag.get_all_nodes():
+            if isinstance(n, ClassMethodNode) and isinstance(n._class_node,
+                                                             DAGNode):
+                by_class.setdefault(id(n._class_node), []).append(n)
+        for cls_id, methods in by_class.items():
+            if any(not os.path.exists(self._ckpt_path(m)) for m in methods):
+                self._replay_class_nodes.add(cls_id)
 
     def _ckpt_path(self, node: DAGNode) -> str:
         return os.path.join(self.tasks_dir, self.keys[id(node)] + ".pkl")
@@ -147,7 +161,11 @@ class _DurableExecutor:
             self._cache[id(node)] = val
             return val
         path = self._ckpt_path(node)
-        if os.path.exists(path) and not isinstance(node, ClassNode):
+        skip_ckpt = isinstance(node, ClassNode) or (
+            isinstance(node, ClassMethodNode)
+            and isinstance(node._class_node, DAGNode)
+            and id(node._class_node) in self._replay_class_nodes)
+        if os.path.exists(path) and not skip_ckpt:
             with open(path, "rb") as f:
                 val = pickle.load(f)
             self._cache[id(node)] = val
@@ -265,6 +283,10 @@ def resume(workflow_id: str) -> Any:
 
 
 def cancel(workflow_id: str) -> None:
+    """Cancel a RUNNING workflow; a no-op for terminal/unknown workflows."""
+    status = _read_status(workflow_id)  # raises for unknown ids
+    if status["status"] != WorkflowStatus.RUNNING.value:
+        return
     with _lock:
         flag = _cancel_flags.get(workflow_id)
     if flag is not None:

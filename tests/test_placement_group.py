@@ -101,7 +101,9 @@ def test_pg_gang_atomicity(cluster):
     pg2 = placement_group([{"CPU": 1.5}, {"CPU": 1.5}], strategy="SPREAD")
     ready1 = pg1.wait(timeout=5)
     ready2 = pg2.wait(timeout=2)
-    assert ready1 != ready2 or not (ready1 and ready2)
+    # Exactly one must be created: both-created means over-reservation,
+    # neither-created means the partial-reservation deadlock 2PC prevents.
+    assert ready1 != ready2
     if ready1:
         remove_placement_group(pg1)
     if ready2:
