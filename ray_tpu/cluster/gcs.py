@@ -518,6 +518,14 @@ class GcsServer:
                 continue
             for i, nid in committed:
                 entry.bundle_nodes[i] = nid
+            # Re-check liveness AFTER recording placements: a node that died
+            # while this loop was committing other bundles was invisible to
+            # the death handler (its slot wasn't in bundle_nodes yet), so
+            # null those slots here and let the replan below pick them up.
+            for i, nid in committed:
+                node = self.nodes.get(nid)
+                if node is None or not node.alive:
+                    entry.bundle_nodes[i] = None
             if any(nid is None for nid in entry.bundle_nodes):
                 # A node holding an already-placed bundle died while this
                 # iteration was preparing/committing (the death handler nulls

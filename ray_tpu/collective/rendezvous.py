@@ -40,13 +40,14 @@ def bootstrap_jax_distributed(world_size: int, rank: int,
     Single-process gangs (world_size == 1) skip distributed init entirely —
     jax sees its local devices and meshes work unchanged.
 
-    ``instance_token`` MUST be a fresh value shared by all members of one
-    gang instance (the launcher generates it — JaxTrainer does this per
-    restart). It namespaces the rendezvous key so a rank can never pick up
-    the coordinator address a *previous* gang with the same group_name left
-    in the KV. Without a token, the key is deleted after a successful init
-    (rank 0, once every rank has connected) to keep sequential reuse of the
-    default name safe.
+    ``instance_token``, when given, namespaces the rendezvous key so a rank
+    can never pick up the coordinator address a *previous* gang with the
+    same group_name left in the KV. Callers may equivalently bake a fresh
+    uuid into ``group_name`` itself — that is what ``JaxTrainer`` does
+    (``train/trainer.py`` generates a per-restart group name), so the token
+    is the explicit form of the same convention. Without either, the key is
+    deleted after a successful init (rank 0, once every rank has connected)
+    to keep sequential reuse of the default name safe.
     """
     import ray_tpu
     from ray_tpu.core.worker import global_worker

@@ -50,6 +50,14 @@ class LlamaConfig:
     param_dtype: Any = jnp.float32
     compute_dtype: Any = jnp.bfloat16
     remat: bool = True
+    # Attention backend: "xla" (fused einsum), "flash" (pallas kernel),
+    # "ring" / "ulysses" (sequence-parallel over the mesh "sp" axis; needs
+    # an ambient mesh_scope).
+    attn_impl: str = "xla"
+    # Pipeline parallelism: set to "pp" to split the layer stack over that
+    # mesh axis (GPipe microbatching; incompatible with ring/ulysses attn).
+    pipeline_axis: Optional[str] = None
+    pipeline_microbatches: int = 4
 
     @property
     def head_dim(self) -> int:
@@ -122,7 +130,22 @@ def _block(cfg: LlamaConfig, x: jax.Array, layer: Params,
     v = (h @ layer["wv"].astype(cdt)).reshape(b, s, hkv, hd)
     q = apply_rope(q, sin, cos)
     k = apply_rope(k, sin, cos)
-    attn = mha(q, k, v, causal=True, segment_ids=segment_ids)
+    if cfg.attn_impl != "xla" and segment_ids is not None:
+        raise NotImplementedError(
+            f"segment_ids (packed sequences) require attn_impl='xla'; "
+            f"got {cfg.attn_impl!r} — failing loudly rather than attending "
+            f"across document boundaries")
+    if cfg.attn_impl in ("ring", "ulysses"):
+        from ray_tpu.parallel.context import sequence_parallel_attention
+
+        attn = sequence_parallel_attention(q, k, v, impl=cfg.attn_impl,
+                                           causal=True)
+    elif cfg.attn_impl == "flash":
+        from ray_tpu.ops.pallas.flash import flash_attention
+
+        attn = flash_attention(q, k, v, causal=True)
+    else:
+        attn = mha(q, k, v, causal=True, segment_ids=segment_ids)
     x = x + attn.reshape(b, s, hq * hd) @ layer["wo"].astype(cdt)
 
     h = rmsnorm(x, layer["mlp_norm"].astype(cdt), cfg.norm_eps)
@@ -132,6 +155,47 @@ def _block(cfg: LlamaConfig, x: jax.Array, layer: Params,
     return x
 
 
+def _pipelined_layers(layers: Params, x: jax.Array, cfg: LlamaConfig,
+                      segment_ids: Optional[jax.Array]) -> jax.Array:
+    """Layer stack split over the ``pp`` mesh axis, GPipe-microbatched.
+
+    RoPE tables are recomputed inside the stage (cheap, XLA-hoisted) so the
+    shard_map body closes over no tracers. Ring/Ulysses attention can't nest
+    inside the pipeline shard_map — validated here.
+    """
+    from ray_tpu.parallel.context import current_mesh
+    from ray_tpu.parallel.pipeline import pipeline_apply
+
+    if cfg.attn_impl in ("ring", "ulysses"):
+        raise ValueError("pipeline_axis is incompatible with ring/ulysses "
+                         "attention (nested shard_map); use attn_impl="
+                         "'flash' or 'xla'")
+    if segment_ids is not None:
+        raise NotImplementedError("segment_ids under pipeline parallelism")
+    mesh = current_mesh()
+    if mesh is None:
+        raise ValueError("pipeline_axis needs an ambient mesh "
+                         "(parallel.context.mesh_scope)")
+
+    def stage(stage_layers, h):
+        sin, cos = rope_angles(h.shape[1], cfg.head_dim, cfg.rope_theta,
+                               cfg.compute_dtype)
+        body = lambda hh, layer: (_block(cfg, hh, layer, sin, cos, None), None)
+        h, _ = jax.lax.scan(body, h, stage_layers)
+        return h
+
+    # Batch rides (dp, fsdp, tp) inside the pipeline region: tp lanes would
+    # otherwise run fully redundant stage compute (stage weights are
+    # replicated across them at the shard_map boundary — v1 limitation; a
+    # manual-collective FSDP-within-stage layout is the follow-up).
+    return pipeline_apply(
+        stage, layers, x, mesh,
+        axis_name=cfg.pipeline_axis,
+        num_microbatches=cfg.pipeline_microbatches,
+        batch_axes=(("dp", "fsdp", "tp"),),
+        remat=cfg.remat)
+
+
 def forward(params: Params, tokens: jax.Array, cfg: LlamaConfig,
             segment_ids: Optional[jax.Array] = None) -> jax.Array:
     """tokens [batch, seq] -> logits [batch, seq, vocab] (fp32)."""
@@ -139,11 +203,15 @@ def forward(params: Params, tokens: jax.Array, cfg: LlamaConfig,
     x = params["embed"].astype(cdt)[tokens]
     sin, cos = rope_angles(tokens.shape[1], cfg.head_dim, cfg.rope_theta, cdt)
 
-    body = lambda x, layer: (_block(cfg, x, layer, sin, cos, segment_ids), None)
-    if cfg.remat:
-        body = jax.checkpoint(
-            body, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
-    x, _ = jax.lax.scan(body, x, params["layers"])
+    if cfg.pipeline_axis is not None:
+        x = _pipelined_layers(params["layers"], x, cfg, segment_ids)
+    else:
+        body = lambda x, layer: (_block(cfg, x, layer, sin, cos, segment_ids), None)
+        if cfg.remat:
+            body = jax.checkpoint(
+                body,
+                policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+        x, _ = jax.lax.scan(body, x, params["layers"])
 
     x = rmsnorm(x, params["final_norm"].astype(cdt), cfg.norm_eps)
     head = (params["embed"].T if cfg.tie_embeddings else params["lm_head"]).astype(cdt)
@@ -163,20 +231,24 @@ def lm_loss(params: Params, batch: Dict[str, jax.Array], cfg: LlamaConfig) -> ja
     return (nll * mask).sum() / jnp.maximum(mask.sum(), 1)
 
 
-def sharding_rules() -> ShardingRules:
-    """Param partitioning over the (dp, fsdp, tp) mesh (scaling-book layout).
+def sharding_rules(pipeline: bool = False) -> ShardingRules:
+    """Param partitioning over the (pp, dp, fsdp, tp) mesh (scaling-book
+    layout).
 
-    The leading stacked-layer axis is never sharded; matrices put their
-    contracting/output dims on (fsdp, tp) so forward matmuls all-gather over
-    fsdp (ZeRO-3) and reduce over tp.
+    The leading stacked-layer axis is sharded over ``pp`` when pipelining
+    (else unsharded); matrices put their contracting/output dims on
+    (fsdp, tp) so forward matmuls all-gather over fsdp (ZeRO-3) and reduce
+    over tp.
     """
+    layer0 = "pp" if pipeline else None
     return ShardingRules([
         (r"embed$", P("tp", "fsdp")),
         (r"lm_head$", P("fsdp", "tp")),
-        (r"layers/w[qkv]$", P(None, "fsdp", "tp")),
-        (r"layers/wo$", P(None, "tp", "fsdp")),
-        (r"layers/w_(gate|up)$", P(None, "fsdp", "tp")),
-        (r"layers/w_down$", P(None, "tp", "fsdp")),
+        (r"layers/w[qkv]$", P(layer0, "fsdp", "tp")),
+        (r"layers/wo$", P(layer0, "tp", "fsdp")),
+        (r"layers/w_(gate|up)$", P(layer0, "fsdp", "tp")),
+        (r"layers/w_down$", P(layer0, "tp", "fsdp")),
+        (r"layers/.*norm", P(layer0)),
         (r"norm", P()),
     ])
 

@@ -1,0 +1,8 @@
+"""Pallas TPU kernels for the hot ops (flash attention & friends).
+
+These kernels override the XLA-path reference implementations in
+``ray_tpu/ops/`` on real TPUs; every kernel also runs in pallas interpret
+mode so CPU CI exercises identical code.
+"""
+
+from ray_tpu.ops.pallas.flash import flash_attention, flash_attention_with_lse  # noqa: F401

@@ -43,7 +43,7 @@ def init_sharded_state(rng: jax.Array, cfg: llama.LlamaConfig, mesh: Mesh,
     host-side full copy ever materializes (essential for 7B+); the optimizer
     state inherits the param shardings through GSPMD propagation.
     """
-    rules = rules or llama.sharding_rules()
+    rules = rules or llama.sharding_rules(pipeline=cfg.pipeline_axis is not None)
     abstract = jax.eval_shape(lambda r: llama.init_params(r, cfg), rng)
     out_shardings = rules.tree_shardings(abstract, mesh)
     params = jax.jit(lambda r: llama.init_params(r, cfg),
@@ -54,8 +54,14 @@ def init_sharded_state(rng: jax.Array, cfg: llama.LlamaConfig, mesh: Mesh,
 
 def make_train_step(cfg: llama.LlamaConfig,
                     optimizer: optax.GradientTransformation,
-                    loss_fn: Callable = None) -> Callable:
-    """(params, opt_state, batch) -> (params, opt_state, metrics), donated."""
+                    loss_fn: Callable = None,
+                    mesh: Optional[Mesh] = None) -> Callable:
+    """(params, opt_state, batch) -> (params, opt_state, metrics), donated.
+
+    ``mesh`` makes itself ambient during tracing (``context.mesh_scope``) so
+    model-internal shard_map regions (ring attention, pipeline stages) can
+    find it.
+    """
     loss_fn = loss_fn or llama.lm_loss
 
     def step(params, opt_state, batch):
@@ -66,23 +72,47 @@ def make_train_step(cfg: llama.LlamaConfig,
         gnorm = optax.global_norm(grads)
         return params, opt_state, {"loss": loss, "grad_norm": gnorm}
 
-    return jax.jit(step, donate_argnums=(0, 1))
+    jstep = jax.jit(step, donate_argnums=(0, 1))
+    if mesh is None:
+        return jstep
+
+    from ray_tpu.parallel.context import mesh_scope
+
+    def run(params, opt_state, batch):
+        with mesh_scope(mesh):
+            return jstep(params, opt_state, batch)
+
+    return run
 
 
 def shard_batch(batch: Dict[str, jax.Array], mesh: Mesh) -> Dict[str, jax.Array]:
-    """Place a host batch onto the mesh: batch dim over (dp, fsdp)."""
-    sharding = NamedSharding(mesh, P(("dp", "fsdp")))
-    return jax.tree.map(lambda x: jax.device_put(x, sharding), batch)
+    """Place a host batch onto the mesh: batch dim over (dp, fsdp), sequence
+    over sp when the mesh has a non-trivial sp axis (context parallelism)."""
+    sp = mesh.shape.get("sp", 1)
+
+    def place(x):
+        # Sequence rides sp only when it divides evenly (token batches are
+        # [B, S+1] — odd — so they stay seq-replicated; GSPMD re-shards the
+        # [B, S] slice at the shard_map boundary).
+        if x.ndim >= 2 and sp > 1 and x.shape[1] % sp == 0:
+            spec = P(("dp", "fsdp"), "sp")
+        else:
+            spec = P(("dp", "fsdp"))
+        return jax.device_put(x, NamedSharding(mesh, spec))
+
+    return jax.tree.map(place, batch)
 
 
-def auto_mesh(n_devices: int, devices=None) -> Tuple[Mesh, MeshConfig]:
-    """A sensible (dp, fsdp, tp) layout for n devices: fsdp-dominant with a
-    tp=min(4, n) inner axis when n allows — the FSDP+TP sweet spot for
-    models at the 7B scale."""
-    tp = 1
-    for cand in (4, 2):
-        if n_devices % cand == 0 and n_devices >= cand * 2:
-            tp = cand
-            break
-    cfg = MeshConfig.for_devices(n_devices, tp=tp)
+def auto_mesh(n_devices: int, devices=None, *, tp: Optional[int] = None,
+              sp: int = 1, pp: int = 1, dp: int = 1) -> Tuple[Mesh, MeshConfig]:
+    """A sensible layout for n devices: fsdp-dominant with a tp=min(4, n)
+    inner axis when n allows — the FSDP+TP sweet spot at the 7B scale.
+    sp/pp carve off sequence/pipeline axes for long-context runs."""
+    if tp is None:
+        tp = 1
+        for cand in (4, 2):
+            if n_devices % (cand * sp * pp * dp) == 0 and n_devices >= cand * 2:
+                tp = cand
+                break
+    cfg = MeshConfig.for_devices(n_devices, tp=tp, sp=sp, pp=pp, dp=dp)
     return make_mesh(cfg, devices), cfg
