@@ -104,7 +104,7 @@ def main() -> None:
 
     import sys
 
-    result, errors = None, []
+    result, errors, non_oom_failures = None, [], 0
     for preset, batch, seq, steps, attn in ladder:
         try:
             result = run_config(preset, batch, seq, steps, attn)
@@ -117,8 +117,10 @@ def main() -> None:
             # number to a slower config.
             print(f"bench: config failed, falling back — {msg}",
                   file=sys.stderr)
-            if not _is_oom(e) and len(errors) > 3:
-                raise
+            if not _is_oom(e):
+                non_oom_failures += 1
+                if non_oom_failures > 2:
+                    raise
     if result is None:
         raise RuntimeError("all bench configs failed:\n" + "\n".join(errors))
     if errors:

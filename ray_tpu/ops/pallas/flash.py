@@ -26,7 +26,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-NEG_INF = -2.0**30
+from ray_tpu.ops.attention import NEG_INF
 
 
 def _needs_interpret() -> bool:

@@ -10,7 +10,7 @@ from __future__ import annotations
 
 import dataclasses
 import re
-from typing import Dict, Optional
+from typing import Any, Dict, Optional
 
 from ray_tpu.core.resources import CPU, TPU
 
@@ -69,3 +69,6 @@ class RunConfig:
     storage_path: Optional[str] = None  # default: /tmp/ray_tpu_results
     failure_config: FailureConfig = dataclasses.field(default_factory=FailureConfig)
     checkpoint_config: CheckpointConfig = dataclasses.field(default_factory=CheckpointConfig)
+    # Tune stop criteria: {"training_iteration": N, "<metric>": value} or
+    # callable(trial_id, result) -> bool (reference: air.RunConfig.stop)
+    stop: Optional[Any] = None

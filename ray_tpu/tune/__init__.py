@@ -1,0 +1,41 @@
+"""ray_tpu.tune: hyperparameter tuning (the reference's ``ray.tune``).
+
+Tuner.fit drives a controller event loop over trial-runner actors; search
+spaces are declarative domains; schedulers implement ASHA / median-stopping /
+PBT early-stopping and population mutation on top of trial checkpoints.
+"""
+
+from ray_tpu.train.config import FailureConfig, RunConfig  # noqa: F401
+from ray_tpu.tune.schedulers import (  # noqa: F401
+    AsyncHyperBandScheduler,
+    FIFOScheduler,
+    HyperBandScheduler,
+    MedianStoppingRule,
+    PopulationBasedTraining,
+    TrialScheduler,
+)
+from ray_tpu.tune.search import (  # noqa: F401
+    BasicVariantGenerator,
+    ConcurrencyLimiter,
+    QuasiRandomSearch,
+    Searcher,
+)
+from ray_tpu.tune.search_space import (  # noqa: F401
+    choice,
+    grid_search,
+    lograndint,
+    loguniform,
+    qloguniform,
+    qrandint,
+    quniform,
+    randint,
+    sample_from,
+    uniform,
+)
+from ray_tpu.tune.trainable import (  # noqa: F401
+    Trainable,
+    get_checkpoint,
+    report,
+    with_resources,
+)
+from ray_tpu.tune.tuner import Result, ResultGrid, TuneConfig, Tuner  # noqa: F401

@@ -1,0 +1,282 @@
+"""The Tune trial control loop.
+
+Reference analog: ``tune/execution/tune_controller.py:81`` — an event loop
+over trial-runner actors. Each trial is hosted by a ``_TrialRunner`` actor
+(the reference's Trainable-actor); the controller drives one ``train()``
+call at a time per trial, feeds results to the scheduler/searcher, applies
+early-stop / PBT-mutation decisions, checkpoints trials and the experiment
+state, and restarts failed trials up to ``max_failures``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.tune import trial as trial_mod
+from ray_tpu.tune.schedulers import CONTINUE, PAUSE, STOP, FIFOScheduler, TrialScheduler
+from ray_tpu.tune.search import BasicVariantGenerator, ConcurrencyLimiter, Searcher
+from ray_tpu.tune.trial import ERROR, PAUSED, PENDING, RUNNING, TERMINATED, Trial
+from ray_tpu.tune.trainable import DONE, Trainable
+
+
+@ray_tpu.remote
+class _TrialRunner:
+    """Hosts one Trainable instance inside its own worker process."""
+
+    def __init__(self, trainable_cls: type, config: Dict[str, Any],
+                 restore_dir: Optional[str] = None):
+        self._t: Trainable = trainable_cls(config)
+        if restore_dir:
+            self._t.restore(restore_dir)
+
+    def train(self) -> Dict[str, Any]:
+        return self._t.train()
+
+    def save(self, checkpoint_dir: str) -> Optional[str]:
+        return self._t.save(checkpoint_dir)
+
+    def stop(self) -> None:
+        self._t.cleanup()
+
+
+def _runner_options(trainable_cls: type) -> Dict[str, Any]:
+    res = getattr(trainable_cls, "_tune_resources", None) or {"cpu": 1}
+    opts: Dict[str, Any] = {}
+    custom: Dict[str, float] = {}
+    for k, v in res.items():
+        lk = k.lower()
+        if lk in ("cpu", "num_cpus"):
+            opts["num_cpus"] = v
+        elif lk in ("tpu", "num_tpus"):
+            opts["num_tpus"] = v
+        elif lk in ("gpu", "num_gpus"):
+            opts["num_gpus"] = v
+        elif lk == "memory":
+            opts["memory"] = v
+        else:
+            custom[k] = v
+    if custom:
+        opts["resources"] = custom
+    return opts
+
+
+class TuneController:
+    def __init__(self, trainable_cls: type, searcher: Searcher,
+                 scheduler: Optional[TrialScheduler],
+                 experiment_dir: str, experiment_name: str,
+                 metric: Optional[str], mode: str = "max",
+                 max_concurrent: int = 0, max_failures: int = 0,
+                 checkpoint_freq: int = 0,
+                 stop: Optional[Any] = None,
+                 restored_trials: Optional[List[Trial]] = None):
+        self._cls = trainable_cls
+        self._searcher = searcher
+        self._scheduler = scheduler or FIFOScheduler()
+        self._scheduler.set_search_properties(metric, mode)
+        self._dir = experiment_dir
+        self._name = experiment_name
+        self._metric = metric
+        self._mode = mode
+        self._max_concurrent = max_concurrent
+        self._max_failures = max_failures
+        self._checkpoint_freq = checkpoint_freq
+        self._stop_criteria = stop
+        self._trials: List[Trial] = list(restored_trials or [])
+        self._next_id = len(self._trials)
+        self._exhausted = False
+        os.makedirs(self._dir, exist_ok=True)
+        for t in self._trials:
+            self._scheduler.on_trial_add(t)
+
+    # ---- trial lifecycle ----
+
+    def _maybe_request_trials(self) -> None:
+        while not self._exhausted:
+            live = [t for t in self._trials if t.status in (PENDING, RUNNING)]
+            if self._max_concurrent and len(live) >= self._max_concurrent:
+                return
+            trial_id = f"{self._name}_{self._next_id:05d}"
+            cfg = self._searcher.suggest(trial_id)
+            if cfg is None:
+                if isinstance(self._searcher, ConcurrencyLimiter) and self._searcher._live:
+                    return  # temporarily saturated, not exhausted
+                self._exhausted = True
+                return
+            self._next_id += 1
+            t = Trial(trial_id, cfg, self._name)
+            self._trials.append(t)
+            self._scheduler.on_trial_add(t)
+
+    def _start_trial(self, t: Trial) -> None:
+        opts = _runner_options(self._cls)
+        t.mark_running(_TrialRunner.options(**opts).remote(
+            self._cls, t.config, t.restore_path))
+        t.restore_path = None
+        t.inflight = t.runner.train.remote()
+
+    def _trial_dir(self, t: Trial) -> str:
+        d = os.path.join(self._dir, t.trial_id)
+        os.makedirs(d, exist_ok=True)
+        return d
+
+    def _save_trial_checkpoint(self, t: Trial) -> None:
+        ckpt_dir = os.path.join(
+            self._trial_dir(t), f"checkpoint_{t.training_iteration:06d}")
+        try:
+            ray_tpu.get(t.runner.save.remote(ckpt_dir), timeout=60)
+            t.checkpoint_path = ckpt_dir
+        except Exception:
+            pass
+
+    def _finalize(self, t: Trial, status: str, error: Optional[str] = None) -> None:
+        if t.runner is not None:
+            try:
+                t.runner.stop.remote()
+                ray_tpu.kill(t.runner, no_restart=True)
+            except Exception:
+                pass
+        t.runner = None
+        t.inflight = None
+        t.status = status
+        t.error = error
+        self._searcher.on_trial_complete(
+            t.trial_id, t.last_result or None, error=status == ERROR)
+        self._scheduler.on_trial_complete(t, t.last_result)
+        with open(os.path.join(self._trial_dir(t), "result.json"), "w") as f:
+            json.dump(t.state(), f, default=str)
+
+    def _should_stop(self, t: Trial, result: Dict[str, Any]) -> bool:
+        if result.get(DONE):
+            return True
+        s = self._stop_criteria
+        if s is None:
+            return False
+        if callable(s):
+            return bool(s(t.trial_id, result))
+        if isinstance(s, dict):
+            for k, v in s.items():
+                r = result.get(k)
+                if r is None:
+                    continue
+                if k == "training_iteration" and r >= v:
+                    return True
+                if k != "training_iteration":
+                    sign = 1 if self._mode == "max" else -1
+                    if sign * r >= sign * v:
+                        return True
+        return False
+
+    def _handle_result(self, t: Trial, result: Dict[str, Any]) -> None:
+        t.on_result(result)
+        if (self._checkpoint_freq
+                and t.training_iteration % self._checkpoint_freq == 0):
+            self._save_trial_checkpoint(t)
+        if self._should_stop(t, result):
+            if self._checkpoint_freq == 0 or t.checkpoint_path is None:
+                self._save_trial_checkpoint(t)
+            self._finalize(t, TERMINATED)
+            return
+        decision = self._scheduler.on_trial_result(t, result)
+        if decision == STOP:
+            self._finalize(t, TERMINATED)
+        elif decision == PAUSE:
+            mutation = self._scheduler.pop_mutation(t)
+            if mutation is not None:
+                new_config, restore_from = mutation
+                if t.runner is not None:
+                    try:
+                        ray_tpu.kill(t.runner, no_restart=True)
+                    except Exception:
+                        pass
+                t.runner, t.inflight = None, None
+                t.config = new_config
+                t.restore_path = restore_from
+                t.status = PENDING
+            # plain PAUSE without mutation: requeue as-is
+            elif t.runner is not None:
+                self._save_trial_checkpoint(t)
+                ray_tpu.kill(t.runner, no_restart=True)
+                t.runner, t.inflight = None, None
+                t.restore_path = t.checkpoint_path
+                t.status = PENDING
+        else:
+            t.inflight = t.runner.train.remote()
+
+    def _handle_failure(self, t: Trial, err: Exception) -> None:
+        t.num_failures += 1
+        self._scheduler.on_trial_error(t)
+        if t.runner is not None:
+            try:
+                ray_tpu.kill(t.runner, no_restart=True)
+            except Exception:
+                pass
+        t.runner, t.inflight = None, None
+        if t.num_failures <= self._max_failures:
+            t.restore_path = t.checkpoint_path
+            t.status = PENDING
+        else:
+            t.status = ERROR
+            self._finalize(t, ERROR, error=repr(err))
+
+    # ---- experiment state ----
+
+    def _save_experiment_state(self) -> None:
+        state = {
+            "experiment_name": self._name,
+            "timestamp": time.time(),
+            "trials": [t.state() for t in self._trials],
+        }
+        tmp = os.path.join(self._dir, ".experiment_state.tmp")
+        with open(tmp, "w") as f:
+            json.dump(state, f, default=str)
+        os.replace(tmp, os.path.join(self._dir, "experiment_state.json"))
+
+    @staticmethod
+    def load_experiment_state(experiment_dir: str) -> List[Trial]:
+        path = os.path.join(experiment_dir, "experiment_state.json")
+        with open(path) as f:
+            state = json.load(f)
+        trials = []
+        for ts in state["trials"]:
+            t = Trial.from_state(ts, state["experiment_name"])
+            if t.status in (RUNNING, PENDING, PAUSED):
+                t.status = PENDING
+                t.restore_path = t.checkpoint_path
+            trials.append(t)
+        return trials
+
+    # ---- main loop ----
+
+    def run(self) -> List[Trial]:
+        while True:
+            self._maybe_request_trials()
+            pending = [t for t in self._trials if t.status == PENDING]
+            running = [t for t in self._trials if t.status == RUNNING]
+            slots = (self._max_concurrent - len(running)
+                     if self._max_concurrent else len(pending))
+            for t in pending[:max(0, slots)]:
+                self._start_trial(t)
+            running = [t for t in self._trials if t.status == RUNNING and t.inflight]
+            if not running:
+                if self._exhausted and not any(
+                        t.status == PENDING for t in self._trials):
+                    break
+                time.sleep(0.02)
+                continue
+            refs = [t.inflight for t in running]
+            ready, _ = ray_tpu.wait(refs, num_returns=1, timeout=5)
+            for ref in ready:
+                t = next(tr for tr in running if tr.inflight == ref)
+                try:
+                    result = ray_tpu.get(ref)
+                except Exception as e:
+                    self._handle_failure(t, e)
+                else:
+                    self._handle_result(t, result)
+            self._save_experiment_state()
+        self._save_experiment_state()
+        return self._trials

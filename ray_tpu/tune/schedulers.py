@@ -1,0 +1,258 @@
+"""Trial schedulers: early stopping and population-based training.
+
+Reference analogs: ``tune/schedulers/trial_scheduler.py`` (decision enum),
+``async_hyperband.py`` (ASHA brackets/rungs), ``median_stopping_rule.py``,
+``hyperband.py``, ``pbt.py``. The controller calls ``on_trial_result`` after
+every result and acts on the returned decision.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.tune.trial import Trial
+
+CONTINUE = "CONTINUE"
+STOP = "STOP"
+PAUSE = "PAUSE"
+
+
+class TrialScheduler:
+    def set_search_properties(self, metric: Optional[str],
+                              mode: Optional[str]) -> None:
+        if getattr(self, "_metric", None) is None:
+            self._metric = metric
+        if getattr(self, "_mode", None) is None:
+            self._mode = mode
+
+    def on_trial_add(self, trial: Trial) -> None:
+        pass
+
+    def on_trial_result(self, trial: Trial, result: Dict[str, Any]) -> str:
+        return CONTINUE
+
+    def on_trial_complete(self, trial: Trial, result: Dict[str, Any]) -> None:
+        pass
+
+    def on_trial_error(self, trial: Trial) -> None:
+        pass
+
+    # PBT hook: returns (new_config, restore_from_trial) or None
+    def pop_mutation(self, trial: Trial):
+        return None
+
+
+class FIFOScheduler(TrialScheduler):
+    """Run every trial to completion in submission order."""
+
+
+def _score(value: float, mode: str) -> float:
+    return value if mode == "max" else -value
+
+
+class AsyncHyperBandScheduler(TrialScheduler):
+    """ASHA: asynchronous successive halving.
+
+    Rung milestones are ``grace_period * reduction_factor**k`` up to
+    ``max_t``; at each rung a trial must beat the top ``1/reduction_factor``
+    quantile of results recorded at that rung or be stopped
+    (``tune/schedulers/async_hyperband.py`` semantics, single bracket by
+    default).
+    """
+
+    def __init__(self, time_attr: str = "training_iteration",
+                 metric: Optional[str] = None, mode: Optional[str] = None,
+                 max_t: int = 100, grace_period: int = 1,
+                 reduction_factor: float = 3, brackets: int = 1):
+        self._time_attr = time_attr
+        self._metric = metric
+        self._mode = mode
+        self._max_t = max_t
+        self._grace = grace_period
+        self._rf = reduction_factor
+        # rung -> list of recorded scores (already sign-normalized)
+        self._rungs: Dict[float, List[float]] = {}
+        milestones = []
+        t = grace_period
+        while t < max_t:
+            milestones.append(t)
+            t = math.ceil(t * reduction_factor)
+        self._milestones = milestones
+        self._trial_rung: Dict[str, int] = {}  # next milestone index per trial
+        self._trial_recorded: Dict[str, Tuple[float, float]] = {}  # tid -> (rung, score)
+
+    def on_trial_add(self, trial: Trial) -> None:
+        self._trial_rung[trial.trial_id] = 0
+
+    def on_trial_result(self, trial: Trial, result: Dict[str, Any]) -> str:
+        t = result.get(self._time_attr, 0)
+        if t >= self._max_t:
+            return STOP
+        metric = result.get(self._metric)
+        if metric is None:
+            return CONTINUE
+        idx = self._trial_rung.get(trial.trial_id, 0)
+        decision = CONTINUE
+        score = _score(metric, self._mode or "max")
+        crossed = False
+        while idx < len(self._milestones) and t >= self._milestones[idx]:
+            crossed = True
+            rung = self._milestones[idx]
+            self._rungs.setdefault(rung, []).append(score)
+            self._trial_recorded[trial.trial_id] = (rung, score)
+            if self._below_cutoff(rung, score):
+                decision = STOP
+            idx += 1
+        self._trial_rung[trial.trial_id] = idx
+        if not crossed:
+            # async demotion: a trial that passed its last rung early may fall
+            # below the cutoff as slower trials record — stop it on its next
+            # report rather than letting it run to the next rung.
+            rec = self._trial_recorded.get(trial.trial_id)
+            if rec is not None and self._below_cutoff(rec[0], rec[1]):
+                decision = STOP
+        return decision
+
+    def _below_cutoff(self, rung: float, score: float) -> bool:
+        scores = self._rungs.get(rung, [])
+        if len(scores) < self._rf:
+            return False
+        scores_sorted = sorted(scores, reverse=True)
+        cutoff = scores_sorted[max(0, int(len(scores) / self._rf) - 1)]
+        return score < cutoff
+
+
+class MedianStoppingRule(TrialScheduler):
+    """Stop a trial whose best result so far is worse than the median of the
+    running means of all other trials at the same step
+    (``tune/schedulers/median_stopping_rule.py``)."""
+
+    def __init__(self, time_attr: str = "training_iteration",
+                 metric: Optional[str] = None, mode: Optional[str] = None,
+                 grace_period: int = 1, min_samples_required: int = 3):
+        self._time_attr = time_attr
+        self._metric = metric
+        self._mode = mode
+        self._grace = grace_period
+        self._min_samples = min_samples_required
+        self._means: Dict[str, float] = {}
+        self._counts: Dict[str, int] = {}
+
+    def on_trial_result(self, trial: Trial, result: Dict[str, Any]) -> str:
+        t = result.get(self._time_attr, 0)
+        metric = result.get(self._metric)
+        if metric is None:
+            return CONTINUE
+        score = _score(metric, self._mode or "max")
+        tid = trial.trial_id
+        n = self._counts.get(tid, 0) + 1
+        self._counts[tid] = n
+        self._means[tid] = self._means.get(tid, 0.0) + (score - self._means.get(tid, 0.0)) / n
+        if t < self._grace:
+            return CONTINUE
+        others = [m for k, m in self._means.items() if k != tid]
+        if len(others) < self._min_samples:
+            return CONTINUE
+        others.sort()
+        median = others[len(others) // 2]
+        if self._means[tid] < median:
+            return STOP
+        return CONTINUE
+
+
+class HyperBandScheduler(AsyncHyperBandScheduler):
+    """Synchronous HyperBand approximated by multi-bracket ASHA — the
+    asynchronous variant dominates in practice (the reference itself
+    recommends ASHA over strict HyperBand)."""
+
+    def __init__(self, *args, **kwargs):
+        kwargs.setdefault("brackets", 3)
+        super().__init__(*args, **kwargs)
+
+
+class PopulationBasedTraining(TrialScheduler):
+    """PBT: at every ``perturbation_interval``, a bottom-quantile trial
+    clones the checkpoint of a top-quantile trial and perturbs its
+    hyperparameters (``tune/schedulers/pbt.py`` exploit/explore).
+
+    The controller implements the mechanics: on a STOP-with-mutation
+    decision it stops the runner, rewrites trial.config / restore_path from
+    ``pop_mutation`` and requeues the trial.
+    """
+
+    def __init__(self, time_attr: str = "training_iteration",
+                 metric: Optional[str] = None, mode: Optional[str] = None,
+                 perturbation_interval: int = 4,
+                 hyperparam_mutations: Optional[Dict[str, Any]] = None,
+                 quantile_fraction: float = 0.25,
+                 resample_probability: float = 0.25, seed: int = 0):
+        self._time_attr = time_attr
+        self._metric = metric
+        self._mode = mode
+        self._interval = perturbation_interval
+        self._mutations = hyperparam_mutations or {}
+        self._quantile = quantile_fraction
+        self._resample_p = resample_probability
+        self._rng = random.Random(seed)
+        self._last_perturb: Dict[str, int] = {}
+        self._latest: Dict[str, float] = {}  # trial_id -> normalized score
+        self._trials: Dict[str, Trial] = {}
+        self._pending_mutation: Dict[str, Any] = {}
+
+    def on_trial_add(self, trial: Trial) -> None:
+        self._trials[trial.trial_id] = trial
+
+    def _quantiles(self):
+        ranked = sorted(self._latest.items(), key=lambda kv: kv[1])
+        n = len(ranked)
+        k = max(1, int(n * self._quantile))
+        bottom = [tid for tid, _ in ranked[:k]]
+        top = [tid for tid, _ in ranked[-k:]]
+        return bottom, top
+
+    def _explore(self, config: Dict[str, Any]) -> Dict[str, Any]:
+        from ray_tpu.tune.search_space import Domain
+
+        new = dict(config)
+        for key, spec in self._mutations.items():
+            if self._rng.random() < self._resample_p or key not in new:
+                if isinstance(spec, Domain):
+                    new[key] = spec.sample(self._rng)
+                elif isinstance(spec, list):
+                    new[key] = self._rng.choice(spec)
+                elif callable(spec):
+                    new[key] = spec()
+            else:
+                cur = new[key]
+                if isinstance(cur, (int, float)):
+                    factor = 1.2 if self._rng.random() > 0.5 else 0.8
+                    new[key] = type(cur)(cur * factor)
+                elif isinstance(spec, list):
+                    new[key] = self._rng.choice(spec)
+        return new
+
+    def on_trial_result(self, trial: Trial, result: Dict[str, Any]) -> str:
+        t = result.get(self._time_attr, 0)
+        metric = result.get(self._metric)
+        if metric is None:
+            return CONTINUE
+        self._latest[trial.trial_id] = _score(metric, self._mode or "max")
+        last = self._last_perturb.get(trial.trial_id, 0)
+        if t - last < self._interval or len(self._latest) < 2:
+            return CONTINUE
+        self._last_perturb[trial.trial_id] = t
+        bottom, top = self._quantiles()
+        if trial.trial_id not in bottom or trial.trial_id in top:
+            return CONTINUE
+        exploit_id = self._rng.choice(top)
+        exploit = self._trials.get(exploit_id)
+        if exploit is None or exploit.checkpoint_path is None:
+            return CONTINUE
+        self._pending_mutation[trial.trial_id] = (
+            self._explore(exploit.config), exploit.checkpoint_path)
+        return PAUSE  # controller stops the runner, mutates, requeues
+
+    def pop_mutation(self, trial: Trial):
+        return self._pending_mutation.pop(trial.trial_id, None)

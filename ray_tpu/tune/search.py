@@ -1,0 +1,171 @@
+"""Search algorithms.
+
+Reference analogs: ``tune/search/searcher.py`` (Searcher interface),
+``tune/search/basic_variant.py`` (grid/random via variant generation),
+``tune/search/concurrency_limiter.py``. Model-based searchers in the
+reference (hyperopt/optuna/...) are external-library adapters; here the
+native model-based searcher is a simple TPE-style ``QuasiRandomSearch``
+over the declarative domains.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.tune.search_space import (
+    Categorical,
+    Domain,
+    Float,
+    Integer,
+    _is_grid,
+    generate_variants,
+)
+
+
+class Searcher:
+    def __init__(self, metric: Optional[str] = None, mode: Optional[str] = None):
+        self._metric = metric
+        self._mode = mode
+
+    def set_search_properties(self, metric: Optional[str], mode: Optional[str],
+                              config: Dict[str, Any]) -> bool:
+        if self._metric is None:
+            self._metric = metric
+        if self._mode is None:
+            self._mode = mode
+        self._space = config
+        return True
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        raise NotImplementedError
+
+    def on_trial_complete(self, trial_id: str,
+                          result: Optional[Dict[str, Any]] = None,
+                          error: bool = False) -> None:
+        pass
+
+
+class BasicVariantGenerator(Searcher):
+    """Grid + random sampling via up-front variant expansion."""
+
+    def __init__(self, points_to_evaluate: Optional[List[Dict]] = None,
+                 max_concurrent: int = 0, seed: Optional[int] = None):
+        super().__init__()
+        self._points = list(points_to_evaluate or [])
+        self._seed = seed
+        self._variants: Optional[List[Dict]] = None
+        self._idx = 0
+        self._num_samples = 1
+
+    def set_num_samples(self, n: int) -> None:
+        self._num_samples = n
+
+    def set_search_properties(self, metric, mode, config) -> bool:
+        super().set_search_properties(metric, mode, config)
+        self._variants = self._points + generate_variants(
+            config or {}, self._num_samples, seed=self._seed)
+        return True
+
+    @property
+    def total_trials(self) -> int:
+        return len(self._variants or [])
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        if self._variants is None or self._idx >= len(self._variants):
+            return None
+        cfg = self._variants[self._idx]
+        self._idx += 1
+        return cfg
+
+
+class QuasiRandomSearch(Searcher):
+    """Model-based-ish native searcher: exploit the best known config's
+    neighborhood with probability ``exploit_p`` once enough results exist,
+    else explore by sampling the domains (a light-weight stand-in for the
+    reference's external hyperopt/optuna adapters)."""
+
+    def __init__(self, metric: Optional[str] = None, mode: Optional[str] = None,
+                 num_samples: int = 16, exploit_p: float = 0.5,
+                 min_observations: int = 4, seed: int = 0):
+        super().__init__(metric, mode)
+        self._rng = random.Random(seed)
+        self._budget = num_samples
+        self._issued = 0
+        self._exploit_p = exploit_p
+        self._min_obs = min_observations
+        self._observed: List[Dict[str, Any]] = []
+        self._configs: Dict[str, Dict] = {}
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        if self._issued >= self._budget:
+            return None
+        self._issued += 1
+        space = getattr(self, "_space", {}) or {}
+        best = self._best_config()
+        cfg: Dict[str, Any] = {}
+        for key, v in space.items():
+            if _is_grid(v):
+                raise ValueError("grid_search is not supported by QuasiRandomSearch")
+            if not isinstance(v, Domain):
+                cfg[key] = v
+                continue
+            if best is not None and self._rng.random() < self._exploit_p:
+                cfg[key] = self._perturb(v, best.get(key))
+            else:
+                cfg[key] = v.sample(self._rng)
+        self._configs[trial_id] = cfg
+        return cfg
+
+    def _perturb(self, domain: Domain, base: Any) -> Any:
+        if base is None:
+            return domain.sample(self._rng)
+        if isinstance(domain, Float):
+            span = (domain.upper - domain.lower) * 0.2
+            v = base + self._rng.uniform(-span, span)
+            return min(max(v, domain.lower), domain.upper)
+        if isinstance(domain, Integer):
+            span = max(1, int((domain.upper - domain.lower) * 0.2))
+            v = base + self._rng.randint(-span, span)
+            return min(max(v, domain.lower), domain.upper - 1)
+        if isinstance(domain, Categorical):
+            return base if self._rng.random() < 0.5 else domain.sample(self._rng)
+        return domain.sample(self._rng)
+
+    def _best_config(self) -> Optional[Dict[str, Any]]:
+        if len(self._observed) < self._min_obs:
+            return None
+        sign = 1 if (self._mode or "max") == "max" else -1
+        best = max(self._observed, key=lambda o: sign * o["value"])
+        return best["config"]
+
+    def on_trial_complete(self, trial_id, result=None, error=False) -> None:
+        if error or result is None or self._metric not in result:
+            self._configs.pop(trial_id, None)
+            return
+        cfg = self._configs.pop(trial_id, None)
+        if cfg is not None:
+            self._observed.append({"config": cfg, "value": result[self._metric]})
+
+
+class ConcurrencyLimiter(Searcher):
+    def __init__(self, searcher: Searcher, max_concurrent: int):
+        super().__init__(searcher._metric, searcher._mode)
+        self._searcher = searcher
+        self._max = max_concurrent
+        self._live: set = set()
+
+    def set_search_properties(self, metric, mode, config) -> bool:
+        return self._searcher.set_search_properties(metric, mode, config)
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        if len(self._live) >= self._max:
+            return None
+        cfg = self._searcher.suggest(trial_id)
+        if cfg is not None:
+            self._live.add(trial_id)
+        return cfg
+
+    def on_trial_complete(self, trial_id, result=None, error=False) -> None:
+        self._live.discard(trial_id)
+        self._searcher.on_trial_complete(trial_id, result, error)

@@ -1,0 +1,186 @@
+"""Search-space domains for Tune.
+
+Reference analog: ``python/ray/tune/search/sample.py`` (Domain/Float/Integer/
+Categorical samplers) and ``tune/search/variant_generator.py`` (grid
+expansion). Domains are declarative: the variant generator resolves them into
+concrete configs; ``grid_search`` values are cross-producted, stochastic
+domains are drawn per sample.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Any, Callable, Dict, List, Sequence, Tuple
+
+
+class Domain:
+    """A sampleable hyperparameter domain."""
+
+    def sample(self, rng: random.Random) -> Any:
+        raise NotImplementedError
+
+
+class Float(Domain):
+    def __init__(self, lower: float, upper: float, log: bool = False,
+                 q: float | None = None):
+        if log and lower <= 0:
+            raise ValueError("loguniform requires lower > 0")
+        self.lower, self.upper, self.log, self.q = lower, upper, log, q
+
+    def sample(self, rng: random.Random) -> float:
+        if self.log:
+            import math
+
+            v = math.exp(rng.uniform(math.log(self.lower), math.log(self.upper)))
+        else:
+            v = rng.uniform(self.lower, self.upper)
+        if self.q is not None:
+            v = round(round(v / self.q) * self.q, 10)
+        return v
+
+
+class Integer(Domain):
+    def __init__(self, lower: int, upper: int, log: bool = False,
+                 q: int | None = None):
+        self.lower, self.upper, self.log, self.q = lower, upper, log, q
+
+    def sample(self, rng: random.Random) -> int:
+        if self.log:
+            import math
+
+            v = int(math.exp(rng.uniform(math.log(self.lower),
+                                         math.log(self.upper))))
+        else:
+            v = rng.randint(self.lower, self.upper - 1)
+        if self.q is not None:
+            v = int(round(v / self.q) * self.q)
+        return max(self.lower, min(v, self.upper - 1))
+
+
+class Categorical(Domain):
+    def __init__(self, categories: Sequence[Any]):
+        self.categories = list(categories)
+
+    def sample(self, rng: random.Random) -> Any:
+        return rng.choice(self.categories)
+
+
+class Function(Domain):
+    def __init__(self, fn: Callable):
+        self.fn = fn
+
+    def sample(self, rng: random.Random) -> Any:
+        try:
+            return self.fn({"rng": rng})
+        except TypeError:
+            return self.fn()
+
+
+class GridSearch:
+    """Marker for exhaustive grid expansion (cross-producted across keys)."""
+
+    def __init__(self, values: Sequence[Any]):
+        self.values = list(values)
+
+
+def uniform(lower: float, upper: float) -> Float:
+    return Float(lower, upper)
+
+
+def quniform(lower: float, upper: float, q: float) -> Float:
+    return Float(lower, upper, q=q)
+
+
+def loguniform(lower: float, upper: float) -> Float:
+    return Float(lower, upper, log=True)
+
+
+def qloguniform(lower: float, upper: float, q: float) -> Float:
+    return Float(lower, upper, log=True, q=q)
+
+
+def randint(lower: int, upper: int) -> Integer:
+    return Integer(lower, upper)
+
+
+def qrandint(lower: int, upper: int, q: int) -> Integer:
+    return Integer(lower, upper, q=q)
+
+
+def lograndint(lower: int, upper: int) -> Integer:
+    return Integer(lower, upper, log=True)
+
+
+def choice(categories: Sequence[Any]) -> Categorical:
+    return Categorical(categories)
+
+
+def sample_from(fn: Callable) -> Function:
+    return Function(fn)
+
+
+def grid_search(values: Sequence[Any]) -> GridSearch:
+    return GridSearch(values)
+
+
+def _is_grid(v: Any) -> bool:
+    return isinstance(v, GridSearch) or (
+        isinstance(v, dict) and set(v.keys()) == {"grid_search"})
+
+
+def _grid_values(v: Any) -> List[Any]:
+    return v.values if isinstance(v, GridSearch) else list(v["grid_search"])
+
+
+def _walk(space: Dict, path: Tuple = ()):  # yields (path, value)
+    for k, v in space.items():
+        if isinstance(v, dict) and not _is_grid(v):
+            yield from _walk(v, path + (k,))
+        else:
+            yield path + (k,), v
+
+
+def _set_path(cfg: Dict, path: Tuple, value: Any) -> None:
+    for k in path[:-1]:
+        cfg = cfg.setdefault(k, {})
+    cfg[path[-1]] = value
+
+
+def generate_variants(space: Dict, num_samples: int,
+                      seed: int | None = None) -> List[Dict]:
+    """Expand a param space into concrete configs.
+
+    Grid keys cross-product; each of the ``num_samples`` repetitions draws
+    fresh values for stochastic domains (reference semantics: num_samples
+    multiplies the grid).
+    """
+    rng = random.Random(seed)
+    grid_paths: List[Tuple[Tuple, List]] = []
+    leaf_items = list(_walk(space))
+    for path, v in leaf_items:
+        if _is_grid(v):
+            grid_paths.append((path, _grid_values(v)))
+
+    def grid_combos(i: int = 0):
+        if i == len(grid_paths):
+            yield []
+            return
+        path, values = grid_paths[i]
+        for v in values:
+            for rest in grid_combos(i + 1):
+                yield [(path, v)] + rest
+
+    variants = []
+    for _ in range(num_samples):
+        for combo in grid_combos():
+            cfg: Dict = {}
+            fixed = dict(combo)
+            for path, v in leaf_items:
+                if path in fixed:
+                    _set_path(cfg, path, fixed[path])
+                elif isinstance(v, Domain):
+                    _set_path(cfg, path, v.sample(rng))
+                else:
+                    _set_path(cfg, path, v)
+            variants.append(cfg)
+    return variants

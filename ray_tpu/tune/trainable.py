@@ -1,0 +1,196 @@
+"""Trainable: the unit of execution for a Tune trial.
+
+Reference analog: ``tune/trainable/trainable.py`` (class API) and
+``tune/trainable/function_trainable.py:373`` (function API — the user fn runs
+in a thread and ``tune.report`` enqueues results into a queue the trial loop
+drains, same contract as the reference's ``:199-264,:410-414``).
+
+The trial runner actor (`_TrialRunner`) hosts one Trainable instance; the
+controller drives it one ``train()`` call at a time.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import queue
+import threading
+import time
+from typing import Any, Callable, Dict, Optional
+
+from ray_tpu.train.checkpoint import Checkpoint
+
+_session_lock = threading.Lock()
+_session: Optional["_FunctionSession"] = None
+
+DONE = "done"
+TRAINING_ITERATION = "training_iteration"
+
+
+class _FunctionSession:
+    def __init__(self, checkpoint: Optional[Checkpoint]):
+        self.queue: "queue.Queue" = queue.Queue(maxsize=2)
+        self.loaded_checkpoint = checkpoint
+        self.error: Optional[BaseException] = None
+        self.finished = threading.Event()
+
+    def report(self, metrics: Dict[str, Any],
+               checkpoint: Optional[Checkpoint] = None) -> None:
+        self.queue.put(("report", dict(metrics), checkpoint))
+
+
+def report(metrics: Dict[str, Any],
+           checkpoint: Optional[Checkpoint] = None) -> None:
+    """Report metrics (and optionally a checkpoint) from a function trainable.
+
+    Inside a ``JaxTrainer`` train loop use ``ray_tpu.train.report``; this is
+    the Tune-level equivalent for plain tune functions.
+    """
+    with _session_lock:
+        s = _session
+    if s is None:
+        raise RuntimeError("tune.report() called outside a Tune trial")
+    s.report(metrics, checkpoint)
+
+
+def get_checkpoint() -> Optional[Checkpoint]:
+    with _session_lock:
+        s = _session
+    if s is None:
+        raise RuntimeError("tune.get_checkpoint() called outside a Tune trial")
+    return s.loaded_checkpoint
+
+
+class Trainable:
+    """Class API: subclass and implement ``setup``/``step`` (and optionally
+    ``save_checkpoint``/``load_checkpoint`` for PBT / fault tolerance)."""
+
+    def __init__(self, config: Dict[str, Any]):
+        self.config = config
+        self._iteration = 0
+        self.setup(config)
+
+    def setup(self, config: Dict[str, Any]) -> None:
+        pass
+
+    def step(self) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def save_checkpoint(self, checkpoint_dir: str) -> Optional[Dict]:
+        return None
+
+    def load_checkpoint(self, checkpoint: Dict) -> None:
+        pass
+
+    def reset_config(self, new_config: Dict[str, Any]) -> bool:
+        return False
+
+    def cleanup(self) -> None:
+        pass
+
+    # -- controller-facing --
+    def train(self) -> Dict[str, Any]:
+        result = self.step()
+        self._iteration += 1
+        result.setdefault(DONE, False)
+        result[TRAINING_ITERATION] = self._iteration
+        result["time_total_s"] = result.get("time_total_s", time.time())
+        return result
+
+    def save(self, checkpoint_dir: str) -> Optional[str]:
+        os.makedirs(checkpoint_dir, exist_ok=True)
+        data = self.save_checkpoint(checkpoint_dir)
+        path = os.path.join(checkpoint_dir, "trainable.pkl")
+        with open(path, "wb") as f:
+            pickle.dump({"data": data, "iteration": self._iteration}, f)
+        return checkpoint_dir
+
+    def restore(self, checkpoint_dir: str) -> None:
+        path = os.path.join(checkpoint_dir, "trainable.pkl")
+        with open(path, "rb") as f:
+            payload = pickle.load(f)
+        self._iteration = payload["iteration"]
+        if payload["data"] is not None:
+            self.load_checkpoint(payload["data"])
+
+
+class FunctionTrainable(Trainable):
+    """Adapts ``fn(config)`` to the Trainable interface by running it in a
+    thread and draining ``tune.report`` results one ``train()`` at a time."""
+
+    _fn: Callable = None  # set by wrap_function subclassing
+
+    def setup(self, config: Dict[str, Any]) -> None:
+        self._thread: Optional[threading.Thread] = None
+        self._fsession: Optional[_FunctionSession] = None
+        self._restored_checkpoint: Optional[Checkpoint] = None
+        self._last_checkpoint: Optional[Checkpoint] = None
+
+    def _start(self) -> None:
+        global _session
+        fsession = _FunctionSession(self._restored_checkpoint)
+
+        def runner():
+            try:
+                self._fn(self.config)
+            except BaseException as e:  # surfaced via train()
+                fsession.error = e
+            finally:
+                fsession.finished.set()
+                fsession.queue.put(("end", None, None))
+
+        with _session_lock:
+            _session = fsession
+        self._fsession = fsession
+        self._thread = threading.Thread(target=runner, daemon=True)
+        self._thread.start()
+
+    def train(self) -> Dict[str, Any]:
+        if self._thread is None:
+            self._start()
+        kind, metrics, checkpoint = self._fsession.queue.get()
+        if kind == "end":
+            if self._fsession.error is not None:
+                raise self._fsession.error
+            result = dict(self._last_result) if hasattr(self, "_last_result") else {}
+            result[DONE] = True
+            result[TRAINING_ITERATION] = self._iteration
+            return result
+        self._iteration += 1
+        result = dict(metrics)
+        result.setdefault(DONE, False)
+        result[TRAINING_ITERATION] = self._iteration
+        self._last_result = result
+        if checkpoint is not None:
+            self._last_checkpoint = checkpoint
+        return result
+
+    def save_checkpoint(self, checkpoint_dir: str) -> Optional[Dict]:
+        if self._last_checkpoint is not None:
+            return {"checkpoint": self._last_checkpoint.to_dict()}
+        return None
+
+    def load_checkpoint(self, checkpoint: Dict) -> None:
+        self._restored_checkpoint = Checkpoint.from_dict(checkpoint["checkpoint"])
+
+    def cleanup(self) -> None:
+        if self._fsession is not None:
+            self._fsession.finished.wait(timeout=0)
+
+
+def wrap_function(fn: Callable) -> type:
+    """Build a FunctionTrainable subclass around ``fn(config)``."""
+
+    class _Wrapped(FunctionTrainable):
+        pass
+
+    _Wrapped._fn = staticmethod(fn)
+    _Wrapped.__name__ = getattr(fn, "__name__", "fn")
+    return _Wrapped
+
+
+def with_resources(trainable, resources: Dict[str, float]):
+    """Attach per-trial resource requirements to a trainable."""
+    trainable = trainable if isinstance(trainable, type) or callable(trainable) else trainable
+    setattr(trainable, "_tune_resources", dict(resources))
+    return trainable

@@ -1,0 +1,80 @@
+"""Trial state.
+
+Reference analog: ``python/ray/tune/experiment/trial.py:307`` (``Trial``) —
+pared to the fields the controller, schedulers, and result reporting need.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+PENDING = "PENDING"
+RUNNING = "RUNNING"
+PAUSED = "PAUSED"
+TERMINATED = "TERMINATED"
+ERROR = "ERROR"
+
+
+class Trial:
+    _counter = 0
+
+    def __init__(self, trial_id: str, config: Dict[str, Any],
+                 experiment_name: str = ""):
+        self.trial_id = trial_id
+        self.config = config
+        self.experiment_name = experiment_name
+        self.status = PENDING
+        self.results: List[Dict[str, Any]] = []
+        self.last_result: Dict[str, Any] = {}
+        self.error: Optional[str] = None
+        self.num_failures = 0
+        self.checkpoint_path: Optional[str] = None
+        self.restore_path: Optional[str] = None  # set by PBT exploit / resume
+        self.start_time: Optional[float] = None
+        self.runner = None  # ActorHandle while RUNNING
+        self.inflight = None  # ObjectRef of pending train() call
+
+    @property
+    def training_iteration(self) -> int:
+        return self.last_result.get("training_iteration", 0)
+
+    def metric_value(self, metric: str) -> Optional[float]:
+        return self.last_result.get(metric)
+
+    def on_result(self, result: Dict[str, Any]) -> None:
+        self.results.append(result)
+        self.last_result = result
+
+    def mark_running(self, runner) -> None:
+        self.status = RUNNING
+        self.runner = runner
+        if self.start_time is None:
+            self.start_time = time.time()
+
+    def state(self) -> Dict[str, Any]:
+        return {
+            "trial_id": self.trial_id,
+            "config": self.config,
+            "status": self.status,
+            "last_result": self.last_result,
+            "error": self.error,
+            "num_failures": self.num_failures,
+            "checkpoint_path": self.checkpoint_path,
+        }
+
+    @classmethod
+    def from_state(cls, state: Dict[str, Any],
+                   experiment_name: str = "") -> "Trial":
+        t = cls(state["trial_id"], state["config"], experiment_name)
+        t.status = state["status"]
+        t.last_result = state.get("last_result", {})
+        if t.last_result:
+            t.results = [t.last_result]
+        t.error = state.get("error")
+        t.num_failures = state.get("num_failures", 0)
+        t.checkpoint_path = state.get("checkpoint_path")
+        return t
+
+    def __repr__(self) -> str:
+        return f"Trial({self.trial_id}, {self.status}, it={self.training_iteration})"

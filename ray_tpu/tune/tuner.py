@@ -1,0 +1,214 @@
+"""Tuner: the user-facing Tune entry point.
+
+Reference analog: ``tune/tuner.py:59`` (``Tuner.fit :337``) +
+``tune/impl/tuner_internal.py:63`` + ``ResultGrid``. Accepts a function
+trainable, a Trainable subclass, or a ``JaxTrainer`` (the Train-on-Tune
+layering of ``train/base_trainer.py:728`` — the trainer's driver loop runs
+inside the trial actor).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Callable, Dict, List, Optional
+
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.config import RunConfig
+from ray_tpu.tune.controller import TuneController
+from ray_tpu.tune.schedulers import TrialScheduler
+from ray_tpu.tune.search import BasicVariantGenerator, Searcher
+from ray_tpu.tune.trainable import Trainable, wrap_function
+from ray_tpu.tune.trial import ERROR, TERMINATED, Trial
+
+
+@dataclasses.dataclass
+class TuneConfig:
+    metric: Optional[str] = None
+    mode: str = "max"
+    num_samples: int = 1
+    max_concurrent_trials: int = 0
+    search_alg: Optional[Searcher] = None
+    scheduler: Optional[TrialScheduler] = None
+    checkpoint_freq: int = 0
+    seed: Optional[int] = None
+
+
+class Result:
+    def __init__(self, trial: Trial):
+        self.metrics = trial.last_result
+        self.config = trial.config
+        self.error = trial.error
+        self.path = trial.checkpoint_path
+        self.metrics_history = trial.results
+        self.trial_id = trial.trial_id
+        self.checkpoint = None
+        if trial.checkpoint_path:
+            ckpt_file = os.path.join(trial.checkpoint_path, "trainable.pkl")
+            if os.path.exists(ckpt_file):
+                import pickle
+
+                with open(ckpt_file, "rb") as f:
+                    payload = pickle.load(f)
+                data = payload.get("data")
+                if isinstance(data, dict) and "checkpoint" in data:
+                    self.checkpoint = Checkpoint.from_dict(data["checkpoint"])
+
+    def __repr__(self) -> str:
+        return f"Result({self.trial_id}, metrics={self.metrics})"
+
+
+class ResultGrid:
+    def __init__(self, trials: List[Trial], metric: Optional[str], mode: str):
+        self._trials = trials
+        self._metric = metric
+        self._mode = mode
+        self._results = [Result(t) for t in trials]
+
+    def __len__(self) -> int:
+        return len(self._results)
+
+    def __getitem__(self, i: int) -> Result:
+        return self._results[i]
+
+    @property
+    def errors(self) -> List[str]:
+        return [t.error for t in self._trials if t.status == ERROR]
+
+    @property
+    def num_terminated(self) -> int:
+        return sum(1 for t in self._trials if t.status == TERMINATED)
+
+    def get_best_result(self, metric: Optional[str] = None,
+                        mode: Optional[str] = None) -> Result:
+        metric = metric or self._metric
+        mode = mode or self._mode
+        if metric is None:
+            raise ValueError("metric required (set TuneConfig.metric)")
+        sign = 1 if mode == "max" else -1
+        scored = [r for r in self._results if metric in (r.metrics or {})]
+        if not scored:
+            raise RuntimeError("no trial reported the metric " + metric)
+        return max(scored, key=lambda r: sign * r.metrics[metric])
+
+    def get_dataframe(self):
+        import pandas as pd
+
+        rows = []
+        for r in self._results:
+            row = dict(r.metrics or {})
+            row["trial_id"] = r.trial_id
+            for k, v in (r.config or {}).items():
+                row[f"config/{k}"] = v
+            rows.append(row)
+        return pd.DataFrame(rows)
+
+
+def _to_trainable_cls(trainable: Any, param_space: Dict) -> type:
+    from ray_tpu.train.trainer import JaxTrainer
+
+    if isinstance(trainable, type) and issubclass(trainable, Trainable):
+        return trainable
+    if isinstance(trainable, JaxTrainer):
+        trainer = trainable
+
+        def _train_fn(config: Dict[str, Any]) -> None:
+            import dataclasses as dc
+
+            import ray_tpu.tune as tune
+
+            merged = dict(trainer.train_config or {})
+            merged.update(config.get("train_loop_config", config))
+            trial_run_cfg = dc.replace(
+                trainer.run_config,
+                name=(trainer.run_config.name or "trial")
+                + f"_{os.getpid()}_{id(config):x}")
+            run = JaxTrainer(
+                trainer.train_fn, train_loop_config=merged,
+                scaling_config=trainer.scaling, run_config=trial_run_cfg,
+                datasets=trainer.datasets,
+                use_jax_distributed=trainer.use_jax_distributed,
+                resume_from_checkpoint=trainer.resume_checkpoint)
+            result = run.fit()
+            if result.error is not None:
+                raise result.error
+            for m in result.metrics_history:
+                tune.report(m)
+
+        cls = wrap_function(_train_fn)
+        # The trial actor is only the train *driver*; the worker gang's
+        # resources are reserved atomically by the trainer's own placement
+        # group (reference: trial PG inheritance, backend_executor.py:179).
+        # Reserving them here too would deadlock supervisor vs. gang.
+        cls._tune_resources = {"cpu": 1}
+        return cls
+    if callable(trainable):
+        return wrap_function(trainable)
+    raise TypeError(f"unsupported trainable: {trainable!r}")
+
+
+class Tuner:
+    def __init__(self, trainable: Any, *, param_space: Optional[Dict] = None,
+                 tune_config: Optional[TuneConfig] = None,
+                 run_config: Optional[RunConfig] = None,
+                 _restored_trials: Optional[List[Trial]] = None):
+        self._trainable = trainable
+        self._param_space = param_space or {}
+        self._tune_config = tune_config or TuneConfig()
+        self._run_config = run_config or RunConfig()
+        self._restored_trials = _restored_trials
+
+    @classmethod
+    def restore(cls, path: str, trainable: Any,
+                tune_config: Optional[TuneConfig] = None) -> "Tuner":
+        """Resume an interrupted experiment from its directory."""
+        trials = TuneController.load_experiment_state(path)
+        run_config = RunConfig(name=os.path.basename(path),
+                               storage_path=os.path.dirname(path))
+        t = cls(trainable, tune_config=tune_config or TuneConfig(),
+                run_config=run_config, _restored_trials=trials)
+        return t
+
+    def fit(self) -> ResultGrid:
+        tc = self._tune_config
+        cls = _to_trainable_cls(self._trainable, self._param_space)
+        searcher = tc.search_alg
+        if searcher is None:
+            searcher = BasicVariantGenerator(seed=tc.seed)
+        if isinstance(searcher, BasicVariantGenerator):
+            searcher.set_num_samples(tc.num_samples)
+        searcher.set_search_properties(tc.metric, tc.mode, self._param_space)
+
+        name = self._run_config.name or "tune_experiment"
+        storage = self._run_config.storage_path or os.path.join(
+            os.path.expanduser("~"), "ray_tpu_results")
+        experiment_dir = os.path.join(storage, name)
+
+        restored = self._restored_trials
+        if restored is not None:
+            # don't re-suggest configs for trials we already have
+            class _NoMore(Searcher):
+                def suggest(self, trial_id):
+                    return None
+
+                def on_trial_complete(self, *a, **k):
+                    pass
+
+            searcher = _NoMore()
+
+        checkpoint_freq = tc.checkpoint_freq
+        from ray_tpu.tune.schedulers import PopulationBasedTraining
+
+        if isinstance(tc.scheduler, PopulationBasedTraining) and not checkpoint_freq:
+            checkpoint_freq = 1  # PBT exploit needs regular checkpoints
+
+        controller = TuneController(
+            cls, searcher, tc.scheduler, experiment_dir, name,
+            tc.metric, tc.mode,
+            max_concurrent=tc.max_concurrent_trials,
+            max_failures=self._run_config.failure_config.max_failures,
+            checkpoint_freq=checkpoint_freq,
+            stop=getattr(self._run_config, "stop", None),
+            restored_trials=restored)
+        trials = controller.run()
+        return ResultGrid(trials, tc.metric, tc.mode)

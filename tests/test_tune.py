@@ -1,0 +1,216 @@
+"""Tune layer: variant generation, trial loop, schedulers, PBT, restore,
+and the Train-on-Tune integration (reference test model:
+``python/ray/tune/tests/test_tune_*.py``)."""
+
+import os
+
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.train import JaxTrainer, RunConfig, ScalingConfig
+from ray_tpu.tune import TuneConfig, Tuner
+
+
+def test_generate_variants_grid_and_samples():
+    from ray_tpu.tune.search_space import generate_variants
+
+    space = {"a": tune.grid_search([1, 2]), "b": tune.uniform(0, 1), "c": 7}
+    variants = generate_variants(space, num_samples=3, seed=0)
+    assert len(variants) == 6  # 2 grid x 3 samples
+    assert {v["a"] for v in variants} == {1, 2}
+    assert all(0 <= v["b"] <= 1 for v in variants)
+    assert all(v["c"] == 7 for v in variants)
+
+
+def test_nested_space_and_domains():
+    from ray_tpu.tune.search_space import generate_variants
+
+    space = {
+        "opt": {"lr": tune.loguniform(1e-4, 1e-1), "wd": tune.choice([0, 0.1])},
+        "layers": tune.randint(1, 5),
+    }
+    (v,) = generate_variants(space, 1, seed=1)
+    assert 1e-4 <= v["opt"]["lr"] <= 1e-1
+    assert v["opt"]["wd"] in (0, 0.1)
+    assert 1 <= v["layers"] < 5
+
+
+def test_function_trainable_basic(rt_cluster, tmp_path):
+    def objective(config):
+        for i in range(3):
+            tune.report({"score": config["x"] * (i + 1)})
+
+    grid = Tuner(
+        objective,
+        param_space={"x": tune.grid_search([1, 2, 3])},
+        tune_config=TuneConfig(metric="score", mode="max"),
+        run_config=RunConfig(name="basic", storage_path=str(tmp_path)),
+    ).fit()
+    assert len(grid) == 3
+    best = grid.get_best_result()
+    assert best.config["x"] == 3
+    assert best.metrics["score"] == 9
+    assert grid.num_terminated == 3
+
+
+def test_class_trainable_and_stop_criteria(rt_cluster, tmp_path):
+    class MyTrainable(tune.Trainable):
+        def setup(self, config):
+            self.x = config["x"]
+
+        def step(self):
+            return {"value": self.x * self._iteration}
+
+    grid = Tuner(
+        MyTrainable,
+        param_space={"x": tune.grid_search([1, 2])},
+        tune_config=TuneConfig(metric="value", mode="max"),
+        run_config=RunConfig(name="cls", storage_path=str(tmp_path),
+                             stop={"training_iteration": 4}),
+    ).fit()
+    assert len(grid) == 2
+    for r in grid:
+        assert r.metrics["training_iteration"] == 4
+
+
+def test_asha_stops_bad_trials(rt_cluster, tmp_path):
+    def objective(config):
+        for i in range(20):
+            tune.report({"acc": config["q"] * (i + 1)})
+
+    grid = Tuner(
+        objective,
+        param_space={"q": tune.grid_search([0.1, 0.2, 0.9, 1.0])},
+        tune_config=TuneConfig(
+            metric="acc", mode="max",
+            scheduler=tune.AsyncHyperBandScheduler(
+                max_t=20, grace_period=2, reduction_factor=2)),
+        run_config=RunConfig(name="asha", storage_path=str(tmp_path)),
+    ).fit()
+    iters = {r.config["q"]: r.metrics.get("training_iteration", 0) for r in grid}
+    # the best trial is never rung-stopped; at least one bad trial is
+    assert iters[1.0] == 20
+    assert min(iters[0.1], iters[0.2]) < 20
+
+
+def test_tune_failure_and_retry(rt_cluster, tmp_path):
+    marker = os.path.join(str(tmp_path), "failed_once")
+
+    def flaky(config):
+        if not os.path.exists(marker):
+            with open(marker, "w") as f:
+                f.write("x")
+            raise RuntimeError("boom")
+        tune.report({"ok": 1})
+
+    grid = Tuner(
+        flaky,
+        param_space={},
+        tune_config=TuneConfig(metric="ok", mode="max"),
+        run_config=RunConfig(
+            name="flaky", storage_path=str(tmp_path),
+            failure_config=tune.FailureConfig(max_failures=2)),
+    ).fit()
+    assert grid.get_best_result().metrics["ok"] == 1
+
+
+def test_tune_error_reported(rt_cluster, tmp_path):
+    def bad(config):
+        raise ValueError("always fails")
+
+    grid = Tuner(
+        bad, param_space={},
+        run_config=RunConfig(name="bad", storage_path=str(tmp_path)),
+    ).fit()
+    assert len(grid.errors) == 1
+    assert "always fails" in grid.errors[0]
+
+
+def test_pbt_mutates_from_checkpoint(rt_cluster, tmp_path):
+    class PBTTrainable(tune.Trainable):
+        def setup(self, config):
+            self.lr = config["lr"]
+            self.level = 0
+
+        def step(self):
+            self.level += self.lr
+            return {"level": self.level, "lr": self.lr}
+
+        def save_checkpoint(self, d):
+            return {"level": self.level}
+
+        def load_checkpoint(self, data):
+            self.level = data["level"]
+
+    grid = Tuner(
+        PBTTrainable,
+        param_space={"lr": tune.grid_search([0.01, 1.0])},
+        tune_config=TuneConfig(
+            metric="level", mode="max",
+            scheduler=tune.PopulationBasedTraining(
+                perturbation_interval=2,
+                hyperparam_mutations={"lr": tune.uniform(0.5, 2.0)})),
+        run_config=RunConfig(name="pbt", storage_path=str(tmp_path),
+                             stop={"training_iteration": 8}),
+    ).fit()
+    # the weak trial should have been exploited toward the strong one's lr
+    levels = sorted(r.metrics["level"] for r in grid)
+    assert levels[-1] >= 7.9  # strong trial ran unimpeded
+    assert levels[0] > 0.08 * 8  # weak trial improved beyond pure lr=0.01
+
+
+def test_experiment_state_and_restore(rt_cluster, tmp_path):
+    def objective(config):
+        tune.report({"v": config["x"]})
+
+    Tuner(
+        objective, param_space={"x": tune.grid_search([5, 6])},
+        tune_config=TuneConfig(metric="v", mode="max"),
+        run_config=RunConfig(name="exp", storage_path=str(tmp_path)),
+    ).fit()
+    state_path = os.path.join(str(tmp_path), "exp", "experiment_state.json")
+    assert os.path.exists(state_path)
+    restored = Tuner.restore(os.path.join(str(tmp_path), "exp"), objective,
+                             tune_config=TuneConfig(metric="v", mode="max"))
+    grid = restored.fit()  # all TERMINATED -> nothing re-runs
+    assert grid.num_terminated == 2
+
+
+def test_trainer_on_tune(rt_cluster, tmp_path):
+    def loop(config):
+        from ray_tpu import train
+
+        for i in range(2):
+            train.report({"loss": config["lr"] * (i + 1)})
+
+    trainer = JaxTrainer(
+        loop, train_loop_config={"lr": 1.0},
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(name="inner", storage_path=str(tmp_path)))
+    grid = Tuner(
+        trainer,
+        param_space={"train_loop_config": {"lr": tune.grid_search([0.5, 2.0])}},
+        tune_config=TuneConfig(metric="loss", mode="min"),
+        run_config=RunConfig(name="trainer_tune", storage_path=str(tmp_path)),
+    ).fit()
+    assert len(grid) == 2
+    assert grid.get_best_result().config["train_loop_config"]["lr"] == 0.5
+
+
+def test_quasi_random_search(rt_cluster, tmp_path):
+    def objective(config):
+        tune.report({"obj": -(config["x"] - 3.0) ** 2})
+
+    grid = Tuner(
+        objective,
+        param_space={"x": tune.uniform(0, 10)},
+        tune_config=TuneConfig(
+            metric="obj", mode="max",
+            search_alg=tune.QuasiRandomSearch(num_samples=10, seed=3),
+            max_concurrent_trials=2),
+        run_config=RunConfig(name="qrs", storage_path=str(tmp_path)),
+    ).fit()
+    assert len(grid) == 10
+    best = grid.get_best_result()
+    assert best.metrics["obj"] > -9.0
