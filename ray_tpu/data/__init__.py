@@ -1,0 +1,32 @@
+"""ray_tpu.data: streaming distributed data (the reference's ``ray.data``).
+
+Columnar-numpy blocks flow through fused map tasks with bounded in-flight
+parallelism; all-to-all ops run as task-graph map/reduce; consumption
+streams into batches (numpy / pandas / jnp-on-device for the TPU feed path).
+"""
+
+from ray_tpu.data.aggregate import (  # noqa: F401
+    AggregateFn,
+    Count,
+    Max,
+    Mean,
+    Min,
+    Quantile,
+    Std,
+    Sum,
+)
+from ray_tpu.data.context import DataContext  # noqa: F401
+from ray_tpu.data.dataset import (  # noqa: F401
+    Dataset,
+    from_arrow,
+    from_items,
+    from_numpy,
+    from_pandas,
+    range,
+    read_csv,
+    read_json,
+    read_numpy,
+    read_parquet,
+)
+from ray_tpu.data.iterator import DataIterator  # noqa: F401
+from ray_tpu.data.logical import ActorPoolStrategy  # noqa: F401

@@ -1,0 +1,77 @@
+"""Aggregations for groupby / global aggregate.
+
+Reference analog: ``data/aggregate.py`` (AggregateFn: Count/Sum/Min/Max/
+Mean/Std/Quantile) — implemented as vectorized numpy reductions over
+hash-partitioned blocks.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, List, Optional
+
+import numpy as np
+
+from ray_tpu.data import block as B
+
+
+@dataclasses.dataclass
+class AggregateFn:
+    name: str
+    on: Optional[str]
+    reduce: Callable[[np.ndarray], float]
+
+    def output_name(self) -> str:
+        return f"{self.name}({self.on})" if self.on else self.name
+
+
+def Count() -> AggregateFn:
+    return AggregateFn("count", None, lambda v: len(v))
+
+
+def Sum(on: str) -> AggregateFn:
+    return AggregateFn("sum", on, np.sum)
+
+
+def Min(on: str) -> AggregateFn:
+    return AggregateFn("min", on, np.min)
+
+
+def Max(on: str) -> AggregateFn:
+    return AggregateFn("max", on, np.max)
+
+
+def Mean(on: str) -> AggregateFn:
+    return AggregateFn("mean", on, np.mean)
+
+
+def Std(on: str) -> AggregateFn:
+    return AggregateFn("std", on, lambda v: float(np.std(v, ddof=1)) if len(v) > 1 else 0.0)
+
+
+def Quantile(on: str, q: float = 0.5) -> AggregateFn:
+    return AggregateFn(f"quantile_{q}", on, lambda v: float(np.quantile(v, q)))
+
+
+def aggregate_block(block: B.Block, key: Optional[str],
+                    aggs: List[AggregateFn]) -> B.Block:
+    """Aggregate one (hash-partitioned) block, optionally grouped by key."""
+    n = B.num_rows(block)
+    if key is None:
+        if n == 0:
+            return {}
+        out = {}
+        for agg in aggs:
+            col = block[agg.on] if agg.on else np.arange(n)
+            out[agg.output_name()] = np.asarray([agg.reduce(col)])
+        return out
+    if n == 0:
+        return {}
+    keys = block[key]
+    uniq, inverse = np.unique(keys, return_inverse=True)
+    out = {key: uniq}
+    for agg in aggs:
+        col = block[agg.on] if agg.on else np.arange(n)
+        vals = [agg.reduce(col[inverse == i]) for i in range(len(uniq))]
+        out[agg.output_name()] = np.asarray(vals)
+    return out

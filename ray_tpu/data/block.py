@@ -1,0 +1,121 @@
+"""Blocks: the unit of distributed data.
+
+Reference analog: ``python/ray/data/block.py`` (``Block``/``BlockMetadata``/
+``BlockAccessor``). The native format here is **columnar numpy** — a dict of
+equal-length ``np.ndarray`` columns — because that is what feeds ``jnp``
+device puts without conversion (the reference's native format is Arrow for
+the same zero-copy reason on CPU).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Iterator, List, Optional, Union
+
+import numpy as np
+
+# A block is a dict of equal-length numpy columns.
+Block = Dict[str, np.ndarray]
+
+
+@dataclasses.dataclass
+class BlockMetadata:
+    num_rows: int
+    size_bytes: int
+    schema: Optional[Dict[str, str]] = None
+
+
+def _to_array(values: List[Any]) -> np.ndarray:
+    arr = np.asarray(values)
+    if arr.dtype == object and values and isinstance(values[0], str):
+        arr = np.asarray(values, dtype=np.str_)
+    return arr
+
+
+def from_rows(rows: List[Dict[str, Any]]) -> Block:
+    if not rows:
+        return {}
+    cols = {}
+    for key in rows[0]:
+        cols[key] = _to_array([r[key] for r in rows])
+    return cols
+
+
+def from_items(items: List[Any]) -> Block:
+    if items and isinstance(items[0], dict):
+        return from_rows(items)
+    return {"item": _to_array(items)}
+
+
+def num_rows(block: Block) -> int:
+    if not block:
+        return 0
+    return len(next(iter(block.values())))
+
+
+def size_bytes(block: Block) -> int:
+    return sum(int(getattr(c, "nbytes", 0)) for c in block.values())
+
+
+def metadata(block: Block) -> BlockMetadata:
+    return BlockMetadata(
+        num_rows=num_rows(block), size_bytes=size_bytes(block),
+        schema={k: str(v.dtype) for k, v in block.items()})
+
+
+def slice_block(block: Block, start: int, end: int) -> Block:
+    return {k: v[start:end] for k, v in block.items()}
+
+
+def take_rows(block: Block, indices: np.ndarray) -> Block:
+    return {k: v[indices] for k, v in block.items()}
+
+
+def concat(blocks: List[Block]) -> Block:
+    blocks = [b for b in blocks if num_rows(b) > 0]
+    if not blocks:
+        return {}
+    keys = list(blocks[0].keys())
+    return {k: np.concatenate([b[k] for b in blocks]) for k in keys}
+
+
+def iter_rows(block: Block) -> Iterator[Dict[str, Any]]:
+    n = num_rows(block)
+    keys = list(block.keys())
+    for i in range(n):
+        yield {k: block[k][i].item() if block[k][i].shape == () else block[k][i]
+               for k in keys}
+
+
+def to_pandas(block: Block):
+    import pandas as pd
+
+    return pd.DataFrame({k: list(v) if v.ndim > 1 else v
+                         for k, v in block.items()})
+
+
+def from_pandas(df) -> Block:
+    return {str(c): df[c].to_numpy() for c in df.columns}
+
+
+def to_batch(block: Block, batch_format: str):
+    if batch_format in ("numpy", "default"):
+        return dict(block)
+    if batch_format == "pandas":
+        return to_pandas(block)
+    raise ValueError(f"unsupported batch_format {batch_format!r}")
+
+
+def from_batch(batch: Union[Block, "Any"]) -> Block:
+    if isinstance(batch, dict):
+        return {k: np.asarray(v) for k, v in batch.items()}
+    try:
+        import pandas as pd
+
+        if isinstance(batch, pd.DataFrame):
+            return from_pandas(batch)
+    except ImportError:
+        pass
+    raise TypeError(
+        f"map_batches UDF must return a dict of arrays or a DataFrame, "
+        f"got {type(batch)}")

@@ -1,0 +1,26 @@
+"""DataContext: execution knobs (reference: ``data/context.py``)."""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Optional
+
+_local = threading.local()
+
+
+@dataclasses.dataclass
+class DataContext:
+    target_max_block_size: int = 128 * 1024 * 1024
+    target_min_block_size: int = 1 * 1024 * 1024
+    max_tasks_in_flight: int = 8
+    read_parallelism: int = 8
+    eager_free: bool = True
+
+    @staticmethod
+    def get_current() -> "DataContext":
+        ctx = getattr(_local, "ctx", None)
+        if ctx is None:
+            ctx = DataContext()
+            _local.ctx = ctx
+        return ctx

@@ -1,0 +1,356 @@
+"""Dataset: the lazy, streaming, distributed data API.
+
+Reference analog: ``data/dataset.py:178`` (``Dataset``) + the creation
+functions in ``data/read_api.py``. A Dataset is (read tasks, logical ops);
+nothing executes until consumption, and consumption streams: blocks flow
+through fused map tasks with bounded in-flight parallelism
+(executor.execute_streaming). ``materialize()`` pins the result refs.
+"""
+
+from __future__ import annotations
+
+import builtins
+from typing import Any, Callable, Dict, Iterator, List, Optional, Union
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.data import aggregate as agg_mod
+from ray_tpu.data import block as B
+from ray_tpu.data import datasource as ds_mod
+from ray_tpu.data import logical as L
+from ray_tpu.data.context import DataContext
+from ray_tpu.data.iterator import (
+    DataIterator,
+    StreamSplitIterator,
+    _SplitCoordinator,
+    batches_from_blocks,
+    prefetched,
+)
+
+
+@ray_tpu.remote
+def _read_task(task) -> B.Block:
+    return task()
+
+
+@ray_tpu.remote
+def _write_task(block: B.Block, path: str, fmt: str, index: int) -> str:
+    return ds_mod.write_block(block, path, fmt, index)
+
+
+@ray_tpu.remote
+def _num_rows_task(block: B.Block) -> int:
+    return B.num_rows(block)
+
+
+class Dataset:
+    def __init__(self, read_tasks: Optional[List] = None,
+                 ops: Optional[List[L.LogicalOp]] = None,
+                 materialized_refs: Optional[List] = None):
+        self._read_tasks = read_tasks or []
+        self._ops = ops or []
+        self._materialized = materialized_refs
+
+    def _with_op(self, op: L.LogicalOp) -> "Dataset":
+        return Dataset(self._read_tasks, self._ops + [op], self._materialized)
+
+    # ---- execution ----
+
+    def _source_refs(self) -> Iterator:
+        if self._materialized is not None:
+            yield from self._materialized
+            return
+        ctx = DataContext.get_current()
+        import collections
+
+        inflight: collections.deque = collections.deque()
+        tasks = iter(self._read_tasks)
+        exhausted = False
+        while True:
+            while not exhausted and len(inflight) < ctx.max_tasks_in_flight:
+                try:
+                    t = next(tasks)
+                except StopIteration:
+                    exhausted = True
+                    break
+                inflight.append(_read_task.remote(t))
+            if not inflight:
+                return
+            yield inflight.popleft()
+
+    def _execute_refs(self) -> Iterator:
+        from ray_tpu.data.executor import execute_streaming
+
+        ctx = DataContext.get_current()
+        return execute_streaming(self._source_refs(), self._ops, ctx)
+
+    def materialize(self) -> "Dataset":
+        refs = list(self._execute_refs())
+        return Dataset(materialized_refs=refs)
+
+    # ---- transforms (lazy) ----
+
+    def map_batches(self, fn, *, batch_size: Optional[int] = None,
+                    batch_format: str = "numpy", compute=None,
+                    fn_args=(), fn_kwargs=None, fn_constructor_args=(),
+                    num_cpus: Optional[float] = None,
+                    num_tpus: float = 0) -> "Dataset":
+        return self._with_op(L.MapBatches(
+            fn, batch_size, batch_format, tuple(fn_args), fn_kwargs or {},
+            compute, tuple(fn_constructor_args), num_tpus, num_cpus))
+
+    def map(self, fn: Callable) -> "Dataset":
+        return self._with_op(L.MapRows(fn))
+
+    def filter(self, fn: Callable) -> "Dataset":
+        return self._with_op(L.Filter(fn))
+
+    def flat_map(self, fn: Callable) -> "Dataset":
+        return self._with_op(L.FlatMap(fn))
+
+    def add_column(self, name: str, fn: Callable) -> "Dataset":
+        return self._with_op(L.AddColumn(name, fn))
+
+    def drop_columns(self, columns: List[str]) -> "Dataset":
+        return self._with_op(L.DropColumns(columns))
+
+    def select_columns(self, columns: List[str]) -> "Dataset":
+        return self._with_op(L.SelectColumns(columns))
+
+    def limit(self, n: int) -> "Dataset":
+        return self._with_op(L.Limit(n))
+
+    def random_sample(self, fraction: float,
+                      seed: Optional[int] = None) -> "Dataset":
+        return self._with_op(L.RandomSample(fraction, seed))
+
+    def random_shuffle(self, seed: Optional[int] = None) -> "Dataset":
+        return self._with_op(L.RandomShuffle(seed))
+
+    def repartition(self, num_blocks: int) -> "Dataset":
+        return self._with_op(L.Repartition(num_blocks))
+
+    def sort(self, key: str, descending: bool = False) -> "Dataset":
+        return self._with_op(L.Sort(key, descending))
+
+    def union(self, *others: "Dataset") -> "Dataset":
+        return self._with_op(L.Union(list(others)))
+
+    def zip(self, other: "Dataset") -> "Dataset":
+        return self._with_op(L.Zip(other))
+
+    # ---- groupby / aggregates ----
+
+    def groupby(self, key: str) -> "GroupedData":
+        return GroupedData(self, key)
+
+    def aggregate(self, *aggs) -> Dict[str, Any]:
+        ds = self._with_op(L.Aggregate(None, list(aggs)))
+        out = B.concat([ray_tpu.get(r) for r in ds._execute_refs()])
+        return {k: v[0].item() if hasattr(v[0], "item") else v[0]
+                for k, v in out.items()}
+
+    def sum(self, on: str):
+        return self.aggregate(agg_mod.Sum(on))[f"sum({on})"]
+
+    def min(self, on: str):
+        return self.aggregate(agg_mod.Min(on))[f"min({on})"]
+
+    def max(self, on: str):
+        return self.aggregate(agg_mod.Max(on))[f"max({on})"]
+
+    def mean(self, on: str):
+        return self.aggregate(agg_mod.Mean(on))[f"mean({on})"]
+
+    def std(self, on: str):
+        return self.aggregate(agg_mod.Std(on))[f"std({on})"]
+
+    # ---- consumption ----
+
+    def count(self) -> int:
+        # row counts resolve remotely — blocks never transfer to the driver
+        return sum(ray_tpu.get(
+            [_num_rows_task.remote(r) for r in self._execute_refs()]))
+
+    def take(self, n: int = 20) -> List[Dict[str, Any]]:
+        rows: List[Dict] = []
+        for ref in self.limit(n)._execute_refs():
+            rows.extend(B.iter_rows(ray_tpu.get(ref)))
+            if len(rows) >= n:
+                break
+        return rows[:n]
+
+    def take_all(self) -> List[Dict[str, Any]]:
+        rows: List[Dict] = []
+        for ref in self._execute_refs():
+            rows.extend(B.iter_rows(ray_tpu.get(ref)))
+        return rows
+
+    def show(self, n: int = 20) -> None:
+        for row in self.take(n):
+            print(row)
+
+    def schema(self) -> Optional[Dict[str, str]]:
+        for ref in self._execute_refs():
+            blk = ray_tpu.get(ref)
+            if B.num_rows(blk):
+                return {k: str(v.dtype) for k, v in blk.items()}
+        return None
+
+    def columns(self) -> List[str]:
+        s = self.schema()
+        return list(s) if s else []
+
+    def num_blocks(self) -> int:
+        return len(list(self._execute_refs()))
+
+    def iter_rows(self) -> Iterator[Dict[str, Any]]:
+        return self.iterator().iter_rows()
+
+    def iter_batches(self, **kwargs) -> Iterator[Any]:
+        return self.iterator().iter_batches(**kwargs)
+
+    def iter_jax_batches(self, **kwargs) -> Iterator[Dict[str, Any]]:
+        return self.iterator().iter_jax_batches(**kwargs)
+
+    def iterator(self) -> DataIterator:
+        return DataIterator(self._execute_refs)
+
+    def to_pandas(self):
+        return B.to_pandas(
+            B.concat([ray_tpu.get(r) for r in self._execute_refs()]))
+
+    # ---- splits ----
+
+    def split(self, n: int) -> List["Dataset"]:
+        refs = list(self._execute_refs())
+        return [Dataset(materialized_refs=refs[i::n])
+                for i in builtins.range(n)]
+
+    def streaming_split(self, n: int, equal: bool = False) -> List[DataIterator]:
+        coord = _SplitCoordinator.options(num_cpus=0).remote(n, equal)
+        return [StreamSplitIterator(coord, i, self)
+                for i in builtins.range(n)]
+
+    def train_test_split(self, test_size: float,
+                         shuffle: bool = False,
+                         seed: Optional[int] = None):
+        ds = self.random_shuffle(seed) if shuffle else self
+        rows = ds.take_all()
+        cut = int(len(rows) * (1 - test_size))
+        return (from_items(rows[:cut]), from_items(rows[cut:]))
+
+    # ---- writes ----
+
+    def _write(self, path: str, fmt: str) -> List[str]:
+        return ray_tpu.get([
+            _write_task.remote(ref, path, fmt, i)
+            for i, ref in enumerate(self._execute_refs())])
+
+    def write_parquet(self, path: str) -> List[str]:
+        return self._write(path, "parquet")
+
+    def write_csv(self, path: str) -> List[str]:
+        return self._write(path, "csv")
+
+    def write_json(self, path: str) -> List[str]:
+        return self._write(path, "json")
+
+    def write_numpy(self, path: str) -> List[str]:
+        return self._write(path, "npy")
+
+    def stats(self) -> str:
+        n = self.count()
+        return f"Dataset(rows={n}, ops={len(self._ops)})"
+
+    def __repr__(self) -> str:
+        return (f"Dataset(read_tasks={len(self._read_tasks)}, "
+                f"ops={[type(o).__name__ for o in self._ops]})")
+
+
+class GroupedData:
+    def __init__(self, ds: Dataset, key: str):
+        self._ds = ds
+        self._key = key
+
+    def aggregate(self, *aggs) -> Dataset:
+        return self._ds._with_op(L.Aggregate(self._key, list(aggs)))
+
+    def count(self) -> Dataset:
+        return self.aggregate(agg_mod.Count())
+
+    def sum(self, on: str) -> Dataset:
+        return self.aggregate(agg_mod.Sum(on))
+
+    def min(self, on: str) -> Dataset:
+        return self.aggregate(agg_mod.Min(on))
+
+    def max(self, on: str) -> Dataset:
+        return self.aggregate(agg_mod.Max(on))
+
+    def mean(self, on: str) -> Dataset:
+        return self.aggregate(agg_mod.Mean(on))
+
+
+# ---------------------------------------------------------------------------
+# Creation API (reference: data/read_api.py)
+# ---------------------------------------------------------------------------
+
+
+def range(n: int, *, parallelism: int = -1) -> Dataset:  # noqa: A001
+    p = parallelism if parallelism > 0 else DataContext.get_current().read_parallelism
+    return Dataset(ds_mod.range_read_tasks(n, p))
+
+
+def from_items(items: List[Any], *, parallelism: int = -1) -> Dataset:
+    p = parallelism if parallelism > 0 else DataContext.get_current().read_parallelism
+    p = max(1, min(p, len(items) or 1))
+    chunks = np.array_split(np.arange(len(items)), p)
+
+    def make(idx):
+        subset = [items[i] for i in idx]
+        return lambda: B.from_items(subset)
+
+    return Dataset([make(c) for c in chunks if len(c)] or
+                   [lambda: B.from_items([])])
+
+
+def from_numpy(arrays: Union[np.ndarray, List[np.ndarray]],
+               column: str = "data") -> Dataset:
+    if isinstance(arrays, np.ndarray):
+        arrays = [arrays]
+    return Dataset([(lambda a=a: {column: a}) for a in arrays])
+
+
+def from_pandas(dfs) -> Dataset:
+    if not isinstance(dfs, list):
+        dfs = [dfs]
+    return Dataset([(lambda d=d: B.from_pandas(d)) for d in dfs])
+
+
+def from_arrow(tables) -> Dataset:
+    if not isinstance(tables, list):
+        tables = [tables]
+
+    def make(t):
+        return lambda: {name: t.column(name).to_numpy(zero_copy_only=False)
+                        for name in t.column_names}
+
+    return Dataset([make(t) for t in tables])
+
+
+def read_parquet(paths, *, columns: Optional[List[str]] = None) -> Dataset:
+    return Dataset(ds_mod.parquet_read_tasks(paths, columns))
+
+
+def read_csv(paths, **kwargs) -> Dataset:
+    return Dataset(ds_mod.csv_read_tasks(paths, **kwargs))
+
+
+def read_json(paths, *, lines: bool = True) -> Dataset:
+    return Dataset(ds_mod.json_read_tasks(paths, lines=lines))
+
+
+def read_numpy(paths, column: str = "data") -> Dataset:
+    return Dataset(ds_mod.numpy_read_tasks(paths, column))

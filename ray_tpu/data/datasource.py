@@ -1,0 +1,137 @@
+"""Datasources: lazy read tasks and file writers.
+
+Reference analog: ``data/datasource/`` (parquet/csv/json/numpy readers with
+path expansion + per-file read tasks) and ``Dataset.write_*``. A ReadTask
+is a zero-arg callable returning one block; reads execute remotely, one
+task per file/fragment, so a Dataset over many files is read in parallel
+and streamed.
+"""
+
+from __future__ import annotations
+
+import glob as globlib
+import os
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu.data import block as B
+
+ReadTask = Callable[[], B.Block]
+
+
+def expand_paths(paths) -> List[str]:
+    if isinstance(paths, str):
+        paths = [paths]
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            out.extend(sorted(
+                os.path.join(p, f) for f in os.listdir(p)
+                if not f.startswith(".")))
+        elif any(c in p for c in "*?["):
+            out.extend(sorted(globlib.glob(p)))
+        else:
+            out.append(p)
+    if not out:
+        raise FileNotFoundError(f"no files matched {paths}")
+    return out
+
+
+def range_read_tasks(n: int, num_blocks: int) -> List[ReadTask]:
+    num_blocks = max(1, min(num_blocks, n)) if n else 1
+    per = (n + num_blocks - 1) // num_blocks if num_blocks else 0
+    tasks = []
+    for i in range(num_blocks):
+        lo, hi = i * per, min((i + 1) * per, n)
+        if lo >= hi and n > 0:
+            continue
+
+        def read(lo=lo, hi=hi) -> B.Block:
+            return {"id": np.arange(lo, hi, dtype=np.int64)}
+
+        tasks.append(read)
+    return tasks or [lambda: {"id": np.arange(0, dtype=np.int64)}]
+
+
+def parquet_read_tasks(paths, columns: Optional[List[str]] = None) -> List[ReadTask]:
+    files = expand_paths(paths)
+
+    def make(path):
+        def read() -> B.Block:
+            import pyarrow.parquet as pq
+
+            table = pq.read_table(path, columns=columns)
+            return {name: np.asarray(table.column(name).to_pylist())
+                    if table.column(name).type.__class__.__name__ == "ListType"
+                    else table.column(name).to_numpy(zero_copy_only=False)
+                    for name in table.column_names}
+
+        return read
+
+    return [make(p) for p in files]
+
+
+def csv_read_tasks(paths, **pandas_kwargs) -> List[ReadTask]:
+    files = expand_paths(paths)
+
+    def make(path):
+        def read() -> B.Block:
+            import pandas as pd
+
+            return B.from_pandas(pd.read_csv(path, **pandas_kwargs))
+
+        return read
+
+    return [make(p) for p in files]
+
+
+def json_read_tasks(paths, lines: bool = True) -> List[ReadTask]:
+    files = expand_paths(paths)
+
+    def make(path):
+        def read() -> B.Block:
+            import pandas as pd
+
+            return B.from_pandas(pd.read_json(path, lines=lines))
+
+        return read
+
+    return [make(p) for p in files]
+
+
+def numpy_read_tasks(paths, column: str = "data") -> List[ReadTask]:
+    files = expand_paths(paths)
+
+    def make(path):
+        def read() -> B.Block:
+            return {column: np.load(path)}
+
+        return read
+
+    return [make(p) for p in files]
+
+
+# ---- writers (run as remote tasks, one file per block) ----
+
+
+def write_block(block: B.Block, path: str, file_format: str, index: int) -> str:
+    os.makedirs(path, exist_ok=True)
+    out = os.path.join(path, f"part-{index:05d}.{file_format}")
+    if file_format == "parquet":
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        pq.write_table(pa.table({k: list(v) if v.ndim > 1 else v
+                                 for k, v in block.items()}), out)
+    elif file_format == "csv":
+        B.to_pandas(block).to_csv(out, index=False)
+    elif file_format == "json":
+        B.to_pandas(block).to_json(out, orient="records", lines=True)
+    elif file_format == "npy":
+        if len(block) != 1:
+            raise ValueError("write_numpy requires a single-column dataset")
+        np.save(out, next(iter(block.values())))
+    else:
+        raise ValueError(f"unsupported format {file_format}")
+    return out

@@ -1,0 +1,478 @@
+"""Streaming execution of a Dataset's logical plan.
+
+Reference analog: ``data/_internal/execution/streaming_executor.py:49`` +
+physical operators (``TaskPoolMapOperator``, ``ActorPoolMapOperator``,
+``OutputSplitter``) and the MapFusion rule in ``logical/optimizers.py``.
+
+The planner fuses runs of map-like logical ops into a single remote task per
+block (one serialization + one scheduling hop per block, not per op).
+Execution is pull-based and streaming: a bounded number of block-tasks are
+in flight per stage (backpressure), and downstream consumption drives
+upstream submission. All-to-all ops (shuffle/sort/aggregate/repartition)
+are barriers: they drain their upstream, run a distributed map/reduce over
+tasks, and stream their outputs.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.data import block as B
+from ray_tpu.data import logical as L
+
+
+# ---------------------------------------------------------------------------
+# Block transforms compiled from logical ops
+# ---------------------------------------------------------------------------
+
+
+def _compile_map_like(op: L.LogicalOp) -> Callable[[B.Block], B.Block]:
+    if isinstance(op, L.MapBatches):
+        fn = op.fn
+        if isinstance(fn, type):  # class UDF instantiated per-worker elsewhere
+            raise TypeError("class UDFs must run on an actor pool")
+
+        def apply_mb(block: B.Block) -> B.Block:
+            n = B.num_rows(block)
+            if n == 0:
+                return block
+            bs = op.batch_size or n
+            outs = []
+            for start in range(0, n, bs):
+                batch = B.to_batch(B.slice_block(block, start, start + bs),
+                                   op.batch_format)
+                out = fn(batch, *op.fn_args, **op.fn_kwargs)
+                outs.append(B.from_batch(out))
+            return B.concat(outs)
+
+        return apply_mb
+    if isinstance(op, L.MapRows):
+        def apply_rows(block: B.Block) -> B.Block:
+            return B.from_rows([op.fn(r) for r in B.iter_rows(block)])
+
+        return apply_rows
+    if isinstance(op, L.Filter):
+        def apply_filter(block: B.Block) -> B.Block:
+            keep = np.asarray([bool(op.fn(r)) for r in B.iter_rows(block)])
+            if not keep.any():
+                return {}
+            return B.take_rows(block, np.nonzero(keep)[0])
+
+        return apply_filter
+    if isinstance(op, L.FlatMap):
+        def apply_flat(block: B.Block) -> B.Block:
+            rows: List[Dict] = []
+            for r in B.iter_rows(block):
+                rows.extend(op.fn(r))
+            return B.from_rows(rows)
+
+        return apply_flat
+    if isinstance(op, L.AddColumn):
+        def apply_add(block: B.Block) -> B.Block:
+            if B.num_rows(block) == 0:
+                return block
+            out = dict(block)
+            out[op.name] = np.asarray(op.fn(dict(block)))
+            return out
+
+        return apply_add
+    if isinstance(op, L.DropColumns):
+        return lambda block: {k: v for k, v in block.items()
+                              if k not in op.columns}
+    if isinstance(op, L.SelectColumns):
+        return lambda block: {k: block[k] for k in op.columns}
+    if isinstance(op, L.RandomSample):
+        def apply_sample(block: B.Block) -> B.Block:
+            n = B.num_rows(block)
+            if n == 0:
+                return block
+            rng = np.random.default_rng(op.seed)
+            keep = rng.random(n) < op.fraction
+            return B.take_rows(block, np.nonzero(keep)[0])
+
+        return apply_sample
+    raise TypeError(f"not a map-like op: {op}")
+
+
+def _run_fused(fns: List[Callable], block: B.Block) -> B.Block:
+    for fn in fns:
+        block = fn(block)
+    return block
+
+
+@ray_tpu.remote
+def _map_task(fns: List[Callable], block: B.Block) -> B.Block:
+    return _run_fused(fns, block)
+
+
+@ray_tpu.remote
+class _MapActor:
+    """Hosts one instance of a callable-class UDF (ActorPoolMapOperator)."""
+
+    def __init__(self, cls_payload, ctor_args, pre_fns, post_fns,
+                 batch_size, batch_format, fn_args, fn_kwargs):
+        self._udf = cls_payload(*ctor_args)
+        self._pre = pre_fns
+        self._post = post_fns
+        self._bs = batch_size
+        self._fmt = batch_format
+        self._args = fn_args
+        self._kwargs = fn_kwargs
+
+    def map(self, block: B.Block) -> B.Block:
+        block = _run_fused(self._pre, block)
+        n = B.num_rows(block)
+        if n:
+            bs = self._bs or n
+            outs = []
+            for start in range(0, n, bs):
+                batch = B.to_batch(B.slice_block(block, start, start + bs),
+                                   self._fmt)
+                outs.append(B.from_batch(
+                    self._udf(batch, *self._args, **self._kwargs)))
+            block = B.concat(outs)
+        return _run_fused(self._post, block)
+
+
+# ---------------------------------------------------------------------------
+# Stages
+# ---------------------------------------------------------------------------
+
+
+class Stage:
+    def run(self, upstream: Iterator, ctx) -> Iterator:
+        raise NotImplementedError
+
+
+class MapStage(Stage):
+    def __init__(self, fns: List[Callable], options: Dict[str, Any]):
+        self.fns = fns
+        self.options = options
+
+    def run(self, upstream: Iterator, ctx) -> Iterator:
+        max_inflight = ctx.max_tasks_in_flight
+        task = _map_task.options(**self.options) if self.options else _map_task
+        inflight: collections.deque = collections.deque()
+        upstream = iter(upstream)
+        exhausted = False
+        while True:
+            while not exhausted and len(inflight) < max_inflight:
+                try:
+                    ref = next(upstream)
+                except StopIteration:
+                    exhausted = True
+                    break
+                inflight.append(task.remote(self.fns, ref))
+            if not inflight:
+                return
+            yield inflight.popleft()
+
+
+class ActorMapStage(Stage):
+    def __init__(self, op: L.MapBatches, pre: List[Callable],
+                 post: List[Callable]):
+        self.op = op
+        self.pre = pre
+        self.post = post
+
+    def run(self, upstream: Iterator, ctx) -> Iterator:
+        op = self.op
+        strategy = op.compute or L.ActorPoolStrategy(size=2)
+        n_actors = strategy.pool_size()
+        opts: Dict[str, Any] = {}
+        if op.num_cpus is not None:
+            opts["num_cpus"] = op.num_cpus
+        if op.num_tpus:
+            opts["num_tpus"] = op.num_tpus
+        actor_cls = _MapActor.options(**opts) if opts else _MapActor
+        pool = [actor_cls.remote(op.fn, op.fn_constructor_args, self.pre,
+                                 self.post, op.batch_size, op.batch_format,
+                                 op.fn_args, op.fn_kwargs)
+                for _ in range(n_actors)]
+        per_actor_cap = 2
+        inflight: collections.deque = collections.deque()
+        issued: List = []
+        counts = {i: 0 for i in range(n_actors)}
+        upstream = iter(upstream)
+        exhausted = False
+        try:
+            while True:
+                while (not exhausted
+                       and len(inflight) < n_actors * per_actor_cap):
+                    try:
+                        ref = next(upstream)
+                    except StopIteration:
+                        exhausted = True
+                        break
+                    i = min(counts, key=counts.get)
+                    counts[i] += 1
+                    out = pool[i].map.remote(ref)
+                    issued.append(out)
+                    inflight.append((i, out))
+                if not inflight:
+                    return
+                i, out = inflight.popleft()
+                counts[i] -= 1
+                yield out
+        finally:
+            # downstream may hold yielded refs unresolved (e.g. an
+            # all-to-all barrier collects refs first) — don't kill the
+            # pool until every issued call has materialized its result
+            if issued:
+                try:
+                    ray_tpu.wait(issued, num_returns=len(issued),
+                                 timeout=300)
+                except Exception:
+                    pass
+            for a in pool:
+                try:
+                    ray_tpu.kill(a, no_restart=True)
+                except Exception:
+                    pass
+
+
+class LimitStage(Stage):
+    def __init__(self, n: int):
+        self.n = n
+
+    def run(self, upstream: Iterator, ctx) -> Iterator:
+        remaining = self.n
+        for ref in upstream:
+            if remaining <= 0:
+                return
+            blk = ray_tpu.get(ref)
+            rows = B.num_rows(blk)
+            if rows <= remaining:
+                remaining -= rows
+                yield ref
+            else:
+                yield ray_tpu.put(B.slice_block(blk, 0, remaining))
+                remaining = 0
+            if remaining == 0:
+                return
+
+
+@ray_tpu.remote
+def _split_task(block: B.Block, n_out: int, seed: Optional[int],
+                salt: int, mode: str, boundaries=None, key=None):
+    """Shuffle/sort/groupby map phase: partition one block n_out ways."""
+    n = B.num_rows(block)
+    if n == 0:  # Filter/RandomSample legitimately produce empty blocks
+        parts = [{} for _ in range(n_out)]
+        return parts if n_out > 1 else parts[0]
+    if mode == "shuffle":
+        rng = np.random.default_rng(None if seed is None else seed + salt)
+        perm = rng.permutation(n)
+        assignment = perm % n_out
+    elif mode == "range":  # sort: range-partition by key against boundaries
+        vals = block[key]
+        assignment = np.searchsorted(boundaries, vals, side="right")
+    elif mode == "hash":  # groupby: hash-partition by key
+        import zlib
+
+        vals = block[key]
+        if vals.dtype.kind in "USO":
+            # NOT hash(): process-salted, differs across worker processes
+            assignment = np.asarray(
+                [zlib.crc32(str(x).encode()) % n_out for x in vals])
+        else:
+            assignment = vals.astype(np.int64) % n_out
+    else:
+        raise ValueError(mode)
+    parts = [B.take_rows(block, np.nonzero(assignment == i)[0])
+             for i in range(n_out)]
+    return parts if n_out > 1 else parts[0]
+
+
+@ray_tpu.remote
+def _reduce_concat(*parts):
+    return B.concat(list(parts))
+
+
+@ray_tpu.remote
+def _reduce_sort(key: str, descending: bool, *parts):
+    merged = B.concat(list(parts))
+    if B.num_rows(merged) == 0:
+        return merged
+    order = np.argsort(merged[key], kind="stable")
+    if descending:
+        order = order[::-1]
+    return B.take_rows(merged, order)
+
+
+@ray_tpu.remote
+def _reduce_aggregate(key, aggs, *parts):
+    from ray_tpu.data.aggregate import aggregate_block
+
+    merged = B.concat(list(parts))
+    return aggregate_block(merged, key, aggs)
+
+
+def _all_to_all(refs: List, n_out: int, mode: str, reduce_task,
+                reduce_args: Tuple = (), seed=None, boundaries=None,
+                key=None) -> List:
+    """Two-phase map/reduce over tasks (the reference's push-based shuffle
+    simplified to a task-graph shuffle)."""
+    if not refs:
+        return []
+    part_lists = [
+        _split_task.options(num_returns=n_out).remote(
+            ref, n_out, seed, i, mode, boundaries, key)
+        for i, ref in enumerate(refs)
+    ]
+    if n_out == 1:
+        part_lists = [[p] for p in part_lists]
+    return [
+        reduce_task.remote(*reduce_args, *[parts[j] for parts in part_lists])
+        for j in range(n_out)
+    ]
+
+
+class AllToAllStage(Stage):
+    def __init__(self, op: L.LogicalOp):
+        self.op = op
+
+    def run(self, upstream: Iterator, ctx) -> Iterator:
+        refs = list(upstream)
+        op = self.op
+        if isinstance(op, L.RandomShuffle):
+            n_out = max(1, len(refs))
+            out = _all_to_all(refs, n_out, "shuffle", _reduce_concat,
+                              seed=op.seed)
+            # shuffle output block order too for better randomness
+            rng = np.random.default_rng(op.seed)
+            out = [out[i] for i in rng.permutation(len(out))]
+        elif isinstance(op, L.Repartition):
+            n_out = op.num_blocks
+            out = _all_to_all(refs, n_out, "shuffle", _reduce_concat, seed=0)
+        elif isinstance(op, L.Sort):
+            n_out = max(1, len(refs))
+            boundaries = self._sample_boundaries(refs, op.key, n_out)
+            out = _all_to_all(refs, n_out, "range",
+                              _reduce_sort, (op.key, op.descending),
+                              boundaries=boundaries, key=op.key)
+            if op.descending:
+                out = out[::-1]
+        elif isinstance(op, L.Aggregate):
+            if op.key is None:
+                out = [_reduce_aggregate.remote(None, op.aggs, *refs)]
+            else:
+                n_out = min(max(1, len(refs)), 8)
+                out = _all_to_all(refs, n_out, "hash",
+                                  _reduce_aggregate, (op.key, op.aggs),
+                                  key=op.key)
+        else:
+            raise TypeError(f"unknown all-to-all op {op}")
+        yield from out
+
+    @staticmethod
+    def _sample_boundaries(refs: List, key: str, n_out: int) -> np.ndarray:
+        samples = []
+        for ref in refs[:20]:
+            blk = ray_tpu.get(ref)
+            if B.num_rows(blk):
+                vals = blk[key]
+                k = min(len(vals), 32)
+                samples.append(np.random.default_rng(0).choice(
+                    vals, size=k, replace=False))
+        if not samples:
+            return np.asarray([0.0] * (n_out - 1))
+        allv = np.sort(np.concatenate(samples))
+        qs = [allv[int(len(allv) * i / n_out)] for i in range(1, n_out)]
+        return np.asarray(qs)
+
+
+class UnionStage(Stage):
+    def __init__(self, other_iterables: List):
+        self.others = other_iterables
+
+    def run(self, upstream: Iterator, ctx) -> Iterator:
+        yield from upstream
+        for it in self.others:
+            yield from it
+
+
+class ZipStage(Stage):
+    def __init__(self, other_iterable):
+        self.other = other_iterable
+
+    def run(self, upstream: Iterator, ctx) -> Iterator:
+        left = B.concat([ray_tpu.get(r) for r in upstream])
+        right = B.concat([ray_tpu.get(r) for r in self.other])
+        if B.num_rows(left) != B.num_rows(right):
+            raise ValueError(
+                f"zip requires equal row counts "
+                f"({B.num_rows(left)} vs {B.num_rows(right)})")
+        merged = dict(left)
+        for k, v in right.items():
+            merged[k + "_1" if k in merged else k] = v
+        yield ray_tpu.put(merged)
+
+
+# ---------------------------------------------------------------------------
+# Planner
+# ---------------------------------------------------------------------------
+
+
+def plan(ops: List[L.LogicalOp]) -> List[Stage]:
+    stages: List[Stage] = []
+    pending_fns: List[Callable] = []
+    pending_opts: Dict[str, Any] = {}
+
+    def flush():
+        nonlocal pending_fns, pending_opts
+        if pending_fns:
+            stages.append(MapStage(pending_fns, pending_opts))
+            pending_fns, pending_opts = [], {}
+
+    for op in ops:
+        if isinstance(op, L.MapBatches) and (
+                isinstance(op.fn, type) or op.compute is not None):
+            # stateful UDF: fuse preceding maps into the actor, flush after
+            pre = pending_fns
+            pending_fns, pending_opts = [], {}
+            stages.append(ActorMapStage(op, pre, []))
+        elif isinstance(op, L.MAP_LIKE):
+            opts = {}
+            if isinstance(op, L.MapBatches):
+                if op.num_cpus is not None:
+                    opts["num_cpus"] = op.num_cpus
+                if op.num_tpus:
+                    opts["num_tpus"] = op.num_tpus
+            if opts != pending_opts:
+                # fuse only ops with identical resource requests — a
+                # resource change (including back to default) splits stages
+                flush()
+                pending_opts = opts
+            pending_fns.append(_compile_map_like(op))
+        elif isinstance(op, L.Limit):
+            flush()
+            stages.append(LimitStage(op.n))
+        elif isinstance(op, (L.RandomShuffle, L.Repartition, L.Sort,
+                             L.Aggregate)):
+            flush()
+            stages.append(AllToAllStage(op))
+        elif isinstance(op, L.Union):
+            flush()
+            stages.append(UnionStage(
+                [o._execute_refs() for o in op.others]))
+        elif isinstance(op, L.Zip):
+            flush()
+            stages.append(ZipStage(op.other._execute_refs()))
+        else:
+            raise TypeError(f"unknown logical op {op}")
+    flush()
+    return stages
+
+
+def execute_streaming(source: Iterator, ops: List[L.LogicalOp],
+                      ctx) -> Iterator:
+    """Returns an iterator of block ObjectRefs."""
+    it = source
+    for stage in plan(ops):
+        it = stage.run(it, ctx)
+    return it

@@ -1,0 +1,190 @@
+"""Consumption: batch iteration and streaming splits.
+
+Reference analogs: ``data/_internal/block_batching/iter_batches.py``
+(batching across block boundaries + prefetch), ``DataIterator``
+(``data/iterator.py``), and ``streaming_split`` /
+``_internal/iterator/stream_split_iterator.py`` (a coordinator actor hands
+blocks to N concurrent consumers — Train workers — round-robin).
+"""
+
+from __future__ import annotations
+
+import collections
+import queue
+import threading
+from typing import Any, Dict, Iterator, List, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.data import block as B
+
+
+def batches_from_blocks(blocks: Iterator[B.Block], batch_size: Optional[int],
+                        batch_format: str = "numpy", drop_last: bool = False,
+                        local_shuffle_buffer_size: Optional[int] = None,
+                        seed: Optional[int] = None) -> Iterator[Any]:
+    """Re-chunk a stream of blocks into fixed-size batches."""
+    rng = np.random.default_rng(seed)
+    buf: List[B.Block] = []
+    buffered = 0
+    min_buffer = local_shuffle_buffer_size or 0
+
+    def drain(final: bool) -> Iterator[Any]:
+        nonlocal buf, buffered
+        while buf and (batch_size is None or buffered >= batch_size
+                       or (final and buffered > 0)):
+            if batch_size is None:
+                merged, buf, buffered = B.concat(buf), [], 0
+                yield B.to_batch(merged, batch_format)
+                return
+            merged = B.concat(buf)
+            if local_shuffle_buffer_size and B.num_rows(merged) > 1:
+                merged = B.take_rows(
+                    merged, rng.permutation(B.num_rows(merged)))
+            take = min(batch_size, B.num_rows(merged))
+            if take < batch_size and not final:
+                buf, buffered = [merged], B.num_rows(merged)
+                return
+            if take < batch_size and drop_last:
+                buf, buffered = [], 0
+                return
+            yield B.to_batch(B.slice_block(merged, 0, take), batch_format)
+            rest = B.slice_block(merged, take, B.num_rows(merged))
+            buf = [rest] if B.num_rows(rest) else []
+            buffered = B.num_rows(rest)
+
+    for blk in blocks:
+        if B.num_rows(blk) == 0:
+            continue
+        buf.append(blk)
+        buffered += B.num_rows(blk)
+        if batch_size is not None and buffered >= max(batch_size, min_buffer):
+            yield from drain(final=False)
+    yield from drain(final=True)
+
+
+def prefetched(it: Iterator[Any], depth: int) -> Iterator[Any]:
+    """Run the upstream iterator in a thread, `depth` items ahead."""
+    if depth <= 0:
+        yield from it
+        return
+    q: "queue.Queue" = queue.Queue(maxsize=depth)
+    _END = object()
+    err: List[BaseException] = []
+
+    def producer():
+        try:
+            for item in it:
+                q.put(item)
+        except BaseException as e:
+            err.append(e)
+        finally:
+            q.put(_END)
+
+    t = threading.Thread(target=producer, daemon=True)
+    t.start()
+    while True:
+        item = q.get()
+        if item is _END:
+            if err:
+                raise err[0]
+            return
+        yield item
+
+
+class DataIterator:
+    """One consumer's view of a stream of blocks."""
+
+    def __init__(self, block_iter_fn):
+        self._block_iter_fn = block_iter_fn
+
+    def _blocks(self) -> Iterator[B.Block]:
+        for ref in self._block_iter_fn():
+            yield ray_tpu.get(ref) if hasattr(ref, "hex") else ref
+
+    def iter_batches(self, *, batch_size: Optional[int] = 256,
+                     batch_format: str = "numpy", drop_last: bool = False,
+                     prefetch_batches: int = 1,
+                     local_shuffle_buffer_size: Optional[int] = None,
+                     local_shuffle_seed: Optional[int] = None) -> Iterator[Any]:
+        it = batches_from_blocks(
+            self._blocks(), batch_size, batch_format, drop_last,
+            local_shuffle_buffer_size, local_shuffle_seed)
+        return prefetched(it, prefetch_batches)
+
+    def iter_rows(self) -> Iterator[Dict[str, Any]]:
+        for blk in self._blocks():
+            yield from B.iter_rows(blk)
+
+    def iter_jax_batches(self, *, batch_size: int = 256,
+                         drop_last: bool = True, dtype=None,
+                         prefetch_batches: int = 2) -> Iterator[Dict[str, Any]]:
+        """Batches as jnp device arrays — the TPU feed path (host numpy →
+        device put; drop_last defaults True to keep shapes static for jit)."""
+        import jax.numpy as jnp
+
+        for batch in self.iter_batches(batch_size=batch_size,
+                                       drop_last=drop_last,
+                                       prefetch_batches=prefetch_batches):
+            yield {k: jnp.asarray(v if dtype is None else v.astype(dtype))
+                   for k, v in batch.items()}
+
+
+@ray_tpu.remote
+class _SplitCoordinator:
+    """Hands out blocks of one executing dataset to N consumers.
+
+    Reference: ``StreamSplitDataIterator`` — blocks are assigned first-come
+    (each consumed exactly once); ``equal=True`` balances by row count.
+    """
+
+    def __init__(self, n: int, equal: bool):
+        self._n = n
+        self._equal = equal
+        self._lock = threading.Lock()
+        self._refs: Optional[List] = None
+        self._queues: List[collections.deque] = [collections.deque()
+                                                 for _ in range(n)]
+
+    def _ensure_started(self, dataset_payload) -> None:
+        if self._refs is not None:
+            return
+        ds = dataset_payload
+        refs = list(ds._execute_refs())
+        if self._equal:
+            rows = [B.num_rows(ray_tpu.get(r)) for r in refs]
+            order = np.argsort(rows)[::-1]
+            loads = [0] * self._n
+            for i in order:
+                j = int(np.argmin(loads))
+                self._queues[j].append(refs[i])
+                loads[j] += rows[i]
+        else:
+            for i, r in enumerate(refs):
+                self._queues[i % self._n].append(r)
+        self._refs = refs
+
+    def next_block(self, split_idx: int, dataset_payload):
+        with self._lock:
+            self._ensure_started(dataset_payload)
+        q = self._queues[split_idx]
+        if not q:
+            return None
+        return ray_tpu.get(q.popleft())
+
+
+class StreamSplitIterator(DataIterator):
+    def __init__(self, coordinator, split_idx: int, dataset):
+        self._coord = coordinator
+        self._idx = split_idx
+        self._ds = dataset
+        super().__init__(self._pull_blocks)
+
+    def _pull_blocks(self):
+        while True:
+            blk = ray_tpu.get(
+                self._coord.next_block.remote(self._idx, self._ds))
+            if blk is None:
+                return
+            yield blk

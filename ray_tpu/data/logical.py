@@ -1,0 +1,123 @@
+"""Logical operators: the lazy plan a Dataset accumulates.
+
+Reference analog: ``data/_internal/logical/operators/`` (``Read``,
+``MapBatches/MapRows/Filter/FlatMap`` ``map_operator.py:103-190``,
+``RandomShuffle/Repartition/Sort/Aggregate`` ``all_to_all_operator.py``,
+``Zip/Union/Limit/Write``). The planner (executor.py) fuses consecutive
+map-like ops into single tasks — the reference's MapFusion rule
+(``logical/optimizers.py``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+
+@dataclasses.dataclass
+class LogicalOp:
+    pass
+
+
+@dataclasses.dataclass
+class MapBatches(LogicalOp):
+    fn: Any  # callable or callable-class
+    batch_size: Optional[int]
+    batch_format: str = "numpy"
+    fn_args: Tuple = ()
+    fn_kwargs: Dict = dataclasses.field(default_factory=dict)
+    compute: Optional[Any] = None  # ActorPoolStrategy for class UDFs
+    fn_constructor_args: Tuple = ()
+    num_tpus: float = 0
+    num_cpus: Optional[float] = None
+
+
+@dataclasses.dataclass
+class MapRows(LogicalOp):
+    fn: Callable
+
+
+@dataclasses.dataclass
+class Filter(LogicalOp):
+    fn: Callable
+
+
+@dataclasses.dataclass
+class FlatMap(LogicalOp):
+    fn: Callable
+
+
+@dataclasses.dataclass
+class AddColumn(LogicalOp):
+    name: str
+    fn: Callable
+
+
+@dataclasses.dataclass
+class DropColumns(LogicalOp):
+    columns: List[str]
+
+
+@dataclasses.dataclass
+class SelectColumns(LogicalOp):
+    columns: List[str]
+
+
+@dataclasses.dataclass
+class Limit(LogicalOp):
+    n: int
+
+
+@dataclasses.dataclass
+class RandomShuffle(LogicalOp):
+    seed: Optional[int] = None
+
+
+@dataclasses.dataclass
+class Repartition(LogicalOp):
+    num_blocks: int
+
+
+@dataclasses.dataclass
+class Sort(LogicalOp):
+    key: str
+    descending: bool = False
+
+
+@dataclasses.dataclass
+class Aggregate(LogicalOp):
+    key: Optional[str]
+    aggs: List[Any]  # AggregateFn list
+
+
+@dataclasses.dataclass
+class Union(LogicalOp):
+    others: List[Any]  # Datasets
+
+
+@dataclasses.dataclass
+class Zip(LogicalOp):
+    other: Any  # Dataset
+
+
+@dataclasses.dataclass
+class RandomSample(LogicalOp):
+    fraction: float
+    seed: Optional[int] = None
+
+
+MAP_LIKE = (MapBatches, MapRows, Filter, FlatMap, AddColumn, DropColumns,
+            SelectColumns, RandomSample)
+
+
+@dataclasses.dataclass
+class ActorPoolStrategy:
+    """Compute strategy for stateful (callable-class) map_batches UDFs —
+    the reference's ``ActorPoolMapOperator`` autoscaling pool."""
+
+    size: Optional[int] = None
+    min_size: int = 1
+    max_size: Optional[int] = None
+
+    def pool_size(self) -> int:
+        return self.size or self.min_size

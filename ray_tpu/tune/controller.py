@@ -129,8 +129,12 @@ class TuneController:
         try:
             ray_tpu.get(t.runner.save.remote(ckpt_dir), timeout=60)
             t.checkpoint_path = ckpt_dir
-        except Exception:
-            pass
+        except Exception as e:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "trial %s: checkpoint save failed (%r); keeping previous "
+                "checkpoint %s", t.trial_id, e, t.checkpoint_path)
 
     def _finalize(self, t: Trial, status: str, error: Optional[str] = None) -> None:
         if t.runner is not None:
@@ -162,12 +166,11 @@ class TuneController:
                 r = result.get(k)
                 if r is None:
                     continue
-                if k == "training_iteration" and r >= v:
+                # reference semantics: unconditional result[key] >= value
+                # regardless of metric mode (min-mode users pass thresholds
+                # already oriented this way)
+                if r >= v:
                     return True
-                if k != "training_iteration":
-                    sign = 1 if self._mode == "max" else -1
-                    if sign * r >= sign * v:
-                        return True
         return False
 
     def _handle_result(self, t: Trial, result: Dict[str, Any]) -> None:

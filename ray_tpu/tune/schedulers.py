@@ -10,7 +10,7 @@ from __future__ import annotations
 
 import math
 import random
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu.tune.trial import Trial
 
@@ -72,19 +72,28 @@ class AsyncHyperBandScheduler(TrialScheduler):
         self._max_t = max_t
         self._grace = grace_period
         self._rf = reduction_factor
-        # rung -> list of recorded scores (already sign-normalized)
-        self._rungs: Dict[float, List[float]] = {}
-        milestones = []
-        t = grace_period
-        while t < max_t:
-            milestones.append(t)
-            t = math.ceil(t * reduction_factor)
-        self._milestones = milestones
+        # per bracket: milestone list (bracket b starts at grace * rf^b) and
+        # rung -> recorded sign-normalized scores
+        self._bracket_milestones: List[List[int]] = []
+        self._bracket_rungs: List[Dict[float, List[float]]] = []
+        for b in range(max(1, brackets)):
+            milestones = []
+            t = int(grace_period * reduction_factor ** b)
+            while t < max_t:
+                milestones.append(t)
+                t = math.ceil(t * reduction_factor)
+            self._bracket_milestones.append(milestones)
+            self._bracket_rungs.append({})
+        self._num_brackets = max(1, brackets)
+        self._next_bracket = 0
+        self._trial_bracket: Dict[str, int] = {}
         self._trial_rung: Dict[str, int] = {}  # next milestone index per trial
         self._trial_recorded: Dict[str, Tuple[float, float]] = {}  # tid -> (rung, score)
 
     def on_trial_add(self, trial: Trial) -> None:
         self._trial_rung[trial.trial_id] = 0
+        self._trial_bracket[trial.trial_id] = self._next_bracket
+        self._next_bracket = (self._next_bracket + 1) % self._num_brackets
 
     def on_trial_result(self, trial: Trial, result: Dict[str, Any]) -> str:
         t = result.get(self._time_attr, 0)
@@ -93,16 +102,19 @@ class AsyncHyperBandScheduler(TrialScheduler):
         metric = result.get(self._metric)
         if metric is None:
             return CONTINUE
+        bracket = self._trial_bracket.get(trial.trial_id, 0)
+        milestones = self._bracket_milestones[bracket]
+        rungs = self._bracket_rungs[bracket]
         idx = self._trial_rung.get(trial.trial_id, 0)
         decision = CONTINUE
         score = _score(metric, self._mode or "max")
         crossed = False
-        while idx < len(self._milestones) and t >= self._milestones[idx]:
+        while idx < len(milestones) and t >= milestones[idx]:
             crossed = True
-            rung = self._milestones[idx]
-            self._rungs.setdefault(rung, []).append(score)
+            rung = milestones[idx]
+            rungs.setdefault(rung, []).append(score)
             self._trial_recorded[trial.trial_id] = (rung, score)
-            if self._below_cutoff(rung, score):
+            if self._below_cutoff(rungs, rung, score):
                 decision = STOP
             idx += 1
         self._trial_rung[trial.trial_id] = idx
@@ -111,12 +123,13 @@ class AsyncHyperBandScheduler(TrialScheduler):
             # below the cutoff as slower trials record — stop it on its next
             # report rather than letting it run to the next rung.
             rec = self._trial_recorded.get(trial.trial_id)
-            if rec is not None and self._below_cutoff(rec[0], rec[1]):
+            if rec is not None and self._below_cutoff(rungs, rec[0], rec[1]):
                 decision = STOP
         return decision
 
-    def _below_cutoff(self, rung: float, score: float) -> bool:
-        scores = self._rungs.get(rung, [])
+    def _below_cutoff(self, rungs: Dict[float, List[float]], rung: float,
+                      score: float) -> bool:
+        scores = rungs.get(rung, [])
         if len(scores) < self._rf:
             return False
         scores_sorted = sorted(scores, reverse=True)
@@ -163,9 +176,10 @@ class MedianStoppingRule(TrialScheduler):
 
 
 class HyperBandScheduler(AsyncHyperBandScheduler):
-    """Synchronous HyperBand approximated by multi-bracket ASHA — the
-    asynchronous variant dominates in practice (the reference itself
-    recommends ASHA over strict HyperBand)."""
+    """HyperBand as multi-bracket async successive halving: trials are
+    assigned round-robin to brackets whose grace periods grow by the
+    reduction factor (the asynchronous variant dominates strict synchronous
+    HyperBand in practice; the reference itself recommends ASHA)."""
 
     def __init__(self, *args, **kwargs):
         kwargs.setdefault("brackets", 3)
@@ -226,9 +240,19 @@ class PopulationBasedTraining(TrialScheduler):
                     new[key] = spec()
             else:
                 cur = new[key]
-                if isinstance(cur, (int, float)):
+                if isinstance(cur, bool):
+                    new[key] = not cur if self._rng.random() < 0.5 else cur
+                elif isinstance(cur, int):
                     factor = 1.2 if self._rng.random() > 0.5 else 0.8
-                    new[key] = type(cur)(cur * factor)
+                    perturbed = round(cur * factor)
+                    if perturbed == cur:  # small ints must still move
+                        perturbed = cur + (1 if factor > 1 else -1)
+                    if cur >= 1:  # keep inherently positive ints positive
+                        perturbed = max(1, perturbed)
+                    new[key] = perturbed
+                elif isinstance(cur, float):
+                    factor = 1.2 if self._rng.random() > 0.5 else 0.8
+                    new[key] = cur * factor
                 elif isinstance(spec, list):
                     new[key] = self._rng.choice(spec)
         return new

@@ -57,6 +57,8 @@ class BasicVariantGenerator(Searcher):
         self._variants: Optional[List[Dict]] = None
         self._idx = 0
         self._num_samples = 1
+        # honored by Tuner.fit, which wraps this in a ConcurrencyLimiter
+        self._max_concurrent = max_concurrent
 
     def set_num_samples(self, n: int) -> None:
         self._num_samples = n

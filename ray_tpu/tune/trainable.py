@@ -68,6 +68,7 @@ class Trainable:
     def __init__(self, config: Dict[str, Any]):
         self.config = config
         self._iteration = 0
+        self._start_time = time.time()
         self.setup(config)
 
     def setup(self, config: Dict[str, Any]) -> None:
@@ -94,7 +95,7 @@ class Trainable:
         self._iteration += 1
         result.setdefault(DONE, False)
         result[TRAINING_ITERATION] = self._iteration
-        result["time_total_s"] = result.get("time_total_s", time.time())
+        result.setdefault("time_total_s", time.time() - self._start_time)
         return result
 
     def save(self, checkpoint_dir: str) -> Optional[str]:
@@ -186,11 +187,32 @@ def wrap_function(fn: Callable) -> type:
 
     _Wrapped._fn = staticmethod(fn)
     _Wrapped.__name__ = getattr(fn, "__name__", "fn")
+    res = getattr(fn, "_tune_resources", None)
+    if res is not None:
+        _Wrapped._tune_resources = dict(res)
     return _Wrapped
 
 
 def with_resources(trainable, resources: Dict[str, float]):
-    """Attach per-trial resource requirements to a trainable."""
-    trainable = trainable if isinstance(trainable, type) or callable(trainable) else trainable
-    setattr(trainable, "_tune_resources", dict(resources))
-    return trainable
+    """Attach per-trial resource requirements without mutating the caller's
+    trainable (a shared class/function must not leak one Tuner's resources
+    into another's)."""
+    import copy
+    import functools
+    import inspect
+
+    if isinstance(trainable, type):
+        return type(trainable.__name__, (trainable,),
+                    {"_tune_resources": dict(resources)})
+    if inspect.isfunction(trainable) or inspect.ismethod(trainable):
+        @functools.wraps(trainable)
+        def wrapper(*args, **kwargs):
+            return trainable(*args, **kwargs)
+
+        wrapper._tune_resources = dict(resources)
+        return wrapper
+    # instance trainables (e.g. JaxTrainer): shallow-copy so the attribute
+    # doesn't leak into other Tuners sharing the instance
+    clone = copy.copy(trainable)
+    clone._tune_resources = dict(resources)
+    return clone

@@ -10,6 +10,7 @@ inside the trial actor).
 from __future__ import annotations
 
 import dataclasses
+import functools
 import os
 from typing import Any, Callable, Dict, List, Optional
 
@@ -17,7 +18,11 @@ from ray_tpu.train.checkpoint import Checkpoint
 from ray_tpu.train.config import RunConfig
 from ray_tpu.tune.controller import TuneController
 from ray_tpu.tune.schedulers import TrialScheduler
-from ray_tpu.tune.search import BasicVariantGenerator, Searcher
+from ray_tpu.tune.search import (
+    BasicVariantGenerator,
+    ConcurrencyLimiter,
+    Searcher,
+)
 from ray_tpu.tune.trainable import Trainable, wrap_function
 from ray_tpu.tune.trial import ERROR, TERMINATED, Trial
 
@@ -42,9 +47,13 @@ class Result:
         self.path = trial.checkpoint_path
         self.metrics_history = trial.results
         self.trial_id = trial.trial_id
-        self.checkpoint = None
-        if trial.checkpoint_path:
-            ckpt_file = os.path.join(trial.checkpoint_path, "trainable.pkl")
+
+    @functools.cached_property
+    def checkpoint(self) -> Optional[Checkpoint]:
+        """Lazily unpickled — a ResultGrid over many trials must not load
+        every checkpoint payload into driver memory up front."""
+        if self.path:
+            ckpt_file = os.path.join(self.path, "trainable.pkl")
             if os.path.exists(ckpt_file):
                 import pickle
 
@@ -52,7 +61,8 @@ class Result:
                     payload = pickle.load(f)
                 data = payload.get("data")
                 if isinstance(data, dict) and "checkpoint" in data:
-                    self.checkpoint = Checkpoint.from_dict(data["checkpoint"])
+                    return Checkpoint.from_dict(data["checkpoint"])
+        return None
 
     def __repr__(self) -> str:
         return f"Result({self.trial_id}, metrics={self.metrics})"
@@ -140,7 +150,8 @@ def _to_trainable_cls(trainable: Any, param_space: Dict) -> type:
         # resources are reserved atomically by the trainer's own placement
         # group (reference: trial PG inheritance, backend_executor.py:179).
         # Reserving them here too would deadlock supervisor vs. gang.
-        cls._tune_resources = {"cpu": 1}
+        cls._tune_resources = getattr(trainer, "_tune_resources", None) or {
+            "cpu": 1}
         return cls
     if callable(trainable):
         return wrap_function(trainable)
@@ -160,14 +171,29 @@ class Tuner:
 
     @classmethod
     def restore(cls, path: str, trainable: Any,
-                tune_config: Optional[TuneConfig] = None) -> "Tuner":
-        """Resume an interrupted experiment from its directory."""
+                tune_config: Optional[TuneConfig] = None,
+                run_config: Optional[RunConfig] = None) -> "Tuner":
+        """Resume an interrupted experiment from its directory.
+
+        The original TuneConfig/RunConfig (stop criteria, failure budget,
+        checkpoint cadence) are restored from the experiment's pickled meta
+        unless overridden explicitly.
+        """
+        import pickle
+
         trials = TuneController.load_experiment_state(path)
-        run_config = RunConfig(name=os.path.basename(path),
-                               storage_path=os.path.dirname(path))
-        t = cls(trainable, tune_config=tune_config or TuneConfig(),
-                run_config=run_config, _restored_trials=trials)
-        return t
+        meta_path = os.path.join(path, "experiment_meta.pkl")
+        if os.path.exists(meta_path) and (tune_config is None
+                                          or run_config is None):
+            with open(meta_path, "rb") as f:
+                meta = pickle.load(f)
+            tune_config = tune_config or meta.get("tune_config")
+            run_config = run_config or meta.get("run_config")
+        if run_config is None:
+            run_config = RunConfig(name=os.path.basename(path),
+                                   storage_path=os.path.dirname(path))
+        return cls(trainable, tune_config=tune_config or TuneConfig(),
+                   run_config=run_config, _restored_trials=trials)
 
     def fit(self) -> ResultGrid:
         tc = self._tune_config
@@ -175,14 +201,29 @@ class Tuner:
         searcher = tc.search_alg
         if searcher is None:
             searcher = BasicVariantGenerator(seed=tc.seed)
-        if isinstance(searcher, BasicVariantGenerator):
-            searcher.set_num_samples(tc.num_samples)
+        inner = (searcher._searcher if isinstance(searcher, ConcurrencyLimiter)
+                 else searcher)
+        if isinstance(inner, BasicVariantGenerator):
+            inner.set_num_samples(tc.num_samples)
+            if inner._max_concurrent and not isinstance(
+                    searcher, ConcurrencyLimiter):
+                searcher = ConcurrencyLimiter(searcher, inner._max_concurrent)
         searcher.set_search_properties(tc.metric, tc.mode, self._param_space)
 
         name = self._run_config.name or "tune_experiment"
         storage = self._run_config.storage_path or os.path.join(
             os.path.expanduser("~"), "ray_tpu_results")
         experiment_dir = os.path.join(storage, name)
+        os.makedirs(experiment_dir, exist_ok=True)
+        import pickle
+
+        try:
+            with open(os.path.join(experiment_dir, "experiment_meta.pkl"),
+                      "wb") as f:
+                pickle.dump({"tune_config": tc,
+                             "run_config": self._run_config}, f)
+        except Exception:
+            pass  # unpicklable search_alg/stop: restore falls back to args
 
         restored = self._restored_trials
         if restored is not None:

@@ -1,0 +1,225 @@
+"""Data layer: creation, transforms, fused streaming execution, all-to-all
+ops, groupby, batching, splits, file IO (reference test model:
+``python/ray/data/tests/``)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data
+
+
+def test_range_count_take(rt_cluster):
+    ds = data.range(100)
+    assert ds.count() == 100
+    rows = ds.take(5)
+    assert [r["id"] for r in rows] == [0, 1, 2, 3, 4]
+
+
+def test_from_items_and_schema(rt_cluster):
+    ds = data.from_items([{"a": 1, "b": "x"}, {"a": 2, "b": "y"}])
+    assert ds.count() == 2
+    schema = ds.schema()
+    assert "a" in schema and "b" in schema
+
+
+def test_map_batches_and_fusion(rt_cluster):
+    ds = (data.range(64)
+          .map_batches(lambda b: {"id": b["id"] * 2})
+          .map_batches(lambda b: {"id": b["id"] + 1}))
+    out = ds.take_all()
+    assert [r["id"] for r in out[:3]] == [1, 3, 5]
+    # fusion check: two map ops compile into one MapStage
+    from ray_tpu.data.executor import MapStage, plan
+
+    stages = plan(ds._ops)
+    assert len(stages) == 1 and isinstance(stages[0], MapStage)
+    assert len(stages[0].fns) == 2
+
+
+def test_map_filter_flat_map(rt_cluster):
+    ds = data.range(10).map(lambda r: {"v": r["id"] ** 2})
+    assert ds.take(3) == [{"v": 0}, {"v": 1}, {"v": 4}]
+    ds2 = data.range(10).filter(lambda r: r["id"] % 2 == 0)
+    assert ds2.count() == 5
+    ds3 = data.range(3).flat_map(
+        lambda r: [{"x": r["id"]}, {"x": r["id"] + 10}])
+    assert ds3.count() == 6
+
+
+def test_add_drop_select_columns(rt_cluster):
+    ds = (data.range(5)
+          .add_column("double", lambda b: b["id"] * 2)
+          .add_column("junk", lambda b: b["id"] * 0))
+    assert set(ds.columns()) == {"id", "double", "junk"}
+    assert ds.drop_columns(["junk"]).columns() == ["id", "double"]
+    assert ds.select_columns(["double"]).take(2) == [
+        {"double": 0}, {"double": 2}]
+
+
+def test_limit_streaming_early_stop(rt_cluster):
+    ds = data.range(1000).limit(7)
+    assert ds.count() == 7
+
+
+def test_random_shuffle_preserves_rows(rt_cluster):
+    ds = data.range(50).random_shuffle(seed=42)
+    vals = sorted(r["id"] for r in ds.take_all())
+    assert vals == list(range(50))
+    # actually shuffled
+    first = [r["id"] for r in data.range(50).random_shuffle(seed=42).take(10)]
+    assert first != list(range(10))
+
+
+def test_repartition(rt_cluster):
+    ds = data.range(100).repartition(4)
+    assert ds.materialize().num_blocks() == 4
+    assert ds.count() == 100
+
+
+def test_sort(rt_cluster):
+    rng = np.random.default_rng(0)
+    vals = rng.permutation(100).astype(np.int64)
+    ds = data.from_numpy(np.array_split(vals, 4), column="v")
+    out = [r["v"] for r in ds.sort("v").take_all()]
+    assert out == sorted(out)
+    out_desc = [r["v"] for r in ds.sort("v", descending=True).take_all()]
+    assert out_desc == sorted(out_desc, reverse=True)
+
+
+def test_groupby_aggregate(rt_cluster):
+    ds = data.from_items(
+        [{"k": i % 3, "v": float(i)} for i in range(30)])
+    out = {r["k"]: r["sum(v)"] for r in ds.groupby("k").sum("v").take_all()}
+    expect = {k: sum(float(i) for i in range(30) if i % 3 == k)
+              for k in range(3)}
+    assert out == expect
+
+
+def test_groupby_string_keys_across_processes(rt_cluster):
+    """String keys must hash-partition deterministically across worker
+    processes (python hash() is process-salted)."""
+    ds = data.from_items(
+        [{"k": ["apple", "banana", "cherry"][i % 3], "v": 1}
+         for i in range(30)], parallelism=6)
+    out = {r["k"]: r["sum(v)"] for r in ds.groupby("k").sum("v").take_all()}
+    assert out == {"apple": 10, "banana": 10, "cherry": 10}
+
+
+def test_sort_with_empty_blocks(rt_cluster):
+    """Filter can produce empty blocks; all-to-all ops must tolerate them."""
+    s = data.range(100, parallelism=4).filter(lambda r: r["id"] < 10).sort("id")
+    assert [r["id"] for r in s.take_all()] == list(range(10))
+
+
+def test_global_aggregates(rt_cluster):
+    ds = data.range(10)
+    assert ds.sum("id") == 45
+    assert ds.min("id") == 0
+    assert ds.max("id") == 9
+    assert ds.mean("id") == pytest.approx(4.5)
+
+
+def test_union_zip(rt_cluster):
+    a = data.range(5)
+    b = data.range(5).map_batches(lambda blk: {"id": blk["id"] + 100})
+    assert a.union(b).count() == 10
+    z = a.zip(data.range(5).map_batches(lambda blk: {"w": blk["id"] * 10}))
+    rows = z.take_all()
+    assert rows[3] == {"id": 3, "w": 30}
+
+
+def test_iter_batches_across_blocks(rt_cluster):
+    ds = data.range(100, parallelism=7)
+    batches = list(ds.iter_batches(batch_size=32, drop_last=False))
+    sizes = [len(b["id"]) for b in batches]
+    assert sizes == [32, 32, 32, 4]
+    all_ids = np.concatenate([b["id"] for b in batches])
+    assert sorted(all_ids.tolist()) == list(range(100))
+
+
+def test_iter_batches_pandas_format(rt_cluster):
+    import pandas as pd
+
+    ds = data.range(10)
+    (batch,) = list(ds.iter_batches(batch_size=None, batch_format="pandas"))
+    assert isinstance(batch, pd.DataFrame)
+    assert len(batch) == 10
+
+
+def test_actor_pool_map_batches(rt_cluster):
+    class AddOffset:
+        def __init__(self, offset=1000):
+            self.offset = offset
+
+        def __call__(self, batch):
+            return {"id": batch["id"] + self.offset}
+
+    ds = data.range(40).map_batches(
+        AddOffset, compute=data.ActorPoolStrategy(size=2))
+    vals = sorted(r["id"] for r in ds.take_all())
+    assert vals == [i + 1000 for i in range(40)]
+
+
+def test_streaming_split(rt_cluster):
+    ds = data.range(60, parallelism=6)
+    it_a, it_b = ds.streaming_split(2)
+    rows_a = [r["id"] for r in it_a.iter_rows()]
+    rows_b = [r["id"] for r in it_b.iter_rows()]
+    assert sorted(rows_a + rows_b) == list(range(60))
+    assert rows_a and rows_b
+
+
+def test_split_materialized(rt_cluster):
+    parts = data.range(40, parallelism=4).split(2)
+    total = sum(p.count() for p in parts)
+    assert total == 40
+
+
+def test_parquet_roundtrip(rt_cluster, tmp_path):
+    ds = data.range(50).add_column("sq", lambda b: b["id"] ** 2)
+    files = ds.write_parquet(str(tmp_path / "pq"))
+    assert files
+    back = data.read_parquet(str(tmp_path / "pq"))
+    assert back.count() == 50
+    assert back.sum("sq") == sum(i * i for i in range(50))
+
+
+def test_csv_json_roundtrip(rt_cluster, tmp_path):
+    ds = data.from_items([{"a": i, "b": f"s{i}"} for i in range(10)])
+    ds.write_csv(str(tmp_path / "csv"))
+    assert data.read_csv(str(tmp_path / "csv") + "/*.csv").count() == 10
+    ds.write_json(str(tmp_path / "js"))
+    back = data.read_json(str(tmp_path / "js") + "/*.json")
+    assert back.count() == 10
+
+
+def test_random_sample(rt_cluster):
+    n = data.range(1000).random_sample(0.1, seed=0).count()
+    assert 50 < n < 200
+
+
+def test_train_integration_dataset_shard(rt_cluster, tmp_path):
+    """JaxTrainer consumes streaming_split shards (the reference's
+    get_dataset_shard path, train/_internal/session.py:1208)."""
+    from ray_tpu.train import JaxTrainer, RunConfig, ScalingConfig
+
+    ds = data.range(64)
+
+    def loop(config):
+        from ray_tpu import train
+
+        shard = train.get_dataset_shard("train")
+        total = 0
+        for batch in shard.iter_batches(batch_size=8):
+            total += int(batch["id"].sum())
+        train.report({"total": total})
+
+    result = JaxTrainer(
+        loop, scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(name="data_train", storage_path=str(tmp_path)),
+        datasets={"train": ds}).fit()
+    assert result.error is None
+    # both workers together consumed every row exactly once
+    # (driver keeps rank-0 metrics; check the sum is a partition of total)
+    assert 0 < result.metrics["total"] < sum(range(64)) + 1
