@@ -36,7 +36,7 @@ def _compile_map_like(op: L.LogicalOp) -> Callable[[B.Block], B.Block]:
         if isinstance(fn, type):  # class UDF instantiated per-worker elsewhere
             raise TypeError("class UDFs must run on an actor pool")
 
-        def apply_mb(block: B.Block) -> B.Block:
+        def apply_mb(block: B.Block, _i: int) -> B.Block:
             n = B.num_rows(block)
             if n == 0:
                 return block
@@ -51,12 +51,12 @@ def _compile_map_like(op: L.LogicalOp) -> Callable[[B.Block], B.Block]:
 
         return apply_mb
     if isinstance(op, L.MapRows):
-        def apply_rows(block: B.Block) -> B.Block:
+        def apply_rows(block: B.Block, _i: int) -> B.Block:
             return B.from_rows([op.fn(r) for r in B.iter_rows(block)])
 
         return apply_rows
     if isinstance(op, L.Filter):
-        def apply_filter(block: B.Block) -> B.Block:
+        def apply_filter(block: B.Block, _i: int) -> B.Block:
             keep = np.asarray([bool(op.fn(r)) for r in B.iter_rows(block)])
             if not keep.any():
                 return {}
@@ -64,7 +64,7 @@ def _compile_map_like(op: L.LogicalOp) -> Callable[[B.Block], B.Block]:
 
         return apply_filter
     if isinstance(op, L.FlatMap):
-        def apply_flat(block: B.Block) -> B.Block:
+        def apply_flat(block: B.Block, _i: int) -> B.Block:
             rows: List[Dict] = []
             for r in B.iter_rows(block):
                 rows.extend(op.fn(r))
@@ -72,7 +72,7 @@ def _compile_map_like(op: L.LogicalOp) -> Callable[[B.Block], B.Block]:
 
         return apply_flat
     if isinstance(op, L.AddColumn):
-        def apply_add(block: B.Block) -> B.Block:
+        def apply_add(block: B.Block, _i: int) -> B.Block:
             if B.num_rows(block) == 0:
                 return block
             out = dict(block)
@@ -81,16 +81,20 @@ def _compile_map_like(op: L.LogicalOp) -> Callable[[B.Block], B.Block]:
 
         return apply_add
     if isinstance(op, L.DropColumns):
-        return lambda block: {k: v for k, v in block.items()
-                              if k not in op.columns}
+        return lambda block, _i: {k: v for k, v in block.items()
+                                  if k not in op.columns}
     if isinstance(op, L.SelectColumns):
-        return lambda block: {k: block[k] for k in op.columns}
+        return lambda block, _i: (
+            {} if B.num_rows(block) == 0
+            else {k: block[k] for k in op.columns})
     if isinstance(op, L.RandomSample):
-        def apply_sample(block: B.Block) -> B.Block:
+        def apply_sample(block: B.Block, block_idx: int) -> B.Block:
             n = B.num_rows(block)
             if n == 0:
                 return block
-            rng = np.random.default_rng(op.seed)
+            # per-block salt: a shared seed must not correlate blocks
+            seed = None if op.seed is None else op.seed + block_idx
+            rng = np.random.default_rng(seed)
             keep = rng.random(n) < op.fraction
             return B.take_rows(block, np.nonzero(keep)[0])
 
@@ -98,15 +102,17 @@ def _compile_map_like(op: L.LogicalOp) -> Callable[[B.Block], B.Block]:
     raise TypeError(f"not a map-like op: {op}")
 
 
-def _run_fused(fns: List[Callable], block: B.Block) -> B.Block:
+def _run_fused(fns: List[Callable], block: B.Block,
+               block_idx: int) -> B.Block:
     for fn in fns:
-        block = fn(block)
+        block = fn(block, block_idx)
     return block
 
 
 @ray_tpu.remote
-def _map_task(fns: List[Callable], block: B.Block) -> B.Block:
-    return _run_fused(fns, block)
+def _map_task(fns: List[Callable], block: B.Block,
+              block_idx: int) -> B.Block:
+    return _run_fused(fns, block, block_idx)
 
 
 @ray_tpu.remote
@@ -123,8 +129,8 @@ class _MapActor:
         self._args = fn_args
         self._kwargs = fn_kwargs
 
-    def map(self, block: B.Block) -> B.Block:
-        block = _run_fused(self._pre, block)
+    def map(self, block: B.Block, block_idx: int) -> B.Block:
+        block = _run_fused(self._pre, block, block_idx)
         n = B.num_rows(block)
         if n:
             bs = self._bs or n
@@ -135,7 +141,7 @@ class _MapActor:
                 outs.append(B.from_batch(
                     self._udf(batch, *self._args, **self._kwargs)))
             block = B.concat(outs)
-        return _run_fused(self._post, block)
+        return _run_fused(self._post, block, block_idx)
 
 
 # ---------------------------------------------------------------------------
@@ -159,6 +165,7 @@ class MapStage(Stage):
         inflight: collections.deque = collections.deque()
         upstream = iter(upstream)
         exhausted = False
+        block_idx = 0
         while True:
             while not exhausted and len(inflight) < max_inflight:
                 try:
@@ -166,7 +173,8 @@ class MapStage(Stage):
                 except StopIteration:
                     exhausted = True
                     break
-                inflight.append(task.remote(self.fns, ref))
+                inflight.append(task.remote(self.fns, ref, block_idx))
+                block_idx += 1
             if not inflight:
                 return
             yield inflight.popleft()
@@ -199,6 +207,7 @@ class ActorMapStage(Stage):
         counts = {i: 0 for i in range(n_actors)}
         upstream = iter(upstream)
         exhausted = False
+        block_idx = 0
         try:
             while True:
                 while (not exhausted
@@ -210,7 +219,8 @@ class ActorMapStage(Stage):
                         break
                     i = min(counts, key=counts.get)
                     counts[i] += 1
-                    out = pool[i].map.remote(ref)
+                    out = pool[i].map.remote(ref, block_idx)
+                    block_idx += 1
                     issued.append(out)
                     inflight.append((i, out))
                 if not inflight:

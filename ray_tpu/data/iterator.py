@@ -65,32 +65,55 @@ def batches_from_blocks(blocks: Iterator[B.Block], batch_size: Optional[int],
 
 
 def prefetched(it: Iterator[Any], depth: int) -> Iterator[Any]:
-    """Run the upstream iterator in a thread, `depth` items ahead."""
+    """Run the upstream iterator in a thread, `depth` items ahead.
+
+    The producer must not block forever when the consumer abandons the
+    iterator early (``break`` mid-epoch) — a stop event unwinds it and
+    releases its buffered blocks.
+    """
     if depth <= 0:
         yield from it
         return
     q: "queue.Queue" = queue.Queue(maxsize=depth)
     _END = object()
     err: List[BaseException] = []
+    stop = threading.Event()
 
     def producer():
         try:
             for item in it:
-                q.put(item)
+                while not stop.is_set():
+                    try:
+                        q.put(item, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+                if stop.is_set():
+                    return
         except BaseException as e:
             err.append(e)
         finally:
-            q.put(_END)
+            # the END sentinel must arrive even when the queue is full —
+            # keep trying unless the consumer already stopped
+            while not stop.is_set():
+                try:
+                    q.put(_END, timeout=0.1)
+                    break
+                except queue.Full:
+                    continue
 
     t = threading.Thread(target=producer, daemon=True)
     t.start()
-    while True:
-        item = q.get()
-        if item is _END:
-            if err:
-                raise err[0]
-            return
-        yield item
+    try:
+        while True:
+            item = q.get()
+            if item is _END:
+                if err:
+                    raise err[0]
+                return
+            yield item
+    finally:
+        stop.set()
 
 
 class DataIterator:
@@ -133,45 +156,49 @@ class DataIterator:
 
 @ray_tpu.remote
 class _SplitCoordinator:
-    """Hands out blocks of one executing dataset to N consumers.
+    """Hands out block *refs* of one executing dataset to N consumers.
 
     Reference: ``StreamSplitDataIterator`` — blocks are assigned first-come
     (each consumed exactly once); ``equal=True`` balances by row count.
+    Only refs flow through this actor — the payloads resolve directly from
+    the object plane at each consumer (no coordinator copy bottleneck).
     """
 
     def __init__(self, n: int, equal: bool):
         self._n = n
         self._equal = equal
         self._lock = threading.Lock()
-        self._refs: Optional[List] = None
+        self._started = False
         self._queues: List[collections.deque] = [collections.deque()
                                                  for _ in range(n)]
 
-    def _ensure_started(self, dataset_payload) -> None:
-        if self._refs is not None:
-            return
-        ds = dataset_payload
-        refs = list(ds._execute_refs())
-        if self._equal:
-            rows = [B.num_rows(ray_tpu.get(r)) for r in refs]
-            order = np.argsort(rows)[::-1]
-            loads = [0] * self._n
-            for i in order:
-                j = int(np.argmin(loads))
-                self._queues[j].append(refs[i])
-                loads[j] += rows[i]
-        else:
-            for i, r in enumerate(refs):
-                self._queues[i % self._n].append(r)
-        self._refs = refs
-
-    def next_block(self, split_idx: int, dataset_payload):
+    def start(self, dataset_payload) -> None:
+        """Executes the dataset once (first caller wins)."""
         with self._lock:
-            self._ensure_started(dataset_payload)
+            if self._started:
+                return
+            refs = list(dataset_payload._execute_refs())
+            if self._equal:
+                from ray_tpu.data.dataset import _num_rows_task
+
+                rows = ray_tpu.get(
+                    [_num_rows_task.remote(r) for r in refs])
+                order = np.argsort(rows)[::-1]
+                loads = [0] * self._n
+                for i in order:
+                    j = int(np.argmin(loads))
+                    self._queues[j].append(refs[i])
+                    loads[j] += rows[i]
+            else:
+                for i, r in enumerate(refs):
+                    self._queues[i % self._n].append(r)
+            self._started = True
+
+    def next_block_ref(self, split_idx: int):
         q = self._queues[split_idx]
         if not q:
             return None
-        return ray_tpu.get(q.popleft())
+        return q.popleft()
 
 
 class StreamSplitIterator(DataIterator):
@@ -179,12 +206,16 @@ class StreamSplitIterator(DataIterator):
         self._coord = coordinator
         self._idx = split_idx
         self._ds = dataset
+        self._started = False
         super().__init__(self._pull_blocks)
 
     def _pull_blocks(self):
+        if not self._started:
+            # ship the dataset (plan closures) once, not per block
+            ray_tpu.get(self._coord.start.remote(self._ds))
+            self._started = True
         while True:
-            blk = ray_tpu.get(
-                self._coord.next_block.remote(self._idx, self._ds))
-            if blk is None:
+            ref = ray_tpu.get(self._coord.next_block_ref.remote(self._idx))
+            if ref is None:
                 return
-            yield blk
+            yield ray_tpu.get(ref)

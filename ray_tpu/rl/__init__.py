@@ -1,0 +1,35 @@
+"""ray_tpu.rl: the RL stack (the reference's RLlib capability surface).
+
+CPU EnvRunner actor fleets sample vectorized envs with jitted inference;
+Learners apply jitted JAX updates (single host, mesh-sharded over TPU
+chips, or a LearnerGroup of actors syncing host-side); algorithms — PPO,
+IMPALA (V-trace, async), DQN (double-Q + prioritized replay), SAC — are
+Tune Trainables.
+"""
+
+from ray_tpu.rl.algorithm import Algorithm  # noqa: F401
+from ray_tpu.rl.algorithms import (  # noqa: F401
+    DQN,
+    DQNConfig,
+    IMPALA,
+    IMPALAConfig,
+    PPO,
+    PPOConfig,
+    SAC,
+    SACConfig,
+)
+from ray_tpu.rl.config import AlgorithmConfig  # noqa: F401
+from ray_tpu.rl.env import (  # noqa: F401
+    CartPole,
+    EnvSpec,
+    Pendulum,
+    VectorEnv,
+    make_env,
+    register_env,
+)
+from ray_tpu.rl.env_runner import EnvRunner, compute_gae  # noqa: F401
+from ray_tpu.rl.learner import Learner, LearnerGroup  # noqa: F401
+from ray_tpu.rl.replay_buffer import (  # noqa: F401
+    PrioritizedReplayBuffer,
+    ReplayBuffer,
+)

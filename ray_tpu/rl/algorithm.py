@@ -1,0 +1,141 @@
+"""Algorithm: the top-level RL driver, runnable standalone or under Tune.
+
+Reference analog: ``rllib/algorithms/algorithm.py:191`` — ``Algorithm`` is
+a Tune ``Trainable`` whose ``step()`` delegates to the per-algorithm
+``training_step()``. ``AlgorithmConfig.build()`` produces one directly;
+``Tuner(PPO, param_space={...})`` runs it as trials with flat-dict config
+overrides.
+"""
+
+from __future__ import annotations
+
+import pickle
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rl.config import AlgorithmConfig
+from ray_tpu.rl.env import make_env
+from ray_tpu.rl.env_runner import EnvRunner
+from ray_tpu.tune.trainable import Trainable
+
+
+class Algorithm(Trainable):
+    explore_mode = "stochastic"  # DQN overrides with "epsilon_greedy"
+
+    @classmethod
+    def get_default_config(cls) -> AlgorithmConfig:
+        return AlgorithmConfig(algo_class=cls)
+
+    # ---- Trainable API ----
+
+    def setup(self, config: Dict[str, Any]) -> None:
+        if "__algo_config" in config:
+            self.config: AlgorithmConfig = config["__algo_config"]
+        else:
+            self.config = self.get_default_config().update_from_dict(config)
+        cfg = self.config
+        # probe the env spec without an actor round-trip
+        self.spec = make_env(cfg.env, 1, cfg.env_config).spec
+        n_runners = max(1, cfg.num_env_runners)
+        self.runners = [
+            EnvRunner.options(num_cpus=cfg.num_cpus_per_runner).remote(
+                cfg.env, cfg.num_envs_per_runner,
+                cfg.rollout_fragment_length, cfg.gamma, cfg.lambda_,
+                seed=cfg.seed + 1000 * i, env_config=cfg.env_config,
+                explore=self.explore_mode)
+            for i in range(n_runners)
+        ]
+        self._env_steps_total = 0
+        self._return_window: List[float] = []
+        self.build_learner()
+
+    def build_learner(self) -> None:
+        raise NotImplementedError
+
+    def training_step(self) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def step(self) -> Dict[str, Any]:
+        result = self.training_step()
+        result.setdefault("env_steps_total", self._env_steps_total)
+        return result
+
+    # ---- helpers ----
+
+    def synchronous_sample(self, params) -> Dict[str, np.ndarray]:
+        """Fan out sample() to the runner fleet and concat fragments
+        (reference: ``rollout_ops.synchronous_parallel_sample``)."""
+        batches = ray_tpu.get([r.sample.remote(params)
+                               for r in self.runners])
+        batch = {k: np.concatenate([b[k] for b in batches])
+                 for k in batches[0]}
+        n = len(batch["rewards"])
+        # drop per-fragment extras (e.g. [N]-shaped bootstrap values) that
+        # can't be row-sliced with the [T*N] columns by minibatch updates
+        batch = {k: v for k, v in batch.items() if len(v) == n}
+        self._env_steps_total += n
+        return batch
+
+    def collect_episode_stats(self) -> Dict[str, float]:
+        stats = ray_tpu.get([r.episode_stats.remote()
+                             for r in self.runners])
+        returns = [s["mean_return"] for s in stats if s["episodes"] > 0]
+        episodes = sum(s["episodes"] for s in stats)
+        if returns:
+            self._return_window.extend(returns)
+            self._return_window = self._return_window[-100:]
+        mean_ret = (float(np.mean(self._return_window))
+                    if self._return_window else float("nan"))
+        return {"episodes_this_iter": episodes,
+                "episode_return_mean": mean_ret}
+
+    # ---- checkpointing ----
+
+    def save_checkpoint(self, checkpoint_dir: str) -> Optional[Dict]:
+        import jax
+
+        params = jax.tree_util.tree_map(np.asarray, self.get_params())
+        return {"params": params,
+                "env_steps_total": self._env_steps_total,
+                "extra": self.get_extra_state()}
+
+    def load_checkpoint(self, checkpoint: Dict) -> None:
+        self.set_params(checkpoint["params"])
+        self._env_steps_total = checkpoint.get("env_steps_total", 0)
+        self.set_extra_state(checkpoint.get("extra"))
+
+    def get_params(self):
+        return self.learner.get_params()
+
+    def set_params(self, params) -> None:
+        self.learner.set_params(params)
+
+    def get_extra_state(self):
+        return None
+
+    def set_extra_state(self, state) -> None:
+        pass
+
+    # standalone convenience mirroring the reference's Algorithm.save/restore
+    def save(self, checkpoint_dir: str) -> Optional[str]:  # type: ignore[override]
+        return super().save(checkpoint_dir)
+
+    @classmethod
+    def from_checkpoint(cls, checkpoint_dir: str,
+                        config: Optional[AlgorithmConfig] = None
+                        ) -> "Algorithm":
+        algo = (config or cls.get_default_config()).build()
+        algo.restore(checkpoint_dir)
+        return algo
+
+    def train(self) -> Dict[str, Any]:
+        return super().train()
+
+    def stop(self) -> None:
+        for r in getattr(self, "runners", []):
+            try:
+                ray_tpu.kill(r, no_restart=True)
+            except Exception:
+                pass

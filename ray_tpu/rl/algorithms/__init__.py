@@ -1,0 +1,4 @@
+from ray_tpu.rl.algorithms.dqn import DQN, DQNConfig  # noqa: F401
+from ray_tpu.rl.algorithms.impala import IMPALA, IMPALAConfig  # noqa: F401
+from ray_tpu.rl.algorithms.ppo import PPO, PPOConfig  # noqa: F401
+from ray_tpu.rl.algorithms.sac import SAC, SACConfig  # noqa: F401

@@ -1,0 +1,141 @@
+"""DQN with double-Q, target network, and optional prioritized replay.
+
+Reference analog: ``rllib/algorithms/dqn/`` (+ ``utils/replay_buffers``).
+The Q-net reuses the policy MLP ("pi" head emits Q-values); exploration is
+epsilon-greedy on the EnvRunner fleet with the epsilon schedule riding in
+the params pytree (no recompiles).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.rl import models
+from ray_tpu.rl.algorithm import Algorithm
+from ray_tpu.rl.config import AlgorithmConfig
+from ray_tpu.rl.learner import Learner
+from ray_tpu.rl.replay_buffer import PrioritizedReplayBuffer, ReplayBuffer
+
+
+class DQN(Algorithm):
+    explore_mode = "epsilon_greedy"
+
+    @classmethod
+    def get_default_config(cls) -> AlgorithmConfig:
+        cfg = AlgorithmConfig(algo_class=cls)
+        cfg.lr = 1e-3
+        cfg.minibatch_size = 64
+        return cfg
+
+    def build_learner(self) -> None:
+        cfg, spec = self.config, self.spec
+        gamma, double_q = cfg.gamma, cfg.double_q
+
+        def loss_fn(params, batch, key):
+            q = models.policy_logits(params["q"], batch["obs"])
+            q_taken = jnp.take_along_axis(
+                q, batch["actions"][..., None].astype(jnp.int32),
+                axis=-1)[..., 0]
+            q_next_t = models.policy_logits(params["target"],
+                                            batch["next_obs"])
+            if double_q:
+                q_next_online = models.policy_logits(params["q"],
+                                                     batch["next_obs"])
+                best = jnp.argmax(q_next_online, axis=-1)
+                q_next = jnp.take_along_axis(
+                    q_next_t, best[..., None], axis=-1)[..., 0]
+            else:
+                q_next = jnp.max(q_next_t, axis=-1)
+            nonterminal = 1.0 - batch["dones"].astype(jnp.float32)
+            target = batch["rewards"] + gamma * nonterminal \
+                * jax.lax.stop_gradient(q_next)
+            td = q_taken - target
+            weights = batch.get("weights", jnp.ones_like(td))
+            loss = jnp.mean(weights * td ** 2)
+            return loss, {"td_abs_mean": jnp.mean(jnp.abs(td)),
+                          "q_mean": jnp.mean(q_taken),
+                          "td": jax.lax.stop_gradient(td)}
+
+        init_q = models.init_policy(jax.random.key(cfg.seed), spec,
+                                    cfg.hidden)
+        params = {"q": init_q, "target": jax.tree_util.tree_map(
+            jnp.copy, init_q)}
+        self.learner = Learner(params, loss_fn, cfg.lr,
+                               grad_clip=cfg.grad_clip, seed=cfg.seed)
+        # the target net takes zero grads through the stop_gradient, but
+        # adam's eps term would still drift it — training_step restores it
+        # after every update and hard-syncs on the schedule instead
+        buf_cls = (PrioritizedReplayBuffer if cfg.prioritized_replay
+                   else ReplayBuffer)
+        if cfg.prioritized_replay:
+            self.buffer = buf_cls(cfg.buffer_size, alpha=cfg.replay_alpha,
+                                  beta=cfg.replay_beta, seed=cfg.seed)
+        else:
+            self.buffer = buf_cls(cfg.buffer_size, seed=cfg.seed)
+        self._updates = 0
+
+    def _epsilon(self) -> float:
+        cfg = self.config
+        frac = min(1.0, self._env_steps_total / max(1, cfg.epsilon_decay_steps))
+        return cfg.epsilon_initial + frac * (cfg.epsilon_final
+                                             - cfg.epsilon_initial)
+
+    def _runner_params(self):
+        p = self.learner.get_params()
+        return {"pi": p["q"]["pi"], "vf": p["q"]["vf"],
+                "epsilon": jnp.asarray(self._epsilon())}
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.config
+        batch = self.synchronous_sample(self._runner_params())
+        self.buffer.add_batch(
+            {k: batch[k] for k in
+             ("obs", "actions", "rewards", "next_obs", "dones")})
+        metrics: Dict[str, Any] = {"epsilon": self._epsilon(),
+                                   "buffer_size": len(self.buffer)}
+        if len(self.buffer) >= cfg.learning_starts:
+            num_updates = max(1, len(batch["rewards"]) // cfg.minibatch_size)
+            td_list = []
+            for _ in range(num_updates):
+                target_before = self.learner.params["target"]
+                if cfg.prioritized_replay:
+                    sample, idx, weights = self.buffer.sample(
+                        cfg.minibatch_size)
+                    sample = dict(sample, weights=weights)
+                else:
+                    sample = self.buffer.sample(cfg.minibatch_size)
+                m = self.learner.update_minibatch(sample)
+                # target net is updated only by periodic hard sync
+                self.learner.params = dict(self.learner.params,
+                                           target=target_before)
+                if cfg.prioritized_replay:
+                    self.buffer.update_priorities(idx, np.asarray(m["td"]))
+                td_list.append(float(m["td_abs_mean"]))
+                self._updates += 1
+                if self._updates % cfg.target_update_freq == 0:
+                    self.learner.params = dict(
+                        self.learner.params,
+                        target=jax.tree_util.tree_map(
+                            jnp.copy, self.learner.params["q"]))
+            metrics["td_abs_mean"] = float(np.mean(td_list))
+            metrics["num_updates"] = self._updates
+        metrics.update(self.collect_episode_stats())
+        return metrics
+
+    def get_extra_state(self):
+        return {"updates": self._updates}
+
+    def set_extra_state(self, state) -> None:
+        if state:
+            self._updates = state.get("updates", 0)
+
+
+class DQNConfig(AlgorithmConfig):
+    def __init__(self, **kwargs):
+        super().__init__(algo_class=DQN, **kwargs)
+        self.lr = 1e-3
+        self.minibatch_size = 64

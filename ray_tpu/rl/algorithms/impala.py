@@ -1,0 +1,137 @@
+"""IMPALA: async actor-learner with V-trace off-policy correction.
+
+Reference analog: ``rllib/algorithms/impala/impala.py:68`` + the learner
+thread pipeline (``execution/multi_gpu_learner_thread.py``) + V-trace
+(``vtrace_torch.py``). Sampling is asynchronous: runners keep producing
+fragments under slightly stale params; the learner consumes them as they
+land (``ray_tpu.wait``) and corrects the off-policyness with V-trace —
+computed inside the jitted loss via ``lax.scan``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rl import models
+from ray_tpu.rl.algorithm import Algorithm
+from ray_tpu.rl.config import AlgorithmConfig
+from ray_tpu.rl.learner import Learner
+
+
+def vtrace(behavior_logp, target_logp, rewards, values, bootstrap_value,
+           dones, gamma, clip_rho: float = 1.0, clip_pg_rho: float = 1.0):
+    """V-trace targets over a [T, N] fragment (Espeholt et al. 2018),
+    as a jittable backward lax.scan."""
+    rho = jnp.exp(target_logp - behavior_logp)
+    clipped_rho = jnp.minimum(clip_rho, rho)
+    clipped_pg_rho = jnp.minimum(clip_pg_rho, rho)
+    nonterminal = 1.0 - dones.astype(jnp.float32)
+    values_next = jnp.concatenate(
+        [values[1:], bootstrap_value[None]], axis=0)
+    deltas = clipped_rho * (
+        rewards + gamma * nonterminal * values_next - values)
+
+    def scan_fn(acc, t):
+        delta_t, nonterm_t, c_t = t
+        acc = delta_t + gamma * nonterm_t * c_t * acc
+        return acc, acc
+
+    cs = jnp.minimum(1.0, rho)
+    _, vs_minus_v = jax.lax.scan(
+        scan_fn, jnp.zeros_like(bootstrap_value),
+        (deltas, nonterminal, cs), reverse=True)
+    vs = vs_minus_v + values
+    vs_next = jnp.concatenate([vs[1:], bootstrap_value[None]], axis=0)
+    pg_advantages = clipped_pg_rho * (
+        rewards + gamma * nonterminal * vs_next - values)
+    return jax.lax.stop_gradient(vs), jax.lax.stop_gradient(pg_advantages)
+
+
+class IMPALA(Algorithm):
+    @classmethod
+    def get_default_config(cls) -> AlgorithmConfig:
+        cfg = AlgorithmConfig(algo_class=cls)
+        cfg.num_env_runners = 2
+        cfg.entropy_coeff = 0.01
+        return cfg
+
+    def build_learner(self) -> None:
+        cfg, spec = self.config, self.spec
+        T = cfg.rollout_fragment_length
+        gamma = cfg.gamma
+        vf_coeff, ent_coeff = cfg.vf_coeff, cfg.entropy_coeff
+        clip_rho, clip_pg = cfg.vtrace_clip_rho, cfg.vtrace_clip_pg_rho
+
+        def loss_fn(params, batch, key):
+            # batch arrives flat [T*N, ...]; reshape to [T, N] for the scan
+            N = batch["rewards"].shape[0] // T
+            sh = lambda a: a.reshape((T, N) + a.shape[1:])  # noqa: E731
+            obs = sh(batch["obs"])
+            actions = sh(batch["actions"])
+            logits = models.policy_logits(params, obs)
+            if spec.discrete:
+                target_logp = models.categorical_logp(logits, actions)
+                entropy = models.categorical_entropy(logits).mean()
+            else:
+                target_logp = models.gaussian_logp(
+                    logits, params["log_std"], actions)
+                entropy = models.gaussian_entropy(params["log_std"])
+            values = models.value(params, obs)
+            bootstrap = batch["last_values"]  # [N]
+            vs, pg_adv = vtrace(
+                sh(batch["logp"]), target_logp, sh(batch["rewards"]),
+                values, bootstrap, sh(batch["dones"]), gamma,
+                clip_rho, clip_pg)
+            pi_loss = -jnp.mean(target_logp * pg_adv)
+            vf_loss = jnp.mean((values - vs) ** 2)
+            total = pi_loss + vf_coeff * vf_loss - ent_coeff * entropy
+            return total, {"pi_loss": pi_loss, "vf_loss": vf_loss,
+                           "entropy": entropy}
+
+        params = models.init_policy(jax.random.key(cfg.seed), spec,
+                                    cfg.hidden)
+        self.learner = Learner(params, loss_fn, cfg.lr,
+                               grad_clip=cfg.grad_clip, seed=cfg.seed)
+        self._inflight: Dict[Any, Any] = {}
+
+    def _submit(self, runner) -> None:
+        ref = runner.sample.remote(self.learner.get_params())
+        self._inflight[ref] = runner
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.config
+        for r in self.runners:  # keep every runner busy (async pipeline)
+            if r not in self._inflight.values():
+                self._submit(r)
+        metrics_list: List[Dict] = []
+        consumed = 0
+        # consume as many fragments as there are runners per step
+        for _ in range(len(self.runners)):
+            ready, _ = ray_tpu.wait(list(self._inflight), num_returns=1)
+            ref = ready[0]
+            runner = self._inflight.pop(ref)
+            batch = ray_tpu.get(ref)
+            self._submit(runner)  # immediately resubmit with fresh params
+            consumed += len(batch["rewards"])
+            self._env_steps_total += len(batch["rewards"])
+            metrics_list.append(self.learner.update_minibatch(batch))
+        out = {k: float(np.mean([float(m[k]) for m in metrics_list]))
+               for k in metrics_list[0]}
+        out["env_steps_this_iter"] = consumed
+        out.update(self.collect_episode_stats())
+        return out
+
+    def stop(self) -> None:
+        self._inflight.clear()
+        super().stop()
+
+
+class IMPALAConfig(AlgorithmConfig):
+    def __init__(self, **kwargs):
+        super().__init__(algo_class=IMPALA, **kwargs)
+        self.num_env_runners = 2

@@ -1,0 +1,91 @@
+"""PPO: clipped-objective policy gradient.
+
+Reference analog: ``rllib/algorithms/ppo/ppo.py:60`` (driver) +
+``ppo/torch/ppo_torch_learner.py:29`` (loss). The loss is a single jitted
+JAX function (clip surrogate + value loss + entropy bonus, advantages
+normalized per-minibatch); the update runs epochs x minibatches on the
+Learner (in-process, mesh-sharded, or a LearnerGroup of actors).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.rl import models
+from ray_tpu.rl.algorithm import Algorithm
+from ray_tpu.rl.config import AlgorithmConfig
+from ray_tpu.rl.env import EnvSpec
+from ray_tpu.rl.learner import Learner, LearnerGroup
+
+
+def make_ppo_loss(spec: EnvSpec, clip_param: float, vf_coeff: float,
+                  entropy_coeff: float):
+    def loss_fn(params, batch, key):
+        obs = batch["obs"]
+        logits = models.policy_logits(params, obs)
+        if spec.discrete:
+            logp = models.categorical_logp(logits, batch["actions"])
+            entropy = models.categorical_entropy(logits).mean()
+        else:
+            logp = models.gaussian_logp(logits, params["log_std"],
+                                        batch["actions"])
+            entropy = models.gaussian_entropy(params["log_std"])
+        ratio = jnp.exp(logp - batch["logp"])
+        adv = batch["advantages"]
+        adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+        surr = jnp.minimum(
+            ratio * adv,
+            jnp.clip(ratio, 1 - clip_param, 1 + clip_param) * adv)
+        policy_loss = -surr.mean()
+        values = models.value(params, obs)
+        vf_loss = jnp.mean((values - batch["value_targets"]) ** 2)
+        total = policy_loss + vf_coeff * vf_loss - entropy_coeff * entropy
+        kl = jnp.mean(batch["logp"] - logp)
+        return total, {"policy_loss": policy_loss, "vf_loss": vf_loss,
+                       "entropy": entropy, "kl": kl}
+
+    return loss_fn
+
+
+class PPO(Algorithm):
+    @classmethod
+    def get_default_config(cls) -> AlgorithmConfig:
+        return AlgorithmConfig(algo_class=cls)
+
+    def build_learner(self) -> None:
+        cfg, spec = self.config, self.spec
+        loss_fn = make_ppo_loss(spec, cfg.clip_param, cfg.vf_coeff,
+                                cfg.entropy_coeff)
+        seed, hidden, lr, clip = cfg.seed, cfg.hidden, cfg.lr, cfg.grad_clip
+
+        def ctor() -> Learner:
+            params = models.init_policy(
+                jax.random.key(seed), spec, hidden)
+            return Learner(params, loss_fn, lr, grad_clip=clip, seed=seed)
+
+        if cfg.num_learners > 0:
+            self.learner = LearnerGroup(ctor, cfg.num_learners,
+                                        cfg.num_tpus_per_learner)
+        else:
+            self.learner = ctor()
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.config
+        params = self.learner.get_params()
+        batch = self.synchronous_sample(params)
+        metrics = self.learner.update(
+            batch, num_epochs=cfg.num_epochs,
+            minibatch_size=cfg.minibatch_size,
+            seed=cfg.seed + self._iteration)
+        result = dict(metrics)
+        result.update(self.collect_episode_stats())
+        result["env_steps_this_iter"] = len(batch["rewards"])
+        return result
+
+
+class PPOConfig(AlgorithmConfig):
+    def __init__(self, **kwargs):
+        super().__init__(algo_class=PPO, **kwargs)

@@ -1,0 +1,166 @@
+"""EnvRunner: the sampling actor.
+
+Reference analog: ``rllib/evaluation/rollout_worker.py:159`` (``sample
+:660``) + GAE postprocessing (``evaluation/postprocessing.py:89/:158``).
+An EnvRunner holds a vectorized env and a jitted policy forward; ``sample``
+steps a fixed-length fragment (static shapes — one XLA compile) and returns
+a columnar SampleBatch. A fleet of these actors feeds the Learner.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rl import models
+from ray_tpu.rl.env import make_env
+
+
+def compute_gae(rewards: np.ndarray, values: np.ndarray, dones: np.ndarray,
+                last_values: np.ndarray, gamma: float,
+                lam: float) -> Dict[str, np.ndarray]:
+    """Vectorized GAE over a [T, N] fragment (numpy scan, CPU-side)."""
+    T, N = rewards.shape
+    adv = np.zeros((T, N), dtype=np.float32)
+    last_gae = np.zeros(N, dtype=np.float32)
+    next_values = last_values
+    for t in range(T - 1, -1, -1):
+        nonterminal = 1.0 - dones[t].astype(np.float32)
+        delta = rewards[t] + gamma * next_values * nonterminal - values[t]
+        last_gae = delta + gamma * lam * nonterminal * last_gae
+        adv[t] = last_gae
+        next_values = values[t]
+    returns = adv + values
+    return {"advantages": adv, "value_targets": returns}
+
+
+@ray_tpu.remote
+class EnvRunner:
+    """One sampling actor: vectorized env + jitted CPU inference."""
+
+    def __init__(self, env_name: str, num_envs: int, rollout_len: int,
+                 gamma: float = 0.99, lam: float = 0.95, seed: int = 0,
+                 env_config: Optional[Dict] = None,
+                 explore: str = "stochastic"):
+        import jax
+        import jax.numpy as jnp
+
+        self._env = make_env(env_name, num_envs, env_config, seed=seed)
+        self.spec = self._env.spec
+        self._rollout_len = rollout_len
+        self._gamma, self._lam = gamma, lam
+        self._key = jax.random.key(seed)
+        self._obs = self._env.reset()
+        self._episode_returns = np.zeros(num_envs, dtype=np.float64)
+        self._completed: list = []
+
+        spec = self.spec
+
+        @jax.jit
+        def act(params, obs, key):
+            logits = models.policy_logits(params, obs)
+            if explore == "epsilon_greedy":
+                vals = jnp.max(logits, axis=-1)  # Q-net has no value head
+            else:
+                vals = models.value(params, obs)
+            if explore == "epsilon_greedy":
+                # logits are Q-values; epsilon rides in the params pytree
+                # so a fresh schedule value needs no recompile
+                k1, k2 = jax.random.split(key)
+                greedy = jnp.argmax(logits, axis=-1)
+                rand = jax.random.randint(
+                    k1, greedy.shape, 0, spec.num_actions)
+                eps = params["epsilon"]
+                pick = jax.random.uniform(k2, greedy.shape) < eps
+                actions = jnp.where(pick, rand, greedy)
+                logp = jnp.zeros(actions.shape)
+            elif spec.discrete:
+                actions = models.categorical_sample(key, logits)
+                logp = models.categorical_logp(logits, actions)
+            else:
+                actions = models.gaussian_sample(
+                    key, logits, params["log_std"])
+                logp = models.gaussian_logp(
+                    logits, params["log_std"], actions)
+            return actions, logp, vals
+
+        self._act = act
+        if explore == "epsilon_greedy":
+            self._value_fn = jax.jit(
+                lambda p, o: jnp.max(models.policy_logits(p, o), axis=-1))
+        else:
+            self._value_fn = jax.jit(models.value)
+
+    def get_spec(self):
+        return self.spec
+
+    def sample(self, params) -> Dict[str, np.ndarray]:
+        """Collect one [T, N] fragment with the given policy params."""
+        import jax
+
+        T, N = self._rollout_len, self._env.num_envs
+        obs_buf = np.zeros((T, N, self.spec.obs_dim), dtype=np.float32)
+        act_shape = (T, N) if self.spec.discrete else (
+            T, N, self.spec.action_dim)
+        act_buf = np.zeros(
+            act_shape,
+            dtype=np.int32 if self.spec.discrete else np.float32)
+        logp_buf = np.zeros((T, N), dtype=np.float32)
+        val_buf = np.zeros((T, N), dtype=np.float32)
+        rew_buf = np.zeros((T, N), dtype=np.float32)
+        done_buf = np.zeros((T, N), dtype=bool)
+        next_obs_buf = np.zeros((T, N, self.spec.obs_dim), dtype=np.float32)
+
+        exec_buf = (act_buf if self.spec.discrete
+                    else np.zeros_like(act_buf))
+        for t in range(T):
+            self._key, sub = jax.random.split(self._key)
+            actions, logp, vals = self._act(params, self._obs, sub)
+            actions = np.asarray(actions)
+            obs_buf[t] = self._obs
+            # "actions" stores the raw policy sample (PPO's ratio needs the
+            # logp-consistent action); "actions_executed" stores what the
+            # env actually ran (what replay-based critics must train on)
+            act_buf[t] = actions
+            logp_buf[t] = np.asarray(logp)
+            val_buf[t] = np.asarray(vals)
+            if not self.spec.discrete:
+                actions = np.clip(actions, self.spec.action_low,
+                                  self.spec.action_high)
+                exec_buf[t] = actions
+            self._obs, rewards, dones = self._env.step(actions)
+            rew_buf[t] = rewards
+            done_buf[t] = dones
+            # post-reset obs on done rows is fine: (1-done) masks bootstrap
+            next_obs_buf[t] = self._obs
+            self._episode_returns += rewards
+            if dones.any():
+                for r in self._episode_returns[dones]:
+                    self._completed.append(float(r))
+                self._episode_returns[dones] = 0.0
+
+        last_values = np.asarray(self._value_fn(params, self._obs))
+        gae = compute_gae(rew_buf, val_buf, done_buf, last_values,
+                          self._gamma, self._lam)
+        flat = lambda a: a.reshape((T * N,) + a.shape[2:])  # noqa: E731
+        return {
+            "obs": flat(obs_buf), "actions": flat(act_buf),
+            "actions_executed": flat(exec_buf),
+            "logp": flat(logp_buf), "values": flat(val_buf),
+            "rewards": flat(rew_buf), "dones": flat(done_buf),
+            "next_obs": flat(next_obs_buf),
+            "advantages": flat(gae["advantages"]),
+            "value_targets": flat(gae["value_targets"]),
+            # [N] bootstrap for off-policy corrections (IMPALA V-trace)
+            "last_values": last_values.astype(np.float32),
+        }
+
+    def episode_stats(self) -> Dict[str, float]:
+        completed, self._completed = self._completed, []
+        if not completed:
+            return {"episodes": 0, "mean_return": float("nan")}
+        return {"episodes": len(completed),
+                "mean_return": float(np.mean(completed))}

@@ -1,0 +1,106 @@
+"""RLModule: pure-JAX policy/value networks + action distributions.
+
+Reference analog: ``rllib/core/rl_module/rl_module.py`` + the jax seeds in
+``rllib/models/jax/`` (``fcnet.py``, ``jax_action_dist.py``). Params are
+pytrees; forward fns are jittable and shared verbatim between the CPU
+EnvRunners (inference) and the TPU Learner (training) — one definition,
+two compilation targets.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.rl.env import EnvSpec
+
+
+def _dense_init(key, in_dim: int, out_dim: int, scale: float = 1.0):
+    w_key, _ = jax.random.split(key)
+    # orthogonal init: the standard PPO-stabilizing choice
+    mat = jax.random.normal(w_key, (in_dim, out_dim))
+    q, r = jnp.linalg.qr(mat)
+    q = q * jnp.sign(jnp.diag(r))[None, : q.shape[1]]
+    if q.shape != (in_dim, out_dim):
+        q = jnp.resize(q, (in_dim, out_dim))
+    return {"w": q * scale, "b": jnp.zeros(out_dim)}
+
+
+def init_mlp(key, dims: Sequence[int], out_scale: float = 0.01) -> Dict:
+    layers = []
+    keys = jax.random.split(key, len(dims) - 1)
+    for i, (a, b) in enumerate(zip(dims[:-1], dims[1:])):
+        scale = out_scale if i == len(dims) - 2 else jnp.sqrt(2.0)
+        layers.append(_dense_init(keys[i], a, b, scale))
+    return {"layers": layers}
+
+
+def mlp_forward(params: Dict, x: jnp.ndarray) -> jnp.ndarray:
+    h = x
+    n = len(params["layers"])
+    for i, layer in enumerate(params["layers"]):
+        h = h @ layer["w"] + layer["b"]
+        if i < n - 1:
+            h = jnp.tanh(h)
+    return h
+
+
+def init_policy(key, spec: EnvSpec, hidden: Sequence[int] = (64, 64)) -> Dict:
+    pk, vk, lk = jax.random.split(key, 3)
+    out = spec.num_actions if spec.discrete else spec.action_dim
+    params = {
+        "pi": init_mlp(pk, [spec.obs_dim, *hidden, out]),
+        "vf": init_mlp(vk, [spec.obs_dim, *hidden, 1], out_scale=1.0),
+    }
+    if not spec.discrete:
+        params["log_std"] = jnp.zeros(spec.action_dim)
+    return params
+
+
+def policy_logits(params: Dict, obs: jnp.ndarray) -> jnp.ndarray:
+    return mlp_forward(params["pi"], obs)
+
+
+def value(params: Dict, obs: jnp.ndarray) -> jnp.ndarray:
+    return mlp_forward(params["vf"], obs)[..., 0]
+
+
+# ---- distributions ----
+
+
+def categorical_sample(key, logits: jnp.ndarray) -> jnp.ndarray:
+    return jax.random.categorical(key, logits)
+
+
+def categorical_logp(logits: jnp.ndarray, actions: jnp.ndarray) -> jnp.ndarray:
+    logp = jax.nn.log_softmax(logits)
+    return jnp.take_along_axis(logp, actions[..., None], axis=-1)[..., 0]
+
+
+def categorical_entropy(logits: jnp.ndarray) -> jnp.ndarray:
+    logp = jax.nn.log_softmax(logits)
+    return -jnp.sum(jnp.exp(logp) * logp, axis=-1)
+
+
+def gaussian_sample(key, mean: jnp.ndarray, log_std: jnp.ndarray):
+    return mean + jnp.exp(log_std) * jax.random.normal(key, mean.shape)
+
+
+def gaussian_logp(mean: jnp.ndarray, log_std: jnp.ndarray,
+                  actions: jnp.ndarray) -> jnp.ndarray:
+    var = jnp.exp(2 * log_std)
+    return jnp.sum(
+        -0.5 * ((actions - mean) ** 2 / var + 2 * log_std
+                + jnp.log(2 * jnp.pi)), axis=-1)
+
+
+def gaussian_entropy(log_std: jnp.ndarray) -> jnp.ndarray:
+    return jnp.sum(log_std + 0.5 * jnp.log(2 * jnp.pi * jnp.e))
+
+
+def num_params(params) -> int:
+    return sum(int(np.prod(x.shape))
+               for x in jax.tree_util.tree_leaves(params))

@@ -197,6 +197,30 @@ def test_csv_json_roundtrip(rt_cluster, tmp_path):
 def test_random_sample(rt_cluster):
     n = data.range(1000).random_sample(0.1, seed=0).count()
     assert 50 < n < 200
+    # blocks must sample independently (per-block salt), not in lockstep
+    ids = [r["id"] for r in
+           data.range(800, parallelism=4).random_sample(0.2, seed=7)
+           .take_all()]
+    offsets_per_block = [set(i % 200 for i in ids if i // 200 == b)
+                         for b in range(4)]
+    assert len(set(map(frozenset, offsets_per_block))) > 1
+
+
+def test_iter_batches_early_break(rt_cluster):
+    """Abandoning a prefetched iterator must not wedge (producer unwinds)."""
+    ds = data.range(200, parallelism=8)
+    for _ in range(5):
+        for batch in ds.iter_batches(batch_size=16, prefetch_batches=2):
+            break  # consumer walks away immediately
+    # and full consumption still works afterwards
+    assert ds.count() == 200
+
+
+def test_filter_then_select_empty_blocks(rt_cluster):
+    ds = (data.range(100, parallelism=4)
+          .filter(lambda r: r["id"] < 10)
+          .select_columns(["id"]))
+    assert sorted(r["id"] for r in ds.take_all()) == list(range(10))
 
 
 def test_train_integration_dataset_shard(rt_cluster, tmp_path):
