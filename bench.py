@@ -83,7 +83,7 @@ def _is_oom(err: BaseException) -> bool:
             or "out of memory" in s or "hbm capacity" in s)
 
 
-def main() -> None:
+def _inner_main() -> None:
     import jax
 
     platform = jax.devices()[0].platform
@@ -141,6 +141,116 @@ def main() -> None:
         "vs_baseline": round(vs, 4),
         "details": result,
     }))
+
+
+_REPO_ROOT = os.path.dirname(os.path.abspath(__file__))
+
+
+def _cpu_env() -> dict:
+    """Scrubbed env forcing the CPU platform (axon sitecustomize removed).
+
+    Single source of truth for the scrub lives in __graft_entry__."""
+    import sys
+
+    sys.path.insert(0, _REPO_ROOT)
+    from __graft_entry__ import _cpu_scrubbed_env
+
+    return _cpu_scrubbed_env(1)
+
+
+def _run_inner(env: dict, timeout: float):
+    """Run the bench inner loop in a subprocess; return its JSON line or None.
+
+    The subprocess boundary is the watchdog: round 1 showed TPU backend init
+    can either raise (UNAVAILABLE) or hang indefinitely with zero output, so
+    neither an except-clause nor an alarm inside the same process is enough —
+    jax holds the GIL during plugin init."""
+    import subprocess
+    import sys
+    import tempfile
+
+    env = dict(env)
+    env["RT_BENCH_INNER"] = "1"
+    with tempfile.TemporaryFile(mode="w+") as out:
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)], env=env,
+                cwd=_REPO_ROOT, stdout=out, timeout=timeout)
+        except subprocess.TimeoutExpired:
+            print(f"bench: inner run timed out after {timeout}s",
+                  file=sys.stderr)
+            return None
+        out.seek(0)
+        lines = [ln for ln in out.read().splitlines() if ln.strip()]
+    if proc.returncode != 0 or not lines:
+        print(f"bench: inner run failed rc={proc.returncode}", file=sys.stderr)
+        return None
+    for ln in reversed(lines):
+        try:
+            return json.loads(ln)
+        except ValueError:
+            continue
+    return None
+
+
+def _probe_backend(timeout: float) -> str | None:
+    """Check whether jax backend init works in this env; return platform."""
+    import subprocess
+    import sys
+
+    code = "import jax; print('PLATFORM=' + jax.devices()[0].platform)"
+    try:
+        proc = subprocess.run([sys.executable, "-c", code],
+                              env=dict(os.environ), capture_output=True,
+                              text=True, timeout=timeout)
+    except subprocess.TimeoutExpired:
+        print(f"bench: backend probe hung >{timeout}s", file=sys.stderr)
+        return None
+    for ln in proc.stdout.splitlines():
+        if ln.startswith("PLATFORM="):
+            return ln.split("=", 1)[1]
+    print(f"bench: backend probe failed rc={proc.returncode}: "
+          f"{proc.stderr[-300:]}", file=sys.stderr)
+    return None
+
+
+def main() -> None:
+    """Watchdog wrapper: ALWAYS emits exactly one JSON result line.
+
+    1. Probe native backend init in a subprocess (bounded — init can hang).
+    2. If healthy, run the bench ladder natively (bounded).
+    3. On any failure, re-run on the scrubbed CPU platform and mark the
+       result loudly as a fallback so a dead TPU never goes unnoticed.
+    """
+    import sys
+
+    if os.environ.get("RT_BENCH_INNER"):
+        _inner_main()
+        return
+
+    result, fallback_reason = None, None
+    platform = _probe_backend(timeout=300)
+    if platform is None:
+        fallback_reason = "native jax backend init failed or hung"
+    else:
+        result = _run_inner(dict(os.environ), timeout=1200)
+        if result is None:
+            fallback_reason = f"bench on platform={platform} failed/timed out"
+
+    if result is None:
+        print(f"bench: falling back to CPU — {fallback_reason}",
+              file=sys.stderr)
+        result = _run_inner(_cpu_env(), timeout=600)
+        if result is not None:
+            result.setdefault("details", {})["platform_fallback"] = (
+                fallback_reason)
+
+    if result is None:
+        result = {"metric": "llama_train_tokens_per_sec_per_chip",
+                  "value": 0.0, "unit": "tokens/s/chip", "vs_baseline": 0.0,
+                  "details": {"error": f"all bench paths failed; "
+                                       f"{fallback_reason}"}}
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

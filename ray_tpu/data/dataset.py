@@ -51,9 +51,18 @@ class Dataset:
         self._read_tasks = read_tasks or []
         self._ops = ops or []
         self._materialized = materialized_refs
+        # (n, equal) -> shared StreamSplitIterators of ONE execution
+        self._stream_splits: Dict = {}
 
     def _with_op(self, op: L.LogicalOp) -> "Dataset":
         return Dataset(self._read_tasks, self._ops + [op], self._materialized)
+
+    def __getstate__(self):
+        # the split cache holds actor handles + a cycle back to this dataset;
+        # never ship it with the plan
+        state = dict(self.__dict__)
+        state["_stream_splits"] = {}
+        return state
 
     # ---- execution ----
 
@@ -229,9 +238,29 @@ class Dataset:
                 for i in builtins.range(n)]
 
     def streaming_split(self, n: int, equal: bool = False) -> List[DataIterator]:
-        coord = _SplitCoordinator.options(num_cpus=0).remote(n, equal)
-        return [StreamSplitIterator(coord, i, self)
-                for i in builtins.range(n)]
+        """N iterators over ONE shared execution of this dataset.
+
+        Repeated calls with the same (n, equal) return the *same* iterator
+        objects backed by one coordinator actor — so per-rank callers (e.g.
+        one call per train worker) still split a single execution instead of
+        each privately re-executing the pipeline (which would duplicate and
+        drop rows under unseeded shuffles)."""
+        key = (n, equal)
+        cached = self._stream_splits.get(key)
+        if cached is None:
+            coord = _SplitCoordinator.options(num_cpus=0).remote(n, equal)
+            cached = [StreamSplitIterator(coord, i, self)
+                      for i in builtins.range(n)]
+            self._stream_splits[key] = cached
+        return cached
+
+    def reset_streaming_split(self) -> None:
+        """Drop cached streaming_split coordinators so the next call starts
+        a fresh execution. Callers that restart consumption from scratch
+        (e.g. JaxTrainer's failure-recovery retry) must reset — a drained
+        coordinator would otherwise hand the restarted consumers an
+        immediately-empty stream."""
+        self._stream_splits = {}
 
     def train_test_split(self, test_size: float,
                          shuffle: bool = False,

@@ -162,60 +162,107 @@ class _SplitCoordinator:
     (each consumed exactly once); ``equal=True`` balances by row count.
     Only refs flow through this actor — the payloads resolve directly from
     the object plane at each consumer (no coordinator copy bottleneck).
+
+    There is exactly ONE coordinator per ``streaming_split`` call, shared by
+    all N iterators, so every consumer sees a split of the *same* dataset
+    execution (a private per-rank execution would silently duplicate/drop
+    rows under unseeded shuffles). Multi-epoch: when every split has drained
+    its queue and requests the next epoch, the dataset is re-executed —
+    a barrier across splits, matching the reference's per-epoch re-execution.
     """
 
     def __init__(self, n: int, equal: bool):
         self._n = n
         self._equal = equal
         self._lock = threading.Lock()
-        self._started = False
+        self._payload = None
+        self._filled_epoch = -1
+        self._requested = [0] * n
         self._queues: List[collections.deque] = [collections.deque()
                                                  for _ in range(n)]
 
     def start(self, dataset_payload) -> None:
-        """Executes the dataset once (first caller wins)."""
+        """Registers the dataset to execute (first caller wins)."""
         with self._lock:
-            if self._started:
-                return
-            refs = list(dataset_payload._execute_refs())
-            if self._equal:
-                from ray_tpu.data.dataset import _num_rows_task
+            if self._payload is None:
+                self._payload = dataset_payload
 
-                rows = ray_tpu.get(
-                    [_num_rows_task.remote(r) for r in refs])
-                order = np.argsort(rows)[::-1]
-                loads = [0] * self._n
-                for i in order:
-                    j = int(np.argmin(loads))
-                    self._queues[j].append(refs[i])
-                    loads[j] += rows[i]
-            else:
-                for i, r in enumerate(refs):
-                    self._queues[i % self._n].append(r)
-            self._started = True
+    def _fill(self) -> None:
+        # caller holds self._lock
+        refs = list(self._payload._execute_refs())
+        if self._equal:
+            from ray_tpu.data.dataset import _num_rows_task
 
-    def next_block_ref(self, split_idx: int):
-        q = self._queues[split_idx]
-        if not q:
-            return None
-        return q.popleft()
+            rows = ray_tpu.get(
+                [_num_rows_task.remote(r) for r in refs])
+            order = np.argsort(rows)[::-1]
+            loads = [0] * self._n
+            for i in order:
+                j = int(np.argmin(loads))
+                self._queues[j].append(refs[i])
+                loads[j] += rows[i]
+        else:
+            for i, r in enumerate(refs):
+                self._queues[i % self._n].append(r)
+
+    def next_block_ref(self, split_idx: int, epoch: int):
+        """Returns ("block", ref) | ("end", None) | ("wait", None)."""
+        with self._lock:
+            if epoch > self._requested[split_idx]:
+                # requesting epoch e declares all earlier epochs finished for
+                # this split — drop any abandoned remainder (consumer broke
+                # out of the iterator mid-epoch) so the barrier can't
+                # deadlock on undrained refs
+                self._requested[split_idx] = epoch
+                self._queues[split_idx].clear()
+            if epoch > self._filled_epoch:
+                # Next epoch starts only once EVERY split asked for it
+                # (each having thereby abandoned/finished the previous one).
+                if min(self._requested) >= epoch:
+                    for q in self._queues:
+                        q.clear()
+                    self._fill()
+                    self._filled_epoch = epoch
+                else:
+                    return ("wait", None)
+            q = self._queues[split_idx]
+            if q:
+                return ("block", q.popleft())
+            return ("end", None)
 
 
 class StreamSplitIterator(DataIterator):
+    """One consumer's split. Re-iterating starts the next epoch (the dataset
+    re-executes once all sibling splits also finish the current epoch)."""
+
     def __init__(self, coordinator, split_idx: int, dataset):
         self._coord = coordinator
         self._idx = split_idx
         self._ds = dataset
         self._started = False
+        self._epoch = 0
         super().__init__(self._pull_blocks)
 
     def _pull_blocks(self):
+        import time
+
         if not self._started:
             # ship the dataset (plan closures) once, not per block
             ray_tpu.get(self._coord.start.remote(self._ds))
             self._started = True
+        epoch = self._epoch
+        self._epoch += 1
+        delay = 0.02
         while True:
-            ref = ray_tpu.get(self._coord.next_block_ref.remote(self._idx))
-            if ref is None:
+            status, ref = ray_tpu.get(
+                self._coord.next_block_ref.remote(self._idx, epoch))
+            if status == "wait":
+                # barrier wait with backoff: a straggler sibling can lag a
+                # whole epoch — don't hammer the coordinator at 20Hz
+                time.sleep(delay)
+                delay = min(delay * 1.6, 1.0)
+                continue
+            delay = 0.02
+            if status == "end":
                 return
             yield ray_tpu.get(ref)

@@ -63,7 +63,8 @@ class Learner:
     """
 
     def __init__(self, init_params, loss_fn: Callable, lr: float,
-                 grad_clip: float = 0.0, mesh=None, seed: int = 0):
+                 grad_clip: float = 0.0, mesh=None, seed: int = 0,
+                 grad_sync: Optional[Callable] = None):
         import jax
 
         self.params = init_params
@@ -72,16 +73,24 @@ class Learner:
         self._lr = lr
         self._key = jax.random.key(seed)
         self._mesh = mesh
+        self._grad_sync = grad_sync
 
-        def step(params, opt_state, batch, key):
+        def compute_grads(params, batch, key):
             (loss, metrics), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(params, batch, key)
+            return grads, loss, metrics
+
+        def apply_grads(params, opt_state, grads, loss, metrics):
             if grad_clip:
                 grads, gnorm = clip_global_norm(grads, grad_clip)
                 metrics = dict(metrics, grad_norm=gnorm)
             new_params, new_opt = adam_update(params, grads, opt_state, lr)
             metrics = dict(metrics, loss=loss)
             return new_params, new_opt, metrics
+
+        def step(params, opt_state, batch, key):
+            grads, loss, metrics = compute_grads(params, batch, key)
+            return apply_grads(params, opt_state, grads, loss, metrics)
 
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
@@ -97,6 +106,16 @@ class Learner:
             self.opt_state = jax.device_put(self.opt_state, replicated)
         else:
             self._step = jax.jit(step)
+        # split path for cross-actor DDP: grads leave the device, get
+        # allreduced host-side, and re-enter the jitted optimizer step —
+        # this keeps params AND adam moments bit-identical across learners
+        self._compute_grads = jax.jit(compute_grads)
+        self._apply_grads = jax.jit(apply_grads)
+
+    def set_grad_sync(self, grad_sync: Optional[Callable]) -> None:
+        """Install a cross-learner gradient allreduce (grads -> grads),
+        applied per minibatch BEFORE the optimizer update (DDP semantics)."""
+        self._grad_sync = grad_sync
 
     def update_minibatch(self, batch: Dict[str, np.ndarray]) -> Dict:
         import jax
@@ -105,8 +124,15 @@ class Learner:
         if self._mesh is not None:
             batch = {k: jax.device_put(v, self._batch_sharding)
                      for k, v in batch.items()}
-        self.params, self.opt_state, metrics = self._step(
-            self.params, self.opt_state, batch, sub)
+        if self._grad_sync is not None:
+            grads, loss, metrics = self._compute_grads(
+                self.params, batch, sub)
+            grads = self._grad_sync(grads)
+            self.params, self.opt_state, metrics = self._apply_grads(
+                self.params, self.opt_state, grads, loss, metrics)
+        else:
+            self.params, self.opt_state, metrics = self._step(
+                self.params, self.opt_state, batch, sub)
         return metrics
 
     def update(self, batch: Dict[str, np.ndarray], *, num_epochs: int = 1,
@@ -148,6 +174,16 @@ class _LearnerActor:
         col.init_collective_group(world, rank, group)
         self._learner: Learner = learner_ctor()
         self._sync_params()
+        self._learner.set_grad_sync(self._allreduce_grads)
+
+    def _allreduce_grads(self, grads):
+        from ray_tpu import collective as col
+        import jax
+
+        leaves, treedef = jax.tree_util.tree_flatten(grads)
+        leaves = [np.asarray(col.allreduce(np.asarray(x), self._group))
+                  / self._world for x in leaves]
+        return jax.tree_util.tree_unflatten(treedef, leaves)
 
     def _sync_params(self) -> None:
         from ray_tpu import collective as col
@@ -161,19 +197,12 @@ class _LearnerActor:
 
     def update(self, shard, num_epochs: int, minibatch_size: int,
                seed: int) -> Dict[str, float]:
-        from ray_tpu import collective as col
-        import jax
-
-        metrics = self._learner.update(
+        # grads are allreduced per minibatch via set_grad_sync (DDP
+        # semantics: params and optimizer moments stay identical across
+        # learners), so no post-hoc param averaging is needed
+        return self._learner.update(
             shard, num_epochs=num_epochs, minibatch_size=minibatch_size,
             seed=seed)
-        # average params across learners (equivalent to synced grads for
-        # equal-sized shards and identical starts)
-        leaves, treedef = jax.tree_util.tree_flatten(self._learner.params)
-        leaves = [np.asarray(col.allreduce(np.asarray(x), self._group))
-                  / self._world for x in leaves]
-        self._learner.params = jax.tree_util.tree_unflatten(treedef, leaves)
-        return metrics
 
     def get_params(self):
         return self._learner.params
@@ -205,13 +234,16 @@ class LearnerGroup:
                minibatch_size: Optional[int] = None,
                seed: int = 0) -> Dict[str, float]:
         n = len(next(iter(batch.values())))
-        mb = minibatch_size or n
         world = len(self._actors)
-        # slice per-rank shards driver-side: each actor receives only its
-        # 1/world of the batch instead of the whole thing
+        # equal-size shards (truncate the remainder): per-minibatch grad
+        # allreduce is a rank-synchronous collective, so every learner must
+        # run the exact same number of minibatches or the group deadlocks
+        n_even = n - (n % world)
+        mb = minibatch_size or n_even // world
         results = ray_tpu.get([
-            a.update.remote({k: v[i::world] for k, v in batch.items()},
-                            num_epochs, mb, seed)
+            a.update.remote(
+                {k: v[i:n_even:world] for k, v in batch.items()},
+                num_epochs, mb, seed)
             for i, a in enumerate(self._actors)])
         return {k: float(np.mean([r[k] for r in results]))
                 for k in results[0]}

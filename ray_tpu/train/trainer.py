@@ -91,6 +91,12 @@ class JaxTrainer:
         last_error: Optional[str] = None
 
         while True:
+            # fresh streaming splits per attempt: a retry after worker death
+            # must re-execute the dataset, not resume a drained coordinator
+            for ds in self.datasets.values():
+                reset = getattr(ds, "reset_streaming_split", None)
+                if reset is not None:
+                    reset()
             group = WorkerGroup(self.scaling, name)
             group.start()
             try:

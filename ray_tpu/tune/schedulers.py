@@ -152,6 +152,7 @@ class MedianStoppingRule(TrialScheduler):
         self._min_samples = min_samples_required
         self._means: Dict[str, float] = {}
         self._counts: Dict[str, int] = {}
+        self._best: Dict[str, float] = {}
 
     def on_trial_result(self, trial: Trial, result: Dict[str, Any]) -> str:
         t = result.get(self._time_attr, 0)
@@ -163,6 +164,7 @@ class MedianStoppingRule(TrialScheduler):
         n = self._counts.get(tid, 0) + 1
         self._counts[tid] = n
         self._means[tid] = self._means.get(tid, 0.0) + (score - self._means.get(tid, 0.0)) / n
+        self._best[tid] = max(self._best.get(tid, score), score)
         if t < self._grace:
             return CONTINUE
         others = [m for k, m in self._means.items() if k != tid]
@@ -170,7 +172,10 @@ class MedianStoppingRule(TrialScheduler):
             return CONTINUE
         others.sort()
         median = others[len(others) // 2]
-        if self._means[tid] < median:
+        # reference semantics: the trial's BEST result so far vs the median
+        # of other trials' running means — an improving trial isn't punished
+        # for a poor start
+        if self._best[tid] < median:
             return STOP
         return CONTINUE
 

@@ -20,8 +20,17 @@ from typing import Any, Callable, Dict, Optional
 
 from ray_tpu.train.checkpoint import Checkpoint
 
+# Sessions are keyed by the fn-runner thread id, NOT a single global: the
+# local (threaded) backend hosts every trial actor in one process, and a
+# process-wide session would let a newly started trial clobber earlier ones
+# (tune.report() silently crediting metrics to the wrong trial).
 _session_lock = threading.Lock()
-_session: Optional["_FunctionSession"] = None
+_sessions: Dict[int, "_FunctionSession"] = {}
+
+
+def _current_session() -> Optional["_FunctionSession"]:
+    with _session_lock:
+        return _sessions.get(threading.get_ident())
 
 DONE = "done"
 TRAINING_ITERATION = "training_iteration"
@@ -46,16 +55,14 @@ def report(metrics: Dict[str, Any],
     Inside a ``JaxTrainer`` train loop use ``ray_tpu.train.report``; this is
     the Tune-level equivalent for plain tune functions.
     """
-    with _session_lock:
-        s = _session
+    s = _current_session()
     if s is None:
         raise RuntimeError("tune.report() called outside a Tune trial")
     s.report(metrics, checkpoint)
 
 
 def get_checkpoint() -> Optional[Checkpoint]:
-    with _session_lock:
-        s = _session
+    s = _current_session()
     if s is None:
         raise RuntimeError("tune.get_checkpoint() called outside a Tune trial")
     return s.loaded_checkpoint
@@ -128,20 +135,24 @@ class FunctionTrainable(Trainable):
         self._last_checkpoint: Optional[Checkpoint] = None
 
     def _start(self) -> None:
-        global _session
         fsession = _FunctionSession(self._restored_checkpoint)
 
         def runner():
+            # register under the runner thread's own id so report() from
+            # within the fn resolves to *this* trial's session even with
+            # many concurrent trials in one process (local backend)
+            with _session_lock:
+                _sessions[threading.get_ident()] = fsession
             try:
                 self._fn(self.config)
             except BaseException as e:  # surfaced via train()
                 fsession.error = e
             finally:
+                with _session_lock:
+                    _sessions.pop(threading.get_ident(), None)
                 fsession.finished.set()
                 fsession.queue.put(("end", None, None))
 
-        with _session_lock:
-            _session = fsession
         self._fsession = fsession
         self._thread = threading.Thread(target=runner, daemon=True)
         self._thread.start()

@@ -170,6 +170,68 @@ def test_streaming_split(rt_cluster):
     assert rows_a and rows_b
 
 
+def test_streaming_split_shared_execution(rt_cluster):
+    """Per-rank streaming_split calls (the JaxTrainer pattern) must split ONE
+    execution: under an unseeded shuffle, private per-rank executions would
+    silently duplicate and drop rows."""
+    ds = data.range(60, parallelism=6).random_shuffle()  # seed=None
+    world = 2
+    rows = []
+    for rank in range(world):
+        it = ds.streaming_split(world)[rank]  # separate calls, shared coord
+        rows.append(it)
+    import threading
+
+    out = [None, None]
+
+    def consume(rank):
+        out[rank] = [r["id"] for r in rows[rank].iter_rows()]
+
+    ts = [threading.Thread(target=consume, args=(i,)) for i in range(world)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=60)
+    assert sorted(out[0] + out[1]) == list(range(60))
+
+
+def test_streaming_split_multi_epoch(rt_cluster):
+    """Re-iterating a split yields the next epoch (dataset re-executes),
+    not a silent empty stream."""
+    ds = data.range(24, parallelism=4)
+    its = ds.streaming_split(2)
+    import threading
+
+    epochs = {(r, e): None for r in range(2) for e in range(3)}
+
+    def consume(rank, epoch):
+        epochs[(rank, epoch)] = [r["id"] for r in its[rank].iter_rows()]
+
+    for e in range(3):
+        ts = [threading.Thread(target=consume, args=(r, e)) for r in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=60)
+        got = sorted(epochs[(0, e)] + epochs[(1, e)])
+        assert got == list(range(24)), f"epoch {e}: {got}"
+
+
+def test_streaming_split_abandoned_epoch_no_deadlock(rt_cluster):
+    """A consumer that breaks out mid-epoch must not wedge the barrier for
+    the next epoch (single split: the common fixed-steps-per-epoch loop)."""
+    ds = data.range(40, parallelism=8)
+    (it,) = ds.streaming_split(1)
+    rows = []
+    for r in it.iter_rows():
+        rows.append(r["id"])
+        if len(rows) >= 3:
+            break  # abandon epoch 0 early
+    # epoch 1 must still produce the full dataset
+    full = [r["id"] for r in it.iter_rows()]
+    assert sorted(full) == list(range(40))
+
+
 def test_split_materialized(rt_cluster):
     parts = data.range(40, parallelism=4).split(2)
     total = sum(p.count() for p in parts)
