@@ -1,0 +1,125 @@
+"""Dynamic request batching — THE TPU utilization lever for inference.
+
+Reference analog: ``serve/batching.py`` (``@serve.batch``). Single requests
+arriving within ``batch_wait_timeout_s`` of each other are fused into one
+list-call of the wrapped method, so the replica's jitted forward pass runs
+one large batch on the MXU instead of many tiny ones. The wrapped function
+takes a list and must return a list of equal length; each caller awaits its
+own element.
+
+TPU note: pair with bucketed padding inside the model call so batched shapes
+stay static for XLA (see ``ray_tpu.serve`` docs) — the batcher itself is
+shape-agnostic.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import functools
+from typing import Any, Callable, List, Optional
+
+
+class _Batcher:
+    """Queue of (item, future) pairs flushed by size or deadline."""
+
+    def __init__(self, fn: Callable, max_batch_size: int,
+                 batch_wait_timeout_s: float):
+        self._fn = fn
+        self._max = max_batch_size
+        self._timeout = batch_wait_timeout_s
+        self._queue: "asyncio.Queue" = asyncio.Queue()
+        self._loop_task: Optional[asyncio.Task] = None
+
+    async def submit(self, item: Any) -> Any:
+        if self._loop_task is None or self._loop_task.done():
+            self._loop_task = asyncio.ensure_future(self._flush_loop())
+        fut = asyncio.get_running_loop().create_future()
+        await self._queue.put((item, fut))
+        return await fut
+
+    async def _flush_loop(self) -> None:
+        while True:
+            item, fut = await self._queue.get()
+            batch = [(item, fut)]
+            deadline = asyncio.get_running_loop().time() + self._timeout
+            while len(batch) < self._max:
+                remaining = deadline - asyncio.get_running_loop().time()
+                if remaining <= 0:
+                    break
+                try:
+                    batch.append(await asyncio.wait_for(
+                        self._queue.get(), timeout=remaining))
+                except asyncio.TimeoutError:
+                    break
+            items = [b[0] for b in batch]
+            futs = [b[1] for b in batch]
+            try:
+                results = await self._fn(items)
+                if results is None or len(results) != len(items):
+                    raise ValueError(
+                        f"@serve.batch function must return a list of "
+                        f"length {len(items)}, got "
+                        f"{type(results).__name__}")
+            except BaseException as e:  # noqa: BLE001 — fan the error out
+                for f in futs:
+                    if not f.done():
+                        f.set_exception(e)
+                continue
+            for f, r in zip(futs, results):
+                if not f.done():
+                    f.set_result(r)
+
+
+def batch(_fn: Optional[Callable] = None, *, max_batch_size: int = 8,
+          batch_wait_timeout_s: float = 0.01):
+    """``@serve.batch`` — decorate an async method taking a list of items.
+
+    Call sites pass ONE item and receive its single result::
+
+        @serve.batch(max_batch_size=16, batch_wait_timeout_s=0.005)
+        async def predict(self, inputs: List[np.ndarray]) -> List[Any]:
+            return self.model(np.stack(inputs))   # one MXU-sized call
+
+        async def __call__(self, request):
+            return await self.predict(request.array)
+    """
+
+    def deco(fn):
+        if not asyncio.iscoroutinefunction(fn):
+            raise TypeError("@serve.batch requires an async def function")
+        attr = f"__rt_batcher_{fn.__name__}"
+
+        @functools.wraps(fn)
+        async def wrapper(*args):
+            if len(args) == 2:  # bound method: (self, item)
+                owner, item = args
+                batcher = getattr(owner, attr, None)
+                if batcher is None:
+                    async def call(items: List[Any]):
+                        return await fn(owner, items)
+
+                    batcher = _Batcher(call, wrapper._rt_max_batch_size,
+                                       wrapper._rt_batch_wait_timeout_s)
+                    setattr(owner, attr, batcher)
+            elif len(args) == 1:  # free function: (item,)
+                item = args[0]
+                batcher = getattr(wrapper, "_rt_free_batcher", None)
+                if batcher is None:
+                    batcher = _Batcher(fn, wrapper._rt_max_batch_size,
+                                       wrapper._rt_batch_wait_timeout_s)
+                    wrapper._rt_free_batcher = batcher
+            else:
+                raise TypeError("@serve.batch methods take exactly one item")
+            return await batcher.submit(item)
+
+        wrapper._rt_max_batch_size = max_batch_size
+        wrapper._rt_batch_wait_timeout_s = batch_wait_timeout_s
+        wrapper.set_max_batch_size = (
+            lambda v: setattr(wrapper, "_rt_max_batch_size", v))
+        wrapper.set_batch_wait_timeout_s = (
+            lambda v: setattr(wrapper, "_rt_batch_wait_timeout_s", v))
+        return wrapper
+
+    if _fn is not None:
+        return deco(_fn)
+    return deco

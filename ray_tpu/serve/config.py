@@ -1,0 +1,75 @@
+"""Serve configuration objects.
+
+Reference analogs: ``serve/config.py`` (``AutoscalingConfig``,
+``DeploymentConfig``) and ``serve/schema.py``. TPU-first notes: replicas
+carry ``num_tpus`` through ``ray_actor_options`` so a deployment pins whole
+chips (``TPU_VISIBLE_CHIPS`` isolation happens in the raylet), and
+``max_ongoing_requests`` defaults low because a TPU replica saturates with a
+few concurrent batched calls, not hundreds of tiny ones.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+DEFAULT_MAX_ONGOING_REQUESTS = 8
+DEFAULT_HTTP_PORT = 8123
+
+
+@dataclasses.dataclass
+class AutoscalingConfig:
+    """Ongoing-requests-driven autoscaling
+    (``serve/_private/autoscaling_policy.py:12``):
+    desired = ceil(total_ongoing_requests / target_ongoing_requests),
+    clamped to [min_replicas, max_replicas], with hysteresis delays.
+    ``min_replicas=0`` enables scale-to-zero (a cold request wakes the
+    deployment through the router's wake RPC)."""
+
+    min_replicas: int = 1
+    max_replicas: int = 4
+    target_ongoing_requests: float = 2.0
+    upscale_delay_s: float = 2.0
+    downscale_delay_s: float = 10.0
+    metrics_interval_s: float = 0.5
+    look_back_period_s: float = 5.0
+
+    def validate(self) -> None:
+        if self.min_replicas < 0 or self.max_replicas < 1:
+            raise ValueError("min_replicas >= 0 and max_replicas >= 1 required")
+        if self.min_replicas > self.max_replicas:
+            raise ValueError("min_replicas must be <= max_replicas")
+        if self.target_ongoing_requests <= 0:
+            raise ValueError("target_ongoing_requests must be positive")
+
+
+@dataclasses.dataclass
+class DeploymentConfig:
+    """Per-deployment settings (reference ``DeploymentConfig``)."""
+
+    num_replicas: int = 1
+    max_ongoing_requests: int = DEFAULT_MAX_ONGOING_REQUESTS
+    autoscaling_config: Optional[AutoscalingConfig] = None
+    user_config: Optional[Dict[str, Any]] = None
+    health_check_period_s: float = 2.0
+    health_check_timeout_s: float = 10.0
+    graceful_shutdown_timeout_s: float = 5.0
+    ray_actor_options: Optional[Dict[str, Any]] = None
+
+    def validate(self) -> None:
+        if self.num_replicas < 0:
+            raise ValueError("num_replicas must be >= 0")
+        if self.max_ongoing_requests < 1:
+            raise ValueError("max_ongoing_requests must be >= 1")
+        if self.autoscaling_config is not None:
+            if isinstance(self.autoscaling_config, dict):
+                self.autoscaling_config = AutoscalingConfig(
+                    **self.autoscaling_config)
+            self.autoscaling_config.validate()
+
+
+@dataclasses.dataclass
+class HTTPOptions:
+    host: str = "127.0.0.1"
+    port: int = DEFAULT_HTTP_PORT
+    request_timeout_s: float = 60.0

@@ -1,0 +1,396 @@
+"""ServeController: the control plane actor for the serve layer.
+
+Reference analogs: ``serve/controller.py:82`` (``ServeController``),
+``_private/application_state.py:669`` (``ApplicationStateManager``),
+``_private/deployment_state.py:1156`` (``DeploymentState`` reconciler) and
+``_private/autoscaling_policy.py:12`` (``calculate_desired_num_replicas``).
+
+One actor owns all desired/actual state:
+  - ``deploy_application`` records the desired app graph;
+  - a reconcile thread starts missing replicas, removes dead ones, and
+    applies autoscaling decisions computed from polled per-replica
+    ongoing-request counts with upscale/downscale hysteresis;
+  - routers/proxies read versioned replica sets from ``get_replicas`` /
+    ``get_routing_table``.
+
+Methods are sync on purpose: they run on the actor's thread pool where
+blocking ``ray_tpu.get`` is legal (async actor methods run on the worker's
+io loop, which blocking calls would deadlock).
+
+Scale-to-zero: a deployment with ``min_replicas=0`` drops to zero when idle;
+a handle's ``wake`` RPC records demand, which the next reconcile tick serves
+by starting a replica.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import traceback
+from typing import Any, Dict, List, Optional, Tuple
+
+import ray_tpu
+from ray_tpu.serve.config import AutoscalingConfig, DeploymentConfig
+from ray_tpu.serve.replica import ReplicaActor
+
+CONTROLLER_NAME = "RT_SERVE_CONTROLLER"
+RECONCILE_PERIOD_S = 0.25
+
+
+class _ReplicaInfo:
+    def __init__(self, replica_id: str, handle):
+        self.replica_id = replica_id
+        self.handle = handle
+        self.last_health_check = time.time()
+        self.last_ongoing = 0
+
+
+class _DeploymentState:
+    def __init__(self, app_name: str, name: str, config: DeploymentConfig,
+                 body, init_args, init_kwargs):
+        self.app_name = app_name
+        self.name = name
+        self.config = config
+        self.body = body
+        self.init_args = init_args
+        self.init_kwargs = init_kwargs
+        self.replicas: Dict[str, _ReplicaInfo] = {}
+        self.version = 0
+        self.next_replica_idx = 0
+        # autoscaling bookkeeping
+        self.metrics: List[Tuple[float, int]] = []  # (t, total_ongoing)
+        self.wake_requested_at: Optional[float] = None
+        self.scale_candidate: Optional[int] = None
+        self.scale_candidate_since: float = 0.0
+        self.last_target: int = 0
+        self.starting: Dict[str, Any] = {}  # replica_id -> (handle, ready ref)
+
+    @property
+    def autoscaling(self) -> Optional[AutoscalingConfig]:
+        return self.config.autoscaling_config
+
+    def target_replicas(self, now: float) -> int:
+        """Fixed num_replicas, or the autoscaler's desired count
+        (reference ``calculate_desired_num_replicas``)."""
+        ac = self.autoscaling
+        if ac is None:
+            return self.config.num_replicas
+        current = len(self.replicas) + len(self.starting)
+        window = [m for m in self.metrics
+                  if now - m[0] <= ac.look_back_period_s]
+        total_ongoing = (sum(m[1] for m in window) / len(window)
+                         if window else 0.0)
+        desired = int(-(-total_ongoing // ac.target_ongoing_requests))  # ceil
+        if (self.wake_requested_at is not None
+                and now - self.wake_requested_at < 30.0):
+            # cold-start demand: guarantee capacity even before metrics move
+            desired = max(desired, 1)
+        desired = max(ac.min_replicas, min(ac.max_replicas, desired))
+        if desired == current:
+            self.scale_candidate = None
+            return current
+        # hysteresis: hold the new value for the delay before acting
+        if self.scale_candidate != desired:
+            self.scale_candidate = desired
+            self.scale_candidate_since = now
+        delay = (ac.upscale_delay_s if desired > current
+                 else ac.downscale_delay_s)
+        if now - self.scale_candidate_since >= delay:
+            return desired
+        return current
+
+
+@ray_tpu.remote
+class ServeController:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._apps: Dict[str, Dict[str, Any]] = {}
+        self._deployments: Dict[Tuple[str, str], _DeploymentState] = {}
+        self._routing_version = 0
+        self._proxy = None
+        self._proxy_port: Optional[int] = None
+        self._shutdown = False
+        self._reconciler = threading.Thread(
+            target=self._reconcile_loop, daemon=True, name="rt-serve-rec")
+        self._reconciler.start()
+
+    # -- deploy ---------------------------------------------------------------
+    def deploy_application(self, app_name: str, route_prefix: str,
+                           ingress: str, deployments: List[Dict]) -> None:
+        """deployments: [{name, body, init_args, init_kwargs, config}]"""
+        with self._lock:
+            new_names = {d["name"] for d in deployments}
+            for key in [k for k in self._deployments
+                        if k[0] == app_name and k[1] not in new_names]:
+                self._stop_deployment(self._deployments.pop(key))
+            self._apps[app_name] = {"route_prefix": route_prefix,
+                                    "ingress": ingress}
+            for d in deployments:
+                cfg: DeploymentConfig = d["config"]
+                cfg.validate()
+                key = (app_name, d["name"])
+                existing = self._deployments.get(key)
+                if existing is not None:
+                    # redeploy: new code/config — restart replicas
+                    self._stop_deployment(existing)
+                self._deployments[key] = _DeploymentState(
+                    app_name, d["name"], cfg, d["body"], d["init_args"],
+                    d["init_kwargs"])
+            self._bump_routing()
+
+    def delete_application(self, app_name: str) -> None:
+        with self._lock:
+            for key in [k for k in self._deployments if k[0] == app_name]:
+                self._stop_deployment(self._deployments.pop(key))
+            self._apps.pop(app_name, None)
+            self._bump_routing()
+
+    def wait_healthy(self, app_name: str, timeout_s: float = 60.0) -> bool:
+        """Block until every deployment of the app has its minimum replica
+        count running (autoscaling min may be 0 — then 'healthy' is free)."""
+        deadline = time.time() + timeout_s
+        while time.time() < deadline:
+            with self._lock:
+                states = [s for (a, _), s in self._deployments.items()
+                          if a == app_name]
+                ok = states and all(
+                    len(s.replicas) >= self._min_required(s) for s in states)
+            if ok:
+                return True
+            time.sleep(0.05)
+        raise TimeoutError(f"app {app_name!r} not healthy in {timeout_s}s")
+
+    def _min_required(self, s: _DeploymentState) -> int:
+        if s.autoscaling is not None:
+            return s.autoscaling.min_replicas
+        return s.config.num_replicas
+
+    # -- routing --------------------------------------------------------------
+    def _bump_routing(self) -> None:
+        self._routing_version += 1
+
+    def get_replicas(self, app_name: str, deployment: str,
+                     known_version: int) -> Dict[str, Any]:
+        with self._lock:
+            s = self._deployments.get((app_name, deployment))
+            if s is None:
+                return {"version": known_version, "replicas": []}
+            return {"version": s.version,
+                    "replicas": [(r.replica_id, r.handle)
+                                 for r in s.replicas.values()]}
+
+    def get_routing_table(self) -> Dict[str, Any]:
+        """For proxies: route_prefix -> (app, ingress deployment)."""
+        with self._lock:
+            return {"version": self._routing_version,
+                    "routes": {meta["route_prefix"]: (app, meta["ingress"])
+                               for app, meta in self._apps.items()}}
+
+    def wake(self, app_name: str, deployment: str) -> None:
+        with self._lock:
+            s = self._deployments.get((app_name, deployment))
+            if s is not None:
+                s.wake_requested_at = time.time()
+
+    def list_applications(self) -> Dict[str, Any]:
+        with self._lock:
+            out = {}
+            now = time.time()
+            for app, meta in self._apps.items():
+                deps = {}
+                for (a, name), s in self._deployments.items():
+                    if a != app:
+                        continue
+                    deps[name] = {
+                        "replicas": len(s.replicas),
+                        "starting": len(s.starting),
+                        "target": s.last_target,
+                        "autoscaling": s.autoscaling is not None,
+                    }
+                out[app] = {"route_prefix": meta["route_prefix"],
+                            "ingress": meta["ingress"], "deployments": deps}
+            return out
+
+    # -- http proxy -----------------------------------------------------------
+    def ensure_proxy(self, host: str, port: int) -> int:
+        from ray_tpu.serve.proxy import ProxyActor
+
+        with self._lock:
+            if self._proxy is None:
+                self._proxy = ProxyActor.options(
+                    name="RT_SERVE_PROXY", max_concurrency=256,
+                    num_cpus=0).remote()
+                self._proxy_port = ray_tpu.get(
+                    self._proxy.start.remote(host, port))
+            return self._proxy_port
+
+    # -- reconcile ------------------------------------------------------------
+    def _reconcile_loop(self) -> None:
+        while not self._shutdown:
+            try:
+                self._reconcile_once()
+            except Exception:  # noqa: BLE001 — keep the loop alive
+                traceback.print_exc()
+            time.sleep(RECONCILE_PERIOD_S)
+
+    def _reconcile_once(self) -> None:
+        now = time.time()
+        with self._lock:
+            states = list(self._deployments.values())
+        for s in states:
+            self._adopt_started(s)
+            self._poll_metrics(s, now)
+            with self._lock:
+                target = s.target_replicas(now)
+                s.last_target = target
+                current = len(s.replicas) + len(s.starting)
+                if current < target:
+                    for _ in range(target - current):
+                        self._start_replica(s)
+                elif current > target:
+                    self._remove_replicas(s, current - target)
+            self._health_check(s, now)
+
+    def _start_replica(self, s: _DeploymentState) -> None:
+        rid = f"{s.app_name}#{s.name}#{s.next_replica_idx}"
+        s.next_replica_idx += 1
+        opts = dict(s.config.ray_actor_options or {})
+        opts.setdefault("num_cpus", 0.1)
+        opts["max_concurrency"] = max(16, s.config.max_ongoing_requests + 4)
+        opts["name"] = f"RT_SERVE:{rid}"
+        handle = ReplicaActor.options(**opts).remote(
+            s.name, s.app_name, rid, s.body, s.init_args, s.init_kwargs,
+            s.config.max_ongoing_requests, s.config.user_config)
+        # readiness probe: the first health check resolving means __init__ ran
+        s.starting[rid] = (handle, handle.check_health.remote())
+
+    def _adopt_started(self, s: _DeploymentState) -> None:
+        with self._lock:
+            pending = list(s.starting.items())
+        for rid, (handle, ready_ref) in pending:
+            done, _ = ray_tpu.wait([ready_ref], num_returns=1, timeout=0)
+            if not done:
+                continue
+            with self._lock:
+                s.starting.pop(rid, None)
+            try:
+                ray_tpu.get(done[0])
+            except Exception:  # init failed — drop; next tick restarts
+                traceback.print_exc()
+                continue
+            with self._lock:
+                s.replicas[rid] = _ReplicaInfo(rid, handle)
+                s.version += 1
+                self._bump_routing()
+
+    def _poll_metrics(self, s: _DeploymentState, now: float) -> None:
+        if s.autoscaling is None:
+            return
+        with self._lock:
+            reps = list(s.replicas.values())
+        total = 0
+        if reps:
+            refs = [r.handle.ongoing_count.remote() for r in reps]
+            ready, _ = ray_tpu.wait(refs, num_returns=len(refs), timeout=2.0)
+            for r, ref in zip(reps, refs):
+                if ref in ready:
+                    try:
+                        r.last_ongoing = ray_tpu.get(ref)
+                        total += r.last_ongoing
+                    except Exception:  # noqa: BLE001 — health check handles it
+                        pass
+        with self._lock:
+            s.metrics.append((now, total))
+            s.metrics = [m for m in s.metrics
+                         if now - m[0] <= s.autoscaling.look_back_period_s]
+
+    def _health_check(self, s: _DeploymentState, now: float) -> None:
+        with self._lock:
+            due = [r for r in s.replicas.values()
+                   if now - r.last_health_check >= s.config.health_check_period_s]
+            for r in due:
+                r.last_health_check = now
+        for r in due:
+            ref = r.handle.check_health.remote()
+            ready, _ = ray_tpu.wait([ref], num_returns=1,
+                                    timeout=s.config.health_check_timeout_s)
+            ok = False
+            if ready:
+                try:
+                    ray_tpu.get(ready[0])
+                    ok = True
+                except Exception:  # noqa: BLE001
+                    pass
+            if not ok:
+                with self._lock:
+                    s.replicas.pop(r.replica_id, None)
+                    s.version += 1
+                    self._bump_routing()
+                try:
+                    ray_tpu.kill(r.handle)
+                except Exception:  # noqa: BLE001
+                    pass
+
+    def _remove_replicas(self, s: _DeploymentState, n: int) -> None:
+        # caller holds the lock; prefer tearing down still-starting replicas
+        for rid in list(s.starting)[:n]:
+            handle, _ = s.starting.pop(rid)
+            n -= 1
+            try:
+                ray_tpu.kill(handle)
+            except Exception:  # noqa: BLE001
+                pass
+        if n <= 0:
+            return
+        victims = sorted(s.replicas.values(),
+                         key=lambda r: r.last_ongoing)[:n]
+        for r in victims:
+            del s.replicas[r.replica_id]
+            s.version += 1
+            self._bump_routing()
+            threading.Thread(
+                target=self._drain_and_kill,
+                args=(r.handle, s.config.graceful_shutdown_timeout_s),
+                daemon=True).start()
+
+    def _drain_and_kill(self, handle, timeout_s: float) -> None:
+        try:
+            ref = handle.prepare_shutdown.remote(timeout_s)
+            ray_tpu.wait([ref], num_returns=1, timeout=timeout_s + 5.0)
+        except Exception:  # noqa: BLE001
+            pass
+        try:
+            ray_tpu.kill(handle)
+        except Exception:  # noqa: BLE001
+            pass
+
+    def _stop_deployment(self, s: _DeploymentState) -> None:
+        # caller holds the lock
+        for rid in list(s.starting):
+            handle, _ = s.starting.pop(rid)
+            try:
+                ray_tpu.kill(handle)
+            except Exception:  # noqa: BLE001
+                pass
+        for r in list(s.replicas.values()):
+            try:
+                ray_tpu.kill(r.handle)
+            except Exception:  # noqa: BLE001
+                pass
+        s.replicas.clear()
+        s.version += 1
+        self._bump_routing()
+
+    def shutdown(self) -> None:
+        self._shutdown = True
+        with self._lock:
+            for key in list(self._deployments):
+                self._stop_deployment(self._deployments.pop(key))
+            self._apps.clear()
+            proxy, self._proxy = self._proxy, None
+        if proxy is not None:
+            try:
+                ray_tpu.get(proxy.stop.remote())
+                ray_tpu.kill(proxy)
+            except Exception:  # noqa: BLE001
+                pass

@@ -1,0 +1,214 @@
+"""DeploymentHandle: the client-side router for calling a deployment.
+
+Reference analogs: ``serve/handle.py`` (``DeploymentHandle``,
+``DeploymentResponse``) and ``serve/_private/router.py:328``
+(``PowerOfTwoChoicesReplicaScheduler``). Routing is client-side: each handle
+keeps a cached replica set (refreshed from the controller) plus local
+in-flight counts, picks the less-loaded of two random replicas, and treats a
+replica's REJECTED reply (over ``max_ongoing_requests``) as backpressure —
+update the count, try another replica, back off.
+
+Works from sync drivers (`.remote().result()`) and from async contexts —
+proxies and replicas — (`await handle.remote(...)`).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+import threading
+import time
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Any, Dict, List, Optional, Tuple
+
+import ray_tpu
+from ray_tpu.exceptions import ActorError
+from ray_tpu.serve.replica import REJECTED
+
+_REFRESH_TTL_S = 1.0
+_RETRY_BACKOFF_S = 0.02
+_COLD_START_TIMEOUT_S = 60.0
+
+
+class _HandleMarker:
+    """Placeholder for a DeploymentHandle inside pickled init args — the
+    replica substitutes the real handle at construction (composition)."""
+
+    def __init__(self, app_name: str, deployment_name: str):
+        self.app_name = app_name
+        self.deployment_name = deployment_name
+
+
+def _resolve_handle_markers(obj: Any) -> Any:
+    if isinstance(obj, _HandleMarker):
+        return DeploymentHandle(obj.app_name, obj.deployment_name)
+    if isinstance(obj, tuple):
+        return tuple(_resolve_handle_markers(x) for x in obj)
+    if isinstance(obj, list):
+        return [_resolve_handle_markers(x) for x in obj]
+    if isinstance(obj, dict):
+        return {k: _resolve_handle_markers(v) for k, v in obj.items()}
+    return obj
+
+
+class DeploymentResponse:
+    """Future-like result of ``handle.remote()``."""
+
+    def __init__(self, fut: "Future"):
+        self._fut = fut
+
+    def result(self, timeout: Optional[float] = None) -> Any:
+        return self._fut.result(timeout)
+
+    def __await__(self):
+        return asyncio.wrap_future(self._fut).__await__()
+
+
+class _RouterState:
+    """Replica cache + local in-flight counts (shared per handle)."""
+
+    def __init__(self, app: str, deployment: str):
+        self.app = app
+        self.deployment = deployment
+        self.version = -1
+        self.replicas: List[Tuple[str, Any]] = []  # (replica_id, actor handle)
+        self.counts: Dict[str, int] = {}
+        self.fetched_at = 0.0
+        self.lock = threading.Lock()
+
+    def _controller(self):
+        from ray_tpu.serve.api import _get_controller
+
+        return _get_controller()
+
+    def refresh(self, force: bool = False) -> None:
+        now = time.time()
+        with self.lock:
+            if not force and now - self.fetched_at < _REFRESH_TTL_S:
+                return
+        snap = ray_tpu.get(self._controller().get_replicas.remote(
+            self.app, self.deployment, self.version))
+        with self.lock:
+            self.fetched_at = time.time()
+            if snap["version"] != self.version:
+                self.version = snap["version"]
+                self.replicas = snap["replicas"]
+                self.counts = {rid: self.counts.get(rid, 0)
+                               for rid, _ in self.replicas}
+
+    def wake_and_wait(self) -> None:
+        """Scale-to-zero cold start: ask the controller for capacity and
+        wait until a replica appears."""
+        deadline = time.time() + _COLD_START_TIMEOUT_S
+        ray_tpu.get(self._controller().wake.remote(self.app, self.deployment))
+        while time.time() < deadline:
+            self.refresh(force=True)
+            if self.replicas:
+                return
+            time.sleep(0.1)
+        raise TimeoutError(
+            f"no replicas for {self.app}/{self.deployment} after "
+            f"{_COLD_START_TIMEOUT_S}s")
+
+    def pick(self) -> Tuple[str, Any]:
+        """Power-of-two-choices by local in-flight count."""
+        with self.lock:
+            reps = self.replicas
+            if not reps:
+                raise LookupError("no replicas")
+            if len(reps) == 1:
+                choice = reps[0]
+            else:
+                a, b = random.sample(reps, 2)
+                choice = a if (self.counts.get(a[0], 0)
+                               <= self.counts.get(b[0], 0)) else b
+            self.counts[choice[0]] = self.counts.get(choice[0], 0) + 1
+            return choice
+
+    def complete(self, replica_id: str, rejected_ongoing: Optional[int] = None):
+        with self.lock:
+            if rejected_ongoing is not None:
+                # replica told us its real queue depth — adopt it
+                self.counts[replica_id] = rejected_ongoing
+            else:
+                self.counts[replica_id] = max(
+                    0, self.counts.get(replica_id, 1) - 1)
+
+
+# one shared pool for all sync-path handle calls in this process
+_pool: Optional[ThreadPoolExecutor] = None
+_pool_lock = threading.Lock()
+
+
+def _shared_pool() -> ThreadPoolExecutor:
+    global _pool
+    with _pool_lock:
+        if _pool is None:
+            _pool = ThreadPoolExecutor(max_workers=32,
+                                       thread_name_prefix="rt-serve-handle")
+        return _pool
+
+
+class DeploymentHandle:
+    def __init__(self, app_name: str, deployment_name: str,
+                 method_name: str = "__call__"):
+        self.app_name = app_name
+        self.deployment_name = deployment_name
+        self._method = method_name
+        self._router = _RouterState(app_name, deployment_name)
+
+    # composition: handle.other_method.remote(...)
+    def options(self, *, method_name: str) -> "DeploymentHandle":
+        h = DeploymentHandle(self.app_name, self.deployment_name, method_name)
+        h._router = self._router  # share the replica cache + counts
+        return h
+
+    def __getattr__(self, item: str) -> "DeploymentHandle":
+        if item.startswith("_"):
+            raise AttributeError(item)
+        return self.options(method_name=item)
+
+    def remote(self, *args, **kwargs) -> DeploymentResponse:
+        fut = _shared_pool().submit(self._call_blocking, args, kwargs)
+        return DeploymentResponse(fut)
+
+    def _call_blocking(self, args: Tuple, kwargs: Dict) -> Any:
+        router = self._router
+        backoff = _RETRY_BACKOFF_S
+        deadline = time.time() + _COLD_START_TIMEOUT_S
+        while True:
+            router.refresh()
+            if not router.replicas:
+                router.wake_and_wait()
+            try:
+                rid, actor = router.pick()
+            except LookupError:
+                continue
+            try:
+                status, payload = ray_tpu.get(actor.handle_request.remote(
+                    self._method, args, kwargs))
+            except ActorError:
+                # stale cache: drop this replica and re-route
+                router.complete(rid)
+                router.refresh(force=True)
+                continue
+            if status == REJECTED:
+                router.complete(rid, rejected_ongoing=payload)
+                if time.time() > deadline:
+                    raise TimeoutError(
+                        f"{self.app_name}/{self.deployment_name}: all "
+                        f"replicas at max_ongoing_requests")
+                time.sleep(backoff)
+                backoff = min(backoff * 1.5, 0.25)
+                router.refresh(force=backoff > 0.1)
+                continue
+            router.complete(rid)
+            return payload
+
+    def __reduce__(self):
+        return (DeploymentHandle,
+                (self.app_name, self.deployment_name, self._method))
+
+    def __repr__(self) -> str:
+        return (f"DeploymentHandle({self.app_name}/{self.deployment_name}"
+                f".{self._method})")

@@ -1,0 +1,178 @@
+"""HTTP proxy: the ingress that turns HTTP requests into handle calls.
+
+Reference analog: ``serve/_private/http_proxy.py:935`` (``HTTPProxy`` on
+uvicorn/ASGI). Here the proxy is one actor running an aiohttp server on the
+worker's event loop. Routing: longest-matching ``route_prefix`` from the
+controller's routing table (refreshed on a short TTL), then a
+``DeploymentHandle`` call on the app's ingress deployment — so the proxy
+shares the power-of-two replica routing and backpressure path with every
+other caller.
+
+The request crosses process boundaries, so the replica receives a picklable
+``ServeRequest`` (method/path/headers/body), not an ASGI scope.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json as _json
+import time
+from typing import Any, Dict, Optional, Tuple
+
+import ray_tpu
+
+_ROUTE_TTL_S = 1.0
+
+
+class ServeRequest:
+    """Picklable HTTP request surface handed to ingress deployments."""
+
+    def __init__(self, method: str, path: str, query: Dict[str, str],
+                 headers: Dict[str, str], body: bytes):
+        self.method = method
+        self.path = path  # path with the app's route_prefix stripped
+        self.query = query
+        self.headers = headers
+        self.body = body
+
+    def json(self) -> Any:
+        return _json.loads(self.body or b"null")
+
+    def text(self) -> str:
+        return (self.body or b"").decode()
+
+
+def _to_response(result: Any):
+    """Map a deployment's return value onto (status, content_type, bytes)."""
+    status = 200
+    if (isinstance(result, tuple) and len(result) == 2
+            and isinstance(result[0], int)):
+        status, result = result
+    if result is None:
+        return status if status != 200 else 204, "text/plain", b""
+    if isinstance(result, bytes):
+        return status, "application/octet-stream", result
+    if isinstance(result, str):
+        return status, "text/plain; charset=utf-8", result.encode()
+    try:
+        import numpy as np
+
+        if isinstance(result, np.ndarray):
+            result = result.tolist()
+        payload = _json.dumps(result, default=_np_default).encode()
+        return status, "application/json", payload
+    except TypeError:
+        return status, "text/plain; charset=utf-8", str(result).encode()
+
+
+def _np_default(o):
+    import numpy as np
+
+    if isinstance(o, (np.integer,)):
+        return int(o)
+    if isinstance(o, (np.floating,)):
+        return float(o)
+    if isinstance(o, np.ndarray):
+        return o.tolist()
+    raise TypeError(f"not JSON serializable: {type(o)}")
+
+
+@ray_tpu.remote
+class ProxyActor:
+    def __init__(self):
+        self._routes: Dict[str, Tuple[str, str]] = {}
+        self._routes_fetched = 0.0
+        self._handles: Dict[Tuple[str, str], Any] = {}
+        self._runner = None
+        self._site = None
+        self._port: Optional[int] = None
+        self._requests_served = 0
+
+    async def start(self, host: str, port: int) -> int:
+        from aiohttp import web
+
+        app = web.Application(client_max_size=64 * 1024 * 1024)
+        app.router.add_route("*", "/{tail:.*}", self._handle)
+        self._runner = web.AppRunner(app, access_log=None)
+        await self._runner.setup()
+        self._site = web.TCPSite(self._runner, host, port)
+        await self._site.start()
+        self._port = self._site._server.sockets[0].getsockname()[1]
+        return self._port
+
+    async def stop(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+            self._runner = None
+
+    def _controller(self):
+        from ray_tpu.serve.api import _get_controller
+
+        return _get_controller()
+
+    async def _refresh_routes(self) -> None:
+        now = time.time()
+        if now - self._routes_fetched < _ROUTE_TTL_S:
+            return
+        # controller lookup + RPC are blocking (io.run) — they must never
+        # run on this worker's event loop, which services the RPC replies
+        loop = asyncio.get_running_loop()
+        table = await loop.run_in_executor(None, self._fetch_routes_blocking)
+        self._routes = table["routes"]
+        self._routes_fetched = time.time()
+
+    def _fetch_routes_blocking(self) -> Dict[str, Any]:
+        return ray_tpu.get(self._controller().get_routing_table.remote())
+
+    def _match(self, path: str) -> Optional[Tuple[str, str, str]]:
+        """Longest-prefix route match -> (app, ingress, stripped_path)."""
+        best = None
+        for prefix, (app, ingress) in self._routes.items():
+            norm = prefix.rstrip("/") or ""
+            if path == norm or path.startswith(norm + "/") or norm == "":
+                if best is None or len(norm) > len(best[0]):
+                    best = (norm, app, ingress)
+        if best is None:
+            return None
+        stripped = path[len(best[0]):] or "/"
+        return best[1], best[2], stripped
+
+    async def _handle(self, request):
+        from aiohttp import web
+
+        path = "/" + request.match_info["tail"]
+        if path == "/-/healthz":
+            return web.Response(text="ok")
+        if path == "/-/routes":
+            await self._refresh_routes()
+            return web.json_response(
+                {p: f"{a}:{i}" for p, (a, i) in self._routes.items()})
+        await self._refresh_routes()
+        m = self._match(path)
+        if m is None:
+            return web.Response(status=404, text=f"no app at {path}")
+        app_name, ingress, stripped = m
+        key = (app_name, ingress)
+        handle = self._handles.get(key)
+        if handle is None:
+            from ray_tpu.serve.handle import DeploymentHandle
+
+            handle = DeploymentHandle(app_name, ingress)
+            self._handles[key] = handle
+        sreq = ServeRequest(
+            method=request.method, path=stripped,
+            query=dict(request.rel_url.query),
+            headers=dict(request.headers), body=await request.read())
+        try:
+            result = await handle.remote(sreq)
+        except TimeoutError as e:
+            return web.Response(status=503, text=f"overloaded: {e}")
+        except Exception as e:  # noqa: BLE001 — user code raised
+            return web.Response(status=500, text=f"{type(e).__name__}: {e}")
+        self._requests_served += 1
+        status, ctype, payload = _to_response(result)
+        return web.Response(status=status, content_type=ctype.split(";")[0],
+                            body=payload)
+
+    def stats(self) -> Dict[str, Any]:
+        return {"port": self._port, "requests_served": self._requests_served}

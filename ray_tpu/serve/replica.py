@@ -1,0 +1,134 @@
+"""Replica: the actor that hosts one copy of a deployment's user callable.
+
+Reference analog: ``serve/_private/replica.py:497`` (``RayServeReplica``,
+``handle_request :235``). Each replica tracks its ongoing-request count and
+REJECTS requests over ``max_ongoing_requests`` — the router treats a
+rejection as backpressure and retries elsewhere (the reference's
+power-of-two scheduler does the same with queue-length probing).
+
+TPU note: a replica is where chips live (``num_tpus`` in
+``ray_actor_options`` pins whole chips via the raylet's
+``TPU_VISIBLE_CHIPS`` isolation), so replica count == chip-group count and
+the autoscaler is effectively provisioning TPU slices.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import inspect
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import ray_tpu
+
+REJECTED = "__rt_serve_rejected__"
+
+
+class _FunctionWrapper:
+    """Adapts a plain function deployment to the class-callable protocol."""
+
+    def __init__(self, fn):
+        self._fn = fn
+
+    async def __call__(self, *args, **kwargs):
+        result = self._fn(*args, **kwargs)
+        if inspect.isawaitable(result):
+            result = await result
+        return result
+
+
+@ray_tpu.remote
+class ReplicaActor:
+    """One replica. Created by the controller with the deployment's body
+    (class or function), init args (deployment-handle markers already
+    substituted by the controller), and config."""
+
+    def __init__(self, deployment_name: str, app_name: str, replica_id: str,
+                 body_ref, init_args: Tuple, init_kwargs: Dict,
+                 max_ongoing_requests: int,
+                 user_config: Optional[Dict] = None):
+        from ray_tpu.serve.handle import _resolve_handle_markers
+
+        self._deployment = deployment_name
+        self._app = app_name
+        self._replica_id = replica_id
+        self._max_ongoing = max_ongoing_requests
+        self._ongoing = 0
+        self._total_served = 0
+        self._started_at = time.time()
+        # sync user callables run here, NOT on the worker's event loop — a
+        # blocking body (the common case: a jitted forward pass) must not
+        # stall the RPC server or sibling requests
+        from concurrent.futures import ThreadPoolExecutor
+
+        self._exec = ThreadPoolExecutor(
+            max_workers=max(1, max_ongoing_requests),
+            thread_name_prefix="rt-replica")
+
+        body = body_ref
+        init_args = _resolve_handle_markers(init_args)
+        init_kwargs = _resolve_handle_markers(init_kwargs)
+        if isinstance(body, type):
+            self._instance = body(*init_args, **init_kwargs)
+        else:
+            self._instance = _FunctionWrapper(body)
+        if user_config is not None:
+            self._reconfigure_sync(user_config)
+
+    def _reconfigure_sync(self, user_config: Dict) -> None:
+        fn = getattr(self._instance, "reconfigure", None)
+        if fn is not None:
+            fn(user_config)
+
+    async def handle_request(self, method_name: str, args: Tuple,
+                             kwargs: Dict) -> Tuple[str, Any]:
+        """Returns ("ok", result) or (REJECTED, ongoing_count)."""
+        if self._ongoing >= self._max_ongoing:
+            return (REJECTED, self._ongoing)
+        self._ongoing += 1
+        try:
+            import functools
+
+            target = self._instance
+            if method_name != "__call__":
+                target = getattr(self._instance, method_name, None)
+                if target is None:
+                    raise AttributeError(
+                        f"deployment {self._deployment} has no method "
+                        f"{method_name!r}")
+            loop = asyncio.get_running_loop()
+            result = await loop.run_in_executor(
+                self._exec, functools.partial(target, *args, **kwargs))
+            if inspect.isawaitable(result):
+                result = await result
+            self._total_served += 1
+            return ("ok", result)
+        finally:
+            self._ongoing -= 1
+
+    # -- controller-facing ----------------------------------------------------
+    def ongoing_count(self) -> int:
+        return self._ongoing
+
+    def stats(self) -> Dict[str, Any]:
+        return {"replica_id": self._replica_id, "ongoing": self._ongoing,
+                "total_served": self._total_served,
+                "uptime_s": time.time() - self._started_at}
+
+    async def check_health(self) -> str:
+        fn = getattr(self._instance, "check_health", None)
+        if fn is not None:
+            result = fn()
+            if inspect.isawaitable(result):
+                await result
+        return "ok"
+
+    def reconfigure(self, user_config: Dict) -> None:
+        self._reconfigure_sync(user_config)
+
+    async def prepare_shutdown(self, timeout_s: float) -> int:
+        """Drain: wait for ongoing requests to finish (bounded)."""
+        deadline = time.time() + timeout_s
+        while self._ongoing > 0 and time.time() < deadline:
+            await asyncio.sleep(0.05)
+        return self._ongoing

@@ -63,7 +63,8 @@ class JaxTrainer:
     # -- dataset sharding -----------------------------------------------------
     def _shard_datasets(self, rank: int, world: int) -> Dict[str, Any]:
         shards = {}
-        for name, ds in self.datasets.items():
+        datasets = getattr(self, "_attempt_datasets", None) or self.datasets
+        for name, ds in datasets.items():
             split = getattr(ds, "streaming_split", None)
             if split is not None:
                 shards[name] = ds.streaming_split(world)[rank]
@@ -91,12 +92,17 @@ class JaxTrainer:
         last_error: Optional[str] = None
 
         while True:
-            # fresh streaming splits per attempt: a retry after worker death
-            # must re-execute the dataset, not resume a drained coordinator
-            for ds in self.datasets.values():
-                reset = getattr(ds, "reset_streaming_split", None)
-                if reset is not None:
-                    reset()
+            # per-attempt dataset copies: a retry after worker death must
+            # re-execute the dataset (fresh coordinator), and concurrent
+            # trials sharing one Dataset object (Train-on-Tune, local
+            # backend) must not see each other's split caches — Dataset's
+            # __getstate__ scrubs the cache, so copy() isolates it
+            import copy as _copy
+
+            self._attempt_datasets = {
+                name: (_copy.copy(ds)
+                       if hasattr(ds, "reset_streaming_split") else ds)
+                for name, ds in self.datasets.items()}
             group = WorkerGroup(self.scaling, name)
             group.start()
             try:

@@ -30,7 +30,16 @@ _sessions: Dict[int, "_FunctionSession"] = {}
 
 def _current_session() -> Optional["_FunctionSession"]:
     with _session_lock:
-        return _sessions.get(threading.get_ident())
+        s = _sessions.get(threading.get_ident())
+        if s is not None:
+            return s
+        # helper threads spawned by the trial fn have no registered ident;
+        # fall back to the unique active session when unambiguous (the
+        # single-trial case — matches the old process-global behavior)
+        alive = {id(v): v for v in _sessions.values()}
+        if len(alive) == 1:
+            return next(iter(alive.values()))
+        return None
 
 DONE = "done"
 TRAINING_ITERATION = "training_iteration"

@@ -1,0 +1,292 @@
+"""Serve layer: controller/replica/router, batching, autoscaling, HTTP.
+
+Mirrors the reference's serve test strategy (``serve/tests/``): fake-cluster
+deployments, handle calls, batching behavior, scale-up under load,
+scale-to-zero wake, composition, HTTP ingress.
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@pytest.fixture
+def serve_cluster():
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=6, num_tpus=4)
+    yield ray_tpu
+    try:
+        serve.shutdown()
+    finally:
+        serve._forget_controller_for_tests()
+        ray_tpu.shutdown()
+
+
+def test_function_deployment_and_handle(serve_cluster):
+    @serve.deployment
+    def echo(x):
+        return {"got": x}
+
+    handle = serve.run(echo.bind(), name="echo_app", route_prefix=None)
+    assert handle.remote(41).result(timeout=30) == {"got": 41}
+
+
+def test_class_deployment_replicas_and_state(serve_cluster):
+    @serve.deployment(num_replicas=2)
+    class Counter:
+        def __init__(self, start):
+            self.count = start
+
+        def __call__(self, inc):
+            self.count += inc
+            return self.count
+
+    handle = serve.run(Counter.bind(100), name="counter", route_prefix=None)
+    results = [handle.remote(1).result(timeout=30) for _ in range(6)]
+    # both replicas served (counts interleave rather than run 101..106)
+    assert all(100 < r <= 106 for r in results)
+    st = serve.status()
+    assert st["counter"]["deployments"]["Counter"]["replicas"] == 2
+
+
+def test_composition_handles(serve_cluster):
+    @serve.deployment
+    class Adder:
+        def __init__(self, offset):
+            self.offset = offset
+
+        def __call__(self, x):
+            return x + self.offset
+
+    @serve.deployment
+    class Combiner:
+        def __init__(self, a, b):
+            self.a = a  # DeploymentHandles (resolved from markers)
+            self.b = b
+
+        async def __call__(self, x):
+            ra = self.a.remote(x)
+            rb = self.b.remote(x)
+            return (await ra) + (await rb)
+
+    app = Combiner.bind(Adder.options(name="A").bind(1),
+                        Adder.options(name="B").bind(10))
+    handle = serve.run(app, name="combo", route_prefix=None)
+    assert handle.remote(5).result(timeout=30) == (5 + 1) + (5 + 10)
+
+
+def test_batching(serve_cluster):
+    @serve.deployment(max_ongoing_requests=32)
+    class Batched:
+        def __init__(self):
+            self.batch_sizes = []
+
+        @serve.batch(max_batch_size=8, batch_wait_timeout_s=0.2)
+        async def predict(self, xs):
+            self.batch_sizes.append(len(xs))
+            return [x * 2 for x in xs]
+
+        async def __call__(self, x):
+            if x == "sizes":
+                return self.batch_sizes
+            return await self.predict(x)
+
+    handle = serve.run(Batched.bind(), name="batched", route_prefix=None)
+    responses = [handle.remote(i) for i in range(8)]
+    assert [r.result(timeout=30) for r in responses] == [i * 2 for i in range(8)]
+    sizes = handle.remote("sizes").result(timeout=30)
+    # at least one real fused batch (>1 item) formed within the window
+    assert max(sizes) > 1, sizes
+    assert sum(sizes) == 8
+
+
+def test_max_ongoing_rejection_and_retry(serve_cluster):
+    @serve.deployment(num_replicas=2, max_ongoing_requests=1)
+    class Slow:
+        def __call__(self, x):
+            time.sleep(0.3)
+            return x
+
+    handle = serve.run(Slow.bind(), name="slow", route_prefix=None)
+    t0 = time.time()
+    rs = [handle.remote(i) for i in range(6)]
+    assert sorted(r.result(timeout=60) for r in rs) == list(range(6))
+    # 6 requests, 2 replicas, 0.3s each -> >= ~0.9s (capacity enforced)
+    assert time.time() - t0 > 0.8
+
+
+def test_autoscaling_up_under_load_and_down(serve_cluster):
+    @serve.deployment(
+        max_ongoing_requests=2,
+        autoscaling_config=dict(min_replicas=1, max_replicas=3,
+                                target_ongoing_requests=1.0,
+                                upscale_delay_s=0.5, downscale_delay_s=2.0,
+                                look_back_period_s=2.0))
+    class Work:
+        def __call__(self, x):
+            time.sleep(0.4)
+            return x
+
+    handle = serve.run(Work.bind(), name="auto", route_prefix=None)
+    assert serve.status()["auto"]["deployments"]["Work"]["replicas"] == 1
+    # sustained load -> scale up
+    stop_at = time.time() + 8.0
+    inflight = []
+    scaled = 0
+    while time.time() < stop_at:
+        inflight = [r for r in inflight]
+        while len(inflight) < 6:
+            inflight.append(handle.remote(1))
+        inflight = [r for r in inflight if not r._fut.done()]
+        scaled = serve.status()["auto"]["deployments"]["Work"]["replicas"]
+        if scaled >= 2:
+            break
+        time.sleep(0.2)
+    assert scaled >= 2, "did not scale up under sustained load"
+    # idle -> scale back down to min
+    deadline = time.time() + 25.0
+    while time.time() < deadline:
+        n = serve.status()["auto"]["deployments"]["Work"]["replicas"]
+        if n == 1:
+            break
+        time.sleep(0.5)
+    assert serve.status()["auto"]["deployments"]["Work"]["replicas"] == 1
+
+
+def test_scale_to_zero_and_wake(serve_cluster):
+    @serve.deployment(
+        autoscaling_config=dict(min_replicas=0, max_replicas=2,
+                                target_ongoing_requests=2.0,
+                                upscale_delay_s=0.25,
+                                downscale_delay_s=0.5,
+                                look_back_period_s=1.0))
+    def zero(x):
+        return x + 1
+
+    handle = serve.run(zero.bind(), name="z", route_prefix=None)
+    # drops to zero while idle
+    deadline = time.time() + 20.0
+    while time.time() < deadline:
+        if serve.status()["z"]["deployments"]["zero"]["replicas"] == 0:
+            break
+        time.sleep(0.25)
+    assert serve.status()["z"]["deployments"]["zero"]["replicas"] == 0
+    # a cold request wakes it
+    assert handle.remote(9).result(timeout=60) == 10
+
+
+def test_replica_death_recovery(serve_cluster):
+    @serve.deployment(num_replicas=1, health_check_period_s=0.5)
+    class Fragile:
+        def __call__(self, x):
+            if x == "die":
+                import os
+
+                os._exit(1)
+            return x
+
+    handle = serve.run(Fragile.bind(), name="fragile", route_prefix=None)
+    assert handle.remote("ok").result(timeout=30) == "ok"
+    try:
+        handle.remote("die").result(timeout=10)
+    except Exception:
+        pass
+    # controller restarts the replica; traffic recovers
+    deadline = time.time() + 30.0
+    last_err = None
+    while time.time() < deadline:
+        try:
+            assert handle.remote("back").result(timeout=10) == "back"
+            return
+        except Exception as e:  # noqa: BLE001
+            last_err = e
+            time.sleep(0.5)
+    raise AssertionError(f"replica never recovered: {last_err}")
+
+
+def test_http_proxy_end_to_end(serve_cluster):
+    import requests
+
+    @serve.deployment
+    class Api:
+        async def __call__(self, request):
+            if request.path == "/sum":
+                data = request.json()
+                return {"sum": sum(data["xs"])}
+            return 404, f"nothing at {request.path}"
+
+    serve.run(Api.bind(), name="api", route_prefix="/api")
+    port = serve.http_port()
+    base = f"http://127.0.0.1:{port}"
+    assert requests.get(f"{base}/-/healthz", timeout=10).text == "ok"
+    r = requests.post(f"{base}/api/sum", json={"xs": [1, 2, 3]}, timeout=30)
+    assert r.status_code == 200
+    assert r.json() == {"sum": 6}
+    assert requests.get(f"{base}/api/nope", timeout=10).status_code == 404
+    assert requests.get(f"{base}/unrouted", timeout=10).status_code == 404
+
+
+def test_redeploy_updates_code(serve_cluster):
+    def make(version):
+        @serve.deployment(name="V")
+        def v(x):
+            return version
+
+        return v
+
+    h = serve.run(make("v1").bind(), name="rv", route_prefix=None)
+    assert h.remote(0).result(timeout=30) == "v1"
+    h = serve.run(make("v2").bind(), name="rv", route_prefix=None)
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        if h.remote(0).result(timeout=30) == "v2":
+            return
+        time.sleep(0.25)
+    raise AssertionError("redeploy did not take effect")
+
+
+@pytest.mark.slow
+def test_serve_llama_debug_preset(serve_cluster):
+    """BASELINE config 5 shape: a llama replica served with batching."""
+    import numpy as np
+
+    @serve.deployment(max_ongoing_requests=16)
+    class Llama:
+        def __init__(self, preset):
+            import jax
+
+            from ray_tpu.models import llama
+
+            self.cfg = llama.PRESETS[preset]
+            self.params = llama.init_params(jax.random.key(0), self.cfg)
+            self.llama = llama
+
+        @serve.batch(max_batch_size=4, batch_wait_timeout_s=0.05)
+        async def logits(self, token_lists):
+            import jax.numpy as jnp
+
+            L = max(len(t) for t in token_lists)
+            toks = np.zeros((len(token_lists), L), dtype=np.int32)
+            for i, t in enumerate(token_lists):
+                toks[i, :len(t)] = t
+            out = self.llama.forward(self.params, jnp.asarray(toks), self.cfg)
+            return [np.asarray(out[i, len(t) - 1]).tolist()[:4]
+                    for i, t in enumerate(token_lists)]
+
+        async def __call__(self, request):
+            return await self.logits(request.json()["tokens"])
+
+    serve.run(Llama.bind("debug"), name="llama", route_prefix="/llama")
+    import requests
+
+    port = serve.http_port()
+    rs = [requests.post(f"http://127.0.0.1:{port}/llama",
+                        json={"tokens": [1, 2, 3, i % 5]}, timeout=120)
+          for i in range(4)]
+    for r in rs:
+        assert r.status_code == 200, r.text
+        assert len(r.json()) == 4
