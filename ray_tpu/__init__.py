@@ -52,6 +52,14 @@ def cancel(ref, *, force=False):
     global_worker()._require_backend().cancel(ref, force)
 
 
+def internal_free(refs):
+    """Eagerly delete objects from the object plane (reference:
+    ``ray._private.internal_api.free``)."""
+    if not isinstance(refs, (list, tuple)):
+        refs = [refs]
+    global_worker()._require_backend().free_objects(list(refs))
+
+
 def get_actor(name, namespace=None):
     """Look up a named actor."""
     return global_worker()._require_backend().get_actor_handle(name, namespace)
@@ -71,7 +79,8 @@ def nodes():
 
 __all__ = [
     "init", "shutdown", "is_initialized", "remote", "method", "put", "get",
-    "wait", "kill", "cancel", "get_actor", "cluster_resources",
+    "wait", "kill", "cancel", "get_actor", "internal_free",
+    "cluster_resources",
     "available_resources", "nodes", "get_runtime_context", "ObjectRef",
     "ActorClass", "ActorHandle", "RemoteFunction", "exceptions",
 ]

@@ -117,3 +117,9 @@ def get_config() -> Config:
 def set_config(cfg: Config) -> None:
     global _config
     _config = cfg
+
+
+def reset_config_for_tests() -> None:
+    """Drop the cached snapshot so the next get_config() re-reads the env."""
+    global _config
+    _config = None

@@ -21,6 +21,7 @@ import asyncio
 import os
 import subprocess
 import sys
+import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -88,6 +89,24 @@ class Raylet:
         self._local_objects: set = set()
         self._tasks: List[asyncio.Task] = []
         self._stopped = False
+        # --- object durability (reference: LocalObjectManager spilling,
+        # plasma EvictionPolicy) ---
+        cfg = get_config()
+        self._store_capacity = (cfg.object_store_memory_bytes
+                                or cfg.object_store_default_cap_bytes)
+        self._spill_dir = (cfg.object_spilling_dir
+                           or os.path.join(cfg.session_dir_root, session_name,
+                                           "spill", node_id))
+        # oid_hex -> {"size": int, "t": last-access, "spilled": bool}
+        self._object_meta: Dict[str, Dict[str, Any]] = {}
+        # spill/restore file IO runs here, never on the event loop — the
+        # raylet must keep dispatching while bytes hit the disk (reference:
+        # dedicated Python IO workers in LocalObjectManager)
+        from concurrent.futures import ThreadPoolExecutor
+
+        self._spill_exec = ThreadPoolExecutor(
+            max_workers=2, thread_name_prefix="rt-spill")
+        self._spill_lock = threading.Lock()
 
     # ---- lifecycle ----------------------------------------------------------
     async def start(self, port: int = 0) -> str:
@@ -212,7 +231,11 @@ class Raylet:
         task_id = p["task_id"]
         cached = self._replies.get(task_id)
         if cached is not None:
-            return cached
+            if not p.get("reconstruct"):
+                return cached
+            # lineage reconstruction MUST re-execute: the cached reply's
+            # plasma objects are exactly what was lost
+            self._replies.pop(task_id, None)
         existing = self._task_futures.get(task_id)
         if existing is not None:
             return await asyncio.shield(existing)
@@ -445,9 +468,90 @@ class Raylet:
         return {"ok": True}
 
     # ---- object plane -------------------------------------------------------
+    def _spill_path(self, oid_hex: str) -> str:
+        return os.path.join(self._spill_dir, oid_hex)
+
+    def _touch(self, oid_hex: str, size: Optional[int] = None,
+               spilled: Optional[bool] = None) -> None:
+        meta = self._object_meta.setdefault(
+            oid_hex, {"size": 0, "t": 0.0, "spilled": False})
+        meta["t"] = time.monotonic()
+        if size is not None:
+            meta["size"] = size
+        if spilled is not None:
+            meta["spilled"] = spilled
+
+    async def _maybe_spill(self) -> None:
+        """Capacity enforcement: when sealed bytes exceed the spill
+        threshold, move least-recently-used objects out of shm onto disk
+        (reference: ``LocalObjectManager::SpillObjects`` dispatched by the
+        plasma LRU ``EvictionPolicy``). File IO runs on the spill executor so
+        the raylet keeps dispatching. Locations in the GCS stay valid — this
+        node still serves the object, just from disk."""
+        await asyncio.get_running_loop().run_in_executor(
+            self._spill_exec, self._spill_blocking)
+
+    def _spill_blocking(self) -> None:
+        from ray_tpu._private.ids import ObjectID
+
+        cfg = get_config()
+        threshold = self._store_capacity * cfg.object_spill_threshold
+        with self._spill_lock:
+            in_mem = [(oid, m) for oid, m in self._object_meta.items()
+                      if not m["spilled"]]
+            used = sum(m["size"] for _, m in in_mem)
+            if used <= threshold:
+                return
+            in_mem.sort(key=lambda kv: kv[1]["t"])  # LRU first
+            os.makedirs(self._spill_dir, exist_ok=True)
+            for oid_hex, meta in in_mem:
+                if used <= threshold:
+                    break
+                view = self.store.read(ObjectID.from_hex(oid_hex))
+                if view is None:
+                    meta["spilled"] = True  # vanished; nothing to spill
+                    used -= meta["size"]
+                    continue
+                tmp = self._spill_path(oid_hex) + ".tmp"
+                with open(tmp, "wb") as f:
+                    f.write(view)
+                os.rename(tmp, self._spill_path(oid_hex))
+                self.store.delete(ObjectID.from_hex(oid_hex))
+                meta["spilled"] = True
+                used -= meta["size"]
+
+    async def _restore_from_spill(self, oid_hex: str) -> bool:
+        """Disk -> shm (reference: ``SpilledObjectReader`` restore path)."""
+        restored = await asyncio.get_running_loop().run_in_executor(
+            self._spill_exec, self._restore_blocking, oid_hex)
+        if restored:
+            await self._maybe_spill()  # restoring may push something else out
+        return restored
+
+    def _restore_blocking(self, oid_hex: str) -> bool:
+        from ray_tpu._private.ids import ObjectID
+
+        with self._spill_lock:
+            path = self._spill_path(oid_hex)
+            if not os.path.exists(path):
+                return False
+            with open(path, "rb") as f:
+                payload = f.read()
+            oid = ObjectID.from_hex(oid_hex)
+            if not self.store.contains(oid):
+                self.store.write_whole(oid, payload)
+            self._touch(oid_hex, size=len(payload), spilled=False)
+            try:
+                os.unlink(path)
+            except FileNotFoundError:
+                pass
+            return True
+
     async def rpc_seal_object(self, p):
         oid_hex = p["oid"]
         self._local_objects.add(oid_hex)
+        self._touch(oid_hex, size=p.get("size", 0), spilled=False)
+        await self._maybe_spill()
         await self._gcs.call("add_object_location", {
             "oid": oid_hex, "node_id": self.node_id, "size": p.get("size", 0)})
         return {"ok": True}
@@ -455,19 +559,29 @@ class Raylet:
     async def rpc_get_object_payload(self, p):
         from ray_tpu._private.ids import ObjectID
 
-        view = self.store.read(ObjectID.from_hex(p["oid"]))
-        if view is None:
-            return {"error": "not found"}
-        return {"payload": bytes(view)}
+        oid_hex = p["oid"]
+        view = self.store.read(ObjectID.from_hex(oid_hex))
+        if view is not None:
+            self._touch(oid_hex)
+            return {"payload": bytes(view)}
+        path = self._spill_path(oid_hex)
+        if os.path.exists(path):
+            with open(path, "rb") as f:
+                return {"payload": f.read()}
+        return {"error": "not found"}
 
     async def rpc_fetch_object(self, p):
         """Pull an object to this node's store (reference: PullManager →
-        remote ObjectManager chunked push)."""
+        remote ObjectManager chunked push). Resolution: local shm → local
+        spill restore → remote node (which itself serves shm or spill)."""
         from ray_tpu._private.ids import ObjectID
 
         oid_hex = p["oid"]
         oid = ObjectID.from_hex(oid_hex)
         if self.store.contains(oid):
+            self._touch(oid_hex)
+            return {"ok": True}
+        if await self._restore_from_spill(oid_hex):
             return {"ok": True}
         reply = await self._gcs.call("get_object_locations", {
             "oid": oid_hex, "wait": True, "timeout": p.get("timeout", 30.0)})
@@ -484,7 +598,7 @@ class Raylet:
                     return {"ok": True}
             except Exception:
                 continue
-        if self.store.contains(oid):
+        if self.store.contains(oid) or await self._restore_from_spill(oid_hex):
             return {"ok": True}
         return {"error": "unavailable", "oid": oid_hex}
 
@@ -494,6 +608,11 @@ class Raylet:
         for oid_hex in p["oids"]:
             self.store.delete(ObjectID.from_hex(oid_hex))
             self._local_objects.discard(oid_hex)
+            self._object_meta.pop(oid_hex, None)
+            try:
+                os.unlink(self._spill_path(oid_hex))
+            except FileNotFoundError:
+                pass
             await self._gcs.call("remove_object_location", {
                 "oid": oid_hex, "node_id": self.node_id})
         return {"ok": True}

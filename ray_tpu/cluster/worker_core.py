@@ -147,6 +147,13 @@ class ClusterBackend(RuntimeBackend):
         self._current_task_id: Optional[str] = None  # set by worker_main
         self._blocked_notified: set = set()
         self._pg_addr_cache: Dict[Tuple[str, int], str] = {}
+        # Lineage for owner-side reconstruction (reference:
+        # ``object_recovery_manager.h:41-94`` — when every copy of a task's
+        # return object is lost, the OWNER resubmits the creating task).
+        # oid_hex -> submit payload; kept only for returns that went to
+        # plasma (small returns live in this process's memory store).
+        self._lineage: Dict[str, Dict] = {}
+        self._reconstructing: Dict[str, asyncio.Future] = {}
 
     # ---- bootstrap ----------------------------------------------------------
     def connect(self) -> None:
@@ -199,6 +206,7 @@ class ClusterBackend(RuntimeBackend):
         """The 4-step resolution; returns the serialized payload."""
         oid_hex = ref.hex()
         deadline = None if timeout is None else time.monotonic() + timeout
+        reconstruct_attempts = 0
 
         def remaining():
             if deadline is None:
@@ -236,14 +244,71 @@ class ClusterBackend(RuntimeBackend):
                         raise ObjectLostError(ref.id())
                 except (ConnectionLost, ConnectionError, OSError):
                     raise ObjectLostError(ref.id()) from None
+            # A reconstructable object fails fast on the directory wait —
+            # we can rebuild it — while a plain object waits out the caller's
+            # deadline in case a producer is still sealing it.
+            can_reconstruct = oid_hex in self._lineage
+            dir_wait = (min(5.0, remaining() or 5.0) if can_reconstruct
+                        else (remaining() or 30.0))
             reply = await self._raylet.call(
-                "fetch_object", {"oid": oid_hex, "timeout": remaining() or 30.0},
+                "fetch_object", {"oid": oid_hex, "timeout": dir_wait},
                 timeout=remaining())
             if reply.get("ok"):
                 view = self.plasma.read(ref.id())
                 if view is not None:
                     return view
+            if can_reconstruct and reconstruct_attempts < 2:
+                reconstruct_attempts += 1
+                await self._reconstruct(oid_hex)
+                continue
+            owner = ref.owner_address()
+            if (owner and owner != self.address
+                    and reconstruct_attempts < 2):
+                # borrower path: every copy is gone and we hold no lineage —
+                # the owner does; ask it to reconstruct
+                reconstruct_attempts += 1
+                try:
+                    client = await self._pool.get(owner)
+                    reply = await client.call(
+                        "get_object", {"oid": oid_hex, "lost": True},
+                        timeout=remaining())
+                    if "payload" in reply:
+                        return memoryview(reply["payload"])
+                    if reply.get("reconstructed"):
+                        continue
+                except (ConnectionLost, ConnectionError, OSError):
+                    pass
             raise ObjectLostError(ref.id())
+
+    async def _reconstruct(self, oid_hex: str) -> None:
+        """Re-execute the creating task to regenerate a lost return object
+        (same task_id => same deterministic return ObjectIDs). Concurrent
+        getters of the same lost object join one resubmission. Single-level:
+        if the creating task's own ref args are also lost, the re-execution
+        fails and the loss surfaces as the task's error."""
+        existing = self._reconstructing.get(oid_hex)
+        if existing is not None:
+            await asyncio.shield(existing)
+            return
+        fut = asyncio.get_running_loop().create_future()
+        payload = dict(self._lineage[oid_hex])
+        payload["reconstruct"] = True
+        task_id = TaskID.from_hex(payload["task_id"])
+        refs = [ObjectRef(ObjectID.for_return(task_id, i), owner=self.address)
+                for i in range(payload["num_returns"])]
+        for r in refs:
+            self._reconstructing[r.hex()] = fut
+        try:
+            target = self._raylet
+            if payload.get("pg") is not None:
+                target = await self._pg_bundle_raylet(payload["pg"])
+            reply = await target.call("submit_task", payload)
+            self._apply_task_reply(reply, refs, payload["fn_name"], payload)
+        finally:
+            for r in refs:
+                self._reconstructing.pop(r.hex(), None)
+            if not fut.done():
+                fut.set_result(None)
 
     def _deserialize_result(self, payload: memoryview) -> Any:
         value = self.serde.deserialize_payload(payload)
@@ -295,13 +360,25 @@ class ClusterBackend(RuntimeBackend):
         return self.io.run(_wait())
 
     async def _rpc_get_object(self, p):
-        """Serve our memory store to borrowers (long-poll while pending)."""
+        """Serve our memory store to borrowers (long-poll while pending).
+        ``lost=True`` from a borrower means every copy is gone: as the owner
+        we hold the lineage, so reconstruct before replying (reference: the
+        owner drives recovery, ``object_recovery_manager.h``)."""
         oid_hex = p["oid"]
         if self.memory_store.is_pending(oid_hex):
             await self.memory_store.wait_ready(oid_hex, p.get("timeout") or 30.0)
         payload = self.memory_store.get(oid_hex)
         if payload is not None:
             return {"payload": payload}
+        if p.get("lost") and oid_hex in self._lineage:
+            try:
+                await self._reconstruct(oid_hex)
+            except Exception:  # noqa: BLE001 — borrower sees not_found
+                pass
+            payload = self.memory_store.get(oid_hex)
+            if payload is not None:
+                return {"payload": payload}
+            return {"in_plasma": True, "reconstructed": True}
         if self.plasma.contains(ObjectID.from_hex(oid_hex)):
             return {"in_plasma": True}
         return {"not_found": True}
@@ -309,6 +386,7 @@ class ClusterBackend(RuntimeBackend):
     def free_objects(self, refs: Sequence[ObjectRef]) -> None:
         for r in refs:
             self.memory_store.delete(r.hex())
+            self._lineage.pop(r.hex(), None)
         self.io.run(self._raylet.call(
             "free_objects", {"oids": [r.hex() for r in refs]}))
 
@@ -416,7 +494,7 @@ class ClusterBackend(RuntimeBackend):
                     attempt += 1
                     continue
             break
-        self._apply_task_reply(reply, refs, payload["fn_name"])
+        self._apply_task_reply(reply, refs, payload["fn_name"], payload)
 
     async def _pg_bundle_raylet(self, pg_info: Dict):
         """Resolve the raylet hosting the task's bundle. The address of a
@@ -441,7 +519,8 @@ class ClusterBackend(RuntimeBackend):
             reply["picked_address"]
         return await self._pool.get(reply["picked_address"])
 
-    def _apply_task_reply(self, reply, refs: List[ObjectRef], fn_name: str) -> None:
+    def _apply_task_reply(self, reply, refs: List[ObjectRef], fn_name: str,
+                          payload: Optional[Dict] = None) -> None:
         if reply.get("error"):
             err = WorkerCrashedError(
                 f"task {fn_name} failed: {reply.get('message', reply['error'])}")
@@ -454,8 +533,15 @@ class ClusterBackend(RuntimeBackend):
             kind, data = ret
             if kind == "val":
                 self.memory_store.put(r.hex(), data)
+                self._lineage.pop(r.hex(), None)
             else:  # "plasma": sealed by the executor; location registered
                 self.memory_store.mark_external(r.hex())
+                if payload is not None:
+                    # retain lineage so this return can be rebuilt if every
+                    # copy is lost (bounded: oldest entries dropped)
+                    self._lineage[r.hex()] = payload
+                    while len(self._lineage) > 4096:
+                        self._lineage.pop(next(iter(self._lineage)))
 
     # ---- actors -------------------------------------------------------------
     def create_actor(self, cls, options, args, kwargs, method_meta):

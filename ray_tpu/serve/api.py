@@ -154,12 +154,21 @@ def _collect_graph(app: Application, app_name: str,
         return obj
 
     dep = app._deployment
+    entry = {"name": dep.name, "body": dep._body,
+             "init_args": convert(app._args),
+             "init_kwargs": convert(app._kwargs),
+             "config": dep._config}
     existing = next((d for d in out if d["name"] == dep.name), None)
     if existing is None:
-        out.append({"name": dep.name, "body": dep._body,
-                    "init_args": convert(app._args),
-                    "init_kwargs": convert(app._kwargs),
-                    "config": dep._config})
+        out.append(entry)
+    elif (existing["body"] is not dep._body
+          or existing["init_args"] != entry["init_args"]
+          or existing["init_kwargs"] != entry["init_kwargs"]
+          or existing["config"] != dep._config):
+        raise ValueError(
+            f"deployment name {dep.name!r} bound twice with different "
+            f"code/args/config — rename one with "
+            f".options(name=...) (each name maps to ONE replica set)")
     return dep.name
 
 

@@ -20,13 +20,15 @@ from typing import Any, Callable, List, Optional
 
 
 class _Batcher:
-    """Queue of (item, future) pairs flushed by size or deadline."""
+    """Queue of (item, future) pairs flushed by size or deadline.
 
-    def __init__(self, fn: Callable, max_batch_size: int,
-                 batch_wait_timeout_s: float):
+    Batch parameters are read from the wrapper per flush, so
+    ``set_max_batch_size`` / ``set_batch_wait_timeout_s`` take effect on the
+    next batch even after the batcher is live."""
+
+    def __init__(self, fn: Callable, wrapper):
         self._fn = fn
-        self._max = max_batch_size
-        self._timeout = batch_wait_timeout_s
+        self._wrapper = wrapper
         self._queue: "asyncio.Queue" = asyncio.Queue()
         self._loop_task: Optional[asyncio.Task] = None
 
@@ -41,8 +43,10 @@ class _Batcher:
         while True:
             item, fut = await self._queue.get()
             batch = [(item, fut)]
-            deadline = asyncio.get_running_loop().time() + self._timeout
-            while len(batch) < self._max:
+            max_size = self._wrapper._rt_max_batch_size
+            timeout = self._wrapper._rt_batch_wait_timeout_s
+            deadline = asyncio.get_running_loop().time() + timeout
+            while len(batch) < max_size:
                 remaining = deadline - asyncio.get_running_loop().time()
                 if remaining <= 0:
                     break
@@ -98,15 +102,13 @@ def batch(_fn: Optional[Callable] = None, *, max_batch_size: int = 8,
                     async def call(items: List[Any]):
                         return await fn(owner, items)
 
-                    batcher = _Batcher(call, wrapper._rt_max_batch_size,
-                                       wrapper._rt_batch_wait_timeout_s)
+                    batcher = _Batcher(call, wrapper)
                     setattr(owner, attr, batcher)
             elif len(args) == 1:  # free function: (item,)
                 item = args[0]
                 batcher = getattr(wrapper, "_rt_free_batcher", None)
                 if batcher is None:
-                    batcher = _Batcher(fn, wrapper._rt_max_batch_size,
-                                       wrapper._rt_batch_wait_timeout_s)
+                    batcher = _Batcher(fn, wrapper)
                     wrapper._rt_free_batcher = batcher
             else:
                 raise TypeError("@serve.batch methods take exactly one item")

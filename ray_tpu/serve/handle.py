@@ -38,6 +38,14 @@ class _HandleMarker:
         self.app_name = app_name
         self.deployment_name = deployment_name
 
+    def __eq__(self, other):
+        return (isinstance(other, _HandleMarker)
+                and other.app_name == self.app_name
+                and other.deployment_name == self.deployment_name)
+
+    def __hash__(self):
+        return hash((self.app_name, self.deployment_name))
+
 
 def _resolve_handle_markers(obj: Any) -> Any:
     if isinstance(obj, _HandleMarker):
@@ -188,8 +196,16 @@ class DeploymentHandle:
                 status, payload = ray_tpu.get(actor.handle_request.remote(
                     self._method, args, kwargs))
             except ActorError:
-                # stale cache: drop this replica and re-route
+                # stale cache: drop this replica and re-route (with the same
+                # backoff/deadline as rejection — a dead replica stays in the
+                # cache until the controller's health check evicts it)
                 router.complete(rid)
+                if time.time() > deadline:
+                    raise TimeoutError(
+                        f"{self.app_name}/{self.deployment_name}: replicas "
+                        f"kept failing") from None
+                time.sleep(backoff)
+                backoff = min(backoff * 1.5, 0.25)
                 router.refresh(force=True)
                 continue
             if status == REJECTED:

@@ -25,16 +25,18 @@ REJECTED = "__rt_serve_rejected__"
 
 
 class _FunctionWrapper:
-    """Adapts a plain function deployment to the class-callable protocol."""
+    """Adapts a plain function deployment to the class-callable protocol.
+
+    Deliberately a plain (sync) __call__: handle_request runs it in the
+    replica executor, so a blocking function body occupies an executor
+    thread, NOT the worker's event loop. Async fns return a coroutine here,
+    which handle_request awaits on the loop."""
 
     def __init__(self, fn):
         self._fn = fn
 
-    async def __call__(self, *args, **kwargs):
-        result = self._fn(*args, **kwargs)
-        if inspect.isawaitable(result):
-            result = await result
-        return result
+    def __call__(self, *args, **kwargs):
+        return self._fn(*args, **kwargs)
 
 
 @ray_tpu.remote
