@@ -94,6 +94,8 @@ class ClusterHandle:
                 except Exception:
                     pass
             try:
+                if self.gcs is not None:
+                    await self.gcs.stop()
                 await self._gcs_rpc_server.stop()
             except Exception:
                 pass

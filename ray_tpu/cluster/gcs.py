@@ -102,6 +102,13 @@ class GcsServer:
     def start_monitor(self) -> None:
         self._monitor_task = asyncio.ensure_future(self._monitor_loop())
 
+    async def stop(self) -> None:
+        from ray_tpu.cluster.rpc import cancel_and_wait
+
+        await cancel_and_wait(self._monitor_task)
+        self._monitor_task = None
+        await self._pool.close_all()
+
     # ---- nodes ------------------------------------------------------------
     async def rpc_register_node(self, p):
         entry = _NodeEntry(p["node_id"], p["address"], p["resources"],

@@ -99,6 +99,18 @@ class Raylet:
                                            "spill", node_id))
         # oid_hex -> {"size": int, "t": last-access, "spilled": bool}
         self._object_meta: Dict[str, Dict[str, Any]] = {}
+        # Get-time pins (reference: ``PinObjectIDs``,
+        # ``raylet/node_manager.h:515-555``): a getter pins its whole ref set
+        # before resolution so concurrent restores can't mutually re-evict
+        # each other's objects between fetch-ok and the shm read. Refcounted;
+        # a stale pin (crashed getter) expires after _PIN_TTL_S.
+        # oid_hex -> {"count": int, "t": monotonic-of-last-pin}
+        self._pinned: Dict[str, Dict[str, float]] = {}
+        # Running sum of in-memory (non-spilled) object bytes, so the
+        # per-unpin spill precheck is O(1) not O(#objects). Maintained by
+        # _touch / _spill_blocking / rpc_free_objects; the spill thread
+        # recomputes exactly under its lock before acting.
+        self._in_mem_bytes = 0
         # spill/restore file IO runs here, never on the event loop — the
         # raylet must keep dispatching while bytes hit the disk (reference:
         # dedicated Python IO workers in LocalObjectManager)
@@ -124,13 +136,18 @@ class Raylet:
 
     async def stop(self, destroy_store: bool = False) -> None:
         self._stopped = True
-        for t in self._tasks:
-            t.cancel()
+        from ray_tpu.cluster.rpc import cancel_and_wait
+
+        await cancel_and_wait(*self._tasks)
+        self._tasks.clear()
         for w in list(self._workers.values()):
             try:
                 w.proc.terminate()
             except ProcessLookupError:
                 pass
+        if self._gcs is not None:
+            await self._gcs.close()
+        await self._pool.close_all()
         await self.server.stop()
         # The shm session dir is SHARED by all nodes of the session (same
         # host); only the session owner destroys it (ClusterHandle.shutdown).
@@ -468,6 +485,51 @@ class Raylet:
         return {"ok": True}
 
     # ---- object plane -------------------------------------------------------
+    _PIN_TTL_S = 120.0
+
+    async def rpc_pin_objects(self, p):
+        now = time.monotonic()
+        if len(self._pinned) > 1024:
+            # purge leaked entries (crashed getters); live pins span only a
+            # fetch→read window, so a stale ``t`` means nobody is waiting
+            for oid_hex, entry in list(self._pinned.items()):
+                if now - entry["t"] > self._PIN_TTL_S:
+                    self._pinned.pop(oid_hex, None)
+        for oid_hex in p["oids"]:
+            entry = self._pinned.setdefault(oid_hex, {"count": 0, "t": now})
+            entry["count"] += 1
+            entry["t"] = now
+        return {"ok": True}
+
+    async def rpc_unpin_objects(self, p):
+        for oid_hex in p["oids"]:
+            entry = self._pinned.get(oid_hex)
+            if entry is None:
+                continue
+            entry["count"] -= 1
+            if entry["count"] <= 0:
+                self._pinned.pop(oid_hex, None)
+        # released pins may allow the store to shrink back under threshold
+        await self._maybe_spill()
+        return {"ok": True}
+
+    def _refresh_pin(self, oid_hex: str) -> None:
+        """Restart the TTL clock for the fetch-ok→read window: a getter may
+        have blocked in fetch far past the TTL (late producer), and the pin
+        must be live precisely when the object lands in shm. Recreates the
+        entry if the purge dropped it while the getter was blocked."""
+        entry = self._pinned.get(oid_hex)
+        if entry is None:
+            entry = self._pinned[oid_hex] = {"count": 1, "t": 0.0}
+        entry["t"] = time.monotonic()
+
+    def _is_pinned(self, oid_hex: str, now: float) -> bool:
+        """Read-only (called from the spill executor thread; mutation happens
+        only on the event loop). A stale ``t`` — crashed getter — is treated
+        as unpinned but left for the event loop to purge."""
+        entry = self._pinned.get(oid_hex)
+        return entry is not None and now - entry["t"] <= self._PIN_TTL_S
+
     def _spill_path(self, oid_hex: str) -> str:
         return os.path.join(self._spill_dir, oid_hex)
 
@@ -475,11 +537,13 @@ class Raylet:
                spilled: Optional[bool] = None) -> None:
         meta = self._object_meta.setdefault(
             oid_hex, {"size": 0, "t": 0.0, "spilled": False})
+        before = 0 if meta["spilled"] else meta["size"]
         meta["t"] = time.monotonic()
         if size is not None:
             meta["size"] = size
         if spilled is not None:
             meta["spilled"] = spilled
+        self._in_mem_bytes += (0 if meta["spilled"] else meta["size"]) - before
 
     async def _maybe_spill(self) -> None:
         """Capacity enforcement: when sealed bytes exceed the spill
@@ -488,6 +552,13 @@ class Raylet:
         plasma LRU ``EvictionPolicy``). File IO runs on the spill executor so
         the raylet keeps dispatching. Locations in the GCS stay valid — this
         node still serves the object, just from disk."""
+        # Cheap loop-side precheck: don't bounce through the executor (and
+        # its lock) when the store is under threshold — unpin calls this on
+        # every fetch. The spill thread re-checks exactly under the lock.
+        cfg = get_config()
+        threshold = self._store_capacity * cfg.object_spill_threshold
+        if 0 <= self._in_mem_bytes <= threshold:
+            return  # negative = drift; fall through so the pass resyncs
         await asyncio.get_running_loop().run_in_executor(
             self._spill_exec, self._spill_blocking)
 
@@ -497,6 +568,7 @@ class Raylet:
         cfg = get_config()
         threshold = self._store_capacity * cfg.object_spill_threshold
         with self._spill_lock:
+            now = time.monotonic()
             in_mem = [(oid, m) for oid, m in self._object_meta.items()
                       if not m["spilled"]]
             used = sum(m["size"] for _, m in in_mem)
@@ -507,9 +579,11 @@ class Raylet:
             for oid_hex, meta in in_mem:
                 if used <= threshold:
                     break
+                if self._is_pinned(oid_hex, now):
+                    continue  # a getter holds this between fetch and read
                 view = self.store.read(ObjectID.from_hex(oid_hex))
                 if view is None:
-                    meta["spilled"] = True  # vanished; nothing to spill
+                    meta["spilled"] = True  # vanished (e.g. freed mid-scan)
                     used -= meta["size"]
                     continue
                 tmp = self._spill_path(oid_hex) + ".tmp"
@@ -519,6 +593,13 @@ class Raylet:
                 self.store.delete(ObjectID.from_hex(oid_hex))
                 meta["spilled"] = True
                 used -= meta["size"]
+            # Exact resync of the O(1)-precheck counter: per-op increments
+            # race across the loop/executor threads (non-atomic RMW, frees
+            # during the scan); recomputing under the lock bounds any drift
+            # to one spill pass.
+            self._in_mem_bytes = sum(
+                m["size"] for m in self._object_meta.values()
+                if not m["spilled"])
 
     async def _restore_from_spill(self, oid_hex: str) -> bool:
         """Disk -> shm (reference: ``SpilledObjectReader`` restore path)."""
@@ -580,8 +661,10 @@ class Raylet:
         oid = ObjectID.from_hex(oid_hex)
         if self.store.contains(oid):
             self._touch(oid_hex)
+            self._refresh_pin(oid_hex)
             return {"ok": True}
         if await self._restore_from_spill(oid_hex):
+            self._refresh_pin(oid_hex)
             return {"ok": True}
         reply = await self._gcs.call("get_object_locations", {
             "oid": oid_hex, "wait": True, "timeout": p.get("timeout", 30.0)})
@@ -593,12 +676,14 @@ class Raylet:
                 data = await client.call("get_object_payload", {"oid": oid_hex})
                 if "payload" in data:
                     self.store.write_whole(oid, data["payload"])
+                    self._refresh_pin(oid_hex)
                     await self.rpc_seal_object({"oid": oid_hex,
                                                 "size": len(data["payload"])})
                     return {"ok": True}
             except Exception:
                 continue
         if self.store.contains(oid) or await self._restore_from_spill(oid_hex):
+            self._refresh_pin(oid_hex)
             return {"ok": True}
         return {"error": "unavailable", "oid": oid_hex}
 
@@ -608,7 +693,10 @@ class Raylet:
         for oid_hex in p["oids"]:
             self.store.delete(ObjectID.from_hex(oid_hex))
             self._local_objects.discard(oid_hex)
-            self._object_meta.pop(oid_hex, None)
+            meta = self._object_meta.pop(oid_hex, None)
+            if meta is not None and not meta["spilled"]:
+                self._in_mem_bytes -= meta["size"]
+            self._pinned.pop(oid_hex, None)
             try:
                 os.unlink(self._spill_path(oid_hex))
             except FileNotFoundError:

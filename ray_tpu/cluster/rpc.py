@@ -29,6 +29,23 @@ class RpcError(Exception):
     pass
 
 
+async def cancel_and_wait(*tasks) -> None:
+    """Cancel tasks and await their completion, swallowing every outcome
+    (CancelledError is a BaseException, hence the explicit tuple)."""
+    live = [t for t in tasks if t is not None and not t.done()]
+    for t in live:
+        t.cancel()
+    for t in live:
+        try:
+            await t
+        except asyncio.CancelledError:
+            cur = asyncio.current_task()
+            if cur is not None and cur.cancelling():
+                raise  # our caller was cancelled at this await — honor it
+        except Exception:  # noqa: BLE001
+            pass
+
+
 class ConnectionLost(RpcError):
     pass
 
@@ -167,7 +184,7 @@ class RpcClient:
         host, port = self.address.rsplit(":", 1)
         self._reader, self._writer = await asyncio.open_connection(host, int(port))
         self._lock = asyncio.Lock()
-        asyncio.ensure_future(self._read_loop())
+        self._read_task = asyncio.ensure_future(self._read_loop())
         if self._peer_id:
             await self.call("hello", {"peer_id": self._peer_id})
 
@@ -189,8 +206,12 @@ class RpcClient:
         finally:
             self._closed = True
             for fut in self._pending.values():
-                if not fut.done():
-                    fut.set_exception(ConnectionLost(f"connection to {self.address} lost"))
+                try:
+                    if not fut.done():
+                        fut.set_exception(
+                            ConnectionLost(f"connection to {self.address} lost"))
+                except RuntimeError:
+                    pass  # loop already closed during interpreter teardown
             self._pending.clear()
 
     async def call(self, method: str, payload: Any = None,
@@ -212,6 +233,7 @@ class RpcClient:
         self._closed = True
         if self._writer is not None:
             self._writer.close()
+        await cancel_and_wait(getattr(self, "_read_task", None))
 
 
 class EventLoopThread:
@@ -236,8 +258,26 @@ class EventLoopThread:
         asyncio.run_coroutine_threadsafe(coro, self.loop)
 
     def stop(self) -> None:
+        # Cancel and drain outstanding tasks first so the loop doesn't warn
+        # "Task was destroyed but it is pending!" at GC time.
+        async def _drain():
+            tasks = [t for t in asyncio.all_tasks()
+                     if t is not asyncio.current_task()]
+            for t in tasks:
+                t.cancel()
+            await asyncio.gather(*tasks, return_exceptions=True)
+
+        try:
+            asyncio.run_coroutine_threadsafe(_drain(), self.loop).result(2)
+        except Exception:
+            pass
         self.loop.call_soon_threadsafe(self.loop.stop)
         self._thread.join(timeout=2)
+        if not self._thread.is_alive():
+            try:
+                self.loop.close()
+            except Exception:
+                pass
 
 
 class ConnectionPool:

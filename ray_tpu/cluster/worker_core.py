@@ -154,6 +154,9 @@ class ClusterBackend(RuntimeBackend):
         # plasma (small returns live in this process's memory store).
         self._lineage: Dict[str, Dict] = {}
         self._reconstructing: Dict[str, asyncio.Future] = {}
+        # Tombstones for explicitly freed objects we own: lets a borrower's
+        # get fail fast instead of waiting out the directory timeout.
+        self._freed: Dict[str, None] = {}
 
     # ---- bootstrap ----------------------------------------------------------
     def connect(self) -> None:
@@ -201,10 +204,16 @@ class ClusterBackend(RuntimeBackend):
         self.memory_store.put(oid.hex(), payload)
         return ObjectRef(oid, owner=self.address)
 
-    async def _resolve_payload(self, ref: ObjectRef,
-                               timeout: Optional[float]) -> memoryview:
-        """The 4-step resolution; returns the serialized payload."""
+    async def _resolve_payload(self, ref: ObjectRef, timeout: Optional[float],
+                               pin_held: bool = False) -> memoryview:
+        """The 4-step resolution; returns the serialized payload.
+
+        ``pin_held``: the caller already holds a raylet pin covering this oid
+        (batched ``get``), so the per-oid pin around the fetch is skipped.
+        """
         oid_hex = ref.hex()
+        if oid_hex in self._freed:
+            raise ObjectLostError(ref.id())
         deadline = None if timeout is None else time.monotonic() + timeout
         reconstruct_attempts = 0
 
@@ -236,12 +245,15 @@ class ClusterBackend(RuntimeBackend):
                         timeout=remaining())
                     if "payload" in reply:
                         return memoryview(reply["payload"])
-                    if reply.get("in_plasma"):
-                        pass  # fall through to the directory pull
-                    elif reply.get("pending"):
+                    if reply.get("pending"):
                         continue
-                    else:
+                    if reply.get("freed"):
                         raise ObjectLostError(ref.id())
+                    # in_plasma, or not found in the owner process at all —
+                    # either way the location directory decides: the value may
+                    # live in another node's store or on spill disk (the owner
+                    # can't see its own raylet's spill dir), so fall through
+                    # to the raylet pull instead of declaring it lost here.
                 except (ConnectionLost, ConnectionError, OSError):
                     raise ObjectLostError(ref.id()) from None
             # A reconstructable object fails fast on the directory wait —
@@ -250,13 +262,26 @@ class ClusterBackend(RuntimeBackend):
             can_reconstruct = oid_hex in self._lineage
             dir_wait = (min(5.0, remaining() or 5.0) if can_reconstruct
                         else (remaining() or 30.0))
-            reply = await self._raylet.call(
-                "fetch_object", {"oid": oid_hex, "timeout": dir_wait},
-                timeout=remaining())
-            if reply.get("ok"):
-                view = self.plasma.read(ref.id())
-                if view is not None:
-                    return view
+            # Pin across the fetch→read window (reference: ``PinObjectIDs``,
+            # ``raylet/node_manager.h:515-555``): concurrent getters' restores
+            # must not re-evict this object between the raylet's fetch-ok and
+            # our shm read. The raylet refreshes the pin's TTL at fetch-ok,
+            # so even a fetch that blocked past the TTL lands protected. Once
+            # the view is in hand the mmap stays valid regardless of eviction.
+            if not pin_held:
+                await self._raylet.call("pin_objects", {"oids": [oid_hex]},
+                                        timeout=remaining())
+            try:
+                reply = await self._raylet.call(
+                    "fetch_object", {"oid": oid_hex, "timeout": dir_wait},
+                    timeout=remaining())
+                if reply.get("ok"):
+                    view = self.plasma.read(ref.id())
+                    if view is not None:
+                        return view
+            finally:
+                if not pin_held:
+                    asyncio.ensure_future(self._unpin_quietly([oid_hex]))
             if can_reconstruct and reconstruct_attempts < 2:
                 reconstruct_attempts += 1
                 await self._reconstruct(oid_hex)
@@ -279,6 +304,16 @@ class ClusterBackend(RuntimeBackend):
                 except (ConnectionLost, ConnectionError, OSError):
                     pass
             raise ObjectLostError(ref.id())
+
+    async def _unpin_quietly(self, oids: List[str]) -> None:
+        """Fire-and-forget unpin; a dropped connection (shutdown, raylet
+        restart) must not surface as an unretrieved task exception — the
+        raylet's pin TTL reclaims the pin anyway."""
+        try:
+            await self._raylet.call("unpin_objects", {"oids": oids},
+                                    timeout=5.0)
+        except Exception:  # noqa: BLE001
+            pass
 
     async def _reconstruct(self, oid_hex: str) -> None:
         """Re-execute the creating task to regenerate a lost return object
@@ -318,10 +353,25 @@ class ClusterBackend(RuntimeBackend):
 
     def get(self, refs: Sequence[ObjectRef], timeout: Optional[float]) -> List[Any]:
         self._notify_blocked()
+        # Batched pinning: one pin RPC covers the whole ref set for the
+        # duration of the resolve (the per-oid pin in _resolve_payload is
+        # skipped). Skipped entirely when every ref is already in our memory
+        # store — the hot small-object path pays no raylet round-trip.
+        oids = [r.hex() for r in refs]
+        all_local = all(self.memory_store.get(h) is not None for h in oids)
 
         async def _gather():
-            return await asyncio.gather(
-                *[self._resolve_payload(r, timeout) for r in refs])
+            if not all_local:
+                await self._raylet.call("pin_objects", {"oids": oids},
+                                        timeout=timeout)
+            try:
+                return await asyncio.gather(
+                    *[self._resolve_payload(r, timeout,
+                                            pin_held=not all_local)
+                      for r in refs])
+            finally:
+                if not all_local:
+                    asyncio.ensure_future(self._unpin_quietly(oids))
 
         payloads = self.io.run(_gather(), timeout=None if timeout is None
                                else timeout + 5.0)
@@ -365,6 +415,8 @@ class ClusterBackend(RuntimeBackend):
         we hold the lineage, so reconstruct before replying (reference: the
         owner drives recovery, ``object_recovery_manager.h``)."""
         oid_hex = p["oid"]
+        if oid_hex in self._freed:
+            return {"freed": True}
         if self.memory_store.is_pending(oid_hex):
             await self.memory_store.wait_ready(oid_hex, p.get("timeout") or 30.0)
         payload = self.memory_store.get(oid_hex)
@@ -387,6 +439,9 @@ class ClusterBackend(RuntimeBackend):
         for r in refs:
             self.memory_store.delete(r.hex())
             self._lineage.pop(r.hex(), None)
+            self._freed[r.hex()] = None
+        while len(self._freed) > 65536:
+            self._freed.pop(next(iter(self._freed)))
         self.io.run(self._raylet.call(
             "free_objects", {"oids": [r.hex() for r in refs]}))
 

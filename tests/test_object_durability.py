@@ -55,6 +55,26 @@ def test_spill_dir_populated_then_freed(small_store_cluster):
     assert len(spilled_after) < len(spilled)
 
 
+def test_concurrent_batched_gets_oversubscribed(small_store_cluster):
+    """Two worker processes + the driver batch-get the same 10MB working set
+    through a 2MB store concurrently: get-time pinning must keep every
+    object alive between its restore and each getter's read (no mutual
+    re-eviction)."""
+    arrays = [np.full((1024, 256), i, dtype=np.float32) for i in range(10)]
+    refs = [ray_tpu.put(a) for a in arrays]
+
+    @ray_tpu.remote
+    def check(rs):
+        vals = ray_tpu.get(rs, timeout=120)
+        return [float(v[0, 0]) for v in vals]
+
+    outs = ray_tpu.get([check.remote(refs) for _ in range(2)], timeout=120)
+    for out in outs:
+        assert out == [float(i) for i in range(10)]
+    vals = ray_tpu.get(refs, timeout=120)
+    assert [float(v[0, 0]) for v in vals] == [float(i) for i in range(10)]
+
+
 def test_task_returns_survive_overfill(small_store_cluster):
     @ray_tpu.remote
     def make(i):
