@@ -19,8 +19,20 @@ import os
 import time
 
 
+_PEAK_FLOPS = {"tpu": 197e12, "cpu": 1e11}  # v5e bf16 peak / rough CPU
+
+
+def _mfu(tok_s_chip: float, preset: str, platform: str) -> float:
+    """Model-FLOPs utilization from the 6*N fwd+bwd estimate."""
+    from ray_tpu.models import llama
+
+    flops_per_tok = 6 * llama.PRESETS[preset].num_params()
+    peak = _PEAK_FLOPS.get(platform, 1e12)
+    return round(tok_s_chip * flops_per_tok / peak, 4)
+
+
 def run_config(preset: str, batch: int, seq: int, steps: int,
-               attn_impl: str = "xla"):
+               attn_impl: str = "xla", loss_chunk: int = 0):
     import jax
     import jax.numpy as jnp
 
@@ -31,7 +43,8 @@ def run_config(preset: str, batch: int, seq: int, steps: int,
     n_dev = len(devices)
     platform = devices[0].platform
 
-    cfg = dataclasses.replace(llama.PRESETS[preset], attn_impl=attn_impl)
+    cfg = dataclasses.replace(llama.PRESETS[preset], attn_impl=attn_impl,
+                              loss_chunk=loss_chunk)
     seq = min(seq, cfg.max_seq_len)
 
     if n_dev > 1:
@@ -64,17 +77,99 @@ def run_config(preset: str, batch: int, seq: int, steps: int,
     tok_s = batch * seq * steps / dt
     tok_s_chip = tok_s / n_dev
 
-    # Model FLOPs utilization (6 * N * tokens fwd+bwd estimate).
-    flops_per_tok = 6 * cfg.num_params()
-    peak = {"tpu": 197e12, "cpu": 1e11}.get(platform, 1e12)  # v5e bf16 peak
-    mfu = (tok_s_chip * flops_per_tok) / peak
     return {
         "preset": preset, "platform": platform, "devices": n_dev,
         "batch": batch, "seq": seq, "steps": steps, "attn": attn_impl,
         "tok_s_chip": tok_s_chip, "loss": float(metrics["loss"]),
-        "mfu_est": round(mfu, 4),
+        "mfu_est": _mfu(tok_s_chip, preset, platform),
         "params_m": round(cfg.num_params() / 1e6, 1),
     }
+
+
+def _bench_train_loop(config):
+    """Runs inside the JaxTrainer worker actor: the PRODUCT path — data via
+    ``get_dataset_shard(...).iter_batches`` feeding the jitted sharded step,
+    per-run ``train.report``. Timed region excludes compile/warmup."""
+    import time as _time
+
+    import dataclasses as _dc
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu import train
+    from ray_tpu.models import llama
+    from ray_tpu.parallel import train_step as ts
+    from ray_tpu.parallel.mesh import MeshConfig, make_mesh
+
+    cfg = _dc.replace(llama.PRESETS[config["preset"]],
+                      attn_impl=config["attn"],
+                      loss_chunk=config.get("loss_chunk", 0))
+    devices = jax.devices()
+    mesh = make_mesh(MeshConfig(), devices)
+    optimizer = ts.default_optimizer(total_steps=1000)
+    params, opt_state = ts.init_sharded_state(jax.random.key(0), cfg, mesh,
+                                              optimizer)
+    step = ts.make_train_step(cfg, optimizer, mesh=mesh)
+
+    shard = train.get_dataset_shard("train")
+    it = shard.iter_batches(batch_size=config["batch"], drop_last=True,
+                            prefetch_batches=2)
+    first = next(it)["data"]
+    bd = ts.shard_batch({"tokens": jnp.asarray(first)}, mesh)
+    params, opt_state, metrics = step(params, opt_state, bd)  # compile
+    jax.block_until_ready(metrics["loss"])
+
+    t0 = _time.perf_counter()
+    n_tok = steps_done = 0
+    for b in it:
+        arr = b["data"]
+        bd = ts.shard_batch({"tokens": jnp.asarray(arr)}, mesh)
+        params, opt_state, metrics = step(params, opt_state, bd)
+        n_tok += arr.shape[0] * (arr.shape[1] - 1)
+        steps_done += 1
+    jax.block_until_ready(metrics["loss"])
+    dt = _time.perf_counter() - t0
+    train.report({
+        "tok_s_chip": n_tok / dt / len(devices),
+        "loss": float(metrics["loss"]),
+        "steps": steps_done,
+        "platform": devices[0].platform,
+        "devices": len(devices),
+    })
+
+
+def run_through_train(preset: str, batch: int, seq: int, steps: int,
+                      attn_impl: str = "xla", loss_chunk: int = 0):
+    """Tokens/sec/chip measured through the Train layer (BASELINE.md's 'Ray
+    Train tokens/sec/chip'): JaxTrainer gang + ray_tpu.data iter_batches feed.
+    The TPU is claimed by the worker subprocess, so the caller must not have
+    initialized the jax backend."""
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu import data as rt_data
+    from ray_tpu.train import JaxTrainer, ScalingConfig
+
+    from ray_tpu.models import llama
+
+    cfg = llama.PRESETS[preset]
+    seq = min(seq, cfg.max_seq_len)
+    rows = (steps + 1) * batch
+    tokens = np.random.default_rng(0).integers(
+        0, cfg.vocab_size, (rows, seq + 1)).astype(np.int32)
+
+    ray_tpu.init(num_cpus=2)
+    try:
+        trainer = JaxTrainer(
+            _bench_train_loop,
+            train_loop_config={"preset": preset, "batch": batch,
+                               "attn": attn_impl, "loss_chunk": loss_chunk},
+            scaling_config=ScalingConfig(num_workers=1, cpus_per_worker=1),
+            datasets={"train": rt_data.from_numpy(tokens)})
+        result = trainer.fit()
+    finally:
+        ray_tpu.shutdown()
+    return dict(result.metrics or {})
 
 
 def _is_oom(err: BaseException) -> bool:
@@ -84,30 +179,41 @@ def _is_oom(err: BaseException) -> bool:
 
 
 def _inner_main() -> None:
-    import jax
+    import sys
 
-    platform = jax.devices()[0].platform
+    # Platform comes from the watchdog's probe subprocess: importing jax
+    # here would claim the (single) chip in THIS process and starve the
+    # Train worker subprocess that must own it for the through-Train phase.
+    platform = os.environ.get("RT_BENCH_PLATFORM", "")
+    if not platform:
+        import jax
+
+        platform = jax.devices()[0].platform
     if platform == "cpu":
-        ladder = [("debug", 8, 128, 3, "xla")]
+        ladder = [("debug", 8, 128, 3, "xla", 0)]
     else:
         ladder = [
-            ("410m", 8, 2048, 10, "flash"),
-            ("410m", 8, 2048, 10, "xla"),
-            ("410m", 4, 2048, 10, "flash"),
-            ("410m", 4, 2048, 10, "xla"),
-            ("160m", 8, 2048, 10, "xla"),
-            ("160m", 4, 1024, 10, "xla"),
+            ("410m", 8, 2048, 20, "flash", 512),
+            ("410m", 8, 2048, 20, "xla", 512),
+            ("410m", 4, 2048, 20, "flash", 512),
+            ("410m", 4, 2048, 20, "xla", 0),
+            ("160m", 8, 2048, 20, "xla", 0),
+            ("160m", 4, 1024, 20, "xla", 0),
         ]
         if os.environ.get("BENCH_PRESET"):
             p = os.environ["BENCH_PRESET"]
-            ladder = [(p, 8, 2048, 10, "flash"), (p, 4, 2048, 10, "xla")] + ladder
+            ladder = [(p, 8, 2048, 10, "flash", 512),
+                      (p, 4, 2048, 10, "xla", 512)] + ladder
 
-    import sys
-
-    result, errors, non_oom_failures = None, [], 0
-    for preset, batch, seq, steps, attn in ladder:
+    # Phase 1 — the PRODUCT number: through JaxTrainer + data iterator.
+    # Walk the ladder on OOM so the driver always records something.
+    train_result, errors, non_oom_failures = None, [], 0
+    chosen = None
+    for preset, batch, seq, steps, attn, chunk in ladder:
         try:
-            result = run_config(preset, batch, seq, steps, attn)
+            train_result = run_through_train(preset, batch, seq, steps, attn,
+                                             chunk)
+            chosen = (preset, batch, seq, steps, attn, chunk)
             break
         except Exception as e:  # OOM or kernel unsupported: walk the ladder
             msg = f"{preset}/b{batch}/s{seq}/{attn}: {str(e)[:200]}"
@@ -121,10 +227,40 @@ def _inner_main() -> None:
                 non_oom_failures += 1
                 if non_oom_failures > 2:
                     raise
-    if result is None:
+    if train_result is None:
         raise RuntimeError("all bench configs failed:\n" + "\n".join(errors))
+
+    # Phase 2 — the raw jitted-step loop on the same config, in this process
+    # (the Train workers have exited, freeing the chip). The delta between
+    # the two is the Train-layer overhead (dispatch, report path, data feed).
+    preset, batch, seq, steps, attn, chunk = chosen
+    raw = None
+    try:
+        raw = run_config(preset, batch, seq, steps, attn, chunk)
+    except Exception as e:  # raw phase is informative, not the headline
+        print(f"bench: raw-step phase failed — {str(e)[:200]}",
+              file=sys.stderr)
+
+    tok_s = train_result["tok_s_chip"]
+    details = {
+        "preset": preset, "platform": train_result.get("platform", platform),
+        "devices": train_result.get("devices", 1), "batch": batch,
+        "seq": seq, "steps": train_result.get("steps", steps), "attn": attn,
+        "loss_chunk": chunk, "tok_s_chip": tok_s,
+        "loss": train_result.get("loss"), "through": "JaxTrainer",
+    }
+    if raw is not None:
+        details["raw_step_tok_s_chip"] = raw["tok_s_chip"]
+        details["train_overhead_pct"] = round(
+            (1 - tok_s / raw["tok_s_chip"]) * 100, 2)
+        details["mfu_est"] = raw["mfu_est"]
     if errors:
-        result["fallback_errors"] = errors
+        details["fallback_errors"] = errors
+
+    from ray_tpu.models import llama as _llama
+
+    details["mfu_through_train"] = _mfu(tok_s, preset, details["platform"])
+    details["params_m"] = round(_llama.PRESETS[preset].num_params() / 1e6, 1)
 
     baseline = None
     if os.path.exists("BENCH_BASELINE.json"):
@@ -132,14 +268,14 @@ def _inner_main() -> None:
             baseline = json.load(open("BENCH_BASELINE.json")).get("value")
         except Exception:
             baseline = None
-    vs = (result["tok_s_chip"] / baseline) if baseline else 1.0
+    vs = (tok_s / baseline) if baseline else 1.0
 
     print(json.dumps({
-        "metric": f"llama_{result['preset']}_train_tokens_per_sec_per_chip",
-        "value": round(result["tok_s_chip"], 2),
+        "metric": f"llama_{preset}_train_tokens_per_sec_per_chip",
+        "value": round(tok_s, 2),
         "unit": "tokens/s/chip",
         "vs_baseline": round(vs, 4),
-        "details": result,
+        "details": details,
     }))
 
 
@@ -233,14 +369,18 @@ def main() -> None:
     if platform is None:
         fallback_reason = "native jax backend init failed or hung"
     else:
-        result = _run_inner(dict(os.environ), timeout=1200)
+        env = dict(os.environ)
+        env["RT_BENCH_PLATFORM"] = platform
+        result = _run_inner(env, timeout=1200)
         if result is None:
             fallback_reason = f"bench on platform={platform} failed/timed out"
 
     if result is None:
         print(f"bench: falling back to CPU — {fallback_reason}",
               file=sys.stderr)
-        result = _run_inner(_cpu_env(), timeout=600)
+        cpu_env = _cpu_env()
+        cpu_env["RT_BENCH_PLATFORM"] = "cpu"
+        result = _run_inner(cpu_env, timeout=600)
         if result is not None:
             result.setdefault("details", {})["platform_fallback"] = (
                 fallback_reason)

@@ -49,6 +49,11 @@ class Config:
     scheduler_top_k_fraction: float = 0.2
     lease_timeout_s: float = 10.0
     max_pending_lease_requests_per_key: int = 10
+    # A queued-but-not-running task waits this long before the raylet asks
+    # the GCS for another node with free capacity (load-based spillback,
+    # reference: ScheduleAndDispatchTasks spillback). Bounded hops.
+    spillback_delay_s: float = 0.1
+    spillback_max_hops: int = 2
 
     # ---- object store ------------------------------------------------------
     # Objects <= this many bytes are stored in the owner's in-process memory

@@ -121,6 +121,15 @@ def start_or_connect(address: Optional[str], job_id: JobID, *,
                      namespace: Optional[str] = None):
     from ray_tpu.cluster.worker_core import ClusterBackend
 
+    if address == "auto":
+        from ray_tpu.cluster import node_main
+
+        latest = node_main.read_session_latest()
+        if latest is None:
+            raise ConnectionError(
+                "init(address='auto'): no running cluster found "
+                "(start one with `rt start --head`)")
+        address = latest["gcs_address"]
     if address is None:
         cluster = ClusterHandle()
         cluster.start_gcs()

@@ -622,7 +622,15 @@ class GcsServer:
     # ---- task routing (spillback target selection) -------------------------
     async def rpc_route_task(self, p):
         req = ResourceSet(p["resources"])
-        views = {nid: n.view for nid, n in self.nodes.items() if n.alive}
+        exclude = set(p.get("exclude") or ())
+        views = {nid: n.view for nid, n in self.nodes.items()
+                 if n.alive and nid not in exclude}
+        if p.get("require_available"):
+            # load-based spillback: only nodes that can run the task NOW
+            # (by their last-heartbeat view) are acceptable targets
+            views = {nid: v for nid, v in views.items() if v.can_fit(req)}
+            if not views:
+                return {"node_id": None}
         node_id = pick_node(p.get("strategy"), views, req,
                             preferred=p.get("preferred"))
         if node_id is None:

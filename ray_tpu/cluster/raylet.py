@@ -164,6 +164,10 @@ class Raylet:
                     "available": self.node.available.to_dict()})
             except Exception:
                 pass
+            if self._queue:
+                # periodic wake so waiting tasks re-evaluate spillback even
+                # when no local resource event fires
+                self._dispatch_event.set()
 
     # ---- worker pool --------------------------------------------------------
     def _spawn_worker(self, key: Tuple, chips: List[int]) -> _WorkerEntry:
@@ -285,7 +289,8 @@ class Raylet:
 
             asyncio.ensure_future(_do_spill())
             return await asyncio.shield(fut)
-        self._queue.append({"payload": p, "future": fut})
+        self._queue.append({"payload": p, "future": fut,
+                            "t": time.monotonic(), "spilling": False})
         self._dispatch_event.set()
         return await asyncio.shield(fut)
 
@@ -303,6 +308,46 @@ class Raylet:
                     "message": f"no node can ever run task requiring {p['resources']}"}
         client = await self._pool.get(route["address"])
         return await client.call("submit_task", p)
+
+    async def _try_spillback(self, item) -> None:
+        """Forward a queued-but-waiting task to a node with free capacity.
+        The task stays in our queue (flagged) until a target accepts it, so
+        local dispatch can still claim it if the attempt finds nothing."""
+        payload = dict(item["payload"])
+        payload["spill_count"] = payload.get("spill_count", 0) + 1
+        payload.pop("spillback_hint", None)
+        try:
+            route = await self._gcs.call("route_task", {
+                "resources": payload["resources"],
+                "strategy": payload.get("strategy"),
+                "require_available": True, "exclude": [self.node_id]})
+        except Exception:
+            route = {}
+        if not route.get("address"):
+            item["spilling"] = False
+            item["t"] = time.monotonic()  # back off before the next attempt
+            return
+        try:
+            self._queue.remove(item)
+        except ValueError:
+            item["spilling"] = False
+            return  # local dispatch already claimed it
+        try:
+            client = await self._pool.get(route["address"])
+            reply = await client.call("submit_task", payload)
+        except Exception:
+            # Target died between the GCS view and the forward: the task is
+            # still locally runnable — requeue it rather than failing the
+            # caller (same task_id, so a remote execution that did land
+            # dedups at that raylet; tasks are retry-idempotent by contract).
+            item["spilling"] = False
+            item["t"] = time.monotonic()
+            self._queue.append(item)
+            self._dispatch_event.set()
+            return
+        fut = item["future"]
+        if not fut.done():
+            fut.set_result(reply)
 
     async def _dispatch_loop(self) -> None:
         while True:
@@ -333,11 +378,25 @@ class Raylet:
                     pool = bundle.pool
                 else:
                     pool = self.node
-                if pool.can_fit(req):
+                if item.get("spilling"):
+                    remaining.append(item)  # a spillback attempt owns it
+                elif pool.can_fit(req):
                     assignment = pool.allocate(req)
                     asyncio.ensure_future(
                         self._run_task(item, req, assignment, pool))
                 else:
+                    # Load-based spillback (reference: spillback replies in
+                    # ScheduleAndDispatchTasks): a feasible task that has
+                    # waited past the delay looks for a node with capacity
+                    # free NOW. PG tasks are bundle-pinned — never spill.
+                    cfg = get_config()
+                    if (pg is None
+                            and payload.get("spill_count", 0)
+                            < cfg.spillback_max_hops
+                            and time.monotonic() - item.get("t", 0)
+                            > cfg.spillback_delay_s):
+                        item["spilling"] = True
+                        asyncio.ensure_future(self._try_spillback(item))
                     remaining.append(item)
             self._queue = remaining
 

@@ -10,9 +10,10 @@ named actors, ``collective.py:120-621``). TPU-native redesign in two planes:
    ``rendezvous.bootstrap_jax_distributed`` wires multi-host processes
    together through the GCS KV (the reference's unique-id rendezvous via a
    named actor, ``nccl_util.py``, same trick).
-2. **Host plane (the compatibility path)** — ``allreduce``/``broadcast``/...
-   on host numpy arrays between actors/tasks, through a rendezvous actor
-   (gloo-equivalent for CPU tensors and control data).
+2. **Host plane (the compatibility path)** — ``allreduce``/``broadcast``/
+   ``send``/``recv``/... on host numpy arrays between actors/tasks: ring
+   algorithms over direct worker-to-worker RPC links (gloo-equivalent for
+   CPU tensors); the rendezvous actor holds membership only.
 """
 
 from ray_tpu.collective.collective import (  # noqa: F401
@@ -22,7 +23,10 @@ from ray_tpu.collective.collective import (  # noqa: F401
     broadcast,
     create_collective_group,
     destroy_collective_group,
+    group_stats,
     init_collective_group,
+    recv,
     reducescatter,
+    send,
 )
 from ray_tpu.collective.rendezvous import bootstrap_jax_distributed  # noqa: F401

@@ -1,99 +1,181 @@
-"""Host-plane collectives between tasks/actors via a rendezvous actor.
+"""Host-plane collectives with a peer-to-peer tensor path.
 
-API shape mirrors the reference's ``ray.util.collective.collective``: members
-join a named group with (world_size, rank), then issue symmetric collective
-calls in program order. The group actor synchronizes round n across all
-ranks (every rank's n-th call is matched — the same program-order contract
-NCCL imposes).
+API shape mirrors the reference's ``ray.util.collective.collective``
+(``collective.py:120-621``): members join a named group with (world_size,
+rank), then issue symmetric collective calls in program order, plus
+point-to-point ``send``/``recv`` (``collective.py:531-621``).
+
+Redesign of the data plane: the named rendezvous actor holds ONLY membership
+(rank -> RPC address) and runs barriers — tensor bytes never pass through it
+(the reference keeps payloads out of the store the same way: NCCL moves them
+directly between ranks). Payloads travel over direct worker-to-worker RPC
+into per-(group, src) FIFO mailboxes; allreduce/reducescatter/allgather run
+as ring algorithms over those links, so per-op traffic is O(bytes) per link
+rather than O(world * bytes) through one actor.
+
+Correctness of message matching relies on the same contract NCCL imposes:
+each rank issues group ops in identical program order, and each (src -> dst)
+link delivers FIFO (single pooled connection, ordered writes).
 """
 
 from __future__ import annotations
 
+import asyncio
 import threading
-from typing import Any, Dict, List, Optional
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
-_REDUCE_OPS = {
-    "sum": lambda arrs: _tree_reduce(arrs, np.add),
-    "prod": lambda arrs: _tree_reduce(arrs, np.multiply),
-    "min": lambda arrs: _tree_reduce(arrs, np.minimum),
-    "max": lambda arrs: _tree_reduce(arrs, np.maximum),
+_REDUCE_NP = {
+    "sum": np.add,
+    "prod": np.multiply,
+    "min": np.minimum,
+    "max": np.maximum,
 }
 
 
-def _tree_reduce(arrs: List[Any], op) -> Any:
-    acc = arrs[0]
-    for a in arrs[1:]:
-        acc = op(acc, a)
-    return acc
-
-
 class _CollectiveGroupActor:
-    """Async rendezvous actor: one instance per group (max_concurrency high
-    so every rank can block in the same round concurrently)."""
+    """Rendezvous actor: membership + barrier. CONTROL PLANE ONLY — no
+    method accepts tensor payloads; ``stats()`` proves it to tests."""
 
     def __init__(self, world_size: int):
-        import asyncio
-
         self.world_size = world_size
-        self._rounds: Dict[int, Dict] = {}
-        self._lock = asyncio.Lock()
+        self.members: Dict[int, str] = {}
+        self._member_event = asyncio.Event()
+        self._barriers: Dict[int, Dict] = {}
+        self._register_calls = 0
 
-    async def op(self, seq: int, rank: int, opname: str, payload, meta):
-        import asyncio
+    async def register(self, rank: int, address: str) -> Dict[int, str]:
+        self._register_calls += 1
+        self.members[rank] = address
+        if len(self.members) == self.world_size:
+            self._member_event.set()
+        await self._member_event.wait()
+        return dict(self.members)
 
-        async with self._lock:
-            rnd = self._rounds.get(seq)
-            if rnd is None:
-                rnd = {"data": {}, "meta": {}, "event": asyncio.Event(),
-                       "result": None}
-                self._rounds[seq] = rnd
-            rnd["data"][rank] = payload
-            rnd["meta"][rank] = meta
-            complete = len(rnd["data"]) == self.world_size
-            if complete:
-                rnd["result"] = self._finish(opname, rnd)
-                rnd["event"].set()
-        if not complete:
-            await rnd["event"].wait()
-        result = rnd["result"]
-        async with self._lock:
-            rnd["meta"].setdefault("_done", set()).add(rank)
-            if len(rnd["meta"]["_done"]) == self.world_size:
-                self._rounds.pop(seq, None)
-        if opname in ("allgather",):
-            return result
-        if opname in ("reducescatter",):
-            return result[rank]
-        return result
+    async def barrier_op(self, seq: int, rank: int) -> None:
+        rnd = self._barriers.get(seq)
+        if rnd is None:
+            rnd = self._barriers[seq] = {"arrived": set(),
+                                         "event": asyncio.Event()}
+        rnd["arrived"].add(rank)
+        if len(rnd["arrived"]) == self.world_size:
+            rnd["event"].set()
+            self._barriers.pop(seq, None)
+        await rnd["event"].wait()
 
-    def _finish(self, opname: str, rnd: Dict):
-        data = [rnd["data"][r] for r in range(self.world_size)]
-        if opname == "barrier":
-            return None
-        if opname == "allreduce":
-            reduce_op = rnd["meta"][0]["op"]
-            return _REDUCE_OPS[reduce_op](data)
-        if opname == "broadcast":
-            src = rnd["meta"][0]["src"]
-            return rnd["data"][src]
-        if opname == "allgather":
-            return data
-        if opname == "reducescatter":
-            reduce_op = rnd["meta"][0]["op"]
-            reduced = _REDUCE_OPS[reduce_op](data)
-            return np.array_split(reduced, self.world_size)
-        raise ValueError(f"unknown collective {opname!r}")
+    async def stats(self) -> Dict[str, int]:
+        return {"register_calls": self._register_calls,
+                "payload_bytes": 0}
+
+
+class _Mailboxes:
+    """Per-process (group, src, dst, channel) -> FIFO of payloads.
+
+    ``dst`` keeps multi-member processes (local mode) from cross-delivering;
+    ``channel`` separates ring-collective traffic from p2p send/recv so a
+    buffered early ``send`` can never be consumed by a later collective's
+    ring step (both are FIFO within a channel)."""
+
+    def __init__(self):
+        self._boxes: Dict[Tuple, deque] = {}
+        self._waiters: Dict[Tuple, deque] = {}
+
+    def deliver(self, key: Tuple, payload) -> None:
+        waiters = self._waiters.get(key)
+        while waiters:
+            fut = waiters.popleft()
+            if not fut.done():
+                fut.set_result(payload)
+                return
+        self._boxes.setdefault(key, deque()).append(payload)
+
+    async def take(self, key: Tuple):
+        box = self._boxes.get(key)
+        if box:
+            return box.popleft()
+        fut = asyncio.get_running_loop().create_future()
+        self._waiters.setdefault(key, deque()).append(fut)
+        return await fut
+
+
+class _RpcPlane:
+    """P2P plane for the cluster backend: mailbox service registered on the
+    worker's existing RpcServer; sends ride the shared connection pool."""
+
+    def __init__(self, backend):
+        self.backend = backend
+        self.address = backend.address
+        self.mail = _Mailboxes()
+        backend.server.register("coll_send", self._rpc_coll_send)
+
+    async def _rpc_coll_send(self, p):
+        self.mail.deliver((p["group"], p["src"], p["dst"], p["ch"]),
+                          p["payload"])
+        return {"ok": True}
+
+    async def send_async(self, dst_addr: str, group: str, src: int, dst: int,
+                         payload, ch: str = "ring") -> None:
+        if dst_addr == self.address:
+            self.mail.deliver((group, src, dst, ch), payload)
+            return
+        client = await self.backend._pool.get(dst_addr)
+        await client.call("coll_send", {"group": group, "src": src,
+                                        "dst": dst, "ch": ch,
+                                        "payload": payload})
+
+    async def recv_async(self, group: str, src: int, dst: int,
+                         ch: str = "ring"):
+        return await self.mail.take((group, src, dst, ch))
+
+    def run(self, coro):
+        return self.backend.io.run(coro)
+
+
+class _ThreadPlane:
+    """Local-mode plane: members are threads of one process sharing a single
+    background loop; 'addresses' are rank markers, delivery is in-memory."""
+
+    _shared = None
+    _shared_lock = threading.Lock()
+
+    def __init__(self):
+        from ray_tpu.cluster.rpc import EventLoopThread
+
+        self.io = EventLoopThread(name="rt-collective-local")
+        self.mail = _Mailboxes()
+        self.address = "local"
+
+    @classmethod
+    def shared(cls) -> "_ThreadPlane":
+        with cls._shared_lock:
+            if cls._shared is None or not cls._shared.io._thread.is_alive():
+                cls._shared = cls()
+            return cls._shared
+
+    async def send_async(self, dst_addr: str, group: str, src: int, dst: int,
+                         payload, ch: str = "ring") -> None:
+        self.mail.deliver((group, src, dst, ch), payload)
+
+    async def recv_async(self, group: str, src: int, dst: int,
+                         ch: str = "ring"):
+        return await self.mail.take((group, src, dst, ch))
+
+    def run(self, coro):
+        return self.io.run(coro)
 
 
 class _GroupHandle:
-    def __init__(self, name: str, world_size: int, rank: int, actor):
+    def __init__(self, name: str, world_size: int, rank: int, actor,
+                 plane, members: Dict[int, str]):
         self.name = name
         self.world_size = world_size
         self.rank = rank
         self.actor = actor
-        self.seq = 0
+        self.plane = plane
+        self.members = members
+        self.barrier_seq = 0
 
 
 _local = threading.local()
@@ -121,12 +203,25 @@ def create_collective_group(world_size: int, group_name: str = "default") -> Non
 def init_collective_group(world_size: int, rank: int,
                           group_name: str = "default") -> None:
     import ray_tpu
+    from ray_tpu.core.worker import global_worker
 
     if not (0 <= rank < world_size):
         raise ValueError(f"rank {rank} out of range for world_size {world_size}")
     create_collective_group(world_size, group_name)
     actor = ray_tpu.get_actor(f"cg:{group_name}", namespace=_NAMESPACE)
-    _groups()[group_name] = _GroupHandle(group_name, world_size, rank, actor)
+
+    backend = global_worker()._require_backend()
+    if hasattr(backend, "server") and hasattr(backend, "io"):
+        plane = getattr(backend, "_collective_plane", None)
+        if plane is None:
+            plane = backend._collective_plane = _RpcPlane(backend)
+        my_addr = plane.address
+    else:  # local/threaded backend: in-process delivery
+        plane = _ThreadPlane.shared()
+        my_addr = f"local:{rank}"
+    members = ray_tpu.get(actor.register.remote(rank, my_addr))
+    _groups()[group_name] = _GroupHandle(group_name, world_size, rank, actor,
+                                         plane, members)
 
 
 def _handle(group_name: str) -> _GroupHandle:
@@ -138,33 +233,164 @@ def _handle(group_name: str) -> _GroupHandle:
     return h
 
 
-def _call(group_name: str, opname: str, payload, meta) -> Any:
-    import ray_tpu
+# ---- ring algorithms (generic over the plane's async send/recv) -------------
 
-    h = _handle(group_name)
-    seq = h.seq
-    h.seq += 1
-    return ray_tpu.get(h.actor.op.remote(seq, h.rank, opname, payload, meta))
+async def _ring_reduce_scatter(h: _GroupHandle, chunks: List[np.ndarray],
+                               op) -> int:
+    """In-place ring reduce-scatter; returns the index this rank owns
+    (fully reduced) at the end: (rank + 1) % W."""
+    W, rank = h.world_size, h.rank
+    right_rank = (rank + 1) % W
+    right = h.members[right_rank]
+    left = (rank - 1) % W
+    for step in range(W - 1):
+        send_idx = (rank - step) % W
+        recv_idx = (rank - step - 1) % W
+        send_fut = asyncio.ensure_future(
+            h.plane.send_async(right, h.name, rank, right_rank,
+                               chunks[send_idx]))
+        incoming = await h.plane.recv_async(h.name, left, rank)
+        chunks[recv_idx] = op(chunks[recv_idx], incoming)
+        await send_fut
+    return (rank + 1) % W
+
+
+async def _ring_allgather_chunks(h: _GroupHandle, chunks: List,
+                                 owned_idx: int) -> None:
+    """Ring allgather: every rank starts owning chunks[owned_idx]; after
+    W-1 steps all entries are filled."""
+    W, rank = h.world_size, h.rank
+    right_rank = (rank + 1) % W
+    right = h.members[right_rank]
+    left = (rank - 1) % W
+    for step in range(W - 1):
+        send_idx = (owned_idx - step) % W
+        recv_idx = (owned_idx - step - 1) % W
+        send_fut = asyncio.ensure_future(
+            h.plane.send_async(right, h.name, rank, right_rank,
+                               chunks[send_idx]))
+        chunks[recv_idx] = await h.plane.recv_async(h.name, left, rank)
+        await send_fut
 
 
 def allreduce(tensor, group_name: str = "default", op: str = "sum"):
-    return _call(group_name, "allreduce", np.asarray(tensor), {"op": op})
+    h = _handle(group_name)
+    arr = np.asarray(tensor)
+    npop = _REDUCE_NP[op]
+    if h.world_size == 1:
+        return arr.copy()
+    flat = arr.ravel()
+    chunks = [c.copy() for c in np.array_split(flat, h.world_size)]
 
+    async def _run():
+        owned = await _ring_reduce_scatter(h, chunks, npop)
+        await _ring_allgather_chunks(h, chunks, owned)
+        return chunks
 
-def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
-    return _call(group_name, "broadcast", np.asarray(tensor), {"src": src_rank})
-
-
-def allgather(tensor, group_name: str = "default") -> List:
-    return _call(group_name, "allgather", np.asarray(tensor), {})
+    out = h.plane.run(_run())
+    return np.concatenate(out).reshape(arr.shape)
 
 
 def reducescatter(tensor, group_name: str = "default", op: str = "sum"):
-    return _call(group_name, "reducescatter", np.asarray(tensor), {"op": op})
+    h = _handle(group_name)
+    arr = np.asarray(tensor)
+    npop = _REDUCE_NP[op]
+    if h.world_size == 1:
+        return arr.copy()
+    chunks = [c.copy() for c in np.array_split(arr, h.world_size)]
+
+    async def _run():
+        owned = await _ring_reduce_scatter(h, chunks, npop)
+        # each rank ends owning chunk (rank+1)%W; one neighbor hop routes
+        # every chunk to its home rank
+        owner = h.members[owned]
+        me = h.rank
+        if owned == me:
+            return chunks[owned]
+        send_fut = asyncio.ensure_future(
+            h.plane.send_async(owner, h.name, me, owned, chunks[owned]))
+        result = await h.plane.recv_async(h.name, (me - 1) % h.world_size, me)
+        await send_fut
+        return result
+
+    return h.plane.run(_run())
+
+
+def allgather(tensor, group_name: str = "default") -> List:
+    h = _handle(group_name)
+    arr = np.asarray(tensor)
+    if h.world_size == 1:
+        return [arr.copy()]
+    parts: List[Optional[np.ndarray]] = [None] * h.world_size
+    parts[h.rank] = arr
+
+    async def _run():
+        await _ring_allgather_chunks(h, parts, h.rank)
+        return parts
+
+    return h.plane.run(_run())
+
+
+def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
+    h = _handle(group_name)
+    arr = np.asarray(tensor)
+    if h.world_size == 1:
+        return arr.copy()
+
+    async def _run():
+        if h.rank == src_rank:
+            await asyncio.gather(*[
+                h.plane.send_async(h.members[r], h.name, h.rank, r, arr)
+                for r in range(h.world_size) if r != src_rank])
+            return arr
+        return await h.plane.recv_async(h.name, src_rank, h.rank)
+
+    return h.plane.run(_run())
+
+
+def send(tensor, dst_rank: int, group_name: str = "default") -> None:
+    """Point-to-point send (reference: ``collective.py:531``). Buffered:
+    completes once the payload is in the receiver's mailbox — the matching
+    ``recv`` may run later."""
+    h = _handle(group_name)
+    if dst_rank == h.rank:
+        raise ValueError("cannot send to self")
+    arr = np.asarray(tensor)
+    h.plane.run(
+        h.plane.send_async(h.members[dst_rank], h.name, h.rank, dst_rank,
+                           arr, ch="p2p"))
+
+
+def recv(tensor, src_rank: int, group_name: str = "default"):
+    """Point-to-point receive into ``tensor`` (in place when possible,
+    reference: ``collective.py:594``); also returns the received array."""
+    h = _handle(group_name)
+    if src_rank == h.rank:
+        raise ValueError("cannot recv from self")
+    got = h.plane.run(
+        h.plane.recv_async(h.name, src_rank, h.rank, ch="p2p"))
+    target = np.asarray(tensor)
+    if target.flags.writeable:
+        np.copyto(target, got)  # shape/dtype mismatch raises — no silent drop
+    return got
 
 
 def barrier(group_name: str = "default") -> None:
-    _call(group_name, "barrier", None, {})
+    import ray_tpu
+
+    h = _handle(group_name)
+    seq = h.barrier_seq
+    h.barrier_seq += 1
+    ray_tpu.get(h.actor.barrier_op.remote(seq, h.rank))
+
+
+def group_stats(group_name: str = "default") -> Dict[str, int]:
+    """Rendezvous-actor traffic counters (control plane only — tests assert
+    payload_bytes stays 0)."""
+    import ray_tpu
+
+    h = _handle(group_name)
+    return ray_tpu.get(h.actor.stats.remote())
 
 
 def destroy_collective_group(group_name: str = "default") -> None:

@@ -1,0 +1,229 @@
+"""``rt`` — the cluster lifecycle CLI.
+
+Reference analog: ``python/ray/scripts/scripts.py`` (``ray start/stop/status``)
+— minus the cloud-provider plumbing (autoscaler handles provisioning).
+Invoked as ``python -m ray_tpu.scripts.cli <cmd>`` (no pip install step).
+
+  rt start --head [--port N] [--num-cpus N] [--num-tpus N]
+  rt start --address=<gcs-host:port>      # join as a worker host
+  rt status
+  rt stop
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional
+
+from ray_tpu._private.config import get_config
+from ray_tpu.cluster import node_main
+
+
+def _list_node_states() -> List[Dict]:
+    out = []
+    d = node_main.state_dir()
+    try:
+        names = os.listdir(d)
+    except FileNotFoundError:
+        return out
+    for name in names:
+        try:
+            with open(os.path.join(d, name)) as f:
+                out.append(json.load(f))
+        except (ValueError, FileNotFoundError):
+            pass
+    return out
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+        return True
+    except (ProcessLookupError, PermissionError):
+        return False
+
+
+def cmd_start(args: argparse.Namespace) -> int:
+    daemon_args = [sys.executable, "-m", "ray_tpu.cluster.node_main"]
+    if args.head:
+        daemon_args += ["--head", "--host", args.host, "--port",
+                        str(args.port)]
+        if args.session_name:
+            daemon_args += ["--session-name", args.session_name]
+    else:
+        daemon_args += ["--address", args.address]
+    if args.num_cpus is not None:
+        daemon_args += ["--num-cpus", str(args.num_cpus)]
+    if args.num_tpus is not None:
+        daemon_args += ["--num-tpus", str(args.num_tpus)]
+    if args.resources:
+        daemon_args += ["--resources", args.resources]
+
+    log_dir = os.path.join(get_config().session_dir_root, "logs")
+    os.makedirs(log_dir, exist_ok=True)
+    log_path = os.path.join(log_dir, f"node-{int(time.time())}-{os.getpid()}.log")
+    log_file = open(log_path, "ab")
+    proc = subprocess.Popen(
+        daemon_args, stdout=subprocess.PIPE, stderr=log_file,
+        start_new_session=True)  # detach: survives this CLI process
+    log_file.close()
+
+    # Block until the daemon prints its ready line (or dies).
+    deadline = time.monotonic() + args.timeout
+    state = None
+    while time.monotonic() < deadline:
+        line = proc.stdout.readline().decode()
+        if not line:
+            break
+        if line.startswith("RT_NODE_READY "):
+            state = json.loads(line[len("RT_NODE_READY "):])
+            break
+    if state is None:
+        rc = proc.poll()
+        print(f"rt start: node daemon failed to come up "
+              f"(rc={rc}); log: {log_path}", file=sys.stderr)
+        return 1
+    role = "head" if state["head"] else "worker"
+    print(f"started {role} node {state['node_id'][:8]} pid={state['pid']}")
+    print(f"  gcs_address:    {state['gcs_address']}")
+    print(f"  raylet_address: {state['raylet_address']}")
+    print(f"  session:        {state['session_name']}")
+    if state["head"]:
+        print(f"\njoin another host with:\n"
+              f"  rt start --address={state['gcs_address']}\n"
+              f"attach a driver with:\n"
+              f"  ray_tpu.init(address=\"{state['gcs_address']}\")")
+    return 0
+
+
+def cmd_stop(args: argparse.Namespace) -> int:
+    states = _list_node_states()
+    if not states:
+        print("no running nodes found")
+        return 0
+    # workers first, head last — workers need the GCS to deregister
+    states.sort(key=lambda s: s["head"])
+    stopped = 0
+    for st in states:
+        pid = st["pid"]
+        if not _pid_alive(pid):
+            _cleanup_state(st)
+            continue
+        try:
+            os.kill(pid, signal.SIGTERM)
+            stopped += 1
+        except ProcessLookupError:
+            pass
+    deadline = time.monotonic() + args.timeout
+    while time.monotonic() < deadline:
+        if not any(_pid_alive(s["pid"]) for s in states):
+            break
+        time.sleep(0.1)
+    for st in states:
+        if args.force and _pid_alive(st["pid"]):
+            try:
+                os.kill(st["pid"], signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+        _cleanup_state(st)
+    print(f"stopped {stopped} node(s)")
+    return 0
+
+
+def _cleanup_state(st: Dict) -> None:
+    for path in (os.path.join(node_main.state_dir(), f"{st['node_id']}.json"),):
+        try:
+            os.unlink(path)
+        except FileNotFoundError:
+            pass
+    if st.get("head"):
+        latest = node_main.read_session_latest()
+        if latest and latest.get("node_id") == st["node_id"]:
+            try:
+                os.unlink(node_main.session_latest_path())
+            except FileNotFoundError:
+                pass
+
+
+def _gcs_call(address: str, method: str, payload: Dict) -> Dict:
+    from ray_tpu.cluster.rpc import RpcClient
+
+    async def _go():
+        client = RpcClient(address, peer_id="rt-cli")
+        await client.connect()
+        try:
+            return await client.call(method, payload, timeout=10.0)
+        finally:
+            await client.close()
+
+    return asyncio.run(_go())
+
+
+def _resolve_gcs(address: Optional[str]) -> Optional[str]:
+    if address and address not in ("auto",):
+        return address
+    latest = node_main.read_session_latest()
+    return latest["gcs_address"] if latest else None
+
+
+def cmd_status(args: argparse.Namespace) -> int:
+    gcs = _resolve_gcs(args.address)
+    if gcs is None:
+        print("no running cluster found (no session_latest.json; "
+              "pass --address)", file=sys.stderr)
+        return 1
+    try:
+        nodes = _gcs_call(gcs, "list_nodes", {})
+    except Exception as e:
+        print(f"cannot reach GCS at {gcs}: {e!r}", file=sys.stderr)
+        return 1
+    print(f"cluster at {gcs}: {sum(n['alive'] for n in nodes)} alive / "
+          f"{len(nodes)} total nodes")
+    for n in nodes:
+        state = "ALIVE" if n["alive"] else "DEAD"
+        role = n.get("labels", {}).get("node_role", "worker")
+        print(f"  {n['node_id'][:8]} {state:5} {role:6} {n['address']:>21} "
+              f"total={n['resources']} available={n['available']}")
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="rt")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p_start = sub.add_parser("start", help="start a head or worker node")
+    p_start.add_argument("--head", action="store_true")
+    p_start.add_argument("--address", default=None)
+    p_start.add_argument("--host", default="127.0.0.1")
+    p_start.add_argument("--port", type=int, default=0)
+    p_start.add_argument("--num-cpus", type=float, default=None)
+    p_start.add_argument("--num-tpus", type=float, default=None)
+    p_start.add_argument("--resources", default=None)
+    p_start.add_argument("--session-name", default=None)
+    p_start.add_argument("--timeout", type=float, default=30.0)
+    p_start.set_defaults(fn=cmd_start)
+
+    p_stop = sub.add_parser("stop", help="stop all nodes on this machine")
+    p_stop.add_argument("--force", action="store_true")
+    p_stop.add_argument("--timeout", type=float, default=10.0)
+    p_stop.set_defaults(fn=cmd_stop)
+
+    p_status = sub.add_parser("status", help="show cluster nodes")
+    p_status.add_argument("--address", default=None)
+    p_status.set_defaults(fn=cmd_status)
+
+    args = parser.parse_args(argv)
+    if args.cmd == "start" and not args.head and not args.address:
+        parser.error("rt start needs --head or --address=<gcs>")
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -54,6 +54,76 @@ def test_collective_multiple_rounds(rt_cluster):
     assert results == [30.0, 30.0, 30.0]
 
 
+def test_send_recv_p2p(rt_cluster):
+    """Point-to-point send/recv between worker processes (reference:
+    collective.py:531-621)."""
+    def member(rank, world):
+        import numpy as np
+
+        from ray_tpu import collective as col
+
+        col.init_collective_group(world, rank, "p2p")
+        if rank == 0:
+            col.send(np.arange(8.0), dst_rank=1, group_name="p2p")
+            out = np.zeros(4)
+            col.recv(out, src_rank=1, group_name="p2p")
+            return out.tolist()
+        col.send(np.full(4, 7.0), dst_rank=0, group_name="p2p")
+        buf = np.zeros(8)
+        col.recv(buf, src_rank=0, group_name="p2p")
+        return buf.tolist()
+
+    m = ray_tpu.remote(member)
+    r0, r1 = ray_tpu.get([m.remote(0, 2), m.remote(1, 2)], timeout=120)
+    assert r0 == [7.0] * 4
+    assert r1 == list(range(8))
+
+
+def test_payloads_never_traverse_rendezvous_actor(rt_cluster):
+    """The rendezvous actor is control-plane only: after a full round of
+    collectives its payload byte counter must be zero (tensor bytes moved
+    over direct worker-to-worker RPC)."""
+    def member(rank, world):
+        import numpy as np
+
+        from ray_tpu import collective as col
+
+        col.init_collective_group(world, rank, "ctl")
+        col.allreduce(np.ones(1024), "ctl")
+        col.allgather(np.ones(16), "ctl")
+        col.broadcast(np.ones(16), 0, "ctl")
+        col.reducescatter(np.ones(16), "ctl")
+        col.barrier("ctl")
+        return col.group_stats("ctl")
+
+    m = ray_tpu.remote(member)
+    stats = ray_tpu.get([m.remote(r, 2) for r in range(2)], timeout=120)
+    for s in stats:
+        assert s["payload_bytes"] == 0
+        assert s["register_calls"] == 2
+
+
+def test_collective_three_rank_ring(rt_cluster):
+    """Ring algorithms with W=3 and a non-divisible tensor length."""
+    def member(rank, world):
+        import numpy as np
+
+        from ray_tpu import collective as col
+
+        col.init_collective_group(world, rank, "ring3")
+        ar = col.allreduce(np.arange(7.0) + rank, "ring3")
+        rs = col.reducescatter(np.arange(7.0), "ring3")
+        return ar.tolist(), rs.tolist()
+
+    m = ray_tpu.remote(member)
+    results = ray_tpu.get([m.remote(r, 3) for r in range(3)], timeout=120)
+    expected_ar = (np.arange(7.0) * 3 + 3).tolist()  # sum over ranks
+    splits = [s.tolist() for s in np.array_split(np.arange(7.0) * 3, 3)]
+    for r, (ar, rs) in enumerate(results):
+        assert ar == expected_ar
+        assert rs == splits[r]
+
+
 def test_collective_rank_validation(rt_local):
     from ray_tpu import collective as col
 
