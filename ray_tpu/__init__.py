@@ -11,6 +11,7 @@ from ray_tpu._private.ids import ActorID, JobID, NodeID, ObjectID, TaskID  # noq
 from ray_tpu.core.actor import ActorClass, ActorHandle, method  # noqa: F401
 from ray_tpu.core.api import RemoteFunction, remote  # noqa: F401
 from ray_tpu.core.object_ref import ObjectRef  # noqa: F401
+from ray_tpu.cluster.worker_core import ObjectRefGenerator  # noqa: F401
 from ray_tpu.core.worker import (  # noqa: F401
     global_worker,
     init,

@@ -34,11 +34,18 @@ class Config:
 
     # ---- session / process tree -------------------------------------------
     session_dir_root: str = "/tmp/ray_tpu"
+    # Interface every RPC server binds ("" = loopback). Multi-host clusters
+    # set 0.0.0.0 (rt start --host); servers then advertise the machine's
+    # outbound IP so cross-host peers dial a reachable address.
+    bind_host: str = ""
     head_port: int = 0  # 0 = pick a free port
     node_manager_port: int = 0
     num_workers_soft_limit: int = 0  # 0 = num_cpus of the node
     worker_register_timeout_s: float = 30.0
     process_startup_timeout_s: float = 30.0
+    # Extra startup budget for workers that must materialize a runtime env
+    # before announcing ready (pip installs can dwarf plain process spawn).
+    runtime_env_setup_timeout_s: float = 600.0
     graceful_shutdown_timeout_s: float = 5.0
 
     # ---- scheduling --------------------------------------------------------

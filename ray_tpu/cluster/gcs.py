@@ -620,6 +620,38 @@ class GcsServer:
         return [e.info() for e in self.placement_groups.values()]
 
     # ---- task routing (spillback target selection) -------------------------
+    # ---- task events (reference: GcsTaskManager, gcs_task_manager.h:61 —
+    # a bounded in-memory event store behind the State API) -----------------
+    _TASK_EVENTS_CAP = 10000
+
+    async def rpc_task_event(self, p):
+        if not hasattr(self, "task_events"):
+            from collections import OrderedDict
+
+            self.task_events: "OrderedDict[str, Dict]" = OrderedDict()
+        ev = self.task_events.pop(p["task_id"], None) or {}
+        ev.update({"task_id": p["task_id"], "name": p.get("name", ev.get("name")),
+                   "state": p["state"], "node_id": p.get("node_id"),
+                   "updated_at": time.time()})
+        self.task_events[p["task_id"]] = ev
+        while len(self.task_events) > self._TASK_EVENTS_CAP:
+            self.task_events.popitem(last=False)
+        return {"ok": True}
+
+    async def rpc_list_tasks(self, p):
+        events = list(getattr(self, "task_events", {}).values())
+        limit = p.get("limit") or 1000
+        return events[-limit:]
+
+    async def rpc_list_objects(self, p):
+        limit = p.get("limit") or 1000
+        out = []
+        for oid, locs in list(self.object_locations.items())[:limit]:
+            out.append({"object_id": oid,
+                        "size": self.object_sizes.get(oid, 0),
+                        "locations": sorted(locs)})
+        return out
+
     async def rpc_route_task(self, p):
         req = ResourceSet(p["resources"])
         exclude = set(p.get("exclude") or ())

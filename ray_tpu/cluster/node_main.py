@@ -67,12 +67,18 @@ async def _amain(args: argparse.Namespace) -> None:
     for sig in (signal.SIGTERM, signal.SIGINT):
         loop.add_signal_handler(sig, stop_ev.set)
 
+    # Bind interface for EVERY server this node tree runs (GCS, raylet,
+    # workers via RT_CONFIG_JSON): rt start --host 0.0.0.0 makes them all
+    # reachable cross-host, advertising the outbound IP.
+    if args.host and args.host != "127.0.0.1":
+        get_config().bind_host = args.host
+
     gcs = gcs_server = None
     session_name = args.session_name
     gcs_address = args.address
     if args.head:
         gcs = GcsServer()
-        gcs_server = RpcServer(loop, host=args.host)
+        gcs_server = RpcServer(loop)
         gcs_server.register_object(gcs)
         await gcs_server.start(args.port)
         gcs.start_monitor()

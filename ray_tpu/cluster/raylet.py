@@ -170,7 +170,10 @@ class Raylet:
                 self._dispatch_event.set()
 
     # ---- worker pool --------------------------------------------------------
-    def _spawn_worker(self, key: Tuple, chips: List[int]) -> _WorkerEntry:
+    def _spawn_worker(self, key: Tuple, chips: List[int],
+                      runtime_env: Optional[Dict] = None) -> _WorkerEntry:
+        import json
+
         worker_id = os.urandom(8).hex()
         env = dict(os.environ)
         env["RT_WORKER_ID"] = worker_id
@@ -179,6 +182,8 @@ class Raylet:
         env["RT_NODE_ID"] = self.node_id
         env["RT_SESSION_NAME"] = self.session_name
         env["RT_CONFIG_JSON"] = get_config().to_json()
+        if runtime_env:
+            env["RT_RUNTIME_ENV_JSON"] = json.dumps(runtime_env)
         if chips:
             env[get_config().tpu_visible_chips_env] = ",".join(map(str, chips))
         log_dir = os.path.join(get_config().session_dir_root,
@@ -203,16 +208,19 @@ class Raylet:
             entry.ready.set_result(True)
         return {"ok": True, "node_id": self.node_id}
 
-    async def _get_worker(self, key: Tuple, chips: List[int]) -> _WorkerEntry:
+    async def _get_worker(self, key: Tuple, chips: List[int],
+                          runtime_env: Optional[Dict] = None) -> _WorkerEntry:
         idle = self._idle.get(key)
         while idle:
             entry = idle.pop()
             if entry.proc.poll() is None:
                 return entry
             self._workers.pop(entry.worker_id, None)
-        entry = self._spawn_worker(key, chips)
-        await asyncio.wait_for(entry.ready,
-                               get_config().process_startup_timeout_s)
+        entry = self._spawn_worker(key, chips, runtime_env)
+        cfg = get_config()
+        timeout = cfg.process_startup_timeout_s + (
+            cfg.runtime_env_setup_timeout_s if runtime_env else 0)
+        await asyncio.wait_for(entry.ready, timeout)
         return entry
 
     def _release_worker(self, entry: _WorkerEntry) -> None:
@@ -291,8 +299,23 @@ class Raylet:
             return await asyncio.shield(fut)
         self._queue.append({"payload": p, "future": fut,
                             "t": time.monotonic(), "spilling": False})
+        self._task_event(task_id, p.get("fn_name"), "PENDING")
         self._dispatch_event.set()
         return await asyncio.shield(fut)
+
+    def _task_event(self, task_id: str, name, state: str) -> None:
+        """Fire-and-forget state event to the GCS task store (reference:
+        TaskEventBuffer -> GcsTaskManager); observability only, never blocks
+        or fails the task path."""
+        async def _send():
+            try:
+                await self._gcs.call("task_event", {
+                    "task_id": task_id, "name": name, "state": state,
+                    "node_id": self.node_id})
+            except Exception:
+                pass
+
+        asyncio.ensure_future(_send())
 
     async def _spill(self, p):
         """Route an infeasible task through the GCS to a node that fits
@@ -405,19 +428,29 @@ class Raylet:
         payload, fut = item["payload"], item["future"]
         task_id = payload["task_id"]
         chips = assignment.get(TPU, [])
-        key = (tuple(chips),)
+        renv = payload.get("runtime_env")
+        # worker reuse is keyed by (chip set, env hash): a process prepared
+        # for one runtime env never executes another env's tasks (reference:
+        # WorkerPool cache keyed by runtime-env hash)
+        key = (tuple(chips), renv["hash"] if renv else None)
         self._inflight[task_id] = {"req": req, "released": ResourceSet(),
                                    "pool": pool}
         try:
-            worker = await self._get_worker(key, chips)
+            worker = await self._get_worker(key, chips, renv)
             worker.busy = True
+            self._task_event(task_id, payload.get("fn_name"), "RUNNING")
             try:
                 reply = await worker.client.call("push_task", payload)
             finally:
                 self._release_worker(worker)
+            failed = (reply.get("error")
+                      or reply.get("stream_error") is not None)
+            self._task_event(task_id, payload.get("fn_name"),
+                             "FAILED" if failed else "FINISHED")
             if not fut.done():
                 fut.set_result(reply)
         except Exception as e:  # worker crashed mid-task or failed to start
+            self._task_event(task_id, payload.get("fn_name"), "FAILED")
             if not fut.done():
                 fut.set_result({"error": "worker_crashed", "message": repr(e)})
         finally:
@@ -491,14 +524,19 @@ class Raylet:
         chips = assignment.get(TPU, [])
         worker = None
         try:
-            worker = self._spawn_worker((("actor", p["actor_id"]),), chips)
+            worker = self._spawn_worker((("actor", p["actor_id"]),), chips,
+                                        spec.get("runtime_env"))
             worker.is_actor_worker = True
             worker.actor_id = p["actor_id"]
             worker.assignment = assignment
             worker._spec_resources = spec.get("resources", {})
             worker._pool = pool
-            await asyncio.wait_for(worker.ready,
-                                   get_config().process_startup_timeout_s)
+            cfg = get_config()
+            await asyncio.wait_for(
+                worker.ready,
+                cfg.process_startup_timeout_s
+                + (cfg.runtime_env_setup_timeout_s
+                   if spec.get("runtime_env") else 0))
             reply = await worker.client.call("create_actor", p)
             if not reply.get("ok"):
                 # Unmark before releasing so _reap_loop doesn't release the

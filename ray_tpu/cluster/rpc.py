@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import asyncio
 import pickle
+import socket
 import struct
 import threading
 from typing import Any, Callable, Dict, Optional, Tuple
@@ -50,6 +51,35 @@ class ConnectionLost(RpcError):
     pass
 
 
+def bind_host() -> str:
+    """The interface servers bind (config ``bind_host``; default loopback).
+    Set RT_BIND_HOST=0.0.0.0 on multi-host clusters."""
+    from ray_tpu._private.config import get_config
+
+    return get_config().bind_host or "127.0.0.1"
+
+
+def advertised_host(bind: str) -> str:
+    """The address peers should dial for a server bound to ``bind``:
+    a wildcard bind advertises this machine's outbound-interface IP
+    (UDP connect probe — no packet is sent)."""
+    if bind in ("", "127.0.0.1", "localhost"):
+        return "127.0.0.1"
+    if bind in ("0.0.0.0", "::"):
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        try:
+            s.connect(("8.8.8.8", 80))
+            return s.getsockname()[0]
+        except OSError:
+            try:
+                return socket.gethostbyname(socket.gethostname())
+            except OSError:
+                return "127.0.0.1"
+        finally:
+            s.close()
+    return bind
+
+
 async def _read_frame(reader: asyncio.StreamReader) -> Any:
     header = await reader.readexactly(_LEN.size)
     (n,) = _LEN.unpack(header)
@@ -71,9 +101,11 @@ class RpcServer:
     arbitrarily long; other requests on the same connection are not blocked.
     """
 
-    def __init__(self, loop: asyncio.AbstractEventLoop, host: str = "127.0.0.1"):
+    def __init__(self, loop: asyncio.AbstractEventLoop,
+                 host: Optional[str] = None):
         self._loop = loop
-        self._host = host
+        self._host = host if host is not None else bind_host()
+        self._advertise = advertised_host(self._host)
         self._handlers: Dict[str, Callable] = {}
         self._server: Optional[asyncio.AbstractServer] = None
         self._on_disconnect: Optional[Callable] = None
@@ -100,7 +132,7 @@ class RpcServer:
 
     @property
     def address(self) -> str:
-        return f"{self._host}:{self.port}"
+        return f"{self._advertise}:{self.port}"
 
     async def _handle_conn(self, reader: asyncio.StreamReader,
                            writer: asyncio.StreamWriter) -> None:

@@ -106,6 +106,91 @@ class _MemoryStore:
             self._data.pop(oid_hex, None)
 
 
+class _StreamState:
+    """Owner-side state of one streaming-generator task (reference:
+    ``ObjectRefStream``, ``core_worker/task_manager.h:96``)."""
+
+    def __init__(self, task_id_hex: str, owner_address: str,
+                 max_buffer: int, loop: asyncio.AbstractEventLoop):
+        self.task_id_hex = task_id_hex
+        self.owner_address = owner_address
+        self.max_buffer = max_buffer
+        self.produced = 0
+        self.consumed = 0
+        self.done = False
+        self.closed = False                    # consumer abandoned the stream
+        self.error_payload: Optional[bytes] = None
+        self._event = asyncio.Event()          # new item / done (loop-affine)
+        self._space = asyncio.Event()          # consumer caught up
+        self._space.set()
+        self.loop = loop
+
+    def notify(self) -> None:
+        self._event.set()
+        if (self.done or self.closed
+                or self.produced - self.consumed <= self.max_buffer):
+            self._space.set()  # done/closed also frees a blocked producer ack
+        else:
+            self._space.clear()
+
+
+class ObjectRefGenerator:
+    """Iterator of ObjectRefs for ``num_returns="streaming"`` tasks
+    (reference: ``StreamingObjectRefGenerator``, ``_raylet.pyx:267``).
+    Yields per-item refs in production order; iteration ends when the
+    generator task completes. Consuming an item releases backpressure."""
+
+    def __init__(self, backend: "ClusterBackend", state: _StreamState):
+        self._backend = backend
+        self._state = state
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> ObjectRef:
+        st = self._state
+
+        async def _wait_next():
+            while True:
+                if st.consumed < st.produced:
+                    idx = st.consumed
+                    st.consumed += 1
+                    st.notify()
+                    return idx
+                if st.done or st.closed:
+                    return None
+                st._event.clear()
+                await st._event.wait()
+
+        idx = self._backend.io.run(_wait_next())
+        if idx is None:
+            raise StopIteration
+        task_id = TaskID.from_hex(st.task_id_hex)
+        return ObjectRef(ObjectID.for_return(task_id, idx),
+                         owner=st.owner_address)
+
+    def completed(self) -> bool:
+        return self._state.done and self._state.consumed >= self._state.produced
+
+    def close(self) -> None:
+        """Abandon the stream: releases the producer's backpressure ack so
+        the executor worker stops instead of blocking forever, and drops the
+        owner-side stream state. Called automatically on GC."""
+        st = self._state
+        if st.closed:
+            return
+        st.closed = True
+        self._backend._streams.pop(st.task_id_hex, None)
+        self._backend.loop.call_soon_threadsafe(st.notify)
+
+    def __del__(self):
+        try:
+            if not self.completed():
+                self.close()
+        except Exception:  # noqa: BLE001 — interpreter teardown
+            pass
+
+
 class _ActorConn:
     """Ordered submission pipe to one actor (per-caller FIFO)."""
 
@@ -136,6 +221,9 @@ class ClusterBackend(RuntimeBackend):
         self.memory_store = _MemoryStore(self.loop)
         self.server = RpcServer(self.loop)
         self.server.register("get_object", self._rpc_get_object)
+        self.server.register("stream_item", self._rpc_stream_item)
+        # task_id_hex -> _StreamState for in-flight streaming generators
+        self._streams: Dict[str, _StreamState] = {}
         self._pool = ConnectionPool(peer_id=f"{role}:{job_id.hex()}")
         self._gcs: Optional[RpcClient] = None
         self._raylet: Optional[RpcClient] = None
@@ -157,6 +245,8 @@ class ClusterBackend(RuntimeBackend):
         # Tombstones for explicitly freed objects we own: lets a borrower's
         # get fail fast instead of waiting out the directory timeout.
         self._freed: Dict[str, None] = {}
+        # runtime_env json -> prepared wire form (working_dir uploaded once)
+        self._prepared_envs: Dict[str, Optional[Dict]] = {}
 
     # ---- bootstrap ----------------------------------------------------------
     def connect(self) -> None:
@@ -435,6 +525,30 @@ class ClusterBackend(RuntimeBackend):
             return {"in_plasma": True}
         return {"not_found": True}
 
+    async def _rpc_stream_item(self, p):
+        """Executor pushes one generator item (reference: item reporting,
+        ``_raylet.pyx:1090``). Inline payloads land in our memory store;
+        plasma items were already sealed node-side. The ack is withheld
+        while the consumer lags more than max_buffer items — the executor
+        awaits it before producing the next item, which IS the backpressure."""
+        st = self._streams.get(p["task_id"])
+        if st is None:
+            return {"ok": False, "gone": True}  # stream cancelled/unknown
+        task_id = TaskID.from_hex(p["task_id"])
+        idx = p["index"]
+        oid_hex = ObjectID.for_return(task_id, idx).hex()
+        if "payload" in p:
+            self.memory_store.put(oid_hex, p["payload"])
+        st.produced = max(st.produced, idx + 1)
+        st.notify()
+        while (st.produced - st.consumed > st.max_buffer
+               and not st.done and not st.closed):
+            st._space.clear()
+            await st._space.wait()
+        if st.closed:
+            return {"ok": False, "gone": True}  # tell the producer to stop
+        return {"ok": True}
+
     def free_objects(self, refs: Sequence[ObjectRef]) -> None:
         for r in refs:
             self.memory_store.delete(r.hex())
@@ -475,6 +589,22 @@ class ClusterBackend(RuntimeBackend):
             self._fn_cache[fid] = fn
         return fn
 
+    def _prepare_env(self, options) -> Optional[Dict]:
+        """Normalize/upload a runtime_env once per distinct content
+        (reference: ``_private/runtime_env/packaging.py`` upload path)."""
+        env = options.get("runtime_env")
+        if not env:
+            return None
+        import json as _json
+
+        from ray_tpu.runtime_env import prepare_runtime_env
+
+        cache_key = _json.dumps(env, sort_keys=True, default=str)
+        if cache_key not in self._prepared_envs:
+            self._prepared_envs[cache_key] = prepare_runtime_env(
+                env, self.kv_put, self.kv_get)
+        return self._prepared_envs[cache_key]
+
     @staticmethod
     def _normalize_strategy(options) -> Tuple[Any, Optional[Dict]]:
         """Returns (strategy_spec, pg_info) from the options surface, which
@@ -506,6 +636,9 @@ class ClusterBackend(RuntimeBackend):
         strategy, pg_info = self._normalize_strategy(options)
         fid = self._export("fn", fn)
         task_id = TaskID.for_task(self.job_id)
+        if num_returns == "streaming":
+            return self._submit_streaming(fn, options, args, kwargs, req,
+                                          strategy, pg_info, fid, task_id)
         refs = [ObjectRef(ObjectID.for_return(task_id, i), owner=self.address)
                 for i in range(num_returns)]
         for r in refs:
@@ -524,9 +657,80 @@ class ClusterBackend(RuntimeBackend):
             "owner": self.address,
             "max_retries": options.get("max_retries",
                                        get_config().task_max_retries_default),
+            "runtime_env": self._prepare_env(options),
         }
         self.io.spawn(self._submit_and_collect(payload, refs))
         return refs[0] if num_returns == 1 else refs
+
+    def _submit_streaming(self, fn, options, args, kwargs, req, strategy,
+                          pg_info, fid, task_id) -> "ObjectRefGenerator":
+        """Streaming-generator submission (``num_returns="streaming"``,
+        reference: ``remote_function.py:333`` + ``task_manager.h:96``)."""
+        state = _StreamState(task_id.hex(), self.address,
+                             max_buffer=options.get("_stream_max_buffer", 16),
+                             loop=self.loop)
+        self._streams[task_id.hex()] = state
+        payload = {
+            "task_id": task_id.hex(),
+            "job_id": self.job_id.hex(),
+            "fn_id": fid,
+            "fn_name": getattr(fn, "__name__", "anonymous"),
+            "args": [self._serialize_arg(a) for a in args],
+            "kwargs": {k: self._serialize_arg(v) for k, v in kwargs.items()},
+            "num_returns": "streaming",
+            "resources": req.to_dict(),
+            "strategy": strategy,
+            "pg": pg_info,
+            "owner": self.address,
+            "max_retries": 0,  # raylet-side dedup off; owner drives retries
+            "runtime_env": self._prepare_env(options),
+        }
+
+        async def _run():
+            # A stream that produced NOTHING yet is safe to retry whole
+            # (transient worker-spawn failures under load); once items have
+            # been consumed, a partial stream must not silently re-run.
+            retries = get_config().task_max_retries_default
+            while True:
+                try:
+                    target = self._raylet
+                    if payload.get("pg") is not None:
+                        target = await self._pg_bundle_raylet(payload["pg"])
+                    reply = await target.call("submit_task", payload)
+                except Exception as e:
+                    reply = {"error": "submit_failed", "message": repr(e)}
+                if (reply.get("error") in ("worker_crashed", "bundle_gone",
+                                           "submit_failed")
+                        and state.produced == 0 and not state.closed
+                        and retries > 0):
+                    retries -= 1
+                    continue
+                break
+            if reply.get("error"):
+                err = WorkerCrashedError(
+                    f"streaming task {payload['fn_name']} failed: "
+                    f"{reply.get('message', reply['error'])}")
+                blob = self.serde.serialize(err).to_bytes()
+                idx = state.produced
+                self.memory_store.put(
+                    ObjectID.for_return(task_id, idx).hex(), blob)
+                state.produced = idx + 1
+            elif reply.get("stream_error") is not None:
+                idx = state.produced
+                self.memory_store.put(
+                    ObjectID.for_return(task_id, idx).hex(),
+                    reply["stream_error"])
+                state.produced = idx + 1
+            state.done = True
+            state.notify()
+            # state is kept for iteration; dropped when consumed or replaced
+            if len(self._streams) > 1024:
+                for k in [k for k, s in self._streams.items()
+                          if s.done and s.consumed >= s.produced][:512]:
+                    self._streams.pop(k, None)
+
+        self.io.spawn(_run())
+        return ObjectRefGenerator(self, state)
 
     async def _submit_and_collect(self, payload, refs: List[ObjectRef]) -> None:
         retries = payload.get("max_retries", 0)
@@ -624,6 +828,7 @@ class ClusterBackend(RuntimeBackend):
             "pg": pg_info,
             "method_meta": method_meta,
             "owner": self.address,
+            "runtime_env": self._prepare_env(options),
         }
         reply = self.io.run(self._gcs.call("register_actor", {"spec": spec}))
         if reply.get("error"):

@@ -53,6 +53,7 @@ class WorkerProcess:
         from ray_tpu.core.worker import global_worker
 
         self.backend.connect()
+        self._materialize_runtime_env()
         srv = self.backend.server
         srv.register("push_task", self.rpc_push_task)
         srv.register("create_actor", self.rpc_create_actor)
@@ -64,6 +65,23 @@ class WorkerProcess:
             "address": self.backend.server.address}))
         # Exit when the raylet goes away.
         self.backend.io.spawn(self._watch_raylet())
+
+    def _materialize_runtime_env(self) -> None:
+        """Make the assigned runtime env live BEFORE user code can run
+        (reference: the runtime-env agent prepares, ``context.py`` applies;
+        here the keyed-by-env worker does both at startup)."""
+        wire_json = os.environ.get("RT_RUNTIME_ENV_JSON")
+        if not wire_json:
+            return
+        import json
+
+        from ray_tpu.runtime_env import materialize
+
+        wire = json.loads(wire_json)
+        cache_root = os.path.join(get_config().session_dir_root,
+                                  os.environ["RT_SESSION_NAME"],
+                                  "runtime_env")
+        materialize(wire, self.backend.kv_get, cache_root)
 
     async def _watch_raylet(self) -> None:
         while True:
@@ -140,21 +158,69 @@ class WorkerProcess:
         worker.job_id = self.backend.job_id
         token = worker.enter_task_context(task_id)
         self.backend._current_task_id = p["task_id"]
+        streaming = p["num_returns"] == "streaming"
         try:
             fn = self.backend.load_function(p["fn_id"])
             args, kwargs = self._resolve_args(p["args"], p["kwargs"])
             result = fn(*args, **kwargs)
+            if streaming:
+                return self._stream_results(result, task_id, p)
             returns = self._pack_returns(result, task_id, p["num_returns"])
             return {"returns": returns}
         except TaskError as e:
+            if streaming:
+                return {"streaming_done": 0,
+                        "stream_error": self.backend.serde.serialize(e).to_bytes()}
             return {"returns": self._error_returns(e, p["num_returns"])}
         except BaseException as e:  # noqa: BLE001
             traceback.print_exc()
-            return {"returns": self._error_returns(
-                TaskError(p["fn_name"], e), p["num_returns"])}
+            err = TaskError(p["fn_name"], e)
+            if streaming:
+                return {"streaming_done": 0,
+                        "stream_error": self.backend.serde.serialize(err).to_bytes()}
+            return {"returns": self._error_returns(err, p["num_returns"])}
         finally:
             self.backend._current_task_id = None
             worker.exit_task_context(token)
+
+    def _stream_results(self, result, task_id: TaskID, p) -> Dict:
+        """Drive a generator task: push each item to the OWNER as produced
+        (reference: item reporting ``_raylet.pyx:1090``). The owner's ack is
+        awaited per item — the owner withholds it while its consumer lags,
+        which is the backpressure. Small items ride the RPC; large go to
+        plasma with only the notification inline."""
+        it = iter(result)
+        small_limit = get_config().max_direct_call_object_size
+        owner = p["owner"]
+
+        async def _send(msg):
+            client = await self.backend._pool.get(owner)
+            return await client.call("stream_item", msg)
+
+        i = 0
+        while True:
+            try:
+                v = next(it)
+            except StopIteration:
+                return {"streaming_done": i}
+            except BaseException as e:  # noqa: BLE001
+                traceback.print_exc()
+                err = TaskError(p["fn_name"], e)
+                return {"streaming_done": i,
+                        "stream_error": self.backend.serde.serialize(err).to_bytes()}
+            payload = self.backend.serde.serialize(v).to_bytes()
+            msg = {"task_id": p["task_id"], "index": i}
+            if len(payload) > small_limit:
+                oid = ObjectID.for_return(task_id, i)
+                self.backend.plasma.write_whole(oid, payload)
+                self.backend.io.run(self.backend._raylet.call(
+                    "seal_object", {"oid": oid.hex(), "size": len(payload)}))
+            else:
+                msg["payload"] = payload
+            ack = self.backend.io.run(_send(msg))
+            if ack.get("gone"):
+                return {"streaming_done": i}  # consumer went away: stop
+            i += 1
 
     # ---- actors -------------------------------------------------------------
     async def rpc_create_actor(self, p):

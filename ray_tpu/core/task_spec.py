@@ -50,7 +50,7 @@ _VALID_OPTIONS = {
     "num_returns", "scheduling_strategy", "placement_group",
     "placement_group_bundle_index", "max_concurrency", "runtime_env",
     "namespace", "get_if_exists", "max_pending_calls", "retry_exceptions",
-    "concurrency_groups", "label_selector",
+    "concurrency_groups", "label_selector", "_stream_max_buffer",
 }
 
 

@@ -29,9 +29,19 @@ from ray_tpu.data.iterator import (
 )
 
 
-@ray_tpu.remote
-def _read_task(task) -> B.Block:
-    return task()
+@ray_tpu.remote(num_returns="streaming")
+def _read_task_stream(task):
+    """Streaming read: a thunk returning a generator yields one ref per
+    sub-block (e.g. per parquet row group) so downstream stages start before
+    the file is fully read; a plain Block becomes a single item."""
+    import types
+
+    out = task()
+    if isinstance(out, types.GeneratorType):
+        for block in out:
+            yield block
+    else:
+        yield out
 
 
 @ray_tpu.remote
@@ -83,10 +93,12 @@ class Dataset:
                 except StopIteration:
                     exhausted = True
                     break
-                inflight.append(_read_task.remote(t))
+                # one streaming task per read thunk: block refs flow back
+                # incrementally (multi-block readers overlap read & compute)
+                inflight.append(iter(_read_task_stream.remote(t)))
             if not inflight:
                 return
-            yield inflight.popleft()
+            yield from inflight.popleft()
 
     def _execute_refs(self) -> Iterator:
         from ray_tpu.data.executor import execute_streaming

@@ -1,0 +1,18 @@
+"""Job submission.
+
+Reference analog: ``dashboard/modules/job/`` — ``JobManager``
+(``job_manager.py:517``, ``submit_job :832``), ``JobSupervisor`` (``:140``,
+a detached actor running the entrypoint subprocess and capturing logs),
+``sdk.py`` ``JobSubmissionClient``, ``cli.py`` (``ray job submit/...``).
+Redesign: no dashboard REST hop — the client attaches as a driver and talks
+to the supervisor actor directly; job metadata lives in the GCS KV.
+"""
+
+from ray_tpu.job.job_manager import (  # noqa: F401
+    JobSubmissionClient,
+    job_status,
+    list_jobs,
+    stop_job,
+    submit_job,
+    tail_job_logs,
+)

@@ -1,0 +1,189 @@
+"""JobSupervisor actor + submission client.
+
+Reference analog: ``dashboard/modules/job/job_manager.py`` — the supervisor
+is a detached actor that owns the entrypoint subprocess (``JobSupervisor
+:140``), so the job outlives the submitting client; status/log access goes
+through the actor; metadata persists in the GCS KV under ``@jobs/``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+_NAMESPACE = "_rt_job"
+_KV_PREFIX = "@jobs/"
+
+# Terminal states match the reference's JobStatus enum.
+PENDING, RUNNING, SUCCEEDED, FAILED, STOPPED = (
+    "PENDING", "RUNNING", "SUCCEEDED", "FAILED", "STOPPED")
+
+
+class _JobSupervisor:
+    """Runs one entrypoint subprocess; detached so it survives the client."""
+
+    def __init__(self, job_id: str, entrypoint: str, env_vars: Dict[str, str],
+                 gcs_address: str, log_dir: str):
+        self.job_id = job_id
+        self.entrypoint = entrypoint
+        os.makedirs(log_dir, exist_ok=True)
+        self.log_path = os.path.join(log_dir, f"job-{job_id}.log")
+        env = dict(os.environ)
+        env.update(env_vars or {})
+        env["RT_JOB_ID"] = job_id
+        env["RT_ADDRESS"] = gcs_address  # job script: init(address="auto")
+        self._log_file = open(self.log_path, "ab")
+        self._proc = subprocess.Popen(
+            entrypoint, shell=True, env=env,
+            stdout=self._log_file, stderr=subprocess.STDOUT,
+            start_new_session=True)
+        self._stopped = False
+        self._update(RUNNING)
+
+    def _update(self, status: str, rc: Optional[int] = None) -> None:
+        import ray_tpu
+
+        meta = {"job_id": self.job_id, "entrypoint": self.entrypoint,
+                "status": status, "log_path": self.log_path,
+                "return_code": rc, "updated_at": time.time()}
+        ray_tpu.global_worker()._require_backend().kv_put(
+            _KV_PREFIX + self.job_id, json.dumps(meta).encode())
+
+    def poll(self) -> str:
+        """Refresh + return status (called by clients; also finalizes)."""
+        rc = self._proc.poll()
+        if rc is None:
+            return RUNNING
+        status = (STOPPED if self._stopped
+                  else SUCCEEDED if rc == 0 else FAILED)
+        self._update(status, rc)
+        return status
+
+    def logs(self, offset: int = 0, max_bytes: int = 1 << 20) -> Dict[str, Any]:
+        # poll BEFORE reading: if the process exits between a read and the
+        # poll, done=True would drop the tail written in that window
+        done = self._proc.poll() is not None
+        self._log_file.flush()
+        try:
+            with open(self.log_path, "rb") as f:
+                f.seek(offset)
+                data = f.read(max_bytes)
+        except FileNotFoundError:
+            data = b""
+        return {"data": data.decode(errors="replace"),
+                "next_offset": offset + len(data),
+                "done": done}
+
+    def stop(self) -> bool:
+        if self._proc.poll() is None:
+            self._stopped = True
+            try:
+                os.killpg(os.getpgid(self._proc.pid), 15)
+            except (ProcessLookupError, PermissionError):
+                self._proc.terminate()
+            return True
+        return False
+
+
+def _backend():
+    import ray_tpu
+
+    return ray_tpu.global_worker()._require_backend()
+
+
+def submit_job(entrypoint: str, *, env_vars: Optional[Dict[str, str]] = None,
+               job_id: Optional[str] = None) -> str:
+    """Start ``entrypoint`` under a detached supervisor actor; returns the
+    job id immediately (reference: ``JobManager.submit_job``)."""
+    import ray_tpu
+    from ray_tpu._private.config import get_config
+
+    job_id = job_id or f"job_{uuid.uuid4().hex[:10]}"
+    backend = _backend()
+    log_dir = os.path.join(get_config().session_dir_root,
+                           backend.session_name, "logs")
+    backend.kv_put(_KV_PREFIX + job_id, json.dumps({
+        "job_id": job_id, "entrypoint": entrypoint, "status": PENDING,
+        "updated_at": time.time()}).encode())
+    ray_tpu.remote(num_cpus=0)(_JobSupervisor).options(
+        name=f"job:{job_id}", namespace=_NAMESPACE,
+        lifetime="detached").remote(
+        job_id, entrypoint, env_vars or {}, backend.gcs_address, log_dir)
+    return job_id
+
+
+def _supervisor(job_id: str):
+    import ray_tpu
+
+    return ray_tpu.get_actor(f"job:{job_id}", namespace=_NAMESPACE)
+
+
+def job_status(job_id: str) -> Dict[str, Any]:
+    import ray_tpu
+
+    try:
+        status = ray_tpu.get(_supervisor(job_id).poll.remote(), timeout=30)
+    except Exception:
+        status = None  # supervisor gone: fall back to the KV record
+    raw = _backend().kv_get(_KV_PREFIX + job_id)
+    if raw is None:
+        raise ValueError(f"no such job: {job_id}")
+    meta = json.loads(raw)
+    if status is not None:
+        meta["status"] = status
+    return meta
+
+
+def tail_job_logs(job_id: str, offset: int = 0) -> Dict[str, Any]:
+    import ray_tpu
+
+    return ray_tpu.get(_supervisor(job_id).logs.remote(offset), timeout=30)
+
+
+def stop_job(job_id: str) -> bool:
+    import ray_tpu
+
+    return ray_tpu.get(_supervisor(job_id).stop.remote(), timeout=30)
+
+
+def list_jobs() -> List[Dict[str, Any]]:
+    backend = _backend()
+    out = []
+    for key in backend.kv_keys(_KV_PREFIX):
+        raw = backend.kv_get(key)
+        if raw:
+            out.append(json.loads(raw))
+    return sorted(out, key=lambda m: m.get("updated_at", 0))
+
+
+class JobSubmissionClient:
+    """SDK shape parity with the reference's ``JobSubmissionClient``."""
+
+    def __init__(self, address: Optional[str] = None):
+        import ray_tpu
+
+        if not ray_tpu.is_initialized():
+            ray_tpu.init(address=address or "auto")
+
+    def submit_job(self, *, entrypoint: str,
+                   runtime_env: Optional[Dict] = None,
+                   job_id: Optional[str] = None) -> str:
+        env_vars = (runtime_env or {}).get("env_vars")
+        return submit_job(entrypoint, env_vars=env_vars, job_id=job_id)
+
+    def get_job_status(self, job_id: str) -> str:
+        return job_status(job_id)["status"]
+
+    def get_job_logs(self, job_id: str) -> str:
+        return tail_job_logs(job_id)["data"]
+
+    def stop_job(self, job_id: str) -> bool:
+        return stop_job(job_id)
+
+    def list_jobs(self) -> List[Dict[str, Any]]:
+        return list_jobs()

@@ -286,16 +286,31 @@ def sharding_rules(pipeline: bool = False) -> ShardingRules:
     (else unsharded); matrices put their contracting/output dims on
     (fsdp, tp) so forward matmuls all-gather over fsdp (ZeRO-3) and reduce
     over tp.
+
+    Pipelined layer weights keep their non-layer dims REPLICATED: the
+    pipeline shard_map consumes stage weights whole (``pipeline_apply``
+    in_specs = P("pp")), and storing them fsdp/tp-sharded would force a
+    replicate-then-partition reshard at the boundary — the
+    ``spmd_partitioner`` "involuntary full rematerialization" warning — on
+    every step's backward transpose. Storage layout == consumption layout;
+    the embed/lm_head (outside the pipeline region) stay fsdp/tp-sharded.
     """
-    layer0 = "pp" if pipeline else None
+    if pipeline:
+        # Embed/head replicated too: feature-sharded embeddings make GSPMD
+        # carry feature-tiled activations into/out of the batch-tiled
+        # pipeline region — the same boundary reshard in disguise.
+        return ShardingRules([
+            (r"layers/", P("pp")),
+            (r".*", P()),
+        ])
     return ShardingRules([
         (r"embed$", P("tp", "fsdp")),
         (r"lm_head$", P("fsdp", "tp")),
-        (r"layers/w[qkv]$", P(layer0, "fsdp", "tp")),
-        (r"layers/wo$", P(layer0, "tp", "fsdp")),
-        (r"layers/w_(gate|up)$", P(layer0, "fsdp", "tp")),
-        (r"layers/w_down$", P(layer0, "tp", "fsdp")),
-        (r"layers/.*norm", P(layer0)),
+        (r"layers/w[qkv]$", P(None, "fsdp", "tp")),
+        (r"layers/wo$", P(None, "tp", "fsdp")),
+        (r"layers/w_(gate|up)$", P(None, "fsdp", "tp")),
+        (r"layers/w_down$", P(None, "tp", "fsdp")),
+        (r"layers/.*norm", P(None)),
         (r"norm", P()),
     ])
 

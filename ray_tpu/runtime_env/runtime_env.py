@@ -1,0 +1,166 @@
+"""RuntimeEnv: packaging (driver side) + materialization (worker side).
+
+Reference analogs: ``_private/runtime_env/packaging.py`` (zip -> KV under a
+content hash, ``gcs://`` URIs), ``working_dir.py`` (download + chdir +
+sys.path), ``pip.py`` (dependency install), ``context.py`` (env var
+injection). The worker cache key (raylet worker pool) includes the env hash,
+so processes are reused only within the same environment — the reference's
+worker-pool-keyed-by-runtime-env-hash behavior.
+
+Supported fields:
+  - ``working_dir``: local dir (driver packages it) or ``gcs://<hash>`` URI.
+  - ``env_vars``: dict of str -> str set in the worker before user code.
+  - ``pip``: list of requirement strings / local wheel paths, installed into
+    a per-env cache dir that is prepended to ``sys.path`` (no venv spawn —
+    same interpreter, isolated site dir).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import json
+import os
+import subprocess
+import sys
+import zipfile
+from typing import Any, Dict, List, Optional
+
+RuntimeEnv = Dict[str, Any]
+
+_EXCLUDE_DIRS = {".git", "__pycache__", ".venv", "node_modules", ".eggs"}
+_MAX_WORKING_DIR_BYTES = 512 * 1024 * 1024
+_KV_PREFIX = "@runtime_env/"
+
+
+def _iter_files(root: str):
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d not in _EXCLUDE_DIRS]
+        for name in sorted(filenames):
+            yield os.path.join(dirpath, name)
+
+
+def package_working_dir(path: str) -> bytes:
+    """Deterministic zip of a directory (sorted entries, zeroed timestamps)
+    so equal content yields an equal hash/URI."""
+    path = os.path.abspath(path)
+    if not os.path.isdir(path):
+        raise ValueError(f"working_dir {path!r} is not a directory")
+    buf = io.BytesIO()
+    total = 0
+    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as zf:
+        for fpath in _iter_files(path):
+            rel = os.path.relpath(fpath, path)
+            total += os.path.getsize(fpath)
+            if total > _MAX_WORKING_DIR_BYTES:
+                raise ValueError(
+                    f"working_dir {path!r} exceeds "
+                    f"{_MAX_WORKING_DIR_BYTES >> 20} MB")
+            info = zipfile.ZipInfo(rel, date_time=(1980, 1, 1, 0, 0, 0))
+            info.external_attr = (os.stat(fpath).st_mode & 0xFFFF) << 16
+            with open(fpath, "rb") as f:
+                zf.writestr(info, f.read())
+    return buf.getvalue()
+
+
+def env_hash(env: RuntimeEnv) -> str:
+    """Content hash identifying a prepared env (worker-pool cache key)."""
+    return hashlib.sha1(
+        json.dumps(env, sort_keys=True).encode()).hexdigest()[:16]
+
+
+def prepare_runtime_env(env: Optional[RuntimeEnv], kv_put, kv_get) -> Optional[Dict]:
+    """Driver-side normalization: upload working_dir once (content-addressed,
+    skipped if the KV already holds the blob); returns the wire form
+    {"working_dir_uri", "env_vars", "pip", "hash"} or None."""
+    if not env:
+        return None
+    wire: Dict[str, Any] = {}
+    wd = env.get("working_dir")
+    if wd:
+        if str(wd).startswith("gcs://"):
+            wire["working_dir_uri"] = wd
+        else:
+            blob = package_working_dir(wd)
+            digest = hashlib.sha1(blob).hexdigest()[:20]
+            uri = f"gcs://{digest}"
+            key = _KV_PREFIX + digest
+            if kv_get(key) is None:
+                kv_put(key, blob)
+            wire["working_dir_uri"] = uri
+    if env.get("env_vars"):
+        vars_ = env["env_vars"]
+        if not all(isinstance(k, str) and isinstance(v, str)
+                   for k, v in vars_.items()):
+            raise TypeError("env_vars must be Dict[str, str]")
+        wire["env_vars"] = dict(vars_)
+    if env.get("pip"):
+        wire["pip"] = list(env["pip"])
+    unknown = set(env) - {"working_dir", "env_vars", "pip"}
+    if unknown:
+        raise ValueError(f"unsupported runtime_env fields: {sorted(unknown)}")
+    if not wire:
+        return None
+    wire["hash"] = env_hash(wire)
+    return wire
+
+
+def materialize(wire: Dict, kv_get, cache_root: str) -> None:
+    """Worker-side: make the env live in THIS process before any user code
+    runs — download/extract working_dir (content-addressed cache shared by
+    workers on the node), chdir + sys.path it, install pip deps into a
+    per-env site dir, export env_vars."""
+    os.makedirs(cache_root, exist_ok=True)
+
+    uri = wire.get("working_dir_uri")
+    if uri:
+        digest = uri[len("gcs://"):]
+        target = os.path.join(cache_root, "working_dirs", digest)
+        if not os.path.isdir(target):
+            blob = kv_get(_KV_PREFIX + digest)
+            if blob is None:
+                raise RuntimeError(f"runtime_env blob {uri} not in GCS KV")
+            tmp = target + f".tmp.{os.getpid()}"
+            os.makedirs(tmp, exist_ok=True)
+            with zipfile.ZipFile(io.BytesIO(blob)) as zf:
+                zf.extractall(tmp)
+            try:
+                os.rename(tmp, target)
+            except OSError:  # another worker won the race
+                import shutil
+
+                shutil.rmtree(tmp, ignore_errors=True)
+        os.chdir(target)
+        if target not in sys.path:
+            sys.path.insert(0, target)
+
+    pip_reqs = wire.get("pip")
+    if pip_reqs:
+        site = os.path.join(cache_root, "pip", wire["hash"])
+        if not os.path.isdir(site):
+            # install into a private tmp dir, then atomically rename — two
+            # workers materializing the same env concurrently must never
+            # write into one site dir (same pattern as working_dir above)
+            tmp = site + f".tmp.{os.getpid()}"
+            os.makedirs(tmp, exist_ok=True)
+            cmd = [sys.executable, "-m", "pip", "install",
+                   "--target", tmp, "--no-warn-script-location"]
+            if all(r.endswith(".whl") or os.path.exists(r) for r in pip_reqs):
+                cmd.append("--no-index")  # local wheels: no network needed
+            proc = subprocess.run(cmd + list(pip_reqs),
+                                  capture_output=True, text=True)
+            if proc.returncode != 0:
+                raise RuntimeError(
+                    f"pip install for runtime_env failed:\n{proc.stderr[-2000:]}")
+            os.makedirs(os.path.dirname(site), exist_ok=True)
+            try:
+                os.rename(tmp, site)
+            except OSError:  # another worker won the race
+                import shutil
+
+                shutil.rmtree(tmp, ignore_errors=True)
+        if site not in sys.path:
+            sys.path.insert(0, site)
+
+    for k, v in (wire.get("env_vars") or {}).items():
+        os.environ[k] = v

@@ -51,10 +51,10 @@ def _pid_alive(pid: int) -> bool:
 
 
 def cmd_start(args: argparse.Namespace) -> int:
-    daemon_args = [sys.executable, "-m", "ray_tpu.cluster.node_main"]
+    daemon_args = [sys.executable, "-m", "ray_tpu.cluster.node_main",
+                   "--host", args.host]
     if args.head:
-        daemon_args += ["--head", "--host", args.host, "--port",
-                        str(args.port)]
+        daemon_args += ["--head", "--port", str(args.port)]
         if args.session_name:
             daemon_args += ["--session-name", args.session_name]
     else:
@@ -75,18 +75,34 @@ def cmd_start(args: argparse.Namespace) -> int:
         start_new_session=True)  # detach: survives this CLI process
     log_file.close()
 
-    # Block until the daemon prints its ready line (or dies).
+    # Block until the daemon prints its ready line (or dies) — readline
+    # gated by select so --timeout holds even if the daemon never writes.
+    import select
+
     deadline = time.monotonic() + args.timeout
     state = None
+    buf = b""
     while time.monotonic() < deadline:
-        line = proc.stdout.readline().decode()
-        if not line:
+        ready, _, _ = select.select([proc.stdout], [], [],
+                                    max(0.0, deadline - time.monotonic()))
+        if not ready:
             break
-        if line.startswith("RT_NODE_READY "):
-            state = json.loads(line[len("RT_NODE_READY "):])
+        chunk = os.read(proc.stdout.fileno(), 4096)
+        if not chunk:
+            break
+        buf += chunk
+        # only parse COMPLETE lines — the ready json may straddle a read
+        complete, _, buf = buf.rpartition(b"\n")
+        for line in complete.decode(errors="replace").splitlines():
+            if line.startswith("RT_NODE_READY "):
+                state = json.loads(line[len("RT_NODE_READY "):])
+                break
+        if state is not None:
             break
     if state is None:
         rc = proc.poll()
+        if rc is None:
+            proc.terminate()  # half-started daemon: don't leave it dangling
         print(f"rt start: node daemon failed to come up "
               f"(rc={rc}); log: {log_path}", file=sys.stderr)
         return 1
@@ -194,6 +210,96 @@ def cmd_status(args: argparse.Namespace) -> int:
     return 0
 
 
+def _attach_driver(address: Optional[str]):
+    import ray_tpu
+
+    gcs = _resolve_gcs(address)
+    if gcs is None:
+        print("no running cluster found (pass --address or start one with "
+              "`rt start --head`)", file=sys.stderr)
+        raise SystemExit(1)
+    ray_tpu.init(address=gcs, ignore_reinit_error=True)
+    return ray_tpu
+
+
+def cmd_job(args: argparse.Namespace) -> int:
+    from ray_tpu import job as rt_job
+
+    rt = _attach_driver(args.address)
+    try:
+        if args.job_cmd == "submit":
+            import shlex
+
+            parts = list(args.entrypoint or [])
+            if parts and parts[0] == "--":
+                parts = parts[1:]  # only the leading separator
+            entrypoint = " ".join(shlex.quote(p) for p in parts)
+            if not entrypoint:
+                print("rt job submit: empty entrypoint", file=sys.stderr)
+                return 1
+            env_vars = dict(kv.split("=", 1) for kv in (args.env or []))
+            job_id = rt_job.submit_job(entrypoint, env_vars=env_vars)
+            print(job_id)
+            if args.wait:
+                return _follow_job(rt_job, job_id, from_start=True)
+            return 0
+        if args.job_cmd == "status":
+            meta = rt_job.job_status(args.job_id)
+            print(json.dumps(meta, indent=2))
+            return 0 if meta["status"] in ("RUNNING", "SUCCEEDED", "PENDING") \
+                else 1
+        if args.job_cmd == "logs":
+            if args.follow:
+                return _follow_job(rt_job, args.job_id, from_start=True)
+            print(rt_job.tail_job_logs(args.job_id)["data"], end="")
+            return 0
+        if args.job_cmd == "stop":
+            print("stopped" if rt_job.stop_job(args.job_id)
+                  else "already finished")
+            return 0
+        if args.job_cmd == "list":
+            for meta in rt_job.list_jobs():
+                print(f"{meta['job_id']}  {meta['status']:9}  "
+                      f"{meta.get('entrypoint', '')}")
+            return 0
+        return 1
+    finally:
+        rt.shutdown()
+
+
+def _follow_job(rt_job, job_id: str, from_start: bool = False) -> int:
+    offset = 0
+    while True:
+        chunk = rt_job.tail_job_logs(job_id, offset)
+        if chunk["data"]:
+            print(chunk["data"], end="", flush=True)
+        offset = chunk["next_offset"]
+        if chunk["done"]:
+            break
+        time.sleep(0.3)
+    status = rt_job.job_status(job_id)["status"]
+    print(f"\n--- job {job_id}: {status}", file=sys.stderr)
+    return 0 if status == "SUCCEEDED" else 1
+
+
+_LIST_RPCS = {"nodes": "list_nodes", "actors": "list_actors",
+              "placement-groups": "list_placement_groups",
+              "tasks": "list_tasks", "objects": "list_objects"}
+
+
+def cmd_list(args: argparse.Namespace) -> int:
+    gcs = _resolve_gcs(args.address)
+    if gcs is None:
+        print("no running cluster found (pass --address)", file=sys.stderr)
+        return 1
+    if args.what == "jobs":
+        return cmd_job(argparse.Namespace(address=args.address,
+                                          job_cmd="list"))
+    rows = _gcs_call(gcs, _LIST_RPCS[args.what], {"limit": args.limit})
+    print(json.dumps(rows, indent=2, default=str))
+    return 0
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="rt")
     sub = parser.add_subparsers(dest="cmd", required=True)
@@ -218,6 +324,30 @@ def main(argv=None) -> int:
     p_status = sub.add_parser("status", help="show cluster nodes")
     p_status.add_argument("--address", default=None)
     p_status.set_defaults(fn=cmd_status)
+
+    p_job = sub.add_parser("job", help="submit / inspect jobs")
+    job_sub = p_job.add_subparsers(dest="job_cmd", required=True)
+    pj_submit = job_sub.add_parser("submit")
+    pj_submit.add_argument("--address", default=None)
+    pj_submit.add_argument("--env", action="append", metavar="K=V")
+    pj_submit.add_argument("--wait", action="store_true",
+                           help="stream logs until the job finishes")
+    pj_submit.add_argument("entrypoint", nargs=argparse.REMAINDER)
+    for name in ("status", "logs", "stop"):
+        pj = job_sub.add_parser(name)
+        pj.add_argument("--address", default=None)
+        pj.add_argument("job_id")
+        if name == "logs":
+            pj.add_argument("--follow", action="store_true")
+    pj_list = job_sub.add_parser("list")
+    pj_list.add_argument("--address", default=None)
+    p_job.set_defaults(fn=cmd_job)
+
+    p_list = sub.add_parser("list", help="state API listings")
+    p_list.add_argument("what", choices=sorted(_LIST_RPCS) + ["jobs"])
+    p_list.add_argument("--address", default=None)
+    p_list.add_argument("--limit", type=int, default=200)
+    p_list.set_defaults(fn=cmd_list)
 
     args = parser.parse_args(argv)
     if args.cmd == "start" and not args.head and not args.address:

@@ -85,6 +85,36 @@ def test_cli_two_node_schedule(cli_cluster):
     assert len(nodes) == 2, f"tasks did not spread across nodes: {nodes}"
 
 
+def test_cli_wildcard_bind_advertises_real_ip(tmp_path):
+    """--host 0.0.0.0 must advertise a dialable address (the outbound IP),
+    never the wildcard itself — cross-host joins depend on it."""
+    env = _cli_env(tmp_path)
+    head = _cli(env, "start", "--head", "--host", "0.0.0.0", "--num-cpus", "1")
+    try:
+        assert head.returncode == 0, head.stderr + head.stdout
+        gcs = [ln.split()[-1] for ln in head.stdout.splitlines()
+               if "gcs_address" in ln][0]
+        raylet = [ln.split()[-1] for ln in head.stdout.splitlines()
+                  if "raylet_address" in ln][0]
+        assert not gcs.startswith("0.0.0.0"), gcs
+        assert not raylet.startswith("0.0.0.0"), raylet
+        status = _cli(env, "status", f"--address={gcs}")
+        assert "1 alive" in status.stdout, status.stdout + status.stderr
+    finally:
+        _cli(env, "stop", "--force")
+
+
+def test_cli_start_timeout_on_unreachable_gcs(tmp_path):
+    """rt start --address=<dead endpoint> must fail within --timeout rather
+    than blocking forever on the daemon's silent stdout."""
+    env = _cli_env(tmp_path)
+    t0 = time.time()
+    r = _cli(env, "start", "--address=127.0.0.1:1", "--timeout", "5",
+             timeout=60)
+    assert r.returncode == 1
+    assert time.time() - t0 < 30
+
+
 def test_cli_auto_attach_and_stop(cli_cluster):
     env, gcs_address = cli_cluster
     ray_tpu.init(address="auto")

@@ -1,0 +1,132 @@
+"""Job submission + state API.
+
+Reference analogs: ``dashboard/modules/job/job_manager.py:517`` (submit_job
+:832, JobSupervisor detached actor, log streaming), ``python/ray/util/state``
+(ray list ...), ``util/state/state_cli.py``.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import job as rt_job
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _wait_status(job_id, want, timeout=60):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        meta = rt_job.job_status(job_id)
+        if meta["status"] in want:
+            return meta
+        time.sleep(0.3)
+    raise AssertionError(f"job stuck in {meta['status']}, wanted {want}")
+
+
+def test_job_submit_and_logs(rt_cluster, tmp_path):
+    script = tmp_path / "entry.py"
+    script.write_text(
+        "import sys\n"
+        "for i in range(5):\n"
+        "    print('job-line', i)\n"
+        "print('job-done')\n")
+    job_id = rt_job.submit_job(f"{sys.executable} {script}")
+    meta = _wait_status(job_id, {"SUCCEEDED"})
+    assert meta["return_code"] == 0
+    logs = rt_job.tail_job_logs(job_id)["data"]
+    assert "job-line 4" in logs
+    assert "job-done" in logs
+
+
+def test_job_failure_status(rt_cluster, tmp_path):
+    script = tmp_path / "bad.py"
+    script.write_text("raise SystemExit(3)\n")
+    job_id = rt_job.submit_job(f"{sys.executable} {script}")
+    meta = _wait_status(job_id, {"FAILED"})
+    assert meta["return_code"] == 3
+
+
+def test_job_stop(rt_cluster, tmp_path):
+    script = tmp_path / "sleepy.py"
+    script.write_text("import time\nprint('started', flush=True)\n"
+                      "time.sleep(300)\n")
+    job_id = rt_job.submit_job(f"{sys.executable} {script}")
+    _wait_status(job_id, {"RUNNING"})
+    # wait for the subprocess to actually print (it's alive)
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        if "started" in rt_job.tail_job_logs(job_id)["data"]:
+            break
+        time.sleep(0.2)
+    assert rt_job.stop_job(job_id)
+    meta = _wait_status(job_id, {"STOPPED"})
+    assert meta["status"] == "STOPPED"
+
+
+def test_job_sdk_client_and_list(rt_cluster, tmp_path):
+    script = tmp_path / "ok.py"
+    script.write_text("print('sdk-ok')\n")
+    client = rt_job.JobSubmissionClient()
+    job_id = client.submit_job(entrypoint=f"{sys.executable} {script}")
+    _wait_status(job_id, {"SUCCEEDED"})
+    assert "sdk-ok" in client.get_job_logs(job_id)
+    assert any(j["job_id"] == job_id for j in client.list_jobs())
+
+
+def test_state_api_list_tasks_objects(rt_cluster):
+    import numpy as np
+
+    @ray_tpu.remote
+    def named_task():
+        return np.zeros((512, 256), dtype=np.float32)  # plasma return
+
+    ref = named_task.remote()
+    ray_tpu.get(ref, timeout=60)
+    backend = ray_tpu.global_worker()._require_backend()
+    # tasks
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        tasks = backend.io.run(backend._gcs.call("list_tasks", {}))
+        mine = [t for t in tasks if t.get("name") == "named_task"]
+        if mine and mine[0]["state"] == "FINISHED":
+            break
+        time.sleep(0.2)
+    assert mine and mine[0]["state"] == "FINISHED"
+    # objects
+    objs = backend.io.run(backend._gcs.call("list_objects", {}))
+    assert any(o["object_id"] == ref.hex() for o in objs)
+
+
+def _cli(env, *args, timeout=120):
+    return subprocess.run(
+        [sys.executable, "-m", "ray_tpu.scripts.cli", *args],
+        env=env, capture_output=True, text=True, timeout=timeout)
+
+
+def test_cli_job_e2e(tmp_path):
+    """Full CLI flow: start head, submit a script job, tail logs, list."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO
+    env["JAX_PLATFORMS"] = "cpu"
+    env["RT_SESSION_DIR_ROOT"] = str(tmp_path)
+    head = _cli(env, "start", "--head", "--num-cpus", "2")
+    assert head.returncode == 0, head.stderr
+    try:
+        script = tmp_path / "cli_job.py"
+        script.write_text("print('hello-from-cli-job')\n")
+        sub = _cli(env, "job", "submit", "--wait", "--",
+                   sys.executable, str(script))
+        assert sub.returncode == 0, sub.stdout + sub.stderr
+        assert "hello-from-cli-job" in sub.stdout
+        listed = _cli(env, "job", "list")
+        assert "SUCCEEDED" in listed.stdout
+        tasks = _cli(env, "list", "nodes")
+        assert tasks.returncode == 0
+    finally:
+        _cli(env, "stop", "--force")
