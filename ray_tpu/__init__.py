@@ -21,6 +21,13 @@ from ray_tpu.core.worker import (  # noqa: F401
 from ray_tpu.runtime_context import get_runtime_context  # noqa: F401
 from ray_tpu import exceptions  # noqa: F401
 
+
+def timeline(filename=None):
+    """Chrome-trace export of recent task spans (reference: ray.timeline)."""
+    from ray_tpu.util.timeline import timeline as _tl
+
+    return _tl(filename)
+
 __version__ = "0.1.0"
 
 

@@ -1,0 +1,18 @@
+"""Demand-driven cluster autoscaling.
+
+Reference analog: ``python/ray/autoscaler/_private/`` —
+``StandardAutoscaler`` (``autoscaler.py:166``), ``LoadMetrics``
+(``load_metrics.py:63``), ``ResourceDemandScheduler``
+(``resource_demand_scheduler.py:102``) and the ``NodeProvider`` plugin API
+(``autoscaler/node_provider.py:13``). Redesign: no SSH updater — providers
+launch node daemons that self-register with the GCS (``rt start
+--address=...`` semantics); the local provider runs REAL raylet daemons as
+subprocesses, the ``ray_tpu`` answer to the reference's
+``FakeMultiNodeProvider`` (which only faked provisioning).
+"""
+
+from ray_tpu.autoscaler.autoscaler import StandardAutoscaler  # noqa: F401
+from ray_tpu.autoscaler.node_provider import (  # noqa: F401
+    LocalNodeProvider,
+    NodeProvider,
+)

@@ -50,6 +50,11 @@ class _ActorEntry:
         self.death_reason = ""
         self.waiters: List[asyncio.Future] = []
 
+    def __getstate__(self):  # snapshot persistence: waiters are loop-affine
+        state = dict(self.__dict__)
+        state["waiters"] = []
+        return state
+
     def info(self) -> Dict[str, Any]:
         return {
             "actor_id": self.actor_id, "state": self.state,
@@ -79,6 +84,11 @@ class _PgEntry:
         self.waiters: List[asyncio.Future] = []
         self._rr = 0  # round-robin pointer for bundle_index=-1 routing
 
+    def __getstate__(self):  # snapshot persistence: waiters are loop-affine
+        state = dict(self.__dict__)
+        state["waiters"] = []
+        return state
+
     def info(self) -> Dict[str, Any]:
         return {"pg_id": self.pg_id, "state": self.state, "name": self.name,
                 "strategy": self.strategy, "bundles": self.bundles,
@@ -86,7 +96,7 @@ class _PgEntry:
 
 
 class GcsServer:
-    def __init__(self):
+    def __init__(self, persist_path: Optional[str] = None):
         self.nodes: Dict[str, _NodeEntry] = {}
         self.kv: Dict[str, bytes] = {}
         self.actors: Dict[str, _ActorEntry] = {}
@@ -98,6 +108,68 @@ class GcsServer:
         self._pool = ConnectionPool(peer_id="gcs")
         self._monitor_task: Optional[asyncio.Task] = None
         self._job_counter = 0
+        # Snapshot persistence (reference: the Redis store client behind the
+        # GCS tables, ``store_client/redis_store_client.cc`` — here a pickle
+        # snapshot so a restarted head recovers actors/PGs/KV/locations).
+        self._persist_path = persist_path
+        self._persist_seq = self._persisted_seq = 0
+        if persist_path:
+            self._restore_snapshot()
+
+    def mark_dirty(self) -> None:
+        self._persist_seq += 1
+
+    _SNAPSHOT_TABLES = ("kv", "actors", "named_actors", "placement_groups",
+                        "object_locations", "object_sizes", "_job_counter")
+
+    def _persist_snapshot(self) -> None:
+        if not self._persist_path or self._persist_seq == self._persisted_seq:
+            return
+        seq = self._persist_seq
+        self._write_snapshot(self._snapshot_tables())
+        self._persisted_seq = seq
+
+    def _write_snapshot(self, state: Dict) -> None:
+        import os
+
+        # unique tmp per writer: a stop()-time sync write racing an
+        # in-flight executor write must never interleave on one file
+        tmp = f"{self._persist_path}.tmp.{os.getpid()}.{id(state)}"
+        os.makedirs(os.path.dirname(self._persist_path) or ".", exist_ok=True)
+        with open(tmp, "wb") as f:
+            pickle.dump(state, f)
+        os.replace(tmp, self._persist_path)
+
+    def _snapshot_tables(self) -> Dict:
+        """Loop-side copies: shallow for scalar tables, per-value copies for
+        mutable containers (location sets mutate mid-pickle otherwise)."""
+        state: Dict[str, Any] = {}
+        for name in self._SNAPSHOT_TABLES:
+            table = getattr(self, name)
+            if name == "object_locations":
+                state[name] = {k: set(v) for k, v in table.items()}
+            elif isinstance(table, dict):
+                state[name] = dict(table)
+            else:
+                state[name] = table
+        return state
+
+    def _restore_snapshot(self) -> None:
+        import os
+
+        if not os.path.exists(self._persist_path):
+            return
+        try:
+            with open(self._persist_path, "rb") as f:
+                state = pickle.load(f)
+        except Exception:
+            return  # corrupt snapshot: start fresh rather than crash
+        for name in self._SNAPSHOT_TABLES:
+            if name in state:
+                setattr(self, name, state[name])
+        # Restored ALIVE actors may still be running (their workers outlive
+        # a GCS restart); callers re-resolve addresses on first use. Nodes
+        # are NOT restored — raylets re-register with their next heartbeat.
 
     def start_monitor(self) -> None:
         self._monitor_task = asyncio.ensure_future(self._monitor_loop())
@@ -107,6 +179,10 @@ class GcsServer:
 
         await cancel_and_wait(self._monitor_task)
         self._monitor_task = None
+        try:
+            self._persist_snapshot()
+        except Exception:
+            pass
         await self._pool.close_all()
 
     # ---- nodes ------------------------------------------------------------
@@ -123,7 +199,22 @@ class GcsServer:
         entry.last_heartbeat = time.monotonic()
         if "available" in p:
             entry.view.available = ResourceSet(p["available"])
+        entry.queued_demands = p.get("queued_demands", [])
         return {"ok": True}
+
+    async def rpc_cluster_load(self, p):
+        """Autoscaler input: per-node capacity/usage + unplaced demand
+        (reference: the load report behind resource_demand_scheduler)."""
+        out = []
+        for n in self.nodes.values():
+            out.append({
+                "node_id": n.node_id, "alive": n.alive,
+                "labels": dict(n.view.labels),
+                "total": n.view.total.to_dict(),
+                "available": n.view.available.to_dict(),
+                "queued_demands": getattr(n, "queued_demands", []),
+            })
+        return out
 
     async def rpc_list_nodes(self, p):
         return [{
@@ -147,8 +238,22 @@ class GcsServer:
             for entry in list(self.nodes.values()):
                 if entry.alive and now - entry.last_heartbeat > cfg.node_death_timeout_s:
                     await self._mark_node_dead(entry, "heartbeat timeout")
+            try:
+                # pickle+write runs OFF the loop: a large table snapshot
+                # must not stall heartbeat handling (and spuriously kill
+                # nodes). Copies are taken on the loop; IO in the executor.
+                if (self._persist_path
+                        and self._persist_seq != self._persisted_seq):
+                    seq = self._persist_seq
+                    state = self._snapshot_tables()
+                    await asyncio.get_running_loop().run_in_executor(
+                        None, self._write_snapshot, state)
+                    self._persisted_seq = seq
+            except Exception:
+                pass
 
     async def _mark_node_dead(self, entry: _NodeEntry, reason: str) -> None:
+        self.mark_dirty()  # internal transitions must persist too
         entry.alive = False
         # Objects whose only copy was there are lost (lineage reconstruction
         # is a later round); actors there restart elsewhere if budgeted.
@@ -177,6 +282,7 @@ class GcsServer:
 
     # ---- kv / function table ----------------------------------------------
     async def rpc_kv_put(self, p):
+        self.mark_dirty()
         self.kv[p["key"]] = p["value"]
         return {"ok": True}
 
@@ -184,6 +290,7 @@ class GcsServer:
         return {"value": self.kv.get(p["key"])}
 
     async def rpc_kv_del(self, p):
+        self.mark_dirty()
         self.kv.pop(p["key"], None)
         return {"ok": True}
 
@@ -192,6 +299,7 @@ class GcsServer:
 
     # ---- object directory --------------------------------------------------
     async def rpc_add_object_location(self, p):
+        self.mark_dirty()
         oid, node_id = p["oid"], p["node_id"]
         self.object_locations.setdefault(oid, set()).add(node_id)
         if "size" in p:
@@ -202,6 +310,7 @@ class GcsServer:
         return {"ok": True}
 
     async def rpc_remove_object_location(self, p):
+        self.mark_dirty()
         locs = self.object_locations.get(p["oid"])
         if locs:
             locs.discard(p["node_id"])
@@ -228,6 +337,7 @@ class GcsServer:
 
     # ---- actors ------------------------------------------------------------
     async def rpc_register_actor(self, p):
+        self.mark_dirty()
         spec = p["spec"]
         actor_id = spec["actor_id"]
         name, ns = spec.get("name"), spec.get("namespace", "default")
@@ -313,6 +423,7 @@ class GcsServer:
         return pg.bundle_nodes[idx]
 
     async def rpc_actor_update(self, p):
+        self.mark_dirty()
         entry = self.actors.get(p["actor_id"])
         if entry is None:
             return {"ok": False}
@@ -339,6 +450,7 @@ class GcsServer:
         return {"ok": True}
 
     async def _handle_actor_failure(self, entry: _ActorEntry, reason: str) -> None:
+        self.mark_dirty()
         if entry.state == ACTOR_DEAD:
             return
         max_restarts = entry.spec.get("max_restarts", 0)
@@ -397,6 +509,7 @@ class GcsServer:
                 "method_meta": entry.spec.get("method_meta")}
 
     async def rpc_kill_actor(self, p):
+        self.mark_dirty()
         entry = self.actors.get(p["actor_id"])
         if entry is None:
             return {"ok": False}
@@ -417,6 +530,7 @@ class GcsServer:
 
     # ---- placement groups ---------------------------------------------------
     async def rpc_create_placement_group(self, p):
+        self.mark_dirty()
         entry = _PgEntry(p["pg_id"], p["bundles"], p["strategy"],
                          p.get("name", ""))
         self.placement_groups[p["pg_id"]] = entry
@@ -541,6 +655,7 @@ class GcsServer:
                 await asyncio.sleep(0.2)
                 continue
             entry.state = PG_CREATED
+            self.mark_dirty()
             for fut in entry.waiters:
                 if not fut.done():
                     fut.set_result(True)
@@ -578,6 +693,7 @@ class GcsServer:
         return info
 
     async def rpc_remove_placement_group(self, p):
+        self.mark_dirty()
         entry = self.placement_groups.get(p["pg_id"])
         if entry is None:
             return {"ok": False}
@@ -633,6 +749,8 @@ class GcsServer:
         ev.update({"task_id": p["task_id"], "name": p.get("name", ev.get("name")),
                    "state": p["state"], "node_id": p.get("node_id"),
                    "updated_at": time.time()})
+        # per-state transition times feed ray_tpu.timeline()'s Chrome trace
+        ev.setdefault("times", {})[p["state"]] = time.time()
         self.task_events[p["task_id"]] = ev
         while len(self.task_events) > self._TASK_EVENTS_CAP:
             self.task_events.popitem(last=False)
@@ -683,5 +801,6 @@ class GcsServer:
         return {"total": total, "available": avail}
 
     async def rpc_next_job_id(self, p):
+        self.mark_dirty()
         self._job_counter += 1
         return {"job_index": self._job_counter}

@@ -77,13 +77,17 @@ async def _amain(args: argparse.Namespace) -> None:
     session_name = args.session_name
     gcs_address = args.address
     if args.head:
-        gcs = GcsServer()
+        session_name = session_name or f"session_{uuid.uuid4().hex[:12]}"
+        # Same --session-name across head restarts => same snapshot file:
+        # actors/PGs/KV survive the restart (GCS fault tolerance).
+        persist = os.path.join(get_config().session_dir_root, session_name,
+                               "gcs_snapshot.pkl")
+        gcs = GcsServer(persist_path=persist)
         gcs_server = RpcServer(loop)
         gcs_server.register_object(gcs)
         await gcs_server.start(args.port)
         gcs.start_monitor()
         gcs_address = gcs_server.address
-        session_name = session_name or f"session_{uuid.uuid4().hex[:12]}"
         gcs.kv["@session/name"] = session_name.encode()
     else:
         client = RpcClient(gcs_address, peer_id="node-join")

@@ -106,6 +106,11 @@ class Raylet:
         # a stale pin (crashed getter) expires after _PIN_TTL_S.
         # oid_hex -> {"count": int, "t": monotonic-of-last-pin}
         self._pinned: Dict[str, Dict[str, float]] = {}
+        # In-flight remote pulls: chunked transfer holds the .building file
+        # across awaits, so concurrent fetches of one object must join the
+        # first pull, not race its O_EXCL create (reference: PullManager
+        # dedups by object id).
+        self._pulls: Dict[str, asyncio.Future] = {}
         # Running sum of in-memory (non-spilled) object bytes, so the
         # per-unpin spill precheck is O(1) not O(#objects). Maintained by
         # _touch / _spill_blocking / rpc_free_objects; the spill thread
@@ -159,9 +164,20 @@ class Raylet:
         while True:
             await asyncio.sleep(cfg.heartbeat_interval_s)
             try:
+                # queued-but-unplaced demand rides the heartbeat so the
+                # autoscaler can bin-pack it onto prospective node types
+                # (reference: resource_demand_scheduler's load report)
+                demands: Dict[Tuple, int] = {}
+                for item in self._queue[:100]:
+                    key = tuple(sorted(
+                        item["payload"].get("resources", {}).items()))
+                    demands[key] = demands.get(key, 0) + 1
                 await self._gcs.call("heartbeat", {
                     "node_id": self.node_id,
-                    "available": self.node.available.to_dict()})
+                    "available": self.node.available.to_dict(),
+                    "queued_demands": [
+                        {"resources": dict(k), "count": c}
+                        for k, c in list(demands.items())[:20]]})
             except Exception:
                 pass
             if self._queue:
@@ -282,21 +298,11 @@ class Raylet:
                         self._replies.pop(next(iter(self._replies)))
 
         fut.add_done_callback(_on_done)
-        req = ResourceSet(p["resources"])
-        if p.get("pg") is None and (not self.node.is_feasible(req)
-                                    or p.get("spillback_hint")):
-            # Spilled tasks get the same dedup: a retry while the forwarded
-            # submit is in flight joins it instead of spilling a second copy.
-            async def _do_spill():
-                try:
-                    reply = await self._spill(p)
-                except Exception as e:
-                    reply = {"error": "submit_failed", "message": repr(e)}
-                if not fut.done():
-                    fut.set_result(reply)
-
-            asyncio.ensure_future(_do_spill())
-            return await asyncio.shield(fut)
+        # Locally-infeasible tasks QUEUE here too (not fail): the spillback
+        # pass forwards them when another node has capacity, and until then
+        # they ride the heartbeat's queued_demands — the signal the
+        # autoscaler provisions against (reference: infeasible tasks stay
+        # pending and drive resource_demand_scheduler).
         self._queue.append({"payload": p, "future": fut,
                             "t": time.monotonic(), "spilling": False})
         self._task_event(task_id, p.get("fn_name"), "PENDING")
@@ -316,21 +322,6 @@ class Raylet:
                 pass
 
         asyncio.ensure_future(_send())
-
-    async def _spill(self, p):
-        """Route an infeasible task through the GCS to a node that fits
-        (reference: spillback reply in ``HandleRequestWorkerLease``; here the
-        raylet forwards and proxies the reply instead)."""
-        p = dict(p)
-        p.pop("spillback_hint", None)
-        route = await self._gcs.call("route_task", {
-            "resources": p["resources"], "strategy": p.get("strategy"),
-            "preferred": None})
-        if not route.get("address"):
-            return {"error": "infeasible",
-                    "message": f"no node can ever run task requiring {p['resources']}"}
-        client = await self._pool.get(route["address"])
-        return await client.call("submit_task", p)
 
     async def _try_spillback(self, item) -> None:
         """Forward a queued-but-waiting task to a node with free capacity.
@@ -748,6 +739,59 @@ class Raylet:
                 return {"payload": f.read()}
         return {"error": "not found"}
 
+    async def rpc_get_object_chunk(self, p):
+        """Serve one bounded slice of an object (reference: chunked reads,
+        ``object_manager/chunk_object_reader.h``); shm and spill-file copies
+        both serve — the puller never needs the whole payload in one frame."""
+        from ray_tpu._private.ids import ObjectID
+
+        oid_hex, off, size = p["oid"], p["offset"], p["size"]
+        view = self.store.read(ObjectID.from_hex(oid_hex))
+        if view is not None:
+            self._touch(oid_hex)
+            return {"total": len(view), "data": bytes(view[off:off + size])}
+        path = self._spill_path(oid_hex)
+        try:
+            total = os.path.getsize(path)
+            with open(path, "rb") as f:
+                f.seek(off)
+                return {"total": total, "data": f.read(size)}
+        except FileNotFoundError:
+            return {"error": "not found"}
+
+    async def _pull_chunked(self, client, oid, oid_hex: str) -> Optional[int]:
+        """Pull a remote object into local shm in bounded chunks, writing
+        straight into the store's mmap (peak memory = one chunk). Returns
+        the object size, or None if the source doesn't have it."""
+        chunk = get_config().object_transfer_chunk_bytes
+        first = await client.call("get_object_chunk",
+                                  {"oid": oid_hex, "offset": 0, "size": chunk})
+        if "data" not in first:
+            return None
+        total = first["total"]
+        if total <= len(first["data"]):
+            self.store.write_whole(oid, first["data"])
+            return total
+        buf = self.store.create(oid, total)
+        try:
+            n = len(first["data"])
+            buf[:n] = first["data"]
+            off = n
+            while off < total:
+                r = await client.call(
+                    "get_object_chunk",
+                    {"oid": oid_hex, "offset": off, "size": chunk})
+                data = r.get("data")
+                if not data:  # source freed/evicted mid-transfer
+                    raise ConnectionError("chunk source went away")
+                buf[off:off + len(data)] = data
+                off += len(data)
+            self.store.seal(oid)
+            return total
+        except Exception:
+            self.store.delete(oid)  # drop the partial .building file
+            raise
+
     async def rpc_fetch_object(self, p):
         """Pull an object to this node's store (reference: PullManager →
         remote ObjectManager chunked push). Resolution: local shm → local
@@ -763,19 +807,39 @@ class Raylet:
         if await self._restore_from_spill(oid_hex):
             self._refresh_pin(oid_hex)
             return {"ok": True}
+        inflight = self._pulls.get(oid_hex)
+        if inflight is not None:  # join the pull already transferring this
+            reply = await asyncio.shield(inflight)
+            if reply.get("ok"):
+                self._refresh_pin(oid_hex)
+            return reply
+        fut = asyncio.get_running_loop().create_future()
+        self._pulls[oid_hex] = fut
+        try:
+            reply = await self._do_fetch(oid, oid_hex,
+                                         p.get("timeout", 30.0))
+        except Exception as e:  # noqa: BLE001 — joiners need a result too
+            reply = {"error": "unavailable", "oid": oid_hex,
+                     "message": repr(e)}
+        finally:
+            self._pulls.pop(oid_hex, None)
+            if not fut.done():
+                fut.set_result(reply)
+        return reply
+
+    async def _do_fetch(self, oid, oid_hex: str, timeout: float) -> Dict:
         reply = await self._gcs.call("get_object_locations", {
-            "oid": oid_hex, "wait": True, "timeout": p.get("timeout", 30.0)})
+            "oid": oid_hex, "wait": True, "timeout": timeout})
         for loc in reply["locations"]:
             if loc["node_id"] == self.node_id:
                 continue
             try:
                 client = await self._pool.get(loc["address"])
-                data = await client.call("get_object_payload", {"oid": oid_hex})
-                if "payload" in data:
-                    self.store.write_whole(oid, data["payload"])
+                total = await self._pull_chunked(client, oid, oid_hex)
+                if total is not None:
                     self._refresh_pin(oid_hex)
                     await self.rpc_seal_object({"oid": oid_hex,
-                                                "size": len(data["payload"])})
+                                                "size": total})
                     return {"ok": True}
             except Exception:
                 continue

@@ -274,6 +274,7 @@ class EventLoopThread:
 
     def __init__(self, name: str = "rt-io"):
         self.loop = asyncio.new_event_loop()
+        self._stopped = False
         self._thread = threading.Thread(target=self._run, daemon=True, name=name)
         self._thread.start()
 
@@ -282,14 +283,27 @@ class EventLoopThread:
         self.loop.run_forever()
 
     def run(self, coro, timeout: Optional[float] = None):
-        """Run a coroutine on the loop from a sync thread; block for result."""
+        """Run a coroutine on the loop from a sync thread; block for result.
+
+        Fails FAST once the loop is stopped: run_coroutine_threadsafe on a
+        no-longer-spinning loop returns a future that never resolves, and a
+        caller blocked on it forever while holding a lock is a process-wide
+        deadlock (stale serve pollers held _controller_lock this way)."""
+        if self._stopped or self.loop.is_closed():
+            coro.close()
+            raise RuntimeError("event loop thread is stopped")
         fut = asyncio.run_coroutine_threadsafe(coro, self.loop)
         return fut.result(timeout)
 
     def spawn(self, coro) -> None:
+        if self._stopped or self.loop.is_closed():
+            coro.close()
+            return
         asyncio.run_coroutine_threadsafe(coro, self.loop)
 
     def stop(self) -> None:
+        self._stopped = True  # run()/spawn() fail fast from here on
+
         # Cancel and drain outstanding tasks first so the loop doesn't warn
         # "Task was destroyed but it is pending!" at GC time.
         async def _drain():

@@ -408,9 +408,11 @@ class ClusterBackend(RuntimeBackend):
     async def _reconstruct(self, oid_hex: str) -> None:
         """Re-execute the creating task to regenerate a lost return object
         (same task_id => same deterministic return ObjectIDs). Concurrent
-        getters of the same lost object join one resubmission. Single-level:
-        if the creating task's own ref args are also lost, the re-execution
-        fails and the loss surfaces as the task's error."""
+        getters of the same lost object join one resubmission. Chains
+        recover multi-level: the re-executed task's arg resolution runs in
+        its worker, whose get falls back to the OWNER of each lost arg with
+        ``lost=True`` — and that owner reconstructs from its own lineage
+        (reference: recursive recovery, ``object_recovery_manager.h:68-94``)."""
         existing = self._reconstructing.get(oid_hex)
         if existing is not None:
             await asyncio.shield(existing)

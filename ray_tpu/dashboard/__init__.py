@@ -1,0 +1,10 @@
+"""Dashboard: REST state API over HTTP.
+
+Reference analog: ``dashboard/`` — ``head.py`` (aiohttp ``DashboardHead``)
++ modules (actor/node/job/metrics/state). Redesign: no separate process
+tree or React client; one actor serves the REST surface straight from GCS
+RPCs and the metrics KV (the reference's ``state_aggregator.py`` role), and
+the CLI (`rt dashboard`) starts it on demand.
+"""
+
+from ray_tpu.dashboard.head import start_dashboard  # noqa: F401

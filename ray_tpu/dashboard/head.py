@@ -1,0 +1,136 @@
+"""DashboardActor: aiohttp REST endpoints over the cluster's state.
+
+Reference analogs: ``dashboard/head.py`` (aiohttp app + module routes),
+``dashboard/state_aggregator.py`` + ``python/ray/util/state/api.py`` (the
+State API), ``dashboard/modules/metrics`` (Prometheus). Routes:
+
+  GET /api/version              build/version info
+  GET /api/nodes                node table
+  GET /api/actors               actor table
+  GET /api/placement_groups     placement groups
+  GET /api/tasks                recent task events
+  GET /api/objects              object directory
+  GET /api/jobs                 submitted jobs
+  GET /api/cluster_resources    total/available
+  GET /metrics                  Prometheus text page
+  GET /-/healthz                liveness
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from typing import Any, Dict, Optional
+
+import ray_tpu
+
+
+@ray_tpu.remote
+class DashboardActor:
+    def __init__(self):
+        self._runner = None
+        self._port: Optional[int] = None
+
+    async def start(self, host: str, port: int) -> int:
+        from aiohttp import web
+
+        if self._port is not None:
+            return self._port  # idempotent: already serving
+        app = web.Application()
+        app.router.add_get("/-/healthz", self._healthz)
+        app.router.add_get("/api/version", self._version)
+        app.router.add_get("/api/nodes", self._gcs_list("list_nodes"))
+        app.router.add_get("/api/actors", self._gcs_list("list_actors"))
+        app.router.add_get("/api/placement_groups",
+                           self._gcs_list("list_placement_groups"))
+        app.router.add_get("/api/tasks", self._gcs_list("list_tasks"))
+        app.router.add_get("/api/objects", self._gcs_list("list_objects"))
+        app.router.add_get("/api/cluster_resources", self._cluster_resources)
+        app.router.add_get("/api/jobs", self._jobs)
+        app.router.add_get("/metrics", self._metrics)
+        self._runner = web.AppRunner(app, access_log=None)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, host, port)
+        await site.start()
+        self._port = site._server.sockets[0].getsockname()[1]
+        return self._port
+
+    async def stop(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+            self._runner = None
+
+    # -- handlers -------------------------------------------------------------
+    async def _healthz(self, request):
+        from aiohttp import web
+
+        return web.Response(text="ok")
+
+    async def _version(self, request):
+        from aiohttp import web
+
+        import ray_tpu as rt
+
+        return web.json_response({"version": getattr(rt, "__version__", "dev"),
+                                  "framework": "ray_tpu"})
+
+    def _backend(self):
+        return ray_tpu.global_worker()._require_backend()
+
+    def _gcs_list(self, method: str):
+        async def handler(request):
+            from aiohttp import web
+
+            loop = asyncio.get_running_loop()
+            limit = int(request.query.get("limit", 1000))
+            rows = await loop.run_in_executor(
+                None, lambda: self._backend().io.run(
+                    self._backend()._gcs.call(method, {"limit": limit})))
+            return web.json_response(rows, dumps=_dumps)
+
+        return handler
+
+    async def _cluster_resources(self, request):
+        from aiohttp import web
+
+        loop = asyncio.get_running_loop()
+        out = await loop.run_in_executor(
+            None, lambda: self._backend().io.run(
+                self._backend()._gcs.call("cluster_resources", {})))
+        return web.json_response(out, dumps=_dumps)
+
+    async def _jobs(self, request):
+        from aiohttp import web
+
+        from ray_tpu.job import list_jobs
+
+        loop = asyncio.get_running_loop()
+        jobs = await loop.run_in_executor(None, list_jobs)
+        return web.json_response(jobs, dumps=_dumps)
+
+    async def _metrics(self, request):
+        from aiohttp import web
+
+        from ray_tpu.util.metrics import metrics_text
+
+        loop = asyncio.get_running_loop()
+        text = await loop.run_in_executor(None, metrics_text)
+        return web.Response(text=text, content_type="text/plain")
+
+
+def _dumps(obj: Any) -> str:
+    return json.dumps(obj, default=str)
+
+
+_DASHBOARD_NAME = "RT_DASHBOARD"
+
+
+def start_dashboard(host: str = "127.0.0.1", port: int = 0) -> int:
+    """Start (or find) the dashboard actor; returns the HTTP port."""
+    try:
+        actor = ray_tpu.get_actor(_DASHBOARD_NAME, namespace="_rt_dashboard")
+    except ValueError:
+        actor = DashboardActor.options(
+            name=_DASHBOARD_NAME, namespace="_rt_dashboard",
+            lifetime="detached", num_cpus=0, max_concurrency=32).remote()
+    return ray_tpu.get(actor.start.remote(host, port))

@@ -23,10 +23,14 @@ from ray_tpu.data.dataset import (  # noqa: F401
     from_numpy,
     from_pandas,
     range,
+    read_binary_files,
     read_csv,
+    read_images,
     read_json,
     read_numpy,
     read_parquet,
+    read_sql,
+    read_text,
 )
 from ray_tpu.data.iterator import DataIterator  # noqa: F401
 from ray_tpu.data.logical import ActorPoolStrategy  # noqa: F401

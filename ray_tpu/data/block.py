@@ -79,12 +79,17 @@ def concat(blocks: List[Block]) -> Block:
     return {k: np.concatenate([b[k] for b in blocks]) for k in keys}
 
 
+def _row_value(v: Any) -> Any:
+    # object-dtype columns (text/bytes) index to plain python values
+    shape = getattr(v, "shape", None)
+    return v.item() if shape == () else v
+
+
 def iter_rows(block: Block) -> Iterator[Dict[str, Any]]:
     n = num_rows(block)
     keys = list(block.keys())
     for i in range(n):
-        yield {k: block[k][i].item() if block[k][i].shape == () else block[k][i]
-               for k in keys}
+        yield {k: _row_value(block[k][i]) for k in keys}
 
 
 def to_pandas(block: Block):

@@ -395,3 +395,19 @@ def read_json(paths, *, lines: bool = True) -> Dataset:
 
 def read_numpy(paths, column: str = "data") -> Dataset:
     return Dataset(ds_mod.numpy_read_tasks(paths, column))
+
+
+def read_text(paths, *, drop_empty_lines: bool = True) -> Dataset:
+    return Dataset(ds_mod.text_read_tasks(paths, drop_empty_lines))
+
+
+def read_binary_files(paths, *, include_paths: bool = False) -> Dataset:
+    return Dataset(ds_mod.binary_read_tasks(paths, include_paths))
+
+
+def read_sql(sql: str, connection_factory) -> Dataset:
+    return Dataset(ds_mod.sql_read_tasks(sql, connection_factory))
+
+
+def read_images(paths, *, size=None, mode: str = "RGB") -> Dataset:
+    return Dataset(ds_mod.images_read_tasks(paths, size, mode))

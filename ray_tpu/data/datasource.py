@@ -122,6 +122,94 @@ def numpy_read_tasks(paths, column: str = "data") -> List[ReadTask]:
     return [make(p) for p in files]
 
 
+def text_read_tasks(paths, drop_empty: bool = True) -> List[ReadTask]:
+    """One row per line (reference: ``read_text``)."""
+    files = expand_paths(paths)
+
+    def make(path):
+        def read() -> B.Block:
+            with open(path, "r", errors="replace") as f:
+                lines = [ln.rstrip("\n") for ln in f]
+            if drop_empty:
+                lines = [ln for ln in lines if ln]
+            return {"text": np.asarray(lines, dtype=object)}
+
+        return read
+
+    return [make(p) for p in files]
+
+
+def binary_read_tasks(paths, include_paths: bool = False) -> List[ReadTask]:
+    """One row per file with raw bytes (reference: ``read_binary_files`` —
+    the substrate image/webdataset readers decode from)."""
+    files = expand_paths(paths)
+
+    def make(path):
+        def read() -> B.Block:
+            with open(path, "rb") as f:
+                data = f.read()
+            block: B.Block = {"bytes": np.asarray([data], dtype=object)}
+            if include_paths:
+                block["path"] = np.asarray([path], dtype=object)
+            return block
+
+        return read
+
+    return [make(p) for p in files]
+
+
+def sql_read_tasks(sql: str, connection_factory) -> List[ReadTask]:
+    """Rows from a DB-API connection (reference: ``read_sql``); the factory
+    runs IN the read task so connections are per-worker."""
+
+    def read() -> B.Block:
+        conn = connection_factory()
+        try:
+            cur = conn.cursor()
+            cur.execute(sql)
+            names = [d[0] for d in cur.description]
+            rows = cur.fetchall()
+        finally:
+            conn.close()
+        cols: dict = {n: [] for n in names}
+        for row in rows:
+            for n, v in zip(names, row):
+                cols[n].append(v)
+        return {n: np.asarray(v) for n, v in cols.items()}
+
+    return [read]
+
+
+def images_read_tasks(paths, size=None, mode: str = "RGB") -> List[ReadTask]:
+    """Decoded image arrays, one row per file (reference: ``read_images``).
+    Requires PIL; raises a clear error when absent."""
+    files = expand_paths(paths)
+
+    def make(path):
+        def read() -> B.Block:
+            try:
+                from PIL import Image
+            except ImportError as e:  # pragma: no cover - env-dependent
+                raise ImportError(
+                    "read_images requires pillow (PIL)") from e
+            img = Image.open(path).convert(mode)
+            if size is not None:
+                img = img.resize(tuple(size))
+                image_col = np.asarray(img)[None, ...]
+            else:
+                # variable-size images can't share a dense [N,H,W,C] column
+                # (block concat needs matching trailing dims) — store each
+                # as an object cell, like read_binary_files
+                image_col = np.empty(1, dtype=object)
+                image_col[0] = np.asarray(img)
+            return {"image": image_col,
+                    "path": np.asarray([path], dtype=object)}
+
+        return read
+
+    return [make(p) for p in files]
+
+
 # ---- writers (run as remote tasks, one file per block) ----
 
 

@@ -300,6 +300,17 @@ def cmd_list(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_metrics(args: argparse.Namespace) -> int:
+    from ray_tpu.util.metrics import metrics_text
+
+    rt = _attach_driver(args.address)
+    try:
+        print(metrics_text(), end="")
+        return 0
+    finally:
+        rt.shutdown()
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="rt")
     sub = parser.add_subparsers(dest="cmd", required=True)
@@ -348,6 +359,17 @@ def main(argv=None) -> int:
     p_list.add_argument("--address", default=None)
     p_list.add_argument("--limit", type=int, default=200)
     p_list.set_defaults(fn=cmd_list)
+
+    p_micro = sub.add_parser("microbenchmark",
+                             help="core-ops throughput sweep")
+    p_micro.set_defaults(fn=lambda a: __import__(
+        "ray_tpu.scripts.microbenchmark",
+        fromlist=["main"]).main(a))
+
+    p_metrics = sub.add_parser("metrics",
+                               help="aggregated Prometheus metrics page")
+    p_metrics.add_argument("--address", default=None)
+    p_metrics.set_defaults(fn=cmd_metrics)
 
     args = parser.parse_args(argv)
     if args.cmd == "start" and not args.head and not args.address:

@@ -1,0 +1,105 @@
+"""``rt microbenchmark`` — core-ops throughput/latency sweep.
+
+Reference analog: ``ray microbenchmark`` (``_private/ray_perf.py:93-311``):
+small-op throughputs for put/get, task submission, and actor calls, printed
+one line per benchmark.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, List, Tuple
+
+import numpy as np
+
+
+def _timeit(name: str, fn: Callable[[], int], min_seconds: float = 2.0
+            ) -> Tuple[str, float]:
+    """fn() runs one batch and returns the op count; loops until the clock
+    budget is spent, reports ops/s."""
+    fn()  # warmup
+    total_ops = 0
+    t0 = time.perf_counter()
+    while time.perf_counter() - t0 < min_seconds:
+        total_ops += fn()
+    dt = time.perf_counter() - t0
+    rate = total_ops / dt
+    print(f"{name:55s} {rate:12.1f} ops/s")
+    return name, rate
+
+
+def main(args=None) -> int:
+    import ray_tpu
+
+    started_here = False
+    if not ray_tpu.is_initialized():
+        ray_tpu.init()
+        started_here = True
+    results: List[Tuple[str, float]] = []
+
+    try:
+        # ---- object plane ---------------------------------------------------
+        small = b"x" * 1024
+        results.append(_timeit(
+            "put small object (1KB, memory store)",
+            lambda: sum(1 for _ in range(100) if ray_tpu.put(small))))
+
+        big = np.zeros(256 * 1024, dtype=np.float32)  # 1MB -> plasma
+        results.append(_timeit(
+            "put 1MB numpy (plasma)",
+            lambda: sum(1 for _ in range(20) if ray_tpu.put(big))))
+
+        ref_small = ray_tpu.put(small)
+        results.append(_timeit(
+            "get small object",
+            lambda: sum(1 for _ in range(100)
+                        if ray_tpu.get(ref_small) is not None)))
+
+        ref_big = ray_tpu.put(big)
+        results.append(_timeit(
+            "get 1MB numpy (zero-copy shm)",
+            lambda: sum(1 for _ in range(50)
+                        if ray_tpu.get(ref_big) is not None)))
+
+        # ---- tasks -----------------------------------------------------------
+        @ray_tpu.remote
+        def nop():
+            return b"ok"
+
+        def task_batch():
+            ray_tpu.get([nop.remote() for _ in range(20)])
+            return 20
+
+        results.append(_timeit("task submit+get (pipelined x20)", task_batch))
+
+        # ---- actors ----------------------------------------------------------
+        @ray_tpu.remote
+        class A:
+            def m(self):
+                return b"ok"
+
+        a = A.remote()
+        ray_tpu.get(a.m.remote())
+
+        def actor_sync():
+            for _ in range(20):
+                ray_tpu.get(a.m.remote())
+            return 20
+
+        results.append(_timeit("actor call sync (1 in flight)", actor_sync))
+
+        def actor_async():
+            ray_tpu.get([a.m.remote() for _ in range(50)])
+            return 50
+
+        results.append(_timeit("actor call async (50 in flight)", actor_async))
+    finally:
+        if started_here:
+            ray_tpu.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

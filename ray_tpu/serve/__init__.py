@@ -25,13 +25,16 @@ from ray_tpu.serve.api import _forget_controller as _forget_controller_for_tests
 from ray_tpu.serve.batching import batch
 from ray_tpu.serve.config import (AutoscalingConfig, DeploymentConfig,
                                   HTTPOptions)
-from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse
+from ray_tpu.serve.handle import (DeploymentHandle, DeploymentResponse,
+                                  DeploymentResponseGenerator)
+from ray_tpu.serve.multiplex import get_multiplexed_model_id, multiplexed
 from ray_tpu.serve.proxy import ServeRequest
 
 __all__ = [
     "Application", "AutoscalingConfig", "Deployment", "DeploymentConfig",
-    "DeploymentHandle", "DeploymentResponse", "HTTPOptions", "ServeRequest",
+    "DeploymentHandle", "DeploymentResponse", "DeploymentResponseGenerator",
+    "HTTPOptions", "ServeRequest",
     "batch", "delete", "deployment", "get_app_handle",
-    "get_deployment_handle", "http_port", "run", "shutdown", "start",
-    "status",
+    "get_deployment_handle", "get_multiplexed_model_id", "http_port",
+    "multiplexed", "run", "shutdown", "start", "status",
 ]

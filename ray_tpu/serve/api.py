@@ -23,21 +23,30 @@ _controller = None
 
 
 def _get_controller(create: bool = False):
-    """The singleton controller actor (named, discovered via get_actor)."""
+    """The singleton controller actor (named, discovered via get_actor).
+
+    RPCs run OUTSIDE _controller_lock: a caller blocked in get_actor (e.g.
+    a stale router poller racing a shutdown) must never wedge every other
+    serve call behind the lock."""
     global _controller
     with _controller_lock:
         if _controller is not None:
             return _controller
-        try:
-            _controller = ray_tpu.get_actor(CONTROLLER_NAME)
-        except Exception:  # noqa: BLE001 — not created yet
-            if not create:
-                raise RuntimeError(
-                    "serve is not running (no controller); call serve.run() "
-                    "or serve.start() first") from None
-            _controller = ServeController.options(
-                name=CONTROLLER_NAME, max_concurrency=32,
-                num_cpus=0).remote()
+    try:
+        found = ray_tpu.get_actor(CONTROLLER_NAME)
+    except Exception:  # noqa: BLE001 — not created yet
+        if not create:
+            raise RuntimeError(
+                "serve is not running (no controller); call serve.run() "
+                "or serve.start() first") from None
+        # long-poll calls (get_replicas/get_routing_table wait=True)
+        # each hold an actor thread — size the pool for many routers
+        found = ServeController.options(
+            name=CONTROLLER_NAME, max_concurrency=256,
+            num_cpus=0, get_if_exists=True).remote()
+    with _controller_lock:
+        if _controller is None:
+            _controller = found
         return _controller
 
 

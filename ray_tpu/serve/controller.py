@@ -55,6 +55,10 @@ class _DeploymentState:
         self.init_args = init_args
         self.init_kwargs = init_kwargs
         self.replicas: Dict[str, _ReplicaInfo] = {}
+        # Replica-set version: assigned from the controller's GLOBAL counter
+        # so versions stay monotonic across redeploys of the same name — a
+        # long-polling router must never see a fresh state reuse a version
+        # it already knows.
         self.version = 0
         self.next_replica_idx = 0
         # autoscaling bookkeeping
@@ -104,9 +108,11 @@ class _DeploymentState:
 class ServeController:
     def __init__(self):
         self._lock = threading.RLock()
+        self._update_cond = threading.Condition(self._lock)
         self._apps: Dict[str, Dict[str, Any]] = {}
         self._deployments: Dict[Tuple[str, str], _DeploymentState] = {}
         self._routing_version = 0
+        self._version_counter = 0
         self._proxy = None
         self._proxy_port: Optional[int] = None
         self._shutdown = False
@@ -168,23 +174,52 @@ class ServeController:
     # -- routing --------------------------------------------------------------
     def _bump_routing(self) -> None:
         self._routing_version += 1
+        self._update_cond.notify_all()
+
+    def _next_version(self) -> int:
+        self._version_counter += 1
+        self._update_cond.notify_all()
+        return self._version_counter
 
     def get_replicas(self, app_name: str, deployment: str,
-                     known_version: int) -> Dict[str, Any]:
-        with self._lock:
-            s = self._deployments.get((app_name, deployment))
-            if s is None:
-                return {"version": known_version, "replicas": []}
-            return {"version": s.version,
-                    "replicas": [(r.replica_id, r.handle)
-                                 for r in s.replicas.values()]}
+                     known_version: int, wait: bool = False,
+                     timeout: float = 10.0) -> Dict[str, Any]:
+        """``wait=True`` long-polls: block until the replica set's version
+        moves past ``known_version`` or the timeout lapses (reference:
+        ``LongPollHost``, ``serve/_private/long_poll.py`` — handles hold ONE
+        blocked call instead of TTL-polling). Runs on the controller's actor
+        thread pool, so blocking here is legal and local; version bumps
+        ``notify_all`` the condition, so waiters wake immediately."""
+        deadline = time.time() + timeout
+        with self._update_cond:
+            while True:
+                s = self._deployments.get((app_name, deployment))
+                version = s.version if s is not None else known_version
+                remaining = deadline - time.time()
+                if (not wait or version != known_version
+                        or remaining <= 0 or self._shutdown):
+                    if s is None:
+                        return {"version": known_version, "replicas": []}
+                    return {"version": s.version,
+                            "replicas": [(r.replica_id, r.handle)
+                                         for r in s.replicas.values()]}
+                self._update_cond.wait(remaining)
 
-    def get_routing_table(self) -> Dict[str, Any]:
-        """For proxies: route_prefix -> (app, ingress deployment)."""
-        with self._lock:
-            return {"version": self._routing_version,
-                    "routes": {meta["route_prefix"]: (app, meta["ingress"])
-                               for app, meta in self._apps.items()}}
+    def get_routing_table(self, known_version: int = -1, wait: bool = False,
+                          timeout: float = 10.0) -> Dict[str, Any]:
+        """For proxies: route_prefix -> (app, ingress deployment); long-polls
+        like ``get_replicas`` when ``wait=True``."""
+        deadline = time.time() + timeout
+        with self._update_cond:
+            while True:
+                remaining = deadline - time.time()
+                if (not wait or self._routing_version != known_version
+                        or remaining <= 0 or self._shutdown):
+                    return {
+                        "version": self._routing_version,
+                        "routes": {meta["route_prefix"]: (app, meta["ingress"])
+                                   for app, meta in self._apps.items()}}
+                self._update_cond.wait(remaining)
 
     def wake(self, app_name: str, deployment: str) -> None:
         with self._lock:
@@ -280,7 +315,7 @@ class ServeController:
                 continue
             with self._lock:
                 s.replicas[rid] = _ReplicaInfo(rid, handle)
-                s.version += 1
+                s.version = self._next_version()
                 self._bump_routing()
 
     def _poll_metrics(self, s: _DeploymentState, now: float) -> None:
@@ -324,7 +359,7 @@ class ServeController:
             if not ok:
                 with self._lock:
                     s.replicas.pop(r.replica_id, None)
-                    s.version += 1
+                    s.version = self._next_version()
                     self._bump_routing()
                 try:
                     ray_tpu.kill(r.handle)
@@ -346,7 +381,7 @@ class ServeController:
                          key=lambda r: r.last_ongoing)[:n]
         for r in victims:
             del s.replicas[r.replica_id]
-            s.version += 1
+            s.version = self._next_version()
             self._bump_routing()
             threading.Thread(
                 target=self._drain_and_kill,
@@ -378,11 +413,13 @@ class ServeController:
             except Exception:  # noqa: BLE001
                 pass
         s.replicas.clear()
-        s.version += 1
+        s.version = self._next_version()
         self._bump_routing()
 
     def shutdown(self) -> None:
         self._shutdown = True
+        with self._update_cond:
+            self._update_cond.notify_all()  # release blocked long-polls
         with self._lock:
             for key in list(self._deployments):
                 self._stop_deployment(self._deployments.pop(key))

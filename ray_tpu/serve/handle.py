@@ -25,7 +25,8 @@ import ray_tpu
 from ray_tpu.exceptions import ActorError
 from ray_tpu.serve.replica import REJECTED
 
-_REFRESH_TTL_S = 1.0
+_REFRESH_TTL_S = 30.0   # fallback only — the long-poll thread pushes updates
+_LONG_POLL_TIMEOUT_S = 10.0
 _RETRY_BACKOFF_S = 0.02
 _COLD_START_TIMEOUT_S = 60.0
 
@@ -81,21 +82,57 @@ class _RouterState:
         self.version = -1
         self.replicas: List[Tuple[str, Any]] = []  # (replica_id, actor handle)
         self.counts: Dict[str, int] = {}
+        self.model_ids: Dict[str, List[str]] = {}  # replica -> loaded models
         self.fetched_at = 0.0
         self.lock = threading.Lock()
+        self._poller: Optional[threading.Thread] = None
+        self._poller_stop = threading.Event()
 
     def _controller(self):
         from ray_tpu.serve.api import _get_controller
 
         return _get_controller()
 
-    def refresh(self, force: bool = False) -> None:
-        now = time.time()
+    def _ensure_poller(self) -> None:
+        """Long-poll push of the replica set (reference: LongPollClient,
+        ``serve/_private/long_poll.py``): ONE outstanding blocked RPC per
+        router instead of a 1s TTL poll per call. Locked: concurrent
+        refresh() callers must not each start an (unstoppable) duplicate."""
         with self.lock:
-            if not force and now - self.fetched_at < _REFRESH_TTL_S:
+            if self._poller is not None and self._poller.is_alive():
                 return
-        snap = ray_tpu.get(self._controller().get_replicas.remote(
-            self.app, self.deployment, self.version))
+            self._poller_stop.clear()
+            self._poller = threading.Thread(
+                target=self._poll_loop, daemon=True,
+                name=f"rt-serve-poll-{self.app}-{self.deployment}")
+            self._poller.start()
+
+    def _poll_loop(self) -> None:
+        failures = 0
+        while not self._poller_stop.is_set():
+            try:
+                snap = ray_tpu.get(
+                    self._controller().get_replicas.remote(
+                        self.app, self.deployment, self.version,
+                        wait=True, timeout=_LONG_POLL_TIMEOUT_S),
+                    timeout=_LONG_POLL_TIMEOUT_S + 10)
+                self._apply(snap)
+                failures = 0
+            except Exception as e:
+                msg = str(e)
+                if ("serve is not running" in msg
+                        or "event loop thread is stopped" in msg):
+                    return  # backend/controller torn down: die NOW
+                failures += 1
+                if failures >= 10:
+                    # controller gone (serve.shutdown / cluster teardown):
+                    # exit instead of spinning forever; the next refresh()
+                    # lazily restarts a poller if serve comes back
+                    return
+                if self._poller_stop.wait(1.0):
+                    return
+
+    def _apply(self, snap: Dict) -> None:
         with self.lock:
             self.fetched_at = time.time()
             if snap["version"] != self.version:
@@ -103,6 +140,19 @@ class _RouterState:
                 self.replicas = snap["replicas"]
                 self.counts = {rid: self.counts.get(rid, 0)
                                for rid, _ in self.replicas}
+                self.model_ids = {
+                    rid: self.model_ids.get(rid, [])
+                    for rid, _ in self.replicas}
+
+    def refresh(self, force: bool = False) -> None:
+        self._ensure_poller()
+        now = time.time()
+        with self.lock:
+            if not force and now - self.fetched_at < _REFRESH_TTL_S:
+                return
+        snap = ray_tpu.get(self._controller().get_replicas.remote(
+            self.app, self.deployment, self.version))
+        self._apply(snap)
 
     def wake_and_wait(self) -> None:
         """Scale-to-zero cold start: ask the controller for capacity and
@@ -118,12 +168,19 @@ class _RouterState:
             f"no replicas for {self.app}/{self.deployment} after "
             f"{_COLD_START_TIMEOUT_S}s")
 
-    def pick(self) -> Tuple[str, Any]:
-        """Power-of-two-choices by local in-flight count."""
+    def pick(self, model_id: Optional[str] = None) -> Tuple[str, Any]:
+        """Power-of-two-choices by local in-flight count; with a multiplexed
+        model id, replicas already holding the model win (reference:
+        model-id-aware routing in the handle, ``serve/multiplex.py``)."""
         with self.lock:
             reps = self.replicas
             if not reps:
                 raise LookupError("no replicas")
+            if model_id:
+                holding = [r for r in reps
+                           if model_id in self.model_ids.get(r[0], ())]
+                if holding:
+                    reps = holding
             if len(reps) == 1:
                 choice = reps[0]
             else:
@@ -133,7 +190,8 @@ class _RouterState:
             self.counts[choice[0]] = self.counts.get(choice[0], 0) + 1
             return choice
 
-    def complete(self, replica_id: str, rejected_ongoing: Optional[int] = None):
+    def complete(self, replica_id: str, rejected_ongoing: Optional[int] = None,
+                 model_ids: Optional[List[str]] = None):
         with self.lock:
             if rejected_ongoing is not None:
                 # replica told us its real queue depth — adopt it
@@ -141,6 +199,14 @@ class _RouterState:
             else:
                 self.counts[replica_id] = max(
                     0, self.counts.get(replica_id, 1) - 1)
+            if model_ids is not None:
+                self.model_ids[replica_id] = model_ids
+
+    def note_models(self, replica_id: str, model_ids: Optional[List[str]]):
+        if model_ids is None:
+            return
+        with self.lock:
+            self.model_ids[replica_id] = model_ids
 
 
 # one shared pool for all sync-path handle calls in this process
@@ -157,17 +223,95 @@ def _shared_pool() -> ThreadPoolExecutor:
         return _pool
 
 
+class DeploymentResponseGenerator:
+    """Iterator over a streaming deployment response: pulls chunk batches
+    from the replica's response stream (reference: streamed handle results,
+    ``serve/_private/replica.py:346``)."""
+
+    def __init__(self, router: "_RouterState", rid: str, actor,
+                 stream_id: str):
+        self._router = router
+        self._rid = rid
+        self._actor = actor
+        self._stream_id = stream_id
+        self._buf: List[Any] = []
+        self._done = False
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        while not self._buf:
+            if self._done:
+                raise StopIteration
+            try:
+                items, done = ray_tpu.get(self._actor.next_chunks.remote(
+                    self._stream_id))
+            except Exception:
+                self._done = True
+                self._router.complete(self._rid)
+                raise
+            self._buf.extend(items)
+            if done:
+                self._done = True
+                self._router.complete(self._rid)
+                if not self._buf:
+                    raise StopIteration
+        return self._buf.pop(0)
+
+    def __aiter__(self):
+        return self
+
+    _END = object()
+
+    def _next_or_end(self):
+        # StopIteration cannot cross an executor future (py3.12 turns it
+        # into RuntimeError); translate to a sentinel on the worker side
+        try:
+            return self.__next__()
+        except StopIteration:
+            return self._END
+
+    async def __anext__(self):
+        loop = asyncio.get_running_loop()
+        item = await loop.run_in_executor(None, self._next_or_end)
+        if item is self._END:
+            raise StopAsyncIteration
+        return item
+
+    def cancel(self) -> None:
+        if not self._done:
+            self._done = True
+            self._router.complete(self._rid)
+            self._actor.cancel_stream.remote(self._stream_id)
+
+    def __del__(self):
+        # abandoned mid-iteration (early break): release the router's
+        # in-flight slot and the replica's suspended generator
+        try:
+            self.cancel()
+        except Exception:  # noqa: BLE001 — interpreter teardown
+            pass
+
+
 class DeploymentHandle:
     def __init__(self, app_name: str, deployment_name: str,
-                 method_name: str = "__call__"):
+                 method_name: str = "__call__",
+                 multiplexed_model_id: str = ""):
         self.app_name = app_name
         self.deployment_name = deployment_name
         self._method = method_name
+        self._model_id = multiplexed_model_id
         self._router = _RouterState(app_name, deployment_name)
 
     # composition: handle.other_method.remote(...)
-    def options(self, *, method_name: str) -> "DeploymentHandle":
-        h = DeploymentHandle(self.app_name, self.deployment_name, method_name)
+    def options(self, *, method_name: Optional[str] = None,
+                multiplexed_model_id: Optional[str] = None) -> "DeploymentHandle":
+        h = DeploymentHandle(
+            self.app_name, self.deployment_name,
+            method_name if method_name is not None else self._method,
+            multiplexed_model_id if multiplexed_model_id is not None
+            else self._model_id)
         h._router = self._router  # share the replica cache + counts
         return h
 
@@ -184,17 +328,18 @@ class DeploymentHandle:
         router = self._router
         backoff = _RETRY_BACKOFF_S
         deadline = time.time() + _COLD_START_TIMEOUT_S
+        meta = {"model_id": self._model_id} if self._model_id else None
         while True:
             router.refresh()
             if not router.replicas:
                 router.wake_and_wait()
             try:
-                rid, actor = router.pick()
+                rid, actor = router.pick(self._model_id or None)
             except LookupError:
                 continue
             try:
-                status, payload = ray_tpu.get(actor.handle_request.remote(
-                    self._method, args, kwargs))
+                reply = ray_tpu.get(actor.handle_request.remote(
+                    self._method, args, kwargs, meta))
             except ActorError:
                 # stale cache: drop this replica and re-route (with the same
                 # backoff/deadline as rejection — a dead replica stays in the
@@ -208,6 +353,8 @@ class DeploymentHandle:
                 backoff = min(backoff * 1.5, 0.25)
                 router.refresh(force=True)
                 continue
+            status, payload = reply[0], reply[1]
+            models = reply[2] if len(reply) > 2 else None
             if status == REJECTED:
                 router.complete(rid, rejected_ongoing=payload)
                 if time.time() > deadline:
@@ -218,12 +365,17 @@ class DeploymentHandle:
                 backoff = min(backoff * 1.5, 0.25)
                 router.refresh(force=backoff > 0.1)
                 continue
-            router.complete(rid)
+            if status == "stream":
+                # the generator keeps the in-flight slot until it completes
+                router.note_models(rid, models)
+                return DeploymentResponseGenerator(router, rid, actor, payload)
+            router.complete(rid, model_ids=models)
             return payload
 
     def __reduce__(self):
         return (DeploymentHandle,
-                (self.app_name, self.deployment_name, self._method))
+                (self.app_name, self.deployment_name, self._method,
+                 self._model_id))
 
     def __repr__(self) -> str:
         return (f"DeploymentHandle({self.app_name}/{self.deployment_name}"

@@ -81,12 +81,15 @@ def _np_default(o):
 class ProxyActor:
     def __init__(self):
         self._routes: Dict[str, Tuple[str, str]] = {}
+        self._routes_version = -1
         self._routes_fetched = 0.0
         self._handles: Dict[Tuple[str, str], Any] = {}
         self._runner = None
         self._site = None
         self._port: Optional[int] = None
         self._requests_served = 0
+        self._poller_started = False
+        self._stopped = False
 
     async def start(self, host: str, port: int) -> int:
         from aiohttp import web
@@ -101,6 +104,7 @@ class ProxyActor:
         return self._port
 
     async def stop(self) -> None:
+        self._stopped = True
         if self._runner is not None:
             await self._runner.cleanup()
             self._runner = None
@@ -111,18 +115,34 @@ class ProxyActor:
         return _get_controller()
 
     async def _refresh_routes(self) -> None:
-        now = time.time()
-        if now - self._routes_fetched < _ROUTE_TTL_S:
-            return
-        # controller lookup + RPC are blocking (io.run) — they must never
-        # run on this worker's event loop, which services the RPC replies
-        loop = asyncio.get_running_loop()
-        table = await loop.run_in_executor(None, self._fetch_routes_blocking)
+        # long-poll push (reference: LongPollClient in the proxy): one
+        # blocked executor thread tracks the table; requests read the cache
+        if not self._poller_started:
+            self._poller_started = True
+            loop = asyncio.get_running_loop()
+            loop.run_in_executor(None, self._route_poll_loop)
+        if self._routes_fetched == 0.0:
+            # first request: fetch synchronously so routing is never empty
+            loop = asyncio.get_running_loop()
+            table = await loop.run_in_executor(
+                None, self._fetch_routes_blocking, False)
+            self._apply_routes(table)
+
+    def _apply_routes(self, table: Dict[str, Any]) -> None:
         self._routes = table["routes"]
+        self._routes_version = table["version"]
         self._routes_fetched = time.time()
 
-    def _fetch_routes_blocking(self) -> Dict[str, Any]:
-        return ray_tpu.get(self._controller().get_routing_table.remote())
+    def _route_poll_loop(self) -> None:
+        while not self._stopped:
+            try:
+                self._apply_routes(self._fetch_routes_blocking(True))
+            except Exception:
+                time.sleep(1.0)
+
+    def _fetch_routes_blocking(self, wait: bool) -> Dict[str, Any]:
+        return ray_tpu.get(self._controller().get_routing_table.remote(
+            self._routes_version if wait else -1, wait, 10.0))
 
     def _match(self, path: str) -> Optional[Tuple[str, str, str]]:
         """Longest-prefix route match -> (app, ingress, stripped_path)."""
@@ -170,9 +190,36 @@ class ProxyActor:
         except Exception as e:  # noqa: BLE001 — user code raised
             return web.Response(status=500, text=f"{type(e).__name__}: {e}")
         self._requests_served += 1
+        from ray_tpu.serve.handle import DeploymentResponseGenerator
+
+        if isinstance(result, DeploymentResponseGenerator):
+            return await self._stream_response(request, result)
         status, ctype, payload = _to_response(result)
         return web.Response(status=status, content_type=ctype.split(";")[0],
                             body=payload)
+
+    async def _stream_response(self, request, gen):
+        """Chunked transfer of a streaming deployment response (reference:
+        ``serve/_private/replica.py:346`` streamed ASGI messages). str/bytes
+        chunks pass through; other values are JSON-encoded, one per line."""
+        from aiohttp import web
+
+        resp = web.StreamResponse(
+            status=200, headers={"Content-Type": "application/octet-stream"})
+        await resp.prepare(request)
+        try:
+            async for chunk in gen:
+                if isinstance(chunk, str):
+                    chunk = chunk.encode()
+                elif not isinstance(chunk, (bytes, bytearray)):
+                    chunk = _json.dumps(chunk, default=_np_default).encode() \
+                        + b"\n"
+                await resp.write(chunk)
+        except Exception:  # noqa: BLE001 — mid-stream failure: cut the body
+            gen.cancel()
+        finally:
+            await resp.write_eof()
+        return resp
 
     def stats(self) -> Dict[str, Any]:
         return {"port": self._port, "requests_served": self._requests_served}

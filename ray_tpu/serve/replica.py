@@ -66,6 +66,8 @@ class ReplicaActor:
         self._exec = ThreadPoolExecutor(
             max_workers=max(1, max_ongoing_requests),
             thread_name_prefix="rt-replica")
+        self._streams: Dict[str, Any] = {}  # response streams being consumed
+        self._next_stream_id = 0
 
         body = body_ref
         init_args = _resolve_handle_markers(init_args)
@@ -83,13 +85,22 @@ class ReplicaActor:
             fn(user_config)
 
     async def handle_request(self, method_name: str, args: Tuple,
-                             kwargs: Dict) -> Tuple[str, Any]:
-        """Returns ("ok", result) or (REJECTED, ongoing_count)."""
+                             kwargs: Dict,
+                             meta: Optional[Dict] = None) -> Tuple:
+        """Returns ("ok", result, loaded_model_ids),
+        ("stream", stream_id, loaded_model_ids) for generator results, or
+        (REJECTED, ongoing_count)."""
         if self._ongoing >= self._max_ongoing:
             return (REJECTED, self._ongoing)
         self._ongoing += 1
         try:
+            import contextvars
             import functools
+
+            from ray_tpu.serve.multiplex import (
+                _current_model_id,
+                loaded_model_ids,
+            )
 
             target = self._instance
             if method_name != "__call__":
@@ -98,24 +109,97 @@ class ReplicaActor:
                     raise AttributeError(
                         f"deployment {self._deployment} has no method "
                         f"{method_name!r}")
-            loop = asyncio.get_running_loop()
-            result = await loop.run_in_executor(
-                self._exec, functools.partial(target, *args, **kwargs))
-            if inspect.isawaitable(result):
-                result = await result
+            token = _current_model_id.set((meta or {}).get("model_id", ""))
+            try:
+                # copy AFTER setting so the executor thread sees the model id
+                ctx = contextvars.copy_context()
+                loop = asyncio.get_running_loop()
+                result = await loop.run_in_executor(
+                    self._exec,
+                    functools.partial(ctx.run, target, *args, **kwargs))
+                if inspect.isawaitable(result):
+                    result = await result
+            finally:
+                _current_model_id.reset(token)
             self._total_served += 1
-            return ("ok", result)
+            models = loaded_model_ids(self._instance)
+            if inspect.isgenerator(result) or inspect.isasyncgen(result):
+                sid = f"s{self._next_stream_id}"
+                self._next_stream_id += 1
+                self._streams[sid] = result
+                # the stream HOLDS the in-flight slot until exhausted or
+                # cancelled: +1 here cancels the finally's -1, so ongoing
+                # counts active streams (admission control, autoscaler
+                # metrics, and prepare_shutdown draining all depend on it)
+                self._ongoing += 1
+                return ("stream", sid, models)
+            return ("ok", result, models)
         finally:
             self._ongoing -= 1
+
+    async def next_chunks(self, stream_id: str, max_items: int = 10) -> Tuple:
+        """Pull up to max_items from a response stream: (items, done).
+        A mid-stream exception travels as the last pull's error."""
+        import functools
+
+        it = self._streams.get(stream_id)
+        if it is None:
+            return ([], True)
+        items: List[Any] = []
+        loop = asyncio.get_running_loop()
+        try:
+            if inspect.isasyncgen(it):
+                for _ in range(max_items):
+                    try:
+                        items.append(await it.__anext__())
+                    except StopAsyncIteration:
+                        self._finish_stream(stream_id)
+                        return (items, True)
+            else:
+                def pull():
+                    out = []
+                    for _ in range(max_items):
+                        try:
+                            out.append(next(it))
+                        except StopIteration:
+                            return out, True
+                    return out, False
+
+                items, done = await loop.run_in_executor(
+                    self._exec, pull)
+                if done:
+                    self._finish_stream(stream_id)
+                    return (items, True)
+        except Exception:
+            self._finish_stream(stream_id)
+            raise
+        return (items, False)
+
+    def _finish_stream(self, stream_id: str) -> None:
+        if self._streams.pop(stream_id, None) is not None:
+            self._ongoing -= 1  # release the slot the stream was holding
+
+    def cancel_stream(self, stream_id: str) -> None:
+        it = self._streams.get(stream_id)
+        self._finish_stream(stream_id)
+        closer = getattr(it, "close", None)
+        if closer is not None:
+            try:
+                closer()
+            except Exception:  # noqa: BLE001
+                pass
 
     # -- controller-facing ----------------------------------------------------
     def ongoing_count(self) -> int:
         return self._ongoing
 
     def stats(self) -> Dict[str, Any]:
+        from ray_tpu.serve.multiplex import loaded_model_ids
+
         return {"replica_id": self._replica_id, "ongoing": self._ongoing,
                 "total_served": self._total_served,
-                "uptime_s": time.time() - self._started_at}
+                "uptime_s": time.time() - self._started_at,
+                "model_ids": loaded_model_ids(self._instance)}
 
     async def check_health(self) -> str:
         fn = getattr(self._instance, "check_health", None)

@@ -1,0 +1,148 @@
+"""multiprocessing.Pool API on top of tasks.
+
+Reference analog: ``python/ray/util/multiprocessing/`` — a drop-in Pool
+whose workers are cluster tasks instead of forked processes, so pools span
+nodes. Supported surface: map/map_async/imap/imap_unordered/starmap/apply/
+apply_async, chunking, context manager.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Callable, Iterable, List, Optional
+
+import ray_tpu
+
+
+class AsyncResult:
+    def __init__(self, refs: List[Any], single: bool = False):
+        self._refs = refs
+        self._single = single
+
+    def get(self, timeout: Optional[float] = None):
+        values = ray_tpu.get(self._refs, timeout=timeout)
+        if self._single:
+            return values[0]
+        return list(itertools.chain.from_iterable(values))
+
+    def wait(self, timeout: Optional[float] = None) -> None:
+        ray_tpu.wait(self._refs, num_returns=len(self._refs), timeout=timeout)
+
+    def ready(self) -> bool:
+        done, _ = ray_tpu.wait(self._refs, num_returns=len(self._refs),
+                               timeout=0)
+        return len(done) == len(self._refs)
+
+    def successful(self) -> bool:
+        try:
+            self.get(timeout=0)
+            return True
+        except Exception:  # noqa: BLE001
+            return False
+
+
+def _chunk(seq: List[Any], chunksize: int) -> List[List[Any]]:
+    return [seq[i:i + chunksize] for i in range(0, len(seq), chunksize)]
+
+
+@ray_tpu.remote
+def _run_chunk(fn: Callable, chunk: List[Any], star: bool) -> List[Any]:
+    if star:
+        return [fn(*args) for args in chunk]
+    return [fn(x) for x in chunk]
+
+
+class Pool:
+    def __init__(self, processes: Optional[int] = None,
+                 initializer: Optional[Callable] = None,
+                 initargs: tuple = ()):
+        if not ray_tpu.is_initialized():
+            ray_tpu.init()
+        total = ray_tpu.cluster_resources().get("CPU", 1)
+        self._processes = processes or max(1, int(total))
+        # initializer support: wrap fn calls (per-chunk, idempotent)
+        self._initializer = initializer
+        self._initargs = initargs
+
+    def _wrap(self, fn: Callable) -> Callable:
+        if self._initializer is None:
+            return fn
+        init, initargs = self._initializer, self._initargs
+
+        def wrapped(*a, **kw):
+            flag = "_rt_pool_initialized"
+            import builtins
+
+            if not getattr(builtins, flag, False):
+                init(*initargs)
+                setattr(builtins, flag, True)
+            return fn(*a, **kw)
+
+        return wrapped
+
+    def _default_chunksize(self, n: int) -> int:
+        return max(1, n // (self._processes * 4) or 1)
+
+    def _map_refs(self, fn, iterable, chunksize, star):
+        items = list(iterable)
+        chunksize = chunksize or self._default_chunksize(len(items))
+        return [_run_chunk.remote(self._wrap(fn), c, star)
+                for c in _chunk(items, chunksize)]
+
+    # -- blocking -------------------------------------------------------------
+    def map(self, fn: Callable, iterable: Iterable,
+            chunksize: Optional[int] = None) -> List[Any]:
+        return AsyncResult(self._map_refs(fn, iterable, chunksize,
+                                          False)).get()
+
+    def starmap(self, fn: Callable, iterable: Iterable,
+                chunksize: Optional[int] = None) -> List[Any]:
+        return AsyncResult(self._map_refs(fn, iterable, chunksize,
+                                          True)).get()
+
+    def apply(self, fn: Callable, args: tuple = (), kwds: Optional[dict] = None):
+        return self.apply_async(fn, args, kwds).get()
+
+    # -- async ----------------------------------------------------------------
+    def map_async(self, fn, iterable, chunksize=None) -> AsyncResult:
+        return AsyncResult(self._map_refs(fn, iterable, chunksize, False))
+
+    def starmap_async(self, fn, iterable, chunksize=None) -> AsyncResult:
+        return AsyncResult(self._map_refs(fn, iterable, chunksize, True))
+
+    def apply_async(self, fn, args: tuple = (),
+                    kwds: Optional[dict] = None) -> AsyncResult:
+        kwds = kwds or {}
+        wrapped = self._wrap(fn)
+        ref = ray_tpu.remote(
+            lambda: wrapped(*args, **kwds)).remote()
+        return AsyncResult([ref], single=True)
+
+    # -- lazy -----------------------------------------------------------------
+    def imap(self, fn, iterable, chunksize: Optional[int] = None):
+        refs = self._map_refs(fn, iterable, chunksize or 1, False)
+        for ref in refs:
+            yield from ray_tpu.get(ref)
+
+    def imap_unordered(self, fn, iterable, chunksize: Optional[int] = None):
+        refs = self._map_refs(fn, iterable, chunksize or 1, False)
+        pending = list(refs)
+        while pending:
+            done, pending = ray_tpu.wait(pending, num_returns=1)
+            yield from ray_tpu.get(done[0])
+
+    # -- lifecycle ------------------------------------------------------------
+    def close(self) -> None:
+        pass  # tasks are stateless; nothing to tear down
+
+    def terminate(self) -> None:
+        pass
+
+    def join(self) -> None:
+        pass
+
+    def __enter__(self) -> "Pool":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.terminate()

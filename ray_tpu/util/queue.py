@@ -1,0 +1,119 @@
+"""Distributed FIFO queue backed by an actor.
+
+Reference analog: ``python/ray/util/queue.py`` (``Queue`` wrapping an async
+``_QueueActor``) — same surface: put/get with block/timeout, qsize/empty/
+full, put_nowait/get_nowait, batch variants.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, List, Optional
+
+import ray_tpu
+
+
+class Empty(Exception):
+    pass
+
+
+class Full(Exception):
+    pass
+
+
+class _QueueActor:
+    def __init__(self, maxsize: int):
+        self._q: asyncio.Queue = asyncio.Queue(maxsize=maxsize)
+
+    async def put(self, item, timeout: Optional[float] = None) -> bool:
+        try:
+            if timeout is None:
+                await self._q.put(item)
+            else:
+                await asyncio.wait_for(self._q.put(item), timeout)
+            return True
+        except asyncio.TimeoutError:
+            return False
+
+    async def get(self, timeout: Optional[float] = None):
+        try:
+            if timeout is None:
+                return True, await self._q.get()
+            return True, await asyncio.wait_for(self._q.get(), timeout)
+        except asyncio.TimeoutError:
+            return False, None
+
+    async def put_nowait(self, item) -> bool:
+        try:
+            self._q.put_nowait(item)
+            return True
+        except asyncio.QueueFull:
+            return False
+
+    async def get_nowait(self):
+        try:
+            return True, self._q.get_nowait()
+        except asyncio.QueueEmpty:
+            return False, None
+
+    async def qsize(self) -> int:
+        return self._q.qsize()
+
+    async def maxsize(self) -> int:
+        return self._q.maxsize
+
+
+class Queue:
+    """Create in a driver/task/actor; pass by value — all holders share the
+    same queue actor."""
+
+    def __init__(self, maxsize: int = 0, *, actor_options: Optional[dict] = None):
+        opts = {"num_cpus": 0, "max_concurrency": 64}
+        opts.update(actor_options or {})
+        self._actor = ray_tpu.remote(_QueueActor).options(**opts).remote(maxsize)
+
+    def put(self, item: Any, block: bool = True,
+            timeout: Optional[float] = None) -> None:
+        if not block:
+            if not ray_tpu.get(self._actor.put_nowait.remote(item)):
+                raise Full("queue is full")
+            return
+        if not ray_tpu.get(self._actor.put.remote(item, timeout)):
+            raise Full("queue put timed out")
+
+    def get(self, block: bool = True, timeout: Optional[float] = None) -> Any:
+        if not block:
+            ok, item = ray_tpu.get(self._actor.get_nowait.remote())
+            if not ok:
+                raise Empty("queue is empty")
+            return item
+        ok, item = ray_tpu.get(self._actor.get.remote(timeout))
+        if not ok:
+            raise Empty("queue get timed out")
+        return item
+
+    def put_nowait(self, item: Any) -> None:
+        self.put(item, block=False)
+
+    def get_nowait(self) -> Any:
+        return self.get(block=False)
+
+    def put_nowait_batch(self, items: List[Any]) -> None:
+        for item in items:
+            self.put_nowait(item)
+
+    def get_nowait_batch(self, num_items: int) -> List[Any]:
+        return [self.get_nowait() for _ in range(num_items)]
+
+    def qsize(self) -> int:
+        return ray_tpu.get(self._actor.qsize.remote())
+
+    def empty(self) -> bool:
+        return self.qsize() == 0
+
+    def full(self) -> bool:
+        maxsize = ray_tpu.get(self._actor.maxsize.remote())
+        return maxsize > 0 and self.qsize() >= maxsize
+
+    def shutdown(self) -> None:
+        ray_tpu.kill(self._actor)

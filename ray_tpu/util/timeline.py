@@ -1,0 +1,53 @@
+"""Chrome-trace timeline export from the GCS task-event store.
+
+Reference analog: ``ray.timeline()`` (``_private/state.py:865``) — dump task
+execution spans as a Chrome ``chrome://tracing`` / Perfetto JSON file. Spans
+come from the per-state transition times the raylets report to the GCS task
+store (PENDING -> RUNNING -> FINISHED/FAILED).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+
+
+def timeline(filename: Optional[str] = None) -> List[Dict[str, Any]]:
+    """Build (and optionally write) Chrome trace events for recent tasks."""
+    backend = ray_tpu.global_worker()._require_backend()
+    events = backend.io.run(backend._gcs.call("list_tasks", {"limit": 10000}))
+    trace: List[Dict[str, Any]] = []
+    for ev in events:
+        times = ev.get("times", {})
+        start = times.get("RUNNING") or times.get("PENDING")
+        end = times.get("FINISHED") or times.get("FAILED")
+        if start is None:
+            continue
+        if end is None:
+            end = start  # still running: zero-length marker
+        trace.append({
+            "name": ev.get("name") or "task",
+            "cat": "task",
+            "ph": "X",
+            "ts": start * 1e6,
+            "dur": max(0.0, (end - start) * 1e6),
+            "pid": ev.get("node_id") or "node",
+            "tid": ev["task_id"][:8],
+            "args": {"task_id": ev["task_id"], "state": ev.get("state")},
+        })
+        pend = times.get("PENDING")
+        if pend is not None and times.get("RUNNING"):
+            trace.append({
+                "name": f"{ev.get('name') or 'task'}:queued",
+                "cat": "scheduling", "ph": "X",
+                "ts": pend * 1e6,
+                "dur": max(0.0, (times["RUNNING"] - pend) * 1e6),
+                "pid": ev.get("node_id") or "node",
+                "tid": ev["task_id"][:8],
+            })
+    if filename:
+        with open(filename, "w") as f:
+            json.dump(trace, f)
+    return trace

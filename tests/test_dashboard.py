@@ -1,0 +1,92 @@
+"""Dashboard REST API + timeline export.
+
+Reference analogs: ``dashboard/head.py`` + ``state_aggregator.py`` (REST
+state API), ``ray.timeline()`` (``_private/state.py:865``).
+"""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+
+
+def _get_json(port, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=30) as resp:
+        return json.loads(resp.read())
+
+
+def test_dashboard_rest_endpoints(rt_cluster):
+    from ray_tpu.dashboard import start_dashboard
+
+    @ray_tpu.remote
+    class Marker:
+        def ping(self):
+            return "pong"
+
+    a = Marker.options(name="dash_marker").remote()
+    assert ray_tpu.get(a.ping.remote(), timeout=60) == "pong"
+
+    @ray_tpu.remote
+    def traced_task():
+        return 1
+
+    ray_tpu.get(traced_task.remote(), timeout=60)
+
+    port = start_dashboard()
+    assert start_dashboard() == port  # idempotent
+
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/-/healthz", timeout=30) as r:
+        assert r.read() == b"ok"
+
+    nodes = _get_json(port, "/api/nodes")
+    assert len(nodes) == 1 and nodes[0]["alive"]
+
+    actors = _get_json(port, "/api/actors")
+    assert any(x.get("name") == "dash_marker" for x in actors)
+
+    resources = _get_json(port, "/api/cluster_resources")
+    assert resources["total"]["CPU"] >= 1
+
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        tasks = _get_json(port, "/api/tasks")
+        if any(t.get("name") == "traced_task" for t in tasks):
+            break
+        time.sleep(0.2)
+    assert any(t.get("name") == "traced_task" for t in tasks)
+
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=30) as r:
+        assert r.status == 200  # prometheus page renders (may be empty)
+
+
+def test_timeline_export(rt_cluster, tmp_path):
+    from ray_tpu.util.timeline import timeline
+
+    @ray_tpu.remote
+    def spanned(i):
+        time.sleep(0.05)
+        return i
+
+    ray_tpu.get([spanned.remote(i) for i in range(3)], timeout=60)
+    # events are fire-and-forget: wait for FINISHED to land
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        trace = timeline()
+        done = [t for t in trace
+                if t["name"] == "spanned" and t["args"]["state"] == "FINISHED"]
+        if len(done) >= 3:
+            break
+        time.sleep(0.2)
+    assert len(done) >= 3
+    assert all(t["dur"] >= 0.04 * 1e6 for t in done)
+
+    out = tmp_path / "trace.json"
+    timeline(str(out))
+    loaded = json.loads(out.read_text())
+    assert isinstance(loaded, list) and loaded

@@ -19,7 +19,7 @@ from ray_tpu import job as rt_job
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _wait_status(job_id, want, timeout=60):
+def _wait_status(job_id, want, timeout=120):  # generous: 1-CPU CI under load
     deadline = time.time() + timeout
     while time.time() < deadline:
         meta = rt_job.job_status(job_id)

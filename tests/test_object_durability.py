@@ -130,6 +130,34 @@ def test_lineage_reconstruction_after_loss(recon_cluster):
     assert sum(1 for _ in open(calls_path)) == 2, "task was not re-executed"
 
 
+def test_multilevel_chain_loss_recovers(recon_cluster):
+    """Lose every copy of BOTH links of a task chain: getting the tail
+    re-executes what's needed (directly, or via each executor's arg
+    resolution recursing to the owner's lineage)."""
+    import glob
+
+    @ray_tpu.remote
+    def stage_a():
+        return np.full((512, 256), 1.0, dtype=np.float32)
+
+    @ray_tpu.remote
+    def stage_b(x):
+        return x * 2
+
+    ra = stage_a.remote()
+    rb = stage_b.remote(ra)
+    assert float(ray_tpu.get(rb, timeout=60)[0, 0]) == 2.0
+
+    backend = ray_tpu.global_worker()._require_backend()
+    for ref in (ra, rb):
+        backend.plasma.delete(ref.id())
+        for p in glob.glob(f"/tmp/ray_tpu/*/spill/*/{ref.hex()}"):
+            os.unlink(p)
+
+    again = ray_tpu.get(rb, timeout=120)
+    assert float(again[0, 0]) == 2.0
+
+
 def test_reconstruction_is_joined_not_duplicated(recon_cluster):
     """Concurrent getters of the same lost object trigger ONE resubmit."""
     import glob

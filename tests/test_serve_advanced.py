@@ -1,0 +1,129 @@
+"""Serve: model multiplexing, streaming responses, long-poll routing.
+
+Reference analogs: ``python/ray/serve/multiplex.py`` (``@serve.multiplexed``,
+``get_multiplexed_model_id``), ``serve/_private/replica.py:346`` (streaming
+responses), ``serve/_private/long_poll.py`` (push of routing tables).
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@pytest.fixture
+def serve_cluster():
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=6, num_tpus=0)
+    yield ray_tpu
+    try:
+        serve.shutdown()
+    finally:
+        serve._forget_controller_for_tests()
+        ray_tpu.shutdown()
+
+
+def test_multiplexed_model_cache_and_eviction(serve_cluster):
+    @serve.deployment(num_replicas=1, max_ongoing_requests=8)
+    class MuxModel:
+        @serve.multiplexed(max_num_models_per_replica=2)
+        def get_model(self, model_id: str):
+            return {"id": model_id, "loaded_at": time.time()}
+
+        def __call__(self, _req=None):
+            model_id = serve.get_multiplexed_model_id()
+            model = self.get_model(model_id)
+            return {"served_by": model["id"], "loaded_at": model["loaded_at"]}
+
+    handle = serve.run(MuxModel.bind(), name="mux", route_prefix=None)
+
+    r1 = handle.options(multiplexed_model_id="m1").remote().result(timeout=60)
+    assert r1["served_by"] == "m1"
+    t_m1 = r1["loaded_at"]
+    # cache hit: same load timestamp
+    r1b = handle.options(multiplexed_model_id="m1").remote().result(timeout=60)
+    assert r1b["loaded_at"] == t_m1
+    # fill cache (max 2) then evict m1 with a third model
+    handle.options(multiplexed_model_id="m2").remote().result(timeout=60)
+    handle.options(multiplexed_model_id="m3").remote().result(timeout=60)
+    r1c = handle.options(multiplexed_model_id="m1").remote().result(timeout=60)
+    assert r1c["loaded_at"] > t_m1, "m1 should have been evicted and reloaded"
+
+
+def test_multiplexed_routing_prefers_holder(serve_cluster):
+    """With N replicas > 1, repeat calls for one model id land on the
+    replica already holding it (after the first call teaches the router)."""
+    import os
+
+    @serve.deployment(num_replicas=3, max_ongoing_requests=8)
+    class Which:
+        @serve.multiplexed(max_num_models_per_replica=4)
+        def get_model(self, model_id: str):
+            return model_id
+
+        def __call__(self, _req=None):
+            self.get_model(serve.get_multiplexed_model_id())
+            return os.getpid()
+
+    handle = serve.run(Which.bind(), name="which", route_prefix=None)
+    h = handle.options(multiplexed_model_id="only")
+    first = h.remote().result(timeout=60)
+    pids = {h.remote().result(timeout=60) for _ in range(8)}
+    assert pids == {first}, f"model-affine routing violated: {pids}"
+
+
+def test_streaming_response_handle(serve_cluster):
+    @serve.deployment(max_ongoing_requests=4)
+    class Streamer:
+        def __call__(self, n=5):
+            for i in range(n):
+                yield f"tok{i}"
+
+    handle = serve.run(Streamer.bind(), name="stream", route_prefix=None)
+    gen = handle.remote(7).result(timeout=60)
+    assert isinstance(gen, serve.DeploymentResponseGenerator)
+    assert list(gen) == [f"tok{i}" for i in range(7)]
+
+
+def test_streaming_tokens_over_http(serve_cluster):
+    """Chunked HTTP body from a generator deployment (streaming-tokens)."""
+    import urllib.request
+
+    @serve.deployment
+    class TokenStream:
+        def __call__(self, req):
+            n = int(req.query.get("n", 4))
+            for i in range(n):
+                yield f"t{i} "
+
+    serve.run(TokenStream.bind(), name="toks", route_prefix="/gen")
+    port = serve.http_port()
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/gen?n=6", timeout=60) as resp:
+        body = resp.read().decode()
+    assert body == "t0 t1 t2 t3 t4 t5 "
+
+
+def test_long_poll_pushes_replica_updates(serve_cluster):
+    """After the first call starts the router's long-poll, a redeploy's new
+    replica set reaches the handle without TTL-period polling."""
+    @serve.deployment(num_replicas=1)
+    def app_fn(_req=None):
+        return "ok"
+
+    handle = serve.run(app_fn.bind(), name="lp", route_prefix=None)
+    assert handle.remote().result(timeout=60) == "ok"
+    router = handle._router
+    v_before = router.version
+    assert len(router.replicas) == 1
+
+    serve.run(app_fn.options(num_replicas=2).bind(), name="lp",
+              route_prefix=None)
+    deadline = time.time() + 20
+    while time.time() < deadline and len(router.replicas) != 2:
+        time.sleep(0.1)  # NO handle calls: the poller must learn by itself
+    assert len(router.replicas) == 2, "long-poll never pushed the update"
+    assert router.version != v_before

@@ -1,0 +1,160 @@
+"""util extras: Queue, ActorPool, multiprocessing.Pool, metrics.
+
+Reference analogs: ``python/ray/util/queue.py``, ``util/actor_pool.py``,
+``util/multiprocessing/``, ``util/metrics.py`` + the Prometheus exporter.
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+
+
+def test_queue_fifo_and_timeout(rt_cluster):
+    from ray_tpu.util.queue import Empty, Full, Queue
+
+    q = Queue(maxsize=2)
+    q.put(1)
+    q.put(2)
+    with pytest.raises(Full):
+        q.put(3, block=False)
+    assert q.qsize() == 2
+    assert q.get() == 1
+    assert q.get() == 2
+    with pytest.raises(Empty):
+        q.get(block=False)
+    t0 = time.time()
+    with pytest.raises(Empty):
+        q.get(timeout=0.3)
+    assert 0.2 < time.time() - t0 < 5.0
+
+
+def test_queue_across_tasks(rt_cluster):
+    from ray_tpu.util.queue import Queue
+
+    q = Queue()
+
+    @ray_tpu.remote
+    def producer(q, n):
+        for i in range(n):
+            q.put(i * 10)
+        return "done"
+
+    @ray_tpu.remote
+    def consumer(q, n):
+        return [q.get(timeout=30) for _ in range(n)]
+
+    p = producer.remote(q, 5)
+    c = consumer.remote(q, 5)
+    assert ray_tpu.get(c, timeout=60) == [0, 10, 20, 30, 40]
+    assert ray_tpu.get(p, timeout=60) == "done"
+
+
+def test_actor_pool_map(rt_cluster):
+    from ray_tpu.util.actor_pool import ActorPool
+
+    @ray_tpu.remote
+    class Sq:
+        def sq(self, x):
+            return x * x
+
+    pool = ActorPool([Sq.remote() for _ in range(2)])
+    got = list(pool.map(lambda a, v: a.sq.remote(v), range(6)))
+    assert got == [0, 1, 4, 9, 16, 25]
+    got_un = sorted(pool.map_unordered(lambda a, v: a.sq.remote(v), range(6)))
+    assert got_un == [0, 1, 4, 9, 16, 25]
+
+
+def test_multiprocessing_pool(rt_cluster):
+    from ray_tpu.util.multiprocessing import Pool
+
+    def square(x):
+        return x * x
+
+    def add(a, b):
+        return a + b
+
+    with Pool(processes=2) as pool:
+        assert pool.map(square, range(8)) == [x * x for x in range(8)]
+        assert pool.starmap(add, [(1, 2), (3, 4)]) == [3, 7]
+        r = pool.apply_async(square, (9,))
+        assert r.get(timeout=60) == 81
+        assert sorted(pool.imap_unordered(square, range(5))) == \
+            [0, 1, 4, 9, 16]
+
+
+def test_metrics_counter_gauge_histogram(rt_cluster):
+    from ray_tpu.util import metrics as M
+
+    c = M.Counter("rt_test_requests", "requests", ("route",))
+    c.inc(1.0, {"route": "/a"})
+    c.inc(2.0, {"route": "/a"})
+    c.inc(5.0, {"route": "/b"})
+    g = M.Gauge("rt_test_temp", "temperature")
+    g.set(42.5)
+    h = M.Histogram("rt_test_lat", "latency", boundaries=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(3.0)
+
+    M.flush_now()
+    text = M.metrics_text()
+    assert 'rt_test_requests{route="/a"} 3.0' in text
+    assert 'rt_test_requests{route="/b"} 5.0' in text
+    assert "rt_test_temp 42.5" in text
+    assert 'rt_test_lat_bucket{le="0.1"} 1' in text
+    assert 'rt_test_lat_bucket{le="1.0"} 2' in text
+    assert 'rt_test_lat_bucket{le="+Inf"} 3' in text
+    assert "rt_test_lat_count 3" in text
+
+
+def test_data_read_text_binary_sql(rt_cluster, tmp_path):
+    import sqlite3
+
+    from ray_tpu import data as rt_data
+
+    txt = tmp_path / "lines.txt"
+    txt.write_text("alpha\nbeta\n\ngamma\n")
+    ds = rt_data.read_text(str(txt))
+    assert [r["text"] for r in ds.iterator().iter_rows()] == \
+        ["alpha", "beta", "gamma"]
+
+    binf = tmp_path / "blob.bin"
+    binf.write_bytes(b"\x00\x01payload")
+    rows = list(rt_data.read_binary_files(
+        str(binf), include_paths=True).iterator().iter_rows())
+    assert rows[0]["bytes"] == b"\x00\x01payload"
+    assert rows[0]["path"].endswith("blob.bin")
+
+    db = tmp_path / "t.db"
+    conn = sqlite3.connect(db)
+    conn.execute("CREATE TABLE t (x INTEGER, y TEXT)")
+    conn.executemany("INSERT INTO t VALUES (?, ?)",
+                     [(1, "a"), (2, "b"), (3, "c")])
+    conn.commit()
+    conn.close()
+    path = str(db)
+    ds = rt_data.read_sql("SELECT x, y FROM t ORDER BY x",
+                          lambda: __import__("sqlite3").connect(path))
+    rows = list(ds.iterator().iter_rows())
+    assert [int(r["x"]) for r in rows] == [1, 2, 3]
+    assert [str(r["y"]) for r in rows] == ["a", "b", "c"]
+
+
+def test_metrics_from_worker_processes(rt_cluster):
+    from ray_tpu.util import metrics as M
+
+    @ray_tpu.remote
+    def work(i):
+        from ray_tpu.util import metrics as WM
+
+        c = WM.Counter("rt_test_worker_ops", "ops")
+        c.inc(float(i + 1))
+        WM.flush_now()
+        return i
+
+    ray_tpu.get([work.remote(i) for i in range(3)], timeout=60)
+    text = M.metrics_text()
+    # counters merge across worker processes: 1 + 2 + 3
+    assert "rt_test_worker_ops 6.0" in text
