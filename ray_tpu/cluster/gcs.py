@@ -20,7 +20,7 @@ from typing import Any, Dict, List, Optional, Set, Tuple
 
 from ray_tpu._private.config import get_config
 from ray_tpu.core.resources import NodeResources, ResourceSet
-from ray_tpu.cluster.rpc import ConnectionPool
+from ray_tpu.cluster.rpc import ConnectionPool, spawn_task
 from ray_tpu.scheduler.policy import pick_node
 
 ACTOR_PENDING = "PENDING_CREATION"
@@ -110,11 +110,62 @@ class GcsServer:
         self._job_counter = 0
         # Snapshot persistence (reference: the Redis store client behind the
         # GCS tables, ``store_client/redis_store_client.cc`` — here a pickle
-        # snapshot so a restarted head recovers actors/PGs/KV/locations).
+        # snapshot so a restarted head recovers actors/PGs/locations, plus a
+        # crc-framed append-only WAL (native LogKV) for the user KV table:
+        # every kv_put is durable immediately, and multi-MB runtime-env
+        # packages stop being re-pickled into each snapshot.
         self._persist_path = persist_path
         self._persist_seq = self._persisted_seq = 0
+        self._kv_log = None
+        self._kv_log_exec = None
         if persist_path:
             self._restore_snapshot()
+            try:
+                from concurrent.futures import ThreadPoolExecutor
+
+                from ray_tpu import _native
+
+                import os as _os
+
+                wal_path = persist_path + ".kv"
+                # A non-empty WAL is AUTHORITATIVE for kv, including
+                # deletions: snapshot-held keys must not be merged over it
+                # (a tombstoned key is absent from keys(), so a merge would
+                # resurrect durably-deleted data).
+                fresh_wal = (not _os.path.exists(wal_path)
+                             or _os.path.getsize(wal_path) == 0)
+                self._kv_log = _native.LogKV(wal_path)
+                if fresh_wal:
+                    # one-time migration of pre-WAL snapshot keys, then an
+                    # immediate kv={} snapshot so the old copy can't shadow
+                    # later WAL deletes
+                    for k, v in self.kv.items():
+                        self._kv_log.put(k, self._encode_kv(v))
+                    self._kv_log.sync()
+                else:
+                    self.kv = {k: self._decode_kv(self._kv_log.get(k))
+                               for k in self._kv_log.keys()}
+                # single thread => append order == table order per key
+                self._kv_log_exec = ThreadPoolExecutor(
+                    max_workers=1, thread_name_prefix="rt-gcs-kvlog")
+                self.mark_dirty()
+                self._persist_snapshot()
+            except Exception:  # noqa: BLE001 — WAL is an upgrade, not a dep
+                self._kv_log = None
+
+    @staticmethod
+    def _encode_kv(value) -> bytes:
+        """Type-tagged WAL value: callers pass str OR bytes and must get the
+        same type back after a restart."""
+        if isinstance(value, str):
+            return b"s" + value.encode()
+        return b"b" + bytes(value)
+
+    @staticmethod
+    def _decode_kv(blob: bytes):
+        if blob[:1] == b"s":
+            return blob[1:].decode()
+        return bytes(blob[1:])
 
     def mark_dirty(self) -> None:
         self._persist_seq += 1
@@ -146,7 +197,9 @@ class GcsServer:
         state: Dict[str, Any] = {}
         for name in self._SNAPSHOT_TABLES:
             table = getattr(self, name)
-            if name == "object_locations":
+            if name == "kv" and self._kv_log is not None:
+                state[name] = {}  # the WAL is the KV's source of truth
+            elif name == "object_locations":
                 state[name] = {k: set(v) for k, v in table.items()}
             elif isinstance(table, dict):
                 state[name] = dict(table)
@@ -183,6 +236,14 @@ class GcsServer:
             self._persist_snapshot()
         except Exception:
             pass
+        if self._kv_log is not None:
+            try:
+                self._kv_log_exec.shutdown(wait=True)
+                self._kv_log.sync()
+                self._kv_log.close()
+            except Exception:  # noqa: BLE001
+                pass
+            self._kv_log = None
         await self._pool.close_all()
 
     # ---- nodes ------------------------------------------------------------
@@ -197,10 +258,26 @@ class GcsServer:
         if entry is None:
             return {"ok": False, "unknown": True}
         entry.last_heartbeat = time.monotonic()
+        resurrected = False
+        if not entry.alive:
+            # A heartbeat from a "dead" node proves the death was spurious —
+            # on a loaded single-core host the shared event loop can stall
+            # past node_death_timeout_s (a large pickle, a jit compile)
+            # and the monitor then wins the post-stall race against the
+            # queued heartbeat. Leaving the node dead wedges every future
+            # actor/task placement (pick_node skips dead nodes forever).
+            # The reference instead kills the raylet and has it re-register
+            # under a new node id (gcs_node_manager.cc); an in-process
+            # raylet can't restart, so resurrect it in place. The reply
+            # flag tells the raylet to re-publish its object locations
+            # (death dropped them from the directory).
+            entry.alive = True
+            resurrected = True
+            self.mark_dirty()
         if "available" in p:
             entry.view.available = ResourceSet(p["available"])
         entry.queued_demands = p.get("queued_demands", [])
-        return {"ok": True}
+        return {"ok": True, "resurrected": resurrected}
 
     async def rpc_cluster_load(self, p):
         """Autoscaler input: per-node capacity/usage + unplaced demand
@@ -278,12 +355,19 @@ class GcsServer:
                                for nid in pg.bundle_nodes]
             if was_created:
                 pg.state = PG_PENDING
-                asyncio.ensure_future(self._schedule_pg(pg))
+                spawn_task(self._schedule_pg(pg))
 
     # ---- kv / function table ----------------------------------------------
     async def rpc_kv_put(self, p):
         self.mark_dirty()
         self.kv[p["key"]] = p["value"]
+        if self._kv_log is not None:
+            # WAL append off-loop (native side releases the GIL during the
+            # write); the single-thread executor keeps append order == the
+            # order the table saw
+            await asyncio.get_running_loop().run_in_executor(
+                self._kv_log_exec, self._kv_log.put, p["key"],
+                self._encode_kv(p["value"]))
         return {"ok": True}
 
     async def rpc_kv_get(self, p):
@@ -292,6 +376,9 @@ class GcsServer:
     async def rpc_kv_del(self, p):
         self.mark_dirty()
         self.kv.pop(p["key"], None)
+        if self._kv_log is not None:
+            await asyncio.get_running_loop().run_in_executor(
+                self._kv_log_exec, self._kv_log.delete, p["key"])
         return {"ok": True}
 
     async def rpc_kv_keys(self, p):
@@ -353,7 +440,7 @@ class GcsServer:
         self.actors[actor_id] = entry
         if name is not None:
             self.named_actors[(ns, name)] = actor_id
-        asyncio.ensure_future(self._schedule_actor(entry))
+        spawn_task(self._schedule_actor(entry))
         return {"actor_id": actor_id, "existing": False}
 
     async def _schedule_actor(self, entry: _ActorEntry,
@@ -383,8 +470,17 @@ class GcsServer:
             node = self.nodes[node_id]
             try:
                 client = await self._pool.get(node.address)
+                # Bounded: a wedged raylet must fail over to another node,
+                # not pin this actor PENDING_CREATION forever (the raylet's
+                # own create path is bounded by process_startup_timeout_s).
+                cfg = get_config()
+                create_timeout = (cfg.process_startup_timeout_s
+                                  + (cfg.runtime_env_setup_timeout_s
+                                     if entry.spec.get("runtime_env") else 0)
+                                  + 30.0)
                 reply = await client.call("create_actor", {
-                    "actor_id": entry.actor_id, "spec": entry.spec})
+                    "actor_id": entry.actor_id, "spec": entry.spec},
+                    timeout=create_timeout)
                 if entry.state == ACTOR_DEAD:
                     # Killed during creation: reap the just-created worker.
                     if reply.get("ok"):
@@ -392,7 +488,12 @@ class GcsServer:
                                           {"actor_id": entry.actor_id})
                     return
                 if reply.get("ok"):
-                    entry.node_id = node_id
+                    # Don't clobber node_id once an ALIVE report landed — if
+                    # a timed-out earlier attempt won the ALIVE race, THIS
+                    # copy is the stale one (rpc_actor_update already killed
+                    # it) and node_id must keep pointing at the winner.
+                    if entry.state != ACTOR_ALIVE:
+                        entry.node_id = node_id
                     return  # raylet reports actor_update(ALIVE) when ready
                 if reply.get("retry"):
                     await asyncio.sleep(0.2)
@@ -400,10 +501,24 @@ class GcsServer:
                 await self._finalize_actor_death(
                     entry, reply.get("error", "creation failed"))
                 return
-            except Exception as e:  # node unreachable — try another
+            except Exception:  # node unreachable or create timed out
+                # If the create was merely SLOW (not dead), its worker may
+                # still come up after we re-place the actor elsewhere —
+                # best-effort kill so two live copies can never coexist
+                # (rpc_actor_update's stale-ALIVE guard is the backstop).
+                spawn_task(self._kill_stale_creation(node.address,
+                                                     entry.actor_id))
                 self._pool.invalidate(node.address)
                 await asyncio.sleep(0.2)
         await self._finalize_actor_death(entry, "scheduling timed out")
+
+    async def _kill_stale_creation(self, address: str, actor_id: str) -> None:
+        try:
+            client = await self._pool.get(address)
+            await client.call("kill_actor", {"actor_id": actor_id},
+                              timeout=10)
+        except Exception:  # noqa: BLE001 — node really is gone
+            pass
 
     async def _pg_bundle_node(self, pg_info: Dict, entry: _ActorEntry
                               ) -> Optional[str]:
@@ -429,9 +544,14 @@ class GcsServer:
             return {"ok": False}
         state = p["state"]
         if state == ACTOR_ALIVE:
-            if entry.state == ACTOR_DEAD:
-                # Killed while the raylet was creating it — don't resurrect;
-                # tell the raylet to reap the worker.
+            stale_alive = (
+                entry.state == ACTOR_DEAD
+                # A second copy finishing creation after the scheduler timed
+                # out and placed the actor elsewhere: the FIRST ALIVE wins,
+                # the loser's worker is reaped (never two live copies).
+                or (entry.state == ACTOR_ALIVE and entry.node_id is not None
+                    and p.get("node_id") not in (None, entry.node_id)))
+            if stale_alive:
                 node = self.nodes.get(p.get("node_id", ""))
                 if node is not None:
                     try:
@@ -440,12 +560,19 @@ class GcsServer:
                                           {"actor_id": entry.actor_id})
                     except Exception:
                         pass
-                return {"ok": True}
+                return {"ok": True, "stale": True}
             entry.state = ACTOR_ALIVE
             entry.address = p.get("address")
             entry.node_id = p.get("node_id", entry.node_id)
             self._wake_actor_waiters(entry)
         elif state == ACTOR_DEAD:
+            # Ignore death reports from a node that no longer owns the actor
+            # (e.g. a resurrected node reaping its orphaned pre-death copy —
+            # the restarted copy elsewhere is alive and well).
+            reporter = p.get("node_id")
+            if (reporter is not None and entry.node_id is not None
+                    and reporter != entry.node_id):
+                return {"ok": True, "stale": True}
             await self._handle_actor_failure(entry, p.get("reason", "worker died"))
         return {"ok": True}
 
@@ -462,7 +589,7 @@ class GcsServer:
             entry.address = None
             # Backoff happens inside the spawned task — this path runs on the
             # monitor loop and must not stall node-death handling.
-            asyncio.ensure_future(self._schedule_actor(
+            spawn_task(self._schedule_actor(
                 entry, backoff=get_config().actor_restart_backoff_s))
         else:
             await self._finalize_actor_death(entry, reason)
@@ -534,7 +661,7 @@ class GcsServer:
         entry = _PgEntry(p["pg_id"], p["bundles"], p["strategy"],
                          p.get("name", ""))
         self.placement_groups[p["pg_id"]] = entry
-        asyncio.ensure_future(self._schedule_pg(entry))
+        spawn_task(self._schedule_pg(entry))
         return {"ok": True}
 
     def _pg_plan(self, entry: _PgEntry) -> Optional[Dict[int, str]]:

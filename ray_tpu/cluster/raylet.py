@@ -28,7 +28,12 @@ from typing import Any, Dict, List, Optional, Tuple
 from ray_tpu._private.config import get_config
 from ray_tpu.core.resources import NodeResources, ResourceSet, TPU
 from ray_tpu.cluster.object_store import PlasmaStore
-from ray_tpu.cluster.rpc import ConnectionPool, RpcClient, RpcServer
+from ray_tpu.cluster.rpc import (
+    ConnectionPool,
+    RpcClient,
+    RpcServer,
+    spawn_task,
+)
 from ray_tpu.exceptions import WorkerCrashedError
 
 
@@ -45,6 +50,7 @@ class _WorkerEntry:
         self.is_actor_worker = False
         self.actor_id: Optional[str] = None
         self.assignment: Dict[str, List[int]] = {}
+        self.oom_killed = False
 
 
 class _BundleState:
@@ -137,6 +143,9 @@ class Raylet:
         self._tasks.append(asyncio.ensure_future(self._heartbeat_loop()))
         self._tasks.append(asyncio.ensure_future(self._dispatch_loop()))
         self._tasks.append(asyncio.ensure_future(self._reap_loop()))
+        if get_config().memory_usage_threshold < 1.0:
+            self._tasks.append(
+                asyncio.ensure_future(self._memory_monitor_loop()))
         return self.server.address
 
     async def stop(self, destroy_store: bool = False) -> None:
@@ -172,12 +181,17 @@ class Raylet:
                     key = tuple(sorted(
                         item["payload"].get("resources", {}).items()))
                     demands[key] = demands.get(key, 0) + 1
-                await self._gcs.call("heartbeat", {
+                reply = await self._gcs.call("heartbeat", {
                     "node_id": self.node_id,
                     "available": self.node.available.to_dict(),
                     "queued_demands": [
                         {"resources": dict(k), "count": c}
                         for k, c in list(demands.items())[:20]]})
+                if reply.get("resurrected"):
+                    # off the heartbeat loop: a long republish here would
+                    # stall heartbeats past node_death_timeout_s and
+                    # re-enter the death/resurrect cycle
+                    spawn_task(self._reconcile_after_resurrection())
             except Exception:
                 pass
             if self._queue:
@@ -255,10 +269,143 @@ class Raylet:
                     if entry.is_actor_worker and entry.actor_id:
                         getattr(entry, "_pool", self.node).release(
                             ResourceSet(entry_spec_resources(entry)), entry.assignment)
+                        reason = (
+                            "killed by the memory monitor (node over "
+                            "memory_usage_threshold)" if entry.oom_killed
+                            else f"worker exited with code "
+                                 f"{entry.proc.returncode}")
                         await self._gcs.call("actor_update", {
                             "actor_id": entry.actor_id, "state": "DEAD",
-                            "reason": f"worker exited with code {entry.proc.returncode}"})
+                            "node_id": self.node_id, "reason": reason})
                         entry.is_actor_worker = False
+
+    async def _reconcile_after_resurrection(self) -> None:
+        """While this node was (spuriously) dead, the GCS dropped our object
+        locations and may have restarted our actors elsewhere / rescheduled
+        our PG bundles. Re-publish every object this node can still serve
+        (shm AND spilled — spill files serve chunks too), kill local actor
+        workers the GCS no longer maps to this node (duplicate
+        side-effecting copies otherwise), and release bundle reservations we
+        no longer own. Failures are per-item; a republish that dies midway
+        is retried wholesale by the next resurrection or get-path repair."""
+        oids = {o.hex() for o in self.store.list_objects()}
+        oids.update(h for h, m in self._object_meta.items()
+                    if m.get("spilled"))
+        for oid_hex in oids:
+            try:
+                await self._gcs.call("add_object_location", {
+                    "oid": oid_hex, "node_id": self.node_id})
+            except Exception:  # noqa: BLE001 — transient; keep going
+                continue
+        for entry in list(self._workers.values()):
+            if not entry.is_actor_worker or not entry.actor_id:
+                continue
+            try:
+                reply = await self._gcs.call(
+                    "get_actor_info", {"actor_id": entry.actor_id})
+                info = reply.get("info")
+            except Exception:  # noqa: BLE001 — next heartbeat retries
+                continue
+            if info is None or info.get("node_id") != self.node_id \
+                    or info.get("state") == "DEAD":
+                entry.is_actor_worker = False  # suppress the DEAD re-report
+                entry.actor_id = None
+                getattr(entry, "_pool", self.node).release(
+                    ResourceSet(entry_spec_resources(entry)),
+                    entry.assignment)
+                self._terminate_worker(entry)
+                self._dispatch_event.set()
+        for (pg_id, idx), bundle in list(self._bundles.items()):
+            try:
+                reply = await self._gcs.call(
+                    "get_placement_group", {"pg_id": pg_id})
+            except Exception:  # noqa: BLE001
+                continue
+            info = reply.get("info") or reply
+            nodes = info.get("bundle_nodes") or []
+            if (info.get("state") == "REMOVED"
+                    or idx >= len(nodes) or nodes[idx] != self.node_id):
+                self._bundles.pop((pg_id, idx), None)
+                self.node.release(bundle.node_req, bundle.node_assignment)
+                self._dispatch_event.set()
+
+    def _terminate_worker(self, entry: _WorkerEntry,
+                          grace_s: float = 5.0) -> None:
+        """SIGTERM now, SIGKILL if still alive after the grace period. The
+        entry STAYS in ``_workers`` so the reap loop's ``poll()`` collects
+        the child (popping immediately would leak a zombie — nothing would
+        ever wait() it)."""
+        try:
+            entry.proc.terminate()
+        except ProcessLookupError:
+            return
+
+        async def _escalate():
+            await asyncio.sleep(grace_s)
+            if entry.proc.poll() is None:
+                try:
+                    entry.proc.kill()
+                except ProcessLookupError:
+                    pass
+
+        spawn_task(_escalate())
+
+    # injectable for tests (fake pressure without allocating gigabytes);
+    # instance-level plain callable, so no descriptor binding applies
+    _memory_info_fn = None
+
+    async def _memory_monitor_loop(self) -> None:
+        """OOM prevention (reference: ``common/memory_monitor.h`` polling +
+        ``raylet/worker_killing_policy.cc``): when node memory use crosses
+        ``memory_usage_threshold``, kill one worker — retriable task workers
+        first, largest RSS — so the kernel OOM-killer never takes down the
+        raylet or an arbitrary process."""
+        from ray_tpu import _native
+
+        cfg = get_config()
+        while True:
+            await asyncio.sleep(cfg.memory_monitor_interval_s)
+            try:
+                # per-tick lookup: tests inject a fake probe on the instance
+                info = (self._memory_info_fn or _native.memory_info)()
+                total, used = info.get("total", -1), info.get("used", -1)
+                if total <= 0 or used < 0:
+                    continue
+                if used / total < cfg.memory_usage_threshold:
+                    continue
+                victim = self._pick_oom_victim()
+                if victim is None:
+                    continue
+                victim.oom_killed = True
+                try:
+                    victim.proc.kill()
+                except ProcessLookupError:
+                    pass
+            except Exception:  # noqa: BLE001 — monitor must never die
+                pass
+
+    def _pick_oom_victim(self) -> Optional[_WorkerEntry]:
+        from ray_tpu import _native
+
+        task_workers, actor_workers = [], []
+        for e in self._workers.values():
+            if e.proc.poll() is not None or e.oom_killed:
+                continue
+            if e.is_actor_worker:
+                actor_workers.append(e)
+            elif e.busy:
+                task_workers.append(e)
+        # Task workers are retriable by policy; among them kill the largest
+        # RSS (frees the most memory). Actors only as a last resort — their
+        # death is user-visible (restart or ActorDiedError).
+        for group in (task_workers, actor_workers):
+            if not group:
+                continue
+            by_pid = {e.proc.pid: e for e in group}
+            ranked = _native.process_memory(list(by_pid))
+            if ranked:
+                return by_pid[ranked[0][0]]
+        return None
 
     async def _on_peer_disconnect(self, peer_id: str) -> None:
         pass
@@ -321,7 +468,7 @@ class Raylet:
             except Exception:
                 pass
 
-        asyncio.ensure_future(_send())
+        spawn_task(_send())
 
     async def _try_spillback(self, item) -> None:
         """Forward a queued-but-waiting task to a node with free capacity.
@@ -396,7 +543,7 @@ class Raylet:
                     remaining.append(item)  # a spillback attempt owns it
                 elif pool.can_fit(req):
                     assignment = pool.allocate(req)
-                    asyncio.ensure_future(
+                    spawn_task(
                         self._run_task(item, req, assignment, pool))
                 else:
                     # Load-based spillback (reference: spillback replies in
@@ -410,7 +557,7 @@ class Raylet:
                             and time.monotonic() - item.get("t", 0)
                             > cfg.spillback_delay_s):
                         item["spilling"] = True
-                        asyncio.ensure_future(self._try_spillback(item))
+                        spawn_task(self._try_spillback(item))
                     remaining.append(item)
             self._queue = remaining
 
@@ -426,6 +573,7 @@ class Raylet:
         key = (tuple(chips), renv["hash"] if renv else None)
         self._inflight[task_id] = {"req": req, "released": ResourceSet(),
                                    "pool": pool}
+        worker = None
         try:
             worker = await self._get_worker(key, chips, renv)
             worker.busy = True
@@ -443,7 +591,15 @@ class Raylet:
         except Exception as e:  # worker crashed mid-task or failed to start
             self._task_event(task_id, payload.get("fn_name"), "FAILED")
             if not fut.done():
-                fut.set_result({"error": "worker_crashed", "message": repr(e)})
+                if worker is not None and worker.oom_killed:
+                    fut.set_result({
+                        "error": "oom_killed",
+                        "message": f"memory monitor killed the worker "
+                                   f"running {payload.get('fn_name')!r} "
+                                   f"(node over memory_usage_threshold)"})
+                else:
+                    fut.set_result({"error": "worker_crashed",
+                                    "message": repr(e)})
         finally:
             state = self._inflight.pop(task_id)
             pool.release(state["req"].subtract(state["released"]), assignment)
@@ -534,14 +690,11 @@ class Raylet:
                 # same resources a second time (double-release would corrupt
                 # chip accounting).
                 worker.is_actor_worker = False
-                self._workers.pop(worker.worker_id, None)
                 pool.release(req, assignment)
-                try:
-                    worker.proc.terminate()
-                except ProcessLookupError:
-                    pass
+                self._terminate_worker(worker)  # reap loop collects it
                 await self._gcs.call("actor_update", {
                     "actor_id": p["actor_id"], "state": "DEAD",
+                    "node_id": self.node_id,
                     "reason": reply.get("error", "actor __init__ failed")})
                 return {"ok": False, "error": reply.get("error")}
             await self._gcs.call("actor_update", {
@@ -551,11 +704,7 @@ class Raylet:
         except Exception as e:
             if worker is not None:
                 worker.is_actor_worker = False
-                self._workers.pop(worker.worker_id, None)
-                try:
-                    worker.proc.terminate()
-                except ProcessLookupError:
-                    pass
+                self._terminate_worker(worker)  # reap loop collects it
             pool.release(req, assignment)
             return {"ok": False, "error": repr(e)}
 
@@ -563,13 +712,10 @@ class Raylet:
         for entry in list(self._workers.values()):
             if entry.actor_id == p["actor_id"]:
                 entry.is_actor_worker = False  # suppress DEAD re-report
+                entry.actor_id = None  # a later duplicate kill is a no-op
                 getattr(entry, "_pool", self.node).release(
                     ResourceSet(entry_spec_resources(entry)), entry.assignment)
-                try:
-                    entry.proc.terminate()
-                except ProcessLookupError:
-                    pass
-                self._workers.pop(entry.worker_id, None)
+                self._terminate_worker(entry)
         return {"ok": True}
 
     # ---- object plane -------------------------------------------------------
@@ -677,6 +823,9 @@ class Raylet:
                 tmp = self._spill_path(oid_hex) + ".tmp"
                 with open(tmp, "wb") as f:
                     f.write(view)
+                from ray_tpu import _native
+
+                meta["crc"] = _native.crc32c(view)
                 os.rename(tmp, self._spill_path(oid_hex))
                 self.store.delete(ObjectID.from_hex(oid_hex))
                 meta["spilled"] = True
@@ -706,6 +855,18 @@ class Raylet:
                 return False
             with open(path, "rb") as f:
                 payload = f.read()
+            expected = self._object_meta.get(oid_hex, {}).get("crc")
+            if expected is not None:
+                from ray_tpu import _native
+
+                if _native.crc32c(payload) != expected:
+                    # corrupt spill file: drop it; the owner reconstructs
+                    # from lineage (better loud loss than silent corruption)
+                    try:
+                        os.unlink(path)
+                    except FileNotFoundError:
+                        pass
+                    return False
             oid = ObjectID.from_hex(oid_hex)
             if not self.store.contains(oid):
                 self.store.write_whole(oid, payload)
@@ -745,17 +906,24 @@ class Raylet:
         both serve — the puller never needs the whole payload in one frame."""
         from ray_tpu._private.ids import ObjectID
 
+        from ray_tpu import _native
+
         oid_hex, off, size = p["oid"], p["offset"], p["size"]
+        kind = _native.checksum_kind()
         view = self.store.read(ObjectID.from_hex(oid_hex))
         if view is not None:
             self._touch(oid_hex)
-            return {"total": len(view), "data": bytes(view[off:off + size])}
+            data = bytes(view[off:off + size])
+            return {"total": len(view), "data": data,
+                    "crc": _native.crc32c(data), "crc_kind": kind}
         path = self._spill_path(oid_hex)
         try:
             total = os.path.getsize(path)
             with open(path, "rb") as f:
                 f.seek(off)
-                return {"total": total, "data": f.read(size)}
+                data = f.read(size)
+            return {"total": total, "data": data,
+                    "crc": _native.crc32c(data), "crc_kind": kind}
         except FileNotFoundError:
             return {"error": "not found"}
 
@@ -763,25 +931,43 @@ class Raylet:
         """Pull a remote object into local shm in bounded chunks, writing
         straight into the store's mmap (peak memory = one chunk). Returns
         the object size, or None if the source doesn't have it."""
+        from ray_tpu import _native
+
+        def _checked(reply) -> Optional[bytes]:
+            data = reply.get("data")
+            if data is None:
+                return None
+            crc = reply.get("crc")
+            if crc is not None:
+                # verify with the ALGORITHM THE SENDER USED — a mixed
+                # native/fallback cluster must not fail every transfer
+                ours = _native.checksum(data, reply.get("crc_kind", "crc32c"))
+                if ours is not None and ours != crc:
+                    raise ConnectionError(
+                        f"chunk checksum mismatch for {oid_hex} "
+                        f"(corruption in transit)")
+            return data
+
         chunk = get_config().object_transfer_chunk_bytes
         first = await client.call("get_object_chunk",
                                   {"oid": oid_hex, "offset": 0, "size": chunk})
         if "data" not in first:
             return None
         total = first["total"]
-        if total <= len(first["data"]):
-            self.store.write_whole(oid, first["data"])
+        first_data = _checked(first)
+        if total <= len(first_data):
+            self.store.write_whole(oid, first_data)
             return total
         buf = self.store.create(oid, total)
         try:
-            n = len(first["data"])
-            buf[:n] = first["data"]
+            n = len(first_data)
+            buf[:n] = first_data
             off = n
             while off < total:
                 r = await client.call(
                     "get_object_chunk",
                     {"oid": oid_hex, "offset": off, "size": chunk})
-                data = r.get("data")
+                data = _checked(r)
                 if not data:  # source freed/evicted mid-transfer
                     raise ConnectionError("chunk source went away")
                 buf[off:off + len(data)] = data

@@ -30,6 +30,23 @@ class RpcError(Exception):
     pass
 
 
+# Strong references for fire-and-forget tasks. The event loop keeps only
+# WEAK references to tasks; a pending task whose await chain isn't rooted in
+# a live object is garbage-collected MID-FLIGHT ("Task was destroyed but it
+# is pending!"). Observed in the wild: a GC'd GcsServer._schedule_actor left
+# its actor PENDING_CREATION forever, and GC'd worker-side handler/consumer
+# tasks swallowed delivered actor calls without ever replying. Every
+# fire-and-forget in the framework must go through spawn_task().
+_BG_TASKS: set = set()
+
+
+def spawn_task(coro) -> "asyncio.Task":
+    t = asyncio.ensure_future(coro)
+    _BG_TASKS.add(t)
+    t.add_done_callback(_BG_TASKS.discard)
+    return t
+
+
 async def cancel_and_wait(*tasks) -> None:
     """Cancel tasks and await their completion, swallowing every outcome
     (CancelledError is a BaseException, hence the explicit tuple)."""
@@ -148,7 +165,7 @@ class RpcServer:
                 if handler is None and method == "hello":
                     async def handler(p):  # default hello ack
                         return {"ok": True}
-                asyncio.ensure_future(
+                spawn_task(
                     self._run_handler(handler, method, msg_id, payload,
                                       writer, write_lock))
         except (asyncio.IncompleteReadError, ConnectionResetError, BrokenPipeError):
@@ -299,7 +316,10 @@ class EventLoopThread:
         if self._stopped or self.loop.is_closed():
             coro.close()
             return
-        asyncio.run_coroutine_threadsafe(coro, self.loop)
+        # NOT run_coroutine_threadsafe: its task<->concurrent-future pair is
+        # an unreferenced cycle once the caller drops the return value, and
+        # the GC can then collect the task mid-flight (see spawn_task).
+        self.loop.call_soon_threadsafe(spawn_task, coro)
 
     def stop(self) -> None:
         self._stopped = True  # run()/spawn() fail fast from here on
@@ -317,6 +337,10 @@ class EventLoopThread:
             asyncio.run_coroutine_threadsafe(_drain(), self.loop).result(2)
         except Exception:
             pass
+        # Tasks that survived the bounded drain would pin _BG_TASKS forever
+        # (their done-callback never fires once the loop stops).
+        for t in [t for t in _BG_TASKS if t.get_loop() is self.loop]:
+            _BG_TASKS.discard(t)
         self.loop.call_soon_threadsafe(self.loop.stop)
         self._thread.join(timeout=2)
         if not self._thread.is_alive():

@@ -40,6 +40,7 @@ from ray_tpu.cluster.rpc import (
     EventLoopThread,
     RpcClient,
     RpcServer,
+    spawn_task,
 )
 from ray_tpu.exceptions import (
     ActorDiedError,
@@ -371,7 +372,7 @@ class ClusterBackend(RuntimeBackend):
                         return view
             finally:
                 if not pin_held:
-                    asyncio.ensure_future(self._unpin_quietly([oid_hex]))
+                    spawn_task(self._unpin_quietly([oid_hex]))
             if can_reconstruct and reconstruct_attempts < 2:
                 reconstruct_attempts += 1
                 await self._reconstruct(oid_hex)
@@ -463,7 +464,7 @@ class ClusterBackend(RuntimeBackend):
                       for r in refs])
             finally:
                 if not all_local:
-                    asyncio.ensure_future(self._unpin_quietly(oids))
+                    spawn_task(self._unpin_quietly(oids))
 
         payloads = self.io.run(_gather(), timeout=None if timeout is None
                                else timeout + 5.0)
@@ -702,7 +703,7 @@ class ClusterBackend(RuntimeBackend):
                 except Exception as e:
                     reply = {"error": "submit_failed", "message": repr(e)}
                 if (reply.get("error") in ("worker_crashed", "bundle_gone",
-                                           "submit_failed")
+                                           "submit_failed", "oom_killed")
                         and state.produced == 0 and not state.closed
                         and retries > 0):
                     retries -= 1
@@ -746,7 +747,7 @@ class ClusterBackend(RuntimeBackend):
             except Exception as e:
                 reply = {"error": "submit_failed", "message": repr(e)}
             if reply.get("error") in ("worker_crashed", "bundle_gone",
-                                      "submit_failed"):
+                                      "submit_failed", "oom_killed"):
                 if payload.get("pg") is not None:
                     self._pg_addr_cache.pop(
                         (payload["pg"]["pg_id"],
@@ -783,8 +784,13 @@ class ClusterBackend(RuntimeBackend):
     def _apply_task_reply(self, reply, refs: List[ObjectRef], fn_name: str,
                           payload: Optional[Dict] = None) -> None:
         if reply.get("error"):
-            err = WorkerCrashedError(
-                f"task {fn_name} failed: {reply.get('message', reply['error'])}")
+            msg = f"task {fn_name} failed: {reply.get('message', reply['error'])}"
+            if reply["error"] == "oom_killed":
+                from ray_tpu.exceptions import OutOfMemoryError
+
+                err: Exception = OutOfMemoryError(msg)
+            else:
+                err = WorkerCrashedError(msg)
             blob = self.serde.serialize(err).to_bytes()
             for r in refs:
                 self.memory_store.put(r.hex(), blob)

@@ -231,8 +231,11 @@ class WorkerProcess:
         self._actor_queue = asyncio.Queue()
         self._actor_threads = ThreadPoolExecutor(
             max_workers=max_conc, thread_name_prefix="rt-actor")
-        for _ in range(max_conc):
-            asyncio.ensure_future(self._actor_consumer())
+        from ray_tpu.cluster.rpc import spawn_task
+
+        # strong refs: a GC'd consumer would strand queued calls forever
+        self._consumer_tasks = [spawn_task(self._actor_consumer())
+                                for _ in range(max_conc)]
 
         def build():
             from ray_tpu.core.worker import global_worker
@@ -311,6 +314,12 @@ class WorkerProcess:
 
 
 def main() -> None:
+    # Debuggability: `kill -USR1 <worker_pid>` dumps all thread stacks to the
+    # worker's log (stderr) — the only way to see inside a wedged worker.
+    import faulthandler
+    import signal
+
+    faulthandler.register(signal.SIGUSR1, file=sys.stderr, all_threads=True)
     wp = WorkerProcess()
     wp.start()
     threading.Event().wait()  # io loop thread does the work

@@ -54,6 +54,9 @@ def _forget_controller() -> None:
     global _controller
     with _controller_lock:
         _controller = None
+    from ray_tpu.serve.handle import _reset_pool
+
+    _reset_pool()
 
 
 class Application:

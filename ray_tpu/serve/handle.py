@@ -151,7 +151,7 @@ class _RouterState:
             if not force and now - self.fetched_at < _REFRESH_TTL_S:
                 return
         snap = ray_tpu.get(self._controller().get_replicas.remote(
-            self.app, self.deployment, self.version))
+            self.app, self.deployment, self.version), timeout=30)
         self._apply(snap)
 
     def wake_and_wait(self) -> None:
@@ -221,6 +221,17 @@ def _shared_pool() -> ThreadPoolExecutor:
             _pool = ThreadPoolExecutor(max_workers=32,
                                        thread_name_prefix="rt-serve-handle")
         return _pool
+
+
+def _reset_pool() -> None:
+    """Drop the shared pool on serve shutdown: calls stranded mid-RPC against
+    a dead cluster must not occupy slots and starve the next serve instance
+    (one bounded pool is shared process-wide)."""
+    global _pool
+    with _pool_lock:
+        old, _pool = _pool, None
+    if old is not None:
+        old.shutdown(wait=False)
 
 
 class DeploymentResponseGenerator:

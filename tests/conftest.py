@@ -10,12 +10,65 @@ import faulthandler  # noqa: E402
 import pytest  # noqa: E402
 
 
+def _dump_io_tasks(reason: str) -> None:
+    """Print the driver io-loop's asyncio task stacks to stderr — OS-thread
+    dumps (faulthandler) show loops idle in select(); the wedge lives in
+    task await graphs."""
+    import asyncio
+    import traceback
+
+    try:
+        from ray_tpu.core.worker import global_worker
+
+        backend = global_worker().backend
+        if backend is None:
+            return
+        loops = {"driver": backend.io.loop}
+        cluster = getattr(backend, "_cluster", None)
+        if cluster is not None and getattr(cluster, "io", None) is not None:
+            loops["cluster(gcs+raylet)"] = cluster.io.loop
+
+        def dump(tag, loop):
+            def _go():
+                print(f"\n===== {tag} asyncio tasks ({reason}) =====",
+                      file=sys.stderr)
+                for t in asyncio.all_tasks(loop):
+                    print(f"-- {t!r}", file=sys.stderr)
+                    for fr in t.get_stack():
+                        traceback.print_stack(fr, limit=1, file=sys.stderr)
+                sys.stderr.flush()
+            return _go
+
+        for tag, loop in loops.items():
+            loop.call_soon_threadsafe(dump(tag, loop))
+        import time as _t
+
+        _t.sleep(1.0)
+    except Exception as e:  # noqa: BLE001 — diagnostics must not raise
+        print(f"io task dump failed: {e}", file=sys.stderr)
+
+
 @pytest.fixture(autouse=True)
-def _hang_watchdog():
-    """A test that wedges past 300s dumps EVERY thread's stack and kills the
-    run — a silent CI hang becomes a loud, diagnosable failure."""
+def _hang_watchdog(request):
+    """A test that wedges past 50s first dumps the io-loop's asyncio task
+    stacks (the only place an await-graph deadlock is visible), then at 300s
+    faulthandler kills the run — a silent CI hang becomes a loud,
+    diagnosable failure."""
+    import threading
+
     faulthandler.dump_traceback_later(300, exit=True)
+    done = threading.Event()
+    name = request.node.name
+
+    def soft_dump():
+        if not done.wait(30):
+            faulthandler.dump_traceback(file=sys.stderr)
+            _dump_io_tasks(f"test {name} exceeded 30s")
+
+    t = threading.Thread(target=soft_dump, daemon=True)
+    t.start()
     yield
+    done.set()
     faulthandler.cancel_dump_traceback_later()
 
 

@@ -232,3 +232,36 @@ def test_cluster_parallel_tasks_distinct_workers(rt_cluster):
 
     pids = ray_tpu.get([slow_pid.remote() for _ in range(3)])
     assert len(set(pids)) == 3
+
+
+def test_node_resurrects_after_spurious_death(rt_cluster):
+    """A heartbeat from a node marked dead (e.g. the shared event loop
+    stalled past node_death_timeout_s on a loaded host) must resurrect it —
+    otherwise every later actor/task placement wedges in PENDING_CREATION
+    (pick_node skips dead nodes forever). Reference contrast:
+    gcs_node_manager.cc kills the raylet and it re-registers; an in-proc
+    raylet can't restart, so the GCS revives it in place."""
+    import asyncio
+
+    from ray_tpu.core.worker import global_worker
+
+    @ray_tpu.remote
+    class A:
+        def m(self):
+            return 1
+
+    a0 = A.remote()
+    assert ray_tpu.get(a0.m.remote()) == 1
+
+    backend = global_worker().backend
+    gcs = backend._cluster.gcs
+
+    async def kill_nodes():
+        for e in list(gcs.nodes.values()):
+            await gcs._mark_node_dead(e, "simulated heartbeat timeout")
+
+    asyncio.run_coroutine_threadsafe(kill_nodes(), backend.io.loop).result(10)
+    time.sleep(2.5)  # a couple of live heartbeats arrive and resurrect
+
+    a = A.remote()
+    assert ray_tpu.get(a.m.remote(), timeout=20) == 1
