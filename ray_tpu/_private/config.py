@@ -77,6 +77,13 @@ class Config:
     node_death_timeout_s: float = 10.0
     actor_restart_backoff_s: float = 0.5
     task_max_retries_default: int = 3
+    # OOM prevention (reference: common/memory_monitor.h +
+    # raylet/worker_killing_policy.cc): when node memory use crosses the
+    # threshold, the raylet kills a worker (retriable task workers first,
+    # largest RSS) instead of letting the kernel OOM-killer nuke the raylet.
+    # >= 1.0 disables the monitor.
+    memory_usage_threshold: float = 0.95
+    memory_monitor_interval_s: float = 1.0
 
     # ---- gcs ---------------------------------------------------------------
     gcs_rpc_timeout_s: float = 30.0

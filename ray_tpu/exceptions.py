@@ -90,6 +90,12 @@ class TaskCancelledError(RayTpuError):
         super().__init__(f"task {task_id} cancelled")
 
 
+class OutOfMemoryError(RayTpuError):
+    """The raylet's memory monitor killed this task's worker to keep the
+    node alive (reference: ``worker_killing_policy.cc``; the task is
+    retried if it has retries left)."""
+
+
 class WorkerCrashedError(RayTpuError):
     """The worker process executing the task died unexpectedly."""
 

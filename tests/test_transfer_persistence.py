@@ -181,3 +181,28 @@ def test_cli_head_restart_preserves_kv(tmp_path):
         if ray_tpu.is_initialized():
             ray_tpu.shutdown()
         _cli(env, "stop", "--force")
+
+
+def test_gcs_kv_wal_str_and_bytes_roundtrip(tmp_path):
+    """The KV WAL (native LogKV) must preserve value TYPES across restart:
+    callers store both str (json configs) and bytes (pickled blobs)."""
+    import asyncio
+
+    from ray_tpu.cluster.gcs import GcsServer
+
+    path = str(tmp_path / "gcs_state")
+
+    async def run():
+        g = GcsServer(persist_path=path)
+        await g.rpc_kv_put({"key": "s", "value": "json-string"})
+        await g.rpc_kv_put({"key": "b", "value": b"\x00raw"})
+        await g.rpc_kv_put({"key": "gone", "value": "x"})
+        await g.rpc_kv_del({"key": "gone"})
+        await g.stop()
+        g2 = GcsServer(persist_path=path)
+        assert g2.kv["s"] == "json-string"
+        assert g2.kv["b"] == b"\x00raw"
+        assert "gone" not in g2.kv
+        await g2.stop()
+
+    asyncio.run(run())
