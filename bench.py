@@ -193,6 +193,10 @@ def _inner_main() -> None:
         ladder = [("debug", 8, 128, 3, "xla", 0)]
     else:
         ladder = [
+            # biggest batch first: single-chip MFU rises with batch until
+            # OOM, and the walk-down makes OOM free
+            ("410m", 32, 2048, 20, "flash", 512),
+            ("410m", 16, 2048, 20, "flash", 512),
             ("410m", 8, 2048, 20, "flash", 512),
             ("410m", 8, 2048, 20, "xla", 512),
             ("410m", 4, 2048, 20, "flash", 512),

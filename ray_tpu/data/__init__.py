@@ -19,6 +19,7 @@ from ray_tpu.data.context import DataContext  # noqa: F401
 from ray_tpu.data.dataset import (  # noqa: F401
     Dataset,
     from_arrow,
+    from_huggingface,
     from_items,
     from_numpy,
     from_pandas,
@@ -31,6 +32,8 @@ from ray_tpu.data.dataset import (  # noqa: F401
     read_parquet,
     read_sql,
     read_text,
+    read_tfrecords,
+    read_webdataset,
 )
 from ray_tpu.data.iterator import DataIterator  # noqa: F401
 from ray_tpu.data.logical import ActorPoolStrategy  # noqa: F401

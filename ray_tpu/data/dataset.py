@@ -301,6 +301,9 @@ class Dataset:
     def write_numpy(self, path: str) -> List[str]:
         return self._write(path, "npy")
 
+    def write_tfrecords(self, path: str) -> List[str]:
+        return self._write(path, "tfrecords")
+
     def stats(self) -> str:
         n = self.count()
         return f"Dataset(rows={n}, ops={len(self._ops)})"
@@ -411,3 +414,47 @@ def read_sql(sql: str, connection_factory) -> Dataset:
 
 def read_images(paths, *, size=None, mode: str = "RGB") -> Dataset:
     return Dataset(ds_mod.images_read_tasks(paths, size, mode))
+
+
+def read_tfrecords(paths) -> Dataset:
+    """tf.train.Example TFRecord shards, no tensorflow dependency
+    (reference: ``data/datasource/tfrecords_datasource.py``)."""
+    return Dataset(ds_mod.tfrecords_read_tasks(paths))
+
+
+def read_webdataset(paths, *, decode: bool = True) -> Dataset:
+    """WebDataset .tar shards: samples grouped by key, columns by extension
+    (reference: ``data/datasource/webdataset_datasource.py``)."""
+    return Dataset(ds_mod.webdataset_read_tasks(paths, decode=decode))
+
+
+def from_huggingface(hf_dataset, *, parallelism: int = -1) -> Dataset:
+    """A 🤗 ``datasets.Dataset`` (in-memory/arrow-backed) sliced into blocks
+    (reference: ``data/read_api.py`` ``from_huggingface``)."""
+    n = len(hf_dataset)
+    if n == 0:
+        return Dataset([lambda: {}])
+    num_blocks = parallelism if parallelism > 0 else max(1, min(200, n // 1000 or 1))
+    per = (n + num_blocks - 1) // num_blocks
+
+    def make(lo, hi):
+        def read():
+            import numpy as np  # noqa: F401
+
+            cols = hf_dataset[lo:hi]  # dict of lists
+            return {k: _np_col(v) for k, v in cols.items()}
+
+        return read
+
+    def _np_col(v):
+        import numpy as np
+
+        try:
+            return np.asarray(v)
+        except Exception:  # ragged: keep as object array
+            arr = np.empty(len(v), dtype=object)
+            arr[:] = v
+            return arr
+
+    return Dataset([make(lo, min(lo + per, n))
+                    for lo in range(0, n, per)] or [lambda: {}])

@@ -230,6 +230,240 @@ def write_block(block: B.Block, path: str, file_format: str, index: int) -> str:
         if len(block) != 1:
             raise ValueError("write_numpy requires a single-column dataset")
         np.save(out, next(iter(block.values())))
+    elif file_format == "tfrecords":
+        return write_tfrecords_block(block, path, index)
     else:
         raise ValueError(f"unsupported format {file_format}")
     return out
+
+
+# ---- TFRecord (reference: data/datasource/tfrecords_datasource.py) ---------
+#
+# TFRecord framing: u64-LE length | u32 masked-crc(length) | payload |
+# u32 masked-crc(payload). Payloads are tf.train.Example protos; the tiny
+# wire-format codec below handles exactly that schema (BytesList /
+# FloatList / Int64List feature maps) with no tensorflow dependency.
+
+def _read_varint(buf: memoryview, pos: int):
+    result = shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+
+
+def _iter_proto_fields(buf: memoryview):
+    pos = 0
+    while pos < len(buf):
+        tag, pos = _read_varint(buf, pos)
+        field, wire = tag >> 3, tag & 7
+        if wire == 2:  # length-delimited
+            n, pos = _read_varint(buf, pos)
+            yield field, buf[pos:pos + n]
+            pos += n
+        elif wire == 0:  # varint
+            v, pos = _read_varint(buf, pos)
+            yield field, v
+        elif wire == 5:  # 32-bit
+            yield field, bytes(buf[pos:pos + 4])
+            pos += 4
+        elif wire == 1:  # 64-bit
+            yield field, bytes(buf[pos:pos + 8])
+            pos += 8
+        else:
+            raise ValueError(f"unsupported proto wire type {wire}")
+
+
+def _decode_example(payload: memoryview) -> Dict[str, Any]:
+    import struct
+
+    out: Dict[str, Any] = {}
+    for f, features in _iter_proto_fields(payload):
+        if f != 1:  # Example.features
+            continue
+        for f2, entry in _iter_proto_fields(features):
+            if f2 != 1:  # Features.feature map entry
+                continue
+            key, feature = None, None
+            for f3, v in _iter_proto_fields(entry):
+                if f3 == 1:
+                    key = bytes(v).decode()
+                elif f3 == 2:
+                    feature = v
+            if key is None or feature is None:
+                continue
+            for kind, body in _iter_proto_fields(feature):
+                vals: List[Any] = []
+                if kind == 1:  # BytesList
+                    vals = [bytes(v) for f4, v in _iter_proto_fields(body)
+                            if f4 == 1]
+                elif kind == 2:  # FloatList (packed or repeated)
+                    for f4, v in _iter_proto_fields(body):
+                        if isinstance(v, (bytes, memoryview)) and len(v) % 4 == 0 and not isinstance(v, int):
+                            vals.extend(struct.unpack(f"<{len(v)//4}f", v))
+                        else:
+                            vals.append(struct.unpack("<f", v)[0])
+                elif kind == 3:  # Int64List (packed varints or repeated)
+                    for f4, v in _iter_proto_fields(body):
+                        if isinstance(v, int):
+                            vals.append(v)
+                        else:
+                            pos = 0
+                            mv = memoryview(v)
+                            while pos < len(mv):
+                                x, pos = _read_varint(mv, pos)
+                                if x >= 1 << 63:  # two's-complement int64
+                                    x -= 1 << 64
+                                vals.append(x)
+                out[key] = vals[0] if len(vals) == 1 else vals
+    return out
+
+
+def _tfrecord_frames(path: str):
+    import struct
+
+    with open(path, "rb") as f:
+        while True:
+            header = f.read(12)
+            if len(header) < 12:
+                return
+            (length,) = struct.unpack("<Q", header[:8])
+            payload = f.read(length)
+            f.read(4)  # payload crc (masked crc32c) — tolerated, not checked
+            if len(payload) < length:
+                return  # torn tail
+            yield payload
+
+
+def tfrecords_read_tasks(paths) -> List[ReadTask]:
+    files = expand_paths(paths)
+
+    def make(path):
+        def read() -> B.Block:
+            rows = [_decode_example(memoryview(p))
+                    for p in _tfrecord_frames(path)]
+            return B.from_rows(rows)
+
+        return read
+
+    return [make(p) for p in files]
+
+
+def _masked_crc(data: bytes) -> int:
+    from ray_tpu import _native
+
+    crc = _native.crc32c(data)
+    return ((crc >> 15 | crc << 17) + 0xA282EAD8) & 0xFFFFFFFF
+
+
+def _encode_example(row: Dict[str, Any]) -> bytes:
+    """Encode one row as tf.train.Example (inverse of _decode_example)."""
+    import struct
+
+    def varint(n: int) -> bytes:
+        # proto int64: negatives are 10-byte two's-complement varints — the
+        # unsigned mask also stops `n >>= 7` looping forever on n < 0
+        n &= (1 << 64) - 1
+        out = b""
+        while True:
+            b7 = n & 0x7F
+            n >>= 7
+            if n:
+                out += bytes([b7 | 0x80])
+            else:
+                return out + bytes([b7])
+
+    def ld(field: int, body: bytes) -> bytes:  # length-delimited field
+        return varint(field << 3 | 2) + varint(len(body)) + body
+
+    feats = b""
+    for key, value in row.items():
+        vals = value if isinstance(value, (list, tuple, np.ndarray)) else [value]
+        first = vals[0] if len(vals) else 0
+        if isinstance(first, (bytes, str)):
+            body = b"".join(
+                ld(1, v.encode() if isinstance(v, str) else bytes(v))
+                for v in vals)
+            feature = ld(1, body)
+        elif isinstance(first, (float, np.floating)):
+            packed = struct.pack(f"<{len(vals)}f", *[float(v) for v in vals])
+            feature = ld(2, ld(1, packed))
+        else:
+            packed = b"".join(varint(int(v)) for v in vals)
+            feature = ld(3, ld(1, packed))
+        feats += ld(1, ld(1, key.encode()) + ld(2, feature))
+    return ld(1, feats)
+
+
+def write_tfrecords_block(block: B.Block, path: str, index: int) -> str:
+    import struct
+
+    out = os.path.join(path, f"part-{index:05d}.tfrecords")
+    with open(out, "wb") as f:
+        for row in B.iter_rows(block):
+            payload = _encode_example(row)
+            header = struct.pack("<Q", len(payload))
+            f.write(header + struct.pack("<I", _masked_crc(header))
+                    + payload + struct.pack("<I", _masked_crc(payload)))
+    return out
+
+
+# ---- WebDataset (reference: data/datasource/webdataset_datasource.py) ------
+
+def _wds_decode(ext: str, payload: bytes) -> Any:
+    ext = ext.lower()
+    if ext in ("jpg", "jpeg", "png", "ppm", "bmp"):
+        import io
+
+        from PIL import Image
+
+        return np.asarray(Image.open(io.BytesIO(payload)).convert("RGB"))
+    if ext == "json":
+        import json
+
+        return json.loads(payload)
+    if ext in ("txt", "text"):
+        return payload.decode()
+    if ext in ("cls", "id", "index"):
+        return int(payload.decode().strip())
+    if ext == "npy":
+        import io
+
+        return np.load(io.BytesIO(payload), allow_pickle=False)
+    return payload  # unknown extension: raw bytes
+
+
+def webdataset_read_tasks(paths, *, decode: bool = True) -> List[ReadTask]:
+    """One read task per .tar shard; samples are files grouped by the
+    basename up to the first dot, columns keyed by extension."""
+    files = expand_paths(paths)
+
+    def make(path):
+        def read() -> B.Block:
+            import tarfile
+
+            samples: Dict[str, Dict[str, Any]] = {}
+            order: List[str] = []
+            with tarfile.open(path) as tar:
+                for member in tar:
+                    if not member.isfile():
+                        continue
+                    base = os.path.basename(member.name)
+                    if "." in base:
+                        key, ext = base.split(".", 1)
+                    else:
+                        key, ext = base, ""
+                    payload = tar.extractfile(member).read()
+                    if key not in samples:
+                        samples[key] = {"__key__": key}
+                        order.append(key)
+                    samples[key][ext] = (_wds_decode(ext, payload)
+                                         if decode else payload)
+            return B.from_rows([samples[k] for k in order])
+
+        return read
+
+    return [make(p) for p in files]

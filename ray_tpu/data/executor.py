@@ -188,30 +188,42 @@ class ActorMapStage(Stage):
         self.post = post
 
     def run(self, upstream: Iterator, ctx) -> Iterator:
+        """Autoscaling pool (reference: ``ActorPoolMapOperator`` +
+        ``AutoscalingPolicy``): start at min_size and add actors while the
+        upstream still has blocks and every slot is busy (up to max_size).
+        The whole pool is released in the ``finally`` once every issued call
+        has materialized."""
         op = self.op
         strategy = op.compute or L.ActorPoolStrategy(size=2)
-        n_actors = strategy.pool_size()
+        lo = strategy.size or strategy.min_size
+        hi = strategy.size or max(strategy.max_size or lo, lo)
         opts: Dict[str, Any] = {}
         if op.num_cpus is not None:
             opts["num_cpus"] = op.num_cpus
         if op.num_tpus:
             opts["num_tpus"] = op.num_tpus
         actor_cls = _MapActor.options(**opts) if opts else _MapActor
-        pool = [actor_cls.remote(op.fn, op.fn_constructor_args, self.pre,
-                                 self.post, op.batch_size, op.batch_format,
-                                 op.fn_args, op.fn_kwargs)
-                for _ in range(n_actors)]
+        pool: List[Any] = []
+        counts: Dict[int, int] = {}
+
+        def add_actor() -> None:
+            counts[len(pool)] = 0
+            pool.append(actor_cls.remote(
+                op.fn, op.fn_constructor_args, self.pre, self.post,
+                op.batch_size, op.batch_format, op.fn_args, op.fn_kwargs))
+
+        for _ in range(lo):
+            add_actor()
         per_actor_cap = 2
         inflight: collections.deque = collections.deque()
         issued: List = []
-        counts = {i: 0 for i in range(n_actors)}
         upstream = iter(upstream)
         exhausted = False
         block_idx = 0
         try:
             while True:
                 while (not exhausted
-                       and len(inflight) < n_actors * per_actor_cap):
+                       and len(inflight) < len(pool) * per_actor_cap):
                     try:
                         ref = next(upstream)
                     except StopIteration:
@@ -223,6 +235,10 @@ class ActorMapStage(Stage):
                     block_idx += 1
                     issued.append(out)
                     inflight.append((i, out))
+                if (not exhausted and len(pool) < hi
+                        and all(c >= per_actor_cap for c in counts.values())):
+                    add_actor()  # demand outruns capacity: scale up
+                    continue
                 if not inflight:
                     return
                 i, out = inflight.popleft()

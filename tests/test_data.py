@@ -309,3 +309,64 @@ def test_train_integration_dataset_shard(rt_cluster, tmp_path):
     # both workers together consumed every row exactly once
     # (driver keeps rank-0 metrics; check the sum is a partition of total)
     assert 0 < result.metrics["total"] < sum(range(64)) + 1
+
+
+def test_actor_pool_autoscales_min_to_max(rt_cluster):
+    """ActorPoolStrategy(min_size, max_size): the pool starts at min and
+    grows under backlog (reference: ActorPoolMapOperator autoscaling).
+    Distinct instance ids across > min_size actors prove the scale-up."""
+    import os
+
+    class Tag:
+        def __call__(self, batch):
+            import time as t
+
+            t.sleep(0.15)  # slow enough to build backlog
+            return {"id": batch["id"], "pid": np.full(len(batch["id"]),
+                                                      os.getpid())}
+
+    ds = data.range(24, parallelism=12).map_batches(
+        Tag, batch_size=2,
+        compute=data.ActorPoolStrategy(min_size=1, max_size=3))
+    rows = ds.take_all()
+    assert sorted(r["id"] for r in rows) == list(range(24))
+    assert len({r["pid"] for r in rows}) >= 2  # scaled past min_size=1
+
+
+def test_tfrecords_roundtrip(rt_cluster, tmp_path):
+    """write_tfrecords produces real TFRecord framing + tf.train.Example
+    protos that read_tfrecords parses back (no tensorflow involved)."""
+    ds = data.from_items([
+        {"label": i - 3, "score": float(i) / 2, "name": f"row{i}".encode()}
+        for i in range(20)])  # negative labels: int64 varint two's-complement
+    out = str(tmp_path / "tfr")
+    files = ds.write_tfrecords(out)
+    assert files and all(f.endswith(".tfrecords") for f in files)
+    back = data.read_tfrecords(out).take_all()
+    assert sorted(r["label"] for r in back) == [i - 3 for i in range(20)]
+    by_label = {r["label"]: r for r in back}
+    assert by_label[1]["name"] == b"row4"
+    assert abs(by_label[1]["score"] - 2.0) < 1e-6
+
+
+def test_webdataset_read(rt_cluster, tmp_path):
+    import io
+    import json
+    import tarfile
+
+    shard = tmp_path / "shard-000.tar"
+    with tarfile.open(shard, "w") as tar:
+        for i in range(6):
+            for ext, payload in (
+                    ("cls", str(i % 3).encode()),
+                    ("json", json.dumps({"idx": i}).encode()),
+                    ("txt", f"caption {i}".encode())):
+                data_bytes = payload
+                info = tarfile.TarInfo(f"sample{i:04d}.{ext}")
+                info.size = len(data_bytes)
+                tar.addfile(info, io.BytesIO(data_bytes))
+    rows = data.read_webdataset(str(shard)).take_all()
+    assert len(rows) == 6
+    assert sorted(r["__key__"] for r in rows)[0] == "sample0000"
+    assert rows[0]["json"]["idx"] in range(6)
+    assert all(isinstance(r["cls"], int) for r in rows)
