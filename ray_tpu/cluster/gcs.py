@@ -876,6 +876,8 @@ class GcsServer:
         ev.update({"task_id": p["task_id"], "name": p.get("name", ev.get("name")),
                    "state": p["state"], "node_id": p.get("node_id"),
                    "updated_at": time.time()})
+        if p.get("trace") is not None:
+            ev["trace"] = p["trace"]
         # per-state transition times feed ray_tpu.timeline()'s Chrome trace
         ev.setdefault("times", {})[p["state"]] = time.time()
         self.task_events[p["task_id"]] = ev

@@ -92,6 +92,12 @@ class Raylet:
         self._replies: Dict[str, Dict] = {}  # task_id -> successful reply
         self._bundles: Dict[Tuple[str, int], _BundleState] = {}
         self._dispatch_event = asyncio.Event()
+        # worker-log ring (filled by _log_pump_loop, drained by poll_logs)
+        import collections as _collections
+
+        self._log_buf: "_collections.deque" = _collections.deque(maxlen=10000)
+        self._log_seq = 0
+        self._log_event = asyncio.Event()
         self._local_objects: set = set()
         self._tasks: List[asyncio.Task] = []
         self._stopped = False
@@ -143,6 +149,7 @@ class Raylet:
         self._tasks.append(asyncio.ensure_future(self._heartbeat_loop()))
         self._tasks.append(asyncio.ensure_future(self._dispatch_loop()))
         self._tasks.append(asyncio.ensure_future(self._reap_loop()))
+        self._tasks.append(asyncio.ensure_future(self._log_pump_loop()))
         if get_config().memory_usage_threshold < 1.0:
             self._tasks.append(
                 asyncio.ensure_future(self._memory_monitor_loop()))
@@ -212,6 +219,9 @@ class Raylet:
         env["RT_NODE_ID"] = self.node_id
         env["RT_SESSION_NAME"] = self.session_name
         env["RT_CONFIG_JSON"] = get_config().to_json()
+        # user prints must reach the log file (and the driver echo) promptly,
+        # not sit in a block buffer until the worker exits
+        env["PYTHONUNBUFFERED"] = "1"
         if runtime_env:
             env["RT_RUNTIME_ENV_JSON"] = json.dumps(runtime_env)
         if chips:
@@ -407,6 +417,77 @@ class Raylet:
                 return by_pid[ranked[0][0]]
         return None
 
+    # ---- worker log plumbing (reference: _private/log_monitor.py) ----------
+    # The raylet tails every worker log file and keeps a bounded ring of
+    # recent lines; drivers long-poll it and echo lines to their stderr
+    # (``log_to_driver``). File offsets persist across the pump's life so
+    # each line is forwarded once.
+
+    async def _log_pump_loop(self) -> None:
+        offsets: Dict[str, int] = {}
+        log_dir = os.path.join(get_config().session_dir_root,
+                               self.session_name, "logs")
+        while True:
+            await asyncio.sleep(0.3)
+            try:
+                names = os.listdir(log_dir)
+            except FileNotFoundError:
+                continue
+            new_any = False
+            for name in names:
+                if not name.startswith("worker-"):
+                    continue
+                path = os.path.join(log_dir, name)
+                off = offsets.get(name, 0)
+                try:
+                    size = os.path.getsize(path)
+                    if size <= off:
+                        continue
+                    with open(path, "rb") as f:
+                        f.seek(off)
+                        chunk = f.read(256 * 1024)
+                    # forward whole lines; keep a partial tail for next
+                    # tick — unless the window is FULL with no newline (one
+                    # giant line): forward it truncated and advance, or the
+                    # pump would re-read the same window forever
+                    cut = chunk.rfind(b"\n")
+                    if cut < 0:
+                        if len(chunk) < 256 * 1024:
+                            continue  # incomplete line still being written
+                        cut = len(chunk)
+                    offsets[name] = off + cut + (0 if cut == len(chunk)
+                                                 else 1)
+                    wid = name[len("worker-"):-len(".log")]
+                    for line in chunk[:cut].decode(
+                            errors="replace").splitlines():
+                        self._log_seq += 1
+                        self._log_buf.append(
+                            {"seq": self._log_seq, "worker_id": wid,
+                             "line": line})
+                        new_any = True
+                except OSError:
+                    continue
+            if new_any:
+                self._log_event.set()
+                self._log_event = asyncio.Event()
+
+    async def rpc_poll_logs(self, p):
+        """Long-poll new worker log lines after ``seq`` (0 = from now)."""
+        buf = self._log_buf
+        after = p.get("after")
+        if after is None:
+            return {"seq": self._log_seq, "entries": []}
+        entries = [e for e in buf if e["seq"] > after]
+        if not entries:
+            try:
+                await asyncio.wait_for(self._log_event.wait(),
+                                       p.get("timeout", 10.0))
+            except asyncio.TimeoutError:
+                pass
+            entries = [e for e in buf if e["seq"] > after]
+        return {"seq": max((e["seq"] for e in entries),
+                           default=after), "entries": entries}
+
     async def _on_peer_disconnect(self, peer_id: str) -> None:
         pass
 
@@ -452,19 +533,24 @@ class Raylet:
         # pending and drive resource_demand_scheduler).
         self._queue.append({"payload": p, "future": fut,
                             "t": time.monotonic(), "spilling": False})
-        self._task_event(task_id, p.get("fn_name"), "PENDING")
+        self._task_event(task_id, p.get("fn_name"), "PENDING",
+                         trace=p.get("trace"))
         self._dispatch_event.set()
         return await asyncio.shield(fut)
 
-    def _task_event(self, task_id: str, name, state: str) -> None:
+    def _task_event(self, task_id: str, name, state: str,
+                    trace: "Optional[Dict]" = None) -> None:
         """Fire-and-forget state event to the GCS task store (reference:
         TaskEventBuffer -> GcsTaskManager); observability only, never blocks
-        or fails the task path."""
+        or fails the task path. ``trace`` carries the span context when the
+        submitter had tracing enabled."""
         async def _send():
             try:
-                await self._gcs.call("task_event", {
-                    "task_id": task_id, "name": name, "state": state,
-                    "node_id": self.node_id})
+                msg = {"task_id": task_id, "name": name, "state": state,
+                       "node_id": self.node_id}
+                if trace is not None:
+                    msg["trace"] = trace
+                await self._gcs.call("task_event", msg)
             except Exception:
                 pass
 

@@ -53,6 +53,16 @@ from ray_tpu.exceptions import (
 _SMALL = lambda: get_config().max_direct_call_object_size
 
 
+def _trace_ctx():
+    """Child-span wire context when tracing is on or a span is ambient
+    (None otherwise) — lazy import keeps tracing off the hot path."""
+    from ray_tpu.util import tracing
+
+    return tracing.context_for_submit()
+
+
+
+
 class _MemoryStore:
     """Owner-side store of serialized payloads with async readiness events."""
 
@@ -259,6 +269,52 @@ class ClusterBackend(RuntimeBackend):
             await self._raylet.connect()
 
         self.io.run(_go(), timeout=get_config().gcs_rpc_timeout_s)
+        if self.role == "driver" and get_config().log_to_driver:
+            self.io.spawn(self._log_forward_loop())
+
+    async def _log_forward_loop(self) -> None:
+        """Echo worker stdout/stderr lines to this driver's stderr with a
+        worker prefix (reference: ``_private/log_monitor.py`` +
+        ``worker.print_logs``). EVERY node's raylet is polled — one
+        long-poll task per raylet, refreshed from the GCS node table — so a
+        multi-host cluster's remote prints reach the driver too. Each
+        poller starts at the raylet's CURRENT seq (no history replay)."""
+        polled: Dict[str, asyncio.Task] = {}
+        while not self._shutdown:
+            try:
+                nodes = await self._gcs.call("list_nodes", {})
+            except Exception:  # noqa: BLE001 — teardown
+                return
+            for n in nodes:
+                addr = n.get("address")
+                if not addr or not n.get("alive"):
+                    continue
+                t = polled.get(addr)
+                if t is None or t.done():
+                    polled[addr] = spawn_task(self._poll_node_logs(addr))
+            await asyncio.sleep(10.0)
+
+    async def _poll_node_logs(self, address: str) -> None:
+        import sys
+
+        try:
+            client = await self._pool.get(address)
+            head = await client.call("poll_logs", {"after": None},
+                                     timeout=15.0)
+            seq = head.get("seq", 0)
+        except Exception:  # noqa: BLE001 — raylet without log pump
+            return
+        while not self._shutdown:
+            try:
+                reply = await client.call(
+                    "poll_logs", {"after": seq, "timeout": 5.0},
+                    timeout=30.0)
+            except Exception:  # noqa: BLE001 — node gone; outer loop retries
+                return
+            for e in reply.get("entries", ()):
+                print(f"\x1b[36m(worker {e['worker_id'][:8]})\x1b[0m "
+                      f"{e['line']}", file=sys.stderr)
+            seq = reply.get("seq", seq)
 
     @property
     def address(self) -> str:
@@ -661,6 +717,7 @@ class ClusterBackend(RuntimeBackend):
             "max_retries": options.get("max_retries",
                                        get_config().task_max_retries_default),
             "runtime_env": self._prepare_env(options),
+            "trace": _trace_ctx(),
         }
         self.io.spawn(self._submit_and_collect(payload, refs))
         return refs[0] if num_returns == 1 else refs
@@ -887,6 +944,7 @@ class ClusterBackend(RuntimeBackend):
             "kwargs": {k: self._serialize_arg(v) for k, v in kwargs.items()},
             "num_returns": num_returns,
             "owner": self.address,
+            "trace": _trace_ctx(),
         }
         self.io.spawn(self._submit_actor_and_collect(payload, refs, method_name))
         return refs[0] if num_returns == 1 else refs

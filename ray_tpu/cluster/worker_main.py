@@ -159,6 +159,9 @@ class WorkerProcess:
         token = worker.enter_task_context(task_id)
         self.backend._current_task_id = p["task_id"]
         streaming = p["num_returns"] == "streaming"
+        from ray_tpu.util import tracing
+
+        trace_token = tracing.activate(p.get("trace"))
         try:
             fn = self.backend.load_function(p["fn_id"])
             args, kwargs = self._resolve_args(p["args"], p["kwargs"])
@@ -180,6 +183,7 @@ class WorkerProcess:
                         "stream_error": self.backend.serde.serialize(err).to_bytes()}
             return {"returns": self._error_returns(err, p["num_returns"])}
         finally:
+            tracing.deactivate(trace_token)
             self.backend._current_task_id = None
             worker.exit_task_context(token)
 
@@ -281,36 +285,74 @@ class WorkerProcess:
                 f"actor has no method {method_name!r}"))
             return {"returns": self._error_returns(err, p["num_returns"])}
         if inspect.iscoroutinefunction(method):
+            from ray_tpu.util import tracing
+
+            trace_token = tracing.activate(p.get("trace"))
+            if p.get("trace") is not None:
+                self._emit_span_event(p, "RUNNING")
             try:
                 args, kwargs = await loop.run_in_executor(
                     self._actor_threads, self._resolve_args, p["args"], p["kwargs"])
                 result = await method(*args, **kwargs)
+                if p.get("trace") is not None:
+                    self._emit_span_event(p, "FINISHED")
                 return {"returns": await loop.run_in_executor(
                     self._actor_threads, self._pack_returns, result, task_id,
                     p["num_returns"])}
             except BaseException as e:  # noqa: BLE001
+                if p.get("trace") is not None:
+                    self._emit_span_event(p, "FAILED")
                 return {"returns": self._error_returns(
                     TaskError(method_name, e), p["num_returns"])}
+            finally:
+                tracing.deactivate(trace_token)
         return await loop.run_in_executor(
             self._actor_threads, self._execute_actor_method_sync, p, method, task_id)
 
     def _execute_actor_method_sync(self, p, method, task_id: TaskID) -> Dict:
         from ray_tpu.core.worker import global_worker
 
+        from ray_tpu.util import tracing
+
         worker = global_worker()
         token = worker.enter_task_context(
             task_id, ActorID.from_hex(p["actor_id"]))
+        trace_token = tracing.activate(p.get("trace"))
+        if p.get("trace") is not None:
+            self._emit_span_event(p, "RUNNING")
         try:
             args, kwargs = self._resolve_args(p["args"], p["kwargs"])
             result = method(*args, **kwargs)
+            if p.get("trace") is not None:
+                self._emit_span_event(p, "FINISHED")
             return {"returns": self._pack_returns(result, task_id,
                                                   p["num_returns"])}
         except BaseException as e:  # noqa: BLE001
             traceback.print_exc()
+            if p.get("trace") is not None:
+                self._emit_span_event(p, "FAILED")
             return {"returns": self._error_returns(
                 TaskError(p["method"], e), p["num_returns"])}
         finally:
+            tracing.deactivate(trace_token)
             worker.exit_task_context(token)
+
+    def _emit_span_event(self, p, state: str) -> None:
+        """Actor-call spans: actor calls bypass the raylet (direct
+        worker->worker), so the executing worker reports the task event the
+        raylet would have (tracing + timeline coverage for actor methods)."""
+        async def _send():
+            try:
+                await self.backend._gcs.call("task_event", {
+                    "task_id": p["task_id"],
+                    "name": f"{type(self._actor_instance).__name__}."
+                            f"{p['method']}",
+                    "state": state, "node_id": os.environ["RT_NODE_ID"],
+                    "trace": p.get("trace")})
+            except Exception:
+                pass
+
+        self.backend.io.spawn(_send())
 
 
 def main() -> None:

@@ -118,6 +118,3 @@ class ActorPoolStrategy:
     size: Optional[int] = None
     min_size: int = 1
     max_size: Optional[int] = None
-
-    def pool_size(self) -> int:
-        return self.size or self.min_size

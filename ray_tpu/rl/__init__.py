@@ -9,14 +9,24 @@ Tune Trainables.
 
 from ray_tpu.rl.algorithm import Algorithm  # noqa: F401
 from ray_tpu.rl.algorithms import (  # noqa: F401
+    APPO,
+    APPOConfig,
+    BC,
+    BCConfig,
+    DDPG,
+    DDPGConfig,
     DQN,
     DQNConfig,
     IMPALA,
     IMPALAConfig,
+    MARWIL,
+    MARWILConfig,
     PPO,
     PPOConfig,
     SAC,
     SACConfig,
+    TD3,
+    TD3Config,
 )
 from ray_tpu.rl.config import AlgorithmConfig  # noqa: F401
 from ray_tpu.rl.env import (  # noqa: F401

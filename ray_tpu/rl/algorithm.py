@@ -23,6 +23,7 @@ from ray_tpu.tune.trainable import Trainable
 
 class Algorithm(Trainable):
     explore_mode = "stochastic"  # DQN overrides with "epsilon_greedy"
+    need_env_runners = True      # offline algorithms (BC/MARWIL) opt out
 
     @classmethod
     def get_default_config(cls) -> AlgorithmConfig:
@@ -38,7 +39,7 @@ class Algorithm(Trainable):
         cfg = self.config
         # probe the env spec without an actor round-trip
         self.spec = make_env(cfg.env, 1, cfg.env_config).spec
-        n_runners = max(1, cfg.num_env_runners)
+        n_runners = max(1, cfg.num_env_runners) if self.need_env_runners else 0
         self.runners = [
             EnvRunner.options(num_cpus=cfg.num_cpus_per_runner).remote(
                 cfg.env, cfg.num_envs_per_runner,

@@ -1,4 +1,17 @@
+from ray_tpu.rl.algorithms.appo import APPO, APPOConfig  # noqa: F401
+from ray_tpu.rl.algorithms.ddpg import (  # noqa: F401
+    DDPG,
+    DDPGConfig,
+    TD3,
+    TD3Config,
+)
 from ray_tpu.rl.algorithms.dqn import DQN, DQNConfig  # noqa: F401
 from ray_tpu.rl.algorithms.impala import IMPALA, IMPALAConfig  # noqa: F401
+from ray_tpu.rl.algorithms.offline import (  # noqa: F401
+    BC,
+    BCConfig,
+    MARWIL,
+    MARWILConfig,
+)
 from ray_tpu.rl.algorithms.ppo import PPO, PPOConfig  # noqa: F401
 from ray_tpu.rl.algorithms.sac import SAC, SACConfig  # noqa: F401

@@ -1,0 +1,175 @@
+"""DDPG and TD3: deterministic policy gradients for continuous control.
+
+Reference analogs: ``rllib/algorithms/ddpg/`` and ``rllib/algorithms/td3/``.
+One implementation: TD3 = DDPG + twin critics + delayed policy updates +
+target-policy smoothing; DDPG is the ``twin_q=False, policy_delay=1,
+target_noise=0`` corner. Everything is a single jitted update.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.rl import models
+from ray_tpu.rl.algorithm import Algorithm
+from ray_tpu.rl.config import AlgorithmConfig
+from ray_tpu.rl.learner import Learner
+from ray_tpu.rl.replay_buffer import ReplayBuffer
+
+
+class DDPG(Algorithm):
+    twin_q = False
+
+    @classmethod
+    def get_default_config(cls) -> AlgorithmConfig:
+        cfg = AlgorithmConfig(algo_class=cls)
+        cfg.env = "Pendulum-v1"
+        cfg.lr = 1e-3
+        cfg.minibatch_size = 256
+        cfg.learning_starts = 1_000
+        if cls is DDPG:
+            cfg.policy_delay = 1
+            cfg.target_noise = 0.0
+        return cfg
+
+    def build_learner(self) -> None:
+        cfg, spec = self.config, self.spec
+        gamma, tau = cfg.gamma, cfg.tau
+        low = jnp.asarray(spec.action_low)
+        high = jnp.asarray(spec.action_high)
+        twin = self.twin_q
+        target_noise, noise_clip = cfg.target_noise, cfg.noise_clip
+
+        key = jax.random.key(cfg.seed)
+        k_pi, k_q1, k_q2 = jax.random.split(key, 3)
+        qin = spec.obs_dim + spec.action_dim
+        params = {
+            "pi": models.init_mlp(
+                k_pi, [spec.obs_dim, *cfg.hidden, spec.action_dim],
+                out_scale=0.01),
+            "q1": models.init_mlp(k_q1, [qin, *cfg.hidden, 1], out_scale=1.0),
+        }
+        if twin:
+            params["q2"] = models.init_mlp(k_q2, [qin, *cfg.hidden, 1],
+                                           out_scale=1.0)
+        for name in list(params):
+            params[f"{name}_target"] = jax.tree_util.tree_map(
+                jnp.copy, params[name])
+
+        def act(pi_params, obs):
+            mid = (high + low) / 2.0
+            half = (high - low) / 2.0
+            return mid + half * jnp.tanh(models.mlp_forward(pi_params, obs))
+
+        def q_val(q_params, obs, a):
+            return models.mlp_forward(
+                q_params, jnp.concatenate([obs, a], axis=-1))[..., 0]
+
+        def critic_loss_fn(params, batch, key):
+            obs, nobs, acts = batch["obs"], batch["next_obs"], batch["actions"]
+            na = act(params["pi_target"], nobs)
+            if target_noise > 0:  # TD3 target policy smoothing
+                noise = jnp.clip(
+                    target_noise * jax.random.normal(key, na.shape),
+                    -noise_clip, noise_clip) * (high - low) / 2.0
+                na = jnp.clip(na + noise, low, high)
+            qt = q_val(params["q1_target"], nobs, na)
+            if twin:
+                qt = jnp.minimum(qt, q_val(params["q2_target"], nobs, na))
+            nonterm = 1.0 - batch["dones"].astype(jnp.float32)
+            target = jax.lax.stop_gradient(
+                batch["rewards"] + gamma * nonterm * qt)
+            loss = jnp.mean((q_val(params["q1"], obs, acts) - target) ** 2)
+            if twin:
+                loss = loss + jnp.mean(
+                    (q_val(params["q2"], obs, acts) - target) ** 2)
+            return loss, {"q_loss": loss}
+
+        def actor_loss_fn(params, batch, key):
+            obs = batch["obs"]
+            a = act(params["pi"], obs)
+            q = q_val(jax.lax.stop_gradient(params["q1"]), obs, a)
+            loss = -jnp.mean(q)
+            return loss, {"pi_loss": loss}
+
+        def loss_fn(params, batch, key):
+            cl, cm = critic_loss_fn(params, batch, key)
+            al, am = actor_loss_fn(params, batch, key)
+            do_actor = batch["do_actor"][0]
+            total = cl + do_actor * al
+            return total, {**cm, **am}
+
+        self.learner = Learner(params, loss_fn, cfg.lr,
+                               grad_clip=cfg.grad_clip, seed=cfg.seed)
+        self.buffer = ReplayBuffer(cfg.buffer_size, seed=cfg.seed)
+        self._act = act
+        self._updates = 0
+
+        @jax.jit
+        def polyak(params):
+            new = dict(params)
+            for name in ("pi", "q1") + (("q2",) if twin else ()):
+                new[f"{name}_target"] = jax.tree_util.tree_map(
+                    lambda t, s: (1 - tau) * t + tau * s,
+                    params[f"{name}_target"], params[name])
+            return new
+
+        self._polyak = polyak
+
+    def _runner_params(self):
+        """Runner protocol adapter: deterministic mean + gaussian
+        exploration noise via log_std."""
+        p = self.learner.get_params()
+        obs_dim, adim = self.spec.obs_dim, self.spec.action_dim
+        vf = {"layers": [{"w": jnp.zeros((obs_dim, 1)), "b": jnp.zeros(1)}]}
+        sigma = max(self.config.exploration_noise, 1e-3)
+        return {"pi": p["pi"], "vf": vf,
+                "log_std": jnp.full((adim,), float(np.log(sigma)))}
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.config
+        batch = self.synchronous_sample(self._runner_params())
+        self.buffer.add_batch(
+            {"obs": batch["obs"], "actions": batch["actions_executed"],
+             "rewards": batch["rewards"], "next_obs": batch["next_obs"],
+             "dones": batch["dones"]})
+        metrics: Dict[str, Any] = {"buffer_size": len(self.buffer)}
+        if len(self.buffer) >= cfg.learning_starts:
+            num_updates = max(1, len(batch["rewards"]) // cfg.minibatch_size)
+            for _ in range(num_updates):
+                mb = self.buffer.sample(cfg.minibatch_size)
+                self._updates += 1
+                do_actor = float(self._updates % max(1, cfg.policy_delay) == 0)
+                mb["do_actor"] = np.full(1, do_actor, dtype=np.float32)
+                m = self.learner.update_minibatch(mb)
+                if do_actor:
+                    self.learner.params = self._polyak(self.learner.params)
+            metrics.update({k: float(v) for k, v in m.items()})
+        metrics.update(self.collect_episode_stats())
+        return metrics
+
+
+class TD3(DDPG):
+    """Twin critics + delayed policy updates + target smoothing."""
+
+    twin_q = True
+
+
+class DDPGConfig(AlgorithmConfig):
+    def __init__(self, **kwargs):
+        super().__init__(algo_class=DDPG, **kwargs)
+        self.env = "Pendulum-v1"
+        self.minibatch_size = 256
+        self.policy_delay = 1
+        self.target_noise = 0.0
+
+
+class TD3Config(AlgorithmConfig):
+    def __init__(self, **kwargs):
+        super().__init__(algo_class=TD3, **kwargs)
+        self.env = "Pendulum-v1"
+        self.minibatch_size = 256

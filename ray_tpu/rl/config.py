@@ -57,6 +57,14 @@ class AlgorithmConfig:
     # IMPALA
     vtrace_clip_rho: float = 1.0
     vtrace_clip_pg_rho: float = 1.0
+    # DDPG / TD3
+    exploration_noise: float = 0.1
+    policy_delay: int = 2              # TD3 delayed policy updates
+    target_noise: float = 0.2          # TD3 target policy smoothing
+    noise_clip: float = 0.5
+    # offline RL (BC / MARWIL)
+    offline_data: Any = None           # dict of arrays or ray_tpu.data Dataset
+    beta: float = 1.0                  # MARWIL advantage temperature
     # resources
     num_tpus_per_learner: float = 0
     num_learners: int = 0              # 0 => learner runs in the algo process

@@ -300,6 +300,28 @@ def cmd_list(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_serve(args: argparse.Namespace) -> int:
+    """rt serve deploy/status/shutdown (reference: ``serve/scripts.py``)."""
+    from ray_tpu import serve
+    from ray_tpu.serve import schema
+
+    _attach_driver(args.address)
+    if args.serve_cmd == "deploy":
+        sys.path.insert(0, os.getcwd())  # import_path resolves from cwd
+        names = schema.deploy_config(schema.load_config_file(args.config))
+        for n in names:
+            print(f"deployed application {n!r}")
+        return 0
+    if args.serve_cmd == "status":
+        print(json.dumps(serve.status(), indent=2, default=str))
+        return 0
+    if args.serve_cmd == "shutdown":
+        serve.shutdown()
+        print("serve stopped")
+        return 0
+    return 1
+
+
 def cmd_metrics(args: argparse.Namespace) -> int:
     from ray_tpu.util.metrics import metrics_text
 
@@ -365,6 +387,16 @@ def main(argv=None) -> int:
     p_micro.set_defaults(fn=lambda a: __import__(
         "ray_tpu.scripts.microbenchmark",
         fromlist=["main"]).main(a))
+
+    p_serve = sub.add_parser("serve", help="deploy/inspect serve apps")
+    serve_sub = p_serve.add_subparsers(dest="serve_cmd", required=True)
+    ps_deploy = serve_sub.add_parser("deploy")
+    ps_deploy.add_argument("config", help="YAML config (serve/schema.py)")
+    ps_deploy.add_argument("--address", default=None)
+    for name in ("status", "shutdown"):
+        ps = serve_sub.add_parser(name)
+        ps.add_argument("--address", default=None)
+    p_serve.set_defaults(fn=cmd_serve)
 
     p_metrics = sub.add_parser("metrics",
                                help="aggregated Prometheus metrics page")

@@ -1,0 +1,112 @@
+"""Distributed tracing: trace-context propagation across task/actor calls.
+
+Reference analog: ``ray/util/tracing/tracing_helper.py`` — OpenTelemetry
+span injection around submit/execute with context carried in the task spec.
+Redesign without the otel dependency: a (trace_id, span_id) pair rides the
+task payload; every task/actor call executed while tracing is enabled
+becomes a span whose parent is the calling task's span. Spans land in the
+GCS task-event store (the same table ``ray_tpu.timeline()`` exports), so a
+trace is a filterable view of the timeline: ``get_trace(trace_id)`` returns
+the span tree.
+
+Usage::
+
+    from ray_tpu.util import tracing
+    tracing.enable()
+    ref = my_task.remote(...)      # root span, fresh trace_id
+    ...
+    spans = tracing.get_trace(tracing.last_trace_id())
+"""
+
+from __future__ import annotations
+
+import contextvars
+import os
+import uuid
+from typing import Any, Dict, List, Optional
+
+_enabled = os.environ.get("RT_TRACING", "") not in ("", "0", "false")
+_current: "contextvars.ContextVar[Optional[Dict[str, str]]]" = \
+    contextvars.ContextVar("rt_trace_ctx", default=None)
+_last_trace_id: Optional[str] = None
+
+
+def enable() -> None:
+    global _enabled
+    _enabled = True
+
+
+def disable() -> None:
+    global _enabled
+    _enabled = False
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def current_context() -> Optional[Dict[str, str]]:
+    """The ambient span context ({trace_id, span_id}) or None."""
+    return _current.get()
+
+
+def context_for_submit() -> Optional[Dict[str, str]]:
+    """Called by the core worker at submit time: the child span's wire
+    context. A fresh trace starts when no span is ambient (driver root);
+    in a worker WITHOUT an ambient span, no context is minted even if a
+    previous traced task ran here — only explicit enable() or an inherited
+    span starts spans."""
+    global _last_trace_id
+    parent = _current.get()
+    if not _enabled and parent is None:
+        return None
+    span_id = uuid.uuid4().hex[:16]
+    if parent is None:
+        trace_id = uuid.uuid4().hex
+        _last_trace_id = trace_id
+        return {"trace_id": trace_id, "span_id": span_id,
+                "parent_span_id": None}
+    return {"trace_id": parent["trace_id"], "span_id": span_id,
+            "parent_span_id": parent["span_id"]}
+
+
+def activate(ctx: Optional[Dict[str, str]]):
+    """Executor side: make the received context ambient for nested calls
+    (grandchildren propagate through the ambient span, NOT a process flag —
+    the worker returns to untraced once this task finishes). Returns a
+    token for ``deactivate``."""
+    if ctx is None:
+        return None
+    return _current.set({"trace_id": ctx["trace_id"],
+                         "span_id": ctx["span_id"]})
+
+
+def deactivate(token) -> None:
+    if token is not None:
+        _current.reset(token)
+
+
+def last_trace_id() -> Optional[str]:
+    """Trace id of the most recent root span started by this process."""
+    return _last_trace_id
+
+
+def get_trace(trace_id: str) -> List[Dict[str, Any]]:
+    """All spans of one trace, parents before children where possible."""
+    import ray_tpu
+
+    backend = ray_tpu.global_worker()._require_backend()
+    events = backend.io.run(
+        backend._gcs.call("list_tasks", {"limit": 10000}))
+    spans = [e for e in events
+             if (e.get("trace") or {}).get("trace_id") == trace_id]
+    by_span = {(s["trace"] or {}).get("span_id"): s for s in spans}
+
+    def depth(s, seen=()):
+        parent = (s["trace"] or {}).get("parent_span_id")
+        if parent is None or parent not in by_span or parent in seen:
+            return 0
+        return 1 + depth(by_span[parent],
+                         seen + ((s["trace"] or {}).get("span_id"),))
+
+    return sorted(spans, key=depth)

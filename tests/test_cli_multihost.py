@@ -132,3 +132,53 @@ def test_cli_auto_attach_and_stop(cli_cluster):
     assert "stopped" in stop.stdout
     status = _cli(env, "status")
     assert status.returncode != 0 or "0 alive" in status.stdout
+
+
+def test_cli_serve_deploy_from_yaml(tmp_path):
+    """rt serve deploy <config.yaml> against a CLI-started head: declarative
+    deploy + HTTP + status + shutdown (reference: ``serve deploy``,
+    ``serve/scripts.py`` + ``serve/schema.py``)."""
+    env = _cli_env(tmp_path)
+    assert _cli(env, "start", "--head", "--num-cpus", "4",
+                timeout=90).returncode == 0
+    try:
+        mod_dir = tmp_path / "app_mod"
+        mod_dir.mkdir()
+        (mod_dir / "my_serve_app.py").write_text(
+            "from ray_tpu import serve\n"
+            "\n"
+            "@serve.deployment\n"
+            "def hello(request=None):\n"
+            "    return {'msg': 'from-yaml'}\n"
+            "\n"
+            "app = hello.bind()\n")
+        cfg = tmp_path / "serve_config.yaml"
+        cfg.write_text(
+            "applications:\n"
+            "  - name: yaml_app\n"
+            "    route_prefix: /hello\n"
+            "    import_path: my_serve_app:app\n"
+            "    deployments:\n"
+            "      - name: hello\n"
+            "        num_replicas: 2\n"
+            "http_options:\n"
+            "  host: 127.0.0.1\n"
+            "  port: 8972\n")
+        env_deploy = dict(env)
+        env_deploy["PYTHONPATH"] = f"{mod_dir}:{env['PYTHONPATH']}"
+        r = _cli(env_deploy, "serve", "deploy", str(cfg), timeout=120)
+        assert r.returncode == 0, r.stderr[-2000:]
+        assert "yaml_app" in r.stdout
+
+        import requests
+
+        resp = requests.post("http://127.0.0.1:8972/hello", json=5,
+                             timeout=30)
+        assert resp.status_code == 200
+        assert resp.json()["msg"] == "from-yaml"
+
+        r = _cli(env, "serve", "status", timeout=60)
+        assert r.returncode == 0 and "yaml_app" in r.stdout
+        assert _cli(env, "serve", "shutdown", timeout=60).returncode == 0
+    finally:
+        _cli(env, "stop", timeout=60)

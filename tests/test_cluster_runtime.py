@@ -265,3 +265,22 @@ def test_node_resurrects_after_spurious_death(rt_cluster):
 
     a = A.remote()
     assert ray_tpu.get(a.m.remote(), timeout=20) == 1
+
+
+def test_worker_logs_stream_to_driver(rt_cluster, capfd):
+    """Worker prints are echoed to the driver's stderr with a worker prefix
+    (reference: _private/log_monitor.py + worker.print_logs)."""
+    @ray_tpu.remote
+    def noisy():
+        print("log-line-for-driver")
+        return 1
+
+    assert ray_tpu.get(noisy.remote()) == 1
+    deadline = time.time() + 10
+    seen = ""
+    while time.time() < deadline:
+        seen += capfd.readouterr().err
+        if "log-line-for-driver" in seen and "(worker " in seen:
+            return
+        time.sleep(0.3)
+    raise AssertionError(f"worker log never reached driver: {seen[-500:]}")

@@ -158,3 +158,41 @@ def test_metrics_from_worker_processes(rt_cluster):
     text = M.metrics_text()
     # counters merge across worker processes: 1 + 2 + 3
     assert "rt_test_worker_ops 6.0" in text
+
+
+def test_tracing_span_tree(rt_cluster):
+    """Tracing: a driver root span, a task child, and a nested grandchild
+    task all share one trace_id with correct parentage (reference:
+    util/tracing/tracing_helper.py context propagation)."""
+    import time
+
+    from ray_tpu.util import tracing
+
+    tracing.enable()
+    try:
+        @ray_tpu.remote
+        def child():
+            return 7
+
+        @ray_tpu.remote
+        def parent():
+            return ray_tpu.get(child.remote())
+
+        assert ray_tpu.get(parent.remote()) == 7
+        trace_id = tracing.last_trace_id()
+        assert trace_id
+        spans = []
+        deadline = time.time() + 10
+        while time.time() < deadline and len(spans) < 2:
+            spans = tracing.get_trace(trace_id)
+            time.sleep(0.3)
+        assert len(spans) >= 2, spans
+        roots = [s for s in spans
+                 if s["trace"].get("parent_span_id") is None]
+        children = [s for s in spans
+                    if s["trace"].get("parent_span_id") is not None]
+        assert roots and children
+        span_ids = {s["trace"]["span_id"] for s in spans}
+        assert children[0]["trace"]["parent_span_id"] in span_ids
+    finally:
+        tracing.disable()
