@@ -130,6 +130,10 @@ def start_or_connect(address: Optional[str], job_id: JobID, *,
                 "init(address='auto'): no running cluster found "
                 "(start one with `rt start --head`)")
         address = latest["gcs_address"]
+    if address and address.startswith("rt://"):
+        # Ray-Client analog: rt://<gcs-host:port> — attach WITHOUT shared shm
+        return connect_existing(address[len("rt://"):], job_id,
+                                namespace=namespace, client_mode=True)
     if address is None:
         cluster = ClusterHandle()
         cluster.start_gcs()
@@ -149,9 +153,13 @@ def start_or_connect(address: Optional[str], job_id: JobID, *,
 
 
 def connect_existing(gcs_address: str, job_id: JobID, *,
-                     namespace: Optional[str] = None):
+                     namespace: Optional[str] = None,
+                     client_mode: bool = False):
     """Attach a driver to a running cluster: pick a raylet from the node
-    table (head node preferred) and join its session."""
+    table (head node preferred) and join its session. ``client_mode``
+    (the reference's Ray Client): this process shares NO /dev/shm with the
+    cluster — large objects travel via the raylet's chunked put/get RPCs,
+    so a laptop can drive a remote TPU pod over plain TCP."""
     import asyncio
 
     from ray_tpu.cluster.rpc import RpcClient
@@ -182,7 +190,8 @@ def connect_existing(gcs_address: str, job_id: JobID, *,
         raylet_address=node["address"],
         node_id=node["node_id"],
         session_name=session_name or "session_shared",
-        job_id=job_id, role="driver", namespace=namespace,
-        loop_thread=io)
+        job_id=job_id, role="client" if client_mode else "driver",
+        namespace=namespace, loop_thread=io,
+        shared_store=not client_mode)
     backend.connect()
     return backend

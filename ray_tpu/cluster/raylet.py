@@ -51,6 +51,7 @@ class _WorkerEntry:
         self.actor_id: Optional[str] = None
         self.assignment: Dict[str, List[int]] = {}
         self.oom_killed = False
+        self.job_id: Optional[str] = None  # current job, for log routing
 
 
 class _BundleState:
@@ -123,6 +124,9 @@ class Raylet:
         # first pull, not race its O_EXCL create (reference: PullManager
         # dedups by object id).
         self._pulls: Dict[str, asyncio.Future] = {}
+        # in-flight client-mode uploads: oid -> (buffer, started_at);
+        # stale entries (client died mid-upload) purged by the reap loop
+        self._client_uploads: Dict[str, Tuple[Any, float]] = {}
         # Running sum of in-memory (non-spilled) object bytes, so the
         # per-unpin spill precheck is O(1) not O(#objects). Maintained by
         # _touch / _spill_blocking / rpc_free_objects; the spill thread
@@ -268,11 +272,24 @@ class Raylet:
         if entry.proc.poll() is None and not entry.is_actor_worker:
             self._idle.setdefault(entry.key, []).append(entry)
 
+    _UPLOAD_TTL_S = 600.0
+
     async def _reap_loop(self) -> None:
         """Detect dead worker processes (reference: worker death via local
-        socket disconnect)."""
+        socket disconnect); also purges client uploads abandoned mid-stream
+        (dead client) so unsealed store allocations can't pile up."""
+        from ray_tpu._private.ids import ObjectID
+
         while True:
             await asyncio.sleep(0.5)
+            now = time.monotonic()
+            for oid_hex, (_, t0) in list(self._client_uploads.items()):
+                if now - t0 > self._UPLOAD_TTL_S:
+                    self._client_uploads.pop(oid_hex, None)
+                    try:
+                        self.store.delete(ObjectID.from_hex(oid_hex))
+                    except Exception:  # noqa: BLE001
+                        pass
             for entry in list(self._workers.values()):
                 if entry.proc.poll() is not None:
                     self._workers.pop(entry.worker_id, None)
@@ -458,12 +475,14 @@ class Raylet:
                     offsets[name] = off + cut + (0 if cut == len(chunk)
                                                  else 1)
                     wid = name[len("worker-"):-len(".log")]
+                    wentry = self._workers.get(wid)
+                    job = wentry.job_id if wentry is not None else None
                     for line in chunk[:cut].decode(
                             errors="replace").splitlines():
                         self._log_seq += 1
                         self._log_buf.append(
                             {"seq": self._log_seq, "worker_id": wid,
-                             "line": line})
+                             "job_id": job, "line": line})
                         new_any = True
                 except OSError:
                     continue
@@ -477,16 +496,26 @@ class Raylet:
         after = p.get("after")
         if after is None:
             return {"seq": self._log_seq, "entries": []}
-        entries = [e for e in buf if e["seq"] > after]
+        job = p.get("job_id")
+
+        def wanted(e):
+            # route lines to their owning driver (reference: log_monitor
+            # per-job routing); untagged lines (worker idle / pre-dispatch
+            # prints) broadcast to every poller
+            return (e["seq"] > after
+                    and (job is None or e.get("job_id") in (None, job)))
+
+        entries = [e for e in buf if wanted(e)]
         if not entries:
             try:
                 await asyncio.wait_for(self._log_event.wait(),
                                        p.get("timeout", 10.0))
             except asyncio.TimeoutError:
                 pass
-            entries = [e for e in buf if e["seq"] > after]
-        return {"seq": max((e["seq"] for e in entries),
-                           default=after), "entries": entries}
+            entries = [e for e in buf if wanted(e)]
+        # seq must advance past FILTERED entries too, or the poller re-scans
+        newest = max((e["seq"] for e in buf), default=after)
+        return {"seq": max(newest, after), "entries": entries}
 
     async def _on_peer_disconnect(self, peer_id: str) -> None:
         pass
@@ -663,6 +692,7 @@ class Raylet:
         try:
             worker = await self._get_worker(key, chips, renv)
             worker.busy = True
+            worker.job_id = payload.get("job_id")
             self._task_event(task_id, payload.get("fn_name"), "RUNNING")
             try:
                 reply = await worker.client.call("push_task", payload)
@@ -760,6 +790,7 @@ class Raylet:
             worker = self._spawn_worker((("actor", p["actor_id"]),), chips,
                                         spec.get("runtime_env"))
             worker.is_actor_worker = True
+            worker.job_id = spec.get("job_id")
             worker.actor_id = p["actor_id"]
             worker.assignment = assignment
             worker._spec_resources = spec.get("resources", {})
@@ -985,6 +1016,43 @@ class Raylet:
             with open(path, "rb") as f:
                 return {"payload": f.read()}
         return {"error": "not found"}
+
+    async def rpc_put_object_chunk(self, p):
+        """Client-mode upload: a process WITHOUT shared shm (Ray-Client
+        analog) streams an object into this node's store in bounded chunks;
+        the final chunk seals + registers the location."""
+        from ray_tpu._private.ids import ObjectID
+
+        oid_hex = p["oid"]
+        oid = ObjectID.from_hex(oid_hex)
+        off, total, data = p["offset"], p["total"], p["data"]
+        try:
+            if off == 0:
+                if self.store.contains(oid):
+                    return {"ok": True, "dup": True}
+                self._client_uploads[oid_hex] = (
+                    self.store.create(oid, total), time.monotonic())
+            entry = self._client_uploads.get(oid_hex)
+            if entry is None:
+                return {"error": "upload not started"}
+            buf = entry[0]
+            buf[off:off + len(data)] = data
+            if p.get("seal"):
+                self._client_uploads.pop(oid_hex, None)
+                self.store.seal(oid)
+                self._local_objects.add(oid_hex)
+                self._touch(oid_hex, size=total, spilled=False)
+                await self._maybe_spill()
+                await self._gcs.call("add_object_location", {
+                    "oid": oid_hex, "node_id": self.node_id, "size": total})
+            return {"ok": True}
+        except Exception as e:  # noqa: BLE001 — drop partial upload
+            self._client_uploads.pop(oid_hex, None)
+            try:
+                self.store.delete(oid)
+            except Exception:  # noqa: BLE001
+                pass
+            return {"error": repr(e)}
 
     async def rpc_get_object_chunk(self, p):
         """Serve one bounded slice of an object (reference: chunked reads,

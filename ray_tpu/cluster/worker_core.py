@@ -217,8 +217,13 @@ class ClusterBackend(RuntimeBackend):
     def __init__(self, *, gcs_address: str, raylet_address: str, node_id: str,
                  session_name: str, job_id: JobID, role: str = "driver",
                  namespace: Optional[str] = None,
-                 loop_thread: Optional[EventLoopThread] = None):
+                 loop_thread: Optional[EventLoopThread] = None,
+                 shared_store: bool = True):
         self.role = role
+        # False = Ray-Client mode (reference: ray.client / util/client):
+        # this process does NOT share the node's /dev/shm, so large objects
+        # travel via the raylet's chunked put/get RPCs instead of mmap.
+        self.shared_store = shared_store
         self.job_id = job_id
         self.namespace = namespace or "default"
         self.node_id = node_id
@@ -269,7 +274,7 @@ class ClusterBackend(RuntimeBackend):
             await self._raylet.connect()
 
         self.io.run(_go(), timeout=get_config().gcs_rpc_timeout_s)
-        if self.role == "driver" and get_config().log_to_driver:
+        if self.role in ("driver", "client") and get_config().log_to_driver:
             self.io.spawn(self._log_forward_loop())
 
     async def _log_forward_loop(self) -> None:
@@ -307,7 +312,8 @@ class ClusterBackend(RuntimeBackend):
         while not self._shutdown:
             try:
                 reply = await client.call(
-                    "poll_logs", {"after": seq, "timeout": 5.0},
+                    "poll_logs", {"after": seq, "timeout": 5.0,
+                                  "job_id": self.job_id.hex()},
                     timeout=30.0)
             except Exception:  # noqa: BLE001 — node gone; outer loop retries
                 return
@@ -335,10 +341,71 @@ class ClusterBackend(RuntimeBackend):
         from ray_tpu.core.worker import global_worker
 
         oid = oid or global_worker().next_put_id()
+        if not self.shared_store:
+            self.io.run(self._upload_object(oid.hex(), payload))
+            return ObjectRef(oid, owner=self.address)
         self.plasma.write_whole(oid, payload)
         self.io.run(self._raylet.call("seal_object",
                                       {"oid": oid.hex(), "size": len(payload)}))
         return ObjectRef(oid, owner=self.address)
+
+    async def _upload_object(self, oid_hex: str, payload: bytes) -> None:
+        """Client mode: chunked upload into the attached raylet's store."""
+        chunk = get_config().object_transfer_chunk_bytes
+        total = len(payload)
+        off = 0
+        while True:
+            end = min(off + chunk, total)
+            reply = await self._raylet.call("put_object_chunk", {
+                "oid": oid_hex, "offset": off, "total": total,
+                "data": payload[off:end], "seal": end >= total})
+            if reply.get("error"):
+                raise RuntimeError(f"client put failed: {reply['error']}")
+            if reply.get("dup"):
+                return  # already in the store — done, don't keep streaming
+            off = end
+            if off >= total:
+                return
+
+    async def _download_object(self, oid_hex: str,
+                               timeout) -> Optional[memoryview]:
+        """Client mode: chunked download from the attached raylet (which
+        serves shm and spill copies alike)."""
+        from ray_tpu import _native
+
+        def _checked(reply) -> Optional[bytes]:
+            data = reply.get("data")
+            if data is None:
+                return None
+            crc = reply.get("crc")
+            if crc is not None:
+                ours = _native.checksum(data, reply.get("crc_kind", "crc32c"))
+                if ours is not None and ours != crc:
+                    raise ConnectionError(
+                        f"chunk checksum mismatch downloading {oid_hex}")
+            return data
+
+        chunk = get_config().object_transfer_chunk_bytes
+        first = await self._raylet.call(
+            "get_object_chunk", {"oid": oid_hex, "offset": 0, "size": chunk},
+            timeout=timeout)
+        data = _checked(first)
+        if data is None:
+            return None
+        buf = bytearray(first["total"])
+        buf[:len(data)] = data
+        off = len(data)
+        while off < len(buf):
+            r = await self._raylet.call(
+                "get_object_chunk",
+                {"oid": oid_hex, "offset": off, "size": chunk},
+                timeout=timeout)
+            data = _checked(r)
+            if not data:
+                return None
+            buf[off:off + len(data)] = data
+            off += len(data)
+        return memoryview(bytes(buf))
 
     # ---- objects ------------------------------------------------------------
     def put(self, value: Any) -> ObjectRef:
@@ -376,9 +443,10 @@ class ClusterBackend(RuntimeBackend):
             payload = self.memory_store.get(oid_hex)
             if payload is not None:
                 return memoryview(payload)
-            view = self.plasma.read(ref.id())
-            if view is not None:
-                return view
+            if self.shared_store:
+                view = self.plasma.read(ref.id())
+                if view is not None:
+                    return view
             if self.memory_store.is_pending(oid_hex):
                 if not await self.memory_store.wait_ready(oid_hex, remaining()):
                     raise GetTimeoutError(f"timed out waiting for {ref}")
@@ -423,7 +491,11 @@ class ClusterBackend(RuntimeBackend):
                     "fetch_object", {"oid": oid_hex, "timeout": dir_wait},
                     timeout=remaining())
                 if reply.get("ok"):
-                    view = self.plasma.read(ref.id())
+                    if self.shared_store:
+                        view = self.plasma.read(ref.id())
+                    else:  # client mode: no shared mmap — RPC download
+                        view = await self._download_object(
+                            oid_hex, remaining())
                     if view is not None:
                         return view
             finally:

@@ -16,6 +16,12 @@ class DataContext:
     max_tasks_in_flight: int = 8
     read_parallelism: int = 8
     eager_free: bool = True
+    # Pipelined shuffle via per-partition merger actors (reference:
+    # _internal/push_based_shuffle.py, Exoshuffle): map outputs stream into
+    # mergers while other map tasks still run; memory per partition is
+    # bounded by the incremental merge. Off by default (matches the
+    # reference's RAY_DATA_PUSH_BASED_SHUFFLE gate).
+    use_push_based_shuffle: bool = False
 
     @staticmethod
     def get_current() -> "DataContext":

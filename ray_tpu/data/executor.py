@@ -314,13 +314,7 @@ def _split_task(block: B.Block, n_out: int, seed: Optional[int],
     return parts if n_out > 1 else parts[0]
 
 
-@ray_tpu.remote
-def _reduce_concat(*parts):
-    return B.concat(list(parts))
-
-
-@ray_tpu.remote
-def _reduce_sort(key: str, descending: bool, *parts):
+def _merge_sort(parts: List[B.Block], key: str, descending: bool) -> B.Block:
     merged = B.concat(list(parts))
     if B.num_rows(merged) == 0:
         return merged
@@ -330,21 +324,42 @@ def _reduce_sort(key: str, descending: bool, *parts):
     return B.take_rows(merged, order)
 
 
-@ray_tpu.remote
-def _reduce_aggregate(key, aggs, *parts):
+def _merge_aggregate(parts: List[B.Block], key, aggs) -> B.Block:
     from ray_tpu.data.aggregate import aggregate_block
 
-    merged = B.concat(list(parts))
-    return aggregate_block(merged, key, aggs)
+    return aggregate_block(B.concat(list(parts)), key, aggs)
+
+
+@ray_tpu.remote
+def _reduce_concat(*parts):
+    return B.concat(list(parts))
+
+
+@ray_tpu.remote
+def _reduce_sort(key: str, descending: bool, *parts):
+    return _merge_sort(list(parts), key, descending)
+
+
+@ray_tpu.remote
+def _reduce_aggregate(key, aggs, *parts):
+    return _merge_aggregate(list(parts), key, aggs)
 
 
 def _all_to_all(refs: List, n_out: int, mode: str, reduce_task,
                 reduce_args: Tuple = (), seed=None, boundaries=None,
                 key=None) -> List:
-    """Two-phase map/reduce over tasks (the reference's push-based shuffle
-    simplified to a task-graph shuffle)."""
+    """Two-phase map/reduce over tasks, or — when
+    ``DataContext.use_push_based_shuffle`` — a pipelined merge through
+    per-partition merger actors (reference:
+    ``_internal/push_based_shuffle.py``, the Exoshuffle design)."""
     if not refs:
         return []
+    from ray_tpu.data.context import DataContext
+
+    if DataContext.get_current().use_push_based_shuffle:
+        return _push_based_all_to_all(refs, n_out, mode, reduce_args,
+                                      seed=seed, boundaries=boundaries,
+                                      key=key)
     part_lists = [
         _split_task.options(num_returns=n_out).remote(
             ref, n_out, seed, i, mode, boundaries, key)
@@ -356,6 +371,81 @@ def _all_to_all(refs: List, n_out: int, mode: str, reduce_task,
         reduce_task.remote(*reduce_args, *[parts[j] for parts in part_lists])
         for j in range(n_out)
     ]
+
+
+@ray_tpu.remote
+class _ShuffleMerger:
+    """One output partition's incremental merger: map outputs stream in via
+    ``add`` (pipelined with still-running map tasks) and are merged every
+    few parts, so partition memory stays bounded; ``finalize`` applies the
+    mode's reduction (concat / sort / aggregate)."""
+
+    _MERGE_EVERY = 8
+
+    def __init__(self, mode: str, reduce_args: Tuple = ()):
+        self._mode = mode
+        self._args = reduce_args
+        self._parts: List[B.Block] = []
+
+    def _compact(self) -> None:
+        # concat-only: aggregates are NOT associative as row-blocks (a
+        # Count of counts is wrong), so aggregation happens once in
+        # finalize; sort likewise sorts once over the full partition
+        self._parts = [B.concat(self._parts)]
+
+    def add(self, part: B.Block) -> bool:
+        self._parts.append(part)
+        if len(self._parts) >= self._MERGE_EVERY:
+            self._compact()
+        return True
+
+    def finalize(self) -> B.Block:
+        if not self._parts:
+            return {}
+        if self._mode == "sort":
+            return _merge_sort(self._parts, *self._args)
+        if self._mode == "aggregate":
+            return _merge_aggregate(self._parts, *self._args)
+        return B.concat(self._parts)
+
+
+def _push_based_all_to_all(refs: List, n_out: int, mode: str,
+                           reduce_args: Tuple, seed=None, boundaries=None,
+                           key=None) -> List:
+    reduce_mode = {"shuffle": "concat", "range": "sort",
+                   "hash": "aggregate"}[mode]
+    mergers = [_ShuffleMerger.remote(reduce_mode, reduce_args)
+               for _ in range(n_out)]
+    acks = []
+    for i, ref in enumerate(refs):
+        parts = _split_task.options(num_returns=n_out).remote(
+            ref, n_out, seed, i, mode, boundaries, key)
+        if n_out == 1:
+            parts = [parts]
+        acks.extend(mergers[j].add.remote(parts[j]) for j in range(n_out))
+    # Ordering: an actor's finalize is per-caller-FIFO behind its adds, so
+    # finalize refs could be returned immediately — but the acks must be
+    # GOT (not just waited): a failed map task errors its add calls, and
+    # only get() raises, preventing a silently truncated shuffle.
+    if acks:
+        ray_tpu.get(acks, timeout=600)
+    out = [m.finalize.remote() for m in mergers]
+    # release merger actors once every finalize has materialized
+    import threading
+
+    def _reap(ms=list(mergers), fs=list(out)):
+        try:
+            ray_tpu.wait(fs, num_returns=len(fs), timeout=600)
+        except Exception:  # noqa: BLE001
+            pass
+        for m in ms:
+            try:
+                ray_tpu.kill(m, no_restart=True)
+            except Exception:  # noqa: BLE001
+                pass
+
+    threading.Thread(target=_reap, daemon=True).start()
+    return out
 
 
 class AllToAllStage(Stage):

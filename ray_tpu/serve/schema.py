@@ -37,8 +37,17 @@ def _import_attr(import_path: str) -> Any:
     return obj
 
 
+_OVERRIDE_FIELDS = ("num_replicas", "max_ongoing_requests",
+                    "autoscaling_config", "user_config", "ray_actor_options",
+                    "health_check_period_s", "graceful_shutdown_timeout_s")
+
+
 def _apply_overrides(app: Application, overrides: List[Dict]) -> None:
-    """Mutate deployment configs inside a bound application graph."""
+    """Re-bind each overridden deployment through ``Deployment.options()``
+    so the normal validation/normalization runs (``num_replicas: auto``,
+    dict autoscaling configs) and the SHARED module-level Deployment object
+    is never mutated — two applications importing one deployment must not
+    leak overrides into each other."""
     by_name = {d["name"]: d for d in overrides}
     seen: set = set()
 
@@ -46,19 +55,11 @@ def _apply_overrides(app: Application, overrides: List[Dict]) -> None:
         if id(a) in seen:
             return
         seen.add(id(a))
-        dep = a._deployment
-        o = by_name.get(dep.name)
+        o = by_name.get(a._deployment.name)
         if o:
-            cfg = dep._config
-            for field in ("num_replicas", "max_ongoing_requests",
-                          "user_config", "graceful_shutdown_timeout_s",
-                          "health_check_period_s"):
-                if field in o:
-                    setattr(cfg, field, o[field])
-            if "autoscaling_config" in o:
-                cfg.autoscaling_config = o["autoscaling_config"]
-            if "ray_actor_options" in o:
-                cfg.ray_actor_options = o["ray_actor_options"]
+            kwargs = {f: o[f] for f in _OVERRIDE_FIELDS if f in o}
+            if kwargs:
+                a._deployment = a._deployment.options(**kwargs)
         for arg in list(a._args) + list(a._kwargs.values()):
             if isinstance(arg, Application):
                 walk(arg)

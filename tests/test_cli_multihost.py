@@ -182,3 +182,65 @@ def test_cli_serve_deploy_from_yaml(tmp_path):
         assert _cli(env, "serve", "shutdown", timeout=60).returncode == 0
     finally:
         _cli(env, "stop", timeout=60)
+
+
+def test_client_mode_no_shared_shm(tmp_path):
+    """rt:// client mode (reference: Ray Client): a driver that shares no
+    /dev/shm with the cluster puts/gets large objects and runs tasks over
+    plain TCP through the raylet's chunked object RPCs."""
+    import numpy as np
+
+    env = _cli_env(tmp_path)
+    assert _cli(env, "start", "--head", "--num-cpus", "4",
+                timeout=90).returncode == 0
+    with open(os.path.join(str(tmp_path), "session_latest.json")) as f:
+        gcs_addr = json.load(f)["gcs_address"]
+    script = tmp_path / "client_driver.py"
+    script.write_text(
+        "import numpy as np\n"
+        "import ray_tpu\n"
+        f"ray_tpu.init(address='rt://{gcs_addr}')\n"
+        "backend = ray_tpu.global_worker().backend\n"
+        "assert backend.shared_store is False, 'client mode must not mmap'\n"
+        "\n"
+        "@ray_tpu.remote\n"
+        "def double(a):\n"
+        "    return a * 2\n"
+        "\n"
+        "big = np.arange(300_000, dtype=np.int64)  # > direct-call limit\n"
+        "ref = ray_tpu.put(big)\n"
+        "out = ray_tpu.get(double.remote(ref), timeout=60)\n"
+        "assert np.array_equal(out, big * 2)\n"
+        "small = ray_tpu.get(double.remote(21), timeout=60)\n"
+        "assert small == 42\n"
+        "print('CLIENT OK')\n")
+    env_client = dict(env)
+    # a DIFFERENT session dir: the client must not find local session state
+    env_client["RT_SESSION_DIR_ROOT"] = str(tmp_path / "client_side")
+    r = subprocess.run([sys.executable, str(script)], env=env_client,
+                       capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "CLIENT OK" in r.stdout
+    _cli(env, "stop", timeout=60)
+
+
+def test_serve_schema_overrides_do_not_leak(rt_cluster_noop=None):
+    """num_replicas: auto validates, and two apps sharing one module-level
+    Deployment get independent override copies."""
+    from ray_tpu import serve
+    from ray_tpu.serve import schema
+
+    @serve.deployment
+    def shared(x=None):
+        return 1
+
+    app1 = shared.bind()
+    app2 = shared.bind()
+    schema._apply_overrides(app1, [{"name": "shared", "num_replicas": 3}])
+    schema._apply_overrides(app2, [{"name": "shared",
+                                    "num_replicas": "auto"}])
+    assert app1._deployment._config.num_replicas == 3
+    a2cfg = app2._deployment._config
+    assert a2cfg.autoscaling_config is not None  # auto => autoscaled
+    assert shared._config.num_replicas != 3  # shared object untouched
+    a2cfg.validate() if hasattr(a2cfg, "validate") else None

@@ -370,3 +370,31 @@ def test_webdataset_read(rt_cluster, tmp_path):
     assert sorted(r["__key__"] for r in rows)[0] == "sample0000"
     assert rows[0]["json"]["idx"] in range(6)
     assert all(isinstance(r["cls"], int) for r in rows)
+
+
+def test_push_based_shuffle_matches_task_shuffle(rt_cluster):
+    """Push-based shuffle (merger actors) must agree with the task-graph
+    shuffle for shuffle/sort/groupby (reference: push_based_shuffle.py)."""
+    from ray_tpu.data.context import DataContext
+
+    ctx = DataContext.get_current()
+    ctx.use_push_based_shuffle = True
+    try:
+        ds = data.range(200, parallelism=8)
+        shuffled = [r["id"] for r in ds.random_shuffle(seed=7).take_all()]
+        assert sorted(shuffled) == list(range(200))
+        assert shuffled != list(range(200))
+
+        import numpy as np_
+
+        src = data.from_items(
+            [{"k": int(i % 5), "v": float(i)} for i in range(100)])
+        agg = {r["k"]: r for r in src.groupby("k").sum("v").take_all()}
+        assert len(agg) == 5
+        assert agg[0]["sum(v)"] == sum(float(i) for i in range(100)
+                                       if i % 5 == 0)
+
+        got = [r["v"] for r in src.sort("v", descending=True).take_all()]
+        assert got == sorted((float(i) for i in range(100)), reverse=True)
+    finally:
+        ctx.use_push_based_shuffle = False
