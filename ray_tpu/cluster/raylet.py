@@ -1036,6 +1036,9 @@ class Raylet:
             if entry is None:
                 return {"error": "upload not started"}
             buf = entry[0]
+            # TTL tracks last ACTIVITY, not start: a slow-but-live upload
+            # must never be reaped mid-stream
+            self._client_uploads[oid_hex] = (buf, time.monotonic())
             buf[off:off + len(data)] = data
             if p.get("seal"):
                 self._client_uploads.pop(oid_hex, None)

@@ -29,6 +29,26 @@ def _kv_key(group_name: str) -> str:
     return f"@rendezvous/{group_name}/coordinator"
 
 
+def _publish_or_await_coordinator(backend, key: str, rank: int,
+                                  coordinator_ip: Optional[str],
+                                  timeout_s: float, what: str) -> str:
+    """Rank 0 publishes ip:port under ``key``; other ranks poll it.
+    The one rendezvous used by both the jax and torch bootstraps."""
+    if rank == 0:
+        ip = coordinator_ip or socket.gethostbyname(socket.gethostname())
+        address = f"{ip}:{_free_port()}"
+        backend.kv_put(key, address.encode())
+        return address
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        raw = backend.kv_get(key)
+        if raw:
+            return raw.decode()
+        time.sleep(0.1)
+    raise TimeoutError(
+        f"{what}: coordinator address not published within {timeout_s}s")
+
+
 def bootstrap_jax_distributed(world_size: int, rank: int,
                               group_name: str = "train",
                               coordinator_ip: Optional[str] = None,
@@ -57,23 +77,9 @@ def bootstrap_jax_distributed(world_size: int, rank: int,
     backend = global_worker()._require_backend()
     key = _kv_key(group_name if instance_token is None
                   else f"{group_name}/{instance_token}")
-    if rank == 0:
-        ip = coordinator_ip or socket.gethostbyname(socket.gethostname())
-        address = f"{ip}:{_free_port()}"
-        backend.kv_put(key, address.encode())
-    else:
-        deadline = time.monotonic() + timeout_s
-        address = None
-        while time.monotonic() < deadline:
-            raw = backend.kv_get(key)
-            if raw:
-                address = raw.decode()
-                break
-            time.sleep(0.1)
-        if address is None:
-            raise TimeoutError(
-                f"rendezvous {group_name!r}: coordinator address not "
-                f"published within {timeout_s}s")
+    address = _publish_or_await_coordinator(
+        backend, key, rank, coordinator_ip, timeout_s,
+        f"rendezvous {group_name!r}")
     import jax
 
     jax.distributed.initialize(
@@ -94,3 +100,37 @@ def clear_rendezvous(group_name: str = "train") -> None:
     from ray_tpu.core.worker import global_worker
 
     global_worker()._require_backend().kv_del(_kv_key(group_name))
+
+
+def bootstrap_torch_distributed(world_size: int, rank: int,
+                                group_name: str = "train",
+                                backend_name: str = "gloo",
+                                timeout_s: float = 60.0) -> None:
+    """torch.distributed process-group bootstrap through the same GCS-KV
+    rendezvous (reference: ``train/torch/config.py:64`` —
+    ``_setup_torch_process_group`` with rank-0 TCP store). CPU torch uses
+    gloo; the coordinator address rides the KV exactly like the jax path."""
+    import ray_tpu  # noqa: F401 — backend access below
+    from ray_tpu.core.worker import global_worker
+
+    if world_size <= 1:
+        return
+    backend = global_worker()._require_backend()
+    key = _kv_key(f"torch/{group_name}")
+    address = _publish_or_await_coordinator(
+        backend, key, rank, None, timeout_s,
+        f"torch rendezvous {group_name!r}")
+    import datetime
+
+    import torch.distributed as dist
+
+    host, port = address.rsplit(":", 1)
+    dist.init_process_group(
+        backend_name, init_method=f"tcp://{host}:{port}",
+        rank=rank, world_size=world_size,
+        timeout=datetime.timedelta(seconds=timeout_s))
+    if rank == 0:
+        try:
+            backend.kv_del(key)
+        except Exception:  # noqa: BLE001
+            pass

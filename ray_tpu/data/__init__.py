@@ -37,3 +37,14 @@ from ray_tpu.data.dataset import (  # noqa: F401
 )
 from ray_tpu.data.iterator import DataIterator  # noqa: F401
 from ray_tpu.data.logical import ActorPoolStrategy  # noqa: F401
+from ray_tpu.data import preprocessors  # noqa: F401,E402
+from ray_tpu.data.preprocessors import (  # noqa: F401,E402
+    Chain,
+    Concatenator,
+    LabelEncoder,
+    MinMaxScaler,
+    OneHotEncoder,
+    Preprocessor,
+    SimpleImputer,
+    StandardScaler,
+)

@@ -428,14 +428,14 @@ def _push_based_all_to_all(refs: List, n_out: int, mode: str,
     # GOT (not just waited): a failed map task errors its add calls, and
     # only get() raises, preventing a silently truncated shuffle.
     if acks:
-        ray_tpu.get(acks, timeout=600)
+        ray_tpu.get(acks)  # unbounded, like the task-graph path
     out = [m.finalize.remote() for m in mergers]
     # release merger actors once every finalize has materialized
     import threading
 
     def _reap(ms=list(mergers), fs=list(out)):
-        try:
-            ray_tpu.wait(fs, num_returns=len(fs), timeout=600)
+        try:  # unbounded: killing a merger mid-finalize loses its partition
+            ray_tpu.wait(fs, num_returns=len(fs), timeout=None)
         except Exception:  # noqa: BLE001
             pass
         for m in ms:

@@ -1,0 +1,266 @@
+"""Preprocessors: fit-on-Dataset, transform-as-map_batches.
+
+Reference analog: ``python/ray/data/preprocessors/`` (``Preprocessor`` base
+``preprocessor.py``, scalers, encoders, imputers, ``Chain``,
+``Concatenator``). Fit statistics come from the Dataset's distributed
+aggregates; ``transform`` appends a fused map stage, so preprocessing
+streams with the rest of the plan (and feeds ``iter_batches`` on the TPU
+input path with no extra materialization).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+
+class Preprocessor:
+    """fit(ds) computes state; transform(ds) appends a map stage."""
+
+    _fitted = False
+
+    def fit(self, ds) -> "Preprocessor":
+        self._fit(ds)
+        self._fitted = True
+        return self
+
+    def fit_transform(self, ds):
+        return self.fit(ds).transform(ds)
+
+    def transform(self, ds):
+        if not self._fitted and self._needs_fit():
+            raise RuntimeError(f"{type(self).__name__} must be fit first")
+        fn = self._transform_batch_fn()
+        return ds.map_batches(fn, batch_format="numpy")
+
+    def transform_batch(self, batch: Dict[str, np.ndarray]) -> Dict:
+        return self._transform_batch_fn()(dict(batch))
+
+    # subclass hooks
+    def _fit(self, ds) -> None:
+        pass
+
+    def _needs_fit(self) -> bool:
+        return True
+
+    def _transform_batch_fn(self):
+        raise NotImplementedError
+
+
+class StandardScaler(Preprocessor):
+    """(x - mean) / std per column (reference: ``StandardScaler``)."""
+
+    def __init__(self, columns: List[str]):
+        self.columns = columns
+        self.stats_: Dict[str, Any] = {}
+
+    def _fit(self, ds) -> None:
+        from ray_tpu.data.aggregate import Mean, Std
+
+        aggs = []
+        for c in self.columns:
+            aggs += [Mean(c), Std(c)]
+        row = ds.aggregate(*aggs)
+        self.stats_ = {c: (row[f"mean({c})"], row[f"std({c})"] or 1.0)
+                       for c in self.columns}
+
+    def _transform_batch_fn(self):
+        stats, cols = self.stats_, self.columns
+
+        def tx(batch):
+            for c in cols:
+                mean, std = stats[c]
+                batch[c] = (batch[c] - mean) / (std if std else 1.0)
+            return batch
+
+        return tx
+
+
+class MinMaxScaler(Preprocessor):
+    """(x - min) / (max - min) per column."""
+
+    def __init__(self, columns: List[str]):
+        self.columns = columns
+        self.stats_: Dict[str, Any] = {}
+
+    def _fit(self, ds) -> None:
+        from ray_tpu.data.aggregate import Max, Min
+
+        aggs = []
+        for c in self.columns:
+            aggs += [Min(c), Max(c)]
+        row = ds.aggregate(*aggs)
+        self.stats_ = {c: (row[f"min({c})"], row[f"max({c})"])
+                       for c in self.columns}
+
+    def _transform_batch_fn(self):
+        stats, cols = self.stats_, self.columns
+
+        def tx(batch):
+            for c in cols:
+                lo, hi = stats[c]
+                span = (hi - lo) or 1.0
+                batch[c] = (batch[c] - lo) / span
+            return batch
+
+        return tx
+
+
+class LabelEncoder(Preprocessor):
+    """Categorical column -> dense int codes (sorted unique order)."""
+
+    def __init__(self, label_column: str):
+        self.label_column = label_column
+        self.classes_: Optional[np.ndarray] = None
+
+    def _fit(self, ds) -> None:
+        uniques: set = set()
+        for batch in ds.iter_batches(batch_format="numpy"):
+            uniques.update(np.unique(batch[self.label_column]).tolist())
+        self.classes_ = np.asarray(sorted(uniques))
+
+    def _transform_batch_fn(self):
+        classes, col = self.classes_, self.label_column
+
+        def tx(batch):
+            codes = np.searchsorted(classes, batch[col])
+            # searchsorted gives colliding/out-of-range codes for UNSEEN
+            # values — corrupt labels must be loud, not silent
+            codes_clipped = np.clip(codes, 0, len(classes) - 1)
+            unseen = classes[codes_clipped] != batch[col]
+            if unseen.any():
+                bad = np.unique(np.asarray(batch[col])[unseen])[:5]
+                raise ValueError(
+                    f"LabelEncoder({col!r}): values not seen during fit: "
+                    f"{bad.tolist()}")
+            batch[col] = codes
+            return batch
+
+        return tx
+
+
+class OneHotEncoder(Preprocessor):
+    """Categorical columns -> one indicator column per category."""
+
+    def __init__(self, columns: List[str]):
+        self.columns = columns
+        self.categories_: Dict[str, np.ndarray] = {}
+
+    def _fit(self, ds) -> None:
+        uniques: Dict[str, set] = {c: set() for c in self.columns}
+        for batch in ds.iter_batches(batch_format="numpy"):
+            for c in self.columns:
+                uniques[c].update(np.unique(batch[c]).tolist())
+        self.categories_ = {c: np.asarray(sorted(v))
+                            for c, v in uniques.items()}
+
+    def _transform_batch_fn(self):
+        cats, cols = self.categories_, self.columns
+
+        def tx(batch):
+            for c in cols:
+                vals = batch.pop(c)
+                for cat in cats[c]:
+                    batch[f"{c}_{cat}"] = (vals == cat).astype(np.int64)
+            return batch
+
+        return tx
+
+
+class SimpleImputer(Preprocessor):
+    """Fill NaNs with the column mean (or a constant)."""
+
+    def __init__(self, columns: List[str], strategy: str = "mean",
+                 fill_value: Optional[float] = None):
+        if strategy not in ("mean", "constant"):
+            raise ValueError(f"unknown strategy {strategy!r}")
+        if strategy == "constant" and fill_value is None:
+            raise ValueError(
+                "strategy='constant' requires fill_value (None would "
+                "silently re-fill NaNs with NaN)")
+        self.columns = columns
+        self.strategy = strategy
+        self.fill_value = fill_value
+        self.stats_: Dict[str, float] = {}
+
+    def _needs_fit(self) -> bool:
+        return self.strategy == "mean"
+
+    def _fit(self, ds) -> None:
+        if self.strategy != "mean":
+            return
+        sums = {c: 0.0 for c in self.columns}
+        counts = {c: 0 for c in self.columns}
+        for batch in ds.iter_batches(batch_format="numpy"):
+            for c in self.columns:
+                v = batch[c].astype(np.float64)
+                ok = ~np.isnan(v)
+                sums[c] += float(v[ok].sum())
+                counts[c] += int(ok.sum())
+        self.stats_ = {c: (sums[c] / counts[c]) if counts[c] else 0.0
+                       for c in self.columns}
+
+    def _transform_batch_fn(self):
+        cols = self.columns
+        fills = (self.stats_ if self.strategy == "mean"
+                 else {c: self.fill_value for c in cols})
+
+        def tx(batch):
+            for c in cols:
+                v = batch[c].astype(np.float64)
+                v[np.isnan(v)] = fills[c]
+                batch[c] = v
+            return batch
+
+        return tx
+
+
+class Concatenator(Preprocessor):
+    """Merge numeric columns into one feature vector column."""
+
+    def __init__(self, columns: List[str], output_column_name: str = "features",
+                 dtype=np.float32):
+        self.columns = columns
+        self.output_column_name = output_column_name
+        self.dtype = dtype
+
+    def _needs_fit(self) -> bool:
+        return False
+
+    def _transform_batch_fn(self):
+        cols, out, dtype = self.columns, self.output_column_name, self.dtype
+
+        def tx(batch):
+            parts = []
+            for c in cols:
+                v = batch.pop(c)
+                parts.append(v.reshape(len(v), -1).astype(dtype))
+            batch[out] = np.concatenate(parts, axis=1)
+            return batch
+
+        return tx
+
+
+class Chain(Preprocessor):
+    """Apply preprocessors in sequence; fit runs each on the PRE-transformed
+    output of its predecessors (reference: ``Chain``)."""
+
+    def __init__(self, *stages: Preprocessor):
+        self.stages = list(stages)
+
+    def fit(self, ds) -> "Chain":
+        for st in self.stages:
+            if st._needs_fit():
+                st.fit(ds)
+            ds = st.transform(ds)
+        self._fitted = True
+        return self
+
+    def transform(self, ds):
+        for st in self.stages:
+            ds = st.transform(ds)
+        return ds
+
+    def _needs_fit(self) -> bool:
+        return any(st._needs_fit() for st in self.stages)

@@ -20,7 +20,8 @@ the MXU fed with large fused batches.
 
 from ray_tpu.serve.api import (Application, Deployment, delete, deployment,
                                get_app_handle, get_deployment_handle,
-                               http_port, run, shutdown, start, status)
+                               http_port, run, shutdown, start, start_grpc,
+                               status)
 from ray_tpu.serve.api import _forget_controller as _forget_controller_for_tests
 from ray_tpu.serve.batching import batch
 from ray_tpu.serve.config import (AutoscalingConfig, DeploymentConfig,
@@ -28,6 +29,7 @@ from ray_tpu.serve.config import (AutoscalingConfig, DeploymentConfig,
 from ray_tpu.serve.handle import (DeploymentHandle, DeploymentResponse,
                                   DeploymentResponseGenerator)
 from ray_tpu.serve.multiplex import get_multiplexed_model_id, multiplexed
+from ray_tpu.serve.grpc_proxy import grpc_request
 from ray_tpu.serve.proxy import ServeRequest
 
 __all__ = [
@@ -35,6 +37,7 @@ __all__ = [
     "DeploymentHandle", "DeploymentResponse", "DeploymentResponseGenerator",
     "HTTPOptions", "ServeRequest",
     "batch", "delete", "deployment", "get_app_handle",
-    "get_deployment_handle", "get_multiplexed_model_id", "http_port",
-    "multiplexed", "run", "shutdown", "start", "status",
+    "get_deployment_handle", "get_multiplexed_model_id", "grpc_request",
+    "http_port", "multiplexed", "run", "shutdown", "start", "start_grpc",
+    "status",
 ]

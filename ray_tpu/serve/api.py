@@ -212,6 +212,14 @@ def start(http_options: Optional[HTTPOptions] = None) -> None:
     ray_tpu.get(controller.ensure_proxy.remote(opts.host, opts.port))
 
 
+def start_grpc(host: str = "127.0.0.1", port: int = 0) -> int:
+    """Start the gRPC ingress (reference: ``gRPCProxy``); returns the bound
+    port. Callers hit ``/rt.serve/<app>[.<method>]`` with cloudpickled
+    (args, kwargs) — see ``serve.grpc_proxy.grpc_request``."""
+    controller = _get_controller(create=True)
+    return ray_tpu.get(controller.ensure_grpc_proxy.remote(host, port))
+
+
 def http_port() -> int:
     """The bound port of the HTTP proxy (after serve.run/start)."""
     controller = _get_controller()

@@ -114,6 +114,8 @@ class ServeController:
         self._routing_version = 0
         self._version_counter = 0
         self._proxy = None
+        self._grpc_proxy = None
+        self._grpc_port = None
         self._proxy_port: Optional[int] = None
         self._shutdown = False
         self._reconciler = threading.Thread(
@@ -245,6 +247,26 @@ class ServeController:
                 out[app] = {"route_prefix": meta["route_prefix"],
                             "ingress": meta["ingress"], "deployments": deps}
             return out
+
+    def get_ingress(self, app_name: str):
+        """Ingress deployment name of one application (gRPC proxy lookup)."""
+        with self._lock:
+            meta = self._apps.get(app_name)
+            return meta["ingress"] if meta else None
+
+    def ensure_grpc_proxy(self, host: str, port: int) -> int:
+        """gRPC ingress (reference: ``gRPCProxy``); idempotent like the
+        HTTP proxy."""
+        from ray_tpu.serve.grpc_proxy import GrpcProxyActor
+
+        with self._lock:
+            if self._grpc_proxy is None:
+                self._grpc_proxy = GrpcProxyActor.options(
+                    name="RT_SERVE_GRPC_PROXY", max_concurrency=256,
+                    num_cpus=0).remote(host, port)
+                self._grpc_port = ray_tpu.get(
+                    self._grpc_proxy.ready.remote())
+            return self._grpc_port
 
     # -- http proxy -----------------------------------------------------------
     def ensure_proxy(self, host: str, port: int) -> int:
@@ -425,9 +447,16 @@ class ServeController:
                 self._stop_deployment(self._deployments.pop(key))
             self._apps.clear()
             proxy, self._proxy = self._proxy, None
+            gproxy, self._grpc_proxy = self._grpc_proxy, None
         if proxy is not None:
             try:
                 ray_tpu.get(proxy.stop.remote())
                 ray_tpu.kill(proxy)
+            except Exception:  # noqa: BLE001
+                pass
+        if gproxy is not None:
+            try:
+                ray_tpu.get(gproxy.shutdown.remote())
+                ray_tpu.kill(gproxy)
             except Exception:  # noqa: BLE001
                 pass

@@ -1,0 +1,140 @@
+"""gRPC ingress: method-routed handle calls over a generic gRPC service.
+
+Reference analog: ``serve/_private/http_proxy.py:636`` (``gRPCProxy``
+subclassing ``GenericProxy``) + ``serve/_private/grpc_util.py``. Redesign
+without protoc codegen: one ``grpc.aio`` server with a generic RPC handler
+accepting any unary method of the form ``/rt.serve/<app>`` (or
+``/rt.serve/<app>.<method>``); request bytes are a cloudpickled
+``(args, kwargs)`` pair, response bytes the cloudpickled return value —
+the same picklable surface handle calls use internally. Clients use
+``grpc_request()`` below or any gRPC stack speaking the same frames.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import cloudpickle
+
+import ray_tpu
+
+SERVICE = "rt.serve"
+
+
+def _parse_method(full_name: str) -> Optional[Tuple[str, str]]:
+    # "/rt.serve/<app>" or "/rt.serve/<app>.<method>"
+    parts = full_name.strip("/").split("/")
+    if len(parts) != 2 or parts[0] != SERVICE:
+        return None
+    app, _, method = parts[1].partition(".")
+    return app, method or "__call__"
+
+
+@ray_tpu.remote
+class GrpcProxyActor:
+    """One gRPC ingress actor (reference: the gRPC proxy actor per node)."""
+
+    def __init__(self, host: str, port: int):
+        self._host = host
+        self._port = port
+        self._handles: Dict[Tuple[str, str], Any] = {}
+        self._server = None
+        self._started = False
+
+    async def ready(self) -> int:
+        if self._started:
+            return self._port
+        import grpc
+
+        proxy = self
+
+        class _Generic(grpc.GenericRpcHandler):
+            def service(self, handler_call_details):
+                target = _parse_method(handler_call_details.method)
+                if target is None:
+                    return None
+
+                async def unary(request_bytes, context):
+                    return await proxy._call(target, request_bytes, context)
+
+                return grpc.unary_unary_rpc_method_handler(
+                    unary,
+                    request_deserializer=None,   # raw bytes in
+                    response_serializer=None)    # raw bytes out
+
+        self._server = grpc.aio.server()
+        self._server.add_generic_rpc_handlers((_Generic(),))
+        self._port = self._server.add_insecure_port(
+            f"{self._host}:{self._port}")
+        await self._server.start()
+        self._started = True
+        return self._port
+
+    async def _call(self, target: Tuple[str, str], request_bytes: bytes,
+                    context) -> bytes:
+        # The handle/controller APIs are SYNC (they block on io.run); calling
+        # them from this worker's own event loop would deadlock it — run the
+        # whole request on an executor thread.
+        import asyncio
+
+        import grpc
+
+        loop = asyncio.get_running_loop()
+        try:
+            return await loop.run_in_executor(
+                None, self._call_sync, target, request_bytes)
+        except LookupError as e:
+            await context.abort(grpc.StatusCode.NOT_FOUND, str(e))
+        except Exception as e:  # noqa: BLE001 — surface as gRPC error
+            await context.abort(grpc.StatusCode.INTERNAL, repr(e))
+
+    def _resolve_handle(self, target: Tuple[str, str]):
+        from ray_tpu.serve.api import _get_controller
+        from ray_tpu.serve.handle import DeploymentHandle
+
+        app, method = target
+        try:
+            ingress = ray_tpu.get(
+                _get_controller().get_ingress.remote(app), timeout=15)
+        except Exception:  # noqa: BLE001
+            ingress = None
+        if ingress is None:
+            raise LookupError(f"no serve application {app!r}")
+        handle = DeploymentHandle(app, ingress, method_name=method)
+        self._handles[target] = handle
+        return handle
+
+    def _call_sync(self, target: Tuple[str, str],
+                   request_bytes: bytes) -> bytes:
+        handle = self._handles.get(target) or self._resolve_handle(target)
+        args, kwargs = cloudpickle.loads(request_bytes) \
+            if request_bytes else ((), {})
+        try:
+            result = handle.remote(*args, **kwargs).result(timeout=120)
+        except Exception:
+            # The cached handle may target a DELETED/redeployed ingress —
+            # drop it, re-resolve through the controller, retry once
+            # (the HTTP proxy gets this for free from the routing table).
+            self._handles.pop(target, None)
+            handle = self._resolve_handle(target)
+            result = handle.remote(*args, **kwargs).result(timeout=120)
+        return cloudpickle.dumps(result)
+
+    async def shutdown(self) -> None:
+        if self._server is not None:
+            await self._server.stop(grace=1.0)
+
+
+def grpc_request(address: str, app: str, *args, method: str = "__call__",
+                 timeout: float = 30.0, **kwargs) -> Any:
+    """Convenience client: one unary call to a served application."""
+    import grpc
+
+    suffix = app if method == "__call__" else f"{app}.{method}"
+    with grpc.insecure_channel(address) as channel:
+        fn = channel.unary_unary(
+            f"/{SERVICE}/{suffix}",
+            request_serializer=None,
+            response_deserializer=None)
+        payload = cloudpickle.dumps((args, kwargs))
+        return cloudpickle.loads(fn(payload, timeout=timeout))

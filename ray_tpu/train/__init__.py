@@ -22,4 +22,8 @@ from ray_tpu.train.session import (  # noqa: F401
     get_dataset_shard,
     report,
 )
-from ray_tpu.train.trainer import JaxTrainer, Result  # noqa: F401
+from ray_tpu.train.trainer import (  # noqa: F401
+    JaxTrainer,
+    Result,
+    TorchTrainer,
+)

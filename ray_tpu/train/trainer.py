@@ -60,6 +60,11 @@ class JaxTrainer:
         self.use_jax_distributed = use_jax_distributed
         self.resume_checkpoint = resume_from_checkpoint
 
+    @property
+    def _dist_bootstrap(self):
+        return ("bootstrap_jax_distributed" if self.use_jax_distributed
+                else None)
+
     # -- dataset sharding -----------------------------------------------------
     def _shard_datasets(self, rank: int, world: int) -> Dict[str, Any]:
         shards = {}
@@ -106,8 +111,8 @@ class JaxTrainer:
             group = WorkerGroup(self.scaling, name)
             group.start()
             try:
-                if self.use_jax_distributed and self.scaling.num_workers > 1:
-                    group.run("bootstrap_jax_distributed",
+                if self._dist_bootstrap and self.scaling.num_workers > 1:
+                    group.run(self._dist_bootstrap,
                               f"{name}:{uuid.uuid4().hex[:6]}", timeout=300)
                 n = self.scaling.num_workers
                 ray_tpu.get([
@@ -159,3 +164,24 @@ class JaxTrainer:
             active = [w for w, r in zip(active, round_results)
                       if r["type"] == "report"]
         return None
+
+
+class TorchTrainer(JaxTrainer):
+    """Data-parallel torch training (reference: ``train/torch/TorchTrainer``).
+
+    Same gang/report/checkpoint machinery as JaxTrainer; the collective
+    backend is a torch.distributed gloo process group bootstrapped through
+    the GCS-KV rendezvous (CPU torch — this framework's compute path is
+    JAX/TPU, but torch users keep their Train API). The user loop calls
+    ``torch.distributed`` collectives / wraps modules in DDP as usual.
+    """
+
+    def __init__(self, *args, use_torch_distributed: bool = True, **kwargs):
+        kwargs.pop("use_jax_distributed", None)
+        super().__init__(*args, **kwargs)
+        self.use_torch_distributed = use_torch_distributed
+
+    @property
+    def _dist_bootstrap(self):
+        return ("bootstrap_torch_distributed" if self.use_torch_distributed
+                else None)

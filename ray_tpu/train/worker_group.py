@@ -43,6 +43,11 @@ class TrainWorker:
 
         bootstrap_jax_distributed(self.world_size, self.rank, group_name)
 
+    def bootstrap_torch_distributed(self, group_name: str) -> None:
+        from ray_tpu.collective.rendezvous import bootstrap_torch_distributed
+
+        bootstrap_torch_distributed(self.world_size, self.rank, group_name)
+
     def start(self, train_fn: Callable, config: Dict[str, Any],
               checkpoint: Optional[Checkpoint],
               dataset_shards: Optional[Dict[str, Any]]) -> None:

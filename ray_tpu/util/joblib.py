@@ -1,0 +1,101 @@
+"""Joblib-on-ray_tpu: run scikit-learn / joblib.Parallel over cluster tasks.
+
+Reference analog: ``python/ray/util/joblib/`` (``register_ray`` +
+``RayBackend``). ``register_ray()`` registers a joblib parallel backend
+named "ray"; ``with joblib.parallel_backend("ray"):`` then fans each batch
+out as a task.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+from joblib._parallel_backends import ParallelBackendBase
+
+import ray_tpu
+
+
+def register_ray() -> None:
+    from joblib.parallel import register_parallel_backend
+
+    register_parallel_backend("ray", RayBackend)
+
+
+class _TaskFuture:
+    """joblib expects a multiprocessing-style async result."""
+
+    def __init__(self, ref):
+        self._ref = ref
+
+    def get(self, timeout: Optional[float] = None) -> Any:
+        return ray_tpu.get(self._ref, timeout=timeout)
+
+
+@ray_tpu.remote
+def _run_batch(batch: Callable) -> Any:
+    return batch()
+
+
+class RayBackend(ParallelBackendBase):
+    """joblib backend over cluster tasks: ParallelBackendBase supplies the
+    batching/dispatch machinery; submission is a task per batch."""
+
+    supports_timeout = True
+    uses_threads = False
+    supports_sharedmem = False
+
+    def configure(self, n_jobs: int = 1, parallel=None, prefer=None,
+                  require=None, **kwargs) -> int:
+        self.parallel = parallel
+        self._n_jobs = self.effective_n_jobs(n_jobs)
+        return self._n_jobs
+
+    def effective_n_jobs(self, n_jobs: Optional[int]) -> int:
+        if n_jobs == 0:
+            raise ValueError("n_jobs == 0 has no meaning")
+        if n_jobs is None:
+            n_jobs = 1
+        if n_jobs < 0:  # -1 = cluster CPU capacity
+            total = ray_tpu.cluster_resources().get("CPU", 1)
+            return max(1, int(total))
+        return n_jobs
+
+    def submit(self, func, callback=None):
+        return self.apply_async(func, callback)
+
+    def apply_async(self, func: Callable, callback=None) -> _TaskFuture:
+        ref = _run_batch.remote(func)
+        fut = _TaskFuture(ref)
+        if callback is not None:
+            # joblib's completion callback drives its dispatch window
+            def _done(r=ref):
+                try:
+                    ray_tpu.wait([r], num_returns=1)
+                finally:
+                    callback(fut)
+
+            import threading
+
+            threading.Thread(target=_done, daemon=True).start()
+        return fut
+
+    def retrieve_result_callback(self, out):
+        return out.get() if isinstance(out, _TaskFuture) else out
+
+    def get_nested_backend(self):
+        from joblib._parallel_backends import SequentialBackend
+
+        return SequentialBackend(nesting_level=1), None
+
+    def terminate(self) -> None:
+        pass
+
+    def abort_everything(self, ensure_ready: bool = True) -> None:
+        pass
+
+    # joblib calls these around a Parallel run
+    def start_call(self) -> None:
+        pass
+
+    def stop_call(self) -> None:
+        pass

@@ -398,3 +398,48 @@ def test_push_based_shuffle_matches_task_shuffle(rt_cluster):
         assert got == sorted((float(i) for i in range(100)), reverse=True)
     finally:
         ctx.use_push_based_shuffle = False
+
+
+def test_preprocessors_end_to_end(rt_cluster):
+    """Scalers/encoders/imputer/concat/chain fit on the Dataset and stream
+    through map_batches (reference: data/preprocessors/)."""
+    from ray_tpu.data import (
+        Chain,
+        Concatenator,
+        LabelEncoder,
+        MinMaxScaler,
+        OneHotEncoder,
+        SimpleImputer,
+        StandardScaler,
+    )
+
+    rows = [{"a": float(i), "b": float(i % 3), "c": f"cat{i % 2}",
+             "n": float("nan") if i % 4 == 0 else float(i)}
+            for i in range(20)]
+    ds = data.from_items(rows)
+
+    out = StandardScaler(["a"]).fit_transform(ds).take_all()
+    vals = np.asarray([r["a"] for r in out])
+    assert abs(vals.mean()) < 1e-6 and abs(vals.std() - 1.0) < 0.1
+
+    out = MinMaxScaler(["a"]).fit_transform(ds).take_all()
+    vals = np.asarray([r["a"] for r in out])
+    assert vals.min() == 0.0 and vals.max() == 1.0
+
+    le = LabelEncoder("c").fit(ds)
+    out = le.transform(ds).take_all()
+    assert sorted(set(r["c"] for r in out)) == [0, 1]
+
+    out = OneHotEncoder(["c"]).fit_transform(ds).take_all()
+    assert all(("c_cat0" in r and "c_cat1" in r and "c" not in r)
+               for r in out)
+    assert all(r["c_cat0"] + r["c_cat1"] == 1 for r in out)
+
+    out = SimpleImputer(["n"]).fit_transform(ds).take_all()
+    assert not any(np.isnan(r["n"]) for r in out)
+
+    chain = Chain(SimpleImputer(["n"]), StandardScaler(["a", "n"]),
+                  Concatenator(["a", "b", "n"]))
+    out = chain.fit_transform(ds).take_all()
+    assert out[0]["features"].shape == (3,)
+    assert not any(np.isnan(r["features"]).any() for r in out)

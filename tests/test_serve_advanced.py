@@ -127,3 +127,28 @@ def test_long_poll_pushes_replica_updates(serve_cluster):
         time.sleep(0.1)  # NO handle calls: the poller must learn by itself
     assert len(router.replicas) == 2, "long-poll never pushed the update"
     assert router.version != v_before
+
+
+def test_grpc_proxy_end_to_end(serve_cluster):
+    """gRPC ingress: generic unary method routing to deployment handles
+    (reference: gRPCProxy, http_proxy.py:636)."""
+    from ray_tpu.serve.grpc_proxy import grpc_request
+
+    @serve.deployment(num_replicas=2)
+    class Adder:
+        def __call__(self, a, b=0):
+            return {"sum": a + b}
+
+        def mul(self, a, b):
+            return a * b
+
+    serve.run(Adder.bind(), name="calc", route_prefix=None)
+    port = serve.start_grpc()
+    addr = f"127.0.0.1:{port}"
+    assert grpc_request(addr, "calc", 2, b=3) == {"sum": 5}
+    assert grpc_request(addr, "calc", 4, 5, method="mul") == 20
+    import grpc
+    import pytest as _pytest
+
+    with _pytest.raises(grpc.RpcError):
+        grpc_request(addr, "nope", 1)

@@ -151,3 +151,30 @@ def test_data_parallel_mlp_with_psum_grads(rt_cluster, tmp_path):
         loop, scaling_config=ScalingConfig(num_workers=2),
         run_config=RunConfig(name="mlp", storage_path=str(tmp_path))).fit()
     assert result.metrics["last_loss"] < result.metrics["first_loss"] * 0.5
+
+
+def test_torch_trainer_ddp_gloo(rt_cluster):
+    """TorchTrainer: 2-worker gloo process group over the KV rendezvous;
+    an all_reduce proves the group is real (reference: TorchTrainer +
+    _setup_torch_process_group)."""
+    from ray_tpu.train import ScalingConfig, TorchTrainer
+
+    def loop(config):
+        import torch
+        import torch.distributed as dist
+
+        from ray_tpu import train
+
+        rank = dist.get_rank()
+        world = dist.get_world_size()
+        t = torch.tensor([float(rank + 1)])
+        dist.all_reduce(t)  # 1 + 2 = 3 across 2 workers
+        train.report({"sum": float(t.item()), "rank": rank,
+                      "world": world})
+
+    trainer = TorchTrainer(
+        loop, train_loop_config={},
+        scaling_config=ScalingConfig(num_workers=2, cpus_per_worker=1))
+    result = trainer.fit()
+    assert result.metrics["sum"] == 3.0
+    assert result.metrics["world"] == 2

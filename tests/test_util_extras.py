@@ -196,3 +196,18 @@ def test_tracing_span_tree(rt_cluster):
         assert children[0]["trace"]["parent_span_id"] in span_ids
     finally:
         tracing.disable()
+
+
+def test_joblib_ray_backend(rt_cluster):
+    """joblib.Parallel over cluster tasks (reference: util/joblib)."""
+    import math
+
+    import joblib
+
+    from ray_tpu.util.joblib import register_ray
+
+    register_ray()
+    with joblib.parallel_backend("ray", n_jobs=4):
+        out = joblib.Parallel()(
+            joblib.delayed(math.factorial)(i) for i in range(8))
+    assert out == [math.factorial(i) for i in range(8)]
