@@ -120,10 +120,11 @@ def init_params(rng: jax.Array, cfg: LlamaConfig) -> Params:
     return params
 
 
-def _block(cfg: LlamaConfig, x: jax.Array, layer: Params,
-           sin: jax.Array, cos: jax.Array,
-           segment_ids: Optional[jax.Array]) -> jax.Array:
-    """One decoder block: pre-norm attn + pre-norm SwiGLU MLP."""
+def attention_half(cfg: LlamaConfig, x: jax.Array, layer: Params,
+                   sin: jax.Array, cos: jax.Array,
+                   segment_ids: Optional[jax.Array]) -> jax.Array:
+    """Pre-norm attention + residual — shared by every model family
+    (llama's dense blocks, moe's expert blocks)."""
     b, s, d = x.shape
     hq, hkv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
     cdt = cfg.compute_dtype
@@ -150,8 +151,15 @@ def _block(cfg: LlamaConfig, x: jax.Array, layer: Params,
         attn = flash_attention(q, k, v, causal=True)
     else:
         attn = mha(q, k, v, causal=True, segment_ids=segment_ids)
-    x = x + attn.reshape(b, s, hq * hd) @ layer["wo"].astype(cdt)
+    return x + attn.reshape(b, s, hq * hd) @ layer["wo"].astype(cdt)
 
+
+def _block(cfg: LlamaConfig, x: jax.Array, layer: Params,
+           sin: jax.Array, cos: jax.Array,
+           segment_ids: Optional[jax.Array]) -> jax.Array:
+    """One decoder block: pre-norm attn + pre-norm SwiGLU MLP."""
+    cdt = cfg.compute_dtype
+    x = attention_half(cfg, x, layer, sin, cos, segment_ids)
     h = rmsnorm(x, layer["mlp_norm"].astype(cdt), cfg.norm_eps)
     gate = jax.nn.silu(h @ layer["w_gate"].astype(cdt))
     up = h @ layer["w_up"].astype(cdt)
@@ -243,11 +251,16 @@ def lm_loss(params: Params, batch: Dict[str, jax.Array], cfg: LlamaConfig) -> ja
     """
     tokens = batch["tokens"]
     inputs, targets = tokens[:, :-1], tokens[:, 1:]
-    mask = batch.get("loss_mask")
-    S = inputs.shape[1]
-    chunk = cfg.loss_chunk
+    x, head = forward_hidden(params, inputs, cfg, batch.get("segment_ids"))
+    return chunked_ce(x, head, targets, batch.get("loss_mask"),
+                      cfg.loss_chunk)
+
+
+def chunked_ce(x: jax.Array, head: jax.Array, targets: jax.Array,
+               mask: Optional[jax.Array], chunk: int) -> jax.Array:
+    """Cross entropy from final hiddens; shared by every model family."""
+    S = targets.shape[1]
     if chunk and S % chunk == 0 and S > chunk:
-        x, head = forward_hidden(params, inputs, cfg, batch.get("segment_ids"))
         n_chunks = S // chunk
         xs = x.reshape(x.shape[0], n_chunks, chunk, -1).swapaxes(0, 1)
         ts = targets.reshape(targets.shape[0], n_chunks, chunk).swapaxes(0, 1)
@@ -270,7 +283,7 @@ def lm_loss(params: Params, batch: Dict[str, jax.Array], cfg: LlamaConfig) -> ja
             (xs, ts, ms))
         return total / jnp.maximum(count, 1)
 
-    logits = forward(params, inputs, cfg, batch.get("segment_ids"))
+    logits = (x @ head).astype(jnp.float32)
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     if mask is None:

@@ -1,0 +1,224 @@
+"""Mixtral-style sparse-MoE transformer — the second flagship model family.
+
+TPU-first design (no reference counterpart — Ray ships no model code; the
+recipe is the public GShard/Switch einsum formulation): the router performs
+STATIC top-k capacity dispatch, so every tensor shape is fixed at trace
+time and XLA tiles the expert FFNs onto the MXU as one batched einsum.
+Experts shard over the mesh's ``ep`` axis (each device group holds
+n_experts/ep experts); GSPMD inserts the all-to-alls implied by the
+dispatch/combine einsums over ICI. Attention blocks, RoPE, norms and the
+chunked loss are shared with :mod:`ray_tpu.models.llama`.
+
+Routing (per token): softmax router logits -> top-k experts -> each chosen
+token takes a slot in its expert's capacity buffer
+(``capacity_factor * tokens / n_experts``); overflow tokens drop that
+expert (standard Switch behavior — the residual stream carries them).
+Load-balancing aux loss: ``n_experts * sum_e(fraction_e * prob_e)``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.models import llama
+from ray_tpu.parallel.sharding import ShardingRules
+from jax.sharding import PartitionSpec as P
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig(llama.LlamaConfig):
+    n_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    router_aux_coef: float = 0.01
+
+    def num_params(self) -> int:
+        d, f, v, l = self.d_model, self.d_ff, self.vocab_size, self.n_layers
+        hd = self.head_dim
+        attn = (d * self.n_heads * hd + 2 * d * self.n_kv_heads * hd
+                + self.n_heads * hd * d)
+        moe = self.n_experts * 3 * d * f + d * self.n_experts  # experts+router
+        per_layer = attn + moe + 2 * d
+        head = 0 if self.tie_embeddings else d * v
+        return v * d + l * per_layer + d + head
+
+    def active_params(self) -> int:
+        """Params touched per token (top-k experts) — the FLOPs-relevant
+        count for MFU estimates."""
+        d, f, v, l = self.d_model, self.d_ff, self.vocab_size, self.n_layers
+        hd = self.head_dim
+        attn = (d * self.n_heads * hd + 2 * d * self.n_kv_heads * hd
+                + self.n_heads * hd * d)
+        moe = self.top_k * 3 * d * f + d * self.n_experts
+        head = 0 if self.tie_embeddings else d * v
+        return v * d + l * (attn + moe + 2 * d) + d + head
+
+
+PRESETS: Dict[str, MoEConfig] = {
+    "moe-debug": MoEConfig(vocab_size=256, d_model=64, n_layers=2, n_heads=4,
+                           n_kv_heads=4, d_ff=128, max_seq_len=256,
+                           n_experts=4, top_k=2),
+    "8x160m": MoEConfig(vocab_size=32000, d_model=768, n_layers=12,
+                        n_heads=12, n_kv_heads=12, d_ff=2048,
+                        max_seq_len=2048, n_experts=8, top_k=2),
+    "8x410m": MoEConfig(vocab_size=32000, d_model=1024, n_layers=24,
+                        n_heads=16, n_kv_heads=16, d_ff=2816,
+                        max_seq_len=2048, n_experts=8, top_k=2),
+}
+
+
+def init_params(rng: jax.Array, cfg: MoEConfig) -> Params:
+    """Llama init plus stacked expert FFNs [L, E, ...] and routers."""
+    d, f, E, L = cfg.d_model, cfg.d_ff, cfg.n_experts, cfg.n_layers
+    base = llama.init_params(rng, cfg)
+    k = jax.random.fold_in(rng, 7)
+    k1, k2, k3, k4 = jax.random.split(k, 4)
+
+    def norm_init(key, shape, fan_in):
+        return (jax.random.normal(key, shape, jnp.float32)
+                * (1.0 / math.sqrt(fan_in))).astype(cfg.param_dtype)
+
+    layers = base["layers"]
+    for name in ("w_gate", "w_up", "w_down"):  # dense FFN -> experts
+        del layers[name]
+    layers["router"] = norm_init(k1, (L, d, E), d)
+    layers["e_gate"] = norm_init(k2, (L, E, d, f), d)
+    layers["e_up"] = norm_init(k3, (L, E, d, f), d)
+    layers["e_down"] = norm_init(k4, (L, E, f, d), f)
+    return base
+
+
+def _moe_ffn(cfg: MoEConfig, h: jax.Array, layer: Params
+             ) -> Tuple[jax.Array, jax.Array]:
+    """[B, S, d] -> ([B, S, d], aux_loss). Static-shape top-k capacity
+    dispatch (GShard einsum formulation)."""
+    b, s, d = h.shape
+    E, K = cfg.n_experts, cfg.top_k
+    G = b * s
+    C = max(1, int(cfg.capacity_factor * G * K / E))
+    tokens = h.reshape(G, d)
+
+    logits = (tokens @ layer["router"].astype(jnp.float32)).astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)                       # [G, E]
+    topk_probs, topk_idx = jax.lax.top_k(probs, K)                # [G, K]
+    # renormalize the selected gates (Mixtral convention)
+    topk_probs = topk_probs / (topk_probs.sum(-1, keepdims=True) + 1e-9)
+
+    # capacity slots: position of each token within its expert's queue,
+    # counted over the flattened [K, G] selection order
+    sel_onehot = jax.nn.one_hot(topk_idx, E, dtype=jnp.int32)     # [G, K, E]
+    flat = sel_onehot.transpose(1, 0, 2).reshape(K * G, E)        # [K*G, E]
+    pos_flat = jnp.cumsum(flat, axis=0) - flat                    # slot idx
+    pos = pos_flat.reshape(K, G, E).transpose(1, 0, 2)            # [G, K, E]
+    slot = jnp.sum(pos * sel_onehot, axis=-1)                     # [G, K]
+    keep = slot < C                                               # overflow
+
+    gates = topk_probs * keep                                      # [G, K]
+    # dispatch/combine tensors [G, E, C]
+    slot_onehot = jax.nn.one_hot(slot, C, dtype=h.dtype)          # [G, K, C]
+    dispatch = jnp.einsum("gke,gkc->gec",
+                          sel_onehot.astype(h.dtype) * keep[..., None],
+                          slot_onehot)
+    combine = jnp.einsum("gke,gkc,gk->gec",
+                         sel_onehot.astype(h.dtype), slot_onehot,
+                         gates.astype(h.dtype))
+
+    expert_in = jnp.einsum("gd,gec->ecd", tokens, dispatch)       # [E, C, d]
+    gate = jax.nn.silu(jnp.einsum("ecd,edf->ecf", expert_in,
+                                  layer["e_gate"].astype(h.dtype)))
+    up = jnp.einsum("ecd,edf->ecf", expert_in,
+                    layer["e_up"].astype(h.dtype))
+    expert_out = jnp.einsum("ecf,efd->ecd", gate * up,
+                            layer["e_down"].astype(h.dtype))
+    out = jnp.einsum("ecd,gec->gd", expert_out, combine)
+
+    # Switch aux loss: balance token fraction vs router probability mass
+    frac = jnp.mean(sel_onehot[:, 0, :].astype(jnp.float32), axis=0)  # top-1
+    prob_mean = jnp.mean(probs, axis=0)
+    aux = E * jnp.sum(frac * prob_mean)
+    return out.reshape(b, s, d), aux
+
+
+def _moe_block(cfg: MoEConfig, x: jax.Array, layer: Params,
+               sin: jax.Array, cos: jax.Array,
+               segment_ids) -> Tuple[jax.Array, jax.Array]:
+    """Shared llama attention half + MoE FFN; returns (hidden, aux_loss)."""
+    x = llama.attention_half(cfg, x, layer, sin, cos, segment_ids)
+    h = llama.rmsnorm(x, layer["mlp_norm"].astype(cfg.compute_dtype),
+                      cfg.norm_eps)
+    ffn, aux = _moe_ffn(cfg, h, layer)
+    return x + ffn, aux
+
+
+def forward_hidden(params: Params, tokens: jax.Array, cfg: MoEConfig,
+                   segment_ids=None) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """-> (hidden, head, total_aux_loss)."""
+    if cfg.pipeline_axis is not None:
+        raise NotImplementedError(
+            "pipeline parallelism for the MoE family is not implemented "
+            "(use dp/fsdp/tp/ep); silently ignoring pipeline_axis would "
+            "train an unpipelined model under pipeline shardings")
+    cdt = cfg.compute_dtype
+    x = params["embed"].astype(cdt)[tokens]
+    sin, cos = llama.rope_angles(tokens.shape[1], cfg.head_dim,
+                                 cfg.rope_theta, cdt)
+
+    def body(carry, layer):
+        x, aux = carry
+        x, a = _moe_block(cfg, x, layer, sin, cos, segment_ids)
+        return (x, aux + a), None
+
+    if cfg.remat:
+        body = jax.checkpoint(
+            body,
+            policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+    (x, aux), _ = jax.lax.scan(body, (x, jnp.zeros((), jnp.float32)),
+                               params["layers"])
+    x = llama.rmsnorm(x, params["final_norm"].astype(cdt), cfg.norm_eps)
+    head = (params["embed"].T if cfg.tie_embeddings
+            else params["lm_head"]).astype(cdt)
+    return x, head, aux / cfg.n_layers
+
+
+def forward(params: Params, tokens: jax.Array, cfg: MoEConfig,
+            segment_ids=None) -> jax.Array:
+    x, head, _ = forward_hidden(params, tokens, cfg, segment_ids)
+    return (x @ head).astype(jnp.float32)
+
+
+def lm_loss(params: Params, batch: Dict[str, jax.Array],
+            cfg: MoEConfig) -> jax.Array:
+    """Next-token CE + router aux loss (llama's chunked CE reused)."""
+    tokens = batch["tokens"]
+    inputs, targets = tokens[:, :-1], tokens[:, 1:]
+    x, head, aux = forward_hidden(params, inputs, cfg,
+                                  batch.get("segment_ids"))
+    ce = llama.chunked_ce(x, head, targets, batch.get("loss_mask"),
+                          cfg.loss_chunk)
+    return ce + cfg.router_aux_coef * aux
+
+
+def sharding_rules(pipeline: bool = False) -> ShardingRules:
+    """Llama rules + expert tensors: experts over ``ep``, expert matrices'
+    ff dim over ``tp`` (fsdp shards the model dim like the dense path)."""
+    if pipeline:
+        raise NotImplementedError(
+            "pipeline parallelism for the MoE family is not implemented")
+    return ShardingRules([
+        (r"embed$", P("tp", "fsdp")),
+        (r"lm_head$", P("fsdp", "tp")),
+        (r"layers/w[qkv]$", P(None, "fsdp", "tp")),
+        (r"layers/wo$", P(None, "tp", "fsdp")),
+        (r"layers/router$", P(None, "fsdp", None)),
+        (r"layers/e_(gate|up)$", P(None, "ep", "fsdp", "tp")),
+        (r"layers/e_down$", P(None, "ep", "tp", "fsdp")),
+        (r"layers/.*norm", P(None)),
+        (r"norm", P()),
+    ])

@@ -34,6 +34,14 @@ def default_optimizer(lr: float = 3e-4, weight_decay: float = 0.1,
     )
 
 
+def model_family(cfg):
+    """The module implementing ``cfg``'s family (init_params / lm_loss /
+    sharding_rules) — llama-family dense models or the sparse-MoE family."""
+    from ray_tpu.models import moe
+
+    return moe if isinstance(cfg, moe.MoEConfig) else llama
+
+
 def init_sharded_state(rng: jax.Array, cfg: llama.LlamaConfig, mesh: Mesh,
                        optimizer: optax.GradientTransformation,
                        rules: Optional[ShardingRules] = None):
@@ -43,10 +51,11 @@ def init_sharded_state(rng: jax.Array, cfg: llama.LlamaConfig, mesh: Mesh,
     host-side full copy ever materializes (essential for 7B+); the optimizer
     state inherits the param shardings through GSPMD propagation.
     """
-    rules = rules or llama.sharding_rules(pipeline=cfg.pipeline_axis is not None)
-    abstract = jax.eval_shape(lambda r: llama.init_params(r, cfg), rng)
+    fam = model_family(cfg)
+    rules = rules or fam.sharding_rules(pipeline=cfg.pipeline_axis is not None)
+    abstract = jax.eval_shape(lambda r: fam.init_params(r, cfg), rng)
     out_shardings = rules.tree_shardings(abstract, mesh)
-    params = jax.jit(lambda r: llama.init_params(r, cfg),
+    params = jax.jit(lambda r: fam.init_params(r, cfg),
                      out_shardings=out_shardings)(rng)
     opt_state = jax.jit(optimizer.init)(params)
     return params, opt_state
@@ -62,7 +71,7 @@ def make_train_step(cfg: llama.LlamaConfig,
     model-internal shard_map regions (ring attention, pipeline stages) can
     find it.
     """
-    loss_fn = loss_fn or llama.lm_loss
+    loss_fn = loss_fn or model_family(cfg).lm_loss
 
     def step(params, opt_state, batch):
         loss, grads = jax.value_and_grad(
@@ -104,15 +113,17 @@ def shard_batch(batch: Dict[str, jax.Array], mesh: Mesh) -> Dict[str, jax.Array]
 
 
 def auto_mesh(n_devices: int, devices=None, *, tp: Optional[int] = None,
-              sp: int = 1, pp: int = 1, dp: int = 1) -> Tuple[Mesh, MeshConfig]:
+              sp: int = 1, pp: int = 1, dp: int = 1, ep: int = 1
+              ) -> Tuple[Mesh, MeshConfig]:
     """A sensible layout for n devices: fsdp-dominant with a tp=min(4, n)
     inner axis when n allows — the FSDP+TP sweet spot at the 7B scale.
-    sp/pp carve off sequence/pipeline axes for long-context runs."""
+    sp/pp/ep carve off sequence/pipeline/expert axes."""
     if tp is None:
         tp = 1
         for cand in (4, 2):
-            if n_devices % (cand * sp * pp * dp) == 0 and n_devices >= cand * 2:
+            if (n_devices % (cand * sp * pp * dp * ep) == 0
+                    and n_devices >= cand * 2):
                 tp = cand
                 break
-    cfg = MeshConfig.for_devices(n_devices, tp=tp, sp=sp, pp=pp, dp=dp)
+    cfg = MeshConfig.for_devices(n_devices, tp=tp, sp=sp, pp=pp, dp=dp, ep=ep)
     return make_mesh(cfg, devices), cfg

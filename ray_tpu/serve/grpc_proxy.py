@@ -106,15 +106,17 @@ class GrpcProxyActor:
 
     def _call_sync(self, target: Tuple[str, str],
                    request_bytes: bytes) -> bytes:
+        from ray_tpu.exceptions import ActorError
+
         handle = self._handles.get(target) or self._resolve_handle(target)
         args, kwargs = cloudpickle.loads(request_bytes) \
             if request_bytes else ((), {})
         try:
             result = handle.remote(*args, **kwargs).result(timeout=120)
-        except Exception:
-            # The cached handle may target a DELETED/redeployed ingress —
-            # drop it, re-resolve through the controller, retry once
-            # (the HTTP proxy gets this for free from the routing table).
+        except (ActorError, TimeoutError):
+            # ROUTING failures only (dead/redeployed ingress, cold-start
+            # timeout): re-resolve and retry once. Application exceptions
+            # (TaskError from user code) must NOT re-execute side effects.
             self._handles.pop(target, None)
             handle = self._resolve_handle(target)
             result = handle.remote(*args, **kwargs).result(timeout=120)

@@ -67,17 +67,46 @@ class RayBackend(ParallelBackendBase):
         ref = _run_batch.remote(func)
         fut = _TaskFuture(ref)
         if callback is not None:
-            # joblib's completion callback drives its dispatch window
-            def _done(r=ref):
-                try:
-                    ray_tpu.wait([r], num_returns=1)
-                finally:
-                    callback(fut)
-
-            import threading
-
-            threading.Thread(target=_done, daemon=True).start()
+            # joblib's completion callback drives its dispatch window; ONE
+            # waiter thread drains all in-flight batches (a thread per
+            # batch would mean thousands of parked OS threads on large
+            # Parallel runs)
+            self._enqueue_wait(ref, fut, callback)
         return fut
+
+    def _enqueue_wait(self, ref, fut, callback) -> None:
+        import queue
+        import threading
+
+        if getattr(self, "_waitq", None) is None:
+            self._waitq: "queue.Queue" = queue.Queue()
+
+            def drain():
+                pending = {}
+                while True:
+                    block = not pending
+                    try:
+                        item = self._waitq.get(block=block, timeout=None
+                                               if block else 0)
+                        if item is None:
+                            return
+                        pending[item[0]] = item
+                    except queue.Empty:
+                        pass
+                    if pending:
+                        ready, _ = ray_tpu.wait(list(pending),
+                                                num_returns=1, timeout=1.0)
+                        for r in ready:
+                            _, f, cb = pending.pop(r)
+                            try:
+                                cb(f)
+                            except Exception:  # noqa: BLE001
+                                pass
+
+            self._wait_thread = threading.Thread(
+                target=drain, daemon=True, name="rt-joblib-wait")
+            self._wait_thread.start()
+        self._waitq.put((ref, fut, callback))
 
     def retrieve_result_callback(self, out):
         return out.get() if isinstance(out, _TaskFuture) else out
@@ -88,7 +117,9 @@ class RayBackend(ParallelBackendBase):
         return SequentialBackend(nesting_level=1), None
 
     def terminate(self) -> None:
-        pass
+        if getattr(self, "_waitq", None) is not None:
+            self._waitq.put(None)  # waiter thread exits
+            self._waitq = None
 
     def abort_everything(self, ensure_ready: bool = True) -> None:
         pass

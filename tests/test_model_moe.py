@@ -1,0 +1,152 @@
+"""Sparse-MoE model family: routing semantics + expert-parallel sharding.
+
+Static top-k capacity dispatch must be exact where capacity allows, drop
+overflow tokens (residual carries them), balance via the aux loss, and
+train sharded over the mesh's ``ep`` axis.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_tpu.models import llama, moe
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    return moe.PRESETS["moe-debug"]
+
+
+def test_moe_forward_backward_finite(cfg):
+    params = moe.init_params(jax.random.key(0), cfg)
+    tokens = jax.random.randint(jax.random.key(1), (2, 33), 0,
+                                cfg.vocab_size)
+    loss, grads = jax.value_and_grad(
+        lambda p: moe.lm_loss(p, {"tokens": tokens}, cfg))(params)
+    assert np.isfinite(float(loss))
+    leaves = jax.tree_util.tree_leaves(grads)
+    assert all(bool(jnp.isfinite(g).all()) for g in leaves)
+    # the router and experts actually receive gradient
+    assert float(jnp.linalg.norm(grads["layers"]["router"])) > 0
+    assert float(jnp.linalg.norm(grads["layers"]["e_gate"])) > 0
+
+
+def test_moe_dispatch_identity_with_ample_capacity(cfg):
+    """With top_k=1 and capacity >= all tokens, every token's MoE output
+    must equal ITS OWN chosen expert's dense FFN on that token — dispatch
+    and combine are exact, not approximate."""
+    c = dataclasses.replace(cfg, n_layers=1, top_k=1, capacity_factor=8.0)
+    params = moe.init_params(jax.random.key(0), c)
+    layer = jax.tree_util.tree_map(lambda x: x[0], params["layers"])
+
+    h = jax.random.normal(jax.random.key(3), (2, 8, c.d_model),
+                          c.compute_dtype)
+    out, _ = moe._moe_ffn(c, h, layer)
+
+    tokens = h.reshape(-1, c.d_model)
+    logits = tokens @ layer["router"].astype(jnp.float32)
+    chosen = np.asarray(jnp.argmax(logits, axis=-1))
+    o = np.asarray(out.reshape(-1, c.d_model), np.float32)
+    for g in range(tokens.shape[0]):
+        e = int(chosen[g])
+        t = tokens[g][None, :]
+        gate = jax.nn.silu(t @ layer["e_gate"][e].astype(t.dtype))
+        up = t @ layer["e_up"][e].astype(t.dtype)
+        dense = np.asarray((gate * up) @ layer["e_down"][e].astype(t.dtype),
+                           np.float32)[0]
+        np.testing.assert_allclose(o[g], dense, rtol=3e-2, atol=3e-2)
+
+
+def test_moe_capacity_overflow_drops_tokens(cfg):
+    """Tiny capacity: overflowed tokens contribute ZERO FFN output (the
+    block's residual carries them) — never garbage."""
+    c = dataclasses.replace(cfg, n_layers=1, top_k=1, capacity_factor=0.01)
+    params = moe.init_params(jax.random.key(0), c)
+    layer = jax.tree_util.tree_map(lambda x: x[0], params["layers"])
+    router = np.zeros_like(np.asarray(layer["router"], np.float32))
+    router[:, 1] = 100.0  # everyone wants expert 1; capacity ~1 slot
+    layer = dict(layer)
+    layer["router"] = jnp.asarray(router, layer["router"].dtype)
+
+    h = jax.random.normal(jax.random.key(3), (1, 16, c.d_model),
+                          c.compute_dtype)
+    out, _ = moe._moe_ffn(c, h, layer)
+    flat = np.asarray(out.reshape(16, -1), np.float32)
+    zero_rows = (np.abs(flat).max(axis=1) < 1e-6).sum()
+    assert zero_rows >= 14  # ~1 slot served, rest dropped
+
+
+def test_moe_aux_loss_prefers_balance(cfg):
+    """Aux loss is minimal (=1) under a uniform router and larger under a
+    collapsed one."""
+    c = dataclasses.replace(cfg, n_layers=1)
+    params = moe.init_params(jax.random.key(0), c)
+    layer = jax.tree_util.tree_map(lambda x: x[0], params["layers"])
+    h = jax.random.normal(jax.random.key(5), (2, 32, c.d_model),
+                          c.compute_dtype)
+
+    uniform = dict(layer)
+    uniform["router"] = jnp.zeros_like(layer["router"])
+    _, aux_uniform = moe._moe_ffn(c, h, uniform)
+
+    collapsed = dict(layer)
+    r = np.zeros_like(np.asarray(layer["router"], np.float32))
+    r[:, 0] = 100.0
+    collapsed["router"] = jnp.asarray(r, layer["router"].dtype)
+    _, aux_collapsed = moe._moe_ffn(c, h, collapsed)
+
+    assert float(aux_collapsed) > float(aux_uniform)
+    assert abs(float(aux_uniform) - 1.0) < 0.2
+
+
+def test_moe_sharded_train_step_ep_axis(cfg):
+    """Full sharded train step on the 8-device CPU mesh with ep=2:
+    expert-parallel state + a real optimizer update."""
+    from ray_tpu.parallel import train_step as ts
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device CPU mesh")
+    mesh, _ = ts.auto_mesh(8, tp=2, ep=2)
+    optimizer = ts.default_optimizer(total_steps=10)
+    params, opt_state = ts.init_sharded_state(
+        jax.random.key(0), cfg, mesh, optimizer)
+    # expert dim is genuinely sharded over ep
+    spec = params["layers"]["e_gate"].sharding.spec
+    assert "ep" in str(spec)
+    step = ts.make_train_step(cfg, optimizer, mesh=mesh)
+    tokens = jax.random.randint(jax.random.key(1), (8, 33), 0,
+                                cfg.vocab_size)
+    batch = ts.shard_batch({"tokens": tokens}, mesh)
+    losses = []
+    for _ in range(3):  # step 1 is a warmup-LR no-op (schedule starts at 0)
+        params, opt_state, metrics = step(params, opt_state, batch)
+        losses.append(float(metrics["loss"]))
+    assert all(np.isfinite(l) for l in losses)
+    # warmup-LR adam on one batch need not descend monotonically, but the
+    # update must have APPLIED: the loss moves once lr > 0
+    assert losses[2] != losses[1]
+
+
+def test_moe_param_counts(cfg):
+    params = moe.init_params(jax.random.key(0), cfg)
+    actual = sum(int(np.prod(x.shape))
+                 for x in jax.tree_util.tree_leaves(params))
+    assert actual == cfg.num_params()
+    assert cfg.active_params() < cfg.num_params()
+
+
+def test_llama_loss_unchanged_after_ce_refactor():
+    """chunked_ce extraction must preserve llama's loss values (chunked ==
+    unchunked paths)."""
+    cfg = llama.PRESETS["debug"]
+    params = llama.init_params(jax.random.key(0), cfg)
+    tokens = jax.random.randint(jax.random.key(1), (2, 65), 0,
+                                cfg.vocab_size)
+    full = llama.lm_loss(params, {"tokens": tokens}, cfg)
+    chunked = llama.lm_loss(
+        params, {"tokens": tokens},
+        dataclasses.replace(cfg, loss_chunk=16))
+    np.testing.assert_allclose(float(full), float(chunked), rtol=1e-5)
