@@ -235,6 +235,9 @@ class Dataset:
     def iter_jax_batches(self, **kwargs) -> Iterator[Dict[str, Any]]:
         return self.iterator().iter_jax_batches(**kwargs)
 
+    def iter_torch_batches(self, **kwargs) -> Iterator[Dict[str, Any]]:
+        return self.iterator().iter_torch_batches(**kwargs)
+
     def iterator(self) -> DataIterator:
         return DataIterator(self._execute_refs)
 

@@ -140,6 +140,30 @@ class DataIterator:
         for blk in self._blocks():
             yield from B.iter_rows(blk)
 
+    def iter_torch_batches(self, *, batch_size: int = 256,
+                           drop_last: bool = False, dtypes=None,
+                           device: Optional[str] = None,
+                           prefetch_batches: int = 2
+                           ) -> Iterator[Dict[str, Any]]:
+        """Batches as torch tensors (reference: ``iter_torch_batches``) —
+        the feed path for TorchTrainer loops."""
+        import torch
+
+        for batch in self.iter_batches(batch_size=batch_size,
+                                       drop_last=drop_last,
+                                       prefetch_batches=prefetch_batches):
+            out = {}
+            for k, v in batch.items():
+                t = torch.as_tensor(v)
+                if dtypes is not None:
+                    dt = dtypes.get(k) if isinstance(dtypes, dict) else dtypes
+                    if dt is not None:
+                        t = t.to(dt)
+                if device is not None:
+                    t = t.to(device)
+                out[k] = t
+            yield out
+
     def iter_jax_batches(self, *, batch_size: int = 256,
                          drop_last: bool = True, dtype=None,
                          prefetch_batches: int = 2) -> Iterator[Dict[str, Any]]:

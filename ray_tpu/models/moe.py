@@ -11,8 +11,9 @@ chunked loss are shared with :mod:`ray_tpu.models.llama`.
 
 Routing (per token): softmax router logits -> top-k experts -> each chosen
 token takes a slot in its expert's capacity buffer
-(``capacity_factor * tokens / n_experts``); overflow tokens drop that
-expert (standard Switch behavior — the residual stream carries them).
+(``capacity_factor * tokens * top_k / n_experts`` slots per expert);
+overflow tokens drop that expert (standard Switch behavior — the residual
+stream carries them).
 Load-balancing aux loss: ``n_experts * sum_e(fraction_e * prob_e)``.
 """
 

@@ -29,6 +29,12 @@ from ray_tpu.rl.algorithms import (  # noqa: F401
     TD3Config,
 )
 from ray_tpu.rl.config import AlgorithmConfig  # noqa: F401
+from ray_tpu.rl.multi_agent import (  # noqa: F401
+    CoordinationGame,
+    MultiAgentEnv,
+    MultiAgentPPO,
+    register_multi_agent_env,
+)
 from ray_tpu.rl.env import (  # noqa: F401
     CartPole,
     EnvSpec,

@@ -65,6 +65,8 @@ class AlgorithmConfig:
     # offline RL (BC / MARWIL)
     offline_data: Any = None           # dict of arrays or ray_tpu.data Dataset
     beta: float = 1.0                  # MARWIL advantage temperature
+    # multi-agent
+    policy_mapping_fn: Any = None      # agent_id -> policy_id (None = identity)
     # resources
     num_tpus_per_learner: float = 0
     num_learners: int = 0              # 0 => learner runs in the algo process
@@ -125,6 +127,11 @@ class AlgorithmConfig:
             return self.train_batch_size
         return (max(1, self.num_env_runners) * self.num_envs_per_runner
                 * self.rollout_fragment_length)
+
+    def multi_agent(self, *, policy_mapping_fn=None) -> "AlgorithmConfig":
+        if policy_mapping_fn is not None:
+            self.policy_mapping_fn = policy_mapping_fn
+        return self
 
     def build(self):
         if self.algo_class is None:

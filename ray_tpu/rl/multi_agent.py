@@ -1,0 +1,222 @@
+"""Multi-agent RL: MultiAgentEnv protocol, policy mapping, multi-policy PPO.
+
+Reference analogs: ``rllib/env/multi_agent_env.py`` (``MultiAgentEnv``),
+``rllib/core/rl_module/marl_module.py`` (``MultiAgentRLModule`` — here a
+dict of per-policy param trees), and the ``policy_mapping_fn`` config
+(``algorithm_config.py`` ``multi_agent()``). Scope: simultaneous-move envs
+(every agent acts every step, shared episode termination) — the common
+cooperative/competitive matrix and particle settings; turn-based envs are
+out of scope.
+
+Per policy: an independent PPO learner (jitted clip-surrogate update).
+Rollouts are vectorized in-process; each agent's trajectory is routed to
+its policy's batch by ``policy_mapping_fn``, GAE computed per agent stream.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.rl import models
+from ray_tpu.rl.algorithms.ppo import make_ppo_loss
+from ray_tpu.rl.config import AlgorithmConfig
+from ray_tpu.rl.env import EnvSpec
+from ray_tpu.rl.env_runner import compute_gae
+from ray_tpu.rl.learner import Learner
+from ray_tpu.tune.trainable import Trainable
+
+
+class MultiAgentEnv:
+    """N vectorized copies of a simultaneous-move multi-agent episode.
+
+    - ``agents``: fixed agent-id list
+    - ``reset() -> {agent: obs [N, obs_dim]}``
+    - ``step({agent: actions [N]}) -> (obs, rewards, dones)`` where obs and
+      rewards are per-agent dicts and ``dones`` is [N] (shared termination;
+      done envs auto-reset).
+    """
+
+    agents: List[str]
+    spec: Dict[str, EnvSpec]
+    num_envs: int
+
+    def reset(self) -> Dict[str, np.ndarray]:
+        raise NotImplementedError
+
+    def step(self, actions: Dict[str, np.ndarray]):
+        raise NotImplementedError
+
+
+class CoordinationGame(MultiAgentEnv):
+    """Repeated 2-agent coordination: both pick one of K arms; reward 1 when
+    they MATCH on the current round's 'good' arm pair, 0 otherwise. The good
+    arm is observable, so coordinated policies reach reward ~1/step; random
+    play earns ~1/K^2. Episodes last ``horizon`` rounds."""
+
+    def __init__(self, num_envs: int = 8, k: int = 3, horizon: int = 16,
+                 seed: int = 0):
+        self.agents = ["a0", "a1"]
+        self.num_envs = num_envs
+        self.k = k
+        self.horizon = horizon
+        self._rng = np.random.default_rng(seed)
+        obs_dim = k + 1  # one-hot good arm + normalized round index
+        spec = EnvSpec(obs_dim=obs_dim, num_actions=k)
+        self.spec = {a: spec for a in self.agents}
+        self._t = np.zeros(num_envs, dtype=np.int64)
+        self._good = self._rng.integers(0, k, num_envs)
+
+    def _obs(self) -> Dict[str, np.ndarray]:
+        onehot = np.eye(self.k, dtype=np.float32)[self._good]
+        frac = (self._t / self.horizon).astype(np.float32)[:, None]
+        obs = np.concatenate([onehot, frac], axis=1)
+        return {a: obs.copy() for a in self.agents}
+
+    def reset(self) -> Dict[str, np.ndarray]:
+        self._t[:] = 0
+        self._good = self._rng.integers(0, self.k, self.num_envs)
+        return self._obs()
+
+    def step(self, actions: Dict[str, np.ndarray]):
+        a0, a1 = actions["a0"], actions["a1"]
+        hit = (a0 == self._good) & (a1 == self._good)
+        reward = hit.astype(np.float32)
+        self._t += 1
+        dones = self._t >= self.horizon
+        # next round's good arm; reset finished envs
+        self._good = self._rng.integers(0, self.k, self.num_envs)
+        self._t[dones] = 0
+        rewards = {a: reward.copy() for a in self.agents}
+        return self._obs(), rewards, dones
+
+
+_MA_ENVS: Dict[str, Callable[..., MultiAgentEnv]] = {
+    "coordination": CoordinationGame,
+}
+
+
+def register_multi_agent_env(name: str, ctor: Callable[..., MultiAgentEnv]):
+    _MA_ENVS[name] = ctor
+
+
+class MultiAgentPPO(Trainable):
+    """Independent-PPO over a policy map (reference: multi-agent PPO with
+    ``policy_mapping_fn``; 'independent' = no centralized critic — the
+    standard IPPO baseline)."""
+
+    def setup(self, config: Dict[str, Any]) -> None:
+        if "__algo_config" in config:
+            self.config: AlgorithmConfig = config["__algo_config"]
+        else:
+            self.config = AlgorithmConfig(algo_class=type(self))\
+                .update_from_dict(config)
+        cfg = self.config
+        ctor = _MA_ENVS[cfg.env] if isinstance(cfg.env, str) else cfg.env
+        self.env = ctor(num_envs=cfg.num_envs_per_runner,
+                        **(cfg.env_config or {}))
+        self.policy_mapping_fn = (cfg.policy_mapping_fn
+                                  or (lambda agent_id: agent_id))
+        self.policies = sorted({self.policy_mapping_fn(a)
+                                for a in self.env.agents})
+        self.learners: Dict[str, Learner] = {}
+        for i, pid in enumerate(self.policies):
+            spec = self.env.spec[next(
+                a for a in self.env.agents
+                if self.policy_mapping_fn(a) == pid)]
+            loss = make_ppo_loss(spec, cfg.clip_param, cfg.vf_coeff,
+                                 cfg.entropy_coeff)
+            params = models.init_policy(
+                jax.random.key(cfg.seed + i), spec, cfg.hidden)
+            self.learners[pid] = Learner(params, loss, cfg.lr,
+                                        grad_clip=cfg.grad_clip,
+                                        seed=cfg.seed + i)
+        self._key = jax.random.key(cfg.seed + 777)
+        self._obs = self.env.reset()
+        self._iteration_rewards: List[float] = []
+
+        # ONE jitted act per policy (the EnvRunner pattern): the rollout hot
+        # loop must not pay op-by-op dispatch for logits/sample/logp/value
+        @jax.jit
+        def _jit_act(params, obs, key):
+            logits = models.policy_logits(params, obs)
+            action = jax.random.categorical(key, logits)
+            logp = models.categorical_logp(logits, action)
+            value = models.value(params, obs)
+            return action, logp, value
+
+        self._jit_act = _jit_act
+
+    def _act(self, pid: str, obs: np.ndarray):
+        self._key, k = jax.random.split(self._key)
+        action, logp, value = self._jit_act(
+            self.learners[pid].get_params(), jnp.asarray(obs), k)
+        return (np.asarray(action), np.asarray(logp), np.asarray(value))
+
+    def step(self) -> Dict[str, Any]:
+        cfg = self.config
+        T, N = cfg.rollout_fragment_length, self.env.num_envs
+        agents = self.env.agents
+        buf = {a: {k: [] for k in
+                   ("obs", "actions", "logp", "values", "rewards", "dones")}
+               for a in agents}
+        for _ in range(T):
+            acts, steps = {}, {}
+            for a in agents:
+                pid = self.policy_mapping_fn(a)
+                action, logp, value = self._act(pid, self._obs[a])
+                steps[a] = (self._obs[a], action, logp, value)
+                acts[a] = action
+            next_obs, rewards, dones = self.env.step(acts)
+            for a in agents:
+                o, act, lp, val = steps[a]
+                b = buf[a]
+                b["obs"].append(o)
+                b["actions"].append(act)
+                b["logp"].append(lp)
+                b["values"].append(val)
+                b["rewards"].append(rewards[a])
+                b["dones"].append(dones)
+            self._obs = next_obs
+
+        metrics: Dict[str, Any] = {}
+        mean_rewards = []
+        per_policy: Dict[str, List[Dict[str, np.ndarray]]] = \
+            {pid: [] for pid in self.policies}
+        for a in agents:
+            pid = self.policy_mapping_fn(a)
+            b = {k: np.stack(v) for k, v in buf[a].items()}  # [T, N, ...]
+            last_value = np.asarray(models.value(
+                self.learners[pid].get_params(), jnp.asarray(self._obs[a])))
+            gae = compute_gae(
+                b["rewards"], b["values"], b["dones"], last_value,
+                cfg.gamma, cfg.lambda_)
+            adv, targets = gae["advantages"], gae["value_targets"]
+            flat = lambda x: x.reshape((T * N,) + x.shape[2:])  # noqa: E731
+            per_policy[pid].append({
+                "obs": flat(b["obs"]), "actions": flat(b["actions"]),
+                "logp": flat(b["logp"]), "advantages": flat(adv),
+                "value_targets": flat(targets)})
+            mean_rewards.append(float(b["rewards"].mean()))
+        for pid in self.policies:
+            batch = {k: np.concatenate([d[k] for d in per_policy[pid]])
+                     for k in per_policy[pid][0]}
+            m = self.learners[pid].update(
+                batch, num_epochs=cfg.num_epochs,
+                minibatch_size=cfg.minibatch_size,
+                seed=cfg.seed + self._iteration)
+            metrics.update({f"{pid}/{k}": v for k, v in m.items()})
+        metrics["reward_mean_per_step"] = float(np.mean(mean_rewards))
+        return metrics
+
+    # -- checkpointing --------------------------------------------------------
+    def save_checkpoint(self, checkpoint_dir: str) -> Optional[Dict]:
+        return {pid: jax.tree_util.tree_map(np.asarray, ln.get_params())
+                for pid, ln in self.learners.items()}
+
+    def load_checkpoint(self, checkpoint: Dict) -> None:
+        for pid, params in checkpoint.items():
+            self.learners[pid].set_params(params)

@@ -113,10 +113,11 @@ class GrpcProxyActor:
             if request_bytes else ((), {})
         try:
             result = handle.remote(*args, **kwargs).result(timeout=120)
-        except (ActorError, TimeoutError):
-            # ROUTING failures only (dead/redeployed ingress, cold-start
-            # timeout): re-resolve and retry once. Application exceptions
-            # (TaskError from user code) must NOT re-execute side effects.
+        except ActorError:
+            # Dead/redeployed ingress ONLY: re-resolve and retry once.
+            # Neither app exceptions (TaskError) nor timeouts retry — the
+            # first request may still be EXECUTING, and a retry would run
+            # user side effects twice.
             self._handles.pop(target, None)
             handle = self._resolve_handle(target)
             result = handle.remote(*args, **kwargs).result(timeout=120)

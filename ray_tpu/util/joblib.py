@@ -79,29 +79,35 @@ class RayBackend(ParallelBackendBase):
         import threading
 
         if getattr(self, "_waitq", None) is None:
-            self._waitq: "queue.Queue" = queue.Queue()
+            q: "queue.Queue" = queue.Queue()
+            self._waitq = q
 
-            def drain():
+            def drain(q=q):  # local ref: terminate() nulls the attribute
                 pending = {}
+                stopping = False
                 while True:
-                    block = not pending
+                    block = not pending and not stopping
                     try:
-                        item = self._waitq.get(block=block, timeout=None
-                                               if block else 0)
+                        item = q.get(block=block, timeout=None
+                                     if block else 0)
                         if item is None:
-                            return
-                        pending[item[0]] = item
+                            stopping = True  # finish pending, then exit
+                        else:
+                            pending[item[0]] = item
                     except queue.Empty:
                         pass
-                    if pending:
-                        ready, _ = ray_tpu.wait(list(pending),
-                                                num_returns=1, timeout=1.0)
-                        for r in ready:
-                            _, f, cb = pending.pop(r)
-                            try:
-                                cb(f)
-                            except Exception:  # noqa: BLE001
-                                pass
+                    if not pending:
+                        if stopping:
+                            return
+                        continue
+                    ready, _ = ray_tpu.wait(list(pending),
+                                            num_returns=1, timeout=1.0)
+                    for r in ready:
+                        _, f, cb = pending.pop(r)
+                        try:
+                            cb(f)
+                        except Exception:  # noqa: BLE001
+                            pass
 
             self._wait_thread = threading.Thread(
                 target=drain, daemon=True, name="rt-joblib-wait")

@@ -443,3 +443,15 @@ def test_preprocessors_end_to_end(rt_cluster):
     out = chain.fit_transform(ds).take_all()
     assert out[0]["features"].shape == (3,)
     assert not any(np.isnan(r["features"]).any() for r in out)
+
+
+def test_iter_torch_batches(rt_cluster):
+    import torch
+
+    ds = data.range(32)
+    batches = list(ds.iter_torch_batches(batch_size=8,
+                                         dtypes=torch.float32))
+    assert len(batches) == 4
+    assert batches[0]["id"].dtype == torch.float32
+    total = torch.cat([b["id"] for b in batches])
+    assert sorted(total.tolist()) == [float(i) for i in range(32)]

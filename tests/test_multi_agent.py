@@ -1,0 +1,72 @@
+"""Multi-agent RL: MultiAgentEnv protocol, policy mapping, IPPO learning.
+
+Reference analogs: ``rllib/env/multi_agent_env.py`` + multi-agent configs
+(``policy_mapping_fn``). The CoordinationGame gives a crisp learning
+signal: random play earns 1/k^2 per step, coordinated play ~1.
+"""
+
+import numpy as np
+import pytest
+
+from ray_tpu.rl import AlgorithmConfig, CoordinationGame, MultiAgentPPO
+
+
+def _config(**overrides):
+    cfg = AlgorithmConfig(algo_class=MultiAgentPPO)
+    cfg.env = "coordination"
+    cfg.num_envs_per_runner = 16
+    cfg.rollout_fragment_length = 64
+    cfg.lr = 3e-3
+    cfg.num_epochs = 4
+    cfg.minibatch_size = 256
+    for k, v in overrides.items():
+        setattr(cfg, k, v)
+    return cfg
+
+
+def test_env_protocol():
+    env = CoordinationGame(num_envs=4, k=3, horizon=5)
+    obs = env.reset()
+    assert set(obs) == {"a0", "a1"}
+    assert obs["a0"].shape == (4, 4)
+    good = np.argmax(obs["a0"][:, :3], axis=1)
+    nobs, rewards, dones = env.step({"a0": good, "a1": good})
+    assert rewards["a0"].tolist() == [1.0] * 4  # both matched the good arm
+    nobs, rewards, dones = env.step(
+        {"a0": np.zeros(4, np.int64), "a1": np.ones(4, np.int64)})
+    assert rewards["a1"].tolist() == [0.0] * 4  # mismatched agents
+
+
+@pytest.mark.slow
+def test_ippo_learns_coordination():
+    algo = _config().build()
+    first = algo.step()["reward_mean_per_step"]
+    last = 0.0
+    for _ in range(25):
+        last = algo.step()["reward_mean_per_step"]
+    assert last > 0.6, (first, last)
+
+
+def test_shared_policy_mapping():
+    """policy_mapping_fn collapsing both agents onto ONE policy: a single
+    learner trains on both agents' experience."""
+    cfg = _config().multi_agent(policy_mapping_fn=lambda a: "shared")
+    algo = cfg.build()
+    assert list(algo.learners) == ["shared"]
+    m = algo.step()
+    assert "shared/policy_loss" in m
+    assert np.isfinite(m["shared/policy_loss"])
+
+
+def test_multi_agent_checkpoint_roundtrip(tmp_path):
+    import jax
+
+    algo = _config().build()
+    algo.step()
+    ckpt = algo.save_checkpoint(str(tmp_path))
+    algo2 = _config().build()
+    algo2.load_checkpoint(ckpt)
+    for pid in algo.learners:
+        a = jax.tree_util.tree_leaves(algo.learners[pid].get_params())
+        b = jax.tree_util.tree_leaves(algo2.learners[pid].get_params())
+        assert all(np.allclose(x, y) for x, y in zip(a, b))
