@@ -957,6 +957,7 @@ class ClusterBackend(RuntimeBackend):
             "max_restarts": options.get("max_restarts", 0),
             "max_task_retries": options.get("max_task_retries", 0),
             "max_concurrency": options.get("max_concurrency") or 1,
+            "concurrency_groups": options.get("concurrency_groups") or {},
             "name": options.get("name"),
             "namespace": options.get("namespace") or self.namespace,
             "lifetime": options.get("lifetime"),
@@ -1073,6 +1074,15 @@ class ClusterBackend(RuntimeBackend):
                     f"connection lost during {method_name!r} (actor died or "
                     f"restarting); set max_task_retries to retry actor tasks")
                 blob = self.serde.serialize(err).to_bytes()
+                for r in refs:
+                    self.memory_store.put(r.hex(), blob)
+                return
+            except Exception as e:  # noqa: BLE001 — worker-side RPC error
+                # e.g. concurrency-group validation, misrouted method: the
+                # server errored the call. This coroutine is fire-and-forget,
+                # so an uncaught raise would STRAND the caller's refs — the
+                # error must flow into them instead.
+                blob = self.serde.serialize(e).to_bytes()
                 for r in refs:
                     self.memory_store.put(r.hex(), blob)
                 return

@@ -46,7 +46,7 @@ class WorkerProcess:
         # Actor state
         self._actor_instance: Any = None
         self._actor_id: Optional[str] = None
-        self._actor_queue: Optional[asyncio.Queue] = None
+        self._actor_queues: Dict[str, asyncio.Queue] = {}
         self._actor_threads: Optional[ThreadPoolExecutor] = None
 
     def start(self) -> None:
@@ -232,14 +232,32 @@ class WorkerProcess:
         loop = asyncio.get_running_loop()
         self._actor_id = spec["actor_id"]
         max_conc = spec.get("max_concurrency", 1)
-        self._actor_queue = asyncio.Queue()
+        # Concurrency groups (reference: ConcurrencyGroupManager,
+        # ``concurrency_group_manager.h``): each named group gets its own
+        # arrival-ordered queue + consumer pool, so a saturated "compute"
+        # group can't starve "io" methods. The default group runs
+        # max_concurrency consumers; method->group routing is read off the
+        # loaded class (``@ray_tpu.method(concurrency_group=...)``).
+        groups = dict(spec.get("concurrency_groups") or {})
+        for name, n in groups.items():
+            if not isinstance(n, int) or n < 1:
+                return {"ok": False,
+                        "error": f"concurrency_groups[{name!r}] must be a "
+                                 f"positive int, got {n!r}"}
+        # "_default" may be user-sized (the documented spelling for sizing
+        # the default pool); otherwise it runs max_concurrency consumers
+        groups.setdefault("_default", max_conc)
+        self._actor_queues = {g: asyncio.Queue() for g in groups}
+        self._method_groups: Dict[str, str] = {}
+        total_threads = sum(groups.values())
         self._actor_threads = ThreadPoolExecutor(
-            max_workers=max_conc, thread_name_prefix="rt-actor")
+            max_workers=max(1, total_threads), thread_name_prefix="rt-actor")
         from ray_tpu.cluster.rpc import spawn_task
 
         # strong refs: a GC'd consumer would strand queued calls forever
-        self._consumer_tasks = [spawn_task(self._actor_consumer())
-                                for _ in range(max_conc)]
+        self._consumer_tasks = [
+            spawn_task(self._actor_consumer(self._actor_queues[g]))
+            for g, n in groups.items() for _ in range(n)]
 
         def build():
             from ray_tpu.core.worker import global_worker
@@ -258,9 +276,9 @@ class WorkerProcess:
             traceback.print_exc()
             return {"ok": False, "error": f"__init__ failed: {e!r}"}
 
-    async def _actor_consumer(self) -> None:
+    async def _actor_consumer(self, q: asyncio.Queue) -> None:
         while True:
-            coro, fut = await self._actor_queue.get()
+            coro, fut = await q.get()
             try:
                 result = await coro
                 if not fut.done():
@@ -269,10 +287,26 @@ class WorkerProcess:
                 if not fut.done():
                     fut.set_exception(e)
 
+    def _queue_for(self, method_name: str) -> asyncio.Queue:
+        group = self._method_groups.get(method_name)
+        if group is None:
+            fn = getattr(type(self._actor_instance), method_name, None)
+            group = getattr(fn, "_concurrency_group", "_default")
+            if group not in self._actor_queues:
+                # loud: a typo'd group would silently lose the isolation
+                # the user configured (reference errors at submission too)
+                raise ValueError(
+                    f"method {method_name!r} names concurrency group "
+                    f"{group!r}, but the actor declared "
+                    f"{sorted(g for g in self._actor_queues if g != '_default')}")
+            self._method_groups[method_name] = group
+        return self._actor_queues[group]
+
     async def rpc_actor_call(self, p):
         loop = asyncio.get_running_loop()
         fut = loop.create_future()
-        await self._actor_queue.put((self._run_actor_method(p), fut))
+        await self._queue_for(p["method"]).put(
+            (self._run_actor_method(p), fut))
         return await fut
 
     async def _run_actor_method(self, p) -> Dict:

@@ -143,11 +143,16 @@ class ActorClass:
         return self._cls
 
 
-def method(*, num_returns: int = 1):
-    """Per-method options decorator (reference: ``ray.method``)."""
+def method(*, num_returns: int = 1,
+           concurrency_group: Optional[str] = None):
+    """Per-method options decorator (reference: ``ray.method``).
+    ``concurrency_group`` names one of the actor's declared
+    ``concurrency_groups`` pools (reference: ConcurrencyGroupManager)."""
 
     def deco(fn):
         fn._num_returns = num_returns
+        if concurrency_group is not None:
+            fn._concurrency_group = concurrency_group
         return fn
 
     return deco

@@ -155,12 +155,10 @@ class DataIterator:
             out = {}
             for k, v in batch.items():
                 t = torch.as_tensor(v)
-                if dtypes is not None:
-                    dt = dtypes.get(k) if isinstance(dtypes, dict) else dtypes
-                    if dt is not None:
-                        t = t.to(dt)
-                if device is not None:
-                    t = t.to(device)
+                dt = (dtypes.get(k) if isinstance(dtypes, dict) else dtypes) \
+                    if dtypes is not None else None
+                if dt is not None or device is not None:
+                    t = t.to(device=device, dtype=dt)  # one cast+transfer
                 out[k] = t
             yield out
 

@@ -136,7 +136,6 @@ class MultiAgentPPO(Trainable):
                                         seed=cfg.seed + i)
         self._key = jax.random.key(cfg.seed + 777)
         self._obs = self.env.reset()
-        self._iteration_rewards: List[float] = []
 
         # ONE jitted act per policy (the EnvRunner pattern): the rollout hot
         # loop must not pay op-by-op dispatch for logits/sample/logp/value

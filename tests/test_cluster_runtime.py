@@ -284,3 +284,51 @@ def test_worker_logs_stream_to_driver(rt_cluster, capfd):
             return
         time.sleep(0.3)
     raise AssertionError(f"worker log never reached driver: {seen[-500:]}")
+
+
+def test_actor_concurrency_groups(rt_cluster):
+    """Named concurrency groups isolate method pools (reference:
+    ConcurrencyGroupManager): a saturated compute group must not block io
+    methods, while same-group calls still queue behind each other."""
+    @ray_tpu.remote(concurrency_groups={"io": 2, "compute": 1})
+    class Worker:
+        @ray_tpu.method(concurrency_group="compute")
+        def crunch(self):
+            time.sleep(1.5)
+            return "crunched"
+
+        @ray_tpu.method(concurrency_group="io")
+        def ping(self):
+            return "pong"
+
+    w = Worker.remote()
+    slow = w.crunch.remote()
+    time.sleep(0.2)  # let crunch occupy its group's single consumer
+    t0 = time.time()
+    assert ray_tpu.get(w.ping.remote(), timeout=10) == "pong"
+    io_latency = time.time() - t0
+    assert io_latency < 1.0, f"io method starved: {io_latency:.2f}s"
+    assert ray_tpu.get(slow, timeout=10) == "crunched"
+
+
+def test_actor_concurrency_group_validation(rt_cluster):
+    """Undeclared group names error loudly; zero-size groups are rejected at
+    creation (a 0-consumer queue would hang its callers forever)."""
+    @ray_tpu.remote(concurrency_groups={"io": 1})
+    class A:
+        @ray_tpu.method(concurrency_group="oi")  # typo
+        def m(self):
+            return 1
+
+    a = A.remote()
+    with pytest.raises(Exception, match="concurrency group"):
+        ray_tpu.get(a.m.remote(), timeout=20)
+
+    @ray_tpu.remote(concurrency_groups={"bad": 0})
+    class B:
+        def m(self):
+            return 1
+
+    b = B.remote()
+    with pytest.raises(Exception, match="positive int"):
+        ray_tpu.get(b.m.remote(), timeout=30)
