@@ -313,6 +313,13 @@ class ServeController:
         s.next_replica_idx += 1
         opts = dict(s.config.ray_actor_options or {})
         opts.setdefault("num_cpus", 0.1)
+        # replicas spread across nodes by default (reference:
+        # SpreadDeploymentSchedulingPolicy) — one node dying must not take a
+        # whole deployment's replica set with it
+        if "scheduling_strategy" not in opts:
+            from ray_tpu.core.task_spec import SpreadStrategy
+
+            opts["scheduling_strategy"] = SpreadStrategy()
         opts["max_concurrency"] = max(16, s.config.max_ongoing_requests + 4)
         opts["name"] = f"RT_SERVE:{rid}"
         handle = ReplicaActor.options(**opts).remote(

@@ -125,3 +125,26 @@ def test_multinode_object_transfer(cluster):
         return float(x.sum())
 
     assert ray_tpu.get(consume.remote(produce.remote()), timeout=60) == 300_000.0
+
+
+def test_serve_replicas_spread_across_nodes(cluster):
+    """Serve replicas default to SPREAD placement (reference:
+    SpreadDeploymentSchedulingPolicy): on a 2-node cluster a 2-replica
+    deployment lands one replica per node."""
+    from ray_tpu import serve
+
+    cluster.add_node(num_cpus=4)
+    cluster.connect_driver()
+    try:
+        @serve.deployment(num_replicas=2)
+        def who(x=None):
+            import os
+
+            return os.environ.get("RT_NODE_ID", "?")
+
+        handle = serve.run(who.bind(), name="spread_app", route_prefix=None)
+        nodes = {handle.remote().result(timeout=30) for _ in range(20)}
+        assert len(nodes) == 2, f"replicas not spread: {nodes}"
+    finally:
+        serve.shutdown()
+        serve._forget_controller_for_tests()
