@@ -11,6 +11,7 @@ State API), ``dashboard/modules/metrics`` (Prometheus). Routes:
   GET /api/tasks                recent task events
   GET /api/objects              object directory
   GET /api/jobs                 submitted jobs
+  GET /api/serve/applications   serve app states
   GET /api/cluster_resources    total/available
   GET /metrics                  Prometheus text page
   GET /-/healthz                liveness
@@ -47,6 +48,7 @@ class DashboardActor:
         app.router.add_get("/api/objects", self._gcs_list("list_objects"))
         app.router.add_get("/api/cluster_resources", self._cluster_resources)
         app.router.add_get("/api/jobs", self._jobs)
+        app.router.add_get("/api/serve/applications", self._serve_apps)
         app.router.add_get("/metrics", self._metrics)
         self._runner = web.AppRunner(app, access_log=None)
         await self._runner.setup()
@@ -107,6 +109,22 @@ class DashboardActor:
         loop = asyncio.get_running_loop()
         jobs = await loop.run_in_executor(None, list_jobs)
         return web.json_response(jobs, dumps=_dumps)
+
+    async def _serve_apps(self, request):
+        """Serve application states (reference: dashboard serve module)."""
+        from aiohttp import web
+
+        def fetch():
+            from ray_tpu import serve
+
+            try:
+                return serve.status()
+            except RuntimeError:  # serve not running
+                return {}
+
+        loop = asyncio.get_running_loop()
+        out = await loop.run_in_executor(None, fetch)
+        return web.json_response(out, dumps=_dumps)
 
     async def _metrics(self, request):
         from aiohttp import web

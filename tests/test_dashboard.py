@@ -90,3 +90,30 @@ def test_timeline_export(rt_cluster, tmp_path):
     timeline(str(out))
     loaded = json.loads(out.read_text())
     assert isinstance(loaded, list) and loaded
+
+
+def test_dashboard_serve_applications(rt_cluster):
+    import requests
+
+    from ray_tpu import serve
+    from ray_tpu.dashboard import start_dashboard
+
+    port = start_dashboard(port=0)
+    base = f"http://127.0.0.1:{port}"
+    # before serve starts: empty dict, not an error
+    assert requests.get(f"{base}/api/serve/applications",
+                        timeout=10).json() == {}
+
+    @serve.deployment
+    def f(x=None):
+        return 1
+
+    serve.run(f.bind(), name="dash_app", route_prefix=None)
+    try:
+        apps = requests.get(f"{base}/api/serve/applications",
+                            timeout=10).json()
+        assert "dash_app" in apps
+        assert "deployments" in apps["dash_app"]
+    finally:
+        serve.shutdown()
+        serve._forget_controller_for_tests()
