@@ -206,3 +206,34 @@ def test_gcs_kv_wal_str_and_bytes_roundtrip(tmp_path):
         await g2.stop()
 
     asyncio.run(run())
+
+
+@pytest.mark.slow
+def test_large_object_transfer_under_small_store(monkeypatch):
+    """A 512MB object crosses nodes with a 128MB store cap: the source
+    spills it, chunks serve from the spill file, the destination restores
+    under its own cap — bounded memory end to end (reference envelope:
+    the 1 GiB broadcast in BASELINE.md, scaled to CI time)."""
+    monkeypatch.setenv("RT_OBJECT_STORE_MEMORY_BYTES", str(128 * 1024 * 1024))
+    config_mod.reset_config_for_tests()
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    from ray_tpu.cluster.cluster_utils import Cluster
+
+    cluster = Cluster(head_node_args={"num_cpus": 2})
+    cluster.add_node(num_cpus=2, resources={"side": 1})
+    cluster.connect_driver()
+    try:
+        arr = np.arange(128 * 1024 * 1024, dtype=np.float32)  # 512MB
+        ref = ray_tpu.put(arr)
+
+        @ray_tpu.remote(resources={"side": 1})
+        def consume(got):
+            return float(got[::65536].sum()), got.shape[0]
+
+        total, n = ray_tpu.get(consume.remote(ref), timeout=600)
+        assert n == arr.shape[0]
+        assert total == float(arr[::65536].sum())
+    finally:
+        cluster.shutdown()
+        config_mod.reset_config_for_tests()
