@@ -1,0 +1,164 @@
+"""Autoregressive generation with a static KV cache — the inference path.
+
+TPU-first decode (no reference counterpart — Ray ships no model code; this
+is the standard JAX recipe): the cache is a STATIC [L, B, max_len, kv_heads,
+head_dim] buffer written with ``dynamic_update_slice``, prefill runs the
+whole prompt as one batched forward (MXU-friendly), and the decode loop is
+a single ``lax.scan`` over steps — one compiled program regardless of how
+many tokens are generated. Causality over the not-yet-written cache tail
+falls out of ``mha(q_offset=pos)``'s mask. GQA works unchanged (the cache
+holds kv heads).
+
+Works for both model families: llama densely, MoE via its block functions
+(each family exposes ``cache_block``-compatible attention weights).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.models import llama
+from ray_tpu.ops.attention import mha
+from ray_tpu.ops.norms import rmsnorm
+from ray_tpu.ops.rope import apply_rope, rope_angles
+
+Params = Dict[str, Any]
+
+
+def init_cache(cfg: llama.LlamaConfig, batch: int, max_len: int) -> Dict:
+    """Zeroed KV cache [L, B, max_len, kv_heads, head_dim] (compute dtype)."""
+    shape = (cfg.n_layers, batch, max_len, cfg.n_kv_heads, cfg.head_dim)
+    return {"k": jnp.zeros(shape, cfg.compute_dtype),
+            "v": jnp.zeros(shape, cfg.compute_dtype)}
+
+
+def _block_with_cache(cfg, x, layer, cache_k, cache_v, sin, cos, pos):
+    """One decoder block over [B, S, d] at absolute position ``pos``,
+    reading/writing the layer's [B, max_len, hkv, hd] cache slices.
+    Returns (hidden, new_cache_k, new_cache_v)."""
+    b, s, d = x.shape
+    hq, hkv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    cdt = cfg.compute_dtype
+
+    if cfg.attn_impl in ("ring", "ulysses"):
+        raise NotImplementedError(
+            f"decode with attn_impl={cfg.attn_impl!r} (sequence-parallel "
+            f"attention) is not supported — single-token decode has no "
+            f"sequence to shard. 'flash' and 'xla' configs both decode via "
+            f"the einsum path (same math; the pallas kernel is a "
+            f"long-sequence training implementation).")
+    h = rmsnorm(x, layer["attn_norm"].astype(cdt), cfg.norm_eps)
+    positions = pos + jnp.arange(s)[None, :]  # [1, s] broadcasts over batch
+    positions = jnp.broadcast_to(positions, (b, s))
+    q = apply_rope((h @ layer["wq"].astype(cdt)).reshape(b, s, hq, hd),
+                   sin, cos, positions)
+    k = apply_rope((h @ layer["wk"].astype(cdt)).reshape(b, s, hkv, hd),
+                   sin, cos, positions)
+    v = (h @ layer["wv"].astype(cdt)).reshape(b, s, hkv, hd)
+    cache_k = jax.lax.dynamic_update_slice(cache_k, k, (0, pos, 0, 0))
+    cache_v = jax.lax.dynamic_update_slice(cache_v, v, (0, pos, 0, 0))
+    attn = mha(q, cache_k, cache_v, causal=True, q_offset=pos)
+    x = x + attn.reshape(b, s, hq * hd) @ layer["wo"].astype(cdt)
+
+    if "w_gate" in layer:  # dense llama FFN (shared ffn_half)
+        x = llama.ffn_half(cfg, x, layer)
+    else:  # MoE FFN: drop-free inference routing (shared ffn_half)
+        from ray_tpu.models import moe
+
+        x, _ = moe.ffn_half(cfg, x, layer, drop_free=True)
+    return x, cache_k, cache_v
+
+
+def _forward_with_cache(params: Params, tokens: jax.Array,
+                        cfg, cache: Dict, pos,
+                        last_only: bool = True) -> Tuple[jax.Array, Dict]:
+    """tokens [B, S] at absolute position ``pos`` -> (logits, updated
+    cache). ``last_only`` projects ONLY the final position to the vocab —
+    generation never needs the full [B, S, V] prefill logits, which at 32k
+    vocab would dominate HBM (the same blowup llama's loss_chunk avoids)."""
+    cdt = cfg.compute_dtype
+    x = params["embed"].astype(cdt)[tokens]
+    max_len = cache["k"].shape[2]
+    sin, cos = rope_angles(max_len, cfg.head_dim, cfg.rope_theta, cdt)
+
+    def body(carry, sl):
+        x = carry
+        layer, ck, cv = sl
+        x, ck, cv = _block_with_cache(cfg, x, layer, ck, cv, sin, cos, pos)
+        return x, (ck, cv)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        body, x, (params["layers"], cache["k"], cache["v"]))
+    if last_only:
+        x = x[:, -1:, :]
+    x = rmsnorm(x, params["final_norm"].astype(cdt), cfg.norm_eps)
+    head = (params["embed"].T if cfg.tie_embeddings
+            else params["lm_head"]).astype(cdt)
+    logits = (x @ head).astype(jnp.float32)
+    return logits, {"k": new_k, "v": new_v}
+
+
+def generate(params: Params, prompt: jax.Array, cfg,
+             *, max_new_tokens: int, temperature: float = 0.0,
+             top_k: Optional[int] = None,
+             key: Optional[jax.Array] = None,
+             max_len: Optional[int] = None) -> jax.Array:
+    """prompt [B, S] -> generated tokens [B, max_new_tokens].
+
+    ``temperature == 0``: greedy. Otherwise softmax sampling (optionally
+    top-k truncated) with ``key``. The whole loop is one jit: prefill +
+    ``lax.scan`` over decode steps.
+    """
+    b, s = prompt.shape
+    total = max_len or (s + max_new_tokens)
+    if total < s + max_new_tokens:
+        raise ValueError(f"max_len {total} < prompt {s} + new {max_new_tokens}")
+    if temperature > 0 and key is None:
+        key = jax.random.key(0)
+    run = _compiled_generate(cfg, b, s, total, max_new_tokens,
+                             float(temperature), top_k)
+    return run(params, prompt, key)
+
+
+@functools.lru_cache(maxsize=64)
+def _compiled_generate(cfg, b: int, s: int, total: int, max_new_tokens: int,
+                       temperature: float, top_k: Optional[int]):
+    """One compiled program per (config, shapes, sampling) — repeat calls
+    (the serve per-request path) hit jit's cache instead of re-tracing.
+    Configs are frozen dataclasses, hence hashable cache keys."""
+
+    @jax.jit
+    def run(params, prompt, key):
+        cache = init_cache(cfg, b, total)
+        logits, cache = _forward_with_cache(params, prompt, cfg, cache, 0)
+        last = logits[:, -1, :]
+
+        def pick(logits, k):
+            if temperature <= 0:
+                return jnp.argmax(logits, axis=-1)
+            scaled = logits / temperature
+            if top_k is not None:
+                kth = jnp.sort(scaled, axis=-1)[:, -top_k][:, None]
+                scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
+            return jax.random.categorical(k, scaled)
+
+        def step(carry, i):
+            cache, last_logits, key = carry
+            if key is not None:
+                key, sub = jax.random.split(key)
+            else:
+                sub = None
+            tok = pick(last_logits, sub)
+            logits, cache = _forward_with_cache(
+                params, tok[:, None], cfg, cache, s + i)
+            return (cache, logits[:, -1, :], key), tok
+
+        (_, _, _), toks = jax.lax.scan(
+            step, (cache, last, key), jnp.arange(max_new_tokens))
+        return toks.swapaxes(0, 1)  # [B, T]
+
+    return run

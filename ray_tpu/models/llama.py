@@ -154,17 +154,21 @@ def attention_half(cfg: LlamaConfig, x: jax.Array, layer: Params,
     return x + attn.reshape(b, s, hq * hd) @ layer["wo"].astype(cdt)
 
 
+def ffn_half(cfg: LlamaConfig, x: jax.Array, layer: Params) -> jax.Array:
+    """Pre-norm SwiGLU MLP + residual — shared by train and decode paths."""
+    cdt = cfg.compute_dtype
+    h = rmsnorm(x, layer["mlp_norm"].astype(cdt), cfg.norm_eps)
+    gate = jax.nn.silu(h @ layer["w_gate"].astype(cdt))
+    up = h @ layer["w_up"].astype(cdt)
+    return x + (gate * up) @ layer["w_down"].astype(cdt)
+
+
 def _block(cfg: LlamaConfig, x: jax.Array, layer: Params,
            sin: jax.Array, cos: jax.Array,
            segment_ids: Optional[jax.Array]) -> jax.Array:
     """One decoder block: pre-norm attn + pre-norm SwiGLU MLP."""
-    cdt = cfg.compute_dtype
     x = attention_half(cfg, x, layer, sin, cos, segment_ids)
-    h = rmsnorm(x, layer["mlp_norm"].astype(cdt), cfg.norm_eps)
-    gate = jax.nn.silu(h @ layer["w_gate"].astype(cdt))
-    up = h @ layer["w_up"].astype(cdt)
-    x = x + (gate * up) @ layer["w_down"].astype(cdt)
-    return x
+    return ffn_half(cfg, x, layer)
 
 
 def _pipelined_layers(layers: Params, x: jax.Array, cfg: LlamaConfig,

@@ -147,15 +147,25 @@ def _moe_ffn(cfg: MoEConfig, h: jax.Array, layer: Params
     return out.reshape(b, s, d), aux
 
 
+def ffn_half(cfg: MoEConfig, x: jax.Array, layer: Params,
+             drop_free: bool = False) -> Tuple[jax.Array, jax.Array]:
+    """Pre-norm MoE FFN + residual; returns (hidden, aux_loss).
+    ``drop_free``: capacity covers every selection (inference routing —
+    capacity drops are a training-time load-balancing construct)."""
+    c = (dataclasses.replace(cfg, capacity_factor=float(cfg.n_experts))
+         if drop_free else cfg)
+    h = llama.rmsnorm(x, layer["mlp_norm"].astype(cfg.compute_dtype),
+                      cfg.norm_eps)
+    ffn, aux = _moe_ffn(c, h, layer)
+    return x + ffn, aux
+
+
 def _moe_block(cfg: MoEConfig, x: jax.Array, layer: Params,
                sin: jax.Array, cos: jax.Array,
                segment_ids) -> Tuple[jax.Array, jax.Array]:
     """Shared llama attention half + MoE FFN; returns (hidden, aux_loss)."""
     x = llama.attention_half(cfg, x, layer, sin, cos, segment_ids)
-    h = llama.rmsnorm(x, layer["mlp_norm"].astype(cfg.compute_dtype),
-                      cfg.norm_eps)
-    ffn, aux = _moe_ffn(cfg, h, layer)
-    return x + ffn, aux
+    return ffn_half(cfg, x, layer)
 
 
 def forward_hidden(params: Params, tokens: jax.Array, cfg: MoEConfig,
