@@ -162,3 +162,61 @@ def _compiled_generate(cfg, b: int, s: int, total: int, max_new_tokens: int,
         return toks.swapaxes(0, 1)  # [B, T]
 
     return run
+
+
+@functools.lru_cache(maxsize=64)
+def _compiled_prefill(cfg, b: int, s: int, total: int):
+    @jax.jit
+    def run(params, prompt):
+        cache = init_cache(cfg, b, total)
+        logits, cache = _forward_with_cache(params, prompt, cfg, cache, 0)
+        return logits[:, -1, :], cache
+
+    return run
+
+
+@functools.lru_cache(maxsize=64)
+def _compiled_decode_step(cfg, b: int, total: int):
+    @jax.jit
+    def run(params, cache, tok, pos):
+        logits, cache = _forward_with_cache(
+            params, tok[:, None], cfg, cache, pos)
+        return logits[:, -1, :], cache
+
+    return run
+
+
+def generate_stream(params: Params, prompt: jax.Array, cfg,
+                    *, max_new_tokens: int, temperature: float = 0.0,
+                    top_k: Optional[int] = None,
+                    key: Optional[jax.Array] = None,
+                    max_len: Optional[int] = None):
+    """Yield tokens [B] one at a time — the serve token-streaming path.
+
+    Same math as ``generate`` but the decode loop runs in Python around a
+    cached jitted single-step, so each token is observable as soon as it's
+    sampled (a single fused scan can't stream). ``pos`` is a traced scalar:
+    one compiled step serves every position.
+    """
+    b, s = prompt.shape
+    total = max_len or (s + max_new_tokens)
+    if total < s + max_new_tokens:
+        raise ValueError(f"max_len {total} < prompt {s} + new {max_new_tokens}")
+    if temperature > 0 and key is None:
+        key = jax.random.key(0)
+
+    last, cache = _compiled_prefill(cfg, b, s, total)(params, prompt)
+    step = _compiled_decode_step(cfg, b, total)
+    for i in range(max_new_tokens):
+        if temperature <= 0:
+            tok = jnp.argmax(last, axis=-1)
+        else:
+            key, sub = jax.random.split(key)
+            scaled = last / temperature
+            if top_k is not None:
+                kth = jnp.sort(scaled, axis=-1)[:, -top_k][:, None]
+                scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
+            tok = jax.random.categorical(sub, scaled)
+        yield tok
+        if i + 1 < max_new_tokens:
+            last, cache = step(params, cache, tok, jnp.int32(s + i))

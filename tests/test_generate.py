@@ -107,3 +107,45 @@ def test_generation_behind_serve(rt_cluster):
     finally:
         serve.shutdown()
         serve._forget_controller_for_tests()
+
+
+def test_generate_stream_matches_generate(fp32_cfg):
+    cfg = fp32_cfg
+    params = llama.init_params(jax.random.key(0), cfg)
+    prompt = jax.random.randint(jax.random.key(1), (2, 6), 0,
+                                cfg.vocab_size)
+    batch_toks = np.asarray(generate.generate(params, prompt, cfg,
+                                              max_new_tokens=8))
+    streamed = [np.asarray(t) for t in generate.generate_stream(
+        params, prompt, cfg, max_new_tokens=8)]
+    assert len(streamed) == 8
+    np.testing.assert_array_equal(np.stack(streamed, axis=1), batch_toks)
+
+
+def test_token_streaming_behind_serve(rt_cluster):
+    """LLM token streaming end-to-end: a serve deployment yields tokens
+    incrementally through the streaming-response path."""
+    from ray_tpu import serve
+
+    @serve.deployment
+    class StreamLM:
+        def __init__(self):
+            self.cfg = dataclasses.replace(llama.PRESETS["debug"],
+                                           compute_dtype=jnp.float32)
+            self.params = llama.init_params(jax.random.key(0), self.cfg)
+
+        def __call__(self, prompt_ids):
+            prompt = jnp.asarray([prompt_ids], jnp.int32)
+            for tok in generate.generate_stream(self.params, prompt,
+                                                self.cfg, max_new_tokens=5):
+                yield int(np.asarray(tok)[0])
+
+    handle = serve.run(StreamLM.bind(), name="slm", route_prefix=None)
+    try:
+        gen = handle.remote([1, 2, 3]).result(timeout=180)
+        toks = list(gen)
+        assert len(toks) == 5
+        assert all(isinstance(t, int) for t in toks)
+    finally:
+        serve.shutdown()
+        serve._forget_controller_for_tests()
