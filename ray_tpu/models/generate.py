@@ -124,6 +124,19 @@ def generate(params: Params, prompt: jax.Array, cfg,
     return run(params, prompt, key)
 
 
+def _sample_token(last_logits, temperature: float, top_k: Optional[int],
+                  key):
+    """Greedy (temperature<=0) or temperature/top-k categorical sampling —
+    the ONE sampling rule shared by the fused and streaming decode paths."""
+    if temperature <= 0:
+        return jnp.argmax(last_logits, axis=-1)
+    scaled = last_logits / temperature
+    if top_k is not None:
+        kth = jnp.sort(scaled, axis=-1)[:, -top_k][:, None]
+        scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
+    return jax.random.categorical(key, scaled)
+
+
 @functools.lru_cache(maxsize=64)
 def _compiled_generate(cfg, b: int, s: int, total: int, max_new_tokens: int,
                        temperature: float, top_k: Optional[int]):
@@ -137,22 +150,13 @@ def _compiled_generate(cfg, b: int, s: int, total: int, max_new_tokens: int,
         logits, cache = _forward_with_cache(params, prompt, cfg, cache, 0)
         last = logits[:, -1, :]
 
-        def pick(logits, k):
-            if temperature <= 0:
-                return jnp.argmax(logits, axis=-1)
-            scaled = logits / temperature
-            if top_k is not None:
-                kth = jnp.sort(scaled, axis=-1)[:, -top_k][:, None]
-                scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
-            return jax.random.categorical(k, scaled)
-
         def step(carry, i):
             cache, last_logits, key = carry
             if key is not None:
                 key, sub = jax.random.split(key)
             else:
                 sub = None
-            tok = pick(last_logits, sub)
+            tok = _sample_token(last_logits, temperature, top_k, sub)
             logits, cache = _forward_with_cache(
                 params, tok[:, None], cfg, cache, s + i)
             return (cache, logits[:, -1, :], key), tok
@@ -209,14 +213,10 @@ def generate_stream(params: Params, prompt: jax.Array, cfg,
     step = _compiled_decode_step(cfg, b, total)
     for i in range(max_new_tokens):
         if temperature <= 0:
-            tok = jnp.argmax(last, axis=-1)
+            sub = None
         else:
             key, sub = jax.random.split(key)
-            scaled = last / temperature
-            if top_k is not None:
-                kth = jnp.sort(scaled, axis=-1)[:, -top_k][:, None]
-                scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
-            tok = jax.random.categorical(sub, scaled)
+        tok = _sample_token(last, temperature, top_k, sub)
         yield tok
         if i + 1 < max_new_tokens:
             last, cache = step(params, cache, tok, jnp.int32(s + i))

@@ -18,6 +18,7 @@ from ray_tpu.tune.search import (  # noqa: F401
     BasicVariantGenerator,
     ConcurrencyLimiter,
     QuasiRandomSearch,
+    TPESearcher,
     Searcher,
 )
 from ray_tpu.tune.search_space import (  # noqa: F401

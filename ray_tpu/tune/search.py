@@ -27,6 +27,23 @@ class Searcher:
     def __init__(self, metric: Optional[str] = None, mode: Optional[str] = None):
         self._metric = metric
         self._mode = mode
+        self._budget: Optional[int] = None  # TuneConfig.num_samples
+        self._issued = 0
+
+    def set_num_samples(self, n: int) -> None:
+        """Trial budget (TuneConfig.num_samples). The controller keeps
+        calling suggest() until it returns None — a searcher that never
+        exhausts would spin the trial loop forever. A budget set explicitly
+        at construction (e.g. QuasiRandomSearch(num_samples=...)) wins over
+        the TuneConfig default."""
+        if self._budget is None:
+            self._budget = n
+
+    def _take_budget(self) -> bool:
+        if self._budget is not None and self._issued >= self._budget:
+            return False
+        self._issued += 1
+        return True
 
     def set_search_properties(self, metric: Optional[str], mode: Optional[str],
                               config: Dict[str, Any]) -> bool:
@@ -88,21 +105,20 @@ class QuasiRandomSearch(Searcher):
     reference's external hyperopt/optuna adapters)."""
 
     def __init__(self, metric: Optional[str] = None, mode: Optional[str] = None,
-                 num_samples: int = 16, exploit_p: float = 0.5,
+                 num_samples: Optional[int] = None, exploit_p: float = 0.5,
                  min_observations: int = 4, seed: int = 0):
         super().__init__(metric, mode)
         self._rng = random.Random(seed)
+        # explicit ctor budget wins; None defers to TuneConfig.num_samples
         self._budget = num_samples
-        self._issued = 0
         self._exploit_p = exploit_p
         self._min_obs = min_observations
         self._observed: List[Dict[str, Any]] = []
         self._configs: Dict[str, Dict] = {}
 
     def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
-        if self._issued >= self._budget:
+        if not self._take_budget():
             return None
-        self._issued += 1
         space = getattr(self, "_space", {}) or {}
         best = self._best_config()
         cfg: Dict[str, Any] = {}
@@ -171,3 +187,126 @@ class ConcurrencyLimiter(Searcher):
     def on_trial_complete(self, trial_id, result=None, error=False) -> None:
         self._live.discard(trial_id)
         self._searcher.on_trial_complete(trial_id, result, error)
+
+
+class TPESearcher(Searcher):
+    """Tree-structured Parzen Estimator (native — the reference wraps
+    hyperopt for this; ``tune/search/hyperopt``). After ``n_initial``
+    random trials, observations split at the ``gamma`` quantile into
+    good/bad sets; numeric dims sample candidates from a KDE over the good
+    set and keep the candidate maximizing the good/bad density ratio;
+    categorical dims sample by smoothed good-set counts over bad-set
+    counts. Log-scaled domains model densities in log space."""
+
+    def __init__(self, metric: Optional[str] = None, mode: Optional[str] = None,
+                 n_initial: int = 10, gamma: float = 0.25,
+                 n_candidates: int = 24, seed: Optional[int] = None):
+        super().__init__(metric, mode)
+        self._n_initial = n_initial
+        self._gamma = gamma
+        self._n_candidates = n_candidates
+        self._rng = random.Random(seed)
+        self._live: Dict[str, Dict[str, Any]] = {}
+        self._obs: List[tuple] = []  # (config, score) — higher is better
+
+    # -- density helpers -----------------------------------------------------
+    @staticmethod
+    def _to_model_space(domain, v: float) -> float:
+        import math
+
+        return math.log(v) if getattr(domain, "log", False) else float(v)
+
+    @staticmethod
+    def _kde_logpdf(xs: List[float], x: float, bw: float) -> float:
+        import math
+
+        if not xs:
+            return 0.0
+        acc = 0.0
+        for mu in xs:
+            acc += math.exp(-0.5 * ((x - mu) / bw) ** 2)
+        return math.log(acc / (len(xs) * bw) + 1e-12)
+
+    def _suggest_numeric(self, name: str, domain, good: List[Dict],
+                         bad: List[Dict]):
+        import math
+
+        lo = self._to_model_space(domain, domain.lower)
+        hi = self._to_model_space(domain, max(domain.upper, domain.lower + 1e-12))
+        bw = max((hi - lo) / 5.0, 1e-6)
+        gx = [self._to_model_space(domain, c[name]) for c in good]
+        bx = [self._to_model_space(domain, c[name]) for c in bad]
+        best_v, best_score = None, -float("inf")
+        for _ in range(self._n_candidates):
+            if gx and self._rng.random() < 0.8:
+                center = self._rng.choice(gx)
+                x = self._rng.gauss(center, bw)
+                x = min(max(x, lo), hi)
+            else:
+                x = self._rng.uniform(lo, hi)
+            score = (self._kde_logpdf(gx, x, bw)
+                     - self._kde_logpdf(bx, x, bw))
+            if score > best_score:
+                best_score, best_v = score, x
+        v = math.exp(best_v) if getattr(domain, "log", False) else best_v
+        if isinstance(domain, Integer):
+            return max(domain.lower, min(int(round(v)), domain.upper - 1))
+        if getattr(domain, "q", None):
+            v = round(v / domain.q) * domain.q
+        return min(max(v, domain.lower), domain.upper)
+
+    def _suggest_categorical(self, name: str, domain, good, bad):
+        weights = []
+        for choice in domain.categories:
+            g = sum(1 for c in good if c[name] == choice) + 1.0
+            b = sum(1 for c in bad if c[name] == choice) + 1.0
+            weights.append(g / b)
+        total = sum(weights)
+        r = self._rng.random() * total
+        acc = 0.0
+        for choice, w in zip(domain.categories, weights):
+            acc += w
+            if r <= acc:
+                return choice
+        return domain.categories[-1]
+
+    # -- Searcher API --------------------------------------------------------
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        if not self._take_budget():
+            return None
+        space = getattr(self, "_space", None) or {}
+        config = {}
+        enough = len(self._obs) >= self._n_initial
+        if enough:
+            ranked = sorted(self._obs, key=lambda o: -o[1])
+            n_good = max(1, int(len(ranked) * self._gamma))
+            good = [c for c, _ in ranked[:n_good]]
+            bad = [c for c, _ in ranked[n_good:]] or good
+        for name, domain in space.items():
+            if _is_grid(domain):
+                raise ValueError("grid_search is not supported by "
+                                 "TPESearcher (use BasicVariantGenerator)")
+            if not isinstance(domain, Domain):
+                config[name] = domain
+            elif not enough or not isinstance(domain,
+                                              (Float, Integer, Categorical)):
+                # warm-up, and Function/sample_from domains (no bounds to
+                # model a density over) always sample directly
+                config[name] = domain.sample(self._rng)
+            elif isinstance(domain, Categorical):
+                config[name] = self._suggest_categorical(name, domain,
+                                                         good, bad)
+            else:
+                config[name] = self._suggest_numeric(name, domain, good, bad)
+        self._live[trial_id] = config
+        return dict(config)
+
+    def on_trial_complete(self, trial_id, result=None, error=False) -> None:
+        config = self._live.pop(trial_id, None)
+        if config is None or error or not result:
+            return
+        value = result.get(self._metric)
+        if value is None:
+            return
+        score = value if self._mode != "min" else -value
+        self._obs.append((config, float(score)))

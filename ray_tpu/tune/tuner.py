@@ -203,8 +203,8 @@ class Tuner:
             searcher = BasicVariantGenerator(seed=tc.seed)
         inner = (searcher._searcher if isinstance(searcher, ConcurrencyLimiter)
                  else searcher)
+        inner.set_num_samples(tc.num_samples)
         if isinstance(inner, BasicVariantGenerator):
-            inner.set_num_samples(tc.num_samples)
             if inner._max_concurrent and not isinstance(
                     searcher, ConcurrencyLimiter):
                 searcher = ConcurrencyLimiter(searcher, inner._max_concurrent)
