@@ -23,6 +23,7 @@ from ray_tpu.data.dataset import (  # noqa: F401
     from_items,
     from_numpy,
     from_pandas,
+    from_torch,
     range,
     read_binary_files,
     read_csv,

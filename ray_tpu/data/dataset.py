@@ -460,4 +460,36 @@ def from_huggingface(hf_dataset, *, parallelism: int = -1) -> Dataset:
             return arr
 
     return Dataset([make(lo, min(lo + per, n))
-                    for lo in range(0, n, per)] or [lambda: {}])
+                    for lo in builtins.range(0, n, per)] or [lambda: {}])
+
+
+def from_torch(torch_dataset, *, parallelism: int = -1) -> Dataset:
+    """A map-style ``torch.utils.data.Dataset`` sliced into blocks
+    (reference: ``data/read_api.py`` ``from_torch``). Items become rows:
+    dicts pass through, (x, y) tuples become {"item": x, "label": y},
+    scalars/arrays become {"item": ...}."""
+    n = len(torch_dataset)
+    if n == 0:
+        return Dataset([lambda: {}])
+    num_blocks = parallelism if parallelism > 0 else max(1, min(64, n // 256 or 1))
+    per = (n + num_blocks - 1) // num_blocks
+
+    def to_row(item):
+        import numpy as _np
+
+        if isinstance(item, dict):
+            return {k: _np.asarray(v) for k, v in item.items()}
+        if isinstance(item, (tuple, list)) and len(item) == 2:
+            return {"item": _np.asarray(item[0]),
+                    "label": _np.asarray(item[1])}
+        return {"item": _np.asarray(item)}
+
+    def make(lo, hi):
+        def read():
+            return B.from_rows([to_row(torch_dataset[i])
+                                for i in builtins.range(lo, hi)])
+
+        return read
+
+    return Dataset([make(lo, min(lo + per, n))
+                    for lo in builtins.range(0, n, per)])

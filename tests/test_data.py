@@ -455,3 +455,39 @@ def test_iter_torch_batches(rt_cluster):
     assert batches[0]["id"].dtype == torch.float32
     total = torch.cat([b["id"] for b in batches])
     assert sorted(total.tolist()) == [float(i) for i in range(32)]
+
+
+def test_from_torch(rt_cluster):
+    import torch.utils.data as tud
+
+    class Squares(tud.Dataset):
+        def __len__(self):
+            return 20
+
+        def __getitem__(self, i):
+            return (i, i * i)
+
+    ds = data.from_torch(Squares())
+    rows = sorted(ds.take_all(), key=lambda r: int(r["item"]))
+    assert len(rows) == 20
+    assert all(int(r["label"]) == int(r["item"]) ** 2 for r in rows)
+
+
+def test_from_huggingface_ducktyped(rt_cluster):
+    """from_huggingface works with anything exposing len() + dict slicing
+    (the hf datasets arrow interface); the hf lib itself isn't installed
+    here, so a duck-typed stand-in exercises the slicing path."""
+    class FakeHF:
+        def __init__(self, n):
+            self._a = list(range(n))
+
+        def __len__(self):
+            return len(self._a)
+
+        def __getitem__(self, sl):
+            return {"a": self._a[sl], "b": [x * 2 for x in self._a[sl]]}
+
+    rows = data.from_huggingface(FakeHF(300), parallelism=4).take_all()
+    assert len(rows) == 300
+    assert sorted(int(r["a"]) for r in rows) == list(range(300))
+    assert all(int(r["b"]) == 2 * int(r["a"]) for r in rows)

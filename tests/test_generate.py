@@ -149,3 +149,43 @@ def test_token_streaming_behind_serve(rt_cluster):
     finally:
         serve.shutdown()
         serve._forget_controller_for_tests()
+
+
+def test_batched_generation_with_serve_batch(rt_cluster):
+    """Continuous-batching shape: concurrent single-prompt requests fuse
+    into ONE batched generate call via @serve.batch (the MXU wants big
+    batches; per-request decode would waste it)."""
+    from ray_tpu import serve
+
+    @serve.deployment(max_ongoing_requests=16)
+    class BatchedLM:
+        def __init__(self):
+            self.cfg = dataclasses.replace(llama.PRESETS["debug"],
+                                           compute_dtype=jnp.float32)
+            self.params = llama.init_params(jax.random.key(0), self.cfg)
+            self.batch_sizes = []
+
+        @serve.batch(max_batch_size=8, batch_wait_timeout_s=0.3)
+        async def gen(self, prompts):
+            self.batch_sizes.append(len(prompts))
+            batch = jnp.asarray(prompts, jnp.int32)
+            toks = generate.generate(self.params, batch, self.cfg,
+                                     max_new_tokens=3)
+            return [np.asarray(t).tolist() for t in toks]
+
+        async def __call__(self, prompt_ids):
+            return await self.gen(prompt_ids)
+
+        def seen_batches(self):
+            return self.batch_sizes
+
+    handle = serve.run(BatchedLM.bind(), name="blm", route_prefix=None)
+    try:
+        rs = [handle.remote([1, 2, i]) for i in range(6)]
+        outs = [r.result(timeout=180) for r in rs]
+        assert all(len(o) == 3 for o in outs)
+        sizes = handle.seen_batches.remote().result(timeout=30)
+        assert max(sizes) > 1, f"requests never fused: {sizes}"
+    finally:
+        serve.shutdown()
+        serve._forget_controller_for_tests()
