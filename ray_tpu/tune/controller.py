@@ -88,6 +88,7 @@ class TuneController:
         self._trials: List[Trial] = list(restored_trials or [])
         self._next_id = len(self._trials)
         self._exhausted = False
+        self._loggers: Dict[str, Any] = {}
         os.makedirs(self._dir, exist_ok=True)
         for t in self._trials:
             self._scheduler.on_trial_add(t)
@@ -150,7 +151,10 @@ class TuneController:
         self._searcher.on_trial_complete(
             t.trial_id, t.last_result or None, error=status == ERROR)
         self._scheduler.on_trial_complete(t, t.last_result)
-        with open(os.path.join(self._trial_dir(t), "result.json"), "w") as f:
+        logger = self._loggers.pop(t.trial_id, None)
+        if logger is not None:
+            logger.close()
+        with open(os.path.join(self._trial_dir(t), "final_result.json"), "w") as f:
             json.dump(t.state(), f, default=str)
 
     def _should_stop(self, t: Trial, result: Dict[str, Any]) -> bool:
@@ -173,8 +177,19 @@ class TuneController:
                     return True
         return False
 
+    def _trial_loggers(self, t: Trial):
+        from ray_tpu.tune.loggers import TrialLoggers
+
+        if t.trial_id not in self._loggers:
+            self._loggers[t.trial_id] = TrialLoggers(self._trial_dir(t))
+        return self._loggers[t.trial_id]
+
     def _handle_result(self, t: Trial, result: Dict[str, Any]) -> None:
         t.on_result(result)
+        try:
+            self._trial_loggers(t).on_result(result)
+        except Exception:  # noqa: BLE001 — logging must not fail the trial
+            pass
         if (self._checkpoint_freq
                 and t.training_iteration % self._checkpoint_freq == 0):
             self._save_trial_checkpoint(t)

@@ -1,0 +1,77 @@
+"""Per-trial experiment loggers: JSONL, CSV, TensorBoard.
+
+Reference analogs: ``tune/logger/json.py`` (``result.json`` JSON lines),
+``tune/logger/csv.py`` (``progress.csv``), ``tune/logger/tensorboard.py``
+(TBX events). Always-on like the reference's defaults; TensorBoard events
+are written when a writer implementation is importable (torch's
+SummaryWriter here — no tensorboardX dependency) and silently skipped
+otherwise.
+"""
+
+from __future__ import annotations
+
+import csv
+import json
+import os
+from typing import Any, Dict, Optional
+
+
+def _scalarize(v: Any) -> Any:
+    if hasattr(v, "item"):
+        try:
+            return v.item()
+        except Exception:  # noqa: BLE001 — non-scalar array
+            return str(v)
+    return v
+
+
+class TrialLoggers:
+    """One per live trial; append-on-result, close-on-finalize."""
+
+    def __init__(self, trial_dir: str):
+        self._dir = trial_dir
+        os.makedirs(trial_dir, exist_ok=True)
+        self._jsonl = open(os.path.join(trial_dir, "result.json"), "a")
+        self._csv_file = open(os.path.join(trial_dir, "progress.csv"), "a",
+                              newline="")
+        self._csv: Optional[csv.DictWriter] = None
+        self._tb = None
+        try:
+            from torch.utils.tensorboard import SummaryWriter
+
+            self._tb = SummaryWriter(log_dir=trial_dir)
+        except Exception:  # noqa: BLE001 — TB optional
+            self._tb = None
+        self._step = 0
+
+    def on_result(self, result: Dict[str, Any]) -> None:
+        self._step += 1
+        row = {k: _scalarize(v) for k, v in result.items()}
+        self._jsonl.write(json.dumps(row, default=str) + "\n")
+        self._jsonl.flush()
+        if self._csv is None:
+            self._csv = csv.DictWriter(self._csv_file,
+                                       fieldnames=sorted(row))
+            self._csv.writeheader()
+        self._csv.writerow({k: row.get(k) for k in self._csv.fieldnames})
+        self._csv_file.flush()
+        if self._tb is not None:
+            for k, v in row.items():
+                if isinstance(v, (int, float)) and not isinstance(v, bool):
+                    try:
+                        self._tb.add_scalar(k, v, global_step=self._step)
+                    except Exception:  # noqa: BLE001
+                        pass
+            self._tb.flush()
+
+    def close(self) -> None:
+        try:
+            self._jsonl.close()
+            self._csv_file.close()
+        except Exception:  # noqa: BLE001
+            pass
+        if self._tb is not None:
+            try:
+                self._tb.close()
+            except Exception:  # noqa: BLE001
+                pass

@@ -249,3 +249,33 @@ def test_tpe_searcher_finds_optimum(rt_cluster):
     # a coin flip — one lucky random draw breaks it)
     losses = [r.metrics["loss"] for r in results]
     assert np.mean(losses[20:]) < np.mean(losses[:8])
+
+
+def test_trial_loggers_jsonl_csv_tb(rt_cluster, tmp_path):
+    """Every trial writes result.json (JSONL), progress.csv, and TB events
+    (reference: tune/logger defaults)."""
+    import glob
+    import json as _json
+
+    def objective(config):
+        for i in range(3):
+            tune.report({"score": config["x"] * (i + 1), "iter": i})
+
+    Tuner(
+        objective,
+        param_space={"x": tune.grid_search([1.0, 2.0])},
+        tune_config=TuneConfig(metric="score", mode="max"),
+        run_config=RunConfig(name="logex", storage_path=str(tmp_path)),
+    ).fit()
+    trial_dirs = [d for d in glob.glob(str(tmp_path / "logex" / "*"))
+                  if os.path.isdir(d)]
+    assert len(trial_dirs) == 2
+    for d in trial_dirs:
+        lines = open(os.path.join(d, "result.json")).read().splitlines()
+        rows = [_json.loads(l) for l in lines]
+        # 3 reports (+ possibly a final done-marker result)
+        assert {r.get("iter") for r in rows} >= {0, 1, 2}
+        csv_lines = open(os.path.join(d, "progress.csv")).read().splitlines()
+        assert len(csv_lines) >= 4  # header + 3 rows
+        assert "score" in csv_lines[0]
+        assert glob.glob(os.path.join(d, "events.out.tfevents.*"))
