@@ -31,18 +31,30 @@ class TrialLoggers:
     def __init__(self, trial_dir: str):
         self._dir = trial_dir
         os.makedirs(trial_dir, exist_ok=True)
+        # Resume-aware: on restore the trial dir already has rows — count
+        # prior results so the CSV header isn't re-written mid-file and TB
+        # steps continue instead of zig-zagging back to 0.
+        prior = 0
+        csv_path = os.path.join(trial_dir, "progress.csv")
+        self._resumed_fieldnames = None
+        if os.path.exists(csv_path):
+            with open(csv_path, newline="") as f:
+                rows = f.read().splitlines()
+            if rows:
+                self._resumed_fieldnames = rows[0].split(",")
+                prior = max(0, len(rows) - 1)
         self._jsonl = open(os.path.join(trial_dir, "result.json"), "a")
-        self._csv_file = open(os.path.join(trial_dir, "progress.csv"), "a",
-                              newline="")
+        self._csv_file = open(csv_path, "a", newline="")
         self._csv: Optional[csv.DictWriter] = None
         self._tb = None
         try:
             from torch.utils.tensorboard import SummaryWriter
 
-            self._tb = SummaryWriter(log_dir=trial_dir)
+            self._tb = SummaryWriter(log_dir=trial_dir,
+                                     purge_step=None)
         except Exception:  # noqa: BLE001 — TB optional
             self._tb = None
-        self._step = 0
+        self._step = prior
 
     def on_result(self, result: Dict[str, Any]) -> None:
         self._step += 1
@@ -50,9 +62,13 @@ class TrialLoggers:
         self._jsonl.write(json.dumps(row, default=str) + "\n")
         self._jsonl.flush()
         if self._csv is None:
-            self._csv = csv.DictWriter(self._csv_file,
-                                       fieldnames=sorted(row))
-            self._csv.writeheader()
+            if self._resumed_fieldnames:
+                self._csv = csv.DictWriter(
+                    self._csv_file, fieldnames=self._resumed_fieldnames)
+            else:
+                self._csv = csv.DictWriter(self._csv_file,
+                                           fieldnames=sorted(row))
+                self._csv.writeheader()
         self._csv.writerow({k: row.get(k) for k in self._csv.fieldnames})
         self._csv_file.flush()
         if self._tb is not None:

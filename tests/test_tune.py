@@ -278,4 +278,10 @@ def test_trial_loggers_jsonl_csv_tb(rt_cluster, tmp_path):
         csv_lines = open(os.path.join(d, "progress.csv")).read().splitlines()
         assert len(csv_lines) >= 4  # header + 3 rows
         assert "score" in csv_lines[0]
-        assert glob.glob(os.path.join(d, "events.out.tfevents.*"))
+        try:
+            import torch.utils.tensorboard  # noqa: F401
+            has_tb = True
+        except Exception:  # noqa: BLE001
+            has_tb = False
+        if has_tb:  # TB is documented-optional; only assert when available
+            assert glob.glob(os.path.join(d, "events.out.tfevents.*"))
