@@ -309,12 +309,27 @@ class GcsServer:
 
     async def _monitor_loop(self) -> None:
         cfg = get_config()
+        started = time.monotonic()
         while True:
             await asyncio.sleep(cfg.heartbeat_interval_s)
             now = time.monotonic()
             for entry in list(self.nodes.values()):
                 if entry.alive and now - entry.last_heartbeat > cfg.node_death_timeout_s:
                     await self._mark_node_dead(entry, "heartbeat timeout")
+            # Restored-ALIVE actors whose node never (re-)registered: after a
+            # grace window for surviving raylets to reattach (they re-register
+            # under their old node id on an "unknown" heartbeat reply), the
+            # worker is provably gone — run the normal failure path so the
+            # restart budget can recreate the actor (reference: GCS FT
+            # reconciliation of the actor table after restart).
+            if now - started > cfg.node_death_timeout_s:
+                for actor in list(self.actors.values()):
+                    if (actor.state in (ACTOR_ALIVE,)
+                            and actor.node_id is not None
+                            and actor.node_id not in self.nodes):
+                        await self._handle_actor_failure(
+                            actor, "node never re-registered after GCS "
+                                   "restart")
             try:
                 # pickle+write runs OFF the loop: a large table snapshot
                 # must not stall heartbeat handling (and spuriously kill
@@ -574,6 +589,23 @@ class GcsServer:
                     and reporter != entry.node_id):
                 return {"ok": True, "stale": True}
             await self._handle_actor_failure(entry, p.get("reason", "worker died"))
+        return {"ok": True}
+
+    async def rpc_actor_unreachable(self, p):
+        """A caller failed to CONNECT to an ALIVE actor's address. Verify
+        before acting (the caller may just have a stale cache): if the
+        actor's node is gone or dead, run the normal failure path so the
+        restart budget applies — the fast lane for post-GCS-restart
+        recovery, ahead of the monitor's grace window."""
+        entry = self.actors.get(p["actor_id"])
+        if (entry is None or entry.state != ACTOR_ALIVE
+                or entry.address != p.get("address")):
+            return {"ok": False}
+        node = self.nodes.get(entry.node_id or "")
+        if node is not None and node.alive:
+            return {"ok": False}  # node looks fine; caller should retry
+        await self._handle_actor_failure(
+            entry, "reported unreachable and its node is gone")
         return {"ok": True}
 
     async def _handle_actor_failure(self, entry: _ActorEntry, reason: str) -> None:

@@ -198,6 +198,18 @@ class Raylet:
                     "queued_demands": [
                         {"resources": dict(k), "count": c}
                         for k, c in list(demands.items())[:20]]})
+                if reply.get("unknown"):
+                    # The GCS restarted and lost the node table (nodes are
+                    # deliberately not snapshotted): re-register under the
+                    # SAME node id, then re-publish actors + locations OFF
+                    # this loop (stalling heartbeats past the death timeout
+                    # would get the fresh registration killed again).
+                    await self._gcs.call("register_node", {
+                        "node_id": self.node_id,
+                        "address": self.server.address,
+                        "resources": self.node.total.to_dict(),
+                        "labels": dict(self.node.labels)})
+                    spawn_task(self._reattach_after_gcs_restart())
                 if reply.get("resurrected"):
                     # off the heartbeat loop: a long republish here would
                     # stall heartbeats past node_death_timeout_s and
@@ -305,6 +317,21 @@ class Raylet:
                             "actor_id": entry.actor_id, "state": "DEAD",
                             "node_id": self.node_id, "reason": reason})
                         entry.is_actor_worker = False
+
+    async def _reattach_after_gcs_restart(self) -> None:
+        """Re-publish live actor workers to a restarted GCS, then run the
+        standard reconciliation (object locations + stale-state cleanup)."""
+        for entry in list(self._workers.values()):
+            if not (entry.is_actor_worker and entry.actor_id
+                    and entry.address):
+                continue
+            try:
+                await self._gcs.call("actor_update", {
+                    "actor_id": entry.actor_id, "state": "ALIVE",
+                    "address": entry.address, "node_id": self.node_id})
+            except Exception:  # noqa: BLE001 — next heartbeat retries
+                return
+        await self._reconcile_after_resurrection()
 
     async def _reconcile_after_resurrection(self) -> None:
         """While this node was (spuriously) dead, the GCS dropped our object

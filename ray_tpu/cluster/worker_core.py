@@ -524,6 +524,16 @@ class ClusterBackend(RuntimeBackend):
                     pass
             raise ObjectLostError(ref.id())
 
+    async def _report_unreachable_quietly(self, actor_id_hex: str,
+                                          address: str) -> None:
+        """Best-effort: the GCS itself may be down in exactly this
+        scenario — a raised ConnectionError here is noise, not signal."""
+        try:
+            await self._gcs.call("actor_unreachable", {
+                "actor_id": actor_id_hex, "address": address}, timeout=10)
+        except Exception:  # noqa: BLE001
+            pass
+
     async def _unpin_quietly(self, oids: List[str]) -> None:
         """Fire-and-forget unpin; a dropped connection (shutdown, raylet
         restart) must not surface as an unretrieved task exception — the
@@ -1046,6 +1056,12 @@ class ClusterBackend(RuntimeBackend):
                         client = await self._pool.get(conn.address)
                     except (ConnectionLost, ConnectionError, OSError):
                         # Never delivered — free retry (actor restarting).
+                        # Tell the GCS: if the actor's node is gone (e.g.
+                        # state restored across a head restart with a stale
+                        # address), this triggers the restart path NOW
+                        # instead of us spinning against a dead address.
+                        spawn_task(self._report_unreachable_quietly(
+                            payload["actor_id"], conn.address))
                         conn.address = None
                         connect_attempts += 1
                         if connect_attempts > 10:

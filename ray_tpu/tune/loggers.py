@@ -39,9 +39,9 @@ class TrialLoggers:
         self._resumed_fieldnames = None
         if os.path.exists(csv_path):
             with open(csv_path, newline="") as f:
-                rows = f.read().splitlines()
+                rows = list(csv.reader(f))
             if rows:
-                self._resumed_fieldnames = rows[0].split(",")
+                self._resumed_fieldnames = rows[0]  # quote-aware parse
                 prior = max(0, len(rows) - 1)
         self._jsonl = open(os.path.join(trial_dir, "result.json"), "a")
         self._csv_file = open(csv_path, "a", newline="")
@@ -50,8 +50,10 @@ class TrialLoggers:
         try:
             from torch.utils.tensorboard import SummaryWriter
 
+            # purge events past the persisted row count: a crashed run may
+            # have logged further steps TB-side than the CSV kept
             self._tb = SummaryWriter(log_dir=trial_dir,
-                                     purge_step=None)
+                                     purge_step=prior + 1 if prior else None)
         except Exception:  # noqa: BLE001 — TB optional
             self._tb = None
         self._step = prior

@@ -237,3 +237,70 @@ def test_large_object_transfer_under_small_store(monkeypatch):
     finally:
         cluster.shutdown()
         config_mod.reset_config_for_tests()
+
+
+def test_cli_head_restart_recovers_named_actor(tmp_path):
+    """A detached named actor with restart budget survives a hard head
+    restart: its table entry restores from the snapshot, the first call
+    after restart finds the old worker gone and the restart machinery
+    recreates it (reference: GCS FT for detached actors)."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO
+    env["JAX_PLATFORMS"] = "cpu"
+    env["RT_SESSION_DIR_ROOT"] = str(tmp_path)
+    head = _cli(env, "start", "--head", "--num-cpus", "2",
+                "--session-name", "actor_sess")
+    assert head.returncode == 0, head.stderr
+    gcs1 = [ln.split()[-1] for ln in head.stdout.splitlines()
+            if "gcs_address" in ln][0]
+    try:
+        os.environ["RT_SESSION_DIR_ROOT"] = str(tmp_path)
+        config_mod.reset_config_for_tests()
+        if ray_tpu.is_initialized():
+            ray_tpu.shutdown()
+        ray_tpu.init(address=gcs1)
+
+        @ray_tpu.remote(max_restarts=-1, lifetime="detached",
+                        name="phoenix")
+        class Counter:
+            def __init__(self):
+                self.n = 0
+
+            def bump(self):
+                self.n += 1
+                return self.n
+
+        c = Counter.remote()
+        assert ray_tpu.get(c.bump.remote(), timeout=60) == 1
+        time.sleep(1.5)  # snapshot persists the actor table
+        ray_tpu.shutdown()
+
+        import json as _json
+
+        for name in os.listdir(os.path.join(str(tmp_path), "nodes")):
+            with open(os.path.join(str(tmp_path), "nodes", name)) as f:
+                st = _json.load(f)
+            os.kill(st["pid"], 9)
+        time.sleep(0.5)
+        for name in os.listdir(os.path.join(str(tmp_path), "nodes")):
+            os.unlink(os.path.join(str(tmp_path), "nodes", name))
+
+        head2 = _cli(env, "start", "--head", "--num-cpus", "2",
+                     "--session-name", "actor_sess")
+        assert head2.returncode == 0, head2.stderr
+        gcs2 = [ln.split()[-1] for ln in head2.stdout.splitlines()
+                if "gcs_address" in ln][0]
+        config_mod.reset_config_for_tests()
+        ray_tpu.init(address=gcs2)
+        c2 = ray_tpu.get_actor("phoenix")
+        # fresh __init__ after recreation: state resets, actor is LIVE
+        val = ray_tpu.get(c2.bump.remote(), timeout=120)
+        assert val == 1, val
+        assert ray_tpu.get(c2.bump.remote(), timeout=60) == 2
+        ray_tpu.shutdown()
+    finally:
+        os.environ.pop("RT_SESSION_DIR_ROOT", None)
+        config_mod.reset_config_for_tests()
+        if ray_tpu.is_initialized():
+            ray_tpu.shutdown()
+        _cli(env, "stop", "--force")
