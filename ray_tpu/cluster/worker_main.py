@@ -396,6 +396,12 @@ def main() -> None:
     import signal
 
     faulthandler.register(signal.SIGUSR1, file=sys.stderr, all_threads=True)
+    # TPU perf flags (latency-hiding scheduler, async collectives) must be
+    # in the env before this process's first jax/libtpu init; workers are
+    # where jitted training steps actually run. No-op on CPU backends.
+    from ray_tpu.parallel.xla_flags import apply_tpu_perf_flags
+
+    apply_tpu_perf_flags()
     wp = WorkerProcess()
     wp.start()
     threading.Event().wait()  # io loop thread does the work

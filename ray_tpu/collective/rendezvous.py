@@ -82,6 +82,26 @@ def bootstrap_jax_distributed(world_size: int, rank: int,
         f"rendezvous {group_name!r}")
     import jax
 
+    # Elastic-restart lifecycle (SURVEY.md §7 hard part: "jax.distributed
+    # lifecycle across actor restarts"): a pooled/reused worker process may
+    # carry a previous gang's coordinator client whose peers are gone —
+    # tear it down and drop cached backends so the new device topology can
+    # register. NCCL's equivalent is destroy_process_group before re-init.
+    if jax.distributed.is_initialized():
+        try:
+            jax.distributed.shutdown()
+        except Exception:
+            # The old gang's coordinator may already be dead (that's often
+            # WHY we're re-bootstrapping) — a failed goodbye to it must not
+            # fail the new gang's hello.
+            pass
+        try:
+            import jax.extend.backend as _jeb
+
+            _jeb.clear_backends()
+        except Exception:  # pragma: no cover — best effort on older jax
+            pass
+
     jax.distributed.initialize(
         coordinator_address=address,
         num_processes=world_size,

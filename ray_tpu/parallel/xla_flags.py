@@ -1,0 +1,59 @@
+"""TPU/XLA performance flags: latency-hiding scheduler + async collectives.
+
+SURVEY.md §7 "Matching A100/NCCL" calls these out as required for the
+headline number: overlap of ICI collectives with compute comes from XLA's
+latency-hiding scheduler and the async-collective fusion passes, which are
+OFF by default and enabled via ``LIBTPU_INIT_ARGS`` (TPU runtime flags must
+be set BEFORE the backend initializes — i.e. before the first jax call in
+the process, which is why these are env-var plumbing, not jax.config calls).
+
+The flag set follows the public MaxText/scaling-book recipe:
+  - ``xla_tpu_enable_latency_hiding_scheduler`` — schedule compute into the
+    shadow of in-flight collectives instead of barriering on them;
+  - async collective fusion (+ all-gather / multiple-steps variants) — let
+    fsdp all-gathers for layer i+1 overlap layer i's matmuls inside the
+    ``lax.scan`` over stacked layers;
+  - ``xla_tpu_overlap_compute_collective_tc`` — tensor-core/collective
+    overlap on newer generations.
+
+Reference analog: the NCCL env tuning Ray Train applies around its process
+group (``/root/reference/python/ray/train/torch/config.py:50``
+``NCCL_SOCKET_IFNAME`` etc.) — there the transport is tuned per-process via
+env vars too; here the "transport" is the XLA scheduler.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import MutableMapping, Optional
+
+TPU_PERF_FLAGS = (
+    "--xla_tpu_enable_latency_hiding_scheduler=true",
+    "--xla_enable_async_all_gather=true",
+    "--xla_enable_async_collective_permute=true",
+    "--xla_tpu_enable_async_collective_fusion=true",
+    "--xla_tpu_enable_async_collective_fusion_fuse_all_gather=true",
+    "--xla_tpu_enable_async_collective_fusion_multiple_steps=true",
+    "--xla_tpu_overlap_compute_collective_tc=true",
+    "--xla_tpu_enable_all_experimental_scheduler_features=false",
+)
+
+
+def apply_tpu_perf_flags(env: Optional[MutableMapping[str, str]] = None,
+                         ) -> MutableMapping[str, str]:
+    """Merge the perf flags into ``LIBTPU_INIT_ARGS`` (idempotent).
+
+    Mutates and returns ``env`` (default ``os.environ``). A flag already
+    present in the env — e.g. a user override setting it ``=false`` — wins;
+    only missing flags are appended. No-op for flags whose key is present.
+    Must run before the process's first jax/libtpu initialization to have
+    any effect.
+    """
+    env = os.environ if env is None else env
+    existing = env.get("LIBTPU_INIT_ARGS", "")
+    have = {f.split("=", 1)[0] for f in existing.split() if f}
+    added = [f for f in TPU_PERF_FLAGS if f.split("=", 1)[0] not in have]
+    if added:
+        env["LIBTPU_INIT_ARGS"] = " ".join(
+            ([existing] if existing else []) + added)
+    return env
