@@ -64,6 +64,140 @@ def make_mesh(config: MeshConfig,
     return Mesh(arr, AXIS_ORDER)
 
 
+DCN_AXES_DEFAULT = ("dp",)
+
+
+def make_hybrid_mesh(config: MeshConfig,
+                     slice_devices: Sequence[Sequence[jax.Device]],
+                     dcn_axes: Sequence[str] = DCN_AXES_DEFAULT) -> Mesh:
+    """ICI×DCN hybrid mesh for multi-slice jobs.
+
+    ``slice_devices`` groups the devices by pod slice (equal sizes). The
+    ``dcn_axes`` (default: ``dp``) span *slices* — their collectives cross
+    the data-center network — while every other axis stays *within* a slice
+    so fsdp all-gathers / tp all-reduces / sp permutes ride ICI. This is the
+    scaling-book multi-slice recipe (dp-over-DCN outermost); the reference
+    encodes the same topology operationally in its TPU pod autoscaler YAMLs
+    (``autoscaler/gcp/example-tpu-pod-topology.yaml``) but has no mesh layer
+    to consume it.
+
+    The product of the dcn axis sizes must equal ``len(slice_devices)``;
+    the remaining axes must use exactly one slice's device count.
+    """
+    for a in dcn_axes:
+        if a not in AXIS_ORDER:
+            raise ValueError(f"unknown dcn axis {a!r}")
+    n_slices = len(slice_devices)
+    dcn_order = [a for a in AXIS_ORDER if a in dcn_axes]
+    ici_order = [a for a in AXIS_ORDER if a not in dcn_axes]
+    dcn_sizes = [getattr(config, a) for a in dcn_order]
+    ici_sizes = [getattr(config, a) for a in ici_order]
+    if math.prod(dcn_sizes) != n_slices:
+        raise ValueError(
+            f"dcn axes {dcn_order} sizes {dcn_sizes} must multiply to the "
+            f"slice count {n_slices}")
+    per_slice = math.prod(ici_sizes)
+    sizes = {len(s) for s in slice_devices}
+    if len(sizes) != 1:
+        raise ValueError(f"slices must be equal-sized, got {sorted(sizes)}")
+    if sizes.pop() != per_slice:
+        raise ValueError(
+            f"each slice needs exactly {per_slice} devices for axes "
+            f"{ici_order} (got {len(slice_devices[0])}); silently idling "
+            f"chips is never what you want — shrink/grow the inner axes")
+
+    arr = np.array([list(s) for s in slice_devices],
+                   dtype=object).reshape(dcn_sizes + ici_sizes)
+    # dims are currently [dcn axes..., ici axes...]; interleave into the
+    # canonical AXIS_ORDER so PartitionSpecs are layout-independent.
+    current = dcn_order + ici_order
+    arr = arr.transpose([current.index(a) for a in AXIS_ORDER])
+    return Mesh(arr, AXIS_ORDER)
+
+
+def hybrid_mesh_from_process_slices(config: MeshConfig,
+                                    process_slices: Sequence[str],
+                                    devices: Optional[Sequence[jax.Device]]
+                                    = None,
+                                    dcn_axes: Sequence[str]
+                                    = DCN_AXES_DEFAULT) -> Mesh:
+    """Hybrid mesh from a process→slice-name assignment.
+
+    ``process_slices[i]`` is the slice name of jax process ``i`` (in a
+    TrainWorker gang, rank i == jax process i — ``bootstrap_jax_distributed``
+    passes the rank as ``process_id``). Devices are grouped by their owning
+    process, processes by slice; slice order on the DCN axis follows first
+    appearance in ``process_slices`` so every rank derives the identical
+    mesh without coordination.
+    """
+    devices = list(devices) if devices is not None else jax.devices()
+    by_process: dict = {}
+    for d in devices:
+        by_process.setdefault(d.process_index, []).append(d)
+    slice_order: list = []
+    slice_procs: dict = {}
+    for proc, name in enumerate(process_slices):
+        if name not in slice_procs:
+            slice_procs[name] = []
+            slice_order.append(name)
+        slice_procs[name].append(proc)
+    slice_devs = [
+        [d for p in slice_procs[name] for d in by_process.get(p, [])]
+        for name in slice_order
+    ]
+    return make_hybrid_mesh(config, slice_devs, dcn_axes)
+
+
+def pg_slice_assignments(pg) -> list:
+    """bundle index → slice name, from the bundles' nodes' topology labels.
+
+    Reads each bundle's placed node from the GCS placement-group table and
+    that node's ``tpu-slice-name`` label (``core/resources.py``
+    LABEL_SLICE_NAME). Nodes without a slice label fall into one synthetic
+    slice per node — correct for CPU test clusters where every "slice" is
+    one host.
+    """
+    from ray_tpu.core.resources import LABEL_SLICE_NAME
+    from ray_tpu.core.worker import global_worker
+    from ray_tpu.util.placement_group import placement_group_table
+
+    backend = global_worker()._require_backend()
+    table = {e["pg_id"]: e for e in placement_group_table()}
+    entry = table.get(pg.id.hex() if hasattr(pg.id, "hex") else str(pg.id))
+    if entry is None:
+        raise ValueError(f"placement group {pg.id} not found in GCS")
+    node_labels = {n["node_id"]: n.get("labels", {})
+                   for n in backend.nodes()}
+    assignments = []
+    for i, node_id in enumerate(entry["bundle_nodes"]):
+        if node_id is None:
+            raise ValueError(f"bundle {i} of {pg.id} is not placed yet "
+                             f"(pg.wait() first)")
+        labels = node_labels.get(node_id, {})
+        assignments.append(labels.get(LABEL_SLICE_NAME) or f"@{node_id}")
+    return assignments
+
+
+def mesh_for_slice_group(pg, config: Optional[MeshConfig] = None,
+                         dcn_axes: Sequence[str] = DCN_AXES_DEFAULT,
+                         devices: Optional[Sequence[jax.Device]] = None
+                         ) -> Mesh:
+    """Turn a ``slice_group()`` placement group into a hybrid device mesh.
+
+    Maps bundle i to jax process i (the TrainWorker convention: rank i runs
+    in bundle i and passes its rank as ``process_id`` to jax.distributed),
+    groups processes by slice label, and builds the ICI×DCN mesh. With no
+    explicit ``config``, dp spans the slices and fsdp fills each slice.
+    """
+    process_slices = pg_slice_assignments(pg)
+    if config is None:
+        devs = list(devices) if devices is not None else jax.devices()
+        n_slices = len(dict.fromkeys(process_slices))
+        config = MeshConfig.for_devices(len(devs), dp=n_slices)
+    return hybrid_mesh_from_process_slices(config, process_slices, devices,
+                                           dcn_axes)
+
+
 def balanced_factors(n: int, k: int = 3) -> Tuple[int, ...]:
     """Split n into k roughly-balanced integer factors (largest first)."""
     factors = [1] * k
