@@ -59,9 +59,12 @@ class LlamaConfig:
     # an ambient mesh_scope).
     attn_impl: str = "xla"
     # Pipeline parallelism: set to "pp" to split the layer stack over that
-    # mesh axis (GPipe microbatching; incompatible with ring/ulysses attn).
+    # mesh axis (incompatible with ring/ulysses attn). Schedule: "gpipe"
+    # (fwd scan + autodiff backward, stash grows with M) or "1f1b"
+    # (interleaved manual-VJP schedule, stash is O(P) — pipeline.py).
     pipeline_axis: Optional[str] = None
     pipeline_microbatches: int = 4
+    pipeline_schedule: str = "gpipe"
 
     @property
     def head_dim(self) -> int:
@@ -171,6 +174,19 @@ def _block(cfg: LlamaConfig, x: jax.Array, layer: Params,
     return ffn_half(cfg, x, layer)
 
 
+def _stage_scan(cfg: LlamaConfig, stage_layers: Params, h: jax.Array,
+                seg: Optional[jax.Array]) -> jax.Array:
+    """One pipeline stage: scan this rank's layer slice over ``h`` — the
+    stage body shared by the GPipe and 1F1B schedules. RoPE tables are
+    recomputed inside (cheap, XLA-hoisted) so the shard_map body closes
+    over no tracers."""
+    sin, cos = rope_angles(h.shape[1], cfg.head_dim, cfg.rope_theta,
+                           cfg.compute_dtype)
+    body = lambda hh, layer: (_block(cfg, hh, layer, sin, cos, seg), None)
+    h, _ = jax.lax.scan(body, h, stage_layers)
+    return h
+
+
 def _pipelined_layers(layers: Params, x: jax.Array, cfg: LlamaConfig,
                       segment_ids: Optional[jax.Array]) -> jax.Array:
     """Layer stack split over the ``pp`` mesh axis, GPipe-microbatched.
@@ -186,19 +202,13 @@ def _pipelined_layers(layers: Params, x: jax.Array, cfg: LlamaConfig,
         raise ValueError("pipeline_axis is incompatible with ring/ulysses "
                          "attention (nested shard_map); use attn_impl="
                          "'flash' or 'xla'")
-    if segment_ids is not None:
-        raise NotImplementedError("segment_ids under pipeline parallelism")
     mesh = current_mesh()
     if mesh is None:
         raise ValueError("pipeline_axis needs an ambient mesh "
                          "(parallel.context.mesh_scope)")
 
-    def stage(stage_layers, h):
-        sin, cos = rope_angles(h.shape[1], cfg.head_dim, cfg.rope_theta,
-                               cfg.compute_dtype)
-        body = lambda hh, layer: (_block(cfg, hh, layer, sin, cos, None), None)
-        h, _ = jax.lax.scan(body, h, stage_layers)
-        return h
+    def stage(stage_layers, h, seg=None):
+        return _stage_scan(cfg, stage_layers, h, seg)
 
     # Batch rides (dp, fsdp, tp) inside the pipeline region: tp lanes would
     # otherwise run fully redundant stage compute (stage weights are
@@ -209,7 +219,8 @@ def _pipelined_layers(layers: Params, x: jax.Array, cfg: LlamaConfig,
         axis_name=cfg.pipeline_axis,
         num_microbatches=cfg.pipeline_microbatches,
         batch_axes=(("dp", "fsdp", "tp"),),
-        remat=cfg.remat)
+        remat=cfg.remat,
+        extras=segment_ids)
 
 
 def forward_hidden(params: Params, tokens: jax.Array, cfg: LlamaConfig,
@@ -258,6 +269,65 @@ def lm_loss(params: Params, batch: Dict[str, jax.Array], cfg: LlamaConfig) -> ja
     x, head = forward_hidden(params, inputs, cfg, batch.get("segment_ids"))
     return chunked_ce(x, head, targets, batch.get("loss_mask"),
                       cfg.loss_chunk)
+
+
+def lm_loss_and_grads_1f1b(params: Params, batch: Dict[str, jax.Array],
+                           cfg: LlamaConfig):
+    """(loss, grads) via the interleaved 1F1B pipeline (manual per-stage
+    VJPs — ``parallel/pipeline.py:pipeline_1f1b``). The embedding lookup is
+    differentiated OUTSIDE the pipeline (its vjp scatter-adds the collected
+    per-microbatch input cotangents); final norm + head live INSIDE the last
+    stage's loss so the backward can start there. Selected by
+    ``cfg.pipeline_schedule == "1f1b"`` in ``make_train_step``.
+    """
+    from ray_tpu.parallel import pipeline as pl
+    from ray_tpu.parallel.context import current_mesh
+
+    if cfg.tie_embeddings:
+        raise NotImplementedError(
+            "1f1b needs untied embeddings (the head lives inside the "
+            "pipeline's last stage; the embedding outside it)")
+    if cfg.attn_impl in ("ring", "ulysses"):
+        raise ValueError("pipeline schedules are incompatible with "
+                         "ring/ulysses attention (nested shard_map); use "
+                         "attn_impl='flash' or 'xla'")
+    mesh = current_mesh()
+    if mesh is None:
+        raise ValueError("1f1b needs an ambient mesh "
+                         "(parallel.context.mesh_scope)")
+    cdt = cfg.compute_dtype
+    tokens = batch["tokens"]
+    inputs, targets = tokens[:, :-1], tokens[:, 1:]
+    segs = batch.get("segment_ids")
+    mask = batch.get("loss_mask")
+
+    def embed_fn(embed_w):
+        return embed_w.astype(cdt)[inputs]
+
+    x, embed_vjp = jax.vjp(embed_fn, params["embed"])
+
+    def stage_fn(stage_layers, h, seg):
+        return _stage_scan(cfg, stage_layers, h, seg)
+
+    def head_loss_fn(head_bundle, y, tgt, msk):
+        y = rmsnorm(y, head_bundle["final_norm"].astype(cdt), cfg.norm_eps)
+        head = head_bundle["lm_head"].astype(cdt)
+        return chunked_ce(y, head, tgt, msk, cfg.loss_chunk)
+
+    head_bundle = {"final_norm": params["final_norm"],
+                   "lm_head": params["lm_head"]}
+    loss, g_layers, g_head, g_x = pl.pipeline_1f1b(
+        stage_fn, head_loss_fn, params["layers"], head_bundle, x, targets,
+        mesh,
+        axis_name=cfg.pipeline_axis,
+        num_microbatches=cfg.pipeline_microbatches,
+        batch_axes=("dp", "fsdp", "tp"),
+        segments=segs, loss_mask=mask)
+    g_embed, = embed_vjp(g_x)
+    grads = {"embed": g_embed, "layers": g_layers,
+             "final_norm": g_head["final_norm"],
+             "lm_head": g_head["lm_head"]}
+    return loss, grads
 
 
 def chunked_ce(x: jax.Array, head: jax.Array, targets: jax.Array,

@@ -71,11 +71,26 @@ def make_train_step(cfg: llama.LlamaConfig,
     model-internal shard_map regions (ring attention, pipeline stages) can
     find it.
     """
-    loss_fn = loss_fn or model_family(cfg).lm_loss
+    use_1f1b = (getattr(cfg, "pipeline_axis", None) is not None
+                and getattr(cfg, "pipeline_schedule", "gpipe") == "1f1b")
+    if use_1f1b:
+        if loss_fn is not None:
+            raise ValueError("1f1b computes its own loss inside the "
+                             "pipeline; custom loss_fn unsupported")
+        if model_family(cfg) is not llama:
+            raise NotImplementedError("1f1b schedule: dense llama only")
+
+        def grad_fn(params, batch):
+            return llama.lm_loss_and_grads_1f1b(params, batch, cfg)
+    else:
+        loss_fn = loss_fn or model_family(cfg).lm_loss
+
+        def grad_fn(params, batch):
+            return jax.value_and_grad(
+                lambda p: loss_fn(p, batch, cfg))(params)
 
     def step(params, opt_state, batch):
-        loss, grads = jax.value_and_grad(
-            lambda p: loss_fn(p, batch, cfg))(params)
+        loss, grads = grad_fn(params, batch)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         gnorm = optax.global_norm(grads)
