@@ -12,6 +12,11 @@ subprocesses, the ``ray_tpu`` answer to the reference's
 """
 
 from ray_tpu.autoscaler.autoscaler import StandardAutoscaler  # noqa: F401
+from ray_tpu.autoscaler.gcp import (  # noqa: F401
+    FakeTpuRestHttp,
+    GcpTpuPodProvider,
+    TpuRestClient,
+)
 from ray_tpu.autoscaler.node_provider import (  # noqa: F401
     LocalNodeProvider,
     NodeProvider,

@@ -45,6 +45,7 @@ class StandardAutoscaler:
         self.gcs_address = gcs_address
         self.update_interval_s = update_interval_s
         self._idle_since: Dict[str, float] = {}   # provider_node_id -> t
+        self._boot_since: Dict[str, float] = {}   # provider_node_id -> t
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self._last_error: Optional[str] = None
@@ -68,44 +69,103 @@ class StandardAutoscaler:
         """Returns {"launched": n, "terminated": m} for observability."""
         load = [n for n in self._cluster_load() if n["alive"]]
         provider_nodes = self.provider.non_terminated_nodes()
+        reaped = self._reap_boot_failures(load, provider_nodes)
+        if reaped:
+            provider_nodes = self.provider.non_terminated_nodes()
         launched = self._scale_up(load, provider_nodes)
         terminated = self._scale_down(load, provider_nodes)
-        return {"launched": launched, "terminated": terminated}
+        return {"launched": launched, "terminated": terminated + reaped}
+
+    def _booting(self, p: Dict, load: List[Dict]) -> bool:
+        """Provider node created but not all hosts joined the GCS yet."""
+        return len(self._gcs_nodes_for(p, load)) < p.get("num_hosts", 1)
+
+    def _reap_boot_failures(self, load: List[Dict],
+                            provider_nodes: List[Dict]) -> int:
+        """Terminate nodes whose hosts never joined within boot_timeout_s.
+
+        Without this, a slice whose startup script fails would count as
+        in-flight headroom forever: scale-up sees the demand "covered",
+        scale-down sees "still booting", and the cluster deadlocks with a
+        billed, useless slice.
+        """
+        boot_timeout = self.config.get("boot_timeout_s", 600.0)
+        now = time.time()
+        reaped = 0
+        for p in provider_nodes:
+            pid = p["provider_node_id"]
+            if not self._booting(p, load):
+                self._boot_since.pop(pid, None)
+                continue
+            self._boot_since.setdefault(pid, now)
+            if now - self._boot_since[pid] >= boot_timeout:
+                self._last_error = (f"node {pid} failed to join within "
+                                    f"{boot_timeout}s; terminating")
+                self.provider.terminate_node(pid)
+                self._boot_since.pop(pid, None)
+                reaped += 1
+        return reaped
 
     def _scale_up(self, load: List[Dict], provider_nodes: List[Dict]) -> int:
-        # unsatisfied demand = queued requests no node could run NOW
-        demands: List[Dict[str, float]] = []
+        # unsatisfied demand = queued requests no node could run NOW.
+        # Each demand is (resources, anti_affinity_group): bundles of a
+        # STRICT_SPREAD gang carry their PG id so the bin-pack never counts
+        # two of them against ONE node's headroom (they could never commit
+        # there — reference: resource_demand_scheduler carries PG strategy).
+        demands: List[tuple] = []
         for n in load:
             for d in n.get("queued_demands", []):
-                demands.extend([dict(d["resources"])] * int(d["count"]))
+                item = (dict(d["resources"]), d.get("strict_spread_group"))
+                demands.extend([item] * int(d["count"]))
         if not demands:
             return 0
-        headroom = [dict(n["available"]) for n in load]
-        unsatisfied: List[Dict[str, float]] = []
-        for demand in demands:
-            placed = False
-            for h in headroom:
-                if _fits(h, demand):
-                    _subtract(h, demand)
-                    placed = True
-                    break
-            if not placed:
-                unsatisfied.append(demand)
+        headroom = [{"res": dict(n["available"]), "groups": []}
+                    for n in load]
+        # In-flight capacity: provider nodes that haven't joined the GCS yet
+        # (cloud slices provision asynchronously — create returns before the
+        # hosts boot). Count their full spec as headroom or every reconcile
+        # tick during boot would launch ANOTHER slice for the same demand
+        # (reference: resource_demand_scheduler's pending-launch accounting).
+        node_types = self.config.get("node_types", {})
+        for p in provider_nodes:
+            if self._booting(p, load):
+                spec = node_types.get(p.get("node_type"))
+                if spec:
+                    # A multi-host slice can hold num_hosts strict-spread
+                    # bundles; a plain node one per group.
+                    headroom.append({"res": dict(spec["resources"]),
+                                     "groups": [],
+                                     "slots": p.get("num_hosts", 1)})
+
+        def try_place(entry, res, group) -> bool:
+            if not _fits(entry["res"], res):
+                return False
+            if group is not None:
+                if entry["groups"].count(group) >= entry.get("slots", 1):
+                    return False
+            _subtract(entry["res"], res)
+            if group is not None:
+                entry["groups"].append(group)
+            return True
+
+        unsatisfied: List[tuple] = []
+        for res, group in demands:
+            if not any(try_place(h, res, group) for h in headroom):
+                unsatisfied.append((res, group))
         if not unsatisfied:
             return 0
 
         max_workers = self.config.get("max_workers", 8)
         current = len(provider_nodes)
         launched = 0
-        node_types = self.config.get("node_types", {})
         # greedy: pack unsatisfied demand onto new nodes of the first
         # feasible type (reference packs via utilization scores; the greedy
         # first-fit keeps v1 predictable)
         while unsatisfied and current + launched < max_workers:
-            demand = unsatisfied[0]
+            res0, _ = unsatisfied[0]
             chosen = None
             for type_name, spec in node_types.items():
-                if _fits(spec["resources"], demand):
+                if _fits(spec["resources"], res0):
                     per_type = sum(1 for p in provider_nodes
                                    if p["node_type"] == type_name)
                     if per_type + launched < spec.get("max_workers",
@@ -124,27 +184,42 @@ class StandardAutoscaler:
                 break
             launched += 1
             # drain every demand this new node absorbs
-            head = dict(spec["resources"])
-            still = []
-            for d in unsatisfied:
-                if _fits(head, d):
-                    _subtract(head, d)
-                else:
-                    still.append(d)
-            unsatisfied = still
+            head = {"res": dict(spec["resources"]), "groups": [],
+                    "slots": spec.get("num_hosts", 1)}
+            unsatisfied = [(res, group) for res, group in unsatisfied
+                           if not try_place(head, res, group)]
         return launched
+
+    def _gcs_nodes_for(self, p: Dict, load: List[Dict]) -> List[Dict]:
+        """GCS nodes belonging to one provider node. A single-host provider
+        records the gcs_node_id it saw at boot; a pod-slice provider can't
+        (hosts join asynchronously), so its hosts are found by the
+        tpu-slice-name label they registered with."""
+        from ray_tpu.core.resources import LABEL_SLICE_NAME
+
+        gid = p.get("gcs_node_id")
+        if gid is not None:
+            return [n for n in load if n["node_id"] == gid]
+        slice_name = p.get("labels", {}).get(LABEL_SLICE_NAME)
+        if slice_name:
+            return [n for n in load
+                    if n.get("labels", {}).get(LABEL_SLICE_NAME) == slice_name]
+        return []
 
     def _scale_down(self, load: List[Dict], provider_nodes: List[Dict]) -> int:
         min_workers = self.config.get("min_workers", 0)
         idle_timeout = self.config.get("idle_timeout_s", 60.0)
-        by_gcs_id = {n["node_id"]: n for n in load}
         now = time.time()
         removable = []
         for p in provider_nodes:
-            gnode = by_gcs_id.get(p.get("gcs_node_id"))
-            idle = (gnode is not None
-                    and gnode["available"] == gnode["total"]
-                    and not gnode.get("queued_demands"))
+            gnodes = self._gcs_nodes_for(p, load)
+            # A slice is idle only if ALL its hosts have joined AND all are
+            # idle — a partially-joined slice must not start the idle clock
+            # (boot skew would get it reaped mid-boot; boot failures are
+            # _reap_boot_failures' job, on the longer boot timeout).
+            idle = (len(gnodes) >= p.get("num_hosts", 1)) and all(
+                g["available"] == g["total"] and not g.get("queued_demands")
+                for g in gnodes)
             if idle:
                 self._idle_since.setdefault(p["provider_node_id"], now)
                 if now - self._idle_since[p["provider_node_id"]] >= idle_timeout:

@@ -1,0 +1,312 @@
+"""GCP TPU-pod node provider: provision whole pod slices via the TPU VM API.
+
+Reference analog: ``python/ray/autoscaler/_private/gcp/node_provider.py`` +
+``node.py:GCPTPUNode`` (the v2 TPU REST surface: ``tpu.googleapis.com/v2
+/projects/{p}/locations/{zone}/nodes``) and the pod YAMLs
+(``autoscaler/gcp/tpu.yaml``, ``example-tpu-pod-topology.yaml``). Redesigned
+TPU-first rather than ported:
+
+  - **One provider node == one pod slice.** The reference treats each TPU VM
+    host as a separate cloud node and leaves gang semantics to Ray; here the
+    slice is the provisioning atom (a v5p-16 create yields all its hosts at
+    once, and a terminate releases the whole slice), matching how the TPU API
+    itself works and how ``slice_group()`` reserves capacity.
+  - Every host boots ``node_main`` with topology labels
+    (``tpu-slice-name``/``tpu-slice-topology``/``tpu-worker-id`` —
+    ``core/resources.py:31``), so scheduler slice-affinity and
+    ``mesh_for_slice_group`` work with zero extra plumbing.
+  - The HTTP transport and auth-token source are injectable: tests run the
+    full provider against ``FakeTpuRestHttp`` (which "boots" hosts as real
+    local ``node_main`` daemons); production uses urllib + the GCE metadata
+    token — this environment has zero egress, so the real transport is
+    exercised only by its unit seam.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import uuid
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ray_tpu.autoscaler.node_provider import NodeProvider
+from ray_tpu.core.resources import (
+    LABEL_SLICE_NAME,
+    LABEL_SLICE_TOPOLOGY,
+)
+
+TPU_API_BASE = "https://tpu.googleapis.com/v2"
+METADATA_TOKEN_URL = ("http://metadata.google.internal/computeMetadata/v1/"
+                      "instance/service-accounts/default/token")
+
+# http transport signature: (method, url, headers, body_json_or_None)
+#   -> (status_code, response_dict)
+HttpFn = Callable[[str, str, Dict[str, str], Optional[Dict]],
+                  Tuple[int, Dict]]
+
+
+def _urllib_http(method: str, url: str, headers: Dict[str, str],
+                 body: Optional[Dict]) -> Tuple[int, Dict]:
+    import urllib.error
+    import urllib.request
+
+    data = None if body is None else json.dumps(body).encode()
+    req = urllib.request.Request(url, data=data, method=method,
+                                 headers={**headers,
+                                          "Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return resp.status, json.loads(resp.read() or b"{}")
+    except urllib.error.HTTPError as e:
+        try:
+            payload = json.loads(e.read() or b"{}")
+        except ValueError:
+            payload = {}
+        return e.code, payload
+
+
+def _metadata_token() -> str:
+    status, payload = _urllib_http(
+        "GET", METADATA_TOKEN_URL, {"Metadata-Flavor": "Google"}, None)
+    if status != 200:
+        raise RuntimeError(f"metadata token fetch failed: HTTP {status}")
+    return payload["access_token"]
+
+
+class TpuRestClient:
+    """Thin typed wrapper over the TPU VM v2 REST nodes collection."""
+
+    def __init__(self, project: str, zone: str,
+                 http: Optional[HttpFn] = None,
+                 token_provider: Optional[Callable[[], str]] = None,
+                 base_url: str = TPU_API_BASE):
+        self.project = project
+        self.zone = zone
+        self._http = http or _urllib_http
+        self._token = token_provider or _metadata_token
+        self._base = base_url
+
+    @property
+    def _parent(self) -> str:
+        return f"projects/{self.project}/locations/{self.zone}"
+
+    def _call(self, method: str, path: str,
+              body: Optional[Dict] = None) -> Dict:
+        headers = {"Authorization": f"Bearer {self._token()}"}
+        status, payload = self._http(method, f"{self._base}/{path}", headers,
+                                     body)
+        if status >= 400:
+            raise RuntimeError(
+                f"TPU API {method} {path} failed: HTTP {status} "
+                f"{payload.get('error', payload)}")
+        return payload
+
+    def create_node(self, node_id: str, body: Dict) -> Dict:
+        return self._call("POST", f"{self._parent}/nodes?nodeId={node_id}",
+                          body)
+
+    def delete_node(self, node_id: str) -> Dict:
+        return self._call("DELETE", f"{self._parent}/nodes/{node_id}")
+
+    def get_node(self, node_id: str) -> Dict:
+        return self._call("GET", f"{self._parent}/nodes/{node_id}")
+
+    def list_nodes(self) -> List[Dict]:
+        return self._call("GET", f"{self._parent}/nodes").get("nodes", [])
+
+
+class GcpTpuPodProvider(NodeProvider):
+    """Autoscaler NodeProvider provisioning TPU pod slices.
+
+    ``node_types`` spec per type (the cluster-YAML essentials)::
+
+        {"v5p_16": {"accelerator_type": "v5p-16",   # or topology+generation
+                    "topology": "2x2x2",            # optional (XOR with type)
+                    "runtime_version": "tpu-ubuntu2204-base",
+                    "num_hosts": 2,                 # host VMs per slice
+                    "resources": {"CPU": 2, "TPU": 8}}}   # SLICE aggregate
+
+    ``resources`` is the slice-aggregate bag the autoscaler bin-packs
+    against (StandardAutoscaler treats one provider node as one unit of
+    capacity — for a pod slice that unit is the whole slice).
+    """
+
+    def __init__(self, gcs_address: str, project: str, zone: str,
+                 node_types: Dict[str, Dict],
+                 cluster_name: str = "rt",
+                 rest: Optional[TpuRestClient] = None):
+        self.gcs_address = gcs_address
+        self.cluster_name = cluster_name
+        self.node_types = dict(node_types)
+        self.rest = rest or TpuRestClient(project, zone)
+
+    # -- helpers --------------------------------------------------------------
+    def _startup_script(self, slice_name: str, spec: Dict) -> str:
+        labels = {LABEL_SLICE_NAME: slice_name,
+                  LABEL_SLICE_TOPOLOGY: spec.get("topology", "")}
+        # TPU_WORKER_ID is set by the TPU runtime on each host VM; chips and
+        # generation are autodetected by node_main (accelerator.py).
+        return (
+            "#!/bin/bash\n"
+            "# ray_tpu worker bring-up (assumes the image bakes the wheel)\n"
+            f"python -m ray_tpu.cluster.node_main "
+            f"--address {self.gcs_address} "
+            f"--labels '{json.dumps(labels)}'\n")
+
+    # -- NodeProvider ---------------------------------------------------------
+    def create_node(self, node_type: str, resources: Dict[str, float],
+                    labels: Dict[str, str]) -> str:
+        spec = self.node_types[node_type]
+        slice_name = f"{self.cluster_name}-{node_type}-{uuid.uuid4().hex[:6]}"
+        body = {
+            "runtimeVersion": spec.get("runtime_version",
+                                       "tpu-ubuntu2204-base"),
+            "labels": {"rt-cluster": self.cluster_name,
+                       "rt-node-type": node_type,
+                       **{k.replace("/", "-"): v for k, v in labels.items()}},
+            "metadata": {"startup-script":
+                         self._startup_script(slice_name, spec)},
+        }
+        # The v2 API takes EXACTLY ONE of acceleratorType ("v5p-16") or
+        # acceleratorConfig ({type, topology}) — sending both is a 400.
+        if spec.get("topology"):
+            body["acceleratorConfig"] = {
+                "type": spec.get("chip_generation", "V5P"),
+                "topology": spec["topology"]}
+        else:
+            body["acceleratorType"] = spec["accelerator_type"]
+        self.rest.create_node(slice_name, body)
+        return slice_name
+
+    def terminate_node(self, provider_node_id: str) -> None:
+        self.rest.delete_node(provider_node_id)
+
+    def non_terminated_nodes(self) -> List[Dict]:
+        out = []
+        for node in self.rest.list_nodes():
+            node_labels = node.get("labels", {})
+            if node_labels.get("rt-cluster") != self.cluster_name:
+                continue
+            if node.get("state") in ("DELETING", "TERMINATED", "PREEMPTED"):
+                continue
+            name = node.get("name", "").rsplit("/", 1)[-1]
+            out.append({
+                "provider_node_id": name,
+                "node_type": node_labels.get("rt-node-type", ""),
+                "labels": {LABEL_SLICE_NAME: name,
+                           LABEL_SLICE_TOPOLOGY: node.get(
+                               "acceleratorConfig", {}).get("topology", ""),
+                           **node_labels},
+                "created_at": node.get("createTime", 0) or 0,
+                "num_hosts": len(node.get("networkEndpoints", [])) or 1,
+            })
+        return out
+
+
+class FakeTpuRestHttp:
+    """In-memory TPU REST API double that BOOTS real local nodes.
+
+    Reference analog: ``autoscaler/_private/fake_multi_node/node_provider.py``
+    — fake the cloud, keep everything below it real. A create "provisions"
+    ``num_hosts`` × ``node_main`` daemons (one per pod-slice host, each with
+    the slice's topology labels), so the autoscaler test exercises the
+    actual join/heartbeat/scheduling path; a delete terminates them.
+    ``shapes`` maps accelerator_type -> (num_hosts, chips_per_host).
+    """
+
+    def __init__(self, gcs_address: str,
+                 shapes: Dict[str, Tuple[int, int]],
+                 cpus_per_host: float = 1):
+        self.gcs_address = gcs_address
+        self.shapes = dict(shapes)
+        self.cpus_per_host = cpus_per_host
+        self.nodes: Dict[str, Dict] = {}       # slice name -> REST node dict
+        self._procs: Dict[str, List] = {}      # slice name -> host processes
+        self.requests: List[Tuple[str, str]] = []
+
+    # -- the HttpFn ----------------------------------------------------------
+    def __call__(self, method: str, url: str, headers: Dict[str, str],
+                 body: Optional[Dict]) -> Tuple[int, Dict]:
+        assert headers.get("Authorization", "").startswith("Bearer "), \
+            "request without auth token"
+        path = url.split("/v2/", 1)[1]
+        self.requests.append((method, path))
+        if method == "POST" and "?nodeId=" in path:
+            name = path.split("?nodeId=", 1)[1]
+            return self._create(name, body)
+        if method == "DELETE":
+            return self._delete(path.rsplit("/", 1)[-1])
+        if method == "GET" and path.endswith("/nodes"):
+            return 200, {"nodes": [dict(n) for n in self.nodes.values()]}
+        if method == "GET":
+            name = path.rsplit("/", 1)[-1]
+            if name not in self.nodes:
+                return 404, {"error": "not found"}
+            return 200, dict(self.nodes[name])
+        return 400, {"error": f"unhandled {method} {path}"}
+
+    def _create(self, name: str, body: Dict) -> Tuple[int, Dict]:
+        if name in self.nodes:
+            return 409, {"error": "already exists"}
+        # Mirror the real API contract: exactly one accelerator field.
+        acc = body.get("acceleratorType", "")
+        topology = body.get("acceleratorConfig", {}).get("topology", "")
+        if bool(acc) == bool(topology):
+            return 400, {"error": "exactly one of acceleratorType / "
+                                  "acceleratorConfig must be set"}
+        key = acc or topology    # shapes may be keyed by either form
+        if key not in self.shapes:
+            return 400, {"error": f"unknown accelerator shape {key!r}"}
+        num_hosts, chips = self.shapes[key]
+        self._boot_hosts(name, topology, num_hosts, chips)
+        self.nodes[name] = {
+            "name": name, "state": "READY",
+            "acceleratorType": acc,
+            "acceleratorConfig": body.get("acceleratorConfig", {}),
+            "labels": dict(body.get("labels", {})),
+            "createTime": time.time(),
+            "networkEndpoints": [{"ipAddress": f"10.0.0.{i}"}
+                                 for i in range(num_hosts)],
+        }
+        return 200, {"name": f"operations/create-{name}", "done": True}
+
+    def _delete(self, name: str) -> Tuple[int, Dict]:
+        if name not in self.nodes:
+            return 404, {"error": "not found"}
+        for proc in self._procs.pop(name, []):
+            proc.terminate()
+        self.nodes.pop(name)
+        return 200, {"name": f"operations/delete-{name}", "done": True}
+
+    def _boot_hosts(self, slice_name: str, topology: str, num_hosts: int,
+                    chips: int) -> None:
+        import os
+        import subprocess
+        import sys
+
+        import ray_tpu
+
+        repo_root = os.path.dirname(os.path.dirname(
+            os.path.abspath(ray_tpu.__file__)))
+        env = dict(os.environ)
+        env["PYTHONPATH"] = ":".join(
+            [repo_root] + [p for p in env.get("PYTHONPATH", "").split(":")
+                           if p])
+        procs = []
+        for worker_id in range(num_hosts):
+            labels = {LABEL_SLICE_NAME: slice_name,
+                      LABEL_SLICE_TOPOLOGY: topology,
+                      "tpu-worker-id": str(worker_id)}
+            args = [sys.executable, "-m", "ray_tpu.cluster.node_main",
+                    "--address", self.gcs_address,
+                    "--num-cpus", str(self.cpus_per_host),
+                    "--num-tpus", str(chips),
+                    "--labels", json.dumps(labels)]
+            procs.append(subprocess.Popen(
+                args, env=env, stdout=subprocess.DEVNULL,
+                stderr=subprocess.DEVNULL, start_new_session=True))
+        self._procs[slice_name] = procs
+
+    def shutdown(self) -> None:
+        for name in list(self.nodes):
+            self._delete(name)

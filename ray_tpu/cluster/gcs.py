@@ -291,6 +291,30 @@ class GcsServer:
                 "available": n.view.available.to_dict(),
                 "queued_demands": getattr(n, "queued_demands", []),
             })
+        # Unplaced placement-group bundles are cluster-level demand (PGs are
+        # scheduled by the GCS, so they never sit in any raylet's queue);
+        # ride them on a synthetic zero-capacity entry so the autoscaler
+        # bin-packs gang reservations too — a pending slice_group() is
+        # exactly what should provision a TPU pod slice (reference:
+        # resource_demand_scheduler handles pending PGs the same way).
+        pending = []
+        for pg in self.placement_groups.values():
+            if pg.state == PG_PENDING:
+                for i, b in enumerate(pg.bundles):
+                    if pg.bundle_nodes[i] is None:
+                        d = {"resources": dict(b), "count": 1}
+                        # STRICT_SPREAD bundles can never share a node —
+                        # the autoscaler's bin-pack must know (else a gang
+                        # that numerically fits one node never provisions).
+                        if pg.strategy == "STRICT_SPREAD":
+                            d["strict_spread_group"] = pg.pg_id
+                        pending.append(d)
+        if pending:
+            out.append({
+                "node_id": "@pending_pg_bundles", "alive": True,
+                "labels": {}, "total": {}, "available": {},
+                "queued_demands": pending[:100],
+            })
         return out
 
     async def rpc_list_nodes(self, p):

@@ -100,6 +100,8 @@ async def _amain(args: argparse.Namespace) -> None:
 
     node_id = uuid.uuid4().hex
     labels = dict(accelerator.tpu_node_labels())
+    if args.labels:
+        labels.update(json.loads(args.labels))
     labels["session"] = session_name
     if args.head:
         labels["node_role"] = "head"
@@ -156,6 +158,9 @@ def main(argv=None) -> None:
     p.add_argument("--num-cpus", type=float, default=None)
     p.add_argument("--num-tpus", type=float, default=None)
     p.add_argument("--resources", default=None, help="JSON dict of extras")
+    p.add_argument("--labels", default=None,
+                   help="JSON dict of node labels (e.g. tpu-slice-name from "
+                        "a pod-slice provider) merged over autodetected ones")
     p.add_argument("--session-name", default=None)
     args = p.parse_args(argv)
     if not args.head and not args.address:
