@@ -131,11 +131,20 @@ class StandardAutoscaler:
             if self._booting(p, load):
                 spec = node_types.get(p.get("node_type"))
                 if spec:
-                    # A multi-host slice can hold num_hosts strict-spread
-                    # bundles; a plain node one per group.
-                    headroom.append({"res": dict(spec["resources"]),
-                                     "groups": [],
-                                     "slots": p.get("num_hosts", 1)})
+                    # Only the NOT-yet-joined hosts' share: joined hosts
+                    # already contribute real headroom through the load
+                    # report — counting the full spec would double-count a
+                    # partially-joined slice's capacity.
+                    hosts = max(1, p.get("num_hosts", 1))
+                    joined = len(self._gcs_nodes_for(p, load))
+                    missing = max(0, hosts - joined)
+                    frac = missing / hosts
+                    headroom.append({
+                        "res": {k: v * frac
+                                for k, v in spec["resources"].items()},
+                        "groups": [],
+                        "slots": missing,
+                    })
 
         def try_place(entry, res, group) -> bool:
             if not _fits(entry["res"], res):

@@ -112,7 +112,16 @@ class TpuRestClient:
         return self._call("GET", f"{self._parent}/nodes/{node_id}")
 
     def list_nodes(self) -> List[Dict]:
-        return self._call("GET", f"{self._parent}/nodes").get("nodes", [])
+        nodes: List[Dict] = []
+        token = ""
+        while True:
+            path = f"{self._parent}/nodes" + (
+                f"?pageToken={token}" if token else "")
+            payload = self._call("GET", path)
+            nodes.extend(payload.get("nodes", []))
+            token = payload.get("nextPageToken", "")
+            if not token:
+                return nodes
 
 
 class GcpTpuPodProvider(NodeProvider):
@@ -190,15 +199,23 @@ class GcpTpuPodProvider(NodeProvider):
             if node.get("state") in ("DELETING", "TERMINATED", "PREEMPTED"):
                 continue
             name = node.get("name", "").rsplit("/", 1)[-1]
+            node_type = node_labels.get("rt-node-type", "")
+            # num_hosts from OUR node-type spec, not networkEndpoints: a
+            # CREATING slice has no endpoints yet, and under-reporting host
+            # count breaks the autoscaler's booting/slot accounting
+            # (double-provisioning, mid-boot idle reaping).
+            spec_hosts = self.node_types.get(node_type, {}).get("num_hosts")
             out.append({
                 "provider_node_id": name,
-                "node_type": node_labels.get("rt-node-type", ""),
+                "node_type": node_type,
                 "labels": {LABEL_SLICE_NAME: name,
                            LABEL_SLICE_TOPOLOGY: node.get(
                                "acceleratorConfig", {}).get("topology", ""),
                            **node_labels},
                 "created_at": node.get("createTime", 0) or 0,
-                "num_hosts": len(node.get("networkEndpoints", [])) or 1,
+                "num_hosts": (spec_hosts
+                              or len(node.get("networkEndpoints", []))
+                              or 1),
             })
         return out
 

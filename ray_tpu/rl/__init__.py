@@ -29,6 +29,15 @@ from ray_tpu.rl.algorithms import (  # noqa: F401
     TD3Config,
 )
 from ray_tpu.rl.config import AlgorithmConfig  # noqa: F401
+from ray_tpu.rl.connectors import (  # noqa: F401
+    ClipObs,
+    ClipReward,
+    Connector,
+    ConnectorPipeline,
+    MeanStdFilter,
+    build_connectors,
+)
+from ray_tpu.rl import ope  # noqa: F401
 from ray_tpu.rl.multi_agent import (  # noqa: F401
     CoordinationGame,
     MultiAgentEnv,

@@ -45,9 +45,18 @@ class Algorithm(Trainable):
                 cfg.env, cfg.num_envs_per_runner,
                 cfg.rollout_fragment_length, cfg.gamma, cfg.lambda_,
                 seed=cfg.seed + 1000 * i, env_config=cfg.env_config,
-                explore=self.explore_mode)
+                explore=self.explore_mode, connectors=cfg.connectors)
             for i in range(n_runners)
         ]
+        # driver-side pipeline skeleton: holds/merges the global connector
+        # state the runner fleet syncs through (reference: filter deltas
+        # flushed to the driver and re-broadcast each iteration)
+        from ray_tpu.rl.connectors import build_connectors
+
+        self._conn_pipeline = (build_connectors(cfg.connectors,
+                                                self.spec.obs_dim)
+                               if n_runners else None)
+        self._connector_state = None
         self._env_steps_total = 0
         self._return_window: List[float] = []
         self.build_learner()
@@ -70,6 +79,7 @@ class Algorithm(Trainable):
         (reference: ``rollout_ops.synchronous_parallel_sample``)."""
         batches = ray_tpu.get([r.sample.remote(params)
                                for r in self.runners])
+        self._sync_connectors()
         batch = {k: np.concatenate([b[k] for b in batches])
                  for k in batches[0]}
         n = len(batch["rewards"])
@@ -78,6 +88,18 @@ class Algorithm(Trainable):
         batch = {k: v for k, v in batch.items() if len(v) == n}
         self._env_steps_total += n
         return batch
+
+    def _sync_connectors(self) -> None:
+        """Merge runner connector deltas into the global state, broadcast
+        back — every runner then normalizes with the FLEET's statistics."""
+        if self._conn_pipeline is None:
+            return
+        deltas = ray_tpu.get([r.pop_connector_deltas.remote()
+                              for r in self.runners])
+        self._connector_state = self._conn_pipeline.merge_deltas(
+            self._connector_state, [d for d in deltas if d is not None])
+        ray_tpu.get([r.set_connector_globals.remote(self._connector_state)
+                     for r in self.runners])
 
     def collect_episode_stats(self) -> Dict[str, float]:
         stats = ray_tpu.get([r.episode_stats.remote()
@@ -100,11 +122,16 @@ class Algorithm(Trainable):
         params = jax.tree_util.tree_map(np.asarray, self.get_params())
         return {"params": params,
                 "env_steps_total": self._env_steps_total,
+                "connector_state": self._connector_state,
                 "extra": self.get_extra_state()}
 
     def load_checkpoint(self, checkpoint: Dict) -> None:
         self.set_params(checkpoint["params"])
         self._env_steps_total = checkpoint.get("env_steps_total", 0)
+        self._connector_state = checkpoint.get("connector_state")
+        if self._connector_state is not None and self._conn_pipeline:
+            ray_tpu.get([r.set_connector_globals.remote(self._connector_state)
+                         for r in self.runners])
         self.set_extra_state(checkpoint.get("extra"))
 
     def get_params(self):

@@ -139,7 +139,8 @@ class DDPG(Algorithm):
              "dones": batch["dones"]})
         metrics: Dict[str, Any] = {"buffer_size": len(self.buffer)}
         if len(self.buffer) >= cfg.learning_starts:
-            num_updates = max(1, len(batch["rewards"]) // cfg.minibatch_size)
+            num_updates = (cfg.updates_per_iter or
+                           max(1, len(batch["rewards"]) // cfg.minibatch_size))
             for _ in range(num_updates):
                 mb = self.buffer.sample(cfg.minibatch_size)
                 self._updates += 1

@@ -98,7 +98,8 @@ class DQN(Algorithm):
         metrics: Dict[str, Any] = {"epsilon": self._epsilon(),
                                    "buffer_size": len(self.buffer)}
         if len(self.buffer) >= cfg.learning_starts:
-            num_updates = max(1, len(batch["rewards"]) // cfg.minibatch_size)
+            num_updates = (cfg.updates_per_iter or
+                           max(1, len(batch["rewards"]) // cfg.minibatch_size))
             td_list = []
             for _ in range(num_updates):
                 target_before = self.learner.params["target"]

@@ -39,6 +39,10 @@ def _squashed_sample_logp(mean, log_std, key, low, high):
 
 
 class SAC(Algorithm):
+    # execute the same tanh-squashed policy the learner optimizes (the raw
+    # runner protocol would act on pre-squash means — a different policy)
+    explore_mode = "squashed_gaussian"
+
     @classmethod
     def get_default_config(cls) -> AlgorithmConfig:
         cfg = AlgorithmConfig(algo_class=cls)
@@ -132,9 +136,11 @@ class SAC(Algorithm):
         self._pi_dist = pi_dist
 
     def _runner_params(self):
-        """Adapt SAC's pi-net to the EnvRunner's (logits, log_std) protocol:
-        the runner samples an unsquashed gaussian and clips — exploration
-        only; training recomputes exact squashed logps from the buffer."""
+        """Adapt SAC's pi-net to the EnvRunner protocol: the runner (in
+        ``squashed_gaussian`` explore mode) executes mid + half*tanh(mean +
+        std*eps) — the same squashed policy the learner optimizes, with a
+        fixed exploration std (per-state log_std can't ride the protocol).
+        Training recomputes exact squashed logps from the buffer."""
         p = self.learner.get_params()
         # runner calls policy_logits(params, obs) -> mean and uses
         # params["log_std"]; slice the pi-net's final layer to its mean half
@@ -160,7 +166,8 @@ class SAC(Algorithm):
              "dones": batch["dones"]})
         metrics: Dict[str, Any] = {"buffer_size": len(self.buffer)}
         if len(self.buffer) >= cfg.learning_starts:
-            num_updates = max(1, len(batch["rewards"]) // cfg.minibatch_size)
+            num_updates = (cfg.updates_per_iter or
+                           max(1, len(batch["rewards"]) // cfg.minibatch_size))
             for _ in range(num_updates):
                 m = self.learner.update_minibatch(
                     self.buffer.sample(cfg.minibatch_size))

@@ -24,6 +24,9 @@ class AlgorithmConfig:
     num_envs_per_runner: int = 8
     rollout_fragment_length: int = 64
     num_cpus_per_runner: float = 1
+    # connector pipeline specs, e.g. ["mean_std_filter",
+    # {"type": "clip_reward", "limit": 1.0}] (rl/connectors.py)
+    connectors: Any = None
     # training
     lr: float = 3e-4
     gamma: float = 0.99
@@ -46,6 +49,10 @@ class AlgorithmConfig:
     target_update_freq: int = 500
     buffer_size: int = 100_000
     learning_starts: int = 1_000
+    # replay-trained algos (DQN/SAC/DDPG/TD3): gradient updates per
+    # training_step; 0 derives it from sampled-steps/minibatch (reference:
+    # DQN's training_intensity ratio)
+    updates_per_iter: int = 0
     double_q: bool = True
     prioritized_replay: bool = False
     replay_alpha: float = 0.6
@@ -83,12 +90,14 @@ class AlgorithmConfig:
     def env_runners(self, num_env_runners: Optional[int] = None,
                     num_envs_per_runner: Optional[int] = None,
                     rollout_fragment_length: Optional[int] = None,
-                    num_cpus_per_runner: Optional[float] = None
+                    num_cpus_per_runner: Optional[float] = None,
+                    connectors: Optional[list] = None
                     ) -> "AlgorithmConfig":
         for k, v in (("num_env_runners", num_env_runners),
                      ("num_envs_per_runner", num_envs_per_runner),
                      ("rollout_fragment_length", rollout_fragment_length),
-                     ("num_cpus_per_runner", num_cpus_per_runner)):
+                     ("num_cpus_per_runner", num_cpus_per_runner),
+                     ("connectors", connectors)):
             if v is not None:
                 setattr(self, k, v)
         return self

@@ -44,18 +44,25 @@ class EnvRunner:
     def __init__(self, env_name: str, num_envs: int, rollout_len: int,
                  gamma: float = 0.99, lam: float = 0.95, seed: int = 0,
                  env_config: Optional[Dict] = None,
-                 explore: str = "stochastic"):
+                 explore: str = "stochastic",
+                 connectors: Optional[list] = None):
         import jax
         import jax.numpy as jnp
+
+        from ray_tpu.rl.connectors import build_connectors
 
         self._env = make_env(env_name, num_envs, env_config, seed=seed)
         self.spec = self._env.spec
         self._rollout_len = rollout_len
         self._gamma, self._lam = gamma, lam
         self._key = jax.random.key(seed)
-        self._obs = self._env.reset()
+        self._obs = self._env.reset()          # RAW env obs
         self._episode_returns = np.zeros(num_envs, dtype=np.float64)
         self._completed: list = []
+        # Connector pipeline (obs normalization / reward clipping); the
+        # FILTERED view is what the policy sees and what the batch stores,
+        # so actor and learner share one normalized space.
+        self._connectors = build_connectors(connectors, self.spec.obs_dim)
 
         spec = self.spec
 
@@ -80,6 +87,16 @@ class EnvRunner:
             elif spec.discrete:
                 actions = models.categorical_sample(key, logits)
                 logp = models.categorical_logp(logits, actions)
+            elif explore == "squashed_gaussian":
+                # SAC-style: the EXECUTED action is the tanh-squashed
+                # rescaled sample — matching the policy the learner
+                # optimizes (logp unused by replay-based learners).
+                std = jnp.exp(params["log_std"])
+                pre = logits + std * jax.random.normal(key, logits.shape)
+                half = (spec.action_high - spec.action_low) / 2.0
+                mid = (spec.action_high + spec.action_low) / 2.0
+                actions = mid + half * jnp.tanh(pre)
+                logp = jnp.zeros(actions.shape[:-1])
             else:
                 actions = models.gaussian_sample(
                     key, logits, params["log_std"])
@@ -116,11 +133,14 @@ class EnvRunner:
 
         exec_buf = (act_buf if self.spec.discrete
                     else np.zeros_like(act_buf))
+        conn = self._connectors
         for t in range(T):
             self._key, sub = jax.random.split(self._key)
-            actions, logp, vals = self._act(params, self._obs, sub)
+            obs_in = (conn.on_obs(self._obs) if conn is not None
+                      else self._obs)
+            actions, logp, vals = self._act(params, obs_in, sub)
             actions = np.asarray(actions)
-            obs_buf[t] = self._obs
+            obs_buf[t] = obs_in
             # "actions" stores the raw policy sample (PPO's ratio needs the
             # logp-consistent action); "actions_executed" stores what the
             # env actually ran (what replay-based critics must train on)
@@ -132,17 +152,24 @@ class EnvRunner:
                                   self.spec.action_high)
                 exec_buf[t] = actions
             self._obs, rewards, dones = self._env.step(actions)
-            rew_buf[t] = rewards
+            # training signal may be clipped; episode stats stay RAW
+            rew_buf[t] = (conn.on_reward(rewards) if conn is not None
+                          else rewards)
             done_buf[t] = dones
-            # post-reset obs on done rows is fine: (1-done) masks bootstrap
-            next_obs_buf[t] = self._obs
+            # post-reset obs on done rows is fine: (1-done) masks bootstrap.
+            # update=False: this same obs is re-filtered (with update) as
+            # obs_in at t+1 — stats must count it once.
+            next_obs_buf[t] = (conn.on_obs(self._obs, update=False)
+                               if conn is not None else self._obs)
             self._episode_returns += rewards
             if dones.any():
                 for r in self._episode_returns[dones]:
                     self._completed.append(float(r))
                 self._episode_returns[dones] = 0.0
 
-        last_values = np.asarray(self._value_fn(params, self._obs))
+        last_obs = (conn.on_obs(self._obs, update=False) if conn is not None
+                    else self._obs)
+        last_values = np.asarray(self._value_fn(params, last_obs))
         gae = compute_gae(rew_buf, val_buf, done_buf, last_values,
                           self._gamma, self._lam)
         flat = lambda a: a.reshape((T * N,) + a.shape[2:])  # noqa: E731
@@ -157,6 +184,15 @@ class EnvRunner:
             # [N] bootstrap for off-policy corrections (IMPALA V-trace)
             "last_values": last_values.astype(np.float32),
         }
+
+    # ---- connector state sync (reference: filter delta flush) ----------
+    def pop_connector_deltas(self):
+        return (self._connectors.pop_deltas()
+                if self._connectors is not None else None)
+
+    def set_connector_globals(self, states) -> None:
+        if self._connectors is not None:
+            self._connectors.set_globals(states)
 
     def episode_stats(self) -> Dict[str, float]:
         completed, self._completed = self._completed, []

@@ -9,13 +9,16 @@ from ray_tpu.train.config import FailureConfig, RunConfig  # noqa: F401
 from ray_tpu.tune.schedulers import (  # noqa: F401
     AsyncHyperBandScheduler,
     FIFOScheduler,
+    HyperBandForBOHB,
     HyperBandScheduler,
     MedianStoppingRule,
+    PB2,
     PopulationBasedTraining,
     TrialScheduler,
 )
 from ray_tpu.tune.search import (  # noqa: F401
     BasicVariantGenerator,
+    BOHBSearcher,
     ConcurrencyLimiter,
     QuasiRandomSearch,
     TPESearcher,

@@ -285,3 +285,156 @@ class PopulationBasedTraining(TrialScheduler):
 
     def pop_mutation(self, trial: Trial):
         return self._pending_mutation.pop(trial.trial_id, None)
+
+
+class HyperBandForBOHB(HyperBandScheduler):
+    """BOHB's scheduling half (reference: ``tune/schedulers/hb_bohb.py``):
+    multi-bracket successive halving that feeds every rung crossing back to
+    the paired ``BOHBSearcher`` so its TPE model trains on the highest
+    fidelity with enough data. Pair via::
+
+        searcher = BOHBSearcher(...)
+        scheduler = HyperBandForBOHB(searcher=searcher, ...)
+        Tuner(..., tune_config=TuneConfig(search_alg=searcher,
+                                          scheduler=scheduler))
+    """
+
+    def __init__(self, *args, searcher=None, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._bohb_searcher = searcher
+        self._last_reported: Dict[str, float] = {}
+
+    def on_trial_result(self, trial: Trial, result: Dict[str, Any]) -> str:
+        decision = super().on_trial_result(trial, result)
+        if self._bohb_searcher is not None:
+            rec = self._trial_recorded.get(trial.trial_id)
+            if rec is not None and \
+                    self._last_reported.get(trial.trial_id) != rec[0]:
+                rung, score = rec
+                self._last_reported[trial.trial_id] = rung
+                self._bohb_searcher.on_rung_result(dict(trial.config),
+                                                   score, rung)
+        return decision
+
+
+class PB2(PopulationBasedTraining):
+    """PBT with GP-bandit explore (reference: ``tune/schedulers/pb2.py``).
+
+    Instead of random 0.8x/1.2x perturbation, the explore step fits a
+    Gaussian process on (normalized time, hyperparams) -> score improvement
+    observed across the population, and picks the candidate maximizing a
+    UCB acquisition within ``hyperparam_bounds`` — far more
+    sample-efficient at small population sizes, which is the whole point
+    (the PB2 paper's regime is 4-8 trials).
+
+    ``hyperparam_bounds``: {key: (low, high)} continuous ranges. Keys not in
+    bounds inherit the exploited trial's value unchanged.
+    """
+
+    def __init__(self, time_attr: str = "training_iteration",
+                 metric: Optional[str] = None, mode: Optional[str] = None,
+                 perturbation_interval: int = 4,
+                 hyperparam_bounds: Optional[Dict[str, Tuple[float, float]]]
+                 = None,
+                 quantile_fraction: float = 0.25,
+                 ucb_kappa: float = 1.0,
+                 n_candidates: int = 64,
+                 max_observations: int = 200, seed: int = 0):
+        super().__init__(time_attr=time_attr, metric=metric, mode=mode,
+                         perturbation_interval=perturbation_interval,
+                         hyperparam_mutations={},
+                         quantile_fraction=quantile_fraction, seed=seed)
+        self._bounds = dict(hyperparam_bounds or {})
+        self._kappa = ucb_kappa
+        self._n_cand = n_candidates
+        self._max_obs = max_observations
+        # GP dataset: X rows = [t_norm, hp_norms...], y = score delta
+        self._X: List[List[float]] = []
+        self._y: List[float] = []
+        self._prev: Dict[str, Tuple[float, float]] = {}  # tid -> (t, score)
+        self._t_max = 1.0
+
+    # -- data collection ------------------------------------------------------
+    def _norm_hp(self, key: str, v: float) -> float:
+        lo, hi = self._bounds[key]
+        return (float(v) - lo) / max(hi - lo, 1e-12)
+
+    def on_trial_result(self, trial: Trial, result: Dict[str, Any]) -> str:
+        t = result.get(self._time_attr, 0)
+        metric = result.get(self._metric)
+        if metric is not None:
+            score = _score(metric, self._mode or "max")
+            self._t_max = max(self._t_max, float(t))
+            prev = self._prev.get(trial.trial_id)
+            if prev is not None and t > prev[0]:
+                # RAW time stored; normalized by the CURRENT t_max at fit
+                # time (normalizing at insertion would freeze each row's
+                # scale to whatever t_max was then — early rows would drift
+                # to a fictitious late-training position as t_max grows).
+                x = [float(prev[0])] + [
+                    self._norm_hp(k, trial.config.get(k, self._bounds[k][0]))
+                    for k in sorted(self._bounds)]
+                self._X.append(x)
+                self._y.append((score - prev[1]) / (t - prev[0]))
+                if len(self._y) > self._max_obs:
+                    self._X.pop(0)
+                    self._y.pop(0)
+            self._prev[trial.trial_id] = (float(t), score)
+        return super().on_trial_result(trial, result)
+
+    def pop_mutation(self, trial: Trial):
+        m = super().pop_mutation(trial)
+        if m is not None:
+            # the next report's score is the EXPLOITED checkpoint's, not a
+            # continuation — a delta across that boundary would poison the GP
+            self._prev.pop(trial.trial_id, None)
+        return m
+
+    # -- GP explore -----------------------------------------------------------
+    def _explore(self, config: Dict[str, Any]) -> Dict[str, Any]:
+        import numpy as np
+
+        new = dict(config)
+        keys = sorted(self._bounds)
+        if not keys:
+            return new
+        if len(self._y) < 4:
+            # cold start: uniform resample within bounds
+            for k in keys:
+                lo, hi = self._bounds[k]
+                v = self._rng.uniform(lo, hi)
+                new[k] = int(round(v)) if isinstance(config.get(k), int) else v
+            return new
+
+        X = np.asarray(self._X, dtype=np.float64)
+        X = X.copy()
+        X[:, 0] /= self._t_max          # normalize raw times at fit time
+        y = np.asarray(self._y, dtype=np.float64)
+        y_std = y.std() or 1.0
+        y_n = (y - y.mean()) / y_std
+        ell, noise = 0.3, 1e-3
+
+        def kern(a, b):
+            d2 = ((a[:, None, :] - b[None, :, :]) ** 2).sum(-1)
+            return np.exp(-0.5 * d2 / ell ** 2)
+
+        K = kern(X, X) + noise * np.eye(len(X))
+        alpha = np.linalg.solve(K, y_n)
+        K_inv = np.linalg.inv(K)
+
+        t_now = 1.0  # explore for the NEXT interval: newest time
+        cands = np.empty((self._n_cand, 1 + len(keys)))
+        cands[:, 0] = t_now
+        for j, k in enumerate(keys):
+            cands[:, 1 + j] = [self._rng.random() for _ in
+                               range(self._n_cand)]
+        Ks = kern(cands, X)
+        mu = Ks @ alpha
+        var = np.maximum(1.0 - np.einsum("ij,jk,ik->i", Ks, K_inv, Ks), 1e-9)
+        ucb = mu + self._kappa * np.sqrt(var)
+        best = cands[int(np.argmax(ucb))]
+        for j, k in enumerate(keys):
+            lo, hi = self._bounds[k]
+            v = lo + best[1 + j] * (hi - lo)
+            new[k] = int(round(v)) if isinstance(config.get(k), int) else v
+        return new

@@ -270,15 +270,21 @@ class TPESearcher(Searcher):
                 return choice
         return domain.categories[-1]
 
+    def _model_observations(self) -> List[tuple]:
+        """(config, score) pairs the density model fits on — subclasses
+        (BOHB) override to pick a fidelity-specific observation set."""
+        return self._obs
+
     # -- Searcher API --------------------------------------------------------
     def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
         if not self._take_budget():
             return None
         space = getattr(self, "_space", None) or {}
         config = {}
-        enough = len(self._obs) >= self._n_initial
+        obs = self._model_observations()
+        enough = len(obs) >= self._n_initial
         if enough:
-            ranked = sorted(self._obs, key=lambda o: -o[1])
+            ranked = sorted(obs, key=lambda o: -o[1])
             n_good = max(1, int(len(ranked) * self._gamma))
             good = [c for c, _ in ranked[:n_good]]
             bad = [c for c, _ in ranked[n_good:]] or good
@@ -310,3 +316,44 @@ class TPESearcher(Searcher):
             return
         score = value if self._mode != "min" else -value
         self._obs.append((config, float(score)))
+
+
+class BOHBSearcher(TPESearcher):
+    """BOHB's model half: a TPE whose density model fits on the HIGHEST
+    rung (fidelity) that has enough observations — fed intermediate rung
+    results by ``HyperBandForBOHB`` (reference: ``tune/search/bohb`` +
+    ``schedulers/hb_bohb.py``; the BOHB paper's per-budget KDE rule).
+    Completed-trial results land on an implicit "final" rung above all
+    scheduler rungs."""
+
+    FINAL_RUNG = float("inf")
+
+    def __init__(self, *args, min_points_per_rung: int = 6, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._min_points = min_points_per_rung
+        self._rung_obs: Dict[float, List[tuple]] = {}
+
+    def on_rung_result(self, config: Dict[str, Any], score: float,
+                       rung: float) -> None:
+        """Called by the paired scheduler at every rung crossing with the
+        sign-normalized (higher-is-better) score."""
+        self._rung_obs.setdefault(rung, []).append((dict(config), score))
+
+    def on_trial_complete(self, trial_id, result=None, error=False) -> None:
+        config = self._live.get(trial_id)
+        super().on_trial_complete(trial_id, result, error)
+        if config is not None and result and not error:
+            value = result.get(self._metric)
+            if value is not None:
+                score = value if self._mode != "min" else -value
+                self.on_rung_result(config, float(score), self.FINAL_RUNG)
+
+    def _model_observations(self) -> List[tuple]:
+        for rung in sorted(self._rung_obs, reverse=True):
+            if len(self._rung_obs[rung]) >= max(self._min_points,
+                                                self._n_initial):
+                return self._rung_obs[rung]
+        # no rung is dense enough yet: pool everything (low-fidelity
+        # evidence beats none — BOHB's own fallback)
+        pooled = [o for obs in self._rung_obs.values() for o in obs]
+        return pooled or self._obs

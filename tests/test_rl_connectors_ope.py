@@ -1,0 +1,217 @@
+"""Connector pipeline (MeanStdFilter/ClipReward), OPE estimators, and the
+deeper convergence gates (reference: ``rllib/connectors/``,
+``rllib/offline/estimators/``, ``rllib/tuned_examples/`` baselines)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import rl
+from ray_tpu.rl import ope
+from ray_tpu.rl.connectors import (
+    ClipReward,
+    MeanStdFilter,
+    build_connectors,
+)
+
+
+# ---------------------------------------------------------------- connectors
+
+def test_mean_std_filter_normalizes():
+    rng = np.random.default_rng(0)
+    f = MeanStdFilter(obs_dim=3)
+    data = rng.normal(loc=[5.0, -2.0, 0.0], scale=[2.0, 0.5, 1.0],
+                      size=(500, 3))
+    for chunk in np.split(data, 10):
+        f.on_obs(chunk)
+    out = f.on_obs(data, update=False)
+    assert np.abs(out.mean(0)).max() < 0.1
+    assert np.abs(out.std(0) - 1.0).max() < 0.1
+
+
+def test_mean_std_filter_delta_merge_equals_single_stream():
+    """Two runners' deltas merged == one filter that saw all the data —
+    the exactness property of Chan's parallel update."""
+    rng = np.random.default_rng(1)
+    a_data = rng.normal(3.0, 2.0, size=(200, 2))
+    b_data = rng.normal(-1.0, 0.5, size=(300, 2))
+
+    fa, fb = MeanStdFilter(2), MeanStdFilter(2)
+    fa.on_obs(a_data)
+    fb.on_obs(b_data)
+    merged = fa.merge_delta(None, fa.pop_delta())
+    merged = fa.merge_delta(merged, fb.pop_delta())
+
+    ref = MeanStdFilter(2)
+    ref.on_obs(np.concatenate([a_data, b_data]))
+    ref_state = ref.merge_delta(None, ref.pop_delta())
+
+    np.testing.assert_allclose(merged["mean"], ref_state["mean"], rtol=1e-10)
+    np.testing.assert_allclose(merged["m2"], ref_state["m2"], rtol=1e-10)
+    assert merged["count"] == ref_state["count"] == 500
+
+
+def test_clip_reward_modes():
+    c = ClipReward(limit=1.0)
+    np.testing.assert_array_equal(c.on_reward(np.array([-3.0, 0.5, 7.0])),
+                                  [-1.0, 0.5, 1.0])
+    s = ClipReward(sign=True)
+    np.testing.assert_array_equal(s.on_reward(np.array([-3.0, 0.0, 7.0])),
+                                  [-1.0, 0.0, 1.0])
+
+
+def test_build_connectors_specs():
+    p = build_connectors(["mean_std_filter",
+                          {"type": "clip_reward", "limit": 2.0}], obs_dim=4)
+    assert len(p.stages) == 2
+    assert build_connectors(None, 4) is None
+    with pytest.raises(ValueError, match="unknown connector"):
+        build_connectors(["nope"], 4)
+
+
+def test_ppo_with_connectors_and_checkpoint(rt_cluster, tmp_path):
+    """Connectors ride the full product path: sampling normalizes obs with
+    fleet-synced stats, and the filter state round-trips a checkpoint."""
+    config = (rl.PPOConfig()
+              .environment("CartPole-v1")
+              .env_runners(num_env_runners=2, num_envs_per_runner=4,
+                           rollout_fragment_length=32,
+                           connectors=["mean_std_filter",
+                                       {"type": "clip_reward",
+                                        "limit": 5.0}])
+              .training(minibatch_size=64, num_epochs=2)
+              .debugging(seed=0))
+    algo = config.build()
+    r = algo.train()
+    assert np.isfinite(r["loss"])
+    state = algo._connector_state
+    assert state is not None and state[0]["count"] > 0   # stats accumulated
+    path = algo.save(str(tmp_path / "ckpt"))
+    algo2 = rl.PPO.from_checkpoint(path, config)
+    assert algo2._connector_state[0]["count"] == state[0]["count"]
+    algo.stop()
+    algo2.stop()
+
+
+# ----------------------------------------------------------------------- OPE
+
+def _bandit_episodes(n, steps=1, p_target=0.9, seed=0, q_model="true"):
+    """Synthetic known-value MDP: single state, 2 actions, r = action.
+    Behavior uniform; target plays a=1 w.p. ``p_target``. With gamma g the
+    true target value is p_target * (1 + g + g^2 + ...)."""
+    rng = np.random.default_rng(seed)
+    episodes = []
+    for _ in range(n):
+        acts = rng.integers(0, 2, size=steps)
+        probs_t = np.where(acts == 1, p_target, 1 - p_target)
+        q = {"true": np.tile([0.0, 1.0], (steps, 1)),
+             "wrong": np.full((steps, 2), 0.5)}[q_model]
+        episodes.append({
+            "rewards": acts.astype(np.float64),
+            "actions": acts,
+            "behavior_logp": np.full(steps, np.log(0.5)),
+            "target_logp": np.log(probs_t),
+            "target_probs": np.tile([1 - p_target, p_target], (steps, 1)),
+            "q_values": q,
+        })
+    return episodes
+
+
+def test_is_wis_recover_known_value():
+    eps = _bandit_episodes(4000, seed=0)
+    v_is = ope.estimate("is", eps)["v_target"]
+    v_wis = ope.estimate("wis", eps)["v_target"]
+    assert abs(v_is - 0.9) < 0.05
+    assert abs(v_wis - 0.9) < 0.05
+    # behavior value is ~0.5 (uniform over {0, 1} rewards)
+    assert abs(ope.estimate("is", eps)["v_behavior"] - 0.5) < 0.05
+
+
+def test_dm_exact_with_true_model():
+    eps = _bandit_episodes(200, seed=1)
+    assert ope.estimate("dm", eps)["v_target"] == pytest.approx(0.9)
+
+
+def test_dr_double_robustness():
+    # wrong model + right weights -> still consistent
+    eps = _bandit_episodes(4000, seed=2, q_model="wrong")
+    assert abs(ope.estimate("dr", eps)["v_target"] - 0.9) < 0.05
+    # right model + WRONG weights (pretend behavior == target) -> exact
+    eps = _bandit_episodes(200, seed=3, q_model="true")
+    for ep in eps:
+        ep["behavior_logp"] = ep["target_logp"]     # weights become 1
+    assert ope.estimate("dr", eps)["v_target"] == pytest.approx(0.9)
+
+
+def test_dr_multistep_with_discount():
+    gamma = 0.5
+    eps = _bandit_episodes(6000, steps=2, seed=4)
+    true_v = 0.9 * (1 + gamma)
+    v = ope.estimate("dr", eps, gamma=gamma)["v_target"]
+    assert abs(v - true_v) < 0.06
+
+
+def test_episodes_from_batch_splits_on_dones():
+    batch = {"rewards": np.arange(6.0),
+             "dones": np.array([0, 0, 1, 0, 0, 0], bool)}
+    eps = ope.episodes_from_batch(batch)
+    assert [len(e["rewards"]) for e in eps] == [3, 3]
+    np.testing.assert_array_equal(eps[0]["rewards"], [0, 1, 2])
+
+
+def test_unknown_estimator():
+    with pytest.raises(ValueError, match="unknown estimator"):
+        ope.estimate("nope", [])
+
+
+# -------------------------------------------------- convergence gates (slow)
+
+@pytest.mark.slow
+def test_dqn_learns_cartpole(rt_cluster):
+    """Reward-threshold gate mirroring the reference's tuned_examples
+    cartpole-dqn baseline (scaled to CI budget)."""
+    config = (rl.DQNConfig()
+              .environment("CartPole-v1")
+              .env_runners(num_env_runners=2, num_envs_per_runner=8,
+                           rollout_fragment_length=32)
+              .training(lr=5e-4, minibatch_size=64, buffer_size=50_000,
+                        learning_starts=500, target_update_freq=200,
+                        epsilon_decay_steps=8_000, double_q=True,
+                        updates_per_iter=64)
+              .debugging(seed=0))
+    algo = config.build()
+    best = -np.inf
+    for _ in range(40):
+        result = algo.train()
+        if np.isfinite(result.get("episode_return_mean", np.nan)):
+            best = max(best, result["episode_return_mean"])
+        if best > 120:
+            break
+    algo.stop()
+    assert best > 120, f"DQN failed to learn CartPole (best={best})"
+
+
+@pytest.mark.slow
+def test_sac_learns_pendulum_with_mean_std_filter(rt_cluster):
+    """SAC + MeanStdFilter on Pendulum: the continuous-control gate the
+    connector work exists for (raw-obs SAC is fragile here). Random policy
+    sits near -1200; the gate requires clearing -700."""
+    config = (rl.SACConfig()
+              .environment("Pendulum-v1")
+              .env_runners(num_env_runners=2, num_envs_per_runner=8,
+                           rollout_fragment_length=32,
+                           connectors=["mean_std_filter"])
+              .training(lr=3e-4, minibatch_size=128, buffer_size=100_000,
+                        learning_starts=500, tau=0.01,
+                        updates_per_iter=256, grad_clip=0.0)
+              .debugging(seed=0))
+    algo = config.build()
+    best = -np.inf
+    for _ in range(85):
+        result = algo.train()
+        if np.isfinite(result.get("episode_return_mean", np.nan)):
+            best = max(best, result["episode_return_mean"])
+        if best > -700:
+            break
+    algo.stop()
+    assert best > -700, f"SAC failed to learn Pendulum (best={best})"

@@ -1,0 +1,180 @@
+"""PB2 (GP-bandit PBT) and BOHB (HyperBand + fidelity-aware TPE).
+
+Reference analogs: ``tune/schedulers/pb2.py``, ``tune/schedulers/hb_bohb.py``
++ ``tune/search/bohb``."""
+
+import numpy as np
+import pytest
+
+from ray_tpu import tune
+from ray_tpu.tune import RunConfig, TuneConfig, Tuner
+from ray_tpu.tune.schedulers import PB2
+from ray_tpu.tune.search import BOHBSearcher
+
+
+def _quad_trainable():
+    class Quad(tune.Trainable):
+        """Quadratic bandit: per-step reward peaks at lr=0.7; score is the
+        running sum, so finding the peak early compounds."""
+
+        def setup(self, config):
+            self.lr = float(config["lr"])
+            self.total = 0.0
+
+        def step(self):
+            self.total += 1.0 - (self.lr - 0.7) ** 2
+            return {"score": self.total}
+
+        def save_checkpoint(self, d):
+            return {"total": self.total}
+
+        def load_checkpoint(self, data):
+            self.total = data["total"]
+
+    return Quad
+
+
+def _run(scheduler, tmp_path, name, lrs, iters=12):
+    grid = Tuner(
+        _quad_trainable(),
+        param_space={"lr": tune.grid_search(list(lrs))},
+        tune_config=TuneConfig(metric="score", mode="max",
+                               scheduler=scheduler),
+        run_config=RunConfig(name=name, storage_path=str(tmp_path),
+                             stop={"training_iteration": iters}),
+    ).fit()
+    return max(r.metrics["score"] for r in grid)
+
+
+def _simulate_population(scheduler, lrs, iters):
+    """Synchronized-PBT idealization: fixed round-robin result order, so a
+    scheduler comparison is fully deterministic (the live controller's
+    arrival order is timing-dependent — covered by the integration tests,
+    unusable for an A/B assertion)."""
+    from ray_tpu.tune.schedulers import PAUSE
+    from ray_tpu.tune.trial import Trial
+
+    trials = [Trial(f"t{i}", {"lr": lr}) for i, lr in enumerate(lrs)]
+    totals = {t.trial_id: 0.0 for t in trials}
+    ckpts = {}
+    earned = 0.0
+    for t in trials:
+        scheduler.on_trial_add(t)
+    for it in range(1, iters + 1):
+        for t in trials:
+            r = 1.0 - (t.config["lr"] - 0.7) ** 2
+            earned += r
+            totals[t.trial_id] += r
+            ckpts[f"{t.trial_id}@{it}"] = totals[t.trial_id]
+            t.checkpoint_path = f"{t.trial_id}@{it}"
+            decision = scheduler.on_trial_result(
+                t, {"training_iteration": it,
+                    "score": totals[t.trial_id]})
+            if decision == PAUSE:
+                mutation = scheduler.pop_mutation(t)
+                if mutation is not None:
+                    new_config, restore_from = mutation
+                    t.config = new_config
+                    totals[t.trial_id] = ckpts[restore_from]
+    # Time-integrated population reward: rewards earlier convergence — the
+    # thing the explore strategy controls (final-state metrics are a lottery
+    # on the last resample; cumulative max is dominated by whichever top
+    # trial never mutates).
+    return earned / (len(trials) * iters)
+
+
+def test_pb2_beats_random_explore_on_quadratic_bandit():
+    """Same population, same budget, same exploit rule — the GP-guided
+    explore must outscore random resampling on the seeded quadratic
+    bandit (deterministic synchronized simulation; the mean gap comes from
+    the GP converging on the 0.7 optimum while random keeps resampling the
+    whole interval)."""
+    lrs = [0.05, 0.2, 0.9, 0.99]   # all far from the 0.7 optimum
+    pb2_scores, rand_scores = [], []
+    for seed in range(5):
+        pb2_scores.append(_simulate_population(
+            PB2(metric="score", mode="max", perturbation_interval=2,
+                hyperparam_bounds={"lr": (0.0, 1.0)},
+                quantile_fraction=0.5, seed=seed),
+            lrs, iters=16))
+        rand_scores.append(_simulate_population(
+            tune.PopulationBasedTraining(
+                metric="score", mode="max", perturbation_interval=2,
+                hyperparam_mutations={"lr": tune.uniform(0.0, 1.0)},
+                quantile_fraction=0.5, resample_probability=1.0, seed=seed),
+            lrs, iters=16))
+    assert np.mean(pb2_scores) > np.mean(rand_scores), (
+        f"PB2 {pb2_scores} did not beat random explore {rand_scores}")
+    wins = sum(p > r for p, r in zip(pb2_scores, rand_scores))
+    assert wins >= 3, f"PB2 won only {wins}/5 seeds"
+
+
+def test_pb2_gp_explore_targets_high_reward_region():
+    """Unit: given observations of the quadratic's improvement surface, the
+    UCB-maximizing candidate lands near the optimum and inside bounds."""
+    pb2 = PB2(hyperparam_bounds={"lr": (0.0, 1.0)}, seed=3)
+    rng = np.random.default_rng(0)
+    for _ in range(60):
+        lr = float(rng.uniform(0, 1))
+        pb2._X.append([float(rng.uniform(0, 1)), lr])
+        pb2._y.append(1.0 - (lr - 0.7) ** 2 + float(rng.normal(0, 0.01)))
+    out = pb2._explore({"lr": 0.1})
+    assert 0.0 <= out["lr"] <= 1.0
+    assert abs(out["lr"] - 0.7) < 0.25, f"GP explore picked {out['lr']}"
+
+
+def test_pb2_cold_start_resamples_within_bounds():
+    pb2 = PB2(hyperparam_bounds={"lr": (0.2, 0.4)}, seed=1)
+    out = pb2._explore({"lr": 0.3, "other": "kept"})
+    assert 0.2 <= out["lr"] <= 0.4
+    assert out["other"] == "kept"
+
+
+def test_bohb_searcher_prefers_densest_highest_rung():
+    s = BOHBSearcher(metric="score", mode="max", n_initial=3,
+                     min_points_per_rung=3)
+    for i in range(5):
+        s.on_rung_result({"x": i}, float(i), rung=1)
+    for i in range(3):
+        s.on_rung_result({"x": 10 + i}, float(i), rung=9)
+    obs = s._model_observations()
+    assert all(c["x"] >= 10 for c, _ in obs)      # highest dense rung wins
+    # a sparse top rung falls back to the next dense one
+    s2 = BOHBSearcher(metric="score", mode="max", n_initial=3,
+                      min_points_per_rung=3)
+    for i in range(4):
+        s2.on_rung_result({"x": i}, float(i), rung=1)
+    s2.on_rung_result({"x": 99}, 1.0, rung=9)
+    assert len(s2._model_observations()) in (4, 5)
+    assert any(c["x"] < 10 for c, _ in s2._model_observations())
+
+
+def test_bohb_end_to_end_feeds_rungs_and_finds_optimum(rt_cluster, tmp_path):
+    """HyperBandForBOHB reports rung crossings to the searcher; the paired
+    TPE then concentrates samples near the optimum."""
+    def objective(config):
+        for i in range(1, 10):
+            tune.report({"score": -(config["x"] - 3.0) ** 2 - 1.0 / i,
+                         "training_iteration": i})
+
+    searcher = BOHBSearcher(metric="score", mode="max", n_initial=6, seed=0)
+    sched = tune.HyperBandForBOHB(metric="score", mode="max", searcher=searcher,
+                                  max_t=9, grace_period=1,
+                                  reduction_factor=3, brackets=2)
+    grid = Tuner(
+        objective,
+        param_space={"x": tune.uniform(-10.0, 10.0)},
+        tune_config=TuneConfig(metric="score", mode="max", num_samples=24,
+                               search_alg=searcher, scheduler=sched),
+        run_config=RunConfig(name="bohb", storage_path=str(tmp_path)),
+    ).fit()
+    assert searcher._rung_obs, "scheduler never fed the searcher"
+    best = grid.get_best_result()
+    assert abs(best.config["x"] - 3.0) < 1.5
+    # later suggestions should cluster near the optimum
+    late = [c["x"] for c, _ in list(searcher._rung_obs.get(
+        BOHBSearcher.FINAL_RUNG, []))[-6:]]
+    if late:
+        assert np.median(np.abs(np.asarray(late) - 3.0)) < \
+            np.median(np.abs(np.asarray([c["x"] for c, _ in list(
+                searcher._rung_obs[BOHBSearcher.FINAL_RUNG])[:6]]) - 3.0)) + 3.0
