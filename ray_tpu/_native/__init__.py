@@ -57,6 +57,40 @@ def checksum_kind() -> str:
     return "crc32c" if _load() is not None else "crc32"
 
 
+_CRC32C_POLY = 0x82F63B78  # reflected Castagnoli
+_crc32c_table: Optional[List[int]] = None
+
+
+def _sw_table() -> List[int]:
+    global _crc32c_table
+    if _crc32c_table is None:
+        t = []
+        for i in range(256):
+            c = i
+            for _ in range(8):
+                c = (c >> 1) ^ (_CRC32C_POLY if c & 1 else 0)
+            t.append(c)
+        _crc32c_table = t
+    return _crc32c_table
+
+
+def crc32c_sw(data, init: int = 0) -> int:
+    """Castagnoli CRC that is ALWAYS Castagnoli: native when available,
+    pure-Python table otherwise. Unlike :func:`crc32c` this never silently
+    switches algorithm with toolchain availability. The WAL replayers use
+    it to verify native-written frames on toolchain-less hosts; it is far
+    too slow (GIL-bound byte loop) for write paths or multi-MB payloads —
+    those use zlib.crc32 or the tagged ``checksum_kind()`` scheme."""
+    n = _load()
+    if n is not None:
+        return n.crc32c(data, init)
+    t = _sw_table()
+    crc = (init & 0xFFFFFFFF) ^ 0xFFFFFFFF
+    for b in bytes(data):
+        crc = t[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
+
 def checksum(data, kind: str) -> Optional[int]:
     """Compute the named checksum, or None if this host can't (no native
     crc32c and the peer used it) — callers skip verification then."""
@@ -110,7 +144,16 @@ def process_memory(pids: List[int]) -> List[Tuple[int, int]]:
 
 
 class PyLogKV:
-    """Pure-Python LogKV fallback (same on-disk format, crc32 checks)."""
+    """Pure-Python LogKV fallback (same on-disk format as the native one).
+
+    Replay accepts BOTH frame checksums — crc32c (native writer) and zlib
+    crc32 (this writer) — so a WAL survives the toolchain appearing or
+    disappearing between restarts (ADVICE r3: a silent algorithm flip used
+    to discard the whole durable KV as a corrupt tail). Writes frame with
+    zlib.crc32: it runs at C speed (the pure-Python crc32c table would be
+    GIL-bound minutes for the multi-MB runtime-env packages the GCS WAL
+    stores), and the native replayer accepts it.
+    """
 
     _TOMB = 0xFFFFFFFF
 
@@ -126,33 +169,60 @@ class PyLogKV:
 
     def _replay(self) -> None:
         s = self._struct
+        size = os.path.getsize(self._path)
+        reason = None
+        self._algos = [lambda b: zlib.crc32(b) & 0xFFFFFFFF, crc32c_sw]
+        pos = 0
         with open(self._path, "rb") as f:
             while True:
+                pos = f.tell()
                 hdr = f.read(12)
+                if len(hdr) == 0:
+                    break
                 if len(hdr) < 12:
+                    reason = "torn header"
                     break
                 crc, klen, vfield = s.unpack("<III", hdr)
                 tomb = vfield == self._TOMB
                 vlen = 0 if tomb else vfield
                 if klen > 1 << 24 or vlen > 1 << 30:
+                    reason = "implausible record lengths"
                     break
                 body = f.read(klen + vlen)
                 if len(body) < klen + vlen:
+                    reason = "torn body"
                     break
-                if crc32c(hdr[4:] + body) != crc:
-                    break
+                rec = hdr[4:] + body
+                # Try the last-matched algorithm first: zlib.crc32 is
+                # C-speed, crc32c_sw a Python byte loop — a homogeneous
+                # file (the common case) should pay the slow check at most
+                # once, not per record.
+                if self._algos[0](rec) != crc:
+                    if self._algos[1](rec) != crc:
+                        reason = "checksum mismatch"
+                        break
+                    self._algos.reverse()
                 key = body[:klen].decode()
                 if tomb:
                     self._table.pop(key, None)
                 else:
                     self._table[key] = body[klen:]
+        if reason is not None and pos < size:
+            import logging
+
+            logging.getLogger("ray_tpu.native").warning(
+                "LogKV replay of %s stopped at offset %d of %d (%s): "
+                "%d trailing bytes ignored. If this is more than one "
+                "torn record the WAL may be corrupt — recovered %d keys.",
+                self._path, pos, size, reason, size - pos, len(self._table))
 
     def _append(self, key: str, value: Optional[bytes]) -> None:
         s = self._struct
         kb = key.encode()
         vfield = self._TOMB if value is None else len(value)
         body = s.pack("<II", len(kb), vfield) + kb + (value or b"")
-        self._f.write(s.pack("<I", crc32c(body)) + body)
+        self._f.write(
+            s.pack("<I", zlib.crc32(body) & 0xFFFFFFFF) + body)
         self._f.flush()
 
     def put(self, key: str, value: bytes) -> None:

@@ -247,31 +247,76 @@ static int logkv_append(LogKVObject* self, const std::string& key,
   return 0;
 }
 
+// zlib-polynomial crc32: legacy WAL files written by the pure-Python
+// fallback of older builds framed records with zlib.crc32. Replay accepts
+// either algorithm per record so a toolchain appearing between restarts
+// can't silently discard the whole durable KV as a corrupt tail.
+static uint32_t crc32_zlib_run(uint32_t crc, const uint8_t* buf, size_t len) {
+  static uint32_t table[256];
+  static bool ready = false;
+  if (!ready) {
+    for (uint32_t i = 0; i < 256; i++) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; k++) c = c & 1 ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+      table[i] = c;
+    }
+    ready = true;
+  }
+  crc = ~crc;
+  while (len--) crc = table[(crc ^ *buf++) & 0xff] ^ (crc >> 8);
+  return ~crc;
+}
+
 static int logkv_replay(LogKVObject* self) {
   FILE* f = fopen(self->path->c_str(), "rb");
   if (!f) return 0;  // fresh store
+  const char* stop = nullptr;
+  long pos = 0;
   for (;;) {
+    pos = ftell(f);
     uint8_t hdr[12];
     size_t n = fread(hdr, 1, 12, f);
     if (n == 0) break;
-    if (n < 12) break;  // torn tail record: ignore (crash mid-append)
+    if (n < 12) {  // torn tail record: ignore (crash mid-append)
+      stop = "torn header";
+      break;
+    }
     uint32_t crc, klen, vfield;
     memcpy(&crc, hdr, 4);
     memcpy(&klen, hdr + 4, 4);
     memcpy(&vfield, hdr + 8, 4);
     bool tombstone = vfield == 0xffffffffu;
     uint32_t vlen = tombstone ? 0 : vfield;
-    if (klen > (1u << 24) || vlen > (1u << 30)) break;  // corrupt
+    if (klen > (1u << 24) || vlen > (1u << 30)) {
+      stop = "implausible record lengths";
+      break;
+    }
     std::string body(8 + klen + vlen, '\0');
     memcpy(&body[0], hdr + 4, 8);
-    if (fread(&body[8], 1, klen + vlen, f) < klen + vlen) break;  // torn
-    if (crc32c_run(0, (const uint8_t*)body.data(), body.size()) != crc)
-      break;  // corrupt tail
+    if (fread(&body[8], 1, klen + vlen, f) < klen + vlen) {
+      stop = "torn body";
+      break;
+    }
+    if (crc32c_run(0, (const uint8_t*)body.data(), body.size()) != crc &&
+        crc32_zlib_run(0, (const uint8_t*)body.data(), body.size()) != crc) {
+      stop = "checksum mismatch";
+      break;
+    }
     std::string key = body.substr(8, klen);
     if (tombstone)
       self->table->erase(key);
     else
       (*self->table)[key] = body.substr(8 + klen, vlen);
+  }
+  if (stop) {
+    fseek(f, 0, SEEK_END);
+    long size = ftell(f);
+    if (pos < size)
+      fprintf(stderr,
+              "rt_native LogKV: replay of %s stopped at offset %ld of %ld "
+              "(%s); %ld trailing bytes ignored, %zu keys recovered\n",
+              self->path->c_str(), pos, size, stop, size - pos,
+              self->table->size());
   }
   fclose(f);
   return 0;

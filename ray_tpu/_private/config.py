@@ -88,6 +88,11 @@ class Config:
     # ---- gcs ---------------------------------------------------------------
     gcs_rpc_timeout_s: float = 30.0
     pubsub_poll_timeout_s: float = 30.0
+    # fsync the KV WAL before acking each kv_put. Default off: appends are
+    # flushed (process-crash durable) but only fsynced at migration and
+    # shutdown, so a host crash can lose acked puts. Turn on for host-crash
+    # durability at the cost of per-put fsync latency.
+    wal_fsync: bool = False
 
     # ---- TPU / accelerator -------------------------------------------------
     # Chips per TPU-VM host (v4/v5p hosts expose 4 chips; v5e hosts 1/4/8).

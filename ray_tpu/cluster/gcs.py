@@ -112,8 +112,11 @@ class GcsServer:
         # GCS tables, ``store_client/redis_store_client.cc`` — here a pickle
         # snapshot so a restarted head recovers actors/PGs/locations, plus a
         # crc-framed append-only WAL (native LogKV) for the user KV table:
-        # every kv_put is durable immediately, and multi-MB runtime-env
-        # packages stop being re-pickled into each snapshot.
+        # every kv_put is appended+flushed before the ack, so it survives a
+        # GCS *process* crash; fsync happens at migration/shutdown (or per
+        # record with RT_WAL_FSYNC=1), so host-crash/power-loss durability
+        # is opt-in. Multi-MB runtime-env packages also stop being
+        # re-pickled into each snapshot.
         self._persist_path = persist_path
         self._persist_seq = self._persisted_seq = 0
         self._kv_log = None
@@ -143,15 +146,61 @@ class GcsServer:
                         self._kv_log.put(k, self._encode_kv(v))
                     self._kv_log.sync()
                 else:
-                    self.kv = {k: self._decode_kv(self._kv_log.get(k))
-                               for k in self._kv_log.keys()}
+                    wal_kv = {k: self._decode_kv(self._kv_log.get(k))
+                              for k in self._kv_log.keys()}
+                    if self.kv:
+                        # A healthy lifecycle persists kv={} snapshots while
+                        # the WAL is active, so a NON-empty snapshot kv next
+                        # to a non-empty WAL means a previous run couldn't
+                        # open the WAL and acked puts into the snapshot
+                        # (degraded mode). Overlay those puts back into the
+                        # WAL instead of silently discarding them; deletes
+                        # acked during the degraded run are unrecoverable
+                        # (no tombstone was written) and may resurrect.
+                        import logging
+
+                        changed = {k: v for k, v in self.kv.items()
+                                   if wal_kv.get(k) != v}
+                        for k, v in changed.items():
+                            self._kv_log.put(k, self._encode_kv(v))
+                        if changed:
+                            self._kv_log.sync()
+                            logging.getLogger("ray_tpu.gcs").warning(
+                                "KV WAL re-opened after a degraded run: "
+                                "merged %d snapshot-acked put(s) back into "
+                                "the WAL. Deletes acked while the WAL was "
+                                "unavailable were not tombstoned and may "
+                                "have resurrected.", len(changed))
+                        wal_kv.update(changed)
+                    self.kv = wal_kv
                 # single thread => append order == table order per key
                 self._kv_log_exec = ThreadPoolExecutor(
                     max_workers=1, thread_name_prefix="rt-gcs-kvlog")
                 self.mark_dirty()
                 self._persist_snapshot()
-            except Exception:  # noqa: BLE001 — WAL is an upgrade, not a dep
+            except Exception as e:  # noqa: BLE001 — WAL an upgrade, not a dep
+                import logging
+                import os as _os
+
                 self._kv_log = None
+                wal_path = persist_path + ".kv"
+                if _os.path.exists(wal_path) and _os.path.getsize(wal_path):
+                    # A WAL exists but could not be opened/replayed. Earlier
+                    # runs snapshot kv={} once the WAL is authoritative, so
+                    # falling back silently would present an EMPTY durable KV
+                    # (runtime-env packages, job/function tables) while the
+                    # real data still sits in the unreadable file. Run
+                    # degraded but say so loudly; the file is left intact for
+                    # a later restart to recover.
+                    logging.getLogger("ray_tpu.gcs").error(
+                        "KV WAL %s exists but failed to open (%s: %s) — "
+                        "durable KV from previous runs is NOT loaded this "
+                        "run, and new puts are snapshot-only until a restart "
+                        "re-opens the WAL.", wal_path, type(e).__name__, e)
+                else:
+                    logging.getLogger("ray_tpu.gcs").warning(
+                        "KV WAL unavailable (%s: %s); falling back to "
+                        "snapshot-only KV persistence.", type(e).__name__, e)
 
     @staticmethod
     def _encode_kv(value) -> bytes:
@@ -405,9 +454,18 @@ class GcsServer:
             # write); the single-thread executor keeps append order == the
             # order the table saw
             await asyncio.get_running_loop().run_in_executor(
-                self._kv_log_exec, self._kv_log.put, p["key"],
+                self._kv_log_exec, self._kv_put_durable, p["key"],
                 self._encode_kv(p["value"]))
         return {"ok": True}
+
+    def _kv_put_durable(self, key: str, value: bytes) -> None:
+        """Runs on the WAL executor thread: append, and fsync when the
+        operator asked for host-crash durability (RT_WAL_FSYNC=1)."""
+        from ray_tpu._private.config import get_config
+
+        self._kv_log.put(key, value)
+        if get_config().wal_fsync:
+            self._kv_log.sync()
 
     async def rpc_kv_get(self, p):
         return {"value": self.kv.get(p["key"])}
@@ -417,8 +475,18 @@ class GcsServer:
         self.kv.pop(p["key"], None)
         if self._kv_log is not None:
             await asyncio.get_running_loop().run_in_executor(
-                self._kv_log_exec, self._kv_log.delete, p["key"])
+                self._kv_log_exec, self._kv_del_durable, p["key"])
         return {"ok": True}
+
+    def _kv_del_durable(self, key: str) -> None:
+        """WAL-executor thread: tombstone, honoring RT_WAL_FSYNC like puts —
+        an un-fsynced acked delete resurrecting after a host crash breaks
+        the same durability promise as a lost put."""
+        from ray_tpu._private.config import get_config
+
+        self._kv_log.delete(key)
+        if get_config().wal_fsync:
+            self._kv_log.sync()
 
     async def rpc_kv_keys(self, p):
         return {"keys": [k for k in self.kv if k.startswith(p["prefix"])]}

@@ -441,7 +441,7 @@ class Raylet:
     def _pick_oom_victim(self) -> Optional[_WorkerEntry]:
         from ray_tpu import _native
 
-        task_workers, actor_workers = [], []
+        idle_workers, task_workers, actor_workers = [], [], []
         for e in self._workers.values():
             if e.proc.poll() is not None or e.oom_killed:
                 continue
@@ -449,10 +449,15 @@ class Raylet:
                 actor_workers.append(e)
             elif e.busy:
                 task_workers.append(e)
-        # Task workers are retriable by policy; among them kill the largest
-        # RSS (frees the most memory). Actors only as a last resort — their
-        # death is user-visible (restart or ActorDiedError).
-        for group in (task_workers, actor_workers):
+            else:
+                idle_workers.append(e)
+        # Cheapest kill first (reference worker_killing_policy.cc prefers
+        # the lowest-cost victim): an idle pooled worker loses no work yet
+        # can hold large RSS from its previous task; then busy task workers
+        # (retriable by policy, largest RSS frees the most); actors only as
+        # a last resort — their death is user-visible (restart or
+        # ActorDiedError).
+        for group in (idle_workers, task_workers, actor_workers):
             if not group:
                 continue
             by_pid = {e.proc.pid: e for e in group}

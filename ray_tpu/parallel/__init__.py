@@ -5,11 +5,31 @@ Where the reference reaches for torch DDP/FSDP/DeepSpeed process groups
 this framework expresses every strategy — DP, FSDP/ZeRO, TP, SP/CP, EP, PP —
 as a `jax.sharding.Mesh` plus partition rules, letting XLA insert the
 ICI/DCN collectives.
+
+Submodules are loaded lazily (PEP 562): ``sharding`` imports jax at module
+scope (~2s cold), and eager re-export made EVERY import under the package —
+including the jax-free ``xla_flags`` env plumbing that worker processes run
+at spawn — pay that cost, slowing worker cold-start enough to starve
+latency-sensitive actor calls.
 """
 
-from ray_tpu.parallel.mesh import MeshConfig, make_mesh  # noqa: F401
-from ray_tpu.parallel.sharding import (  # noqa: F401
-    ShardingRules,
-    named_sharding,
-    shard_pytree,
-)
+_EXPORTS = {
+    "MeshConfig": "ray_tpu.parallel.mesh",
+    "make_mesh": "ray_tpu.parallel.mesh",
+    "ShardingRules": "ray_tpu.parallel.sharding",
+    "named_sharding": "ray_tpu.parallel.sharding",
+    "shard_pytree": "ray_tpu.parallel.sharding",
+}
+
+__all__ = list(_EXPORTS)
+
+
+def __getattr__(name):
+    if name in _EXPORTS:
+        import importlib
+
+        mod = importlib.import_module(_EXPORTS[name])
+        val = getattr(mod, name)
+        globals()[name] = val
+        return val
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

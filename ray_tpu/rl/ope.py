@@ -136,10 +136,31 @@ def estimate(method: str, episodes: Sequence[Dict], **kwargs
     return ESTIMATORS[method](episodes, **kwargs)
 
 
-def episodes_from_batch(batch: Dict[str, np.ndarray]) -> List[Dict]:
+def episodes_from_batch(batch: Dict[str, np.ndarray],
+                        num_envs: int = 1) -> List[Dict]:
     """Split a flat columnar batch (with ``dones``) into episode dicts —
-    the bridge from offline datasets / sample batches to the estimators."""
+    the bridge from offline datasets / sample batches to the estimators.
+
+    ``EnvRunner.sample`` flattens its ``[T, N]`` buffers time-major
+    (row ``t*N + n`` is env ``n`` at step ``t``), so batches collected with
+    ``num_envs_per_runner > 1`` interleave environments; pass that count as
+    ``num_envs`` so rows are first de-interleaved per env — splitting the
+    raw interleaved rows on ``dones`` would stitch timesteps of unrelated
+    trajectories into one "episode" and silently corrupt the estimates.
+    """
     dones = np.asarray(batch["dones"]).astype(bool)
+    if dones.size == 0:
+        return []
+    if num_envs > 1:
+        if len(dones) % num_envs:
+            raise ValueError(
+                f"batch length {len(dones)} not divisible by "
+                f"num_envs={num_envs}")
+        episodes = []
+        for n in range(num_envs):
+            episodes.extend(episodes_from_batch(
+                {k: np.asarray(v)[n::num_envs] for k, v in batch.items()}))
+        return episodes
     bounds = np.flatnonzero(dones) + 1
     episodes = []
     start = 0

@@ -62,6 +62,18 @@ class GrpcProxyActor:
                     request_deserializer=None,   # raw bytes in
                     response_serializer=None)    # raw bytes out
 
+        # Trust boundary: requests are cloudpickle payloads, and unpickling
+        # executes arbitrary code by construction — the ingress must only be
+        # reachable by trusted clients. The default loopback bind enforces
+        # that; binding wider is the operator widening the boundary.
+        if self._host not in ("127.0.0.1", "localhost", "::1"):
+            import logging
+
+            logging.getLogger("ray_tpu.serve").warning(
+                "serve gRPC ingress binding to %s: requests are pickle-"
+                "deserialized, so ANY client that can reach this port can "
+                "execute code in the proxy. Only bind beyond loopback on a "
+                "trusted network.", self._host)
         self._server = grpc.aio.server()
         self._server.add_generic_rpc_handlers((_Generic(),))
         self._port = self._server.add_insecure_port(

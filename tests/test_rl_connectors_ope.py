@@ -159,6 +159,27 @@ def test_episodes_from_batch_splits_on_dones():
     np.testing.assert_array_equal(eps[0]["rewards"], [0, 1, 2])
 
 
+def test_episodes_from_batch_deinterleaves_vector_envs():
+    """EnvRunner flattens [T, N] buffers time-major: row t*N + n is env n
+    at step t. num_envs must de-interleave before splitting on dones."""
+    # 2 envs, 3 steps: env0 rewards 0,1,2 (done at t=2), env1 10,11,12
+    rewards = np.array([0, 10, 1, 11, 2, 12], np.float64)
+    dones = np.array([0, 0, 0, 0, 1, 1], bool)
+    eps = ope.episodes_from_batch(
+        {"rewards": rewards, "dones": dones}, num_envs=2)
+    assert [len(e["rewards"]) for e in eps] == [3, 3]
+    np.testing.assert_array_equal(eps[0]["rewards"], [0, 1, 2])
+    np.testing.assert_array_equal(eps[1]["rewards"], [10, 11, 12])
+    with pytest.raises(ValueError, match="not divisible"):
+        ope.episodes_from_batch(
+            {"rewards": rewards, "dones": dones}, num_envs=4)
+
+
+def test_episodes_from_batch_empty():
+    assert ope.episodes_from_batch(
+        {"rewards": np.array([]), "dones": np.array([], bool)}) == []
+
+
 def test_unknown_estimator():
     with pytest.raises(ValueError, match="unknown estimator"):
         ope.estimate("nope", [])

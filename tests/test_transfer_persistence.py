@@ -208,6 +208,55 @@ def test_gcs_kv_wal_str_and_bytes_roundtrip(tmp_path):
     asyncio.run(run())
 
 
+def test_gcs_kv_degraded_wal_run_merges_on_reopen(tmp_path, monkeypatch):
+    """A run whose WAL failed to open acks puts into the snapshot only; the
+    next restart that re-opens the WAL must merge those puts back instead
+    of silently replacing kv with the (older) WAL contents."""
+    import asyncio
+
+    from ray_tpu.cluster.gcs import GcsServer
+
+    path = str(tmp_path / "gcs_state")
+
+    async def run():
+        # healthy run writes durable keys through the WAL
+        g = GcsServer(persist_path=path)
+        assert g._kv_log is not None
+        await g.rpc_kv_put({"key": "wal-key", "value": "v1"})
+        await g.rpc_kv_put({"key": "both", "value": "old"})
+        await g.stop()
+
+        # degraded run: WAL open fails (simulated), puts land snapshot-only
+        import ray_tpu._native as nat
+
+        def boom(path):
+            raise OSError("simulated WAL open failure")
+
+        monkeypatch.setattr(nat, "LogKV", boom)
+        g2 = GcsServer(persist_path=path)
+        assert g2._kv_log is None
+        await g2.rpc_kv_put({"key": "degraded-key", "value": "v2"})
+        await g2.rpc_kv_put({"key": "both", "value": "new"})
+        await g2.stop()
+        monkeypatch.undo()
+
+        # healthy restart: WAL re-opens; degraded puts must survive
+        g3 = GcsServer(persist_path=path)
+        assert g3._kv_log is not None
+        assert g3.kv["wal-key"] == "v1"
+        assert g3.kv["degraded-key"] == "v2"
+        assert g3.kv["both"] == "new"
+        await g3.stop()
+
+        # and they are now IN the WAL (snapshot kv is blanked again)
+        g4 = GcsServer(persist_path=path)
+        assert g4.kv["degraded-key"] == "v2"
+        assert g4.kv["both"] == "new"
+        await g4.stop()
+
+    asyncio.run(run())
+
+
 @pytest.mark.slow
 def test_large_object_transfer_under_small_store(monkeypatch):
     """A 512MB object crosses nodes with a 128MB store cap: the source
