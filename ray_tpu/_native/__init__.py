@@ -212,9 +212,14 @@ class PyLogKV:
 
             logging.getLogger("ray_tpu.native").warning(
                 "LogKV replay of %s stopped at offset %d of %d (%s): "
-                "%d trailing bytes ignored. If this is more than one "
+                "%d trailing bytes truncated. If this is more than one "
                 "torn record the WAL may be corrupt — recovered %d keys.",
                 self._path, pos, size, reason, size - pos, len(self._table))
+            # Truncate the unreplayable tail BEFORE appending: records
+            # written after a surviving torn tail would sit behind it and
+            # be invisible to every future replay — acked-then-lost on
+            # each subsequent restart.
+            os.truncate(self._path, pos)
 
     def _append(self, key: str, value: Optional[bytes]) -> None:
         s = self._struct

@@ -311,12 +311,19 @@ static int logkv_replay(LogKVObject* self) {
   if (stop) {
     fseek(f, 0, SEEK_END);
     long size = ftell(f);
-    if (pos < size)
+    if (pos < size) {
       fprintf(stderr,
               "rt_native LogKV: replay of %s stopped at offset %ld of %ld "
-              "(%s); %ld trailing bytes ignored, %zu keys recovered\n",
+              "(%s); %ld trailing bytes truncated, %zu keys recovered\n",
               self->path->c_str(), pos, size, stop, size - pos,
               self->table->size());
+      // Truncate the unreplayable tail before the O_APPEND open: appends
+      // landing after a surviving torn tail would be skipped by every
+      // future replay — acked writes silently lost on each restart.
+      if (truncate(self->path->c_str(), pos) != 0)
+        fprintf(stderr, "rt_native LogKV: truncate(%s, %ld) failed: %s\n",
+                self->path->c_str(), pos, strerror(errno));
+    }
   }
   fclose(f);
   return 0;

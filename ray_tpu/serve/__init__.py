@@ -23,6 +23,8 @@ from ray_tpu.serve.api import (Application, Deployment, delete, deployment,
                                http_port, run, shutdown, start, start_grpc,
                                status)
 from ray_tpu.serve.api import _forget_controller as _forget_controller_for_tests
+from ray_tpu.serve.asgi import (ASGIResponse, ASGIResponseStart, asgi_app,
+                                ingress)
 from ray_tpu.serve.batching import batch
 from ray_tpu.serve.config import (AutoscalingConfig, DeploymentConfig,
                                   HTTPOptions)
@@ -33,10 +35,12 @@ from ray_tpu.serve.grpc_proxy import grpc_request
 from ray_tpu.serve.proxy import ServeRequest
 
 __all__ = [
+    "ASGIResponse", "ASGIResponseStart",
     "Application", "AutoscalingConfig", "Deployment", "DeploymentConfig",
     "DeploymentHandle", "DeploymentResponse", "DeploymentResponseGenerator",
     "HTTPOptions", "ServeRequest",
-    "batch", "delete", "deployment", "get_app_handle",
+    "asgi_app", "batch", "delete", "deployment", "get_app_handle",
+    "ingress",
     "get_deployment_handle", "get_multiplexed_model_id", "grpc_request",
     "http_port", "multiplexed", "run", "shutdown", "start", "start_grpc",
     "status",

@@ -1,0 +1,308 @@
+"""ASGI adapter: deploy any ASGI3 app (FastAPI, Starlette, Quart, raw
+callables) as a serve deployment, unchanged.
+
+Reference analog: ``serve/_private/http_proxy.py:935`` (``HTTPProxy`` speaks
+ASGI natively on uvicorn, and ``@serve.ingress(fastapi_app)`` mounts an app
+on a deployment class). This framework's proxy hands replicas a picklable
+``ServeRequest`` instead of a live ASGI connection, so the adapter runs the
+ASGI protocol *inside the replica*: scope/receive/send are synthesized from
+the request, and the app's send events are translated back into either a
+buffered :class:`ASGIResponse` or a streamed response (first item an
+:class:`ASGIResponseStart`, then body chunks) riding the existing response
+stream machinery.
+
+Two ways in:
+
+- ``serve.asgi_app(app_or_factory)`` — wraps a bare ASGI app (or a
+  zero-arg factory, for apps that aren't picklable) into a deployment body.
+- ``@serve.ingress(app)`` on a deployment class — the class keeps its own
+  ``__init__``/methods; HTTP traffic is routed through the app. The app can
+  reach the live deployment instance as ``scope["extensions"]
+  ["ray_tpu.deployment"]`` (FastAPI: ``request.scope[...]``) — a redesign
+  of the reference's class-based-view binding, which rewrites FastAPI
+  dependencies; here the instance is surfaced through the scope instead.
+
+Lifespan: ``lifespan.startup`` runs once before the first request in the
+replica; ``lifespan.shutdown`` is best-effort (replica teardown is process
+teardown). WebSockets are not supported (HTTP only).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+__all__ = ["ASGIResponse", "ASGIResponseStart", "asgi_app", "ingress"]
+
+
+class ASGIResponse:
+    """Picklable buffered HTTP response produced by the ASGI adapter; the
+    proxy maps it 1:1 onto the wire (status/headers/body)."""
+
+    def __init__(self, status: int, headers: List[Tuple[str, str]],
+                 body: bytes):
+        self.status = status
+        self.headers = headers
+        self.body = body
+
+
+class ASGIResponseStart:
+    """First item of a streamed ASGI response: status + headers; the
+    remaining stream items are body chunks."""
+
+    def __init__(self, status: int, headers: List[Tuple[str, str]]):
+        self.status = status
+        self.headers = headers
+
+
+def _build_scope(request, instance) -> Dict[str, Any]:
+    """ServeRequest -> ASGI HTTP scope. The path is the route-prefix-
+    stripped path the proxy computed, so an app mounted at /api sees /."""
+    from urllib.parse import urlencode
+
+    # raw forms preserve repeated params/headers (?tag=a&tag=b, duplicate
+    # Set-Cookie) that the convenience dicts collapse
+    raw_headers = getattr(request, "raw_headers", None)
+    header_items = raw_headers if raw_headers is not None \
+        else (request.headers or {}).items()
+    headers = [(k.lower().encode(), v.encode()) for k, v in header_items]
+    raw_query = getattr(request, "raw_query", None)
+    query_string = raw_query.encode() if raw_query is not None \
+        else urlencode(request.query or {}).encode()
+    return {
+        "type": "http",
+        "asgi": {"version": "3.0", "spec_version": "2.3"},
+        "http_version": "1.1",
+        "method": request.method,
+        "scheme": "http",
+        "path": request.path,
+        "raw_path": request.path.encode(),
+        "query_string": query_string,
+        "root_path": "",
+        "headers": headers,
+        "client": ("127.0.0.1", 0),
+        "server": ("127.0.0.1", 0),
+        "extensions": {"ray_tpu.deployment": instance},
+    }
+
+
+async def _run_lifespan_startup(app) -> None:
+    """Drive lifespan.startup once. Apps that don't implement lifespan
+    (raise on the unknown scope type) are fine — ASGI allows that."""
+    startup_done = asyncio.Event()
+    failed: List[str] = []
+    delivered = False
+
+    async def receive():
+        # startup exactly once; then park — the standard lifespan loop
+        # calls receive() again waiting for lifespan.shutdown, which never
+        # comes (replica teardown is process teardown)
+        nonlocal delivered
+        if not delivered:
+            delivered = True
+            return {"type": "lifespan.startup"}
+        await asyncio.Event().wait()
+
+    async def send(message):
+        if message["type"] == "lifespan.startup.complete":
+            startup_done.set()
+        elif message["type"] == "lifespan.startup.failed":
+            failed.append(message.get("message", ""))
+            startup_done.set()
+
+    async def run():
+        try:
+            await app({"type": "lifespan", "asgi": {"version": "3.0"}},
+                      receive, send)
+        except BaseException:  # noqa: BLE001 — app opted out of lifespan
+            pass
+        finally:
+            # apps may RETURN from the lifespan scope without sending
+            # startup.complete (e.g. `if scope["type"] != "http": return`)
+            # — that must not park the first request forever
+            startup_done.set()
+
+    task = asyncio.ensure_future(run())
+    await startup_done.wait()
+    # keep the lifespan task alive for apps that hold state in it; replica
+    # teardown is process teardown, so shutdown is implicit
+    _lifespan_tasks.append(task)
+    if failed:
+        raise RuntimeError(f"ASGI lifespan startup failed: {failed[0]}")
+
+
+_lifespan_tasks: List[asyncio.Task] = []
+
+
+async def _call_asgi(app, request, instance):
+    """Run one HTTP request through the app.
+
+    Returns an :class:`ASGIResponse` when the app finished the body in its
+    first write, else an async generator (``ASGIResponseStart`` then body
+    chunks) so chunked/SSE/token streams flow incrementally through the
+    replica's response-stream machinery.
+    """
+    scope = _build_scope(request, instance)
+    body = request.body or b""
+    sent_body = False
+    events: asyncio.Queue = asyncio.Queue()
+
+    async def receive():
+        nonlocal sent_body
+        if not sent_body:
+            sent_body = True
+            return {"type": "http.request", "body": body,
+                    "more_body": False}
+        # client disconnect is never signaled mid-request here: the proxy
+        # already buffered the full request
+        await asyncio.Event().wait()
+
+    async def send(message):
+        await events.put(message)
+
+    app_task = asyncio.ensure_future(app(scope, receive, send))
+
+    async def next_event():
+        # drain queued events before consulting the app task: the app may
+        # have finished AFTER putting its final body messages
+        if not events.empty():
+            return events.get_nowait()
+        if app_task.done():
+            exc = app_task.exception()
+            if exc is not None:
+                raise exc
+            return None  # app returned without completing the response
+        getter = asyncio.ensure_future(events.get())
+        await asyncio.wait({getter, app_task},
+                           return_when=asyncio.FIRST_COMPLETED)
+        if getter.done():
+            return getter.result()
+        getter.cancel()
+        if not events.empty():
+            return events.get_nowait()
+        exc = app_task.exception()
+        if exc is not None:
+            raise exc
+        return None
+
+    start: Optional[Dict] = None
+    while start is None:
+        msg = await next_event()
+        if msg is None:
+            raise RuntimeError("ASGI app returned before response.start")
+        if msg["type"] == "http.response.start":
+            start = msg
+    status = start["status"]
+    headers = [(k.decode(), v.decode()) for k, v in start.get("headers", [])]
+
+    first = await next_event()
+    if first is None or first["type"] != "http.response.body":
+        return ASGIResponse(status, headers, b"")
+    if not first.get("more_body"):
+        if app_task.done() and app_task.exception():
+            raise app_task.exception()
+        return ASGIResponse(status, headers, bytes(first.get("body", b"")))
+
+    async def stream():
+        yield ASGIResponseStart(status, headers)
+        if first.get("body"):
+            yield bytes(first["body"])
+        while True:
+            msg = await next_event()
+            if msg is None:
+                return
+            if msg["type"] != "http.response.body":
+                continue
+            if msg.get("body"):
+                yield bytes(msg["body"])
+            if not msg.get("more_body"):
+                return
+
+    return stream()
+
+
+class _ASGIAdapter:
+    """Mixin driving requests through ``self._asgi_app``."""
+
+    _asgi_app = None
+    _asgi_startup: Optional[asyncio.Future] = None
+
+    def _resolve_asgi_app(self):
+        app = self._asgi_app
+        if app is None:
+            raise RuntimeError("no ASGI app bound")
+        return app
+
+    async def __call__(self, request):
+        app = self._resolve_asgi_app()
+        # one shared startup task: concurrent first requests all await the
+        # SAME lifespan completion (not run the app pre-startup), and a
+        # failed startup re-raises for every subsequent request
+        if self._asgi_startup is None:
+            self._asgi_startup = asyncio.ensure_future(
+                _run_lifespan_startup(app))
+        await asyncio.shield(self._asgi_startup)
+        return await _call_asgi(app, request, self)
+
+
+def asgi_app(app_or_factory: Any) -> type:
+    """Wrap an ASGI3 app — or a zero-arg factory returning one, for apps
+    that don't cloudpickle — into a deployment body class.
+
+    >>> serve.run(serve.deployment(serve.asgi_app(fastapi_app)))
+    """
+
+    class ASGIDeployment(_ASGIAdapter):
+        def __init__(self):
+            app = app_or_factory
+            # a factory is a callable that is NOT itself an ASGI app; ASGI
+            # apps take 3 args (scope, receive, send)
+            if callable(app) and not _looks_like_asgi(app):
+                app = app()
+            self._asgi_app = app
+
+    ASGIDeployment.__name__ = getattr(
+        app_or_factory, "__name__", type(app_or_factory).__name__)
+    return ASGIDeployment
+
+
+def ingress(app: Any) -> Callable[[type], type]:
+    """Class decorator mounting an ASGI app on a deployment class
+    (reference: ``serve.ingress(fastapi_app)``). The class's ``__init__``
+    and methods are untouched; HTTP requests route through ``app``, which
+    can reach the instance via ``scope["extensions"]["ray_tpu.deployment"]``.
+    """
+
+    def wrap(cls: type) -> type:
+        ns = {"_asgi_app_static": app}
+
+        class Ingress(cls, _ASGIAdapter):  # type: ignore[misc, valid-type]
+            def _resolve_asgi_app(self):
+                return ns["_asgi_app_static"]
+
+            async def __call__(self, request):
+                return await _ASGIAdapter.__call__(self, request)
+
+        Ingress.__name__ = cls.__name__
+        Ingress.__qualname__ = cls.__qualname__
+        return Ingress
+
+    return wrap
+
+
+def _looks_like_asgi(obj: Any) -> bool:
+    """ASGI apps are callables taking (scope, receive, send); factories
+    take zero args. Class instances (FastAPI, Starlette) are ASGI."""
+    import inspect
+
+    if not inspect.isfunction(obj) and not inspect.ismethod(obj):
+        return True  # app objects (FastAPI etc.) — callable instances
+    try:
+        params = [
+            p for p in inspect.signature(obj).parameters.values()
+            if p.default is p.empty
+            and p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD)
+        ]
+        return len(params) >= 3
+    except (TypeError, ValueError):
+        return True

@@ -25,15 +25,25 @@ _ROUTE_TTL_S = 1.0
 
 
 class ServeRequest:
-    """Picklable HTTP request surface handed to ingress deployments."""
+    """Picklable HTTP request surface handed to ingress deployments.
+
+    ``query``/``headers`` are convenience dicts (last value wins for
+    repeats); ``raw_query`` and ``raw_headers`` preserve the wire form —
+    repeated query params (``?tag=a&tag=b``) and duplicate headers — which
+    the ASGI adapter needs to hand FastAPI/Starlette an unmodified scope.
+    """
 
     def __init__(self, method: str, path: str, query: Dict[str, str],
-                 headers: Dict[str, str], body: bytes):
+                 headers: Dict[str, str], body: bytes,
+                 raw_query: Optional[str] = None,
+                 raw_headers: Optional[list] = None):
         self.method = method
         self.path = path  # path with the app's route_prefix stripped
         self.query = query
         self.headers = headers
         self.body = body
+        self.raw_query = raw_query
+        self.raw_headers = raw_headers  # [(name, value), ...] with repeats
 
     def json(self) -> Any:
         return _json.loads(self.body or b"null")
@@ -182,7 +192,9 @@ class ProxyActor:
         sreq = ServeRequest(
             method=request.method, path=stripped,
             query=dict(request.rel_url.query),
-            headers=dict(request.headers), body=await request.read())
+            headers=dict(request.headers), body=await request.read(),
+            raw_query=request.rel_url.raw_query_string,
+            raw_headers=[(k, v) for k, v in request.headers.items()])
         try:
             result = await handle.remote(sreq)
         except TimeoutError as e:
@@ -190,10 +202,19 @@ class ProxyActor:
         except Exception as e:  # noqa: BLE001 — user code raised
             return web.Response(status=500, text=f"{type(e).__name__}: {e}")
         self._requests_served += 1
+        from ray_tpu.serve.asgi import ASGIResponse
         from ray_tpu.serve.handle import DeploymentResponseGenerator
 
         if isinstance(result, DeploymentResponseGenerator):
             return await self._stream_response(request, result)
+        if isinstance(result, ASGIResponse):
+            # ASGI deployments control the full response surface; a
+            # multidict preserves duplicate headers (Set-Cookie x2)
+            from multidict import CIMultiDict
+
+            return web.Response(status=result.status,
+                                headers=CIMultiDict(result.headers),
+                                body=result.body)
         status, ctype, payload = _to_response(result)
         return web.Response(status=status, content_type=ctype.split(";")[0],
                             body=payload)
@@ -201,20 +222,46 @@ class ProxyActor:
     async def _stream_response(self, request, gen):
         """Chunked transfer of a streaming deployment response (reference:
         ``serve/_private/replica.py:346`` streamed ASGI messages). str/bytes
-        chunks pass through; other values are JSON-encoded, one per line."""
+        chunks pass through; other values are JSON-encoded, one per line.
+        An ASGI deployment's stream leads with ``ASGIResponseStart``, which
+        sets the response status/headers before the first body byte."""
         from aiohttp import web
 
-        resp = web.StreamResponse(
-            status=200, headers={"Content-Type": "application/octet-stream"})
-        await resp.prepare(request)
+        from multidict import CIMultiDict
+
+        from ray_tpu.serve.asgi import ASGIResponseStart
+
+        it = gen.__aiter__()
+        status = 200
+        headers = CIMultiDict({"Content-Type": "application/octet-stream"})
+        _NO_CHUNK = object()  # a literal None chunk is a valid stream item
+        pending_first = _NO_CHUNK
         try:
-            async for chunk in gen:
-                if isinstance(chunk, str):
-                    chunk = chunk.encode()
-                elif not isinstance(chunk, (bytes, bytearray)):
-                    chunk = _json.dumps(chunk, default=_np_default).encode() \
-                        + b"\n"
-                await resp.write(chunk)
+            first = await it.__anext__()
+            if isinstance(first, ASGIResponseStart):
+                status, headers = first.status, CIMultiDict(first.headers)
+            else:
+                pending_first = first
+        except StopAsyncIteration:
+            pass
+        except Exception:  # noqa: BLE001 — failed before first chunk
+            gen.cancel()
+            return web.Response(status=500, text="stream failed")
+        resp = web.StreamResponse(status=status, headers=headers)
+        await resp.prepare(request)
+
+        def encode(chunk):
+            if isinstance(chunk, str):
+                return chunk.encode()
+            if not isinstance(chunk, (bytes, bytearray)):
+                return _json.dumps(chunk, default=_np_default).encode() + b"\n"
+            return chunk
+
+        try:
+            if pending_first is not _NO_CHUNK:
+                await resp.write(encode(pending_first))
+            async for chunk in it:
+                await resp.write(encode(chunk))
         except Exception:  # noqa: BLE001 — mid-stream failure: cut the body
             gen.cancel()
         finally:

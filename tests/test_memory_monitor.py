@@ -57,11 +57,35 @@ def test_logkv_durability(tmp_path):
     kv3.close()
 
 
+def test_logkv_appends_after_torn_tail_survive_restart(tmp_path):
+    """A torn tail must be truncated before appending: records written
+    after a surviving torn tail would be skipped by every future replay —
+    acked puts silently lost on each restart."""
+    from ray_tpu._native import PyLogKV
+
+    for opener in (_native.LogKV, PyLogKV):
+        path = str(tmp_path / f"torn_{opener.__name__}.log")
+        kv = opener(path)
+        kv.put("before", b"1")
+        kv.close()
+        with open(path, "ab") as f:
+            f.write(b"\xde\xad\xbe")  # torn header (crash mid-append)
+        kv2 = opener(path)
+        assert kv2.get("before") == b"1"
+        kv2.put("after", b"2")  # acked post-crash write
+        kv2.close()
+        kv3 = opener(path)
+        assert kv3.get("before") == b"1"
+        assert kv3.get("after") == b"2", f"{opener.__name__} lost a put"
+        kv3.close()
+
+
 def test_logkv_algorithm_stable_across_implementations(tmp_path):
     """The WAL on-disk format must replay identically whichever
     implementation wrote it (ADVICE r3: toolchain availability flipping
-    between restarts silently discarded the whole durable KV). The Python
-    fallback now frames with software crc32c, so native and Python agree."""
+    between restarts silently discarded the whole durable KV). Both
+    replayers accept crc32c AND zlib-crc32 frames; writers use whichever is
+    C-speed for them (native: crc32c; Python fallback: zlib.crc32)."""
     from ray_tpu._native import PyLogKV, crc32c_sw
 
     # crc32c_sw must be true Castagnoli: known vector crc32c("123456789")

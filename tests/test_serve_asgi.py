@@ -1,0 +1,211 @@
+"""ASGI adapter: deploy raw ASGI3 apps (the protocol FastAPI/Starlette
+speak) through serve, with path params, status/headers control, streaming,
+lifespan, and the ``@serve.ingress`` class decorator.
+
+Reference analog: ``serve/_private/http_proxy.py:935`` (native ASGI proxy)
+and ``serve.ingress(fastapi_app)``; tested here with a hand-rolled ASGI app
+because FastAPI isn't in this image — any ASGI3 app exercises the same
+adapter path.
+"""
+
+import json
+import sys
+
+import cloudpickle
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+# the mini app is module-level (shared by several tests) but workers can't
+# import this test module — ship it by value like test-local closures are
+cloudpickle.register_pickle_by_value(sys.modules[__name__])
+
+
+@pytest.fixture
+def serve_cluster():
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=6)
+    yield ray_tpu
+    try:
+        serve.shutdown()
+    finally:
+        serve._forget_controller_for_tests()
+        ray_tpu.shutdown()
+
+
+def _mini_asgi_app():
+    """A tiny ASGI3 app: /items/{id} path param, /echo json POST, /stream
+    chunked response, /fail 500, lifespan tracking."""
+    state = {"started": False}
+
+    async def app(scope, receive, send):
+        if scope["type"] == "lifespan":
+            while True:
+                msg = await receive()
+                if msg["type"] == "lifespan.startup":
+                    state["started"] = True
+                    await send({"type": "lifespan.startup.complete"})
+                elif msg["type"] == "lifespan.shutdown":
+                    await send({"type": "lifespan.shutdown.complete"})
+                    return
+        assert scope["type"] == "http"
+        path = scope["path"]
+
+        async def respond(status, body, ctype=b"application/json",
+                          extra=()):
+            await send({"type": "http.response.start", "status": status,
+                        "headers": [(b"content-type", ctype), *extra]})
+            await send({"type": "http.response.body", "body": body})
+
+        if path.startswith("/items/"):
+            item_id = path.split("/")[2]
+            if not item_id.isdigit():
+                await respond(422, b'{"detail":"not an int"}')
+                return
+            await respond(
+                200,
+                json.dumps({"id": int(item_id),
+                            "lifespan_ran": state["started"]}).encode(),
+                extra=((b"x-item", item_id.encode()),))
+        elif path == "/echo":
+            body = b""
+            while True:
+                msg = await receive()
+                body += msg.get("body", b"")
+                if not msg.get("more_body"):
+                    break
+            await respond(200, json.dumps(
+                {"echo": json.loads(body or b"null"),
+                 "method": scope["method"],
+                 "q": scope["query_string"].decode()}).encode())
+        elif path == "/stream":
+            await send({"type": "http.response.start", "status": 200,
+                        "headers": [(b"content-type", b"text/plain")]})
+            for i in range(4):
+                await send({"type": "http.response.body",
+                            "body": f"tok{i};".encode(), "more_body": True})
+            await send({"type": "http.response.body", "body": b"done",
+                        "more_body": False})
+        elif path == "/fail":
+            raise RuntimeError("app exploded")
+        else:
+            await respond(404, b'{"detail":"nope"}')
+
+    return app
+
+
+def test_asgi_app_deployment_end_to_end(serve_cluster):
+    import requests
+
+    serve.run(serve.deployment(serve.asgi_app(_mini_asgi_app)).bind(),
+              name="asgi", route_prefix="/svc")
+    base = f"http://127.0.0.1:{serve.http_port()}/svc"
+
+    # path params + custom headers + lifespan ran before first request
+    r = requests.get(f"{base}/items/42", timeout=30)
+    assert r.status_code == 200
+    assert r.json() == {"id": 42, "lifespan_ran": True}
+    assert r.headers["x-item"] == "42"
+
+    # non-200 statuses pass through
+    assert requests.get(f"{base}/items/abc", timeout=30).status_code == 422
+    assert requests.get(f"{base}/other", timeout=30).status_code == 404
+
+    # request body, method, query string all reach the app — including
+    # REPEATED params, which the raw query string must preserve
+    r = requests.post(f"{base}/echo?a=1&a=2&b=3", json={"k": "v"},
+                      timeout=30)
+    assert r.json() == {"echo": {"k": "v"}, "method": "POST",
+                        "q": "a=1&a=2&b=3"}
+
+    # user exceptions surface as 500 (not a wedged request)
+    assert requests.get(f"{base}/fail", timeout=30).status_code == 500
+
+
+def test_asgi_streaming_response(serve_cluster):
+    import requests
+
+    serve.run(serve.deployment(serve.asgi_app(_mini_asgi_app)).bind(),
+              name="asgi_s", route_prefix="/s")
+    base = f"http://127.0.0.1:{serve.http_port()}/s"
+    r = requests.get(f"{base}/stream", timeout=30, stream=True)
+    assert r.status_code == 200
+    assert r.headers["content-type"] == "text/plain"
+    assert r.raw.read() == b"tok0;tok1;tok2;tok3;done"
+
+
+def test_ingress_decorator_binds_class(serve_cluster):
+    """@serve.ingress mounts the app while keeping the deployment class's
+    own state; the app reaches the instance through the ASGI scope."""
+    import requests
+
+    async def app(scope, receive, send):
+        if scope["type"] != "http":
+            raise RuntimeError("no lifespan here")  # apps may opt out
+        inst = scope["extensions"]["ray_tpu.deployment"]
+        n = inst.bump()
+        await send({"type": "http.response.start", "status": 200,
+                    "headers": [(b"content-type", b"application/json")]})
+        await send({"type": "http.response.body",
+                    "body": json.dumps({"model": inst.model,
+                                        "calls": n}).encode()})
+
+    @serve.deployment
+    @serve.ingress(app)
+    class Model:
+        def __init__(self, model):
+            self.model = model
+            self.calls = 0
+
+        def bump(self):
+            self.calls += 1
+            return self.calls
+
+    serve.run(Model.bind("llama-debug"), name="ing", route_prefix="/m")
+    base = f"http://127.0.0.1:{serve.http_port()}/m"
+    assert requests.get(base, timeout=30).json() == {
+        "model": "llama-debug", "calls": 1}
+    assert requests.get(base, timeout=30).json()["calls"] == 2
+
+
+def test_asgi_app_factory(serve_cluster):
+    """Zero-arg factories defer app construction to the replica (the
+    escape hatch for apps that don't pickle)."""
+    import requests
+
+    serve.run(serve.deployment(
+        serve.asgi_app(lambda: _mini_asgi_app())).bind(),
+        name="asgi_f", route_prefix="/f")
+    base = f"http://127.0.0.1:{serve.http_port()}/f"
+    assert requests.get(f"{base}/items/7", timeout=30).json()["id"] == 7
+
+
+def test_fastapi_app_if_available(serve_cluster):
+    """FastAPI apps are ASGI3 apps; when the package exists, they deploy
+    unchanged (reference parity: serve.run on a FastAPI ingress)."""
+    fastapi = pytest.importorskip("fastapi")
+    import requests
+
+    def build():
+        app = fastapi.FastAPI()
+
+        @app.get("/items/{item_id}")
+        def read(item_id: int, q: str = ""):
+            return {"item_id": item_id, "q": q}
+
+        @app.get("/stream")
+        def stream():
+            from fastapi.responses import StreamingResponse
+
+            return StreamingResponse(iter(["a", "b", "c"]))
+
+        return app
+
+    serve.run(serve.deployment(serve.asgi_app(build)).bind(),
+              name="fastapi", route_prefix="/fa")
+    base = f"http://127.0.0.1:{serve.http_port()}/fa"
+    r = requests.get(f"{base}/items/5?q=x", timeout=30)
+    assert r.json() == {"item_id": 5, "q": "x"}
+    assert requests.get(f"{base}/stream", timeout=30).text == "abc"
