@@ -686,9 +686,13 @@ class Raylet:
                     pool = bundle.pool
                 else:
                     pool = self.node
+                from ray_tpu.scheduler.policy import strategy_allows_local
+
+                local_ok = pg is not None or strategy_allows_local(
+                    payload.get("strategy"), self.node_id, self.node.labels)
                 if item.get("spilling"):
                     remaining.append(item)  # a spillback attempt owns it
-                elif pool.can_fit(req):
+                elif local_ok and pool.can_fit(req):
                     assignment = pool.allocate(req)
                     spawn_task(
                         self._run_task(item, req, assignment, pool))
@@ -697,10 +701,13 @@ class Raylet:
                     # ScheduleAndDispatchTasks): a feasible task that has
                     # waited past the delay looks for a node with capacity
                     # free NOW. PG tasks are bundle-pinned — never spill.
+                    # Strategy-ineligible tasks (hard affinity/labels bound
+                    # elsewhere) MUST route and are exempt from the hop cap.
                     cfg = get_config()
                     if (pg is None
-                            and payload.get("spill_count", 0)
-                            < cfg.spillback_max_hops
+                            and (not local_ok
+                                 or payload.get("spill_count", 0)
+                                 < cfg.spillback_max_hops)
                             and time.monotonic() - item.get("t", 0)
                             > cfg.spillback_delay_s):
                         item["spilling"] = True

@@ -757,6 +757,16 @@ class ClusterBackend(RuntimeBackend):
         )
 
         strategy = options.get("scheduling_strategy")
+        selector = options.get("label_selector")
+        if selector:
+            if strategy is not None:
+                raise ValueError(
+                    "label_selector cannot be combined with "
+                    "scheduling_strategy; put soft preferences in a "
+                    "NodeLabelStrategy(hard=..., soft=...) instead")
+            from ray_tpu.core.task_spec import NodeLabelStrategy
+
+            strategy = NodeLabelStrategy(hard=dict(selector))
         pg = options.get("placement_group")
         if pg is not None:
             if not isinstance(pg, PlacementGroup):

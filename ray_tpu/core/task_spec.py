@@ -37,6 +37,29 @@ class NodeAffinityStrategy(SchedulingStrategy):
 
 
 @dataclasses.dataclass
+class NodeLabelStrategy(SchedulingStrategy):
+    """Schedule onto nodes matching label constraints (reference:
+    ``NodeLabelSchedulingPolicy`` + ``NodeLabelSchedulingStrategy``).
+
+    ``hard`` must match; ``soft`` prefers matching nodes but falls back.
+    Each value is one match expression:
+
+    - ``"v"``        — label equals v (In)
+    - ``["a", "b"]`` — label in {a, b} (In)
+    - ``"!v"``       — label not equal v (NotIn)
+    - ``"*"``        — label exists (Exists)
+    - ``"!*"``       — label absent (DoesNotExist)
+
+    e.g. ``NodeLabelStrategy(hard={"tpu-slice-name": "slice-0"},
+    soft={"accelerator-type": ["TPU-V5P", "TPU-V5E"]})``.
+    """
+
+    kind: str = "NODE_LABEL"
+    hard: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    soft: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
 class PlacementGroupStrategy(SchedulingStrategy):
     kind: str = "PLACEMENT_GROUP"
     placement_group_id_hex: str = ""

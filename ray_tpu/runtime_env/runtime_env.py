@@ -13,6 +13,9 @@ Supported fields:
   - ``pip``: list of requirement strings / local wheel paths, installed into
     a per-env cache dir that is prepended to ``sys.path`` (no venv spawn —
     same interpreter, isolated site dir).
+  - ``py_modules``: list of local package dirs (reference:
+    ``runtime_env/py_modules.py``) shipped content-addressed like
+    working_dir, joined to ``sys.path`` as import roots without chdir.
 """
 
 from __future__ import annotations
@@ -96,7 +99,27 @@ def prepare_runtime_env(env: Optional[RuntimeEnv], kv_put, kv_get) -> Optional[D
         wire["env_vars"] = dict(vars_)
     if env.get("pip"):
         wire["pip"] = list(env["pip"])
-    unknown = set(env) - {"working_dir", "env_vars", "pip"}
+    py_modules = env.get("py_modules")
+    if py_modules:
+        # Each entry is a local package dir (or a prior gcs:// URI); each is
+        # uploaded content-addressed like working_dir but joins sys.path
+        # WITHOUT chdir (reference: runtime_env/py_modules.py — modules are
+        # import roots, working_dir is the cwd).
+        uris = []
+        for mod in py_modules:
+            if str(mod).startswith("gcs://"):
+                uris.append(mod)
+                continue
+            blob = package_working_dir(mod)
+            digest = hashlib.sha1(blob).hexdigest()[:20]
+            key = _KV_PREFIX + digest
+            if kv_get(key) is None:
+                kv_put(key, blob)
+            # preserve the top-level package name: the zip holds the dir's
+            # CONTENTS, so the import root must re-create <name>/
+            uris.append(f"gcs://{digest}#{os.path.basename(os.path.abspath(mod))}")
+        wire["py_modules_uris"] = uris
+    unknown = set(env) - {"working_dir", "env_vars", "pip", "py_modules"}
     if unknown:
         raise ValueError(f"unsupported runtime_env fields: {sorted(unknown)}")
     if not wire:
@@ -133,6 +156,32 @@ def materialize(wire: Dict, kv_get, cache_root: str) -> None:
         os.chdir(target)
         if target not in sys.path:
             sys.path.insert(0, target)
+
+    for mod_uri in wire.get("py_modules_uris") or ():
+        # "gcs://<digest>#<pkg_name>": the zip holds the package dir's
+        # CONTENTS, so extraction recreates <root>/<pkg_name>/ and <root>
+        # joins sys.path as the import root (no chdir — that's
+        # working_dir's job).
+        ref, _, pkg_name = mod_uri.partition("#")
+        digest = ref[len("gcs://"):]
+        root = os.path.join(cache_root, "py_modules", digest)
+        if not os.path.isdir(root):
+            blob = kv_get(_KV_PREFIX + digest)
+            if blob is None:
+                raise RuntimeError(f"runtime_env blob {ref} not in GCS KV")
+            tmp = root + f".tmp.{os.getpid()}"
+            dest = os.path.join(tmp, pkg_name) if pkg_name else tmp
+            os.makedirs(dest, exist_ok=True)
+            with zipfile.ZipFile(io.BytesIO(blob)) as zf:
+                zf.extractall(dest)
+            try:
+                os.rename(tmp, root)
+            except OSError:  # another worker won the race
+                import shutil
+
+                shutil.rmtree(tmp, ignore_errors=True)
+        if root not in sys.path:
+            sys.path.insert(0, root)
 
     pip_reqs = wire.get("pip")
     if pip_reqs:

@@ -8,6 +8,7 @@ reference's two-level design (``ClusterTaskManager``/``LocalTaskManager``).
 from ray_tpu.scheduler.policy import (  # noqa: F401
     HybridPolicy,
     NodeAffinityPolicy,
+    NodeLabelPolicy,
     SpreadPolicy,
     pick_node,
 )

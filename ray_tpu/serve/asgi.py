@@ -146,6 +146,10 @@ async def _call_asgi(app, request, instance):
     body = request.body or b""
     sent_body = False
     events: asyncio.Queue = asyncio.Queue()
+    # Set once the response has been fully delivered; a later receive()
+    # then reports http.disconnect so apps polling is_disconnected() (SSE,
+    # long-poll) unwind instead of parking their task forever.
+    response_complete = asyncio.Event()
 
     async def receive():
         nonlocal sent_body
@@ -153,14 +157,17 @@ async def _call_asgi(app, request, instance):
             sent_body = True
             return {"type": "http.request", "body": body,
                     "more_body": False}
-        # client disconnect is never signaled mid-request here: the proxy
-        # already buffered the full request
-        await asyncio.Event().wait()
+        await response_complete.wait()
+        return {"type": "http.disconnect"}
 
     async def send(message):
         await events.put(message)
 
     app_task = asyncio.ensure_future(app(scope, receive, send))
+    # retrieve the exception of an app that fails AFTER its response was
+    # returned — an unobserved task exception warns at GC otherwise
+    app_task.add_done_callback(
+        lambda t: t.cancelled() or t.exception())
 
     async def next_event():
         # drain queued events before consulting the app task: the app may
@@ -185,38 +192,52 @@ async def _call_asgi(app, request, instance):
             raise exc
         return None
 
-    start: Optional[Dict] = None
-    while start is None:
-        msg = await next_event()
-        if msg is None:
-            raise RuntimeError("ASGI app returned before response.start")
-        if msg["type"] == "http.response.start":
-            start = msg
-    status = start["status"]
-    headers = [(k.decode(), v.decode()) for k, v in start.get("headers", [])]
-
-    first = await next_event()
-    if first is None or first["type"] != "http.response.body":
-        return ASGIResponse(status, headers, b"")
-    if not first.get("more_body"):
-        if app_task.done() and app_task.exception():
-            raise app_task.exception()
-        return ASGIResponse(status, headers, bytes(first.get("body", b"")))
-
-    async def stream():
-        yield ASGIResponseStart(status, headers)
-        if first.get("body"):
-            yield bytes(first["body"])
-        while True:
+    try:
+        start: Optional[Dict] = None
+        while start is None:
             msg = await next_event()
             if msg is None:
-                return
-            if msg["type"] != "http.response.body":
-                continue
-            if msg.get("body"):
-                yield bytes(msg["body"])
-            if not msg.get("more_body"):
-                return
+                raise RuntimeError("ASGI app returned before response.start")
+            if msg["type"] == "http.response.start":
+                start = msg
+        status = start["status"]
+        headers = [(k.decode(), v.decode())
+                   for k, v in start.get("headers", [])]
+
+        first = await next_event()
+        if first is None or first["type"] != "http.response.body":
+            response_complete.set()
+            return ASGIResponse(status, headers, b"")
+        if not first.get("more_body"):
+            if app_task.done() and app_task.exception():
+                raise app_task.exception()
+            response_complete.set()
+            return ASGIResponse(status, headers,
+                                bytes(first.get("body", b"")))
+    except BaseException:
+        response_complete.set()
+        app_task.cancel()
+        raise
+
+    async def stream():
+        try:
+            yield ASGIResponseStart(status, headers)
+            if first.get("body"):
+                yield bytes(first["body"])
+            while True:
+                msg = await next_event()
+                if msg is None:
+                    return
+                if msg["type"] != "http.response.body":
+                    continue
+                if msg.get("body"):
+                    yield bytes(msg["body"])
+                if not msg.get("more_body"):
+                    return
+        finally:
+            # normal end, consumer cancel (GeneratorExit), or app error:
+            # unblock the app's next receive() so its task exits
+            response_complete.set()
 
     return stream()
 

@@ -14,7 +14,9 @@ from ray_tpu.tune.schedulers import (  # noqa: F401
     MedianStoppingRule,
     PB2,
     PopulationBasedTraining,
+    ResourceChangingScheduler,
     TrialScheduler,
+    evenly_distribute_cpus,
 )
 from ray_tpu.tune.search import (  # noqa: F401
     BasicVariantGenerator,

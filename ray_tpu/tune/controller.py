@@ -43,8 +43,11 @@ class _TrialRunner:
         self._t.cleanup()
 
 
-def _runner_options(trainable_cls: type) -> Dict[str, Any]:
-    res = getattr(trainable_cls, "_tune_resources", None) or {"cpu": 1}
+def _runner_options(trainable_cls: type,
+                    override: Optional[Dict[str, Any]] = None
+                    ) -> Dict[str, Any]:
+    res = override or getattr(trainable_cls, "_tune_resources", None) \
+        or {"cpu": 1}
     opts: Dict[str, Any] = {}
     custom: Dict[str, float] = {}
     for k, v in res.items():
@@ -109,11 +112,12 @@ class TuneController:
                 return
             self._next_id += 1
             t = Trial(trial_id, cfg, self._name)
+            t.base_resources = getattr(self._cls, "_tune_resources", None)
             self._trials.append(t)
             self._scheduler.on_trial_add(t)
 
     def _start_trial(self, t: Trial) -> None:
-        opts = _runner_options(self._cls)
+        opts = _runner_options(self._cls, t.resources)
         t.mark_running(_TrialRunner.options(**opts).remote(
             self._cls, t.config, t.restore_path))
         t.restore_path = None

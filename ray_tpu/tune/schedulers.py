@@ -438,3 +438,80 @@ class PB2(PopulationBasedTraining):
             v = lo + best[1 + j] * (hi - lo)
             new[k] = int(round(v)) if isinstance(config.get(k), int) else v
         return new
+
+
+class ResourceChangingScheduler(TrialScheduler):
+    """Reallocate trial resources while the experiment runs (reference:
+    ``tune/schedulers/resource_changing_scheduler.py`` — wraps a base
+    scheduler; a ``resources_allocation_function`` proposes new resources
+    per result, and the trial is checkpoint-paused and relaunched with
+    them).
+
+    ``resources_allocation_function(trials, trial, result)`` receives the
+    live trial list, the reporting trial, and its result; it returns a
+    resource dict (``{"cpu": 2}``-style, the ``_tune_resources`` surface)
+    or None for no change. The default evenly splits the cluster's CPUs
+    across live trials, so finished trials hand capacity to survivors.
+    """
+
+    def __init__(self, base_scheduler: Optional[TrialScheduler] = None,
+                 resources_allocation_function=None):
+        self._base = base_scheduler or FIFOScheduler()
+        self._alloc = resources_allocation_function or evenly_distribute_cpus
+        self._trials: List[Trial] = []
+
+    def set_search_properties(self, metric, mode) -> bool:
+        return self._base.set_search_properties(metric, mode)
+
+    def on_trial_add(self, trial: Trial) -> None:
+        self._trials.append(trial)
+        self._base.on_trial_add(trial)
+
+    def on_trial_complete(self, trial: Trial, result) -> None:
+        self._base.on_trial_complete(trial, result)
+
+    def on_trial_error(self, trial: Trial) -> None:
+        self._base.on_trial_error(trial)
+
+    def pop_mutation(self, trial: Trial):
+        return self._base.pop_mutation(trial)
+
+    def on_trial_result(self, trial: Trial, result: Dict[str, Any]) -> str:
+        decision = self._base.on_trial_result(trial, result)
+        if decision != CONTINUE:
+            return decision
+        try:
+            proposed = self._alloc(list(self._trials), trial, result)
+        except Exception:  # noqa: BLE001 — allocator bugs must not kill runs
+            return decision
+        current = trial.resources or trial.base_resources or {}
+        if proposed and proposed != current:
+            # checkpoint-pause; the controller requeues and _start_trial
+            # relaunches the runner with the new resources
+            trial.resources = dict(proposed)
+            return PAUSE
+        return decision
+
+
+def evenly_distribute_cpus(trials: List[Trial], trial: Trial,
+                           result: Dict[str, Any]):
+    """Default allocator: split the cluster's CPUs evenly across live
+    trials (reference: ``DistributeResources``). Never shrinks below the
+    trainable's base request."""
+    import ray_tpu
+
+    try:
+        total = ray_tpu.cluster_resources().get("CPU", 0)
+    except Exception:  # noqa: BLE001 — not connected (unit tests)
+        return None
+    from ray_tpu.tune.trial import PENDING, RUNNING
+
+    live = [t for t in trials if t.status in (PENDING, RUNNING)]
+    if not live or total <= 0:
+        return None
+    base = (trial.base_resources or {}).get("cpu", 1)
+    current = (trial.resources or trial.base_resources or {}).get("cpu", 1)
+    share = max(base, int(total) // len(live))  # never below the declared
+    if share == current:
+        return None
+    return {"cpu": share}

@@ -34,6 +34,12 @@ class Trial:
         self.start_time: Optional[float] = None
         self.runner = None  # ActorHandle while RUNNING
         self.inflight = None  # ObjectRef of pending train() call
+        # per-trial resource override (ResourceChangingScheduler); None =
+        # use the trainable class's _tune_resources. base_resources is the
+        # class's declared request, stamped by the controller so allocators
+        # can floor at it.
+        self.resources = None
+        self.base_resources = None
 
     @property
     def training_iteration(self) -> int:

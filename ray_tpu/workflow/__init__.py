@@ -19,8 +19,17 @@ from ray_tpu.workflow.api import (  # noqa: F401
     run,
     run_async,
 )
+from ray_tpu.workflow.events import (  # noqa: F401
+    EventListener,
+    HTTPListener,
+    TimerListener,
+    http_event_provider,
+    wait_for_event,
+)
 
 __all__ = [
     "init", "run", "run_async", "resume", "cancel", "get_status",
     "get_output", "list_all", "WorkflowStatus",
+    "EventListener", "TimerListener", "HTTPListener", "wait_for_event",
+    "http_event_provider",
 ]

@@ -190,6 +190,19 @@ class _DurableExecutor:
             ref = node._execute_impl(args, kwargs, self.input_val)
             val = global_worker().get(ref)
             self._checkpoint(path, val)
+            # wait_for_event nodes: exactly-once commit hook fires AFTER
+            # the event is durably checkpointed (workflow/events.py)
+            listener_cls = getattr(node, "_event_listener_cls", None)
+            if listener_cls is not None:
+                try:
+                    import asyncio
+                    import inspect
+
+                    r = listener_cls().event_checkpointed(val)
+                    if inspect.isawaitable(r):
+                        asyncio.run(r)
+                except Exception:  # noqa: BLE001 — best-effort hook
+                    pass
         else:
             val = node._execute_impl(args, kwargs, self.input_val)
         self._cache[id(node)] = val

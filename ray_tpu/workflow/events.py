@@ -1,0 +1,149 @@
+"""Workflow event system: external-event wait/resume.
+
+Reference parity: ``python/ray/workflow/event_listener.py`` (the
+``EventListener`` protocol with ``poll_for_event`` /
+``event_checkpointed``) and ``http_event_provider.py`` (a serve deployment
+receiving events over HTTP that listeners poll). Redesigned for this
+engine: ``wait_for_event`` produces a normal DAG node executed as a remote
+task, so the durable executor checkpoints the received event like any task
+result — a resumed workflow does NOT re-wait for an event it already
+consumed (the reference's ``event_checkpointed`` contract falls out of the
+checkpoint machinery instead of a second callback path).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any
+
+__all__ = ["EventListener", "TimerListener", "HTTPListener",
+           "wait_for_event", "http_event_provider"]
+
+
+class EventListener:
+    """Subclass and implement ``poll_for_event`` (sync or async). The
+    instance is created inside the waiting task, once per (re)execution.
+
+    Reference: ``workflow/event_listener.py:11``. ``event_checkpointed``
+    is supported as an optional post-checkpoint hook for exactly-once
+    integrations (e.g. committing a queue offset): it runs AFTER the
+    durable executor has checkpointed the event, on a best-effort basis.
+    """
+
+    def poll_for_event(self, *args, **kwargs) -> Any:
+        raise NotImplementedError
+
+    def event_checkpointed(self, event: Any) -> None:
+        """Optional commit hook; called after the event is durably
+        checkpointed (may be sync or async)."""
+
+
+class TimerListener(EventListener):
+    """Fires once ``timestamp`` (unix seconds) has passed — the reference's
+    canonical example listener."""
+
+    def poll_for_event(self, timestamp: float) -> float:
+        time.sleep(max(0.0, timestamp - time.time()))
+        return timestamp
+
+
+class HTTPListener(EventListener):
+    """Polls the :func:`http_event_provider` serve deployment for an event
+    posted to ``(workflow_id, event_key)``.
+
+    Reference: ``http_event_provider.py`` ``HTTPListener``.
+    """
+
+    def poll_for_event(self, workflow_id: str, event_key: str,
+                       poll_interval_s: float = 0.2) -> Any:
+        from ray_tpu import serve
+
+        handle = serve.get_app_handle("workflow-events")
+        while True:
+            found, payload = handle.get_event.remote(
+                workflow_id, event_key).result(timeout=30)
+            if found:
+                return payload
+            time.sleep(poll_interval_s)
+
+
+def wait_for_event(listener_cls, *args, **kwargs):
+    """A DAG node that completes when the listener observes its event;
+    compose it into workflows like any other bound task.
+
+    >>> gate = workflow.wait_for_event(HTTPListener, "wf1", "approved")
+    >>> result = process.bind(gate)
+    >>> workflow.run(result, workflow_id="wf1")
+
+    The event value is checkpointed, so resume never re-waits.
+
+    Reference: ``workflow/api.py`` ``wait_for_event``.
+    """
+    if not (isinstance(listener_cls, type)
+            and issubclass(listener_cls, EventListener)):
+        raise TypeError(
+            f"wait_for_event needs an EventListener subclass, got "
+            f"{listener_cls!r}")
+    import ray_tpu
+
+    @ray_tpu.remote
+    def _wait_for_event(cls, wargs, wkwargs):
+        import asyncio
+        import inspect
+
+        listener = cls()
+        event = listener.poll_for_event(*wargs, **wkwargs)
+        if inspect.isawaitable(event):
+            event = asyncio.run(event)
+        return event
+
+    node = _wait_for_event.bind(listener_cls, args, kwargs)
+    # the durable executor fires listener.event_checkpointed after writing
+    # the checkpoint; mark the node so it knows which class to notify
+    node._event_listener_cls = listener_cls
+    return node
+
+
+def http_event_provider(port_app_name: str = "workflow-events"):
+    """Deploy the HTTP event provider (a serve application): external
+    systems POST ``{"workflow_id": ..., "event_key": ..., "payload": ...}``
+    to ``/workflow-events/send`` and workflows consume via
+    :class:`HTTPListener`. Returns the deployment handle.
+
+    Reference: ``http_event_provider.py`` ``HTTPEventProvider`` (also a
+    serve deployment on the cluster's proxy).
+    """
+    from ray_tpu import serve
+
+    @serve.deployment
+    class EventProvider:
+        MAX_PENDING = 10_000
+
+        def __init__(self):
+            self._events = {}  # (workflow_id, key) -> payload
+
+        def get_event(self, workflow_id: str, event_key: str):
+            # Consumed on delivery: exactly-once to the waiting workflow
+            # (its checkpoint makes replay safe), no unbounded growth, and
+            # a re-run workflow id waits for a FRESH event instead of
+            # re-reading a stale one.
+            k = (workflow_id, event_key)
+            if k in self._events:
+                return True, self._events.pop(k)
+            return False, None
+
+        def __call__(self, request):
+            data = request.json()
+            if not isinstance(data, dict) or "workflow_id" not in data \
+                    or "event_key" not in data:
+                return 400, "need workflow_id and event_key"
+            if len(self._events) >= self.MAX_PENDING:
+                # evict oldest undelivered: a dead workflow must not
+                # brick the provider for live ones
+                self._events.pop(next(iter(self._events)))
+            self._events[(data["workflow_id"], data["event_key"])] = \
+                data.get("payload")
+            return {"accepted": True}
+
+    return serve.run(EventProvider.bind(), name=port_app_name,
+                     route_prefix="/workflow-events")

@@ -122,3 +122,133 @@ class TestWorkflow:
         workflow.run(_double.bind(1), workflow_id="wf-a")
         entries = workflow.list_all()
         assert any(e["workflow_id"] == "wf-a" for e in entries)
+
+
+class TestWorkflowEvents:
+    """Event system (reference: workflow/event_listener.py +
+    http_event_provider.py): wait_for_event nodes, checkpointed events on
+    resume, the exactly-once commit hook, and the HTTP provider."""
+
+    def test_timer_listener_fires(self, local_rt, tmp_path):
+        workflow.init(str(tmp_path))
+        import time as _time
+
+        gate = workflow.wait_for_event(
+            workflow.TimerListener, _time.time() + 0.3)
+        t0 = _time.time()
+        workflow.run(_double.bind(gate), workflow_id="wf-timer")
+        assert _time.time() - t0 >= 0.25
+
+    def test_custom_listener_and_checkpoint_hook(self, local_rt, tmp_path):
+        workflow.init(str(tmp_path))
+        committed = str(tmp_path / "committed")
+
+        class FileListener(workflow.EventListener):
+            """Fires when a file exists; commit hook records the ack."""
+
+            def poll_for_event(self, path):
+                import time as _t
+                while not os.path.exists(path):
+                    _t.sleep(0.05)
+                with open(path) as f:
+                    return f.read()
+
+            def event_checkpointed(self, event):
+                with open(committed, "w") as f:
+                    f.write(f"ack:{event}")
+
+        evt_file = str(tmp_path / "evt")
+        with open(evt_file, "w") as f:
+            f.write("7")
+        gate = workflow.wait_for_event(FileListener, evt_file)
+        assert workflow.run(_to_int_double.bind(gate),
+                            workflow_id="wf-file-evt") == 14
+        # the commit hook ran after checkpointing
+        with open(committed) as f:
+            assert f.read() == "ack:7"
+
+    def test_event_checkpoint_survives_resume(self, local_rt, tmp_path):
+        """A consumed event must NOT be re-waited on resume: the checkpoint
+        is replayed even though the event source is gone."""
+        global _FAIL_MARKER
+        workflow.init(str(tmp_path))
+        marker = str(tmp_path / "fail_marker")
+        open(marker, "w").close()
+        _FAIL_MARKER = marker
+
+        evt_file = str(tmp_path / "evt")
+        with open(evt_file, "w") as f:
+            f.write("3")
+
+        class OneShotListener(workflow.EventListener):
+            def poll_for_event(self, path):
+                with open(path) as f:
+                    v = f.read()
+                os.remove(path)  # the event can only be observed ONCE
+                return v
+
+        gate = workflow.wait_for_event(OneShotListener, evt_file)
+        dag = _add.bind(_to_int_double.bind(gate), _flaky.bind(1))
+        with pytest.raises(ray_tpu.exceptions.TaskError):
+            workflow.run(dag, workflow_id="wf-evt-resume")
+        os.remove(marker)
+        # resume succeeds even though the event file no longer exists:
+        # _to_int_double("3") == 6 replays from its checkpoint, _flaky(1)
+        # now returns 2
+        assert workflow.resume("wf-evt-resume") == 8
+
+    def test_wait_for_event_type_checks(self, local_rt):
+        with pytest.raises(TypeError, match="EventListener"):
+            workflow.wait_for_event(object)
+
+
+@remote
+def _to_int_double(x):
+    return 2 * int(x)
+
+
+def test_http_event_provider_end_to_end(tmp_path):
+    """External systems unblock workflows by POSTing to the serve-deployed
+    event provider (reference: http_event_provider.py): a workflow parked
+    on HTTPListener resumes when the event arrives over HTTP."""
+    import json as _json
+    import threading
+    import time as _time
+
+    import requests
+
+    from ray_tpu import serve
+
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=4)
+    try:
+        workflow.init(str(tmp_path))
+        workflow.http_event_provider()
+        base = f"http://127.0.0.1:{serve.http_port()}/workflow-events"
+
+        gate = workflow.wait_for_event(
+            workflow.HTTPListener, "wf-http", "approval")
+        wid = workflow.run_async(_to_int_double.bind(gate),
+                                 workflow_id="wf-http")
+
+        def post_later():
+            _time.sleep(0.8)
+            r = requests.post(base, data=_json.dumps(
+                {"workflow_id": "wf-http", "event_key": "approval",
+                 "payload": "21"}), timeout=10)
+            assert r.json() == {"accepted": True}
+
+        t = threading.Thread(target=post_later)
+        t.start()
+        assert workflow.get_output(wid, timeout=60) == 42
+        t.join()
+        # malformed events are rejected
+        assert requests.post(base, data=_json.dumps({"nope": 1}),
+                             timeout=10).status_code == 400
+    finally:
+        try:
+            serve.shutdown()
+        finally:
+            serve._forget_controller_for_tests()
+            ray_tpu.shutdown()

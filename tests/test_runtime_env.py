@@ -120,3 +120,44 @@ def test_runtime_env_unknown_field_rejected(rt_cluster):
 
     with pytest.raises(Exception, match="unsupported runtime_env"):
         f.remote()
+
+
+def test_py_modules_import_without_chdir(rt_cluster, tmp_path):
+    """py_modules ship package dirs as import roots (reference:
+    runtime_env/py_modules.py): the package imports by NAME in the worker,
+    and cwd is NOT changed (that's working_dir's job)."""
+    pkg = tmp_path / "magic_pkg"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("NAME = 'magic_pkg'\n")
+    (pkg / "core.py").write_text("def spell():\n    return 'abracadabra'\n")
+
+    @ray_tpu.remote(runtime_env={"py_modules": [str(pkg)]})
+    def use_pkg():
+        import magic_pkg
+        from magic_pkg.core import spell
+
+        return magic_pkg.NAME, spell(), os.getcwd()
+
+    name, word, cwd = ray_tpu.get(use_pkg.remote(), timeout=60)
+    assert (name, word) == ("magic_pkg", "abracadabra")
+    assert "magic_pkg" not in cwd  # import root, not working dir
+
+
+def test_py_modules_with_working_dir(rt_cluster, tmp_path, project_dir):
+    """py_modules compose with working_dir: cwd comes from working_dir,
+    imports resolve from both."""
+    pkg = tmp_path / "side_pkg"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("VALUE = 7\n")
+
+    @ray_tpu.remote(runtime_env={"working_dir": project_dir,
+                                 "py_modules": [str(pkg)]})
+    def both():
+        import side_pkg
+        import secret_mod
+
+        with open("data.txt") as f:
+            return side_pkg.VALUE, secret_mod.MAGIC, f.read().strip()
+
+    assert ray_tpu.get(both.remote(), timeout=60) == (
+        7, "from-working-dir", "forty-two")

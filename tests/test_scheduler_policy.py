@@ -1,0 +1,169 @@
+"""Node-selection policy unit tests (reference: the C++ policy tests in
+``src/ray/raylet/scheduling/policy/*_test.cc``): hybrid top-k ranking,
+node-label hard/soft matching, and the local-dispatch eligibility gate."""
+
+import random
+
+import pytest
+
+from ray_tpu.core.resources import CPU, NodeResources, ResourceSet
+from ray_tpu.core.task_spec import NodeAffinityStrategy, NodeLabelStrategy
+from ray_tpu.scheduler.policy import (
+    HybridPolicy,
+    NodeLabelPolicy,
+    pick_node,
+    strategy_allows_local,
+)
+
+
+def _node(cpu_total=4, cpu_avail=None, labels=None):
+    nr = NodeResources({CPU: cpu_total}, labels=labels)
+    if cpu_avail is not None:
+        nr.available = ResourceSet({CPU: cpu_avail})
+    return nr
+
+
+def _req(cpu=1):
+    return ResourceSet({CPU: cpu})
+
+
+class TestHybridTopK:
+    def test_prefers_lowest_utilization(self):
+        nodes = {"busy": _node(4, 1), "idle": _node(4, 4)}
+        # busy node at 75% util, idle at 0: idle must win every time
+        picks = {HybridPolicy().pick(nodes, _req(), rng=random.Random(i))
+                 for i in range(20)}
+        assert picks == {"idle"}
+
+    def test_truncation_ties_lightly_loaded_nodes(self, monkeypatch):
+        monkeypatch.setenv("RT_SCHEDULER_SPREAD_THRESHOLD", "0.5")
+        from ray_tpu._private import config as config_mod
+
+        config_mod.reset_config_for_tests()
+        # both under the 0.5 threshold -> tie -> both get picked over trials
+        nodes = {"a": _node(10, 10), "b": _node(10, 9)}
+        picks = {HybridPolicy().pick(nodes, _req(), rng=random.Random(i))
+                 for i in range(40)}
+        assert picks == {"a", "b"}
+        config_mod.reset_config_for_tests()
+
+    def test_top_k_spreads_across_best_fraction(self):
+        """With many distinct utilizations, the random pick covers the
+        top-k fraction (not only the single best node) — the reference's
+        noisy-neighbor avoidance (hybrid_scheduling_policy.h:29-48)."""
+        # 10 nodes above the spread threshold with distinct utils
+        nodes = {f"n{i}": _node(100, 30 - i) for i in range(10)}
+        picks = {HybridPolicy().pick(nodes, _req(), rng=random.Random(i))
+                 for i in range(60)}
+        # k = ceil(0.2 * 10) = 2 -> exactly the two least-utilized nodes
+        assert picks == {"n0", "n1"}
+
+    def test_preferred_wins_outright_tie(self):
+        nodes = {"a": _node(4, 4), "b": _node(4, 4), "c": _node(4, 4)}
+        for i in range(10):
+            assert HybridPolicy().pick(nodes, _req(), preferred="b",
+                                       rng=random.Random(i)) == "b"
+
+
+class TestNodeLabelPolicy:
+    NODES = {
+        "v5p-0": _node(labels={"accelerator-type": "TPU-V5P",
+                               "tpu-slice-name": "slice-0"}),
+        "v5e-0": _node(labels={"accelerator-type": "TPU-V5E",
+                               "tpu-slice-name": "slice-1"}),
+        "cpu-0": _node(labels={}),
+    }
+
+    def test_hard_equals(self):
+        p = NodeLabelPolicy({"accelerator-type": "TPU-V5P"}, {})
+        assert p.pick(self.NODES, _req()) == "v5p-0"
+
+    def test_hard_in_list(self):
+        p = NodeLabelPolicy(
+            {"accelerator-type": ["TPU-V5P", "TPU-V5E"]}, {})
+        picks = {p.pick(self.NODES, _req(), rng=random.Random(i))
+                 for i in range(20)}
+        assert picks <= {"v5p-0", "v5e-0"} and picks
+
+    def test_hard_exists_and_absent(self):
+        assert NodeLabelPolicy({"accelerator-type": "!*"}, {}).pick(
+            self.NODES, _req()) == "cpu-0"
+        picks = {NodeLabelPolicy({"accelerator-type": "*"}, {}).pick(
+            self.NODES, _req(), rng=random.Random(i)) for i in range(20)}
+        assert picks <= {"v5p-0", "v5e-0"}
+
+    def test_hard_not_equal(self):
+        p = NodeLabelPolicy({"tpu-slice-name": "!slice-0",
+                             "accelerator-type": "*"}, {})
+        assert p.pick(self.NODES, _req()) == "v5e-0"
+
+    def test_hard_unmatched_returns_none(self):
+        p = NodeLabelPolicy({"accelerator-type": "TPU-V9"}, {})
+        assert p.pick(self.NODES, _req()) is None
+
+    def test_soft_prefers_but_falls_back(self):
+        soft = NodeLabelPolicy({}, {"accelerator-type": "TPU-V5P"})
+        assert soft.pick(self.NODES, _req()) == "v5p-0"
+        # soft constraint nobody satisfies: still schedules somewhere
+        nobody = NodeLabelPolicy({}, {"accelerator-type": "TPU-V9"})
+        assert nobody.pick(self.NODES, _req()) is not None
+
+    def test_soft_full_node_does_not_shadow_idle_hard_node(self):
+        """A soft-matching node with no free capacity must lose to an idle
+        hard-tier node — a queue target is not a preference."""
+        nodes = {
+            "soft-full": _node(4, 0, labels={"gen": "v5p"}),
+            "hard-idle": _node(4, 4, labels={"gen": "v5e"}),
+        }
+        p = NodeLabelPolicy({"gen": "*"}, {"gen": "v5p"})
+        for i in range(10):
+            assert p.pick(nodes, _req(), rng=random.Random(i)) == "hard-idle"
+
+    def test_pick_node_dispatch(self):
+        s = NodeLabelStrategy(hard={"tpu-slice-name": "slice-1"})
+        assert pick_node(s, self.NODES, _req()) == "v5e-0"
+
+
+class TestStrategyAllowsLocal:
+    def test_default_and_spread_allow(self):
+        assert strategy_allows_local(None, "n1", {})
+
+    def test_hard_affinity_binds(self):
+        s = NodeAffinityStrategy(node_id_hex="n2", soft=False)
+        assert not strategy_allows_local(s, "n1", {})
+        assert strategy_allows_local(s, "n2", {})
+
+    def test_soft_affinity_allows(self):
+        s = NodeAffinityStrategy(node_id_hex="n2", soft=True)
+        assert strategy_allows_local(s, "n1", {})
+
+    def test_label_strategy_checks_local_labels(self):
+        s = NodeLabelStrategy(hard={"tpu-slice-name": "slice-0"})
+        assert strategy_allows_local(s, "n1", {"tpu-slice-name": "slice-0"})
+        assert not strategy_allows_local(s, "n1", {})
+
+
+def test_label_selector_option_schedules_on_labeled_node():
+    """End to end: tasks with label_selector= land on the matching node of
+    a two-node cluster (reference: NodeLabelSchedulingPolicy)."""
+    import ray_tpu
+    from ray_tpu.cluster.cluster_utils import Cluster
+
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    cluster = Cluster(head_node_args={"num_cpus": 2})
+    cluster.add_node(num_cpus=2, labels={"disk": "ssd"})
+    cluster.connect_driver()
+    try:
+        @ray_tpu.remote(label_selector={"disk": "ssd"}, num_cpus=1)
+        def where():
+            return ray_tpu.get_runtime_context().get_node_id()
+
+        ssd_node = [n["node_id"] for n in ray_tpu.nodes()
+                    if n.get("labels", {}).get("disk") == "ssd"]
+        assert len(ssd_node) == 1
+        got = {ray_tpu.get(where.remote(), timeout=60) for _ in range(3)}
+        assert got == set(ssd_node)
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
