@@ -1258,6 +1258,38 @@ class Raylet:
             "available": self.node.available.to_dict(),
         }
 
+    async def rpc_dump_stacks(self, p):
+        """Node-wide live stack capture (the py-spy-equivalent endpoint,
+        reference ``dashboard/modules/reporter/profile_manager.py:11``):
+        this raylet's threads + every live worker's, via each worker's
+        ``dump_stacks`` RPC. A worker that can't respond in time (GIL held
+        by native code) is reported unreachable rather than hanging the
+        whole capture."""
+        from ray_tpu.util.profiling import format_current_stacks
+
+        out = [{"pid": os.getpid(), "role": "raylet",
+                "stacks": format_current_stacks()}]
+
+        async def one(entry):
+            info = {"pid": entry.proc.pid, "role": "actor"
+                    if entry.is_actor_worker else "worker",
+                    "worker_id": entry.worker_id, "busy": entry.busy}
+            try:
+                if entry.client is None:
+                    raise RuntimeError("not yet registered")
+                reply = await asyncio.wait_for(
+                    entry.client.call("dump_stacks", {}),
+                    timeout=p.get("timeout", 3.0))
+                info["stacks"] = reply["stacks"]
+            except Exception as e:  # noqa: BLE001 — report, don't fail
+                info["unreachable"] = f"{type(e).__name__}: {e}"
+            return info
+
+        live = [e for e in self._workers.values()
+                if e.proc.poll() is None]
+        out.extend(await asyncio.gather(*(one(e) for e in live)))
+        return {"node_id": self.node_id, "processes": out}
+
 
 def entry_spec_resources(entry) -> Dict[str, float]:
     return getattr(entry, "_spec_resources", {})

@@ -59,6 +59,7 @@ class WorkerProcess:
         srv.register("create_actor", self.rpc_create_actor)
         srv.register("actor_call", self.rpc_actor_call)
         srv.register("exit", self.rpc_exit)
+        srv.register("dump_stacks", self.rpc_dump_stacks)
         global_worker().connect(self.backend, self.backend.job_id, "worker")
         self.backend.io.run(self.backend._raylet.call("worker_ready", {
             "worker_id": self.worker_id,
@@ -92,6 +93,14 @@ class WorkerProcess:
     async def rpc_exit(self, p):
         asyncio.get_running_loop().call_later(0.1, os._exit, 0)
         return {"ok": True}
+
+    async def rpc_dump_stacks(self, p):
+        """Live stack snapshot of every thread (the py-spy-equivalent
+        surface; see ``util/profiling.py``). Runs on the event loop — it
+        responds even while user tasks block executor threads."""
+        from ray_tpu.util.profiling import format_current_stacks
+
+        return {"pid": os.getpid(), "stacks": format_current_stacks()}
 
     # ---- argument / return marshalling -------------------------------------
     def _resolve_args(self, wire_args: List[Tuple], wire_kwargs: Dict[str, Tuple]):

@@ -49,6 +49,7 @@ class DashboardActor:
         app.router.add_get("/api/cluster_resources", self._cluster_resources)
         app.router.add_get("/api/jobs", self._jobs)
         app.router.add_get("/api/serve/applications", self._serve_apps)
+        app.router.add_get("/api/stacks", self._stacks)
         app.router.add_get("/metrics", self._metrics)
         self._runner = web.AppRunner(app, access_log=None)
         await self._runner.setup()
@@ -121,6 +122,45 @@ class DashboardActor:
                 return serve.status()
             except RuntimeError:  # serve not running
                 return {}
+
+        loop = asyncio.get_running_loop()
+        out = await loop.run_in_executor(None, fetch)
+        return web.json_response(out, dumps=_dumps)
+
+    async def _stacks(self, request):
+        """Cluster-wide live Python stacks (py-spy-equivalent, reference:
+        ``dashboard/modules/reporter/profile_manager.py``): every node's
+        raylet asks its workers to snapshot ``sys._current_frames()``.
+        ``?node_id=`` limits to one node."""
+        from aiohttp import web
+
+        want = request.query.get("node_id")
+        timeout = float(request.query.get("timeout", 3.0))
+
+        def fetch():
+            backend = self._backend()
+
+            async def run():
+                nodes = await backend._gcs.call("list_nodes", {})
+                out = []
+                for n in nodes:
+                    if want and n["node_id"] != want:
+                        continue
+                    if not n.get("alive", True):
+                        continue
+                    try:
+                        client = await backend._pool.get(n["address"])
+                        reply = await asyncio.wait_for(
+                            client.call("dump_stacks", {"timeout": timeout}),
+                            timeout=timeout + 2.0)
+                        out.append(reply)
+                    except Exception as e:  # noqa: BLE001 — partial is fine
+                        out.append({"node_id": n["node_id"],
+                                    "unreachable":
+                                        f"{type(e).__name__}: {e}"})
+                return out
+
+            return backend.io.run(run())
 
         loop = asyncio.get_running_loop()
         out = await loop.run_in_executor(None, fetch)

@@ -9,8 +9,17 @@ Tune Trainables.
 
 from ray_tpu.rl.algorithm import Algorithm  # noqa: F401
 from ray_tpu.rl.algorithms import (  # noqa: F401
+    A2C,
+    A2CConfig,
     APPO,
     APPOConfig,
+    BanditConfig,
+    BanditLinTS,
+    BanditLinUCB,
+    CQL,
+    CQLConfig,
+    ES,
+    ESConfig,
     BC,
     BCConfig,
     DDPG,
@@ -38,6 +47,17 @@ from ray_tpu.rl.connectors import (  # noqa: F401
     build_connectors,
 )
 from ray_tpu.rl import ope  # noqa: F401
+from ray_tpu.rl import pixel_env  # noqa: F401 — registers CatchPixels-v0
+from ray_tpu.rl.pixel_env import (  # noqa: F401
+    CatchPixels,
+    FrameStack,
+    PixelWrapper,
+    gym_vector_env,
+)
+from ray_tpu.rl.policy_server import (  # noqa: F401
+    ExternalEnvRunner,
+    PolicyClient,
+)
 from ray_tpu.rl.multi_agent import (  # noqa: F401
     CoordinationGame,
     MultiAgentEnv,

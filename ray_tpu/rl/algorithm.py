@@ -16,7 +16,7 @@ import numpy as np
 
 import ray_tpu
 from ray_tpu.rl.config import AlgorithmConfig
-from ray_tpu.rl.env import make_env
+from ray_tpu.rl.env import EnvSpec, make_env
 from ray_tpu.rl.env_runner import EnvRunner
 from ray_tpu.tune.trainable import Trainable
 
@@ -37,24 +37,58 @@ class Algorithm(Trainable):
         else:
             self.config = self.get_default_config().update_from_dict(config)
         cfg = self.config
-        # probe the env spec without an actor round-trip
-        self.spec = make_env(cfg.env, 1, cfg.env_config).spec
-        n_runners = max(1, cfg.num_env_runners) if self.need_env_runners else 0
-        self.runners = [
-            EnvRunner.options(num_cpus=cfg.num_cpus_per_runner).remote(
-                cfg.env, cfg.num_envs_per_runner,
-                cfg.rollout_fragment_length, cfg.gamma, cfg.lambda_,
-                seed=cfg.seed + 1000 * i, env_config=cfg.env_config,
-                explore=self.explore_mode, connectors=cfg.connectors)
-            for i in range(n_runners)
-        ]
+        if cfg.env.startswith("external://"):
+            # external-env serving (rl/policy_server.py): the runner is an
+            # HTTP policy server; the spec must be declared up front since
+            # no env exists to probe (reference: policy_server_input needs
+            # the space config too)
+            from ray_tpu.rl.policy_server import ExternalEnvRunner
+
+            spec_kwargs = cfg.env_config.get("spec")
+            if not spec_kwargs:
+                raise ValueError(
+                    'external envs need env_config={"spec": {...EnvSpec '
+                    'fields...}}')
+            if cfg.connectors:
+                raise ValueError(
+                    "connectors are not applied by external-env runners "
+                    "(the external simulator owns preprocessing); drop "
+                    "config.connectors or filter client-side")
+            self.spec = EnvSpec(**spec_kwargs)
+            port = int(cfg.env.split("://", 1)[1] or 0)
+            n_runners = max(1, cfg.num_env_runners) \
+                if self.need_env_runners else 0
+            self.runners = [
+                ExternalEnvRunner.options(
+                    num_cpus=cfg.num_cpus_per_runner).remote(
+                    port + i if port else 0, dict(spec_kwargs),
+                    cfg.rollout_fragment_length, cfg.num_envs_per_runner,
+                    cfg.gamma, cfg.lambda_, seed=cfg.seed + 1000 * i)
+                for i in range(n_runners)
+            ]
+            # bind now so callers can fetch ports before training starts
+            self.server_ports = ray_tpu.get(
+                [r.ready.remote() for r in self.runners])
+        else:
+            # probe the env spec without an actor round-trip
+            self.spec = make_env(cfg.env, 1, cfg.env_config).spec
+            n_runners = max(1, cfg.num_env_runners) \
+                if self.need_env_runners else 0
+            self.runners = [
+                EnvRunner.options(num_cpus=cfg.num_cpus_per_runner).remote(
+                    cfg.env, cfg.num_envs_per_runner,
+                    cfg.rollout_fragment_length, cfg.gamma, cfg.lambda_,
+                    seed=cfg.seed + 1000 * i, env_config=cfg.env_config,
+                    explore=self.explore_mode, connectors=cfg.connectors)
+                for i in range(n_runners)
+            ]
         # driver-side pipeline skeleton: holds/merges the global connector
         # state the runner fleet syncs through (reference: filter deltas
         # flushed to the driver and re-broadcast each iteration)
         from ray_tpu.rl.connectors import build_connectors
 
         self._conn_pipeline = (build_connectors(cfg.connectors,
-                                                self.spec.obs_dim)
+                                                self.spec.obs_dims[-1])
                                if n_runners else None)
         self._connector_state = None
         self._env_steps_total = 0

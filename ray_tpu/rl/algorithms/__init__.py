@@ -1,4 +1,12 @@
+from ray_tpu.rl.algorithms.a2c import A2C, A2CConfig  # noqa: F401
 from ray_tpu.rl.algorithms.appo import APPO, APPOConfig  # noqa: F401
+from ray_tpu.rl.algorithms.bandits import (  # noqa: F401
+    BanditConfig,
+    BanditLinTS,
+    BanditLinUCB,
+    LinearBanditEnv,
+)
+from ray_tpu.rl.algorithms.cql import CQL, CQLConfig  # noqa: F401
 from ray_tpu.rl.algorithms.ddpg import (  # noqa: F401
     DDPG,
     DDPGConfig,
@@ -6,6 +14,7 @@ from ray_tpu.rl.algorithms.ddpg import (  # noqa: F401
     TD3Config,
 )
 from ray_tpu.rl.algorithms.dqn import DQN, DQNConfig  # noqa: F401
+from ray_tpu.rl.algorithms.es import ES, ESConfig  # noqa: F401
 from ray_tpu.rl.algorithms.impala import IMPALA, IMPALAConfig  # noqa: F401
 from ray_tpu.rl.algorithms.offline import (  # noqa: F401
     BC,

@@ -1,0 +1,79 @@
+"""A2C: synchronous advantage actor-critic.
+
+Reference analog: ``rllib/algorithms/a2c/a2c.py`` (A2C as sync A3C,
+sharing PPO's sampling but with the plain policy-gradient loss, one pass
+over each batch). The loss is the unclipped surrogate on GAE advantages +
+value regression + entropy bonus, jitted like every learner update.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.rl import models
+from ray_tpu.rl.algorithm import Algorithm
+from ray_tpu.rl.config import AlgorithmConfig
+from ray_tpu.rl.env import EnvSpec
+from ray_tpu.rl.learner import Learner, LearnerGroup
+
+
+class A2CConfig(AlgorithmConfig):
+    def __init__(self, **kwargs):
+        super().__init__(algo_class=A2C, **kwargs)
+        self.num_epochs = 1  # on-policy single pass — the A2C distinction
+
+
+def make_a2c_loss(spec: EnvSpec, vf_coeff: float, entropy_coeff: float):
+    def loss_fn(params, batch, key):
+        obs = batch["obs"]
+        logits = models.policy_logits(params, obs)
+        if spec.discrete:
+            logp = models.categorical_logp(logits, batch["actions"])
+            entropy = models.categorical_entropy(logits).mean()
+        else:
+            logp = models.gaussian_logp(logits, params["log_std"],
+                                        batch["actions"])
+            entropy = models.gaussian_entropy(params["log_std"])
+        adv = batch["advantages"]
+        adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+        policy_loss = -(logp * adv).mean()
+        values = models.value(params, obs)
+        vf_loss = jnp.mean((values - batch["value_targets"]) ** 2)
+        total = policy_loss + vf_coeff * vf_loss - entropy_coeff * entropy
+        return total, {"policy_loss": policy_loss, "vf_loss": vf_loss,
+                       "entropy": entropy}
+
+    return loss_fn
+
+
+class A2C(Algorithm):
+    @classmethod
+    def get_default_config(cls) -> AlgorithmConfig:
+        return A2CConfig()
+
+    def build_learner(self) -> None:
+        cfg, spec = self.config, self.spec
+        loss_fn = make_a2c_loss(spec, cfg.vf_coeff, cfg.entropy_coeff)
+        seed, hidden, lr, clip = cfg.seed, cfg.hidden, cfg.lr, cfg.grad_clip
+
+        def ctor() -> Learner:
+            params = models.init_policy(jax.random.key(seed), spec, hidden)
+            return Learner(params, loss_fn, lr, grad_clip=clip, seed=seed)
+
+        if cfg.num_learners > 0:
+            self.learner = LearnerGroup(ctor, cfg.num_learners,
+                                        cfg.num_tpus_per_learner)
+        else:
+            self.learner = ctor()
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.config
+        params = self.learner.get_params()
+        batch = self.synchronous_sample(params)
+        metrics = self.learner.update(
+            batch, num_epochs=1, minibatch_size=cfg.minibatch_size or 0)
+        metrics.update(self.collect_episode_stats())
+        return metrics

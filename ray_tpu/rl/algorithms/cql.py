@@ -1,0 +1,181 @@
+"""CQL: conservative Q-learning for offline RL.
+
+Reference analog: ``rllib/algorithms/cql/cql.py`` (CQL(H) on top of SAC —
+the soft actor-critic update plus a conservative penalty that pushes Q
+down on out-of-distribution actions and up on dataset actions, Kumar et
+al. 2020). Same shape here: the SAC loss terms plus
+
+    alpha_cql * ( logsumexp_a Q(s, a~) - Q(s, a_data) )
+
+with a~ drawn from uniform-random and current-policy actions
+(importance-corrected), all inside one jitted update over offline
+minibatches — no env interaction.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.rl import models
+from ray_tpu.rl.algorithm import Algorithm
+from ray_tpu.rl.algorithms.offline import _to_arrays
+from ray_tpu.rl.algorithms.sac import _squashed_sample_logp
+from ray_tpu.rl.config import AlgorithmConfig
+from ray_tpu.rl.learner import Learner
+
+
+class CQLConfig(AlgorithmConfig):
+    def __init__(self, **kwargs):
+        super().__init__(algo_class=CQL, **kwargs)
+        self.env = "Pendulum-v1"
+        self.minibatch_size = 256
+        self.cql_alpha = 5.0
+        self.cql_num_actions = 8   # sampled actions for the logsumexp
+        self.updates_per_iter = 50
+
+
+class CQL(Algorithm):
+    need_env_runners = False  # offline: the dataset IS the experience
+
+    @classmethod
+    def get_default_config(cls) -> AlgorithmConfig:
+        return CQLConfig()
+
+    def build_learner(self) -> None:
+        cfg, spec = self.config, self.spec
+        if cfg.offline_data is None:
+            raise ValueError("CQL needs config.offline_data")
+        self._data = _to_arrays(cfg.offline_data)
+        for col in ("obs", "actions", "rewards", "next_obs", "dones"):
+            if col not in self._data:
+                raise ValueError(f"offline_data missing {col!r}")
+        self._n = len(self._data["rewards"])
+        self._rng = np.random.default_rng(cfg.seed)
+
+        gamma, tau = cfg.gamma, cfg.tau
+        low, high = spec.action_low, spec.action_high
+        adim = spec.action_dim
+        n_samp = cfg.cql_num_actions
+        cql_alpha = cfg.cql_alpha
+
+        key = jax.random.key(cfg.seed)
+        k_pi, k_q1, k_q2 = jax.random.split(key, 3)
+        qin = spec.obs_dim + adim
+        q1 = models.init_mlp(k_q1, [qin, *cfg.hidden, 1], out_scale=1.0)
+        q2 = models.init_mlp(k_q2, [qin, *cfg.hidden, 1], out_scale=1.0)
+        pi = models.init_mlp(
+            k_pi, [spec.obs_dim, *cfg.hidden, 2 * adim], out_scale=0.01)
+        params = {
+            "pi": pi, "q1": q1, "q2": q2,
+            "q1_target": jax.tree_util.tree_map(jnp.copy, q1),
+            "q2_target": jax.tree_util.tree_map(jnp.copy, q2),
+            "log_alpha": jnp.asarray(float(np.log(cfg.initial_alpha))),
+        }
+
+        def pi_dist(pi_params, obs):
+            out = models.mlp_forward(pi_params, obs)
+            return jnp.split(out, 2, axis=-1)
+
+        def q_val(q_params, obs, act):
+            return models.mlp_forward(
+                q_params, jnp.concatenate([obs, act], axis=-1))[..., 0]
+
+        def _q_on_sampled(q_params, obs, acts):
+            """Q over [S, B, A] sampled actions -> [S, B]."""
+            rep = jnp.broadcast_to(obs, (acts.shape[0],) + obs.shape)
+            return q_val(q_params, rep, acts)
+
+        def loss_fn(params, batch, key):
+            k1, k2, k3, k4 = jax.random.split(key, 4)
+            obs, nobs = batch["obs"], batch["next_obs"]
+            acts = batch["actions"]
+            B = obs.shape[0]
+            alpha = jnp.exp(params["log_alpha"])
+            # --- SAC critic target (soft bellman backup) ---
+            nmean, nlogstd = pi_dist(params["pi"], nobs)
+            nact, nlogp = _squashed_sample_logp(nmean, nlogstd, k1,
+                                                low, high)
+            qt = jnp.minimum(q_val(params["q1_target"], nobs, nact),
+                             q_val(params["q2_target"], nobs, nact))
+            nonterminal = 1.0 - batch["dones"].astype(jnp.float32)
+            target = jax.lax.stop_gradient(
+                batch["rewards"] + gamma * nonterminal
+                * (qt - alpha * nlogp))
+            q1_pred = q_val(params["q1"], obs, acts)
+            q2_pred = q_val(params["q2"], obs, acts)
+            bellman = jnp.mean((q1_pred - target) ** 2) + \
+                jnp.mean((q2_pred - target) ** 2)
+            # --- conservative penalty (CQL(H)) ---
+            rand = jax.random.uniform(k2, (n_samp, B, adim),
+                                      minval=low, maxval=high)
+            mean, log_std = pi_dist(params["pi"], obs)
+            pol, pol_logp = _squashed_sample_logp(
+                jnp.broadcast_to(mean, (n_samp,) + mean.shape),
+                jnp.broadcast_to(log_std, (n_samp,) + log_std.shape),
+                k3, low, high)
+            span = high - low
+            rand_logp = -adim * jnp.log(span)  # uniform density
+            cql_cat = []
+            for qp in ("q1", "q2"):
+                q_rand = _q_on_sampled(params[qp], obs, rand) - rand_logp
+                q_pol = _q_on_sampled(params[qp], obs, pol) \
+                    - jax.lax.stop_gradient(pol_logp)
+                cat = jnp.concatenate([q_rand, q_pol], axis=0)  # [2S, B]
+                lse = jax.nn.logsumexp(cat, axis=0) - jnp.log(2 * n_samp)
+                pred = q1_pred if qp == "q1" else q2_pred
+                cql_cat.append(jnp.mean(lse - pred))
+            cql_penalty = cql_cat[0] + cql_cat[1]
+            # --- actor (SAC) ---
+            act_new, logp = _squashed_sample_logp(mean, log_std, k4,
+                                                  low, high)
+            q_min = jnp.minimum(
+                q_val(jax.lax.stop_gradient(params["q1"]), obs, act_new),
+                q_val(jax.lax.stop_gradient(params["q2"]), obs, act_new))
+            pi_loss = jnp.mean(jax.lax.stop_gradient(alpha) * logp - q_min)
+            alpha_loss = -jnp.mean(
+                params["log_alpha"]
+                * jax.lax.stop_gradient(logp - adim))
+            total = bellman + cql_alpha * cql_penalty + pi_loss + alpha_loss
+            return total, {"bellman_loss": bellman,
+                           "cql_penalty": cql_penalty,
+                           "pi_loss": pi_loss,
+                           "alpha": alpha}
+
+        self.learner = Learner(params, loss_fn, cfg.lr,
+                               grad_clip=cfg.grad_clip, seed=cfg.seed)
+
+        @jax.jit
+        def polyak(params):
+            new = dict(params)
+            for src, dst in (("q1", "q1_target"), ("q2", "q2_target")):
+                new[dst] = jax.tree_util.tree_map(
+                    lambda t, s: (1 - tau) * t + tau * s,
+                    params[dst], params[src])
+            return new
+
+        self._polyak = polyak
+        self._q_val = jax.jit(
+            lambda p, o, a: q_val(p["q1"], o, a))
+
+    def q_value(self, obs: np.ndarray, actions: np.ndarray) -> np.ndarray:
+        """Q1 estimates — the OOD-vs-dataset probe used by tests."""
+        return np.asarray(self._q_val(self.learner.get_params(),
+                                      jnp.asarray(obs), jnp.asarray(actions)))
+
+    def _minibatch(self, size: int) -> Dict[str, np.ndarray]:
+        idx = self._rng.integers(0, self._n, size=min(size, self._n))
+        return {k: v[idx] for k, v in self._data.items()}
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.config
+        m: Dict[str, Any] = {}
+        for _ in range(cfg.updates_per_iter or 50):
+            m = self.learner.update_minibatch(
+                self._minibatch(cfg.minibatch_size))
+            self.learner.params = self._polyak(self.learner.params)
+        self._env_steps_total += 0  # offline: no env interaction
+        return {k: float(v) for k, v in m.items()}

@@ -1,0 +1,187 @@
+"""ES: OpenAI-style evolution strategies.
+
+Reference analog: ``rllib/algorithms/es/es.py`` (Salimans et al. 2017 —
+a fleet of workers evaluates antithetic parameter perturbations for whole
+episodes; the driver combines centered-rank-weighted noise into a gradient
+estimate). Redesigned: noise is reconstructed from integer seeds on both
+sides (the reference's SharedNoiseTable trick — only seeds and returns
+cross the wire, never parameter vectors), and each worker evaluates its
+perturbation over a small vectorized env batch.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Tuple
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rl import models
+from ray_tpu.rl.algorithm import Algorithm
+from ray_tpu.rl.config import AlgorithmConfig
+
+
+class ESConfig(AlgorithmConfig):
+    def __init__(self, **kwargs):
+        super().__init__(algo_class=ES, **kwargs)
+        self.episodes_per_perturbation = 2
+        self.noise_std = 0.05
+        self.num_perturbations = 16   # antithetic pairs per iteration
+        self.lr = 0.02
+        self.max_episode_len = 500
+
+
+def _flatten(params) -> Tuple[np.ndarray, List]:
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    shapes = [(np.asarray(leaf).shape, np.asarray(leaf).dtype)
+              for leaf in leaves]
+    flat = np.concatenate([np.asarray(leaf).ravel() for leaf in leaves])
+    return flat.astype(np.float64), (treedef, shapes)
+
+
+def _unflatten(flat: np.ndarray, meta) -> Any:
+    import jax
+
+    treedef, shapes = meta
+    leaves, off = [], 0
+    for shape, dtype in shapes:
+        n = int(np.prod(shape)) if shape else 1
+        leaves.append(flat[off:off + n].reshape(shape).astype(dtype))
+        off += n
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def _noise(seed: int, dim: int, std: float) -> np.ndarray:
+    return np.random.default_rng(seed).standard_normal(dim) * std
+
+
+def _centered_ranks(x: np.ndarray) -> np.ndarray:
+    """Fitness shaping: returns -> centered ranks in [-0.5, 0.5]
+    (the reference's compute_centered_ranks)."""
+    ranks = np.empty(len(x), dtype=np.float64)
+    ranks[x.argsort()] = np.arange(len(x))
+    return ranks / (len(x) - 1) - 0.5 if len(x) > 1 else np.zeros(1)
+
+
+@ray_tpu.remote
+class _ESWorker:
+    """Evaluates perturbed policies for whole episodes."""
+
+    def __init__(self, env_name: str, env_config: Dict, seed: int,
+                 hidden, noise_std: float, max_len: int):
+        import jax
+
+        from ray_tpu.rl.env import make_env
+
+        self._env = make_env(env_name, 1, env_config, seed=seed)
+        self.spec = self._env.spec
+        self._std = noise_std
+        self._max_len = max_len
+        base = models.init_policy(jax.random.key(0), self.spec, hidden)
+        _, self._meta = _flatten(base)
+
+        import jax.numpy as jnp
+
+        spec = self.spec
+
+        @jax.jit
+        def act(params, obs):
+            logits = models.policy_logits(params, obs)
+            if spec.discrete:
+                return jnp.argmax(logits, axis=-1)
+            return logits  # deterministic mean action
+
+        self._act = act
+
+    def episode_return(self, flat: np.ndarray) -> Tuple[float, int]:
+        params = _unflatten(np.asarray(flat), self._meta)
+        obs = self._env.reset()
+        total, steps = 0.0, 0
+        for _ in range(self._max_len):
+            a = np.asarray(self._act(params, obs))
+            if not self.spec.discrete:
+                a = np.clip(a, self.spec.action_low, self.spec.action_high)
+            obs, r, d = self._env.step(a)
+            total += float(r[0])
+            steps += 1
+            if d[0]:
+                break
+        return total, steps
+
+    def evaluate(self, flat_center: np.ndarray, noise_seed: int,
+                 episodes: int) -> Tuple[float, float, int]:
+        """Antithetic pair: (mean return at center+eps, at center-eps,
+        actual env steps consumed)."""
+        center = np.asarray(flat_center)
+        eps = _noise(noise_seed, len(center), self._std)
+        steps = 0
+        pos_r, neg_r = [], []
+        for _ in range(episodes):
+            r, n = self.episode_return(center + eps)
+            pos_r.append(r)
+            steps += n
+            r, n = self.episode_return(center - eps)
+            neg_r.append(r)
+            steps += n
+        return float(np.mean(pos_r)), float(np.mean(neg_r)), steps
+
+
+class ES(Algorithm):
+    need_env_runners = False  # whole-episode eval fleet instead
+
+    @classmethod
+    def get_default_config(cls) -> AlgorithmConfig:
+        return ESConfig()
+
+    def build_learner(self) -> None:
+        import jax
+
+        cfg = self.config
+        params = models.init_policy(jax.random.key(cfg.seed), self.spec,
+                                    cfg.hidden)
+        self._center, self._meta = _flatten(params)
+        n_workers = max(1, cfg.num_env_runners)
+        self._workers = [
+            _ESWorker.options(num_cpus=cfg.num_cpus_per_runner).remote(
+                cfg.env, cfg.env_config, cfg.seed + 7919 * i, cfg.hidden,
+                cfg.noise_std, cfg.max_episode_len)
+            for i in range(n_workers)
+        ]
+        self._rng = np.random.default_rng(cfg.seed)
+        self.learner = self  # Algorithm.save/restore reach params via us
+
+    def get_params(self):
+        return _unflatten(self._center, self._meta)
+
+    def set_params(self, params) -> None:
+        self._center, self._meta = _flatten(params)
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.config
+        seeds = [int(s) for s in
+                 self._rng.integers(0, 2 ** 31 - 1,
+                                    size=cfg.num_perturbations)]
+        # round-robin the pairs across the worker fleet
+        pending = [
+            self._workers[i % len(self._workers)].evaluate.remote(
+                self._center, seeds[i], cfg.episodes_per_perturbation)
+            for i in range(len(seeds))
+        ]
+        results = ray_tpu.get(pending)
+        pos = np.array([r[0] for r in results])
+        neg = np.array([r[1] for r in results])
+        ranks = _centered_ranks(np.concatenate([pos, neg]))
+        pos_r, neg_r = ranks[:len(pos)], ranks[len(pos):]
+        grad = np.zeros_like(self._center)
+        for seed, w in zip(seeds, pos_r - neg_r):
+            grad += w * _noise(seed, len(self._center), cfg.noise_std)
+        grad /= (len(seeds) * cfg.noise_std)
+        self._center = self._center + cfg.lr * grad
+        self._env_steps_total += int(sum(r[2] for r in results))
+        return {
+            "mean_return": float(np.mean(np.concatenate([pos, neg]))),
+            "best_return": float(np.max(np.concatenate([pos, neg]))),
+            "grad_norm": float(np.linalg.norm(grad)),
+        }

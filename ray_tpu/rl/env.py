@@ -36,15 +36,28 @@ def make_env(name: str, num_envs: int, config: Optional[Dict] = None,
 
 @dataclasses.dataclass
 class EnvSpec:
-    obs_dim: int
+    obs_dim: int = 0
     num_actions: int = 0        # discrete action count (0 => continuous)
     action_dim: int = 0         # continuous action dim
     action_low: float = -1.0
     action_high: float = 1.0
+    # Image observations (the Atari-class path): (H, W, C). When set,
+    # obs_dim is ignored and policies get a conv encoder (models.py).
+    obs_shape: Tuple[int, ...] = ()
 
     @property
     def discrete(self) -> bool:
         return self.num_actions > 0
+
+    @property
+    def obs_dims(self) -> Tuple[int, ...]:
+        """Per-observation shape: (obs_dim,) for flat envs, (H, W, C) for
+        pixel envs — the buffer/layout contract shared by runners."""
+        return tuple(self.obs_shape) if self.obs_shape else (self.obs_dim,)
+
+    @property
+    def is_pixel(self) -> bool:
+        return len(self.obs_shape) == 3
 
 
 class VectorEnv:

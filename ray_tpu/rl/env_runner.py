@@ -62,7 +62,10 @@ class EnvRunner:
         # Connector pipeline (obs normalization / reward clipping); the
         # FILTERED view is what the policy sees and what the batch stores,
         # so actor and learner share one normalized space.
-        self._connectors = build_connectors(connectors, self.spec.obs_dim)
+        # filters run per-last-axis: features for flat obs, channels for
+        # pixel obs
+        self._connectors = build_connectors(connectors,
+                                            self.spec.obs_dims[-1])
 
         spec = self.spec
 
@@ -119,7 +122,7 @@ class EnvRunner:
         import jax
 
         T, N = self._rollout_len, self._env.num_envs
-        obs_buf = np.zeros((T, N, self.spec.obs_dim), dtype=np.float32)
+        obs_buf = np.zeros((T, N, *self.spec.obs_dims), dtype=np.float32)
         act_shape = (T, N) if self.spec.discrete else (
             T, N, self.spec.action_dim)
         act_buf = np.zeros(
@@ -129,7 +132,8 @@ class EnvRunner:
         val_buf = np.zeros((T, N), dtype=np.float32)
         rew_buf = np.zeros((T, N), dtype=np.float32)
         done_buf = np.zeros((T, N), dtype=bool)
-        next_obs_buf = np.zeros((T, N, self.spec.obs_dim), dtype=np.float32)
+        next_obs_buf = np.zeros((T, N, *self.spec.obs_dims),
+                                dtype=np.float32)
 
         exec_buf = (act_buf if self.spec.discrete
                     else np.zeros_like(act_buf))

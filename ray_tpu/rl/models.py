@@ -48,24 +48,98 @@ def mlp_forward(params: Dict, x: jnp.ndarray) -> jnp.ndarray:
     return h
 
 
+# Conv encoder for pixel specs — the Nature CNN (Mnih et al. 2015, the
+# stack RLlib's default vision net uses for Atari) for full-size frames,
+# a compact stack for small boards (Nature's 8x4 front end collapses
+# anything under 36px to zero). Shared by the policy and value heads
+# (the standard actor-critic weight-sharing for pixels).
+_NATURE_SPECS = ((32, 8, 4), (64, 4, 2), (64, 3, 1))  # (feat, kernel, stride)
+_SMALL_SPECS = ((32, 3, 1), (64, 3, 2), (64, 3, 1))  # boards >= 9px
+
+
+def _conv_specs_for(h: int, w: int):
+    return _NATURE_SPECS if min(h, w) >= 36 else _SMALL_SPECS
+
+
+def _conv_out_hw(h: int, w: int, specs) -> Tuple[int, int]:
+    for _, k, s in specs:
+        h = (h - k) // s + 1
+        w = (w - k) // s + 1
+        if h < 1 or w < 1:
+            raise ValueError(f"obs too small for conv stack at {(h, w)}")
+    return h, w
+
+
+def init_cnn(key, obs_shape: Sequence[int], out_dim: int = 512) -> Dict:
+    h, w, c = obs_shape
+    specs = _conv_specs_for(h, w)
+    convs = []
+    keys = jax.random.split(key, len(specs) + 1)
+    in_ch = c
+    for i, (feat, k, stride) in enumerate(specs):
+        fan_in = k * k * in_ch
+        convs.append({
+            "w": jax.random.normal(keys[i], (k, k, in_ch, feat))
+            * jnp.sqrt(2.0 / fan_in),
+            "b": jnp.zeros(feat),
+            # stride rides as a SHAPE (static under jit; an int leaf would
+            # trace) — cnn_forward reads conv["s"].shape[0]
+            "s": jnp.zeros(stride),
+        })
+        in_ch = feat
+    oh, ow = _conv_out_hw(h, w, specs)
+    dense = _dense_init(keys[-1], oh * ow * in_ch, out_dim,
+                        scale=jnp.sqrt(2.0))
+    return {"convs": convs, "dense": dense}
+
+
+def cnn_forward(params: Dict, x: jnp.ndarray) -> jnp.ndarray:
+    """[..., H, W, C] pixels -> [..., F] features (relu conv stack)."""
+    lead = x.shape[:-3]
+    x = x.reshape((-1,) + x.shape[-3:])
+    for conv in params["convs"]:
+        stride = conv["s"].shape[0]
+        x = jax.lax.conv_general_dilated(
+            x, conv["w"], window_strides=(stride, stride), padding="VALID",
+            dimension_numbers=("NHWC", "HWIO", "NHWC")) + conv["b"]
+        x = jax.nn.relu(x)
+    x = x.reshape(x.shape[0], -1)
+    x = jax.nn.relu(x @ params["dense"]["w"] + params["dense"]["b"])
+    return x.reshape(lead + (x.shape[-1],))
+
+
 def init_policy(key, spec: EnvSpec, hidden: Sequence[int] = (64, 64)) -> Dict:
-    pk, vk, lk = jax.random.split(key, 3)
+    pk, vk, ek = jax.random.split(key, 3)
     out = spec.num_actions if spec.discrete else spec.action_dim
-    params = {
-        "pi": init_mlp(pk, [spec.obs_dim, *hidden, out]),
-        "vf": init_mlp(vk, [spec.obs_dim, *hidden, 1], out_scale=1.0),
-    }
+    if spec.is_pixel:
+        feat = 512
+        params = {
+            "enc": init_cnn(ek, spec.obs_shape, feat),
+            "pi": init_mlp(pk, [feat, out]),
+            "vf": init_mlp(vk, [feat, 1], out_scale=1.0),
+        }
+    else:
+        params = {
+            "pi": init_mlp(pk, [spec.obs_dim, *hidden, out]),
+            "vf": init_mlp(vk, [spec.obs_dim, *hidden, 1], out_scale=1.0),
+        }
     if not spec.discrete:
         params["log_std"] = jnp.zeros(spec.action_dim)
     return params
 
 
+def _encode(params: Dict, obs: jnp.ndarray) -> jnp.ndarray:
+    if "enc" in params:
+        return cnn_forward(params["enc"], obs)
+    return obs
+
+
 def policy_logits(params: Dict, obs: jnp.ndarray) -> jnp.ndarray:
-    return mlp_forward(params["pi"], obs)
+    return mlp_forward(params["pi"], _encode(params, obs))
 
 
 def value(params: Dict, obs: jnp.ndarray) -> jnp.ndarray:
-    return mlp_forward(params["vf"], obs)[..., 0]
+    return mlp_forward(params["vf"], _encode(params, obs))[..., 0]
 
 
 # ---- distributions ----

@@ -1,0 +1,275 @@
+"""External-env policy serving: train on envs that live OUTSIDE the
+cluster (simulators, games, real systems) and connect over HTTP.
+
+Reference analog: ``rllib/env/policy_server_input.py`` +
+``policy_client.py`` — rollout workers become HTTP servers; external
+simulators drive episodes with ``start_episode`` / ``get_action`` /
+``log_returns`` / ``end_episode`` and the experiences feed training.
+
+Redesign: :class:`ExternalEnvRunner` is an actor with the SAME sampling
+surface as :class:`ray_tpu.rl.env_runner.EnvRunner` (``sample(params)``
+returns a columnar batch with GAE), so on-policy algorithms swap it in by
+setting ``config.env = "external://<port>"`` — no special-cased training
+loop. Inference for connected clients runs the same jitted forward the
+in-cluster runners use.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rl import models
+from ray_tpu.rl.env import EnvSpec
+from ray_tpu.rl.env_runner import compute_gae
+
+
+@ray_tpu.remote
+class ExternalEnvRunner:
+    """Serves get_action over HTTP; buffers the resulting transitions.
+
+    ``sample(params)`` installs fresh policy params, then blocks until
+    ``rollout_len * num_slots`` external steps accumulate and returns the
+    standard columnar batch (advantages computed per completed episode
+    segment).
+    """
+
+    def __init__(self, port: int, spec: Dict[str, Any], rollout_len: int,
+                 num_slots: int = 1, gamma: float = 0.99,
+                 lam: float = 0.95, seed: int = 0):
+        import jax
+
+        self.spec = EnvSpec(**spec)
+        self._target_steps = rollout_len * num_slots
+        self._gamma, self._lam = gamma, lam
+        self._key = jax.random.key(seed)
+        self._params = None
+        self._episodes: Dict[str, Dict[str, List]] = {}
+        self._done_rows: List[Dict[str, np.ndarray]] = []
+        self._steps_buffered = 0
+        self._completed_returns: List[float] = []
+        self._port = port
+        self._bound_port: Optional[int] = None
+
+        spec_obj = self.spec
+
+        @jax.jit
+        def act(params, obs, key):
+            import jax.numpy as jnp
+
+            logits = models.policy_logits(params, obs)
+            vals = models.value(params, obs)
+            if spec_obj.discrete:
+                actions = models.categorical_sample(key, logits)
+                logp = models.categorical_logp(logits, actions)
+            else:
+                actions = models.gaussian_sample(key, logits,
+                                                 params["log_std"])
+                logp = models.gaussian_logp(logits, params["log_std"],
+                                            actions)
+            return actions, logp, vals
+
+        self._act = act
+
+    async def ready(self) -> int:
+        if self._bound_port is not None:
+            return self._bound_port
+        from aiohttp import web
+
+        app = web.Application()
+        app.router.add_post("/episodes/{eid}/start", self._h_start)
+        app.router.add_post("/episodes/{eid}/action", self._h_action)
+        app.router.add_post("/episodes/{eid}/rewards", self._h_rewards)
+        app.router.add_post("/episodes/{eid}/end", self._h_end)
+        runner = web.AppRunner(app, access_log=None)
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", self._port)
+        await site.start()
+        self._bound_port = site._server.sockets[0].getsockname()[1]
+        return self._bound_port
+
+    # ---- HTTP handlers ----------------------------------------------------
+    async def _h_start(self, request):
+        from aiohttp import web
+
+        eid = request.match_info["eid"]
+        self._episodes[eid] = {"obs": [], "actions": [], "logp": [],
+                               "values": [], "rewards": [], "return": 0.0}
+        return web.json_response({"ok": True})
+
+    async def _h_action(self, request):
+        import jax
+
+        from aiohttp import web
+
+        eid = request.match_info["eid"]
+        ep = self._episodes.get(eid)
+        if ep is None:
+            return web.json_response({"error": "unknown episode"},
+                                     status=404)
+        body = await request.json()
+        obs = np.asarray(body["obs"], dtype=np.float32)
+        if self._params is None:
+            return web.json_response({"error": "no policy yet"}, status=503)
+        self._key, sub = jax.random.split(self._key)
+        a, logp, val = self._act(self._params, obs[None], sub)
+        a = np.asarray(a)[0]
+        ep["obs"].append(obs)
+        ep["actions"].append(a)
+        ep["logp"].append(float(np.asarray(logp)[0]))
+        ep["values"].append(float(np.asarray(val)[0]))
+        action = a.tolist() if not self.spec.discrete else int(a)
+        return web.json_response({"action": action})
+
+    async def _h_rewards(self, request):
+        from aiohttp import web
+
+        eid = request.match_info["eid"]
+        ep = self._episodes.get(eid)
+        if ep is None:
+            return web.json_response({"error": "unknown episode"},
+                                     status=404)
+        body = await request.json()
+        r = float(body["reward"])
+        ep["rewards"].append(r)
+        ep["return"] += r
+        return web.json_response({"ok": True})
+
+    async def _h_end(self, request):
+        from aiohttp import web
+
+        eid = request.match_info["eid"]
+        ep = self._episodes.pop(eid, None)
+        if ep is None:
+            return web.json_response({"error": "unknown episode"},
+                                     status=404)
+        self._finish_episode(ep, terminal=True)
+        return web.json_response({"ok": True})
+
+    def _finish_episode(self, ep: Dict, terminal: bool) -> int:
+        """Consume the first T complete (action, reward) steps into a
+        training segment; returns T so a mid-episode cut can leave the
+        incomplete tail (an action whose reward hasn't arrived) in place
+        — discarding it would misalign every later reward by one step."""
+        T = min(len(ep["rewards"]), len(ep["actions"]))
+        if T == 0:
+            return 0
+        rewards = np.asarray(ep["rewards"][:T], np.float32).reshape(T, 1)
+        values = np.asarray(ep["values"][:T], np.float32).reshape(T, 1)
+        dones = np.zeros((T, 1), dtype=bool)
+        if terminal:
+            dones[-1] = True
+        # bootstrap a mid-episode cut from the NEXT state's value when the
+        # pending tail holds one, else from the last consumed state
+        if terminal:
+            last_v = np.zeros(1, np.float32)
+        elif len(ep["values"]) > T:
+            last_v = np.asarray([ep["values"][T]], np.float32)
+        else:
+            last_v = values[-1]
+        gae = compute_gae(rewards, values, dones, last_v,
+                          self._gamma, self._lam)
+        obs = np.asarray(ep["obs"][:T], np.float32)
+        acts = np.asarray(ep["actions"][:T])
+        next_obs = np.concatenate([obs[1:], obs[-1:]], axis=0)
+        self._done_rows.append({
+            "obs": obs, "actions": acts,
+            "actions_executed": acts,
+            "logp": np.asarray(ep["logp"][:T], np.float32),
+            "values": values[:, 0], "rewards": rewards[:, 0],
+            "dones": dones[:, 0], "next_obs": next_obs,
+            "advantages": gae["advantages"][:, 0],
+            "value_targets": gae["value_targets"][:, 0],
+        })
+        self._steps_buffered += T
+        if terminal:
+            self._completed_returns.append(ep["return"])
+        return T
+
+    # ---- EnvRunner protocol ----------------------------------------------
+    def get_spec(self):
+        return self.spec
+
+    async def sample(self, params) -> Dict[str, np.ndarray]:
+        import asyncio
+
+        self._params = params
+        # in-flight steps of OPEN episodes count toward the fragment — an
+        # episode longer than the target (a trained CartPole balancing
+        # forever, any continuing task) must still cut, like the
+        # reference's rollout_fragment_length cut mid-episode
+        def total_steps() -> int:
+            open_steps = sum(
+                min(len(ep["rewards"]), len(ep["actions"]))
+                for ep in self._episodes.values())
+            return self._steps_buffered + open_steps
+
+        while total_steps() < self._target_steps:
+            await asyncio.sleep(0.02)
+        # cut still-open episodes at their last COMPLETE step; the
+        # incomplete tail (action awaiting its reward) stays in place
+        for ep in list(self._episodes.values()):
+            t = self._finish_episode(ep, terminal=False)
+            if t:
+                for k in ("obs", "actions", "logp", "values", "rewards"):
+                    ep[k] = ep[k][t:]
+        rows, self._done_rows = self._done_rows, []
+        self._steps_buffered = 0
+        return {k: np.concatenate([r[k] for r in rows])
+                for k in rows[0]}
+
+    def pop_connector_deltas(self):
+        return None
+
+    def set_connector_globals(self, states) -> None:
+        pass
+
+    def episode_stats(self) -> Dict[str, float]:
+        completed, self._completed_returns = self._completed_returns, []
+        if not completed:
+            return {"episodes": 0, "mean_return": float("nan")}
+        return {"episodes": len(completed),
+                "mean_return": float(np.mean(completed))}
+
+
+class PolicyClient:
+    """The external simulator's side (reference: ``policy_client.py``)."""
+
+    def __init__(self, address: str):
+        self._base = address.rstrip("/")
+        self._n = 0
+
+    def _post(self, path: str, payload: Optional[Dict] = None,
+              retries: int = 50) -> Dict:
+        import requests
+
+        for attempt in range(retries):
+            r = requests.post(f"{self._base}{path}", json=payload or {},
+                              timeout=30)
+            if r.status_code == 503:  # policy not installed yet
+                time.sleep(0.2)
+                continue
+            r.raise_for_status()
+            return r.json()
+        raise TimeoutError(f"policy server never became ready: {path}")
+
+    def start_episode(self, episode_id: Optional[str] = None) -> str:
+        eid = episode_id or f"ep{self._n}"
+        self._n += 1
+        self._post(f"/episodes/{eid}/start")
+        return eid
+
+    def get_action(self, episode_id: str, obs) -> Any:
+        reply = self._post(f"/episodes/{episode_id}/action",
+                           {"obs": np.asarray(obs).tolist()})
+        return reply["action"]
+
+    def log_returns(self, episode_id: str, reward: float) -> None:
+        self._post(f"/episodes/{episode_id}/rewards",
+                   {"reward": float(reward)})
+
+    def end_episode(self, episode_id: str, obs=None) -> None:
+        self._post(f"/episodes/{episode_id}/end")

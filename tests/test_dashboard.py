@@ -117,3 +117,35 @@ def test_dashboard_serve_applications(rt_cluster):
     finally:
         serve.shutdown()
         serve._forget_controller_for_tests()
+
+
+def test_stacks_endpoint_captures_live_worker_frames(rt_cluster):
+    """/api/stacks (py-spy-equivalent, reference: reporter
+    profile_manager): the capture includes the raylet and a worker whose
+    user function is provably mid-execution (its function name appears in
+    the dumped frames)."""
+    from ray_tpu.dashboard import start_dashboard
+
+    @ray_tpu.remote
+    def spinning_task_for_stacks():
+        import time as _t
+        _t.sleep(8)  # keep the frame alive while we capture
+        return "done"
+
+    ref = spinning_task_for_stacks.remote()
+    time.sleep(1.5)  # let the worker spawn and enter the sleep
+
+    port = start_dashboard()
+    nodes = _get_json(port, "/api/stacks")
+    assert nodes and "processes" in nodes[0]
+    procs = nodes[0]["processes"]
+    roles = {p["role"] for p in procs if "role" in p}
+    assert "raylet" in roles
+    all_stacks = "\n".join(p.get("stacks", "") for p in procs)
+    assert "spinning_task_for_stacks" in all_stacks
+    # capture is non-disruptive: the task still completes
+    assert ray_tpu.get(ref, timeout=60) == "done"
+    # node_id filter
+    node_id = nodes[0]["node_id"]
+    only = _get_json(port, f"/api/stacks?node_id={node_id}")
+    assert len(only) == 1 and only[0]["node_id"] == node_id
