@@ -266,6 +266,50 @@ def _run_rl_phase(timeout: float = 420.0):
     return None
 
 
+def _decode_phase(preset: str, dtype: str, batch: int = 8,
+                  prompt_len: int = 128, new_tokens: int = 128) -> dict:
+    """Autoregressive decode throughput (models/generate.py: one-jit
+    prefill + lax.scan KV-cache loop) — tokens/s across the batch."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models import generate as gen
+    from ray_tpu.models import llama
+
+    cfg = _bench_cfg(preset, "xla", 0, dtype)  # decode path uses xla attn
+    params = llama.init_params(jax.random.key(0), cfg)
+    prompt = jax.random.randint(jax.random.key(1), (batch, prompt_len), 0,
+                                cfg.vocab_size, dtype=jnp.int32)
+    out = gen.generate(params, prompt, cfg, max_new_tokens=new_tokens)
+    jax.block_until_ready(out)  # compile + warmup
+    t0 = time.perf_counter()
+    out = gen.generate(params, prompt, cfg, max_new_tokens=new_tokens)
+    jax.block_until_ready(out)
+    dt = time.perf_counter() - t0
+    return {"decode_tok_s": round(batch * new_tokens / dt, 1),
+            "decode_batch": batch, "decode_new_tokens": new_tokens}
+
+
+def _est_hbm_bytes(preset: str, batch: int, seq: int, dtype: str) -> float:
+    """Training-state + activation estimate for one chip.
+
+    Optimizer state is exact (p+g+m+v at the param dtype); the activation
+    term's 17 B/(token*d_model*layer) factor is fitted to measured XLA
+    allocations under this remat/flash config — activations are bf16
+    compute in BOTH param dtypes, so one factor covers both: measured
+    410m/b16/fp32 19.71 GB vs 19.7 predicted; 1b/b8/bf16 OOMed (21.3
+    predicted) while 1b/b4/bf16 ran (15.1 predicted) on a 15.75 GB v5e.
+    Rungs that can't fit are skipped instead of burning a ~40 s compile
+    each to learn it.
+    """
+    from ray_tpu.models import llama
+
+    cfg = llama.PRESETS[preset]
+    state = cfg.num_params() * (16 if dtype == "fp32" else 8)
+    act = 17 * batch * seq * cfg.d_model * cfg.n_layers
+    return float(state + act)
+
+
 def _is_oom(err: BaseException) -> bool:
     s = str(err)
     return ("RESOURCE_EXHAUSTED" in s or "Ran out of memory" in s
@@ -311,7 +355,16 @@ def _inner_main() -> None:
     # Walk the ladder on OOM so the driver always records something.
     train_result, errors, non_oom_failures = None, [], 0
     chosen = None
+    hbm = float(os.environ.get("RT_BENCH_HBM_BYTES") or 0) or (
+        15.75e9 if platform == "tpu" else 0)  # v5e default when unreported
     for preset, batch, seq, steps, attn, chunk, dtype in ladder:
+        if hbm and _est_hbm_bytes(preset, batch, seq, dtype) > hbm:
+            msg = (f"{preset}/b{batch}/s{seq}/{dtype}: skipped — estimated "
+                   f"{_est_hbm_bytes(preset, batch, seq, dtype) / 1e9:.1f}G "
+                   f"> {hbm / 1e9:.1f}G HBM")
+            errors.append(msg)
+            print(f"bench: {msg}", file=sys.stderr)
+            continue
         try:
             train_result = run_through_train(preset, batch, seq, steps, attn,
                                              chunk, dtype)
@@ -358,6 +411,14 @@ def _inner_main() -> None:
         details["mfu_est"] = raw["mfu_est"]
     if errors:
         details["fallback_errors"] = errors
+
+    # Phase 2b — serving-side decode throughput on the SAME model (the
+    # other half of the serving story; best-effort, never the headline).
+    try:
+        details.update(_decode_phase(preset, dtype))
+    except Exception as e:  # noqa: BLE001 — informative only
+        print(f"bench: decode phase failed — {str(e)[:200]}",
+              file=sys.stderr)
 
     from ray_tpu.models import llama as _llama
 
@@ -443,25 +504,36 @@ def _run_inner(env: dict, timeout: float):
     return None
 
 
-def _probe_backend(timeout: float, env: dict) -> str | None:
-    """Check whether jax backend init works in ``env``; return platform."""
+def _probe_backend(timeout: float, env: dict):
+    """Check whether jax backend init works in ``env``; returns
+    (platform, hbm_bytes_str_or_None) or (None, None)."""
     import subprocess
     import sys
 
-    code = "import jax; print('PLATFORM=' + jax.devices()[0].platform)"
+    code = ("import jax; d = jax.devices()[0]; "
+            "print('PLATFORM=' + d.platform)\n"
+            "try:\n"
+            "    print('HBM=' + str(d.memory_stats()['bytes_limit']))\n"
+            "except Exception:\n"
+            "    pass")
     try:
         proc = subprocess.run([sys.executable, "-c", code],
                               env=dict(env), capture_output=True,
                               text=True, timeout=timeout)
     except subprocess.TimeoutExpired:
         print(f"bench: backend probe hung >{timeout}s", file=sys.stderr)
-        return None
+        return None, None
+    platform = hbm = None
     for ln in proc.stdout.splitlines():
         if ln.startswith("PLATFORM="):
-            return ln.split("=", 1)[1]
+            platform = ln.split("=", 1)[1]
+        elif ln.startswith("HBM="):
+            hbm = ln.split("=", 1)[1]
+    if platform is not None:
+        return platform, hbm
     print(f"bench: backend probe failed rc={proc.returncode}: "
           f"{proc.stderr[-300:]}", file=sys.stderr)
-    return None
+    return None, None
 
 
 def _probe_backend_with_retries(flags_env: dict):
@@ -482,17 +554,17 @@ def _probe_backend_with_retries(flags_env: dict):
     attempts = [(240, 30, flags_env), (300, 60, flags_env),
                 (360, 0, plain_env)]
     for attempt, (timeout, sleep_after, env) in enumerate(attempts, start=1):
-        platform = _probe_backend(timeout=timeout, env=env)
+        platform, hbm = _probe_backend(timeout=timeout, env=env)
         if platform is not None:
             if env is plain_env and attempt == 3:
                 print("bench: backend only initializes WITHOUT perf flags — "
                       "running unflagged", file=sys.stderr)
-            return platform, env
+            return platform, env, hbm
         print(f"bench: backend probe attempt {attempt}/3 failed",
               file=sys.stderr)
         if sleep_after:
             _time.sleep(sleep_after)
-    return None, None
+    return None, None, None
 
 
 def main() -> None:
@@ -521,12 +593,14 @@ def main() -> None:
     flags_env = apply_tpu_perf_flags(dict(os.environ))
 
     result, fallback_reason = None, None
-    platform, probe_env = _probe_backend_with_retries(flags_env)
+    platform, probe_env, hbm = _probe_backend_with_retries(flags_env)
     if platform is None:
         fallback_reason = "native jax backend init failed or hung (3 tries)"
     else:
         env = dict(probe_env)
         env["RT_BENCH_PLATFORM"] = platform
+        if hbm:
+            env["RT_BENCH_HBM_BYTES"] = hbm
         result = _run_inner(env, timeout=1500)
         if result is None:
             fallback_reason = f"bench on platform={platform} failed/timed out"

@@ -140,25 +140,25 @@ class DashboardActor:
         def fetch():
             backend = self._backend()
 
+            async def one(n):
+                try:
+                    client = await backend._pool.get(n["address"])
+                    return await asyncio.wait_for(
+                        client.call("dump_stacks", {"timeout": timeout}),
+                        timeout=timeout + 2.0)
+                except Exception as e:  # noqa: BLE001 — partial is fine
+                    return {"node_id": n["node_id"],
+                            "unreachable": f"{type(e).__name__}: {e}"}
+
             async def run():
                 nodes = await backend._gcs.call("list_nodes", {})
-                out = []
-                for n in nodes:
-                    if want and n["node_id"] != want:
-                        continue
-                    if not n.get("alive", True):
-                        continue
-                    try:
-                        client = await backend._pool.get(n["address"])
-                        reply = await asyncio.wait_for(
-                            client.call("dump_stacks", {"timeout": timeout}),
-                            timeout=timeout + 2.0)
-                        out.append(reply)
-                    except Exception as e:  # noqa: BLE001 — partial is fine
-                        out.append({"node_id": n["node_id"],
-                                    "unreachable":
-                                        f"{type(e).__name__}: {e}"})
-                return out
+                targets = [n for n in nodes
+                           if (not want or n["node_id"] == want)
+                           and n.get("alive", True)]
+                # all nodes concurrently: worst case is ONE timeout, not
+                # num_nodes stacked timeouts
+                return list(await asyncio.gather(*(one(n)
+                                                   for n in targets)))
 
             return backend.io.run(run())
 

@@ -94,6 +94,10 @@ class PixelWrapper(VectorEnv):
         if h % self._factor or w % self._factor:
             raise ValueError(f"resize_factor {self._factor} must divide "
                              f"{(h, w)}")
+        if grayscale and c not in (1, 3):
+            raise ValueError(
+                f"grayscale needs 1- or 3-channel frames, got C={c} "
+                "(wrap BEFORE frame-stacking)")
         out = (h // self._factor, w // self._factor,
                1 if grayscale else c)
         self.spec = EnvSpec(num_actions=env.spec.num_actions,
@@ -125,6 +129,7 @@ class PixelWrapper(VectorEnv):
         total = None
         prev = frame = None
         done_any = None
+        dones = None
         for i in range(self._skip):
             frame, rewards, dones = self._env.step(actions)
             total = rewards if total is None else total + rewards
@@ -134,7 +139,12 @@ class PixelWrapper(VectorEnv):
             if dones.any():
                 break  # env auto-resets; don't skip across the boundary
         if prev is not None:
-            frame = np.maximum(frame, prev)  # flicker max-pool
+            # flicker max-pool — but NOT across an auto-reset boundary:
+            # done rows' `frame` is the NEXT episode's first obs, and
+            # blending the dead episode's pixels into it would corrupt the
+            # new episode's (and FrameStack's seeded) first observation
+            pooled = np.maximum(frame, prev)
+            frame = np.where(dones[:, None, None, None], frame, pooled)
         return self._transform(frame), total, done_any
 
 

@@ -41,15 +41,25 @@ class TestPixelPath:
         assert np.abs(finals).sum() == 8
 
     def test_frame_stack_and_wrapper(self):
-        env = rl.FrameStack(rl.CatchPixels(2, size=16), 4)
+        env = rl.FrameStack(rl.CatchPixels(2, seed=0, size=16), 4)
         assert env.spec.obs_shape == (16, 16, 4)
         obs = env.reset()
+        # reset seeds all k channels with the same frame
+        assert np.array_equal(obs[..., 0], obs[..., 3])
         o2, _, _ = env.step(np.zeros(2, dtype=np.int64))
-        # newest frame occupies the LAST channel
-        assert not np.array_equal(o2[..., -1], o2[..., 0]) or True
+        # frame-major: channel 0 holds the OLDEST frame (== the reset
+        # frame), the LAST channel holds the newest (ball moved a row)
+        assert np.array_equal(o2[..., 0], obs[..., 0])
+        assert not np.array_equal(o2[..., -1], o2[..., 0])
+        ref = rl.CatchPixels(2, seed=0, size=16)
+        ref.reset()
+        cur, _, _ = ref.step(np.zeros(2, dtype=np.int64))
+        assert np.array_equal(o2[..., -1], cur[..., 0])
         w = rl.PixelWrapper(rl.CatchPixels(2, size=16), resize_factor=2)
         assert w.spec.obs_shape == (8, 8, 1)
         assert w.reset().max() <= 1.0
+        with pytest.raises(ValueError, match="grayscale"):
+            rl.PixelWrapper(env)  # 4-channel stacked input
 
     def test_cnn_policy_forward_and_smoke_train(self, rl_cluster):
         cfg = rl.PPOConfig()
