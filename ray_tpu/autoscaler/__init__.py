@@ -12,6 +12,11 @@ subprocesses, the ``ray_tpu`` answer to the reference's
 """
 
 from ray_tpu.autoscaler.autoscaler import StandardAutoscaler  # noqa: F401
+from ray_tpu.autoscaler.instance_manager import (  # noqa: F401
+    Instance,
+    InstanceManager,
+    InstanceStorage,
+)
 from ray_tpu.autoscaler.gcp import (  # noqa: F401
     FakeTpuRestHttp,
     GcpTpuPodProvider,

@@ -1,0 +1,357 @@
+"""Autoscaler v2: the instance-state-machine architecture.
+
+Reference analog: ``python/ray/autoscaler/v2/instance_manager/`` —
+``InstanceStorage`` (``instance_storage.py:31``: versioned store with
+status-change subscribers) plus the reconciler that drives every cloud
+instance through an explicit lifecycle instead of v1's stateless
+diff-and-launch loop. The v2 design's point: every transition is recorded
+and observable, and stuck states (a node that never joined the cluster, a
+launch loop against an out-of-quota provider) are detected by timeouts
+and circuit breakers rather than inferred. The store here is in-memory
+(REQUESTED is transient because ``create_node`` is synchronous); a
+durable store slots in behind the same surface.
+
+Lifecycle::
+
+    QUEUED -> REQUESTED -> ALLOCATED -> RAY_RUNNING -> RAY_STOPPING
+         \\         \\            \\                         -> TERMINATED
+          \\         \\            -> (join timeout) TERMINATED
+           \\         -> ALLOCATION_FAILED (retry or give up)
+            -> ...
+
+``InstanceManager.reconcile()`` is the single idempotent step: it compares
+target counts against live instances, launches/terminates through the
+same :class:`NodeProvider` plugin surface v1 uses, matches provider nodes
+to GCS cluster membership to detect RAY_RUNNING, and expires stuck
+states. The v1 ``StandardAutoscaler`` stays the demand brain; this is the
+execution substrate under explicit scale targets (``rt up``-style
+declarative configs, tests, or the demand loop).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+import uuid
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ray_tpu.autoscaler.node_provider import NodeProvider
+
+# lifecycle states
+QUEUED = "QUEUED"                       # decided, not yet requested
+REQUESTED = "REQUESTED"                 # provider.create_node in flight
+ALLOCATED = "ALLOCATED"                 # provider says it exists
+RAY_RUNNING = "RAY_RUNNING"             # joined the GCS (serving)
+RAY_STOPPING = "RAY_STOPPING"           # drain requested
+TERMINATED = "TERMINATED"               # gone (terminal)
+ALLOCATION_FAILED = "ALLOCATION_FAILED"  # create failed (terminal, counted)
+
+_LIVE_STATES = (QUEUED, REQUESTED, ALLOCATED, RAY_RUNNING)
+
+
+@dataclasses.dataclass
+class Instance:
+    instance_id: str
+    node_type: str
+    status: str = QUEUED
+    resources: Dict[str, float] = dataclasses.field(default_factory=dict)
+    labels: Dict[str, str] = dataclasses.field(default_factory=dict)
+    provider_node_id: Optional[str] = None
+    gcs_node_id: Optional[str] = None
+    error: Optional[str] = None
+    launch_attempts: int = 0
+    version: int = 0
+    # status -> wall time of entry (the audit trail the v2 design exists
+    # to provide)
+    status_history: List[Tuple[str, float]] = dataclasses.field(
+        default_factory=list)
+
+    def at(self, status: str) -> Optional[float]:
+        for s, t in reversed(self.status_history):
+            if s == status:
+                return t
+        return None
+
+
+class InstanceStorage:
+    """Versioned instance table with optimistic concurrency + subscribers
+    (reference: ``instance_storage.py:31``). Single-process here — the
+    version check guards interleaved reconciler/operator updates, and
+    subscribers feed observability (event log, metrics)."""
+
+    def __init__(self):
+        self._instances: Dict[str, Instance] = {}
+        self._version = 0
+        self._subscribers: List[Callable[[Instance, str], None]] = []
+
+    @property
+    def version(self) -> int:
+        return self._version
+
+    def subscribe(self, fn: Callable[[Instance, str], None]) -> None:
+        """fn(instance, old_status) after every status change."""
+        self._subscribers.append(fn)
+
+    def upsert(self, inst: Instance,
+               expected_version: Optional[int] = None) -> Tuple[bool, int]:
+        """CAS upsert: fails (False, current_global_version) when the
+        caller's snapshot of THIS instance is stale (its stored record's
+        version moved since the snapshot was taken)."""
+        old = self._instances.get(inst.instance_id)
+        if expected_version is not None and \
+                (old.version if old else 0) != expected_version:
+            # per-INSTANCE CAS: the caller's snapshot of this record is
+            # stale (someone else transitioned it since); global-version
+            # CAS would spuriously abort on unrelated instances' writes
+            return False, self._version
+        old_status = old.status if old else None
+        stored = self._copy(inst)
+        if old is not None:
+            # the TABLE owns the audit trail: callers may hold stale
+            # copies whose history misses intermediate transitions
+            stored.status_history = list(old.status_history)
+        if old_status != stored.status:
+            stored.status_history.append((stored.status, time.time()))
+        self._version += 1
+        stored.version = inst.version = self._version
+        # store a COPY: the table must not alias the caller's mutable
+        # object, or later caller mutations silently bypass upsert (no
+        # version bump, no subscriber event, broken CAS)
+        self._instances[stored.instance_id] = stored
+        if old_status != stored.status:
+            for fn in self._subscribers:
+                try:
+                    # subscribers get a COPY too — a mutating observer
+                    # must not edit the table behind the version counter
+                    fn(self._copy(stored), old_status)
+                except Exception:  # noqa: BLE001 — observers never break us
+                    pass
+        return True, self._version
+
+    @staticmethod
+    def _copy(inst: Instance) -> Instance:
+        return dataclasses.replace(
+            inst, resources=dict(inst.resources), labels=dict(inst.labels),
+            status_history=list(inst.status_history))
+
+    def get(self, instance_id: str) -> Optional[Instance]:
+        inst = self._instances.get(instance_id)
+        return self._copy(inst) if inst is not None else None
+
+    def list(self, statuses: Optional[Tuple[str, ...]] = None
+             ) -> List[Instance]:
+        out = list(self._instances.values())
+        if statuses is not None:
+            out = [i for i in out if i.status in statuses]
+        return [self._copy(i) for i in out]
+
+    def delete(self, instance_id: str) -> None:
+        if instance_id in self._instances:
+            self._version += 1
+            del self._instances[instance_id]
+
+
+class InstanceManager:
+    """Reconciler: drives instances toward per-type target counts.
+
+    ``gcs_nodes_fn`` returns the live cluster membership
+    (``[{node_id, alive, labels}]``) — how ALLOCATED instances are
+    recognized as RAY_RUNNING and dead ones retired, mirroring the
+    reference's cloud-instance <-> ray-node binding.
+    """
+
+    def __init__(self, provider: NodeProvider,
+                 node_types: Dict[str, Dict],
+                 gcs_nodes_fn: Callable[[], List[Dict]],
+                 storage: Optional[InstanceStorage] = None,
+                 max_launch_retries: int = 2,
+                 join_timeout_s: float = 300.0,
+                 failure_backoff_s: float = 10.0,
+                 max_terminal_records: int = 256):
+        self.storage = storage or InstanceStorage()
+        self._provider = provider
+        self._node_types = node_types  # name -> {resources, labels, ...}
+        self._gcs_nodes_fn = gcs_nodes_fn
+        self._targets: Dict[str, int] = {}
+        self._max_retries = max_launch_retries
+        self._join_timeout_s = join_timeout_s
+        # per-node-type launch circuit breaker: each ALLOCATION_FAILED
+        # doubles the pause before replacements queue again (capped), so
+        # a provider that is permanently out of quota is probed at a
+        # gentle rate instead of hammered every pass
+        self._backoff_base_s = failure_backoff_s
+        self._backoff_until: Dict[str, float] = {}
+        self._backoff_mult: Dict[str, int] = {}
+        self._max_terminal = max_terminal_records
+
+    # ---- target surface ---------------------------------------------------
+    def set_target(self, node_type: str, count: int) -> None:
+        if node_type not in self._node_types:
+            raise KeyError(f"unknown node type {node_type!r}; have "
+                           f"{sorted(self._node_types)}")
+        self._targets[node_type] = max(0, int(count))
+
+    def targets(self) -> Dict[str, int]:
+        return dict(self._targets)
+
+    # ---- the reconcile step ----------------------------------------------
+    def reconcile(self) -> Dict[str, int]:
+        """One idempotent pass; returns a transition-count summary."""
+        summary = {"launched": 0, "running": 0, "terminated": 0,
+                   "failed": 0, "queued": 0}
+        provider_nodes = {n["provider_node_id"]: n
+                          for n in self._provider.non_terminated_nodes()}
+        gcs_nodes = {n.get("labels", {}).get("as-instance-id"): n
+                     for n in self._gcs_nodes_fn()}
+
+        # 1. queue/trim toward targets
+        self._fill_targets(summary, trim=True)
+
+        # 2. drive state transitions. Each write is per-instance-CAS'd on
+        # the snapshot version: an operator (or subscriber) transition that
+        # interleaves wins, and this pass simply skips the record.
+        for inst in self.storage.list():
+            if inst.status == QUEUED:
+                self._launch(inst, summary)
+            elif inst.status == ALLOCATED:
+                node = gcs_nodes.get(inst.instance_id)
+                if node is not None and node.get("alive", True):
+                    inst.gcs_node_id = node["node_id"]
+                    inst.status = RAY_RUNNING
+                    if self.storage.upsert(
+                            inst, expected_version=inst.version)[0]:
+                        summary["running"] += 1
+                elif node is not None:
+                    # joined and ALREADY died between passes — don't sit
+                    # out the join timeout on a corpse
+                    self._terminate(inst, "died before first observation",
+                                    summary)
+                elif inst.provider_node_id not in provider_nodes:
+                    self._fail(inst, "provider node disappeared before "
+                                     "joining", summary)
+                elif time.time() - (inst.at(ALLOCATED) or 0) \
+                        > self._join_timeout_s:
+                    self._terminate(inst, "never joined the cluster",
+                                    summary)
+            elif inst.status == RAY_RUNNING:
+                node = gcs_nodes.get(inst.instance_id)
+                if inst.provider_node_id not in provider_nodes or (
+                        node is not None and not node.get("alive", True)):
+                    # died underneath us: record and (if still targeted)
+                    # the next pass re-queues a replacement
+                    self._terminate(inst, "node died", summary)
+            elif inst.status == RAY_STOPPING:
+                if inst.provider_node_id not in provider_nodes:
+                    inst.status = TERMINATED
+                    if self.storage.upsert(
+                            inst, expected_version=inst.version)[0]:
+                        summary["terminated"] += 1
+                else:
+                    self._provider.terminate_node(inst.provider_node_id)
+
+        # 3. instances retired during this pass leave a shortfall —
+        # queue replacements NOW so recovery doesn't wait a full period
+        self._fill_targets(summary, trim=False)
+        self._gc_terminal_records()
+        return summary
+
+    def _fill_targets(self, summary: Dict[str, int], trim: bool) -> None:
+        now = time.time()
+        by_type: Dict[str, List[Instance]] = {t: [] for t in self._targets}
+        for i in self.storage.list(_LIVE_STATES):
+            if i.node_type in by_type:
+                by_type[i.node_type].append(i)
+        for node_type, want in self._targets.items():
+            live = by_type[node_type]
+            if want > len(live) and \
+                    now >= self._backoff_until.get(node_type, 0.0):
+                for _ in range(want - len(live)):
+                    inst = Instance(
+                        instance_id=f"inst-{uuid.uuid4().hex[:8]}",
+                        node_type=node_type,
+                        resources=dict(self._node_types[node_type]
+                                       .get("resources", {})),
+                        labels=dict(self._node_types[node_type]
+                                    .get("labels", {})))
+                    self.storage.upsert(inst)
+                    summary["queued"] += 1
+            if trim and want < len(live):
+                # retire surplus: never-joined first, then newest
+                surplus = sorted(
+                    live, key=lambda i: (i.status == RAY_RUNNING,
+                                         -(i.at(i.status) or 0)))
+                for inst in surplus[:len(live) - want]:
+                    self._stop(inst, summary)
+
+    def _gc_terminal_records(self) -> None:
+        """Bound the terminal-record history (the audit trail is useful,
+        unbounded growth across weeks of churn is not)."""
+        terminal = self.storage.list((TERMINATED, ALLOCATION_FAILED))
+        if len(terminal) <= self._max_terminal:
+            return
+        terminal.sort(key=lambda i: i.at(i.status) or 0)
+        for inst in terminal[:len(terminal) - self._max_terminal]:
+            self.storage.delete(inst.instance_id)
+
+    # ---- transitions ------------------------------------------------------
+    def _launch(self, inst: Instance, summary: Dict[str, int]) -> None:
+        inst.status = REQUESTED
+        inst.launch_attempts += 1
+        self.storage.upsert(inst)
+        try:
+            nt = self._node_types[inst.node_type]
+            labels = {**inst.labels, "as-instance-id": inst.instance_id}
+            inst.provider_node_id = self._provider.create_node(
+                inst.node_type, dict(nt.get("resources", {})), labels)
+        except Exception as e:  # noqa: BLE001 — cloud errors are data
+            if inst.launch_attempts <= self._max_retries:
+                inst.status = QUEUED  # retry next pass
+                inst.error = f"attempt {inst.launch_attempts}: {e}"
+                self.storage.upsert(inst)
+            else:
+                self._fail(inst, str(e), summary)
+            return
+        inst.status = ALLOCATED
+        self.storage.upsert(inst)
+        summary["launched"] += 1
+        self._backoff_mult.pop(inst.node_type, None)
+        self._backoff_until.pop(inst.node_type, None)
+
+    def _stop(self, inst: Instance, summary: Dict[str, int]) -> None:
+        if inst.status in (QUEUED,):
+            inst.status = TERMINATED
+            self.storage.upsert(inst)
+            summary["terminated"] += 1
+            return
+        inst.status = RAY_STOPPING
+        self.storage.upsert(inst)
+        if inst.provider_node_id:
+            try:
+                self._provider.terminate_node(inst.provider_node_id)
+            except Exception:  # noqa: BLE001 — retried next pass
+                pass
+
+    def _terminate(self, inst: Instance, reason: str,
+                   summary: Dict[str, int]) -> None:
+        inst.error = reason
+        if inst.provider_node_id:
+            try:
+                self._provider.terminate_node(inst.provider_node_id)
+            except Exception:  # noqa: BLE001
+                pass
+        inst.status = TERMINATED
+        self.storage.upsert(inst)
+        summary["terminated"] += 1
+
+    def _fail(self, inst: Instance, reason: str,
+              summary: Dict[str, int]) -> None:
+        inst.error = reason
+        inst.status = ALLOCATION_FAILED
+        self.storage.upsert(inst)
+        summary["failed"] += 1
+        # circuit-break this node type: exponential pause before the next
+        # replacement attempt, reset by the first successful launch
+        mult = self._backoff_mult.get(inst.node_type, 0)
+        self._backoff_mult[inst.node_type] = min(mult + 1, 6)  # <= 64x
+        self._backoff_until[inst.node_type] = time.time() + \
+            self._backoff_base_s * (2 ** mult)

@@ -57,6 +57,10 @@ class LocalNodeProvider(NodeProvider):
             args += ["--num-tpus", str(num_tpus)]
         if res:
             args += ["--resources", json.dumps(res)]
+        if labels:
+            # the node must register with these labels so cluster-side
+            # consumers (v2 instance binding, label scheduling) see them
+            args += ["--labels", json.dumps(labels)]
         proc = subprocess.Popen(args, stdout=subprocess.PIPE,
                                 stderr=subprocess.DEVNULL,
                                 start_new_session=True)

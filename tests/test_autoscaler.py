@@ -175,3 +175,250 @@ def test_autoscaler_e2e_local_provider(tmp_path, monkeypatch):
             ray_tpu.shutdown()
         cli("stop", "--force")
         config_mod.reset_config_for_tests()
+
+
+# ---------------------------------------------------- v2 instance manager --
+
+class TestInstanceManager:
+    """State-machine tests (reference: autoscaler/v2 instance_storage +
+    reconciler): explicit lifecycle, CAS storage, failure retries,
+    join-timeout expiry, and dead-node replacement."""
+
+    def _im(self, provider=None, gcs_nodes=None, **kw):
+        from ray_tpu.autoscaler.instance_manager import InstanceManager
+
+        gcs = gcs_nodes if gcs_nodes is not None else []
+        return InstanceManager(
+            provider or FakeProvider(),
+            {"cpu2": {"resources": {"CPU": 2.0}, "labels": {"t": "cpu2"}}},
+            lambda: gcs, **kw)
+
+    def test_scale_up_to_running(self):
+        from ray_tpu.autoscaler.instance_manager import (
+            ALLOCATED, RAY_RUNNING)
+
+        provider = FakeProvider()
+        gcs_nodes = []
+        im = self._im(provider, gcs_nodes)
+        im.set_target("cpu2", 2)
+        s1 = im.reconcile()
+        assert s1["queued"] == 2 and s1["launched"] == 2
+        insts = im.storage.list()
+        assert {i.status for i in insts} == {ALLOCATED}
+        # every created node carries the binding label
+        assert all("as-instance-id" in n["labels"]
+                   for n in provider.non_terminated_nodes())
+        # nodes join the GCS -> RAY_RUNNING
+        for n in provider.non_terminated_nodes():
+            gcs_nodes.append({"node_id": n["gcs_node_id"], "alive": True,
+                              "labels": dict(n["labels"])})
+        s2 = im.reconcile()
+        assert s2["running"] == 2
+        assert {i.status for i in im.storage.list()} == {RAY_RUNNING}
+
+    def test_launch_failure_retries_then_fails(self):
+        from ray_tpu.autoscaler.instance_manager import ALLOCATION_FAILED
+
+        class Exploding(FakeProvider):
+            def create_node(self, *a, **k):
+                raise RuntimeError("quota exceeded")
+
+        im = self._im(Exploding(), max_launch_retries=2)
+        im.set_target("cpu2", 1)
+        im.reconcile()   # attempt 1 -> back to QUEUED
+        im.reconcile()   # attempt 2 -> back to QUEUED
+        s = im.reconcile()  # attempt 3 > max_retries -> failed
+        assert s["failed"] == 1
+        (inst,) = im.storage.list((ALLOCATION_FAILED,))
+        assert "quota" in inst.error
+        assert inst.launch_attempts == 3
+
+    def test_join_timeout_terminates_and_replaces(self):
+        from ray_tpu.autoscaler.instance_manager import (
+            ALLOCATED, TERMINATED)
+
+        provider = FakeProvider()
+        im = self._im(provider, join_timeout_s=0.0)  # immediate expiry
+        im.set_target("cpu2", 1)
+        im.reconcile()
+        assert im.storage.list((ALLOCATED,))
+        time.sleep(0.01)
+        s = im.reconcile()
+        assert s["terminated"] == 1
+        assert provider.terminated  # cloud node reclaimed
+        # the shortfall re-queues a replacement on the same pass
+        assert s["queued"] == 1
+
+    def test_dead_node_replaced(self):
+        from ray_tpu.autoscaler.instance_manager import RAY_RUNNING
+
+        provider = FakeProvider()
+        gcs_nodes = []
+        im = self._im(provider, gcs_nodes)
+        im.set_target("cpu2", 1)
+        im.reconcile()
+        n = provider.non_terminated_nodes()[0]
+        gcs_nodes.append({"node_id": n["gcs_node_id"], "alive": True,
+                          "labels": dict(n["labels"])})
+        im.reconcile()
+        assert im.storage.list((RAY_RUNNING,))
+        # the node dies under us
+        provider.nodes.clear()
+        gcs_nodes[0]["alive"] = False
+        s = im.reconcile()
+        assert s["terminated"] == 1 and s["queued"] == 1
+
+    def test_scale_down_prefers_not_yet_joined(self):
+        from ray_tpu.autoscaler.instance_manager import (
+            RAY_RUNNING, RAY_STOPPING, TERMINATED)
+
+        provider = FakeProvider()
+        gcs_nodes = []
+        im = self._im(provider, gcs_nodes)
+        im.set_target("cpu2", 2)
+        im.reconcile()
+        # only ONE joins
+        n = provider.non_terminated_nodes()[0]
+        gcs_nodes.append({"node_id": n["gcs_node_id"], "alive": True,
+                          "labels": dict(n["labels"])})
+        im.reconcile()
+        im.set_target("cpu2", 1)
+        im.reconcile()
+        statuses = sorted(i.status for i in im.storage.list())
+        # the running node survives; the never-joined one is stopping/gone
+        assert RAY_RUNNING in statuses
+        assert RAY_STOPPING in statuses or TERMINATED in statuses
+        running = [i for i in im.storage.list((RAY_RUNNING,))]
+        assert len(running) == 1
+
+    def test_storage_versioning_and_subscribers(self):
+        from ray_tpu.autoscaler.instance_manager import (
+            Instance, InstanceStorage)
+
+        st = InstanceStorage()
+        events = []
+        st.subscribe(lambda inst, old: events.append((old, inst.status)))
+        inst = Instance(instance_id="i1", node_type="cpu2")
+        ok, v1 = st.upsert(inst)
+        assert ok and v1 == 1
+        # stale CAS fails
+        ok, v = st.upsert(inst, expected_version=0)
+        assert not ok and v == v1
+        inst.status = "REQUESTED"
+        ok, v2 = st.upsert(inst, expected_version=v1)
+        assert ok and v2 == 2
+        assert events == [(None, "QUEUED"), ("QUEUED", "REQUESTED")]
+        # the audit trail records both states
+        assert [s for s, _ in st.get("i1").status_history] == [
+            "QUEUED", "REQUESTED"]
+
+
+def test_instance_manager_e2e_local_provider(tmp_path, monkeypatch):
+    """v2 e2e: the reconciler boots a REAL node daemon, binds it to the
+    GCS membership via the as-instance-id label, reaches RAY_RUNNING, and
+    tears it down on target 0."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO
+    env["JAX_PLATFORMS"] = "cpu"
+    env["RT_SESSION_DIR_ROOT"] = str(tmp_path)
+
+    def cli(*args):
+        return subprocess.run(
+            [sys.executable, "-m", "ray_tpu.scripts.cli", *args],
+            env=env, capture_output=True, text=True, timeout=90)
+
+    head = cli("start", "--head", "--num-cpus", "1")
+    assert head.returncode == 0, head.stderr
+    gcs = [ln.split()[-1] for ln in head.stdout.splitlines()
+           if "gcs_address" in ln][0]
+    monkeypatch.setenv("RT_SESSION_DIR_ROOT", str(tmp_path))
+    config_mod.reset_config_for_tests()
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    try:
+        from ray_tpu.autoscaler import InstanceManager, LocalNodeProvider
+        from ray_tpu.autoscaler.instance_manager import (
+            RAY_RUNNING, TERMINATED)
+
+        ray_tpu.init(address=gcs)
+        im = InstanceManager(
+            LocalNodeProvider(gcs),
+            {"cpu2": {"resources": {"CPU": 2.0}}},
+            gcs_nodes_fn=ray_tpu.nodes)
+        im.set_target("cpu2", 1)
+        im.reconcile()
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            s = im.reconcile()
+            if im.storage.list((RAY_RUNNING,)):
+                break
+            time.sleep(0.5)
+        (inst,) = im.storage.list((RAY_RUNNING,))
+        assert inst.gcs_node_id
+        # the real node serves tasks
+        @ray_tpu.remote(num_cpus=2)
+        def two():
+            return "ran"
+
+        assert ray_tpu.get(two.remote(), timeout=60) == "ran"
+
+        im.set_target("cpu2", 0)
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            im.reconcile()
+            if not im.storage.list((RAY_RUNNING, "RAY_STOPPING")):
+                break
+            time.sleep(0.5)
+        assert im.storage.list((TERMINATED,))
+    finally:
+        try:
+            ray_tpu.shutdown()
+        finally:
+            cli("stop", "--force")
+            config_mod.reset_config_for_tests()
+
+
+def test_instance_storage_interleaved_writer_wins_cas():
+    """Per-instance CAS: a transition that lands between snapshot and
+    write makes the stale write FAIL instead of clobbering it."""
+    from ray_tpu.autoscaler.instance_manager import Instance, InstanceStorage
+
+    st = InstanceStorage()
+    st.upsert(Instance(instance_id="i1", node_type="t"))
+    snap = st.get("i1")
+    # operator transitions the instance under the reconciler's feet
+    op = st.get("i1")
+    op.status = "RAY_STOPPING"
+    assert st.upsert(op, expected_version=op.version)[0]
+    # the stale snapshot's write must bounce
+    snap.status = "RAY_RUNNING"
+    ok, _ = st.upsert(snap, expected_version=snap.version)
+    assert not ok
+    assert st.get("i1").status == "RAY_STOPPING"
+    # unrelated instances don't interfere (per-instance, not global CAS)
+    st.upsert(Instance(instance_id="i2", node_type="t"))
+    snap2 = st.get("i1")
+    snap2.status = "TERMINATED"
+    assert st.upsert(snap2, expected_version=snap2.version)[0]
+
+
+def test_instance_manager_backoff_circuit_breaker():
+    """A permanently failing provider is probed with exponential pauses,
+    not hammered every pass, and records stay bounded."""
+    from ray_tpu.autoscaler.instance_manager import InstanceManager
+
+    class Exploding(FakeProvider):
+        def create_node(self, *a, **k):
+            self.created.append("try")
+            raise RuntimeError("out of quota")
+
+    provider = Exploding()
+    im = InstanceManager(provider, {"t": {"resources": {"CPU": 1}}},
+                         lambda: [], max_launch_retries=0,
+                         failure_backoff_s=3600.0, max_terminal_records=4)
+    im.set_target("t", 1)
+    for _ in range(20):
+        im.reconcile()
+    # one failed instance, then the breaker held: exactly one create call
+    assert len(provider.created) == 1
+    assert len(im.storage.list()) <= 5  # bounded records
