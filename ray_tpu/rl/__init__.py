@@ -13,6 +13,12 @@ from ray_tpu.rl.algorithms import (  # noqa: F401
     A2CConfig,
     APPO,
     APPOConfig,
+    ARS,
+    ARSConfig,
+    AlphaZero,
+    AlphaZeroConfig,
+    MCTS,
+    TicTacToe,
     BanditConfig,
     BanditLinTS,
     BanditLinUCB,
@@ -32,6 +38,8 @@ from ray_tpu.rl.algorithms import (  # noqa: F401
     MARWILConfig,
     PPO,
     PPOConfig,
+    QMIX,
+    QMIXConfig,
     SAC,
     SACConfig,
     TD3,

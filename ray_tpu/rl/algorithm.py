@@ -195,8 +195,34 @@ class Algorithm(Trainable):
     def train(self) -> Dict[str, Any]:
         return super().train()
 
+    def evaluate(self, num_episodes: int = 10) -> Dict[str, Any]:
+        """Roll out the current policy and report episode returns
+        (reference: ``Algorithm.evaluate``). Base implementation uses the
+        env-runner fleet; fleet-less algorithms (ES/ARS/bandits) override."""
+        import time
+
+        if not self.runners:
+            raise ValueError(
+                f"{type(self).__name__} has no env runners; evaluate is "
+                "not supported")
+        params = (self._runner_params()
+                  if hasattr(self, "_runner_params") else self.get_params())
+        episodes_seen = 0
+        stats = {"episode_return_mean": float("nan")}
+        deadline = time.monotonic() + 300
+        while episodes_seen < num_episodes \
+                and time.monotonic() < deadline:
+            self.synchronous_sample(params)
+            stats = self.collect_episode_stats()
+            episodes_seen += stats["episodes_this_iter"]
+        return {"episodes": episodes_seen,
+                "episode_return_mean": stats["episode_return_mean"]}
+
     def stop(self) -> None:
-        for r in getattr(self, "runners", []):
+        # runners (env-runner fleets) and _workers (ES/ARS episode-eval
+        # fleets) both hold cluster CPUs; release them all
+        for r in (list(getattr(self, "runners", []))
+                  + list(getattr(self, "_workers", []))):
             try:
                 ray_tpu.kill(r, no_restart=True)
             except Exception:

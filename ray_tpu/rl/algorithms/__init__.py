@@ -1,5 +1,13 @@
 from ray_tpu.rl.algorithms.a2c import A2C, A2CConfig  # noqa: F401
+from ray_tpu.rl.algorithms.alphazero import (  # noqa: F401
+    AlphaZero,
+    AlphaZeroConfig,
+    Game,
+    MCTS,
+    TicTacToe,
+)
 from ray_tpu.rl.algorithms.appo import APPO, APPOConfig  # noqa: F401
+from ray_tpu.rl.algorithms.ars import ARS, ARSConfig  # noqa: F401
 from ray_tpu.rl.algorithms.bandits import (  # noqa: F401
     BanditConfig,
     BanditLinTS,
@@ -23,4 +31,5 @@ from ray_tpu.rl.algorithms.offline import (  # noqa: F401
     MARWILConfig,
 )
 from ray_tpu.rl.algorithms.ppo import PPO, PPOConfig  # noqa: F401
+from ray_tpu.rl.algorithms.qmix import QMIX, QMIXConfig  # noqa: F401
 from ray_tpu.rl.algorithms.sac import SAC, SACConfig  # noqa: F401

@@ -125,6 +125,21 @@ class _LinearBandit(Algorithm):
             self._A_inv[a] = Ai - np.outer(Ax, Ax) / (1.0 + x @ Ax)
             self._b[a] += r * x
 
+    def evaluate(self, num_episodes: int = 10) -> Dict[str, Any]:
+        """Greedy (exploitation-only) pulls with the fitted arm models;
+        one 'episode' = one vectorized env batch."""
+        theta = self._theta_hat()
+        total, n = 0.0, 0
+        obs = self._obs
+        for _ in range(num_episodes):
+            arms = np.argmax(obs @ theta.T, axis=-1)
+            obs, r, _ = self._env.step(arms)
+            total += float(np.sum(r))
+            n += len(r)
+        self._obs = obs
+        return {"episodes": num_episodes,
+                "episode_return_mean": total / max(1, n)}
+
     def training_step(self) -> Dict[str, Any]:
         cfg = self.config
         regret_known = hasattr(self._env, "best_mean_reward")

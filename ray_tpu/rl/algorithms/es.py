@@ -158,6 +158,15 @@ class ES(Algorithm):
     def set_params(self, params) -> None:
         self._center, self._meta = _flatten(params)
 
+    def evaluate(self, num_episodes: int = 10) -> Dict[str, Any]:
+        """Whole episodes at the unperturbed center parameters."""
+        refs = [self._workers[i % len(self._workers)]
+                .episode_return.remote(self._center)
+                for i in range(num_episodes)]
+        rets = [r[0] for r in ray_tpu.get(refs)]
+        return {"episodes": num_episodes,
+                "episode_return_mean": float(np.mean(rets))}
+
     def training_step(self) -> Dict[str, Any]:
         cfg = self.config
         seeds = [int(s) for s in

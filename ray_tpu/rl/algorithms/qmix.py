@@ -1,0 +1,306 @@
+"""QMIX: cooperative multi-agent Q-learning with monotonic value mixing.
+
+Reference analog: ``rllib/algorithms/qmix/`` (Rashid et al. 2018). Each
+agent has a utility network Q_a(obs_a, ·) (one weight-shared MLP with an
+agent-id one-hot appended to the observation — the standard parameter
+sharing); a mixing network combines the chosen utilities into Q_tot under
+a monotonicity constraint: the mixer's weights are produced by
+hypernetworks of the global state and passed through ``abs``, so
+dQ_tot/dQ_a >= 0 and the per-agent argmax equals the joint argmax.
+
+Runs in-process on the ``MultiAgentEnv`` protocol (rl/multi_agent.py),
+with transition replay, epsilon-greedy exploration, and periodically
+synced target networks. The global state is the concatenation of all
+agents' observations (the usual choice when the env exposes no separate
+state).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.rl import models
+from ray_tpu.rl.config import AlgorithmConfig
+from ray_tpu.rl.learner import Learner
+from ray_tpu.rl.multi_agent import _MA_ENVS, MultiAgentEnv
+from ray_tpu.tune.trainable import Trainable
+
+
+class QMIXConfig(AlgorithmConfig):
+    def __init__(self, **kwargs):
+        super().__init__(algo_class=QMIX, **kwargs)
+        self.env = "coordination"
+        self.lr = 5e-4
+        self.minibatch_size = 64
+        self.buffer_size = 50_000
+        self.learning_starts = 500
+        self.target_update_freq = 200    # in gradient updates
+        self.epsilon_initial = 1.0
+        self.epsilon_final = 0.05
+        self.epsilon_decay_steps = 5_000
+        self.mixing_embed_dim = 32
+        self.updates_per_iter = 32
+
+
+def _init_mixer(key, n_agents: int, state_dim: int, embed: int) -> Dict:
+    """Hypernetworks state -> mixer weights (abs applied in the forward
+    pass, not here): w1 [state,n*embed], b1 [state,embed], w2 [state,embed],
+    and a 2-layer value head for the final bias."""
+    k1, k2, k3, k4 = jax.random.split(key, 4)
+    return {
+        "hw1": models.init_mlp(k1, (state_dim, n_agents * embed),
+                               out_scale=0.1),
+        "hb1": models.init_mlp(k2, (state_dim, embed), out_scale=0.1),
+        "hw2": models.init_mlp(k3, (state_dim, embed), out_scale=0.1),
+        "hb2": models.init_mlp(k4, (state_dim, embed, 1), out_scale=0.1),
+    }
+
+
+def _mix(mixer: Dict, qs: jnp.ndarray, state: jnp.ndarray) -> jnp.ndarray:
+    """qs [B, n_agents], state [B, state_dim] -> Q_tot [B]."""
+    b, n = qs.shape
+    w1 = jnp.abs(models.mlp_forward(mixer["hw1"], state))   # [B, n*e]
+    e = w1.shape[-1] // n
+    w1 = w1.reshape(b, n, e)
+    b1 = models.mlp_forward(mixer["hb1"], state)            # [B, e]
+    hidden = jax.nn.elu(jnp.einsum("bn,bne->be", qs, w1) + b1)
+    w2 = jnp.abs(models.mlp_forward(mixer["hw2"], state))   # [B, e]
+    b2 = models.mlp_forward(mixer["hb2"], state)[..., 0]    # [B]
+    return jnp.sum(hidden * w2, axis=-1) + b2
+
+
+class QMIX(Trainable):
+    """Centralized-training / decentralized-execution cooperative MARL."""
+
+    @classmethod
+    def get_default_config(cls) -> AlgorithmConfig:
+        return QMIXConfig()
+
+    def setup(self, config: Dict[str, Any]) -> None:
+        if "__algo_config" in config:
+            self.config: AlgorithmConfig = config["__algo_config"]
+        else:
+            self.config = QMIXConfig().update_from_dict(config)
+        cfg = self.config
+        ctor = _MA_ENVS[cfg.env] if isinstance(cfg.env, str) else cfg.env
+        self.env: MultiAgentEnv = ctor(num_envs=cfg.num_envs_per_runner,
+                                       **(cfg.env_config or {}))
+        self.agents = list(self.env.agents)
+        n = len(self.agents)
+        specs = [self.env.spec[a] for a in self.agents]
+        if any(not s.discrete for s in specs):
+            raise ValueError("QMIX requires discrete actions")
+        # shared agent net over (obs ++ agent one-hot); pad obs to the max
+        # dim so heterogeneous agents share one tower
+        self._obs_dims = [s.obs_dim for s in specs]
+        self._max_obs = max(self._obs_dims)
+        self._agent_actions = [s.num_actions for s in specs]
+        self._num_actions = max(self._agent_actions)
+        # heterogeneous agents: rows of invalid action slots get -inf so
+        # neither exploration argmax nor the TD-target max can pick them
+        mask = np.zeros((n, self._num_actions), dtype=np.float32)
+        for i, a_n in enumerate(self._agent_actions):
+            mask[i, a_n:] = -np.inf
+        self._action_mask = mask
+        self._state_dim = sum(self._obs_dims)
+        in_dim = self._max_obs + n
+        k = jax.random.key(cfg.seed)
+        k_agent, k_mix = jax.random.split(k)
+        agent_net = models.init_mlp(
+            k_agent, (in_dim,) + tuple(cfg.hidden) + (self._num_actions,))
+        mixer = _init_mixer(k_mix, n, self._state_dim, cfg.mixing_embed_dim)
+        params = {"agent": agent_net, "mixer": mixer,
+                  "target_agent": jax.tree_util.tree_map(
+                      jnp.array, agent_net),
+                  "target_mixer": jax.tree_util.tree_map(jnp.array, mixer)}
+        gamma = cfg.gamma
+        eye = jnp.eye(n, dtype=jnp.float32)
+        act_mask = jnp.asarray(mask)
+
+        def agent_qs(net, obs):
+            """obs [B, n, max_obs] -> per-agent Q [B, n, A]; invalid
+            action slots are -inf."""
+            bsz = obs.shape[0]
+            ids = jnp.broadcast_to(eye, (bsz, n, n))
+            x = jnp.concatenate([obs, ids], axis=-1)
+            return models.mlp_forward(net, x) + act_mask
+
+        def loss_fn(p, batch, key):
+            del key
+            q = agent_qs(p["agent"], batch["obs"])          # [B, n, A]
+            q_taken = jnp.take_along_axis(
+                q, batch["actions"][..., None].astype(jnp.int32),
+                axis=-1)[..., 0]                            # [B, n]
+            q_tot = _mix(p["mixer"], q_taken, batch["state"])
+            q_next = agent_qs(p["target_agent"], batch["next_obs"])
+            q_next_max = jnp.max(q_next, axis=-1)           # [B, n]
+            q_tot_next = _mix(p["target_mixer"], q_next_max,
+                              batch["next_state"])
+            nonterminal = 1.0 - batch["dones"].astype(jnp.float32)
+            target = batch["rewards"] + gamma * nonterminal \
+                * jax.lax.stop_gradient(q_tot_next)
+            td = q_tot - target
+            loss = jnp.mean(td ** 2)
+            return loss, {"td_abs_mean": jnp.mean(jnp.abs(td)),
+                          "q_tot_mean": jnp.mean(q_tot)}
+
+        self.learner = Learner(params, loss_fn, cfg.lr,
+                               grad_clip=cfg.grad_clip, seed=cfg.seed)
+        self._agent_qs = jax.jit(
+            lambda net, obs: agent_qs(net, obs))
+        # replay storage (flat transitions across the vector envs)
+        self._buf: Dict[str, List[np.ndarray]] = \
+            {k: [] for k in ("obs", "actions", "rewards", "dones",
+                             "state", "next_obs", "next_state")}
+        self._buf_len = 0
+        self._rng = np.random.default_rng(cfg.seed)
+        self._obs = self.env.reset()
+        self._env_steps_total = 0
+        self._grad_updates = 0
+        self._return_window: List[float] = []
+        self._ep_return = np.zeros(self.env.num_envs, dtype=np.float64)
+
+    # -- rollout ----------------------------------------------------------
+
+    def _stack_obs(self, obs: Dict[str, np.ndarray]) -> np.ndarray:
+        """dict -> [N, n_agents, max_obs] (zero-padded)."""
+        n_envs = self.env.num_envs
+        out = np.zeros((n_envs, len(self.agents), self._max_obs),
+                       dtype=np.float32)
+        for i, a in enumerate(self.agents):
+            out[:, i, :self._obs_dims[i]] = obs[a]
+        return out
+
+    def _state_of(self, obs: Dict[str, np.ndarray]) -> np.ndarray:
+        return np.concatenate([obs[a] for a in self.agents],
+                              axis=-1).astype(np.float32)
+
+    @property
+    def _epsilon(self) -> float:
+        cfg = self.config
+        frac = min(1.0, self._env_steps_total
+                   / max(1, cfg.epsilon_decay_steps))
+        return cfg.epsilon_initial \
+            + frac * (cfg.epsilon_final - cfg.epsilon_initial)
+
+    def _collect(self, steps: int) -> float:
+        cfg = self.config
+        n_envs = self.env.num_envs
+        reward_sum = 0.0
+        for _ in range(steps):
+            stacked = self._stack_obs(self._obs)
+            q = np.asarray(self._agent_qs(
+                self.learner.get_params()["agent"], jnp.asarray(stacked)))
+            greedy = np.argmax(q, axis=-1)                  # [N, n]
+            eps_mask = self._rng.random(greedy.shape) < self._epsilon
+            rand = np.stack([self._rng.integers(0, a_n, n_envs)
+                             for a_n in self._agent_actions], axis=1)
+            chosen = np.where(eps_mask, rand, greedy)
+            acts = {a: chosen[:, i].astype(np.int64)
+                    for i, a in enumerate(self.agents)}
+            next_obs, rewards, dones = self.env.step(acts)
+            team_r = np.mean([rewards[a] for a in self.agents],
+                             axis=0).astype(np.float32)
+            self._buf["obs"].append(stacked)
+            self._buf["actions"].append(chosen.astype(np.int64))
+            self._buf["rewards"].append(team_r)
+            self._buf["dones"].append(dones.astype(np.float32))
+            self._buf["state"].append(self._state_of(self._obs))
+            self._buf["next_obs"].append(self._stack_obs(next_obs))
+            self._buf["next_state"].append(self._state_of(next_obs))
+            self._buf_len += n_envs
+            self._env_steps_total += n_envs
+            reward_sum += float(team_r.sum())
+            self._ep_return += team_r
+            for i in np.nonzero(dones)[0]:
+                self._return_window.append(float(self._ep_return[i]))
+                self._ep_return[i] = 0.0
+            self._obs = next_obs
+            # bound the buffer
+            max_rows = max(1, cfg.buffer_size // n_envs)
+            for key in self._buf:
+                if len(self._buf[key]) > max_rows:
+                    del self._buf[key][:len(self._buf[key]) - max_rows]
+            self._buf_len = min(self._buf_len,
+                                max_rows * n_envs)
+        self._return_window = self._return_window[-100:]
+        return reward_sum / max(1, steps * n_envs)
+
+    def _sample_batch(self, arrays: Dict[str, np.ndarray],
+                      size: int) -> Dict[str, np.ndarray]:
+        idx = self._rng.integers(0, len(arrays["rewards"]), size)
+        return {k: v[idx] for k, v in arrays.items()}
+
+    # -- Trainable API ----------------------------------------------------
+
+    def step(self) -> Dict[str, Any]:
+        cfg = self.config
+        mean_step_r = self._collect(cfg.rollout_fragment_length)
+        metrics: Dict[str, Any] = {"reward_mean_per_step": mean_step_r,
+                                   "epsilon": self._epsilon}
+        if self._buf_len >= cfg.learning_starts:
+            updates = cfg.updates_per_iter or 1
+            mlist = []
+            # one concatenation per step(), not per minibatch draw
+            arrays = {k: np.concatenate(v) for k, v in self._buf.items()}
+            for _ in range(updates):
+                mb = self._sample_batch(arrays, cfg.minibatch_size)
+                mlist.append(self.learner.update_minibatch(mb))
+                self._grad_updates += 1
+                if self._grad_updates % cfg.target_update_freq == 0:
+                    p = self.learner.get_params()
+                    p = dict(p)
+                    p["target_agent"] = jax.tree_util.tree_map(
+                        jnp.array, p["agent"])
+                    p["target_mixer"] = jax.tree_util.tree_map(
+                        jnp.array, p["mixer"])
+                    self.learner.set_params(p)
+            for k in mlist[0]:
+                metrics[k] = float(np.mean([float(m[k]) for m in mlist]))
+        metrics["env_steps_total"] = self._env_steps_total
+        if self._return_window:
+            metrics["episode_return_mean"] = float(
+                np.mean(self._return_window))
+        return metrics
+
+    def evaluate(self, num_episodes: int = 10) -> Dict[str, Any]:
+        """Greedy (epsilon=0) episodes on a fresh env instance."""
+        cfg = self.config
+        ctor = _MA_ENVS[cfg.env] if isinstance(cfg.env, str) else cfg.env
+        env: MultiAgentEnv = ctor(num_envs=cfg.num_envs_per_runner,
+                                  **(cfg.env_config or {}))
+        obs = env.reset()
+        done_returns: List[float] = []
+        ep_ret = np.zeros(env.num_envs, dtype=np.float64)
+        params = self.learner.get_params()["agent"]
+        for _ in range(4096):
+            stacked = self._stack_obs(obs)
+            q = np.asarray(self._agent_qs(params, jnp.asarray(stacked)))
+            chosen = np.argmax(q, axis=-1)
+            acts = {a: chosen[:, i].astype(np.int64)
+                    for i, a in enumerate(self.agents)}
+            obs, rewards, dones = env.step(acts)
+            ep_ret += np.mean([rewards[a] for a in self.agents], axis=0)
+            for i in np.nonzero(dones)[0]:
+                done_returns.append(float(ep_ret[i]))
+                ep_ret[i] = 0.0
+            if len(done_returns) >= num_episodes:
+                break
+        return {"episodes": len(done_returns),
+                "episode_return_mean": float(np.mean(done_returns))
+                if done_returns else float("nan")}
+
+    # -- checkpointing ----------------------------------------------------
+
+    def save_checkpoint(self, checkpoint_dir: str) -> Optional[Dict]:
+        return {"params": jax.tree_util.tree_map(
+            np.asarray, self.learner.get_params()),
+            "env_steps_total": self._env_steps_total}
+
+    def load_checkpoint(self, checkpoint: Dict) -> None:
+        self.learner.set_params(checkpoint["params"])
+        self._env_steps_total = checkpoint.get("env_steps_total", 0)

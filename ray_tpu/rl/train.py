@@ -1,0 +1,150 @@
+"""`rt rl train` / `rt rl evaluate` — the RL command-line entry.
+
+Reference analogs: ``rllib/train.py`` (``rllib train --run PPO --env
+CartPole-v1 --config '{...}' --stop '{...}'``), ``rllib/evaluate.py``
+(rollouts from a checkpoint), and ``rllib/algorithms/registry.py`` (the
+name -> algorithm map). The checkpoint directory stores the pickled
+``AlgorithmConfig`` next to the Trainable payload so ``evaluate`` can
+rebuild the exact algorithm without re-specifying flags.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import sys
+import time
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+
+def algorithm_registry() -> Dict[str, type]:
+    """name -> AlgorithmConfig class for every bundled algorithm."""
+    from ray_tpu import rl
+
+    return {
+        "PPO": rl.PPOConfig, "APPO": rl.APPOConfig,
+        "IMPALA": rl.IMPALAConfig, "A2C": rl.A2CConfig,
+        "DQN": rl.DQNConfig, "SAC": rl.SACConfig,
+        "DDPG": rl.DDPGConfig, "TD3": rl.TD3Config,
+        "BC": rl.BCConfig, "MARWIL": rl.MARWILConfig,
+        "CQL": rl.CQLConfig, "ES": rl.ESConfig, "ARS": rl.ARSConfig,
+        "QMIX": rl.QMIXConfig, "ALPHAZERO": rl.AlphaZeroConfig,
+        "BANDITLINUCB": rl.BanditConfig, "BANDITLINTS": rl.BanditConfig,
+    }
+
+
+def get_algorithm_config(run: str):
+    reg = algorithm_registry()
+    key = run.replace("-", "").replace("_", "").upper()
+    if key not in reg:
+        raise ValueError(
+            f"unknown algorithm {run!r}; available: {sorted(reg)}")
+    cfg = reg[key]()
+    # the two bandit flavors share a config class; pick the right algo
+    if key in ("BANDITLINTS", "BANDITLINUCB"):
+        from ray_tpu import rl
+
+        cfg.algo_class = (rl.BanditLinTS if key == "BANDITLINTS"
+                          else rl.BanditLinUCB)
+    return cfg
+
+
+def _load_overrides(config_json: Optional[str],
+                    config_file: Optional[str]) -> Dict[str, Any]:
+    overrides: Dict[str, Any] = {}
+    if config_file:
+        with open(config_file) as f:
+            text = f.read()
+        try:
+            overrides.update(json.loads(text))
+        except json.JSONDecodeError:
+            import yaml
+
+            overrides.update(yaml.safe_load(text) or {})
+    if config_json:
+        overrides.update(json.loads(config_json))
+    return overrides
+
+
+def run_train(run: str, env: Optional[str] = None,
+              config_json: Optional[str] = None,
+              config_file: Optional[str] = None,
+              stop_iters: int = 10,
+              stop_reward: Optional[float] = None,
+              stop_timesteps: Optional[int] = None,
+              checkpoint_dir: Optional[str] = None,
+              out=sys.stdout) -> Dict[str, Any]:
+    """Train `run` until a stop criterion fires; returns the last result."""
+    cfg = get_algorithm_config(run)
+    if env:
+        cfg.env = env
+    overrides = _load_overrides(config_json, config_file)
+    if overrides:
+        cfg.update_from_dict(overrides)
+    algo = cfg.build()
+    result: Dict[str, Any] = {}
+    try:
+        for i in range(stop_iters):
+            t0 = time.monotonic()
+            result = algo.train()
+            dt = time.monotonic() - t0
+            reward = result.get("episode_return_mean",
+                                result.get("mean_return",
+                                           result.get("reward_mean_per_step",
+                                                      float("nan"))))
+            steps = result.get("env_steps_total", 0)
+            print(f"iter {i + 1}/{stop_iters}  reward={reward:.2f}  "
+                  f"env_steps={steps}  {dt:.1f}s", file=out, flush=True)
+            if stop_reward is not None and np.isfinite(reward) \
+                    and reward >= stop_reward:
+                print(f"stop: reward {reward:.2f} >= {stop_reward}",
+                      file=out)
+                break
+            if stop_timesteps is not None and steps >= stop_timesteps:
+                print(f"stop: env steps {steps} >= {stop_timesteps}",
+                      file=out)
+                break
+        if checkpoint_dir:
+            path = algo.save(checkpoint_dir)
+            with open(os.path.join(checkpoint_dir, "algo_config.pkl"),
+                      "wb") as f:
+                pickle.dump({"run": run, "config": cfg}, f)
+            print(f"checkpoint saved to {path}", file=out)
+    finally:
+        stop = getattr(algo, "stop", None)
+        if stop:
+            stop()
+    return result
+
+
+def run_evaluate(checkpoint_dir: str, run: Optional[str] = None,
+                 episodes: int = 10, out=sys.stdout) -> Dict[str, Any]:
+    """Roll out a trained policy and report episode returns."""
+    meta_path = os.path.join(checkpoint_dir, "algo_config.pkl")
+    if os.path.exists(meta_path):
+        with open(meta_path, "rb") as f:
+            meta = pickle.load(f)
+        cfg = meta["config"]
+        run = run or meta["run"]
+    elif run:
+        cfg = get_algorithm_config(run)
+    else:
+        raise ValueError(
+            f"{meta_path} not found; pass --run to name the algorithm")
+    algo = cfg.build()
+    algo.restore(checkpoint_dir)
+    try:
+        eval_fn = getattr(algo, "evaluate", None)
+        if eval_fn is None:
+            raise ValueError(
+                f"{type(algo).__name__} does not implement evaluate()")
+        result = eval_fn(episodes)
+        print(json.dumps(result, indent=2), file=out)
+        return result
+    finally:
+        stop = getattr(algo, "stop", None)
+        if stop:
+            stop()

@@ -322,6 +322,38 @@ def cmd_serve(args: argparse.Namespace) -> int:
     return 1
 
 
+def cmd_rl(args: argparse.Namespace) -> int:
+    """rt rl train/evaluate (reference: ``rllib/train.py``,
+    ``rllib/evaluate.py``)."""
+    import ray_tpu
+    from ray_tpu.rl import train as rl_train
+
+    owns_session = False
+    if args.address:
+        _attach_driver(args.address)
+        owns_session = True
+    elif not ray_tpu.is_initialized():
+        ray_tpu.init()  # standalone local cluster, like `rllib train`
+        owns_session = True
+    try:
+        if args.rl_cmd == "train":
+            rl_train.run_train(
+                args.run, env=args.env, config_json=args.config,
+                config_file=args.config_file, stop_iters=args.stop_iters,
+                stop_reward=args.stop_reward,
+                stop_timesteps=args.stop_timesteps,
+                checkpoint_dir=args.checkpoint_dir)
+            return 0
+        if args.rl_cmd == "evaluate":
+            rl_train.run_evaluate(args.checkpoint, run=args.run,
+                                  episodes=args.episodes)
+            return 0
+        return 1
+    finally:
+        if owns_session:  # don't tear down a borrowed live session
+            ray_tpu.shutdown()
+
+
 def cmd_metrics(args: argparse.Namespace) -> int:
     from ray_tpu.util.metrics import metrics_text
 
@@ -397,6 +429,28 @@ def main(argv=None) -> int:
         ps = serve_sub.add_parser(name)
         ps.add_argument("--address", default=None)
     p_serve.set_defaults(fn=cmd_serve)
+
+    p_rl = sub.add_parser("rl", help="train / evaluate RL algorithms")
+    rl_sub = p_rl.add_subparsers(dest="rl_cmd", required=True)
+    pr_train = rl_sub.add_parser("train")
+    pr_train.add_argument("--run", required=True,
+                          help="algorithm name (PPO, DQN, SAC, ...)")
+    pr_train.add_argument("--env", default=None)
+    pr_train.add_argument("--config", default=None,
+                          help="JSON dict of AlgorithmConfig overrides")
+    pr_train.add_argument("--config-file", default=None,
+                          help="YAML/JSON file of config overrides")
+    pr_train.add_argument("--stop-iters", type=int, default=10)
+    pr_train.add_argument("--stop-reward", type=float, default=None)
+    pr_train.add_argument("--stop-timesteps", type=int, default=None)
+    pr_train.add_argument("--checkpoint-dir", default=None)
+    pr_train.add_argument("--address", default=None)
+    pr_eval = rl_sub.add_parser("evaluate")
+    pr_eval.add_argument("checkpoint", help="checkpoint dir from train")
+    pr_eval.add_argument("--run", default=None)
+    pr_eval.add_argument("--episodes", type=int, default=10)
+    pr_eval.add_argument("--address", default=None)
+    p_rl.set_defaults(fn=cmd_rl)
 
     p_metrics = sub.add_parser("metrics",
                                help="aggregated Prometheus metrics page")
