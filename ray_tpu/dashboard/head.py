@@ -38,6 +38,7 @@ class DashboardActor:
         if self._port is not None:
             return self._port  # idempotent: already serving
         app = web.Application()
+        app.router.add_get("/", self._index)
         app.router.add_get("/-/healthz", self._healthz)
         app.router.add_get("/api/version", self._version)
         app.router.add_get("/api/nodes", self._gcs_list("list_nodes"))
@@ -64,6 +65,15 @@ class DashboardActor:
             self._runner = None
 
     # -- handlers -------------------------------------------------------------
+    async def _index(self, request):
+        """The browser UI (reference: ``dashboard/client/`` React SPA —
+        here a single static page over the same REST surface)."""
+        from aiohttp import web
+
+        from ray_tpu.dashboard.ui import INDEX_HTML
+
+        return web.Response(text=INDEX_HTML, content_type="text/html")
+
     async def _healthz(self, request):
         from aiohttp import web
 

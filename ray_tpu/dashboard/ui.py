@@ -1,0 +1,352 @@
+"""The dashboard's browser UI: one self-contained HTML page.
+
+Reference analog: ``dashboard/client/`` (a 183-file React SPA). Redesigned
+for a zero-egress TPU pod: a single static page with no external assets,
+rendered from the same ``/api/*`` REST endpoints the CLI uses (state
+listings, jobs, serve apps, cluster resources, Prometheus text). Served at
+``GET /`` by ``dashboard/head.py``.
+"""
+
+INDEX_HTML = r"""<!doctype html>
+<html lang="en">
+<head>
+<meta charset="utf-8">
+<meta name="viewport" content="width=device-width, initial-scale=1">
+<title>ray_tpu dashboard</title>
+<style>
+:root {
+  color-scheme: light;
+  --surface-1: #fcfcfb; --surface-2: #f1f1ef;
+  --text-primary: #0b0b0b; --text-secondary: #52514e; --text-muted: #7a7974;
+  --border: #dddcd8;
+  --series-1: #2a78d6;
+  --good: #0ca30c; --warning: #fab219; --serious: #ec835a;
+  --critical: #d03b3b;
+}
+@media (prefers-color-scheme: dark) {
+  :root:not([data-theme="light"]) {
+    color-scheme: dark;
+    --surface-1: #1a1a19; --surface-2: #242423;
+    --text-primary: #ffffff; --text-secondary: #c3c2b7;
+    --text-muted: #8f8e86; --border: #3a3a38;
+    --series-1: #3987e5;
+  }
+}
+:root[data-theme="dark"] {
+  color-scheme: dark;
+  --surface-1: #1a1a19; --surface-2: #242423;
+  --text-primary: #ffffff; --text-secondary: #c3c2b7;
+  --text-muted: #8f8e86; --border: #3a3a38;
+  --series-1: #3987e5;
+}
+* { box-sizing: border-box; }
+body {
+  margin: 0; background: var(--surface-1); color: var(--text-primary);
+  font: 14px/1.45 system-ui, -apple-system, "Segoe UI", sans-serif;
+}
+header {
+  display: flex; align-items: baseline; gap: 12px;
+  padding: 14px 20px 10px;
+  border-bottom: 1px solid var(--border);
+}
+header h1 { font-size: 17px; margin: 0; font-weight: 650; }
+header .sub { color: var(--text-muted); font-size: 12px; }
+header .spacer { flex: 1; }
+header button {
+  background: var(--surface-2); color: var(--text-secondary);
+  border: 1px solid var(--border); border-radius: 6px;
+  padding: 3px 10px; font-size: 12px; cursor: pointer;
+}
+.tiles {
+  display: grid; grid-template-columns: repeat(auto-fit, minmax(150px, 1fr));
+  gap: 10px; padding: 14px 20px;
+}
+.tile {
+  background: var(--surface-2); border: 1px solid var(--border);
+  border-radius: 8px; padding: 10px 14px;
+}
+.tile .label {
+  font-size: 11px; letter-spacing: .04em; text-transform: uppercase;
+  color: var(--text-muted);
+}
+.tile .value { font-size: 24px; font-weight: 650; margin-top: 2px;
+  font-variant-numeric: tabular-nums; }
+.tile .detail { font-size: 11px; color: var(--text-secondary); }
+.meter {
+  margin-top: 6px; height: 6px; border-radius: 4px;
+  background: color-mix(in srgb, var(--border) 60%, var(--surface-2));
+  overflow: hidden;
+}
+.meter > div {
+  height: 100%; border-radius: 4px; background: var(--series-1);
+  transition: width .4s;
+}
+nav { display: flex; gap: 2px; padding: 0 20px; flex-wrap: wrap;
+  border-bottom: 1px solid var(--border); }
+nav button {
+  background: none; border: none; border-bottom: 2px solid transparent;
+  color: var(--text-secondary); padding: 7px 12px; font-size: 13px;
+  cursor: pointer;
+}
+nav button.active {
+  color: var(--text-primary); border-bottom-color: var(--series-1);
+  font-weight: 600;
+}
+main { padding: 14px 20px 40px; }
+table { border-collapse: collapse; width: 100%; font-size: 13px; }
+th {
+  text-align: left; color: var(--text-muted); font-weight: 600;
+  font-size: 11px; letter-spacing: .04em; text-transform: uppercase;
+  padding: 6px 10px; border-bottom: 1px solid var(--border);
+  position: sticky; top: 0; background: var(--surface-1);
+}
+td {
+  padding: 6px 10px; border-bottom: 1px solid var(--border);
+  color: var(--text-secondary); font-variant-numeric: tabular-nums;
+  max-width: 380px; overflow: hidden; text-overflow: ellipsis;
+  white-space: nowrap;
+}
+td.id { font-family: ui-monospace, monospace; font-size: 12px; }
+.status { display: inline-flex; align-items: center; gap: 5px; }
+.status .dot { width: 8px; height: 8px; border-radius: 50%; }
+.s-good .dot { background: var(--good); }
+.s-warning .dot { background: var(--warning); }
+.s-serious .dot { background: var(--serious); }
+.s-critical .dot { background: var(--critical); }
+.s-muted .dot { background: var(--text-muted); }
+.empty { color: var(--text-muted); padding: 24px 0; }
+#error { color: var(--critical); font-size: 12px; padding: 0 20px; }
+</style>
+</head>
+<body>
+<header>
+  <h1>ray_tpu</h1>
+  <span class="sub" id="version"></span>
+  <span class="spacer"></span>
+  <span class="sub" id="updated"></span>
+  <button id="pause">pause</button>
+  <button id="theme">theme</button>
+</header>
+<div class="tiles" id="tiles"></div>
+<div id="error"></div>
+<nav id="tabs"></nav>
+<main id="content"></main>
+<script>
+"use strict";
+const TABS = [
+  {id: "nodes", label: "Nodes", url: "/api/nodes"},
+  {id: "actors", label: "Actors", url: "/api/actors"},
+  {id: "jobs", label: "Jobs", url: "/api/jobs"},
+  {id: "placement_groups", label: "Placement groups",
+   url: "/api/placement_groups"},
+  {id: "tasks", label: "Tasks", url: "/api/tasks?limit=200"},
+  {id: "objects", label: "Objects", url: "/api/objects?limit=200"},
+  {id: "serve", label: "Serve", url: "/api/serve/applications"},
+];
+let active = "nodes", paused = false, data = {};
+
+// --- status rendering: icon + label, never color alone ---
+const STATUS_CLASS = {
+  ALIVE: "s-good", RUNNING: "s-good", CREATED: "s-good",
+  SUCCEEDED: "s-good", FINISHED: "s-good", COMMITTED: "s-good",
+  HEALTHY: "s-good",
+  PENDING: "s-warning", PENDING_CREATION: "s-warning",
+  DEPLOYING: "s-warning", PREPARED: "s-warning", QUEUED: "s-warning",
+  UPDATING: "s-warning",
+  RESTARTING: "s-serious", RECONSTRUCTING: "s-serious",
+  DEAD: "s-critical", FAILED: "s-critical", STOPPED: "s-critical",
+  UNHEALTHY: "s-critical",
+};
+function esc(s) {
+  return String(s ?? "").replace(/[&<>"]/g,
+    c => ({"&": "&amp;", "<": "&lt;", ">": "&gt;", '"': "&quot;"}[c]));
+}
+function statusCell(s) {
+  const cls = STATUS_CLASS[String(s).toUpperCase()] || "s-muted";
+  return `<span class="status ${cls}"><span class="dot"></span>` +
+         `${esc(s)}</span>`;
+}
+function fmtRes(r) {
+  if (!r || typeof r !== "object") return "";
+  return Object.entries(r).map(([k, v]) => `${esc(k)}:${esc(v)}`)
+    .join(" ");
+}
+
+// --- per-tab table definitions: [header, row -> cell html] ---
+const COLS = {
+  nodes: [
+    ["Node", r => `<td class="id">${esc(r.node_id)}</td>`],
+    ["Address", r => `<td>${esc(r.address || "")}</td>`],
+    ["State", r => `<td>${statusCell(r.alive === false ? "DEAD"
+                                     : "ALIVE")}</td>`],
+    ["Labels", r => `<td>${fmtRes(r.labels)}</td>`],
+    ["Total", r => `<td>${fmtRes(r.resources_total || r.resources)}</td>`],
+    ["Available", r => `<td>${fmtRes(r.resources_available
+                                     || r.available)}</td>`],
+  ],
+  actors: [
+    ["Actor", r => `<td class="id">${esc(r.actor_id)}</td>`],
+    ["Name", r => `<td>${esc(r.name || "")}</td>`],
+    ["Class", r => `<td>${esc(r.class_name || "")}</td>`],
+    ["State", r => `<td>${statusCell(r.state)}</td>`],
+    ["Node", r => `<td class="id">${esc(r.node_id || "")}</td>`],
+    ["Restarts", r => `<td>${esc(r.num_restarts ?? 0)}</td>`],
+  ],
+  jobs: [
+    ["Job", r => `<td class="id">${esc(r.job_id || r.submission_id)}</td>`],
+    ["Entrypoint", r => `<td>${esc(r.entrypoint || "")}</td>`],
+    ["Status", r => `<td>${statusCell(r.status)}</td>`],
+    ["Message", r => `<td>${esc(r.message || "")}</td>`],
+  ],
+  placement_groups: [
+    ["Group", r => `<td class="id">${esc(r.placement_group_id)}</td>`],
+    ["Name", r => `<td>${esc(r.name || "")}</td>`],
+    ["Strategy", r => `<td>${esc(r.strategy || "")}</td>`],
+    ["State", r => `<td>${statusCell(r.state)}</td>`],
+    ["Bundles", r => `<td>${esc((r.bundles || []).length)}</td>`],
+  ],
+  tasks: [
+    ["Task", r => `<td class="id">${esc(r.task_id)}</td>`],
+    ["Name", r => `<td>${esc(r.name || r.func_name || "")}</td>`],
+    ["State", r => `<td>${statusCell(r.state || r.status)}</td>`],
+    ["Node", r => `<td class="id">${esc(r.node_id || "")}</td>`],
+    ["Duration", r => {
+      const t = r.times || {};
+      const end = t.FINISHED || t.FAILED, start = t.RUNNING || t.PENDING;
+      return `<td>${end && start
+        ? ((end - start).toFixed(2) + "s") : ""}</td>`;
+    }],
+  ],
+  objects: [
+    ["Object", r => `<td class="id">${esc(r.object_id)}</td>`],
+    ["Size", r => `<td>${esc(r.size ?? "")}</td>`],
+    ["Where", r => `<td>${esc(r.node_id || r.location || "")}</td>`],
+    ["Spilled", r => `<td>${r.spilled ? "yes" : ""}</td>`],
+  ],
+};
+
+function renderTiles() {
+  const res = data.resources || {};
+  const total = res.total || {}, avail = res.available || {};
+  const nodes = data.nodes || [], actors = data.actors || [];
+  const jobs = data.jobs || [];
+  const tiles = [];
+  const aliveN = nodes.filter(n => n.alive !== false).length;
+  tiles.push(tile("Nodes", `${aliveN}`,
+    nodes.length > aliveN ? `${nodes.length - aliveN} dead` : "alive"));
+  const aliveA = actors.filter(a =>
+    String(a.state).toUpperCase() === "ALIVE").length;
+  tiles.push(tile("Actors", `${aliveA}`, `${actors.length} total`));
+  const runJ = jobs.filter(j =>
+    ["RUNNING", "PENDING"].includes(String(j.status).toUpperCase())).length;
+  tiles.push(tile("Jobs", `${runJ}`, `${jobs.length} total`));
+  for (const key of ["CPU", "TPU"]) {
+    if (!(key in total)) continue;
+    const t = total[key] || 0, a = avail[key] ?? t;
+    const used = Math.max(0, t - a);
+    const pct = t ? Math.round(100 * used / t) : 0;
+    tiles.push(tile(`${key} in use`, `${used}/${t}`,
+      `${pct}%`, pct));
+  }
+  document.getElementById("tiles").innerHTML = tiles.join("");
+}
+function tile(label, value, detail, meterPct) {
+  const meter = meterPct === undefined ? "" :
+    `<div class="meter"><div style="width:${meterPct}%"></div></div>`;
+  return `<div class="tile"><div class="label">${esc(label)}</div>` +
+    `<div class="value">${esc(value)}</div>` +
+    `<div class="detail">${esc(detail)}</div>${meter}</div>`;
+}
+
+function renderTable() {
+  const el = document.getElementById("content");
+  if (active === "serve") {
+    const apps = data.serve || {};
+    const names = Object.keys(apps);
+    if (!names.length) {
+      el.innerHTML = `<div class="empty">no serve applications</div>`;
+      return;
+    }
+    el.innerHTML = names.map(n => {
+      const app = apps[n] || {};
+      const deps = app.deployments || app;
+      return `<h3>${esc(n)} ${statusCell(app.status || "RUNNING")}</h3>` +
+        `<table><tr><th>Deployment</th><th>Status</th><th>Replicas</th>` +
+        `</tr>` + Object.entries(deps).map(([d, info]) =>
+          `<tr><td>${esc(d)}</td>` +
+          `<td>${statusCell((info && info.status) || "?")}</td>` +
+          `<td>${esc((info && (info.num_replicas ?? info.replicas))
+                     ?? "")}</td></tr>`).join("") + `</table>`;
+    }).join("");
+    return;
+  }
+  const rows = data[active] || [];
+  const cols = COLS[active];
+  if (!rows.length) {
+    el.innerHTML = `<div class="empty">no ${esc(active)} yet</div>`;
+    return;
+  }
+  el.innerHTML = `<table><tr>` +
+    cols.map(c => `<th>${esc(c[0])}</th>`).join("") + `</tr>` +
+    rows.map(r => `<tr>` + cols.map(c => c[1](r)).join("") +
+             `</tr>`).join("") + `</table>`;
+}
+
+function renderTabs() {
+  document.getElementById("tabs").innerHTML = TABS.map(t =>
+    `<button data-id="${t.id}" class="${t.id === active ? "active" : ""}">` +
+    `${esc(t.label)}</button>`).join("");
+}
+
+async function fetchJson(url) {
+  const resp = await fetch(url);
+  if (!resp.ok) throw new Error(`${url}: HTTP ${resp.status}`);
+  return resp.json();
+}
+async function refresh(force) {
+  if (paused && !force) return;
+  try {
+    const [nodes, actors, jobs, resources, tab] = await Promise.all([
+      fetchJson("/api/nodes"), fetchJson("/api/actors"),
+      fetchJson("/api/jobs"), fetchJson("/api/cluster_resources"),
+      fetchJson(TABS.find(t => t.id === active).url),
+    ]);
+    data.nodes = nodes; data.actors = actors; data.jobs = jobs;
+    data.resources = resources;
+    data[active] = active === "serve" ? (tab || {}) : tab;
+    renderTiles(); renderTable();
+    document.getElementById("updated").textContent =
+      "updated " + new Date().toLocaleTimeString();
+    document.getElementById("error").textContent = "";
+  } catch (e) {
+    document.getElementById("error").textContent = String(e);
+  }
+}
+
+document.getElementById("tabs").addEventListener("click", e => {
+  const id = e.target.dataset && e.target.dataset.id;
+  if (!id) return;
+  active = id; renderTabs();
+  refresh(true);  // tab switch renders even while paused
+});
+document.getElementById("pause").addEventListener("click", e => {
+  paused = !paused;
+  e.target.textContent = paused ? "resume" : "pause";
+});
+document.getElementById("theme").addEventListener("click", () => {
+  const root = document.documentElement;
+  const cur = root.dataset.theme ||
+    (matchMedia("(prefers-color-scheme: dark)").matches ? "dark" : "light");
+  root.dataset.theme = cur === "dark" ? "light" : "dark";
+});
+fetchJson("/api/version").then(v => {
+  document.getElementById("version").textContent =
+    `${v.framework} ${v.version}`;
+}).catch(() => {});
+renderTabs();
+refresh();
+setInterval(refresh, 5000);
+</script>
+</body>
+</html>
+"""

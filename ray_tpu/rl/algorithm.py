@@ -196,27 +196,53 @@ class Algorithm(Trainable):
         return super().train()
 
     def evaluate(self, num_episodes: int = 10) -> Dict[str, Any]:
-        """Roll out the current policy and report episode returns
-        (reference: ``Algorithm.evaluate``). Base implementation uses the
-        env-runner fleet; fleet-less algorithms (ES/ARS/bandits) override."""
+        """Roll out the EXPLOITATION policy and report episode returns
+        (reference: ``Algorithm.evaluate``). Base implementation samples
+        through the env-runner fleet with ``_eval_params()`` (greedy:
+        epsilon/exploration-noise off) and leaves training state — the
+        env-step counter, the episode-return window, exploration
+        schedules — untouched. Fleet-less algorithms (ES/ARS/bandits/
+        QMIX/AlphaZero) override."""
         import time
 
         if not self.runners:
             raise ValueError(
                 f"{type(self).__name__} has no env runners; evaluate is "
                 "not supported")
-        params = (self._runner_params()
-                  if hasattr(self, "_runner_params") else self.get_params())
+        if hasattr(self, "_eval_params"):
+            params = self._eval_params()
+        elif hasattr(self, "_runner_params"):
+            params = self._runner_params()
+        else:
+            params = self.get_params()
         episodes_seen = 0
-        stats = {"episode_return_mean": float("nan")}
-        deadline = time.monotonic() + 300
-        while episodes_seen < num_episodes \
-                and time.monotonic() < deadline:
-            self.synchronous_sample(params)
-            stats = self.collect_episode_stats()
-            episodes_seen += stats["episodes_this_iter"]
+        steps_before = self._env_steps_total
+        saved_window = self._return_window
+        saved_conn = self._connector_state
+        self._return_window = []  # eval episodes only
+        try:
+            deadline = time.monotonic() + 300
+            while episodes_seen < num_episodes \
+                    and time.monotonic() < deadline:
+                self.synchronous_sample(params)
+                stats = self.collect_episode_stats()
+                episodes_seen += stats["episodes_this_iter"]
+            mean_ret = (float(np.mean(self._return_window))
+                        if self._return_window else float("nan"))
+        finally:
+            # evaluation must not advance exploration/stop schedules,
+            # pollute the training return window, or shift the fleet's
+            # connector (obs-filter) statistics
+            self._env_steps_total = steps_before
+            self._return_window = saved_window
+            if self._conn_pipeline is not None \
+                    and saved_conn is not None:
+                self._connector_state = saved_conn
+                ray_tpu.get([
+                    r.set_connector_globals.remote(saved_conn)
+                    for r in self.runners])
         return {"episodes": episodes_seen,
-                "episode_return_mean": stats["episode_return_mean"]}
+                "episode_return_mean": mean_ret}
 
     def stop(self) -> None:
         # runners (env-runner fleets) and _workers (ES/ARS episode-eval

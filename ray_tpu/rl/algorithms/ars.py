@@ -1,173 +1,51 @@
 """ARS: Augmented Random Search (Mania et al. 2018).
 
-Reference analog: ``rllib/algorithms/ars/ars.py`` — like ES, a fleet of
-workers evaluates antithetic parameter perturbations for whole episodes,
-but with the three ARS augmentations: (V2) observations are normalized by
-a running mean/std filter shared across the fleet, (b) only the top-b
-directions by max(r+, r-) contribute to the update, and the step is scaled
-by the standard deviation of the selected returns. Noise travels as
-integer seeds (the SharedNoiseTable trick), never parameter vectors; the
-running obs filter syncs by merging per-worker (count, sum, sumsq) deltas
-on the driver — the same delta-merge pattern as the connector
-MeanStdFilter (rl/connectors.py).
+Reference analog: ``rllib/algorithms/ars/ars.py`` — ES's antithetic
+whole-episode evaluation fleet (shared here by subclassing :class:`ES`;
+noise travels as integer seeds, the SharedNoiseTable trick) with the
+three ARS augmentations: (V2) observations are normalized by a running
+mean/std filter shared across the fleet (the ``normalize_obs`` flag on
+the shared ``_ESWorker``), (b) only the top-b directions by
+max(r+, r-) contribute to the update, and the step is scaled by the
+standard deviation of the selected returns.
 """
 
 from __future__ import annotations
 
-from typing import Any, Dict, Tuple
+from typing import Any, Dict
 
 import numpy as np
 
 import ray_tpu
-from ray_tpu.rl import models
-from ray_tpu.rl.algorithm import Algorithm
+from ray_tpu.rl.algorithms.es import ES, ESConfig, _noise
 from ray_tpu.rl.config import AlgorithmConfig
-from ray_tpu.rl.algorithms.es import (
-    _centered_ranks,  # noqa: F401  (kept for API symmetry with ES)
-    _flatten,
-    _noise,
-    _unflatten,
-)
 
 
-class ARSConfig(AlgorithmConfig):
+class ARSConfig(ESConfig):
     def __init__(self, **kwargs):
-        super().__init__(algo_class=ARS, **kwargs)
+        super().__init__(**kwargs)
+        self.algo_class = ARS
         self.episodes_per_perturbation = 1
-        self.noise_std = 0.05
-        self.num_perturbations = 16   # antithetic direction pairs / iter
         self.top_directions = 8       # b: directions kept for the update
-        self.lr = 0.02
-        self.max_episode_len = 500
         self.normalize_obs = True
 
 
-@ray_tpu.remote
-class _ARSWorker:
-    """Evaluates perturbed deterministic policies with a running obs
-    filter (ARS-V2). Filter deltas are popped by the driver and the merged
-    global filter pushed back, so every worker normalizes with fleet-wide
-    statistics."""
-
-    def __init__(self, env_name: str, env_config: Dict, seed: int,
-                 hidden, noise_std: float, max_len: int,
-                 normalize_obs: bool):
-        import jax
-        import jax.numpy as jnp
-
-        from ray_tpu.rl.env import make_env
-
-        self._env = make_env(env_name, 1, env_config, seed=seed)
-        self.spec = self._env.spec
-        self._std = noise_std
-        self._max_len = max_len
-        self._normalize = normalize_obs
-        base = models.init_policy(jax.random.key(0), self.spec, hidden)
-        _, self._meta = _flatten(base)
-        d = self.spec.obs_dim
-        # global filter (mean/var used for normalization) + local delta
-        self._mean = np.zeros(d, dtype=np.float64)
-        self._var = np.ones(d, dtype=np.float64)
-        self._delta = np.zeros((3, d), dtype=np.float64)  # count,sum,sumsq
-
-        spec = self.spec
-
-        @jax.jit
-        def act(params, obs):
-            logits = models.policy_logits(params, obs)
-            if spec.discrete:
-                return jnp.argmax(logits, axis=-1)
-            return logits
-
-        self._act = act
-
-    def set_filter(self, mean: np.ndarray, var: np.ndarray) -> None:
-        self._mean = np.asarray(mean, dtype=np.float64)
-        self._var = np.asarray(var, dtype=np.float64)
-
-    def pop_filter_delta(self) -> np.ndarray:
-        out, self._delta = self._delta, np.zeros_like(self._delta)
-        return out
-
-    def _norm(self, obs: np.ndarray) -> np.ndarray:
-        if not self._normalize:
-            return obs
-        self._delta[0] += 1.0
-        self._delta[1] += obs[0]
-        self._delta[2] += obs[0] ** 2
-        return ((obs - self._mean)
-                / np.sqrt(self._var + 1e-8)).astype(np.float32)
-
-    def _episode_return(self, params) -> Tuple[float, int]:
-        obs = self._env.reset()
-        total, steps = 0.0, 0
-        for _ in range(self._max_len):
-            a = np.asarray(self._act(params, self._norm(obs)))
-            if not self.spec.discrete:
-                a = np.clip(a, self.spec.action_low, self.spec.action_high)
-            obs, r, d = self._env.step(a)
-            total += float(r[0])
-            steps += 1
-            if d[0]:
-                break
-        return total, steps
-
-    def episode_return(self, flat: np.ndarray) -> Tuple[float, int]:
-        """One episode at exactly these (unperturbed) parameters."""
-        return self._episode_return(
-            _unflatten(np.asarray(flat), self._meta))
-
-    def evaluate(self, flat_center: np.ndarray, noise_seed: int,
-                 episodes: int) -> Tuple[float, float, int]:
-        center = np.asarray(flat_center)
-        eps = _noise(noise_seed, len(center), self._std)
-        steps = 0
-        pos_r, neg_r = [], []
-        for _ in range(episodes):
-            r, n = self._episode_return(
-                _unflatten(center + eps, self._meta))
-            pos_r.append(r)
-            steps += n
-            r, n = self._episode_return(
-                _unflatten(center - eps, self._meta))
-            neg_r.append(r)
-            steps += n
-        return float(np.mean(pos_r)), float(np.mean(neg_r)), steps
-
-
-class ARS(Algorithm):
-    need_env_runners = False  # whole-episode eval fleet instead
+class ARS(ES):
+    """ES fleet + top-direction selection + fleet-synced obs filter."""
 
     @classmethod
     def get_default_config(cls) -> AlgorithmConfig:
         return ARSConfig()
 
     def build_learner(self) -> None:
-        import jax
-
-        cfg = self.config
-        params = models.init_policy(jax.random.key(cfg.seed), self.spec,
-                                    cfg.hidden)
-        self._center, self._meta = _flatten(params)
-        n_workers = max(1, cfg.num_env_runners)
-        self._workers = [
-            _ARSWorker.options(num_cpus=cfg.num_cpus_per_runner).remote(
-                cfg.env, cfg.env_config, cfg.seed + 7919 * i, cfg.hidden,
-                cfg.noise_std, cfg.max_episode_len, cfg.normalize_obs)
-            for i in range(n_workers)
-        ]
-        self._rng = np.random.default_rng(cfg.seed)
+        super().build_learner()
         d = self.spec.obs_dim
         self._f_count = 1e-4
         self._f_sum = np.zeros(d, dtype=np.float64)
         self._f_sumsq = np.ones(d, dtype=np.float64) * 1e-4
-        self.learner = self
 
-    def get_params(self):
-        return _unflatten(self._center, self._meta)
-
-    def set_params(self, params) -> None:
-        self._center, self._meta = _flatten(params)
+    # -- obs-filter state (checkpointed; reference: ARS's shared
+    # MeanStdFilter snapshot) --------------------------------------------
 
     def get_extra_state(self):
         return {"count": self._f_count, "sum": self._f_sum,
@@ -186,14 +64,16 @@ class ARS(Algorithm):
         ray_tpu.get([w.set_filter.remote(mean, var)
                      for w in self._workers])
 
-    def evaluate(self, num_episodes: int = 10) -> Dict[str, Any]:
-        """Whole episodes at the unperturbed center parameters."""
-        refs = [self._workers[i % len(self._workers)]
-                .episode_return.remote(self._center)
-                for i in range(num_episodes)]
-        rets = [r[0] for r in ray_tpu.get(refs)]
-        return {"episodes": num_episodes,
-                "episode_return_mean": float(np.mean(rets))}
+    def _merge_filter_deltas(self) -> None:
+        deltas = ray_tpu.get([w.pop_filter_delta.remote()
+                              for w in self._workers])
+        for dlt in deltas:
+            self._f_count += float(dlt[0][0])
+            self._f_sum += dlt[1]
+            self._f_sumsq += dlt[2]
+        self._broadcast_filter()
+
+    # -- the ARS update ---------------------------------------------------
 
     def training_step(self) -> Dict[str, Any]:
         cfg = self.config
@@ -222,15 +102,8 @@ class ARS(Algorithm):
         # unit-direction space as in the paper
         self._center = self._center \
             + cfg.lr / (b * sigma_r * cfg.noise_std) * grad
-        # merge + re-broadcast the fleet's obs-filter deltas
         if cfg.normalize_obs:
-            deltas = ray_tpu.get([w.pop_filter_delta.remote()
-                                  for w in self._workers])
-            for dlt in deltas:
-                self._f_count += float(dlt[0][0])
-                self._f_sum += dlt[1]
-                self._f_sumsq += dlt[2]
-            self._broadcast_filter()
+            self._merge_filter_deltas()
         self._env_steps_total += int(sum(r[2] for r in results))
         all_r = np.concatenate([pos, neg])
         return {

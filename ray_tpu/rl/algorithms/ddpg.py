@@ -130,6 +130,11 @@ class DDPG(Algorithm):
         return {"pi": p["pi"], "vf": vf,
                 "log_std": jnp.full((adim,), float(np.log(sigma)))}
 
+    def _eval_params(self):
+        """Deterministic actor (exploration noise ~0) for evaluate."""
+        return {**self._runner_params(),
+                "log_std": jnp.full((self.spec.action_dim,), -20.0)}
+
     def training_step(self) -> Dict[str, Any]:
         cfg = self.config
         batch = self.synchronous_sample(self._runner_params())

@@ -89,6 +89,10 @@ class DQN(Algorithm):
         return {"pi": p["q"]["pi"], "vf": p["q"]["vf"],
                 "epsilon": jnp.asarray(self._epsilon())}
 
+    def _eval_params(self):
+        """Greedy Q-policy (epsilon off) for Algorithm.evaluate."""
+        return {**self._runner_params(), "epsilon": jnp.asarray(0.0)}
+
     def training_step(self) -> Dict[str, Any]:
         cfg = self.config
         batch = self.synchronous_sample(self._runner_params())

@@ -67,10 +67,15 @@ def _centered_ranks(x: np.ndarray) -> np.ndarray:
 
 @ray_tpu.remote
 class _ESWorker:
-    """Evaluates perturbed policies for whole episodes."""
+    """Evaluates policies for whole episodes, optionally normalizing
+    observations with a fleet-shared running filter (the ARS-V2
+    augmentation; ES runs with ``normalize_obs=False``). Filter deltas
+    are popped by the driver, merged, and the global mean/var pushed
+    back so every worker normalizes with fleet-wide statistics."""
 
     def __init__(self, env_name: str, env_config: Dict, seed: int,
-                 hidden, noise_std: float, max_len: int):
+                 hidden, noise_std: float, max_len: int,
+                 normalize_obs: bool = False):
         import jax
 
         from ray_tpu.rl.env import make_env
@@ -79,8 +84,14 @@ class _ESWorker:
         self.spec = self._env.spec
         self._std = noise_std
         self._max_len = max_len
+        self._normalize = normalize_obs
         base = models.init_policy(jax.random.key(0), self.spec, hidden)
         _, self._meta = _flatten(base)
+        d = self.spec.obs_dim
+        # global filter (mean/var used to normalize) + local delta
+        self._mean = np.zeros(d, dtype=np.float64)
+        self._var = np.ones(d, dtype=np.float64)
+        self._delta = np.zeros((3, d), dtype=np.float64)  # count,sum,sumsq
 
         import jax.numpy as jnp
 
@@ -95,12 +106,29 @@ class _ESWorker:
 
         self._act = act
 
+    def set_filter(self, mean: np.ndarray, var: np.ndarray) -> None:
+        self._mean = np.asarray(mean, dtype=np.float64)
+        self._var = np.asarray(var, dtype=np.float64)
+
+    def pop_filter_delta(self) -> np.ndarray:
+        out, self._delta = self._delta, np.zeros_like(self._delta)
+        return out
+
+    def _norm(self, obs: np.ndarray) -> np.ndarray:
+        if not self._normalize:
+            return obs
+        self._delta[0] += 1.0
+        self._delta[1] += obs[0]
+        self._delta[2] += obs[0] ** 2
+        return ((obs - self._mean)
+                / np.sqrt(self._var + 1e-8)).astype(np.float32)
+
     def episode_return(self, flat: np.ndarray) -> Tuple[float, int]:
         params = _unflatten(np.asarray(flat), self._meta)
         obs = self._env.reset()
         total, steps = 0.0, 0
         for _ in range(self._max_len):
-            a = np.asarray(self._act(params, obs))
+            a = np.asarray(self._act(params, self._norm(obs)))
             if not self.spec.discrete:
                 a = np.clip(a, self.spec.action_low, self.spec.action_high)
             obs, r, d = self._env.step(a)
@@ -146,7 +174,8 @@ class ES(Algorithm):
         self._workers = [
             _ESWorker.options(num_cpus=cfg.num_cpus_per_runner).remote(
                 cfg.env, cfg.env_config, cfg.seed + 7919 * i, cfg.hidden,
-                cfg.noise_std, cfg.max_episode_len)
+                cfg.noise_std, cfg.max_episode_len,
+                getattr(cfg, "normalize_obs", False))
             for i in range(n_workers)
         ]
         self._rng = np.random.default_rng(cfg.seed)

@@ -155,6 +155,11 @@ class SAC(Algorithm):
         # moderate fixed exploration std
         return {"pi": pi, "vf": vf, "log_std": jnp.zeros(adim) - 0.5}
 
+    def _eval_params(self):
+        """Mean action (std ~0) for Algorithm.evaluate."""
+        p = self._runner_params()
+        return {**p, "log_std": jnp.zeros(self.spec.action_dim) - 20.0}
+
     def training_step(self) -> Dict[str, Any]:
         cfg = self.config
         batch = self.synchronous_sample(self._runner_params())

@@ -91,16 +91,24 @@ def run_train(run: str, env: Optional[str] = None,
             t0 = time.monotonic()
             result = algo.train()
             dt = time.monotonic() - t0
-            reward = result.get("episode_return_mean",
-                                result.get("mean_return",
-                                           result.get("reward_mean_per_step",
-                                                      float("nan"))))
+            # display metric: best-effort fallback chain
+            shown = result.get("episode_return_mean",
+                               result.get("mean_return",
+                                          result.get("reward_mean_per_step",
+                                                     float("nan"))))
             steps = result.get("env_steps_total", 0)
-            print(f"iter {i + 1}/{stop_iters}  reward={reward:.2f}  "
+            print(f"iter {i + 1}/{stop_iters}  reward={shown:.2f}  "
                   f"env_steps={steps}  {dt:.1f}s", file=out, flush=True)
-            if stop_reward is not None and np.isfinite(reward) \
-                    and reward >= stop_reward:
-                print(f"stop: reward {reward:.2f} >= {stop_reward}",
+            # stop metric: episode-return semantics only (mean_return for
+            # the population-based algos, which never report episodes) —
+            # never the per-step reward, whose scale is episode-length
+            # smaller and would fire a threshold meant for episode returns
+            stop_metric = result.get("episode_return_mean",
+                                     result.get("mean_return"))
+            if stop_reward is not None and stop_metric is not None \
+                    and np.isfinite(stop_metric) \
+                    and stop_metric >= stop_reward:
+                print(f"stop: reward {stop_metric:.2f} >= {stop_reward}",
                       file=out)
                 break
             if stop_timesteps is not None and steps >= stop_timesteps:

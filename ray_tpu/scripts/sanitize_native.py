@@ -1,0 +1,144 @@
+"""ASAN/UBSAN harness for the rt_native C extension.
+
+Reference analog: the bazel ``--config=asan`` / ``--config=tsan`` CI
+builds exercised over ``src/ray`` (SURVEY.md §4 sanitizers row). Here the
+native surface is one translation unit, so the harness (1) rebuilds it
+with ``-fsanitize=address,undefined -fno-sanitize-recover=all``, then (2)
+runs a worst-case exercise of every export in a subprocess with libasan
+preloaded (CPython itself isn't instrumented, so the runtime library must
+be LD_PRELOADed; leak detection is off because the interpreter's own
+arena allocations would drown real reports).
+
+Run: ``python -m ray_tpu.scripts.sanitize_native`` — exits nonzero on any
+sanitizer report or smoke failure. Wired as a slow-marked test in
+``tests/test_sanitize_native.py``.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import sysconfig
+import tempfile
+
+_SMOKE = r"""
+import importlib.util
+import os
+import sys
+
+so, workdir = sys.argv[1], sys.argv[2]
+# the spec name must match the extension's PyInit_rt_native symbol
+spec = importlib.util.spec_from_file_location("rt_native", so)
+mod = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(mod)
+
+# -- crc32c: empty / tiny / unaligned views / large ---------------------
+assert mod.crc32c(b"") == 0
+big = os.urandom(1 << 20)
+full = mod.crc32c(big)
+# incremental == one-shot (exercises the init path)
+half = mod.crc32c(big[: 1 << 19])
+assert mod.crc32c(big[1 << 19:], half) == full
+for off in range(1, 9):  # unaligned starts
+    mod.crc32c(memoryview(big)[off:])
+assert mod.crc32c(b"123456789") == 0xE3069283  # published check value
+
+# -- memory_info / process probes ---------------------------------------
+info = mod.memory_info()
+assert info["total"] > 0 and 0 <= info["used"] <= info["total"]
+assert mod.process_rss(os.getpid()) > 0
+mod.process_rss(99999999)  # nonexistent pid must not crash
+mems = mod.process_memory([os.getpid(), 99999999])
+assert any(p == os.getpid() and rss > 0 for p, rss in mems)
+
+# -- LogKV lifecycle: put/get/delete/compact/replay ---------------------
+path = os.path.join(workdir, "kv.log")
+kv = mod.LogKV(path)
+vals = {}
+for i in range(500):
+    k = f"key-{i % 97}"
+    v = os.urandom(1 + (i * 37) % 4096)
+    kv.put(k, v)
+    vals[k] = v
+for i in range(0, 97, 3):
+    kv.delete(f"key-{i}")
+    vals.pop(f"key-{i}", None)
+kv.sync()
+assert sorted(kv.keys()) == sorted(vals)
+for k, v in vals.items():
+    assert kv.get(k) == v
+kv.compact()
+assert sorted(kv.keys()) == sorted(vals)
+kv.close()
+
+# reopen replays the compacted log
+kv2 = mod.LogKV(path)
+assert sorted(kv2.keys()) == sorted(vals)
+kv2.close()
+
+# torn tail: truncate mid-record, replay must stop cleanly, and the next
+# append must recover the file
+with open(path, "rb") as f:
+    data = f.read()
+with open(path, "wb") as f:
+    f.write(data[: len(data) - 7])
+kv3 = mod.LogKV(path)
+kv3.put("after-torn", b"x" * 128)
+assert kv3.get("after-torn") == b"x" * 128
+kv3.close()
+print("SMOKE_OK")
+"""
+
+
+def run(verbose: bool = True) -> int:
+    from ray_tpu._native.build import SRC
+
+    import shutil
+
+    if shutil.which("g++") is None:
+        print("sanitize_native: g++ unavailable; skipping",
+              file=sys.stderr)
+        return 0
+    libasan = subprocess.run(
+        ["g++", "-print-file-name=libasan.so"],
+        capture_output=True, text=True).stdout.strip()
+    if not os.path.isabs(libasan):
+        print("sanitize_native: g++/libasan unavailable; skipping",
+              file=sys.stderr)
+        return 0
+
+    with tempfile.TemporaryDirectory(prefix="rt_sanitize_") as tmp:
+        so = os.path.join(tmp, "rt_native_asan.so")
+        include = sysconfig.get_paths()["include"]
+        cmd = ["g++", "-O1", "-g", "-std=c++17", "-fPIC", "-shared",
+               "-Wall", "-fsanitize=address,undefined",
+               "-fno-sanitize-recover=all", f"-I{include}", SRC, "-o", so]
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+        if proc.returncode != 0:
+            print(f"sanitized build failed:\n{proc.stderr[-2000:]}",
+                  file=sys.stderr)
+            return 1
+        env = dict(os.environ)
+        env["LD_PRELOAD"] = libasan
+        env["ASAN_OPTIONS"] = ("detect_leaks=0:abort_on_error=1:"
+                               "allocator_may_return_null=1")
+        env["UBSAN_OPTIONS"] = "print_stacktrace=1:halt_on_error=1"
+        proc = subprocess.run(
+            [sys.executable, "-c", _SMOKE, so, tmp],
+            capture_output=True, text=True, env=env, timeout=300)
+        report = proc.stdout + proc.stderr
+        failed = (proc.returncode != 0 or "SMOKE_OK" not in proc.stdout
+                  or "ERROR: AddressSanitizer" in report
+                  or "runtime error" in report)
+        if failed or verbose:
+            print(report[-4000:], file=sys.stderr if failed else sys.stdout)
+        if failed:
+            print("sanitize_native: FAILED", file=sys.stderr)
+            return 1
+        print("sanitize_native: OK (asan+ubsan clean)")
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(run())

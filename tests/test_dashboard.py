@@ -65,6 +65,25 @@ def test_dashboard_rest_endpoints(rt_cluster):
         assert r.status == 200  # prometheus page renders (may be empty)
 
 
+def test_dashboard_ui_page(rt_cluster):
+    """GET / serves the browser UI (reference: ``dashboard/client/``):
+    a self-contained page wired to the same /api/* endpoints."""
+    from ray_tpu.dashboard import start_dashboard
+
+    port = start_dashboard()
+    req = urllib.request.urlopen(f"http://127.0.0.1:{port}/", timeout=30)
+    assert req.headers.get_content_type() == "text/html"
+    html = req.read().decode()
+    # the page consumes the REST surface this same head serves
+    for api in ("/api/nodes", "/api/actors", "/api/jobs",
+                "/api/cluster_resources", "/api/serve/applications"):
+        assert api in html, api
+    # zero-egress: no external scripts/styles/fonts
+    assert "http://" not in html.replace("http://127.0.0.1", "")
+    assert "https://" not in html
+    assert "<script src" not in html and "link rel" not in html
+
+
 def test_timeline_export(rt_cluster, tmp_path):
     from ray_tpu.util.timeline import timeline
 
