@@ -199,7 +199,8 @@ const COLS = {
     ["Message", r => `<td>${esc(r.message || "")}</td>`],
   ],
   placement_groups: [
-    ["Group", r => `<td class="id">${esc(r.placement_group_id)}</td>`],
+    ["Group", r => `<td class="id">${esc(r.pg_id
+                                         || r.placement_group_id)}</td>`],
     ["Name", r => `<td>${esc(r.name || "")}</td>`],
     ["Strategy", r => `<td>${esc(r.strategy || "")}</td>`],
     ["State", r => `<td>${statusCell(r.state)}</td>`],
@@ -220,8 +221,8 @@ const COLS = {
   objects: [
     ["Object", r => `<td class="id">${esc(r.object_id)}</td>`],
     ["Size", r => `<td>${esc(r.size ?? "")}</td>`],
-    ["Where", r => `<td>${esc(r.node_id || r.location || "")}</td>`],
-    ["Spilled", r => `<td>${r.spilled ? "yes" : ""}</td>`],
+    ["Locations", r => `<td class="id">${esc(
+      (r.locations || []).join(" "))}</td>`],
   ],
 };
 

@@ -40,6 +40,9 @@ from ray_tpu.rl.algorithms import (  # noqa: F401
     PPOConfig,
     QMIX,
     QMIXConfig,
+    R2D2,
+    R2D2Config,
+    MaskedCartPole,
     SAC,
     SACConfig,
     TD3,

@@ -235,8 +235,10 @@ class Algorithm(Trainable):
             # connector (obs-filter) statistics
             self._env_steps_total = steps_before
             self._return_window = saved_window
-            if self._conn_pipeline is not None \
-                    and saved_conn is not None:
+            if self._conn_pipeline is not None:
+                # unconditional: saved_conn=None (evaluate before any
+                # train) must also roll the fleet back — set_globals(None)
+                # resets every stage to pristine statistics
                 self._connector_state = saved_conn
                 ray_tpu.get([
                     r.set_connector_globals.remote(saved_conn)

@@ -32,4 +32,9 @@ from ray_tpu.rl.algorithms.offline import (  # noqa: F401
 )
 from ray_tpu.rl.algorithms.ppo import PPO, PPOConfig  # noqa: F401
 from ray_tpu.rl.algorithms.qmix import QMIX, QMIXConfig  # noqa: F401
+from ray_tpu.rl.algorithms.r2d2 import (  # noqa: F401
+    MaskedCartPole,
+    R2D2,
+    R2D2Config,
+)
 from ray_tpu.rl.algorithms.sac import SAC, SACConfig  # noqa: F401

@@ -188,11 +188,14 @@ class ES(Algorithm):
         self._center, self._meta = _flatten(params)
 
     def evaluate(self, num_episodes: int = 10) -> Dict[str, Any]:
-        """Whole episodes at the unperturbed center parameters."""
+        """Whole episodes at the unperturbed center parameters. Discards
+        the workers' obs-filter deltas afterward so evaluation episodes
+        never shift ARS's fleet normalization statistics."""
         refs = [self._workers[i % len(self._workers)]
                 .episode_return.remote(self._center)
                 for i in range(num_episodes)]
         rets = [r[0] for r in ray_tpu.get(refs)]
+        ray_tpu.get([w.pop_filter_delta.remote() for w in self._workers])
         return {"episodes": num_episodes,
                 "episode_return_mean": float(np.mean(rets))}
 

@@ -1,0 +1,344 @@
+"""R2D2: recurrent-replay DQN (Kapturowski et al. 2019).
+
+Reference analog: ``rllib/algorithms/r2d2/``. A GRU Q-network is trained
+on replayed SEQUENCES instead of transitions: each stored sequence
+carries the recurrent state at its start (the paper's "stored state"
+strategy), the first ``burn_in`` steps warm the state without
+contributing loss, and the remaining steps take double-Q TD updates
+unrolled with ``lax.scan``. Sequences are chopped at episode boundaries
+and padded with a validity mask.
+
+Runs in-process (the feedforward EnvRunner protocol can't carry hidden
+state); the bundled partially-observable env — CartPole with velocities
+masked out (``CartPoleNoVel-v0``) — is unsolvable by a memoryless policy
+beyond the random baseline, which is what the convergence test exploits.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.rl import models
+from ray_tpu.rl.config import AlgorithmConfig
+from ray_tpu.rl.env import CartPole, EnvSpec, VectorEnv, register_env
+from ray_tpu.rl.learner import Learner
+from ray_tpu.rl.replay_buffer import ReplayBuffer
+from ray_tpu.tune.trainable import Trainable
+
+
+class MaskedCartPole(VectorEnv):
+    """CartPole with only the position components observable (cart x,
+    pole angle) — velocity must be inferred from memory."""
+
+    _KEEP = np.array([0, 2])  # x, theta
+
+    def __init__(self, num_envs: int, seed: int = 0):
+        self._inner = CartPole(num_envs, seed=seed)
+        self.num_envs = num_envs
+        self.spec = EnvSpec(obs_dim=2, num_actions=2)
+
+    def reset(self) -> np.ndarray:
+        return self._inner.reset()[:, self._KEEP]
+
+    def step(self, actions):
+        obs, r, d = self._inner.step(actions)
+        return obs[:, self._KEEP], r, d
+
+
+register_env("CartPoleNoVel-v0",
+             lambda c: MaskedCartPole(c["num_envs"], seed=c.get("seed", 0)))
+
+
+# ---------------------------------------------------------------- GRU ----
+
+def init_gru(key, in_dim: int, hidden: int) -> Dict:
+    kx, kh = jax.random.split(key)
+    s_x = 1.0 / np.sqrt(in_dim)
+    s_h = 1.0 / np.sqrt(hidden)
+    return {
+        "wx": jax.random.uniform(kx, (in_dim, 3 * hidden), minval=-s_x,
+                                 maxval=s_x),
+        "wh": jax.random.uniform(kh, (hidden, 3 * hidden), minval=-s_h,
+                                 maxval=s_h),
+        "b": jnp.zeros(3 * hidden),
+    }
+
+
+def gru_step(p: Dict, h: jnp.ndarray, x: jnp.ndarray) -> jnp.ndarray:
+    """One GRU step: h [B, H], x [B, E] -> h' [B, H]."""
+    gx = x @ p["wx"] + p["b"]
+    gh = h @ p["wh"]
+    xr, xz, xn = jnp.split(gx, 3, axis=-1)
+    hr, hz, hn = jnp.split(gh, 3, axis=-1)
+    r = jax.nn.sigmoid(xr + hr)
+    z = jax.nn.sigmoid(xz + hz)
+    n = jnp.tanh(xn + r * hn)
+    return (1.0 - z) * n + z * h
+
+
+class R2D2Config(AlgorithmConfig):
+    def __init__(self, **kwargs):
+        super().__init__(algo_class=R2D2, **kwargs)
+        self.env = "CartPoleNoVel-v0"
+        self.lr = 5e-4
+        self.hidden = (64,)          # obs encoder widths
+        self.gru_hidden = 128
+        self.seq_len = 16            # stored sequence length
+        self.burn_in = 4             # warm-up steps without loss
+        self.buffer_size = 4_000     # in sequences
+        self.learning_starts = 64    # sequences before training
+        self.minibatch_size = 64     # sequences per update
+        self.target_update_freq = 200
+        self.updates_per_iter = 32
+        self.epsilon_initial = 1.0
+        self.epsilon_final = 0.05
+        self.epsilon_decay_steps = 30_000
+
+
+class R2D2(Trainable):
+    @classmethod
+    def get_default_config(cls) -> AlgorithmConfig:
+        return R2D2Config()
+
+    def setup(self, config: Dict[str, Any]) -> None:
+        if "__algo_config" in config:
+            self.config: AlgorithmConfig = config["__algo_config"]
+        else:
+            self.config = R2D2Config().update_from_dict(config)
+        cfg = self.config
+        from ray_tpu.rl.env import make_env
+
+        self.env = make_env(cfg.env, cfg.num_envs_per_runner,
+                            cfg.env_config, seed=cfg.seed)
+        spec = self.env.spec
+        if not spec.discrete:
+            raise ValueError("R2D2 requires discrete actions")
+        self._A = spec.num_actions
+        H = cfg.gru_hidden
+        k_enc, k_gru, k_head = jax.random.split(jax.random.key(cfg.seed), 3)
+        enc_dims = (spec.obs_dim,) + tuple(cfg.hidden)
+        net = {
+            "enc": models.init_mlp(k_enc, enc_dims, out_scale=1.0),
+            "gru": init_gru(k_gru, enc_dims[-1], H),
+            "head": models.init_mlp(k_head, (H, self._A)),
+        }
+        params = {"q": net,
+                  "target": jax.tree_util.tree_map(jnp.array, net)}
+        gamma, burn_in = cfg.gamma, cfg.burn_in
+
+        def unroll(net_p, obs_seq, h0):
+            """obs [B, L, D], h0 [B, H] -> q [B, L, A] via scan over L."""
+            emb = jnp.tanh(models.mlp_forward(net_p["enc"], obs_seq))
+
+            def step(h, x):
+                h2 = gru_step(net_p["gru"], h, x)
+                return h2, h2
+
+            _, hs = jax.lax.scan(step, h0,
+                                 jnp.swapaxes(emb, 0, 1))  # [L, B, H]
+            hs = jnp.swapaxes(hs, 0, 1)                    # [B, L, H]
+            return models.mlp_forward(net_p["head"], hs)
+
+        self._unroll = jax.jit(
+            lambda net_p, obs_seq, h0: unroll(net_p, obs_seq, h0))
+
+        def loss_fn(p, batch, key):
+            del key
+            q = unroll(p["q"], batch["obs"], batch["h0"])       # [B,L,A]
+            q_tgt = unroll(p["target"], batch["obs"], batch["h0"])
+            q_taken = jnp.take_along_axis(
+                q, batch["actions"][..., None].astype(jnp.int32),
+                axis=-1)[..., 0]                                # [B,L]
+            # double-Q: next-step argmax from the online net, value from
+            # the target net; shift left to align t -> t+1 (pad zeros)
+            best_next = jnp.argmax(q, axis=-1)                  # [B,L]
+            q_next = jnp.take_along_axis(
+                q_tgt, best_next[..., None], axis=-1)[..., 0]
+            q_next = jnp.concatenate(
+                [q_next[:, 1:], jnp.zeros_like(q_next[:, :1])], axis=1)
+            nonterminal = 1.0 - batch["dones"]
+            target = batch["rewards"] + gamma * nonterminal \
+                * jax.lax.stop_gradient(q_next)
+            td = q_taken - target
+            # loss only on trainable steps: valid, past the burn-in, and
+            # either terminal (no bootstrap needed) or followed by a
+            # valid step (bootstrap available)
+            valid = batch["valid"]
+            next_valid = jnp.concatenate(
+                [valid[:, 1:], jnp.zeros_like(valid[:, :1])], axis=1)
+            L = valid.shape[1]
+            past_burn = (jnp.arange(L)[None, :] >= burn_in)
+            mask = valid * jnp.maximum(batch["dones"], next_valid) \
+                * past_burn
+            denom = jnp.maximum(jnp.sum(mask), 1.0)
+            loss = jnp.sum(mask * td ** 2) / denom
+            return loss, {"td_abs_mean": jnp.sum(mask * jnp.abs(td))
+                          / denom,
+                          "q_mean": jnp.sum(mask * q_taken) / denom}
+
+        self.learner = Learner(params, loss_fn, cfg.lr,
+                               grad_clip=cfg.grad_clip, seed=cfg.seed)
+
+        @jax.jit
+        def act_q(net_p, h, obs):
+            emb = jnp.tanh(models.mlp_forward(net_p["enc"], obs))
+            h2 = gru_step(net_p["gru"], h, emb)
+            return h2, models.mlp_forward(net_p["head"], h2)
+
+        self._act_q = act_q
+        self.buffer = ReplayBuffer(cfg.buffer_size, seed=cfg.seed)
+        self._rng = np.random.default_rng(cfg.seed)
+        N = self.env.num_envs
+        self._obs = self.env.reset()
+        self._h = np.zeros((N, H), dtype=np.float32)
+        # per-env open sequence accumulators
+        self._open: List[Dict[str, list]] = [self._new_seq(i)
+                                             for i in range(N)]
+        self._env_steps_total = 0
+        self._grad_updates = 0
+        self._ep_return = np.zeros(N)
+        self._return_window: List[float] = []
+
+    # -- sequence bookkeeping ---------------------------------------------
+
+    def _new_seq(self, env_i: int) -> Dict[str, Any]:
+        return {"h0": self._h[env_i].copy(), "obs": [], "actions": [],
+                "rewards": [], "dones": []}
+
+    def _flush_seq(self, env_i: int) -> None:
+        cfg = self.config
+        seq = self._open[env_i]
+        t = len(seq["obs"])
+        if t == 0:
+            self._open[env_i] = self._new_seq(env_i)
+            return
+        L, D = cfg.seq_len, self.env.spec.obs_dim
+        obs = np.zeros((L, D), dtype=np.float32)
+        obs[:t] = np.stack(seq["obs"])
+        acts = np.zeros(L, dtype=np.int64)
+        acts[:t] = seq["actions"]
+        rews = np.zeros(L, dtype=np.float32)
+        rews[:t] = seq["rewards"]
+        dones = np.zeros(L, dtype=np.float32)
+        dones[:t] = seq["dones"]
+        valid = np.zeros(L, dtype=np.float32)
+        valid[:t] = 1.0
+        self.buffer.add_batch({
+            "obs": obs[None], "actions": acts[None], "rewards": rews[None],
+            "dones": dones[None], "valid": valid[None],
+            "h0": seq["h0"][None]})
+        self._open[env_i] = self._new_seq(env_i)
+
+    @property
+    def _epsilon(self) -> float:
+        cfg = self.config
+        frac = min(1.0, self._env_steps_total
+                   / max(1, cfg.epsilon_decay_steps))
+        return cfg.epsilon_initial \
+            + frac * (cfg.epsilon_final - cfg.epsilon_initial)
+
+    def _collect(self, steps: int) -> None:
+        cfg = self.config
+        N = self.env.num_envs
+        net = self.learner.get_params()["q"]
+        for _ in range(steps):
+            h2, q = self._act_q(net, jnp.asarray(self._h),
+                                jnp.asarray(self._obs))
+            # np.array (copy): device arrays surface as read-only views
+            h2, q = np.array(h2), np.asarray(q)
+            greedy = np.argmax(q, axis=-1)
+            explore = self._rng.random(N) < self._epsilon
+            rand = self._rng.integers(0, self._A, N)
+            acts = np.where(explore, rand, greedy).astype(np.int64)
+            next_obs, rewards, dones = self.env.step(acts)
+            for i in range(N):
+                seq = self._open[i]
+                seq["obs"].append(self._obs[i])
+                seq["actions"].append(acts[i])
+                seq["rewards"].append(rewards[i])
+                seq["dones"].append(float(dones[i]))
+                if dones[i] or len(seq["obs"]) >= cfg.seq_len:
+                    if dones[i]:
+                        h2[i] = 0.0  # episode boundary resets the state
+                    # flush BEFORE updating self._h so a length-cut
+                    # sequence's successor stores the carried state
+                    self._h[i] = h2[i]
+                    self._flush_seq(i)
+                else:
+                    self._h[i] = h2[i]
+            self._ep_return += rewards
+            for i in np.nonzero(dones)[0]:
+                self._return_window.append(float(self._ep_return[i]))
+                self._ep_return[i] = 0.0
+            self._obs = next_obs
+            self._env_steps_total += N
+        self._return_window = self._return_window[-100:]
+
+    # -- Trainable API ----------------------------------------------------
+
+    def step(self) -> Dict[str, Any]:
+        cfg = self.config
+        self._collect(cfg.rollout_fragment_length)
+        metrics: Dict[str, Any] = {"epsilon": self._epsilon,
+                                   "buffer_sequences": len(self.buffer)}
+        if len(self.buffer) >= cfg.learning_starts:
+            mlist = []
+            for _ in range(cfg.updates_per_iter or 1):
+                mb = self.buffer.sample(cfg.minibatch_size)
+                mlist.append(self.learner.update_minibatch(mb))
+                self._grad_updates += 1
+                if self._grad_updates % cfg.target_update_freq == 0:
+                    p = dict(self.learner.get_params())
+                    p["target"] = jax.tree_util.tree_map(
+                        jnp.array, p["q"])
+                    self.learner.set_params(p)
+            for k in mlist[0]:
+                metrics[k] = float(np.mean([float(m[k]) for m in mlist]))
+        metrics["env_steps_total"] = self._env_steps_total
+        if self._return_window:
+            metrics["episode_return_mean"] = float(
+                np.mean(self._return_window))
+        return metrics
+
+    def evaluate(self, num_episodes: int = 10) -> Dict[str, Any]:
+        """Greedy episodes on a fresh env with a fresh recurrent state."""
+        from ray_tpu.rl.env import make_env
+
+        cfg = self.config
+        env = make_env(cfg.env, cfg.num_envs_per_runner, cfg.env_config,
+                       seed=cfg.seed + 991)
+        N, H = env.num_envs, cfg.gru_hidden
+        net = self.learner.get_params()["q"]
+        obs = env.reset()
+        h = np.zeros((N, H), dtype=np.float32)
+        ep_ret = np.zeros(N)
+        returns: List[float] = []
+        for _ in range(4096):
+            h2, q = self._act_q(net, jnp.asarray(h), jnp.asarray(obs))
+            h, q = np.array(h2), np.asarray(q)
+            obs, r, d = env.step(np.argmax(q, axis=-1).astype(np.int64))
+            ep_ret += r
+            for i in np.nonzero(d)[0]:
+                returns.append(float(ep_ret[i]))
+                ep_ret[i] = 0.0
+                h[i] = 0.0
+            if len(returns) >= num_episodes:
+                break
+        return {"episodes": len(returns),
+                "episode_return_mean": float(np.mean(returns))
+                if returns else float("nan")}
+
+    # -- checkpointing ----------------------------------------------------
+
+    def save_checkpoint(self, checkpoint_dir: str) -> Optional[Dict]:
+        return {"params": jax.tree_util.tree_map(
+            np.asarray, self.learner.get_params()),
+            "env_steps_total": self._env_steps_total}
+
+    def load_checkpoint(self, checkpoint: Dict) -> None:
+        self.learner.set_params(checkpoint["params"])
+        self._env_steps_total = checkpoint.get("env_steps_total", 0)

@@ -32,6 +32,7 @@ def algorithm_registry() -> Dict[str, type]:
         "BC": rl.BCConfig, "MARWIL": rl.MARWILConfig,
         "CQL": rl.CQLConfig, "ES": rl.ESConfig, "ARS": rl.ARSConfig,
         "QMIX": rl.QMIXConfig, "ALPHAZERO": rl.AlphaZeroConfig,
+        "R2D2": rl.R2D2Config,
         "BANDITLINUCB": rl.BanditConfig, "BANDITLINTS": rl.BanditConfig,
     }
 

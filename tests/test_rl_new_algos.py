@@ -150,6 +150,61 @@ def test_qmix_learns_coordination(rl_cluster):
     algo.load_checkpoint(ckpt)
 
 
+# ------------------------------------------------------------------ R2D2 --
+
+def test_masked_cartpole_hides_velocity():
+    env = rl.MaskedCartPole(4, seed=0)
+    assert env.spec.obs_dim == 2
+    obs = env.reset()
+    assert obs.shape == (4, 2)
+    o2, r, d = env.step(np.zeros(4, dtype=np.int64))
+    assert o2.shape == (4, 2) and r.shape == (4,)
+
+
+def test_r2d2_gru_and_sequence_machinery():
+    """Smoke: sequences flush at episode boundaries and length cuts,
+    the stored h0 rides replay, and the loss masks padding."""
+    cfg = rl.R2D2Config()
+    cfg.num_envs_per_runner = 4
+    cfg.rollout_fragment_length = 48
+    cfg.seq_len = 8
+    cfg.burn_in = 2
+    cfg.learning_starts = 8
+    cfg.updates_per_iter = 4
+    algo = rl.R2D2({"__algo_config": cfg})
+    m = algo.step()
+    assert m["buffer_sequences"] >= 8
+    assert "td_abs_mean" in m and np.isfinite(m["td_abs_mean"])
+    # stored sequences carry the right shapes
+    mb = algo.buffer.sample(4)
+    assert mb["obs"].shape == (4, 8, 2)
+    assert mb["h0"].shape == (4, cfg.gru_hidden)
+    assert set(np.unique(mb["valid"])) <= {0.0, 1.0}
+    # evaluate is greedy + fresh state, and round-trips a checkpoint
+    ev = algo.evaluate(num_episodes=2)
+    assert ev["episodes"] >= 2
+    ckpt = algo.save_checkpoint("")
+    algo.load_checkpoint(ckpt)
+
+
+@pytest.mark.slow
+def test_r2d2_learns_masked_cartpole():
+    """Memoryless policies plateau ~40-60 on velocity-masked CartPole;
+    recurrence must beat that decisively."""
+    cfg = rl.R2D2Config()
+    cfg.num_envs_per_runner = 16
+    cfg.rollout_fragment_length = 64
+    cfg.seed = 1
+    algo = rl.R2D2({"__algo_config": cfg})
+    best = 0.0
+    for _ in range(100):
+        m = algo.step()
+        best = max(best, m.get("episode_return_mean", 0.0))
+        if best > 90:
+            break
+    assert best > 90, f"R2D2 plateaued at {best}"
+
+
 # ------------------------------------------------------------- AlphaZero --
 
 def _play_vs_random(algo, games: int, seed: int, az_first: bool) -> float:
