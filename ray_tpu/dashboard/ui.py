@@ -199,8 +199,7 @@ const COLS = {
     ["Message", r => `<td>${esc(r.message || "")}</td>`],
   ],
   placement_groups: [
-    ["Group", r => `<td class="id">${esc(r.pg_id
-                                         || r.placement_group_id)}</td>`],
+    ["Group", r => `<td class="id">${esc(r.pg_id)}</td>`],
     ["Name", r => `<td>${esc(r.name || "")}</td>`],
     ["Strategy", r => `<td>${esc(r.strategy || "")}</td>`],
     ["State", r => `<td>${statusCell(r.state)}</td>`],

@@ -24,6 +24,10 @@ from ray_tpu.rl.algorithms import (  # noqa: F401
     BanditLinUCB,
     CQL,
     CQLConfig,
+    CRR,
+    CRRConfig,
+    DT,
+    DTConfig,
     ES,
     ESConfig,
     BC,

@@ -15,6 +15,8 @@ from ray_tpu.rl.algorithms.bandits import (  # noqa: F401
     LinearBanditEnv,
 )
 from ray_tpu.rl.algorithms.cql import CQL, CQLConfig  # noqa: F401
+from ray_tpu.rl.algorithms.crr import CRR, CRRConfig  # noqa: F401
+from ray_tpu.rl.algorithms.dt import DT, DTConfig  # noqa: F401
 from ray_tpu.rl.algorithms.ddpg import (  # noqa: F401
     DDPG,
     DDPGConfig,

@@ -1,0 +1,208 @@
+"""CRR: critic-regularized regression for offline RL.
+
+Reference analog: ``rllib/algorithms/crr/crr.py`` (Wang et al. 2020 —
+"Critic Regularized Regression"). An offline actor-critic: the critic is
+a plain TD(0) ensemble with polyak targets, and the actor is weighted
+behavior cloning where the weight is a function of the advantage
+
+    A(s, a) = Q(s, a) - E_{a'~pi}[Q(s, a')]
+
+estimated with ``crr_num_actions`` policy samples. Two weightings from
+the paper, selected by ``crr_weight_type``:
+
+- ``"bin"``:  w = 1[A > 0]           (binary filter; "bin_max" in rllib)
+- ``"exp"``:  w = clip(exp(A/beta))  (exponential, like AWAC/MARWIL)
+
+Everything is one jitted update over offline minibatches — no env
+interaction (the env is probed only for spaces, like BC/MARWIL/CQL).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.rl import models
+from ray_tpu.rl.algorithm import Algorithm
+from ray_tpu.rl.algorithms.offline import _to_arrays
+from ray_tpu.rl.algorithms.sac import _squashed_sample_logp
+from ray_tpu.rl.config import AlgorithmConfig
+from ray_tpu.rl.learner import Learner
+
+
+class CRRConfig(AlgorithmConfig):
+    def __init__(self, **kwargs):
+        super().__init__(algo_class=CRR, **kwargs)
+        self.env = "Pendulum-v1"
+        self.minibatch_size = 256
+        self.crr_beta = 1.0          # exp-weight temperature
+        self.crr_num_actions = 4     # policy samples for E[Q(s, a')]
+        self.crr_weight_type = "exp"  # "exp" | "bin"
+        self.crr_weight_clip = 20.0
+        self.updates_per_iter = 50
+
+
+class CRR(Algorithm):
+    need_env_runners = False  # offline: the dataset IS the experience
+
+    @classmethod
+    def get_default_config(cls) -> AlgorithmConfig:
+        return CRRConfig()
+
+    def build_learner(self) -> None:
+        cfg, spec = self.config, self.spec
+        if spec.discrete:
+            raise ValueError("CRR here targets continuous control; use "
+                             "BC/MARWIL or DQN-family for discrete")
+        if cfg.offline_data is None:
+            raise ValueError("CRR needs config.offline_data")
+        self._data = _to_arrays(cfg.offline_data)
+        for col in ("obs", "actions", "rewards", "next_obs", "dones"):
+            if col not in self._data:
+                raise ValueError(f"offline_data missing {col!r}")
+        self._n = len(self._data["rewards"])
+        self._rng = np.random.default_rng(cfg.seed)
+
+        gamma, tau = cfg.gamma, cfg.tau
+        low, high = spec.action_low, spec.action_high
+        adim = spec.action_dim
+        n_samp = cfg.crr_num_actions
+        beta = cfg.crr_beta
+        w_type = cfg.crr_weight_type
+        w_clip = cfg.crr_weight_clip
+        if w_type not in ("exp", "bin"):
+            raise ValueError(f"crr_weight_type must be 'exp' or 'bin', "
+                             f"got {w_type!r}")
+
+        key = jax.random.key(cfg.seed)
+        k_pi, k_q1, k_q2 = jax.random.split(key, 3)
+        qin = spec.obs_dim + adim
+        q1 = models.init_mlp(k_q1, [qin, *cfg.hidden, 1], out_scale=1.0)
+        q2 = models.init_mlp(k_q2, [qin, *cfg.hidden, 1], out_scale=1.0)
+        pi = models.init_mlp(
+            k_pi, [spec.obs_dim, *cfg.hidden, 2 * adim], out_scale=0.01)
+        params = {
+            "pi": pi, "q1": q1, "q2": q2,
+            "q1_target": jax.tree_util.tree_map(jnp.copy, q1),
+            "q2_target": jax.tree_util.tree_map(jnp.copy, q2),
+        }
+
+        def pi_dist(pi_params, obs):
+            out = models.mlp_forward(pi_params, obs)
+            return jnp.split(out, 2, axis=-1)
+
+        def q_val(q_params, obs, act):
+            return models.mlp_forward(
+                q_params, jnp.concatenate([obs, act], axis=-1))[..., 0]
+
+        def loss_fn(params, batch, key):
+            k1, k2 = jax.random.split(key)
+            obs, nobs = batch["obs"], batch["next_obs"]
+            acts = batch["actions"]
+            # --- critic: TD(0) toward min of target ensemble, with the
+            # next action drawn from the CURRENT policy (the paper's
+            # policy-evaluation critic; no entropy term unlike SAC/CQL) ---
+            nmean, nlogstd = pi_dist(params["pi"], nobs)
+            nact, _ = _squashed_sample_logp(nmean, nlogstd, k1, low, high)
+            qt = jnp.minimum(q_val(params["q1_target"], nobs, nact),
+                             q_val(params["q2_target"], nobs, nact))
+            nonterminal = 1.0 - batch["dones"].astype(jnp.float32)
+            target = jax.lax.stop_gradient(
+                batch["rewards"] + gamma * nonterminal * qt)
+            q1_pred = q_val(params["q1"], obs, acts)
+            q2_pred = q_val(params["q2"], obs, acts)
+            critic_loss = jnp.mean((q1_pred - target) ** 2) + \
+                jnp.mean((q2_pred - target) ** 2)
+            # --- advantage estimate: A = Q(s, a_data) - mean_j Q(s, a_j) ---
+            mean, log_std = pi_dist(params["pi"], obs)
+            samp, _ = _squashed_sample_logp(
+                jnp.broadcast_to(mean, (n_samp,) + mean.shape),
+                jnp.broadcast_to(log_std, (n_samp,) + log_std.shape),
+                k2, low, high)
+            rep = jnp.broadcast_to(obs, (n_samp,) + obs.shape)
+            q_samp = jnp.minimum(q_val(params["q1"], rep, samp),
+                                 q_val(params["q2"], rep, samp))
+            q_data = jnp.minimum(q1_pred, q2_pred)
+            adv = jax.lax.stop_gradient(q_data - q_samp.mean(axis=0))
+            if w_type == "bin":
+                w = (adv > 0).astype(jnp.float32)
+            else:
+                w = jnp.minimum(jnp.exp(adv / beta), w_clip)
+            # --- actor: advantage-filtered log-likelihood of data actions
+            # (squashed-gaussian logp of the dataset action) ---
+            eps = 1e-6
+            span = (high - low) / 2.0
+            mid = (high + low) / 2.0
+            pre = jnp.arctanh(jnp.clip((acts - mid) / span,
+                                       -1 + eps, 1 - eps))
+            std = jnp.exp(jnp.clip(log_std, -10.0, 2.0))
+            base_logp = jnp.sum(
+                -0.5 * ((pre - mean) / std) ** 2 - jnp.log(std)
+                - 0.5 * jnp.log(2 * jnp.pi), axis=-1)
+            # tanh-squash correction
+            base_logp -= jnp.sum(
+                jnp.log(span * (1 - jnp.tanh(pre) ** 2) + eps), axis=-1)
+            pi_loss = -jnp.mean(w * base_logp)
+            total = critic_loss + pi_loss
+            return total, {"critic_loss": critic_loss, "pi_loss": pi_loss,
+                           "adv_mean": adv.mean(), "weight_mean": w.mean()}
+
+        self.learner = Learner(params, loss_fn, cfg.lr,
+                               grad_clip=cfg.grad_clip, seed=cfg.seed)
+
+        @jax.jit
+        def polyak(params):
+            new = dict(params)
+            for src, dst in (("q1", "q1_target"), ("q2", "q2_target")):
+                new[dst] = jax.tree_util.tree_map(
+                    lambda t, s: (1 - tau) * t + tau * s,
+                    params[dst], params[src])
+            return new
+
+        self._polyak = polyak
+
+        @jax.jit
+        def act_greedy(params, obs):
+            mean, _ = pi_dist(params["pi"], obs)
+            mid = (high + low) / 2.0
+            span = (high - low) / 2.0
+            return mid + span * jnp.tanh(mean)
+
+        self._act_greedy = act_greedy
+
+    def _minibatch(self, size: int) -> Dict[str, np.ndarray]:
+        idx = self._rng.integers(0, self._n, size=min(size, self._n))
+        return {k: v[idx] for k, v in self._data.items()}
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.config
+        m: Dict[str, Any] = {}
+        for _ in range(cfg.updates_per_iter or 50):
+            m = self.learner.update_minibatch(
+                self._minibatch(cfg.minibatch_size))
+            self.learner.params = self._polyak(self.learner.params)
+        self._env_steps_total += 0  # offline: no env interaction
+        return {k: float(v) for k, v in m.items()}
+
+    def evaluate(self, num_episodes: int = 5) -> Dict[str, float]:
+        """Greedy (tanh-mean) rollout in the probe env."""
+        from ray_tpu.rl.env import make_env
+
+        env = make_env(self.config.env, 1, self.config.env_config)
+        params = self.learner.get_params()
+        returns = []
+        obs = env.reset()
+        ep_ret, done_count, steps = 0.0, 0, 0
+        while done_count < num_episodes and steps < 100_000:
+            action = np.asarray(self._act_greedy(params, jnp.asarray(obs)))
+            obs, reward, done = env.step(action)
+            ep_ret += float(reward[0])
+            steps += 1
+            if done[0]:
+                returns.append(ep_ret)
+                ep_ret = 0.0
+                done_count += 1
+        return {"episode_return_mean": float(np.mean(returns or [0.0]))}

@@ -143,9 +143,6 @@ class R2D2(Trainable):
             hs = jnp.swapaxes(hs, 0, 1)                    # [B, L, H]
             return models.mlp_forward(net_p["head"], hs)
 
-        self._unroll = jax.jit(
-            lambda net_p, obs_seq, h0: unroll(net_p, obs_seq, h0))
-
         def loss_fn(p, batch, key):
             del key
             q = unroll(p["q"], batch["obs"], batch["h0"])       # [B,L,A]
@@ -264,8 +261,9 @@ class R2D2(Trainable):
                 if dones[i] or len(seq["obs"]) >= cfg.seq_len:
                     if dones[i]:
                         h2[i] = 0.0  # episode boundary resets the state
-                    # flush BEFORE updating self._h so a length-cut
-                    # sequence's successor stores the carried state
+                    # update self._h BEFORE flushing: _flush_seq opens the
+                    # successor via _new_seq, whose h0 copies self._h — it
+                    # must see the post-step carried state
                     self._h[i] = h2[i]
                     self._flush_seq(i)
                 else:

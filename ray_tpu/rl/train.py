@@ -30,7 +30,8 @@ def algorithm_registry() -> Dict[str, type]:
         "DQN": rl.DQNConfig, "SAC": rl.SACConfig,
         "DDPG": rl.DDPGConfig, "TD3": rl.TD3Config,
         "BC": rl.BCConfig, "MARWIL": rl.MARWILConfig,
-        "CQL": rl.CQLConfig, "ES": rl.ESConfig, "ARS": rl.ARSConfig,
+        "CQL": rl.CQLConfig, "CRR": rl.CRRConfig, "DT": rl.DTConfig,
+        "ES": rl.ESConfig, "ARS": rl.ARSConfig,
         "QMIX": rl.QMIXConfig, "ALPHAZERO": rl.AlphaZeroConfig,
         "R2D2": rl.R2D2Config,
         "BANDITLINUCB": rl.BanditConfig, "BANDITLINTS": rl.BanditConfig,
