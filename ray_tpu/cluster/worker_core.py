@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import asyncio
 import hashlib
+import logging
 import threading
 import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
@@ -49,6 +50,8 @@ from ray_tpu.exceptions import (
     TaskError,
     WorkerCrashedError,
 )
+
+logger = logging.getLogger("ray_tpu.worker_core")
 
 _SMALL = lambda: get_config().max_direct_call_object_size
 
@@ -1006,18 +1009,31 @@ class ClusterBackend(RuntimeBackend):
         return conn
 
     async def _resolve_actor(self, conn: _ActorConn, timeout: float = 60.0) -> str:
-        reply = await self._gcs.call("get_actor_info", {
-            "actor_id": conn.actor_id_hex, "wait_alive": True,
-            "timeout": timeout})
-        info = reply.get("info")
-        if info is None:
-            raise ActorDiedError(conn.actor_id_hex, "unknown actor")
-        if info["state"] == "DEAD":
-            conn.dead_reason = info.get("death_reason", "dead")
-            raise ActorDiedError(conn.actor_id_hex, conn.dead_reason)
-        if info["state"] != "ALIVE":
-            raise ActorDiedError(conn.actor_id_hex,
-                                 f"not alive within timeout: {info['state']}")
+        # PENDING_CREATION / RESTARTING are NOT errors: the actor may be
+        # queued behind cluster resources (or a node the autoscaler is
+        # still provisioning). Like the reference, callers block until it
+        # comes alive or genuinely dies — with a periodic warning so an
+        # infeasible request is visible instead of a silent hang.
+        waited = 0.0
+        while True:
+            reply = await self._gcs.call("get_actor_info", {
+                "actor_id": conn.actor_id_hex, "wait_alive": True,
+                "timeout": timeout})
+            info = reply.get("info")
+            if info is None:
+                raise ActorDiedError(conn.actor_id_hex, "unknown actor")
+            if info["state"] == "DEAD":
+                conn.dead_reason = info.get("death_reason", "dead")
+                raise ActorDiedError(conn.actor_id_hex, conn.dead_reason)
+            if info["state"] == "ALIVE":
+                break
+            waited += timeout
+            logger.warning(
+                "actor %s still %s after %.0fs — waiting for cluster "
+                "resources (creation queues until a node frees up or "
+                "the autoscaler adds capacity; check requested "
+                "num_cpus/num_tpus against the cluster)",
+                conn.actor_id_hex, info["state"], waited)
         conn.address = info["address"]
         conn.max_task_retries = info.get("max_task_retries", 0)
         return conn.address

@@ -13,6 +13,8 @@ from ray_tpu.rl.algorithms import (  # noqa: F401
     A2CConfig,
     APPO,
     APPOConfig,
+    ApexDQN,
+    ApexDQNConfig,
     ARS,
     ARSConfig,
     AlphaZero,
@@ -28,6 +30,15 @@ from ray_tpu.rl.algorithms import (  # noqa: F401
     CRRConfig,
     DT,
     DTConfig,
+    DreamerV3,
+    DreamerV3Config,
+    MADDPG,
+    MADDPGConfig,
+    MAML,
+    MAMLConfig,
+    PointGoal,
+    PG,
+    PGConfig,
     ES,
     ESConfig,
     BC,
@@ -49,10 +60,20 @@ from ray_tpu.rl.algorithms import (  # noqa: F401
     MaskedCartPole,
     SAC,
     SACConfig,
+    RecSlateEnv,
+    SlateQ,
+    SlateQConfig,
     TD3,
     TD3Config,
 )
 from ray_tpu.rl.config import AlgorithmConfig  # noqa: F401
+from ray_tpu.rl.rl_module import (  # noqa: F401
+    Catalog,
+    ModuleSpec,
+    MultiAgentRLModule,
+    RLModule,
+    register_module_builder,
+)
 from ray_tpu.rl.connectors import (  # noqa: F401
     ClipObs,
     ClipReward,
@@ -77,6 +98,7 @@ from ray_tpu.rl.multi_agent import (  # noqa: F401
     CoordinationGame,
     MultiAgentEnv,
     MultiAgentPPO,
+    SpreadGame,
     register_multi_agent_env,
 )
 from ray_tpu.rl.env import (  # noqa: F401

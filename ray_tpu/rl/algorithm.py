@@ -108,6 +108,23 @@ class Algorithm(Trainable):
 
     # ---- helpers ----
 
+    def init_policy_params(self):
+        """Initial policy/value param pytree: routed through the RLModule
+        Catalog when ``config.module_spec`` is set (custom encoder or
+        activation), else the default ``models.init_policy`` network."""
+        import jax
+
+        from ray_tpu.rl import models as _models
+
+        cfg = self.config
+        if getattr(cfg, "module_spec", None) is not None:
+            from ray_tpu.rl.rl_module import Catalog
+
+            return Catalog.build_params(self.spec, cfg.module_spec,
+                                        cfg.seed)
+        return _models.init_policy(jax.random.key(cfg.seed), self.spec,
+                                   cfg.hidden)
+
     def synchronous_sample(self, params) -> Dict[str, np.ndarray]:
         """Fan out sample() to the runner fleet and concat fragments
         (reference: ``rollout_ops.synchronous_parallel_sample``)."""

@@ -6,6 +6,7 @@ from ray_tpu.rl.algorithms.alphazero import (  # noqa: F401
     MCTS,
     TicTacToe,
 )
+from ray_tpu.rl.algorithms.apex import ApexDQN, ApexDQNConfig  # noqa: F401
 from ray_tpu.rl.algorithms.appo import APPO, APPOConfig  # noqa: F401
 from ray_tpu.rl.algorithms.ars import ARS, ARSConfig  # noqa: F401
 from ray_tpu.rl.algorithms.bandits import (  # noqa: F401
@@ -16,7 +17,18 @@ from ray_tpu.rl.algorithms.bandits import (  # noqa: F401
 )
 from ray_tpu.rl.algorithms.cql import CQL, CQLConfig  # noqa: F401
 from ray_tpu.rl.algorithms.crr import CRR, CRRConfig  # noqa: F401
+from ray_tpu.rl.algorithms.dreamer import (  # noqa: F401
+    DreamerV3,
+    DreamerV3Config,
+)
 from ray_tpu.rl.algorithms.dt import DT, DTConfig  # noqa: F401
+from ray_tpu.rl.algorithms.maddpg import MADDPG, MADDPGConfig  # noqa: F401
+from ray_tpu.rl.algorithms.maml import (  # noqa: F401
+    MAML,
+    MAMLConfig,
+    PointGoal,
+)
+from ray_tpu.rl.algorithms.pg import PG, PGConfig  # noqa: F401
 from ray_tpu.rl.algorithms.ddpg import (  # noqa: F401
     DDPG,
     DDPGConfig,
@@ -40,3 +52,8 @@ from ray_tpu.rl.algorithms.r2d2 import (  # noqa: F401
     R2D2Config,
 )
 from ray_tpu.rl.algorithms.sac import SAC, SACConfig  # noqa: F401
+from ray_tpu.rl.algorithms.slateq import (  # noqa: F401
+    RecSlateEnv,
+    SlateQ,
+    SlateQConfig,
+)

@@ -57,10 +57,11 @@ class A2C(Algorithm):
     def build_learner(self) -> None:
         cfg, spec = self.config, self.spec
         loss_fn = make_a2c_loss(spec, cfg.vf_coeff, cfg.entropy_coeff)
-        seed, hidden, lr, clip = cfg.seed, cfg.hidden, cfg.lr, cfg.grad_clip
+        seed, lr, clip = cfg.seed, cfg.lr, cfg.grad_clip
+        init_params = self.init_policy_params()
 
         def ctor() -> Learner:
-            params = models.init_policy(jax.random.key(seed), spec, hidden)
+            params = jax.tree_util.tree_map(jnp.array, init_params)
             return Learner(params, loss_fn, lr, grad_clip=clip, seed=seed)
 
         if cfg.num_learners > 0:

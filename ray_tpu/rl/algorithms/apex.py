@@ -1,0 +1,99 @@
+"""Ape-X DQN: distributed prioritized experience replay.
+
+Reference analog: ``rllib/algorithms/apex_dqn/apex_dqn.py`` (Horgan et
+al. 2018). The three Ape-X signatures, on this framework's primitives:
+
+1. **Async sampling fleet** — runners keep producing fragments under
+   slightly stale params (the IMPALA inflight-refs pipeline, not the
+   synchronous DQN gather), so the learner never waits on the slowest
+   actor.
+2. **Per-actor epsilon ladder** — runner ``i`` of ``N`` explores with
+   ``eps_i = base ** (1 + 7 * i / (N - 1))`` (the paper's schedule): a
+   few runners stay near-greedy while others explore hard, replacing the
+   single annealed epsilon.
+3. **Prioritized replay always on** — new fragments enter the buffer at
+   max priority; sampled minibatches update priorities from the TD error
+   (inherited from the DQN learner's ``td`` output).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import ray_tpu
+from ray_tpu.rl.algorithms.dqn import DQN
+from ray_tpu.rl.config import AlgorithmConfig
+
+
+class ApexDQNConfig(AlgorithmConfig):
+    def __init__(self, **kwargs):
+        super().__init__(algo_class=ApexDQN, **kwargs)
+        self.lr = 1e-3
+        self.minibatch_size = 64
+        self.num_env_runners = 4
+        self.prioritized_replay = True
+        self.apex_eps_base = 0.4
+        self.apex_eps_alpha = 7.0
+        self.updates_per_iter = 16
+
+
+class ApexDQN(DQN):
+    @classmethod
+    def get_default_config(cls) -> AlgorithmConfig:
+        return ApexDQNConfig()
+
+    def build_learner(self) -> None:
+        cfg = self.config
+        if not cfg.prioritized_replay:
+            raise ValueError("ApexDQN requires prioritized_replay=True "
+                             "(it IS the algorithm)")
+        super().build_learner()
+        self._inflight: Dict[Any, Any] = {}
+        # epsilon ladder: runner i's exploration is fixed, not annealed
+        n = max(1, len(self.runners))
+        base, alpha = cfg.apex_eps_base, cfg.apex_eps_alpha
+        self._runner_eps = [
+            base ** (1 + alpha * i / max(1, n - 1)) for i in range(n)]
+
+    def _params_for(self, runner_i: int):
+        return self._runner_params(epsilon=self._runner_eps[runner_i])
+
+    def _submit(self, runner_i: int) -> None:
+        runner = self.runners[runner_i]
+        ref = runner.sample.remote(self._params_for(runner_i))
+        self._inflight[ref] = runner_i
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.config
+        submitted = set(self._inflight.values())
+        for i in range(len(self.runners)):
+            if i not in submitted:
+                self._submit(i)
+        # consume one round of fragments (whichever runners finish first)
+        consumed = 0
+        for _ in range(len(self.runners)):
+            ready, _ = ray_tpu.wait(list(self._inflight), num_returns=1)
+            ref = ready[0]
+            runner_i = self._inflight.pop(ref)
+            batch = ray_tpu.get(ref)
+            self._submit(runner_i)  # resubmit with fresh params
+            self.buffer.add_batch(
+                {k: batch[k] for k in
+                 ("obs", "actions", "rewards", "next_obs", "dones")})
+            n = len(batch["rewards"])
+            consumed += n
+            self._env_steps_total += n
+        metrics: Dict[str, Any] = {"buffer_size": len(self.buffer),
+                                   "env_steps_this_iter": consumed,
+                                   "eps_ladder_min": self._runner_eps[-1],
+                                   "eps_ladder_max": self._runner_eps[0]}
+        if len(self.buffer) >= cfg.learning_starts:
+            metrics["td_abs_mean"] = self._replay_updates(
+                cfg.updates_per_iter or 16)
+            metrics["num_updates"] = self._updates
+        metrics.update(self.collect_episode_stats())
+        return metrics
+
+    def stop(self) -> None:
+        self._inflight.clear()
+        super().stop()

@@ -67,8 +67,7 @@ class APPO(IMPALA):
 
         from ray_tpu.rl.learner import Learner
 
-        params = models.init_policy(jax.random.key(cfg.seed), spec,
-                                    cfg.hidden)
+        params = self.init_policy_params()
         self.learner = Learner(params, loss_fn, cfg.lr,
                                grad_clip=cfg.grad_clip, seed=cfg.seed)
         self._inflight: Dict[Any, Any] = {}

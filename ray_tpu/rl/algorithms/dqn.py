@@ -60,8 +60,7 @@ class DQN(Algorithm):
                           "q_mean": jnp.mean(q_taken),
                           "td": jax.lax.stop_gradient(td)}
 
-        init_q = models.init_policy(jax.random.key(cfg.seed), spec,
-                                    cfg.hidden)
+        init_q = self.init_policy_params()
         params = {"q": init_q, "target": jax.tree_util.tree_map(
             jnp.copy, init_q)}
         self.learner = Learner(params, loss_fn, cfg.lr,
@@ -84,10 +83,11 @@ class DQN(Algorithm):
         return cfg.epsilon_initial + frac * (cfg.epsilon_final
                                              - cfg.epsilon_initial)
 
-    def _runner_params(self):
+    def _runner_params(self, epsilon: float = None):
         p = self.learner.get_params()
+        eps = self._epsilon() if epsilon is None else epsilon
         return {"pi": p["q"]["pi"], "vf": p["q"]["vf"],
-                "epsilon": jnp.asarray(self._epsilon())}
+                "epsilon": jnp.asarray(eps)}
 
     def _eval_params(self):
         """Greedy Q-policy (epsilon off) for Algorithm.evaluate."""
@@ -104,32 +104,41 @@ class DQN(Algorithm):
         if len(self.buffer) >= cfg.learning_starts:
             num_updates = (cfg.updates_per_iter or
                            max(1, len(batch["rewards"]) // cfg.minibatch_size))
-            td_list = []
-            for _ in range(num_updates):
-                target_before = self.learner.params["target"]
-                if cfg.prioritized_replay:
-                    sample, idx, weights = self.buffer.sample(
-                        cfg.minibatch_size)
-                    sample = dict(sample, weights=weights)
-                else:
-                    sample = self.buffer.sample(cfg.minibatch_size)
-                m = self.learner.update_minibatch(sample)
-                # target net is updated only by periodic hard sync
-                self.learner.params = dict(self.learner.params,
-                                           target=target_before)
-                if cfg.prioritized_replay:
-                    self.buffer.update_priorities(idx, np.asarray(m["td"]))
-                td_list.append(float(m["td_abs_mean"]))
-                self._updates += 1
-                if self._updates % cfg.target_update_freq == 0:
-                    self.learner.params = dict(
-                        self.learner.params,
-                        target=jax.tree_util.tree_map(
-                            jnp.copy, self.learner.params["q"]))
-            metrics["td_abs_mean"] = float(np.mean(td_list))
+            metrics["td_abs_mean"] = self._replay_updates(num_updates)
             metrics["num_updates"] = self._updates
         metrics.update(self.collect_episode_stats())
         return metrics
+
+    def _replay_updates(self, num_updates: int) -> float:
+        """The shared DQN-family update loop (also Ape-X): prioritized or
+        uniform minibatches, target restored after each step (adam's eps
+        term would drift it through the zero-grad path), priorities
+        refreshed from TD error, periodic hard target sync. Returns the
+        mean |TD|."""
+        cfg = self.config
+        td_list = []
+        for _ in range(num_updates):
+            target_before = self.learner.params["target"]
+            if cfg.prioritized_replay:
+                sample, idx, weights = self.buffer.sample(
+                    cfg.minibatch_size)
+                sample = dict(sample, weights=weights)
+            else:
+                sample = self.buffer.sample(cfg.minibatch_size)
+            m = self.learner.update_minibatch(sample)
+            # target net is updated only by periodic hard sync
+            self.learner.params = dict(self.learner.params,
+                                       target=target_before)
+            if cfg.prioritized_replay:
+                self.buffer.update_priorities(idx, np.asarray(m["td"]))
+            td_list.append(float(m["td_abs_mean"]))
+            self._updates += 1
+            if self._updates % cfg.target_update_freq == 0:
+                self.learner.params = dict(
+                    self.learner.params,
+                    target=jax.tree_util.tree_map(
+                        jnp.copy, self.learner.params["q"]))
+        return float(np.mean(td_list))
 
     def get_extra_state(self):
         return {"updates": self._updates}

@@ -93,8 +93,7 @@ class IMPALA(Algorithm):
             return total, {"pi_loss": pi_loss, "vf_loss": vf_loss,
                            "entropy": entropy}
 
-        params = models.init_policy(jax.random.key(cfg.seed), spec,
-                                    cfg.hidden)
+        params = self.init_policy_params()
         self.learner = Learner(params, loss_fn, cfg.lr,
                                grad_clip=cfg.grad_clip, seed=cfg.seed)
         self._inflight: Dict[Any, Any] = {}

@@ -1,0 +1,267 @@
+"""MADDPG: multi-agent DDPG with centralized critics.
+
+Reference analog: ``rllib/algorithms/maddpg/maddpg.py`` (Lowe et al.
+2017). Decentralized actors ``mu_i(o_i)`` act from local observations;
+per-agent CENTRALIZED critics ``Q_i(o_1..o_n, a_1..a_n)`` see every
+agent's observation and action during training (centralized training,
+decentralized execution). Off-policy on a shared transition replay with
+polyak target networks; exploration is decaying gaussian action noise.
+
+Runs in-process on the ``MultiAgentEnv`` protocol (rl/multi_agent.py) —
+its home setting is the continuous particle env ``"spread"``
+(``SpreadGame``, an MPE simple-spread analog). All per-agent losses sum
+into ONE jitted update: each term only touches its own agent's
+parameters (critics are ``stop_gradient``-ed inside actor terms, so the
+actor gradient flows through the action input alone — the MADDPG policy
+gradient).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.rl import models
+from ray_tpu.rl.config import AlgorithmConfig
+from ray_tpu.rl.learner import Learner
+from ray_tpu.rl.multi_agent import _MA_ENVS, MultiAgentEnv
+from ray_tpu.rl.replay_buffer import ReplayBuffer
+from ray_tpu.tune.trainable import Trainable
+
+
+class MADDPGConfig(AlgorithmConfig):
+    def __init__(self, **kwargs):
+        super().__init__(algo_class=MADDPG, **kwargs)
+        self.env = "spread"
+        self.lr = 1e-3
+        self.minibatch_size = 256
+        self.buffer_size = 100_000
+        self.learning_starts = 1_000
+        self.updates_per_iter = 32
+        self.exploration_noise = 0.3
+        self.noise_final = 0.05
+        self.noise_decay_steps = 20_000
+        self.hidden = (64, 64)
+
+
+class MADDPG(Trainable):
+    @classmethod
+    def get_default_config(cls) -> AlgorithmConfig:
+        return MADDPGConfig()
+
+    def setup(self, config: Dict[str, Any]) -> None:
+        if "__algo_config" in config:
+            self.config: AlgorithmConfig = config["__algo_config"]
+        else:
+            self.config = MADDPGConfig().update_from_dict(config)
+        cfg = self.config
+        ctor = _MA_ENVS[cfg.env] if isinstance(cfg.env, str) else cfg.env
+        self.env: MultiAgentEnv = ctor(num_envs=cfg.num_envs_per_runner,
+                                       **(cfg.env_config or {}))
+        self.agents = list(self.env.agents)
+        n = len(self.agents)
+        specs = [self.env.spec[a] for a in self.agents]
+        if any(s.discrete for s in specs):
+            raise ValueError("MADDPG requires continuous actions (use "
+                             "QMIX/IPPO for discrete cooperative games)")
+        if len({(s.obs_dim, s.action_dim) for s in specs}) != 1:
+            raise ValueError("MADDPG here assumes homogeneous per-agent "
+                             "obs/action dims")
+        spec = specs[0]
+        do, da = spec.obs_dim, spec.action_dim
+        low, high = spec.action_low, spec.action_high
+        mid, span = (high + low) / 2.0, (high - low) / 2.0
+        gamma, tau = cfg.gamma, cfg.tau
+        qin = n * (do + da)
+
+        key = jax.random.key(cfg.seed)
+        keys = jax.random.split(key, 2 * n)
+        actors = [models.init_mlp(keys[i], (do, *cfg.hidden, da),
+                                  out_scale=0.01) for i in range(n)]
+        critics = [models.init_mlp(keys[n + i], (qin, *cfg.hidden, 1),
+                                   out_scale=1.0) for i in range(n)]
+        params = {
+            "actors": actors, "critics": critics,
+            "actors_t": jax.tree_util.tree_map(jnp.array, actors),
+            "critics_t": jax.tree_util.tree_map(jnp.array, critics),
+        }
+
+        def act_of(actor_p, obs):
+            return mid + span * jnp.tanh(models.mlp_forward(actor_p, obs))
+
+        def q_of(critic_p, obs_flat, acts_flat):
+            x = jnp.concatenate([obs_flat, acts_flat], axis=-1)
+            return models.mlp_forward(critic_p, x)[..., 0]
+
+        def loss_fn(p, batch, key):
+            del key
+            obs = batch["obs"]              # [B, n, do]
+            acts = batch["actions"]         # [B, n, da]
+            nobs = batch["next_obs"]
+            B = obs.shape[0]
+            obs_flat = obs.reshape(B, -1)
+            acts_flat = acts.reshape(B, -1)
+            nobs_flat = nobs.reshape(B, -1)
+            nonterm = 1.0 - batch["dones"].astype(jnp.float32)
+            # target joint action from TARGET actors
+            nacts_flat = jnp.concatenate(
+                [act_of(p["actors_t"][j], nobs[:, j]) for j in range(n)],
+                axis=-1)
+            total = 0.0
+            metrics: Dict[str, Any] = {}
+            q_means = []
+            for i in range(n):
+                qt = q_of(p["critics_t"][i], nobs_flat, nacts_flat)
+                y = jax.lax.stop_gradient(
+                    batch["rewards"][:, i] + gamma * nonterm * qt)
+                q_pred = q_of(p["critics"][i], obs_flat, acts_flat)
+                critic_loss = jnp.mean((q_pred - y) ** 2)
+                # actor i: replace column i with mu_i(o_i); the critic is
+                # stop_gradient-ed so only the action path carries grads
+                a_i = act_of(p["actors"][i], obs[:, i])
+                joint = jnp.concatenate(
+                    [a_i if j == i else acts[:, j] for j in range(n)],
+                    axis=-1)
+                frozen_critic = jax.lax.stop_gradient(p["critics"][i])
+                actor_loss = -jnp.mean(q_of(frozen_critic, obs_flat,
+                                            joint))
+                total = total + critic_loss + actor_loss
+                metrics[f"critic_loss_{i}"] = critic_loss
+                metrics[f"actor_loss_{i}"] = actor_loss
+                q_means.append(q_pred.mean())
+            metrics["q_mean"] = jnp.mean(jnp.stack(q_means))
+            return total, metrics
+
+        self.learner = Learner(params, loss_fn, cfg.lr,
+                               grad_clip=cfg.grad_clip, seed=cfg.seed)
+
+        @jax.jit
+        def polyak(p):
+            new = dict(p)
+            for src, dst in (("actors", "actors_t"),
+                             ("critics", "critics_t")):
+                new[dst] = jax.tree_util.tree_map(
+                    lambda t, s: (1 - tau) * t + tau * s, p[dst], p[src])
+            return new
+
+        self._polyak = polyak
+        self._act_all = jax.jit(
+            lambda actors, obs: jnp.stack(
+                [act_of(actors[j], obs[:, j]) for j in range(n)], axis=1))
+        self._n, self._do, self._da = n, do, da
+        self._low, self._high = low, high
+
+        self.buffer = ReplayBuffer(cfg.buffer_size, seed=cfg.seed)
+        self._rng = np.random.default_rng(cfg.seed)
+        self._obs = self.env.reset()
+        self._env_steps_total = 0
+        self._return_window: List[float] = []
+        self._ep_return = np.zeros(self.env.num_envs, dtype=np.float64)
+
+    # -- rollout ----------------------------------------------------------
+
+    def _stack_obs(self, obs: Dict[str, np.ndarray]) -> np.ndarray:
+        return np.stack([obs[a] for a in self.agents],
+                        axis=1).astype(np.float32)
+
+    @property
+    def _noise(self) -> float:
+        cfg = self.config
+        frac = min(1.0, self._env_steps_total
+                   / max(1, cfg.noise_decay_steps))
+        return cfg.exploration_noise \
+            + frac * (cfg.noise_final - cfg.exploration_noise)
+
+    def _collect(self, steps: int) -> float:
+        cfg = self.config
+        n_envs = self.env.num_envs
+        reward_sum = 0.0
+        for _ in range(steps):
+            stacked = self._stack_obs(self._obs)
+            acts = np.asarray(self._act_all(
+                self.learner.get_params()["actors"], jnp.asarray(stacked)))
+            acts = np.clip(
+                acts + self._noise
+                * self._rng.standard_normal(acts.shape).astype(np.float32),
+                self._low, self._high)
+            act_dict = {a: acts[:, i]
+                        for i, a in enumerate(self.agents)}
+            next_obs, rewards, dones = self.env.step(act_dict)
+            rew = np.stack([rewards[a] for a in self.agents],
+                           axis=1).astype(np.float32)   # [N, n]
+            self.buffer.add_batch(
+                {"obs": stacked, "actions": acts.astype(np.float32),
+                 "rewards": rew, "dones": dones.astype(np.float32),
+                 "next_obs": self._stack_obs(next_obs)})
+            self._env_steps_total += n_envs
+            team_r = rew.mean(axis=1)
+            reward_sum += float(team_r.sum())
+            self._ep_return += team_r
+            for i in np.nonzero(dones)[0]:
+                self._return_window.append(float(self._ep_return[i]))
+                self._ep_return[i] = 0.0
+            self._obs = next_obs
+        self._return_window = self._return_window[-100:]
+        return reward_sum / max(1, steps * n_envs)
+
+    # -- Trainable API ----------------------------------------------------
+
+    def step(self) -> Dict[str, Any]:
+        cfg = self.config
+        mean_step_r = self._collect(cfg.rollout_fragment_length)
+        metrics: Dict[str, Any] = {"reward_mean_per_step": mean_step_r,
+                                   "noise": self._noise}
+        if len(self.buffer) >= cfg.learning_starts:
+            mlist = []
+            for _ in range(cfg.updates_per_iter or 1):
+                mb = self.buffer.sample(cfg.minibatch_size)
+                mlist.append(self.learner.update_minibatch(mb))
+                self.learner.set_params(
+                    self._polyak(self.learner.get_params()))
+            for k in mlist[0]:
+                metrics[k] = float(np.mean([float(m[k]) for m in mlist]))
+        metrics["env_steps_total"] = self._env_steps_total
+        if self._return_window:
+            metrics["episode_return_mean"] = float(
+                np.mean(self._return_window))
+        return metrics
+
+    def evaluate(self, num_episodes: int = 10) -> Dict[str, Any]:
+        """Noise-free episodes on a fresh env instance."""
+        cfg = self.config
+        ctor = _MA_ENVS[cfg.env] if isinstance(cfg.env, str) else cfg.env
+        env: MultiAgentEnv = ctor(num_envs=cfg.num_envs_per_runner,
+                                  **(cfg.env_config or {}))
+        obs = env.reset()
+        done_returns: List[float] = []
+        ep_ret = np.zeros(env.num_envs, dtype=np.float64)
+        actors = self.learner.get_params()["actors"]
+        for _ in range(4096):
+            stacked = self._stack_obs(obs)
+            acts = np.asarray(self._act_all(actors, jnp.asarray(stacked)))
+            act_dict = {a: acts[:, i]
+                        for i, a in enumerate(self.agents)}
+            obs, rewards, dones = env.step(act_dict)
+            ep_ret += np.mean([rewards[a] for a in self.agents], axis=0)
+            for i in np.nonzero(dones)[0]:
+                done_returns.append(float(ep_ret[i]))
+                ep_ret[i] = 0.0
+            if len(done_returns) >= num_episodes:
+                break
+        return {"episodes": len(done_returns),
+                "episode_return_mean": float(np.mean(done_returns))
+                if done_returns else float("nan")}
+
+    # -- checkpointing ----------------------------------------------------
+
+    def save_checkpoint(self, checkpoint_dir: str) -> Optional[Dict]:
+        return {"params": jax.tree_util.tree_map(
+            np.asarray, self.learner.get_params()),
+            "env_steps_total": self._env_steps_total}
+
+    def load_checkpoint(self, checkpoint: Dict) -> None:
+        self.learner.set_params(checkpoint["params"])
+        self._env_steps_total = checkpoint.get("env_steps_total", 0)

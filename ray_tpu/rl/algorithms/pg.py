@@ -1,0 +1,69 @@
+"""PG: vanilla policy gradient (REINFORCE).
+
+Reference analog: ``rllib/algorithms/pg/pg.py`` — the minimal on-policy
+baseline: ``loss = -mean(logp(a|s) * R)`` with monte-carlo returns and no
+clipping, no value baseline, no multiple epochs. The config pins
+``lambda_ = 1.0`` so the runner's GAE degenerates to monte-carlo returns
+(the untouched value head stays near zero, so ``value_targets`` are the
+discounted returns the reference uses).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.rl import models
+from ray_tpu.rl.algorithm import Algorithm
+from ray_tpu.rl.config import AlgorithmConfig
+from ray_tpu.rl.learner import Learner
+
+
+class PGConfig(AlgorithmConfig):
+    def __init__(self, **kwargs):
+        super().__init__(algo_class=PG, **kwargs)
+        self.lambda_ = 1.0     # monte-carlo returns, the REINFORCE target
+        self.num_epochs = 1    # strictly on-policy: one pass, no reuse
+        self.lr = 4e-3
+
+
+class PG(Algorithm):
+    @classmethod
+    def get_default_config(cls) -> AlgorithmConfig:
+        return PGConfig()
+
+    def build_learner(self) -> None:
+        cfg, spec = self.config, self.spec
+        ent_coeff = cfg.entropy_coeff
+
+        def loss_fn(params, batch, key):
+            logits = models.policy_logits(params, batch["obs"])
+            if spec.discrete:
+                logp = models.categorical_logp(logits, batch["actions"])
+                entropy = models.categorical_entropy(logits).mean()
+            else:
+                logp = models.gaussian_logp(logits, params["log_std"],
+                                            batch["actions"])
+                entropy = models.gaussian_entropy(params["log_std"])
+            ret = batch["value_targets"]
+            ret = (ret - ret.mean()) / (ret.std() + 1e-8)
+            pi_loss = -jnp.mean(logp * ret)
+            total = pi_loss - ent_coeff * entropy
+            return total, {"pi_loss": pi_loss, "entropy": entropy}
+
+        params = self.init_policy_params()
+        self.learner = Learner(params, loss_fn, cfg.lr,
+                               grad_clip=cfg.grad_clip, seed=cfg.seed)
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.config
+        batch = self.synchronous_sample(self.learner.get_params())
+        metrics = self.learner.update(
+            batch, num_epochs=1, minibatch_size=cfg.minibatch_size or 0,
+            seed=cfg.seed + self._iteration)
+        result = dict(metrics)
+        result.update(self.collect_episode_stats())
+        result["env_steps_this_iter"] = len(batch["rewards"])
+        return result

@@ -1,0 +1,356 @@
+"""SlateQ: Q-learning for slate recommendation.
+
+Reference analog: ``rllib/algorithms/slateq/slateq.py`` (Ie et al. 2019,
+on RecSim). The action is a SLATE of k documents from an m-document
+candidate set; SlateQ makes the combinatorial action space tractable by
+decomposing the slate value under a conditional user-choice model:
+
+    Q(s, A) = sum_{i in A} P(click = i | s, A) * Q(s, i)
+
+with per-ITEM Q-values. With multinomial-logit choice (score-proportional
+clicks), the greedy slate is the top-k items by choice-weighted Q, so
+both action selection and the TD target stay O(m log m).
+
+``RecSlateEnv`` is the bundled RecSim analog: users carry an interest
+vector that nudges toward clicked documents; the click model is a
+softmax over ``interest . doc`` scores with a no-click option; reward is
+the clicked document's engagement. Observations expose the user interest
+and every candidate's features (the same flattened layout RecSim's
+wrappers produce).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.rl import models
+from ray_tpu.rl.config import AlgorithmConfig
+from ray_tpu.rl.learner import Learner
+from ray_tpu.rl.replay_buffer import ReplayBuffer
+from ray_tpu.tune.trainable import Trainable
+
+
+class RecSlateEnv:
+    """Vectorized slate-recommendation episodes."""
+
+    def __init__(self, num_envs: int = 8, num_docs: int = 10,
+                 slate_size: int = 3, feat_dim: int = 4,
+                 horizon: int = 20, no_click_bias: float = 1.0,
+                 interest_lr: float = 0.2, seed: int = 0):
+        self.num_envs = num_envs
+        self.num_docs = num_docs
+        self.slate_size = slate_size
+        self.feat_dim = feat_dim
+        self.horizon = horizon
+        self.no_click_bias = no_click_bias
+        self.interest_lr = interest_lr
+        self._rng = np.random.default_rng(seed)
+        self._t = np.zeros(num_envs, dtype=np.int64)
+        self._user = np.zeros((num_envs, feat_dim), dtype=np.float32)
+        self._docs = np.zeros((num_envs, num_docs, feat_dim),
+                              dtype=np.float32)
+        self._quality = np.zeros((num_envs, num_docs), dtype=np.float32)
+        self._reset_envs(np.ones(num_envs, dtype=bool))
+
+    def _reset_envs(self, mask: np.ndarray) -> None:
+        n = int(mask.sum())
+        if not n:
+            return
+
+        def unit(x):
+            return x / (np.linalg.norm(x, axis=-1, keepdims=True) + 1e-8)
+
+        self._user[mask] = unit(self._rng.standard_normal(
+            (n, self.feat_dim)).astype(np.float32))
+        self._docs[mask] = unit(self._rng.standard_normal(
+            (n, self.num_docs, self.feat_dim)).astype(np.float32))
+        self._quality[mask] = self._rng.uniform(
+            0.2, 1.0, (n, self.num_docs)).astype(np.float32)
+        self._t[mask] = 0
+
+    def obs(self) -> np.ndarray:
+        """[N, feat + docs*(feat+1)]: user interest ++ per-doc features
+        and quality (the candidate set IS part of the observation)."""
+        docs = np.concatenate(
+            [self._docs, self._quality[..., None]], axis=-1)
+        return np.concatenate(
+            [self._user, docs.reshape(self.num_envs, -1)],
+            axis=-1).astype(np.float32)
+
+    @property
+    def obs_dim(self) -> int:
+        return self.feat_dim + self.num_docs * (self.feat_dim + 1)
+
+    def reset(self) -> np.ndarray:
+        self._reset_envs(np.ones(self.num_envs, dtype=bool))
+        return self.obs()
+
+    def choice_probs(self, slates: np.ndarray) -> np.ndarray:
+        """Multinomial-logit user choice over a [N, k] slate; returns
+        [N, k+1] probs, last column = no-click."""
+        scores = np.take_along_axis(
+            np.einsum("nf,ndf->nd", self._user, self._docs),
+            slates, axis=1)                                  # [N, k]
+        logits = np.concatenate(
+            [scores, np.full((self.num_envs, 1), self.no_click_bias,
+                             dtype=np.float32)], axis=1)
+        z = np.exp(logits - logits.max(axis=1, keepdims=True))
+        return z / z.sum(axis=1, keepdims=True)
+
+    def step(self, slates: np.ndarray):
+        """slates [N, k] int doc indices -> (obs, reward, done, clicked)
+        where clicked is the chosen slate POSITION or -1 for no-click."""
+        probs = self.choice_probs(slates)
+        u = self._rng.random((self.num_envs, 1))
+        choice = (probs.cumsum(axis=1) < u).sum(axis=1)      # [N] in 0..k
+        clicked_pos = np.where(choice < self.slate_size, choice, -1)
+        reward = np.zeros(self.num_envs, dtype=np.float32)
+        hit = clicked_pos >= 0
+        if hit.any():
+            doc_idx = np.take_along_axis(
+                slates[hit], clicked_pos[hit][:, None], axis=1)[:, 0]
+            reward[hit] = self._quality[hit, doc_idx]
+            # interest drifts toward consumed content
+            d = self._docs[hit, doc_idx]
+            self._user[hit] = (1 - self.interest_lr) * self._user[hit] \
+                + self.interest_lr * d
+            self._user[hit] /= (np.linalg.norm(
+                self._user[hit], axis=-1, keepdims=True) + 1e-8)
+        self._t += 1
+        dones = self._t >= self.horizon
+        self._reset_envs(dones)
+        return self.obs(), reward, dones, clicked_pos
+
+
+class SlateQConfig(AlgorithmConfig):
+    def __init__(self, **kwargs):
+        super().__init__(algo_class=SlateQ, **kwargs)
+        self.lr = 1e-3
+        self.minibatch_size = 128
+        self.buffer_size = 50_000
+        self.learning_starts = 500
+        self.target_update_freq = 200
+        self.epsilon_initial = 1.0
+        self.epsilon_final = 0.05
+        self.epsilon_decay_steps = 10_000
+        self.updates_per_iter = 32
+        self.num_docs = 10
+        self.slate_size = 3
+        self.feat_dim = 4
+        self.recsim_horizon = 20
+
+
+class SlateQ(Trainable):
+    @classmethod
+    def get_default_config(cls) -> AlgorithmConfig:
+        return SlateQConfig()
+
+    def setup(self, config: Dict[str, Any]) -> None:
+        if "__algo_config" in config:
+            self.config: AlgorithmConfig = config["__algo_config"]
+        else:
+            self.config = SlateQConfig().update_from_dict(config)
+        cfg = self.config
+        self.env = RecSlateEnv(
+            num_envs=cfg.num_envs_per_runner, num_docs=cfg.num_docs,
+            slate_size=cfg.slate_size, feat_dim=cfg.feat_dim,
+            horizon=cfg.recsim_horizon, seed=cfg.seed,
+            **(cfg.env_config or {}))
+        m, k, f = cfg.num_docs, cfg.slate_size, cfg.feat_dim
+        gamma = cfg.gamma
+        user_dim = f
+        doc_feat = f + 1  # features + quality
+        no_click = self.env.no_click_bias
+
+        # per-item Q net: (user interest ++ doc features) -> scalar
+        qnet = models.init_mlp(
+            jax.random.key(cfg.seed),
+            (user_dim + doc_feat, *cfg.hidden, 1), out_scale=1.0)
+        params = {"q": qnet,
+                  "target": jax.tree_util.tree_map(jnp.copy, qnet)}
+
+        def split_obs(obs):
+            user = obs[:, :user_dim]                         # [B, f]
+            docs = obs[:, user_dim:].reshape(-1, m, doc_feat)
+            return user, docs
+
+        def item_qs(net, obs):
+            """[B, m] per-item Q over the full candidate set."""
+            user, docs = split_obs(obs)
+            rep = jnp.broadcast_to(user[:, None], (user.shape[0], m,
+                                                   user_dim))
+            x = jnp.concatenate([rep, docs], axis=-1)
+            return models.mlp_forward(net, x)[..., 0]
+
+        def choice_weights(obs, slate_idx):
+            """softmax(interest . doc) over slate + no-click -> [B, k]
+            click probs for each slate position."""
+            user, docs = split_obs(obs)
+            scores = jnp.einsum("bf,bmf->bm", user, docs[..., :user_dim])
+            s = jnp.take_along_axis(scores, slate_idx, axis=1)  # [B, k]
+            logits = jnp.concatenate(
+                [s, jnp.full((s.shape[0], 1), no_click)], axis=1)
+            p = jax.nn.softmax(logits, axis=1)
+            return p[:, :k]
+
+        def greedy_slate(net, obs):
+            """Top-k by choice-weighted Q (optimal under MNL choice)."""
+            q = item_qs(net, obs)                            # [B, m]
+            user, docs = split_obs(obs)
+            scores = jnp.einsum("bf,bmf->bm", user, docs[..., :user_dim])
+            w = jnp.exp(scores)  # choice propensity (unnormalized)
+            _, idx = jax.lax.top_k(w * q, k)
+            return idx
+
+        def slate_value(net, obs, slate_idx):
+            """Q(s, A) under the decomposition."""
+            q = item_qs(net, obs)
+            qs = jnp.take_along_axis(q, slate_idx, axis=1)   # [B, k]
+            w = choice_weights(obs, slate_idx)
+            return jnp.sum(w * qs, axis=1)
+
+        def loss_fn(p, batch, key):
+            del key
+            # TD on the CLICKED item's Q (no-click transitions carry no
+            # item gradient, matching the SlateQ decomposition)
+            q_all = item_qs(p["q"], batch["obs"])            # [B, m]
+            clicked_doc = batch["clicked_doc"]               # [B] (or -1)
+            hit = (clicked_doc >= 0).astype(jnp.float32)
+            safe_idx = jnp.maximum(clicked_doc, 0)
+            q_clicked = jnp.take_along_axis(
+                q_all, safe_idx[:, None], axis=1)[:, 0]
+            next_slate = greedy_slate(p["q"], batch["next_obs"])
+            v_next = slate_value(p["target"], batch["next_obs"],
+                                 next_slate)
+            nonterm = 1.0 - batch["dones"].astype(jnp.float32)
+            target = jax.lax.stop_gradient(
+                batch["rewards"] + gamma * nonterm * v_next)
+            td = (q_clicked - target) * hit
+            loss = jnp.sum(td ** 2) / jnp.maximum(hit.sum(), 1.0)
+            return loss, {"td_abs_mean": jnp.sum(jnp.abs(td))
+                          / jnp.maximum(hit.sum(), 1.0),
+                          "click_rate": hit.mean(),
+                          "q_clicked_mean": jnp.sum(q_clicked * hit)
+                          / jnp.maximum(hit.sum(), 1.0)}
+
+        self.learner = Learner(params, loss_fn, cfg.lr,
+                               grad_clip=cfg.grad_clip, seed=cfg.seed)
+        self._greedy_slate = jax.jit(
+            lambda net, obs: greedy_slate(net, obs))
+        self._updates = 0
+        self.buffer = ReplayBuffer(cfg.buffer_size, seed=cfg.seed)
+        self._rng = np.random.default_rng(cfg.seed)
+        self._obs = self.env.reset()
+        self._env_steps_total = 0
+        self._return_window: List[float] = []
+        self._ep_return = np.zeros(self.env.num_envs, dtype=np.float64)
+
+    @property
+    def _epsilon(self) -> float:
+        cfg = self.config
+        frac = min(1.0, self._env_steps_total
+                   / max(1, cfg.epsilon_decay_steps))
+        return cfg.epsilon_initial \
+            + frac * (cfg.epsilon_final - cfg.epsilon_initial)
+
+    def _pick_slates(self, obs: np.ndarray, epsilon: float) -> np.ndarray:
+        cfg = self.config
+        n = self.env.num_envs
+        slates = np.array(self._greedy_slate(
+            self.learner.get_params()["q"], jnp.asarray(obs)))
+        explore = self._rng.random(n) < epsilon
+        for i in np.nonzero(explore)[0]:
+            slates[i] = self._rng.choice(cfg.num_docs, cfg.slate_size,
+                                         replace=False)
+        return slates
+
+    def _collect(self, steps: int) -> None:
+        n_envs = self.env.num_envs
+        for _ in range(steps):
+            obs = self._obs
+            slates = self._pick_slates(obs, self._epsilon)
+            next_obs, rewards, dones, clicked_pos = self.env.step(slates)
+            clicked_doc = np.where(
+                clicked_pos >= 0,
+                np.take_along_axis(
+                    slates, np.maximum(clicked_pos, 0)[:, None],
+                    axis=1)[:, 0],
+                -1)
+            self.buffer.add_batch(
+                {"obs": obs, "clicked_doc": clicked_doc.astype(np.int32),
+                 "rewards": rewards, "dones": dones.astype(np.float32),
+                 "next_obs": next_obs})
+            self._env_steps_total += n_envs
+            self._ep_return += rewards
+            for i in np.nonzero(dones)[0]:
+                self._return_window.append(float(self._ep_return[i]))
+                self._ep_return[i] = 0.0
+            self._obs = next_obs
+        self._return_window = self._return_window[-100:]
+
+    def step(self) -> Dict[str, Any]:
+        cfg = self.config
+        self._collect(cfg.rollout_fragment_length)
+        metrics: Dict[str, Any] = {"epsilon": self._epsilon,
+                                   "buffer_size": len(self.buffer)}
+        if len(self.buffer) >= cfg.learning_starts:
+            mlist = []
+            for _ in range(cfg.updates_per_iter or 1):
+                mb = self.buffer.sample(cfg.minibatch_size)
+                target_before = self.learner.params["target"]
+                mlist.append(self.learner.update_minibatch(mb))
+                self.learner.params = dict(self.learner.params,
+                                           target=target_before)
+                self._updates += 1
+                if self._updates % cfg.target_update_freq == 0:
+                    self.learner.params = dict(
+                        self.learner.params,
+                        target=jax.tree_util.tree_map(
+                            jnp.copy, self.learner.params["q"]))
+            for k in mlist[0]:
+                metrics[k] = float(np.mean([float(m[k]) for m in mlist]))
+        metrics["env_steps_total"] = self._env_steps_total
+        if self._return_window:
+            metrics["episode_return_mean"] = float(
+                np.mean(self._return_window))
+        return metrics
+
+    def evaluate(self, num_episodes: int = 10) -> Dict[str, Any]:
+        """Greedy slates on a fresh env."""
+        cfg = self.config
+        env = RecSlateEnv(
+            num_envs=cfg.num_envs_per_runner, num_docs=cfg.num_docs,
+            slate_size=cfg.slate_size, feat_dim=cfg.feat_dim,
+            horizon=cfg.recsim_horizon, seed=cfg.seed + 777,
+            **(cfg.env_config or {}))
+        obs = env.reset()
+        qnet = self.learner.get_params()["q"]
+        done_returns: List[float] = []
+        ep_ret = np.zeros(env.num_envs, dtype=np.float64)
+        for _ in range(4096):
+            slates = np.asarray(self._greedy_slate(qnet, jnp.asarray(obs)))
+            obs, rewards, dones, _ = env.step(slates)
+            ep_ret += rewards
+            for i in np.nonzero(dones)[0]:
+                done_returns.append(float(ep_ret[i]))
+                ep_ret[i] = 0.0
+            if len(done_returns) >= num_episodes:
+                break
+        return {"episodes": len(done_returns),
+                "episode_return_mean": float(np.mean(done_returns))
+                if done_returns else float("nan")}
+
+    def save_checkpoint(self, checkpoint_dir: str) -> Optional[Dict]:
+        return {"params": jax.tree_util.tree_map(
+            np.asarray, self.learner.get_params()),
+            "env_steps_total": self._env_steps_total,
+            "updates": self._updates}
+
+    def load_checkpoint(self, checkpoint: Dict) -> None:
+        self.learner.set_params(checkpoint["params"])
+        self._env_steps_total = checkpoint.get("env_steps_total", 0)
+        self._updates = checkpoint.get("updates", 0)

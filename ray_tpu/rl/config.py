@@ -72,6 +72,9 @@ class AlgorithmConfig:
     # offline RL (BC / MARWIL)
     offline_data: Any = None           # dict of arrays or ray_tpu.data Dataset
     beta: float = 1.0                  # MARWIL advantage temperature
+    # model container: an rl_module.ModuleSpec routes param init through
+    # the Catalog (custom encoder/activation); None = the default policy
+    module_spec: Any = None
     # multi-agent
     policy_mapping_fn: Any = None      # agent_id -> policy_id (None = identity)
     # resources

@@ -41,10 +41,14 @@ def init_mlp(key, dims: Sequence[int], out_scale: float = 0.01) -> Dict:
 def mlp_forward(params: Dict, x: jnp.ndarray) -> jnp.ndarray:
     h = x
     n = len(params["layers"])
+    # optional activation marker (rl_module catalogs): absent -> tanh;
+    # a shape-(1,) "act" leaf -> relu. Shape-encoded so it stays static
+    # under jit (same trick as the CNN stride leaves).
+    relu = "act" in params and params["act"].shape[0] == 1
     for i, layer in enumerate(params["layers"]):
         h = h @ layer["w"] + layer["b"]
         if i < n - 1:
-            h = jnp.tanh(h)
+            h = jax.nn.relu(h) if relu else jnp.tanh(h)
     return h
 
 

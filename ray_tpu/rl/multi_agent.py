@@ -94,8 +94,73 @@ class CoordinationGame(MultiAgentEnv):
         return self._obs(), rewards, dones
 
 
+class SpreadGame(MultiAgentEnv):
+    """Continuous cooperative coverage (an MPE ``simple_spread``-style
+    particle setting, the reference MADDPG's home env): 2 agents move on
+    the [-1,1]^2 plane toward 2 landmarks; the SHARED dense reward is
+    ``-sum_l min_a dist(a, l)``, maximized when each landmark has an agent
+    on it. Actions are velocities in [-1,1]^2; fixed horizon, auto-reset."""
+
+    def __init__(self, num_envs: int = 8, horizon: int = 25,
+                 dt: float = 0.15, seed: int = 0):
+        self.agents = ["a0", "a1"]
+        self.num_envs = num_envs
+        self.horizon = horizon
+        self.dt = dt
+        self._rng = np.random.default_rng(seed)
+        # obs: own pos (2) + other pos (2) + both landmarks (4)
+        spec = EnvSpec(obs_dim=8, action_dim=2,
+                       action_low=-1.0, action_high=1.0)
+        self.spec = {a: spec for a in self.agents}
+        self._t = np.zeros(num_envs, dtype=np.int64)
+        self._pos = np.zeros((num_envs, 2, 2), dtype=np.float32)
+        self._land = np.zeros((num_envs, 2, 2), dtype=np.float32)
+        self._reset_envs(np.ones(num_envs, dtype=bool))
+
+    def _reset_envs(self, mask: np.ndarray) -> None:
+        n = int(mask.sum())
+        if not n:
+            return
+        self._pos[mask] = self._rng.uniform(-1, 1, (n, 2, 2))
+        self._land[mask] = self._rng.uniform(-1, 1, (n, 2, 2))
+        self._t[mask] = 0
+
+    def _obs(self) -> Dict[str, np.ndarray]:
+        land = self._land.reshape(self.num_envs, 4)
+        out = {}
+        for i, a in enumerate(self.agents):
+            own = self._pos[:, i]
+            other = self._pos[:, 1 - i]
+            out[a] = np.concatenate([own, other, land],
+                                    axis=1).astype(np.float32)
+        return out
+
+    def reset(self) -> Dict[str, np.ndarray]:
+        self._reset_envs(np.ones(self.num_envs, dtype=bool))
+        return self._obs()
+
+    def _coverage_reward(self) -> np.ndarray:
+        # dist[e, l, a] = || land[e,l] - pos[e,a] ||
+        d = np.linalg.norm(self._land[:, :, None] - self._pos[:, None],
+                           axis=-1)
+        return -d.min(axis=2).sum(axis=1).astype(np.float32)
+
+    def step(self, actions: Dict[str, np.ndarray]):
+        for i, a in enumerate(self.agents):
+            vel = np.clip(np.asarray(actions[a], dtype=np.float32), -1, 1)
+            self._pos[:, i] = np.clip(self._pos[:, i] + self.dt * vel,
+                                      -1, 1)
+        reward = self._coverage_reward()
+        self._t += 1
+        dones = self._t >= self.horizon
+        self._reset_envs(dones)
+        rewards = {a: reward.copy() for a in self.agents}
+        return self._obs(), rewards, dones
+
+
 _MA_ENVS: Dict[str, Callable[..., MultiAgentEnv]] = {
     "coordination": CoordinationGame,
+    "spread": SpreadGame,
 }
 
 

@@ -1,0 +1,244 @@
+"""RLModule + Catalog: the configurable model-container layer.
+
+Reference analogs: ``rllib/core/rl_module/rl_module.py`` (the RLModule
+container with its three forward contracts), ``marl_module.py``
+(``MultiAgentRLModule``), and the per-algorithm catalogs
+(``rllib/algorithms/ppo/ppo_catalog.py`` etc. — the pluggable
+spec -> encoder/head factory).
+
+Here the container is a thin, functional wrapper over ``rl/models.py``
+param pytrees: a ``ModuleSpec`` describes the architecture (encoder
+family, widths, activation), the ``Catalog`` resolves it against an
+``EnvSpec`` into an initialized ``RLModule``, and custom architectures
+plug in via ``register_module_builder`` (the catalog-extension hook the
+reference exposes by subclassing catalogs). Because the produced param
+trees keep the framework's standard layout (``pi``/``vf``/``enc``/
+``log_std`` keys), every algorithm, the EnvRunner fleet, and the
+checkpoint machinery consume catalog-built modules unchanged —
+``AlgorithmConfig.module_spec`` switches any on-policy algorithm onto a
+custom architecture with no other code changes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.rl import models
+from ray_tpu.rl.env import EnvSpec
+
+
+@dataclasses.dataclass
+class ModuleSpec:
+    """Architecture description, resolved by the Catalog.
+
+    ``encoder``: "auto" (mlp for flat specs, cnn for pixel specs), "mlp",
+    "cnn", or a name registered via ``register_module_builder``.
+    """
+
+    encoder: str = "auto"
+    hidden: Sequence[int] = (64, 64)
+    activation: str = "tanh"            # "tanh" | "relu"
+    encoder_out: int = 512              # cnn feature width
+    free_log_std: bool = True           # continuous: global learned std
+    builder_kwargs: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+
+# name -> builder(key, env_spec, module_spec) -> params pytree
+_MODULE_BUILDERS: Dict[str, Callable] = {}
+
+
+def register_module_builder(name: str, builder: Callable) -> None:
+    """Catalog extension hook (reference: subclassing ``Catalog`` to
+    swap encoders). ``builder(key, env_spec, module_spec)`` must return
+    a params pytree with the standard ``pi``/``vf`` (+ ``enc`` /
+    ``log_std``) layout."""
+    _MODULE_BUILDERS[name] = builder
+
+
+def _act_marker(activation: str) -> jnp.ndarray:
+    if activation == "tanh":
+        return jnp.zeros(0)
+    if activation == "relu":
+        return jnp.zeros(1)
+    raise ValueError(f"unknown activation {activation!r} "
+                     "(expected 'tanh' or 'relu')")
+
+
+def _check_log_std(spec: EnvSpec, ms: ModuleSpec) -> None:
+    if not spec.discrete and not ms.free_log_std:
+        raise ValueError(
+            "continuous-action modules require free_log_std=True (the "
+            "losses and exploration paths read params['log_std']; a "
+            "state-dependent std head is not supported)")
+
+
+def _build_mlp_module(key, spec: EnvSpec, ms: ModuleSpec) -> Dict:
+    _check_log_std(spec, ms)
+    pk, vk = jax.random.split(key)
+    out = spec.num_actions if spec.discrete else spec.action_dim
+    marker = _act_marker(ms.activation)
+    pi = models.init_mlp(pk, [spec.obs_dim, *ms.hidden, out])
+    vf = models.init_mlp(vk, [spec.obs_dim, *ms.hidden, 1], out_scale=1.0)
+    if marker.shape[0]:
+        pi["act"] = marker
+        vf["act"] = jnp.array(marker)
+    params = {"pi": pi, "vf": vf}
+    if not spec.discrete and ms.free_log_std:
+        params["log_std"] = jnp.zeros(spec.action_dim)
+    return params
+
+
+def _build_cnn_module(key, spec: EnvSpec, ms: ModuleSpec) -> Dict:
+    if not spec.is_pixel:
+        raise ValueError("cnn encoder needs a pixel EnvSpec (obs_shape "
+                         "of rank 3)")
+    _check_log_std(spec, ms)
+    pk, vk, ek = jax.random.split(key, 3)
+    out = spec.num_actions if spec.discrete else spec.action_dim
+    feat = ms.encoder_out
+    params = {
+        "enc": models.init_cnn(ek, spec.obs_shape, feat),
+        "pi": models.init_mlp(pk, [feat, out]),
+        "vf": models.init_mlp(vk, [feat, 1], out_scale=1.0),
+    }
+    if not spec.discrete and ms.free_log_std:
+        params["log_std"] = jnp.zeros(spec.action_dim)
+    return params
+
+
+_MODULE_BUILDERS["mlp"] = _build_mlp_module
+_MODULE_BUILDERS["cnn"] = _build_cnn_module
+
+
+class RLModule:
+    """Params + the three forward contracts of the reference RLModule:
+
+    - ``forward_inference``: greedy/deterministic actions
+    - ``forward_exploration``: stochastic actions + logp
+    - ``forward_train``: logits/values for the learner loss
+    """
+
+    def __init__(self, params: Dict, env_spec: EnvSpec,
+                 module_spec: Optional[ModuleSpec] = None):
+        self.params = params
+        self.env_spec = env_spec
+        self.module_spec = module_spec or ModuleSpec()
+        spec = env_spec
+
+        @jax.jit
+        def fwd_train(p, obs):
+            return {"action_logits": models.policy_logits(p, obs),
+                    "values": models.value(p, obs)}
+
+        @jax.jit
+        def fwd_inference(p, obs):
+            logits = models.policy_logits(p, obs)
+            if spec.discrete:
+                return jnp.argmax(logits, axis=-1)
+            return jnp.clip(logits, spec.action_low, spec.action_high)
+
+        @jax.jit
+        def fwd_exploration(p, obs, key):
+            logits = models.policy_logits(p, obs)
+            if spec.discrete:
+                acts = models.categorical_sample(key, logits)
+                logp = models.categorical_logp(logits, acts)
+            else:
+                acts = models.gaussian_sample(key, logits, p["log_std"])
+                logp = models.gaussian_logp(logits, p["log_std"], acts)
+                acts = jnp.clip(acts, spec.action_low, spec.action_high)
+            return acts, logp
+
+        self._fwd_train = fwd_train
+        self._fwd_inference = fwd_inference
+        self._fwd_exploration = fwd_exploration
+
+    def forward_train(self, obs) -> Dict[str, jnp.ndarray]:
+        return self._fwd_train(self.params, jnp.asarray(obs))
+
+    def forward_inference(self, obs) -> np.ndarray:
+        return np.asarray(self._fwd_inference(self.params,
+                                              jnp.asarray(obs)))
+
+    def forward_exploration(self, obs, key):
+        acts, logp = self._fwd_exploration(self.params, jnp.asarray(obs),
+                                           key)
+        return np.asarray(acts), np.asarray(logp)
+
+    # -- state ------------------------------------------------------------
+
+    def get_state(self) -> Dict:
+        return jax.tree_util.tree_map(np.asarray, self.params)
+
+    def set_state(self, state: Dict) -> None:
+        self.params = jax.tree_util.tree_map(jnp.asarray, state)
+
+    def num_params(self) -> int:
+        return models.num_params(self.params)
+
+
+class Catalog:
+    """Resolves (EnvSpec, ModuleSpec) -> initialized RLModule."""
+
+    @staticmethod
+    def build(env_spec: EnvSpec,
+              module_spec: Optional[ModuleSpec] = None,
+              seed: int = 0) -> RLModule:
+        ms = module_spec or ModuleSpec()
+        name = ms.encoder
+        if name == "auto":
+            name = "cnn" if env_spec.is_pixel else "mlp"
+        if name not in _MODULE_BUILDERS:
+            raise ValueError(
+                f"unknown module builder {name!r}; registered: "
+                f"{sorted(_MODULE_BUILDERS)}")
+        params = _MODULE_BUILDERS[name](jax.random.key(seed), env_spec, ms)
+        return RLModule(params, env_spec, ms)
+
+    @staticmethod
+    def build_params(env_spec: EnvSpec,
+                     module_spec: Optional[ModuleSpec] = None,
+                     seed: int = 0) -> Dict:
+        """Just the initialized param pytree (what Algorithm.build_learner
+        feeds its Learner when ``config.module_spec`` is set)."""
+        return Catalog.build(env_spec, module_spec, seed).params
+
+
+class MultiAgentRLModule:
+    """Policy-id -> RLModule container (reference ``marl_module.py``)."""
+
+    def __init__(self, modules: Dict[str, RLModule]):
+        self._modules = dict(modules)
+
+    def __getitem__(self, policy_id: str) -> RLModule:
+        return self._modules[policy_id]
+
+    def __contains__(self, policy_id: str) -> bool:
+        return policy_id in self._modules
+
+    def keys(self):
+        return self._modules.keys()
+
+    def items(self):
+        return self._modules.items()
+
+    def get_state(self) -> Dict[str, Dict]:
+        return {pid: m.get_state() for pid, m in self._modules.items()}
+
+    def set_state(self, state: Dict[str, Dict]) -> None:
+        for pid, s in state.items():
+            self._modules[pid].set_state(s)
+
+    @staticmethod
+    def build(env_specs: Dict[str, EnvSpec],
+              module_specs: Optional[Dict[str, ModuleSpec]] = None,
+              seed: int = 0) -> "MultiAgentRLModule":
+        module_specs = module_specs or {}
+        return MultiAgentRLModule({
+            pid: Catalog.build(es, module_specs.get(pid), seed + i)
+            for i, (pid, es) in enumerate(sorted(env_specs.items()))})

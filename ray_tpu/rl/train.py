@@ -27,12 +27,16 @@ def algorithm_registry() -> Dict[str, type]:
     return {
         "PPO": rl.PPOConfig, "APPO": rl.APPOConfig,
         "IMPALA": rl.IMPALAConfig, "A2C": rl.A2CConfig,
-        "DQN": rl.DQNConfig, "SAC": rl.SACConfig,
+        "PG": rl.PGConfig, "MAML": rl.MAMLConfig,
+        "DQN": rl.DQNConfig, "APEXDQN": rl.ApexDQNConfig,
+        "SAC": rl.SACConfig,
         "DDPG": rl.DDPGConfig, "TD3": rl.TD3Config,
         "BC": rl.BCConfig, "MARWIL": rl.MARWILConfig,
         "CQL": rl.CQLConfig, "CRR": rl.CRRConfig, "DT": rl.DTConfig,
         "ES": rl.ESConfig, "ARS": rl.ARSConfig,
-        "QMIX": rl.QMIXConfig, "ALPHAZERO": rl.AlphaZeroConfig,
+        "QMIX": rl.QMIXConfig, "MADDPG": rl.MADDPGConfig,
+        "SLATEQ": rl.SlateQConfig, "DREAMERV3": rl.DreamerV3Config,
+        "ALPHAZERO": rl.AlphaZeroConfig,
         "R2D2": rl.R2D2Config,
         "BANDITLINUCB": rl.BanditConfig, "BANDITLINTS": rl.BanditConfig,
     }

@@ -1,0 +1,118 @@
+"""MADDPG (centralized-critic multi-agent DDPG) + the SpreadGame env.
+
+Reference analog: ``rllib/algorithms/maddpg/`` (Lowe et al. 2017, MPE
+particle envs).
+"""
+
+import numpy as np
+import pytest
+
+from ray_tpu import rl
+from ray_tpu.rl.multi_agent import SpreadGame
+
+
+def test_spread_env_mechanics():
+    env = SpreadGame(num_envs=4, horizon=5, seed=0)
+    obs = env.reset()
+    assert set(obs) == {"a0", "a1"}
+    assert obs["a0"].shape == (4, 8)
+    # standing still for `horizon` steps terminates every env
+    zeros = {a: np.zeros((4, 2), np.float32) for a in env.agents}
+    for t in range(5):
+        obs, rewards, dones = env.step(zeros)
+        assert rewards["a0"].shape == (4,)
+        # shared-reward game: both agents see the identical signal
+        np.testing.assert_allclose(rewards["a0"], rewards["a1"])
+        assert (rewards["a0"] <= 0).all()  # negative coverage distance
+    assert dones.all()
+
+
+def test_spread_reward_improves_when_agents_cover_landmarks():
+    env = SpreadGame(num_envs=2, horizon=50, seed=1)
+    env.reset()
+    base = env._coverage_reward().copy()
+    # teleport agents onto the landmarks: reward must rise to ~0
+    env._pos[:] = env._land
+    on_target = env._coverage_reward()
+    assert (on_target > base).all()
+    np.testing.assert_allclose(on_target, 0.0, atol=1e-6)
+
+
+def test_maddpg_rejects_discrete():
+    cfg = rl.MADDPGConfig()
+    cfg.env = "coordination"
+    with pytest.raises(ValueError, match="continuous"):
+        cfg.build()
+
+
+def test_maddpg_smoke():
+    cfg = rl.MADDPGConfig()
+    cfg.num_envs_per_runner = 8
+    cfg.rollout_fragment_length = 10
+    cfg.learning_starts = 50
+    cfg.minibatch_size = 32
+    cfg.updates_per_iter = 4
+    algo = cfg.build()
+    m = {}
+    for _ in range(3):
+        m = algo.step()
+    assert np.isfinite(m["critic_loss_0"])
+    assert np.isfinite(m["actor_loss_1"])
+    assert m["env_steps_total"] == 3 * 10 * 8
+
+
+@pytest.mark.slow
+def test_maddpg_learns_spread():
+    """Centralized critics + decentralized actors must beat the random
+    baseline on the coverage game (dense shaped reward; ~100 iters)."""
+    cfg = rl.MADDPGConfig()
+    cfg.num_envs_per_runner = 16
+    cfg.rollout_fragment_length = 25
+    cfg.learning_starts = 400
+    cfg.minibatch_size = 128
+    cfg.updates_per_iter = 64
+    cfg.noise_decay_steps = 4_000
+    cfg.env_config = {"horizon": 25, "seed": 3}
+    cfg.seed = 3
+    algo = cfg.build()
+
+    # random-policy baseline on a fresh env
+    env = SpreadGame(num_envs=16, horizon=25, seed=7)
+    env.reset()
+    rng = np.random.default_rng(7)
+    rand_returns, ep = [], np.zeros(16)
+    for _ in range(100):
+        acts = {a: rng.uniform(-1, 1, (16, 2)).astype(np.float32)
+                for a in env.agents}
+        _, rewards, dones = env.step(acts)
+        ep += np.mean([rewards[a] for a in env.agents], axis=0)
+        for i in np.nonzero(dones)[0]:
+            rand_returns.append(ep[i])
+            ep[i] = 0.0
+    baseline = float(np.mean(rand_returns))
+
+    best = -np.inf
+    for it in range(120):
+        algo.step()
+        if (it + 1) % 20 == 0 and it >= 59:
+            res = algo.evaluate(num_episodes=16)
+            best = max(best, res["episode_return_mean"])
+            if best > baseline + 3.0:
+                break
+    assert best > baseline + 3.0, (best, baseline)
+
+
+def test_maddpg_checkpoint_roundtrip():
+    cfg = rl.MADDPGConfig()
+    cfg.num_envs_per_runner = 4
+    cfg.rollout_fragment_length = 5
+    cfg.learning_starts = 10_000  # never updates: pure rollout smoke
+    algo = cfg.build()
+    algo.step()
+    state = algo.save_checkpoint("/tmp/unused")
+    algo2 = rl.MADDPGConfig().build()
+    algo2.load_checkpoint(state)
+    p1 = algo.learner.get_params()["actors"][0]
+    p2 = algo2.learner.get_params()["actors"][0]
+    for a, b in zip(sorted(p1), sorted(p2)):
+        assert a == b

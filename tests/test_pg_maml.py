@@ -1,0 +1,104 @@
+"""PG (REINFORCE) and MAML (meta-RL) additions.
+
+Reference analogs: ``rllib/algorithms/pg/`` and ``rllib/algorithms/maml/``.
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import rl
+from ray_tpu.rl.algorithms.maml import PointGoal
+
+
+@pytest.fixture
+def rl_cluster():
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=4)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+# -------------------------------------------------------------------- PG --
+
+def test_pg_config_pins_on_policy():
+    cfg = rl.PGConfig()
+    assert cfg.lambda_ == 1.0
+    assert cfg.num_epochs == 1
+
+
+def test_pg_learns_cartpole(rl_cluster):
+    """The minimal REINFORCE baseline still has to lift CartPole returns
+    well above random (~20) with monte-carlo targets."""
+    cfg = rl.PGConfig()
+    cfg.env = "CartPole-v1"
+    cfg.num_env_runners = 2
+    cfg.num_envs_per_runner = 8
+    cfg.rollout_fragment_length = 128
+    cfg.entropy_coeff = 0.005
+    algo = cfg.build()
+    try:
+        best = -np.inf
+        for _ in range(25):
+            m = algo.training_step()
+            best = max(best, m.get("episode_return_mean", -np.inf))
+            if best >= 80:
+                break
+        assert best >= 80, best
+    finally:
+        algo.stop()
+
+
+# ------------------------------------------------------------------ MAML --
+
+def test_point_goal_env():
+    env = PointGoal((1.0, 0.0), num_envs=4, horizon=3, seed=0)
+    obs = env.reset()
+    assert obs.shape == (4, 2)
+    # moving straight toward the goal must beat standing still
+    right = np.tile([1.0, 0.0], (4, 1)).astype(np.float32)
+    _, r_move, _ = env.step(right)
+    env.reset()
+    _, r_still, _ = env.step(np.zeros((4, 2), np.float32))
+    assert (r_move > r_still).all()
+    # horizon termination
+    env.reset()
+    for _ in range(3):
+        _, _, dones = env.step(right)
+    assert dones.all()
+
+
+def test_maml_adaptation_gain_improves():
+    """The MAML property: after meta-training, one inner-loop gradient
+    step on a FRESH task must improve that task's reward, and the gain
+    should exceed the untrained initialization's gain."""
+    cfg = rl.MAMLConfig()
+    cfg.seed = 0
+    algo = cfg.build()
+    before = algo.evaluate(num_tasks=8)
+    m = {}
+    for _ in range(30):
+        m = algo.step()
+    after = algo.evaluate(num_tasks=8)
+    assert np.isfinite(m["meta_loss"])
+    # post-adaptation reward improves over the course of meta-training
+    assert after["post_adapt_reward"] > before["post_adapt_reward"], \
+        (before, after)
+    # and adaptation genuinely helps on fresh tasks after meta-training
+    assert after["adaptation_gain"] > 0.05, after
+
+
+def test_maml_checkpoint_roundtrip():
+    cfg = rl.MAMLConfig()
+    cfg.meta_batch_size = 2
+    cfg.num_envs_per_runner = 4
+    cfg.horizon = 8
+    algo = cfg.build()
+    algo.step()
+    state = algo.save_checkpoint("/tmp/unused")
+    algo2 = rl.MAMLConfig().build()
+    algo2.load_checkpoint(state)
+    a = algo.params["log_std"]
+    b = algo2.params["log_std"]
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b))

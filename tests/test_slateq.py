@@ -1,0 +1,95 @@
+"""SlateQ: decomposed slate Q-learning on the RecSim-analog env.
+
+Reference analog: ``rllib/algorithms/slateq/``.
+"""
+
+import numpy as np
+import pytest
+
+from ray_tpu import rl
+from ray_tpu.rl.algorithms.slateq import RecSlateEnv
+
+
+def test_recslate_env_mechanics():
+    env = RecSlateEnv(num_envs=4, num_docs=6, slate_size=2, horizon=3,
+                      seed=0)
+    obs = env.reset()
+    assert obs.shape == (4, env.obs_dim)
+    slates = np.tile([0, 1], (4, 1))
+    for _ in range(3):
+        obs, rew, dones, clicked = env.step(slates)
+    assert dones.all()
+    assert (rew >= 0).all()
+    assert set(np.unique(clicked)).issubset({-1, 0, 1})
+
+
+def test_recslate_choice_model_prefers_aligned_docs():
+    """Click probability must be highest for the document best aligned
+    with the user's interest vector."""
+    env = RecSlateEnv(num_envs=1, num_docs=4, slate_size=2, seed=1,
+                      no_click_bias=-10.0)  # force a click
+    env.reset()
+    # craft: doc 0 = interest, doc 1 = -interest
+    env._docs[0, 0] = env._user[0]
+    env._docs[0, 1] = -env._user[0]
+    probs = env.choice_probs(np.asarray([[0, 1]]))
+    assert probs[0, 0] > probs[0, 1]
+    assert probs[0, 2] < 1e-3  # no-click suppressed
+
+
+def test_slateq_learns_to_recommend():
+    """Greedy slates after training must collect more engagement than
+    random slates (quality-weighted clicks)."""
+    cfg = rl.SlateQConfig()
+    cfg.num_envs_per_runner = 16
+    cfg.rollout_fragment_length = 20
+    cfg.learning_starts = 500
+    cfg.updates_per_iter = 32
+    cfg.epsilon_decay_steps = 4_000
+    cfg.seed = 0
+    algo = cfg.build()
+
+    # random-slate baseline
+    env = RecSlateEnv(num_envs=16, num_docs=cfg.num_docs,
+                      slate_size=cfg.slate_size, horizon=20, seed=99)
+    env.reset()
+    rng = np.random.default_rng(99)
+    returns, ep = [], np.zeros(16)
+    for _ in range(80):
+        slates = np.stack([rng.choice(cfg.num_docs, cfg.slate_size,
+                                      replace=False) for _ in range(16)])
+        _, rew, dones, _ = env.step(slates)
+        ep += rew
+        for i in np.nonzero(dones)[0]:
+            returns.append(ep[i])
+            ep[i] = 0.0
+    baseline = float(np.mean(returns))
+
+    best = -np.inf
+    for it in range(40):
+        m = algo.step()
+        if (it + 1) % 10 == 0:
+            res = algo.evaluate(num_episodes=16)
+            best = max(best, res["episode_return_mean"])
+            if best > baseline * 1.15:
+                break
+    assert np.isfinite(m["td_abs_mean"])
+    assert best > baseline * 1.15, (best, baseline)
+
+
+def test_slateq_checkpoint_roundtrip():
+    cfg = rl.SlateQConfig()
+    cfg.num_envs_per_runner = 4
+    cfg.rollout_fragment_length = 5
+    cfg.learning_starts = 10_000
+    algo = cfg.build()
+    algo.step()
+    state = algo.save_checkpoint("/tmp/unused")
+    algo2 = rl.SlateQConfig().build()
+    algo2.load_checkpoint(state)
+    import jax
+
+    a = jax.tree_util.tree_leaves(algo.learner.get_params())
+    b = jax.tree_util.tree_leaves(algo2.learner.get_params())
+    for x, y in zip(a, b):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y))
