@@ -269,7 +269,10 @@ class DreamerV3(Trainable):
                 scan_fn, (h0, z0), ks)
             return fts, acts, logits  # [H, B*T, ...]
 
-        def ac_losses(actor, critic, wm, h0, z0, key, ret_scale):
+        def actor_loss_fn(actor, critic, wm, h0, z0, key, ret_scale):
+            """ONE imagination rollout serves both losses: the actor's
+            REINFORCE term here, and (via the stop-gradient aux) the
+            critic regression in train_step."""
             wm = jax.lax.stop_gradient(wm)
             fts, acts, logits = imagine(wm, actor, h0, z0, key)
             fts_a = jnp.concatenate(
@@ -291,7 +294,6 @@ class DreamerV3(Trainable):
                                    jnp.arange(H - 2, -1, -1))
             rets = rets[::-1]                                # [H-1, N]
             rets_sg = jax.lax.stop_gradient(rets)
-            critic_loss = jnp.mean((values[:-1] - rets_sg) ** 2)
             adv = (rets_sg - jax.lax.stop_gradient(values[:-1])) \
                 / jnp.maximum(ret_scale, 1.0)
             logp = jax.nn.log_softmax(logits[:-1], axis=-1)
@@ -299,7 +301,8 @@ class DreamerV3(Trainable):
                 logp, acts[:-1][..., None], axis=-1)[..., 0]
             entropy = -jnp.sum(jnp.exp(logp) * logp, axis=-1).mean()
             actor_loss = -jnp.mean(lp_a * adv) - ent_coeff * entropy
-            return actor_loss, critic_loss, rets_sg, entropy
+            aux = (jax.lax.stop_gradient(fts), rets_sg, entropy)
+            return actor_loss, aux
 
         @jax.jit
         def train_step(wm, actor, critic, opt_states, batch, key,
@@ -313,25 +316,26 @@ class DreamerV3(Trainable):
             h0, z0 = wm_aux.pop("states")
 
             def a_loss_fn(a):
-                al, _, rets, ent = ac_losses(a, critic, wm, h0, z0, k2,
-                                             ret_scale)
-                return al, (rets, ent)
+                return actor_loss_fn(a, critic, wm, h0, z0, k2,
+                                     ret_scale)
 
-            (al, (rets, ent)), a_grads = jax.value_and_grad(
+            (al, (fts_sg, rets_sg, ent)), a_grads = jax.value_and_grad(
                 a_loss_fn, has_aux=True)(actor)
             upd, a_state = self._a_opt.update(a_grads, a_state, actor)
             actor = optax.apply_updates(actor, upd)
 
+            # critic regresses on the SAME (pre-update-actor) rollout:
+            # targets are the lambda returns computed with the pre-update
+            # critic, stop-gradded — no second imagination pass
             def c_loss_fn(c):
-                _, cl, _, _ = ac_losses(actor, c, wm, h0, z0, k2,
-                                        ret_scale)
-                return cl
+                vals = _fwd(c, fts_sg)[..., 0]
+                return jnp.mean((vals[:-1] - rets_sg) ** 2)
 
             cl, c_grads = jax.value_and_grad(c_loss_fn)(critic)
             upd, c_state = self._c_opt.update(c_grads, c_state, critic)
             critic = optax.apply_updates(critic, upd)
-            lo = jnp.percentile(rets, 5)
-            hi = jnp.percentile(rets, 95)
+            lo = jnp.percentile(rets_sg, 5)
+            hi = jnp.percentile(rets_sg, 95)
             metrics = dict(wm_aux, wm_loss=wl, actor_loss=al,
                            critic_loss=cl, actor_entropy=ent,
                            ret_range=hi - lo)
@@ -364,8 +368,9 @@ class DreamerV3(Trainable):
         self._buf_steps = 0
         self._rng = np.random.default_rng(cfg.seed)
         self._env_steps_total = 0
-        self._return_window: List[float] = []
-        self._ep_return = np.zeros(N, dtype=np.float64)
+        from ray_tpu.rl.evaluation import ReturnWindow
+
+        self._returns = ReturnWindow(N)
 
     # -- collection -------------------------------------------------------
 
@@ -392,10 +397,7 @@ class DreamerV3(Trainable):
             self._is_first = dones.astype(np.float32)
             self._obs = next_obs
             self._env_steps_total += N
-            self._ep_return += rew
-            for i in np.nonzero(dones)[0]:
-                self._return_window.append(float(self._ep_return[i]))
-                self._ep_return[i] = 0.0
+            self._returns.add(rew, dones)
         chunk = {k: np.stack(v, axis=1) for k, v in rows.items()}  # [N,T]
         self._chunks.append(chunk)
         self._buf_steps += steps * N
@@ -405,7 +407,6 @@ class DreamerV3(Trainable):
             drop = len(self._chunks) - max_chunks
             del self._chunks[:drop]
             self._buf_steps = sum(c["rewards"].size for c in self._chunks)
-        self._return_window = self._return_window[-100:]
 
     def _sample_batch(self) -> Dict[str, np.ndarray]:
         cfg = self.config
@@ -446,9 +447,9 @@ class DreamerV3(Trainable):
                 metrics[k] = float(np.mean([float(x[k]) for x in mlist]))
             metrics["ret_scale"] = self._ret_scale
         metrics["env_steps_total"] = self._env_steps_total
-        if self._return_window:
-            metrics["episode_return_mean"] = float(
-                np.mean(self._return_window))
+        mean_ret = self._returns.mean()
+        if mean_ret is not None:
+            metrics["episode_return_mean"] = mean_ret
         return metrics
 
     def evaluate(self, num_episodes: int = 10) -> Dict[str, Any]:
@@ -462,28 +463,26 @@ class DreamerV3(Trainable):
         z = jnp.zeros((N, Z))
         a_prev = jnp.zeros((N, self._A))
         is_first = np.ones(N, dtype=np.float32)
-        key = jax.random.key(cfg.seed + 12345)
-        obs = env.reset()
-        done_returns: List[float] = []
-        ep_ret = np.zeros(N, dtype=np.float64)
-        for _ in range(4096):
-            key, sub = jax.random.split(key)
-            h, z, a = self._act_fn(self.wm, self.actor, h, z, a_prev,
-                                   jnp.asarray(obs),
-                                   jnp.asarray(is_first), sub)
+        from ray_tpu.rl.evaluation import run_episodes
+
+        state = {"h": h, "z": z, "a_prev": a_prev, "is_first": is_first,
+                 "obs": env.reset(),
+                 "key": jax.random.key(cfg.seed + 12345)}
+
+        def step():
+            state["key"], sub = jax.random.split(state["key"])
+            state["h"], state["z"], a = self._act_fn(
+                self.wm, self.actor, state["h"], state["z"],
+                state["a_prev"], jnp.asarray(state["obs"]),
+                jnp.asarray(state["is_first"]), sub)
             acts = np.asarray(a)
-            obs, rew, dones = env.step(acts)
-            a_prev = jnp.asarray(np.eye(self._A, dtype=np.float32)[acts])
-            is_first = dones.astype(np.float32)
-            ep_ret += rew
-            for i in np.nonzero(dones)[0]:
-                done_returns.append(float(ep_ret[i]))
-                ep_ret[i] = 0.0
-            if len(done_returns) >= num_episodes:
-                break
-        return {"episodes": len(done_returns),
-                "episode_return_mean": float(np.mean(done_returns))
-                if done_returns else float("nan")}
+            state["obs"], rew, dones = env.step(acts)
+            state["a_prev"] = jnp.asarray(
+                np.eye(self._A, dtype=np.float32)[acts])
+            state["is_first"] = dones.astype(np.float32)
+            return rew, dones
+
+        return run_episodes(step, num_episodes, N)
 
     # -- checkpointing ----------------------------------------------------
 

@@ -158,8 +158,9 @@ class MADDPG(Trainable):
         self._rng = np.random.default_rng(cfg.seed)
         self._obs = self.env.reset()
         self._env_steps_total = 0
-        self._return_window: List[float] = []
-        self._ep_return = np.zeros(self.env.num_envs, dtype=np.float64)
+        from ray_tpu.rl.evaluation import ReturnWindow
+
+        self._returns = ReturnWindow(self.env.num_envs)
 
     # -- rollout ----------------------------------------------------------
 
@@ -199,12 +200,8 @@ class MADDPG(Trainable):
             self._env_steps_total += n_envs
             team_r = rew.mean(axis=1)
             reward_sum += float(team_r.sum())
-            self._ep_return += team_r
-            for i in np.nonzero(dones)[0]:
-                self._return_window.append(float(self._ep_return[i]))
-                self._ep_return[i] = 0.0
+            self._returns.add(team_r, dones)
             self._obs = next_obs
-        self._return_window = self._return_window[-100:]
         return reward_sum / max(1, steps * n_envs)
 
     # -- Trainable API ----------------------------------------------------
@@ -224,36 +221,32 @@ class MADDPG(Trainable):
             for k in mlist[0]:
                 metrics[k] = float(np.mean([float(m[k]) for m in mlist]))
         metrics["env_steps_total"] = self._env_steps_total
-        if self._return_window:
-            metrics["episode_return_mean"] = float(
-                np.mean(self._return_window))
+        mean_ret = self._returns.mean()
+        if mean_ret is not None:
+            metrics["episode_return_mean"] = mean_ret
         return metrics
 
     def evaluate(self, num_episodes: int = 10) -> Dict[str, Any]:
         """Noise-free episodes on a fresh env instance."""
+        from ray_tpu.rl.evaluation import run_episodes
+
         cfg = self.config
         ctor = _MA_ENVS[cfg.env] if isinstance(cfg.env, str) else cfg.env
         env: MultiAgentEnv = ctor(num_envs=cfg.num_envs_per_runner,
                                   **(cfg.env_config or {}))
-        obs = env.reset()
-        done_returns: List[float] = []
-        ep_ret = np.zeros(env.num_envs, dtype=np.float64)
+        state = {"obs": env.reset()}
         actors = self.learner.get_params()["actors"]
-        for _ in range(4096):
-            stacked = self._stack_obs(obs)
+
+        def step():
+            stacked = self._stack_obs(state["obs"])
             acts = np.asarray(self._act_all(actors, jnp.asarray(stacked)))
             act_dict = {a: acts[:, i]
                         for i, a in enumerate(self.agents)}
-            obs, rewards, dones = env.step(act_dict)
-            ep_ret += np.mean([rewards[a] for a in self.agents], axis=0)
-            for i in np.nonzero(dones)[0]:
-                done_returns.append(float(ep_ret[i]))
-                ep_ret[i] = 0.0
-            if len(done_returns) >= num_episodes:
-                break
-        return {"episodes": len(done_returns),
-                "episode_return_mean": float(np.mean(done_returns))
-                if done_returns else float("nan")}
+            state["obs"], rewards, dones = env.step(act_dict)
+            team = np.mean([rewards[a] for a in self.agents], axis=0)
+            return team, dones
+
+        return run_episodes(step, num_episodes, env.num_envs)
 
     # -- checkpointing ----------------------------------------------------
 

@@ -246,8 +246,9 @@ class SlateQ(Trainable):
         self._rng = np.random.default_rng(cfg.seed)
         self._obs = self.env.reset()
         self._env_steps_total = 0
-        self._return_window: List[float] = []
-        self._ep_return = np.zeros(self.env.num_envs, dtype=np.float64)
+        from ray_tpu.rl.evaluation import ReturnWindow
+
+        self._returns = ReturnWindow(self.env.num_envs)
 
     @property
     def _epsilon(self) -> float:
@@ -285,12 +286,8 @@ class SlateQ(Trainable):
                  "rewards": rewards, "dones": dones.astype(np.float32),
                  "next_obs": next_obs})
             self._env_steps_total += n_envs
-            self._ep_return += rewards
-            for i in np.nonzero(dones)[0]:
-                self._return_window.append(float(self._ep_return[i]))
-                self._ep_return[i] = 0.0
+            self._returns.add(rewards, dones)
             self._obs = next_obs
-        self._return_window = self._return_window[-100:]
 
     def step(self) -> Dict[str, Any]:
         cfg = self.config
@@ -314,35 +311,31 @@ class SlateQ(Trainable):
             for k in mlist[0]:
                 metrics[k] = float(np.mean([float(m[k]) for m in mlist]))
         metrics["env_steps_total"] = self._env_steps_total
-        if self._return_window:
-            metrics["episode_return_mean"] = float(
-                np.mean(self._return_window))
+        mean_ret = self._returns.mean()
+        if mean_ret is not None:
+            metrics["episode_return_mean"] = mean_ret
         return metrics
 
     def evaluate(self, num_episodes: int = 10) -> Dict[str, Any]:
         """Greedy slates on a fresh env."""
+        from ray_tpu.rl.evaluation import run_episodes
+
         cfg = self.config
         env = RecSlateEnv(
             num_envs=cfg.num_envs_per_runner, num_docs=cfg.num_docs,
             slate_size=cfg.slate_size, feat_dim=cfg.feat_dim,
             horizon=cfg.recsim_horizon, seed=cfg.seed + 777,
             **(cfg.env_config or {}))
-        obs = env.reset()
+        state = {"obs": env.reset()}
         qnet = self.learner.get_params()["q"]
-        done_returns: List[float] = []
-        ep_ret = np.zeros(env.num_envs, dtype=np.float64)
-        for _ in range(4096):
-            slates = np.asarray(self._greedy_slate(qnet, jnp.asarray(obs)))
-            obs, rewards, dones, _ = env.step(slates)
-            ep_ret += rewards
-            for i in np.nonzero(dones)[0]:
-                done_returns.append(float(ep_ret[i]))
-                ep_ret[i] = 0.0
-            if len(done_returns) >= num_episodes:
-                break
-        return {"episodes": len(done_returns),
-                "episode_return_mean": float(np.mean(done_returns))
-                if done_returns else float("nan")}
+
+        def step():
+            slates = np.asarray(self._greedy_slate(
+                qnet, jnp.asarray(state["obs"])))
+            state["obs"], rewards, dones, _ = env.step(slates)
+            return rewards, dones
+
+        return run_episodes(step, num_episodes, env.num_envs)
 
     def save_checkpoint(self, checkpoint_dir: str) -> Optional[Dict]:
         return {"params": jax.tree_util.tree_map(

@@ -29,6 +29,7 @@ def algorithm_registry() -> Dict[str, type]:
         "IMPALA": rl.IMPALAConfig, "A2C": rl.A2CConfig,
         "PG": rl.PGConfig, "MAML": rl.MAMLConfig,
         "DQN": rl.DQNConfig, "APEXDQN": rl.ApexDQNConfig,
+        "SIMPLEQ": rl.DQNConfig,
         "SAC": rl.SACConfig,
         "DDPG": rl.DDPGConfig, "TD3": rl.TD3Config,
         "BC": rl.BCConfig, "MARWIL": rl.MARWILConfig,
@@ -55,6 +56,10 @@ def get_algorithm_config(run: str):
 
         cfg.algo_class = (rl.BanditLinTS if key == "BANDITLINTS"
                           else rl.BanditLinUCB)
+    elif key == "SIMPLEQ":
+        # reference SimpleQ = DQN without the DQN-paper add-ons
+        cfg.double_q = False
+        cfg.prioritized_replay = False
     return cfg
 
 
@@ -132,6 +137,72 @@ def run_train(run: str, env: Optional[str] = None,
         if stop:
             stop()
     return result
+
+
+def tuned_examples_dir() -> str:
+    """The bundled convergence-config zoo (reference:
+    ``rllib/tuned_examples/``)."""
+    return os.path.join(os.path.dirname(__file__), "tuned_examples")
+
+
+def load_tuned_example(name_or_path: str) -> Dict[str, Any]:
+    """Load one experiment from a tuned-example YAML.
+
+    Accepts a path or a bare name resolved against the bundled zoo
+    (``cartpole-ppo`` -> ``rl/tuned_examples/cartpole-ppo.yaml``). The
+    file uses the reference's format: one top-level experiment key with
+    ``run`` / ``env`` / ``stop`` / ``config`` fields.
+    """
+    import yaml
+
+    path = name_or_path
+    if not os.path.exists(path):
+        candidate = os.path.join(tuned_examples_dir(),
+                                 name_or_path.replace(".yaml", "")
+                                 + ".yaml")
+        if os.path.exists(candidate):
+            path = candidate
+        else:
+            raise FileNotFoundError(
+                f"{name_or_path!r} is neither a file nor a bundled tuned "
+                f"example; bundled: {sorted(list_tuned_examples())}")
+    with open(path) as f:
+        doc = yaml.safe_load(f)
+    if not isinstance(doc, dict) or not doc:
+        raise ValueError(f"{path}: expected one top-level experiment key")
+    name, exp = next(iter(doc.items()))
+    if "run" not in exp:
+        raise ValueError(f"{path}: experiment {name!r} needs a 'run' key")
+    return {"name": name, **exp}
+
+
+def list_tuned_examples() -> list:
+    d = tuned_examples_dir()
+    if not os.path.isdir(d):
+        return []
+    return [f[:-5] for f in sorted(os.listdir(d)) if f.endswith(".yaml")]
+
+
+def run_tuned_example(name_or_path: str,
+                      checkpoint_dir: Optional[str] = None,
+                      stop_iters: Optional[int] = None,
+                      stop_reward: Optional[float] = None,
+                      stop_timesteps: Optional[int] = None,
+                      out=sys.stdout) -> Dict[str, Any]:
+    """Train a bundled (or user) tuned example to its stop criteria.
+    Explicit stop arguments override the YAML's ``stop`` block."""
+    exp = load_tuned_example(name_or_path)
+    stop = exp.get("stop") or {}
+    return run_train(
+        exp["run"], env=exp.get("env"),
+        config_json=json.dumps(exp.get("config") or {}),
+        stop_iters=int(stop_iters if stop_iters is not None
+                       else stop.get("training_iteration", 100)),
+        stop_reward=(stop_reward if stop_reward is not None
+                     else stop.get("episode_return_mean")),
+        stop_timesteps=(stop_timesteps if stop_timesteps is not None
+                        else stop.get("timesteps_total")),
+        checkpoint_dir=checkpoint_dir, out=out)
 
 
 def run_evaluate(checkpoint_dir: str, run: Optional[str] = None,

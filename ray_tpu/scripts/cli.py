@@ -328,6 +328,22 @@ def cmd_rl(args: argparse.Namespace) -> int:
     import ray_tpu
     from ray_tpu.rl import train as rl_train
 
+    if args.rl_cmd == "examples":  # pure listing: no cluster needed
+        for name in rl_train.list_tuned_examples():
+            print(name)
+        return 0
+    if args.rl_cmd == "train" and not args.run \
+            and not getattr(args, "file", None):
+        print("rt rl train: pass --run ALGO or -f TUNED_EXAMPLE",
+              file=sys.stderr)
+        return 2
+    if args.rl_cmd == "train" and getattr(args, "file", None) \
+            and (args.run or args.env or args.config or args.config_file):
+        # a tuned example fully specifies algo/env/config; silently
+        # training something other than what the flag says would mislead
+        print("rt rl train: -f is exclusive with --run/--env/--config/"
+              "--config-file (stop flags still apply)", file=sys.stderr)
+        return 2
     owns_session = False
     if args.address:
         _attach_driver(args.address)
@@ -337,9 +353,18 @@ def cmd_rl(args: argparse.Namespace) -> int:
         owns_session = True
     try:
         if args.rl_cmd == "train":
+            if getattr(args, "file", None):
+                rl_train.run_tuned_example(
+                    args.file, checkpoint_dir=args.checkpoint_dir,
+                    stop_iters=args.stop_iters,
+                    stop_reward=args.stop_reward,
+                    stop_timesteps=args.stop_timesteps)
+                return 0
             rl_train.run_train(
                 args.run, env=args.env, config_json=args.config,
-                config_file=args.config_file, stop_iters=args.stop_iters,
+                config_file=args.config_file,
+                stop_iters=(args.stop_iters if args.stop_iters is not None
+                            else 10),
                 stop_reward=args.stop_reward,
                 stop_timesteps=args.stop_timesteps,
                 checkpoint_dir=args.checkpoint_dir)
@@ -433,14 +458,19 @@ def main(argv=None) -> int:
     p_rl = sub.add_parser("rl", help="train / evaluate RL algorithms")
     rl_sub = p_rl.add_subparsers(dest="rl_cmd", required=True)
     pr_train = rl_sub.add_parser("train")
-    pr_train.add_argument("--run", required=True,
+    pr_train.add_argument("--run", default=None,
                           help="algorithm name (PPO, DQN, SAC, ...)")
+    pr_train.add_argument("-f", "--file", default=None,
+                          help="tuned-example YAML (path or bundled name; "
+                               "see `rt rl examples`)")
     pr_train.add_argument("--env", default=None)
     pr_train.add_argument("--config", default=None,
                           help="JSON dict of AlgorithmConfig overrides")
     pr_train.add_argument("--config-file", default=None,
                           help="YAML/JSON file of config overrides")
-    pr_train.add_argument("--stop-iters", type=int, default=10)
+    pr_train.add_argument("--stop-iters", type=int, default=None,
+                          help="iteration cap (default 10; with -f, the "
+                               "YAML's stop block)")
     pr_train.add_argument("--stop-reward", type=float, default=None)
     pr_train.add_argument("--stop-timesteps", type=int, default=None)
     pr_train.add_argument("--checkpoint-dir", default=None)
@@ -450,6 +480,9 @@ def main(argv=None) -> int:
     pr_eval.add_argument("--run", default=None)
     pr_eval.add_argument("--episodes", type=int, default=10)
     pr_eval.add_argument("--address", default=None)
+    pr_ex = rl_sub.add_parser("examples",
+                              help="list bundled tuned examples")
+    pr_ex.add_argument("--address", default=None)
     p_rl.set_defaults(fn=cmd_rl)
 
     p_metrics = sub.add_parser("metrics",

@@ -97,5 +97,18 @@ def test_cli_arg_wiring():
 
     with pytest.raises(SystemExit):
         main(["rl"])  # subcommand required
-    with pytest.raises(SystemExit):
-        main(["rl", "train"])  # --run required
+    # --run is optional now (tuned examples via -f), but one of the two
+    # must be given — reported as an exit code, before any cluster spins up
+    assert main(["rl", "train"]) == 2
+
+
+def test_simpleq_alias_strips_dqn_addons():
+    """SimpleQ (reference: rllib/algorithms/simple_q) = DQN without
+    double-Q or prioritized replay."""
+    from ray_tpu.rl.train import get_algorithm_config
+
+    cfg = get_algorithm_config("SimpleQ")
+    assert cfg.double_q is False
+    assert cfg.prioritized_replay is False
+    # the plain DQN entry is untouched
+    assert get_algorithm_config("DQN").double_q is True
