@@ -289,6 +289,101 @@ def _run_rl_phase(timeout: float = 420.0):
     return None
 
 
+def _serve_main() -> None:
+    """Serve phase (BASELINE.md config 5 shape): one JAX-model replica
+    behind the HTTP proxy — end-to-end request latency through proxy
+    routing + the replica actor, on the debug-size llama. CPU-scrubbed
+    subprocess like the RL phase; this measures the SERVING STACK, which
+    is host-path dominated. Prints one JSON line SERVEBENCH={...}."""
+    import numpy as np
+    import requests
+
+    import ray_tpu
+    from ray_tpu import serve
+
+    out = {}
+    ray_tpu.init(num_cpus=4)
+    try:
+        @serve.deployment(max_ongoing_requests=16)
+        class Scorer:
+            SEQ = 32  # fixed serving shape: ONE compile, then steady state
+
+            def __init__(self):
+                import jax
+
+                from ray_tpu.models import llama
+
+                cfg = llama.PRESETS["debug"]
+                self.params = llama.init_params(jax.random.key(0), cfg)
+                self._fwd = jax.jit(
+                    lambda p, t: llama.forward(p, t, cfg))
+
+            async def __call__(self, request):
+                import jax.numpy as jnp
+
+                toks = np.zeros((1, self.SEQ), dtype=np.int32)
+                body = request.json()["tokens"][:self.SEQ]
+                toks[0, :len(body)] = body
+                logits = self._fwd(self.params, jnp.asarray(toks))
+                return {"next":
+                        int(np.asarray(logits[0, len(body) - 1]).argmax())}
+
+        serve.run(Scorer.bind(), name="bench_scorer",
+                  route_prefix="/score")
+        port = serve.http_port()
+        url = f"http://127.0.0.1:{port}/score"
+        body = {"tokens": list(range(32))}
+        for _ in range(5):  # warmup: replica spawn + XLA compile
+            requests.post(url, json=body, timeout=120).raise_for_status()
+        lat = []
+        t_all = time.perf_counter()
+        for _ in range(50):
+            t0 = time.perf_counter()
+            r = requests.post(url, json=body, timeout=60)
+            r.raise_for_status()
+            lat.append(time.perf_counter() - t0)
+        wall = time.perf_counter() - t_all
+        lat_ms = sorted(x * 1000 for x in lat)
+        out = {"serve_p50_ms": round(lat_ms[len(lat_ms) // 2], 1),
+               "serve_p99_ms": round(lat_ms[-1], 1),
+               "serve_rps": round(len(lat) / wall, 1)}
+    except Exception as e:  # noqa: BLE001 — informative only
+        out = {"serve_error": str(e)[:200]}
+    finally:
+        try:
+            serve.shutdown()
+        except Exception:  # noqa: BLE001
+            pass
+        ray_tpu.shutdown()
+    print("SERVEBENCH=" + json.dumps(out))
+
+
+def _run_serve_phase(timeout: float = 240.0):
+    """Run _serve_main in a CPU-scrubbed subprocess; dict or None."""
+    import subprocess
+    import sys
+
+    env = _cpu_env()
+    env["RT_BENCH_SERVE"] = "1"
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)], env=env,
+            cwd=_REPO_ROOT, capture_output=True, text=True, timeout=timeout)
+    except subprocess.TimeoutExpired:
+        print(f"bench: serve phase timed out after {timeout}s",
+              file=sys.stderr)
+        return None
+    for ln in reversed(proc.stdout.splitlines()):
+        if ln.startswith("SERVEBENCH="):
+            try:
+                return json.loads(ln[len("SERVEBENCH="):])
+            except ValueError:
+                break
+    print(f"bench: serve phase failed rc={proc.returncode}: "
+          f"{proc.stderr[-300:]}", file=sys.stderr)
+    return None
+
+
 def _decode_phase(preset: str, dtype: str, batch: int = 8,
                   prompt_len: int = 128, new_tokens: int = 128) -> dict:
     """Autoregressive decode throughput (models/generate.py: one-jit
@@ -657,6 +752,9 @@ def main() -> None:
     if os.environ.get("RT_BENCH_RL"):
         _rl_main()
         return
+    if os.environ.get("RT_BENCH_SERVE"):
+        _serve_main()
+        return
 
     # TPU perf flags (latency-hiding scheduler, async collectives) must be
     # in the env before any child process initializes the backend. Kept out
@@ -700,6 +798,11 @@ def main() -> None:
     rl = _run_rl_phase()
     if rl:
         result.setdefault("details", {}).update(rl)
+
+    # Serve phase — BASELINE.md config 5 shape. Informative, best-effort.
+    sv = _run_serve_phase()
+    if sv:
+        result.setdefault("details", {}).update(sv)
 
     print(json.dumps(result))
 

@@ -234,14 +234,16 @@ def test_http_event_provider_end_to_end(tmp_path):
 
         def post_later():
             _time.sleep(0.8)
+            # generous timeout: on a loaded 1-core CI box the proxy and
+            # replica compete for the same core
             r = requests.post(base, data=_json.dumps(
                 {"workflow_id": "wf-http", "event_key": "approval",
-                 "payload": "21"}), timeout=10)
+                 "payload": "21"}), timeout=60)
             assert r.json() == {"accepted": True}
 
         t = threading.Thread(target=post_later)
         t.start()
-        assert workflow.get_output(wid, timeout=60) == 42
+        assert workflow.get_output(wid, timeout=120) == 42
         t.join()
         # malformed events are rejected
         assert requests.post(base, data=_json.dumps({"nope": 1}),
