@@ -264,29 +264,35 @@ def _rl_main() -> None:
     print("RLBENCH=" + json.dumps(out))
 
 
-def _run_rl_phase(timeout: float = 420.0):
-    """Run _rl_main in a CPU-scrubbed subprocess; return its dict or None."""
+def _run_phase(env_var: str, prefix: str, timeout: float):
+    """Run this script as a CPU-scrubbed subprocess phase (env_var set),
+    parse its ``PREFIX={json}`` stdout line; dict or None."""
     import subprocess
     import sys
 
     env = _cpu_env()
-    env["RT_BENCH_RL"] = "1"
+    env[env_var] = "1"
     try:
         proc = subprocess.run(
             [sys.executable, os.path.abspath(__file__)], env=env,
             cwd=_REPO_ROOT, capture_output=True, text=True, timeout=timeout)
     except subprocess.TimeoutExpired:
-        print(f"bench: RL phase timed out after {timeout}s", file=sys.stderr)
+        print(f"bench: {prefix} phase timed out after {timeout}s",
+              file=sys.stderr)
         return None
     for ln in reversed(proc.stdout.splitlines()):
-        if ln.startswith("RLBENCH="):
+        if ln.startswith(prefix + "="):
             try:
-                return json.loads(ln[len("RLBENCH="):])
+                return json.loads(ln[len(prefix) + 1:])
             except ValueError:
                 break
-    print(f"bench: RL phase failed rc={proc.returncode}: "
+    print(f"bench: {prefix} phase failed rc={proc.returncode}: "
           f"{proc.stderr[-300:]}", file=sys.stderr)
     return None
+
+
+def _run_rl_phase(timeout: float = 420.0):
+    return _run_phase("RT_BENCH_RL", "RLBENCH", timeout)
 
 
 def _serve_main() -> None:
@@ -335,18 +341,28 @@ def _serve_main() -> None:
         body = {"tokens": list(range(32))}
         for _ in range(5):  # warmup: replica spawn + XLA compile
             requests.post(url, json=body, timeout=120).raise_for_status()
+        # latency: sequential closed-loop (one in flight)
         lat = []
-        t_all = time.perf_counter()
         for _ in range(50):
             t0 = time.perf_counter()
             r = requests.post(url, json=body, timeout=60)
             r.raise_for_status()
             lat.append(time.perf_counter() - t0)
-        wall = time.perf_counter() - t_all
         lat_ms = sorted(x * 1000 for x in lat)
+        # throughput: concurrent open-ish loop (8 in flight) — a genuine
+        # capacity number, not 1/mean-latency
+        from concurrent.futures import ThreadPoolExecutor
+
+        def one(_):
+            requests.post(url, json=body, timeout=60).raise_for_status()
+
+        with ThreadPoolExecutor(max_workers=8) as pool:
+            t_all = time.perf_counter()
+            list(pool.map(one, range(200)))
+            wall = time.perf_counter() - t_all
         out = {"serve_p50_ms": round(lat_ms[len(lat_ms) // 2], 1),
                "serve_p99_ms": round(lat_ms[-1], 1),
-               "serve_rps": round(len(lat) / wall, 1)}
+               "serve_rps": round(200 / wall, 1)}
     except Exception as e:  # noqa: BLE001 — informative only
         out = {"serve_error": str(e)[:200]}
     finally:
@@ -359,29 +375,7 @@ def _serve_main() -> None:
 
 
 def _run_serve_phase(timeout: float = 240.0):
-    """Run _serve_main in a CPU-scrubbed subprocess; dict or None."""
-    import subprocess
-    import sys
-
-    env = _cpu_env()
-    env["RT_BENCH_SERVE"] = "1"
-    try:
-        proc = subprocess.run(
-            [sys.executable, os.path.abspath(__file__)], env=env,
-            cwd=_REPO_ROOT, capture_output=True, text=True, timeout=timeout)
-    except subprocess.TimeoutExpired:
-        print(f"bench: serve phase timed out after {timeout}s",
-              file=sys.stderr)
-        return None
-    for ln in reversed(proc.stdout.splitlines()):
-        if ln.startswith("SERVEBENCH="):
-            try:
-                return json.loads(ln[len("SERVEBENCH="):])
-            except ValueError:
-                break
-    print(f"bench: serve phase failed rc={proc.returncode}: "
-          f"{proc.stderr[-300:]}", file=sys.stderr)
-    return None
+    return _run_phase("RT_BENCH_SERVE", "SERVEBENCH", timeout)
 
 
 def _decode_phase(preset: str, dtype: str, batch: int = 8,
