@@ -1,3 +1,4 @@
 """Model zoo: pure-pytree JAX models designed for sharding-annotated jit."""
 
 from ray_tpu.models.llama import LlamaConfig  # noqa: F401
+from ray_tpu.models.vit import ViTConfig  # noqa: F401

@@ -1,0 +1,190 @@
+"""ViT: Vision Transformer classification family.
+
+Reference analog: the vision workloads the reference's Train/Data docs
+target (torchvision models on TorchTrainer); here the TPU-native
+equivalent — a pre-LN ViT (Dosovitskiy et al. 2020) written in the same
+stacked-layer/pjit style as ``models/llama.py``: layer params carry a
+leading ``[n_layers]`` axis consumed by ``lax.scan``, compute runs in
+bfloat16 on the MXU, parameters shard over the (dp, fsdp, tp) mesh with
+the same scaling-book layout, and patch embedding is a single reshaped
+matmul (no conv needed for non-overlapping patches).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.parallel.sharding import P, ShardingRules
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass
+class ViTConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    channels: int = 3
+    d_model: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    d_ff: int = 3072
+    num_classes: int = 1000
+    norm_eps: float = 1e-6
+    param_dtype: Any = jnp.float32
+    compute_dtype: Any = jnp.bfloat16
+    remat: bool = True
+
+    @property
+    def num_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    @property
+    def patch_dim(self) -> int:
+        return self.channels * self.patch_size ** 2
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    def num_params(self) -> int:
+        d, f, L = self.d_model, self.d_ff, self.n_layers
+        per_layer = 4 * d * d + 2 * d * f + 4 * d + f + d  # attn+mlp+ln
+        return (self.patch_dim * d + d                      # patch proj
+                + (self.num_patches + 1) * d + d            # pos + cls
+                + L * per_layer + 2 * d                     # final ln
+                + d * self.num_classes + self.num_classes)  # head
+
+
+PRESETS: Dict[str, ViTConfig] = {
+    "debug": ViTConfig(image_size=32, patch_size=8, d_model=64,
+                       n_layers=2, n_heads=4, d_ff=128, num_classes=10),
+    "s16": ViTConfig(d_model=384, n_layers=12, n_heads=6, d_ff=1536),
+    "b16": ViTConfig(),  # ViT-B/16
+}
+
+
+def init_params(rng: jax.Array, cfg: ViTConfig) -> Params:
+    d, f, L = cfg.d_model, cfg.d_ff, cfg.n_layers
+    keys = jax.random.split(rng, 10)
+
+    def ninit(key, shape, fan_in):
+        return (jax.random.normal(key, shape, jnp.float32)
+                * (1.0 / math.sqrt(fan_in))).astype(cfg.param_dtype)
+
+    return {
+        "patch_proj": ninit(keys[0], (cfg.patch_dim, d), cfg.patch_dim),
+        "patch_bias": jnp.zeros((d,), cfg.param_dtype),
+        "cls": jnp.zeros((1, 1, d), cfg.param_dtype),
+        "pos": (jax.random.normal(keys[1], (cfg.num_patches + 1, d),
+                                  jnp.float32)
+                * 0.02).astype(cfg.param_dtype),
+        "layers": {
+            "ln1": jnp.ones((L, d), cfg.param_dtype),
+            "ln1_b": jnp.zeros((L, d), cfg.param_dtype),
+            "wq": ninit(keys[2], (L, d, d), d),
+            "wk": ninit(keys[3], (L, d, d), d),
+            "wv": ninit(keys[4], (L, d, d), d),
+            "wo": ninit(keys[5], (L, d, d), d),
+            "ln2": jnp.ones((L, d), cfg.param_dtype),
+            "ln2_b": jnp.zeros((L, d), cfg.param_dtype),
+            "w_up": ninit(keys[6], (L, d, f), d),
+            "b_up": jnp.zeros((L, f), cfg.param_dtype),
+            "w_down": ninit(keys[7], (L, f, d), f),
+            "b_down": jnp.zeros((L, d), cfg.param_dtype),
+        },
+        "final_ln": jnp.ones((d,), cfg.param_dtype),
+        "final_ln_b": jnp.zeros((d,), cfg.param_dtype),
+        "head": ninit(keys[8], (d, cfg.num_classes), d),
+        "head_b": jnp.zeros((cfg.num_classes,), cfg.param_dtype),
+    }
+
+
+def _ln(x, g, b, eps):
+    mu = x.mean(-1, keepdims=True)
+    var = ((x - mu) ** 2).mean(-1, keepdims=True)
+    return (x - mu) * jax.lax.rsqrt(var + eps) * g + b
+
+
+def patchify(images: jax.Array, cfg: ViTConfig) -> jax.Array:
+    """[B, H, W, C] -> [B, num_patches, patch_dim] (non-overlapping
+    patches as a reshape — equivalent to the stride-P conv)."""
+    B = images.shape[0]
+    p = cfg.patch_size
+    n = cfg.image_size // p
+    x = images.reshape(B, n, p, n, p, cfg.channels)
+    x = x.transpose(0, 1, 3, 2, 4, 5)  # [B, n, n, p, p, C]
+    return x.reshape(B, n * n, cfg.patch_dim)
+
+
+def _block(cfg: ViTConfig, x: jax.Array, lp: Params) -> jax.Array:
+    B, S, d = x.shape
+    h = _ln(x, lp["ln1"], lp["ln1_b"], cfg.norm_eps)
+    q = (h @ lp["wq"]).reshape(B, S, cfg.n_heads, cfg.head_dim)
+    k = (h @ lp["wk"]).reshape(B, S, cfg.n_heads, cfg.head_dim)
+    v = (h @ lp["wv"]).reshape(B, S, cfg.n_heads, cfg.head_dim)
+    att = jnp.einsum("bqhd,bkhd->bhqk", q, k) / math.sqrt(cfg.head_dim)
+    att = jax.nn.softmax(att.astype(jnp.float32),
+                         axis=-1).astype(x.dtype)  # no mask: bidirectional
+    out = jnp.einsum("bhqk,bkhd->bqhd", att, v).reshape(B, S, d)
+    x = x + out @ lp["wo"]
+    h = _ln(x, lp["ln2"], lp["ln2_b"], cfg.norm_eps)
+    h = jax.nn.gelu(h @ lp["w_up"] + lp["b_up"])
+    return x + (h @ lp["w_down"] + lp["b_down"])
+
+
+def forward(params: Params, images: jax.Array, cfg: ViTConfig) -> jax.Array:
+    """[B, H, W, C] float images -> [B, num_classes] logits."""
+    cd = cfg.compute_dtype
+    x = patchify(images.astype(cd), cfg)
+    x = x @ params["patch_proj"].astype(cd) \
+        + params["patch_bias"].astype(cd)
+    B = x.shape[0]
+    cls = jnp.broadcast_to(params["cls"].astype(cd),
+                           (B, 1, cfg.d_model))
+    x = jnp.concatenate([cls, x], axis=1) + params["pos"].astype(cd)
+
+    def body(h, lp):
+        lp = jax.tree_util.tree_map(lambda t: t.astype(cd), lp)
+        return _block(cfg, h, lp), None
+
+    if cfg.remat:
+        body = jax.checkpoint(body)
+    x, _ = jax.lax.scan(body, x, params["layers"])
+    x = _ln(x.astype(jnp.float32), params["final_ln"],
+            params["final_ln_b"], cfg.norm_eps)
+    cls_out = x[:, 0]
+    return cls_out @ params["head"].astype(jnp.float32) \
+        + params["head_b"].astype(jnp.float32)
+
+
+def cls_loss(params: Params, batch: Dict[str, jax.Array],
+             cfg: ViTConfig) -> jax.Array:
+    """Softmax cross-entropy over ``batch["images"]/["labels"]``."""
+    logits = forward(params, batch["images"], cfg)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(
+        logp, batch["labels"][:, None].astype(jnp.int32), axis=-1)[:, 0]
+    return nll.mean()
+
+
+def sharding_rules() -> ShardingRules:
+    """Scaling-book layout over (dp, fsdp, tp): attention/MLP matrices put
+    contracting/output dims on (fsdp, tp); vectors replicated."""
+    return ShardingRules([
+        (r"patch_proj$", P("fsdp", "tp")),
+        (r"head$", P("fsdp", "tp")),
+        (r"layers/w[qkv]$", P(None, "fsdp", "tp")),
+        (r"layers/wo$", P(None, "tp", "fsdp")),
+        (r"layers/w_up$", P(None, "fsdp", "tp")),
+        (r"layers/w_down$", P(None, "tp", "fsdp")),
+        (r".*", P()),
+    ])
+
+
+def data_rules() -> ShardingRules:
+    return ShardingRules([(r".*", P(("dp", "fsdp")))])
