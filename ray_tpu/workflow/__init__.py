@@ -9,8 +9,10 @@ missing, so a crashed workflow continues where it left off.
 """
 
 from ray_tpu.workflow.api import (  # noqa: F401
+    Continuation,
     WorkflowStatus,
     cancel,
+    continuation,
     get_output,
     get_status,
     init,

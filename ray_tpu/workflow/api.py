@@ -11,6 +11,7 @@ same DAG.
 from __future__ import annotations
 
 import enum
+import hashlib
 import json
 import os
 
@@ -117,15 +118,36 @@ def _load_dag(workflow_id: str):
         return pickle.load(f)
 
 
+class Continuation:
+    """A workflow task's return value saying "durably run THIS DAG and use
+    its result as mine" (reference: ``ray.workflow.continuation`` — the
+    primitive behind durable loops and recursion).
+
+    Sub-DAG checkpoints live under the returning node's key, so a resumed
+    workflow re-runs the (deterministic) parent task to regenerate the
+    DAG but reuses every completed sub-step's checkpoint."""
+
+    def __init__(self, dag: DAGNode):
+        if not isinstance(dag, DAGNode):
+            raise TypeError("continuation() takes a bound DAG node "
+                            "(fn.bind(...))")
+        self.dag = dag
+
+
+def continuation(dag: DAGNode) -> Continuation:
+    return Continuation(dag)
+
+
 class _DurableExecutor:
     """Executes a DAG bottom-up, checkpointing each task's result."""
 
     def __init__(self, workflow_id: str, dag: DAGNode, input_val: _InputValue,
-                 cancel_flag: threading.Event):
+                 cancel_flag: threading.Event, key_prefix: str = ""):
         self.workflow_id = workflow_id
         self.dag = dag
         self.input_val = input_val
         self.keys = _node_keys(dag)
+        self.key_prefix = key_prefix
         self.tasks_dir = os.path.join(_wf_dir(workflow_id), "tasks")
         self.cancel_flag = cancel_flag
         self._cache: Dict[int, Any] = {}
@@ -145,10 +167,38 @@ class _DurableExecutor:
                 self._replay_class_nodes.add(cls_id)
 
     def _ckpt_path(self, node: DAGNode) -> str:
-        return os.path.join(self.tasks_dir, self.keys[id(node)] + ".pkl")
+        return os.path.join(self.tasks_dir,
+                            self.key_prefix + self.keys[id(node)] + ".pkl")
+
+    def _resolve_continuation(self, node: DAGNode, val):
+        """Durably execute a returned sub-DAG; its checkpoints are
+        namespaced under a HASH of the returning node's full path, so the
+        filename stays fixed-length at any recursion depth (a literal
+        path concatenation hits NAME_MAX at ~13 levels). Nested
+        continuations inside the sub-DAG resolve in the sub-executor."""
+        if not isinstance(val, Continuation):
+            return val
+        path_id = hashlib.sha1(
+            (self.key_prefix + self.keys[id(node)]).encode()
+        ).hexdigest()[:12]
+        sub = _DurableExecutor(self.workflow_id, val.dag, self.input_val,
+                               self.cancel_flag,
+                               key_prefix=path_id + ".")
+        return sub.run()
 
     def run(self) -> Any:
-        return self._exec(self.dag)
+        # DAG resolution recurses over structure (args and continuation
+        # sub-DAGs alike); give deep durable loops stack headroom — pure-
+        # Python frames, heap-allocated on modern CPython
+        import sys
+
+        limit = sys.getrecursionlimit()
+        if limit < 20_000:
+            sys.setrecursionlimit(20_000)
+        try:
+            return self._exec(self.dag)
+        finally:
+            sys.setrecursionlimit(limit)
 
     def _exec(self, node: DAGNode) -> Any:
         if id(node) in self._cache:
@@ -182,13 +232,17 @@ class _DurableExecutor:
             from ray_tpu.core.worker import global_worker
 
             ref = getattr(handle, node._method_name).remote(*args, **kwargs)
-            val = global_worker().get(ref)
+            val = self._resolve_continuation(
+                node, global_worker().get(ref))
             self._checkpoint(path, val)
         elif isinstance(node, FunctionNode):
             from ray_tpu.core.worker import global_worker
 
             ref = node._execute_impl(args, kwargs, self.input_val)
-            val = global_worker().get(ref)
+            # a Continuation resolves durably BEFORE the checkpoint: the
+            # node's stored value is the continuation's final result
+            val = self._resolve_continuation(
+                node, global_worker().get(ref))
             self._checkpoint(path, val)
             # wait_for_event nodes: exactly-once commit hook fires AFTER
             # the event is durably checkpointed (workflow/events.py)

@@ -84,6 +84,26 @@ def _record(x, path):
     return x * 10
 
 
+@remote
+def _mul(a, b):
+    return a * b
+
+
+@remote
+def _factorial(n):
+    # durable recursion: each level returns a continuation DAG
+    if n <= 1:
+        return 1
+    return workflow.continuation(_mul.bind(n, _factorial.bind(n - 1)))
+
+
+@remote
+def _cont_parent(record_path):
+    # sub-DAG: a checkpointable side-effect step feeding a flaky step
+    return workflow.continuation(
+        _add.bind(_record.bind(5, record_path), _flaky.bind(1)))
+
+
 class TestWorkflow:
     def test_run_and_status(self, local_rt, tmp_path):
         workflow.init(str(tmp_path))
@@ -122,6 +142,44 @@ class TestWorkflow:
         workflow.run(_double.bind(1), workflow_id="wf-a")
         entries = workflow.list_all()
         assert any(e["workflow_id"] == "wf-a" for e in entries)
+
+    def test_continuation_recursion(self, local_rt, tmp_path):
+        """Durable recursion (reference: ray.workflow.continuation):
+        factorial unrolls through returned sub-DAGs. Depth 25 regression-
+        guards the hashed checkpoint namespace (a literal path
+        concatenation hits the filesystem NAME_MAX at ~13 levels)."""
+        import math
+
+        workflow.init(str(tmp_path))
+        assert workflow.run(_factorial.bind(5), workflow_id="wf-fact") \
+            == 120
+        assert workflow.get_status("wf-fact") \
+            == workflow.WorkflowStatus.SUCCESSFUL
+        assert workflow.run(_factorial.bind(25), workflow_id="wf-deep") \
+            == math.factorial(25)
+
+    def test_continuation_resume_reuses_sub_checkpoints(
+            self, local_rt, tmp_path):
+        """Crash inside a continuation's sub-DAG: resume re-runs the
+        (deterministic) parent task to rebuild the DAG but completed
+        sub-steps replay from their namespaced checkpoints."""
+        global _FAIL_MARKER
+        workflow.init(str(tmp_path))
+        marker = str(tmp_path / "cont_fail")
+        record_path = str(tmp_path / "cont_record.txt")
+        open(marker, "w").close()
+        _FAIL_MARKER = marker
+
+        dag = _cont_parent.bind(record_path)
+        with pytest.raises(ray_tpu.exceptions.TaskError):
+            workflow.run(dag, workflow_id="wf-cont")
+        assert workflow.get_status("wf-cont") \
+            == workflow.WorkflowStatus.RESUMABLE
+        os.remove(marker)
+        assert workflow.resume("wf-cont") == (50 + 2)
+        # the sub-DAG's completed _record step ran exactly once
+        with open(record_path) as f:
+            assert len(f.readlines()) == 1
 
 
 class TestWorkflowEvents:
