@@ -305,6 +305,8 @@ class ClusterBackend(RuntimeBackend):
     async def _poll_node_logs(self, address: str) -> None:
         import sys
 
+        from ray_tpu.util.tqdm_rt import maybe_render
+
         try:
             client = await self._pool.get(address)
             head = await client.call("poll_logs", {"after": None},
@@ -321,8 +323,14 @@ class ClusterBackend(RuntimeBackend):
             except Exception:  # noqa: BLE001 — node gone; outer loop retries
                 return
             for e in reply.get("entries", ()):
+                line = e["line"]
+                # progress-bar magic lines render compactly instead of
+                # spamming raw JSON (util/tqdm_rt.py)
+                bar = maybe_render(line)
+                if bar is not None:
+                    line = bar
                 print(f"\x1b[36m(worker {e['worker_id'][:8]})\x1b[0m "
-                      f"{e['line']}", file=sys.stderr)
+                      f"{line}", file=sys.stderr)
             seq = reply.get("seq", seq)
 
     @property

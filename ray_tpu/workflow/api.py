@@ -189,16 +189,16 @@ class _DurableExecutor:
     def run(self) -> Any:
         # DAG resolution recurses over structure (args and continuation
         # sub-DAGs alike); give deep durable loops stack headroom — pure-
-        # Python frames, heap-allocated on modern CPython
+        # Python frames, heap-allocated on modern CPython. Raised
+        # monotonically and NEVER restored: setrecursionlimit is
+        # process-global, so a save/restore here would race with
+        # concurrent run_async workflows still recursing on their
+        # daemon threads (their deep stacks would suddenly overflow).
         import sys
 
-        limit = sys.getrecursionlimit()
-        if limit < 20_000:
+        if sys.getrecursionlimit() < 20_000:
             sys.setrecursionlimit(20_000)
-        try:
-            return self._exec(self.dag)
-        finally:
-            sys.setrecursionlimit(limit)
+        return self._exec(self.dag)
 
     def _exec(self, node: DAGNode) -> Any:
         if id(node) in self._cache:

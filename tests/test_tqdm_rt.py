@@ -1,0 +1,77 @@
+"""Distributed progress bars (reference: ray.experimental.tqdm_ray)."""
+
+import io
+import json
+
+import pytest
+
+from ray_tpu.util.tqdm_rt import MAGIC, maybe_render, render_state, tqdm
+
+
+@pytest.fixture
+def worker_env(monkeypatch):
+    # magic-line emission is the WORKER behavior (driver renders locally)
+    monkeypatch.setenv("RT_WORKER_ID", "testworker")
+
+
+def test_bar_emits_magic_lines_and_counts(worker_env):
+    buf = io.StringIO()
+    for _ in tqdm(range(5), desc="work", file=buf):
+        pass
+    lines = [ln for ln in buf.getvalue().splitlines()
+             if ln.startswith(MAGIC)]
+    assert lines, "no magic lines emitted"
+    final = json.loads(lines[-1][len(MAGIC):])
+    assert final["n"] == 5
+    assert final["total"] == 5
+    assert final["done"] is True
+    assert final["desc"] == "work"
+
+
+def test_aborted_iteration_is_not_marked_done(worker_env):
+    buf = io.StringIO()
+    with pytest.raises(RuntimeError):
+        for i in tqdm(range(100), desc="crash", file=buf):
+            if i == 30:
+                raise RuntimeError("boom")
+    final = json.loads(buf.getvalue().splitlines()[-1][len(MAGIC):])
+    assert final["done"] is False
+    assert final["n"] == 30
+
+
+def test_update_is_rate_limited_but_close_always_emits(worker_env):
+    buf = io.StringIO()
+    bar = tqdm(desc="fast", total=1000, file=buf)
+    for _ in range(1000):
+        bar.update(1)  # sub-interval updates are coalesced
+    bar.close()
+    lines = buf.getvalue().splitlines()
+    assert 1 <= len(lines) < 20
+    assert json.loads(lines[-1][len(MAGIC):])["n"] == 1000
+
+
+def test_driver_process_renders_locally(monkeypatch):
+    monkeypatch.delenv("RT_WORKER_ID", raising=False)
+    buf = io.StringIO()
+    for _ in tqdm(range(3), desc="local", file=buf):
+        pass
+    out = buf.getvalue()
+    assert MAGIC not in out          # no raw JSON on a driver terminal
+    assert "local: 3/3 (100%)" in out
+
+
+def test_render_forms():
+    assert render_state({"desc": "d", "n": 5, "total": 10,
+                         "rate": 2.5}) == "d: 5/10 (50%) [2.5/s]"
+    assert render_state({"desc": "d", "n": 7, "total": None,
+                         "rate": 1.0}) == "d: 7 [1.0/s]"
+    assert render_state({"desc": "d", "n": 10, "total": 10, "rate": 1.0,
+                         "done": True}).endswith("done")
+
+
+def test_maybe_render_passthrough():
+    assert maybe_render("a normal log line") is None
+    line = MAGIC + json.dumps({"desc": "x", "n": 1, "total": 2,
+                               "rate": 0.5})
+    assert maybe_render(line) == "x: 1/2 (50%) [0.5/s]"
+    assert maybe_render(MAGIC + "not-json") is None
