@@ -27,8 +27,15 @@ def test_zoo_is_nonempty_and_listed():
 
 
 def test_every_bundled_example_validates():
-    """Each YAML must name a registered algorithm and carry only config
-    keys its AlgorithmConfig accepts (update_from_dict raises on typos)."""
+    """Each YAML must name a registered algorithm, carry only config keys
+    its AlgorithmConfig accepts (update_from_dict raises on typos), and —
+    for single-agent gym-style envs — name a REGISTERED env (a typo'd
+    env name would otherwise only fail at train time)."""
+    from ray_tpu.rl.env import make_env
+    from ray_tpu.rl.multi_agent import _MA_ENVS
+
+    # envs owned by the algorithm itself (no env registry entry)
+    self_managed = {"recsim", "pointgoal"}
     for name in rl_train.list_tuned_examples():
         exp = rl_train.load_tuned_example(name)
         cfg = rl_train.get_algorithm_config(exp["run"])
@@ -36,6 +43,9 @@ def test_every_bundled_example_validates():
         stop = exp.get("stop") or {}
         assert stop.get("training_iteration"), (name, "needs an iteration "
                                                 "bound so runs terminate")
+        env = exp.get("env")
+        if env and env not in self_managed and env not in _MA_ENVS:
+            make_env(env, 1, {})  # raises on unknown env names
 
 
 def test_unknown_example_lists_bundled():
