@@ -31,10 +31,38 @@ def autodetect_num_tpu_chips() -> int:
     return 0
 
 
-def tpu_node_labels() -> Dict[str, str]:
+# GKE's TPU device-plugin webhook injects these into TPU pods (the
+# reference reads the analogous GKE env at ``ray_constants.py:488`` and GCE
+# metadata via RAY_GCE_TPU_ACCELERATOR_ENDPOINT ``:494``). Mapping them
+# here means a pod scheduled by the GKE provider registers with the same
+# slice labels a TPU-VM node would — zero extra plumbing in node_main.
+GKE_WORKER_ID_ENV = "TPU_WORKER_ID"
+GKE_TOPOLOGY_ENV = "TPU_TOPOLOGY"
+GKE_ACCELERATOR_ENV = "TPU_ACCELERATOR_TYPE"
+GKE_SLICE_NAME_ENV = "TPU_NAME"
+
+
+def gke_node_labels() -> Dict[str, str]:
+    """Slice labels from GKE-injected pod env (empty off-GKE)."""
     from ray_tpu.core import resources as res
 
     labels: Dict[str, str] = {}
+    if GKE_ACCELERATOR_ENV in os.environ:
+        labels[res.LABEL_ACCELERATOR_TYPE] = (
+            "TPU-" + os.environ[GKE_ACCELERATOR_ENV].split("-")[0].upper())
+    if GKE_SLICE_NAME_ENV in os.environ:
+        labels[res.LABEL_SLICE_NAME] = os.environ[GKE_SLICE_NAME_ENV]
+    if GKE_TOPOLOGY_ENV in os.environ:
+        labels[res.LABEL_SLICE_TOPOLOGY] = os.environ[GKE_TOPOLOGY_ENV]
+    if GKE_WORKER_ID_ENV in os.environ:
+        labels[res.LABEL_WORKER_ID_IN_SLICE] = os.environ[GKE_WORKER_ID_ENV]
+    return labels
+
+
+def tpu_node_labels() -> Dict[str, str]:
+    from ray_tpu.core import resources as res
+
+    labels: Dict[str, str] = gke_node_labels()
     version = os.environ.get(TPU_VERSION_ENV)
     if version:
         labels[res.LABEL_ACCELERATOR_TYPE] = f"TPU-{version.upper()}"

@@ -75,6 +75,11 @@ class Config:
     # ---- health / fault tolerance -----------------------------------------
     heartbeat_interval_s: float = 1.0
     node_death_timeout_s: float = 10.0
+    # Overall bound on waiting for a PENDING_CREATION/RESTARTING actor to
+    # come alive before an actor call fails with ActorUnschedulableError.
+    # 0 = wait forever (reference semantics). Callers needing bounded
+    # resolution (health checks, CI) set RT_ACTOR_RESOLVE_DEADLINE_S.
+    actor_resolve_deadline_s: float = 0.0
     actor_restart_backoff_s: float = 0.5
     task_max_retries_default: int = 3
     # OOM prevention (reference: common/memory_monitor.h +

@@ -22,6 +22,11 @@ from ray_tpu.autoscaler.gcp import (  # noqa: F401
     GcpTpuPodProvider,
     TpuRestClient,
 )
+from ray_tpu.autoscaler.gke import (  # noqa: F401
+    FakeK8sHttp,
+    GkeTpuPodProvider,
+    K8sClient,
+)
 from ray_tpu.autoscaler.node_provider import (  # noqa: F401
     LocalNodeProvider,
     NodeProvider,

@@ -259,6 +259,12 @@ class RpcClient:
                     if not fut.done():
                         fut.set_exception(
                             ConnectionLost(f"connection to {self.address} lost"))
+                        # Mark the exception retrieved: callers abandoned at
+                        # teardown (e.g. a timed-out wait_for) never await this
+                        # future, and asyncio would spam "Future exception was
+                        # never retrieved" at GC. A live awaiter still sees the
+                        # ConnectionLost raised from `await fut`.
+                        fut.exception()
                 except RuntimeError:
                     pass  # loop already closed during interpreter teardown
             self._pending.clear()

@@ -45,6 +45,7 @@ from ray_tpu.cluster.rpc import (
 )
 from ray_tpu.exceptions import (
     ActorDiedError,
+    ActorUnschedulableError,
     GetTimeoutError,
     ObjectLostError,
     TaskError,
@@ -1016,17 +1017,26 @@ class ClusterBackend(RuntimeBackend):
             self._actor_conns[actor_id_hex] = conn
         return conn
 
-    async def _resolve_actor(self, conn: _ActorConn, timeout: float = 60.0) -> str:
+    async def _resolve_actor(self, conn: _ActorConn, timeout: float = 60.0,
+                             deadline: Optional[float] = None) -> str:
         # PENDING_CREATION / RESTARTING are NOT errors: the actor may be
         # queued behind cluster resources (or a node the autoscaler is
         # still provisioning). Like the reference, callers block until it
         # comes alive or genuinely dies — with a periodic warning so an
-        # infeasible request is visible instead of a silent hang.
+        # infeasible request is visible instead of a silent hang. An
+        # optional deadline (param or RT_ACTOR_RESOLVE_DEADLINE_S) bounds
+        # the wait with a distinct ActorUnschedulableError.
+        if deadline is None:
+            deadline = get_config().actor_resolve_deadline_s or None
         waited = 0.0
         while True:
+            # clamp each long-poll to the remaining deadline so a short
+            # deadline isn't swallowed by one 60s GCS wait
+            poll = timeout if deadline is None else max(
+                0.5, min(timeout, deadline - waited))
             reply = await self._gcs.call("get_actor_info", {
                 "actor_id": conn.actor_id_hex, "wait_alive": True,
-                "timeout": timeout})
+                "timeout": poll})
             info = reply.get("info")
             if info is None:
                 raise ActorDiedError(conn.actor_id_hex, "unknown actor")
@@ -1035,7 +1045,10 @@ class ClusterBackend(RuntimeBackend):
                 raise ActorDiedError(conn.actor_id_hex, conn.dead_reason)
             if info["state"] == "ALIVE":
                 break
-            waited += timeout
+            waited += poll
+            if deadline is not None and waited >= deadline:
+                raise ActorUnschedulableError(conn.actor_id_hex,
+                                              info["state"], waited)
             logger.warning(
                 "actor %s still %s after %.0fs — waiting for cluster "
                 "resources (creation queues until a node frees up or "
@@ -1108,7 +1121,9 @@ class ClusterBackend(RuntimeBackend):
                 reply = await fut
                 self._apply_task_reply(reply, refs, method_name)
                 return
-            except ActorDiedError as e:
+            except (ActorDiedError, ActorUnschedulableError) as e:
+                # both resolve the caller's refs with the error so get()
+                # re-raises it instead of hanging on a never-sent call
                 blob = self.serde.serialize(e).to_bytes()
                 for r in refs:
                     self.memory_store.put(r.hex(), blob)

@@ -63,9 +63,34 @@ class ActorDiedError(ActorError):
         self.reason = reason
         super().__init__(f"actor {actor_id} died: {reason}")
 
+    def __reduce__(self):
+        # default Exception pickling would reconstruct with the formatted
+        # message as actor_id, double-wrapping the text on every serde hop
+        return (ActorDiedError, (self.actor_id, self.reason))
+
 
 class ActorUnavailableError(ActorError):
     """The actor is temporarily unreachable (e.g. restarting)."""
+
+
+class ActorUnschedulableError(ActorError):
+    """The actor stayed PENDING_CREATION/RESTARTING past a caller-supplied
+    deadline (e.g. an infeasible resource request on a cluster that will
+    never grow). Only raised when a deadline is requested — by default
+    callers block like the reference does."""
+
+    def __init__(self, actor_id=None, state: str = "", waited_s: float = 0.0):
+        self.actor_id = actor_id
+        self.state = state
+        self.waited_s = waited_s
+        super().__init__(
+            f"actor {actor_id} still {state} after {waited_s:.0f}s deadline — "
+            f"likely an infeasible resource request (check num_cpus/num_tpus "
+            f"against the cluster)")
+
+    def __reduce__(self):
+        return (ActorUnschedulableError,
+                (self.actor_id, self.state, self.waited_s))
 
 
 class ObjectLostError(RayTpuError):

@@ -60,7 +60,8 @@ class Algorithm(Trainable):
                 if self.need_env_runners else 0
             self.runners = [
                 ExternalEnvRunner.options(
-                    num_cpus=cfg.num_cpus_per_runner).remote(
+                    num_cpus=cfg.num_cpus_per_runner,
+                    runtime_env=cfg.runner_runtime_env).remote(
                     port + i if port else 0, dict(spec_kwargs),
                     cfg.rollout_fragment_length, cfg.num_envs_per_runner,
                     cfg.gamma, cfg.lambda_, seed=cfg.seed + 1000 * i)
@@ -75,7 +76,8 @@ class Algorithm(Trainable):
             n_runners = max(1, cfg.num_env_runners) \
                 if self.need_env_runners else 0
             self.runners = [
-                EnvRunner.options(num_cpus=cfg.num_cpus_per_runner).remote(
+                EnvRunner.options(num_cpus=cfg.num_cpus_per_runner,
+                                  runtime_env=cfg.runner_runtime_env).remote(
                     cfg.env, cfg.num_envs_per_runner,
                     cfg.rollout_fragment_length, cfg.gamma, cfg.lambda_,
                     seed=cfg.seed + 1000 * i, env_config=cfg.env_config,

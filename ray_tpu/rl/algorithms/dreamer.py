@@ -418,7 +418,14 @@ class DreamerV3(Trainable):
             row = self._rng.integers(c["rewards"].shape[0])
             for k in out:
                 out[k].append(c[k][row])
-        return {k: np.stack(v) for k, v in out.items()}
+        batch = {k: np.stack(v) for k, v in out.items()}
+        # The RSSM scan starts each sampled chunk from a zeroed (h, z), so the
+        # first replayed step must be treated as an episode start even when the
+        # chunk was cut mid-episode (the reference forces is_first=True on the
+        # first replayed step for the same reason) — otherwise the world model
+        # trains on zero-state transitions that never occur at collection time.
+        batch["is_first"][:, 0] = 1.0  # np.stack already copied
+        return batch
 
     # -- Trainable API ----------------------------------------------------
 

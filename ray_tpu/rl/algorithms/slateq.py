@@ -198,7 +198,11 @@ class SlateQ(Trainable):
             return p[:, :k]
 
         def greedy_slate(net, obs):
-            """Top-k by choice-weighted Q (optimal under MNL choice)."""
+            """Top-k by choice-weighted Q — the reference's top-k heuristic.
+
+            Maximizes the unnormalized sum(w_i * Q_i), not the true MNL slate
+            value sum(w_i*Q_i)/(w_noclick + sum(w_i)); exact when Q >= 0 and
+            the no-click weight dominates, otherwise a heuristic bound."""
             q = item_qs(net, obs)                            # [B, m]
             user, docs = split_obs(obs)
             scores = jnp.einsum("bf,bmf->bm", user, docs[..., :user_dim])

@@ -24,6 +24,11 @@ class AlgorithmConfig:
     num_envs_per_runner: int = 8
     rollout_fragment_length: int = 64
     num_cpus_per_runner: float = 1
+    # runtime_env for the EnvRunner actors, e.g.
+    # {"env_vars": {"JAX_PLATFORMS": "cpu"}} to pin the sampling fleet's
+    # policy forward to host CPUs while the learner owns the chip
+    # (BASELINE config 4's CPU-rollouts -> TPU-learner architecture).
+    runner_runtime_env: Optional[dict] = None
     # connector pipeline specs, e.g. ["mean_std_filter",
     # {"type": "clip_reward", "limit": 1.0}] (rl/connectors.py)
     connectors: Any = None
@@ -94,13 +99,15 @@ class AlgorithmConfig:
                     num_envs_per_runner: Optional[int] = None,
                     rollout_fragment_length: Optional[int] = None,
                     num_cpus_per_runner: Optional[float] = None,
-                    connectors: Optional[list] = None
+                    connectors: Optional[list] = None,
+                    runner_runtime_env: Optional[dict] = None
                     ) -> "AlgorithmConfig":
         for k, v in (("num_env_runners", num_env_runners),
                      ("num_envs_per_runner", num_envs_per_runner),
                      ("rollout_fragment_length", rollout_fragment_length),
                      ("num_cpus_per_runner", num_cpus_per_runner),
-                     ("connectors", connectors)):
+                     ("connectors", connectors),
+                     ("runner_runtime_env", runner_runtime_env)):
             if v is not None:
                 setattr(self, k, v)
         return self

@@ -1,0 +1,233 @@
+"""``rt scale-envelope`` — one-host scalability envelope.
+
+Reference analog: ``release/benchmarks/README.md:7-31`` (the committed
+scalability envelope: 10k+ simultaneous tasks, 40k actors, 1M queued tasks,
+10k object args, 1k PGs — measured on a 64x64-core cloud cluster) and the
+drivers in ``release/benchmarks/distributed/test_many_tasks.py``.
+
+This is the single-host, scaled-down analog: each scenario is time-bounded,
+isolated (one failing scenario never discards the others' numbers), and
+reports an achieved count + rate so the asyncio-Python control plane's
+limits are MEASURED rather than assumed (VERDICT r4 #3 — the evidence the
+Python-raylet redesign owes). Writes one JSON document; the driver commits
+it as SCALE_r{N}.json.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict
+
+
+def _scenario(out: Dict[str, Any], name: str):
+    """Decorator-ish context: run fn, record result or error under name."""
+
+    class _Ctx:
+        def __enter__(self):
+            self.t0 = time.perf_counter()
+            return self
+
+        def __exit__(self, et, ev, tb):
+            out.setdefault("scenarios", {}).setdefault(name, {})[
+                "wall_s"] = round(time.perf_counter() - self.t0, 2)
+            if ev is not None:
+                out["scenarios"][name]["error"] = f"{et.__name__}: {ev}"[:300]
+                return True  # isolate: swallow, keep other scenarios
+            return False
+
+        def record(self, **kv):
+            out.setdefault("scenarios", {}).setdefault(name, {}).update(kv)
+
+    return _Ctx()
+
+
+def run_envelope(actor_target: int = 1000, queued_target: int = 10_000,
+                 get_objects: int = 1000, pg_target: int = 100,
+                 task_args_target: int = 1000,
+                 actor_budget_s: float = 120.0) -> Dict[str, Any]:
+    import numpy as np
+
+    import ray_tpu
+
+    out: Dict[str, Any] = {
+        "hardware": {"cpus": os.cpu_count()},
+        "reference": "release/benchmarks/README.md:7-31 (64x64-core "
+                     "cluster); this is the 1-host analog",
+    }
+    try:
+        import psutil  # noqa: F401 — optional
+
+        out["hardware"]["mem_gb"] = round(
+            psutil.virtual_memory().total / 1e9, 1)
+    except Exception:  # noqa: BLE001
+        pass
+
+    # Generous fake resources: the envelope exercises the CONTROL PLANE
+    # (scheduler, GCS, object plane), not arithmetic — same trick as the
+    # reference's fake-resource cluster tests.
+    ray_tpu.init(num_cpus=max(16, os.cpu_count() or 1))
+    try:
+        # ---- 1. sustained task throughput -------------------------------
+        @ray_tpu.remote
+        def nop():
+            return 0
+
+        with _scenario(out, "tasks_per_sec") as sc:
+            ray_tpu.get([nop.remote() for _ in range(50)])  # warm workers
+            n_done = 0
+            t0 = time.perf_counter()
+            while time.perf_counter() - t0 < 10.0:
+                ray_tpu.get([nop.remote() for _ in range(200)])
+                n_done += 200
+            dt = time.perf_counter() - t0
+            sc.record(tasks=n_done, tasks_per_sec=round(n_done / dt, 1))
+
+        # ---- 2. queued tasks on one node --------------------------------
+        # Submission outruns execution (0-CPU nop workers drain slowly on
+        # purpose via a short sleep): measures how many tasks the raylet
+        # queue holds while staying responsive, and the submission rate.
+        @ray_tpu.remote
+        def tiny_sleep():
+            time.sleep(0.001)
+            return 0
+
+        with _scenario(out, "queued_tasks") as sc:
+            t0 = time.perf_counter()
+            refs = [tiny_sleep.remote() for _ in range(queued_target)]
+            submit_dt = time.perf_counter() - t0
+            # responsiveness probe while the queue drains
+            probe_t0 = time.perf_counter()
+            ray_tpu.get(nop.remote())
+            probe_ms = (time.perf_counter() - probe_t0) * 1000
+            ray_tpu.get(refs)  # full drain
+            drain_dt = time.perf_counter() - t0
+            sc.record(queued=queued_target,
+                      submit_per_sec=round(queued_target / submit_dt, 1),
+                      probe_latency_ms=round(probe_ms, 1),
+                      drain_tasks_per_sec=round(queued_target / drain_dt, 1))
+
+        # ---- 3. many objects in one get ---------------------------------
+        with _scenario(out, "get_many_objects") as sc:
+            refs = [ray_tpu.put(i) for i in range(get_objects)]
+            t0 = time.perf_counter()
+            vals = ray_tpu.get(refs)
+            get_dt = time.perf_counter() - t0
+            assert vals[-1] == get_objects - 1
+            sc.record(objects=get_objects,
+                      get_wall_s=round(get_dt, 3),
+                      objects_per_sec=round(get_objects / get_dt, 1))
+
+        # ---- 4. many object args to a single task -----------------------
+        @ray_tpu.remote
+        def count_args(*args):
+            return len(args)
+
+        with _scenario(out, "object_args_single_task") as sc:
+            refs = [ray_tpu.put(i) for i in range(task_args_target)]
+            t0 = time.perf_counter()
+            got = ray_tpu.get(count_args.remote(*refs))
+            sc.record(args=task_args_target, resolved=got,
+                      wall_s=round(time.perf_counter() - t0, 3))
+            assert got == task_args_target
+
+        # ---- 5. 100MB object broadcast to N tasks -----------------------
+        @ray_tpu.remote
+        def touch(arr):
+            return int(arr[0]) + arr.nbytes
+
+        with _scenario(out, "broadcast_100mb") as sc:
+            big = np.zeros(25_000_000, dtype=np.float32)  # 100 MB
+            ref = ray_tpu.put(big)
+            t0 = time.perf_counter()
+            ray_tpu.get([touch.remote(ref) for _ in range(8)])
+            dt = time.perf_counter() - t0
+            sc.record(consumers=8, wall_s=round(dt, 3),
+                      gb_per_sec=round(8 * big.nbytes / 1e9 / dt, 2))
+
+        # ---- 6. live actors ---------------------------------------------
+        # Each actor is a real worker process (like the reference): create
+        # until the target or the time budget, verify every one responds.
+        @ray_tpu.remote(num_cpus=0)
+        class Member:
+            def ping(self):
+                return os.getpid()
+
+        with _scenario(out, "live_actors") as sc:
+            actors = []
+            t0 = time.perf_counter()
+            batch = 50
+            while (len(actors) < actor_target
+                   and time.perf_counter() - t0 < actor_budget_s):
+                new = [Member.remote() for _ in range(
+                    min(batch, actor_target - len(actors)))]
+                # gate on liveness so we count REAL actors, not queued specs
+                ray_tpu.get([a.ping.remote() for a in new])
+                actors.extend(new)
+            create_dt = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            pids = ray_tpu.get([a.ping.remote() for a in actors])
+            call_dt = time.perf_counter() - t0
+            sc.record(actors=len(actors),
+                      distinct_pids=len(set(pids)),
+                      create_per_sec=round(len(actors) / create_dt, 1),
+                      fanout_call_wall_s=round(call_dt, 3),
+                      calls_per_sec=round(len(actors) / call_dt, 1))
+            for a in actors:
+                ray_tpu.kill(a)
+
+        # ---- 7. placement-group churn + simultaneous PGs ----------------
+        from ray_tpu.util.placement_group import (placement_group,
+                                                  remove_placement_group)
+
+        with _scenario(out, "placement_groups") as sc:
+            pgs = []
+            t0 = time.perf_counter()
+            for _ in range(pg_target):
+                pg = placement_group([{"CPU": 0.01}], strategy="PACK")
+                pg.wait(timeout=30)
+                pgs.append(pg)
+            create_dt = time.perf_counter() - t0
+            n_live = len(pgs)
+            t0 = time.perf_counter()
+            for pg in pgs:
+                remove_placement_group(pg)
+            remove_dt = time.perf_counter() - t0
+            sc.record(simultaneous_pgs=n_live,
+                      create_per_sec=round(n_live / create_dt, 1),
+                      remove_per_sec=round(n_live / remove_dt, 1))
+    finally:
+        ray_tpu.shutdown()
+    return out
+
+
+def main(args=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(prog="rt scale-envelope")
+    ap.add_argument("--actors", type=int, default=1000)
+    ap.add_argument("--queued", type=int, default=10_000)
+    ap.add_argument("--objects", type=int, default=1000)
+    ap.add_argument("--pgs", type=int, default=100)
+    ap.add_argument("--task-args", type=int, default=1000)
+    ap.add_argument("--actor-budget-s", type=float, default=120.0)
+    ap.add_argument("--out", type=str, default="")
+    ns = ap.parse_args(args)
+
+    result = run_envelope(actor_target=ns.actors, queued_target=ns.queued,
+                          get_objects=ns.objects, pg_target=ns.pgs,
+                          task_args_target=ns.task_args,
+                          actor_budget_s=ns.actor_budget_s)
+    doc = json.dumps(result, indent=2)
+    if ns.out:
+        with open(ns.out, "w") as f:
+            f.write(doc + "\n")
+    print(doc)
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

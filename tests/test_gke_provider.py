@@ -1,0 +1,241 @@
+"""GKE TPU node provider: k8s client surface, pod-group slice lifecycle,
+GKE env -> slice-label mapping, and the autoscaler end-to-end against a fake
+k8s API that boots REAL local nodes (reference pattern:
+``autoscaler/_private/kuberay/node_provider.py`` scale flow +
+``fake_multi_node/node_provider.py`` — fake the cloud, keep the runtime
+real)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.autoscaler import (
+    FakeK8sHttp,
+    GkeTpuPodProvider,
+    K8sClient,
+    StandardAutoscaler,
+)
+from ray_tpu.autoscaler.gke import (
+    GKE_SEL_ACCEL,
+    GKE_SEL_TOPOLOGY,
+    LABEL_SLICE,
+)
+from ray_tpu.core.resources import (
+    LABEL_SLICE_NAME,
+    LABEL_SLICE_TOPOLOGY,
+    LABEL_WORKER_ID_IN_SLICE,
+)
+
+NODE_TYPES = {
+    "v5e_2x4": {"accelerator": "tpu-v5-lite-podslice",
+                "accelerator_type": "v5litepod-8", "topology": "2x4",
+                "num_hosts": 2, "chips_per_host": 4,
+                "cpu": "1", "memory": "2Gi",
+                "resources": {"CPU": 2.0, "TPU": 8.0}}}
+
+
+class RecordingHttp:
+    def __init__(self, replies=None):
+        self.calls = []
+        self.replies = list(replies or [])
+
+    def __call__(self, method, url, headers, body):
+        self.calls.append((method, url, headers, body))
+        return self.replies.pop(0) if self.replies else (200, {})
+
+
+def _provider(http, gcs_address="unused"):
+    k8s = K8sClient(namespace="rt-ns", http=http,
+                    token_provider=lambda: "sa-token")
+    return GkeTpuPodProvider(gcs_address, NODE_TYPES,
+                             cluster_name="rt-test", k8s=k8s)
+
+
+def test_k8s_client_request_shapes():
+    http = RecordingHttp(replies=[(201, {}), (200, {"items": []}),
+                                  (200, {})])
+    client = K8sClient(namespace="ns1", http=http,
+                       token_provider=lambda: "tok")
+    client.create_pod({"metadata": {"name": "p1"}})
+    client.list_pods(label_selector="a=b")
+    client.delete_pod("p1")
+    (m1, u1, h1, _), (m2, u2, _, _), (m3, u3, _, _) = http.calls
+    base = "https://kubernetes.default.svc/api/v1/namespaces/ns1"
+    assert (m1, u1) == ("POST", f"{base}/pods")
+    assert h1["Authorization"] == "Bearer tok"
+    assert (m2, u2) == ("GET", f"{base}/pods?labelSelector=a=b")
+    assert (m3, u3) == ("DELETE", f"{base}/pods/p1")
+
+
+def test_k8s_client_error_raises():
+    http = RecordingHttp(replies=[(403, {"message": "denied"})])
+    client = K8sClient(namespace="ns", http=http,
+                       token_provider=lambda: "t")
+    with pytest.raises(RuntimeError, match="HTTP 403"):
+        client.list_pods()
+
+
+def test_pod_template_is_a_gke_tpu_pod():
+    """The pod body carries the GKE TPU nodepool selectors, the
+    google.com/tpu resource request, and the TPU_* env node_main maps to
+    slice labels."""
+    provider = _provider(RecordingHttp(), gcs_address="gcs:1234")
+    body = provider._pod_body("slice-x", "v5e_2x4", 1,
+                              NODE_TYPES["v5e_2x4"])
+    assert body["spec"]["nodeSelector"] == {
+        GKE_SEL_ACCEL: "tpu-v5-lite-podslice", GKE_SEL_TOPOLOGY: "2x4"}
+    ctr = body["spec"]["containers"][0]
+    assert ctr["resources"]["requests"]["google.com/tpu"] == "4"
+    assert ctr["resources"]["limits"]["google.com/tpu"] == "4"
+    env = {e["name"]: e["value"] for e in ctr["env"]}
+    assert env["TPU_WORKER_ID"] == "1"
+    assert env["TPU_NAME"] == "slice-x"
+    assert env["TPU_TOPOLOGY"] == "2x4"
+    # webhook format ("v5litepod-8"), not the nodeSelector string
+    assert env["TPU_ACCELERATOR_TYPE"] == "v5litepod-8"
+    assert "--address" in ctr["command"] and "gcs:1234" in ctr["command"]
+    assert body["metadata"]["labels"][LABEL_SLICE] == "slice-x"
+
+
+def test_gke_env_maps_to_slice_labels(monkeypatch):
+    """accelerator.py:gke_node_labels — the GKE-webhook env a pod sees
+    becomes the framework's slice labels at node registration (the
+    reference's RAY_GCE_TPU_ACCELERATOR_ENDPOINT analog)."""
+    from ray_tpu._private import accelerator
+
+    monkeypatch.setenv("TPU_NAME", "my-slice")
+    monkeypatch.setenv("TPU_WORKER_ID", "3")
+    monkeypatch.setenv("TPU_TOPOLOGY", "4x4")
+    monkeypatch.setenv("TPU_ACCELERATOR_TYPE", "v5litepod-16")
+    labels = accelerator.tpu_node_labels()
+    assert labels[LABEL_SLICE_NAME] == "my-slice"
+    assert labels[LABEL_WORKER_ID_IN_SLICE] == "3"
+    assert labels[LABEL_SLICE_TOPOLOGY] == "4x4"
+
+
+def test_provider_lifecycle_against_fake_api():
+    """create (2 pods/slice) -> list (grouped, with slice labels) ->
+    terminate (group delete), no cluster involved."""
+    fake = FakeK8sHttp("unused", boot=False)
+    provider = _provider(fake)
+
+    pid = provider.create_node("v5e_2x4", {"CPU": 2.0, "TPU": 8.0},
+                               {"autoscaler_node_type": "v5e_2x4"})
+    assert pid.startswith("rt-test-v5e_2x4-")
+    assert len(fake.pods) == 2  # one pod per slice host
+    nodes = provider.non_terminated_nodes()
+    assert len(nodes) == 1  # grouped into one provider node
+    assert nodes[0]["provider_node_id"] == pid
+    assert nodes[0]["node_type"] == "v5e_2x4"
+    assert nodes[0]["labels"][LABEL_SLICE_NAME] == pid
+    assert nodes[0]["labels"][LABEL_SLICE_TOPOLOGY] == "2x4"
+    assert nodes[0]["num_hosts"] == 2
+    provider.terminate_node(pid)
+    assert provider.non_terminated_nodes() == []
+    assert fake.pods == {}
+
+
+def test_fake_api_rejects_non_tpu_pods():
+    fake = FakeK8sHttp("unused", boot=False)
+    k8s = K8sClient(namespace="ns", http=fake,
+                    token_provider=lambda: "t")
+    with pytest.raises(RuntimeError, match="nodeSelector"):
+        k8s.create_pod({"metadata": {"name": "p", "labels": {}},
+                        "spec": {"nodeSelector": {},
+                                 "containers": [{"resources":
+                                                 {"requests": {}}}]}})
+
+
+def test_partial_slice_rolls_back():
+    """If host 2 of a slice fails to create, host 1 must not leak."""
+    fake = FakeK8sHttp("unused", boot=False)
+    real_create = fake._create
+    calls = {"n": 0}
+
+    def flaky_create(body):
+        calls["n"] += 1
+        if calls["n"] == 2:
+            return 500, {"message": "quota exceeded"}
+        return real_create(body)
+
+    fake._create = flaky_create
+    provider = _provider(fake)
+    with pytest.raises(RuntimeError, match="quota"):
+        provider.create_node("v5e_2x4", {}, {})
+    assert fake.pods == {}  # first pod rolled back
+
+
+def test_no_relaunch_while_slice_is_booting():
+    """Same double-provisioning guard as the TPU-VM provider: an in-flight
+    pod group counts as capacity while its hosts join the GCS."""
+    fake = FakeK8sHttp("unused", boot=False)
+    provider = _provider(fake)
+    load = [{"node_id": "@pending_pg_bundles", "alive": True, "labels": {},
+             "total": {}, "available": {},
+             "queued_demands": [{"resources": {"TPU": 4.0, "CPU": 0.5},
+                                 "count": 2}]}]
+    a = StandardAutoscaler({"max_workers": 4, "node_types": NODE_TYPES},
+                           provider, gcs_address="unused")
+    a._cluster_load = lambda: load
+    assert a.update()["launched"] == 1
+    assert a.update()["launched"] == 0
+    assert len(fake.pods) == 2
+
+
+@pytest.mark.slow
+def test_autoscaler_scales_fake_gke_slice_for_slice_group():
+    """Full gang flow on the k8s path: a pending slice_group() drives the
+    autoscaler to create ONE pod group; its two REAL node daemons join the
+    GCS with slice labels mapped from the GKE TPU env; the PG commits;
+    releasing it idles the slice and the whole pod group is deleted."""
+    from ray_tpu.cluster.cluster_utils import Cluster
+    from ray_tpu.util.placement_group import (
+        remove_placement_group,
+        slice_group,
+    )
+
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    c = Cluster(initialize_head=True, head_node_args={"num_cpus": 1})
+    fake = None
+    try:
+        c.connect_driver()
+        gcs_addr = c.gcs_address
+        fake = FakeK8sHttp(gcs_addr, cpus_per_host=1)
+        provider = _provider(fake, gcs_address=gcs_addr)
+        autoscaler = StandardAutoscaler(
+            {"min_workers": 0, "max_workers": 4, "idle_timeout_s": 1.0,
+             "node_types": NODE_TYPES},
+            provider, gcs_address=gcs_addr, update_interval_s=0.5)
+
+        pg = slice_group(num_hosts=2, chips_per_host=4, cpus_per_host=0.5)
+        deadline = time.monotonic() + 30
+        launched = 0
+        while time.monotonic() < deadline and not launched:
+            launched = autoscaler.update()["launched"]
+            time.sleep(0.5)
+        assert launched == 1
+        assert len(fake.pods) == 2
+
+        assert pg.wait(timeout=60)
+        nodes = {n["node_id"]: n for n in
+                 ray_tpu.global_worker()._require_backend().nodes()}
+        slice_nodes = [n for n in nodes.values()
+                       if n["labels"].get(LABEL_SLICE_NAME)]
+        assert len(slice_nodes) == 2
+        assert {n["labels"][LABEL_WORKER_ID_IN_SLICE]
+                for n in slice_nodes} == {"0", "1"}
+
+        remove_placement_group(pg)
+        deadline = time.monotonic() + 30
+        terminated = 0
+        while time.monotonic() < deadline and not terminated:
+            terminated = autoscaler.update()["terminated"]
+            time.sleep(0.5)
+        assert terminated == 1
+        assert fake.pods == {}
+    finally:
+        if fake is not None:
+            fake.shutdown()
+        c.shutdown()
