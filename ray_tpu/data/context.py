@@ -16,6 +16,18 @@ class DataContext:
     max_tasks_in_flight: int = 8
     read_parallelism: int = 8
     eager_free: bool = True
+    # rule-based logical-plan rewrites (data/optimizer.py; reference:
+    # _internal/logical/optimizers.py)
+    optimizer_enabled: bool = True
+    # resource-aware streaming backpressure (reference:
+    # streaming_executor_state.py:55 TopologyResourceUsage): a map stage
+    # stops submitting while its estimated in-flight output bytes exceed
+    # this budget (0 disables; the count cap above always applies).
+    memory_budget_bytes: int = 2 * 1024**3
+    # CPU-aware cap: in-flight tasks per stage <= cluster CPUs x this
+    # factor (0 disables; >1 keeps a submission queue so workers never
+    # idle between blocks).
+    cpu_oversubscription: float = 2.0
     # Pipelined shuffle via per-partition merger actors (reference:
     # _internal/push_based_shuffle.py, Exoshuffle): map outputs stream into
     # mergers while other map tasks still run; memory per partition is

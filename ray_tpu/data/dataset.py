@@ -104,7 +104,22 @@ class Dataset:
         from ray_tpu.data.executor import execute_streaming
 
         ctx = DataContext.get_current()
-        return execute_streaming(self._source_refs(), self._ops, ctx)
+        ops = self._ops
+        src = self
+        if ctx.optimizer_enabled and self._materialized is None:
+            from ray_tpu.data.optimizer import optimize
+
+            read_tasks, ops, _ = optimize(self._read_tasks, self._ops)
+            if read_tasks is not self._read_tasks:
+                src = Dataset(read_tasks, [])
+        return execute_streaming(src._source_refs(), ops, ctx)
+
+    def explain(self) -> str:
+        """Before/after logical plan with the optimizer rules applied
+        (reference: ``Dataset.explain``/plan logging)."""
+        from ray_tpu.data.optimizer import explain
+
+        return explain(self._read_tasks, self._ops)
 
     def materialize(self) -> "Dataset":
         refs = list(self._execute_refs())

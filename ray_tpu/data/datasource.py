@@ -77,6 +77,10 @@ def parquet_read_tasks(paths, columns: Optional[List[str]] = None) -> List[ReadT
             for rg in range(f.num_row_groups):
                 yield _table_to_block(f.read_row_group(rg, columns=columns))
 
+        # tags the optimizer's projection-pushdown rule rewrites by
+        # (optimizer.py:_rewrite_parquet_columns)
+        read.parquet_path = path
+        read.parquet_columns = list(columns) if columns else None
         return read
 
     return [make(p) for p in files]

@@ -109,10 +109,13 @@ def _run_fused(fns: List[Callable], block: B.Block,
     return block
 
 
-@ray_tpu.remote
-def _map_task(fns: List[Callable], block: B.Block,
-              block_idx: int) -> B.Block:
-    return _run_fused(fns, block, block_idx)
+@ray_tpu.remote(num_returns=2)
+def _map_task(fns: List[Callable], block: B.Block, block_idx: int):
+    """Returns (block, metadata): the small metadata ref resolves with the
+    task and feeds the stage's memory accounting without pulling the block
+    (reference: RefBundle carries BlockMetadata)."""
+    out = _run_fused(fns, block, block_idx)
+    return out, {"nbytes": B.size_bytes(out), "rows": B.num_rows(out)}
 
 
 @ray_tpu.remote
@@ -155,25 +158,88 @@ class Stage:
 
 
 class MapStage(Stage):
+    """Bounded-in-flight map over blocks with resource-aware backpressure.
+
+    Reference analog: ``TaskPoolMapOperator`` under
+    ``streaming_executor_state.py:55`` (``TopologyResourceUsage``): the
+    stage stops submitting when (a) the task-count cap is reached, (b) the
+    count exceeds the cluster's CPU slots x oversubscription, or (c) the
+    estimated bytes of in-flight outputs (EWMA of completed block sizes)
+    exceed the stage's memory budget — so a fast producer ahead of a slow
+    consumer is throttled instead of buffering the whole dataset.
+    """
+
     def __init__(self, fns: List[Callable], options: Dict[str, Any]):
         self.fns = fns
         self.options = options
+        self.stats: Dict[str, Any] = {"submitted": 0, "completed_meta": 0,
+                                      "bytes_ewma": 0.0,
+                                      "backpressure_events": 0}
+
+    def _count_cap(self, ctx) -> int:
+        cap = ctx.max_tasks_in_flight
+        if getattr(ctx, "cpu_oversubscription", 0):
+            try:
+                cpus = ray_tpu.cluster_resources().get("CPU", 0)
+            except Exception:  # noqa: BLE001 — sizing hint only
+                cpus = 0
+            if cpus:
+                task_cpus = self.options.get("num_cpus") or 1
+                cap = min(cap, max(1, int(
+                    cpus / task_cpus * ctx.cpu_oversubscription)))
+        return cap
 
     def run(self, upstream: Iterator, ctx) -> Iterator:
-        max_inflight = ctx.max_tasks_in_flight
+        max_inflight = self._count_cap(ctx)
+        mem_budget = getattr(ctx, "memory_budget_bytes", 0)
         task = _map_task.options(**self.options) if self.options else _map_task
         inflight: collections.deque = collections.deque()
+        pending_meta: List = []
         upstream = iter(upstream)
         exhausted = False
         block_idx = 0
+
+        def harvest_meta() -> None:
+            # resolve completed metadata without blocking; update the EWMA
+            if not pending_meta:
+                return
+            done, rest = ray_tpu.wait(pending_meta,
+                                      num_returns=len(pending_meta),
+                                      timeout=0)
+            pending_meta[:] = rest
+            for m in done:
+                try:
+                    meta = ray_tpu.get(m)
+                except Exception:  # noqa: BLE001 — error surfaces via block
+                    continue
+                prev = self.stats["bytes_ewma"]
+                self.stats["bytes_ewma"] = (
+                    meta["nbytes"] if not prev
+                    else 0.7 * prev + 0.3 * meta["nbytes"])
+                self.stats["completed_meta"] += 1
+
+        def over_memory() -> bool:
+            if not mem_budget or not self.stats["bytes_ewma"]:
+                return False
+            est = len(inflight) * self.stats["bytes_ewma"]
+            if est >= mem_budget:
+                self.stats["backpressure_events"] += 1
+                return True
+            return False
+
         while True:
-            while not exhausted and len(inflight) < max_inflight:
+            harvest_meta()
+            while (not exhausted and len(inflight) < max_inflight
+                   and not over_memory()):
                 try:
                     ref = next(upstream)
                 except StopIteration:
                     exhausted = True
                     break
-                inflight.append(task.remote(self.fns, ref, block_idx))
+                block_ref, meta_ref = task.remote(self.fns, ref, block_idx)
+                inflight.append(block_ref)
+                pending_meta.append(meta_ref)
+                self.stats["submitted"] += 1
                 block_idx += 1
             if not inflight:
                 return

@@ -1,0 +1,122 @@
+"""Rule-based logical-plan optimizer.
+
+Reference analog: ``data/_internal/logical/optimizers.py:1`` (the
+``LogicalOptimizer`` rule list: operator fusion, limit/projection pushdown,
+randomize-blocks reordering). The executor's planner already fuses runs of
+map-like ops into single tasks (``executor.py:plan``); this pass runs BEFORE
+planning and rewrites the (read_tasks, ops) pair itself:
+
+  - ``projection_pushdown_into_read``: a leading ``SelectColumns`` over
+    column-rewritable read tasks (parquet) becomes a column-pruned read —
+    pruned columns are never decoded or shipped.
+  - ``limit_pushdown``: ``Limit`` moves upstream past row-count-preserving
+    ops so per-row work happens only on surviving rows; adjacent limits
+    collapse to the smaller.
+  - ``filter_before_shuffle``: a ``Filter`` directly after
+    ``RandomShuffle``/``Repartition`` runs before it instead — dropped rows
+    are never shuffled.
+  - ``shuffle_elision``: a ``RandomShuffle``/``Repartition`` feeding an
+    order-insensitive all-to-all (``Aggregate``, ``Sort``, another shuffle)
+    is dead work and is removed.
+
+Every rewrite is semantics-preserving on the multiset of rows (order is
+only reordered where the downstream op is order-insensitive). ``optimize``
+returns the applied rule names so callers/tests can assert on them.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Tuple
+
+from ray_tpu.data import logical as L
+
+# ops that neither add, drop, nor reorder rows — Limit commutes with them
+_ROW_PRESERVING = (L.MapRows, L.AddColumn, L.DropColumns, L.SelectColumns)
+
+
+def _rewrite_parquet_columns(read_tasks: List,
+                             columns: List[str]) -> Optional[List]:
+    """Rebuild parquet read tasks with a pruned column list; None if any
+    task is not column-rewritable (non-parquet or already narrower)."""
+    from ray_tpu.data.datasource import parquet_read_tasks
+
+    paths = []
+    for t in read_tasks:
+        path = getattr(t, "parquet_path", None)
+        if path is None:
+            return None
+        existing = getattr(t, "parquet_columns", None)
+        if existing is not None and not set(columns) <= set(existing):
+            return None  # selection asks for columns the read won't have
+        paths.append(path)
+    return parquet_read_tasks(paths, columns=list(columns))
+
+
+def optimize(read_tasks: List, ops: List[L.LogicalOp]
+             ) -> Tuple[List, List[L.LogicalOp], List[str]]:
+    """Apply rules to fixpoint; returns (read_tasks, ops, applied_rules)."""
+    applied: List[str] = []
+    ops = list(ops)
+
+    changed = True
+    while changed:
+        changed = False
+
+        # -- projection pushdown into the read ---------------------------
+        if ops and isinstance(ops[0], L.SelectColumns):
+            rewritten = _rewrite_parquet_columns(read_tasks, ops[0].columns)
+            if rewritten is not None:
+                read_tasks = rewritten
+                ops.pop(0)
+                applied.append("projection_pushdown_into_read")
+                changed = True
+                continue
+
+        for i in range(len(ops) - 1):
+            a, b = ops[i], ops[i + 1]
+            # -- limit pushdown / fusion ---------------------------------
+            if isinstance(b, L.Limit) and isinstance(a, _ROW_PRESERVING):
+                ops[i], ops[i + 1] = b, a
+                applied.append("limit_pushdown")
+                changed = True
+                break
+            if isinstance(a, L.Limit) and isinstance(b, L.Limit):
+                ops[i:i + 2] = [L.Limit(min(a.n, b.n))]
+                applied.append("limit_fusion")
+                changed = True
+                break
+            # -- filter before shuffle -----------------------------------
+            if (isinstance(b, L.Filter)
+                    and isinstance(a, (L.RandomShuffle, L.Repartition))):
+                ops[i], ops[i + 1] = b, a
+                applied.append("filter_before_shuffle")
+                changed = True
+                break
+            # -- shuffle elision -----------------------------------------
+            # a's row distribution is destroyed/recreated by b anyway —
+            # EXCEPT RandomShuffle -> Repartition: repartition scatters
+            # deterministically, so eliding the shuffle would silently
+            # drop the pipeline's randomness
+            if (isinstance(a, (L.RandomShuffle, L.Repartition))
+                    and isinstance(b, (L.Aggregate, L.Sort,
+                                       L.RandomShuffle, L.Repartition))
+                    and not (isinstance(a, L.RandomShuffle)
+                             and isinstance(b, L.Repartition))):
+                ops.pop(i)
+                applied.append("shuffle_elision")
+                changed = True
+                break
+
+    return read_tasks, ops, applied
+
+
+def explain(read_tasks: List, ops: List[L.LogicalOp]) -> str:
+    """Human-readable before/after plan (``Dataset.explain()``)."""
+    before = [type(o).__name__ for o in ops]
+    _, out_ops, applied = optimize(read_tasks, ops)
+    after = [type(o).__name__ for o in out_ops]
+    lines = [f"logical plan : {' -> '.join(before) or '(scan only)'}",
+             f"optimized    : {' -> '.join(after) or '(scan only)'}"]
+    if applied:
+        lines.append(f"applied rules: {', '.join(applied)}")
+    return "\n".join(lines)

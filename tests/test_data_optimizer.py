@@ -1,0 +1,217 @@
+"""Logical-plan optimizer rules + resource-aware streaming backpressure
+(reference: ``data/_internal/logical/optimizers.py``,
+``streaming_executor_state.py:55`` TopologyResourceUsage)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data as rt_data
+from ray_tpu.data import logical as L
+from ray_tpu.data.context import DataContext
+from ray_tpu.data.optimizer import optimize
+
+
+@pytest.fixture(scope="module")
+def rt():
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=4)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+# ---- pure rewrite rules (no cluster) ---------------------------------------
+
+
+def test_limit_pushdown_past_row_preserving_ops():
+    ops = [L.MapRows(lambda r: r), L.AddColumn("x", lambda b: 1),
+           L.Limit(5)]
+    _, out, applied = optimize([], ops)
+    assert [type(o).__name__ for o in out] == \
+        ["Limit", "MapRows", "AddColumn"]
+    assert "limit_pushdown" in applied
+
+
+def test_limit_does_not_cross_filter():
+    """Filter drops rows: Limit(5) after Filter keeps 5 SURVIVORS, which is
+    not Limit(5) before Filter — must not be reordered."""
+    ops = [L.Filter(lambda r: True), L.Limit(5)]
+    _, out, applied = optimize([], ops)
+    assert [type(o).__name__ for o in out] == ["Filter", "Limit"]
+    assert applied == []
+
+
+def test_limit_fusion():
+    _, out, applied = optimize([], [L.Limit(10), L.Limit(3), L.Limit(7)])
+    assert len(out) == 1 and out[0].n == 3
+    assert "limit_fusion" in applied
+
+
+def test_filter_before_shuffle():
+    ops = [L.RandomShuffle(seed=0), L.Filter(lambda r: r["id"] % 2 == 0)]
+    _, out, applied = optimize([], ops)
+    assert [type(o).__name__ for o in out] == ["Filter", "RandomShuffle"]
+    assert "filter_before_shuffle" in applied
+
+
+def test_shuffle_elision_before_aggregate_and_sort():
+    from ray_tpu.data.aggregate import Sum
+
+    ops = [L.RandomShuffle(), L.Aggregate("k", [Sum("v")])]
+    _, out, applied = optimize([], ops)
+    assert [type(o).__name__ for o in out] == ["Aggregate"]
+    assert "shuffle_elision" in applied
+
+    ops = [L.Repartition(4), L.Sort("k")]
+    _, out, _ = optimize([], ops)
+    assert [type(o).__name__ for o in out] == ["Sort"]
+
+    ops = [L.RandomShuffle(seed=1), L.RandomShuffle(seed=2)]
+    _, out, _ = optimize([], ops)
+    assert len(out) == 1 and out[0].seed == 2
+
+    # NOT elided: repartition scatters deterministically, so dropping the
+    # shuffle would silently lose the pipeline's randomness
+    ops = [L.RandomShuffle(seed=1), L.Repartition(4)]
+    _, out, applied = optimize([], ops)
+    assert [type(o).__name__ for o in out] == ["RandomShuffle",
+                                               "Repartition"]
+    assert "shuffle_elision" not in applied
+
+
+def test_shuffle_kept_before_limit():
+    """shuffle+limit is a random sample — elision would change semantics."""
+    ops = [L.RandomShuffle(seed=0), L.Limit(3)]
+    _, out, applied = optimize([], ops)
+    assert [type(o).__name__ for o in out] == ["RandomShuffle", "Limit"]
+
+
+def test_projection_pushdown_into_parquet_read(tmp_path, rt):
+    import pandas as pd
+
+    path = str(tmp_path / "t.parquet")
+    pd.DataFrame({"a": np.arange(50), "b": np.arange(50) * 2,
+                  "c": np.arange(50) * 3}).to_parquet(path)
+    ds = rt_data.read_parquet(path).select_columns(["a", "c"])
+    tasks, out, applied = optimize(ds._read_tasks, ds._ops)
+    assert "projection_pushdown_into_read" in applied
+    assert out == []  # select absorbed into the read
+    assert tasks[0].parquet_columns == ["a", "c"]
+    # end to end: pruned read produces only the selected columns
+    got = ds.take_all()
+    assert set(got[0].keys()) == {"a", "c"}
+    assert [r["c"] for r in got[:3]] == [0, 3, 6]
+
+
+def test_projection_pushdown_skipped_for_non_parquet(rt):
+    ds = rt_data.range(10).select_columns(["id"])
+    tasks, out, applied = optimize(ds._read_tasks, ds._ops)
+    assert applied == []
+    assert [type(o).__name__ for o in out] == ["SelectColumns"]
+
+
+def test_explain_reports_rules(tmp_path, rt):
+    ds = rt_data.range(100).map(lambda r: r).limit(5)
+    text = ds.explain()
+    assert "limit_pushdown" in text
+    assert "Limit -> MapRows" in text
+
+
+# ---- optimized == unoptimized results --------------------------------------
+
+
+def test_optimizer_preserves_results(rt):
+    def build():
+        return (rt_data.range(200)
+                .map(lambda r: {"id": r["id"], "y": r["id"] * 2})
+                .limit(40)
+                .filter(lambda r: r["id"] % 2 == 0))
+
+    ctx = DataContext.get_current()
+    ctx.optimizer_enabled = False
+    try:
+        want = sorted(r["y"] for r in build().take_all())
+    finally:
+        ctx.optimizer_enabled = True
+    got = sorted(r["y"] for r in build().take_all())
+    assert got == want == sorted(i * 2 for i in range(40) if i % 2 == 0)
+
+
+def test_shuffle_elision_preserves_aggregate(rt):
+    from ray_tpu.data.aggregate import Sum
+
+    ds = (rt_data.range(100)
+          .add_column("k", lambda b: b["id"] % 4)
+          .random_shuffle(seed=0)
+          .groupby("k").aggregate(Sum("id")))
+    rows = sorted(ds.take_all(), key=lambda r: r["k"])
+    assert [r["sum(id)"] for r in rows] == [
+        sum(i for i in range(100) if i % 4 == k) for k in range(4)]
+
+
+# ---- resource-aware backpressure -------------------------------------------
+
+
+def test_memory_budget_bounds_inflight(rt):
+    """With a tiny memory budget and a slow consumer, the map stage must
+    throttle submission: in-flight tasks stay near the bytes bound, not the
+    count cap, and backpressure events are recorded."""
+    from ray_tpu.data.executor import MapStage, _compile_map_like
+
+    ctx = DataContext.get_current()
+    old = (ctx.max_tasks_in_flight, ctx.memory_budget_bytes)
+    ctx.max_tasks_in_flight = 16
+    # each block is ~80KB (10k float64); budget of 200KB allows ~2 in flight
+    ctx.memory_budget_bytes = 200 * 1024
+    try:
+        stage = MapStage(
+            [_compile_map_like(L.MapBatches(
+                lambda b: {"x": np.zeros(10_000, dtype=np.float64)},
+                batch_size=None))], {})
+        src = [ray_tpu.put({"x": np.zeros(10_000, dtype=np.float64)})
+               for _ in range(12)]
+        peak = 0
+        out = []
+        for ref in stage.run(iter(src), ctx):
+            out.append(ray_tpu.get(ref))  # slow consumer: one at a time
+            inflight_est = (stage.stats["submitted"] - len(out))
+            peak = max(peak, inflight_est)
+        assert len(out) == 12
+        # with EWMA ~80KB and a 200KB budget the stage should hold ~2-3 in
+        # flight once metadata arrives — far below the count cap of 16
+        assert stage.stats["backpressure_events"] > 0
+        assert peak < 16
+    finally:
+        ctx.max_tasks_in_flight, ctx.memory_budget_bytes = old
+
+
+def test_trainer_fed_from_parquet_pipeline(tmp_path, rt):
+    """The VERDICT r4 #6 proof shape: JaxTrainer consuming a parquet
+    pipeline through iter_batches — bounded buffering, every row arrives."""
+    import pandas as pd
+
+    from ray_tpu.train import JaxTrainer, ScalingConfig
+
+    for i in range(4):
+        pd.DataFrame({"x": np.arange(64) + 64 * i}).to_parquet(
+            str(tmp_path / f"p{i}.parquet"))
+    ds = (rt_data.read_parquet(str(tmp_path) + "/*.parquet")
+          .map_batches(lambda b: {"x": b["x"] * 2}))
+
+    def loop(config):
+        from ray_tpu import train
+
+        shard = train.get_dataset_shard("train")
+        total = n = 0
+        for batch in shard.iter_batches(batch_size=32):
+            total += int(batch["x"].sum())
+            n += len(batch["x"])
+        train.report({"total": total, "rows": n})
+
+    result = JaxTrainer(
+        loop, scaling_config=ScalingConfig(num_workers=1,
+                                           cpus_per_worker=1),
+        datasets={"train": ds}).fit()
+    assert result.metrics["rows"] == 256
+    assert result.metrics["total"] == sum(2 * v for v in range(256))
