@@ -19,9 +19,10 @@ from ray_tpu.cluster.raylet import Raylet
 
 class Cluster:
     def __init__(self, initialize_head: bool = True,
-                 head_node_args: Optional[Dict] = None):
+                 head_node_args: Optional[Dict] = None,
+                 gcs_persist_path: Optional[str] = None):
         self._handle = ClusterHandle()
-        self._handle.start_gcs()
+        self._handle.start_gcs(persist_path=gcs_persist_path)
         self.head_node: Optional[Raylet] = None
         if initialize_head:
             self.head_node = self.add_node(**(head_node_args or {}))
@@ -38,6 +39,14 @@ class Cluster:
 
     def remove_node(self, node: Raylet) -> None:
         self._handle.remove_node(node)
+
+    def kill_gcs(self) -> None:
+        """Chaos: crash the head (reference NodeKiller-style fault
+        injection, ``_private/test_utils.py:1401``)."""
+        self._handle.kill_gcs()
+
+    def restart_gcs(self) -> str:
+        return self._handle.restart_gcs()
 
     def connect_driver(self, namespace: Optional[str] = None):
         """Attach the global worker to this cluster as a driver."""

@@ -36,13 +36,44 @@ class ClusterHandle:
         self.gcs: Optional[GcsServer] = None
         self.gcs_address: Optional[str] = None
         self.raylets: List[Raylet] = []
+        self._gcs_persist_path: Optional[str] = None
 
-    def start_gcs(self) -> str:
+    def start_gcs(self, persist_path: Optional[str] = None) -> str:
+        self._gcs_persist_path = persist_path
+
         async def _go():
-            self.gcs = GcsServer()
+            self.gcs = GcsServer(persist_path=persist_path)
             server = RpcServer(self.io.loop)
             server.register_object(self.gcs)
             await server.start()
+            self.gcs.start_monitor()
+            self._gcs_rpc_server = server
+            return server.address
+
+        self.gcs_address = self.io.run(_go())
+        return self.gcs_address
+
+    def kill_gcs(self) -> None:
+        """Chaos helper: take the head down (RPC server closed, component
+        stopped). Clients see ConnectionLost; WAL-backed state survives."""
+        async def _go():
+            await self._gcs_rpc_server.stop()
+            await self.gcs.stop()
+
+        self.io.run(_go())
+        self.gcs = None
+
+    def restart_gcs(self) -> str:
+        """Bring the head back ON THE SAME ADDRESS with the persisted
+        state; live raylets reconnect (RpcClient auto_reconnect) and
+        re-register via the heartbeat 'unknown' path."""
+        port = int(self.gcs_address.rsplit(":", 1)[1])
+
+        async def _go():
+            self.gcs = GcsServer(persist_path=self._gcs_persist_path)
+            server = RpcServer(self.io.loop)
+            server.register_object(self.gcs)
+            await server.start(port=port)
             self.gcs.start_monitor()
             self._gcs_rpc_server = server
             return server.address

@@ -144,7 +144,9 @@ class Raylet:
     # ---- lifecycle ----------------------------------------------------------
     async def start(self, port: int = 0) -> str:
         await self.server.start(port)
-        self._gcs = RpcClient(self.gcs_address, peer_id=f"raylet:{self.node_id}")
+        self._gcs = RpcClient(self.gcs_address,
+                              peer_id=f"raylet:{self.node_id}",
+                              auto_reconnect=True)
         await self._gcs.connect()
         await self._gcs.call("register_node", {
             "node_id": self.node_id, "address": self.server.address,

@@ -217,25 +217,61 @@ class RpcServer:
 
 
 class RpcClient:
-    """One multiplexed connection to a server; safe for concurrent calls."""
+    """One multiplexed connection to a server; safe for concurrent calls.
 
-    def __init__(self, address: str, peer_id: str = ""):
+    ``auto_reconnect=True`` makes ``call`` re-dial a dropped connection
+    (single-flight) instead of failing forever — the client half of GCS
+    head-restart recovery (reference: ``GcsClient`` auto-reconnect,
+    ``_raylet.pyx:2346``): long-lived daemons (raylets, pollers) ride out
+    a head crash and their next call lands on the resurrected server.
+    In-flight calls at drop time still fail with ConnectionLost — only
+    NEW calls reconnect; callers with at-most-once concerns keep their
+    retry decisions."""
+
+    def __init__(self, address: str, peer_id: str = "",
+                 auto_reconnect: bool = False):
         self.address = address
         self._peer_id = peer_id
+        self.auto_reconnect = auto_reconnect
         self._reader: Optional[asyncio.StreamReader] = None
         self._writer: Optional[asyncio.StreamWriter] = None
         self._pending: Dict[int, asyncio.Future] = {}
         self._next_id = 0
         self._lock: Optional[asyncio.Lock] = None
         self._closed = False
+        self._explicitly_closed = False
+        self._reconnect_lock: Optional[asyncio.Lock] = None
 
     async def connect(self) -> None:
         host, port = self.address.rsplit(":", 1)
         self._reader, self._writer = await asyncio.open_connection(host, int(port))
         self._lock = asyncio.Lock()
+        self._closed = False
         self._read_task = asyncio.ensure_future(self._read_loop())
         if self._peer_id:
             await self.call("hello", {"peer_id": self._peer_id})
+
+    async def _reconnect(self) -> None:
+        if self._reconnect_lock is None:
+            self._reconnect_lock = asyncio.Lock()
+        async with self._reconnect_lock:
+            if not self._closed:
+                return  # another caller won the race
+            if self._explicitly_closed:
+                raise ConnectionLost(
+                    f"connection to {self.address} closed")
+            await cancel_and_wait(getattr(self, "_read_task", None))
+            if self._writer is not None:
+                # release the dead socket before dialing again — daemons
+                # riding out repeated head crashes must not leak one FD
+                # per reconnect cycle
+                self._writer.close()
+                self._writer = None
+            try:
+                await self.connect()
+            except OSError as e:
+                raise ConnectionLost(
+                    f"reconnect to {self.address} failed: {e}") from None
 
     async def _read_loop(self) -> None:
         try:
@@ -272,7 +308,9 @@ class RpcClient:
     async def call(self, method: str, payload: Any = None,
                    timeout: Optional[float] = None) -> Any:
         if self._closed:
-            raise ConnectionLost(f"connection to {self.address} closed")
+            if not self.auto_reconnect or self._explicitly_closed:
+                raise ConnectionLost(f"connection to {self.address} closed")
+            await self._reconnect()
         fut = asyncio.get_running_loop().create_future()
         async with self._lock:
             msg_id = self._next_id
@@ -286,6 +324,7 @@ class RpcClient:
 
     async def close(self) -> None:
         self._closed = True
+        self._explicitly_closed = True
         if self._writer is not None:
             self._writer.close()
         await cancel_and_wait(getattr(self, "_read_task", None))

@@ -272,7 +272,8 @@ class ClusterBackend(RuntimeBackend):
     def connect(self) -> None:
         async def _go():
             await self.server.start()
-            self._gcs = RpcClient(self.gcs_address, peer_id=self.role)
+            self._gcs = RpcClient(self.gcs_address, peer_id=self.role,
+                                  auto_reconnect=True)
             await self._gcs.connect()
             self._raylet = RpcClient(self.raylet_address, peer_id=self.role)
             await self._raylet.connect()

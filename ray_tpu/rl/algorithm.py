@@ -9,10 +9,13 @@ overrides.
 
 from __future__ import annotations
 
+import logging
 import pickle
 from typing import Any, Dict, List, Optional
 
 import numpy as np
+
+logger = logging.getLogger("ray_tpu.rl")
 
 import ray_tpu
 from ray_tpu.rl.config import AlgorithmConfig
@@ -75,9 +78,12 @@ class Algorithm(Trainable):
             self.spec = make_env(cfg.env, 1, cfg.env_config).spec
             n_runners = max(1, cfg.num_env_runners) \
                 if self.need_env_runners else 0
+            restarts = (cfg.max_env_runner_restarts
+                        if cfg.restart_failed_env_runners else 0)
             self.runners = [
                 EnvRunner.options(num_cpus=cfg.num_cpus_per_runner,
-                                  runtime_env=cfg.runner_runtime_env).remote(
+                                  runtime_env=cfg.runner_runtime_env,
+                                  max_restarts=restarts).remote(
                     cfg.env, cfg.num_envs_per_runner,
                     cfg.rollout_fragment_length, cfg.gamma, cfg.lambda_,
                     seed=cfg.seed + 1000 * i, env_config=cfg.env_config,
@@ -127,11 +133,33 @@ class Algorithm(Trainable):
         return _models.init_policy(jax.random.key(cfg.seed), self.spec,
                                    cfg.hidden)
 
+    def gather_tolerant(self, refs: List) -> List:
+        """Per-ref get that DROPS failed results instead of failing the
+        iteration (reference: FaultTolerantActorManager.foreach_worker
+        with mark_healthy semantics). Raises only when everything failed —
+        a fleet-wide outage is not survivable. The dead runner's actor
+        restarts in the background (max_restarts) and serves the next
+        iteration."""
+        out, last_err = [], None
+        for ref in refs:
+            try:
+                out.append(ray_tpu.get(ref))
+            except Exception as e:  # noqa: BLE001 — fragment loss, not fatal
+                last_err = e
+                logger.warning("env-runner call failed (%s: %s) — dropping "
+                               "this fragment; the runner restarts if it "
+                               "has budget", type(e).__name__,
+                               str(e)[:120])
+        if not out and refs:
+            raise last_err
+        return out
+
     def synchronous_sample(self, params) -> Dict[str, np.ndarray]:
         """Fan out sample() to the runner fleet and concat fragments
-        (reference: ``rollout_ops.synchronous_parallel_sample``)."""
-        batches = ray_tpu.get([r.sample.remote(params)
-                               for r in self.runners])
+        (reference: ``rollout_ops.synchronous_parallel_sample``); tolerates
+        individual runner deaths (fragments dropped for the iteration)."""
+        batches = self.gather_tolerant([r.sample.remote(params)
+                                        for r in self.runners])
         self._sync_connectors()
         batch = {k: np.concatenate([b[k] for b in batches])
                  for k in batches[0]}
@@ -147,16 +175,20 @@ class Algorithm(Trainable):
         back — every runner then normalizes with the FLEET's statistics."""
         if self._conn_pipeline is None:
             return
-        deltas = ray_tpu.get([r.pop_connector_deltas.remote()
-                              for r in self.runners])
+        deltas = self.gather_tolerant([r.pop_connector_deltas.remote()
+                                       for r in self.runners])
         self._connector_state = self._conn_pipeline.merge_deltas(
             self._connector_state, [d for d in deltas if d is not None])
-        ray_tpu.get([r.set_connector_globals.remote(self._connector_state)
-                     for r in self.runners])
+        try:
+            self.gather_tolerant(
+                [r.set_connector_globals.remote(self._connector_state)
+                 for r in self.runners])
+        except Exception:  # noqa: BLE001 — rebroadcast next iteration
+            pass
 
     def collect_episode_stats(self) -> Dict[str, float]:
-        stats = ray_tpu.get([r.episode_stats.remote()
-                             for r in self.runners])
+        stats = self.gather_tolerant([r.episode_stats.remote()
+                                      for r in self.runners])
         returns = [s["mean_return"] for s in stats if s["episodes"] > 0]
         episodes = sum(s["episodes"] for s in stats)
         if returns:

@@ -97,8 +97,17 @@ class IMPALA(Algorithm):
         self.learner = Learner(params, loss_fn, cfg.lr,
                                grad_clip=cfg.grad_clip, seed=cfg.seed)
         self._inflight: Dict[Any, Any] = {}
+        self._runner_failures: Dict[Any, int] = {}
+
+    # consecutive failures before a runner leaves the rotation: a runner
+    # past max_restarts fails refs INSTANTLY — resubmitting forever would
+    # win every wait() and starve live runners' fragments
+    _MAX_CONSECUTIVE_FAILURES = 3
 
     def _submit(self, runner) -> None:
+        if self._runner_failures.get(runner, 0) \
+                >= self._MAX_CONSECUTIVE_FAILURES:
+            return  # evicted from rotation
         ref = runner.sample.remote(self.learner.get_params())
         self._inflight[ref] = runner
 
@@ -107,18 +116,42 @@ class IMPALA(Algorithm):
         for r in self.runners:  # keep every runner busy (async pipeline)
             if r not in self._inflight.values():
                 self._submit(r)
+        if not self._inflight:
+            raise RuntimeError(
+                "all env-runners failed permanently (each exceeded "
+                f"{self._MAX_CONSECUTIVE_FAILURES} consecutive failures)")
         metrics_list: List[Dict] = []
         consumed = 0
-        # consume as many fragments as there are runners per step
+        # consume as many fragments as there are runners per step; a dead
+        # runner's fragment is dropped and the (restarting) runner is
+        # resubmitted — fleet fault tolerance (reference:
+        # FaultTolerantActorManager under the IMPALA aggregation path)
         for _ in range(len(self.runners)):
+            if not self._inflight:
+                break
             ready, _ = ray_tpu.wait(list(self._inflight), num_returns=1)
             ref = ready[0]
             runner = self._inflight.pop(ref)
-            batch = ray_tpu.get(ref)
+            try:
+                batch = ray_tpu.get(ref)
+            except Exception as e:  # noqa: BLE001 — fragment lost, not fatal
+                import logging
+
+                logging.getLogger("ray_tpu.rl").warning(
+                    "IMPALA runner fragment lost (%s: %s) — resubmitting",
+                    type(e).__name__, str(e)[:120])
+                self._runner_failures[runner] = \
+                    self._runner_failures.get(runner, 0) + 1
+                self._submit(runner)  # restarted actor serves this
+                continue
+            self._runner_failures.pop(runner, None)
             self._submit(runner)  # immediately resubmit with fresh params
             consumed += len(batch["rewards"])
             self._env_steps_total += len(batch["rewards"])
             metrics_list.append(self.learner.update_minibatch(batch))
+        if not metrics_list:
+            return {"env_steps_this_iter": 0,
+                    **self.collect_episode_stats()}
         out = {k: float(np.mean([float(m[k]) for m in metrics_list]))
                for k in metrics_list[0]}
         out["env_steps_this_iter"] = consumed

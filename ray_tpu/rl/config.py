@@ -29,6 +29,12 @@ class AlgorithmConfig:
     # policy forward to host CPUs while the learner owns the chip
     # (BASELINE config 4's CPU-rollouts -> TPU-learner architecture).
     runner_runtime_env: Optional[dict] = None
+    # Fleet fault tolerance (reference: FaultTolerantActorManager,
+    # rllib/utils/actor_manager.py): runners restart on worker death and a
+    # failed fragment is dropped for the iteration instead of killing the
+    # training loop.
+    restart_failed_env_runners: bool = True
+    max_env_runner_restarts: int = 2
     # connector pipeline specs, e.g. ["mean_std_filter",
     # {"type": "clip_reward", "limit": 1.0}] (rl/connectors.py)
     connectors: Any = None

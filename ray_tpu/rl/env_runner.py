@@ -114,6 +114,13 @@ class EnvRunner:
         else:
             self._value_fn = jax.jit(models.value)
 
+    def get_pid(self) -> int:
+        """Worker process id — chaos/fault-injection hook (reference:
+        NodeKiller-style tests kill rollout workers by pid)."""
+        import os
+
+        return os.getpid()
+
     def get_spec(self):
         return self.spec
 

@@ -1,0 +1,193 @@
+"""Chaos suite: combined failure scenarios across subsystems (VERDICT r4
+#7). Reference analogs: ``test_gcs_fault_tolerance.py``-style suites and the
+``NodeKiller`` fault injector (``_private/test_utils.py:1401``). The
+primitives (lineage, actor restart, FailureConfig, WAL recovery) have their
+own unit tests; these exercise the COMBINED paths: a raylet dying under a
+live Train gang, the GCS dying under live serve traffic, an env-runner dying
+mid-IMPALA."""
+
+import os
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster.cluster_utils import Cluster
+
+
+@pytest.fixture(autouse=True)
+def _fresh():
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    yield
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+
+
+def test_chaos_raylet_death_mid_train_gang(tmp_path):
+    """Kill the raylet hosting the train worker mid-run: FailureConfig
+    restarts the gang on the surviving node FROM THE LAST CHECKPOINT."""
+    from ray_tpu._private.config import get_config
+    from ray_tpu.train import (Checkpoint, FailureConfig, JaxTrainer,
+                               RunConfig, ScalingConfig)
+
+    get_config().node_death_timeout_s = 3.0
+    c = Cluster(initialize_head=True, head_node_args={"num_cpus": 2})
+    n1 = c.add_node(num_cpus=2, resources={"gang": 1})
+    n2 = c.add_node(num_cpus=2, resources={"gang": 1})
+    try:
+        c.connect_driver()
+        marker = str(tmp_path / "worker_node.txt")
+        attempts = str(tmp_path / "attempts.txt")
+
+        def loop(config):
+            from ray_tpu import train
+
+            ckpt = train.get_checkpoint()
+            start = ckpt.to_dict()["step"] + 1 if ckpt else 0
+            with open(config["attempts"], "a") as f:
+                f.write(f"{start}\n")
+            with open(config["marker"], "w") as f:
+                f.write(ray_tpu.get_runtime_context().get_node_id())
+            for step in range(start, 6):
+                time.sleep(0.5)
+                train.report({"step": step},
+                             checkpoint=Checkpoint.from_dict({"step": step}))
+
+        def killer():
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                if os.path.exists(marker):
+                    node_id = open(marker).read().strip()
+                    if node_id:
+                        time.sleep(1.0)  # let a checkpoint land
+                        victim = next((n for n in (n1, n2)
+                                       if n.node_id == node_id), None)
+                        if victim is not None:
+                            c.remove_node(victim)
+                        return
+                time.sleep(0.2)
+
+        t = threading.Thread(target=killer, daemon=True)
+        t.start()
+        result = JaxTrainer(
+            loop,
+            train_loop_config={"marker": marker, "attempts": attempts},
+            scaling_config=ScalingConfig(num_workers=1, cpus_per_worker=1,
+                                         resources_per_worker={"gang": 0.5}),
+            run_config=RunConfig(
+                name="chaos_gang", storage_path=str(tmp_path),
+                failure_config=FailureConfig(max_failures=2))).fit()
+        t.join(timeout=10)
+        assert result.error is None
+        assert result.metrics["step"] == 5
+        starts = [int(x) for x in open(attempts).read().split()]
+        assert len(starts) >= 2, "gang was never restarted"
+        assert starts[0] == 0
+        # the restart resumed from a checkpoint, not from scratch
+        assert any(s > 0 for s in starts[1:]), f"no resume: {starts}"
+    finally:
+        c.shutdown()
+        from ray_tpu._private import config as config_mod
+
+        config_mod.reset_config_for_tests()
+
+
+def test_chaos_gcs_death_under_serve_traffic(tmp_path):
+    """Kill the GCS while requests flow: the proxy->replica data path keeps
+    serving (routes are cached client-side), and after the head restarts on
+    the same address the control plane recovers (a NEW deployment works)."""
+    import requests as rq
+
+    from ray_tpu import serve
+
+    c = Cluster(initialize_head=True, head_node_args={"num_cpus": 4},
+                gcs_persist_path=str(tmp_path / "gcs_state"))
+    try:
+        c.connect_driver()
+
+        @serve.deployment
+        class Echo:
+            def __call__(self, request):
+                return {"n": request.json()["n"]}
+
+        serve.run(Echo.bind(), name="chaos_echo", route_prefix="/echo")
+        url = f"http://127.0.0.1:{serve.http_port()}/echo"
+        assert rq.post(url, json={"n": 1}, timeout=30).json()["n"] == 1
+
+        c.kill_gcs()
+        time.sleep(0.5)
+        # data path survives the head outage: routes + replica connections
+        # are cached in the proxy; no GCS hop per request
+        ok = 0
+        for i in range(10):
+            r = rq.post(url, json={"n": i}, timeout=30)
+            r.raise_for_status()
+            assert r.json()["n"] == i
+            ok += 1
+        assert ok == 10
+
+        c.restart_gcs()
+        # raylets re-register via the heartbeat 'unknown' path; give the
+        # reconciliation a few heartbeats
+        time.sleep(3.0)
+        # control plane recovered: existing app still routes...
+        assert rq.post(url, json={"n": 99}, timeout=30).json()["n"] == 99
+        # ...and NEW control-plane work (a second app) deploys
+        @serve.deployment
+        class Echo2:
+            def __call__(self, request):
+                return {"m": request.json()["m"] * 2}
+
+        serve.run(Echo2.bind(), name="chaos_echo2", route_prefix="/echo2")
+        url2 = f"http://127.0.0.1:{serve.http_port()}/echo2"
+        assert rq.post(url2, json={"m": 4}, timeout=60).json()["m"] == 8
+        serve.shutdown()
+    finally:
+        c.shutdown()
+
+
+def test_chaos_env_runner_death_mid_impala(tmp_path):
+    """SIGKILL one env-runner's worker process mid-IMPALA: the fragment is
+    dropped, the actor restarts (max_restarts), and training keeps making
+    env-step progress with the full fleet afterwards."""
+    import signal
+
+    from ray_tpu import rl
+
+    ray_tpu.init(num_cpus=5)
+    algo = (rl.IMPALAConfig()
+            .environment("CartPole-v1")
+            .env_runners(num_env_runners=2, num_envs_per_runner=4,
+                         rollout_fragment_length=32)
+            .training(minibatch_size=128)
+            .debugging(seed=0)).build()
+    try:
+        algo.train()  # warmup: fleet alive, pipeline primed
+        pid = ray_tpu.get(algo.runners[0].get_pid.remote())
+        os.kill(pid, signal.SIGKILL)
+
+        # training continues through the death: no exception, progress
+        steps_before = algo._env_steps_total
+        for _ in range(4):
+            algo.train()
+        assert algo._env_steps_total > steps_before
+
+        # the killed runner restarted (new pid) and serves calls again
+        deadline = time.monotonic() + 60
+        new_pid = None
+        while time.monotonic() < deadline:
+            try:
+                new_pid = ray_tpu.get(algo.runners[0].get_pid.remote(),
+                                      timeout=30)
+                break
+            except Exception:
+                time.sleep(1.0)
+        assert new_pid is not None and new_pid != pid
+        # full fleet sampling again
+        steps_before = algo._env_steps_total
+        algo.train()
+        assert algo._env_steps_total > steps_before
+    finally:
+        algo.stop()
