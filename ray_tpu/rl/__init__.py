@@ -13,12 +13,17 @@ from ray_tpu.rl.algorithms import (  # noqa: F401
     A2CConfig,
     APPO,
     APPOConfig,
+    ApexDDPG,
+    ApexDDPGConfig,
     ApexDQN,
     ApexDQNConfig,
     ARS,
     ARSConfig,
     AlphaZero,
     AlphaZeroConfig,
+    ConnectFour,
+    LeelaChessZero,
+    LeelaChessZeroConfig,
     MCTS,
     TicTacToe,
     BanditConfig,
@@ -34,6 +39,8 @@ from ray_tpu.rl.algorithms import (  # noqa: F401
     DreamerV3Config,
     MADDPG,
     MADDPGConfig,
+    MBMPO,
+    MBMPOConfig,
     MAML,
     MAMLConfig,
     PointGoal,

@@ -6,7 +6,12 @@ from ray_tpu.rl.algorithms.alphazero import (  # noqa: F401
     MCTS,
     TicTacToe,
 )
-from ray_tpu.rl.algorithms.apex import ApexDQN, ApexDQNConfig  # noqa: F401
+from ray_tpu.rl.algorithms.apex import (  # noqa: F401
+    ApexDDPG,
+    ApexDDPGConfig,
+    ApexDQN,
+    ApexDQNConfig,
+)
 from ray_tpu.rl.algorithms.appo import APPO, APPOConfig  # noqa: F401
 from ray_tpu.rl.algorithms.ars import ARS, ARSConfig  # noqa: F401
 from ray_tpu.rl.algorithms.bandits import (  # noqa: F401
@@ -22,7 +27,13 @@ from ray_tpu.rl.algorithms.dreamer import (  # noqa: F401
     DreamerV3Config,
 )
 from ray_tpu.rl.algorithms.dt import DT, DTConfig  # noqa: F401
+from ray_tpu.rl.algorithms.lc0 import (  # noqa: F401
+    ConnectFour,
+    LeelaChessZero,
+    LeelaChessZeroConfig,
+)
 from ray_tpu.rl.algorithms.maddpg import MADDPG, MADDPGConfig  # noqa: F401
+from ray_tpu.rl.algorithms.mbmpo import MBMPO, MBMPOConfig  # noqa: F401
 from ray_tpu.rl.algorithms.maml import (  # noqa: F401
     MAML,
     MAMLConfig,

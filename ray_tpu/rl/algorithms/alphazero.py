@@ -165,6 +165,44 @@ class MCTS:
         return self._N[state].copy()
 
 
+def play_selfplay_game(game: Game, predict, *, num_simulations: int,
+                       c_puct: float, dirichlet_alpha: float,
+                       root_noise_eps: float, temperature_moves: int,
+                       rng: np.random.Generator
+                       ) -> Tuple[List[Tuple[np.ndarray, np.ndarray, float]],
+                                  int]:
+    """One self-play game -> ([(obs, pi, z)], moves). Shared by the local
+    AlphaZero loop and LeelaChessZero's remote self-play workers."""
+    state = game.initial_state()
+    history: List[Tuple[np.ndarray, np.ndarray]] = []
+    move = 0
+    while True:
+        tv = game.terminal_value(state)
+        if tv is not None:
+            # tv is for the player to move at the terminal state; walk
+            # back alternating signs
+            examples = []
+            z = tv
+            for obs, pi in reversed(history):
+                z = -z
+                examples.append((obs, pi, z))
+            return examples, move
+        # fresh tree per move: visit counts from earlier searches ran
+        # under that root's Dirichlet noise and must not leak into this
+        # move's policy target
+        mcts = MCTS(game, predict, c_puct, dirichlet_alpha,
+                    root_noise_eps, rng)
+        visits = mcts.search(state, num_simulations)
+        pi = visits / visits.sum()
+        if move < temperature_moves:
+            a = int(rng.choice(len(pi), p=pi))
+        else:
+            a = int(np.argmax(visits))
+        history.append((game.encode(state), pi))
+        state = game.next_state(state, a)
+        move += 1
+
+
 class AlphaZeroConfig(AlgorithmConfig):
     def __init__(self, **kwargs):
         super().__init__(algo_class=AlphaZero, **kwargs)
@@ -182,11 +220,14 @@ class AlphaZeroConfig(AlgorithmConfig):
         self.vf_coeff = 1.0
 
 
+GAMES = {"tictactoe": TicTacToe}  # lc0.py registers connect4
+
+
 def make_game(name_or_game) -> Game:
     if isinstance(name_or_game, Game):
         return name_or_game
-    if name_or_game == "tictactoe":
-        return TicTacToe()
+    if name_or_game in GAMES:
+        return GAMES[name_or_game]()
     raise ValueError(f"unknown game {name_or_game!r}")
 
 
@@ -264,35 +305,12 @@ class AlphaZero(Trainable):
 
     def _self_play_game(self) -> Tuple[List, int]:
         cfg = self.config
-        predict = self._predict_fn()
-        state = self.game.initial_state()
-        history: List[Tuple[np.ndarray, np.ndarray]] = []
-        move = 0
-        while True:
-            tv = self.game.terminal_value(state)
-            if tv is not None:
-                # tv is for the player to move at the terminal state;
-                # walk back alternating signs
-                examples = []
-                z = tv
-                for obs, pi in reversed(history):
-                    z = -z
-                    examples.append((obs, pi, z))
-                return examples, move
-            # fresh tree per move: visit counts from earlier searches ran
-            # under that root's Dirichlet noise and must not leak into
-            # this move's policy target
-            mcts = MCTS(self.game, predict, cfg.c_puct,
-                        cfg.dirichlet_alpha, cfg.root_noise_eps, self._rng)
-            visits = mcts.search(state, cfg.num_simulations)
-            pi = visits / visits.sum()
-            if move < cfg.temperature_moves:
-                a = int(self._rng.choice(len(pi), p=pi))
-            else:
-                a = int(np.argmax(visits))
-            history.append((self.game.encode(state), pi))
-            state = self.game.next_state(state, a)
-            move += 1
+        return play_selfplay_game(
+            self.game, self._predict_fn(),
+            num_simulations=cfg.num_simulations, c_puct=cfg.c_puct,
+            dirichlet_alpha=cfg.dirichlet_alpha,
+            root_noise_eps=cfg.root_noise_eps,
+            temperature_moves=cfg.temperature_moves, rng=self._rng)
 
     # -- Trainable API ----------------------------------------------------
 

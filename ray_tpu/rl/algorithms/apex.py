@@ -21,6 +21,7 @@ from __future__ import annotations
 from typing import Any, Dict
 
 import ray_tpu
+from ray_tpu.rl.algorithms.ddpg import DDPG
 from ray_tpu.rl.algorithms.dqn import DQN
 from ray_tpu.rl.config import AlgorithmConfig
 
@@ -37,7 +38,74 @@ class ApexDQNConfig(AlgorithmConfig):
         self.updates_per_iter = 16
 
 
-class ApexDQN(DQN):
+class _ApexFleet:
+    """The Ape-X actor/learner decoupling, shared by the DQN and DDPG
+    variants: an async inflight pipeline (runners resample immediately
+    under slightly stale params) feeding a prioritized buffer. Subclasses
+    provide ``_params_for(runner_i)`` (the exploration ladder) and the
+    learner-side ``_replay_updates`` (from their base algorithm)."""
+
+    # consecutive failures before a runner is dropped from the rotation —
+    # a runner past max_restarts fails its refs INSTANTLY, and resubmitting
+    # to it forever would win every wait() and starve live runners
+    _MAX_CONSECUTIVE_FAILURES = 3
+
+    def _init_fleet(self) -> None:
+        self._inflight: Dict[Any, Any] = {}
+        self._runner_failures: Dict[int, int] = {}
+
+    def _submit(self, runner_i: int) -> None:
+        if self._runner_failures.get(runner_i, 0) \
+                >= self._MAX_CONSECUTIVE_FAILURES:
+            return  # evicted from rotation
+        runner = self.runners[runner_i]
+        ref = runner.sample.remote(self._params_for(runner_i))
+        self._inflight[ref] = runner_i
+
+    def _store_batch(self, batch) -> None:
+        self.buffer.add_batch(
+            {k: batch[k] for k in ("obs", "actions", "rewards",
+                                   "next_obs", "dones")})
+
+    def _consume_round(self) -> int:
+        """Pump one round of fragments into the buffer; dead runners'
+        fragments are dropped and the (restarting) runner resubmitted."""
+        submitted = set(self._inflight.values())
+        for i in range(len(self.runners)):
+            if i not in submitted:
+                self._submit(i)
+        if not self._inflight:
+            raise RuntimeError(
+                "all env-runners failed permanently (each exceeded "
+                f"{self._MAX_CONSECUTIVE_FAILURES} consecutive failures)")
+        consumed = 0
+        for _ in range(len(self.runners)):
+            if not self._inflight:
+                break
+            ready, _ = ray_tpu.wait(list(self._inflight), num_returns=1)
+            ref = ready[0]
+            runner_i = self._inflight.pop(ref)
+            try:
+                batch = ray_tpu.get(ref)
+            except Exception:  # noqa: BLE001 — fragment lost, not fatal
+                self._runner_failures[runner_i] = \
+                    self._runner_failures.get(runner_i, 0) + 1
+                self._submit(runner_i)
+                continue
+            self._runner_failures.pop(runner_i, None)
+            self._submit(runner_i)  # resubmit with fresh params
+            self._store_batch(batch)
+            n = len(batch["rewards"])
+            consumed += n
+            self._env_steps_total += n
+        return consumed
+
+    def stop(self) -> None:
+        self._inflight.clear()
+        super().stop()
+
+
+class ApexDQN(_ApexFleet, DQN):
     @classmethod
     def get_default_config(cls) -> AlgorithmConfig:
         return ApexDQNConfig()
@@ -48,7 +116,7 @@ class ApexDQN(DQN):
             raise ValueError("ApexDQN requires prioritized_replay=True "
                              "(it IS the algorithm)")
         super().build_learner()
-        self._inflight: Dict[Any, Any] = {}
+        self._init_fleet()
         # epsilon ladder: runner i's exploration is fixed, not annealed
         n = max(1, len(self.runners))
         base, alpha = cfg.apex_eps_base, cfg.apex_eps_alpha
@@ -58,31 +126,9 @@ class ApexDQN(DQN):
     def _params_for(self, runner_i: int):
         return self._runner_params(epsilon=self._runner_eps[runner_i])
 
-    def _submit(self, runner_i: int) -> None:
-        runner = self.runners[runner_i]
-        ref = runner.sample.remote(self._params_for(runner_i))
-        self._inflight[ref] = runner_i
-
     def training_step(self) -> Dict[str, Any]:
         cfg = self.config
-        submitted = set(self._inflight.values())
-        for i in range(len(self.runners)):
-            if i not in submitted:
-                self._submit(i)
-        # consume one round of fragments (whichever runners finish first)
-        consumed = 0
-        for _ in range(len(self.runners)):
-            ready, _ = ray_tpu.wait(list(self._inflight), num_returns=1)
-            ref = ready[0]
-            runner_i = self._inflight.pop(ref)
-            batch = ray_tpu.get(ref)
-            self._submit(runner_i)  # resubmit with fresh params
-            self.buffer.add_batch(
-                {k: batch[k] for k in
-                 ("obs", "actions", "rewards", "next_obs", "dones")})
-            n = len(batch["rewards"])
-            consumed += n
-            self._env_steps_total += n
+        consumed = self._consume_round()
         metrics: Dict[str, Any] = {"buffer_size": len(self.buffer),
                                    "env_steps_this_iter": consumed,
                                    "eps_ladder_min": self._runner_eps[-1],
@@ -94,6 +140,67 @@ class ApexDQN(DQN):
         metrics.update(self.collect_episode_stats())
         return metrics
 
-    def stop(self) -> None:
-        self._inflight.clear()
-        super().stop()
+
+class ApexDDPGConfig(AlgorithmConfig):
+    def __init__(self, **kwargs):
+        super().__init__(algo_class=ApexDDPG, **kwargs)
+        self.env = "Pendulum-v1"
+        self.lr = 1e-3
+        self.minibatch_size = 256
+        self.num_env_runners = 4
+        self.prioritized_replay = True
+        self.policy_delay = 1
+        self.target_noise = 0.0
+        self.updates_per_iter = 16
+        # per-actor gaussian noise ladder (continuous-action analog of the
+        # epsilon ladder): sigma_i = base ** (1 + alpha * i / (N - 1))
+        self.apex_sigma_base = 0.4
+        self.apex_sigma_alpha = 3.0
+
+
+class ApexDDPG(_ApexFleet, DDPG):
+    """Ape-X DDPG: the distributed prioritized-replay harness around the
+    deterministic-policy-gradient learner (reference analog:
+    ``rllib/algorithms/apex_ddpg/apex_ddpg.py``). Same three Ape-X
+    signatures as the DQN variant; exploration diversity comes from a
+    per-actor gaussian-noise ladder instead of epsilon."""
+
+    @classmethod
+    def get_default_config(cls) -> AlgorithmConfig:
+        return ApexDDPGConfig()
+
+    def build_learner(self) -> None:
+        cfg = self.config
+        if not cfg.prioritized_replay:
+            raise ValueError("ApexDDPG requires prioritized_replay=True "
+                             "(it IS the algorithm)")
+        super().build_learner()
+        self._init_fleet()
+        n = max(1, len(self.runners))
+        base, alpha = cfg.apex_sigma_base, cfg.apex_sigma_alpha
+        self._runner_sigmas = [
+            base ** (1 + alpha * i / max(1, n - 1)) for i in range(n)]
+
+    def _params_for(self, runner_i: int):
+        return self._runner_params(sigma=self._runner_sigmas[runner_i])
+
+    def _store_batch(self, batch) -> None:
+        # replay the EXECUTED (noisy, clipped) action — the critic's TD
+        # target must condition on what actually hit the env
+        self.buffer.add_batch(
+            {"obs": batch["obs"], "actions": batch["actions_executed"],
+             "rewards": batch["rewards"], "next_obs": batch["next_obs"],
+             "dones": batch["dones"]})
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.config
+        consumed = self._consume_round()
+        metrics: Dict[str, Any] = {"buffer_size": len(self.buffer),
+                                   "env_steps_this_iter": consumed,
+                                   "sigma_ladder_min": self._runner_sigmas[-1],
+                                   "sigma_ladder_max": self._runner_sigmas[0]}
+        if len(self.buffer) >= cfg.learning_starts:
+            metrics.update(self._replay_updates(cfg.updates_per_iter or 16))
+            metrics["num_updates"] = self._updates
+        metrics.update(self.collect_episode_stats())
+        return metrics

@@ -18,7 +18,7 @@ from ray_tpu.rl import models
 from ray_tpu.rl.algorithm import Algorithm
 from ray_tpu.rl.config import AlgorithmConfig
 from ray_tpu.rl.learner import Learner
-from ray_tpu.rl.replay_buffer import ReplayBuffer
+from ray_tpu.rl.replay_buffer import PrioritizedReplayBuffer, ReplayBuffer
 
 
 class DDPG(Algorithm):
@@ -83,11 +83,14 @@ class DDPG(Algorithm):
             nonterm = 1.0 - batch["dones"].astype(jnp.float32)
             target = jax.lax.stop_gradient(
                 batch["rewards"] + gamma * nonterm * qt)
-            loss = jnp.mean((q_val(params["q1"], obs, acts) - target) ** 2)
+            td = q_val(params["q1"], obs, acts) - target
+            weights = batch.get("weights", jnp.ones_like(td))
+            loss = jnp.mean(weights * td ** 2)
             if twin:
                 loss = loss + jnp.mean(
-                    (q_val(params["q2"], obs, acts) - target) ** 2)
-            return loss, {"q_loss": loss}
+                    weights * (q_val(params["q2"], obs, acts) - target) ** 2)
+            return loss, {"q_loss": loss,
+                          "td": jax.lax.stop_gradient(td)}
 
         def actor_loss_fn(params, batch, key):
             obs = batch["obs"]
@@ -105,7 +108,12 @@ class DDPG(Algorithm):
 
         self.learner = Learner(params, loss_fn, cfg.lr,
                                grad_clip=cfg.grad_clip, seed=cfg.seed)
-        self.buffer = ReplayBuffer(cfg.buffer_size, seed=cfg.seed)
+        if cfg.prioritized_replay:  # Ape-X DDPG path
+            self.buffer = PrioritizedReplayBuffer(
+                cfg.buffer_size, alpha=cfg.replay_alpha,
+                beta=cfg.replay_beta, seed=cfg.seed)
+        else:
+            self.buffer = ReplayBuffer(cfg.buffer_size, seed=cfg.seed)
         self._act = act
         self._updates = 0
 
@@ -120,13 +128,16 @@ class DDPG(Algorithm):
 
         self._polyak = polyak
 
-    def _runner_params(self):
+    def _runner_params(self, sigma: float = None):
         """Runner protocol adapter: deterministic mean + gaussian
-        exploration noise via log_std."""
+        exploration noise via log_std. ``sigma`` overrides the config
+        exploration noise (Ape-X DDPG's per-actor noise ladder)."""
         p = self.learner.get_params()
         obs_dim, adim = self.spec.obs_dim, self.spec.action_dim
         vf = {"layers": [{"w": jnp.zeros((obs_dim, 1)), "b": jnp.zeros(1)}]}
-        sigma = max(self.config.exploration_noise, 1e-3)
+        if sigma is None:
+            sigma = self.config.exploration_noise
+        sigma = max(sigma, 1e-3)
         return {"pi": p["pi"], "vf": vf,
                 "log_std": jnp.full((adim,), float(np.log(sigma)))}
 
@@ -146,17 +157,31 @@ class DDPG(Algorithm):
         if len(self.buffer) >= cfg.learning_starts:
             num_updates = (cfg.updates_per_iter or
                            max(1, len(batch["rewards"]) // cfg.minibatch_size))
-            for _ in range(num_updates):
-                mb = self.buffer.sample(cfg.minibatch_size)
-                self._updates += 1
-                do_actor = float(self._updates % max(1, cfg.policy_delay) == 0)
-                mb["do_actor"] = np.full(1, do_actor, dtype=np.float32)
-                m = self.learner.update_minibatch(mb)
-                if do_actor:
-                    self.learner.params = self._polyak(self.learner.params)
-            metrics.update({k: float(v) for k, v in m.items()})
+            metrics.update(self._replay_updates(num_updates))
         metrics.update(self.collect_episode_stats())
         return metrics
+
+    def _replay_updates(self, num_updates: int) -> Dict[str, float]:
+        """Shared DDPG-family update loop (also Ape-X DDPG): uniform or
+        prioritized minibatches, delayed actor + polyak on actor steps,
+        priorities refreshed from the critic's TD error."""
+        cfg = self.config
+        m: Dict[str, Any] = {}
+        for _ in range(num_updates):
+            if cfg.prioritized_replay:
+                mb, idx, weights = self.buffer.sample(cfg.minibatch_size)
+                mb = dict(mb, weights=weights)
+            else:
+                mb = self.buffer.sample(cfg.minibatch_size)
+            self._updates += 1
+            do_actor = float(self._updates % max(1, cfg.policy_delay) == 0)
+            mb["do_actor"] = np.full(1, do_actor, dtype=np.float32)
+            m = self.learner.update_minibatch(mb)
+            if cfg.prioritized_replay:
+                self.buffer.update_priorities(idx, np.asarray(m["td"]))
+            if do_actor:
+                self.learner.params = self._polyak(self.learner.params)
+        return {k: float(v) for k, v in m.items() if np.ndim(v) == 0}
 
 
 class TD3(DDPG):

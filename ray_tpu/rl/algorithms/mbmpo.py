@@ -1,0 +1,371 @@
+"""MBMPO: model-based meta-policy optimization (Clavera et al. 2018).
+
+Reference analog: ``rllib/algorithms/mbmpo/`` (``mbmpo.py``,
+``model_ensemble.py``): learn an ensemble of K transition-dynamics models
+from real rollouts, then treat each model as a MAML "task" — meta-learn a
+policy that adapts to any member in one inner PG step, making it robust to
+model error. TPU-first redesign:
+
+- the K models are ONE weight-stacked MLP trained by a single jitted
+  ``vmap``-over-members update (batched matmuls on the MXU) instead of the
+  reference's K torch nets stepped in Python loops
+  (``model_ensemble.py:TDModel`` + per-model fit loops).
+- each model predicts (normalized delta-obs, reward). Learning the reward
+  head removes the reference's requirement that envs expose a hand-coded
+  ``reward()`` (``mbmpo.py`` hard-restricts to specially wrapped envs).
+- imagination, inner adaptation, and the second-order meta-gradient run
+  inside ONE compiled program: imagined rollouts are ``lax.scan`` over the
+  model, tasks (= ensemble members) are ``vmap``-ed, the meta-gradient is
+  ``jax.grad`` through the inner update (the same estimator as
+  ``maml.py`` — sampling dependence ignored, batches stop-gradiented).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.rl import models
+from ray_tpu.rl.config import AlgorithmConfig
+from ray_tpu.rl.env import make_env
+from ray_tpu.tune.trainable import Trainable
+
+
+class MBMPOConfig(AlgorithmConfig):
+    def __init__(self, **kwargs):
+        super().__init__(algo_class=MBMPO, **kwargs)
+        self.env = "Pendulum-v1"
+        self.num_envs_per_runner = 16   # real-env vector width
+        self.real_steps_per_iter = 400  # real transitions collected / iter
+        self.buffer_size = 100_000
+        # dynamics ensemble
+        self.ensemble_size = 5
+        self.model_hidden = (256, 256)
+        self.model_lr = 1e-3
+        self.model_epochs = 5
+        self.model_batch = 256
+        self.val_frac = 0.1
+        # imagination + MAML
+        self.imag_horizon = 16
+        self.imag_envs = 32
+        self.inner_lr = 0.1
+        self.inner_steps = 1
+        self.meta_steps_per_iter = 8    # MAML outer steps per fitted
+        # ensemble (reference: maml_optimizer_steps)
+        self.lr = 3e-4                  # meta (outer) learning rate
+        self.hidden = (64, 64)
+        self.exploration_noise = 0.5    # std of the gaussian policy at init
+
+
+class MBMPO(Trainable):
+    @classmethod
+    def get_default_config(cls) -> AlgorithmConfig:
+        return MBMPOConfig()
+
+    def setup(self, config: Dict[str, Any]) -> None:
+        if "__algo_config" in config:
+            self.config: AlgorithmConfig = config["__algo_config"]
+        else:
+            self.config = MBMPOConfig().update_from_dict(config)
+        cfg = self.config
+        self.env = make_env(cfg.env, cfg.num_envs_per_runner,
+                            cfg.env_config, seed=cfg.seed)
+        spec = self.env.spec
+        if spec.action_dim == 0:
+            raise ValueError("MBMPO needs a continuous-action env "
+                             f"({cfg.env!r} is discrete)")
+        D, A, K = spec.obs_dim, spec.action_dim, cfg.ensemble_size
+        self._D, self._A = D, A
+        self._low = np.asarray(spec.action_low, dtype=np.float32)
+        self._high = np.asarray(spec.action_high, dtype=np.float32)
+        self._rng = np.random.default_rng(cfg.seed)
+        self._key = jax.random.key(cfg.seed + 1)
+        self._env_steps_total = 0
+
+        # -- policy (gaussian MLP, as maml.py) ----------------------------
+        k_pi = jax.random.key(cfg.seed)
+        self.params = {
+            "pi": models.init_mlp(k_pi, (D, *cfg.hidden, A), out_scale=0.01),
+            "log_std": jnp.full((A,), float(np.log(cfg.exploration_noise))),
+        }
+        import optax
+
+        self._opt = optax.adam(cfg.lr)
+        self._opt_state = self._opt.init(self.params)
+
+        # -- dynamics ensemble: weight-stacked [K, ...] MLPs --------------
+        def init_model(key):
+            return models.init_mlp(key, (D + A, *cfg.model_hidden, D + 1),
+                                   out_scale=0.1)
+
+        mkeys = jax.random.split(jax.random.key(cfg.seed + 7), K)
+        self.model_params = jax.vmap(init_model)(mkeys)
+        self._model_opt = optax.adam(cfg.model_lr)
+        self._model_opt_state = self._model_opt.init(self.model_params)
+
+        # identity normalizers until real data arrives
+        self._norm = {
+            "x_mean": np.zeros(D + A, np.float32),
+            "x_std": np.ones(D + A, np.float32),
+            "y_mean": np.zeros(D + 1, np.float32),
+            "y_std": np.ones(D + 1, np.float32),
+        }
+        self._buf: Dict[str, list] = {k: [] for k in
+                                      ("obs", "act", "delta", "rew")}
+
+        gamma = cfg.gamma
+        inner_lr, inner_steps = cfg.inner_lr, cfg.inner_steps
+        H, N = cfg.imag_horizon, cfg.imag_envs
+        low = jnp.asarray(self._low)
+        high = jnp.asarray(self._high)
+
+        def model_fwd(mp, x_norm):
+            return models.mlp_forward(mp, x_norm)
+
+        def model_loss(mp, x, y):
+            pred = model_fwd(mp, x)
+            return jnp.mean((pred - y) ** 2)
+
+        @jax.jit
+        def model_update(mparams, mopt, xs, ys):
+            """One SGD step for ALL K members at once; xs/ys are
+            per-member bootstrap minibatches [K, B, ...]."""
+            def per_member(mp, x, y):
+                return jax.value_and_grad(model_loss)(mp, x, y)
+
+            losses, grads = jax.vmap(per_member)(mparams, xs, ys)
+            updates, mopt = self._model_opt.update(grads, mopt, mparams)
+            import optax as _optax
+
+            mparams = _optax.apply_updates(mparams, updates)
+            return mparams, mopt, jnp.mean(losses)
+
+        self._model_update = model_update
+        self._model_val_loss = jax.jit(
+            jax.vmap(model_loss, in_axes=(0, None, None)))
+
+        def act_mean_noise(p, obs, key):
+            mean = models.mlp_forward(p["pi"], obs)
+            a = mean + jnp.exp(p["log_std"]) \
+                * jax.random.normal(key, mean.shape)
+            return jnp.clip(a, low, high)
+
+        self._act = jax.jit(act_mean_noise)
+
+        def imagine(p, mp, norm, start_obs, key):
+            """H-step rollout inside model ``mp`` from real start states.
+            Policy params are stop-gradiented — sampling dependence is
+            not differentiated (the maml.py estimator)."""
+            p = jax.lax.stop_gradient(p)
+
+            def step(carry, key_t):
+                obs = carry
+                a = act_mean_noise(p, obs, key_t)
+                x = (jnp.concatenate([obs, a], -1) - norm["x_mean"]) \
+                    / norm["x_std"]
+                y = model_fwd(mp, x) * norm["y_std"] + norm["y_mean"]
+                nobs = obs + y[..., :-1]
+                rew = y[..., -1]
+                return nobs, (obs, a, rew)
+
+            keys = jax.random.split(key, H)
+            _, (obs_t, act_t, rew_t) = jax.lax.scan(step, start_obs, keys)
+
+            def disc(acc, r):
+                acc = r + gamma * acc
+                return acc, acc
+
+            _, rets = jax.lax.scan(disc, jnp.zeros(N), rew_t, reverse=True)
+            batch = {"obs": obs_t.reshape(H * N, -1),
+                     "acts": act_t.reshape(H * N, -1),
+                     "returns": rets.reshape(H * N)}
+            return jax.lax.stop_gradient(batch), jnp.mean(rew_t)
+
+        def pg_loss(p, batch):
+            mean = models.mlp_forward(p["pi"], batch["obs"])
+            logp = models.gaussian_logp(mean, p["log_std"], batch["acts"])
+            ret = batch["returns"]
+            ret = (ret - ret.mean()) / (ret.std() + 1e-8)
+            return -jnp.mean(logp * ret)
+
+        def adapt(p, batch):
+            for _ in range(inner_steps):
+                g = jax.grad(pg_loss)(p, batch)
+                p = jax.tree_util.tree_map(
+                    lambda w, gw: w - inner_lr * gw, p, g)
+            return p
+
+        def task_loss(p, mp, norm, start_obs, key):
+            k1, k2 = jax.random.split(key)
+            pre, pre_rew = imagine(p, mp, norm, start_obs, k1)
+            p_ad = adapt(p, pre)
+            post, post_rew = imagine(p_ad, mp, norm, start_obs, k2)
+            return pg_loss(p_ad, post), (pre_rew, post_rew)
+
+        def meta_loss(p, mparams, norm, start_obs, keys):
+            losses, (pre, post) = jax.vmap(
+                task_loss, in_axes=(None, 0, None, None, 0))(
+                p, mparams, norm, start_obs, keys)
+            return jnp.mean(losses), (jnp.mean(pre), jnp.mean(post))
+
+        self._meta_grad = jax.jit(
+            jax.value_and_grad(meta_loss, has_aux=True))
+
+        @jax.jit
+        def apply_meta(p, opt_state, grads):
+            import optax as _optax
+
+            updates, opt_state = self._opt.update(grads, opt_state, p)
+            return _optax.apply_updates(p, updates), opt_state
+
+        self._apply_meta = apply_meta
+        self._adapt = jax.jit(adapt)
+
+    # -- real-env interaction ---------------------------------------------
+
+    def _collect_real(self, n_steps: int) -> float:
+        cfg = self.config
+        obs = self.env.reset() if not self._buf["obs"] else self._last_obs
+        rew_sum, count = 0.0, 0
+        steps = max(1, n_steps // self.env.num_envs)
+        for _ in range(steps):
+            self._key, sub = jax.random.split(self._key)
+            acts = np.asarray(self._act(self.params, jnp.asarray(obs), sub))
+            nobs, rew, dones = self.env.step(acts)
+            # a done row's next_obs is the RESET obs — its delta is not a
+            # dynamics transition; drop those rows from the model dataset
+            keep = ~dones
+            self._buf["obs"].append(obs[keep])
+            self._buf["act"].append(
+                acts[keep].reshape(int(keep.sum()), self._A))
+            self._buf["delta"].append((nobs - obs)[keep])
+            self._buf["rew"].append(rew[keep])
+            rew_sum += float(rew.sum())
+            count += rew.size
+            obs = nobs
+        self._last_obs = obs
+        self._env_steps_total += count
+        # trim ring
+        total = sum(len(a) for a in self._buf["obs"])
+        while total > cfg.buffer_size and len(self._buf["obs"]) > 1:
+            total -= len(self._buf["obs"][0])
+            for k in self._buf:
+                self._buf[k].pop(0)
+        return rew_sum / max(1, count)
+
+    def _dataset(self) -> Tuple[np.ndarray, np.ndarray]:
+        obs = np.concatenate(self._buf["obs"])
+        act = np.concatenate(self._buf["act"])
+        delta = np.concatenate(self._buf["delta"])
+        rew = np.concatenate(self._buf["rew"])[:, None]
+        x = np.concatenate([obs, act], -1).astype(np.float32)
+        y = np.concatenate([delta, rew], -1).astype(np.float32)
+        return x, y
+
+    def _fit_ensemble(self) -> Dict[str, float]:
+        cfg = self.config
+        x, y = self._dataset()
+        self._norm = {
+            "x_mean": x.mean(0), "x_std": x.std(0) + 1e-6,
+            "y_mean": y.mean(0), "y_std": y.std(0) + 1e-6,
+        }
+        xn = (x - self._norm["x_mean"]) / self._norm["x_std"]
+        yn = (y - self._norm["y_mean"]) / self._norm["y_std"]
+        n = len(xn)
+        n_val = max(1, int(n * cfg.val_frac))
+        perm = self._rng.permutation(n)
+        val_idx, train_idx = perm[:n_val], perm[n_val:]
+        B = min(cfg.model_batch, len(train_idx))
+        K = cfg.ensemble_size
+        steps = max(1, len(train_idx) // B) * cfg.model_epochs
+        loss = 0.0
+        for _ in range(steps):
+            # per-member bootstrap minibatches decorrelate the ensemble
+            idx = self._rng.choice(train_idx, size=(K, B))
+            self.model_params, self._model_opt_state, ls = \
+                self._model_update(self.model_params,
+                                   self._model_opt_state,
+                                   jnp.asarray(xn[idx]),
+                                   jnp.asarray(yn[idx]))
+            loss = float(ls)
+        val = self._model_val_loss(self.model_params,
+                                   jnp.asarray(xn[val_idx]),
+                                   jnp.asarray(yn[val_idx]))
+        return {"model_train_loss": loss,
+                "model_val_loss": float(jnp.mean(val)),
+                "model_val_worst": float(jnp.max(val)),
+                "dataset_size": n}
+
+    # -- Trainable API ----------------------------------------------------
+
+    def step(self) -> Dict[str, Any]:
+        cfg = self.config
+        mean_rew = self._collect_real(cfg.real_steps_per_iter)
+        model_metrics = self._fit_ensemble()
+        # meta-updates from real start states (several MAML outer steps
+        # per fitted ensemble, reference: maml_optimizer_steps)
+        obs_pool = np.concatenate(self._buf["obs"])[-4096:]
+        norm = {k: jnp.asarray(v) for k, v in self._norm.items()}
+        for _ in range(max(1, cfg.meta_steps_per_iter)):
+            start = obs_pool[self._rng.integers(0, len(obs_pool),
+                                                size=cfg.imag_envs)]
+            self._key, sub = jax.random.split(self._key)
+            keys = jax.random.split(sub, cfg.ensemble_size)
+            (loss, (pre, post)), grads = self._meta_grad(
+                self.params, self.model_params, norm,
+                jnp.asarray(start), keys)
+            self.params, self._opt_state = self._apply_meta(
+                self.params, self._opt_state, grads)
+        ep_len = getattr(self.env, "_max_t", 200)
+        return {"meta_loss": float(loss),
+                "imag_pre_adapt_reward": float(pre),
+                "imag_post_adapt_reward": float(post),
+                "imag_adaptation_gain": float(post) - float(pre),
+                "real_reward_per_step": mean_rew,
+                "mean_return": mean_rew * ep_len,
+                "env_steps_total": self._env_steps_total,
+                **model_metrics}
+
+    def evaluate(self, num_episodes: int = 4) -> Dict[str, float]:
+        """Real-env return of the CURRENT meta-policy (fresh env, training
+        stream untouched)."""
+        cfg = self.config
+        env = make_env(cfg.env, cfg.num_envs_per_runner, cfg.env_config,
+                       seed=cfg.seed + 999)
+        horizon = getattr(env, "_max_t", 200)
+        key = jax.random.key(cfg.seed + 31337)
+        obs = env.reset()
+        total = 0.0
+        for _ in range(horizon):
+            key, sub = jax.random.split(key)
+            acts = np.asarray(self._act(self.params, jnp.asarray(obs), sub))
+            obs, rew, _ = env.step(acts)
+            total += float(rew.mean())
+        return {"episode_return_mean": total,
+                "episodes": env.num_envs}
+
+    # -- checkpointing ----------------------------------------------------
+
+    def save_checkpoint(self, checkpoint_dir: str) -> Optional[Dict]:
+        return {"params": jax.tree_util.tree_map(np.asarray, self.params),
+                "model_params": jax.tree_util.tree_map(
+                    np.asarray, self.model_params),
+                "norm": self._norm,
+                "env_steps_total": self._env_steps_total}
+
+    def load_checkpoint(self, checkpoint: Dict) -> None:
+        self.params = jax.tree_util.tree_map(jnp.asarray,
+                                             checkpoint["params"])
+        self.model_params = jax.tree_util.tree_map(
+            jnp.asarray, checkpoint["model_params"])
+        self._norm = checkpoint["norm"]
+        self._env_steps_total = checkpoint.get("env_steps_total", 0)
+
+    def cleanup(self) -> None:
+        pass
+
+    def stop(self) -> None:
+        self.cleanup()

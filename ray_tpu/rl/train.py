@@ -28,7 +28,9 @@ def algorithm_registry() -> Dict[str, type]:
         "PPO": rl.PPOConfig, "APPO": rl.APPOConfig,
         "IMPALA": rl.IMPALAConfig, "A2C": rl.A2CConfig,
         "PG": rl.PGConfig, "MAML": rl.MAMLConfig,
+        "MBMPO": rl.MBMPOConfig,
         "DQN": rl.DQNConfig, "APEXDQN": rl.ApexDQNConfig,
+        "APEXDDPG": rl.ApexDDPGConfig,
         "SIMPLEQ": rl.DQNConfig,
         "SAC": rl.SACConfig,
         "DDPG": rl.DDPGConfig, "TD3": rl.TD3Config,
@@ -38,6 +40,7 @@ def algorithm_registry() -> Dict[str, type]:
         "QMIX": rl.QMIXConfig, "MADDPG": rl.MADDPGConfig,
         "SLATEQ": rl.SlateQConfig, "DREAMERV3": rl.DreamerV3Config,
         "ALPHAZERO": rl.AlphaZeroConfig,
+        "LEELACHESSZERO": rl.LeelaChessZeroConfig,
         "R2D2": rl.R2D2Config,
         "BANDITLINUCB": rl.BanditConfig, "BANDITLINTS": rl.BanditConfig,
     }

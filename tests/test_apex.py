@@ -87,3 +87,40 @@ def test_apex_learns_cartpole(rl_cluster):
         assert best >= 120, best
     finally:
         algo.stop()
+
+
+def test_apex_ddpg_smoke_async_pipeline(rl_cluster):
+    """Ape-X DDPG (reference: ``rllib/algorithms/apex_ddpg/``): the same
+    async fleet + prioritized replay around the DDPG learner, with a
+    per-actor gaussian-noise ladder."""
+    cfg = rl.ApexDDPGConfig()
+    cfg.num_env_runners = 2
+    cfg.num_envs_per_runner = 2
+    cfg.rollout_fragment_length = 32
+    cfg.learning_starts = 100
+    cfg.updates_per_iter = 8
+    cfg.minibatch_size = 64
+    algo = cfg.build()
+    try:
+        m = {}
+        for _ in range(4):
+            m = algo.training_step()
+        assert m["buffer_size"] >= 100
+        assert m["env_steps_this_iter"] > 0
+        assert np.isfinite(m["q_loss"])
+        assert m["num_updates"] >= 8
+        assert m["sigma_ladder_max"] > m["sigma_ladder_min"]
+        assert len(algo._inflight) == 2
+        # priorities actually vary after TD refresh (the tree is in use)
+        base = algo.buffer._leaf_base
+        leaves = algo.buffer._tree[base: base + len(algo.buffer)]
+        assert leaves.max() > leaves.min()
+    finally:
+        algo.stop()
+
+
+def test_apex_ddpg_requires_prioritized(rl_cluster):
+    cfg = rl.ApexDDPGConfig()
+    cfg.prioritized_replay = False
+    with pytest.raises(ValueError, match="prioritized"):
+        cfg.build()
