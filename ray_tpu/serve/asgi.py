@@ -24,7 +24,16 @@ Two ways in:
 
 Lifespan: ``lifespan.startup`` runs once before the first request in the
 replica; ``lifespan.shutdown`` is best-effort (replica teardown is process
-teardown). WebSockets are not supported (HTTP only).
+teardown).
+
+WebSockets: the proxy bridges an accepted aiohttp websocket to the replica
+over two legs (reference: the uvicorn proxy speaks WS natively,
+``serve/_private/http_proxy.py``): outbound app frames ride a streaming
+actor call (``__ws_connect__`` yields accept/text/bytes/close events);
+inbound client frames are pushed with per-connection-ordered
+``__ws_push__`` calls onto the SAME replica. The ASGI websocket protocol
+(connect/receive/disconnect in, accept/send/close out) runs inside the
+replica, like the HTTP path.
 """
 
 from __future__ import annotations
@@ -55,9 +64,11 @@ class ASGIResponseStart:
         self.headers = headers
 
 
-def _build_scope(request, instance) -> Dict[str, Any]:
-    """ServeRequest -> ASGI HTTP scope. The path is the route-prefix-
-    stripped path the proxy computed, so an app mounted at /api sees /."""
+def _build_scope(request, instance,
+                 scope_type: str = "http") -> Dict[str, Any]:
+    """ServeRequest -> ASGI HTTP/websocket scope. The path is the route-
+    prefix-stripped path the proxy computed, so an app mounted at /api
+    sees /."""
     from urllib.parse import urlencode
 
     # raw forms preserve repeated params/headers (?tag=a&tag=b, duplicate
@@ -69,12 +80,11 @@ def _build_scope(request, instance) -> Dict[str, Any]:
     raw_query = getattr(request, "raw_query", None)
     query_string = raw_query.encode() if raw_query is not None \
         else urlencode(request.query or {}).encode()
-    return {
-        "type": "http",
+    scope = {
+        "type": scope_type,
         "asgi": {"version": "3.0", "spec_version": "2.3"},
         "http_version": "1.1",
-        "method": request.method,
-        "scheme": "http",
+        "scheme": "http" if scope_type == "http" else "ws",
         "path": request.path,
         "raw_path": request.path.encode(),
         "query_string": query_string,
@@ -84,6 +94,13 @@ def _build_scope(request, instance) -> Dict[str, Any]:
         "server": ("127.0.0.1", 0),
         "extensions": {"ray_tpu.deployment": instance},
     }
+    if scope_type == "http":
+        scope["method"] = request.method
+    else:
+        protos = (request.headers or {}).get("Sec-WebSocket-Protocol", "")
+        scope["subprotocols"] = [p.strip() for p in protos.split(",")
+                                 if p.strip()]
+    return scope
 
 
 async def _run_lifespan_startup(app) -> None:
@@ -242,6 +259,105 @@ async def _call_asgi(app, request, instance):
     return stream()
 
 
+# Per-connection inbound queues for websocket bridging; keyed by the
+# proxy-generated connection id. Lives at module level: __ws_push__ actor
+# calls and the __ws_connect__ stream land on the same replica process.
+_WS_INBOX: Dict[str, asyncio.Queue] = {}
+
+
+async def _run_ws_asgi(app, request, conn_id: str, instance):
+    """Drive one websocket connection through the app; an async generator
+    of outbound events for the proxy:
+
+      {"kind": "accept", "subprotocol": ..., "headers": [...]}
+      {"kind": "text", "data": str} / {"kind": "bytes", "data": bytes}
+      {"kind": "close", "code": int, "reason": str}   (always last)
+
+    Inbound client frames arrive via ``_WS_INBOX[conn_id]`` (pushed by
+    ``__ws_push__``) and surface to the app as websocket.receive /
+    websocket.disconnect messages."""
+    scope = _build_scope(request, instance, scope_type="websocket")
+    inbox: asyncio.Queue = asyncio.Queue()
+    _WS_INBOX[conn_id] = inbox
+    events: asyncio.Queue = asyncio.Queue()
+    delivered_connect = False
+
+    async def receive():
+        nonlocal delivered_connect
+        if not delivered_connect:
+            delivered_connect = True
+            return {"type": "websocket.connect"}
+        msg = await inbox.get()
+        kind = msg["kind"]
+        if kind == "text":
+            return {"type": "websocket.receive", "text": msg["data"]}
+        if kind == "bytes":
+            return {"type": "websocket.receive", "bytes": msg["data"]}
+        return {"type": "websocket.disconnect",
+                "code": msg.get("code", 1005)}
+
+    async def send(message):
+        await events.put(message)
+
+    app_task = asyncio.ensure_future(app(scope, receive, send))
+    app_task.add_done_callback(lambda t: t.cancelled() or t.exception())
+
+    async def next_event():
+        if not events.empty():
+            return events.get_nowait()
+        if app_task.done():
+            exc = app_task.exception()
+            if exc is not None:
+                raise exc
+            return None
+        getter = asyncio.ensure_future(events.get())
+        await asyncio.wait({getter, app_task},
+                           return_when=asyncio.FIRST_COMPLETED)
+        if getter.done():
+            return getter.result()
+        getter.cancel()
+        if not events.empty():
+            return events.get_nowait()
+        exc = app_task.exception()
+        if exc is not None:
+            raise exc
+        return None
+
+    try:
+        while True:
+            msg = await next_event()
+            if msg is None:
+                # app returned without an explicit close
+                yield {"kind": "close", "code": 1000, "reason": ""}
+                return
+            t = msg["type"]
+            if t == "websocket.accept":
+                yield {"kind": "accept",
+                       "subprotocol": msg.get("subprotocol"),
+                       "headers": [(k.decode(), v.decode()) for k, v in
+                                   msg.get("headers", [])]}
+            elif t == "websocket.send":
+                if msg.get("text") is not None:
+                    yield {"kind": "text", "data": msg["text"]}
+                else:
+                    yield {"kind": "bytes",
+                           "data": bytes(msg.get("bytes", b""))}
+            elif t == "websocket.close":
+                yield {"kind": "close", "code": msg.get("code", 1000),
+                       "reason": msg.get("reason", "")}
+                return
+    except BaseException as e:  # noqa: BLE001 — app error -> 1011 close
+        yield {"kind": "close", "code": 1011, "reason": str(e)[:120]}
+        return
+    finally:
+        _WS_INBOX.pop(conn_id, None)
+        if not app_task.done():
+            # unblock a receive()-parked app so its task can unwind
+            inbox.put_nowait({"kind": "disconnect", "code": 1001})
+            await asyncio.sleep(0)
+            app_task.cancel()
+
+
 class _ASGIAdapter:
     """Mixin driving requests through ``self._asgi_app``."""
 
@@ -254,7 +370,7 @@ class _ASGIAdapter:
             raise RuntimeError("no ASGI app bound")
         return app
 
-    async def __call__(self, request):
+    async def _ensure_startup(self):
         app = self._resolve_asgi_app()
         # one shared startup task: concurrent first requests all await the
         # SAME lifespan completion (not run the app pre-startup), and a
@@ -263,7 +379,28 @@ class _ASGIAdapter:
             self._asgi_startup = asyncio.ensure_future(
                 _run_lifespan_startup(app))
         await asyncio.shield(self._asgi_startup)
+        return app
+
+    async def __call__(self, request):
+        app = await self._ensure_startup()
         return await _call_asgi(app, request, self)
+
+    async def __ws_connect__(self, request, conn_id: str):
+        """Streaming entry for one websocket connection (called by the
+        proxy); yields outbound events."""
+        app = await self._ensure_startup()
+        async for ev in _run_ws_asgi(app, request, conn_id, self):
+            yield ev
+
+    async def __ws_push__(self, conn_id: str, kind: str, data=None,
+                          code: int = 1005) -> bool:
+        """Inbound client frame (or disconnect) for a live connection.
+        Returns False when the connection is already gone."""
+        q = _WS_INBOX.get(conn_id)
+        if q is None:
+            return False
+        q.put_nowait({"kind": kind, "data": data, "code": code})
+        return True
 
 
 def asgi_app(app_or_factory: Any) -> type:

@@ -189,6 +189,9 @@ class ProxyActor:
 
             handle = DeploymentHandle(app_name, ingress)
             self._handles[key] = handle
+        if (request.headers.get("Upgrade", "").lower() == "websocket"
+                and request.method == "GET"):
+            return await self._handle_websocket(request, handle, stripped)
         sreq = ServeRequest(
             method=request.method, path=stripped,
             query=dict(request.rel_url.query),
@@ -218,6 +221,118 @@ class ProxyActor:
         status, ctype, payload = _to_response(result)
         return web.Response(status=status, content_type=ctype.split(";")[0],
                             body=payload)
+
+    async def _handle_websocket(self, request, handle, stripped: str):
+        """Bridge an aiohttp websocket to an ASGI deployment (reference:
+        the uvicorn proxy's native WS path, ``serve/_private/http_proxy.py``).
+
+        Outbound: one streaming actor call (``__ws_connect__``) yields
+        accept/text/bytes/close events. Inbound: each client frame is an
+        ordered ``__ws_push__`` call PINNED to the same replica (the
+        generator's actor), so the per-caller actor FIFO preserves frame
+        order. The 101 handshake is deferred until the app accepts; a
+        close-before-accept surfaces as HTTP 403 (ASGI denial semantics)."""
+        import uuid
+
+        from aiohttp import WSMsgType, web
+
+        from ray_tpu.serve.handle import DeploymentResponseGenerator
+        from ray_tpu.serve.replica import REJECTED as REJECTED_STATUS
+
+        conn_id = uuid.uuid4().hex
+        sreq = ServeRequest(
+            method="GET", path=stripped,
+            query=dict(request.rel_url.query),
+            headers=dict(request.headers), body=b"",
+            raw_query=request.rel_url.raw_query_string,
+            raw_headers=[(k, v) for k, v in request.headers.items()])
+        try:
+            gen = await handle.options(
+                method_name="__ws_connect__").remote(sreq, conn_id)
+        except TimeoutError as e:
+            return web.Response(status=503, text=f"overloaded: {e}")
+        except Exception as e:  # noqa: BLE001
+            return web.Response(status=500,
+                                text=f"{type(e).__name__}: {e}")
+        if not isinstance(gen, DeploymentResponseGenerator):
+            return web.Response(
+                status=426, text="deployment is not websocket-capable "
+                                 "(no ASGI app bound)")
+        actor = gen._actor
+        it = gen.__aiter__()
+        loop = asyncio.get_running_loop()
+
+        async def push(kind: str, data=None, code: int = 1005) -> None:
+            # ordered, awaited pushes: per-caller FIFO on the pinned
+            # replica keeps frame order. __ws_push__ bypasses admission
+            # control on the replica (the connection's stream holds the
+            # slot); a REJECTED here is therefore unexpected — fail loudly
+            # rather than silently dropping a frame
+            ref = actor.handle_request.remote(
+                "__ws_push__", (conn_id, kind, data, code), {}, None)
+            reply = await loop.run_in_executor(None, ray_tpu.get, ref)
+            if reply[0] == REJECTED_STATUS:
+                raise RuntimeError("websocket frame rejected by replica")
+
+        try:
+            first = await it.__anext__()
+        except (StopAsyncIteration, Exception) as e:  # noqa: B014
+            gen.cancel()
+            return web.Response(status=500,
+                                text=f"websocket app failed: {e}")
+        if first.get("kind") == "close":
+            gen.cancel()
+            if first.get("code") == 1011:
+                # app CRASHED before accepting (asgi.py translates app
+                # errors to a 1011 close) — that's a server error, not an
+                # auth-style denial
+                return web.Response(
+                    status=500,
+                    text=f"websocket app failed: {first.get('reason', '')}")
+            return web.Response(status=403, text="websocket rejected")
+        ws = web.WebSocketResponse(
+            protocols=[first["subprotocol"]] if first.get("subprotocol")
+            else ())
+        await ws.prepare(request)
+        self._requests_served += 1
+
+        async def inbound():
+            try:
+                async for msg in ws:
+                    if msg.type == WSMsgType.TEXT:
+                        await push("text", msg.data)
+                    elif msg.type == WSMsgType.BINARY:
+                        await push("bytes", msg.data)
+                    elif msg.type == WSMsgType.ERROR:
+                        break
+            finally:
+                await push("disconnect",
+                           code=ws.close_code or 1005)
+
+        in_task = asyncio.ensure_future(inbound())
+        try:
+            async for ev in it:
+                kind = ev.get("kind")
+                if kind == "text":
+                    await ws.send_str(ev["data"])
+                elif kind == "bytes":
+                    await ws.send_bytes(ev["data"])
+                elif kind == "close":
+                    await ws.close(code=ev.get("code", 1000),
+                                   message=ev.get("reason", "").encode())
+                    break
+        except Exception:  # noqa: BLE001 — replica died mid-connection
+            pass
+        finally:
+            gen.cancel()
+            if not ws.closed:
+                await ws.close(code=1011)
+            in_task.cancel()
+            try:
+                await in_task
+            except (asyncio.CancelledError, Exception):  # noqa: B014
+                pass
+        return ws
 
     async def _stream_response(self, request, gen):
         """Chunked transfer of a streaming deployment response (reference:

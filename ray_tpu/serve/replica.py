@@ -24,6 +24,63 @@ import ray_tpu
 REJECTED = "__rt_serve_rejected__"
 
 
+class _AsyncStreamPump:
+    """Drains an async generator into a bounded queue from a background
+    task, so ``next_chunks`` can return items AS PRODUCED instead of
+    awaiting the generator ``max_items`` times per pull (which would hold
+    back SSE tokens and websocket frames until a batch filled). The bound
+    gives a fast producer backpressure when the consumer lags."""
+
+    _DONE = object()
+
+    def __init__(self, agen, maxsize: int = 256):
+        self._agen = agen
+        self._queue: asyncio.Queue = asyncio.Queue(maxsize=maxsize)
+        self._error: Optional[BaseException] = None
+        self._loop = asyncio.get_running_loop()
+        self._task = asyncio.ensure_future(self._pump())
+
+    async def _pump(self) -> None:
+        try:
+            async for item in self._agen:
+                await self._queue.put(item)
+        except BaseException as e:  # noqa: BLE001 — delivered to consumer
+            self._error = e
+        finally:
+            await self._queue.put(self._DONE)
+
+    async def take(self, max_items: int) -> Tuple[List[Any], bool]:
+        """Block for one item, then drain opportunistically."""
+        items: List[Any] = []
+        first = await self._queue.get()
+        if first is self._DONE:
+            if self._error is not None:
+                raise self._error
+            return (items, True)
+        items.append(first)
+        while len(items) < max_items and not self._queue.empty():
+            nxt = self._queue.get_nowait()
+            if nxt is self._DONE:
+                if self._error is not None:
+                    # deliver the collected items now; the error travels
+                    # as the NEXT pull's failure (contract above)
+                    self._queue.put_nowait(self._DONE)
+                    return (items, False)
+                return (items, True)
+            items.append(nxt)
+        return (items, False)
+
+    def close(self) -> None:
+        """Thread-safe teardown (cancel_stream may run off-loop)."""
+        def _do():
+            self._task.cancel()
+            closer = getattr(self._agen, "aclose", None)
+            if closer is not None:
+                asyncio.ensure_future(closer())
+
+        self._loop.call_soon_threadsafe(_do)
+
+
 class _FunctionWrapper:
     """Adapts a plain function deployment to the class-callable protocol.
 
@@ -90,7 +147,12 @@ class ReplicaActor:
         """Returns ("ok", result, loaded_model_ids),
         ("stream", stream_id, loaded_model_ids) for generator results, or
         (REJECTED, ongoing_count)."""
-        if self._ongoing >= self._max_ongoing:
+        # websocket inbound frames bypass admission control: the
+        # connection's __ws_connect__ stream already holds a slot, and
+        # rejecting its own frames would wedge every connection on a
+        # replica running at max_ongoing (e.g. max_ongoing_requests=1)
+        if (self._ongoing >= self._max_ongoing
+                and method_name != "__ws_push__"):
             return (REJECTED, self._ongoing)
         self._ongoing += 1
         try:
@@ -126,7 +188,15 @@ class ReplicaActor:
             if inspect.isgenerator(result) or inspect.isasyncgen(result):
                 sid = f"s{self._next_stream_id}"
                 self._next_stream_id += 1
-                self._streams[sid] = result
+                if inspect.isasyncgen(result):
+                    # async gens are drained by a pump task into a queue so
+                    # next_chunks returns each item AS IT IS PRODUCED — a
+                    # batched pull that awaited __anext__ max_items times
+                    # would hold back SSE tokens / websocket frames until
+                    # the batch filled
+                    self._streams[sid] = _AsyncStreamPump(result)
+                else:
+                    self._streams[sid] = result
                 # the stream HOLDS the in-flight slot until exhausted or
                 # cancelled: +1 here cancels the finally's -1, so ongoing
                 # counts active streams (admission control, autoscaler
@@ -139,22 +209,23 @@ class ReplicaActor:
 
     async def next_chunks(self, stream_id: str, max_items: int = 10) -> Tuple:
         """Pull up to max_items from a response stream: (items, done).
-        A mid-stream exception travels as the last pull's error."""
-        import functools
+        A mid-stream exception travels as the last pull's error.
 
+        Async-gen streams block only for the FIRST item of a pull; the rest
+        are taken opportunistically (whatever the pump already produced) —
+        incremental streams (SSE, websocket frames) flow with per-item
+        latency while bursty producers still batch."""
         it = self._streams.get(stream_id)
         if it is None:
             return ([], True)
         items: List[Any] = []
         loop = asyncio.get_running_loop()
         try:
-            if inspect.isasyncgen(it):
-                for _ in range(max_items):
-                    try:
-                        items.append(await it.__anext__())
-                    except StopAsyncIteration:
-                        self._finish_stream(stream_id)
-                        return (items, True)
+            if isinstance(it, _AsyncStreamPump):
+                items, done = await it.take(max_items)
+                if done:
+                    self._finish_stream(stream_id)
+                return (items, done)
             else:
                 def pull():
                     out = []

@@ -209,3 +209,122 @@ def test_fastapi_app_if_available(serve_cluster):
     r = requests.get(f"{base}/items/5?q=x", timeout=30)
     assert r.json() == {"item_id": 5, "q": "x"}
     assert requests.get(f"{base}/stream", timeout=30).text == "abc"
+
+
+def _ws_asgi_app():
+    """Websocket ASGI app: echoes text uppercased, sums binary bytes,
+    closes on 'bye'; rejects when the path is /denied."""
+
+    async def app(scope, receive, send):
+        if scope["type"] != "websocket":
+            await send({"type": "http.response.start", "status": 404,
+                        "headers": []})
+            await send({"type": "http.response.body", "body": b""})
+            return
+        msg = await receive()
+        assert msg["type"] == "websocket.connect"
+        if scope["path"] == "/denied":
+            await send({"type": "websocket.close", "code": 4403})
+            return
+        await send({"type": "websocket.accept"})
+        await send({"type": "websocket.send",
+                    "text": f"hello:{scope['path']}"})
+        while True:
+            msg = await receive()
+            if msg["type"] == "websocket.disconnect":
+                return
+            if msg.get("bytes") is not None:
+                await send({"type": "websocket.send",
+                            "bytes": bytes([sum(msg["bytes"]) % 256])})
+            elif msg.get("text") == "bye":
+                await send({"type": "websocket.send", "text": "BYE"})
+                await send({"type": "websocket.close", "code": 1000})
+                return
+            else:
+                await send({"type": "websocket.send",
+                            "text": msg["text"].upper()})
+
+    return app
+
+
+def test_asgi_websocket_end_to_end(serve_cluster):
+    """Full duplex through the proxy bridge: ordered echo, binary frames,
+    app-initiated close, and pre-accept rejection -> HTTP 403."""
+    import asyncio
+
+    import aiohttp
+
+    serve.run(serve.deployment(serve.asgi_app(_ws_asgi_app)).bind(),
+              name="ws", route_prefix="/ws")
+    port = serve.http_port()
+
+    async def drive():
+        async with aiohttp.ClientSession() as sess:
+            async with sess.ws_connect(
+                    f"http://127.0.0.1:{port}/ws/chat",
+                    timeout=60) as ws:
+                first = await ws.receive_str(timeout=60)
+                assert first == "hello:/chat"
+                # ordered text echo
+                for i in range(5):
+                    await ws.send_str(f"msg{i}")
+                got = [await ws.receive_str(timeout=60) for _ in range(5)]
+                assert got == [f"MSG{i}" for i in range(5)]
+                # binary frames
+                await ws.send_bytes(bytes([1, 2, 3]))
+                assert await ws.receive_bytes(timeout=60) == bytes([6])
+                # app-initiated close
+                await ws.send_str("bye")
+                assert await ws.receive_str(timeout=60) == "BYE"
+                closed = await ws.receive(timeout=60)
+                assert closed.type == aiohttp.WSMsgType.CLOSE
+                assert closed.data == 1000
+
+            # pre-accept rejection: handshake denied as HTTP 403
+            try:
+                await sess.ws_connect(
+                    f"http://127.0.0.1:{port}/ws/denied", timeout=60)
+                raise AssertionError("expected handshake rejection")
+            except aiohttp.WSServerHandshakeError as e:
+                assert e.status == 403
+
+    asyncio.new_event_loop().run_until_complete(drive())
+
+
+def test_asgi_websocket_client_disconnect_unwinds_app(serve_cluster):
+    """Dropping the client delivers websocket.disconnect to the app and
+    frees the replica slot (no leaked in-flight stream)."""
+    import asyncio
+
+    import aiohttp
+
+    serve.run(serve.deployment(serve.asgi_app(_ws_asgi_app)).bind(),
+              name="ws2", route_prefix="/ws2")
+    port = serve.http_port()
+
+    async def drive():
+        async with aiohttp.ClientSession() as sess:
+            ws = await sess.ws_connect(
+                f"http://127.0.0.1:{port}/ws2/chat", timeout=60)
+            assert await ws.receive_str(timeout=60) == "hello:/chat"
+            await ws.close()
+
+    asyncio.new_event_loop().run_until_complete(drive())
+    # the replica's ongoing count must drain back to zero
+    import time as _time
+
+    from ray_tpu.serve.api import _get_controller
+
+    ctrl = _get_controller()
+    ingress = ray_tpu.get(ctrl.get_ingress.remote("ws2"))
+    info = ray_tpu.get(ctrl.get_replicas.remote("ws2", ingress, -1))
+    handles = [h for _, h in info["replicas"]]
+    assert handles
+    deadline = _time.time() + 30
+    counts = None
+    while _time.time() < deadline:
+        counts = [ray_tpu.get(h.ongoing_count.remote()) for h in handles]
+        if all(c == 0 for c in counts):
+            return
+        _time.sleep(0.5)
+    raise AssertionError(f"replica slots leaked: {counts}")
