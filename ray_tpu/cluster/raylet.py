@@ -87,6 +87,10 @@ class Raylet:
         self._pool = ConnectionPool(peer_id=f"raylet:{node_id}")
         self._workers: Dict[str, _WorkerEntry] = {}
         self._idle: Dict[Tuple, List[_WorkerEntry]] = {}
+        # concurrent worker-process boots allowed (see _get_worker):
+        # enough to hide boot latency, few enough that a task burst can't
+        # fork-bomb a small host
+        self._spawn_slots = max(4, 2 * (os.cpu_count() or 1))
         self._queue: List[Dict] = []          # pending task payloads + futures
         self._inflight: Dict[str, Dict] = {}  # task_id -> resource state
         self._task_futures: Dict[str, "asyncio.Future"] = {}  # dedup joins
@@ -226,7 +230,8 @@ class Raylet:
 
     # ---- worker pool --------------------------------------------------------
     def _spawn_worker(self, key: Tuple, chips: List[int],
-                      runtime_env: Optional[Dict] = None) -> _WorkerEntry:
+                      runtime_env: Optional[Dict] = None,
+                      python_exe: Optional[str] = None) -> _WorkerEntry:
         import json
 
         worker_id = os.urandom(8).hex()
@@ -249,7 +254,8 @@ class Raylet:
         os.makedirs(log_dir, exist_ok=True)
         log_file = open(os.path.join(log_dir, f"worker-{worker_id}.log"), "wb")
         proc = subprocess.Popen(
-            [sys.executable, "-m", "ray_tpu.cluster.worker_main"],
+            [python_exe or sys.executable, "-m",
+             "ray_tpu.cluster.worker_main"],
             env=env, stdout=log_file, stderr=subprocess.STDOUT)
         log_file.close()
         entry = _WorkerEntry(worker_id, proc, key, self.loop)
@@ -268,18 +274,57 @@ class Raylet:
 
     async def _get_worker(self, key: Tuple, chips: List[int],
                           runtime_env: Optional[Dict] = None) -> _WorkerEntry:
-        idle = self._idle.get(key)
-        while idle:
-            entry = idle.pop()
-            if entry.proc.poll() is None:
-                return entry
-            self._workers.pop(entry.worker_id, None)
-        entry = self._spawn_worker(key, chips, runtime_env)
-        cfg = get_config()
-        timeout = cfg.process_startup_timeout_s + (
-            cfg.runtime_env_setup_timeout_s if runtime_env else 0)
-        await asyncio.wait_for(entry.ready, timeout)
-        return entry
+        """Idle worker or a new spawn — with spawn THROTTLING: at most
+        ``_spawn_slots`` worker processes boot concurrently. A burst of N
+        first-touch tasks must not fork N interpreters at once — on a
+        small host the spawn stampede thrashes every boot past the startup
+        timeout, and each timed-out waiter used to ABANDON its live
+        process and retry, forking more (discovered by `rt
+        scale-envelope`). Waiters poll the idle pool while throttled, so
+        a released worker is picked up ahead of any new spawn; a spawn
+        that still times out is KILLED, not leaked."""
+        while True:
+            idle = self._idle.get(key)
+            while idle:
+                entry = idle.pop()
+                if entry.proc.poll() is None:
+                    return entry
+                self._workers.pop(entry.worker_id, None)
+            if self._spawn_slots > 0:
+                break
+            await asyncio.sleep(0.05)
+        self._spawn_slots -= 1
+        try:
+            python_exe = None
+            if runtime_env and runtime_env.get("venv"):
+                # hermetic env: materialize the virtualenv OFF the raylet
+                # loop and boot the worker with its interpreter (reference:
+                # the agent's conda/container setup swapping
+                # context.py_executable)
+                from ray_tpu.runtime_env.runtime_env import ensure_venv
+
+                cache_root = os.path.join(get_config().session_dir_root,
+                                          self.session_name, "runtime_env")
+                # setup stays bounded like the worker-side pip path; on
+                # timeout the task fails (the executor thread finishes in
+                # the background and the venv, if it completes, is cached)
+                python_exe = await asyncio.wait_for(
+                    self.loop.run_in_executor(
+                        None, ensure_venv, runtime_env, cache_root),
+                    get_config().runtime_env_setup_timeout_s)
+            entry = self._spawn_worker(key, chips, runtime_env, python_exe)
+            cfg = get_config()
+            timeout = cfg.process_startup_timeout_s + (
+                cfg.runtime_env_setup_timeout_s if runtime_env else 0)
+            try:
+                await asyncio.wait_for(entry.ready, timeout)
+            except asyncio.TimeoutError:
+                entry.proc.kill()
+                self._workers.pop(entry.worker_id, None)
+                raise
+            return entry
+        finally:
+            self._spawn_slots += 1
 
     def _release_worker(self, entry: _WorkerEntry) -> None:
         entry.busy = False

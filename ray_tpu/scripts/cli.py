@@ -390,6 +390,27 @@ def cmd_metrics(args: argparse.Namespace) -> int:
         rt.shutdown()
 
 
+def cmd_export_grafana(args: argparse.Namespace) -> int:
+    """rt metrics-export-grafana: turnkey Grafana/Prometheus provisioning
+    (reference: ``dashboard/modules/metrics/grafana_dashboard_factory``)."""
+    from ray_tpu.dashboard.grafana import export_grafana, \
+        snapshot_user_metrics
+
+    user = []
+    if args.address:
+        rt = _attach_driver(args.address)
+        try:
+            user = snapshot_user_metrics()
+        finally:
+            rt.shutdown()
+    paths = export_grafana(args.out, prom_url=args.prom_url,
+                           metrics_target=args.metrics_target,
+                           user_metrics=user)
+    for k, v in paths.items():
+        print(f"{k}: {v}")
+    return 0
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="rt")
     sub = parser.add_subparsers(dest="cmd", required=True)
@@ -452,11 +473,13 @@ def main(argv=None) -> int:
     p_scale.add_argument("--actors", type=int, default=1000)
     p_scale.add_argument("--queued", type=int, default=10_000)
     p_scale.add_argument("--pgs", type=int, default=100)
+    p_scale.add_argument("--actor-budget-s", type=float, default=120.0)
     p_scale.add_argument("--out", type=str, default="")
     p_scale.set_defaults(fn=lambda a: __import__(
         "ray_tpu.scripts.scale_envelope", fromlist=["main"]).main(
         ["--actors", str(a.actors), "--queued", str(a.queued),
-         "--pgs", str(a.pgs)] + (["--out", a.out] if a.out else [])))
+         "--pgs", str(a.pgs), "--actor-budget-s", str(a.actor_budget_s)]
+        + (["--out", a.out] if a.out else [])))
 
     p_serve = sub.add_parser("serve", help="deploy/inspect serve apps")
     serve_sub = p_serve.add_subparsers(dest="serve_cmd", required=True)
@@ -497,6 +520,16 @@ def main(argv=None) -> int:
                               help="list bundled tuned examples")
     pr_ex.add_argument("--address", default=None)
     p_rl.set_defaults(fn=cmd_rl)
+
+    p_graf = sub.add_parser(
+        "metrics-export-grafana",
+        help="write Grafana dashboards + provisioning + prometheus.yml")
+    p_graf.add_argument("--out", required=True)
+    p_graf.add_argument("--prom-url", default="http://127.0.0.1:9090")
+    p_graf.add_argument("--metrics-target", default="127.0.0.1:8265")
+    p_graf.add_argument("--address", default=None,
+                        help="live cluster to harvest user metrics from")
+    p_graf.set_defaults(fn=cmd_export_grafana)
 
     p_metrics = sub.add_parser("metrics",
                                help="aggregated Prometheus metrics page")

@@ -26,12 +26,21 @@ def _scenario(out: Dict[str, Any], name: str):
 
     class _Ctx:
         def __enter__(self):
+            import sys
+
+            print(f"[scale-envelope] {name} ...", file=sys.stderr,
+                  flush=True)
             self.t0 = time.perf_counter()
             return self
 
         def __exit__(self, et, ev, tb):
+            import sys
+
+            wall = round(time.perf_counter() - self.t0, 2)
             out.setdefault("scenarios", {}).setdefault(name, {})[
-                "wall_s"] = round(time.perf_counter() - self.t0, 2)
+                "wall_s"] = wall
+            print(f"[scale-envelope] {name} done in {wall}s",
+                  file=sys.stderr, flush=True)
             if ev is not None:
                 out["scenarios"][name]["error"] = f"{et.__name__}: {ev}"[:300]
                 return True  # isolate: swallow, keep other scenarios
@@ -75,7 +84,11 @@ def run_envelope(actor_target: int = 1000, queued_target: int = 10_000,
             return 0
 
         with _scenario(out, "tasks_per_sec") as sc:
-            ray_tpu.get([nop.remote() for _ in range(50)])  # warm workers
+            # warm the FULL worker pool (a wide round boots every slot the
+            # spawn throttle allows) so the timed loop measures
+            # steady-state dispatch, not process boots
+            ray_tpu.get([nop.remote() for _ in range(200)])
+            ray_tpu.get([nop.remote() for _ in range(200)])
             n_done = 0
             t0 = time.perf_counter()
             while time.perf_counter() - t0 < 10.0:
@@ -157,7 +170,10 @@ def run_envelope(actor_target: int = 1000, queued_target: int = 10_000,
         with _scenario(out, "live_actors") as sc:
             actors = []
             t0 = time.perf_counter()
-            batch = 50
+            # small batches so the time budget is honored on a starved box
+            # (a 50-wide batch can alone exceed the budget on 1 core; the
+            # check between batches would then never fire)
+            batch = 10
             while (len(actors) < actor_target
                    and time.perf_counter() - t0 < actor_budget_s):
                 new = [Member.remote() for _ in range(
@@ -165,6 +181,9 @@ def run_envelope(actor_target: int = 1000, queued_target: int = 10_000,
                 # gate on liveness so we count REAL actors, not queued specs
                 ray_tpu.get([a.ping.remote() for a in new])
                 actors.extend(new)
+                print(f"[scale-envelope] actors: {len(actors)} "
+                      f"({time.perf_counter() - t0:.0f}s)",
+                      file=__import__("sys").stderr, flush=True)
             create_dt = time.perf_counter() - t0
             t0 = time.perf_counter()
             pids = ray_tpu.get([a.ping.remote() for a in actors])
