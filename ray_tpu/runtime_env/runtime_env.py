@@ -16,6 +16,13 @@ Supported fields:
   - ``py_modules``: list of local package dirs (reference:
     ``runtime_env/py_modules.py``) shipped content-addressed like
     working_dir, joined to ``sys.path`` as import roots without chdir.
+  - ``venv``: bool — hermetic interpreter isolation (the redesign of the
+    reference's ``conda.py``/``container.py`` plugins for prebaked TPU
+    images): the RAYLET creates a real virtualenv per env hash
+    (``--system-site-packages`` so jax/the framework resolve from the
+    image), installs ``pip`` deps into it, and spawns the worker WITH THAT
+    INTERPRETER — user deps can shadow or pin versions without touching
+    the node's site-packages, and `pip` state cannot leak across envs.
 """
 
 from __future__ import annotations
@@ -99,6 +106,8 @@ def prepare_runtime_env(env: Optional[RuntimeEnv], kv_put, kv_get) -> Optional[D
         wire["env_vars"] = dict(vars_)
     if env.get("pip"):
         wire["pip"] = list(env["pip"])
+    if env.get("venv"):
+        wire["venv"] = True
     py_modules = env.get("py_modules")
     if py_modules:
         # Each entry is a local package dir (or a prior gcs:// URI); each is
@@ -119,7 +128,8 @@ def prepare_runtime_env(env: Optional[RuntimeEnv], kv_put, kv_get) -> Optional[D
             # CONTENTS, so the import root must re-create <name>/
             uris.append(f"gcs://{digest}#{os.path.basename(os.path.abspath(mod))}")
         wire["py_modules_uris"] = uris
-    unknown = set(env) - {"working_dir", "env_vars", "pip", "py_modules"}
+    unknown = set(env) - {"working_dir", "env_vars", "pip", "py_modules",
+                          "venv"}
     if unknown:
         raise ValueError(f"unsupported runtime_env fields: {sorted(unknown)}")
     if not wire:
@@ -184,7 +194,9 @@ def materialize(wire: Dict, kv_get, cache_root: str) -> None:
             sys.path.insert(0, root)
 
     pip_reqs = wire.get("pip")
-    if pip_reqs:
+    if pip_reqs and not wire.get("venv"):
+        # (venv envs carry their deps IN the interpreter the raylet
+        # launched this worker with — see ensure_venv)
         site = os.path.join(cache_root, "pip", wire["hash"])
         if not os.path.isdir(site):
             # install into a private tmp dir, then atomically rename — two
@@ -213,3 +225,76 @@ def materialize(wire: Dict, kv_get, cache_root: str) -> None:
 
     for k, v in (wire.get("env_vars") or {}).items():
         os.environ[k] = v
+
+
+def ensure_venv(wire: Dict, cache_root: str) -> str:
+    """Raylet-side: create (or reuse) the hermetic virtualenv for a
+    ``venv: True`` env and return its python executable. Keyed by the env
+    hash; creation is atomic (private tmp dir, rename into place) so two
+    concurrent spawns can't corrupt one env. The reference's analog is the
+    agent materializing ``conda.py``/``container.py`` envs before worker
+    launch and swapping ``context.py_executable``."""
+    venv_dir = os.path.join(cache_root, "venvs", wire["hash"])
+    py = os.path.join(venv_dir, "bin", "python")
+    if os.path.exists(py):
+        return py
+    # Concurrent same-hash calls run in executor THREADS of the one
+    # raylet process (spawn throttle allows several) — a pid-keyed tmp
+    # dir does NOT separate them the way it does for materialize()'s
+    # per-worker-process callers. Serialize creation and re-check.
+    with _VENV_CREATE_LOCK:
+        if os.path.exists(py):
+            return py
+        return _create_venv(venv_dir, py, wire)
+
+
+_VENV_CREATE_LOCK = __import__("threading").Lock()
+
+
+def _create_venv(venv_dir: str, py: str, wire: Dict) -> str:
+    import uuid
+    import venv as _venv
+
+    tmp = venv_dir + f".tmp.{uuid.uuid4().hex[:8]}"
+    # system-site-packages: jax/numpy/the framework come from the prebaked
+    # image; the venv only OVERLAYS user deps
+    _venv.create(tmp, system_site_packages=True, with_pip=True,
+                 symlinks=True)
+    # When THIS process itself runs inside a virtualenv (the common case:
+    # the image ships /opt/venv), venv.create chains to the BASE
+    # interpreter — system-site-packages then points at the base python's
+    # site dir and the image's packages vanish. Propagate the creating
+    # interpreter's site dirs with a .pth so the overlay always sees them.
+    parent_sites = [p for p in sys.path
+                    if p.endswith("site-packages") and os.path.isdir(p)]
+    if parent_sites:
+        import glob as _glob
+
+        for site_dir in _glob.glob(os.path.join(tmp, "lib", "python*",
+                                                "site-packages")):
+            with open(os.path.join(site_dir, "_rt_parent_site.pth"),
+                      "w") as f:
+                f.write("\n".join(parent_sites) + "\n")
+    reqs = wire.get("pip") or []
+    if reqs:
+        tmp_py = os.path.join(tmp, "bin", "python")
+        cmd = [tmp_py, "-m", "pip", "install",
+               "--no-warn-script-location"]
+        if all(r.endswith(".whl") or os.path.exists(r) for r in reqs):
+            cmd.append("--no-index")  # local wheels: no network needed
+        proc = subprocess.run(cmd + list(reqs), capture_output=True,
+                              text=True)
+        if proc.returncode != 0:
+            import shutil
+
+            shutil.rmtree(tmp, ignore_errors=True)
+            raise RuntimeError(
+                f"venv pip install failed:\n{proc.stderr[-2000:]}")
+    os.makedirs(os.path.dirname(venv_dir), exist_ok=True)
+    try:
+        os.rename(tmp, venv_dir)
+    except OSError:  # another spawn won the race
+        import shutil
+
+        shutil.rmtree(tmp, ignore_errors=True)
+    return py

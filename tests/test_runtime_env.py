@@ -161,3 +161,44 @@ def test_py_modules_with_working_dir(rt_cluster, tmp_path, project_dir):
 
     assert ray_tpu.get(both.remote(), timeout=60) == (
         7, "from-working-dir", "forty-two")
+
+
+def test_venv_hermetic_interpreter(rt_cluster, tmp_path):
+    """``venv: True`` boots the worker with a per-env virtualenv
+    interpreter (reference: conda.py/container.py hermetic envs): the
+    task sees a DIFFERENT sys.executable under the session's venv cache,
+    and a wheel installed there imports — while base-image packages still
+    resolve through --system-site-packages."""
+    wheel = _build_wheel(tmp_path)
+
+    @ray_tpu.remote(runtime_env={"venv": True, "pip": [wheel]})
+    def probe():
+        import sys as _sys
+
+        import rt_dummy_pkg  # the wheel, visible only inside the venv
+
+        import numpy  # base image package, via --system-site-packages
+
+        return (_sys.executable, rt_dummy_pkg.VALUE,
+                numpy.__name__)
+
+    exe, val, np_name = ray_tpu.get(probe.remote())
+    assert "/venvs/" in exe, exe
+    assert exe != sys.executable
+    assert val == 1234
+    assert np_name == "numpy"
+
+    # the plain-interpreter path must NOT see the venv-installed package
+    @ray_tpu.remote
+    def plain():
+        import sys as _sys
+
+        try:
+            import rt_dummy_pkg  # noqa: F401
+            return (_sys.executable, True)
+        except ImportError:
+            return (_sys.executable, False)
+
+    exe2, leaked = ray_tpu.get(plain.remote())
+    assert "/venvs/" not in exe2
+    assert not leaked, "venv deps leaked into the base interpreter"
