@@ -1,0 +1,216 @@
+"""Grafana + Prometheus provisioning factory.
+
+Reference analog: ``dashboard/modules/metrics/grafana_dashboard_factory.py``
+(+ ``grafana_dashboard_provisioning_template.py``,
+``grafana_datasource_template.py``, ``metrics_head.py`` writing the
+prometheus scrape config). Redesign: the reference renders panel configs
+defined in three hand-maintained dashboard modules; here ONE factory emits
+
+  <out>/grafana/provisioning/dashboards/rt.yml      file provider
+  <out>/grafana/provisioning/datasources/rt.yml     Prometheus datasource
+  <out>/grafana/dashboards/rt_cluster.json          the cluster dashboard
+  <out>/prometheus/prometheus.yml                   scrape config
+
+pointed at this framework's single aggregated ``/metrics`` page (the
+dashboard REST endpoint, ``dashboard/head.py``). Panels cover the system
+series synthesized at scrape time (``head.SYSTEM_METRICS``) plus any user
+metrics found in a live registry snapshot when a cluster is attached.
+
+Start grafana with ``--config`` pointing provisioning at the generated
+directory (or copy the files into /etc/grafana) and prometheus with the
+generated ``prometheus.yml`` — turnkey, no clicking.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional
+
+_DATASOURCE_UID = "rt_prometheus"
+
+
+def _panel(panel_id: int, title: str, expr: str, legend: str,
+           unit: str = "short", x: int = 0, y: int = 0) -> Dict:
+    """One timeseries panel (current Grafana schema, not the legacy
+    'graph' type the reference still emits)."""
+    return {
+        "id": panel_id,
+        "type": "timeseries",
+        "title": title,
+        "datasource": {"type": "prometheus", "uid": _DATASOURCE_UID},
+        "gridPos": {"h": 8, "w": 12, "x": x, "y": y},
+        "fieldConfig": {
+            "defaults": {
+                "unit": unit,
+                "custom": {"fillOpacity": 10, "lineWidth": 1,
+                           "stacking": {"mode": "none"}},
+            },
+            "overrides": [],
+        },
+        "options": {
+            "legend": {"displayMode": "table", "placement": "bottom",
+                       "calcs": ["lastNotNull"]},
+            "tooltip": {"mode": "multi"},
+        },
+        "targets": [{
+            "expr": expr,
+            "legendFormat": legend,
+            "refId": "A",
+            "datasource": {"type": "prometheus",
+                           "uid": _DATASOURCE_UID},
+        }],
+    }
+
+
+def build_cluster_dashboard(
+        user_metrics: Optional[List[Dict]] = None) -> Dict:
+    """The default cluster dashboard: one panel per system series plus one
+    per user metric (rate() for counters, raw for gauges, p50/p99 for
+    histograms)."""
+    panels: List[Dict] = []
+    pid = 1
+
+    def add(title, expr, legend, unit="short"):
+        nonlocal pid
+        n = len(panels)
+        panels.append(_panel(pid, title, expr, legend, unit,
+                             x=(n % 2) * 12, y=(n // 2) * 8))
+        pid += 1
+
+    add("Nodes", 'rt_nodes', "{{state}}")
+    add("Actors by state", 'rt_actors', "{{state}}")
+    add("Tasks by state", 'rt_tasks', "{{state}}")
+    add("Placement groups", 'rt_placement_groups', "{{state}}")
+    add("Resource utilization",
+        'rt_resource_total - ignoring(state) rt_resource_available',
+        "{{resource}} in use")
+    add("Resource capacity", 'rt_resource_total', "{{resource}}")
+    add("Objects in store", 'rt_objects_in_store', "objects")
+
+    for m in user_metrics or []:
+        name, kind = m.get("name"), m.get("type", "gauge")
+        if not name:
+            continue
+        if kind == "counter":
+            add(f"{name} (rate)", f"rate({name}[5m])", "{{instance}}")
+        elif kind == "histogram":
+            add(f"{name} p50/p99",
+                f"histogram_quantile(0.99, rate({name}_bucket[5m]))",
+                "p99", unit="s")
+            panels[-1]["targets"].append({
+                "expr": f"histogram_quantile(0.50, "
+                        f"rate({name}_bucket[5m]))",
+                "legendFormat": "p50",
+                "refId": "B",
+                "datasource": {"type": "prometheus",
+                               "uid": _DATASOURCE_UID},
+            })
+        else:
+            add(name, name, "{{instance}}")
+
+    return {
+        "uid": "rt-cluster",
+        "title": "ray_tpu cluster",
+        "tags": ["ray_tpu", "generated"],
+        "timezone": "browser",
+        "refresh": "10s",
+        "time": {"from": "now-30m", "to": "now"},
+        "schemaVersion": 39,
+        "templating": {"list": []},
+        "panels": panels,
+    }
+
+
+_DASHBOARD_PROVIDER_YML = """\
+apiVersion: 1
+providers:
+  - name: ray_tpu
+    folder: ray_tpu
+    type: file
+    disableDeletion: false
+    allowUiUpdates: true
+    options:
+      path: {dashboards_dir}
+"""
+
+_DATASOURCE_YML = """\
+apiVersion: 1
+datasources:
+  - name: rt-prometheus
+    uid: %s
+    type: prometheus
+    access: proxy
+    url: {prom_url}
+    isDefault: true
+""" % _DATASOURCE_UID
+
+_PROMETHEUS_YML = """\
+global:
+  scrape_interval: 10s
+  evaluation_interval: 10s
+scrape_configs:
+  - job_name: ray_tpu
+    metrics_path: /metrics
+    static_configs:
+      - targets: ['{metrics_target}']
+"""
+
+
+def export_grafana(out_dir: str,
+                   prom_url: str = "http://127.0.0.1:9090",
+                   metrics_target: str = "127.0.0.1:8265",
+                   user_metrics: Optional[List[Dict]] = None
+                   ) -> Dict[str, str]:
+    """Write the full provisioning tree; returns {artifact: path}."""
+    dash_dir = os.path.join(out_dir, "grafana", "dashboards")
+    prov_dash = os.path.join(out_dir, "grafana", "provisioning",
+                             "dashboards")
+    prov_ds = os.path.join(out_dir, "grafana", "provisioning",
+                           "datasources")
+    prom_dir = os.path.join(out_dir, "prometheus")
+    for d in (dash_dir, prov_dash, prov_ds, prom_dir):
+        os.makedirs(d, exist_ok=True)
+
+    paths = {}
+    p = os.path.join(dash_dir, "rt_cluster.json")
+    with open(p, "w") as f:
+        json.dump(build_cluster_dashboard(user_metrics), f, indent=2)
+    paths["dashboard"] = p
+
+    p = os.path.join(prov_dash, "rt.yml")
+    with open(p, "w") as f:
+        f.write(_DASHBOARD_PROVIDER_YML.format(dashboards_dir=dash_dir))
+    paths["dashboard_provider"] = p
+
+    p = os.path.join(prov_ds, "rt.yml")
+    with open(p, "w") as f:
+        f.write(_DATASOURCE_YML.format(prom_url=prom_url))
+    paths["datasource"] = p
+
+    p = os.path.join(prom_dir, "prometheus.yml")
+    with open(p, "w") as f:
+        f.write(_PROMETHEUS_YML.format(metrics_target=metrics_target))
+    paths["prometheus_config"] = p
+    return paths
+
+
+def snapshot_user_metrics() -> List[Dict]:
+    """User metric descriptors from the attached cluster's pushed
+    snapshots (name + type only — enough to choose a panel shape)."""
+    import ray_tpu
+    from ray_tpu.util import metrics as um
+
+    backend = ray_tpu.global_worker()._require_backend()
+    seen: Dict[str, Dict] = {}
+    for key in backend.kv_keys(um._KV_PREFIX):
+        raw = backend.kv_get(key)
+        if not raw:
+            continue
+        try:
+            for m in json.loads(raw)["metrics"]:
+                seen.setdefault(m["name"], {"name": m["name"],
+                                            "type": m.get("type", "gauge")})
+        except (ValueError, KeyError):
+            continue
+    return sorted(seen.values(), key=lambda m: m["name"])

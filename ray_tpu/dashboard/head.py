@@ -177,17 +177,99 @@ class DashboardActor:
         return web.json_response(out, dumps=_dumps)
 
     async def _metrics(self, request):
+        """User metrics (pushed registries) + system series synthesized
+        from cluster state at scrape time (reference: the metric_defs.cc
+        built-ins exported by the per-node agent — here the dashboard IS
+        the exporter, so the state API is the source of truth)."""
         from aiohttp import web
 
         from ray_tpu.util.metrics import metrics_text
 
+        def fetch():
+            text = metrics_text()
+            try:
+                text += system_metrics_text(self._backend())
+            except Exception:  # noqa: BLE001 — user page still served
+                pass
+            return text
+
         loop = asyncio.get_running_loop()
-        text = await loop.run_in_executor(None, metrics_text)
+        text = await loop.run_in_executor(None, fetch)
         return web.Response(text=text, content_type="text/plain")
 
 
 def _dumps(obj: Any) -> str:
     return json.dumps(obj, default=str)
+
+
+# System series synthesized per scrape; also the panel inventory for the
+# generated Grafana dashboard (dashboard/grafana.py)
+SYSTEM_METRICS = {
+    "rt_nodes": ("gauge", "Cluster nodes by liveness"),
+    "rt_actors": ("gauge", "Actors by state"),
+    "rt_tasks": ("gauge", "Task events by state"),
+    "rt_placement_groups": ("gauge", "Placement groups by state"),
+    "rt_resource_total": ("gauge", "Cluster resource capacity"),
+    "rt_resource_available": ("gauge", "Cluster resource availability"),
+    "rt_objects_in_store": ("gauge", "Objects tracked in the directory"),
+}
+
+
+def system_metrics_text(backend) -> str:
+    """Prometheus text for the framework's own state (nodes/actors/tasks/
+    PGs/resources/objects), computed from the GCS at scrape time."""
+    from collections import Counter as _Counter
+
+    import asyncio as _asyncio
+
+    async def gather():
+        gcs = backend._gcs
+        # concurrent: scrape latency is the MAX of the six calls, not
+        # the sum (Prometheus scrapes every 10s)
+        return await _asyncio.gather(
+            gcs.call("list_nodes", {}),
+            gcs.call("list_actors", {}),
+            gcs.call("list_tasks", {"limit": 10_000}),
+            gcs.call("list_placement_groups", {}),
+            gcs.call("cluster_resources", {}),
+            gcs.call("list_objects", {"limit": 100_000}))
+
+    nodes, actors, tasks, pgs, res, objs = backend.io.run(gather())
+    lines = []
+
+    def emit(name, label_kv, value):
+        labels = ",".join(f'{k}="{v}"' for k, v in label_kv)
+        lines.append(f"{name}{{{labels}}} {value}"
+                     if labels else f"{name} {value}")
+
+    for name, (kind, desc) in SYSTEM_METRICS.items():
+        lines.append(f"# HELP {name} {desc}")
+        lines.append(f"# TYPE {name} {kind}")
+        if name == "rt_nodes":
+            alive = sum(1 for n in nodes if n.get("alive", True))
+            emit(name, [("state", "alive")], alive)
+            emit(name, [("state", "dead")], len(nodes) - alive)
+        elif name == "rt_actors":
+            for state, c in sorted(_Counter(
+                    a.get("state", "?") for a in actors).items()):
+                emit(name, [("state", state)], c)
+        elif name == "rt_tasks":
+            for state, c in sorted(_Counter(
+                    t.get("state", "?") for t in tasks).items()):
+                emit(name, [("state", state)], c)
+        elif name == "rt_placement_groups":
+            for state, c in sorted(_Counter(
+                    p.get("state", "?") for p in pgs).items()):
+                emit(name, [("state", state)], c)
+        elif name == "rt_resource_total":
+            for r, v in sorted((res.get("total") or {}).items()):
+                emit(name, [("resource", r)], v)
+        elif name == "rt_resource_available":
+            for r, v in sorted((res.get("available") or {}).items()):
+                emit(name, [("resource", r)], v)
+        elif name == "rt_objects_in_store":
+            emit(name, [], len(objs))
+    return "\n".join(lines) + "\n"
 
 
 _DASHBOARD_NAME = "RT_DASHBOARD"

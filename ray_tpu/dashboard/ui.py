@@ -115,6 +115,41 @@ td.id { font-family: ui-monospace, monospace; font-size: 12px; }
 .s-critical .dot { background: var(--critical); }
 .s-muted .dot { background: var(--text-muted); }
 .empty { color: var(--text-muted); padding: 24px 0; }
+tr.clickable { cursor: pointer; }
+tr.clickable:hover td { background: var(--surface-2); }
+tr.detail td { background: var(--surface-2); }
+table.kv { width: auto; margin: 6px 0; }
+table.kv th { text-align: left; padding-right: 14px;
+  color: var(--text-secondary); border: none; }
+table.kv td { border: none; font-family: ui-monospace, monospace;
+  font-size: 12px; }
+.stack-btn {
+  background: var(--surface-1); color: var(--text-secondary);
+  border: 1px solid var(--border); border-radius: 6px;
+  padding: 3px 10px; font-size: 12px; cursor: pointer; margin: 4px 0;
+}
+.stack-out { max-height: 300px; overflow: auto; font-size: 11px; }
+.tl-head { color: var(--text-muted); font-size: 12px; margin: 4px 0 10px; }
+.tl-row { display: flex; align-items: center; gap: 8px; height: 18px; }
+.tl-label {
+  width: 180px; flex: none; overflow: hidden; text-overflow: ellipsis;
+  white-space: nowrap; font-size: 11px; color: var(--text-secondary);
+  font-family: ui-monospace, monospace;
+}
+.tl-track {
+  position: relative; flex: 1; height: 12px;
+  background: var(--surface-2); border-radius: 3px; overflow: hidden;
+}
+.tl-bar { position: absolute; top: 0; height: 100%; border-radius: 2px;
+  background: var(--series-1); opacity: .9; }
+.tl-bar.s-good { background: var(--good); }
+.tl-bar.s-critical { background: var(--critical); }
+.tl-bar.s-warning { background: var(--warning); }
+.tl-wait {
+  position: absolute; top: 0; height: 100%;
+  background: repeating-linear-gradient(45deg, transparent,
+    transparent 3px, var(--border) 3px, var(--border) 5px);
+}
 #error { color: var(--critical); font-size: 12px; padding: 0 20px; }
 </style>
 </head>
@@ -140,6 +175,7 @@ const TABS = [
   {id: "placement_groups", label: "Placement groups",
    url: "/api/placement_groups"},
   {id: "tasks", label: "Tasks", url: "/api/tasks?limit=200"},
+  {id: "timeline", label: "Timeline", url: "/api/tasks?limit=500"},
   {id: "objects", label: "Objects", url: "/api/objects?limit=200"},
   {id: "serve", label: "Serve", url: "/api/serve/applications"},
 ];
@@ -258,8 +294,76 @@ function tile(label, value, detail, meterPct) {
     `<div class="detail">${esc(detail)}</div>${meter}</div>`;
 }
 
+// --- task timeline: horizontal bars over the shared time window ---
+function renderTimeline(el) {
+  const rows = (data.timeline || []).filter(r => r.times
+    && (r.times.RUNNING || r.times.PENDING));
+  if (!rows.length) {
+    el.innerHTML = `<div class="empty">no task events yet</div>`;
+    return;
+  }
+  const now = Date.now() / 1000;
+  const start = Math.min(...rows.map(r =>
+    r.times.PENDING || r.times.RUNNING));
+  const end = Math.max(now, ...rows.map(r =>
+    r.times.FINISHED || r.times.FAILED || now));
+  const span = Math.max(0.001, end - start);
+  const pct = t => (100 * (t - start) / span).toFixed(2);
+  const byNode = {};
+  for (const r of rows) {
+    (byNode[r.node_id || "(unscheduled)"] ??= []).push(r);
+  }
+  const lane = r => {
+    const t = r.times;
+    const s = t.RUNNING || t.PENDING;
+    const e = t.FINISHED || t.FAILED || now;
+    const state = String(r.state || r.status || "").toUpperCase();
+    const cls = STATUS_CLASS[state] || "s-muted";
+    const wait = t.RUNNING && t.PENDING
+      ? `<div class="tl-wait" style="left:${pct(t.PENDING)}%;` +
+        `width:${Math.max(0.2, pct(t.RUNNING) - pct(t.PENDING))}%"></div>`
+      : "";
+    return `<div class="tl-row" title="${esc(r.name || r.func_name || "")}` +
+      ` ${esc(state)} ${(e - s).toFixed(2)}s">` +
+      `<span class="tl-label">${esc(r.name || r.func_name || r.task_id)}` +
+      `</span><div class="tl-track">${wait}` +
+      `<div class="tl-bar ${cls}" style="left:${pct(s)}%;` +
+      `width:${Math.max(0.3, pct(e) - pct(s))}%"></div></div></div>`;
+  };
+  el.innerHTML = `<div class="tl-head">window ${span.toFixed(1)}s ` +
+    `(${rows.length} tasks; hatched = queued wait)</div>` +
+    Object.entries(byNode).map(([n, rs]) =>
+      `<h3 class="id">${esc(n)}</h3>` +
+      rs.sort((a, b) => (a.times.PENDING || a.times.RUNNING || 0)
+                      - (b.times.PENDING || b.times.RUNNING || 0))
+        .map(lane).join("")).join("");
+}
+
+// --- per-actor drill-down: expandable full record + live stack ---
+let openActor = null;
+function actorDetail(r) {
+  const rows = Object.entries(r).map(([k, v]) =>
+    `<tr><th>${esc(k)}</th><td>${esc(
+       typeof v === "object" ? JSON.stringify(v) : v)}</td></tr>`);
+  return `<tr class="detail"><td colspan="6"><table class="kv">` +
+    rows.join("") + `</table>` +
+    `<button class="stack-btn" data-node="${esc(r.node_id || "")}">` +
+    `fetch live stacks on this node</button>` +
+    `<pre class="stack-out" id="stack-out"></pre></td></tr>`;
+}
+async function fetchStacks(nodeId) {
+  const out = document.getElementById("stack-out");
+  out.textContent = "collecting…";
+  try {
+    const d = await fetchJson(
+      `/api/stacks?node_id=${encodeURIComponent(nodeId)}&timeout=3`);
+    out.textContent = JSON.stringify(d, null, 2);
+  } catch (e) { out.textContent = String(e); }
+}
+
 function renderTable() {
   const el = document.getElementById("content");
+  if (active === "timeline") { renderTimeline(el); return; }
   if (active === "serve") {
     const apps = data.serve || {};
     const names = Object.keys(apps);
@@ -288,8 +392,14 @@ function renderTable() {
   }
   el.innerHTML = `<table><tr>` +
     cols.map(c => `<th>${esc(c[0])}</th>`).join("") + `</tr>` +
-    rows.map(r => `<tr>` + cols.map(c => c[1](r)).join("") +
-             `</tr>`).join("") + `</table>`;
+    rows.map(r => {
+      const id = active === "actors" ? r.actor_id : null;
+      const open = id && id === openActor;
+      return `<tr${id ? ` class="clickable" data-actor="${esc(id)}"`
+                      : ""}>` +
+        cols.map(c => c[1](r)).join("") + `</tr>` +
+        (open ? actorDetail(r) : "");
+    }).join("") + `</table>`;
 }
 
 function renderTabs() {
@@ -328,6 +438,15 @@ document.getElementById("tabs").addEventListener("click", e => {
   if (!id) return;
   active = id; renderTabs();
   refresh(true);  // tab switch renders even while paused
+});
+document.getElementById("content").addEventListener("click", e => {
+  const btn = e.target.closest(".stack-btn");
+  if (btn) { fetchStacks(btn.dataset.node); return; }
+  const row = e.target.closest("tr[data-actor]");
+  if (!row) return;
+  const id = row.dataset.actor;
+  openActor = openActor === id ? null : id;
+  renderTable();
 });
 document.getElementById("pause").addEventListener("click", e => {
   paused = !paused;
