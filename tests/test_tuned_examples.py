@@ -35,7 +35,7 @@ def test_every_bundled_example_validates():
     from ray_tpu.rl.multi_agent import _MA_ENVS
 
     # envs owned by the algorithm itself (no env registry entry)
-    self_managed = {"recsim", "pointgoal"}
+    self_managed = {"recsim", "pointgoal", "connect4"}
     for name in rl_train.list_tuned_examples():
         exp = rl_train.load_tuned_example(name)
         cfg = rl_train.get_algorithm_config(exp["run"])
