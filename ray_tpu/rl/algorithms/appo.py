@@ -71,6 +71,7 @@ class APPO(IMPALA):
         self.learner = Learner(params, loss_fn, cfg.lr,
                                grad_clip=cfg.grad_clip, seed=cfg.seed)
         self._inflight: Dict[Any, Any] = {}
+        self._runner_failures: Dict[Any, int] = {}  # IMPALA fleet FT state
 
 
 class APPOConfig(AlgorithmConfig):
