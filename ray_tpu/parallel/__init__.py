@@ -19,6 +19,9 @@ _EXPORTS = {
     "ShardingRules": "ray_tpu.parallel.sharding",
     "named_sharding": "ray_tpu.parallel.sharding",
     "shard_pytree": "ray_tpu.parallel.sharding",
+    "make_train_step": "ray_tpu.parallel.train_step",
+    "make_multi_step": "ray_tpu.parallel.train_step",
+    "shard_batch": "ray_tpu.parallel.train_step",
 }
 
 __all__ = list(_EXPORTS)
