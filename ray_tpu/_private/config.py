@@ -41,6 +41,10 @@ class Config:
     head_port: int = 0  # 0 = pick a free port
     node_manager_port: int = 0
     num_workers_soft_limit: int = 0  # 0 = num_cpus of the node
+    # idle pooled workers beyond the soft limit are reaped after this long
+    # (reference: idle worker killing in the raylet worker pool) — bounds
+    # process growth when jobs cycle through many runtime envs
+    idle_worker_ttl_s: float = 120.0
     worker_register_timeout_s: float = 30.0
     process_startup_timeout_s: float = 30.0
     # Extra startup budget for workers that must materialize a runtime env

@@ -52,6 +52,7 @@ class _WorkerEntry:
         self.assignment: Dict[str, List[int]] = {}
         self.oom_killed = False
         self.job_id: Optional[str] = None  # current job, for log routing
+        self.idle_since: Optional[float] = None  # monotonic; None = busy
 
 
 class _BundleState:
@@ -288,6 +289,7 @@ class Raylet:
             while idle:
                 entry = idle.pop()
                 if entry.proc.poll() is None:
+                    entry.idle_since = None
                     return entry
                 self._workers.pop(entry.worker_id, None)
             if self._spawn_slots > 0:
@@ -329,6 +331,7 @@ class Raylet:
     def _release_worker(self, entry: _WorkerEntry) -> None:
         entry.busy = False
         if entry.proc.poll() is None and not entry.is_actor_worker:
+            entry.idle_since = time.monotonic()
             self._idle.setdefault(entry.key, []).append(entry)
 
     _UPLOAD_TTL_S = 600.0
@@ -349,6 +352,30 @@ class Raylet:
                         self.store.delete(ObjectID.from_hex(oid_hex))
                     except Exception:  # noqa: BLE001
                         pass
+            # idle-worker reaping (reference: the worker pool's idle
+            # killing): pooled workers beyond the soft limit that sat
+            # idle past the TTL are retired oldest-first — bounds process
+            # growth when jobs cycle through many runtime envs
+            cfg = get_config()
+            from ray_tpu.core.resources import CPU
+
+            soft = cfg.num_workers_soft_limit or max(
+                1, int(self.node.total.get(CPU) or 1))
+            all_idle = sorted(
+                (e for lst in self._idle.values() for e in lst
+                 if e.idle_since is not None),
+                key=lambda e: e.idle_since)
+            surplus = len(all_idle) - soft
+            for entry in all_idle[:max(0, surplus)]:
+                if now - entry.idle_since <= cfg.idle_worker_ttl_s:
+                    break  # oldest within TTL -> all newer ones are too
+                self._idle.get(entry.key, []).remove(entry)
+                self._workers.pop(entry.worker_id, None)
+                try:
+                    entry.proc.terminate()
+                except Exception:  # noqa: BLE001 — already gone
+                    pass
+
             for entry in list(self._workers.values()):
                 if entry.proc.poll() is not None:
                     self._workers.pop(entry.worker_id, None)

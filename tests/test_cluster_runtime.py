@@ -332,3 +332,45 @@ def test_actor_concurrency_group_validation(rt_cluster):
     b = B.remote()
     with pytest.raises(Exception, match="positive int"):
         ray_tpu.get(b.m.remote(), timeout=30)
+
+
+def test_idle_workers_reaped_beyond_soft_limit(rt_cluster):
+    """Pooled workers beyond the soft limit that sit idle past the TTL
+    are retired (reference: raylet idle-worker killing) — env-cycling
+    jobs must not accumulate processes forever."""
+    import time as _time
+
+    from ray_tpu._private import config as config_mod
+    from ray_tpu._private.config import get_config
+
+    get_config().num_workers_soft_limit = 1
+    get_config().idle_worker_ttl_s = 1.0
+    try:
+        # distinct runtime envs -> distinct pool keys -> distinct workers
+        @ray_tpu.remote
+        def pid():
+            import os
+
+            return os.getpid()
+
+        pids = set()
+        for i in range(3):
+            ref = pid.options(
+                runtime_env={"env_vars": {"POOL_KEY": str(i)}}).remote()
+            pids.add(ray_tpu.get(ref))
+        assert len(pids) == 3  # three live pooled workers
+
+        import psutil
+
+        deadline = _time.time() + 15
+        while _time.time() < deadline:
+            alive = [p for p in pids if psutil.pid_exists(p)]
+            if len(alive) <= 1:
+                break
+            _time.sleep(0.5)
+        assert len(alive) <= 1, f"idle workers not reaped: {alive}"
+
+        # the pool still works after reaping
+        assert isinstance(ray_tpu.get(pid.remote()), int)
+    finally:
+        config_mod.reset_config_for_tests()
