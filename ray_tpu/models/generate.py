@@ -168,6 +168,110 @@ def _compiled_generate(cfg, b: int, s: int, total: int, max_new_tokens: int,
     return run
 
 
+def generate_speculative(params: Params, draft_params: Params,
+                         prompt: jax.Array, cfg, draft_cfg,
+                         *, max_new_tokens: int, speculate_k: int = 4,
+                         max_len: Optional[int] = None) -> jax.Array:
+    """Greedy speculative decoding: a small DRAFT model proposes
+    ``speculate_k`` tokens per round; the TARGET verifies them in ONE
+    forward (k+1 positions batched onto the MXU) and emits the longest
+    matching prefix plus its own correction token. Output is EXACTLY the
+    target's greedy continuation — the draft only changes how many
+    target launches it takes (1 per ~(accepted+1) tokens instead of 1
+    per token), which is the lever when decode is launch- or
+    HBM-bound. (Leviathan et al. 2023; no reference counterpart — Ray
+    ships no model code.)
+
+    Batch semantics: acceptance is LOCKSTEP (min over rows). Each row's
+    emitted tokens are still its own target-greedy tokens — a row that
+    would have accepted more simply emits them over later rounds — so
+    exactness holds for any batch size; speedup is highest at B=1 (the
+    latency case).
+
+    The whole loop is one jit: a ``lax.while_loop`` over rounds, a
+    ``lax.scan`` for the draft's proposals inside. Stale cache entries
+    past a rejection are overwritten before they can be attended (each
+    round's k+1-wide write starts exactly at the first stale position).
+    """
+    b, s = prompt.shape
+    total = max_len or (s + max_new_tokens + speculate_k + 1)
+    if total < s + max_new_tokens + speculate_k + 1:
+        raise ValueError(f"max_len {total} < prompt {s} + new "
+                         f"{max_new_tokens} + k {speculate_k} + 1")
+    run = _compiled_speculative(cfg, draft_cfg, b, s, total,
+                                max_new_tokens, speculate_k)
+    return run(params, draft_params, prompt)
+
+
+@functools.lru_cache(maxsize=64)
+def _compiled_speculative(cfg, draft_cfg, b: int, s: int, total: int,
+                          max_new_tokens: int, k: int):
+    @jax.jit
+    def run(params, draft_params, prompt):
+        # prefill BOTH models; invariant from here on: caches hold KV for
+        # positions < pos, and cur is the (already decided) token AT pos
+        tcache = init_cache(cfg, b, total)
+        tlogits, tcache = _forward_with_cache(params, prompt, cfg,
+                                              tcache, 0)
+        dcache = init_cache(draft_cfg, b, total)
+        _, dcache = _forward_with_cache(draft_params, prompt, draft_cfg,
+                                        dcache, 0)
+        cur = jnp.argmax(tlogits[:, -1, :], axis=-1)  # token at pos=s
+        out = jnp.zeros((b, max_new_tokens + k + 1), jnp.int32)
+        # out[0] is cur (the first generated token)
+        out = out.at[:, 0].set(cur.astype(jnp.int32))
+
+        def cond(st):
+            return st[0] < max_new_tokens
+
+        def drafts_pad(d):
+            return jnp.concatenate(
+                [d, jnp.zeros((b, 1), d.dtype)], axis=1)
+
+        def body(st):
+            n, pos, cur, tcache, dcache, out = st
+
+            # draft proposes k tokens autoregressively
+            def dstep(carry, i):
+                dcache, tok = carry
+                logits, dcache = _forward_with_cache(
+                    draft_params, tok[:, None], draft_cfg, dcache, pos + i)
+                nxt = jnp.argmax(logits[:, -1, :], axis=-1)
+                return (dcache, nxt), nxt
+
+            (dcache, _), drafts = jax.lax.scan(
+                dstep, (dcache, cur), jnp.arange(k))
+            drafts = drafts.swapaxes(0, 1)  # [B, k]
+
+            # target verifies cur + all k drafts in ONE forward
+            block = jnp.concatenate([cur[:, None], drafts], axis=1)
+            logits, tcache = _forward_with_cache(
+                params, block, cfg, tcache, pos, last_only=False)
+            t = jnp.argmax(logits, axis=-1)  # [B, k+1]; t[:, j] follows
+            #                                   block position pos+j
+
+            # longest accepted prefix, lockstep across the batch
+            match = drafts == t[:, :k]                      # [B, k]
+            a = jnp.min(jnp.argmin(
+                jnp.concatenate([match, jnp.zeros((b, 1), bool)], 1), 1))
+            # emitted block: draft tokens below a, target tokens from a on
+            # (position a IS the correction; beyond is scratch that the
+            # next round overwrites)
+            emit = jnp.where(jnp.arange(k + 1)[None, :] < a, drafts_pad(
+                drafts), t).astype(jnp.int32)
+            out = jax.lax.dynamic_update_slice(out, emit, (0, n + 1))
+            cur = jax.lax.dynamic_index_in_dim(emit, a, axis=1,
+                                               keepdims=False)
+            return (n + a + 1, pos + a + 1, cur, tcache, dcache, out)
+
+        n, _, _, _, _, out = jax.lax.while_loop(
+            cond, body, (jnp.int32(0), jnp.int32(s), cur, tcache,
+                         dcache, out))
+        return out[:, :max_new_tokens]
+
+    return run
+
+
 @functools.lru_cache(maxsize=64)
 def _compiled_prefill(cfg, b: int, s: int, total: int):
     @jax.jit
