@@ -663,6 +663,41 @@ def _decode_main() -> None:
                     out["decode_marginal_batch"] = mid
             except Exception as e:  # noqa: BLE001 — sweep keys stand
                 out["decode_marginal_error"] = str(e)[:200]
+
+        # Speculative-decoding leg (models/generate.py:
+        # generate_speculative): a small draft proposes, the target
+        # verifies k+1 positions per launch — the decode-side
+        # launch-amortization story (the scan leg is the train-side one).
+        # B=1 (the latency case), greedy-exact.
+        try:
+            draft_preset = cfgd.get("draft_preset",
+                                    {"410m": "160m", "1b": "160m",
+                                     "160m": "debug"}.get(preset, "debug"))
+            dcfg = _bench_cfg(draft_preset, "xla", 0, dtype)
+            dparams = llama.init_params(jax.random.key(9), dcfg)
+
+            def sp_timed(n_new: int, seed: int) -> float:
+                prompt = jax.random.randint(jax.random.key(seed),
+                                            (1, prompt_len), 0,
+                                            cfg.vocab_size,
+                                            dtype=jnp.int32)
+                t0 = time.perf_counter()
+                res = gen.generate_speculative(
+                    params, dparams, prompt, cfg, dcfg,
+                    max_new_tokens=n_new, speculate_k=4)
+                _np.asarray(res)
+                return time.perf_counter() - t0
+
+            sp_timed(new_tokens, seed=11)  # compile + warmup
+            dt_spec = sp_timed(new_tokens, seed=411)
+            timed(1, new_tokens, seed=412)  # ensure plain b1 compiled
+            dt_plain = timed(1, new_tokens, seed=413)
+            out["decode_spec_tok_s_b1"] = round(new_tokens / dt_spec, 1)
+            out["decode_plain_tok_s_b1"] = round(new_tokens / dt_plain, 1)
+            out["decode_spec_speedup_b1"] = round(dt_plain / dt_spec, 2)
+            out["decode_spec_draft"] = draft_preset
+        except Exception as e:  # noqa: BLE001 — additive leg
+            out["decode_spec_error"] = str(e)[:200]
     except Exception as e:  # noqa: BLE001
         out["decode_error"] = str(e)[:300]
     print("DECODEBENCH=" + json.dumps(out))
