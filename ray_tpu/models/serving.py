@@ -1,0 +1,187 @@
+"""Continuous batching for autoregressive serving.
+
+The vLLM-style capability (no reference counterpart — Ray pairs with
+external engines for this), designed static-shape for XLA/TPU instead of
+paged dynamic memory:
+
+- ONE static KV cache [L, max_slots, max_len, hkv, hd]; a request
+  occupies a SLOT for its lifetime. No paging, no dynamic shapes — the
+  compiled programs never change as requests come and go.
+- Admission is a per-request prefill that scatters the prompt's KV into
+  the free slot (`dynamic_update_slice` on the slot axis) and returns
+  the first generated token.
+- Every engine tick is ONE compiled step decoding ALL slots together:
+  the per-slot absolute position rides a [slots] vector, handled by
+  ``vmap``-ing the single-row cached forward (per-row rope positions,
+  per-row cache writes become scatters, causal masking by each row's own
+  position). Free slots compute garbage that is never observed and is
+  overwritten from position 0 by the next admission's prefill.
+- Greedy decoding — each request's output is EXACTLY
+  ``generate.generate(...)`` on its own prompt, regardless of what else
+  shares the batch (the test asserts this token-for-token).
+
+Prefill compiles once per (batch=1, prompt_len) via the module's lru
+cache; production use would bucket prompt lengths — admission cost, not
+a steady-state one (the decode step is length-independent).
+"""
+
+from __future__ import annotations
+
+import functools
+import itertools
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.models import generate as G
+from ray_tpu.models import llama
+
+Params = Dict[str, Any]
+
+
+class _Request:
+    __slots__ = ("req_id", "slot", "remaining", "tokens")
+
+    def __init__(self, req_id: int, slot: int, remaining: int):
+        self.req_id = req_id
+        self.slot = slot
+        self.remaining = remaining
+        self.tokens: List[int] = []
+
+
+class ContinuousBatcher:
+    """Slot-based continuous batching engine around one model."""
+
+    def __init__(self, params: Params, cfg: llama.LlamaConfig, *,
+                 max_slots: int = 8, max_len: int = 512):
+        self.params = params
+        self.cfg = cfg
+        self.max_slots = max_slots
+        self.max_len = max_len
+        shape = (cfg.n_layers, max_slots, max_len, cfg.n_kv_heads,
+                 cfg.head_dim)
+        self._ck = jnp.zeros(shape, cfg.compute_dtype)
+        self._cv = jnp.zeros(shape, cfg.compute_dtype)
+        self._free: List[int] = list(range(max_slots))
+        self._active: Dict[int, _Request] = {}  # slot -> request
+        self._cur = np.zeros(max_slots, np.int32)   # token AT pos, per slot
+        self._pos = np.zeros(max_slots, np.int32)   # absolute position
+        self._ids = itertools.count()
+        self._step_fn = _compiled_rowwise_step(cfg, max_slots, max_len)
+
+    # -- admission --------------------------------------------------------
+
+    def submit(self, prompt: np.ndarray, max_new_tokens: int) -> int:
+        """Admit one request (prompt: int array [S]); returns req_id.
+        Raises RuntimeError when no slot is free (caller queues/retries —
+        admission control belongs to the serving layer)."""
+        if not self._free:
+            raise RuntimeError("no free slots")
+        s = len(prompt)
+        if s + max_new_tokens + 1 > self.max_len:
+            raise ValueError(f"prompt {s} + new {max_new_tokens} exceeds "
+                             f"max_len {self.max_len}")
+        slot = self._free.pop()
+        fn = _compiled_slot_prefill(self.cfg, s, self.max_slots,
+                                    self.max_len)
+        self._ck, self._cv, first = fn(
+            self.params, self._ck, self._cv,
+            jnp.asarray(prompt, jnp.int32)[None, :], slot)
+        req = _Request(next(self._ids), slot, max_new_tokens)
+        first_tok = int(first[0])
+        req.tokens.append(first_tok)
+        req.remaining -= 1
+        self._cur[slot] = first_tok
+        self._pos[slot] = s
+        if req.remaining <= 0:
+            self._free.append(slot)
+        else:
+            self._active[slot] = req
+        return req.req_id
+
+    # -- the engine tick --------------------------------------------------
+
+    def step(self) -> List[Tuple[int, int, bool]]:
+        """ONE decode step for every active slot; returns
+        [(req_id, token, done)] for requests that produced a token."""
+        if not self._active:
+            return []
+        self._ck, self._cv, nxt = self._step_fn(
+            self.params, self._ck, self._cv,
+            jnp.asarray(self._cur), jnp.asarray(self._pos))
+        nxt = np.asarray(nxt)
+        out = []
+        for slot, req in list(self._active.items()):
+            tok = int(nxt[slot])
+            req.tokens.append(tok)
+            req.remaining -= 1
+            self._cur[slot] = tok
+            self._pos[slot] += 1
+            done = req.remaining <= 0
+            if done:
+                del self._active[slot]
+                self._free.append(slot)
+            out.append((req.req_id, tok, done))
+        return out
+
+    @property
+    def num_active(self) -> int:
+        return len(self._active)
+
+    def run_to_completion(self) -> Dict[int, List[int]]:
+        """Drain all active requests; returns req_id -> generated tokens
+        (convenience for tests/batch jobs; serving calls step())."""
+        results: Dict[int, List[int]] = {
+            r.req_id: r.tokens for r in self._active.values()}
+        while self._active:
+            reqs = {r.req_id: r for r in self._active.values()}
+            for rid, tok, done in self.step():
+                results.setdefault(rid, reqs[rid].tokens)
+        return results
+
+
+@functools.lru_cache(maxsize=64)
+def _compiled_slot_prefill(cfg, s: int, max_slots: int, max_len: int):
+    """Prefill ONE prompt into ONE slot of the shared cache; returns the
+    updated cache and the first greedy token."""
+
+    @jax.jit
+    def run(params, ck, cv, prompt, slot):
+        row = {"k": jnp.zeros((cfg.n_layers, 1, max_len, cfg.n_kv_heads,
+                               cfg.head_dim), cfg.compute_dtype),
+               "v": jnp.zeros((cfg.n_layers, 1, max_len, cfg.n_kv_heads,
+                               cfg.head_dim), cfg.compute_dtype)}
+        logits, row = G._forward_with_cache(params, prompt, cfg, row, 0)
+        first = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
+        ck = jax.lax.dynamic_update_slice(ck, row["k"], (0, slot, 0, 0, 0))
+        cv = jax.lax.dynamic_update_slice(cv, row["v"], (0, slot, 0, 0, 0))
+        return ck, cv, first
+
+    return run
+
+
+@functools.lru_cache(maxsize=16)
+def _compiled_rowwise_step(cfg, max_slots: int, max_len: int):
+    """One decode step for ALL slots with PER-SLOT positions: vmap the
+    single-row cached forward over the slot axis — per-row rope, per-row
+    cache scatter, per-row causal masking, one compiled program."""
+
+    def one_row(params, ck_row, cv_row, tok, pos):
+        cache = {"k": ck_row[:, None], "v": cv_row[:, None]}
+        logits, cache = G._forward_with_cache(
+            params, tok[None, None], cfg, cache, pos)
+        nxt = jnp.argmax(logits[0, -1, :]).astype(jnp.int32)
+        return cache["k"][:, 0], cache["v"][:, 0], nxt
+
+    @jax.jit
+    def run(params, ck, cv, cur, pos):
+        ck_rows = ck.swapaxes(0, 1)  # [slots, L, T, hkv, hd]
+        cv_rows = cv.swapaxes(0, 1)
+        ck_rows, cv_rows, nxt = jax.vmap(
+            one_row, in_axes=(None, 0, 0, 0, 0))(
+            params, ck_rows, cv_rows, cur, pos)
+        return (ck_rows.swapaxes(0, 1), cv_rows.swapaxes(0, 1), nxt)
+
+    return run
