@@ -32,16 +32,17 @@ import os
 import time
 
 
-_PEAK_FLOPS = {"tpu": 197e12, "cpu": 1e11}  # v5e bf16 peak / rough CPU
-
-
-def _mfu(tok_s_chip: float, preset: str, platform: str) -> float:
-    """Model-FLOPs utilization from the 6*N fwd+bwd estimate."""
+def _mfu(tok_s_chip: float, preset: str, platform: str, seq: int) -> float:
+    """Model-FLOPs utilization from the SHARED analytic accounting
+    (util/flops.py: 6N + causal-attention term, RT_PEAK_FLOPS-overridable
+    peak) — the same formula the step profiler reports, so bench and
+    `rt profile` numbers agree on identical runs."""
     from ray_tpu.models import llama
+    from ray_tpu.util import flops as F
 
-    flops_per_tok = 6 * llama.PRESETS[preset].num_params()
-    peak = _PEAK_FLOPS.get(platform, 1e12)
-    return round(tok_s_chip * flops_per_tok / peak, 4)
+    cfg = llama.PRESETS[preset]
+    return round(tok_s_chip * F.train_flops_per_token(cfg, seq)
+                 / F.peak_flops_per_chip(platform), 4)
 
 
 def _bench_cfg(preset: str, attn_impl: str, loss_chunk: int,
@@ -160,14 +161,14 @@ def run_sweep(preset: str, batch: int, seq: int, attn_impl: str = "xla",
     if b > 0:
         marg = tok_per_step / b / n_dev
         result["marginal_tok_s_chip"] = round(marg, 2)
-        result["marginal_mfu"] = _mfu(marg, preset, platform)
+        result["marginal_mfu"] = _mfu(marg, preset, platform, seq)
     # Single-point sustained at the largest k, for continuity with r4's
     # sustained_* figures (includes a/k of fixed overhead), plus the
     # dispatch rate (clock stop before the host read — the r1-r4 ruler;
     # also the basis for Train-layer overhead, which is host-side work).
     sus = tok_per_step * ks[-1] / walls[-1] / n_dev
     result["sustained_tok_s_chip"] = round(sus, 2)
-    result["sustained_mfu"] = _mfu(sus, preset, platform)
+    result["sustained_mfu"] = _mfu(sus, preset, platform, seq)
     if last_dispatch[0] > 0:
         result["dispatch_tok_s_chip"] = round(
             tok_per_step * ks[-1] / last_dispatch[0] / n_dev, 2)
@@ -235,7 +236,7 @@ def run_sweep(preset: str, batch: int, seq: int, attn_impl: str = "xla",
             result["scan_steps_per_call"] = K
             result["scan_step_s"] = round(scan_step_s, 4)
             result["scan_tok_s_chip"] = round(scan_tok_s, 2)
-            result["scan_mfu"] = _mfu(scan_tok_s, preset, platform)
+            result["scan_mfu"] = _mfu(scan_tok_s, preset, platform, seq)
             if b > 0:
                 result["per_launch_overhead_s"] = round(
                     max(0.0, b - scan_step_s), 4)
@@ -610,8 +611,13 @@ def _decode_main() -> None:
         params = llama.init_params(jax.random.key(0), cfg)
         platform = jax.devices()[0].platform
         out["decode_platform"] = platform
-        flops_per_tok = 2 * cfg.num_params()
-        peak = _PEAK_FLOPS.get(platform, 1e12)
+        from ray_tpu.util import flops as F
+
+        # shared accounting (util/flops.py): decode flops at the mean
+        # live context, peak per chip with RT_PEAK_FLOPS override
+        flops_per_tok = F.decode_flops_per_token(
+            cfg, prompt_len + new_tokens / 2)
+        peak = F.peak_flops_per_chip(platform)
 
         def timed(batch: int, n_new: int, seed: int) -> float:
             prompt = jax.random.randint(jax.random.key(seed),

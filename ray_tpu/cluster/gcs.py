@@ -990,29 +990,67 @@ class GcsServer:
     # ---- task events (reference: GcsTaskManager, gcs_task_manager.h:61 —
     # a bounded in-memory event store behind the State API) -----------------
     _TASK_EVENTS_CAP = 10000
+    _STEP_EVENTS_CAP = 4096
 
     async def rpc_task_event(self, p):
+        self._apply_task_event(p)
+        return {"ok": True}
+
+    async def rpc_task_events(self, p):
+        """Batched form: the step profiler drains its whole ring in ONE
+        call instead of a round-trip per record."""
+        for ev in p.get("events") or ():
+            self._apply_task_event(ev)
+        return {"ok": True, "count": len(p.get("events") or ())}
+
+    def _apply_task_event(self, p):
         if not hasattr(self, "task_events"):
             from collections import OrderedDict
 
             self.task_events: "OrderedDict[str, Dict]" = OrderedDict()
-        ev = self.task_events.pop(p["task_id"], None) or {}
+            # step-profiler records get their OWN bounded store: a streamed
+            # profile run emits a record per token, and sharing the task
+            # FIFO would evict the real task history
+            self.step_events: "OrderedDict[str, Dict]" = OrderedDict()
+        is_step = p.get("profile") is not None
+        store = self.step_events if is_step else self.task_events
+        cap = self._STEP_EVENTS_CAP if is_step else self._TASK_EVENTS_CAP
+        ev = store.pop(p["task_id"], None) or {}
         ev.update({"task_id": p["task_id"], "name": p.get("name", ev.get("name")),
                    "state": p["state"], "node_id": p.get("node_id"),
                    "updated_at": time.time()})
         if p.get("trace") is not None:
             ev["trace"] = p["trace"]
+        # step-profiler records ride the same store: a breakdown payload
+        # plus caller-supplied span times (the profiler measured the real
+        # start/end; server receive-time would misplace the lane)
+        if p.get("profile") is not None:
+            ev["profile"] = p["profile"]
         # per-state transition times feed ray_tpu.timeline()'s Chrome trace
-        ev.setdefault("times", {})[p["state"]] = time.time()
-        self.task_events[p["task_id"]] = ev
-        while len(self.task_events) > self._TASK_EVENTS_CAP:
-            self.task_events.popitem(last=False)
-        return {"ok": True}
+        if p.get("times"):
+            ev.setdefault("times", {}).update(p["times"])
+        else:
+            ev.setdefault("times", {})[p["state"]] = time.time()
+        store[p["task_id"]] = ev
+        while len(store) > cap:
+            store.popitem(last=False)
 
     async def rpc_list_tasks(self, p):
-        events = list(getattr(self, "task_events", {}).values())
+        # "profile": "only" -> step-profiler records (the Steps page);
+        # "include" -> both lanes (the Perfetto timeline asks for this
+        # explicitly); default EXCLUDES step records so legacy callers
+        # (rt list tasks, the /metrics rt_tasks scrape, tracing) keep
+        # seeing real tasks only.
+        mode = p.get("profile") or "exclude"
         limit = p.get("limit") or 1000
-        return events[-limit:]
+        events = []
+        # limit applies PER STORE: a step store at its cap must not crowd
+        # the real task events out of a combined (timeline) response
+        if mode != "only":
+            events += list(getattr(self, "task_events", {}).values())[-limit:]
+        if mode != "exclude":
+            events += list(getattr(self, "step_events", {}).values())[-limit:]
+        return events
 
     async def rpc_list_objects(self, p):
         limit = p.get("limit") or 1000

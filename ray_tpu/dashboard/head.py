@@ -9,6 +9,7 @@ State API), ``dashboard/modules/metrics`` (Prometheus). Routes:
   GET /api/actors               actor table
   GET /api/placement_groups     placement groups
   GET /api/tasks                recent task events
+  GET /api/steps                step-profiler records (profile payloads)
   GET /api/objects              object directory
   GET /api/jobs                 submitted jobs
   GET /api/serve/applications   serve app states
@@ -45,7 +46,12 @@ class DashboardActor:
         app.router.add_get("/api/actors", self._gcs_list("list_actors"))
         app.router.add_get("/api/placement_groups",
                            self._gcs_list("list_placement_groups"))
-        app.router.add_get("/api/tasks", self._gcs_list("list_tasks"))
+        app.router.add_get("/api/tasks", self._gcs_list(
+            "list_tasks", {"profile": "exclude"}))
+        # step-profiler records (util/step_profiler.py): the per-step
+        # device-time / MFU page reads the same store, profile rows only
+        app.router.add_get("/api/steps", self._gcs_list(
+            "list_tasks", {"profile": "only"}))
         app.router.add_get("/api/objects", self._gcs_list("list_objects"))
         app.router.add_get("/api/cluster_resources", self._cluster_resources)
         app.router.add_get("/api/jobs", self._jobs)
@@ -90,15 +96,16 @@ class DashboardActor:
     def _backend(self):
         return ray_tpu.global_worker()._require_backend()
 
-    def _gcs_list(self, method: str):
+    def _gcs_list(self, method: str, extra: Optional[Dict] = None):
         async def handler(request):
             from aiohttp import web
 
             loop = asyncio.get_running_loop()
-            limit = int(request.query.get("limit", 1000))
+            payload = {"limit": int(request.query.get("limit", 1000)),
+                       **(extra or {})}
             rows = await loop.run_in_executor(
                 None, lambda: self._backend().io.run(
-                    self._backend()._gcs.call(method, {"limit": limit})))
+                    self._backend()._gcs.call(method, payload)))
             return web.json_response(rows, dumps=_dumps)
 
         return handler

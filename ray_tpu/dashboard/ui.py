@@ -19,7 +19,7 @@ INDEX_HTML = r"""<!doctype html>
   --surface-1: #fcfcfb; --surface-2: #f1f1ef;
   --text-primary: #0b0b0b; --text-secondary: #52514e; --text-muted: #7a7974;
   --border: #dddcd8;
-  --series-1: #2a78d6;
+  --series-1: #2a78d6; --series-2: #eb6834; --series-3: #1baf7a;
   --good: #0ca30c; --warning: #fab219; --serious: #ec835a;
   --critical: #d03b3b;
 }
@@ -29,7 +29,7 @@ INDEX_HTML = r"""<!doctype html>
     --surface-1: #1a1a19; --surface-2: #242423;
     --text-primary: #ffffff; --text-secondary: #c3c2b7;
     --text-muted: #8f8e86; --border: #3a3a38;
-    --series-1: #3987e5;
+    --series-1: #3987e5; --series-2: #d95926; --series-3: #199e70;
   }
 }
 :root[data-theme="dark"] {
@@ -37,7 +37,7 @@ INDEX_HTML = r"""<!doctype html>
   --surface-1: #1a1a19; --surface-2: #242423;
   --text-primary: #ffffff; --text-secondary: #c3c2b7;
   --text-muted: #8f8e86; --border: #3a3a38;
-  --series-1: #3987e5;
+  --series-1: #3987e5; --series-2: #d95926; --series-3: #199e70;
 }
 * { box-sizing: border-box; }
 body {
@@ -81,6 +81,21 @@ header button {
   height: 100%; border-radius: 4px; background: var(--series-1);
   transition: width .4s;
 }
+/* step-breakdown stacked bar: compile/dispatch/device-sync share of one
+   step's wall time; 2px surface gaps separate the fills */
+.bk-track {
+  display: flex; gap: 2px; width: 140px; height: 8px;
+  border-radius: 4px; overflow: hidden;
+  background: color-mix(in srgb, var(--border) 60%, var(--surface-2));
+}
+.bk-seg { height: 100%; border-radius: 2px; }
+.bk-compile { background: var(--series-2); }
+.bk-dispatch { background: var(--series-3); }
+.bk-sync { background: var(--series-1); }
+.legend { display: flex; gap: 14px; margin: 0 0 10px;
+  font-size: 12px; color: var(--text-secondary); }
+.legend .chip { display: inline-block; width: 9px; height: 9px;
+  border-radius: 2px; margin-right: 5px; }
 nav { display: flex; gap: 2px; padding: 0 20px; flex-wrap: wrap;
   border-bottom: 1px solid var(--border); }
 nav button {
@@ -175,6 +190,7 @@ const TABS = [
   {id: "placement_groups", label: "Placement groups",
    url: "/api/placement_groups"},
   {id: "tasks", label: "Tasks", url: "/api/tasks?limit=200"},
+  {id: "steps", label: "Steps", url: "/api/steps?limit=200"},
   {id: "timeline", label: "Timeline", url: "/api/tasks?limit=500"},
   {id: "objects", label: "Objects", url: "/api/objects?limit=200"},
   {id: "serve", label: "Serve", url: "/api/serve/applications"},
@@ -259,7 +275,41 @@ const COLS = {
     ["Locations", r => `<td class="id">${esc(
       (r.locations || []).join(" "))}</td>`],
   ],
+  steps: [
+    ["Kind", r => `<td>${esc(prof(r).kind || "")}</td>`],
+    ["Name", r => `<td>${esc(prof(r).name || "")}</td>`],
+    ["Step", r => `<td>${esc(prof(r).step ?? "")}</td>`],
+    ["Wall ms", r => `<td>${ms(prof(r).wall_s)}</td>`],
+    ["Compile ms", r => `<td>${ms(prof(r).compile_s)}</td>`],
+    ["Dispatch ms", r => `<td>${ms(prof(r).dispatch_s)}</td>`],
+    ["Sync ms", r => `<td>${ms(prof(r).execute_s)}</td>`],
+    ["Tok/s", r => `<td>${prof(r).tokens_per_s
+      ? prof(r).tokens_per_s.toFixed(1) : ""}</td>`],
+    ["MFU", r => `<td>${prof(r).mfu
+      ? (100 * prof(r).mfu).toFixed(2) + "%" : ""}</td>`],
+    ["Breakdown", r => `<td>${breakdownBar(prof(r))}</td>`],
+  ],
 };
+function prof(r) { return r.profile || {}; }
+function ms(v) { return v == null ? "" : (1000 * v).toFixed(2); }
+function breakdownBar(p) {
+  const wall = p.wall_s || 0;
+  if (!wall) return "";
+  const seg = (cls, v, label) => {
+    const pct = Math.max(0, Math.min(100, 100 * (v || 0) / wall));
+    return pct < 0.5 ? "" :
+      `<div class="bk-seg ${cls}" style="width:${pct.toFixed(1)}%"` +
+      ` title="${esc(label)} ${ms(v)}ms"></div>`;
+  };
+  return `<div class="bk-track">` +
+    seg("bk-compile", p.compile_s, "compile") +
+    seg("bk-dispatch", p.dispatch_s, "dispatch") +
+    seg("bk-sync", p.execute_s, "device sync") + `</div>`;
+}
+const STEP_LEGEND = `<div class="legend">` +
+  `<span><span class="chip bk-compile"></span>compile</span>` +
+  `<span><span class="chip bk-dispatch"></span>dispatch</span>` +
+  `<span><span class="chip bk-sync"></span>device sync</span></div>`;
 
 function renderTiles() {
   const res = data.resources || {};
@@ -387,10 +437,13 @@ function renderTable() {
   const rows = data[active] || [];
   const cols = COLS[active];
   if (!rows.length) {
-    el.innerHTML = `<div class="empty">no ${esc(active)} yet</div>`;
+    el.innerHTML = active === "steps"
+      ? `<div class="empty">no step records yet — enable the step ` +
+        `profiler (RT_STEP_PROFILER=1 or rt profile) and drain()</div>`
+      : `<div class="empty">no ${esc(active)} yet</div>`;
     return;
   }
-  el.innerHTML = `<table><tr>` +
+  el.innerHTML = (active === "steps" ? STEP_LEGEND : "") + `<table><tr>` +
     cols.map(c => `<th>${esc(c[0])}</th>`).join("") + `</tr>` +
     rows.map(r => {
       const id = active === "actors" ? r.actor_id : null;

@@ -25,6 +25,7 @@ from ray_tpu.models import llama
 from ray_tpu.ops.attention import mha
 from ray_tpu.ops.norms import rmsnorm
 from ray_tpu.ops.rope import apply_rope, rope_angles
+from ray_tpu.util import step_profiler
 
 Params = Dict[str, Any]
 
@@ -121,7 +122,17 @@ def generate(params: Params, prompt: jax.Array, cfg,
         key = jax.random.key(0)
     run = _compiled_generate(cfg, b, s, total, max_new_tokens,
                              float(temperature), top_k)
-    return run(params, prompt, key)
+    if not step_profiler.is_enabled():
+        return run(params, prompt, key)
+    from ray_tpu.util import flops as F
+
+    return step_profiler.profiled_call(
+        "generate", run, (params, prompt, key),
+        key=("generate", cfg, b, s, total, max_new_tokens,
+             float(temperature), top_k),
+        tokens=b * max_new_tokens,
+        flops=F.generate_flops(cfg, b, s, max_new_tokens),
+        meta={"batch": b, "prompt_len": s})
 
 
 def _sample_token(last_logits, temperature: float, top_k: Optional[int],
@@ -200,7 +211,21 @@ def generate_speculative(params: Params, draft_params: Params,
                          f"{max_new_tokens} + k {speculate_k} + 1")
     run = _compiled_speculative(cfg, draft_cfg, b, s, total,
                                 max_new_tokens, speculate_k)
-    return run(params, draft_params, prompt)
+    if not step_profiler.is_enabled():
+        return run(params, draft_params, prompt)
+    from ray_tpu.util import flops as F
+
+    # Analytic work: target prefill+decode plus the draft's proposals
+    # (the draft runs ~1 forward per emitted token too — acceptance only
+    # changes how many TARGET launches that took).
+    return step_profiler.profiled_call(
+        "speculative", run, (params, draft_params, prompt),
+        key=("speculative", cfg, draft_cfg, b, s, total, max_new_tokens,
+             speculate_k),
+        tokens=b * max_new_tokens,
+        flops=(F.generate_flops(cfg, b, s, max_new_tokens)
+               + F.generate_flops(draft_cfg, b, s, max_new_tokens)),
+        meta={"batch": b, "prompt_len": s, "speculate_k": speculate_k})
 
 
 @functools.lru_cache(maxsize=64)
@@ -313,7 +338,21 @@ def generate_stream(params: Params, prompt: jax.Array, cfg,
     if temperature > 0 and key is None:
         key = jax.random.key(0)
 
-    last, cache = _compiled_prefill(cfg, b, s, total)(params, prompt)
+    profiled = step_profiler.is_enabled()
+    if profiled:
+        from ray_tpu.util import flops as F
+
+    prefill = _compiled_prefill(cfg, b, s, total)
+    if profiled:
+        # per-launch records: the streamed path is the one that pays launch
+        # overhead PER TOKEN, which is exactly what the profiler's
+        # dispatch/sync split is built to expose
+        last, cache = step_profiler.profiled_call(
+            "prefill", prefill, (params, prompt),
+            key=("prefill", cfg, b, s, total), tokens=b * s,
+            flops=F.prefill_flops(cfg, b, s), meta={"batch": b})
+    else:
+        last, cache = prefill(params, prompt)
     step = _compiled_decode_step(cfg, b, total)
     for i in range(max_new_tokens):
         if temperature <= 0:
@@ -323,4 +362,11 @@ def generate_stream(params: Params, prompt: jax.Array, cfg,
         tok = _sample_token(last, temperature, top_k, sub)
         yield tok
         if i + 1 < max_new_tokens:
-            last, cache = step(params, cache, tok, jnp.int32(s + i))
+            if profiled:
+                last, cache = step_profiler.profiled_call(
+                    "decode", step,
+                    (params, cache, tok, jnp.int32(s + i)),
+                    key=("decode", cfg, b, total), tokens=b,
+                    flops=b * F.decode_flops_per_token(cfg, s + i))
+            else:
+                last, cache = step(params, cache, tok, jnp.int32(s + i))

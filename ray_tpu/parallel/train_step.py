@@ -21,6 +21,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ray_tpu.models import llama
 from ray_tpu.parallel.mesh import MeshConfig, make_mesh
 from ray_tpu.parallel.sharding import ShardingRules
+from ray_tpu.util import step_profiler
 
 
 def default_optimizer(lr: float = 3e-4, weight_decay: float = 0.1,
@@ -97,14 +98,60 @@ def make_train_step(cfg: llama.LlamaConfig,
         return params, opt_state, {"loss": loss, "grad_norm": gnorm}
 
     jstep = jax.jit(step, donate_argnums=(0, 1))
-    if mesh is None:
-        return jstep
+    return _instrumented(jstep, cfg, mesh)
 
-    from ray_tpu.parallel.context import mesh_scope
 
-    def run(params, opt_state, batch):
+def _batch_tokens(batch, stacked: bool = False) -> Tuple[int, int]:
+    """(trained tokens, seq len) of one step's batch. Token batches are
+    [B, S+1] ([K, B, S+1] stacked): S positions train per row. Custom
+    loss_fn batches without a usable token-shaped leaf yield (0, 1) — the
+    profiler then records times without tokens/MFU instead of crashing
+    the training loop it instruments."""
+    need = 3 if stacked else 2
+    leaf = batch.get("tokens") if isinstance(batch, dict) else None
+    if leaf is None or getattr(leaf, "ndim", 0) < need:
+        cands = [x for x in jax.tree.leaves(batch)
+                 if getattr(x, "ndim", 0) >= need]
+        if not cands:
+            return 0, 1
+        leaf = cands[0]
+    if stacked:
+        k, b, s1 = leaf.shape[0], leaf.shape[1], leaf.shape[2]
+        return k * b * max(1, s1 - 1), max(1, s1 - 1)
+    b, s1 = leaf.shape[0], leaf.shape[1]
+    return b * max(1, s1 - 1), max(1, s1 - 1)
+
+
+_PROGRAM_IDS = __import__("itertools").count()
+
+
+def _instrumented(jstep, cfg, mesh, stacked: bool = False):
+    """The (params, opt_state, batch) entry point every trainer calls:
+    ambient-mesh plumbing plus the step profiler's per-step record (wall /
+    compile / dispatch / device-sync split, analytic MFU). Disabled
+    profiling costs one predicate per step. The profiler key is a fresh
+    counter value per built step — NOT id(jstep), which CPython reuses
+    after GC and would book a new program's compile as dispatch."""
+    program_id = next(_PROGRAM_IDS)
+
+    def call(params, opt_state, batch):
+        if mesh is None:
+            return jstep(params, opt_state, batch)
+        from ray_tpu.parallel.context import mesh_scope
+
         with mesh_scope(mesh):
             return jstep(params, opt_state, batch)
+
+    def run(params, opt_state, batch):
+        if not step_profiler.is_enabled():
+            return call(params, opt_state, batch)
+        from ray_tpu.util import flops as F
+
+        tokens, seq = _batch_tokens(batch, stacked)
+        return step_profiler.profiled_call(
+            "train", call, (params, opt_state, batch),
+            key=("train", program_id), tokens=tokens,
+            flops=tokens * F.train_flops_per_token(cfg, seq))
 
     return run
 
@@ -150,16 +197,7 @@ def make_multi_step(cfg: llama.LlamaConfig,
         return params, opt_state, metrics
 
     jsteps = jax.jit(steps, donate_argnums=(0, 1))
-    if mesh is None:
-        return jsteps
-
-    from ray_tpu.parallel.context import mesh_scope
-
-    def run(params, opt_state, batches):
-        with mesh_scope(mesh):
-            return jsteps(params, opt_state, batches)
-
-    return run
+    return _instrumented(jsteps, cfg, mesh, stacked=True)
 
 
 def shard_batch(batch: Dict[str, jax.Array], mesh: Mesh,

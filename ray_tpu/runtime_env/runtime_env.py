@@ -241,14 +241,26 @@ def ensure_venv(wire: Dict, cache_root: str) -> str:
     # Concurrent same-hash calls run in executor THREADS of the one
     # raylet process (spawn throttle allows several) — a pid-keyed tmp
     # dir does NOT separate them the way it does for materialize()'s
-    # per-worker-process callers. Serialize creation and re-check.
-    with _VENV_CREATE_LOCK:
+    # per-worker-process callers. Serialize creation PER ENV HASH and
+    # re-check: distinct envs build concurrently (one slow pip install
+    # must not make unrelated envs time out in the worker pool), while
+    # same-hash spawns still create exactly once.
+    with _venv_lock(wire["hash"]):
         if os.path.exists(py):
             return py
         return _create_venv(venv_dir, py, wire)
 
 
-_VENV_CREATE_LOCK = __import__("threading").Lock()
+_VENV_LOCKS: Dict[str, Any] = {}
+_VENV_LOCKS_GUARD = __import__("threading").Lock()
+
+
+def _venv_lock(env_hash: str):
+    with _VENV_LOCKS_GUARD:
+        lock = _VENV_LOCKS.get(env_hash)
+        if lock is None:
+            lock = _VENV_LOCKS[env_hash] = __import__("threading").Lock()
+        return lock
 
 
 def _create_venv(venv_dir: str, py: str, wire: Dict) -> str:

@@ -412,6 +412,13 @@ def cmd_export_grafana(args: argparse.Namespace) -> int:
 
 
 def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else list(argv)
+    if argv[:1] == ["profile"]:
+        # passthrough: one parser (scripts/profile.py), one source of
+        # truth — `rt profile --help` shows its full flag set
+        from ray_tpu.scripts import profile as _profile
+
+        return _profile.main(argv[1:])
     parser = argparse.ArgumentParser(prog="rt")
     sub = parser.add_subparsers(dest="cmd", required=True)
 
@@ -459,6 +466,13 @@ def main(argv=None) -> int:
     p_list.add_argument("--address", default=None)
     p_list.add_argument("--limit", type=int, default=200)
     p_list.set_defaults(fn=cmd_list)
+
+    # `rt profile` is routed in main() before parsing (scripts/profile.py
+    # owns the flag set); this stub only makes it show up in `rt --help`
+    sub.add_parser(
+        "profile", add_help=False,
+        help="step profiler: per-step wall/compile/sync breakdown + MFU "
+             "over a model preset (util/step_profiler.py)")
 
     p_micro = sub.add_parser("microbenchmark",
                              help="core-ops throughput sweep")

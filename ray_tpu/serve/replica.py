@@ -20,6 +20,7 @@ import time
 from typing import Any, Dict, List, Optional, Tuple
 
 import ray_tpu
+from ray_tpu.util import step_profiler
 
 REJECTED = "__rt_serve_rejected__"
 
@@ -44,10 +45,38 @@ class _AsyncStreamPump:
         try:
             async for item in self._agen:
                 await self._queue.put(item)
+        except asyncio.CancelledError:
+            # close() tearing us down: the consumer is gone, so an awaited
+            # put on a full queue would pend forever (a fast producer fills
+            # the bound, nothing drains it). Never block — and RE-RAISE so
+            # cancellation stays cancellation instead of becoming the
+            # stream's "error".
+            self._put_done_nowait()
+            raise
         except BaseException as e:  # noqa: BLE001 — delivered to consumer
             self._error = e
-        finally:
+        # completion/error: an awaited put keeps backpressure honest (a
+        # lagging-but-live consumer will drain the queue), but close()
+        # cancelling us AT this await must still land the marker
+        try:
             await self._queue.put(self._DONE)
+        except asyncio.CancelledError:
+            self._put_done_nowait()
+            raise
+
+    def _put_done_nowait(self) -> None:
+        """Enqueue the DONE marker without ever blocking: on a full queue
+        drop buffered items (teardown path — nobody will consume them)
+        until the marker fits."""
+        while True:
+            try:
+                self._queue.put_nowait(self._DONE)
+                return
+            except asyncio.QueueFull:
+                try:
+                    self._queue.get_nowait()
+                except asyncio.QueueEmpty:
+                    pass
 
     async def take(self, max_items: int) -> Tuple[List[Any], bool]:
         """Block for one item, then drain opportunistically."""
@@ -172,6 +201,7 @@ class ReplicaActor:
                         f"deployment {self._deployment} has no method "
                         f"{method_name!r}")
             token = _current_model_id.set((meta or {}).get("model_id", ""))
+            t_epoch, t0 = time.time(), time.perf_counter()
             try:
                 # copy AFTER setting so the executor thread sees the model id
                 ctx = contextvars.copy_context()
@@ -183,6 +213,16 @@ class ReplicaActor:
                     result = await result
             finally:
                 _current_model_id.reset(token)
+            if step_profiler.is_enabled():
+                # serve is a profiler hot path too: per-request wall time
+                # (the user callable's execution — a returned stream's
+                # drain is accounted by the generate/decode records it
+                # produces, not here)
+                step_profiler.record(
+                    "serve", name=self._deployment, t_start=t_epoch,
+                    wall_s=time.perf_counter() - t0,
+                    meta={"method": method_name,
+                          "replica_id": self._replica_id})
             self._total_served += 1
             models = loaded_model_ids(self._instance)
             if inspect.isgenerator(result) or inspect.isasyncgen(result):

@@ -73,6 +73,27 @@ class _Registry:
 _registry = _Registry()
 
 
+_GET_OR_CREATE_LOCK = threading.Lock()
+
+
+def get_or_create(kind: type, name: str, description: str = "",
+                  **kwargs) -> "Metric":
+    """Idempotent registration: return the live metric when one of the same
+    type already holds ``name`` (constructing a fresh object would shadow
+    the accumulated samples in the registry). Library instrumentation (the
+    step profiler's auto-registered histograms) goes through this so
+    re-entry — a second ``enable()``, concurrent first observations from
+    two threads, a reimport under tests — is safe. The outer lock makes
+    check-then-construct atomic; construction takes the registry lock
+    nested inside it (never the reverse), so there is no lock cycle."""
+    with _GET_OR_CREATE_LOCK:
+        with _registry.lock:
+            existing = _registry.metrics.get(name)
+        if existing is not None and type(existing) is kind:
+            return existing
+        return kind(name, description, **kwargs)
+
+
 def _label_key(labels: Optional[Dict[str, str]]) -> Tuple:
     return tuple(sorted((labels or {}).items()))
 

@@ -4,6 +4,13 @@ Reference analog: ``ray.timeline()`` (``_private/state.py:865``) — dump task
 execution spans as a Chrome ``chrome://tracing`` / Perfetto JSON file. Spans
 come from the per-state transition times the raylets report to the GCS task
 store (PENDING -> RUNNING -> FINISHED/FAILED).
+
+Step-profiler records (``util/step_profiler.py``) live in the same store
+and export as their own lanes in the same file: each step is a ``step``
+category span on a ``step:<kind>`` track, with ``compile`` and ``sync``
+sub-spans marking the first-call compile time and the post-dispatch
+host-sync stall — so the train/decode breakdown lines up against the task
+lanes in one Perfetto view.
 """
 
 from __future__ import annotations
@@ -17,9 +24,14 @@ import ray_tpu
 def timeline(filename: Optional[str] = None) -> List[Dict[str, Any]]:
     """Build (and optionally write) Chrome trace events for recent tasks."""
     backend = ray_tpu.global_worker()._require_backend()
-    events = backend.io.run(backend._gcs.call("list_tasks", {"limit": 10000}))
+    events = backend.io.run(backend._gcs.call(
+        "list_tasks", {"limit": 10000, "profile": "include"}))
     trace: List[Dict[str, Any]] = []
     for ev in events:
+        prof = ev.get("profile")
+        if prof:
+            trace.extend(_step_lanes(ev, prof))
+            continue
         times = ev.get("times", {})
         start = times.get("RUNNING") or times.get("PENDING")
         end = times.get("FINISHED") or times.get("FAILED")
@@ -51,3 +63,34 @@ def timeline(filename: Optional[str] = None) -> List[Dict[str, Any]]:
         with open(filename, "w") as f:
             json.dump(trace, f)
     return trace
+
+
+def _step_lanes(ev: Dict[str, Any], prof: Dict[str, Any]
+                ) -> List[Dict[str, Any]]:
+    """One step record -> its Perfetto lanes: the full step span plus
+    compile (front of the span) and sync (tail: the post-dispatch device
+    stall) sub-spans where nonzero."""
+    pid = ev.get("node_id") or "node"
+    tid = f"step:{prof.get('kind', 'step')}"
+    ts = prof["t_start"] * 1e6
+    wall = max(0.0, prof.get("wall_s", 0.0)) * 1e6
+    out = [{
+        "name": ev.get("name") or prof.get("kind", "step"),
+        "cat": "step", "ph": "X", "ts": ts, "dur": wall,
+        "pid": pid, "tid": tid,
+        "args": {"step": prof.get("step"), "tokens": prof.get("tokens"),
+                 "tokens_per_s": prof.get("tokens_per_s"),
+                 "mfu": prof.get("mfu"),
+                 "launches": prof.get("launches")},
+    }]
+    compile_s = prof.get("compile_s") or 0.0
+    if compile_s > 0:
+        out.append({"name": "compile", "cat": "compile", "ph": "X",
+                    "ts": ts, "dur": compile_s * 1e6,
+                    "pid": pid, "tid": tid})
+    sync_s = prof.get("execute_s") or 0.0
+    if sync_s > 0:
+        out.append({"name": "sync", "cat": "sync", "ph": "X",
+                    "ts": ts + wall - sync_s * 1e6, "dur": sync_s * 1e6,
+                    "pid": pid, "tid": tid})
+    return out

@@ -202,3 +202,35 @@ def test_venv_hermetic_interpreter(rt_cluster, tmp_path):
     exe2, leaked = ray_tpu.get(plain.remote())
     assert "/venvs/" not in exe2
     assert not leaked, "venv deps leaked into the base interpreter"
+
+
+def test_ensure_venv_lock_is_per_hash(tmp_path, monkeypatch):
+    """One slow env build must not serialize creation of a DIFFERENT env
+    (ADVICE r5: the old global lock made unrelated envs time out in the
+    worker pool behind one pip install)."""
+    import os
+    import threading
+    import time
+
+    from ray_tpu.runtime_env import runtime_env as RE
+
+    assert RE._venv_lock("aaa") is RE._venv_lock("aaa")
+    assert RE._venv_lock("aaa") is not RE._venv_lock("bbb")
+
+    def fake_create(venv_dir, py, wire):
+        if wire["hash"] == "slow":
+            time.sleep(1.5)
+        os.makedirs(os.path.dirname(py), exist_ok=True)
+        open(py, "w").close()
+        return py
+
+    monkeypatch.setattr(RE, "_create_venv", fake_create)
+    t = threading.Thread(target=RE.ensure_venv,
+                         args=({"hash": "slow"}, str(tmp_path)))
+    t.start()
+    time.sleep(0.1)  # the slow build now holds ITS lock
+    t0 = time.perf_counter()
+    py = RE.ensure_venv({"hash": "fast"}, str(tmp_path))
+    assert time.perf_counter() - t0 < 1.0  # did not queue behind "slow"
+    assert os.path.exists(py)
+    t.join()

@@ -152,3 +152,46 @@ def test_grpc_proxy_end_to_end(serve_cluster):
 
     with _pytest.raises(grpc.RpcError):
         grpc_request(addr, "nope", 1)
+
+
+def test_async_stream_pump_cancel_full_queue_no_leak():
+    """close() with a FULL bounded queue and no consumer: the old pump
+    stored CancelledError as the stream error and then awaited put(DONE)
+    forever (ADVICE r5). The fixed pump re-raises cancellation and lands
+    DONE via put_nowait, so the task terminates."""
+    import asyncio
+
+    from ray_tpu.serve.replica import _AsyncStreamPump
+
+    async def main():
+        finalized = {"aclose": False}
+
+        async def agen():
+            try:
+                i = 0
+                while True:
+                    yield i
+                    i += 1
+            finally:
+                finalized["aclose"] = True
+
+        pump = _AsyncStreamPump(agen(), maxsize=4)
+        items, done = await pump.take(2)
+        assert items and not done
+        await asyncio.sleep(0.05)  # producer refills the bound and blocks
+        assert pump._queue.full()
+        pump.close()  # consumer gone: cancel with the queue still full
+        await asyncio.wait_for(
+            asyncio.gather(pump._task, return_exceptions=True), 2.0)
+        assert pump._task.done()
+        assert pump._error is None  # cancellation is NOT a stream error
+        # DONE is reachable for a late pull: it terminates instead of
+        # blocking on a wedged stream
+        _, done = await asyncio.wait_for(pump.take(100), 2.0)
+        assert done
+        deadline = asyncio.get_running_loop().time() + 2.0
+        while not finalized["aclose"]:
+            assert asyncio.get_running_loop().time() < deadline
+            await asyncio.sleep(0.01)
+
+    asyncio.run(main())

@@ -1,0 +1,52 @@
+"""Self-check for the slow-gate rotation (tests/conftest.py).
+
+The rotation escapes ``-m "not slow"`` by rewriting ``item.own_markers``
+during collection — a pytest-internals dependency that could silently die
+on a pytest upgrade, selecting ZERO slow gates with no failure signal
+(ADVICE round 5). This test collects a subset of the slow-marked files in
+a subprocess under a pinned rotation key and asserts the rotation really
+selects gates."""
+
+import os
+import subprocess
+import sys
+
+# A handful of files that carry slow gates — enough items for the hash
+# bucketing to select from, small enough to collect in a few seconds.
+_SLOW_FILES = [
+    "tests/test_rl.py",
+    "tests/test_rl_extras.py",
+    "tests/test_rl_new_algos.py",
+    "tests/test_multi_agent.py",
+    "tests/test_tuned_examples.py",
+    "tests/test_serve.py",
+]
+
+
+def _collect(marker: str, env_extra):
+    env = dict(os.environ)
+    env.pop("RT_SLOW_ROTATION", None)
+    env.pop("RT_SLOW_ROTATION_KEY", None)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env.update(env_extra)
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, "-m", "pytest", "--collect-only", "-q",
+         "-m", marker, "-p", "no:cacheprovider", *_SLOW_FILES],
+        cwd=root, env=env, capture_output=True, text=True, timeout=240)
+    assert proc.returncode in (0, 5), proc.stdout + proc.stderr
+    return [ln for ln in proc.stdout.splitlines() if "::" in ln]
+
+
+def test_rotation_selects_gates_under_pinned_key():
+    # a dead rotation selects ZERO — the silent failure this check exists
+    # to catch; a healthy one selects a strict subset of the ~8 slow gates
+    # these files carry (the selection itself proves the marker rewrite
+    # worked: `-m slow_rotation` only matches items whose `slow` marker
+    # was swapped out during collection)
+    rotated = _collect("slow_rotation", {"RT_SLOW_ROTATION_KEY": "rot-a"})
+    assert 1 <= len(rotated) <= 7, rotated
+
+
+def test_rotation_disable_flag():
+    assert _collect("slow_rotation", {"RT_SLOW_ROTATION": "0"}) == []
