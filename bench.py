@@ -434,6 +434,30 @@ def _rl_main() -> None:
     print("RLBENCH=" + json.dumps(out))
 
 
+def _preserve(payload: dict, path: str = "") -> None:
+    """Self-preservation (VERDICT r5 #1): write/refresh the on-chip
+    artifact IMMEDIATELY after every successful phase, so a later wedge,
+    timeout, or CPU fallback can never forfeit numbers already measured.
+    Atomic tmp+rename; target comes from RT_BENCH_PRESERVE (the watchdog
+    sets it only when the probed platform is the real chip) or an explicit
+    ``path`` (the watchdog's own end-of-phase refreshes)."""
+    path = path or os.environ.get("RT_BENCH_PRESERVE", "")
+    if not path:
+        return
+    try:
+        payload = dict(payload)
+        payload["preserved_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                                time.gmtime())
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(payload, f, indent=1)
+        os.replace(tmp, path)
+    except Exception as e:  # noqa: BLE001 — preservation never fails a run
+        import sys
+
+        print(f"bench: preserve failed: {e!r}", file=sys.stderr)
+
+
 def _run_phase(env_var: str, prefix: str, timeout: float,
                env: dict | None = None, extra_env: dict | None = None):
     """Run this script as a subprocess phase (env_var set), parse its
@@ -877,6 +901,8 @@ def _inner_main() -> None:
                   file=sys.stderr)
             continue
         sweeps.append(((preset, batch, seq, attn, chunk, dtype), res))
+        _preserve({"stage": "sweep", "ladder": [s[1] for s in sweeps],
+                   "fallback_errors": list(errors)})
         if len(sweeps) == 2:
             break
     if not sweeps:
@@ -957,6 +983,7 @@ def _inner_main() -> None:
                 (1 - tr_disp / raw_disp) * 100, 2)
     if errors:
         details["fallback_errors"] = errors
+    _preserve({"stage": "through_train", "details": dict(details)})
 
     # Phase 3 — decode: bf16 KV-cache generate on the chip (VERDICT r4 #8).
     decode_cfg = json.dumps({
@@ -971,6 +998,7 @@ def _inner_main() -> None:
                      extra_env={"RT_BENCH_DECODE_CFG": decode_cfg})
     if dec:
         details.update(dec)
+        _preserve({"stage": "decode", "details": dict(details)})
 
     from ray_tpu.models import llama as _llama
 
@@ -1168,21 +1196,46 @@ def main() -> None:
 
     flags_env = apply_tpu_perf_flags(dict(os.environ))
 
+    preserve_path = os.path.join(_REPO_ROOT, "BENCH_TPU_MEASURED_r06.json")
+
+    def _native_env(probe_env, platform, hbm):
+        env = dict(probe_env)
+        env["RT_BENCH_PLATFORM"] = platform
+        if hbm:
+            env["RT_BENCH_HBM_BYTES"] = hbm
+        if platform == "tpu":
+            # self-preservation: every successful on-chip phase refreshes
+            # this artifact immediately (VERDICT r5 #1)
+            env["RT_BENCH_PRESERVE"] = preserve_path
+        return env
+
     result, fallback_reason = None, None
     platform, probe_env, hbm = _probe_backend_with_retries(flags_env)
     if platform is None:
         fallback_reason = "native jax backend init failed or hung (3 tries)"
     else:
-        env = dict(probe_env)
-        env["RT_BENCH_PLATFORM"] = platform
-        if hbm:
-            env["RT_BENCH_HBM_BYTES"] = hbm
+        env = _native_env(probe_env, platform, hbm)
         # Budget > worst-case sum of the inner phases' own subprocess
         # timeouts (2 sweeps x 400 + train 420 + decode 600 ≈ 1820s) so a
         # slow-but-succeeding TPU run is never killed into a CPU fallback.
         result = _run_inner(env, timeout=2400)
         if result is None:
             fallback_reason = f"bench on platform={platform} failed/timed out"
+            # Known tunnel failure mode: the backend WEDGES mid-run
+            # (jax.devices()/compiles hang). Before forfeiting the chip to
+            # a CPU fallback, re-probe with the bounded retry/backoff
+            # ladder and give the native path one more shot.
+            print("bench: re-probing a possibly wedged backend before "
+                  "any CPU fallback", file=sys.stderr)
+            platform2, probe_env2, hbm2 = _probe_backend_with_retries(
+                flags_env)
+            if platform2 is not None:
+                platform, probe_env, hbm = platform2, probe_env2, hbm2
+                result = _run_inner(
+                    _native_env(probe_env, platform, hbm), timeout=2400)
+                if result is None:
+                    fallback_reason = (f"bench on platform={platform} "
+                                       f"failed twice (wedge re-probe ok)")
 
     if result is None:
         print(f"bench: falling back to CPU — {fallback_reason}",
@@ -1214,23 +1267,38 @@ def main() -> None:
         serve_extra = {"RT_BENCH_SERVE_PRESET": "debug",
                        "RT_BENCH_SERVE_DTYPE": "fp32"}
 
+    # Preserve only a REAL on-chip result: the synthetic all-paths-failed
+    # dict (details.error) must never clobber an artifact holding numbers a
+    # partially-successful inner run already preserved.
+    on_chip = (platform == "tpu"
+               and "platform_fallback" not in result.get("details", {})
+               and "error" not in result.get("details", {}))
+    if on_chip:
+        _preserve(dict(result), path=preserve_path)
+
     # RL phase — the other half of the north-star metric (BASELINE.md
     # config 4). Informative: never blocks or degrades the headline number.
     rl = _run_phase("RT_BENCH_RL", "RLBENCH", timeout=480, env=phase_env)
     if rl:
         result.setdefault("details", {}).update(rl)
+        if on_chip:
+            _preserve(dict(result), path=preserve_path)
 
     # Serve phase — BASELINE.md config 5. Informative, best-effort.
     sv = _run_phase("RT_BENCH_SERVE", "SERVEBENCH", timeout=600,
                     env=phase_env, extra_env=serve_extra)
     if sv:
         result.setdefault("details", {}).update(sv)
+        if on_chip:
+            _preserve(dict(result), path=preserve_path)
 
     # Data-ingestion phase — host-side input pipeline throughput (always
     # CPU; the chip is not involved).
     db = _run_phase("RT_BENCH_DATA", "DATABENCH", timeout=300)
     if db:
         result.setdefault("details", {}).update(db)
+        if on_chip:
+            _preserve(dict(result), path=preserve_path)
 
     print(json.dumps(result))
 

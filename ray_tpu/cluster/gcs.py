@@ -326,6 +326,11 @@ class GcsServer:
         if "available" in p:
             entry.view.available = ResourceSet(p["available"])
         entry.queued_demands = p.get("queued_demands", [])
+        # scheduler queue telemetry: depth of the raylet's pending-task
+        # queue rides every heartbeat (feeds rt_raylet_queue_depth and the
+        # nodes listing — the number that explains a 255 s probe latency)
+        if "queue_depth" in p:
+            entry.queue_depth = p["queue_depth"]
         return {"ok": True, "resurrected": resurrected}
 
     async def rpc_cluster_load(self, p):
@@ -372,6 +377,7 @@ class GcsServer:
             "resources": n.view.total.to_dict(),
             "available": n.view.available.to_dict(),
             "labels": dict(n.view.labels),
+            "queue_depth": getattr(n, "queue_depth", 0),
         } for n in self.nodes.values()]
 
     async def rpc_drain_node(self, p):
@@ -1015,12 +1021,28 @@ class GcsServer:
         is_step = p.get("profile") is not None
         store = self.step_events if is_step else self.task_events
         cap = self._STEP_EVENTS_CAP if is_step else self._TASK_EVENTS_CAP
-        ev = store.pop(p["task_id"], None) or {}
+        ev = store.pop(p["task_id"], None)
+        if ev is None and p.get("state") is None:
+            # a phases-only partial for a task the FIFO already evicted:
+            # don't resurrect a skeleton row (and evict a live event)
+            return
+        ev = ev or {}
+        # Partial merges (a driver's phases-only update) omit state/node_id
+        # and must not clobber what the raylet recorded — a FAILED task
+        # stays FAILED and keeps its node.
         ev.update({"task_id": p["task_id"], "name": p.get("name", ev.get("name")),
-                   "state": p["state"], "node_id": p.get("node_id"),
+                   "state": p.get("state", ev.get("state")),
+                   "node_id": p.get("node_id", ev.get("node_id")),
                    "updated_at": time.time()})
         if p.get("trace") is not None:
             ev["trace"] = p["trace"]
+        # per-phase latency breakdown: the raylet, the executing worker and
+        # the driver each report the phases they own; the union accumulates
+        # on the one event (tracing.PHASE_ORDER documents the partition)
+        if p.get("phases"):
+            ev.setdefault("phases", {}).update(p["phases"])
+        if p.get("worker_source") is not None:
+            ev["worker_source"] = p["worker_source"]
         # step-profiler records ride the same store: a breakdown payload
         # plus caller-supplied span times (the profiler measured the real
         # start/end; server receive-time would misplace the lane)
@@ -1029,7 +1051,7 @@ class GcsServer:
         # per-state transition times feed ray_tpu.timeline()'s Chrome trace
         if p.get("times"):
             ev.setdefault("times", {}).update(p["times"])
-        else:
+        elif p.get("state"):
             ev.setdefault("times", {})[p["state"]] = time.time()
         store[p["task_id"]] = ev
         while len(store) > cap:

@@ -145,6 +145,38 @@ class Raylet:
         self._spill_exec = ThreadPoolExecutor(
             max_workers=2, thread_name_prefix="rt-spill")
         self._spill_lock = threading.Lock()
+        # Scheduler queue telemetry (reference: the raylet's
+        # scheduler_stats in GcsNodeManager reports): queue depth rides
+        # every heartbeat; per-dispatch queue wait feeds a histogram. Both
+        # series land on the Prometheus push — from THIS process's registry
+        # when no driver shares it (standalone node daemon), or via the
+        # driver's pusher in an in-process cluster. RT_QUEUE_TELEMETRY=0
+        # reduces the dispatch path to one predicate check.
+        self._telemetry = os.environ.get(
+            "RT_QUEUE_TELEMETRY", "1") not in ("", "0", "false")
+        self._tele_metrics: Optional[Dict[str, Any]] = None
+        self._tele_pushed = 0.0
+
+    _QUEUE_WAIT_BUCKETS = (0.001, 0.005, 0.02, 0.1, 0.5, 1.0, 5.0, 15.0,
+                           60.0, 300.0, 900.0)
+
+    def _telemetry_metrics(self) -> Dict[str, Any]:
+        if self._tele_metrics is None:
+            from ray_tpu.util import metrics as M
+
+            self._tele_metrics = {
+                "queue_depth": M.get_or_create(
+                    M.Gauge, "rt_raylet_queue_depth",
+                    "Pending tasks in the raylet dispatch queue",
+                    tag_keys=("node_id",)),
+                "queue_wait": M.get_or_create(
+                    M.Histogram, "rt_task_queue_wait_seconds",
+                    "Raylet queue wait per dispatched task "
+                    "(enqueue to dispatch claim)",
+                    boundaries=self._QUEUE_WAIT_BUCKETS,
+                    tag_keys=("node_id",)),
+            }
+        return self._tele_metrics
 
     # ---- lifecycle ----------------------------------------------------------
     async def start(self, port: int = 0) -> str:
@@ -202,6 +234,7 @@ class Raylet:
                 reply = await self._gcs.call("heartbeat", {
                     "node_id": self.node_id,
                     "available": self.node.available.to_dict(),
+                    "queue_depth": len(self._queue),
                     "queued_demands": [
                         {"resources": dict(k), "count": c}
                         for k, c in list(demands.items())[:20]]})
@@ -224,10 +257,43 @@ class Raylet:
                     spawn_task(self._reconcile_after_resurrection())
             except Exception:
                 pass
+            if self._telemetry:
+                await self._push_telemetry()
             if self._queue:
                 # periodic wake so waiting tasks re-evaluate spillback even
                 # when no local resource event fires
                 self._dispatch_event.set()
+
+    async def _push_telemetry(self) -> None:
+        """Queue-depth gauge + registry push. A standalone node daemon has
+        no driver metrics pusher, so the raylet ships its own registry
+        snapshot to the @metrics/ KV; when a driver shares this process
+        (in-process test cluster) its pusher covers the shared registry and
+        this path skips the write (double-pushed histograms would double
+        their counts in the merged Prometheus page)."""
+        import json as _json
+
+        try:
+            m = self._telemetry_metrics()
+            m["queue_depth"].set(len(self._queue),
+                                 {"node_id": self.node_id})
+            now = time.monotonic()
+            if now - self._tele_pushed < 5.0:
+                return
+            import ray_tpu
+            from ray_tpu.util import metrics as M
+
+            if ray_tpu.is_initialized():
+                self._tele_pushed = now
+                return  # the driver's pusher owns this registry
+            await self._gcs.call("kv_put", {
+                "key": f"{M._KV_PREFIX}raylet:{self.node_id}",
+                "value": _json.dumps({
+                    "t": time.time(),
+                    "metrics": M._registry.snapshot()}).encode()})
+            self._tele_pushed = now
+        except Exception:  # noqa: BLE001 — telemetry must never kill
+            pass  # the heartbeat loop
 
     # ---- worker pool --------------------------------------------------------
     def _spawn_worker(self, key: Tuple, chips: List[int],
@@ -274,8 +340,12 @@ class Raylet:
         return {"ok": True, "node_id": self.node_id}
 
     async def _get_worker(self, key: Tuple, chips: List[int],
-                          runtime_env: Optional[Dict] = None) -> _WorkerEntry:
-        """Idle worker or a new spawn — with spawn THROTTLING: at most
+                          runtime_env: Optional[Dict] = None
+                          ) -> Tuple[_WorkerEntry, str]:
+        """Returns ``(worker, source)`` with source "warm" (pool hit) or
+        "spawn" (fresh process) — the phase tracer's worker_acquire tag.
+
+        Idle worker or a new spawn — with spawn THROTTLING: at most
         ``_spawn_slots`` worker processes boot concurrently. A burst of N
         first-touch tasks must not fork N interpreters at once — on a
         small host the spawn stampede thrashes every boot past the startup
@@ -290,7 +360,7 @@ class Raylet:
                 entry = idle.pop()
                 if entry.proc.poll() is None:
                     entry.idle_since = None
-                    return entry
+                    return entry, "warm"
                 self._workers.pop(entry.worker_id, None)
             if self._spawn_slots > 0:
                 break
@@ -324,7 +394,7 @@ class Raylet:
                 entry.proc.kill()
                 self._workers.pop(entry.worker_id, None)
                 raise
-            return entry
+            return entry, "spawn"
         finally:
             self._spawn_slots += 1
 
@@ -666,25 +736,37 @@ class Raylet:
         # they ride the heartbeat's queued_demands — the signal the
         # autoscaler provisions against (reference: infeasible tasks stay
         # pending and drive resource_demand_scheduler).
-        self._queue.append({"payload": p, "future": fut,
-                            "t": time.monotonic(), "spilling": False})
+        item = {"payload": p, "future": fut,
+                "t": time.monotonic(), "spilling": False}
+        if p.get("trace") is not None:  # phase tracing: one predicate here
+            # separate stamp: spillback backoff resets item["t"], but the
+            # span's queue_wait must cover the full local wait
+            item["t_enq"] = item["t"]
+        self._queue.append(item)
         self._task_event(task_id, p.get("fn_name"), "PENDING",
                          trace=p.get("trace"))
         self._dispatch_event.set()
         return await asyncio.shield(fut)
 
     def _task_event(self, task_id: str, name, state: str,
-                    trace: "Optional[Dict]" = None) -> None:
+                    trace: "Optional[Dict]" = None,
+                    phases: "Optional[Dict]" = None,
+                    worker_source: Optional[str] = None) -> None:
         """Fire-and-forget state event to the GCS task store (reference:
         TaskEventBuffer -> GcsTaskManager); observability only, never blocks
         or fails the task path. ``trace`` carries the span context when the
-        submitter had tracing enabled."""
+        submitter had tracing enabled; ``phases`` the per-phase latency
+        breakdown this raylet measured for a traced task."""
         async def _send():
             try:
                 msg = {"task_id": task_id, "name": name, "state": state,
                        "node_id": self.node_id}
                 if trace is not None:
                     msg["trace"] = trace
+                if phases:
+                    msg["phases"] = phases
+                if worker_source is not None:
+                    msg["worker_source"] = worker_source
                 await self._gcs.call("task_event", msg)
             except Exception:
                 pass
@@ -795,6 +877,18 @@ class Raylet:
         task_id = payload["task_id"]
         chips = assignment.get(TPU, [])
         renv = payload.get("runtime_env")
+        t_claim = time.monotonic()
+        if self._telemetry:
+            self._telemetry_metrics()["queue_wait"].observe(
+                t_claim - item["t"], {"node_id": self.node_id})
+        # Phase tracing (one predicate when untraced): this raylet owns
+        # queue_wait / worker_acquire / transfer / sched_overhead; the
+        # worker's reply contributes arg_fetch / execute / result_store.
+        traced = payload.get("trace") is not None
+        t_enq = item.get("t_enq", item["t"])
+        phases: Optional[Dict[str, float]] = (
+            {"queue_wait": t_claim - t_enq} if traced else None)
+        source = None
         # worker reuse is keyed by (chip set, env hash): a process prepared
         # for one runtime env never executes another env's tasks (reference:
         # WorkerPool cache keyed by runtime-env hash)
@@ -803,18 +897,34 @@ class Raylet:
                                    "pool": pool}
         worker = None
         try:
-            worker = await self._get_worker(key, chips, renv)
+            worker, source = await self._get_worker(key, chips, renv)
             worker.busy = True
             worker.job_id = payload.get("job_id")
             self._task_event(task_id, payload.get("fn_name"), "RUNNING")
+            t_acq = time.monotonic()
             try:
                 reply = await worker.client.call("push_task", payload)
             finally:
                 self._release_worker(worker)
             failed = (reply.get("error")
                       or reply.get("stream_error") is not None)
+            if traced:
+                now = time.monotonic()
+                phases["worker_acquire"] = t_acq - t_claim
+                worker_phases = reply.pop("worker_phases", None) or {}
+                worker_total = sum(worker_phases.values())
+                phases.update(worker_phases)
+                # push RPC + marshalling around the worker's own span;
+                # also absorbs any raylet event-loop latency inside the
+                # push window (the queue side of that latency is already
+                # inside queue_wait)
+                phases["transfer"] = max(0.0, (now - t_acq) - worker_total)
+                reply["phases"] = phases
+                reply["phases_total"] = now - t_enq
+                reply["worker_source"] = source
             self._task_event(task_id, payload.get("fn_name"),
-                             "FAILED" if failed else "FINISHED")
+                             "FAILED" if failed else "FINISHED",
+                             phases=phases, worker_source=source)
             if not fut.done():
                 fut.set_result(reply)
         except Exception as e:  # worker crashed mid-task or failed to start

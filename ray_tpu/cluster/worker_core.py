@@ -65,6 +65,28 @@ def _trace_ctx():
     return tracing.context_for_submit()
 
 
+_phase_hist = None
+
+
+def _observe_phases(phases: Dict[str, float]) -> None:
+    """rt_task_phase_seconds{phase=...}: the Prometheus twin of the span's
+    phase table, observed in the owner process (whose metrics pusher is
+    live). Reached only for traced tasks — never on the untraced path."""
+    global _phase_hist
+    try:
+        from ray_tpu.util import metrics as M
+
+        if _phase_hist is None:
+            _phase_hist = M.get_or_create(
+                M.Histogram, "rt_task_phase_seconds",
+                "Per-phase task latency breakdown (traced tasks)",
+                tag_keys=("phase",))
+        for name, secs in phases.items():
+            _phase_hist.observe(secs, {"phase": name})
+    except Exception:  # noqa: BLE001 — observability never fails the task
+        pass
+
+
 
 
 class _MemoryStore:
@@ -619,7 +641,27 @@ class ClusterBackend(RuntimeBackend):
 
         payloads = self.io.run(_gather(), timeout=None if timeout is None
                                else timeout + 5.0)
-        return [self._deserialize_result(p) for p in payloads]
+        from ray_tpu.util import tracing
+
+        if not (tracing.enabled() or tracing.current_context() is not None):
+            return [self._deserialize_result(p) for p in payloads]
+        # driver_get phase: post-reply deserialization in the caller,
+        # attributed per producing task (return objects only — puts carry
+        # the high index bit and belong to no task span)
+        out: List[Any] = []
+        per_task: Dict[str, float] = {}
+        for r, p in zip(refs, payloads):
+            t0 = time.perf_counter()
+            out.append(self._deserialize_result(p))
+            oid = r.id()
+            if oid.index() < 0x80000000:
+                key = oid.task_id().hex()
+                per_task[key] = per_task.get(key, 0.0) \
+                    + (time.perf_counter() - t0)
+        for tid, secs in per_task.items():
+            _observe_phases({"driver_get": secs})
+            self.io.spawn(self._phase_event(tid, {"driver_get": secs}))
+        return out
 
     def _notify_blocked(self) -> None:
         """Inside a task, a blocking get returns the task's CPU to the raylet
@@ -824,7 +866,10 @@ class ClusterBackend(RuntimeBackend):
             "runtime_env": self._prepare_env(options),
             "trace": _trace_ctx(),
         }
-        self.io.spawn(self._submit_and_collect(payload, refs))
+        from ray_tpu.util import tracing
+
+        self.io.spawn(self._submit_and_collect(
+            payload, refs, t_entry=tracing.take_submit_entry()))
         return refs[0] if num_returns == 1 else refs
 
     def _submit_streaming(self, fn, options, args, kwargs, req, strategy,
@@ -849,6 +894,7 @@ class ClusterBackend(RuntimeBackend):
             "owner": self.address,
             "max_retries": 0,  # raylet-side dedup off; owner drives retries
             "runtime_env": self._prepare_env(options),
+            "trace": _trace_ctx(),  # span + phases land via the raylet
         }
 
         async def _run():
@@ -897,10 +943,14 @@ class ClusterBackend(RuntimeBackend):
         self.io.spawn(_run())
         return ObjectRefGenerator(self, state)
 
-    async def _submit_and_collect(self, payload, refs: List[ObjectRef]) -> None:
+    async def _submit_and_collect(self, payload, refs: List[ObjectRef],
+                                  t_entry: Optional[float] = None) -> None:
         retries = payload.get("max_retries", 0)
         attempt = 0
+        traced = payload.get("trace") is not None  # one predicate per hop
         while True:
+            t_sub = (t_entry if attempt == 0 and t_entry is not None
+                     else time.perf_counter()) if traced else 0.0
             try:
                 target = self._raylet
                 if payload.get("pg") is not None:
@@ -918,7 +968,34 @@ class ClusterBackend(RuntimeBackend):
                     attempt += 1
                     continue
             break
+        if traced and reply.get("phases") is not None:
+            # FINAL attempt only — a retried attempt's partial phases must
+            # not double-count the task in the histogram or pollute the
+            # event's merged breakdown. submit = driver-side residual of
+            # this attempt's wall around the raylet's accounted interval
+            # (serialization + both RPC directions); completes the
+            # partition.
+            reply["phases"]["submit"] = max(
+                0.0, (time.perf_counter() - t_sub)
+                - reply.get("phases_total", 0.0))
+            _observe_phases(reply["phases"])
+            spawn_task(self._phase_event(
+                payload["task_id"],
+                {"submit": reply["phases"]["submit"]}))
         self._apply_task_reply(reply, refs, payload["fn_name"], payload)
+
+    async def _phase_event(self, task_id_hex: str,
+                           phases: Dict[str, float]) -> None:
+        """Merge driver-measured phases (submit, driver_get) into the
+        task's GCS event; best-effort, fire-and-forget. No state/node_id:
+        a partial merge must not flip what the raylet recorded (a FAILED
+        task stays FAILED)."""
+        try:
+            await self._gcs.call("task_event", {
+                "task_id": task_id_hex, "phases": phases,
+                "times": {"DRIVER": time.time()}}, timeout=10)
+        except Exception:  # noqa: BLE001
+            pass
 
     async def _pg_bundle_raylet(self, pg_info: Dict):
         """Resolve the raylet hosting the task's bundle. The address of a
@@ -1077,10 +1154,16 @@ class ClusterBackend(RuntimeBackend):
             "owner": self.address,
             "trace": _trace_ctx(),
         }
-        self.io.spawn(self._submit_actor_and_collect(payload, refs, method_name))
+        from ray_tpu.util import tracing
+
+        self.io.spawn(self._submit_actor_and_collect(
+            payload, refs, method_name,
+            t_entry=tracing.take_submit_entry()))
         return refs[0] if num_returns == 1 else refs
 
-    async def _submit_actor_and_collect(self, payload, refs, method_name) -> None:
+    async def _submit_actor_and_collect(self, payload, refs, method_name,
+                                        t_entry: Optional[float] = None
+                                        ) -> None:
         conn = self._actor_conn(payload["actor_id"])
         # Delivery semantics (reference parity, actor.py:333-352): connection
         # failures BEFORE the call is written are always safe to retry; once
@@ -1117,9 +1200,26 @@ class ClusterBackend(RuntimeBackend):
                                                  "unreachable") from None
                         await asyncio.sleep(get_config().actor_restart_backoff_s)
                         continue
+                    t_sub = 0.0
+                    if payload.get("trace") is not None:
+                        t_sub = (t_entry if t_entry is not None
+                                 else time.perf_counter())
+                        t_entry = None  # retries re-stamp from now
                     fut = asyncio.ensure_future(
                         client.call("actor_call", payload))
                 reply = await fut
+                worker_phases = reply.pop("worker_phases", None)
+                if payload.get("trace") is not None and worker_phases:
+                    # actor calls bypass the raylet: the partition is just
+                    # worker-side phases + the driver's submit residual
+                    phases = dict(worker_phases)
+                    phases["submit"] = max(
+                        0.0, (time.perf_counter() - t_sub)
+                        - sum(worker_phases.values()))
+                    reply["phases"] = phases
+                    _observe_phases(phases)
+                    spawn_task(self._phase_event(
+                        payload["task_id"], {"submit": phases["submit"]}))
                 self._apply_task_reply(reply, refs, method_name)
                 return
             except (ActorDiedError, ActorUnschedulableError) as e:

@@ -159,6 +159,8 @@ class WorkerProcess:
                                           self._execute_task_sync, p)
 
     def _execute_task_sync(self, p) -> Dict:
+        import time as _time
+
         from ray_tpu.core.worker import global_worker
 
         task_id = TaskID.from_hex(p["task_id"])
@@ -170,27 +172,64 @@ class WorkerProcess:
         streaming = p["num_returns"] == "streaming"
         from ray_tpu.util import tracing
 
+        traced = p.get("trace") is not None  # phase stamps ride the span
         trace_token = tracing.activate(p.get("trace"))
+        t0 = t1 = t2 = 0.0
+
+        def _failure_phases() -> Dict[str, float]:
+            # best-effort phases for a raised task: whatever stamps exist
+            # (a missing breakdown would make the raylet book the whole
+            # execution as "transfer" and misdirect the investigation)
+            now = _time.perf_counter()
+            wp = {"arg_fetch": (t1 or now) - t0}
+            if t1:
+                wp["execute"] = (t2 or now) - t1
+            return wp
+
         try:
+            t0 = _time.perf_counter() if traced else 0.0
             fn = self.backend.load_function(p["fn_id"])
             args, kwargs = self._resolve_args(p["args"], p["kwargs"])
+            t1 = _time.perf_counter() if traced else 0.0
             result = fn(*args, **kwargs)
+            t2 = _time.perf_counter() if traced else 0.0
             if streaming:
-                return self._stream_results(result, task_id, p)
+                reply = self._stream_results(result, task_id, p)
+                if traced:
+                    # execute covers driving the generator (production +
+                    # per-item pushes); items store as they stream, so
+                    # there is no separate result_store phase
+                    reply["worker_phases"] = {
+                        "arg_fetch": t1 - t0,
+                        "execute": _time.perf_counter() - t2}
+                return reply
             returns = self._pack_returns(result, task_id, p["num_returns"])
-            return {"returns": returns}
+            reply = {"returns": returns}
+            if traced:
+                reply["worker_phases"] = {
+                    "arg_fetch": t1 - t0, "execute": t2 - t1,
+                    "result_store": _time.perf_counter() - t2}
+            return reply
         except TaskError as e:
             if streaming:
-                return {"streaming_done": 0,
-                        "stream_error": self.backend.serde.serialize(e).to_bytes()}
-            return {"returns": self._error_returns(e, p["num_returns"])}
+                reply = {"streaming_done": 0,
+                         "stream_error": self.backend.serde.serialize(e).to_bytes()}
+            else:
+                reply = {"returns": self._error_returns(e, p["num_returns"])}
+            if traced:
+                reply["worker_phases"] = _failure_phases()
+            return reply
         except BaseException as e:  # noqa: BLE001
             traceback.print_exc()
             err = TaskError(p["fn_name"], e)
             if streaming:
-                return {"streaming_done": 0,
-                        "stream_error": self.backend.serde.serialize(err).to_bytes()}
-            return {"returns": self._error_returns(err, p["num_returns"])}
+                reply = {"streaming_done": 0,
+                         "stream_error": self.backend.serde.serialize(err).to_bytes()}
+            else:
+                reply = {"returns": self._error_returns(err, p["num_returns"])}
+            if traced:
+                reply["worker_phases"] = _failure_phases()
+            return reply
         finally:
             tracing.deactivate(trace_token)
             self.backend._current_task_id = None
@@ -312,7 +351,11 @@ class WorkerProcess:
         return self._actor_queues[group]
 
     async def rpc_actor_call(self, p):
+        import time as _time
+
         loop = asyncio.get_running_loop()
+        if p.get("trace") is not None:  # phase tracing: queue-wait stamp
+            p["_t_enq"] = _time.perf_counter()
         fut = loop.create_future()
         await self._queue_for(p["method"]).put(
             (self._run_actor_method(p), fut))
@@ -328,22 +371,33 @@ class WorkerProcess:
                 f"actor has no method {method_name!r}"))
             return {"returns": self._error_returns(err, p["num_returns"])}
         if inspect.iscoroutinefunction(method):
+            import time as _time
+
             from ray_tpu.util import tracing
 
+            traced = p.get("trace") is not None
             trace_token = tracing.activate(p.get("trace"))
-            if p.get("trace") is not None:
+            if traced:
                 self._emit_span_event(p, "RUNNING")
             try:
+                t0 = _time.perf_counter() if traced else 0.0
                 args, kwargs = await loop.run_in_executor(
                     self._actor_threads, self._resolve_args, p["args"], p["kwargs"])
+                t1 = _time.perf_counter() if traced else 0.0
                 result = await method(*args, **kwargs)
-                if p.get("trace") is not None:
-                    self._emit_span_event(p, "FINISHED")
-                return {"returns": await loop.run_in_executor(
+                t2 = _time.perf_counter() if traced else 0.0
+                returns = await loop.run_in_executor(
                     self._actor_threads, self._pack_returns, result, task_id,
-                    p["num_returns"])}
+                    p["num_returns"])
+                reply = {"returns": returns}
+                if traced:
+                    reply["worker_phases"] = self._actor_phases(
+                        p, t0, t1, t2, _time.perf_counter())
+                    self._emit_span_event(p, "FINISHED",
+                                          phases=reply["worker_phases"])
+                return reply
             except BaseException as e:  # noqa: BLE001
-                if p.get("trace") is not None:
+                if traced:
                     self._emit_span_event(p, "FAILED")
                 return {"returns": self._error_returns(
                     TaskError(method_name, e), p["num_returns"])}
@@ -353,6 +407,8 @@ class WorkerProcess:
             self._actor_threads, self._execute_actor_method_sync, p, method, task_id)
 
     def _execute_actor_method_sync(self, p, method, task_id: TaskID) -> Dict:
+        import time as _time
+
         from ray_tpu.core.worker import global_worker
 
         from ray_tpu.util import tracing
@@ -360,19 +416,27 @@ class WorkerProcess:
         worker = global_worker()
         token = worker.enter_task_context(
             task_id, ActorID.from_hex(p["actor_id"]))
+        traced = p.get("trace") is not None
         trace_token = tracing.activate(p.get("trace"))
-        if p.get("trace") is not None:
+        if traced:
             self._emit_span_event(p, "RUNNING")
         try:
+            t0 = _time.perf_counter() if traced else 0.0
             args, kwargs = self._resolve_args(p["args"], p["kwargs"])
+            t1 = _time.perf_counter() if traced else 0.0
             result = method(*args, **kwargs)
-            if p.get("trace") is not None:
-                self._emit_span_event(p, "FINISHED")
-            return {"returns": self._pack_returns(result, task_id,
-                                                  p["num_returns"])}
+            t2 = _time.perf_counter() if traced else 0.0
+            reply = {"returns": self._pack_returns(result, task_id,
+                                                   p["num_returns"])}
+            if traced:
+                reply["worker_phases"] = self._actor_phases(
+                    p, t0, t1, t2, _time.perf_counter())
+                self._emit_span_event(p, "FINISHED",
+                                      phases=reply["worker_phases"])
+            return reply
         except BaseException as e:  # noqa: BLE001
             traceback.print_exc()
-            if p.get("trace") is not None:
+            if traced:
                 self._emit_span_event(p, "FAILED")
             return {"returns": self._error_returns(
                 TaskError(p["method"], e), p["num_returns"])}
@@ -380,18 +444,36 @@ class WorkerProcess:
             tracing.deactivate(trace_token)
             worker.exit_task_context(token)
 
-    def _emit_span_event(self, p, state: str) -> None:
+    @staticmethod
+    def _actor_phases(p, t0: float, t1: float, t2: float,
+                      t3: float) -> Dict[str, float]:
+        """Actor-call phase partition: actor calls bypass the raylet, so
+        queue_wait here is the actor's own concurrency-group queue (stamped
+        at rpc_actor_call enqueue)."""
+        phases = {"arg_fetch": t1 - t0, "execute": t2 - t1,
+                  "result_store": t3 - t2}
+        t_enq = p.get("_t_enq")
+        if t_enq is not None:
+            phases["queue_wait"] = max(0.0, t0 - t_enq)
+        return phases
+
+    def _emit_span_event(self, p, state: str,
+                         phases: Optional[Dict] = None) -> None:
         """Actor-call spans: actor calls bypass the raylet (direct
         worker->worker), so the executing worker reports the task event the
-        raylet would have (tracing + timeline coverage for actor methods)."""
+        raylet would have (tracing + timeline coverage for actor methods);
+        ``phases`` carries the per-phase breakdown on FINISHED."""
         async def _send():
             try:
-                await self.backend._gcs.call("task_event", {
+                msg = {
                     "task_id": p["task_id"],
                     "name": f"{type(self._actor_instance).__name__}."
                             f"{p['method']}",
                     "state": state, "node_id": os.environ["RT_NODE_ID"],
-                    "trace": p.get("trace")})
+                    "trace": p.get("trace")}
+                if phases:
+                    msg["phases"] = phases
+                await self.backend._gcs.call("task_event", msg)
             except Exception:
                 pass
 

@@ -127,6 +127,11 @@ class Worker:
 
     # -- control plane -------------------------------------------------------
     def submit_task(self, fn, options: Dict, args: Tuple, kwargs: Dict):
+        from ray_tpu.util import tracing
+
+        # phase tracing: stamp the submit entry so the span's ``submit``
+        # phase covers arg serialization (no-op predicate when untraced)
+        tracing.mark_submit_entry()
         return self._require_backend().submit_task(fn, options, args, kwargs)
 
     def create_actor(self, cls, options: Dict, args: Tuple, kwargs: Dict,
@@ -136,6 +141,9 @@ class Worker:
 
     def submit_actor_task(self, actor_id: ActorID, method_name: str, args, kwargs,
                           num_returns: int = 1):
+        from ray_tpu.util import tracing
+
+        tracing.mark_submit_entry()
         return self._require_backend().submit_actor_task(
             actor_id, method_name, args, kwargs, num_returns)
 

@@ -82,7 +82,8 @@ header button {
   transition: width .4s;
 }
 /* step-breakdown stacked bar: compile/dispatch/device-sync share of one
-   step's wall time; 2px surface gaps separate the fills */
+   step's wall time; 2px surface gaps separate the fills. The tasks tab
+   reuses the track for the per-phase task breakdown. */
 .bk-track {
   display: flex; gap: 2px; width: 140px; height: 8px;
   border-radius: 4px; overflow: hidden;
@@ -92,6 +93,13 @@ header button {
 .bk-compile { background: var(--series-2); }
 .bk-dispatch { background: var(--series-3); }
 .bk-sync { background: var(--series-1); }
+/* task phase colors: wait-ish phases warm, work-ish phases cool */
+.ph-queue_wait { background: var(--warning); }
+.ph-worker_acquire { background: var(--serious); }
+.ph-execute { background: var(--series-1); }
+.ph-arg_fetch { background: var(--series-3); }
+.ph-result_store { background: var(--series-2); }
+.ph-other { background: var(--text-muted); }
 .legend { display: flex; gap: 14px; margin: 0 0 10px;
   font-size: 12px; color: var(--text-secondary); }
 .legend .chip { display: inline-block; width: 9px; height: 9px;
@@ -235,6 +243,7 @@ const COLS = {
     ["Total", r => `<td>${fmtRes(r.resources_total || r.resources)}</td>`],
     ["Available", r => `<td>${fmtRes(r.resources_available
                                      || r.available)}</td>`],
+    ["Queued", r => `<td>${esc(r.queue_depth ?? "")}</td>`],
   ],
   actors: [
     ["Actor", r => `<td class="id">${esc(r.actor_id)}</td>`],
@@ -268,6 +277,9 @@ const COLS = {
       return `<td>${end && start
         ? ((end - start).toFixed(2) + "s") : ""}</td>`;
     }],
+    ["Queue ms", r => `<td>${r.phases
+      ? ms(r.phases.queue_wait) : ""}</td>`],
+    ["Phases", r => `<td>${phaseBar(r)}</td>`],
   ],
   objects: [
     ["Object", r => `<td class="id">${esc(r.object_id)}</td>`],
@@ -310,6 +322,38 @@ const STEP_LEGEND = `<div class="legend">` +
   `<span><span class="chip bk-compile"></span>compile</span>` +
   `<span><span class="chip bk-dispatch"></span>dispatch</span>` +
   `<span><span class="chip bk-sync"></span>device sync</span></div>`;
+
+// task-lifecycle phase drill-down (traced tasks; util/tracing.PHASE_ORDER)
+const PHASE_ORDER = ["submit", "queue_wait", "worker_acquire", "transfer",
+  "arg_fetch", "execute", "result_store", "driver_get"];
+const PHASE_CLASS = {queue_wait: "ph-queue_wait",
+  worker_acquire: "ph-worker_acquire", execute: "ph-execute",
+  arg_fetch: "ph-arg_fetch", result_store: "ph-result_store"};
+function phaseBar(r) {
+  const p = r.phases;
+  if (!p) return "";
+  const keys = PHASE_ORDER.filter(k => p[k] > 0)
+    .concat(Object.keys(p).filter(k => !PHASE_ORDER.includes(k)));
+  const total = keys.reduce((a, k) => a + (p[k] || 0), 0);
+  if (!total) return "";
+  const segs = keys.map(k => {
+    const pct = Math.max(0, Math.min(100, 100 * p[k] / total));
+    const src = k === "worker_acquire" && r.worker_source
+      ? ` (${r.worker_source})` : "";
+    return pct < 0.5 ? "" :
+      `<div class="bk-seg ${PHASE_CLASS[k] || "ph-other"}"` +
+      ` style="width:${pct.toFixed(1)}%"` +
+      ` title="${esc(k)}${esc(src)} ${ms(p[k])}ms"></div>`;
+  });
+  return `<div class="bk-track">${segs.join("")}</div>`;
+}
+const PHASE_LEGEND = `<div class="legend">` +
+  `<span><span class="chip ph-queue_wait"></span>queue wait</span>` +
+  `<span><span class="chip ph-worker_acquire"></span>worker acquire</span>` +
+  `<span><span class="chip ph-arg_fetch"></span>arg fetch</span>` +
+  `<span><span class="chip ph-execute"></span>execute</span>` +
+  `<span><span class="chip ph-result_store"></span>result store</span>` +
+  `<span><span class="chip ph-other"></span>other</span></div>`;
 
 function renderTiles() {
   const res = data.resources || {};
@@ -443,7 +487,9 @@ function renderTable() {
       : `<div class="empty">no ${esc(active)} yet</div>`;
     return;
   }
-  el.innerHTML = (active === "steps" ? STEP_LEGEND : "") + `<table><tr>` +
+  el.innerHTML = (active === "steps" ? STEP_LEGEND
+    : active === "tasks" && rows.some(r => r.phases) ? PHASE_LEGEND
+    : "") + `<table><tr>` +
     cols.map(c => `<th>${esc(c[0])}</th>`).join("") + `</tr>` +
     rows.map(r => {
       const id = active === "actors" ? r.actor_id : null;

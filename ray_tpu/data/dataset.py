@@ -69,9 +69,10 @@ class Dataset:
 
     def __getstate__(self):
         # the split cache holds actor handles + a cycle back to this dataset;
-        # never ship it with the plan
+        # never ship it with the plan (nor process-local execution stats)
         state = dict(self.__dict__)
         state["_stream_splits"] = {}
+        state.pop("_last_stats", None)
         return state
 
     # ---- execution ----
@@ -101,7 +102,7 @@ class Dataset:
             yield from inflight.popleft()
 
     def _execute_refs(self) -> Iterator:
-        from ray_tpu.data.executor import execute_streaming
+        from ray_tpu.data.executor import ExecutionStats, execute_streaming
 
         ctx = DataContext.get_current()
         ops = self._ops
@@ -112,7 +113,11 @@ class Dataset:
             read_tasks, ops, _ = optimize(self._read_tasks, self._ops)
             if read_tasks is not self._read_tasks:
                 src = Dataset(read_tasks, [])
-        return execute_streaming(src._source_refs(), ops, ctx)
+        # per-operator accounting of this (the most recent) execution —
+        # the backing store of ``stats()``
+        self._last_stats = ExecutionStats()
+        return execute_streaming(src._source_refs(), ops, ctx,
+                                 stats=self._last_stats)
 
     def explain(self) -> str:
         """Before/after logical plan with the optimizer rules applied
@@ -323,8 +328,18 @@ class Dataset:
         return self._write(path, "tfrecords")
 
     def stats(self) -> str:
-        n = self.count()
-        return f"Dataset(rows={n}, ops={len(self._ops)})"
+        """Per-operator execution report of the MOST RECENT execution of
+        this dataset (reference: ``Dataset.stats()`` / DatasetStats): wall
+        time, blocks, rows/bytes (map stages), submitted task counts, and
+        backpressure events. Consume the dataset first — ``stats()`` never
+        triggers an execution itself."""
+        stats = getattr(self, "_last_stats", None)
+        if stats is None or not stats.entries:
+            return (f"Dataset(read_tasks={len(self._read_tasks)}, "
+                    f"ops={len(self._ops)}) — not executed yet; consume "
+                    f"it (iterate / materialize / count) then call "
+                    f".stats()")
+        return stats.to_string()
 
     def __repr__(self) -> str:
         return (f"Dataset(read_tasks={len(self._read_tasks)}, "

@@ -16,6 +16,7 @@ tasks, and stream their outputs.
 from __future__ import annotations
 
 import collections
+import time
 from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
 
 import numpy as np
@@ -148,11 +149,101 @@ class _MapActor:
 
 
 # ---------------------------------------------------------------------------
+# Execution statistics (reference: ``data/_internal/stats.py`` DatasetStats —
+# the per-operator accounting behind ``Dataset.stats()``)
+# ---------------------------------------------------------------------------
+
+
+class ExecutionStats:
+    """Per-operator accounting of one streaming execution.
+
+    Each operator entry holds gross wall time (time spent inside that
+    stage's iterator, which INCLUDES its upstream — streaming pulls nest),
+    block/row/byte counts, and any stage-specific counters (submitted
+    tasks, backpressure events). ``summary()`` nets out the nesting so the
+    per-operator walls are additive."""
+
+    def __init__(self):
+        self.entries: List[Dict[str, Any]] = []
+        self.started_at = time.time()
+
+    def new_entry(self, operator: str,
+                  stage: Optional["Stage"] = None) -> Dict[str, Any]:
+        entry = {"operator": operator, "wall_s": 0.0, "blocks": 0,
+                 "stage": stage}
+        self.entries.append(entry)
+        return entry
+
+    def summary(self) -> List[Dict[str, Any]]:
+        # Close the books lazily: stages with deferred accounting (map
+        # stages waiting on straggler metadata refs) settle only when
+        # stats are actually read — never on the streaming hot path.
+        for e in self.entries:
+            stage = e.get("stage")
+            if hasattr(stage, "finalize_stats"):
+                stage.finalize_stats()
+        out: List[Dict[str, Any]] = []
+        prev_gross = 0.0
+        for e in self.entries:
+            row = {"operator": e["operator"], "blocks": e["blocks"],
+                   "wall_s": max(0.0, e["wall_s"] - prev_gross),
+                   "gross_s": e["wall_s"]}
+            prev_gross = e["wall_s"]
+            stage = e.get("stage")
+            stats = getattr(stage, "stats", None)
+            if stats:
+                for k in ("submitted", "rows", "bytes",
+                          "backpressure_events"):
+                    if k in stats:
+                        row[k] = stats[k]
+            out.append(row)
+        return out
+
+    def to_string(self) -> str:
+        rows = self.summary()
+        if not rows:
+            return "(no execution recorded)"
+        total = rows[-1]["gross_s"] if rows else 0.0
+        lines = [f"Execution: {len(rows)} operator(s), "
+                 f"{total:.3f}s total wall"]
+        for i, r in enumerate(rows):
+            parts = [f"{r['blocks']} block(s)", f"{r['wall_s']:.3f}s wall"]
+            if r.get("rows"):
+                parts.append(f"{r['rows']} rows")
+            if r.get("bytes"):
+                parts.append(f"{r['bytes'] / 1e6:.2f} MB")
+            if r.get("submitted") is not None:
+                parts.append(f"{r['submitted']} task(s)")
+            if r.get("backpressure_events"):
+                parts.append(
+                    f"{r['backpressure_events']} backpressure event(s)")
+            lines.append(f"Operator {i} {r['operator']}: "
+                         + ", ".join(parts))
+        return "\n".join(lines)
+
+
+def _instrumented(it: Iterator, entry: Dict[str, Any]) -> Iterator:
+    """Wrap a stage's output iterator with wall/block accounting."""
+    while True:
+        t0 = time.perf_counter()
+        try:
+            item = next(it)
+        except StopIteration:
+            entry["wall_s"] += time.perf_counter() - t0
+            return
+        entry["wall_s"] += time.perf_counter() - t0
+        entry["blocks"] += 1
+        yield item
+
+
+# ---------------------------------------------------------------------------
 # Stages
 # ---------------------------------------------------------------------------
 
 
 class Stage:
+    label = "Stage"
+
     def run(self, upstream: Iterator, ctx) -> Iterator:
         raise NotImplementedError
 
@@ -169,12 +260,50 @@ class MapStage(Stage):
     consumer is throttled instead of buffering the whole dataset.
     """
 
-    def __init__(self, fns: List[Callable], options: Dict[str, Any]):
+    def __init__(self, fns: List[Callable], options: Dict[str, Any],
+                 label: str = "Map"):
         self.fns = fns
         self.options = options
+        self.label = label
         self.stats: Dict[str, Any] = {"submitted": 0, "completed_meta": 0,
-                                      "bytes_ewma": 0.0,
-                                      "backpressure_events": 0}
+                                      "bytes_ewma": 0.0, "rows": 0,
+                                      "bytes": 0, "backpressure_events": 0}
+        self._pending_meta: List = []
+
+    def _harvest_meta(self, block: bool = False) -> None:
+        """Fold completed metadata refs into the stats/EWMA. ``block``
+        waits (bounded) for stragglers — used only by ``finalize_stats``,
+        never on the streaming path."""
+        if not self._pending_meta:
+            return
+        try:
+            if block:  # failed tasks resolve metas with the error payload
+                ray_tpu.wait(self._pending_meta,
+                             num_returns=len(self._pending_meta),
+                             timeout=30)
+            done, rest = ray_tpu.wait(self._pending_meta,
+                                      num_returns=len(self._pending_meta),
+                                      timeout=0)
+        except Exception:  # noqa: BLE001 — e.g. stats() read after
+            return  # shutdown: report stays partial, never raises
+        self._pending_meta[:] = rest
+        for m in done:
+            try:
+                meta = ray_tpu.get(m)
+            except Exception:  # noqa: BLE001 — error surfaces via block
+                continue
+            prev = self.stats["bytes_ewma"]
+            self.stats["bytes_ewma"] = (
+                meta["nbytes"] if not prev
+                else 0.7 * prev + 0.3 * meta["nbytes"])
+            self.stats["completed_meta"] += 1
+            self.stats["rows"] += meta["rows"]
+            self.stats["bytes"] += meta["nbytes"]
+
+    def finalize_stats(self) -> None:
+        """Settle straggler metadata so stats() reports full row/byte
+        totals; called from ExecutionStats.summary() at read time."""
+        self._harvest_meta(block=True)
 
     def _count_cap(self, ctx) -> int:
         cap = ctx.max_tasks_in_flight
@@ -194,29 +323,9 @@ class MapStage(Stage):
         mem_budget = getattr(ctx, "memory_budget_bytes", 0)
         task = _map_task.options(**self.options) if self.options else _map_task
         inflight: collections.deque = collections.deque()
-        pending_meta: List = []
         upstream = iter(upstream)
         exhausted = False
         block_idx = 0
-
-        def harvest_meta() -> None:
-            # resolve completed metadata without blocking; update the EWMA
-            if not pending_meta:
-                return
-            done, rest = ray_tpu.wait(pending_meta,
-                                      num_returns=len(pending_meta),
-                                      timeout=0)
-            pending_meta[:] = rest
-            for m in done:
-                try:
-                    meta = ray_tpu.get(m)
-                except Exception:  # noqa: BLE001 — error surfaces via block
-                    continue
-                prev = self.stats["bytes_ewma"]
-                self.stats["bytes_ewma"] = (
-                    meta["nbytes"] if not prev
-                    else 0.7 * prev + 0.3 * meta["nbytes"])
-                self.stats["completed_meta"] += 1
 
         def over_memory() -> bool:
             if not mem_budget or not self.stats["bytes_ewma"]:
@@ -228,7 +337,7 @@ class MapStage(Stage):
             return False
 
         while True:
-            harvest_meta()
+            self._harvest_meta()
             while (not exhausted and len(inflight) < max_inflight
                    and not over_memory()):
                 try:
@@ -238,7 +347,7 @@ class MapStage(Stage):
                     break
                 block_ref, meta_ref = task.remote(self.fns, ref, block_idx)
                 inflight.append(block_ref)
-                pending_meta.append(meta_ref)
+                self._pending_meta.append(meta_ref)
                 self.stats["submitted"] += 1
                 block_idx += 1
             if not inflight:
@@ -252,6 +361,7 @@ class ActorMapStage(Stage):
         self.op = op
         self.pre = pre
         self.post = post
+        self.label = f"ActorMap({getattr(op.fn, '__name__', 'udf')})"
 
     def run(self, upstream: Iterator, ctx) -> Iterator:
         """Autoscaling pool (reference: ``ActorPoolMapOperator`` +
@@ -330,6 +440,7 @@ class ActorMapStage(Stage):
 class LimitStage(Stage):
     def __init__(self, n: int):
         self.n = n
+        self.label = f"Limit({n})"
 
     def run(self, upstream: Iterator, ctx) -> Iterator:
         remaining = self.n
@@ -517,6 +628,7 @@ def _push_based_all_to_all(refs: List, n_out: int, mode: str,
 class AllToAllStage(Stage):
     def __init__(self, op: L.LogicalOp):
         self.op = op
+        self.label = type(op).__name__
 
     def run(self, upstream: Iterator, ctx) -> Iterator:
         refs = list(upstream)
@@ -569,6 +681,8 @@ class AllToAllStage(Stage):
 
 
 class UnionStage(Stage):
+    label = "Union"
+
     def __init__(self, other_iterables: List):
         self.others = other_iterables
 
@@ -579,6 +693,8 @@ class UnionStage(Stage):
 
 
 class ZipStage(Stage):
+    label = "Zip"
+
     def __init__(self, other_iterable):
         self.other = other_iterable
 
@@ -604,19 +720,22 @@ def plan(ops: List[L.LogicalOp]) -> List[Stage]:
     stages: List[Stage] = []
     pending_fns: List[Callable] = []
     pending_opts: Dict[str, Any] = {}
+    pending_names: List[str] = []
 
     def flush():
-        nonlocal pending_fns, pending_opts
+        nonlocal pending_fns, pending_opts, pending_names
         if pending_fns:
-            stages.append(MapStage(pending_fns, pending_opts))
-            pending_fns, pending_opts = [], {}
+            stages.append(MapStage(pending_fns, pending_opts,
+                                   label="Map[" + "+".join(pending_names)
+                                         + "]"))
+            pending_fns, pending_opts, pending_names = [], {}, []
 
     for op in ops:
         if isinstance(op, L.MapBatches) and (
                 isinstance(op.fn, type) or op.compute is not None):
             # stateful UDF: fuse preceding maps into the actor, flush after
             pre = pending_fns
-            pending_fns, pending_opts = [], {}
+            pending_fns, pending_opts, pending_names = [], {}, []
             stages.append(ActorMapStage(op, pre, []))
         elif isinstance(op, L.MAP_LIKE):
             opts = {}
@@ -631,6 +750,7 @@ def plan(ops: List[L.LogicalOp]) -> List[Stage]:
                 flush()
                 pending_opts = opts
             pending_fns.append(_compile_map_like(op))
+            pending_names.append(type(op).__name__)
         elif isinstance(op, L.Limit):
             flush()
             stages.append(LimitStage(op.n))
@@ -652,9 +772,17 @@ def plan(ops: List[L.LogicalOp]) -> List[Stage]:
 
 
 def execute_streaming(source: Iterator, ops: List[L.LogicalOp],
-                      ctx) -> Iterator:
-    """Returns an iterator of block ObjectRefs."""
+                      ctx, stats: Optional[ExecutionStats] = None
+                      ) -> Iterator:
+    """Returns an iterator of block ObjectRefs. ``stats`` (an
+    ExecutionStats) receives per-operator wall/block accounting — the
+    backing store of ``Dataset.stats()``."""
     it = source
+    if stats is not None:
+        it = _instrumented(iter(it), stats.new_entry("Read"))
     for stage in plan(ops):
         it = stage.run(it, ctx)
+        if stats is not None:
+            it = _instrumented(iter(it),
+                               stats.new_entry(stage.label, stage))
     return it

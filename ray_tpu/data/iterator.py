@@ -12,6 +12,7 @@ from __future__ import annotations
 import collections
 import queue
 import threading
+import time
 from typing import Any, Dict, Iterator, List, Optional
 
 import numpy as np
@@ -116,6 +117,63 @@ def prefetched(it: Iterator[Any], depth: int) -> Iterator[Any]:
         stop.set()
 
 
+class JaxBatchIterator:
+    """Iterator of jnp device batches with ingest-vs-compute accounting.
+
+    The time THIS iterator spends producing a batch (pipeline pull +
+    host→device put) is **ingest**; the time the consumer holds the batch
+    between ``next()`` calls (their train step) is **compute**.
+    ``report()`` states which side gates the run — the number VERDICT asks
+    for ("host-side input pipelines that keep chips fed"): a training loop
+    is *ingest-limited* when the chips wait on data, *compute-limited* when
+    the pipeline keeps up.
+    """
+
+    def __init__(self, inner: Iterator[Dict[str, Any]]):
+        self._inner = inner
+        self.ingest_s = 0.0
+        self.compute_s = 0.0
+        self.batches = 0
+        self._t_resume: Optional[float] = None
+
+    def __iter__(self) -> "JaxBatchIterator":
+        return self
+
+    def __next__(self) -> Dict[str, Any]:
+        t0 = time.perf_counter()
+        if self._t_resume is not None:
+            self.compute_s += t0 - self._t_resume
+        try:
+            batch = next(self._inner)
+        except StopIteration:
+            self._t_resume = None
+            raise
+        self.ingest_s += time.perf_counter() - t0
+        self._t_resume = time.perf_counter()
+        self.batches += 1
+        return batch
+
+    def report(self) -> Dict[str, Any]:
+        total = self.ingest_s + self.compute_s
+        verdict = ("ingest-limited" if self.ingest_s > self.compute_s
+                   else "compute-limited")
+        return {
+            "verdict": verdict,
+            "ingest_s": round(self.ingest_s, 4),
+            "compute_s": round(self.compute_s, 4),
+            "ingest_frac": round(self.ingest_s / total, 4) if total else 0.0,
+            "batches": self.batches,
+            "batches_per_s": (round(self.batches / total, 2)
+                              if total else 0.0),
+        }
+
+    def verdict(self) -> str:
+        r = self.report()
+        return (f"{r['verdict']}: ingest {r['ingest_s']:.3f}s vs compute "
+                f"{r['compute_s']:.3f}s over {r['batches']} batch(es) "
+                f"(ingest fraction {r['ingest_frac']:.0%})")
+
+
 class DataIterator:
     """One consumer's view of a stream of blocks."""
 
@@ -164,16 +222,25 @@ class DataIterator:
 
     def iter_jax_batches(self, *, batch_size: int = 256,
                          drop_last: bool = True, dtype=None,
-                         prefetch_batches: int = 2) -> Iterator[Dict[str, Any]]:
+                         prefetch_batches: int = 2) -> "JaxBatchIterator":
         """Batches as jnp device arrays — the TPU feed path (host numpy →
-        device put; drop_last defaults True to keep shapes static for jit)."""
+        device put; drop_last defaults True to keep shapes static for jit).
+
+        Returns a ``JaxBatchIterator``: iterate as before, and call
+        ``.report()`` / ``.verdict()`` afterwards for the
+        ingest-vs-compute breakdown ("is the pipeline keeping the chips
+        fed?")."""
         import jax.numpy as jnp
 
-        for batch in self.iter_batches(batch_size=batch_size,
-                                       drop_last=drop_last,
-                                       prefetch_batches=prefetch_batches):
-            yield {k: jnp.asarray(v if dtype is None else v.astype(dtype))
-                   for k, v in batch.items()}
+        def gen():
+            for batch in self.iter_batches(batch_size=batch_size,
+                                           drop_last=drop_last,
+                                           prefetch_batches=prefetch_batches):
+                yield {k: jnp.asarray(v if dtype is None
+                                      else v.astype(dtype))
+                       for k, v in batch.items()}
+
+        return JaxBatchIterator(gen())
 
 
 @ray_tpu.remote

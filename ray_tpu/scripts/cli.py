@@ -379,6 +379,47 @@ def cmd_rl(args: argparse.Namespace) -> int:
             ray_tpu.shutdown()
 
 
+def cmd_trace(args: argparse.Namespace) -> int:
+    """rt trace <task_id|trace_id|span_id>: print the span tree with the
+    per-phase latency tables and the named critical path (the cluster-side
+    twin of `rt profile` — reads the GCS task-event store directly, no
+    driver attach)."""
+    from ray_tpu.util.tracing import format_trace
+
+    gcs = _resolve_gcs(args.address)
+    if gcs is None:
+        print("no running cluster found (pass --address)", file=sys.stderr)
+        return 1
+    events = _gcs_call(gcs, "list_tasks", {"limit": args.limit})
+    ident = args.id
+
+    def ctx(e):
+        return e.get("trace") or {}
+
+    trace_id = None
+    if any(ctx(e).get("trace_id") == ident for e in events):
+        trace_id = ident
+    else:
+        for e in events:
+            if (e.get("task_id", "").startswith(ident)
+                    or ctx(e).get("span_id") == ident):
+                trace_id = ctx(e).get("trace_id")
+                if trace_id is None:
+                    # untraced task: still print its event (+ phases if the
+                    # task ran with phase tracing from an ambient span)
+                    print(format_trace([e]))
+                    return 0
+                break
+    if trace_id is None:
+        print(f"rt trace: no task or trace matching {ident!r} in the "
+              f"event store (traces are bounded; re-run with tracing on)",
+              file=sys.stderr)
+        return 1
+    spans = [e for e in events if ctx(e).get("trace_id") == trace_id]
+    print(format_trace(spans))
+    return 0
+
+
 def cmd_metrics(args: argparse.Namespace) -> int:
     from ray_tpu.util.metrics import metrics_text
 
@@ -549,6 +590,16 @@ def main(argv=None) -> int:
                                help="aggregated Prometheus metrics page")
     p_metrics.add_argument("--address", default=None)
     p_metrics.set_defaults(fn=cmd_metrics)
+
+    p_trace = sub.add_parser(
+        "trace",
+        help="span tree + per-phase latency tables for a task or trace "
+             "(util/tracing.py phase records)")
+    p_trace.add_argument("id", help="task_id (prefix ok), trace_id, "
+                                    "or span_id")
+    p_trace.add_argument("--address", default=None)
+    p_trace.add_argument("--limit", type=int, default=10000)
+    p_trace.set_defaults(fn=cmd_trace)
 
     args = parser.parse_args(argv)
     if args.cmd == "start" and not args.head and not args.address:

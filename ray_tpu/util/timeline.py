@@ -11,6 +11,12 @@ category span on a ``step:<kind>`` track, with ``compile`` and ``sync``
 sub-spans marking the first-call compile time and the post-dispatch
 host-sync stall — so the train/decode breakdown lines up against the task
 lanes in one Perfetto view.
+
+Traced tasks additionally carry a per-phase breakdown (``util/tracing.py``
+``PHASE_ORDER``): each phase becomes its own span on a ``<task>:phases``
+track, laid out consecutively from the task's enqueue time — queue-wait,
+worker-acquire (spawn vs warm), arg-fetch, execute, result-store line up
+under the task's main lane.
 """
 
 from __future__ import annotations
@@ -59,10 +65,39 @@ def timeline(filename: Optional[str] = None) -> List[Dict[str, Any]]:
                 "pid": ev.get("node_id") or "node",
                 "tid": ev["task_id"][:8],
             })
+        if ev.get("phases"):
+            trace.extend(_phase_lanes(ev))
     if filename:
         with open(filename, "w") as f:
             json.dump(trace, f)
     return trace
+
+
+def _phase_lanes(ev: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """One traced task's phase breakdown -> consecutive Perfetto sub-spans
+    on a ``<task>:phases`` track, anchored at the task's enqueue time.
+    ``driver_get`` trails the reply, so it lays out after the partition."""
+    from ray_tpu.util.tracing import sorted_phases
+
+    times = ev.get("times", {})
+    start = times.get("PENDING") or times.get("RUNNING")
+    if start is None:
+        return []
+    pid = ev.get("node_id") or "node"
+    tid = f"{ev['task_id'][:8]}:phases"
+    out: List[Dict[str, Any]] = []
+    # PENDING is stamped at raylet enqueue — the submit phase precedes it
+    t = (start - max(0.0, ev["phases"].get("submit", 0.0))) * 1e6
+    for name, secs in sorted_phases(ev["phases"]):
+        dur = max(0.0, secs) * 1e6
+        args = {"seconds": secs}
+        if name == "worker_acquire" and ev.get("worker_source"):
+            args["worker_source"] = ev["worker_source"]
+        out.append({"name": name, "cat": "phase", "ph": "X",
+                    "ts": t, "dur": dur, "pid": pid, "tid": tid,
+                    "args": args})
+        t += dur
+    return out
 
 
 def _step_lanes(ev: Dict[str, Any], prof: Dict[str, Any]

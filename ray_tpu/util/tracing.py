@@ -9,6 +9,27 @@ GCS task-event store (the same table ``ray_tpu.timeline()`` exports), so a
 trace is a filterable view of the timeline: ``get_trace(trace_id)`` returns
 the span tree.
 
+Every traced task additionally carries a PER-PHASE latency breakdown
+(reference: the task-event phase records behind Ray's State API,
+``gcs_task_manager`` + ``task_events.proto``): the driver, raylet, and
+executing worker each stamp the phases they own, and the union lands in the
+span's GCS event as ``phases`` — a partition of the submit→reply interval:
+
+  submit          driver-side residual: arg serialization + submit RPC + wire
+  queue_wait      raylet queue time (enqueue → dispatch claim, including
+                  dispatch-loop latency)
+  worker_acquire  worker checkout (``worker_source`` says spawn vs warm)
+  transfer        push RPC + payload marshalling around the worker's span
+  arg_fetch       dependency resolution + deserialization in the worker
+  execute         the user function
+  result_store    return serialization (+ plasma seal for large returns)
+  driver_get      post-reply deserialization in the caller's ``get``
+
+Phase stamping rides the span context: a task with no ``trace`` in its
+payload pays exactly one predicate check per hop (the step-profiler
+discipline). ``format_trace`` renders the span tree with phase tables and
+names the critical path — the ``rt trace`` CLI prints it.
+
 Usage::
 
     from ray_tpu.util import tracing
@@ -16,12 +37,15 @@ Usage::
     ref = my_task.remote(...)      # root span, fresh trace_id
     ...
     spans = tracing.get_trace(tracing.last_trace_id())
+    print(tracing.format_trace(spans))
 """
 
 from __future__ import annotations
 
 import contextvars
 import os
+import threading
+import time
 import uuid
 from typing import Any, Dict, List, Optional
 
@@ -91,6 +115,24 @@ def last_trace_id() -> Optional[str]:
     return _last_trace_id
 
 
+_submit_entry = threading.local()
+
+
+def mark_submit_entry() -> None:
+    """Called at the public submit entry (core/worker.py) so the ``submit``
+    phase covers driver-side arg serialization too, not just the RPC.
+    One predicate when tracing is off."""
+    if _enabled or _current.get() is not None:
+        _submit_entry.t = time.perf_counter()
+
+
+def take_submit_entry() -> Optional[float]:
+    """Consume the entry stamp (backend submit path); None when untraced."""
+    t = getattr(_submit_entry, "t", None)
+    _submit_entry.t = None
+    return t
+
+
 def get_trace(trace_id: str) -> List[Dict[str, Any]]:
     """All spans of one trace, parents before children where possible."""
     import ray_tpu
@@ -110,3 +152,118 @@ def get_trace(trace_id: str) -> List[Dict[str, Any]]:
                          seen + ((s["trace"] or {}).get("span_id"),))
 
     return sorted(spans, key=depth)
+
+
+# ---------------------------------------------------------------------------
+# Phase records
+# ---------------------------------------------------------------------------
+
+# Wall-clock partition of one task's submit→reply interval, in causal order
+# (driver_get trails the reply). ``format_trace`` and the dashboard render
+# phases in this order; unknown keys sort after.
+PHASE_ORDER = ("submit", "queue_wait", "worker_acquire", "transfer",
+               "arg_fetch", "execute", "result_store", "driver_get")
+
+
+def sorted_phases(phases: Dict[str, float]) -> List[Any]:
+    """(name, seconds) pairs in canonical phase order."""
+    rank = {p: i for i, p in enumerate(PHASE_ORDER)}
+    return sorted(phases.items(),
+                  key=lambda kv: (rank.get(kv[0], len(PHASE_ORDER)), kv[0]))
+
+
+def span_tree(spans: List[Dict[str, Any]]) -> List[Any]:
+    """Nest spans by parentage: [(span, [children...]), ...] roots first."""
+    by_span: Dict[str, Any] = {}
+    for s in spans:
+        sid = (s.get("trace") or {}).get("span_id")
+        if sid is not None:
+            by_span[sid] = (s, [])
+    roots: List[Any] = []
+    for s in spans:
+        ctx = s.get("trace") or {}
+        sid, parent = ctx.get("span_id"), ctx.get("parent_span_id")
+        node = by_span.get(sid) or (s, [])
+        if parent is not None and parent in by_span and parent != sid:
+            by_span[parent][1].append(node)
+        else:
+            roots.append(node)
+    return roots
+
+
+def _span_duration(span: Dict[str, Any]) -> float:
+    phases = span.get("phases") or {}
+    if phases:
+        return sum(v for k, v in phases.items() if k != "driver_get")
+    times = span.get("times") or {}
+    start = times.get("PENDING") or times.get("RUNNING")
+    end = times.get("FINISHED") or times.get("FAILED")
+    if start is not None and end is not None:
+        return max(0.0, end - start)
+    return 0.0
+
+
+def critical_path(spans: List[Dict[str, Any]]) -> List[Any]:
+    """The root→leaf chain that dominates end-to-end latency, each hop
+    tagged with its heaviest phase: [(span, phase_name, seconds), ...].
+    At each level the child with the largest span duration wins (children
+    of one parent overlap in wall time; the longest one gates the parent).
+    """
+    roots = span_tree(spans)
+    if not roots:
+        return []
+    path: List[Any] = []
+    node = max(roots, key=lambda n: _span_duration(n[0]))
+    while node is not None:
+        span, children = node
+        phases = span.get("phases") or {}
+        if phases:
+            name, dur = max(phases.items(), key=lambda kv: kv[1])
+        else:
+            name, dur = "total", _span_duration(span)
+        path.append((span, name, dur))
+        node = max(children, key=lambda n: _span_duration(n[0])) \
+            if children else None
+    return path
+
+
+def format_trace(spans: List[Dict[str, Any]]) -> str:
+    """Human-readable span tree with per-phase tables and the named
+    critical path — what ``rt trace`` prints."""
+    if not spans:
+        return "(no spans)"
+    lines: List[str] = []
+
+    def emit(node, indent: int) -> None:
+        span, children = node
+        dur = _span_duration(span)
+        pad = "  " * indent
+        lines.append(
+            f"{pad}{'└─ ' if indent else ''}{span.get('name') or 'task'}  "
+            f"[{span.get('state', '?')}]  {dur * 1e3:.1f} ms  "
+            f"task_id={span.get('task_id', '')[:16]}")
+        phases = span.get("phases") or {}
+        if phases:
+            total = sum(phases.values()) or 1.0
+            for pname, secs in sorted_phases(phases):
+                bar = "#" * max(1, int(20 * secs / total)) if secs > 0 else ""
+                extra = ""
+                if pname == "worker_acquire" and span.get("worker_source"):
+                    extra = f" ({span['worker_source']})"
+                lines.append(f"{pad}     {pname:<15}{secs * 1e3:>10.2f} ms"
+                             f"  {bar}{extra}")
+        for child in sorted(children,
+                            key=lambda n: -_span_duration(n[0])):
+            emit(child, indent + 1)
+
+    trace_id = (spans[0].get("trace") or {}).get("trace_id", "?")
+    lines.append(f"trace {trace_id} — {len(spans)} span(s)")
+    for root in span_tree(spans):
+        emit(root, 0)
+    cp = critical_path(spans)
+    if cp:
+        hops = " -> ".join(
+            f"{s.get('name') or 'task'}:{phase} ({dur * 1e3:.1f} ms)"
+            for s, phase, dur in cp)
+        lines.append(f"critical path: {hops}")
+    return "\n".join(lines)

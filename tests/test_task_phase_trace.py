@@ -1,0 +1,281 @@
+"""Critical-path observability: task-lifecycle phase tracing, scheduler
+queue telemetry, and Dataset.stats().
+
+Covers the PR-3 tentpole: per-phase latency breakdowns threaded through the
+span context (driver → raylet → worker), queue-wait/queue-depth telemetry
+on the Prometheus push, Perfetto phase lanes, the ``rt trace`` span-tree
+formatter, and the data plane's per-operator stats + ingest-vs-compute
+verdict. Named to sort late in tier-1 collection (repo convention: after
+``test_rl*``)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+
+
+def _poll_trace(tracing, trace_id, want, deadline_s=20.0,
+                need_phases=True):
+    spans = []
+    deadline = time.time() + deadline_s
+    while time.time() < deadline:
+        spans = tracing.get_trace(trace_id)
+        if len(spans) >= want and (
+                not need_phases or all(s.get("phases") for s in spans)):
+            break
+        time.sleep(0.3)
+    return spans
+
+
+def test_phase_breakdown_sums_to_e2e(rt_cluster):
+    """A traced task's phases are a partition of the observed end-to-end
+    latency: ordered, non-negative, queue_wait isolated, and summing to
+    within 10% of the submit→get wall; the span tree renders with a named
+    critical path and the timeline grows phase lanes."""
+    from ray_tpu.util import tracing
+
+    @ray_tpu.remote
+    def warmup():
+        return 0
+
+    @ray_tpu.remote
+    def slow(x):
+        time.sleep(0.5)
+        return x
+
+    ray_tpu.get(warmup.remote())  # pool a worker: acquire stays bounded
+    tracing.enable()
+    try:
+        t0 = time.perf_counter()
+        ref = slow.remote(5)
+        assert ray_tpu.get(ref) == 5
+        e2e = time.perf_counter() - t0
+        trace_id = tracing.last_trace_id()
+        spans = _poll_trace(tracing, trace_id, want=1)
+    finally:
+        tracing.disable()
+    assert spans, "traced task never reached the event store"
+    span = spans[0]
+    phases = span["phases"]
+    # queue-wait isolated as its own phase; all phases non-negative
+    assert "queue_wait" in phases
+    assert all(v >= 0 for v in phases.values()), phases
+    for required in ("submit", "queue_wait", "worker_acquire", "arg_fetch",
+                     "execute", "result_store"):
+        assert required in phases, (required, phases)
+    assert span.get("worker_source") in ("spawn", "warm")
+    # execute dominates a sleep task and the partition matches reality
+    assert phases["execute"] == pytest.approx(0.5, abs=0.25)
+    psum = sum(v for k, v in phases.items() if k != "driver_get")
+    assert psum == pytest.approx(e2e, rel=0.10), (psum, e2e, phases)
+    # phase-stamp ordering: canonical order is stable and complete
+    ordered = [k for k, _ in tracing.sorted_phases(phases)]
+    rank = {p: i for i, p in enumerate(tracing.PHASE_ORDER)}
+    assert ordered == sorted(ordered, key=lambda k: rank.get(k, 99))
+    # rt trace rendering: tree + phase table + named critical path
+    text = tracing.format_trace(spans)
+    assert "critical path:" in text
+    assert "execute" in text and "queue_wait" in text
+    # Perfetto export gains task-phase lanes
+    lanes = [e for e in ray_tpu.timeline()
+             if e.get("cat") == "phase"
+             and e["tid"].startswith(span["task_id"][:8])]
+    assert {e["name"] for e in lanes} >= {"queue_wait", "execute"}
+
+
+def test_actor_trace_propagation_with_phases(rt_cluster):
+    """Cross-process propagation through actor calls: the actor-method
+    span carries its own phases (concurrency-queue wait, arg fetch,
+    execute, result store) and a task submitted INSIDE the method becomes
+    its child span with raylet-side phases."""
+    from ray_tpu.util import tracing
+
+    @ray_tpu.remote
+    def inner(x):
+        return x + 1
+
+    @ray_tpu.remote
+    class Doubler:
+        def go(self, x):
+            return ray_tpu.get(inner.remote(x)) * 2
+
+    a = Doubler.remote()
+    tracing.enable()
+    try:
+        assert ray_tpu.get(a.go.remote(10)) == 22
+        trace_id = tracing.last_trace_id()
+        spans = _poll_trace(tracing, trace_id, want=2)
+    finally:
+        tracing.disable()
+    assert len(spans) >= 2, spans
+    by_parent = {(s["trace"] or {}).get("parent_span_id"): s for s in spans}
+    root = by_parent.get(None)
+    assert root is not None and root["name"] == "Doubler.go"
+    child = next(s for s in spans
+                 if (s["trace"] or {}).get("parent_span_id") is not None)
+    assert (child["trace"]["parent_span_id"]
+            == root["trace"]["span_id"])
+    # actor-call phases: direct worker->worker, no raylet hop
+    for k in ("queue_wait", "arg_fetch", "execute", "result_store",
+              "submit"):
+        assert k in root["phases"], root["phases"]
+    # the nested task went through the raylet: worker_acquire present
+    assert "worker_acquire" in child["phases"], child["phases"]
+    # critical path walks root -> child
+    path = tracing.critical_path(spans)
+    assert [p[0]["task_id"] for p in path] == [root["task_id"],
+                                               child["task_id"]]
+
+
+def test_queue_wait_histogram_under_deep_queue(rt_cluster):
+    """Queue telemetry: whole-node tasks serialize behind each other, and
+    the queue-wait histogram + queue-depth gauge land on the Prometheus
+    push (no tracing required — telemetry is trace-independent)."""
+    from ray_tpu.util import metrics as M
+
+    @ray_tpu.remote(num_cpus=4)  # the whole node: forces a deep queue
+    def hog(i):
+        time.sleep(0.05)
+        return i
+
+    refs = [hog.remote(i) for i in range(8)]
+    assert ray_tpu.get(refs, timeout=120) == list(range(8))
+    text = M.metrics_text()
+    assert "rt_task_queue_wait_seconds" in text
+    assert "rt_raylet_queue_depth" in text
+    # the histogram actually observed the dispatches (count >= submitted)
+    count_lines = [ln for ln in text.splitlines()
+                   if ln.startswith("rt_task_queue_wait_seconds_count")]
+    assert count_lines and sum(
+        float(ln.rsplit(" ", 1)[1]) for ln in count_lines) >= 8
+    # later tasks waited behind earlier ones: nonzero total wait
+    sum_lines = [ln for ln in text.splitlines()
+                 if ln.startswith("rt_task_queue_wait_seconds_sum")]
+    assert sum(float(ln.rsplit(" ", 1)[1]) for ln in sum_lines) > 0.0
+    # the GCS node table exposes the heartbeat's queue depth
+    nodes = ray_tpu.nodes()
+    assert all("queue_depth" in n for n in nodes)
+
+
+def test_untraced_path_stays_predicate_only(rt_cluster):
+    """With tracing disabled the submit/dispatch hot path must add only
+    predicate checks: no span context is minted, no phase stamps are
+    taken, and the task's event carries no phases."""
+    from ray_tpu.util import tracing
+
+    assert not tracing.enabled()
+    # predicate level 1: no context minted at submit
+    assert tracing.context_for_submit() is None
+    # predicate level 2: no submit-entry stamp is taken
+    tracing.mark_submit_entry()
+    assert tracing.take_submit_entry() is None
+
+    @ray_tpu.remote
+    def plain():
+        return "ok"
+
+    ref = plain.remote()
+    assert ray_tpu.get(ref) == "ok"
+    task_id = ref.id().task_id().hex()
+    backend = ray_tpu.global_worker()._require_backend()
+    ev = None
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        events = backend.io.run(
+            backend._gcs.call("list_tasks", {"limit": 1000}))
+        for e in events:
+            if e["task_id"] == task_id and e.get("state") == "FINISHED":
+                ev = e
+                break
+        if ev:
+            break
+        time.sleep(0.3)
+    assert ev is not None
+    assert "phases" not in ev, ev
+    assert ev.get("trace") is None
+
+
+def test_format_trace_and_critical_path_unit():
+    """Pure-function check of the span-tree formatter: nesting, phase
+    tables in canonical order, and the critical path picking the heaviest
+    child at each level."""
+    from ray_tpu.util import tracing
+
+    spans = [
+        {"task_id": "aa" * 8, "name": "root", "state": "FINISHED",
+         "trace": {"trace_id": "t1", "span_id": "s1",
+                   "parent_span_id": None},
+         "phases": {"execute": 1.0, "queue_wait": 0.1}},
+        {"task_id": "bb" * 8, "name": "fast_child", "state": "FINISHED",
+         "trace": {"trace_id": "t1", "span_id": "s2",
+                   "parent_span_id": "s1"},
+         "phases": {"execute": 0.05}},
+        {"task_id": "cc" * 8, "name": "slow_child", "state": "FINISHED",
+         "trace": {"trace_id": "t1", "span_id": "s3",
+                   "parent_span_id": "s1"},
+         "phases": {"queue_wait": 0.7, "execute": 0.1}},
+    ]
+    roots = tracing.span_tree(spans)
+    assert len(roots) == 1 and len(roots[0][1]) == 2
+    path = tracing.critical_path(spans)
+    assert [p[0]["name"] for p in path] == ["root", "slow_child"]
+    assert path[0][1] == "execute"        # root's dominant phase
+    assert path[1][1] == "queue_wait"     # slow child gated by the queue
+    text = tracing.format_trace(spans)
+    assert "trace t1" in text and "critical path:" in text
+    assert "slow_child:queue_wait" in text
+    # spans without any trace context still render (untraced rt trace)
+    assert "critical path" in tracing.format_trace(
+        [{"task_id": "dd" * 8, "name": "solo", "state": "FINISHED",
+          "times": {"RUNNING": 1.0, "FINISHED": 2.0}}])
+
+
+def test_dataset_stats_accounting(rt_local):
+    """Dataset.stats(): per-operator wall/blocks/rows/bytes of the most
+    recent execution, backpressure counters wired through, and the
+    not-yet-executed message before any consumption."""
+    from ray_tpu import data as rtd
+
+    ds = rtd.range(2000, parallelism=4) \
+        .map_batches(lambda b: {"id": b["id"] * 2}) \
+        .filter(lambda r: r["id"] % 4 == 0)
+    assert "not executed yet" in ds.stats()
+    assert ds.count() == 1000
+    report = ds.stats()
+    assert "Operator 0 Read" in report
+    assert "Map[MapBatches+Filter]" in report
+    assert "4 task(s)" in report
+    assert "1000 rows" in report
+    summary = ds._last_stats.summary()
+    assert summary[0]["operator"] == "Read"
+    assert summary[0]["blocks"] == 4
+    map_row = summary[1]
+    assert map_row["rows"] == 1000 and map_row["bytes"] > 0
+    assert all(r["wall_s"] >= 0 for r in summary)
+    # per-operator net walls are additive back to the gross total
+    assert sum(r["wall_s"] for r in summary) == pytest.approx(
+        summary[-1]["gross_s"], rel=1e-6)
+
+
+def test_iter_jax_batches_ingest_verdict(rt_local):
+    """iter_jax_batches returns a reporting iterator whose verdict names
+    the gating side with numbers (VERDICT #7: can the host feed the
+    chips?)."""
+    jnp = pytest.importorskip("jax.numpy")  # noqa: F841
+    from ray_tpu import data as rtd
+
+    ds = rtd.range(1024, parallelism=2)
+    it = ds.iter_jax_batches(batch_size=128)
+    for _ in it:
+        time.sleep(0.002)  # a tiny "train step"
+    rep = it.report()
+    assert rep["verdict"] in ("ingest-limited", "compute-limited")
+    assert rep["batches"] == 8
+    assert rep["ingest_s"] > 0 and rep["compute_s"] > 0
+    assert 0.0 <= rep["ingest_frac"] <= 1.0
+    assert rep["verdict"] == ("ingest-limited"
+                              if rep["ingest_s"] > rep["compute_s"]
+                              else "compute-limited")
+    text = it.verdict()
+    assert "ingest" in text and "compute" in text and "batch" in text
