@@ -28,6 +28,16 @@ def timeline(filename=None):
 
     return _tl(filename)
 
+
+def memory_summary(**kwargs):
+    """Cluster memory report: per-node store usage, the per-object owner
+    table (with call sites under RT_RECORD_REF_CREATION_SITES=1), leak
+    suspects and HBM stats (reference: ray.internal.memory_summary /
+    `ray memory`). See `rt memory` for the CLI twin."""
+    from ray_tpu.util.memory import memory_summary as _ms
+
+    return _ms(**kwargs)
+
 __version__ = "0.1.0"
 
 
@@ -88,6 +98,7 @@ def nodes():
 __all__ = [
     "init", "shutdown", "is_initialized", "remote", "method", "put", "get",
     "wait", "kill", "cancel", "get_actor", "internal_free",
+    "memory_summary",
     "cluster_resources",
     "available_resources", "nodes", "get_runtime_context", "ObjectRef",
     "ActorClass", "ActorHandle", "RemoteFunction", "exceptions",

@@ -75,6 +75,18 @@ class Config:
     object_transfer_chunk_bytes: int = 8 * 1024**2
     object_spilling_dir: str = ""  # "" = <session_dir>/spill
     object_spill_threshold: float = 0.8
+    # ---- memory observability ----------------------------------------------
+    # Ownership/reference ledger (reference: the core worker's
+    # ReferenceCounter behind `ray memory`): per-process table of owned
+    # objects with owner, size, state and ref kinds, aggregated by
+    # memory_summary() / `rt memory`. Off = zero bookkeeping per ObjectRef.
+    object_ledger: bool = True
+    # Capture the Python call site that created each ref (Ray parity:
+    # RAY_record_ref_creation_sites). Costs a stack walk per ref — opt-in.
+    record_ref_creation_sites: bool = False
+    # An owned object older than this whose only references are local refs
+    # in the driver is flagged as a leak suspect by memory_summary().
+    memory_leak_age_s: float = 300.0
 
     # ---- health / fault tolerance -----------------------------------------
     heartbeat_interval_s: float = 1.0

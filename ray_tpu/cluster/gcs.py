@@ -1074,6 +1074,27 @@ class GcsServer:
             events += list(getattr(self, "step_events", {}).values())[-limit:]
         return events
 
+    # ---- memory events (spill / restore / oom_kill instants; the store
+    # behind `rt memory --oom` and the timeline's memory lane) -------------
+    _MEM_EVENTS_CAP = 2048
+
+    async def rpc_mem_event(self, p):
+        if not hasattr(self, "mem_events"):
+            from collections import deque
+
+            self.mem_events: "deque" = deque(maxlen=self._MEM_EVENTS_CAP)
+        p.setdefault("t", time.time())
+        self.mem_events.append(p)
+        return {"ok": True}
+
+    async def rpc_list_mem_events(self, p):
+        events = list(getattr(self, "mem_events", ()))
+        kind = p.get("kind")
+        if kind:
+            events = [e for e in events if e.get("kind") == kind]
+        limit = p.get("limit") or 1000
+        return events[-limit:]
+
     async def rpc_list_objects(self, p):
         limit = p.get("limit") or 1000
         out = []

@@ -53,6 +53,7 @@ class _WorkerEntry:
         self.oom_killed = False
         self.job_id: Optional[str] = None  # current job, for log routing
         self.idle_since: Optional[float] = None  # monotonic; None = busy
+        self.current_task: Optional[str] = None  # fn_name while executing
 
 
 class _BundleState:
@@ -156,9 +157,21 @@ class Raylet:
             "RT_QUEUE_TELEMETRY", "1") not in ("", "0", "false")
         self._tele_metrics: Optional[Dict[str, Any]] = None
         self._tele_pushed = 0.0
+        # Memory-plane counters (cumulative; surfaced by rpc_memory_report
+        # and `rt memory`, twinned as rt_object_* / rt_oom_kills_total on
+        # the Prometheus push). Mutated from the loop AND the spill
+        # executor thread — single increments only, drift-free enough for
+        # telemetry.
+        self._mem_stats: Dict[str, float] = {
+            "spills": 0, "spill_bytes": 0, "spill_seconds": 0.0,
+            "restores": 0, "restore_bytes": 0, "restore_seconds": 0.0,
+            "pin_purges": 0, "oom_kills": 0}
+        self._rss_reported: set = set()  # worker_ids with a live RSS gauge
 
     _QUEUE_WAIT_BUCKETS = (0.001, 0.005, 0.02, 0.1, 0.5, 1.0, 5.0, 15.0,
                            60.0, 300.0, 900.0)
+    _SPILL_BUCKETS = (0.001, 0.005, 0.02, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+                      5.0, 15.0, 60.0)
 
     def _telemetry_metrics(self) -> Dict[str, Any]:
         if self._tele_metrics is None:
@@ -174,6 +187,32 @@ class Raylet:
                     "Raylet queue wait per dispatched task "
                     "(enqueue to dispatch claim)",
                     boundaries=self._QUEUE_WAIT_BUCKETS,
+                    tag_keys=("node_id",)),
+                "store_bytes": M.get_or_create(
+                    M.Gauge, "rt_object_store_bytes",
+                    "Per-node object store bytes by state "
+                    "(in_memory / spilled / pinned)",
+                    tag_keys=("node_id", "state")),
+                "spill_hist": M.get_or_create(
+                    M.Histogram, "rt_object_spill_seconds",
+                    "Disk-spill IO time per spilled object",
+                    boundaries=self._SPILL_BUCKETS, tag_keys=("node_id",)),
+                "restore_hist": M.get_or_create(
+                    M.Histogram, "rt_object_restore_seconds",
+                    "Spill-restore IO time per restored object",
+                    boundaries=self._SPILL_BUCKETS, tag_keys=("node_id",)),
+                "worker_rss": M.get_or_create(
+                    M.Gauge, "rt_worker_rss_bytes",
+                    "Resident set size of each live worker process",
+                    tag_keys=("node_id", "worker_id")),
+                "oom_kills": M.get_or_create(
+                    M.Counter, "rt_oom_kills_total",
+                    "Workers killed by the raylet memory monitor",
+                    tag_keys=("node_id",)),
+                "pin_purges": M.get_or_create(
+                    M.Counter, "rt_object_pin_purges_total",
+                    "Leaked get-pins purged by the TTL timer "
+                    "(crashed getters)",
                     tag_keys=("node_id",)),
             }
         return self._tele_metrics
@@ -280,6 +319,10 @@ class Raylet:
             now = time.monotonic()
             if now - self._tele_pushed < 5.0:
                 return
+            # O(#objects) scan and /proc reads at the push cadence only —
+            # samples set more often than they are shipped are wasted work
+            self._set_store_gauges(m)
+            self._update_worker_rss(m)
             import ray_tpu
             from ray_tpu.util import metrics as M
 
@@ -294,6 +337,61 @@ class Raylet:
             self._tele_pushed = now
         except Exception:  # noqa: BLE001 — telemetry must never kill
             pass  # the heartbeat loop
+
+    def _store_state_bytes(self) -> Dict[str, int]:
+        """One pass over the object meta: bytes by state. ``pinned`` counts
+        live-pinned in-memory bytes (a subset of in_memory, like the
+        reference's pinned accounting)."""
+        now = time.monotonic()
+        in_mem = spilled = pinned = 0
+        for oid_hex, meta in list(self._object_meta.items()):
+            if meta.get("spilled"):
+                spilled += meta["size"]
+            else:
+                in_mem += meta["size"]
+                if self._is_pinned(oid_hex, now):
+                    pinned += meta["size"]
+        return {"in_memory": in_mem, "spilled": spilled, "pinned": pinned}
+
+    def _set_store_gauges(self, m: Dict[str, Any]) -> None:
+        for state, v in self._store_state_bytes().items():
+            m["store_bytes"].set(v, {"node_id": self.node_id,
+                                     "state": state})
+
+    def _update_worker_rss(self, m: Dict[str, Any]) -> None:
+        """rt_worker_rss_bytes per live worker; dead workers' samples are
+        removed so the page doesn't accumulate stale series."""
+        from ray_tpu import _native
+
+        by_pid = {e.proc.pid: e.worker_id
+                  for e in self._workers.values() if e.proc.poll() is None}
+        live: set = set()
+        for pid, rss in _native.process_memory(list(by_pid)):
+            wid = by_pid.get(pid)
+            if wid is None:
+                continue
+            live.add(wid)
+            m["worker_rss"].set(rss, {"node_id": self.node_id,
+                                      "worker_id": wid})
+        for wid in self._rss_reported - live:
+            m["worker_rss"].remove({"node_id": self.node_id,
+                                    "worker_id": wid})
+        self._rss_reported = live
+
+    def _mem_event(self, kind: str, **fields) -> None:
+        """Fire-and-forget memory instant event to the GCS mem-event store
+        (spill / restore / oom_kill): feeds ``ray_tpu.timeline()`` instant
+        markers and the `rt memory --oom` post-mortem replay."""
+        async def _send():
+            try:
+                msg = {"kind": kind, "node_id": self.node_id,
+                       "t": time.time()}
+                msg.update(fields)
+                await self._gcs.call("mem_event", msg)
+            except Exception:  # noqa: BLE001 — observability only
+                pass
+
+        spawn_task(_send())
 
     # ---- worker pool --------------------------------------------------------
     def _spawn_worker(self, key: Tuple, chips: List[int],
@@ -400,6 +498,7 @@ class Raylet:
 
     def _release_worker(self, entry: _WorkerEntry) -> None:
         entry.busy = False
+        entry.current_task = None
         if entry.proc.poll() is None and not entry.is_actor_worker:
             entry.idle_since = time.monotonic()
             self._idle.setdefault(entry.key, []).append(entry)
@@ -412,9 +511,17 @@ class Raylet:
         (dead client) so unsealed store allocations can't pile up."""
         from ray_tpu._private.ids import ObjectID
 
+        self._last_pin_purge = 0.0
         while True:
             await asyncio.sleep(0.5)
             now = time.monotonic()
+            if now - self._last_pin_purge > 5.0:
+                # get-pin TTL enforcement on a timer: leaked pins from
+                # crashed getters must expire even when no spill pass or
+                # pin burst ever runs (they would otherwise exempt their
+                # objects from eviction forever)
+                self._last_pin_purge = now
+                self._purge_stale_pins(now)
             for oid_hex, (_, t0) in list(self._client_uploads.items()):
                 if now - t0 > self._UPLOAD_TTL_S:
                     self._client_uploads.pop(oid_hex, None)
@@ -575,12 +682,43 @@ class Raylet:
                 if victim is None:
                     continue
                 victim.oom_killed = True
+                victim_rss = _native.process_rss(victim.proc.pid)
                 try:
                     victim.proc.kill()
                 except ProcessLookupError:
                     pass
+                self._record_oom_kill(victim, victim_rss,
+                                      {"total": total, "used": used})
             except Exception:  # noqa: BLE001 — monitor must never die
                 pass
+
+    def _record_oom_kill(self, victim: _WorkerEntry, victim_rss: int,
+                         node_memory: Dict[str, int]) -> None:
+        """OOM post-mortem: stamp a GCS ``oom_kill`` event carrying the node
+        memory state, the victim (RSS, role, running task/actor) and the
+        top-10 largest live store objects — what `rt memory --oom` replays.
+        The kill itself already happened; everything here is best-effort."""
+        self._mem_stats["oom_kills"] += 1
+        if self._telemetry:
+            try:
+                self._telemetry_metrics()["oom_kills"].inc(
+                    1.0, {"node_id": self.node_id})
+            except Exception:  # noqa: BLE001
+                pass
+        top = sorted(((oid, m) for oid, m in self._object_meta.items()),
+                     key=lambda kv: -kv[1]["size"])[:10]
+        self._mem_event(
+            "oom_kill",
+            node_memory=dict(node_memory),
+            victim={
+                "worker_id": victim.worker_id, "pid": victim.proc.pid,
+                "rss": victim_rss,
+                "role": "actor" if victim.is_actor_worker else "worker",
+                "actor_id": victim.actor_id,
+                "task": victim.current_task, "busy": victim.busy},
+            top_objects=[{"oid": oid, "size": m["size"],
+                          "state": "spilled" if m.get("spilled")
+                          else "in_memory"} for oid, m in top])
 
     def _pick_oom_victim(self) -> Optional[_WorkerEntry]:
         from ray_tpu import _native
@@ -900,6 +1038,7 @@ class Raylet:
             worker, source = await self._get_worker(key, chips, renv)
             worker.busy = True
             worker.job_id = payload.get("job_id")
+            worker.current_task = payload.get("fn_name")
             self._task_event(task_id, payload.get("fn_name"), "RUNNING")
             t_acq = time.monotonic()
             try:
@@ -1061,14 +1200,32 @@ class Raylet:
     # ---- object plane -------------------------------------------------------
     _PIN_TTL_S = 120.0
 
+    def _purge_stale_pins(self, now: float) -> int:
+        """Drop leaked get-pins (crashed getters): live pins span only a
+        fetch→read window, so a stale ``t`` means nobody is waiting. Runs
+        on the reap-loop TIMER (not just when the pin path happens to get
+        hot), so a leaked pin can't silently exempt its object from
+        spilling for the life of the raylet. Purges are counted — leaked
+        pins are a visible signal, not silent cleanup."""
+        purged = 0
+        for oid_hex, entry in list(self._pinned.items()):
+            if now - entry["t"] > self._PIN_TTL_S:
+                self._pinned.pop(oid_hex, None)
+                purged += 1
+        if purged:
+            self._mem_stats["pin_purges"] += purged
+            if self._telemetry:
+                try:
+                    self._telemetry_metrics()["pin_purges"].inc(
+                        float(purged), {"node_id": self.node_id})
+                except Exception:  # noqa: BLE001 — cleanup must proceed
+                    pass
+        return purged
+
     async def rpc_pin_objects(self, p):
         now = time.monotonic()
         if len(self._pinned) > 1024:
-            # purge leaked entries (crashed getters); live pins span only a
-            # fetch→read window, so a stale ``t`` means nobody is waiting
-            for oid_hex, entry in list(self._pinned.items()):
-                if now - entry["t"] > self._PIN_TTL_S:
-                    self._pinned.pop(oid_hex, None)
+            self._purge_stale_pins(now)  # burst guard between timer ticks
         for oid_hex in p["oids"]:
             entry = self._pinned.setdefault(oid_hex, {"count": 0, "t": now})
             entry["count"] += 1
@@ -1133,21 +1290,33 @@ class Raylet:
         threshold = self._store_capacity * cfg.object_spill_threshold
         if 0 <= self._in_mem_bytes <= threshold:
             return  # negative = drift; fall through so the pass resyncs
-        await asyncio.get_running_loop().run_in_executor(
+        spilled = await asyncio.get_running_loop().run_in_executor(
             self._spill_exec, self._spill_blocking)
+        # telemetry off the IO thread: histograms + instant events per
+        # spilled object (the byte-side twin of the queue-wait histogram)
+        for oid_hex, size, secs in spilled or ():
+            self._mem_stats["spills"] += 1
+            self._mem_stats["spill_bytes"] += size
+            self._mem_stats["spill_seconds"] += secs
+            if self._telemetry:
+                self._telemetry_metrics()["spill_hist"].observe(
+                    secs, {"node_id": self.node_id})
+            self._mem_event("spill", oid=oid_hex, size=size, seconds=secs)
 
-    def _spill_blocking(self) -> None:
+    def _spill_blocking(self) -> List[Tuple[str, int, float]]:
+        """Returns [(oid_hex, size, io_seconds)] for each object spilled."""
         from ray_tpu._private.ids import ObjectID
 
         cfg = get_config()
         threshold = self._store_capacity * cfg.object_spill_threshold
+        out: List[Tuple[str, int, float]] = []
         with self._spill_lock:
             now = time.monotonic()
             in_mem = [(oid, m) for oid, m in self._object_meta.items()
                       if not m["spilled"]]
             used = sum(m["size"] for _, m in in_mem)
             if used <= threshold:
-                return
+                return out
             in_mem.sort(key=lambda kv: kv[1]["t"])  # LRU first
             os.makedirs(self._spill_dir, exist_ok=True)
             for oid_hex, meta in in_mem:
@@ -1160,6 +1329,7 @@ class Raylet:
                     meta["spilled"] = True  # vanished (e.g. freed mid-scan)
                     used -= meta["size"]
                     continue
+                t0 = time.monotonic()
                 tmp = self._spill_path(oid_hex) + ".tmp"
                 with open(tmp, "wb") as f:
                     f.write(view)
@@ -1170,6 +1340,7 @@ class Raylet:
                 self.store.delete(ObjectID.from_hex(oid_hex))
                 meta["spilled"] = True
                 used -= meta["size"]
+                out.append((oid_hex, meta["size"], time.monotonic() - t0))
             # Exact resync of the O(1)-precheck counter: per-op increments
             # race across the loop/executor threads (non-atomic RMW, frees
             # during the scan); recomputing under the lock bounds any drift
@@ -1177,12 +1348,23 @@ class Raylet:
             self._in_mem_bytes = sum(
                 m["size"] for m in self._object_meta.values()
                 if not m["spilled"])
+        return out
 
     async def _restore_from_spill(self, oid_hex: str) -> bool:
         """Disk -> shm (reference: ``SpilledObjectReader`` restore path)."""
+        t0 = time.monotonic()
         restored = await asyncio.get_running_loop().run_in_executor(
             self._spill_exec, self._restore_blocking, oid_hex)
         if restored:
+            secs = time.monotonic() - t0
+            size = self._object_meta.get(oid_hex, {}).get("size", 0)
+            self._mem_stats["restores"] += 1
+            self._mem_stats["restore_bytes"] += size
+            self._mem_stats["restore_seconds"] += secs
+            if self._telemetry:
+                self._telemetry_metrics()["restore_hist"].observe(
+                    secs, {"node_id": self.node_id})
+            self._mem_event("restore", oid=oid_hex, size=size, seconds=secs)
             await self._maybe_spill()  # restoring may push something else out
         return restored
 
@@ -1440,6 +1622,61 @@ class Raylet:
             "queued": len(self._queue),
             "object_store_bytes": self.store.used_bytes(),
             "available": self.node.available.to_dict(),
+        }
+
+    async def rpc_memory_report(self, p):
+        """Node memory introspection for memory_summary() / `rt memory`:
+        store usage by state, cumulative spill/restore/OOM counters, the
+        per-object table (largest first, bounded by ``limit``) and live
+        worker RSS (reference: the NodeManager stats behind
+        ``ray memory`` / ``memory_summary``)."""
+        from ray_tpu import _native
+
+        now_mono = time.monotonic()
+        states = self._store_state_bytes()
+        limit = p.get("limit") or 200
+        objects = []
+        # snapshot first: the spill/restore executor thread inserts keys
+        # concurrently, and a plain .items() walk could see a resize
+        meta_items = list(self._object_meta.items())
+        for oid_hex, meta in meta_items:
+            pinned = self._is_pinned(oid_hex, now_mono)
+            objects.append({
+                "oid": oid_hex, "size": meta["size"],
+                "state": ("spilled" if meta.get("spilled")
+                          else "pinned" if pinned else "in_memory"),
+                "age_s": max(0.0, now_mono - meta["t"]),
+                "pinned": pinned})
+        objects.sort(key=lambda d: -d["size"])
+        by_pid = {e.proc.pid: e for e in self._workers.values()
+                  if e.proc.poll() is None}
+        workers = [{
+            "worker_id": by_pid[pid].worker_id, "pid": pid, "rss": rss,
+            "busy": by_pid[pid].busy,
+            "actor_id": by_pid[pid].actor_id,
+            "task": by_pid[pid].current_task}
+            for pid, rss in _native.process_memory(list(by_pid))
+            if pid in by_pid]
+        mem = _native.memory_info()
+        return {
+            "node_id": self.node_id,
+            "address": self.server.address,
+            "node_memory": {"total": mem.get("total", -1),
+                            "used": mem.get("used", -1)},
+            "store": {
+                "used_bytes": self.store.used_bytes(),
+                "capacity_bytes": self._store_capacity,
+                "in_mem_bytes": states["in_memory"],
+                "spilled_bytes": states["spilled"],
+                "pinned_bytes": states["pinned"],
+                "spilled_count": sum(
+                    1 for _, m in meta_items if m.get("spilled")),
+                "pinned_count": len(self._pinned),
+                "num_objects": len(meta_items),
+                **{k: v for k, v in self._mem_stats.items()},
+            },
+            "objects": objects[:limit],
+            "workers": workers,
         }
 
     async def rpc_dump_stacks(self, p):

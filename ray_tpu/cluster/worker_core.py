@@ -32,6 +32,7 @@ from ray_tpu._private.ids import ActorID, JobID, ObjectID, TaskID
 from ray_tpu._private.serialization import SerializationContext, unpack_payload
 from ray_tpu.core.actor import ActorHandle
 from ray_tpu.core.backend import RuntimeBackend
+from ray_tpu.core import object_ledger
 from ray_tpu.core.object_ref import ObjectRef
 from ray_tpu.core.task_spec import resources_from_options, validate_options
 from ray_tpu.cluster.object_store import PlasmaStore
@@ -303,6 +304,10 @@ class ClusterBackend(RuntimeBackend):
         self.io.run(_go(), timeout=get_config().gcs_rpc_timeout_s)
         if self.role in ("driver", "client") and get_config().log_to_driver:
             self.io.spawn(self._log_forward_loop())
+        if object_ledger.enabled():
+            # ledger snapshots ride the KV like metrics do, so `rt memory`
+            # can join owner/call-site info from every process
+            object_ledger.get_ledger().ensure_pusher()
 
     async def _log_forward_loop(self) -> None:
         """Echo worker stdout/stderr lines to this driver's stderr with a
@@ -364,6 +369,8 @@ class ClusterBackend(RuntimeBackend):
     # ---- serialization helpers ---------------------------------------------
     def _serialize_arg(self, value: Any) -> Tuple:
         if isinstance(value, ObjectRef):
+            if object_ledger.enabled():
+                object_ledger.get_ledger().record_task_arg(value.hex())
             return ("ref", value._descriptor())
         payload = self.serde.serialize(value).to_bytes()
         if len(payload) > _SMALL():
@@ -378,10 +385,16 @@ class ClusterBackend(RuntimeBackend):
         oid = oid or global_worker().next_put_id()
         if not self.shared_store:
             self.io.run(self._upload_object(oid.hex(), payload))
+            if object_ledger.enabled():
+                object_ledger.get_ledger().record_put(
+                    oid.hex(), len(payload), "plasma", owner=self.address)
             return ObjectRef(oid, owner=self.address)
         self.plasma.write_whole(oid, payload)
         self.io.run(self._raylet.call("seal_object",
                                       {"oid": oid.hex(), "size": len(payload)}))
+        if object_ledger.enabled():
+            object_ledger.get_ledger().record_put(
+                oid.hex(), len(payload), "plasma", owner=self.address)
         return ObjectRef(oid, owner=self.address)
 
     async def _upload_object(self, oid_hex: str, payload: bytes) -> None:
@@ -451,6 +464,9 @@ class ClusterBackend(RuntimeBackend):
         if len(payload) > _SMALL():
             return self._put_payload_plasma(payload, oid)
         self.memory_store.put(oid.hex(), payload)
+        if object_ledger.enabled():
+            object_ledger.get_ledger().record_put(
+                oid.hex(), len(payload), "memory", owner=self.address)
         return ObjectRef(oid, owner=self.address)
 
     async def _resolve_payload(self, ref: ObjectRef, timeout: Optional[float],
@@ -619,6 +635,10 @@ class ClusterBackend(RuntimeBackend):
 
     def get(self, refs: Sequence[ObjectRef], timeout: Optional[float]) -> List[Any]:
         self._notify_blocked()
+        if object_ledger.enabled():
+            ledger = object_ledger.get_ledger()
+            for r in refs:
+                ledger.record_get(r.hex())
         # Batched pinning: one pin RPC covers the whole ref set for the
         # duration of the resolve (the per-oid pin in _resolve_payload is
         # skipped). Skipped entirely when every ref is already in our memory
@@ -746,10 +766,14 @@ class ClusterBackend(RuntimeBackend):
         return {"ok": True}
 
     def free_objects(self, refs: Sequence[ObjectRef]) -> None:
+        ledger = (object_ledger.get_ledger()
+                  if object_ledger.enabled() else None)
         for r in refs:
             self.memory_store.delete(r.hex())
             self._lineage.pop(r.hex(), None)
             self._freed[r.hex()] = None
+            if ledger is not None:
+                ledger.record_freed(r.hex())
         while len(self._freed) > 65536:
             self._freed.pop(next(iter(self._freed)))
         self.io.run(self._raylet.call(
@@ -1298,6 +1322,11 @@ class ClusterBackend(RuntimeBackend):
         if self._shutdown:
             return
         self._shutdown = True
+        if object_ledger.enabled():
+            # a dead process's KV ledger snapshot must not keep reporting
+            # its objects as held (workers killed outright are covered by
+            # the staleness filter in util/memory._kv_ledgers)
+            object_ledger.get_ledger().retract(self)
         hook = self._cluster_shutdown_hook
         if hook is not None:
             try:

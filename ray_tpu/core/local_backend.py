@@ -39,17 +39,49 @@ from ray_tpu.exceptions import (
 )
 
 
+def _estimate_size(value: Any) -> int:
+    """Cheap in-memory footprint estimate (no serialization on the local
+    hot path): array buffers dominate real workloads and expose nbytes."""
+    import sys
+
+    nbytes = getattr(value, "nbytes", None)
+    if isinstance(nbytes, int):
+        return nbytes
+    if isinstance(value, (bytes, bytearray, memoryview, str)):
+        return len(value)
+    try:
+        return sys.getsizeof(value)
+    except TypeError:
+        return 0
+
+
 class _ObjectStore:
     """Sealed-once object table with blocking reads."""
 
     def __init__(self):
         self._objects: Dict[ObjectID, Any] = {}
+        # oid -> {"size": estimate, "t": seal time} for memory_summary()
+        self._meta: Dict[ObjectID, Dict[str, float]] = {}
         self._cv = threading.Condition()
 
     def put(self, oid: ObjectID, value: Any) -> None:
         with self._cv:
             self._objects[oid] = value
+            self._meta[oid] = {"size": _estimate_size(value),
+                               "t": time.time()}
             self._cv.notify_all()
+
+    def stats(self) -> Dict[str, Any]:
+        """Store usage + per-object table for the memory plane."""
+        with self._cv:
+            objects = [{"oid": oid.hex(), "size": int(m["size"]),
+                        "state": "in_memory",
+                        "age_s": max(0.0, time.time() - m["t"])}
+                       for oid, m in self._meta.items()]
+        objects.sort(key=lambda d: -d["size"])
+        return {"num_objects": len(objects),
+                "used_bytes": sum(o["size"] for o in objects),
+                "objects": objects}
 
     def contains(self, oid: ObjectID) -> bool:
         with self._cv:
@@ -82,6 +114,7 @@ class _ObjectStore:
         with self._cv:
             for o in oids:
                 self._objects.pop(o, None)
+                self._meta.pop(o, None)
 
 
 class _ActorExecutor:
@@ -184,9 +217,13 @@ class LocalBackend(RuntimeBackend):
     # -- objects -------------------------------------------------------------
     def put(self, value: Any) -> ObjectRef:
         from ray_tpu.core.worker import global_worker
+        from ray_tpu.core import object_ledger
 
         oid = global_worker().next_put_id()
         self._store.put(oid, value)
+        if object_ledger.enabled():
+            object_ledger.get_ledger().record_put(
+                oid.hex(), _estimate_size(value), "local", owner="local")
         return ObjectRef(oid)
 
     def _resolve(self, value: Any) -> Any:
@@ -200,6 +237,12 @@ class LocalBackend(RuntimeBackend):
         return value
 
     def get(self, refs: Sequence[ObjectRef], timeout: Optional[float]) -> List[Any]:
+        from ray_tpu.core import object_ledger
+
+        if object_ledger.enabled():
+            ledger = object_ledger.get_ledger()
+            for r in refs:
+                ledger.record_get(r.hex())
         out = []
         deadline = None if timeout is None else time.monotonic() + timeout
         for r in refs:
@@ -217,7 +260,29 @@ class LocalBackend(RuntimeBackend):
         return ready, not_ready
 
     def free_objects(self, refs: Sequence[ObjectRef]) -> None:
+        from ray_tpu.core import object_ledger
+
         self._store.free([r.id() for r in refs])
+        if object_ledger.enabled():
+            ledger = object_ledger.get_ledger()
+            for r in refs:
+                ledger.record_freed(r.hex())
+
+    def memory_report(self) -> Dict[str, Any]:
+        """The local-mode analog of the raylet's memory_report RPC: one
+        synthetic node whose store is the in-process object table."""
+        stats = self._store.stats()
+        return {"node_id": self._node_id_hex, "address": "local",
+                "store": {"used_bytes": stats["used_bytes"],
+                          "capacity_bytes": 0,
+                          "in_mem_bytes": stats["used_bytes"],
+                          "spilled_bytes": 0, "spilled_count": 0,
+                          "pinned_count": 0,
+                          "num_objects": stats["num_objects"],
+                          "spills": 0, "restores": 0,
+                          "spill_seconds": 0.0, "restore_seconds": 0.0,
+                          "pin_purges": 0, "oom_kills": 0},
+                "objects": stats["objects"], "workers": []}
 
     # -- tasks ---------------------------------------------------------------
     def submit_task(self, fn, options, args, kwargs):

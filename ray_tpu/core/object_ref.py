@@ -12,6 +12,7 @@ from __future__ import annotations
 from typing import Any, Optional, Tuple
 
 from ray_tpu._private.ids import ObjectID
+from ray_tpu.core import object_ledger
 
 
 class ObjectRef:
@@ -21,6 +22,10 @@ class ObjectRef:
         self._id = object_id
         self._owner = owner  # "host:port" of the owning worker, if known
         self._call_site = call_site
+        if object_ledger.enabled():
+            # ownership/reference ledger (`rt memory`): liveness of this ref
+            # is tracked via a weakref, so dropping it needs no release call
+            object_ledger.get_ledger().record_ref(self)
 
     def id(self) -> ObjectID:
         return self._id

@@ -53,6 +53,14 @@ class Worker:
             self.backend.shutdown()
         self.backend = None
         self.mode = None
+        try:
+            # the ownership ledger is session state: entries must not leak
+            # into the next init() in this process (tests re-init a lot)
+            from ray_tpu.core import object_ledger
+
+            object_ledger.get_ledger().clear()
+        except Exception:  # noqa: BLE001 — teardown best-effort
+            pass
 
     def _require_backend(self) -> RuntimeBackend:
         if self.backend is None:

@@ -11,6 +11,8 @@ State API), ``dashboard/modules/metrics`` (Prometheus). Routes:
   GET /api/tasks                recent task events
   GET /api/steps                step-profiler records (profile payloads)
   GET /api/objects              object directory
+  GET /api/memory               memory plane (store usage + owner ledgers)
+  GET /api/logs                 worker log rings (?node=&worker=&limit=)
   GET /api/jobs                 submitted jobs
   GET /api/serve/applications   serve app states
   GET /api/cluster_resources    total/available
@@ -53,6 +55,8 @@ class DashboardActor:
         app.router.add_get("/api/steps", self._gcs_list(
             "list_tasks", {"profile": "only"}))
         app.router.add_get("/api/objects", self._gcs_list("list_objects"))
+        app.router.add_get("/api/memory", self._memory)
+        app.router.add_get("/api/logs", self._logs)
         app.router.add_get("/api/cluster_resources", self._cluster_resources)
         app.router.add_get("/api/jobs", self._jobs)
         app.router.add_get("/api/serve/applications", self._serve_apps)
@@ -182,6 +186,73 @@ class DashboardActor:
         loop = asyncio.get_running_loop()
         out = await loop.run_in_executor(None, fetch)
         return web.json_response(out, dumps=_dumps)
+
+    async def _memory(self, request):
+        """The Memory tab's payload: per-node store reports joined with
+        the ownership ledgers + recent OOM post-mortems (util/memory.py)."""
+        from aiohttp import web
+
+        from ray_tpu.util.memory import memory_snapshot, oom_reports
+
+        limit = int(request.query.get("limit", 200))
+
+        def fetch():
+            snap = memory_snapshot(limit=limit)
+            try:
+                snap["oom_kills"] = oom_reports()
+            except Exception:  # noqa: BLE001 — partial payload is fine
+                snap["oom_kills"] = []
+            return snap
+
+        loop = asyncio.get_running_loop()
+        out = await loop.run_in_executor(None, fetch)
+        return web.json_response(out, dumps=_dumps)
+
+    async def _logs(self, request):
+        """Worker log viewer: drains every raylet's bounded log ring
+        (reference: the dashboard log endpoints over log_monitor state).
+        ``?node=<id prefix>`` limits to one node, ``?worker=<id prefix>``
+        to one worker, ``?limit=`` caps returned lines."""
+        from aiohttp import web
+
+        want_node = request.query.get("node")
+        want_worker = request.query.get("worker")
+        limit = int(request.query.get("limit", 500))
+
+        def fetch():
+            backend = self._backend()
+
+            async def one(n):
+                try:
+                    client = await backend._pool.get(n["address"])
+                    reply = await asyncio.wait_for(
+                        client.call("poll_logs",
+                                    {"after": 0, "timeout": 0.05}), 5.0)
+                    return [{"node_id": n["node_id"], **e}
+                            for e in reply.get("entries", ())]
+                except Exception:  # noqa: BLE001 — partial view is fine
+                    return []
+
+            async def run():
+                nodes = await backend._gcs.call("list_nodes", {})
+                targets = [
+                    n for n in nodes if n.get("alive", True)
+                    and (not want_node
+                         or n["node_id"].startswith(want_node))]
+                chunks = await asyncio.gather(*(one(n) for n in targets))
+                return [e for ch in chunks for e in ch]
+
+            return backend.io.run(run())
+
+        loop = asyncio.get_running_loop()
+        entries = await loop.run_in_executor(None, fetch)
+        if want_worker:
+            entries = [e for e in entries
+                       if str(e.get("worker_id", "")).startswith(
+                           want_worker)]
+        entries.sort(key=lambda e: (e.get("node_id", ""),
+                                    e.get("seq", 0)))
+        return web.json_response(entries[-limit:], dumps=_dumps)
 
     async def _metrics(self, request):
         """User metrics (pushed registries) + system series synthesized

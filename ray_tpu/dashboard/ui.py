@@ -201,6 +201,8 @@ const TABS = [
   {id: "steps", label: "Steps", url: "/api/steps?limit=200"},
   {id: "timeline", label: "Timeline", url: "/api/tasks?limit=500"},
   {id: "objects", label: "Objects", url: "/api/objects?limit=200"},
+  {id: "memory", label: "Memory", url: "/api/memory?limit=100"},
+  {id: "logs", label: "Logs", url: "/api/logs?limit=300"},
   {id: "serve", label: "Serve", url: "/api/serve/applications"},
 ];
 let active = "nodes", paused = false, data = {};
@@ -455,9 +457,104 @@ async function fetchStacks(nodeId) {
   } catch (e) { out.textContent = String(e); }
 }
 
+// --- memory tab: store usage by node + owner ledger + OOM post-mortems ---
+function fmtBytes(n) {
+  if (n == null || n < 0) return "?";
+  const units = ["B", "KiB", "MiB", "GiB", "TiB"];
+  let i = 0;
+  while (Math.abs(n) >= 1024 && i < units.length - 1) { n /= 1024; i++; }
+  return `${n.toFixed(i ? 1 : 0)} ${units[i]}`;
+}
+function shortOid(oid) {
+  oid = String(oid || "");
+  return oid.length <= 18 ? oid
+    : `${oid.slice(0, 8)}..${oid.slice(-8)}`;
+}
+function renderMemory(el) {
+  const snap = data.memory || {};
+  const nodes = snap.nodes || [];
+  if (!nodes.length) {
+    el.innerHTML = `<div class="empty">no memory reports yet</div>`;
+    return;
+  }
+  const nodeRows = nodes.map(n => {
+    if (n.error) return `<tr><td class="id">${esc(n.node_id)}</td>` +
+      `<td colspan="8">${esc(n.error)}</td></tr>`;
+    const s = n.store || {};
+    return `<tr><td class="id">${esc((n.node_id || "").slice(0, 8))}</td>` +
+      `<td>${fmtBytes(s.used_bytes)}</td>` +
+      `<td>${fmtBytes(s.capacity_bytes)}</td>` +
+      `<td>${fmtBytes(s.in_mem_bytes)}</td>` +
+      `<td>${fmtBytes(s.spilled_bytes)} (${esc(s.spilled_count ?? 0)})</td>` +
+      `<td>${esc(s.pinned_count ?? 0)}</td>` +
+      `<td>${esc(s.num_objects ?? 0)}</td>` +
+      `<td>${esc(s.spills ?? 0)}/${esc(s.restores ?? 0)}</td>` +
+      `<td>${esc(s.oom_kills ?? 0)}/${esc(s.pin_purges ?? 0)}</td></tr>`;
+  }).join("");
+  const objs = nodes.flatMap(n => (n.objects || []).map(o =>
+    ({...o, node: (n.node_id || "").slice(0, 8)})))
+    .sort((a, b) => (b.size || 0) - (a.size || 0)).slice(0, 30);
+  const objRows = objs.map(o =>
+    `<tr><td class="id">${esc(o.node)}</td>` +
+    `<td class="id">${esc(shortOid(o.oid))}</td>` +
+    `<td>${fmtBytes(o.size)}</td><td>${statusCell(o.state)}</td>` +
+    `<td>${(o.age_s ?? 0).toFixed(1)}s</td>` +
+    `<td class="id">${esc(o.owner || "")}</td>` +
+    `<td>${esc(o.call_site || "")}</td></tr>`).join("");
+  const suspects = (snap.leak_suspects || []).map(o =>
+    `<tr><td class="id">${esc(shortOid(o.oid))}</td>` +
+    `<td>${fmtBytes(o.size)}</td><td>${esc(o.local_refs ?? "")}</td>` +
+    `<td>${(o.age_s ?? 0).toFixed(0)}s</td>` +
+    `<td>${esc(o.call_site || "")}</td></tr>`).join("");
+  const ooms = (snap.oom_kills || []).map(ev => {
+    const v = ev.victim || {}, m = ev.node_memory || {};
+    return `<tr><td>${esc(new Date(1000 * (ev.t || 0))
+        .toLocaleTimeString())}</td>` +
+      `<td class="id">${esc((ev.node_id || "").slice(0, 8))}</td>` +
+      `<td>${esc(v.role || "")} ${esc((v.worker_id || "").slice(0, 8))}` +
+      `</td><td>${fmtBytes(v.rss)}</td>` +
+      `<td>${esc(v.task || v.actor_id || "(idle)")}</td>` +
+      `<td>${fmtBytes(m.used)} / ${fmtBytes(m.total)}</td></tr>`;
+  }).join("");
+  el.innerHTML =
+    `<h3>Object store by node</h3><table><tr><th>Node</th>` +
+    `<th>Shm used</th><th>Capacity</th><th>In-mem</th><th>Spilled</th>` +
+    `<th>Pins</th><th>Objects</th><th>Spills/restores</th>` +
+    `<th>OOM/pin-purges</th></tr>${nodeRows}</table>` +
+    `<h3>Largest objects</h3>` +
+    (objs.length ? `<table><tr><th>Node</th><th>Object</th><th>Size</th>` +
+      `<th>State</th><th>Age</th><th>Owner</th><th>Call site</th></tr>` +
+      `${objRows}</table>` : `<div class="empty">store empty</div>`) +
+    `<h3>Leak suspects</h3>` +
+    (suspects ? `<table><tr><th>Object</th><th>Size</th>` +
+      `<th>Local refs</th><th>Age</th><th>Call site</th></tr>` +
+      `${suspects}</table>` : `<div class="empty">none</div>`) +
+    `<h3>OOM kills</h3>` +
+    (ooms ? `<table><tr><th>When</th><th>Node</th><th>Victim</th>` +
+      `<th>RSS</th><th>Running</th><th>Node memory</th></tr>` +
+      `${ooms}</table>` : `<div class="empty">none recorded</div>`);
+}
+
+// --- logs tab: the raylets' worker-log rings ---
+function renderLogs(el) {
+  const rows = data.logs || [];
+  if (!rows.length) {
+    el.innerHTML = `<div class="empty">no worker log lines yet</div>`;
+    return;
+  }
+  el.innerHTML = `<div class="tl-head">${rows.length} line(s) — filter ` +
+    `with /api/logs?node=&amp;worker=</div>` +
+    `<pre class="stack-out" style="max-height:70vh">` +
+    rows.map(e => `${esc((e.node_id || "").slice(0, 8))} ` +
+      `${esc((e.worker_id || "").slice(0, 8))} ${esc(e.line)}`)
+      .join("\n") + `</pre>`;
+}
+
 function renderTable() {
   const el = document.getElementById("content");
   if (active === "timeline") { renderTimeline(el); return; }
+  if (active === "memory") { renderMemory(el); return; }
+  if (active === "logs") { renderLogs(el); return; }
   if (active === "serve") {
     const apps = data.serve || {};
     const names = Object.keys(apps);

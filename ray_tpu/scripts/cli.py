@@ -420,6 +420,33 @@ def cmd_trace(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_memory(args: argparse.Namespace) -> int:
+    """rt memory: the byte-side twin of `rt trace` (reference: `ray
+    memory` + memory_summary). Default: per-node store usage + per-object
+    owner tables + leak suspects; --oom replays OOM post-mortems straight
+    from the GCS (no driver attach); --device adds the HBM table."""
+    from ray_tpu.util.memory import format_oom_reports
+
+    if args.oom:
+        gcs = _resolve_gcs(args.address)
+        if gcs is None:
+            print("no running cluster found (pass --address)",
+                  file=sys.stderr)
+            return 1
+        events = _gcs_call(gcs, "list_mem_events",
+                           {"kind": "oom_kill", "limit": args.limit})
+        print(format_oom_reports(events))
+        return 0
+    rt = _attach_driver(args.address)
+    try:
+        print(rt.memory_summary(limit=args.limit, top_n=args.top,
+                                leak_age_s=args.leak_age,
+                                include_devices=args.device))
+        return 0
+    finally:
+        rt.shutdown()
+
+
 def cmd_metrics(args: argparse.Namespace) -> int:
     from ray_tpu.util.metrics import metrics_text
 
@@ -590,6 +617,24 @@ def main(argv=None) -> int:
                                help="aggregated Prometheus metrics page")
     p_metrics.add_argument("--address", default=None)
     p_metrics.set_defaults(fn=cmd_metrics)
+
+    p_mem = sub.add_parser(
+        "memory",
+        help="memory plane: per-node store usage, per-object owner table, "
+             "leak suspects (util/memory.py; `ray memory` analog)")
+    p_mem.add_argument("--address", default=None)
+    p_mem.add_argument("--oom", action="store_true",
+                       help="replay recent OOM-kill post-mortems")
+    p_mem.add_argument("--device", action="store_true",
+                       help="include the per-device HBM table")
+    p_mem.add_argument("--limit", type=int, default=200,
+                       help="per-owner / per-node object rows")
+    p_mem.add_argument("--top", type=int, default=10,
+                       help="rows in the largest-objects view")
+    p_mem.add_argument("--leak-age", type=float, default=None,
+                       help="leak-suspect age threshold seconds "
+                            "(default RT_MEMORY_LEAK_AGE_S)")
+    p_mem.set_defaults(fn=cmd_memory)
 
     p_trace = sub.add_parser(
         "trace",

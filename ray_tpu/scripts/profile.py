@@ -35,14 +35,16 @@ def _find_preset(name: str):
 def _fmt_table(records) -> str:
     head = (f"{'kind':<12} {'step':>4} {'wall ms':>9} {'compile ms':>11} "
             f"{'dispatch ms':>12} {'sync ms':>9} {'launches':>8} "
-            f"{'tokens':>7} {'tok/s':>10} {'MFU':>7}")
+            f"{'tokens':>7} {'tok/s':>10} {'MFU':>7} {'peak HBM MB':>12}")
     lines = [head, "-" * len(head)]
     for r in records:
+        hbm = getattr(r, "hbm_peak_bytes", 0)
+        hbm_col = f"{hbm / 1e6:>12.1f}" if hbm else f"{'-':>12}"
         lines.append(
             f"{r.kind:<12} {r.step:>4} {r.wall_s * 1e3:>9.2f} "
             f"{r.compile_s * 1e3:>11.2f} {r.dispatch_s * 1e3:>12.2f} "
             f"{r.execute_s * 1e3:>9.2f} {r.launches:>8} {r.tokens:>7} "
-            f"{r.tokens_per_s:>10.1f} {r.mfu:>7.4f}")
+            f"{r.tokens_per_s:>10.1f} {r.mfu:>7.4f} {hbm_col}")
     return "\n".join(lines)
 
 

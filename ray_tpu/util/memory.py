@@ -1,0 +1,407 @@
+"""Cluster memory introspection: ``memory_summary()`` / ``rt memory``.
+
+Reference analog: ``ray memory`` / ``ray.internal.memory_summary`` — the
+aggregation that answers "where did the bytes go". Three sources join here:
+
+  1. per-node raylet ``memory_report`` RPCs (store usage by state, the
+     per-object table with spill/pin state, cumulative spill/restore/OOM/
+     pin-purge counters, live worker RSS),
+  2. per-process ownership ledgers (``core/object_ledger.py``): owner,
+     ref kinds (live local refs / task-arg uses / gets), and — under
+     ``RT_RECORD_REF_CREATION_SITES=1`` — the creating call site. Remote
+     processes' ledgers ride the GCS KV under ``@memobj/``; this process's
+     ledger is read live,
+  3. device HBM stats via ``jax.local_devices()[i].memory_stats()``
+     (graceful fallback when the backend lacks it), also published as
+     ``rt_hbm_used_bytes`` gauges.
+
+Works against both backends: the cluster backend fans out over the node
+table; the local (threaded) backend reports its in-process object table as
+one synthetic node. OOM post-mortems (``rt memory --oom``) replay the GCS
+``oom_kill`` mem-events stamped by the raylet memory monitor.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.core import object_ledger
+
+_LEDGER_KV_PREFIX = "@memobj/"
+
+
+def _fmt_bytes(n: Optional[float]) -> str:
+    if n is None or n < 0:
+        return "?"
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024.0 or unit == "TiB":
+            return f"{n:.1f} {unit}" if unit != "B" else f"{int(n)} B"
+        n /= 1024.0
+    return f"{n:.1f} TiB"
+
+
+# ---------------------------------------------------------------------------
+# HBM accounting
+# ---------------------------------------------------------------------------
+
+def device_memory_stats() -> List[Dict[str, Any]]:
+    """Per-device live/peak HBM bytes. ``available=False`` entries mean the
+    backend exposes no ``memory_stats`` (e.g. CPU) — callers must treat the
+    numbers as absent, not zero."""
+    try:
+        import jax
+
+        devices = jax.local_devices()
+    except Exception:  # noqa: BLE001 — no jax in this process
+        return []
+    out = []
+    for d in devices:
+        stats: Dict[str, Any] = {}
+        try:
+            s = d.memory_stats()
+            if s:
+                stats = dict(s)
+        except Exception:  # noqa: BLE001 — backend without memory_stats
+            pass
+        out.append({
+            "id": getattr(d, "id", 0),
+            "platform": getattr(d, "platform", "?"),
+            "kind": getattr(d, "device_kind", "?"),
+            "bytes_in_use": stats.get("bytes_in_use"),
+            "peak_bytes_in_use": stats.get("peak_bytes_in_use"),
+            "bytes_limit": stats.get("bytes_limit"),
+            "available": bool(stats),
+        })
+    return out
+
+
+def publish_hbm_gauges(stats: Optional[List[Dict[str, Any]]] = None
+                       ) -> None:
+    """Set ``rt_hbm_used_bytes{device=}`` from device stats (no-op when the
+    backend has no memory accounting)."""
+    try:
+        from ray_tpu.util import metrics as M
+
+        stats = device_memory_stats() if stats is None else stats
+        gauge = None
+        for d in stats:
+            if d.get("bytes_in_use") is None:
+                continue
+            if gauge is None:
+                gauge = M.get_or_create(
+                    M.Gauge, "rt_hbm_used_bytes",
+                    "Live device (HBM) bytes in use per local device",
+                    tag_keys=("device",))
+            gauge.set(d["bytes_in_use"],
+                      {"device": f"{d['platform']}:{d['id']}"})
+    except Exception:  # noqa: BLE001 — observability never fails the caller
+        pass
+
+
+# ---------------------------------------------------------------------------
+# Aggregation
+# ---------------------------------------------------------------------------
+
+def _cluster_reports(backend, limit: int) -> List[Dict[str, Any]]:
+    async def _go():
+        import asyncio
+
+        nodes = await backend._gcs.call("list_nodes", {})
+
+        async def one(n):
+            try:
+                client = await backend._pool.get(n["address"])
+                return await asyncio.wait_for(
+                    client.call("memory_report", {"limit": limit}), 15.0)
+            except Exception as e:  # noqa: BLE001 — partial view is fine
+                return {"node_id": n["node_id"], "address": n["address"],
+                        "error": f"{type(e).__name__}: {e}"}
+
+        return list(await asyncio.gather(
+            *(one(n) for n in nodes if n.get("alive"))))
+
+    return backend.io.run(_go())
+
+
+# Snapshots older than this are treated as dead-process remnants (live
+# pushers refresh every ~5s; shutdown retracts the key, but a worker
+# killed outright — OOM, crash — leaves its last push behind).
+_LEDGER_STALE_S = 30.0
+
+
+def _kv_ledgers(backend) -> List[Dict[str, Any]]:
+    """Every live process's pushed ownership-ledger snapshot, this
+    process's live ledger folded in last (it is fresher than its last
+    push). Stale snapshots (dead processes) are dropped."""
+    out: List[Dict[str, Any]] = []
+    now = time.time()
+    try:
+        for key in backend.kv_keys(_LEDGER_KV_PREFIX):
+            raw = backend.kv_get(key)
+            if not raw:
+                continue
+            try:
+                led = json.loads(raw)
+            except (ValueError, KeyError):
+                continue
+            if now - led.get("t", 0.0) <= _LEDGER_STALE_S:
+                out.append(led)
+    except Exception:  # noqa: BLE001 — KV unavailable (local backend)
+        pass
+    own = object_ledger.get_ledger().snapshot()
+    out = [l for l in out
+           if l.get("owner") != getattr(backend, "address", "local")]
+    out.append({"t": now,
+                "owner": getattr(backend, "address", "local"),
+                "objects": own})
+    return out
+
+
+def _merge_owner_info(ledgers: List[Dict[str, Any]]
+                      ) -> Dict[str, Dict[str, Any]]:
+    """oid -> best-known ledger entry across processes. The OWNER's entry
+    (real size, creation call site) must win over a borrower's info-poor
+    one (a worker that only received the ref as a task arg)."""
+    info: Dict[str, Dict[str, Any]] = {}
+    for led in ledgers:
+        for obj in led.get("objects", ()):
+            obj = dict(obj)
+            obj.setdefault("owner", led.get("owner"))
+            cur = info.get(obj["oid"])
+            if cur is None:
+                info[obj["oid"]] = obj
+                continue
+            richer = ((bool(obj.get("call_site"))
+                       and not cur.get("call_site"))
+                      or obj.get("size", 0) > cur.get("size", 0))
+            if richer:
+                # keep the union of ref counts: they are per-process views
+                for k in ("local_refs", "task_arg_uses", "get_count"):
+                    obj[k] = obj.get(k, 0) + cur.get(k, 0)
+                obj["last_get_at"] = max(obj.get("last_get_at", 0.0),
+                                         cur.get("last_get_at", 0.0))
+                info[obj["oid"]] = obj
+            else:
+                for k in ("local_refs", "task_arg_uses", "get_count"):
+                    cur[k] = cur.get(k, 0) + obj.get(k, 0)
+                cur["last_get_at"] = max(cur.get("last_get_at", 0.0),
+                                         obj.get("last_get_at", 0.0))
+    return info
+
+
+def _suspects_from_ledgers(owner_info: Dict[str, Dict[str, Any]],
+                           age_s: Optional[float]) -> List[Dict[str, Any]]:
+    """Leak suspects computed over the AGGREGATED ledgers, so `rt memory`
+    (a fresh attached driver) and the dashboard see the leaking driver's
+    refs, not just their own empty ledger: objects past the age threshold
+    whose only references are local refs somewhere, never consumed by a
+    task and not recently read."""
+    if age_s is None:
+        from ray_tpu._private.config import get_config
+
+        age_s = get_config().memory_leak_age_s
+    now = time.time()
+    out = []
+    for o in owner_info.values():
+        if o.get("state") == "freed" or o.get("local_refs", 0) <= 0:
+            continue
+        age = now - o.get("created_at", now)
+        if age < age_s:
+            continue
+        if o.get("task_arg_uses", 0) == 0 and (
+                o.get("last_get_at", 0.0) == 0.0
+                or now - o["last_get_at"] >= age_s):
+            d = dict(o)
+            d["age_s"] = age
+            out.append(d)
+    out.sort(key=lambda d: -d.get("size", 0))
+    return out
+
+
+def memory_snapshot(limit: int = 200,
+                    leak_age_s: Optional[float] = None,
+                    include_devices: bool = True) -> Dict[str, Any]:
+    """The structured form behind ``memory_summary()`` and the dashboard's
+    ``/api/memory``."""
+    import ray_tpu
+
+    backend = ray_tpu.global_worker()._require_backend()
+    if hasattr(backend, "_gcs"):
+        nodes = _cluster_reports(backend, limit)
+    else:
+        nodes = [backend.memory_report()]
+    ledgers = _kv_ledgers(backend)
+    owner_info = _merge_owner_info(ledgers)
+    # annotate the store objects with ownership where known
+    for n in nodes:
+        for obj in n.get("objects", ()):
+            info = owner_info.get(obj["oid"])
+            if info:
+                obj["owner"] = info.get("owner")
+                obj["call_site"] = info.get("call_site", "")
+                obj["local_refs"] = info.get("local_refs", 0)
+    suspects = _suspects_from_ledgers(owner_info, leak_age_s)
+    snap = {
+        "t": time.time(),
+        "nodes": nodes,
+        "ledgers": ledgers,
+        "leak_suspects": suspects,
+    }
+    if include_devices:
+        devs = device_memory_stats()
+        publish_hbm_gauges(devs)
+        snap["devices"] = devs
+    return snap
+
+
+def oom_reports(limit: int = 20) -> List[Dict[str, Any]]:
+    """The most recent ``oom_kill`` post-mortem events from the GCS."""
+    import ray_tpu
+
+    backend = ray_tpu.global_worker()._require_backend()
+    if not hasattr(backend, "_gcs"):
+        return []
+    return backend.io.run(backend._gcs.call(
+        "list_mem_events", {"kind": "oom_kill", "limit": limit}))
+
+
+# ---------------------------------------------------------------------------
+# Formatting
+# ---------------------------------------------------------------------------
+
+def _short_oid(oid: str) -> str:
+    """Head..tail form: a put/return oid's distinguishing bits (the index)
+    live at the END of the 48-char hex, so a plain prefix is ambiguous."""
+    return oid if len(oid) <= 18 else f"{oid[:8]}..{oid[-8:]}"
+
+
+def _object_row(o: Dict[str, Any]) -> str:
+    site = o.get("call_site") or ""
+    refs = (f"{o.get('local_refs', '?')}/"
+            f"{o.get('task_arg_uses', '?')}/{o.get('get_count', '?')}")
+    return (f"  {_short_oid(o['oid']):<18} {_fmt_bytes(o.get('size')):>12} "
+            f"{o.get('state', '?'):<10} {refs:>8} "
+            f"{o.get('age_s', 0.0):>8.1f}s  {site}")
+
+
+def memory_summary(limit: int = 200, top_n: int = 10,
+                   leak_age_s: Optional[float] = None,
+                   include_devices: bool = False,
+                   group_by: str = "owner") -> str:
+    """Human-readable memory plane report (what ``rt memory`` prints)."""
+    snap = memory_snapshot(limit=limit, leak_age_s=leak_age_s,
+                           include_devices=include_devices)
+    lines: List[str] = []
+    lines.append("=== Per-node object store usage ===")
+    head = (f"{'node':<10} {'shm used':>12} {'capacity':>12} "
+            f"{'in-mem':>12} {'spilled':>12} {'pinned':>10} "
+            f"{'objs':>6} {'spills':>7} {'restores':>9} "
+            f"{'pin-purges':>11} {'oom-kills':>10}")
+    lines.append(head)
+    for n in snap["nodes"]:
+        if n.get("error"):
+            lines.append(f"{n['node_id'][:8]:<10} unreachable: {n['error']}")
+            continue
+        s = n.get("store", {})
+        spilled = (f"{_fmt_bytes(s.get('spilled_bytes'))} "
+                   f"({s.get('spilled_count', 0)})")
+        lines.append(
+            f"{n['node_id'][:8]:<10} {_fmt_bytes(s.get('used_bytes')):>12} "
+            f"{_fmt_bytes(s.get('capacity_bytes')):>12} "
+            f"{_fmt_bytes(s.get('in_mem_bytes')):>12} "
+            f"{spilled:>12} {s.get('pinned_count', 0):>10} "
+            f"{s.get('num_objects', 0):>6} {int(s.get('spills', 0)):>7} "
+            f"{int(s.get('restores', 0)):>9} "
+            f"{int(s.get('pin_purges', 0)):>11} "
+            f"{int(s.get('oom_kills', 0)):>10}")
+
+    lines.append("")
+    lines.append("=== Objects by owner "
+                 "(refs = local/task-arg/gets) ===")
+    for led in snap["ledgers"]:
+        objs = led.get("objects") or []
+        if not objs:
+            continue
+        total = sum(o.get("size", 0) for o in objs)
+        lines.append(f"owner {led.get('owner', '?')} — {len(objs)} "
+                     f"object(s), {_fmt_bytes(total)}")
+        for o in objs[:limit]:
+            o = dict(o)
+            now = time.time()
+            o.setdefault("age_s", max(0.0, now - o.get("created_at", now)))
+            lines.append(_object_row(o))
+
+    all_store_objs = [dict(o, node=n["node_id"][:8])
+                      for n in snap["nodes"] if not n.get("error")
+                      for o in n.get("objects", ())]
+    all_store_objs.sort(key=lambda o: -o.get("size", 0))
+    lines.append("")
+    lines.append(f"=== Top {top_n} largest store objects ===")
+    if not all_store_objs:
+        lines.append("  (store empty)")
+    for o in all_store_objs[:top_n]:
+        lines.append(
+            f"  {o['node']:<10} {_short_oid(o['oid']):<18} "
+            f"{_fmt_bytes(o['size']):>12} {o.get('state', '?'):<10} "
+            f"{o.get('age_s', 0.0):>8.1f}s  "
+            f"owner={o.get('owner', '?')} {o.get('call_site', '')}")
+
+    lines.append("")
+    suspects = snap["leak_suspects"]
+    if suspects:
+        lines.append(f"=== Leak suspects ({len(suspects)}): driver-local "
+                     f"refs only, past the age threshold ===")
+        for o in suspects[:top_n]:
+            lines.append(_object_row(o))
+    else:
+        lines.append("=== Leak suspects: none ===")
+
+    if include_devices:
+        lines.append("")
+        lines.append("=== Devices (HBM) ===")
+        devs = snap.get("devices") or []
+        if not devs:
+            lines.append("  (no jax devices visible in this process)")
+        for d in devs:
+            if d["available"]:
+                lines.append(
+                    f"  {d['platform']}:{d['id']} {d['kind']:<16} "
+                    f"in use {_fmt_bytes(d['bytes_in_use']):>12}  "
+                    f"peak {_fmt_bytes(d['peak_bytes_in_use']):>12}  "
+                    f"limit {_fmt_bytes(d['bytes_limit']):>12}")
+            else:
+                lines.append(f"  {d['platform']}:{d['id']} {d['kind']:<16} "
+                             f"(no memory_stats on this backend)")
+    return "\n".join(lines)
+
+
+def format_oom_reports(events: List[Dict[str, Any]]) -> str:
+    """Render ``oom_kill`` post-mortems (newest last)."""
+    if not events:
+        return "(no oom_kill events recorded)"
+    lines: List[str] = []
+    for ev in events:
+        when = time.strftime("%Y-%m-%d %H:%M:%S",
+                             time.localtime(ev.get("t", 0)))
+        mem = ev.get("node_memory", {})
+        v = ev.get("victim", {})
+        lines.append(f"--- oom_kill @ {when} node={ev.get('node_id', '?')[:8]}"
+                     f" ---")
+        lines.append(
+            f"  node memory: {_fmt_bytes(mem.get('used'))} / "
+            f"{_fmt_bytes(mem.get('total'))}")
+        task = v.get("task") or (f"actor {v.get('actor_id')}"
+                                 if v.get("actor_id") else "(idle)")
+        lines.append(
+            f"  victim: {v.get('role', 'worker')} "
+            f"{str(v.get('worker_id'))[:8]} pid={v.get('pid')} "
+            f"rss={_fmt_bytes(v.get('rss'))} running {task}")
+        top = ev.get("top_objects") or []
+        if top:
+            lines.append("  largest live store objects at kill time:")
+            for o in top:
+                lines.append(f"    {_short_oid(o['oid']):<18} "
+                             f"{_fmt_bytes(o['size']):>12} {o['state']}")
+    return "\n".join(lines)

@@ -155,6 +155,12 @@ class Gauge(Metric):
     def dec(self, value: float = 1.0, tags=None) -> None:
         self.inc(-value, tags)
 
+    def remove(self, tags: Optional[Dict[str, str]] = None) -> None:
+        """Drop one labeled sample (e.g. a dead worker's RSS gauge) so
+        stale series don't linger on the Prometheus page forever."""
+        with self._lock:
+            self._values.pop(_label_key(self._tags(tags)), None)
+
     def to_dict(self) -> Dict:
         with self._lock:
             return {"type": "gauge", "name": self.name,

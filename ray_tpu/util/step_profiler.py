@@ -110,6 +110,7 @@ class StepRecord:
     mfu: float
     first_call: bool
     meta: Dict[str, Any]
+    hbm_peak_bytes: int = 0  # max per-device peak HBM (0 = no accounting)
 
     def to_dict(self) -> Dict[str, Any]:
         return dataclasses.asdict(self)
@@ -146,6 +147,26 @@ def _peak_total() -> float:
         * max(1, jax.local_device_count())
 
 
+def _hbm_peak_bytes() -> int:
+    """Max per-device ``peak_bytes_in_use`` across local devices (the
+    step record's peak-HBM column), refreshing the ``rt_hbm_used_bytes``
+    live gauges on the way. One implementation — util/memory.py — owns
+    device probing and gauge registration; backends without
+    ``memory_stats`` (CPU) report 0."""
+    try:
+        from ray_tpu.util.memory import (
+            device_memory_stats,
+            publish_hbm_gauges,
+        )
+
+        stats = device_memory_stats()
+        publish_hbm_gauges(stats)
+        return max((d.get("peak_bytes_in_use") or d.get("bytes_in_use")
+                    or 0 for d in stats), default=0)
+    except Exception:  # noqa: BLE001 — profiling must never fail the step
+        return 0
+
+
 def record(kind: str, *, name: str = "", t_start: Optional[float] = None,
            wall_s: float, compile_s: float = 0.0, dispatch_s: float = 0.0,
            execute_s: float = 0.0, launches: int = 1, tokens: int = 0,
@@ -164,6 +185,7 @@ def record(kind: str, *, name: str = "", t_start: Optional[float] = None,
             mfu = 0.0
     else:
         mfu = 0.0
+    hbm_peak = _hbm_peak_bytes()
     with _lock:
         _seq += 1
         step = _per_kind_step.get(kind, 0)
@@ -174,7 +196,8 @@ def record(kind: str, *, name: str = "", t_start: Optional[float] = None,
             wall_s=wall_s, compile_s=compile_s, dispatch_s=dispatch_s,
             execute_s=execute_s, launches=launches, tokens=tokens,
             flops=flops, tokens_per_s=tok_s, mfu=mfu,
-            first_call=first_call, meta=dict(meta or {}))
+            first_call=first_call, meta=dict(meta or {}),
+            hbm_peak_bytes=hbm_peak)
         _records.append(rec)
     _observe_metrics(rec)
     _ensure_drainer()
@@ -254,6 +277,7 @@ def summary(kind: Optional[str] = None) -> Dict[str, Any]:
         "tokens_per_s": (sum(r.tokens for r in steady) / wall
                          if wall > 0 else 0.0),
         "mean_mfu": sum(r.mfu for r in steady) / n,
+        "peak_hbm_bytes": max((r.hbm_peak_bytes for r in rs), default=0),
     }
 
 

@@ -67,10 +67,42 @@ def timeline(filename: Optional[str] = None) -> List[Dict[str, Any]]:
             })
         if ev.get("phases"):
             trace.extend(_phase_lanes(ev))
+    trace.extend(_memory_instants(backend))
     if filename:
         with open(filename, "w") as f:
             json.dump(trace, f)
     return trace
+
+
+def _memory_instants(backend) -> List[Dict[str, Any]]:
+    """Spill / restore / oom_kill instant markers on a per-node ``memory``
+    track, merged from the GCS mem-event store (cluster/raylet.py stamps
+    them; `rt memory --oom` replays the oom_kill payloads)."""
+    try:
+        events = backend.io.run(backend._gcs.call(
+            "list_mem_events", {"limit": 2000}))
+    except Exception:  # noqa: BLE001 — older GCS / local backend
+        return []
+    out: List[Dict[str, Any]] = []
+    for ev in events or ():
+        kind = ev.get("kind", "mem")
+        name = kind
+        args: Dict[str, Any] = {}
+        if kind in ("spill", "restore"):
+            name = f"{kind} {str(ev.get('oid', ''))[:8]}"
+            args = {"oid": ev.get("oid"), "size": ev.get("size"),
+                    "seconds": ev.get("seconds")}
+        elif kind == "oom_kill":
+            victim = ev.get("victim", {})
+            name = f"oom_kill {str(victim.get('worker_id', ''))[:8]}"
+            args = {"victim": victim, "node_memory": ev.get("node_memory")}
+        out.append({
+            "name": name, "cat": "memory", "ph": "i", "s": "t",
+            "ts": ev.get("t", 0.0) * 1e6,
+            "pid": ev.get("node_id") or "node", "tid": "memory",
+            "args": args,
+        })
+    return out
 
 
 def _phase_lanes(ev: Dict[str, Any]) -> List[Dict[str, Any]]:

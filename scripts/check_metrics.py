@@ -1,0 +1,114 @@
+#!/usr/bin/env python3
+"""Metrics-doc lint: every registered ``rt_*`` metric must be unique and
+documented.
+
+Wired as a tier-1 test (``tests/test_zz_metrics_doc.py``) so a new
+Prometheus series cannot ship undocumented:
+
+  1. scans ``ray_tpu/**/*.py`` for metric registrations —
+     ``M.get_or_create(M.<Kind>, "rt_...")`` sites plus the dashboard's
+     synthesized ``SYSTEM_METRICS`` table;
+  2. asserts no name is registered under conflicting kinds (two sites may
+     share a name ONLY with the same kind — that is the get_or_create
+     idiom for one series observed from several processes);
+  3. asserts every registered name appears in README.md's
+     "Metrics reference" table with the matching kind, and that the table
+     carries no stale rows for series that no longer exist.
+
+Run directly: ``python scripts/check_metrics.py`` (exit 0 = clean).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+from typing import Dict, List, Tuple
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_GET_OR_CREATE = re.compile(
+    r"get_or_create\(\s*M\.(Counter|Gauge|Histogram)\s*,\s*"
+    r"\"(rt_[a-z0-9_]+)\"", re.S)
+_SYSTEM_ROW = re.compile(
+    r"\"(rt_[a-z0-9_]+)\":\s*\(\"(gauge|counter|histogram)\"")
+_README_ROW = re.compile(
+    r"^\|\s*`(rt_[a-z0-9_]+)`\s*\|\s*(counter|gauge|histogram)\s*\|", re.M)
+
+
+def registered_metrics() -> Dict[str, List[Tuple[str, str]]]:
+    """name -> [(kind, relpath), ...] across every registration site."""
+    regs: Dict[str, List[Tuple[str, str]]] = {}
+    pkg = os.path.join(ROOT, "ray_tpu")
+    for dirpath, _, files in os.walk(pkg):
+        for fn in files:
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            rel = os.path.relpath(path, ROOT)
+            try:
+                with open(path, encoding="utf-8") as f:
+                    src = f.read()
+            except OSError:
+                continue
+            for kind, name in _GET_OR_CREATE.findall(src):
+                regs.setdefault(name, []).append((kind.lower(), rel))
+            if "SYSTEM_METRICS" in src:
+                for name, kind in _SYSTEM_ROW.findall(src):
+                    regs.setdefault(name, []).append((kind, rel))
+    return regs
+
+
+def documented_metrics() -> Dict[str, str]:
+    with open(os.path.join(ROOT, "README.md"), encoding="utf-8") as f:
+        readme = f.read()
+    return {name: kind for name, kind in _README_ROW.findall(readme)}
+
+
+def check() -> List[str]:
+    problems: List[str] = []
+    regs = registered_metrics()
+    if not regs:
+        return ["no rt_* metric registrations found — the scanner regexes "
+                "no longer match the registration idiom"]
+    docs = documented_metrics()
+    if not docs:
+        problems.append("README.md has no 'Metrics reference' table rows "
+                        "(| `rt_name` | kind | description |)")
+    for name, sites in sorted(regs.items()):
+        kinds = {k for k, _ in sites}
+        if len(kinds) > 1:
+            problems.append(
+                f"{name}: registered under conflicting kinds "
+                f"{sorted(kinds)} at {sorted(p for _, p in sites)}")
+            continue
+        kind = next(iter(kinds))
+        if name not in docs:
+            problems.append(
+                f"{name} ({kind}, {sites[0][1]}): not documented in "
+                f"README.md's metrics table")
+        elif docs[name] != kind:
+            problems.append(
+                f"{name}: registered as {kind} ({sites[0][1]}) but "
+                f"documented as {docs[name]}")
+    for name in sorted(set(docs) - set(regs)):
+        problems.append(f"{name}: documented in README.md but never "
+                        f"registered in ray_tpu/ (stale row?)")
+    return problems
+
+
+def main() -> int:
+    problems = check()
+    if problems:
+        print("metrics-doc lint FAILED:", file=sys.stderr)
+        for p in problems:
+            print(f"  - {p}", file=sys.stderr)
+        return 1
+    regs = registered_metrics()
+    print(f"metrics-doc lint OK: {len(regs)} rt_* series registered, "
+          f"all documented")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
