@@ -19,6 +19,7 @@ import time
 from typing import Any, Dict, List, Optional, Set, Tuple
 
 from ray_tpu._private.config import get_config
+from ray_tpu.core import failure as F
 from ray_tpu.core.resources import NodeResources, ResourceSet
 from ray_tpu.cluster.rpc import ConnectionPool, spawn_task
 from ray_tpu.scheduler.policy import pick_node
@@ -47,7 +48,8 @@ class _ActorEntry:
         self.address: Optional[str] = None
         self.node_id: Optional[str] = None
         self.num_restarts = 0
-        self.death_reason = ""
+        self.death_reason = ""           # str(death_cause): legacy renderers
+        self.death_cause: Optional[Dict[str, Any]] = None  # failure.py wire
         self.waiters: List[asyncio.Future] = []
 
     def __getstate__(self):  # snapshot persistence: waiters are loop-affine
@@ -63,6 +65,7 @@ class _ActorEntry:
             "class_name": self.spec.get("class_name"),
             "num_restarts": self.num_restarts,
             "death_reason": self.death_reason,
+            "death_cause": getattr(self, "death_cause", None),
             "max_task_retries": self.spec.get("max_task_retries", 0),
         }
 
@@ -378,6 +381,11 @@ class GcsServer:
             "available": n.view.available.to_dict(),
             "labels": dict(n.view.labels),
             "queue_depth": getattr(n, "queue_depth", 0),
+            # dead rows persist for the cluster's lifetime: when + why the
+            # node died lets `rt doctor` window its findings instead of
+            # flagging a drain from hours ago as critical forever
+            "death_t": getattr(n, "death_t", None),
+            "death_reason": getattr(n, "death_reason", ""),
         } for n in self.nodes.values()]
 
     async def rpc_drain_node(self, p):
@@ -407,8 +415,10 @@ class GcsServer:
                             and actor.node_id is not None
                             and actor.node_id not in self.nodes):
                         await self._handle_actor_failure(
-                            actor, "node never re-registered after GCS "
-                                   "restart")
+                            actor, F.cause_dict(
+                                F.NODE_DEATH,
+                                "node never re-registered after GCS "
+                                "restart", node_id=actor.node_id))
             try:
                 # pickle+write runs OFF the loop: a large table snapshot
                 # must not stall heartbeat handling (and spuriously kill
@@ -426,6 +436,11 @@ class GcsServer:
     async def _mark_node_dead(self, entry: _NodeEntry, reason: str) -> None:
         self.mark_dirty()  # internal transitions must persist too
         entry.alive = False
+        entry.death_t = time.time()
+        entry.death_reason = reason
+        self._record_failure({
+            "category": F.NODE_DEATH, "message": f"node died: {reason}",
+            "node_id": entry.node_id, "address": entry.address})
         # Objects whose only copy was there are lost (lineage reconstruction
         # is a later round); actors there restart elsewhere if budgeted.
         for oid, locs in list(self.object_locations.items()):
@@ -433,7 +448,9 @@ class GcsServer:
         for actor in list(self.actors.values()):
             if actor.node_id == entry.node_id and actor.state in (
                     ACTOR_ALIVE, ACTOR_PENDING, ACTOR_RESTARTING):
-                await self._handle_actor_failure(actor, f"node died: {reason}")
+                await self._handle_actor_failure(actor, F.cause_dict(
+                    F.NODE_DEATH, f"node died: {reason}",
+                    node_id=entry.node_id))
         # Reschedule ONLY the lost bundles of affected placement groups
         # (reference: GcsPlacementGroupManager PG rescheduling on node death).
         # Surviving bundles keep their reservations — actors/tasks inside
@@ -591,6 +608,7 @@ class GcsServer:
                                   + (cfg.runtime_env_setup_timeout_s
                                      if entry.spec.get("runtime_env") else 0)
                                   + 30.0)
+                restarts_before = entry.num_restarts
                 reply = await client.call("create_actor", {
                     "actor_id": entry.actor_id, "spec": entry.spec},
                     timeout=create_timeout)
@@ -611,8 +629,23 @@ class GcsServer:
                 if reply.get("retry"):
                     await asyncio.sleep(0.2)
                     continue
+                if (entry.state == ACTOR_DEAD
+                        or entry.num_restarts != restarts_before):
+                    # the raylet reported this same death via actor_update
+                    # BEFORE replying and _handle_actor_failure already
+                    # scheduled a restart (num_restarts moved) or finalized
+                    # — finalizing here would burn the restart budget the
+                    # GCS just honored. A reply with NO matching
+                    # actor_update (raylet spawn failure / startup timeout:
+                    # its generic except path never updates) falls through,
+                    # so the actor still dies loudly instead of wedging in
+                    # RESTARTING forever.
+                    return
                 await self._finalize_actor_death(
-                    entry, reply.get("error", "creation failed"))
+                    entry, reply.get("cause") or F.cause_dict(
+                        F.WORKER_CRASH,
+                        reply.get("error", "creation failed"),
+                        node_id=node_id))
                 return
             except Exception:  # node unreachable or create timed out
                 # If the create was merely SLOW (not dead), its worker may
@@ -623,7 +656,8 @@ class GcsServer:
                                                      entry.actor_id))
                 self._pool.invalidate(node.address)
                 await asyncio.sleep(0.2)
-        await self._finalize_actor_death(entry, "scheduling timed out")
+        await self._finalize_actor_death(entry, F.cause_dict(
+            F.SCHEDULING_TIMEOUT, "scheduling timed out"))
 
     async def _kill_stale_creation(self, address: str, actor_id: str) -> None:
         try:
@@ -638,7 +672,9 @@ class GcsServer:
         """Resolve (and fix) the bundle an actor lands in; None = not ready."""
         pg = self.placement_groups.get(pg_info["pg_id"])
         if pg is None or pg.state == PG_REMOVED:
-            await self._finalize_actor_death(entry, "placement group removed")
+            await self._finalize_actor_death(entry, F.cause_dict(
+                F.PG_REMOVED, "placement group removed",
+                pg_id=pg_info.get("pg_id")))
             return None
         if pg.state != PG_CREATED:
             return None
@@ -686,7 +722,10 @@ class GcsServer:
             if (reporter is not None and entry.node_id is not None
                     and reporter != entry.node_id):
                 return {"ok": True, "stale": True}
-            await self._handle_actor_failure(entry, p.get("reason", "worker died"))
+            await self._handle_actor_failure(
+                entry, p.get("cause") or F.cause_dict(
+                    F.WORKER_CRASH, p.get("reason", "worker died"),
+                    node_id=reporter))
         return {"ok": True}
 
     async def rpc_actor_unreachable(self, p):
@@ -702,14 +741,35 @@ class GcsServer:
         node = self.nodes.get(entry.node_id or "")
         if node is not None and node.alive:
             return {"ok": False}  # node looks fine; caller should retry
-        await self._handle_actor_failure(
-            entry, "reported unreachable and its node is gone")
+        await self._handle_actor_failure(entry, F.cause_dict(
+            F.NODE_DEATH, "reported unreachable and its node is gone",
+            node_id=entry.node_id))
         return {"ok": True}
 
-    async def _handle_actor_failure(self, entry: _ActorEntry, reason: str) -> None:
+    def _observe_actor_restart(self) -> None:
+        """``rt_actor_restarts_total``: restarts the GCS scheduled after an
+        actor worker died with budget left. Registry-local; shipped by the
+        co-resident pusher (driver, or the head raylet's)."""
+        try:
+            from ray_tpu.util import metrics as M
+
+            if not hasattr(self, "_restart_counter"):
+                self._restart_counter = M.get_or_create(
+                    M.Counter, "rt_actor_restarts_total",
+                    "Actor restarts scheduled by the GCS after a failure")
+            self._restart_counter.inc()
+        except Exception:  # noqa: BLE001 — telemetry only
+            pass
+
+    async def _handle_actor_failure(self, entry: _ActorEntry, reason) -> None:
+        """``reason`` is a ``failure.py`` cause dict (legacy strings are
+        coerced). With restart budget left the actor restarts and the
+        failure is recorded with the underlying category; an exhausted
+        budget re-categorizes the terminal event as restart-exhausted."""
         self.mark_dirty()
         if entry.state == ACTOR_DEAD:
             return
+        cause = F.FailureCause.from_value(reason)
         max_restarts = entry.spec.get("max_restarts", 0)
         if entry.spec.get("_explicit_kill"):
             max_restarts = 0
@@ -717,16 +777,40 @@ class GcsServer:
             entry.num_restarts += 1
             entry.state = ACTOR_RESTARTING
             entry.address = None
+            self._observe_actor_restart()
+            self._record_failure({
+                "category": cause.category, "message": str(cause),
+                "actor_id": entry.actor_id,
+                "name": entry.spec.get("class_name"),
+                "node_id": cause.context.get("node_id", entry.node_id),
+                "restarting": True, "num_restarts": entry.num_restarts})
             # Backoff happens inside the spawned task — this path runs on the
             # monitor loop and must not stall node-death handling.
             spawn_task(self._schedule_actor(
                 entry, backoff=get_config().actor_restart_backoff_s))
         else:
-            await self._finalize_actor_death(entry, reason)
+            if entry.num_restarts >= max_restarts > 0:
+                # the budget existed and is spent: the terminal cause is
+                # the exhaustion itself; the last underlying cause rides
+                # the message
+                cause = F.FailureCause(
+                    F.ACTOR_RESTART_EXHAUSTED,
+                    f"out of restarts ({entry.num_restarts}/"
+                    f"{max_restarts}); last failure: {cause}",
+                    **cause.context)
+            await self._finalize_actor_death(entry, cause)
 
-    async def _finalize_actor_death(self, entry: _ActorEntry, reason: str) -> None:
+    async def _finalize_actor_death(self, entry: _ActorEntry, reason) -> None:
+        cause = F.FailureCause.from_value(reason)
         entry.state = ACTOR_DEAD
-        entry.death_reason = reason
+        entry.death_reason = str(cause)
+        entry.death_cause = dict(
+            cause.to_dict(), actor_id=entry.actor_id,
+            num_restarts=entry.num_restarts,
+            node_id=cause.context.get("node_id", entry.node_id),
+            t=time.time())  # recency: rt doctor windows actor findings
+        self._record_failure(dict(
+            entry.death_cause, name=entry.spec.get("class_name")))
         name, ns = entry.spec.get("name"), entry.spec.get("namespace", "default")
         if name is not None and self.named_actors.get((ns, name)) == entry.actor_id:
             del self.named_actors[(ns, name)]
@@ -779,7 +863,8 @@ class GcsServer:
                     await client.call("kill_actor", {"actor_id": entry.actor_id})
                 except Exception:
                     pass
-        await self._finalize_actor_death(entry, "killed via kill()")
+        await self._finalize_actor_death(entry, F.cause_dict(
+            F.CANCELLED, "killed via kill()"))
         return {"ok": True}
 
     async def rpc_list_actors(self, p):
@@ -977,7 +1062,9 @@ class GcsServer:
                     await client.call("kill_actor", {"actor_id": actor.actor_id})
                 except Exception:
                     pass
-            await self._finalize_actor_death(actor, "placement group removed")
+            await self._finalize_actor_death(actor, F.cause_dict(
+                F.PG_REMOVED, "placement group removed",
+                pg_id=entry.pg_id))
         for i, nid in enumerate(entry.bundle_nodes):
             if nid is None or nid not in self.nodes:
                 continue
@@ -1092,6 +1179,85 @@ class GcsServer:
         kind = p.get("kind")
         if kind:
             events = [e for e in events if e.get("kind") == kind]
+        limit = p.get("limit") or 1000
+        return events[-limit:]
+
+    # ---- failure events (the death-cause feed behind `rt errors`,
+    # `/api/errors` and the timeline's errors lane; reference: the
+    # error-info pubsub channel + RayErrorInfo in common.proto) ------------
+    _FAILURE_EVENTS_CAP = 2048
+    _FAILURE_DEDUP_WINDOW_S = 30.0
+
+    def _record_failure(self, p: Dict) -> None:
+        """Store one categorized FailureEvent. Repeated identical causes
+        within the dedup window collapse into the existing event's
+        ``count`` (a crash loop must not evict the rest of the feed), and
+        every report — deduped or not — increments
+        ``rt_failures_total{category=}`` exactly once, here (single
+        counting site: emitters never double-count)."""
+        if not hasattr(self, "failure_events"):
+            from collections import deque
+
+            self.failure_events: "deque" = deque(
+                maxlen=self._FAILURE_EVENTS_CAP)
+            self._failure_last: Dict[Tuple, Dict] = {}
+            self._failure_seq = 0
+        p.setdefault("t", time.time())
+        p.setdefault("category", F.UNKNOWN)
+        F.observe_failure(p["category"])
+        # task_id deliberately NOT in the key: 5000 tasks failing the same
+        # way within the window fold into one row (count=5000, first
+        # task_id kept) instead of evicting the rest of the feed
+        key = (p.get("category"), p.get("node_id"), p.get("actor_id"),
+               p.get("name"), p.get("message"))
+        last = self._failure_last.get(key)
+        if (last is not None
+                and p["t"] - last.get("last_t", last["t"])
+                <= self._FAILURE_DEDUP_WINDOW_S):
+            last["count"] = last.get("count", 1) + 1
+            last["last_t"] = p["t"]
+            # the deque may have rotated this row out while its crash loop
+            # kept the dedup key warm — re-append (same dict, accrued
+            # count) so an ONGOING failure stays visible in the feed
+            if (not self.failure_events
+                    or last["seq"] < self.failure_events[0]["seq"]):
+                self._failure_seq += 1
+                last["seq"] = self._failure_seq
+                self.failure_events.append(last)
+            return
+        p.setdefault("count", 1)
+        self._failure_seq += 1
+        p["seq"] = self._failure_seq
+        self.failure_events.append(p)
+        self._failure_last[key] = p
+        if len(self._failure_last) > 2 * self._FAILURE_EVENTS_CAP:
+            # drop tracking for events long rotated out of the deque; if a
+            # unique-key burst keeps everything inside the window, hard-cap
+            # to the newest half so the prune actually shrinks (never an
+            # O(n) rebuild per insert on the GCS loop)
+            cutoff = p["t"] - self._FAILURE_DEDUP_WINDOW_S
+            kept = {k: e for k, e in self._failure_last.items()
+                    if e.get("last_t", e["t"]) > cutoff}
+            if len(kept) > self._FAILURE_EVENTS_CAP:
+                kept = dict(sorted(
+                    kept.items(),
+                    key=lambda kv: kv[1].get("last_t", kv[1]["t"])
+                )[-self._FAILURE_EVENTS_CAP:])
+            self._failure_last = kept
+
+    async def rpc_failure_event(self, p):
+        self._record_failure(p)
+        return {"ok": True}
+
+    async def rpc_list_failure_events(self, p):
+        events = list(getattr(self, "failure_events", ()))
+        category = p.get("category")
+        if category:
+            events = [e for e in events if e.get("category") == category]
+        since = p.get("since")
+        if since:
+            events = [e for e in events
+                      if e.get("last_t", e.get("t", 0)) >= since]
         limit = p.get("limit") or 1000
         return events[-limit:]
 

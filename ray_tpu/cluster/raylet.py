@@ -26,6 +26,7 @@ import time
 from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu._private.config import get_config
+from ray_tpu.core import failure as F
 from ray_tpu.core.resources import NodeResources, ResourceSet, TPU
 from ray_tpu.cluster.object_store import PlasmaStore
 from ray_tpu.cluster.rpc import (
@@ -167,6 +168,8 @@ class Raylet:
             "restores": 0, "restore_bytes": 0, "restore_seconds": 0.0,
             "pin_purges": 0, "oom_kills": 0}
         self._rss_reported: set = set()  # worker_ids with a live RSS gauge
+        # client-side failure-emission rate limit (see _failure_event)
+        self._failure_limiter = F.EmitLimiter()
 
     _QUEUE_WAIT_BUCKETS = (0.001, 0.005, 0.02, 0.1, 0.5, 1.0, 5.0, 15.0,
                            60.0, 300.0, 900.0)
@@ -393,6 +396,21 @@ class Raylet:
 
         spawn_task(_send())
 
+    def _failure_event(self, category: str, message: str, **fields) -> None:
+        """Categorized FailureEvent to the GCS failure store
+        (core/failure.py taxonomy): feeds `rt errors`, `/api/errors`, the
+        timeline's errors lane and ``rt_failures_total{category=}``
+        (counted GCS-side — emitters never double-count). Rate-limited
+        per (category, subject-kind): a burst of 5000 tasks failing the
+        same way (bundle gone, infeasible) must not stream one RPC per
+        task or evict the feed with unique-task rows."""
+        key = (category, fields.get("name") or fields.get("actor_id")
+               or fields.get("worker_id") or message)
+        if not self._failure_limiter.allow(key):
+            return
+        F.emit(spawn_task, self._gcs, category, message,
+               node_id=self.node_id, **fields)
+
     # ---- worker pool --------------------------------------------------------
     def _spawn_worker(self, key: Tuple, chips: List[int],
                       runtime_env: Optional[Dict] = None,
@@ -559,14 +577,25 @@ class Raylet:
                     if entry.is_actor_worker and entry.actor_id:
                         getattr(entry, "_pool", self.node).release(
                             ResourceSet(entry_spec_resources(entry)), entry.assignment)
-                        reason = (
-                            "killed by the memory monitor (node over "
-                            "memory_usage_threshold)" if entry.oom_killed
-                            else f"worker exited with code "
-                                 f"{entry.proc.returncode}")
+                        if entry.oom_killed:
+                            cause = F.cause_dict(
+                                F.OOM_KILL,
+                                "killed by the memory monitor (node over "
+                                "memory_usage_threshold)",
+                                node_id=self.node_id,
+                                worker_id=entry.worker_id)
+                        else:
+                            cause = F.cause_dict(
+                                F.WORKER_CRASH,
+                                f"worker exited with code "
+                                f"{entry.proc.returncode}",
+                                node_id=self.node_id,
+                                worker_id=entry.worker_id,
+                                exit_code=entry.proc.returncode)
                         await self._gcs.call("actor_update", {
                             "actor_id": entry.actor_id, "state": "DEAD",
-                            "node_id": self.node_id, "reason": reason})
+                            "node_id": self.node_id,
+                            "reason": cause["message"], "cause": cause})
                         entry.is_actor_worker = False
 
     async def _reattach_after_gcs_restart(self) -> None:
@@ -705,6 +734,13 @@ class Raylet:
                     1.0, {"node_id": self.node_id})
             except Exception:  # noqa: BLE001
                 pass
+        self._failure_event(
+            F.OOM_KILL,
+            f"memory monitor killed worker {victim.worker_id[:8]} "
+            f"(rss {victim_rss}, node at "
+            f"{node_memory.get('used', 0)}/{node_memory.get('total', 0)})",
+            worker_id=victim.worker_id, actor_id=victim.actor_id,
+            task=victim.current_task)
         top = sorted(((oid, m) for oid, m in self._object_meta.items()),
                      key=lambda kv: -kv[1]["size"])[:10]
         self._mem_event(
@@ -963,19 +999,39 @@ class Raylet:
                 if pg is not None:
                     bundle = self._bundles.get((pg["pg_id"], pg["bundle_index"]))
                     if bundle is None:
+                        self._failure_event(
+                            F.PG_REMOVED,
+                            "placement group bundle not on this node "
+                            "(removed or rescheduled)",
+                            task_id=payload.get("task_id"),
+                            name=payload.get("fn_name"),
+                            pg_id=pg.get("pg_id"))
                         if not item["future"].done():
                             item["future"].set_result({
                                 "error": "bundle_gone",
                                 "message": "placement group bundle not on this "
-                                           "node (removed or rescheduled)"})
+                                           "node (removed or rescheduled)",
+                                "cause": F.cause_dict(
+                                    F.PG_REMOVED,
+                                    "placement group bundle not on this "
+                                    "node (removed or rescheduled)",
+                                    node_id=self.node_id,
+                                    pg_id=pg.get("pg_id"))})
                         continue
                     if not bundle.pool.is_feasible(req):
+                        msg = (f"task requires {req.to_dict()} but "
+                               f"its placement group bundle only has "
+                               f"{bundle.pool.total.to_dict()}")
+                        self._failure_event(
+                            F.SCHEDULING_TIMEOUT, msg,
+                            task_id=payload.get("task_id"),
+                            name=payload.get("fn_name"))
                         if not item["future"].done():
                             item["future"].set_result({
-                                "error": "infeasible",
-                                "message": f"task requires {req.to_dict()} but "
-                                           f"its placement group bundle only has "
-                                           f"{bundle.pool.total.to_dict()}"})
+                                "error": "infeasible", "message": msg,
+                                "cause": F.cause_dict(
+                                    F.SCHEDULING_TIMEOUT, msg,
+                                    node_id=self.node_id)})
                         continue
                     pool = bundle.pool
                 else:
@@ -1068,16 +1124,28 @@ class Raylet:
                 fut.set_result(reply)
         except Exception as e:  # worker crashed mid-task or failed to start
             self._task_event(task_id, payload.get("fn_name"), "FAILED")
+            if worker is not None and worker.oom_killed:
+                cause = F.cause_dict(
+                    F.OOM_KILL,
+                    f"memory monitor killed the worker running "
+                    f"{payload.get('fn_name')!r} "
+                    f"(node over memory_usage_threshold)",
+                    node_id=self.node_id, task_id=task_id,
+                    worker_id=worker.worker_id)
+                err_kind = "oom_killed"
+            else:
+                cause = F.cause_dict(
+                    F.WORKER_CRASH, repr(e), node_id=self.node_id,
+                    task_id=task_id,
+                    worker_id=worker.worker_id if worker else None)
+                err_kind = "worker_crashed"
+            self._failure_event(cause["category"], cause["message"],
+                                task_id=task_id,
+                                name=payload.get("fn_name"))
             if not fut.done():
-                if worker is not None and worker.oom_killed:
-                    fut.set_result({
-                        "error": "oom_killed",
-                        "message": f"memory monitor killed the worker "
-                                   f"running {payload.get('fn_name')!r} "
-                                   f"(node over memory_usage_threshold)"})
-                else:
-                    fut.set_result({"error": "worker_crashed",
-                                    "message": repr(e)})
+                fut.set_result({"error": err_kind,
+                                "message": cause["message"],
+                                "cause": cause})
         finally:
             state = self._inflight.pop(task_id)
             pool.release(state["req"].subtract(state["released"]), assignment)
@@ -1171,11 +1239,17 @@ class Raylet:
                 worker.is_actor_worker = False
                 pool.release(req, assignment)
                 self._terminate_worker(worker)  # reap loop collects it
+                # user code raised in __init__: a task-error-category death
+                cause = F.cause_dict(
+                    F.TASK_ERROR,
+                    reply.get("error", "actor __init__ failed"),
+                    node_id=self.node_id, actor_id=p["actor_id"])
                 await self._gcs.call("actor_update", {
                     "actor_id": p["actor_id"], "state": "DEAD",
                     "node_id": self.node_id,
-                    "reason": reply.get("error", "actor __init__ failed")})
-                return {"ok": False, "error": reply.get("error")}
+                    "reason": cause["message"], "cause": cause})
+                return {"ok": False, "error": reply.get("error"),
+                        "cause": cause}
             await self._gcs.call("actor_update", {
                 "actor_id": p["actor_id"], "state": "ALIVE",
                 "address": reply["address"], "node_id": self.node_id})
@@ -1185,7 +1259,16 @@ class Raylet:
                 worker.is_actor_worker = False
                 self._terminate_worker(worker)  # reap loop collects it
             pool.release(req, assignment)
-            return {"ok": False, "error": repr(e)}
+            category = (F.RUNTIME_ENV_SETUP
+                        if spec.get("runtime_env")
+                        and isinstance(e, asyncio.TimeoutError)
+                        else F.WORKER_CRASH)
+            cause = F.cause_dict(category, repr(e), node_id=self.node_id,
+                                 actor_id=p["actor_id"])
+            # no _failure_event here: the GCS records this same cause when
+            # the create reply finalizes the actor (emitting both would
+            # double rt_failures_total for one failure)
+            return {"ok": False, "error": repr(e), "cause": cause}
 
     async def rpc_kill_actor(self, p):
         for entry in list(self._workers.values()):

@@ -58,7 +58,11 @@ async def cancel_and_wait(*tasks) -> None:
             await t
         except asyncio.CancelledError:
             cur = asyncio.current_task()
-            if cur is not None and cur.cancelling():
+            # Task.cancelling() is 3.11+; on 3.10 fall back to swallowing
+            # (the pre-cancelling() semantics) rather than crashing every
+            # teardown path with AttributeError.
+            cancelling = getattr(cur, "cancelling", None)
+            if cancelling is not None and cancelling():
                 raise  # our caller was cancelled at this await — honor it
         except Exception:  # noqa: BLE001
             pass

@@ -44,11 +44,13 @@ from ray_tpu.cluster.rpc import (
     RpcServer,
     spawn_task,
 )
+from ray_tpu.core import failure as F
 from ray_tpu.exceptions import (
     ActorDiedError,
     ActorUnschedulableError,
     GetTimeoutError,
     ObjectLostError,
+    OwnerDiedError,
     TaskError,
     WorkerCrashedError,
 )
@@ -86,6 +88,53 @@ def _observe_phases(phases: Dict[str, float]) -> None:
             _phase_hist.observe(secs, {"phase": name})
     except Exception:  # noqa: BLE001 — observability never fails the task
         pass
+
+
+# Recovery telemetry (failure plane): owner-side retry / lineage-
+# reconstruction counters + the reconstruction-latency histogram. All
+# lazily registered so the untraced happy path never touches the registry.
+_recovery_metrics: Optional[Dict[str, Any]] = None
+
+_RECONSTRUCT_BUCKETS = (0.005, 0.02, 0.1, 0.5, 1.0, 2.5, 5.0, 15.0, 60.0,
+                        300.0)
+
+
+def _observe_retry() -> None:
+    try:
+        _get_recovery_metrics()["retries"].inc()
+    except Exception:  # noqa: BLE001 — telemetry only
+        pass
+
+
+def _observe_reconstruction(outcome: str, seconds: float) -> None:
+    try:
+        m = _get_recovery_metrics()
+        m["reconstructions"].inc(1.0, {"outcome": outcome})
+        m["reconstruct_hist"].observe(seconds)
+    except Exception:  # noqa: BLE001 — telemetry only
+        pass
+
+
+def _get_recovery_metrics() -> Dict[str, Any]:
+    global _recovery_metrics
+    if _recovery_metrics is None:
+        from ray_tpu.util import metrics as M
+
+        _recovery_metrics = {
+            "retries": M.get_or_create(
+                M.Counter, "rt_task_retries_total",
+                "Owner-side task resubmissions after a retriable failure"),
+            "reconstructions": M.get_or_create(
+                M.Counter, "rt_object_reconstructions_total",
+                "Lineage reconstructions of lost objects by outcome",
+                tag_keys=("outcome",)),
+            "reconstruct_hist": M.get_or_create(
+                M.Histogram, "rt_object_reconstruction_seconds",
+                "Wall time of one lineage reconstruction "
+                "(resubmit to reply)",
+                boundaries=_RECONSTRUCT_BUCKETS),
+        }
+    return _recovery_metrics
 
 
 
@@ -237,6 +286,7 @@ class _ActorConn:
         self.address: Optional[str] = None
         self.send_lock: Optional[asyncio.Lock] = None
         self.dead_reason: Optional[str] = None
+        self.dead_cause: Optional[Dict] = None  # failure.py wire dict
         self.max_task_retries: int = 0
 
 
@@ -288,6 +338,8 @@ class ClusterBackend(RuntimeBackend):
         # Tombstones for explicitly freed objects we own: lets a borrower's
         # get fail fast instead of waiting out the directory timeout.
         self._freed: Dict[str, None] = {}
+        # client-side failure-emission rate limit (see _failure_event)
+        self._failure_limiter = F.EmitLimiter()
         # runtime_env json -> prepared wire form (working_dir uploaded once)
         self._prepared_envs: Dict[str, Optional[Dict]] = {}
 
@@ -487,6 +539,9 @@ class ClusterBackend(RuntimeBackend):
                 return None
             r = deadline - time.monotonic()
             if r <= 0:
+                self._failure_event(F.GET_TIMEOUT,
+                                    f"timed out resolving {ref}",
+                                    oid=oid_hex)
                 raise GetTimeoutError(f"timed out resolving {ref}")
             return r
 
@@ -500,6 +555,9 @@ class ClusterBackend(RuntimeBackend):
                     return view
             if self.memory_store.is_pending(oid_hex):
                 if not await self.memory_store.wait_ready(oid_hex, remaining()):
+                    self._failure_event(F.GET_TIMEOUT,
+                                        f"timed out waiting for {ref}",
+                                        oid=oid_hex)
                     raise GetTimeoutError(f"timed out waiting for {ref}")
                 continue
             owner = ref.owner_address()
@@ -521,7 +579,13 @@ class ClusterBackend(RuntimeBackend):
                     # can't see its own raylet's spill dir), so fall through
                     # to the raylet pull instead of declaring it lost here.
                 except (ConnectionLost, ConnectionError, OSError):
-                    raise ObjectLostError(ref.id()) from None
+                    cause = F.cause_dict(
+                        F.OWNER_DIED,
+                        f"owner {owner} unreachable while resolving the "
+                        f"object", oid=oid_hex, owner=owner)
+                    self._failure_event(F.OWNER_DIED, cause["message"],
+                                        oid=oid_hex)
+                    raise OwnerDiedError(ref.id(), cause) from None
             # A reconstructable object fails fast on the directory wait —
             # we can rebuild it — while a plain object waits out the caller's
             # deadline in case a producer is still sealing it.
@@ -573,7 +637,26 @@ class ClusterBackend(RuntimeBackend):
                         continue
                 except (ConnectionLost, ConnectionError, OSError):
                     pass
-            raise ObjectLostError(ref.id())
+            cause = F.cause_dict(
+                F.OBJECT_LOST,
+                "all copies lost and reconstruction "
+                + ("exhausted" if reconstruct_attempts else "unavailable"),
+                oid=oid_hex, reconstruct_attempts=reconstruct_attempts)
+            self._failure_event(F.OBJECT_LOST, cause["message"], oid=oid_hex)
+            raise ObjectLostError(ref.id(), cause)
+
+    def _failure_event(self, category: str, message: str, **fields) -> None:
+        """Categorized FailureEvent from this owner process to the GCS
+        failure store (`rt errors` / `/api/errors` / the timeline's errors
+        lane). Rate-limited per (category, subject) via the shared
+        EmitLimiter: a readiness-polling loop of get(timeout=...) expiries
+        must not stream one GCS RPC per poll."""
+        key = (category, fields.get("oid") or fields.get("actor_id")
+               or fields.get("task_id") or message)
+        if not self._failure_limiter.allow(key):
+            return
+        F.emit(self.io.spawn, self._gcs, category, message,
+               node_id=self.node_id, **fields)
 
     async def _report_unreachable_quietly(self, actor_id_hex: str,
                                           address: str) -> None:
@@ -615,13 +698,24 @@ class ClusterBackend(RuntimeBackend):
                 for i in range(payload["num_returns"])]
         for r in refs:
             self._reconstructing[r.hex()] = fut
+        t0 = time.monotonic()
+        outcome = "error"
         try:
             target = self._raylet
             if payload.get("pg") is not None:
                 target = await self._pg_bundle_raylet(payload["pg"])
             reply = await target.call("submit_task", payload)
+            outcome = "failed" if reply.get("error") else "ok"
             self._apply_task_reply(reply, refs, payload["fn_name"], payload)
         finally:
+            _observe_reconstruction(outcome, time.monotonic() - t0)
+            if outcome != "ok":
+                self._failure_event(
+                    F.OBJECT_LOST,
+                    f"lineage reconstruction of task "
+                    f"{payload.get('fn_name')} did not complete "
+                    f"({outcome})", oid=oid_hex,
+                    task_id=payload.get("task_id"))
             for r in refs:
                 self._reconstructing.pop(r.hex(), None)
             if not fut.done():
@@ -939,6 +1033,7 @@ class ClusterBackend(RuntimeBackend):
                         and state.produced == 0 and not state.closed
                         and retries > 0):
                     retries -= 1
+                    _observe_retry()
                     continue
                 break
             if reply.get("error"):
@@ -990,6 +1085,7 @@ class ClusterBackend(RuntimeBackend):
                          payload["pg"].get("bundle_index", -1)), None)
                 if attempt < retries:
                     attempt += 1
+                    _observe_retry()
                     continue
             break
         if traced and reply.get("phases") is not None:
@@ -1054,6 +1150,18 @@ class ClusterBackend(RuntimeBackend):
                 err: Exception = OutOfMemoryError(msg)
             else:
                 err = WorkerCrashedError(msg)
+            # the raylet's structured cause rides into the raised exception
+            # (picklable: BaseException reduce carries __dict__), so `rt
+            # errors` and the get()-time error agree on why
+            if reply.get("cause"):
+                err.cause_info = dict(reply["cause"])
+            if reply["error"] == "submit_failed":
+                # the raylet never saw this task — the owner is the only
+                # process that can put it on the failure feed
+                self._failure_event(
+                    F.WORKER_CRASH, msg,
+                    task_id=payload.get("task_id") if payload else None,
+                    name=fn_name)
             blob = self.serde.serialize(err).to_bytes()
             for r in refs:
                 self.memory_store.put(r.hex(), blob)
@@ -1143,8 +1251,16 @@ class ClusterBackend(RuntimeBackend):
             if info is None:
                 raise ActorDiedError(conn.actor_id_hex, "unknown actor")
             if info["state"] == "DEAD":
+                # the GCS knows MORE than a bare reason string: surface the
+                # structured cause (category, restart count, last node) so
+                # the caller-side error says what `rt list actors` knows
                 conn.dead_reason = info.get("death_reason", "dead")
-                raise ActorDiedError(conn.actor_id_hex, conn.dead_reason)
+                conn.dead_cause = info.get("death_cause") or {
+                    "category": F.UNKNOWN, "message": conn.dead_reason,
+                    "num_restarts": info.get("num_restarts"),
+                    "node_id": info.get("node_id")}
+                raise ActorDiedError(conn.actor_id_hex, conn.dead_reason,
+                                     cause=conn.dead_cause)
             if info["state"] == "ALIVE":
                 break
             waited += poll
@@ -1202,7 +1318,9 @@ class ClusterBackend(RuntimeBackend):
                 # ordering is the actor worker's arrival-ordered queue.
                 async with conn.send_lock:
                     if conn.dead_reason:
-                        raise ActorDiedError(payload["actor_id"], conn.dead_reason)
+                        raise ActorDiedError(payload["actor_id"],
+                                             conn.dead_reason,
+                                             cause=conn.dead_cause)
                     if conn.address is None:
                         await self._resolve_actor(conn)
                     if task_retries_left is None:
@@ -1257,12 +1375,17 @@ class ClusterBackend(RuntimeBackend):
                 conn.address = None  # delivered but connection dropped
                 if task_retries_left and task_retries_left > 0:
                     task_retries_left -= 1
+                    _observe_retry()
                     await asyncio.sleep(get_config().actor_restart_backoff_s)
                     continue
                 err = ActorDiedError(
                     payload["actor_id"],
                     f"connection lost during {method_name!r} (actor died or "
-                    f"restarting); set max_task_retries to retry actor tasks")
+                    f"restarting); set max_task_retries to retry actor tasks",
+                    cause=F.cause_dict(
+                        F.WORKER_CRASH,
+                        f"connection lost during {method_name!r}",
+                        actor_id=payload["actor_id"]))
                 blob = self.serde.serialize(err).to_bytes()
                 for r in refs:
                     self.memory_store.put(r.hex(), blob)
@@ -1282,6 +1405,8 @@ class ClusterBackend(RuntimeBackend):
         if conn:
             conn.address = None
             conn.dead_reason = "killed via kill()"
+            conn.dead_cause = F.cause_dict(F.CANCELLED, "killed via kill()",
+                                           actor_id=actor_id.hex())
         self.io.run(self._gcs.call("kill_actor", {"actor_id": actor_id.hex()}))
 
     def get_actor_handle(self, name, namespace):

@@ -48,6 +48,10 @@ class WorkerProcess:
         self._actor_id: Optional[str] = None
         self._actor_queues: Dict[str, asyncio.Queue] = {}
         self._actor_threads: Optional[ThreadPoolExecutor] = None
+        # client-side failure-emission rate limit (see _failure_event)
+        from ray_tpu.core.failure import EmitLimiter
+
+        self._failure_limiter = EmitLimiter(cap=256)
 
     def start(self) -> None:
         from ray_tpu.core.worker import global_worker
@@ -101,6 +105,21 @@ class WorkerProcess:
         from ray_tpu.util.profiling import format_current_stacks
 
         return {"pid": os.getpid(), "stacks": format_current_stacks()}
+
+    def _failure_event(self, message: str, **fields) -> None:
+        """Stamp a task-error FailureEvent on the GCS feed (the executing
+        worker is the only process that always sees a user exception — the
+        caller may never ``get`` the ref). Rate-limited per failing
+        function/method via the shared EmitLimiter: a map over bad input
+        failing thousands of tasks per second must not stream one GCS RPC
+        per execution (the GCS dedups rows, not RPCs)."""
+        from ray_tpu.core import failure as F
+
+        if not self._failure_limiter.allow(fields.get("name") or message):
+            return
+        F.emit(self.backend.io.spawn, self.backend._gcs, F.TASK_ERROR,
+               message, node_id=os.environ.get("RT_NODE_ID"),
+               worker_id=self.worker_id, **fields)
 
     # ---- argument / return marshalling -------------------------------------
     def _resolve_args(self, wire_args: List[Tuple], wire_kwargs: Dict[str, Tuple]):
@@ -211,6 +230,11 @@ class WorkerProcess:
                     "result_store": _time.perf_counter() - t2}
             return reply
         except TaskError as e:
+            # a TaskError here is PROPAGATION (a dependency's failure
+            # re-raised while fetching args / inside user code) — its
+            # origin worker already emitted the task_error event; emitting
+            # again would attribute one upstream error to every
+            # downstream consumer
             if streaming:
                 reply = {"streaming_done": 0,
                          "stream_error": self.backend.serde.serialize(e).to_bytes()}
@@ -221,6 +245,8 @@ class WorkerProcess:
             return reply
         except BaseException as e:  # noqa: BLE001
             traceback.print_exc()
+            self._failure_event(f"{type(e).__name__}: {e}",
+                                task_id=p["task_id"], name=p["fn_name"])
             err = TaskError(p["fn_name"], e)
             if streaming:
                 reply = {"streaming_done": 0,
@@ -257,6 +283,10 @@ class WorkerProcess:
                 return {"streaming_done": i}
             except BaseException as e:  # noqa: BLE001
                 traceback.print_exc()
+                if not isinstance(e, TaskError):  # origin only
+                    self._failure_event(
+                        f"{type(e).__name__}: {e}", task_id=p["task_id"],
+                        name=p["fn_name"])
                 err = TaskError(p["fn_name"], e)
                 return {"streaming_done": i,
                         "stream_error": self.backend.serde.serialize(err).to_bytes()}
@@ -399,6 +429,10 @@ class WorkerProcess:
             except BaseException as e:  # noqa: BLE001
                 if traced:
                     self._emit_span_event(p, "FAILED")
+                if not isinstance(e, TaskError):  # origin only, not
+                    self._failure_event(          # propagated upstream errors
+                        f"{type(e).__name__}: {e}", task_id=p["task_id"],
+                        actor_id=p.get("actor_id"), name=method_name)
                 return {"returns": self._error_returns(
                     TaskError(method_name, e), p["num_returns"])}
             finally:
@@ -438,6 +472,10 @@ class WorkerProcess:
             traceback.print_exc()
             if traced:
                 self._emit_span_event(p, "FAILED")
+            if not isinstance(e, TaskError):  # origin only, not
+                self._failure_event(          # propagated upstream errors
+                    f"{type(e).__name__}: {e}", task_id=p["task_id"],
+                    actor_id=p.get("actor_id"), name=p["method"])
             return {"returns": self._error_returns(
                 TaskError(p["method"], e), p["num_returns"])}
         finally:

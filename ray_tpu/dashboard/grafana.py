@@ -87,6 +87,14 @@ def build_cluster_dashboard(
         "{{resource}} in use")
     add("Resource capacity", 'rt_resource_total', "{{resource}}")
     add("Objects in store", 'rt_objects_in_store', "objects")
+    # failure plane (PR 5): the death-cause feed + recovery telemetry
+    add("Failures by category", 'rate(rt_failures_total[5m])',
+        "{{category}}")
+    add("OOM kills", 'increase(rt_oom_kills_total[10m])', "{{node_id}}")
+    add("Actor restarts", 'increase(rt_actor_restarts_total[10m])',
+        "restarts")
+    add("Task retries", 'increase(rt_task_retries_total[10m])', "retries")
+    add("Raylet queue depth", 'rt_raylet_queue_depth', "{{node_id}}")
 
     for m in user_metrics or []:
         name, kind = m.get("name"), m.get("type", "gauge")
@@ -149,6 +157,8 @@ _PROMETHEUS_YML = """\
 global:
   scrape_interval: 10s
   evaluation_interval: 10s
+rule_files:
+  - alert_rules.yml
 scrape_configs:
   - job_name: ray_tpu
     metrics_path: /metrics
@@ -188,9 +198,30 @@ def export_grafana(out_dir: str,
         f.write(_DATASOURCE_YML.format(prom_url=prom_url))
     paths["datasource"] = p
 
+    # alerting rules over the failure plane (scripts/alert_rules.yml is
+    # the source of truth — linted by scripts/check_metrics.py); copied
+    # next to prometheus.yml so the relative rule_files entry resolves.
+    # Copied FIRST: prometheus.yml must only reference the file when the
+    # copy landed (a dangling rule_files entry fails Prometheus startup).
+    src = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))), "scripts",
+        "alert_rules.yml")
+    p = os.path.join(prom_dir, "alert_rules.yml")
+    have_rules = False
+    try:
+        with open(src) as f_in, open(p, "w") as f_out:
+            f_out.write(f_in.read())
+        paths["alert_rules"] = p
+        have_rules = True
+    except OSError:
+        pass  # installed without the repo's scripts/ tree
+
     p = os.path.join(prom_dir, "prometheus.yml")
+    yml = _PROMETHEUS_YML.format(metrics_target=metrics_target)
+    if not have_rules:
+        yml = yml.replace("rule_files:\n  - alert_rules.yml\n", "")
     with open(p, "w") as f:
-        f.write(_PROMETHEUS_YML.format(metrics_target=metrics_target))
+        f.write(yml)
     paths["prometheus_config"] = p
     return paths
 

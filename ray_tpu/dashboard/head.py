@@ -11,6 +11,7 @@ State API), ``dashboard/modules/metrics`` (Prometheus). Routes:
   GET /api/tasks                recent task events
   GET /api/steps                step-profiler records (profile payloads)
   GET /api/objects              object directory
+  GET /api/errors               failure plane (categorized FailureEvents)
   GET /api/memory               memory plane (store usage + owner ledgers)
   GET /api/logs                 worker log rings (?node=&worker=&limit=)
   GET /api/jobs                 submitted jobs
@@ -55,6 +56,10 @@ class DashboardActor:
         app.router.add_get("/api/steps", self._gcs_list(
             "list_tasks", {"profile": "only"}))
         app.router.add_get("/api/objects", self._gcs_list("list_objects"))
+        # the failure plane: categorized FailureEvents (death-cause
+        # taxonomy, core/failure.py) straight off the GCS store
+        app.router.add_get("/api/errors",
+                           self._gcs_list("list_failure_events"))
         app.router.add_get("/api/memory", self._memory)
         app.router.add_get("/api/logs", self._logs)
         app.router.add_get("/api/cluster_resources", self._cluster_resources)

@@ -198,6 +198,7 @@ const TABS = [
   {id: "placement_groups", label: "Placement groups",
    url: "/api/placement_groups"},
   {id: "tasks", label: "Tasks", url: "/api/tasks?limit=200"},
+  {id: "errors", label: "Errors", url: "/api/errors?limit=200"},
   {id: "steps", label: "Steps", url: "/api/steps?limit=200"},
   {id: "timeline", label: "Timeline", url: "/api/tasks?limit=500"},
   {id: "objects", label: "Objects", url: "/api/objects?limit=200"},
@@ -218,6 +219,13 @@ const STATUS_CLASS = {
   RESTARTING: "s-serious", RECONSTRUCTING: "s-serious",
   DEAD: "s-critical", FAILED: "s-critical", STOPPED: "s-critical",
   UNHEALTHY: "s-critical",
+  // failure-plane categories (core/failure.py taxonomy)
+  OOM_KILL: "s-critical", WORKER_CRASH: "s-critical",
+  NODE_DEATH: "s-critical", ACTOR_RESTART_EXHAUSTED: "s-critical",
+  OWNER_DIED: "s-critical", TASK_ERROR: "s-serious",
+  OBJECT_LOST: "s-serious", RUNTIME_ENV_SETUP: "s-serious",
+  GET_TIMEOUT: "s-warning", SCHEDULING_TIMEOUT: "s-warning",
+  PG_REMOVED: "s-warning", CANCELLED: "s-muted",
 };
 function esc(s) {
   return String(s ?? "").replace(/[&<>"]/g,
@@ -288,6 +296,18 @@ const COLS = {
     ["Size", r => `<td>${esc(r.size ?? "")}</td>`],
     ["Locations", r => `<td class="id">${esc(
       (r.locations || []).join(" "))}</td>`],
+  ],
+  // failure plane: the categorized FailureEvent feed (/api/errors)
+  errors: [
+    ["When", r => `<td>${esc(new Date(1000 * (r.last_t || r.t || 0))
+      .toLocaleTimeString())}</td>`],
+    ["Category", r => `<td>${statusCell(r.category || "unknown")}</td>`],
+    ["Node", r => `<td class="id">${esc(
+      String(r.node_id || "").slice(0, 8))}</td>`],
+    ["What", r => `<td class="id">${esc(r.name || r.task_id
+      || r.actor_id || r.worker_id || "")}</td>`],
+    ["Count", r => `<td>${esc(r.count ?? 1)}</td>`],
+    ["Message", r => `<td>${esc(r.message || "")}</td>`],
   ],
   steps: [
     ["Kind", r => `<td>${esc(prof(r).kind || "")}</td>`],
@@ -575,7 +595,8 @@ function renderTable() {
     }).join("");
     return;
   }
-  const rows = data[active] || [];
+  let rows = data[active] || [];
+  if (active === "errors") rows = rows.slice().reverse();  // newest first
   const cols = COLS[active];
   if (!rows.length) {
     el.innerHTML = active === "steps"

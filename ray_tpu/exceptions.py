@@ -56,17 +56,35 @@ class ActorError(RayTpuError):
 
 
 class ActorDiedError(ActorError):
-    """The actor is dead (crashed, killed, or out of restarts)."""
+    """The actor is dead (crashed, killed, or out of restarts).
 
-    def __init__(self, actor_id=None, reason: str = ""):
+    ``cause`` carries the structured death cause (``core/failure.py`` wire
+    dict: category, message, restart count, last node) when the GCS knows
+    it — so the caller-side error says exactly what ``rt list actors`` and
+    ``rt errors`` know, and ``rt trace`` and the exception agree on why.
+    """
+
+    def __init__(self, actor_id=None, reason: str = "", cause=None):
         self.actor_id = actor_id
         self.reason = reason
-        super().__init__(f"actor {actor_id} died: {reason}")
+        self.cause_info = dict(cause) if cause else None
+        msg = f"actor {actor_id} died: {reason}"
+        if self.cause_info:
+            extras = [f"category={self.cause_info.get('category')}"]
+            if self.cause_info.get("num_restarts") is not None:
+                extras.append(
+                    f"restarts={self.cause_info['num_restarts']}")
+            if self.cause_info.get("node_id"):
+                extras.append(
+                    f"last_node={str(self.cause_info['node_id'])[:8]}")
+            msg += f" ({', '.join(extras)})"
+        super().__init__(msg)
 
     def __reduce__(self):
         # default Exception pickling would reconstruct with the formatted
         # message as actor_id, double-wrapping the text on every serde hop
-        return (ActorDiedError, (self.actor_id, self.reason))
+        return (ActorDiedError, (self.actor_id, self.reason,
+                                 self.cause_info))
 
 
 class ActorUnavailableError(ActorError):
@@ -96,13 +114,21 @@ class ActorUnschedulableError(ActorError):
 class ObjectLostError(RayTpuError):
     """All copies of an object were lost and it could not be reconstructed."""
 
-    def __init__(self, object_id=None):
+    def __init__(self, object_id=None, cause=None):
         self.object_id = object_id
-        super().__init__(f"object {object_id} lost")
+        self.cause_info = dict(cause) if cause else None
+        msg = f"object {object_id} lost"
+        if self.cause_info and self.cause_info.get("message"):
+            msg += f": {self.cause_info['message']}"
+        super().__init__(msg)
+
+    def __reduce__(self):
+        return (type(self), (self.object_id, self.cause_info))
 
 
 class OwnerDiedError(ObjectLostError):
-    pass
+    """The object's owner process died — its memory-store copy and lineage
+    are gone with it (reference: ``OWNER_DIED`` in common.proto)."""
 
 
 class GetTimeoutError(RayTpuError, TimeoutError):
@@ -134,4 +160,15 @@ class PendingCallsLimitExceeded(RayTpuError):
 
 
 class NodeDiedError(RayTpuError):
-    pass
+    """A node (its raylet) died; tasks/actors/objects there are gone."""
+
+    def __init__(self, node_id=None, cause=None):
+        self.node_id = node_id
+        self.cause_info = dict(cause) if cause else None
+        msg = f"node {node_id} died"
+        if self.cause_info and self.cause_info.get("message"):
+            msg += f": {self.cause_info['message']}"
+        super().__init__(msg)
+
+    def __reduce__(self):
+        return (NodeDiedError, (self.node_id, self.cause_info))

@@ -284,7 +284,8 @@ def _follow_job(rt_job, job_id: str, from_start: bool = False) -> int:
 
 _LIST_RPCS = {"nodes": "list_nodes", "actors": "list_actors",
               "placement-groups": "list_placement_groups",
-              "tasks": "list_tasks", "objects": "list_objects"}
+              "tasks": "list_tasks", "objects": "list_objects",
+              "errors": "list_failure_events"}
 
 
 def cmd_list(args: argparse.Namespace) -> int:
@@ -390,7 +391,12 @@ def cmd_trace(args: argparse.Namespace) -> int:
     if gcs is None:
         print("no running cluster found (pass --address)", file=sys.stderr)
         return 1
-    events = _gcs_call(gcs, "list_tasks", {"limit": args.limit})
+    try:
+        events = _gcs_call(gcs, "list_tasks", {"limit": args.limit})
+    except Exception as e:  # noqa: BLE001 — one line, not a stack trace
+        print(f"rt trace: cannot reach GCS at {gcs}: "
+              f"{type(e).__name__}: {e}", file=sys.stderr)
+        return 1
     ident = args.id
 
     def ctx(e):
@@ -433,10 +439,36 @@ def cmd_memory(args: argparse.Namespace) -> int:
             print("no running cluster found (pass --address)",
                   file=sys.stderr)
             return 1
-        events = _gcs_call(gcs, "list_mem_events",
-                           {"kind": "oom_kill", "limit": args.limit})
+        try:
+            events = _gcs_call(gcs, "list_mem_events",
+                               {"kind": "oom_kill", "limit": args.limit})
+        except Exception as e:  # noqa: BLE001 — one line, no stack trace
+            print(f"rt memory: cannot reach GCS at {gcs}: "
+                  f"{type(e).__name__}: {e}", file=sys.stderr)
+            return 1
+        if args.id:
+            # filter to one victim / object / node; an unknown or expired
+            # id gets a clear one-liner + nonzero, never an empty table
+            ident = args.id
+            events = [
+                ev for ev in events
+                if str((ev.get("victim") or {}).get("worker_id", ""))
+                .startswith(ident)
+                or str(ev.get("node_id", "")).startswith(ident)
+                or any(str(o.get("oid", "")).startswith(ident)
+                       or str(o.get("oid", "")).endswith(ident)
+                       for o in ev.get("top_objects") or ())]
+            if not events:
+                print(f"rt memory --oom: no OOM post-mortem matching "
+                      f"{ident!r} (the event store is bounded — it may "
+                      f"have expired)", file=sys.stderr)
+                return 1
         print(format_oom_reports(events))
         return 0
+    if args.id:
+        print("rt memory: an id filter only applies with --oom",
+              file=sys.stderr)
+        return 2
     rt = _attach_driver(args.address)
     try:
         print(rt.memory_summary(limit=args.limit, top_n=args.top,
@@ -445,6 +477,64 @@ def cmd_memory(args: argparse.Namespace) -> int:
         return 0
     finally:
         rt.shutdown()
+
+
+def cmd_errors(args: argparse.Namespace) -> int:
+    """rt errors: tail/filter the cluster's categorized FailureEvent feed
+    (cluster/gcs.py failure_events store — the death-cause taxonomy of
+    core/failure.py). Reads the GCS directly, no driver attach."""
+    gcs = _resolve_gcs(args.address)
+    if gcs is None:
+        print("no running cluster found (pass --address)", file=sys.stderr)
+        return 1
+    payload = {"limit": args.limit}
+    if args.category:
+        payload["category"] = args.category
+    try:
+        events = _gcs_call(gcs, "list_failure_events", payload)
+    except Exception as e:  # noqa: BLE001 — one line, no stack trace
+        print(f"rt errors: cannot reach GCS at {gcs}: "
+              f"{type(e).__name__}: {e}", file=sys.stderr)
+        return 1
+    if args.json:
+        print(json.dumps(events, indent=2, default=str))
+        return 0
+    if not events:
+        what = (f"category {args.category!r}" if args.category
+                else "any category")
+        print(f"(no failure events recorded for {what})")
+        return 0
+    for ev in events:
+        # last_t: a deduped crash loop shows when it LAST fired, like the
+        # dashboard — not the 30s-old first occurrence
+        when = time.strftime("%H:%M:%S", time.localtime(
+            ev.get("last_t", ev.get("t", 0))))
+        who = " ".join(
+            f"{k}={str(ev[k])[:12]}" for k in
+            ("name", "task_id", "actor_id", "worker_id") if ev.get(k))
+        count = f" x{ev['count']}" if ev.get("count", 1) > 1 else ""
+        print(f"{when}  {str(ev.get('node_id', '?'))[:8]:<8} "
+              f"{ev.get('category', 'unknown'):<24}{count:<5} "
+              f"{who + '  ' if who else ''}{ev.get('message', '')}")
+    return 0
+
+
+def cmd_doctor(args: argparse.Namespace) -> int:
+    """rt doctor: one-shot cluster health report (util/doctor.py) — node/
+    actor/worker liveness, recent failure categories ranked, OOM
+    post-mortems + leak suspects from the memory plane, queue-depth and
+    spill pressure. Exit 0 healthy / 1 unhealthy / 2 unreachable."""
+    from ray_tpu.util import doctor
+
+    gcs = _resolve_gcs(args.address)
+    if gcs is None:
+        print("rt doctor: no running cluster found (pass --address)",
+              file=sys.stderr)
+        return 2
+    text, rc = doctor.run(gcs, window_s=args.window,
+                          queue_warn=args.queue_warn, as_json=args.json)
+    print(text, file=sys.stderr if rc == 2 else sys.stdout)
+    return rc
 
 
 def cmd_metrics(args: argparse.Namespace) -> int:
@@ -634,7 +724,34 @@ def main(argv=None) -> int:
     p_mem.add_argument("--leak-age", type=float, default=None,
                        help="leak-suspect age threshold seconds "
                             "(default RT_MEMORY_LEAK_AGE_S)")
+    p_mem.add_argument("id", nargs="?", default=None,
+                       help="with --oom: filter post-mortems by victim "
+                            "worker id, object id, or node id prefix")
     p_mem.set_defaults(fn=cmd_memory)
+
+    p_err = sub.add_parser(
+        "errors",
+        help="tail the categorized FailureEvent feed (death-cause "
+             "taxonomy; GCS failure_events store)")
+    p_err.add_argument("--address", default=None)
+    p_err.add_argument("--category", default=None,
+                       help="only this death-cause category "
+                            "(e.g. worker_crash, oom_kill, task_error)")
+    p_err.add_argument("--limit", type=int, default=200)
+    p_err.add_argument("--json", action="store_true")
+    p_err.set_defaults(fn=cmd_errors)
+
+    p_doc = sub.add_parser(
+        "doctor",
+        help="one-shot cluster health report; exit 0 healthy / 1 "
+             "unhealthy / 2 unreachable (util/doctor.py)")
+    p_doc.add_argument("--address", default=None)
+    p_doc.add_argument("--window", type=float, default=600.0,
+                       help="recency window (s) for failure/OOM findings")
+    p_doc.add_argument("--queue-warn", type=int, default=100,
+                       help="raylet queue depth that warrants a warning")
+    p_doc.add_argument("--json", action="store_true")
+    p_doc.set_defaults(fn=cmd_doctor)
 
     p_trace = sub.add_parser(
         "trace",

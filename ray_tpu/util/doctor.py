@@ -1,0 +1,307 @@
+"""``rt doctor`` — one-shot cluster health report with a CI-friendly exit
+code.
+
+Reads every observability plane this repo has grown (no driver attach —
+direct GCS/raylet RPCs, so it works against a wedged cluster too):
+
+  - node / actor / worker liveness (GCS node+actor tables, raylet
+    ``node_stats``),
+  - the failure plane: recent FailureEvents ranked by category
+    (``cluster/gcs.py`` ``failure_events`` store, `rt errors`' feed),
+  - the memory plane (PR 4): OOM post-mortems, spill pressure and leak
+    suspects (raylet ``memory_report`` + the ``@memobj/`` KV ledgers),
+  - scheduler pressure: per-node raylet queue depth.
+
+Exit codes: 0 healthy, 1 unhealthy (any critical finding), 2 cluster
+unreachable. ``collect()`` returns the raw report; ``diagnose()`` turns it
+into findings; ``format_report()`` renders the human page.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+# categories that indicate breakage (vs. intentional / user-code outcomes)
+_CRITICAL_CATEGORIES = ("oom_kill", "worker_crash", "node_death",
+                        "actor_restart_exhausted", "owner_died")
+_WARN_CATEGORIES = ("task_error", "object_lost", "get_timeout",
+                    "scheduling_timeout", "pg_removed",
+                    "runtime_env_setup", "unknown")
+
+OK, WARN, CRITICAL = "ok", "warn", "critical"
+
+
+async def _collect_async(gcs_address: str, window_s: float,
+                         limit: int) -> Dict[str, Any]:
+    from ray_tpu.cluster.rpc import RpcClient
+
+    gcs = RpcClient(gcs_address, peer_id="rt-doctor")
+    await gcs.connect()
+    try:
+        nodes, actors, failures, ooms = await asyncio.gather(
+            gcs.call("list_nodes", {}, timeout=10.0),
+            gcs.call("list_actors", {}, timeout=10.0),
+            gcs.call("list_failure_events", {"limit": limit}, timeout=10.0),
+            gcs.call("list_mem_events",
+                     {"kind": "oom_kill", "limit": 50}, timeout=10.0))
+
+        async def probe_node(n):
+            out = {"node_id": n["node_id"], "alive": n.get("alive", True),
+                   "queue_depth": n.get("queue_depth", 0),
+                   "address": n.get("address"),
+                   "death_t": n.get("death_t"),
+                   "death_reason": n.get("death_reason", "")}
+            if not out["alive"]:
+                return out
+            client = None
+            try:
+                client = RpcClient(n["address"], peer_id="rt-doctor")
+                await client.connect()
+                stats, mem = await asyncio.gather(
+                    client.call("node_stats", {}, timeout=10.0),
+                    client.call("memory_report", {"limit": 20},
+                                timeout=10.0))
+                out["stats"] = stats
+                out["memory"] = mem
+            except Exception as e:  # noqa: BLE001 — report, don't die
+                out["unreachable"] = f"{type(e).__name__}: {e}"
+            finally:
+                if client is not None:
+                    try:
+                        await client.close()
+                    except Exception:  # noqa: BLE001
+                        pass
+            return out
+
+        probed = list(await asyncio.gather(*(probe_node(n) for n in nodes)))
+
+        # ownership ledgers via the GCS KV (no driver needed) -> suspects;
+        # fetched concurrently — the one-shot report must not serialize
+        # 200 round-trips against a loaded GCS
+        ledgers: List[Dict] = []
+        try:
+            keys = (await gcs.call("kv_keys", {"prefix": "@memobj/"},
+                                   timeout=10.0))["keys"]
+            now = time.time()
+            replies = await asyncio.gather(
+                *(gcs.call("kv_get", {"key": k}, timeout=10.0)
+                  for k in keys[:200]))
+            for reply in replies:
+                raw = reply.get("value")
+                if not raw:
+                    continue
+                try:
+                    led = json.loads(raw)
+                except ValueError:
+                    continue
+                if now - led.get("t", 0.0) <= 30.0:  # live pushers only
+                    ledgers.append(led)
+        except Exception:  # noqa: BLE001 — ledger plane optional
+            pass
+
+        return {"t": time.time(), "gcs_address": gcs_address,
+                "window_s": window_s, "nodes": probed, "actors": actors,
+                "failures": failures, "oom_kills": ooms,
+                "ledgers": ledgers}
+    finally:
+        try:
+            await gcs.close()
+        except Exception:  # noqa: BLE001
+            pass
+
+
+def collect(gcs_address: str, window_s: float = 600.0,
+            limit: int = 1000) -> Dict[str, Any]:
+    """Gather the health report, or raise ConnectionError when the GCS is
+    unreachable."""
+    return asyncio.run(_collect_async(gcs_address, window_s, limit))
+
+
+def _recent(events: List[Dict], window_s: float,
+            now: Optional[float] = None) -> List[Dict]:
+    now = time.time() if now is None else now
+    return [e for e in events or ()
+            if now - e.get("last_t", e.get("t", 0.0)) <= window_s]
+
+
+def diagnose(report: Dict[str, Any],
+             queue_warn: int = 100) -> List[Tuple[str, str]]:
+    """Turn the raw report into ranked ``(level, message)`` findings.
+    Any CRITICAL finding makes the cluster unhealthy (exit 1)."""
+    findings: List[Tuple[str, str]] = []
+    window_s = report.get("window_s", 600.0)
+
+    # -- liveness ------------------------------------------------------------
+    now = time.time()
+    nodes = report.get("nodes", [])
+    dead = [n for n in nodes if not n.get("alive", True)]
+    for n in dead:
+        # dead rows persist forever in the GCS node table — window them
+        # like actor deaths (a drain from hours ago must not fail today's
+        # CI gate), and grade a deliberate drain as a warning, not a page
+        died_at = n.get("death_t")
+        if died_at is not None and now - died_at > window_s:
+            continue
+        reason = n.get("death_reason") or ""
+        level = WARN if "drain" in reason else CRITICAL
+        findings.append((level, f"node {n['node_id'][:8]} is DEAD"
+                                + (f" ({reason})" if reason else "")))
+    for n in nodes:
+        if n.get("alive", True) and n.get("unreachable"):
+            findings.append((CRITICAL,
+                             f"node {n['node_id'][:8]} is marked alive but "
+                             f"unreachable: {n['unreachable']}"))
+    if not nodes:
+        findings.append((CRITICAL, "no nodes registered with the GCS"))
+
+    # -- actors --------------------------------------------------------------
+    for a in report.get("actors", []):
+        if a.get("state") != "DEAD":
+            continue
+        cause = a.get("death_cause") or {}
+        cat = cause.get("category", "unknown")
+        if cat == "cancelled":
+            continue  # deliberate kill() — not a health problem
+        # recency window: the actor table keeps DEAD rows for the cluster's
+        # lifetime — a death from hours ago must not fail today's CI gate
+        # (causes without a stamp are treated as recent, conservatively)
+        died_at = cause.get("t")
+        if died_at is not None and now - died_at > window_s:
+            continue
+        level = (CRITICAL if cat in _CRITICAL_CATEGORIES else WARN)
+        findings.append((
+            level,
+            f"actor {str(a.get('actor_id'))[:8]} "
+            f"({a.get('class_name')}) died: "
+            f"{a.get('death_reason') or cat} "
+            f"[category={cat}, restarts={a.get('num_restarts', 0)}]"))
+
+    # -- failure feed, ranked by category ------------------------------------
+    recent = _recent(report.get("failures"), window_s)
+    by_cat: Dict[str, int] = {}
+    for e in recent:
+        by_cat[e.get("category", "unknown")] = \
+            by_cat.get(e.get("category", "unknown"), 0) + e.get("count", 1)
+    for cat, count in sorted(by_cat.items(), key=lambda kv: -kv[1]):
+        if cat == "cancelled":
+            continue
+        level = CRITICAL if cat in _CRITICAL_CATEGORIES else WARN
+        findings.append((level,
+                         f"{count} recent failure(s) of category {cat} "
+                         f"(last {int(window_s)}s; see `rt errors "
+                         f"--category {cat}`)"))
+
+    # -- OOM post-mortems (memory plane) -------------------------------------
+    for ev in _recent(report.get("oom_kills"), window_s):
+        v = ev.get("victim", {})
+        findings.append((
+            CRITICAL,
+            f"OOM kill on node {str(ev.get('node_id'))[:8]}: "
+            f"{v.get('role', 'worker')} {str(v.get('worker_id'))[:8]} "
+            f"running {v.get('task') or v.get('actor_id') or '(idle)'} "
+            f"(replay: `rt memory --oom`)"))
+
+    # -- scheduler / spill pressure ------------------------------------------
+    for n in nodes:
+        if not n.get("alive", True):
+            continue
+        depth = n.get("queue_depth", 0)
+        if depth > queue_warn:
+            findings.append((WARN,
+                             f"node {n['node_id'][:8]} raylet queue depth "
+                             f"{depth} (> {queue_warn}; tasks are waiting "
+                             f"on resources)"))
+        store = (n.get("memory") or {}).get("store") or {}
+        cap = store.get("capacity_bytes") or 0
+        in_mem = store.get("in_mem_bytes") or 0
+        if cap and in_mem / cap > 0.9:
+            findings.append((WARN,
+                             f"node {n['node_id'][:8]} object store at "
+                             f"{100 * in_mem / cap:.0f}% of capacity "
+                             f"(spill imminent)"))
+        if store.get("spilled_bytes"):
+            findings.append((WARN,
+                             f"node {n['node_id'][:8]} holds "
+                             f"{store.get('spilled_count', 0)} spilled "
+                             f"object(s) on disk "
+                             f"({store['spilled_bytes']} bytes) — gets pay "
+                             f"restore IO"))
+
+    # -- leak suspects (memory plane) ----------------------------------------
+    try:
+        from ray_tpu.util.memory import (_merge_owner_info,
+                                         _suspects_from_ledgers)
+
+        owner_info = _merge_owner_info(report.get("ledgers") or [])
+        suspects = _suspects_from_ledgers(owner_info, None)
+        if suspects:
+            top = suspects[0]
+            findings.append((WARN,
+                             f"{len(suspects)} leak suspect(s) — oldest-"
+                             f"held driver-local refs (largest: "
+                             f"{top.get('size', 0)} bytes, see "
+                             f"`rt memory`)"))
+    except Exception:  # noqa: BLE001 — ledger plane optional
+        pass
+
+    if not findings:
+        findings.append((OK, "no dead nodes/actors, no recent failures, "
+                             "no memory pressure"))
+    order = {CRITICAL: 0, WARN: 1, OK: 2}
+    findings.sort(key=lambda f: order.get(f[0], 3))
+    return findings
+
+
+def exit_code(findings: List[Tuple[str, str]]) -> int:
+    return 1 if any(level == CRITICAL for level, _ in findings) else 0
+
+
+def format_report(report: Dict[str, Any],
+                  findings: List[Tuple[str, str]]) -> str:
+    nodes = report.get("nodes", [])
+    actors = report.get("actors", [])
+    alive_n = sum(1 for n in nodes if n.get("alive", True))
+    alive_a = sum(1 for a in actors if a.get("state") == "ALIVE")
+    recent = _recent(report.get("failures"), report.get("window_s", 600.0))
+    lines = [
+        f"=== rt doctor @ {time.strftime('%Y-%m-%d %H:%M:%S')} "
+        f"(gcs {report.get('gcs_address')}) ===",
+        f"nodes:  {alive_n}/{len(nodes)} alive   "
+        f"actors: {alive_a}/{len(actors)} alive   "
+        f"recent failures: {sum(e.get('count', 1) for e in recent)} "
+        f"(last {int(report.get('window_s', 600))}s)",
+        "",
+    ]
+    marks = {CRITICAL: "[CRIT]", WARN: "[warn]", OK: "[ ok ]"}
+    for level, msg in findings:
+        lines.append(f"{marks.get(level, '[ ?? ]')} {msg}")
+    workers = sum((n.get("stats") or {}).get("workers", 0) for n in nodes)
+    queued = sum((n.get("stats") or {}).get("queued", 0) for n in nodes)
+    lines.append("")
+    lines.append(f"workers: {workers} live   queued tasks: {queued}")
+    verdict = ("UNHEALTHY" if exit_code(findings) else "healthy")
+    lines.append(f"verdict: {verdict}")
+    return "\n".join(lines)
+
+
+def run(gcs_address: str, window_s: float = 600.0, queue_warn: int = 100,
+        as_json: bool = False) -> Tuple[str, int]:
+    """Collect + diagnose + render; returns (text, exit_code). Exit 2 when
+    the GCS itself is unreachable."""
+    try:
+        report = collect(gcs_address, window_s=window_s)
+    except Exception as e:  # noqa: BLE001 — the cluster is the patient
+        return (f"rt doctor: cannot reach GCS at {gcs_address}: "
+                f"{type(e).__name__}: {e}", 2)
+    findings = diagnose(report, queue_warn=queue_warn)
+    if as_json:
+        payload = dict(report,
+                       findings=[{"level": lv, "message": m}
+                                 for lv, m in findings],
+                       healthy=exit_code(findings) == 0)
+        return json.dumps(payload, indent=2, default=str), \
+            exit_code(findings)
+    return format_report(report, findings), exit_code(findings)

@@ -68,6 +68,7 @@ def timeline(filename: Optional[str] = None) -> List[Dict[str, Any]]:
         if ev.get("phases"):
             trace.extend(_phase_lanes(ev))
     trace.extend(_memory_instants(backend))
+    trace.extend(_failure_instants(backend))
     if filename:
         with open(filename, "w") as f:
             json.dump(trace, f)
@@ -101,6 +102,34 @@ def _memory_instants(backend) -> List[Dict[str, Any]]:
             "ts": ev.get("t", 0.0) * 1e6,
             "pid": ev.get("node_id") or "node", "tid": "memory",
             "args": args,
+        })
+    return out
+
+
+def _failure_instants(backend) -> List[Dict[str, Any]]:
+    """Categorized FailureEvents as instant markers on a per-node
+    ``errors`` track (cluster/gcs.py ``failure_events`` store — the same
+    feed behind `rt errors` and `/api/errors`), so deaths line up against
+    the task lanes they interrupted."""
+    try:
+        events = backend.io.run(backend._gcs.call(
+            "list_failure_events", {"limit": 2000}))
+    except Exception:  # noqa: BLE001 — older GCS / local backend
+        return []
+    out: List[Dict[str, Any]] = []
+    for ev in events or ():
+        cat = ev.get("category", "unknown")
+        who = (ev.get("name") or ev.get("task_id") or ev.get("actor_id")
+               or ev.get("worker_id") or "")
+        name = f"{cat} {str(who)[:12]}".strip()
+        count = ev.get("count", 1)
+        if count > 1:
+            name += f" x{count}"
+        out.append({
+            "name": name, "cat": "error", "ph": "i", "s": "t",
+            "ts": ev.get("t", 0.0) * 1e6,
+            "pid": ev.get("node_id") or "node", "tid": "errors",
+            "args": {k: v for k, v in ev.items() if k != "t"},
         })
     return out
 
