@@ -97,6 +97,17 @@ class Config:
     # resolution (health checks, CI) set RT_ACTOR_RESOLVE_DEADLINE_S.
     actor_resolve_deadline_s: float = 0.0
     actor_restart_backoff_s: float = 0.5
+    # Restart-storm damping (reference: exponential actor restart delays in
+    # gcs_actor_manager): the GCS backs off min(cap, base * 2**(n-1)) +-25%
+    # jitter per consecutive restart, so a crash-looping actor can't hammer
+    # the scheduler at a fixed cadence.
+    actor_restart_backoff_max_s: float = 30.0
+    # RpcClient auto-reconnect pacing: capped exponential backoff + jitter
+    # across up to rpc_reconnect_attempts re-dials per call (a head restart
+    # takes a moment to rebind — an immediate single re-dial just loses).
+    rpc_reconnect_base_s: float = 0.05
+    rpc_reconnect_max_s: float = 2.0
+    rpc_reconnect_attempts: int = 5
     task_max_retries_default: int = 3
     # OOM prevention (reference: common/memory_monitor.h +
     # raylet/worker_killing_policy.cc): when node memory use crosses the

@@ -23,6 +23,7 @@ from ray_tpu.core import failure as F
 from ray_tpu.core.resources import NodeResources, ResourceSet
 from ray_tpu.cluster.rpc import ConnectionPool, spawn_task
 from ray_tpu.scheduler.policy import pick_node
+from ray_tpu.util import chaos as C
 
 ACTOR_PENDING = "PENDING_CREATION"
 ACTOR_ALIVE = "ALIVE"
@@ -111,6 +112,10 @@ class GcsServer:
         self._pool = ConnectionPool(peer_id="gcs")
         self._monitor_task: Optional[asyncio.Task] = None
         self._job_counter = 0
+        # chaos-plan revision (snapshotted): a restarted head must NOT come
+        # back at rev 0 while the KV still holds the plan — raylets would
+        # see a rev change, re-arm, and reset spent kill-once fire budgets
+        self._chaos_rev = 0
         # Snapshot persistence (reference: the Redis store client behind the
         # GCS tables, ``store_client/redis_store_client.cc`` — here a pickle
         # snapshot so a restarted head recovers actors/PGs/locations, plus a
@@ -204,6 +209,19 @@ class GcsServer:
                     logging.getLogger("ray_tpu.gcs").warning(
                         "KV WAL unavailable (%s: %s); falling back to "
                         "snapshot-only KV persistence.", type(e).__name__, e)
+            # A restarted head with a persisted chaos plan must RE-ARM its
+            # own process (GCS-local sites + its ConnectionPool clients) —
+            # otherwise rt chaos status would report armed cluster-wide
+            # while the head itself runs dead. Raylets stay armed on their
+            # own; the unchanged rev means no re-sync churn.
+            raw = self.kv.get(self._CHAOS_KEY)
+            if raw:
+                try:
+                    C.arm(raw.decode() if isinstance(raw, bytes) else raw,
+                          rev=max(1, self._chaos_rev))
+                    self._chaos_rev = max(1, self._chaos_rev)
+                except (ValueError, TypeError):
+                    pass
 
     @staticmethod
     def _encode_kv(value) -> bytes:
@@ -222,8 +240,11 @@ class GcsServer:
     def mark_dirty(self) -> None:
         self._persist_seq += 1
 
+    # failure_events/_failure_seq are lazily created by _record_failure —
+    # snapshot/restore tolerate their absence
     _SNAPSHOT_TABLES = ("kv", "actors", "named_actors", "placement_groups",
-                        "object_locations", "object_sizes", "_job_counter")
+                        "object_locations", "object_sizes", "_job_counter",
+                        "_chaos_rev", "failure_events", "_failure_seq")
 
     def _persist_snapshot(self) -> None:
         if not self._persist_path or self._persist_seq == self._persisted_seq:
@@ -248,11 +269,18 @@ class GcsServer:
         mutable containers (location sets mutate mid-pickle otherwise)."""
         state: Dict[str, Any] = {}
         for name in self._SNAPSHOT_TABLES:
-            table = getattr(self, name)
+            table = getattr(self, name, None)
+            if table is None:
+                continue  # lazily-created table never materialized
             if name == "kv" and self._kv_log is not None:
                 state[name] = {}  # the WAL is the KV's source of truth
             elif name == "object_locations":
                 state[name] = {k: set(v) for k, v in table.items()}
+            elif name == "failure_events":
+                # per-row copies: the dedup path mutates rows in place
+                # (count/last_t) and a row changing size mid-pickle on the
+                # executor thread would corrupt the snapshot
+                state[name] = [dict(e) for e in table]
             elif isinstance(table, dict):
                 state[name] = dict(table)
             else:
@@ -272,6 +300,17 @@ class GcsServer:
         for name in self._SNAPSHOT_TABLES:
             if name in state:
                 setattr(self, name, state[name])
+        if isinstance(self.__dict__.get("failure_events"), list):
+            # the feed survives a head restart (a chaos gcs.kill must stay
+            # attributable after its own kill): rebuild the bounded deque
+            # and reset the dedup index (cross-restart dedup not needed)
+            from collections import deque
+
+            self.failure_events = deque(self.failure_events,
+                                        maxlen=self._FAILURE_EVENTS_CAP)
+            self._failure_last = {}
+            self._failure_seq = int(self.__dict__.get("_failure_seq", 0)
+                                    or len(self.failure_events))
         # Restored ALIVE actors may still be running (their workers outlive
         # a GCS restart); callers re-resolve addresses on first use. Nodes
         # are NOT restored — raylets re-register with their next heartbeat.
@@ -306,9 +345,29 @@ class GcsServer:
         return {"ok": True}
 
     async def rpc_heartbeat(self, p):
+        f = C.maybe_fire("gcs.kill")
+        if f is not None:
+            self._record_failure(C.event_payload("gcs.kill", f))
+            import os as _os
+
+            if _os.environ.get("RT_NODE_DAEMON"):
+                # standalone head daemon (rt start): die for real — but
+                # snapshot FIRST so the injection event survives its own
+                # kill (the restarted head replays the feed)
+                self.mark_dirty()
+                try:
+                    self._persist_snapshot()
+                except Exception:  # noqa: BLE001 — the kill still happens
+                    pass
+                asyncio.get_running_loop().call_later(0.1, _os._exit, 137)
+            # in-process GCS (driver-hosted / test cluster): exiting would
+            # kill the host process — the stamped event records the
+            # suppression; tests use Cluster.kill_gcs() instead
         entry = self.nodes.get(p["node_id"])
         if entry is None:
-            return {"ok": False, "unknown": True}
+            return {"ok": False, "unknown": True,
+                    "chaos_rev": self._chaos_rev,
+                    "chaos_armed": self._CHAOS_KEY in self.kv}
         entry.last_heartbeat = time.monotonic()
         resurrected = False
         if not entry.alive:
@@ -334,7 +393,15 @@ class GcsServer:
         # nodes listing — the number that explains a 255 s probe latency)
         if "queue_depth" in p:
             entry.queue_depth = p["queue_depth"]
-        return {"ok": True, "resurrected": resurrected}
+        # chaos-plan revision + armed flag ride every heartbeat reply:
+        # raylets compare against their last-seen rev and (re)fetch
+        # @chaos/plan on change — the distribution path that lets
+        # `rt chaos` torture a live cluster. The armed flag lets a DISARM
+        # propagate without any KV fetch, so even a plan dropping every
+        # other rpc stays disarmable.
+        return {"ok": True, "resurrected": resurrected,
+                "chaos_rev": self._chaos_rev,
+                "chaos_armed": self._CHAOS_KEY in self.kv}
 
     async def rpc_cluster_load(self, p):
         """Autoscaler input: per-node capacity/usage + unplaced demand
@@ -467,6 +534,51 @@ class GcsServer:
             if was_created:
                 pg.state = PG_PENDING
                 spawn_task(self._schedule_pg(pg))
+
+    # ---- chaos plane (util/chaos.py) ---------------------------------------
+    # The GCS is the plan's distribution point: arm stores the plan in the
+    # KV (@chaos/plan) and bumps a revision that rides every heartbeat
+    # reply; raylets fetch + arm on rev change and forward to their workers.
+
+    _CHAOS_KEY = "@chaos/plan"
+
+    async def rpc_chaos_arm(self, p):
+        try:
+            plan = C.ChaosPlan.from_value(p.get("plan"))
+        except (ValueError, TypeError) as e:
+            return {"error": str(e)}
+        self._chaos_rev = self._chaos_rev + 1
+        # fresh nonce per EXPLICIT arm: re-running the same plan repeats
+        # the experiment (counters reset everywhere), while re-announces
+        # of this stored copy (head restart, worker forwards) keep the
+        # nonce and stay idempotent
+        plan.nonce = self._chaos_rev
+        await self.rpc_kv_put({"key": self._CHAOS_KEY,
+                               "value": plan.to_json()})
+        # arm this process too (gcs.kill / rpc.* sites in the GCS's own
+        # clients; in-process clusters share the process with everything)
+        C.arm(plan, rev=self._chaos_rev)
+        return {"ok": True, "rev": self._chaos_rev,
+                "plan": plan.to_dict()}
+
+    async def rpc_chaos_disarm(self, p):
+        await self.rpc_kv_del({"key": self._CHAOS_KEY})
+        self._chaos_rev = self._chaos_rev + 1
+        C.disarm()
+        return {"ok": True, "rev": self._chaos_rev}
+
+    async def rpc_chaos_status(self, p):
+        raw = self.kv.get(self._CHAOS_KEY)
+        plan = None
+        if raw:
+            try:
+                plan = C.ChaosPlan.from_value(
+                    raw.decode() if isinstance(raw, bytes) else raw).to_dict()
+            except (ValueError, TypeError):
+                plan = None
+        return {"armed": plan is not None,
+                "rev": self._chaos_rev, "plan": plan,
+                "local": C.status()}
 
     # ---- kv / function table ----------------------------------------------
     async def rpc_kv_put(self, p):
@@ -784,10 +896,27 @@ class GcsServer:
                 "name": entry.spec.get("class_name"),
                 "node_id": cause.context.get("node_id", entry.node_id),
                 "restarting": True, "num_restarts": entry.num_restarts})
+            # Restart-storm damping: CONSECUTIVE restarts back off
+            # exponentially (capped, jittered) instead of re-dispatching a
+            # crash loop at a fixed 0.5s cadence. The streak — not the
+            # lifetime num_restarts — keys the exponent, and it resets
+            # once the actor stayed healthy past the cap: an isolated
+            # failure of a long-lived actor recovers at base speed.
+            # Recorded on the entry so `rt list actors` / tests see it.
+            cfg = get_config()
+            now = time.monotonic()
+            if (now - getattr(entry, "last_failure_t", -1e9)
+                    > cfg.actor_restart_backoff_max_s):
+                entry.restart_streak = 0
+            entry.restart_streak = getattr(entry, "restart_streak", 0) + 1
+            entry.last_failure_t = now
+            backoff = F.backoff_with_jitter(
+                entry.restart_streak, cfg.actor_restart_backoff_s,
+                cfg.actor_restart_backoff_max_s)
+            entry.last_restart_backoff_s = backoff
             # Backoff happens inside the spawned task — this path runs on the
             # monitor loop and must not stall node-death handling.
-            spawn_task(self._schedule_actor(
-                entry, backoff=get_config().actor_restart_backoff_s))
+            spawn_task(self._schedule_actor(entry, backoff=backoff))
         else:
             if entry.num_restarts >= max_restarts > 0:
                 # the budget existed and is spent: the terminal cause is
@@ -1254,6 +1383,11 @@ class GcsServer:
         category = p.get("category")
         if category:
             events = [e for e in events if e.get("category") == category]
+        origin = p.get("origin")
+        if origin == "organic":  # everything NOT injected by the chaos plane
+            events = [e for e in events if not e.get("origin")]
+        elif origin:
+            events = [e for e in events if e.get("origin") == origin]
         since = p.get("since")
         if since:
             events = [e for e in events

@@ -62,6 +62,11 @@ async def _amain(args: argparse.Namespace) -> None:
     from ray_tpu.cluster.raylet import Raylet
     from ray_tpu.cluster.rpc import RpcClient, RpcServer
 
+    # Marks this process as a standalone node daemon: destructive chaos
+    # sites (gcs.kill) are only allowed to os._exit here, never inside a
+    # driver-hosted in-process control plane (util/chaos.py).
+    os.environ["RT_NODE_DAEMON"] = "1"
+
     loop = asyncio.get_running_loop()
     stop_ev = asyncio.Event()
     for sig in (signal.SIGTERM, signal.SIGINT):

@@ -36,6 +36,7 @@ from ray_tpu.cluster.rpc import (
     spawn_task,
 )
 from ray_tpu.exceptions import WorkerCrashedError
+from ray_tpu.util import chaos as C
 
 
 class _WorkerEntry:
@@ -170,6 +171,20 @@ class Raylet:
         self._rss_reported: set = set()  # worker_ids with a live RSS gauge
         # client-side failure-emission rate limit (see _failure_event)
         self._failure_limiter = F.EmitLimiter()
+        # --- GCS-outage degraded mode (reference: the raylet surviving a
+        # GCS failover, gcs_client reconnection) --- while the GCS is
+        # unreachable this raylet KEEPS executing local work; bookkeeping
+        # updates (object locations, death reports) defer here and replay
+        # in order on resync. Entered by the heartbeat loop or the first
+        # failed publish; exited by the first successful heartbeat.
+        self._degraded_since: Optional[float] = None
+        self._deferred_gcs: "_collections.deque" = _collections.deque(
+            maxlen=10000)
+        self._deferred_dropped = 0  # overflow evictions during an outage
+        self._flushing = False      # single-flight deferred-replay guard
+        # last chaos-plan revision this raylet synced from the GCS
+        self._chaos_seen_rev = 0
+        self._hb_drops = 0  # consecutive chaos-dropped heartbeats
 
     _QUEUE_WAIT_BUCKETS = (0.001, 0.005, 0.02, 0.1, 0.5, 1.0, 5.0, 15.0,
                            60.0, 300.0, 900.0)
@@ -264,47 +279,91 @@ class Raylet:
         cfg = get_config()
         while True:
             await asyncio.sleep(cfg.heartbeat_interval_s)
-            try:
-                # queued-but-unplaced demand rides the heartbeat so the
-                # autoscaler can bin-pack it onto prospective node types
-                # (reference: resource_demand_scheduler's load report)
-                demands: Dict[Tuple, int] = {}
-                for item in self._queue[:100]:
-                    key = tuple(sorted(
-                        item["payload"].get("resources", {}).items()))
-                    demands[key] = demands.get(key, 0) + 1
-                reply = await self._gcs.call("heartbeat", {
-                    "node_id": self.node_id,
-                    "available": self.node.available.to_dict(),
-                    "queue_depth": len(self._queue),
-                    "queued_demands": [
-                        {"resources": dict(k), "count": c}
-                        for k, c in list(demands.items())[:20]]})
-                if reply.get("unknown"):
-                    # The GCS restarted and lost the node table (nodes are
-                    # deliberately not snapshotted): re-register under the
-                    # SAME node id, then re-publish actors + locations OFF
-                    # this loop (stalling heartbeats past the death timeout
-                    # would get the fresh registration killed again).
-                    await self._gcs.call("register_node", {
-                        "node_id": self.node_id,
-                        "address": self.server.address,
-                        "resources": self.node.total.to_dict(),
-                        "labels": dict(self.node.labels)})
-                    spawn_task(self._reattach_after_gcs_restart())
-                if reply.get("resurrected"):
-                    # off the heartbeat loop: a long republish here would
-                    # stall heartbeats past node_death_timeout_s and
-                    # re-enter the death/resurrect cycle
-                    spawn_task(self._reconcile_after_resurrection())
-            except Exception:
-                pass
+            f = C.maybe_fire("raylet.heartbeat_drop")
+            if f is not None:
+                # simulated raylet<->GCS partition: ONLY the beat is not
+                # sent (telemetry push + dispatch wake below still run —
+                # local work must not stall); enough consecutive drops
+                # cross node_death_timeout_s and the GCS declares this
+                # node dead (then resurrects it when the beats resume)
+                self._chaos_stamp("raylet.heartbeat_drop", f)
+                self._hb_drops += 1
+                if self._hb_drops % 5 == 0:
+                    # an UNBOUNDED drop plan must still honor `rt chaos
+                    # disarm`: probe the plan revision out-of-band every
+                    # few drops (the heartbeat itself stays dropped, so
+                    # the node-death semantics are untouched)
+                    spawn_task(self._probe_chaos_rev())
+            else:
+                self._hb_drops = 0
+                await self._heartbeat_once()
             if self._telemetry:
                 await self._push_telemetry()
             if self._queue:
                 # periodic wake so waiting tasks re-evaluate spillback even
                 # when no local resource event fires
                 self._dispatch_event.set()
+
+    async def _heartbeat_once(self) -> None:
+        try:
+            # queued-but-unplaced demand rides the heartbeat so the
+            # autoscaler can bin-pack it onto prospective node types
+            # (reference: resource_demand_scheduler's load report)
+            demands: Dict[Tuple, int] = {}
+            for item in self._queue[:100]:
+                key = tuple(sorted(
+                    item["payload"].get("resources", {}).items()))
+                demands[key] = demands.get(key, 0) + 1
+            # bounded: a hung-but-connected GCS must trip the transient
+            # path into degraded mode, not wedge the maintenance loop
+            reply = await self._gcs.call("heartbeat", {
+                "node_id": self.node_id,
+                "available": self.node.available.to_dict(),
+                "queue_depth": len(self._queue),
+                "queued_demands": [
+                    {"resources": dict(k), "count": c}
+                    for k, c in list(demands.items())[:20]]},
+                timeout=10.0)
+            if reply.get("unknown"):
+                # The GCS restarted and lost the node table (nodes are
+                # deliberately not snapshotted): re-register under the
+                # SAME node id, then re-publish actors + locations OFF
+                # this loop (stalling heartbeats past the death timeout
+                # would get the fresh registration killed again).
+                await self._gcs.call("register_node", {
+                    "node_id": self.node_id,
+                    "address": self.server.address,
+                    "resources": self.node.total.to_dict(),
+                    "labels": dict(self.node.labels)}, timeout=10.0)
+                spawn_task(self._reattach_after_gcs_restart())
+            if reply.get("resurrected"):
+                # off the heartbeat loop: a long republish here would
+                # stall heartbeats past node_death_timeout_s and
+                # re-enter the death/resurrect cycle
+                spawn_task(self._reconcile_after_resurrection())
+            rev = reply.get("chaos_rev")
+            if rev is not None and rev != self._chaos_seen_rev:
+                spawn_task(self._sync_chaos(
+                    rev, reply.get("chaos_armed", True)))
+            if self._degraded_since is not None and not self._flushing:
+                # the GCS is reachable again: replay deferred updates and
+                # leave degraded mode — OFF this loop (a 10k-entry replay
+                # awaited here would stall beats past node_death_timeout_s
+                # and re-enter the death/resurrect cycle); single-flight
+                self._flushing = True
+                spawn_task(self._flush_deferred_guarded())
+            for ev in C.drain_events():
+                # rpc.* chaos fires buffered in-process (the rpc layer
+                # has no GCS handle) ship from here
+                F.emit_raw(spawn_task, self._gcs, ev)
+        except Exception as e:  # noqa: BLE001
+            # GCS unreachable: enter degraded mode — local dispatch
+            # keeps running, bookkeeping defers until resync. Only
+            # TRANSPORT failures count (same discipline as _gcs_publish);
+            # an application error from a healthy GCS is swallowed like
+            # the pre-degraded-mode loop did.
+            if self._is_transient(e) and self._degraded_since is None:
+                self._degraded_since = time.monotonic()
 
     async def _push_telemetry(self) -> None:
         """Queue-depth gauge + registry push. A standalone node daemon has
@@ -411,6 +470,136 @@ class Raylet:
         F.emit(spawn_task, self._gcs, category, message,
                node_id=self.node_id, **fields)
 
+    # ---- chaos plane (util/chaos.py) ---------------------------------------
+    def _chaos_stamp(self, site: str, fault: Dict, **fields) -> None:
+        """Stamp one chaos-origin FailureEvent for a fault fired in this
+        raylet. Thread-safe: callable from the spill executor as well as
+        the event loop (the send is scheduled onto the loop)."""
+        payload = C.event_payload(site, fault, node_id=self.node_id,
+                                  **fields)
+        self.loop.call_soon_threadsafe(
+            F.emit_raw, spawn_task, self._gcs, payload)
+
+    async def _probe_chaos_rev(self) -> None:
+        """Out-of-band plan-revision check while heartbeats are being
+        chaos-dropped — the escape hatch that keeps disarm reachable."""
+        try:
+            reply = await self._gcs.call("chaos_status", {}, timeout=10.0)
+        except Exception:  # noqa: BLE001 — next probe retries
+            return
+        rev = reply.get("rev")
+        if rev is not None and rev != self._chaos_seen_rev:
+            await self._sync_chaos(rev, reply.get("armed", True))
+
+    async def _sync_chaos(self, rev: int, armed: bool = True) -> None:
+        """The GCS announced a new chaos-plan revision: fetch the plan via
+        the chaos-exempt ``chaos_status`` RPC (a live rpc.drop plan must
+        not block its own update), arm/disarm this process, and forward
+        to live workers (new workers get the plan via RT_CHAOS_PLAN_JSON
+        at spawn). ``armed=False`` (from the heartbeat reply) skips the
+        fetch so a DISARM always lands."""
+        plan = None
+        if armed:
+            try:
+                reply = await self._gcs.call("chaos_status", {},
+                                             timeout=10.0)
+            except Exception:  # noqa: BLE001 — next heartbeat retries
+                return
+            plan = reply.get("plan")
+            if plan is not None:
+                try:
+                    C.arm(plan, rev=rev)
+                except Exception:  # noqa: BLE001 — malformed: stay safe
+                    plan = None
+        if plan is None:
+            C.disarm()
+        self._chaos_seen_rev = rev
+        for entry in list(self._workers.values()):
+            if entry.client is None or entry.proc.poll() is not None:
+                continue
+            try:
+                await entry.client.call(
+                    "chaos_arm", {"plan": plan, "rev": rev}, timeout=5.0)
+            except Exception:  # noqa: BLE001 — worker mid-death or busy
+                continue
+
+    # ---- GCS-outage degraded mode ------------------------------------------
+    # Only TRANSPORT failures mean "the GCS is unreachable"; an
+    # application-level RpcError is a healthy GCS rejecting this payload —
+    # deferring it would poison the replay queue (same payload, same
+    # rejection, forever) and wedge the raylet in degraded mode.
+    _TRANSIENT_GCS_ERRORS = (OSError, asyncio.TimeoutError)
+
+    def _is_transient(self, e: BaseException) -> bool:
+        from ray_tpu.cluster.rpc import ConnectionLost
+
+        return isinstance(e, (ConnectionLost,) + self._TRANSIENT_GCS_ERRORS)
+
+    def _defer(self, method: str, payload: Dict) -> None:
+        if len(self._deferred_gcs) == self._deferred_gcs.maxlen:
+            # overflow evicts the oldest entry — COUNTED, never silent;
+            # the resync path repairs with a full location republish
+            self._deferred_dropped += 1
+        self._deferred_gcs.append((method, payload))
+
+    async def _gcs_publish(self, method: str, payload: Dict) -> None:
+        """Bookkeeping updates (object locations, death reports) that must
+        not fail LOCAL execution when the GCS is unreachable: in degraded
+        mode they defer immediately (no per-call reconnect stall) and
+        replay in order once the heartbeat loop sees the GCS again.
+        Application errors propagate to the caller as before."""
+        if self._degraded_since is not None:
+            self._defer(method, payload)
+            return
+        try:
+            await self._gcs.call(method, payload, timeout=10.0)
+        except Exception as e:  # noqa: BLE001
+            if not self._is_transient(e):
+                raise
+            if self._degraded_since is None:
+                self._degraded_since = time.monotonic()
+            self._defer(method, payload)
+
+    async def _flush_deferred_guarded(self) -> None:
+        try:
+            await self._flush_deferred()
+        finally:
+            self._flushing = False
+
+    async def _flush_deferred(self) -> None:
+        """Replay deferred bookkeeping after a GCS outage; exits degraded
+        mode only when the whole backlog lands. A transport failure means
+        the GCS bounced again — stay degraded, keep the rest queued; an
+        application rejection drops THAT entry (a poisoned payload must
+        not head-of-line-block the backlog forever)."""
+        n = len(self._deferred_gcs)
+        while self._deferred_gcs:
+            method, payload = self._deferred_gcs.popleft()
+            try:
+                await self._gcs.call(method, payload, timeout=10.0)
+            except Exception as e:  # noqa: BLE001
+                if self._is_transient(e):  # still (or again) down
+                    self._deferred_gcs.appendleft((method, payload))
+                    return
+                continue  # rejected by a healthy GCS: drop, keep flushing
+        outage_s = time.monotonic() - (self._degraded_since
+                                       or time.monotonic())
+        self._degraded_since = None
+        dropped = self._deferred_dropped
+        self._deferred_dropped = 0
+        if dropped:
+            # the deque overflowed during the outage: some location
+            # updates are gone — repair wholesale by republishing every
+            # object this node still serves (idempotent adds)
+            spawn_task(self._reconcile_after_resurrection())
+        self._failure_event(
+            F.UNKNOWN,
+            f"raylet ran degraded for {outage_s:.1f}s during a GCS "
+            f"outage; resynced {n} deferred update(s)"
+            + (f", {dropped} overflowed (full location republish "
+               f"triggered)" if dropped else ""),
+            origin="recovery")
+
     # ---- worker pool --------------------------------------------------------
     def _spawn_worker(self, key: Tuple, chips: List[int],
                       runtime_env: Optional[Dict] = None,
@@ -432,6 +621,12 @@ class Raylet:
             env["RT_RUNTIME_ENV_JSON"] = json.dumps(runtime_env)
         if chips:
             env[get_config().tpu_visible_chips_env] = ",".join(map(str, chips))
+        if C.armed():
+            # new workers join the tortured cluster armed from birth (live
+            # workers got the plan via the chaos_arm RPC)
+            env["RT_CHAOS_PLAN_JSON"] = C.plan_json()
+        else:
+            env.pop("RT_CHAOS_PLAN_JSON", None)
         log_dir = os.path.join(get_config().session_dir_root,
                                self.session_name, "logs")
         os.makedirs(log_dir, exist_ok=True)
@@ -453,7 +648,23 @@ class Raylet:
         entry.client = await self._pool.get(p["address"])
         if not entry.ready.done():
             entry.ready.set_result(True)
+        if self._chaos_seen_rev > 0 or C.armed():
+            # a worker spawned just before a plan-rev change registered too
+            # late for _sync_chaos's forward and too early for the spawn
+            # env — hand it the CURRENT state so no worker runs stale
+            import json as _json
+
+            pj = C.plan_json()
+            spawn_task(self._call_quietly(entry.client, "chaos_arm", {
+                "plan": _json.loads(pj) if pj else None,
+                "rev": C.current_rev()}))
         return {"ok": True, "node_id": self.node_id}
+
+    async def _call_quietly(self, client, method: str, payload: Dict) -> None:
+        try:
+            await client.call(method, payload, timeout=5.0)
+        except Exception:  # noqa: BLE001 — best-effort side channel
+            pass
 
     async def _get_worker(self, key: Tuple, chips: List[int],
                           runtime_env: Optional[Dict] = None
@@ -592,10 +803,19 @@ class Raylet:
                                 node_id=self.node_id,
                                 worker_id=entry.worker_id,
                                 exit_code=entry.proc.returncode)
-                        await self._gcs.call("actor_update", {
-                            "actor_id": entry.actor_id, "state": "DEAD",
-                            "node_id": self.node_id,
-                            "reason": cause["message"], "cause": cause})
+                        # degraded-aware: a dead actor's report must not
+                        # kill the reap loop while the GCS is down — it
+                        # defers and replays on resync (the restart budget
+                        # is honored late rather than never); an outright
+                        # GCS rejection is swallowed too (retrying the
+                        # same report cannot help, and the loop must live)
+                        try:
+                            await self._gcs_publish("actor_update", {
+                                "actor_id": entry.actor_id, "state": "DEAD",
+                                "node_id": self.node_id,
+                                "reason": cause["message"], "cause": cause})
+                        except Exception:  # noqa: BLE001
+                            pass
                         entry.is_actor_worker = False
 
     async def _reattach_after_gcs_restart(self) -> None:
@@ -700,8 +920,17 @@ class Raylet:
         while True:
             await asyncio.sleep(cfg.memory_monitor_interval_s)
             try:
-                # per-tick lookup: tests inject a fake probe on the instance
-                info = (self._memory_info_fn or _native.memory_info)()
+                f = C.maybe_fire("oom.pressure")
+                if f is not None:
+                    # synthetic memory pressure: report the node at `value`
+                    # (fraction) so the monitor's kill path runs for real
+                    self._chaos_stamp("oom.pressure", f)
+                    info = {"total": 1000,
+                            "used": int(1000 * float(f.get("value", 0.99)))}
+                else:
+                    # per-tick lookup: tests inject a fake probe on the
+                    # instance
+                    info = (self._memory_info_fn or _native.memory_info)()
                 total, used = info.get("total", -1), info.get("used", -1)
                 if total <= 0 or used < 0:
                     continue
@@ -1092,6 +1321,20 @@ class Raylet:
         worker = None
         try:
             worker, source = await self._get_worker(key, chips, renv)
+            f = C.maybe_fire("raylet.kill_worker",
+                             target=payload.get("fn_name"))
+            if f is not None:
+                # kill the acquired worker just before the push: the push
+                # fails, the normal worker_crash path runs, and the owner's
+                # retry budget proves recovery. Counters live in this
+                # long-lived raylet, so at/max_fires plans stay exact.
+                self._chaos_stamp("raylet.kill_worker", f, task_id=task_id,
+                                  name=payload.get("fn_name"),
+                                  worker_id=worker.worker_id)
+                try:
+                    worker.proc.kill()
+                except ProcessLookupError:
+                    pass
             worker.busy = True
             worker.job_id = payload.get("job_id")
             worker.current_task = payload.get("fn_name")
@@ -1413,6 +1656,12 @@ class Raylet:
                     used -= meta["size"]
                     continue
                 t0 = time.monotonic()
+                fault = C.maybe_fire("spill.slow", target=oid_hex)
+                if fault is not None:
+                    # slow-disk injection (spill executor thread, so the
+                    # stall hits the IO histogram, not the event loop)
+                    self._chaos_stamp("spill.slow", fault, oid=oid_hex)
+                    time.sleep(float(fault.get("delay_s", 0.2)))
                 tmp = self._spill_path(oid_hex) + ".tmp"
                 with open(tmp, "wb") as f:
                     f.write(view)
@@ -1487,9 +1736,35 @@ class Raylet:
         self._local_objects.add(oid_hex)
         self._touch(oid_hex, size=p.get("size", 0), spilled=False)
         await self._maybe_spill()
-        await self._gcs.call("add_object_location", {
+        # degraded-aware: a sealed object must not fail its task because
+        # the GCS is briefly unreachable — the location defers + resyncs
+        await self._gcs_publish("add_object_location", {
             "oid": oid_hex, "node_id": self.node_id, "size": p.get("size", 0)})
+        f = C.maybe_fire("object.lose", target=oid_hex)
+        if f is not None:
+            # silent-loss injection: the location is registered but the
+            # payload vanishes — every later get must run the owner's
+            # lineage reconstruction (the recovery path under test)
+            self._chaos_stamp("object.lose", f, oid=oid_hex)
+            self._drop_object_copies(oid_hex)
         return {"ok": True}
+
+    def _drop_object_copies(self, oid_hex: str) -> None:
+        """Delete every local copy of an object (shm + spill + meta) —
+        the chaos object-loss effect."""
+        from ray_tpu._private.ids import ObjectID
+
+        try:
+            self.store.delete(ObjectID.from_hex(oid_hex))
+        except Exception:  # noqa: BLE001
+            pass
+        meta = self._object_meta.pop(oid_hex, None)
+        if meta is not None and not meta.get("spilled"):
+            self._in_mem_bytes -= meta["size"]
+        try:
+            os.unlink(self._spill_path(oid_hex))
+        except OSError:
+            pass
 
     async def rpc_get_object_payload(self, p):
         from ray_tpu._private.ids import ObjectID
@@ -1534,7 +1809,7 @@ class Raylet:
                 self._local_objects.add(oid_hex)
                 self._touch(oid_hex, size=total, spilled=False)
                 await self._maybe_spill()
-                await self._gcs.call("add_object_location", {
+                await self._gcs_publish("add_object_location", {
                     "oid": oid_hex, "node_id": self.node_id, "size": total})
             return {"ok": True}
         except Exception as e:  # noqa: BLE001 — drop partial upload
@@ -1693,7 +1968,7 @@ class Raylet:
                 os.unlink(self._spill_path(oid_hex))
             except FileNotFoundError:
                 pass
-            await self._gcs.call("remove_object_location", {
+            await self._gcs_publish("remove_object_location", {
                 "oid": oid_hex, "node_id": self.node_id})
         return {"ok": True}
 

@@ -72,6 +72,42 @@ class ConnectionLost(RpcError):
     pass
 
 
+# Lazily-bound chaos module (util/chaos.py): the rpc layer stays free of
+# top-level ray_tpu imports, and the unarmed fast path is one attribute
+# check per call.
+_chaos_mod = None
+
+
+def _chaos():
+    global _chaos_mod
+    if _chaos_mod is None:
+        from ray_tpu.util import chaos
+
+        _chaos_mod = chaos
+    return _chaos_mod
+
+
+_reconnect_counter = None
+
+
+def _observe_reconnect(outcome: str) -> None:
+    """``rt_rpc_reconnects_total{outcome=}``: one tick per reconnect dial
+    attempt (ok / error), in the reconnecting process's registry. Never
+    raises — reconnect telemetry must not break the reconnect."""
+    global _reconnect_counter
+    try:
+        from ray_tpu.util import metrics as M
+
+        if _reconnect_counter is None:
+            _reconnect_counter = M.get_or_create(
+                M.Counter, "rt_rpc_reconnects_total",
+                "RPC client reconnect dial attempts after a dropped "
+                "connection, by outcome", tag_keys=("outcome",))
+        _reconnect_counter.inc(1.0, {"outcome": outcome})
+    except Exception:  # noqa: BLE001
+        pass
+
+
 def bind_host() -> str:
     """The interface servers bind (config ``bind_host``; default loopback).
     Set RT_BIND_HOST=0.0.0.0 on multi-host clusters."""
@@ -245,6 +281,7 @@ class RpcClient:
         self._closed = False
         self._explicitly_closed = False
         self._reconnect_lock: Optional[asyncio.Lock] = None
+        self._reconnect_failed_at = -1e9  # monotonic stamp of last failure
 
     async def connect(self) -> None:
         host, port = self.address.rsplit(":", 1)
@@ -264,18 +301,61 @@ class RpcClient:
             if self._explicitly_closed:
                 raise ConnectionLost(
                     f"connection to {self.address} closed")
-            await cancel_and_wait(getattr(self, "_read_task", None))
-            if self._writer is not None:
-                # release the dead socket before dialing again — daemons
-                # riding out repeated head crashes must not leak one FD
-                # per reconnect cycle
-                self._writer.close()
-                self._writer = None
-            try:
-                await self.connect()
-            except OSError as e:
+            # Capped exponential backoff + jitter across several re-dials
+            # (reference: gcs_rpc_client retry pacing): a restarted head
+            # takes a moment to rebind its port, and an immediate single
+            # re-dial both loses that race and — across a fleet of
+            # reconnecting raylets — stampedes the resurrected server.
+            from ray_tpu._private.config import get_config
+            from ray_tpu.core.failure import backoff_with_jitter
+
+            cfg = get_config()
+            # Failure memo: callers queued on the lock behind a cycle that
+            # just exhausted its attempts fail FAST instead of each
+            # re-dialing the full backoff ladder (K serialized callers
+            # would otherwise stack K x the cycle time).
+            import time as _time
+
+            if (_time.monotonic() - self._reconnect_failed_at
+                    < max(2.0, cfg.rpc_reconnect_max_s)):
                 raise ConnectionLost(
-                    f"reconnect to {self.address} failed: {e}") from None
+                    f"reconnect to {self.address} is failing "
+                    f"(recent attempt cycle exhausted; retry suppressed)")
+            attempts = max(1, cfg.rpc_reconnect_attempts)
+            last_err: Optional[BaseException] = None
+            for attempt in range(1, attempts + 1):
+                await cancel_and_wait(getattr(self, "_read_task", None))
+                if self._writer is not None:
+                    # release the dead socket before dialing again — daemons
+                    # riding out repeated head crashes must not leak one FD
+                    # per reconnect cycle
+                    self._writer.close()
+                    self._writer = None
+                try:
+                    # each dial is BOUNDED: a blackholed host (SYN dropped,
+                    # no RST) or a server that accepts TCP but never
+                    # answers hello must burn one attempt, not wedge every
+                    # caller serialized on the reconnect lock
+                    await asyncio.wait_for(
+                        self.connect(), max(2.0, cfg.rpc_reconnect_max_s))
+                    _observe_reconnect("ok")
+                    self._reconnect_failed_at = -1e9
+                    return
+                except (OSError, ConnectionLost, asyncio.TimeoutError) as e:
+                    # ConnectionLost too: connect() ends with a hello RPC
+                    # that dies mid-handshake when the server is still
+                    # going down — that's a retryable dial, not a verdict
+                    self._closed = True  # a partial dial is cleaned up at
+                    last_err = e         # the top of the next iteration
+                    _observe_reconnect("error")
+                    if attempt < attempts:
+                        await asyncio.sleep(backoff_with_jitter(
+                            attempt, cfg.rpc_reconnect_base_s,
+                            cfg.rpc_reconnect_max_s))
+            self._reconnect_failed_at = _time.monotonic()
+            raise ConnectionLost(
+                f"reconnect to {self.address} failed after {attempts} "
+                f"attempt(s): {last_err}") from None
 
     async def _read_loop(self) -> None:
         try:
@@ -311,6 +391,25 @@ class RpcClient:
 
     async def call(self, method: str, payload: Any = None,
                    timeout: Optional[float] = None) -> Any:
+        c = _chaos()
+        # chaos rpc partition sites — a few methods are BELOW the
+        # injection plane: 'hello' (dropping the handshake would leave a
+        # connected-but-anonymous client whose server-side disconnect
+        # tracking never engages, outlasting the partition) and the chaos
+        # control loop itself ('heartbeat' carries the plan revision,
+        # 'chaos_status' carries the plan, 'chaos_arm' is the worker
+        # forward) — an armed plan must never block its own rollout,
+        # update, or disarm; heartbeat partitions have their dedicated
+        # raylet.heartbeat_drop site
+        if c._STATE is not None and method not in (
+                "hello", "heartbeat", "chaos_status", "chaos_arm"):
+            f = c.maybe_fire("rpc.delay", target=method)
+            if f is not None:
+                await asyncio.sleep(float(f.get("delay_s", 0.05)))
+            f = c.maybe_fire("rpc.drop", target=method)
+            if f is not None:
+                raise ConnectionLost(
+                    f"chaos: dropped rpc {method!r} to {self.address}")
         if self._closed:
             if not self.auto_reconnect or self._explicitly_closed:
                 raise ConnectionLost(f"connection to {self.address} closed")

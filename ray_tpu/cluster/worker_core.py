@@ -345,15 +345,51 @@ class ClusterBackend(RuntimeBackend):
 
     # ---- bootstrap ----------------------------------------------------------
     def connect(self) -> None:
+        # chaos plane: a process spawned into a tortured cluster arms
+        # before any RPC it issues can be a target (worker processes get
+        # the plan injected by their raylet at spawn; a driver attaching to
+        # a chaos run sets the same env explicitly)
+        import os as _os
+
+        plan_json = _os.environ.get("RT_CHAOS_PLAN_JSON")
+        armed_from_env = False
+        if plan_json:
+            from ray_tpu.util import chaos as _chaos
+
+            try:
+                _chaos.arm(plan_json)
+                armed_from_env = True
+            except (ValueError, TypeError):
+                logger.warning("RT_CHAOS_PLAN_JSON did not parse as a "
+                               "ChaosPlan; ignoring")
+
         async def _go():
             await self.server.start()
             self._gcs = RpcClient(self.gcs_address, peer_id=self.role,
                                   auto_reconnect=True)
-            await self._gcs.connect()
+            try:
+                await self._gcs.connect()
+            except (OSError, ConnectionLost):
+                # ConnectionLost too: connect() ends with a hello RPC that
+                # can die mid-handshake when the head is going down
+                if self.role != "worker":
+                    raise
+                # Degraded boot: the GCS is unreachable (outage/failover)
+                # but a worker only needs its RAYLET to serve pushes — boot
+                # anyway and let the auto-reconnect client re-dial at first
+                # use, so a raylet running degraded can still grow its pool
+                # instead of crash-looping spawns against a dead head.
+                self._gcs._closed = True
             self._raylet = RpcClient(self.raylet_address, peer_id=self.role)
             await self._raylet.connect()
 
         self.io.run(_go(), timeout=get_config().gcs_rpc_timeout_s)
+        if armed_from_env and self.role in ("driver", "client"):
+            # drivers have no raylet maintenance loop; without this their
+            # buffered rpc.* injection events would only drain when the
+            # log-forward loop happens to run (and never with
+            # log_to_driver off)
+            self.io.spawn(self._chaos_drain_loop())
         if self.role in ("driver", "client") and get_config().log_to_driver:
             self.io.spawn(self._log_forward_loop())
         if object_ledger.enabled():
@@ -381,7 +417,22 @@ class ClusterBackend(RuntimeBackend):
                 t = polled.get(addr)
                 if t is None or t.done():
                     polled[addr] = spawn_task(self._poll_node_logs(addr))
+            self._drain_chaos_events()
             await asyncio.sleep(10.0)
+
+    async def _chaos_drain_loop(self) -> None:
+        while not self._shutdown:
+            self._drain_chaos_events()
+            await asyncio.sleep(2.0)
+
+    def _drain_chaos_events(self) -> None:
+        """Ship buffered rpc.* injection events so they reach
+        `rt errors --origin chaos` (called from _chaos_drain_loop for
+        env-armed drivers, and opportunistically from the log-poll tick)."""
+        from ray_tpu.util import chaos as _chaos
+
+        for ev in _chaos.drain_events():
+            F.emit_raw(spawn_task, self._gcs, ev)
 
     async def _poll_node_logs(self, address: str) -> None:
         import sys

@@ -29,6 +29,7 @@ from ray_tpu.cluster.rpc import RpcClient
 from ray_tpu.cluster.worker_core import ClusterBackend
 from ray_tpu.core.object_ref import ObjectRef
 from ray_tpu.exceptions import TaskError
+from ray_tpu.util import chaos as C
 
 
 class WorkerProcess:
@@ -64,6 +65,7 @@ class WorkerProcess:
         srv.register("actor_call", self.rpc_actor_call)
         srv.register("exit", self.rpc_exit)
         srv.register("dump_stacks", self.rpc_dump_stacks)
+        srv.register("chaos_arm", self.rpc_chaos_arm)
         global_worker().connect(self.backend, self.backend.job_id, "worker")
         self.backend.io.run(self.backend._raylet.call("worker_ready", {
             "worker_id": self.worker_id,
@@ -93,6 +95,59 @@ class WorkerProcess:
             await asyncio.sleep(1.0)
             if self.backend._raylet._closed:
                 os._exit(0)
+            # buffered rpc.* chaos fires ship from the watch loop (the
+            # rpc layer itself has no GCS handle)
+            self.backend._drain_chaos_events()
+
+    async def rpc_chaos_arm(self, p):
+        """Live (re)arming from this worker's raylet when `rt chaos` ships
+        a new plan revision (new workers arm from RT_CHAOS_PLAN_JSON)."""
+        try:
+            if p.get("plan"):
+                C.arm(p["plan"], rev=p.get("rev", 0))
+            else:
+                C.disarm()
+            return {"ok": True}
+        except (ValueError, TypeError) as e:
+            return {"ok": False, "error": str(e)}
+
+    def _chaos_kill_payload(self, target, task_id, fault):
+        return C.event_payload(
+            "worker.kill", fault, node_id=os.environ.get("RT_NODE_ID"),
+            worker_id=self.worker_id, task_id=task_id, name=target)
+
+    def _maybe_chaos_kill(self, target: Optional[str],
+                          task_id: Optional[str]) -> None:
+        """worker.kill injection site (task-executor thread): die
+        mid-execution like a real crash (``os._exit(137)``), after
+        synchronously stamping the chaos-origin event (a fire-and-forget
+        would die with the process)."""
+        f = C.maybe_fire("worker.kill", target=target)
+        if f is None:
+            return
+        try:
+            self.backend.io.run(self.backend._gcs.call(
+                "failure_event",
+                self._chaos_kill_payload(target, task_id, f)), timeout=5.0)
+        except Exception:  # noqa: BLE001 — the kill still happens
+            pass
+        os._exit(137)
+
+    async def _maybe_chaos_kill_async(self, target: Optional[str],
+                                      task_id: Optional[str]) -> None:
+        """Event-loop twin of :meth:`_maybe_chaos_kill` (actor methods run
+        their dispatch on the io loop, where a blocking io.run would
+        deadlock)."""
+        f = C.maybe_fire("worker.kill", target=target)
+        if f is None:
+            return
+        try:
+            await asyncio.wait_for(self.backend._gcs.call(
+                "failure_event",
+                self._chaos_kill_payload(target, task_id, f)), 5.0)
+        except Exception:  # noqa: BLE001 — the kill still happens
+            pass
+        os._exit(137)
 
     async def rpc_exit(self, p):
         asyncio.get_running_loop().call_later(0.1, os._exit, 0)
@@ -182,6 +237,7 @@ class WorkerProcess:
 
         from ray_tpu.core.worker import global_worker
 
+        self._maybe_chaos_kill(p.get("fn_name"), p.get("task_id"))
         task_id = TaskID.from_hex(p["task_id"])
         self.backend.job_id = JobID.from_hex(p["job_id"])
         worker = global_worker()
@@ -395,6 +451,7 @@ class WorkerProcess:
         loop = asyncio.get_running_loop()
         task_id = TaskID.from_hex(p["task_id"])
         method_name = p["method"]
+        await self._maybe_chaos_kill_async(method_name, p.get("task_id"))
         method = getattr(self._actor_instance, method_name, None)
         if method is None:
             err = TaskError(method_name, AttributeError(

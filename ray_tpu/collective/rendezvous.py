@@ -77,20 +77,93 @@ def bootstrap_jax_distributed(world_size: int, rank: int,
     backend = global_worker()._require_backend()
     key = _kv_key(group_name if instance_token is None
                   else f"{group_name}/{instance_token}")
-    address = _publish_or_await_coordinator(
-        backend, key, rank, coordinator_ip, timeout_s,
-        f"rendezvous {group_name!r}")
+    try:
+        address = _publish_or_await_coordinator(
+            backend, key, rank, coordinator_ip, timeout_s,
+            f"rendezvous {group_name!r}")
+    except TimeoutError as e:
+        # CPU-graceful covers the await too: when a CPU gang's rank 0
+        # degraded (and cleaned its key), the peers must degrade with it
+        # rather than die on the missing coordinator
+        if _rendezvous_strict() or not _cpu_only_backend():
+            raise
+        import logging
+
+        logging.getLogger("ray_tpu.rendezvous").warning(
+            "rendezvous for %r timed out on a CPU-only host (%s); rank %d "
+            "continues with local jax", group_name, e, rank)
+        # "local jax" must actually be local: a pooled worker may still
+        # hold the PREVIOUS gang's coordinator client (see the teardown
+        # note below) — shut it down on this degrade path too
+        _shutdown_previous_gang()
+        return
     import jax
 
-    # Elastic-restart lifecycle (SURVEY.md §7 hard part: "jax.distributed
-    # lifecycle across actor restarts"): a pooled/reused worker process may
-    # carry a previous gang's coordinator client whose peers are gone —
-    # tear it down and drop cached backends so the new device topology can
-    # register. NCCL's equivalent is destroy_process_group before re-init.
-    if jax.distributed.is_initialized():
+    _shutdown_previous_gang()
+
+    kwargs = dict(coordinator_address=address,
+                  num_processes=world_size,
+                  process_id=rank,
+                  local_device_ids=local_device_ids)
+    try:
+        try:
+            # bound the rendezvous where jax supports it: a gang member
+            # that died pre-connect must fail THIS rank loudly in
+            # timeout_s, not hang the whole gang on a default 5-minute wait
+            jax.distributed.initialize(
+                initialization_timeout=max(1, int(timeout_s)), **kwargs)
+        except TypeError:  # older jax: no initialization_timeout kwarg
+            jax.distributed.initialize(**kwargs)
+    except Exception as e:  # noqa: BLE001
+        # CPU-graceful: on a CPU-only host a failed process-group bootstrap
+        # degrades to local (un-distributed) jax — the gang still runs, each
+        # rank seeing its own devices — so the multi-host product path can
+        # be exercised (and chaos-tested) without TPUs. On real accelerator
+        # hosts, or with RT_RENDEZVOUS_STRICT=1, the failure is fatal: a
+        # silent single-host fallback there would train the wrong program.
+        if _rendezvous_strict() or not _cpu_only_backend():
+            raise
+        import logging
+
+        logging.getLogger("ray_tpu.rendezvous").warning(
+            "jax.distributed bootstrap for %r failed on a CPU-only host "
+            "(%s: %s); rank %d continues with local jax "
+            "(set RT_RENDEZVOUS_STRICT=1 to make this fatal)",
+            group_name, type(e).__name__, e, rank)
+        if rank == 0:
+            # clean the rendezvous key on the degrade path too — a stale
+            # coordinator address must not greet the next gang reusing
+            # this group_name (peers that miss it degrade the same way
+            # via the await-timeout branch above)
+            try:
+                backend.kv_del(key)
+            except Exception:  # noqa: BLE001
+                pass
+        return
+    if rank == 0:
+        # initialize() returns only after every process connected, so all
+        # ranks have read the key — safe to clear it now.
+        try:
+            backend.kv_del(key)
+        except Exception:
+            pass
+
+
+def _shutdown_previous_gang() -> None:
+    """Elastic-restart lifecycle (SURVEY.md §7 hard part: "jax.distributed
+    lifecycle across actor restarts"): a pooled/reused worker process may
+    carry a previous gang's coordinator client whose peers are gone — tear
+    it down and drop cached backends so the new device topology can
+    register (or so a degraded rank truly runs LOCAL jax). NCCL's
+    equivalent is destroy_process_group before re-init. getattr guard:
+    very old jax builds predate is_initialized — treat them as
+    never-initialized instead of dying before the bootstrap."""
+    import jax
+
+    if getattr(jax.distributed, "is_initialized", lambda: False)():
         try:
             jax.distributed.shutdown()
-        except Exception:
+        except Exception:  # noqa: BLE001
             # The old gang's coordinator may already be dead (that's often
             # WHY we're re-bootstrapping) — a failed goodbye to it must not
             # fail the new gang's hello.
@@ -102,18 +175,24 @@ def bootstrap_jax_distributed(world_size: int, rank: int,
         except Exception:  # pragma: no cover — best effort on older jax
             pass
 
-    jax.distributed.initialize(
-        coordinator_address=address,
-        num_processes=world_size,
-        process_id=rank,
-        local_device_ids=local_device_ids)
-    if rank == 0:
-        # initialize() returns only after every process connected, so all
-        # ranks have read the key — safe to clear it now.
-        try:
-            backend.kv_del(key)
-        except Exception:
-            pass
+
+def _rendezvous_strict() -> bool:
+    return os.environ.get("RT_RENDEZVOUS_STRICT", "").lower() in (
+        "1", "true", "yes", "on")
+
+
+def _cpu_only_backend() -> bool:
+    """True when this process's jax sees no accelerator platform (the
+    CPU-graceful degrade gate). Conservative: unknown -> True only for
+    explicit JAX_PLATFORMS=cpu; a probe failure assumes accelerators."""
+    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+        return True
+    try:
+        import jax
+
+        return jax.default_backend() == "cpu"
+    except Exception:  # noqa: BLE001 — can't tell: don't mask a TPU gang
+        return False
 
 
 def clear_rendezvous(group_name: str = "train") -> None:

@@ -24,6 +24,7 @@ plus the GCS count would double every failure and skew the
 
 from __future__ import annotations
 
+import random
 import time
 from typing import Any, Callable, Dict, Optional, Type
 
@@ -150,6 +151,21 @@ def categorize_exception(exc: BaseException) -> str:
     return UNKNOWN
 
 
+# ---- recovery pacing --------------------------------------------------------
+
+def backoff_with_jitter(attempt: int, base_s: float, cap_s: float,
+                        rng: Optional[random.Random] = None) -> float:
+    """Capped exponential backoff with +-25% jitter — the one pacing
+    function for every recovery loop (RPC reconnect re-dials, GCS
+    restart-storm damping). ``attempt`` is 1-based; the uncapped delay
+    doubles per attempt and the jitter keeps a fleet of reconnecting
+    clients (or a gang of crash-looping actors) from re-dialing in
+    lockstep."""
+    delay = min(cap_s, base_s * (2 ** max(0, attempt - 1)))
+    r = (rng.random() if rng is not None else random.random())
+    return delay * (0.75 + 0.5 * r)
+
+
 # ---- the one fire-and-forget emitter ---------------------------------------
 
 class EmitLimiter:
@@ -181,6 +197,24 @@ class EmitLimiter:
                                    key=lambda kv: kv[1])[-self.cap // 2:])
             self._last = kept
         return True
+
+def emit_raw(spawn: Callable, gcs, payload: Dict[str, Any],
+             timeout: float = 10.0) -> None:
+    """Ship one PRE-BUILT FailureEvent dict (chaos injections, recovery
+    notices, drained buffers) without ever blocking or failing the caller
+    — the raw-payload twin of :func:`emit`, so the wire send still has
+    exactly one author."""
+    async def _send():
+        try:
+            await gcs.call("failure_event", payload, timeout=timeout)
+        except Exception:  # noqa: BLE001 — observability only
+            pass
+
+    try:
+        spawn(_send())
+    except Exception:  # noqa: BLE001 — teardown race
+        pass
+
 
 def emit(spawn: Callable, gcs, category: str, message: str,
          node_id: Optional[str] = None, timeout: float = 10.0,

@@ -490,6 +490,9 @@ def cmd_errors(args: argparse.Namespace) -> int:
     payload = {"limit": args.limit}
     if args.category:
         payload["category"] = args.category
+    if getattr(args, "origin", None):
+        # "chaos" = injected by the chaos plane; "organic" = everything else
+        payload["origin"] = args.origin
     try:
         events = _gcs_call(gcs, "list_failure_events", payload)
     except Exception as e:  # noqa: BLE001 — one line, no stack trace
@@ -513,10 +516,74 @@ def cmd_errors(args: argparse.Namespace) -> int:
             f"{k}={str(ev[k])[:12]}" for k in
             ("name", "task_id", "actor_id", "worker_id") if ev.get(k))
         count = f" x{ev['count']}" if ev.get("count", 1) > 1 else ""
+        origin = f"[{ev['origin']}] " if ev.get("origin") else ""
         print(f"{when}  {str(ev.get('node_id', '?'))[:8]:<8} "
               f"{ev.get('category', 'unknown'):<24}{count:<5} "
-              f"{who + '  ' if who else ''}{ev.get('message', '')}")
+              f"{origin}{who + '  ' if who else ''}{ev.get('message', '')}")
     return 0
+
+
+def cmd_chaos(args: argparse.Namespace) -> int:
+    """rt chaos arm/disarm/status: drive the fault-injection plane
+    (util/chaos.py) against a live cluster. The plan ships through the GCS
+    KV (@chaos/plan) and a revision on every heartbeat reply — raylets arm
+    themselves and their workers within a heartbeat."""
+    gcs = _resolve_gcs(args.address)
+    if gcs is None:
+        print("rt chaos: no running cluster found (pass --address)",
+              file=sys.stderr)
+        return 1
+    if args.chaos_cmd == "arm" and args.plan:
+        # local usage errors must not masquerade as cluster unreachability
+        try:
+            with open(args.plan) as f:
+                plan_from_file = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"rt chaos arm: cannot read plan file {args.plan!r}: "
+                  f"{type(e).__name__}: {e}", file=sys.stderr)
+            return 2
+    try:
+        if args.chaos_cmd == "arm":
+            if args.plan:
+                plan = plan_from_file
+            else:
+                if not args.site:
+                    print("rt chaos arm: pass --plan FILE or --site SITE",
+                          file=sys.stderr)
+                    return 2
+                fault: Dict = {"site": args.site}
+                for flag, field in (("at", "at"), ("after", "after"),
+                                    ("prob", "prob"),
+                                    ("max_fires", "max_fires"),
+                                    ("delay", "delay_s"),
+                                    ("value", "value"),
+                                    ("target", "target")):
+                    v = getattr(args, flag)
+                    if v is not None:
+                        fault[field] = v
+                plan = {"seed": args.seed, "faults": [fault]}
+            reply = _gcs_call(gcs, "chaos_arm", {"plan": plan})
+            if reply.get("error"):
+                print(f"rt chaos arm: {reply['error']}", file=sys.stderr)
+                return 1
+            faults = reply.get("plan", {}).get("faults", [])
+            print(f"chaos armed (rev {reply.get('rev')}): "
+                  f"{len(faults)} fault(s) at "
+                  f"{', '.join(f['site'] for f in faults)}")
+            return 0
+        if args.chaos_cmd == "disarm":
+            reply = _gcs_call(gcs, "chaos_disarm", {})
+            print(f"chaos disarmed (rev {reply.get('rev')})")
+            return 0
+        if args.chaos_cmd == "status":
+            print(json.dumps(_gcs_call(gcs, "chaos_status", {}),
+                             indent=2, default=str))
+            return 0
+        return 1
+    except Exception as e:  # noqa: BLE001 — one line, no stack trace
+        print(f"rt chaos: cannot reach GCS at {gcs}: "
+              f"{type(e).__name__}: {e}", file=sys.stderr)
+        return 1
 
 
 def cmd_doctor(args: argparse.Namespace) -> int:
@@ -739,7 +806,45 @@ def main(argv=None) -> int:
                             "(e.g. worker_crash, oom_kill, task_error)")
     p_err.add_argument("--limit", type=int, default=200)
     p_err.add_argument("--json", action="store_true")
+    p_err.add_argument("--origin", default=None,
+                       choices=("chaos", "organic", "recovery"),
+                       help="only chaos-injected, recovery-plane, or "
+                            "organic failures")
     p_err.set_defaults(fn=cmd_errors)
+
+    p_chaos = sub.add_parser(
+        "chaos",
+        help="fault injection: arm/disarm a seeded ChaosPlan against the "
+             "live cluster (util/chaos.py)")
+    chaos_sub = p_chaos.add_subparsers(dest="chaos_cmd", required=True)
+    pc_arm = chaos_sub.add_parser("arm")
+    pc_arm.add_argument("--address", default=None)
+    pc_arm.add_argument("--plan", default=None,
+                        help="JSON plan file ({seed, faults: [...]})")
+    pc_arm.add_argument("--site", default=None,
+                        help="single-fault shorthand: injection site name "
+                             "(worker.kill, raylet.kill_worker, rpc.drop, "
+                             "object.lose, oom.pressure, ...)")
+    pc_arm.add_argument("--at", type=int, default=None,
+                        help="fire exactly on the Nth hit of the site")
+    pc_arm.add_argument("--after", type=int, default=None,
+                        help="fire on every hit after the Nth")
+    pc_arm.add_argument("--prob", type=float, default=None,
+                        help="fire with this (seeded) probability")
+    pc_arm.add_argument("--max-fires", type=int, default=None,
+                        dest="max_fires")
+    pc_arm.add_argument("--delay", type=float, default=None,
+                        help="delay_s for rpc.delay / spill.slow")
+    pc_arm.add_argument("--value", type=float, default=None,
+                        help="effect value (oom.pressure fraction)")
+    pc_arm.add_argument("--target", default=None,
+                        help="substring match on the site's target "
+                             "(fn/method/rpc name, object id)")
+    pc_arm.add_argument("--seed", type=int, default=0)
+    for name in ("disarm", "status"):
+        pc = chaos_sub.add_parser(name)
+        pc.add_argument("--address", default=None)
+    p_chaos.set_defaults(fn=cmd_chaos)
 
     p_doc = sub.add_parser(
         "doctor",

@@ -131,9 +131,42 @@ class JaxTrainer:
                     raise TrainingFailedError(
                         f"training failed (no restart budget left): {error}")
                 failures_left -= 1
+                self._emit_gang_restart(
+                    name, error,
+                    self.run_config.failure_config.max_failures
+                    - failures_left)
                 latest_checkpoint = manager.latest_checkpoint or latest_checkpoint
             finally:
                 group.shutdown()
+
+    @staticmethod
+    def _emit_gang_restart(name: str, error: str, restart_num: int) -> None:
+        """Stamp a FailureConfig-driven gang restart into the failure plane
+        (PR 5): a FailureEvent on the feed (visible in `rt errors` /
+        `rt doctor`) plus a `rt_actor_restarts_total` tick, so train-level
+        recovery is observable like every other restart. Best-effort —
+        recovery must not fail on telemetry."""
+        try:
+            from ray_tpu.core import failure as F
+            from ray_tpu.core.worker import global_worker
+
+            backend = global_worker().backend
+            if backend is not None and hasattr(backend, "_gcs"):
+                err = ((error or "").strip().splitlines() or [""])[0][:300]
+                category = (F.WORKER_CRASH if "died" in err
+                            else F.TASK_ERROR)
+                F.emit(backend.io.spawn, backend._gcs, category,
+                       f"JaxTrainer gang restart {restart_num} "
+                       f"(from last checkpoint): {err}",
+                       name="JaxTrainer", experiment=name,
+                       restarting=True, gang_restart=True)
+            from ray_tpu.util import metrics as M
+
+            M.get_or_create(
+                M.Counter, "rt_actor_restarts_total",
+                "Actor restarts scheduled by the GCS after a failure").inc()
+        except Exception:  # noqa: BLE001 — telemetry only
+            pass
 
     def _drain_results(self, group: WorkerGroup, manager: CheckpointManager,
                        history: List[Dict]) -> Optional[str]:

@@ -22,6 +22,7 @@ from ray_tpu.util.placement_group import (
     PlacementGroupSchedulingStrategy,
     placement_group,
     remove_placement_group,
+    slice_group,
 )
 
 
@@ -104,8 +105,22 @@ class WorkerGroup:
 
     def start(self) -> None:
         n = self.scaling.num_workers
-        self.pg = placement_group([self.scaling.bundle() for _ in range(n)],
-                                  strategy=self.scaling.placement_strategy)
+        if self.scaling.use_tpu and not self.scaling.resources_per_worker:
+            # Multi-host TPU gang: the pod-slice PG shape (slice_group —
+            # one bundle per host, chips pinned per bundle). A one-host
+            # gang packs; a multi-host gang takes the ScalingConfig's
+            # strategy (topology="v5p-N" already set STRICT_SPREAD).
+            self.pg = slice_group(
+                num_hosts=n,
+                chips_per_host=self.scaling.tpu_chips_per_worker,
+                cpus_per_host=self.scaling.cpus_per_worker,
+                strategy=(self.scaling.placement_strategy if n > 1
+                          else "PACK"),
+                name=self.experiment_name)
+        else:
+            self.pg = placement_group(
+                [self.scaling.bundle() for _ in range(n)],
+                strategy=self.scaling.placement_strategy)
         if not self.pg.wait(timeout=300):
             remove_placement_group(self.pg)
             raise TimeoutError(

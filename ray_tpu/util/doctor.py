@@ -180,19 +180,28 @@ def diagnose(report: Dict[str, Any],
             f"[category={cat}, restarts={a.get('num_restarts', 0)}]"))
 
     # -- failure feed, ranked by category ------------------------------------
+    # chaos-injected events (util/chaos.py stamps origin="chaos") count
+    # separately so a torture run's findings say which failures were
+    # deliberate and which the cluster produced on its own
     recent = _recent(report.get("failures"), window_s)
     by_cat: Dict[str, int] = {}
+    injected: Dict[str, int] = {}
     for e in recent:
-        by_cat[e.get("category", "unknown")] = \
-            by_cat.get(e.get("category", "unknown"), 0) + e.get("count", 1)
+        cat = e.get("category", "unknown")
+        n = e.get("count", 1)
+        by_cat[cat] = by_cat.get(cat, 0) + n
+        if e.get("origin") == "chaos":
+            injected[cat] = injected.get(cat, 0) + n
     for cat, count in sorted(by_cat.items(), key=lambda kv: -kv[1]):
         if cat == "cancelled":
             continue
         level = CRITICAL if cat in _CRITICAL_CATEGORIES else WARN
+        chaos_note = (f", {injected[cat]} chaos-injected"
+                      if injected.get(cat) else "")
         findings.append((level,
                          f"{count} recent failure(s) of category {cat} "
-                         f"(last {int(window_s)}s; see `rt errors "
-                         f"--category {cat}`)"))
+                         f"(last {int(window_s)}s{chaos_note}; see "
+                         f"`rt errors --category {cat}`)"))
 
     # -- OOM post-mortems (memory plane) -------------------------------------
     for ev in _recent(report.get("oom_kills"), window_s):
@@ -298,10 +307,10 @@ def run(gcs_address: str, window_s: float = 600.0, queue_warn: int = 100,
                 f"{type(e).__name__}: {e}", 2)
     findings = diagnose(report, queue_warn=queue_warn)
     if as_json:
+        rc = exit_code(findings)
         payload = dict(report,
                        findings=[{"level": lv, "message": m}
                                  for lv, m in findings],
-                       healthy=exit_code(findings) == 0)
-        return json.dumps(payload, indent=2, default=str), \
-            exit_code(findings)
+                       healthy=rc == 0, exit_code=rc)
+        return json.dumps(payload, indent=2, default=str), rc
     return format_report(report, findings), exit_code(findings)

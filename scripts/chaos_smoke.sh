@@ -1,0 +1,59 @@
+#!/usr/bin/env bash
+# chaos_smoke.sh — one-shot chaos/recovery CI gate.
+#
+# Starts a real node daemon (`rt start --head`), arms a kill-worker chaos
+# plan from the CLI, drives a workload THROUGH the injected kill (task
+# retries recover it), verifies the injection is visible on the failure
+# feed (`rt errors --origin chaos`), and requires `rt doctor` to exit 0
+# once the recovery window passes — gating CI on recovery, not liveness.
+#
+# Also runnable as a slow-marked test: tests/test_zz_chaos_plane.py
+# ::test_chaos_smoke_script.
+set -euo pipefail
+
+RT="python -m ray_tpu.scripts.cli"
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+# an isolated session root so a developer's running cluster is untouched
+export RT_SESSION_DIR_ROOT="${RT_SESSION_DIR_ROOT:-$(mktemp -d /tmp/rt_chaos_smoke.XXXXXX)}"
+
+cleanup() { $RT stop --force >/dev/null 2>&1 || true; }
+trap cleanup EXIT
+
+echo "== start head node =="
+$RT start --head --num-cpus 4
+
+echo "== arm chaos: kill the first task's worker, once =="
+$RT chaos arm --site raylet.kill_worker --at 1 --max-fires 1 --seed 1
+$RT chaos status
+sleep 2  # the plan rides the next heartbeat reply to the raylet
+
+echo "== run workload through the kill (retries must recover) =="
+python - <<'EOF'
+import ray_tpu
+
+ray_tpu.init(address="auto")
+
+@ray_tpu.remote(max_retries=3)
+def f(x):
+    return x * 2
+
+got = ray_tpu.get([f.remote(i) for i in range(4)], timeout=180)
+assert got == [0, 2, 4, 6], got
+print("workload recovered:", got)
+ray_tpu.shutdown()
+EOF
+
+echo "== injected fault visible + distinguishable on the feed =="
+$RT chaos disarm
+$RT errors --origin chaos | grep -q "chaos" \
+    || { echo "FAIL: no chaos-origin event on the feed"; exit 1; }
+
+echo "== doctor must return to exit 0 after the recovery window =="
+sleep 3
+$RT doctor --window 2 --json | python -c '
+import json, sys
+d = json.load(sys.stdin)
+assert d["exit_code"] == 0 and d["healthy"], d["findings"]
+print("doctor healthy:", [f["message"] for f in d["findings"]])
+'
+echo "chaos smoke OK"
