@@ -65,6 +65,28 @@ class Config:
     # reference: ScheduleAndDispatchTasks spillback). Bounded hops.
     spillback_delay_s: float = 0.1
     spillback_max_hops: int = 2
+    # ---- overload robustness (fair dispatch / admission / deadlines) -------
+    # Admission control (reference: the raylet rejecting leases under
+    # backlog pressure): each scheduling class's raylet queue is bounded;
+    # a submit beyond the bound is bounced with a ``backpressure`` reply
+    # that the owner blocks-with-backoff on by default (fail-fast is a
+    # per-task opt-in via ``.options(on_overload="fail")``). 0 = unbounded.
+    max_queued_per_class: int = 20000
+    # Owner-side pacing between backpressured resubmits (capped
+    # exponential + jitter via failure.backoff_with_jitter).
+    backpressure_retry_base_s: float = 0.05
+    backpressure_retry_max_s: float = 2.0
+    # Warm worker pool (reference: ``worker_pool.h`` prestart): keep this
+    # many plain (no-chip, no-runtime-env) workers idle so cold task
+    # dispatch and actor creation stop paying interpreter boot. 0 = off.
+    worker_prestart_floor: int = 0
+    # Actor creation adopts an idle pooled worker instead of forking a
+    # fresh interpreter when the actor needs no TPU chips and no runtime
+    # env (the 0.4/s spawn floor of SCALE_r05 was pure process boot).
+    worker_adopt_for_actors: bool = True
+    # Raylet->GCS task-event chatter coalesces into one batched flush per
+    # interval instead of one RPC per state change on the submit hot path.
+    task_event_flush_s: float = 0.1
 
     # ---- object store ------------------------------------------------------
     # Objects <= this many bytes are stored in the owner's in-process memory

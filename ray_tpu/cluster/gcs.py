@@ -393,6 +393,11 @@ class GcsServer:
         # nodes listing — the number that explains a 255 s probe latency)
         if "queue_depth" in p:
             entry.queue_depth = p["queue_depth"]
+        if "sched" in p:
+            # scheduling-plane snapshot (per-class depth/wait + warm-pool
+            # occupancy/hit-rate): feeds `rt status`, the dashboard Nodes
+            # tab and the `rt doctor` per-class starvation finding
+            entry.sched = p["sched"]
         # chaos-plan revision + armed flag ride every heartbeat reply:
         # raylets compare against their last-seen rev and (re)fetch
         # @chaos/plan on change — the distribution path that lets
@@ -448,6 +453,7 @@ class GcsServer:
             "available": n.view.available.to_dict(),
             "labels": dict(n.view.labels),
             "queue_depth": getattr(n, "queue_depth", 0),
+            "sched": getattr(n, "sched", None),
             # dead rows persist for the cluster's lifetime: when + why the
             # node died lets `rt doctor` window its findings instead of
             # flagging a drain from hours ago as critical forever

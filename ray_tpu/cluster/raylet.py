@@ -18,6 +18,8 @@ Reference analog: ``src/ray/raylet/`` — ``NodeManager`` (lease/dispatch RPCs),
 from __future__ import annotations
 
 import asyncio
+import collections
+import itertools
 import os
 import subprocess
 import sys
@@ -74,6 +76,162 @@ class _BundleState:
         self.committed = False
 
 
+# the worker-pool key of a plain worker: no pinned chips, no runtime env —
+# the only kind the prestart floor maintains and actor creation may adopt
+_WARM_KEY: Tuple = ((), None)
+
+
+class _SchedQueues:
+    """Per-scheduling-class FIFO queues dispatched round-robin (reference:
+    the LocalTaskManager's per-``SchedulingClass`` queues,
+    ``local_task_manager.h`` — the structure that keeps a 1-task probe from
+    waiting out a 5k-deep bulk flood).
+
+    A scheduling class is ``(owner, fn_name, resource shape)`` — the
+    granularity at which the reference keys dispatch. FIFO order is
+    preserved WITHIN a class; classes take turns claiming resources, and a
+    class that just dispatched rotates to the back of the order.
+    """
+
+    def __init__(self):
+        self._classes = collections.OrderedDict()  # key -> deque of items
+        self._deque = collections.deque
+        self._len = 0
+        self._expiring = 0  # queued items carrying a deadline stamp
+
+    @staticmethod
+    def _strategy_token(strategy) -> Tuple:
+        # Canonical hashable form of a SchedulingStrategy. The strategy is
+        # part of the class (reference: SchedulingClassDescriptor): a head
+        # that MUST route elsewhere (hard NODE_AFFINITY/NODE_LABEL) and
+        # can't — peers full — would otherwise head-of-line-block locally
+        # runnable tasks of the same shape forever.
+        kind = getattr(strategy, "kind", "DEFAULT")
+        if kind == "NODE_AFFINITY":
+            return (kind, strategy.node_id_hex, bool(strategy.soft))
+        if kind == "NODE_LABEL":
+            def canon(d):
+                return tuple(sorted(
+                    (k, tuple(v) if isinstance(v, list) else v)
+                    for k, v in (d or {}).items()))
+            return (kind, canon(strategy.hard), canon(strategy.soft))
+        return (kind,)
+
+    @staticmethod
+    def class_key(payload: Dict) -> Tuple:
+        # PG identity is part of the class: bundles are independent pools,
+        # so a head blocked on a saturated bundle must not queue-block
+        # same-shaped tasks bound for an idle bundle (PG tasks never
+        # spill — without this split they could starve behind it forever)
+        pg = payload.get("pg") or None
+        return (payload.get("owner") or "",
+                payload.get("fn_name") or "",
+                tuple(sorted((payload.get("resources") or {}).items())),
+                (pg["pg_id"], pg.get("bundle_index")) if pg else None,
+                _SchedQueues._strategy_token(payload.get("strategy")))
+
+    @staticmethod
+    def class_label(key: Tuple) -> str:
+        return key[1] or "anonymous"
+
+    def push(self, item: Dict) -> None:
+        q = self._classes.get(item["skey"])
+        if q is None:
+            q = self._classes[item["skey"]] = self._deque()
+        q.append(item)
+        self._len += 1
+        if item.get("expires") is not None:
+            self._expiring += 1
+
+    @property
+    def expiring(self) -> int:
+        """Queued items with a deadline — lets the heartbeat sweep skip
+        its O(total queued) scan when nothing can expire."""
+        return self._expiring
+
+    def __len__(self) -> int:
+        return self._len
+
+    def depth(self, key: Tuple) -> int:
+        q = self._classes.get(key)
+        return len(q) if q else 0
+
+    def head(self, key: Tuple) -> Optional[Dict]:
+        q = self._classes.get(key)
+        return q[0] if q else None
+
+    def pop_head(self, key: Tuple) -> Optional[Dict]:
+        q = self._classes.get(key)
+        if not q:
+            return None
+        item = q.popleft()
+        self._len -= 1
+        if item.get("expires") is not None:
+            self._expiring -= 1
+        if not q:
+            self._classes.pop(key, None)
+        return item
+
+    def remove(self, item: Dict) -> bool:
+        """O(class depth) removal — only the spillback path (rare) and the
+        deadline sweep use it."""
+        q = self._classes.get(item["skey"])
+        if not q:
+            return False
+        try:
+            q.remove(item)
+        except ValueError:
+            return False
+        self._len -= 1
+        if item.get("expires") is not None:
+            self._expiring -= 1
+        if not q:
+            self._classes.pop(item["skey"], None)
+        return True
+
+    def rotate(self, key: Tuple) -> None:
+        if key in self._classes:
+            self._classes.move_to_end(key)
+
+    def window(self, key: Tuple, n: int) -> List[Dict]:
+        """The first ``n`` items of a class (the spillback scan window)."""
+        q = self._classes.get(key)
+        return list(itertools.islice(q, n)) if q else []
+
+    def keys(self) -> List[Tuple]:
+        return list(self._classes)
+
+    def items(self):
+        """Every queued item, class by class (deadline sweep)."""
+        for q in list(self._classes.values()):
+            yield from list(q)
+
+    def first_n(self, n: int):
+        """Up to ``n`` queued items WITHOUT copying class deques — the
+        heartbeat demand scan must stay O(n), not O(total queued)."""
+        for q in list(self._classes.values()):
+            if n <= 0:
+                return
+            for item in itertools.islice(q, n):
+                n -= 1
+                yield item
+
+    def by_class(self) -> List[Tuple[str, int, float]]:
+        """(label, depth, oldest enqueue monotonic) per class, deepest
+        first. Labels collide across owners on purpose — telemetry
+        cardinality stays bounded by distinct function names."""
+        agg: Dict[str, Tuple[int, float]] = {}
+        for key, q in list(self._classes.items()):
+            if not q:
+                continue
+            label = self.class_label(key)
+            depth, oldest = agg.get(label, (0, float("inf")))
+            agg[label] = (depth + len(q),
+                          min(oldest, q[0].get("t_enq", q[0]["t"])))
+        return sorted(((lb, d, t) for lb, (d, t) in agg.items()),
+                      key=lambda r: -r[1])
+
+
 class Raylet:
     def __init__(self, node_id: str, session_name: str, gcs_address: str,
                  resources: Dict[str, float], labels: Dict[str, str],
@@ -95,16 +253,17 @@ class Raylet:
         # enough to hide boot latency, few enough that a task burst can't
         # fork-bomb a small host
         self._spawn_slots = max(4, 2 * (os.cpu_count() or 1))
-        self._queue: List[Dict] = []          # pending task payloads + futures
+        # Pending task payloads + futures, organized per scheduling class
+        # and dispatched round-robin (the overload-robust replacement for
+        # the old FIFO list — see _SchedQueues).
+        self._squeue = _SchedQueues()
         self._inflight: Dict[str, Dict] = {}  # task_id -> resource state
         self._task_futures: Dict[str, "asyncio.Future"] = {}  # dedup joins
         self._replies: Dict[str, Dict] = {}  # task_id -> successful reply
         self._bundles: Dict[Tuple[str, int], _BundleState] = {}
         self._dispatch_event = asyncio.Event()
         # worker-log ring (filled by _log_pump_loop, drained by poll_logs)
-        import collections as _collections
-
-        self._log_buf: "_collections.deque" = _collections.deque(maxlen=10000)
+        self._log_buf: "collections.deque" = collections.deque(maxlen=10000)
         self._log_seq = 0
         self._log_event = asyncio.Event()
         self._local_objects: set = set()
@@ -178,13 +337,33 @@ class Raylet:
         # in order on resync. Entered by the heartbeat loop or the first
         # failed publish; exited by the first successful heartbeat.
         self._degraded_since: Optional[float] = None
-        self._deferred_gcs: "_collections.deque" = _collections.deque(
+        self._deferred_gcs: "collections.deque" = collections.deque(
             maxlen=10000)
         self._deferred_dropped = 0  # overflow evictions during an outage
         self._flushing = False      # single-flight deferred-replay guard
         # last chaos-plan revision this raylet synced from the GCS
         self._chaos_seen_rev = 0
         self._hb_drops = 0  # consecutive chaos-dropped heartbeats
+        # --- overload-robust control plane (fair dispatch / warm pool /
+        # admission / deadlines) --- cumulative accounting surfaced by
+        # node_stats, the heartbeat's sched summary, `rt status` and the
+        # rt_sched_* / rt_worker_pool_* Prometheus series.
+        self._sched_stats: Dict[str, int] = {
+            "warm_hits": 0, "cold_spawns": 0, "actor_adoptions": 0,
+            "prestarted": 0, "backpressure": 0, "deadline_evictions": 0}
+        # per-class recent queue waits: label -> deque[(t_mono, wait_s)];
+        # feeds the heartbeat's wait_p99_s (the `rt doctor` starvation
+        # finding) without keeping one histogram per class
+        self._class_waits: Dict[str, Any] = {}
+        self._class_gauge_labels: set = set()  # live rt_sched_class gauges
+        self._prestarting = 0  # warm-pool spawns currently booting
+        # raylet->GCS task-event chatter batches here and ships as ONE
+        # coalesced task_events RPC per flush window (the submit hot path
+        # used to pay 3 GCS round-trips per task)
+        self._task_event_buf: "collections.deque" = collections.deque(
+            maxlen=10000)
+        self._task_event_flushing = False
+        self._task_event_kick = asyncio.Event()  # terminal-state fast path
 
     _QUEUE_WAIT_BUCKETS = (0.001, 0.005, 0.02, 0.1, 0.5, 1.0, 5.0, 15.0,
                            60.0, 300.0, 900.0)
@@ -231,6 +410,26 @@ class Raylet:
                     M.Counter, "rt_object_pin_purges_total",
                     "Leaked get-pins purged by the TTL timer "
                     "(crashed getters)",
+                    tag_keys=("node_id",)),
+                "class_depth": M.get_or_create(
+                    M.Gauge, "rt_sched_class_queue_depth",
+                    "Pending tasks per scheduling class in the raylet's "
+                    "round-robin dispatch queues",
+                    tag_keys=("node_id", "sched_class")),
+                "warm_hits": M.get_or_create(
+                    M.Counter, "rt_worker_pool_warm_hits_total",
+                    "Dispatches served by a warm pooled worker instead "
+                    "of a fresh process spawn",
+                    tag_keys=("node_id", "kind")),
+                "backpressure": M.get_or_create(
+                    M.Counter, "rt_sched_backpressure_total",
+                    "Task submissions bounced with a backpressure reply "
+                    "(per-class admission bound)",
+                    tag_keys=("node_id",)),
+                "deadline_evictions": M.get_or_create(
+                    M.Counter, "rt_sched_deadline_evictions_total",
+                    "Queued tasks shed because their deadline_s budget "
+                    "expired before dispatch",
                     tag_keys=("node_id",)),
             }
         return self._tele_metrics
@@ -299,7 +498,11 @@ class Raylet:
                 await self._heartbeat_once()
             if self._telemetry:
                 await self._push_telemetry()
-            if self._queue:
+            if len(self._squeue):
+                # deadline budgets are enforced on a sweep too, not just at
+                # the dispatch head: stale work deep in a blocked class is
+                # shed while it is still cheap to shed
+                self._evict_expired()
                 # periodic wake so waiting tasks re-evaluate spillback even
                 # when no local resource event fires
                 self._dispatch_event.set()
@@ -310,7 +513,7 @@ class Raylet:
             # autoscaler can bin-pack it onto prospective node types
             # (reference: resource_demand_scheduler's load report)
             demands: Dict[Tuple, int] = {}
-            for item in self._queue[:100]:
+            for item in self._squeue.first_n(100):
                 key = tuple(sorted(
                     item["payload"].get("resources", {}).items()))
                 demands[key] = demands.get(key, 0) + 1
@@ -319,7 +522,8 @@ class Raylet:
             reply = await self._gcs.call("heartbeat", {
                 "node_id": self.node_id,
                 "available": self.node.available.to_dict(),
-                "queue_depth": len(self._queue),
+                "queue_depth": len(self._squeue),
+                "sched": self._sched_summary(),
                 "queued_demands": [
                     {"resources": dict(k), "count": c}
                     for k, c in list(demands.items())[:20]]},
@@ -376,7 +580,7 @@ class Raylet:
 
         try:
             m = self._telemetry_metrics()
-            m["queue_depth"].set(len(self._queue),
+            m["queue_depth"].set(len(self._squeue),
                                  {"node_id": self.node_id})
             now = time.monotonic()
             if now - self._tele_pushed < 5.0:
@@ -384,6 +588,7 @@ class Raylet:
             # O(#objects) scan and /proc reads at the push cadence only —
             # samples set more often than they are shipped are wasted work
             self._set_store_gauges(m)
+            self._set_class_gauges(m)
             self._update_worker_rss(m)
             import ray_tpu
             from ray_tpu.util import metrics as M
@@ -419,6 +624,86 @@ class Raylet:
         for state, v in self._store_state_bytes().items():
             m["store_bytes"].set(v, {"node_id": self.node_id,
                                      "state": state})
+
+    def _set_class_gauges(self, m: Dict[str, Any]) -> None:
+        """rt_sched_class_queue_depth per live scheduling class; classes
+        that drained remove their samples so the page doesn't accumulate
+        one stale series per function name ever submitted."""
+        live: set = set()
+        for label, depth, _oldest in self._squeue.by_class():
+            live.add(label)
+            m["class_depth"].set(depth, {"node_id": self.node_id,
+                                         "sched_class": label})
+        for label in self._class_gauge_labels - live:
+            m["class_depth"].remove({"node_id": self.node_id,
+                                     "sched_class": label})
+        self._class_gauge_labels = live
+
+    def _class_wait_p99(self, label: str,
+                        now: float, window_s: float = 60.0
+                        ) -> Optional[float]:
+        dq = self._class_waits.get(label)
+        if not dq:
+            return None
+        waits = sorted(w for t, w in dq if now - t <= window_s)
+        if not waits:
+            self._class_waits.pop(label, None)  # stale class: stop reporting
+            return None
+        return waits[min(len(waits) - 1, int(0.99 * len(waits)))]
+
+    def _sched_summary(self) -> Dict[str, Any]:
+        """The scheduling plane's health snapshot: per-class depth +
+        queue-wait p99 + oldest-waiter age (what `rt doctor` grades for
+        starvation), and warm-pool occupancy / hit accounting. Rides every
+        heartbeat into the GCS node table -> `rt status`, the dashboard
+        Nodes tab and doctor findings."""
+        now = time.monotonic()
+        if len(self._class_waits) > 256:
+            # bound the per-class wait rings: a job churning through many
+            # distinct fn names must not grow this forever — drop labels
+            # whose newest sample went stale
+            for label, dq in list(self._class_waits.items()):
+                if not dq or now - dq[-1][0] > 600.0:
+                    self._class_waits.pop(label, None)
+        classes = []
+        rows = self._squeue.by_class()
+        pick = rows[:10]
+        if len(rows) > 10:
+            # depth alone must not truncate away a starving shallow class
+            # (the exact case doctor's per-class finding exists for) —
+            # union in the oldest waiters
+            seen = {r[0] for r in pick}
+            pick += [r for r in sorted(rows, key=lambda r: r[2])
+                     if r[0] not in seen][:5]
+        for label, depth, oldest_t in pick:
+            entry: Dict[str, Any] = {
+                "class": label, "depth": depth,
+                "oldest_wait_s": round(max(0.0, now - oldest_t), 3)}
+            p99 = self._class_wait_p99(label, now)
+            if p99 is not None:
+                entry["wait_p99_s"] = round(p99, 3)
+            classes.append(entry)
+        s = self._sched_stats
+        served = s["warm_hits"] + s["cold_spawns"]
+        return {
+            "classes": classes,
+            "warm": {
+                # warm-pool occupancy = adoptable/prestartable workers
+                # ONLY (the _WARM_KEY list); env- or chip-keyed idle
+                # workers can't serve a cold plain dispatch — counting
+                # them would claim a full pool while every hit misses
+                "idle": len(self._idle.get(_WARM_KEY, ())),
+                "idle_total": sum(len(v) for v in self._idle.values()),
+                "floor": get_config().worker_prestart_floor,
+                "warm_hits": s["warm_hits"],
+                "cold_spawns": s["cold_spawns"],
+                "actor_adoptions": s["actor_adoptions"],
+                "prestarted": s["prestarted"],
+                "hit_rate": round(s["warm_hits"] / served, 3) if served
+                else None},
+            "backpressure_total": s["backpressure"],
+            "deadline_evictions_total": s["deadline_evictions"],
+        }
 
     def _update_worker_rss(self, m: Dict[str, Any]) -> None:
         """rt_worker_rss_bytes per live worker; dead workers' samples are
@@ -782,6 +1067,23 @@ class Raylet:
                 except Exception:  # noqa: BLE001 — already gone
                     pass
 
+            # warm-pool prestart (reference: worker_pool.h PrestartWorkers):
+            # keep the configured floor of plain workers idle so the next
+            # cold dispatch or actor creation finds a live interpreter.
+            # Bounded per tick so a floor bump can't stampede the host.
+            if cfg.worker_prestart_floor > 0 and not self._stopped:
+                warm_idle = sum(
+                    1 for e in self._idle.get(_WARM_KEY, ())
+                    if e.proc.poll() is None)
+                # floor capped by the idle soft limit: a floor above it
+                # would fight the surplus reaper above in a perpetual
+                # boot/retire churn loop on an otherwise idle node
+                floor = min(cfg.worker_prestart_floor, soft)
+                want = floor - warm_idle - self._prestarting
+                for _ in range(min(max(0, want), 2)):
+                    self._prestarting += 1
+                    spawn_task(self._prestart_worker())
+
             for entry in list(self._workers.values()):
                 if entry.proc.poll() is not None:
                     self._workers.pop(entry.worker_id, None)
@@ -817,6 +1119,35 @@ class Raylet:
                         except Exception:  # noqa: BLE001
                             pass
                         entry.is_actor_worker = False
+
+    async def _prestart_worker(self) -> None:
+        """Boot one warm-pool worker and release it into the idle pool.
+        Failures are silent — the floor check next tick tries again.
+        Prestart never outbids task-driven boots for spawn slots: when
+        the throttle is saturated it skips (worsening a boot stampede to
+        warm the pool defeats both)."""
+        try:
+            if self._spawn_slots <= 0:
+                return
+            self._spawn_slots -= 1
+            try:
+                entry = self._spawn_worker(_WARM_KEY, [], None)
+                try:
+                    await asyncio.wait_for(
+                        entry.ready, get_config().process_startup_timeout_s)
+                except asyncio.TimeoutError:
+                    entry.proc.kill()
+                    self._workers.pop(entry.worker_id, None)
+                    return
+                self._sched_stats["prestarted"] += 1
+                self._release_worker(entry)
+                self._dispatch_event.set()
+            finally:
+                self._spawn_slots += 1
+        except Exception:  # noqa: BLE001 — next reap tick retries
+            pass
+        finally:
+            self._prestarting -= 1
 
     async def _reattach_after_gcs_restart(self) -> None:
         """Re-publish live actor workers to a restarted GCS, then run the
@@ -1120,6 +1451,27 @@ class Raylet:
         existing = self._task_futures.get(task_id)
         if existing is not None:
             return await asyncio.shield(existing)
+        # Admission control (before any state is created for the task): a
+        # scheduling class at its queue bound bounces the submit with a
+        # backpressure reply instead of absorbing an unbounded producer —
+        # the owner blocks-with-backoff (default) or fails fast
+        # (on_overload="fail"); either way the raylet never wedges under a
+        # runaway submit loop.
+        cfg = get_config()
+        skey = _SchedQueues.class_key(p)
+        if (cfg.max_queued_per_class > 0
+                and self._squeue.depth(skey) >= cfg.max_queued_per_class):
+            self._sched_stats["backpressure"] += 1
+            if self._telemetry:
+                try:
+                    self._telemetry_metrics()["backpressure"].inc(
+                        1.0, {"node_id": self.node_id})
+                except Exception:  # noqa: BLE001 — telemetry only
+                    pass
+            return {"error": "backpressure",
+                    "queue_depth": self._squeue.depth(skey),
+                    "limit": cfg.max_queued_per_class,
+                    "retry_after_s": cfg.backpressure_retry_base_s}
         fut = asyncio.get_running_loop().create_future()
         self._task_futures[task_id] = fut
 
@@ -1139,13 +1491,20 @@ class Raylet:
         # they ride the heartbeat's queued_demands — the signal the
         # autoscaler provisions against (reference: infeasible tasks stay
         # pending and drive resource_demand_scheduler).
-        item = {"payload": p, "future": fut,
+        item = {"payload": p, "future": fut, "skey": skey,
+                "label": _SchedQueues.class_label(skey),
                 "t": time.monotonic(), "spilling": False}
-        if p.get("trace") is not None:  # phase tracing: one predicate here
-            # separate stamp: spillback backoff resets item["t"], but the
-            # span's queue_wait must cover the full local wait
-            item["t_enq"] = item["t"]
-        self._queue.append(item)
+        # separate stamp: spillback backoff resets item["t"], but the
+        # span's queue_wait, the per-class oldest_wait_s and the wait-p99
+        # samples must all cover the FULL local wait (a class whose head
+        # keeps failing spillback is starving, not freshly enqueued)
+        item["t_enq"] = item["t"]
+        # deadline budget: end-to-end staleness bound measured from local
+        # enqueue (clocks don't cross processes); an expired item is shed
+        # by the dispatch head check or the heartbeat sweep
+        if p.get("deadline_s"):
+            item["expires"] = item["t"] + float(p["deadline_s"])
+        self._squeue.push(item)
         self._task_event(task_id, p.get("fn_name"), "PENDING",
                          trace=p.get("trace"))
         self._dispatch_event.set()
@@ -1155,26 +1514,91 @@ class Raylet:
                     trace: "Optional[Dict]" = None,
                     phases: "Optional[Dict]" = None,
                     worker_source: Optional[str] = None) -> None:
-        """Fire-and-forget state event to the GCS task store (reference:
+        """Buffered state event to the GCS task store (reference:
         TaskEventBuffer -> GcsTaskManager); observability only, never blocks
-        or fails the task path. ``trace`` carries the span context when the
-        submitter had tracing enabled; ``phases`` the per-phase latency
-        breakdown this raylet measured for a traced task."""
-        async def _send():
-            try:
-                msg = {"task_id": task_id, "name": name, "state": state,
-                       "node_id": self.node_id}
-                if trace is not None:
-                    msg["trace"] = trace
-                if phases:
-                    msg["phases"] = phases
-                if worker_source is not None:
-                    msg["worker_source"] = worker_source
-                await self._gcs.call("task_event", msg)
-            except Exception:
-                pass
+        or fails the task path. Events COALESCE into one batched
+        ``task_events`` RPC per flush window instead of one round-trip per
+        state change — at 3 states per task the unbatched form dominated
+        the submit hot path's GCS chatter. A single in-flight flusher
+        drains the buffer FIFO, so per-task state order is preserved.
+        ``trace`` carries the span context when the submitter had tracing
+        enabled; ``phases`` the per-phase latency breakdown this raylet
+        measured for a traced task."""
+        msg = {"task_id": task_id, "name": name, "state": state,
+               "node_id": self.node_id}
+        if state is not None:
+            # client-side stamp (the driver's phase partials already do
+            # this): batching would otherwise collapse a short task's
+            # PENDING/RUNNING/FINISHED onto one server arrival time and
+            # zero its timeline lane
+            msg["times"] = {state: time.time()}
+        if trace is not None:
+            msg["trace"] = trace
+        if phases:
+            msg["phases"] = phases
+        if worker_source is not None:
+            msg["worker_source"] = worker_source
+        if get_config().task_event_flush_s <= 0:
+            # batching off: ship each event on its own fire-and-forget RPC
+            async def _send(m=msg):
+                try:
+                    await self._gcs.call("task_event", m)
+                except Exception:  # noqa: BLE001 — observability only
+                    pass
 
-        spawn_task(_send())
+            spawn_task(_send())
+            return
+        self._task_event_buf.append(msg)
+        if state in ("FINISHED", "FAILED"):
+            # terminal states flush NOW (whole buffer, order kept): the
+            # owner's reply races this event to the GCS, and consumers
+            # (tracing polls, the driver's phases partial) must find the
+            # terminal event the moment the reply is visible — only the
+            # PENDING/RUNNING chatter rides the coalescing window
+            self._task_event_kick.set()
+        if not self._task_event_flushing:
+            self._task_event_flushing = True
+            spawn_task(self._flush_task_events())
+
+    async def _flush_task_events(self) -> None:
+        try:
+            while self._task_event_buf:
+                if not self._task_event_kick.is_set():
+                    try:
+                        await asyncio.wait_for(
+                            self._task_event_kick.wait(),
+                            get_config().task_event_flush_s)
+                    except asyncio.TimeoutError:
+                        pass
+                self._task_event_kick = asyncio.Event()
+                while self._task_event_buf:
+                    batch = []
+                    while self._task_event_buf and len(batch) < 512:
+                        batch.append(self._task_event_buf.popleft())
+                    try:
+                        await self._gcs.call("task_events",
+                                             {"events": batch})
+                    except Exception:  # noqa: BLE001 — observability only:
+                        # drop this batch rather than loop hot against a
+                        # down GCS; the finally-side retrigger retries the
+                        # REST of the buffer after a pause (terminal events
+                        # of a job's last tasks must not strand forever)
+                        return
+        finally:
+            self._task_event_flushing = False
+            if self._task_event_buf:
+                spawn_task(self._reflush_task_events(1.0))
+            elif self._task_event_kick.is_set():
+                # a terminal event that landed mid-drain (and was drained)
+                # set the kick; left set, the next flusher would skip the
+                # coalescing window and ship 1-event batches
+                self._task_event_kick = asyncio.Event()
+
+    async def _reflush_task_events(self, delay_s: float) -> None:
+        await asyncio.sleep(delay_s)
+        if self._task_event_buf and not self._task_event_flushing:
+            self._task_event_flushing = True
+            await self._flush_task_events()
 
     async def _try_spillback(self, item) -> None:
         """Forward a queued-but-waiting task to a node with free capacity.
@@ -1194,9 +1618,7 @@ class Raylet:
             item["spilling"] = False
             item["t"] = time.monotonic()  # back off before the next attempt
             return
-        try:
-            self._queue.remove(item)
-        except ValueError:
+        if not self._squeue.remove(item):
             item["spilling"] = False
             return  # local dispatch already claimed it
         try:
@@ -1209,7 +1631,17 @@ class Raylet:
             # dedups at that raylet; tasks are retry-idempotent by contract).
             item["spilling"] = False
             item["t"] = time.monotonic()
-            self._queue.append(item)
+            self._squeue.push(item)
+            self._dispatch_event.set()
+            return
+        if isinstance(reply, dict) and reply.get("error") == "backpressure":
+            # the peer's admission bound is its own: this task was already
+            # admitted HERE — requeue locally instead of propagating a
+            # bounce the owner never earned (fail-fast callers would raise
+            # BackpressureError for a node they never overloaded)
+            item["spilling"] = False
+            item["t"] = time.monotonic()
+            self._squeue.push(item)
             self._dispatch_event.set()
             return
         fut = item["future"]
@@ -1220,79 +1652,200 @@ class Raylet:
         while True:
             await self._dispatch_event.wait()
             self._dispatch_event.clear()
-            remaining = []
-            for item in self._queue:
-                payload = item["payload"]
-                req = ResourceSet(payload["resources"])
-                pg = payload.get("pg")
-                if pg is not None:
-                    bundle = self._bundles.get((pg["pg_id"], pg["bundle_index"]))
-                    if bundle is None:
-                        self._failure_event(
-                            F.PG_REMOVED,
-                            "placement group bundle not on this node "
-                            "(removed or rescheduled)",
-                            task_id=payload.get("task_id"),
-                            name=payload.get("fn_name"),
-                            pg_id=pg.get("pg_id"))
-                        if not item["future"].done():
-                            item["future"].set_result({
-                                "error": "bundle_gone",
-                                "message": "placement group bundle not on this "
-                                           "node (removed or rescheduled)",
-                                "cause": F.cause_dict(
-                                    F.PG_REMOVED,
-                                    "placement group bundle not on this "
-                                    "node (removed or rescheduled)",
-                                    node_id=self.node_id,
-                                    pg_id=pg.get("pg_id"))})
+            self._dispatch_pass()
+
+    def _dispatch_pass(self) -> None:
+        """One fairness sweep over the per-class queues (reference:
+        ``LocalTaskManager::ScheduleAndDispatchTasks`` over per-class
+        deques): classes take turns claiming resources — one dispatch per
+        class per turn, FIFO within a class, and a class that dispatched
+        rotates to the back. A 5k-deep bulk class therefore costs a 1-task
+        probe class exactly one dispatch slot, not the whole backlog.
+        Sweeps repeat until a full rotation makes no progress (resources
+        exhausted or every head blocked)."""
+        progressed = True
+        while progressed:
+            progressed = False
+            for key in self._squeue.keys():
+                while True:
+                    item = self._squeue.head(key)
+                    if item is None:
+                        break
+                    outcome = self._try_dispatch_head(item)
+                    if outcome == "dispatched":
+                        self._squeue.pop_head(key)
+                        self._squeue.rotate(key)
+                        progressed = True
+                        break  # one dispatch per class per turn
+                    if outcome == "resolved":
+                        # errored/evicted head: drop it and inspect the
+                        # next item without losing this class's turn
+                        self._squeue.pop_head(key)
+                        progressed = True
                         continue
-                    if not bundle.pool.is_feasible(req):
-                        msg = (f"task requires {req.to_dict()} but "
-                               f"its placement group bundle only has "
-                               f"{bundle.pool.total.to_dict()}")
-                        self._failure_event(
+                    # "blocked": the class waits for local resources — but
+                    # let a bounded window of it offload in PARALLEL
+                    # (head-only spillback would drain a backlog onto an
+                    # idle peer at one task per round-trip)
+                    self._maybe_spill_class(key)
+                    break  # next class's turn
+
+    def _try_dispatch_head(self, item: Dict) -> str:
+        """Attempt one head-of-class dispatch. Returns ``"dispatched"``
+        (resources claimed, task launched), ``"resolved"`` (the item
+        finished without running — error reply or deadline eviction; pop
+        it) or ``"blocked"`` (the class waits for resources/spillback)."""
+        payload = item["payload"]
+        now = time.monotonic()
+        if item.get("spilling"):
+            return "blocked"  # a spillback attempt owns it
+        if item["future"].done():
+            return "resolved"  # owner gone / already answered elsewhere
+        if item.get("expires") is not None and now > item["expires"]:
+            self._evict_item(item, now)
+            return "resolved"
+        req = ResourceSet(payload["resources"])
+        pg = payload.get("pg")
+        if pg is not None:
+            bundle = self._bundles.get((pg["pg_id"], pg["bundle_index"]))
+            if bundle is None:
+                self._failure_event(
+                    F.PG_REMOVED,
+                    "placement group bundle not on this node "
+                    "(removed or rescheduled)",
+                    task_id=payload.get("task_id"),
+                    name=payload.get("fn_name"),
+                    pg_id=pg.get("pg_id"))
+                if not item["future"].done():
+                    item["future"].set_result({
+                        "error": "bundle_gone",
+                        "message": "placement group bundle not on this "
+                                   "node (removed or rescheduled)",
+                        "cause": F.cause_dict(
+                            F.PG_REMOVED,
+                            "placement group bundle not on this "
+                            "node (removed or rescheduled)",
+                            node_id=self.node_id,
+                            pg_id=pg.get("pg_id"))})
+                return "resolved"
+            if not bundle.pool.is_feasible(req):
+                msg = (f"task requires {req.to_dict()} but "
+                       f"its placement group bundle only has "
+                       f"{bundle.pool.total.to_dict()}")
+                self._failure_event(
+                    F.SCHEDULING_TIMEOUT, msg,
+                    task_id=payload.get("task_id"),
+                    name=payload.get("fn_name"))
+                if not item["future"].done():
+                    item["future"].set_result({
+                        "error": "infeasible", "message": msg,
+                        "cause": F.cause_dict(
                             F.SCHEDULING_TIMEOUT, msg,
+                            node_id=self.node_id)})
+                return "resolved"
+            pool = bundle.pool
+        else:
+            pool = self.node
+        from ray_tpu.scheduler.policy import strategy_allows_local
+
+        local_ok = pg is not None or strategy_allows_local(
+            payload.get("strategy"), self.node_id, self.node.labels)
+        if local_ok and pool.can_fit(req):
+            assignment = pool.allocate(req)
+            spawn_task(self._run_task(item, req, assignment, pool))
+            return "dispatched"
+        # Load-based spillback (reference: spillback replies in
+        # ScheduleAndDispatchTasks) is handled class-wide by
+        # _maybe_spill_class on the "blocked" return: a feasible task that
+        # has waited past the delay looks for a node with capacity free
+        # NOW. PG tasks are bundle-pinned — never spill; strategy-
+        # ineligible tasks MUST route and are exempt from the hop cap.
+        return "blocked"
+
+    _SPILL_SCAN = 32   # items of a blocked class scanned for spillback
+    _SPILL_CONC = 8    # concurrent spillback attempts per class
+
+    def _maybe_spill_class(self, key: Tuple) -> None:
+        """Mark up to ``_SPILL_CONC`` eligible items of a blocked class as
+        spilling and launch their attempts. Eligibility mirrors the head
+        path: never PG-pinned, hop cap honored (strategy-ineligible items
+        are exempt), waited past the spillback delay, not expired."""
+        cfg = get_config()
+        now = time.monotonic()
+        from ray_tpu.scheduler.policy import strategy_allows_local
+
+        budget = self._SPILL_CONC
+        launch = []
+        for item in self._squeue.window(key, self._SPILL_SCAN):
+            if item.get("spilling"):
+                budget -= 1
+                if budget <= 0:
+                    break  # cap reached — still launch what we collected
+                continue
+            payload = item["payload"]
+            if payload.get("pg") is not None or item["future"].done():
+                continue
+            if (item.get("expires") is not None
+                    and now > item["expires"]):
+                continue  # the sweep/head check sheds it
+            local_ok = strategy_allows_local(
+                payload.get("strategy"), self.node_id, self.node.labels)
+            if ((not local_ok
+                 or payload.get("spill_count", 0) < cfg.spillback_max_hops)
+                    and now - item.get("t", 0) > cfg.spillback_delay_s):
+                launch.append(item)
+                budget -= 1
+                if budget <= 0:
+                    break
+        for item in launch:
+            item["spilling"] = True
+            spawn_task(self._try_spillback(item))
+
+    def _evict_expired(self, now: Optional[float] = None) -> int:
+        """Deadline sweep: shed every queued item whose budget expired
+        (spillback-owned items are skipped — they are mid-move). Runs from
+        the heartbeat loop; the dispatch head check catches the rest."""
+        if not self._squeue.expiring:
+            return 0  # nothing carries a deadline: skip the full scan
+        now = time.monotonic() if now is None else now
+        expired = [item for item in self._squeue.items()
+                   if item.get("expires") is not None
+                   and now > item["expires"] and not item.get("spilling")]
+        for item in expired:
+            if self._squeue.remove(item):
+                self._evict_item(item, now)
+        return len(expired)
+
+    def _evict_item(self, item: Dict, now: float) -> None:
+        """Deadline eviction: resolve the owner's submit with a
+        ``scheduling_timeout`` cause (an ORGANIC failure-feed row — shed
+        stale work is a real scheduling outcome, not an injected one) and
+        count it. The caller removes the item from the queue."""
+        payload = item["payload"]
+        waited = now - item.get("t_enq", item["t"])
+        msg = (f"deadline_s={payload.get('deadline_s')} budget expired "
+               f"after {waited:.1f}s in the raylet queue (class "
+               f"{item['label']!r}); stale work shed instead of executed "
+               f"late")
+        self._sched_stats["deadline_evictions"] += 1
+        if self._telemetry:
+            try:
+                self._telemetry_metrics()["deadline_evictions"].inc(
+                    1.0, {"node_id": self.node_id})
+            except Exception:  # noqa: BLE001 — telemetry only
+                pass
+        cause = F.cause_dict(F.SCHEDULING_TIMEOUT, msg,
+                             node_id=self.node_id,
+                             task_id=payload.get("task_id"))
+        self._failure_event(F.SCHEDULING_TIMEOUT, msg,
                             task_id=payload.get("task_id"),
                             name=payload.get("fn_name"))
-                        if not item["future"].done():
-                            item["future"].set_result({
-                                "error": "infeasible", "message": msg,
-                                "cause": F.cause_dict(
-                                    F.SCHEDULING_TIMEOUT, msg,
-                                    node_id=self.node_id)})
-                        continue
-                    pool = bundle.pool
-                else:
-                    pool = self.node
-                from ray_tpu.scheduler.policy import strategy_allows_local
-
-                local_ok = pg is not None or strategy_allows_local(
-                    payload.get("strategy"), self.node_id, self.node.labels)
-                if item.get("spilling"):
-                    remaining.append(item)  # a spillback attempt owns it
-                elif local_ok and pool.can_fit(req):
-                    assignment = pool.allocate(req)
-                    spawn_task(
-                        self._run_task(item, req, assignment, pool))
-                else:
-                    # Load-based spillback (reference: spillback replies in
-                    # ScheduleAndDispatchTasks): a feasible task that has
-                    # waited past the delay looks for a node with capacity
-                    # free NOW. PG tasks are bundle-pinned — never spill.
-                    # Strategy-ineligible tasks (hard affinity/labels bound
-                    # elsewhere) MUST route and are exempt from the hop cap.
-                    cfg = get_config()
-                    if (pg is None
-                            and (not local_ok
-                                 or payload.get("spill_count", 0)
-                                 < cfg.spillback_max_hops)
-                            and time.monotonic() - item.get("t", 0)
-                            > cfg.spillback_delay_s):
-                        item["spilling"] = True
-                        spawn_task(self._try_spillback(item))
-                    remaining.append(item)
-            self._queue = remaining
+        self._task_event(payload["task_id"], payload.get("fn_name"),
+                         "FAILED")
+        fut = item["future"]
+        if not fut.done():
+            fut.set_result({"error": "deadline_exceeded", "message": msg,
+                            "cause": cause})
 
     async def _run_task(self, item, req: ResourceSet, assignment,
                         pool: NodeResources) -> None:
@@ -1304,6 +1857,14 @@ class Raylet:
         if self._telemetry:
             self._telemetry_metrics()["queue_wait"].observe(
                 t_claim - item["t"], {"node_id": self.node_id})
+        # per-class wait sample (feeds the heartbeat's wait_p99_s and the
+        # doctor starvation finding); bounded ring per class label
+        dq = self._class_waits.get(item.get("label") or "anonymous")
+        if dq is None:
+            dq = self._class_waits.setdefault(
+                item.get("label") or "anonymous",
+                collections.deque(maxlen=512))
+        dq.append((t_claim, t_claim - item.get("t_enq", item["t"])))
         # Phase tracing (one predicate when untraced): this raylet owns
         # queue_wait / worker_acquire / transfer / sched_overhead; the
         # worker's reply contributes arg_fetch / execute / result_store.
@@ -1321,6 +1882,17 @@ class Raylet:
         worker = None
         try:
             worker, source = await self._get_worker(key, chips, renv)
+            # warm-pool accounting: a pool hit skipped an interpreter boot
+            if source == "warm":
+                self._sched_stats["warm_hits"] += 1
+                if self._telemetry:
+                    try:
+                        self._telemetry_metrics()["warm_hits"].inc(
+                            1.0, {"node_id": self.node_id, "kind": "task"})
+                    except Exception:  # noqa: BLE001 — telemetry only
+                        pass
+            else:
+                self._sched_stats["cold_spawns"] += 1
             f = C.maybe_fire("raylet.kill_worker",
                              target=payload.get("fn_name"))
             if f is not None:
@@ -1460,8 +2032,35 @@ class Raylet:
         chips = assignment.get(TPU, [])
         worker = None
         try:
-            worker = self._spawn_worker((("actor", p["actor_id"]),), chips,
-                                        spec.get("runtime_env"))
+            # Warm-pool adoption (reference: the worker pool handing a
+            # prestarted worker to PopWorker): an actor that needs no
+            # pinned chips and no runtime env takes over an idle pooled
+            # worker instead of paying interpreter boot — the 0.4/s actor
+            # spawn floor of SCALE_r05 was pure process startup.
+            if (get_config().worker_adopt_for_actors and not chips
+                    and not spec.get("runtime_env")):
+                idle = self._idle.get(_WARM_KEY)
+                while idle:
+                    cand = idle.pop()
+                    if cand.proc.poll() is None:
+                        worker = cand
+                        worker.idle_since = None
+                        worker.key = (("actor", p["actor_id"]),)
+                        self._sched_stats["warm_hits"] += 1
+                        self._sched_stats["actor_adoptions"] += 1
+                        if self._telemetry:
+                            try:
+                                self._telemetry_metrics()["warm_hits"].inc(
+                                    1.0, {"node_id": self.node_id,
+                                          "kind": "actor"})
+                            except Exception:  # noqa: BLE001
+                                pass
+                        break
+                    self._workers.pop(cand.worker_id, None)
+            if worker is None:
+                self._sched_stats["cold_spawns"] += 1
+                worker = self._spawn_worker((("actor", p["actor_id"]),),
+                                            chips, spec.get("runtime_env"))
             worker.is_actor_worker = True
             worker.job_id = spec.get("job_id")
             worker.actor_id = p["actor_id"]
@@ -1977,7 +2576,8 @@ class Raylet:
             "node_id": self.node_id,
             "workers": len(self._workers),
             "idle": sum(len(v) for v in self._idle.values()),
-            "queued": len(self._queue),
+            "queued": len(self._squeue),
+            "sched": self._sched_summary(),
             "object_store_bytes": self.store.used_bytes(),
             "available": self.node.available.to_dict(),
         }

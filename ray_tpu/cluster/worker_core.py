@@ -1003,6 +1003,53 @@ class ClusterBackend(RuntimeBackend):
             return strategy.to_spec(), pg_info
         return strategy, None
 
+    @staticmethod
+    def _stamp_overload_options(payload: Dict, options: Dict) -> None:
+        """Deadline budget + backpressure policy ride the submit payload
+        (absent on the default path — the wire stays small)."""
+        if options.get("deadline_s"):
+            payload["deadline_s"] = float(options["deadline_s"])
+        if options.get("on_overload"):
+            payload["on_overload"] = options["on_overload"]
+
+    async def _backpressure_pause(self, attempt: int) -> None:
+        """Block-with-backoff between backpressured resubmits: capped
+        exponential + jitter so a fleet of throttled producers doesn't
+        re-slam the raylet in lockstep."""
+        cfg = get_config()
+        await asyncio.sleep(F.backoff_with_jitter(
+            attempt, cfg.backpressure_retry_base_s,
+            cfg.backpressure_retry_max_s))
+
+    def _backpressure_error(self, reply: Dict, fn_name: str):
+        from ray_tpu.exceptions import BackpressureError
+
+        return BackpressureError(
+            f"task {fn_name} rejected under overload: scheduling-class "
+            f"queue at its admission bound "
+            f"({reply.get('queue_depth')}/{reply.get('limit')}); the "
+            f"default on_overload='block' waits this out instead",
+            queue_depth=reply.get("queue_depth"),
+            limit=reply.get("limit"))
+
+    def _deadline_shed(self, payload: Dict, what: str):
+        """Owner-side pre-enqueue deadline shed: the submit was parked in
+        the backpressure backoff loop past its budget and was NEVER
+        enqueued, so the owner is the only process that can stamp the
+        organic scheduling_timeout feed row (queued work is covered by the
+        raylet's ``_evict_item``). Returns ``(message, cause)`` for the
+        caller to deliver on its own path (stream slot vs return refs)."""
+        msg = (f"deadline_s={payload['deadline_s']} budget expired while "
+               f"blocked on backpressure (never enqueued)")
+        self._failure_event(
+            F.SCHEDULING_TIMEOUT,
+            f"{what} {payload['fn_name']} shed: {msg}",
+            task_id=payload.get("task_id"),
+            name=payload["fn_name"])
+        return msg, F.cause_dict(F.SCHEDULING_TIMEOUT,
+                                 "deadline expired under backpressure",
+                                 task_id=payload.get("task_id"))
+
     # ---- tasks --------------------------------------------------------------
     def submit_task(self, fn, options, args, kwargs):
         validate_options(options, for_actor=False)
@@ -1035,6 +1082,7 @@ class ClusterBackend(RuntimeBackend):
             "runtime_env": self._prepare_env(options),
             "trace": _trace_ctx(),
         }
+        self._stamp_overload_options(payload, options)
         from ray_tpu.util import tracing
 
         self.io.spawn(self._submit_and_collect(
@@ -1065,12 +1113,16 @@ class ClusterBackend(RuntimeBackend):
             "runtime_env": self._prepare_env(options),
             "trace": _trace_ctx(),  # span + phases land via the raylet
         }
+        self._stamp_overload_options(payload, options)
 
         async def _run():
             # A stream that produced NOTHING yet is safe to retry whole
             # (transient worker-spawn failures under load); once items have
             # been consumed, a partial stream must not silently re-run.
             retries = get_config().task_max_retries_default
+            bp_attempts = 0
+            bp_deadline = (time.monotonic() + payload["deadline_s"]
+                           if payload.get("deadline_s") else None)
             while True:
                 try:
                     target = self._raylet
@@ -1079,6 +1131,19 @@ class ClusterBackend(RuntimeBackend):
                     reply = await target.call("submit_task", payload)
                 except Exception as e:
                     reply = {"error": "submit_failed", "message": repr(e)}
+                if reply.get("error") == "backpressure":
+                    if (bp_deadline is not None
+                            and time.monotonic() >= bp_deadline):
+                        # deadline holds pre-enqueue: shed instead of
+                        # blocking past the budget
+                        msg, cause = self._deadline_shed(payload, "stream")
+                        reply = {"error": "deadline_exceeded",
+                                 "message": msg, "cause": cause}
+                    elif (payload.get("on_overload") != "fail"
+                            and not state.closed):
+                        bp_attempts += 1
+                        await self._backpressure_pause(bp_attempts)
+                        continue
                 if (reply.get("error") in ("worker_crashed", "bundle_gone",
                                            "submit_failed", "oom_killed")
                         and state.produced == 0 and not state.closed
@@ -1088,9 +1153,20 @@ class ClusterBackend(RuntimeBackend):
                     continue
                 break
             if reply.get("error"):
-                err = WorkerCrashedError(
-                    f"streaming task {payload['fn_name']} failed: "
-                    f"{reply.get('message', reply['error'])}")
+                if reply["error"] == "backpressure":
+                    err: Exception = self._backpressure_error(
+                        reply, payload["fn_name"])
+                elif reply["error"] == "deadline_exceeded":
+                    from ray_tpu.exceptions import SchedulingTimeoutError
+
+                    err = SchedulingTimeoutError(
+                        f"streaming task {payload['fn_name']} shed: "
+                        f"{reply.get('message', reply['error'])}",
+                        cause=reply.get("cause"))
+                else:
+                    err = WorkerCrashedError(
+                        f"streaming task {payload['fn_name']} failed: "
+                        f"{reply.get('message', reply['error'])}")
                 blob = self.serde.serialize(err).to_bytes()
                 idx = state.produced
                 self.memory_store.put(
@@ -1117,6 +1193,12 @@ class ClusterBackend(RuntimeBackend):
                                   t_entry: Optional[float] = None) -> None:
         retries = payload.get("max_retries", 0)
         attempt = 0
+        bp_attempts = 0
+        # the deadline budget must hold PRE-enqueue too: a submit parked in
+        # the backpressure backoff loop is exactly the stale work
+        # deadline_s exists to shed
+        bp_deadline = (time.monotonic() + payload["deadline_s"]
+                       if payload.get("deadline_s") else None)
         traced = payload.get("trace") is not None  # one predicate per hop
         while True:
             t_sub = (t_entry if attempt == 0 and t_entry is not None
@@ -1128,6 +1210,32 @@ class ClusterBackend(RuntimeBackend):
                 reply = await target.call("submit_task", payload)
             except Exception as e:
                 reply = {"error": "submit_failed", "message": repr(e)}
+            if reply.get("error") == "backpressure":
+                # admission control bounced the submit: block-with-backoff
+                # (default) keeps the producer paced without consuming its
+                # retry budget; fail-fast resolves the refs with a
+                # BackpressureError the caller can catch.
+                if payload.get("on_overload") == "fail":
+                    blob = self.serde.serialize(self._backpressure_error(
+                        reply, payload["fn_name"])).to_bytes()
+                    for r in refs:
+                        self.memory_store.put(r.hex(), blob)
+                    return
+                if (bp_deadline is not None
+                        and time.monotonic() >= bp_deadline):
+                    from ray_tpu.exceptions import SchedulingTimeoutError
+
+                    msg, cause = self._deadline_shed(payload, "task")
+                    err = SchedulingTimeoutError(
+                        f"task {payload['fn_name']} shed: {msg}",
+                        cause=cause)
+                    blob = self.serde.serialize(err).to_bytes()
+                    for r in refs:
+                        self.memory_store.put(r.hex(), blob)
+                    return
+                bp_attempts += 1
+                await self._backpressure_pause(bp_attempts)
+                continue
             if reply.get("error") in ("worker_crashed", "bundle_gone",
                                       "submit_failed", "oom_killed"):
                 if payload.get("pg") is not None:
@@ -1199,6 +1307,16 @@ class ClusterBackend(RuntimeBackend):
                 from ray_tpu.exceptions import OutOfMemoryError
 
                 err: Exception = OutOfMemoryError(msg)
+            elif reply["error"] == "deadline_exceeded":
+                # the raylet shed the task (deadline_s budget expired in
+                # queue); get() raises the scheduling_timeout cause
+                from ray_tpu.exceptions import SchedulingTimeoutError
+
+                err = SchedulingTimeoutError(msg, cause=reply.get("cause"))
+            elif reply["error"] == "backpressure":
+                # only reachable on paths that bypass the submit loop's
+                # own backpressure handling (e.g. reconstruction)
+                err = self._backpressure_error(reply, fn_name)
             else:
                 err = WorkerCrashedError(msg)
             # the raylet's structured cause rides into the raised exception

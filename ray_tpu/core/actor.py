@@ -32,6 +32,12 @@ class ActorMethod:
 
     def options(self, **opts):
         validate_options(opts, for_actor=False)
+        for k in ("deadline_s", "on_overload"):
+            if opts.get(k) is not None:
+                raise ValueError(
+                    f"option {k!r} is not supported on actor method "
+                    "calls: actor tasks go straight to the actor's "
+                    "worker and never sit in the raylet queue")
         handle, name = self._handle, self._method_name
 
         class _Opted:

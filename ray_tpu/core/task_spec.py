@@ -74,6 +74,7 @@ _VALID_OPTIONS = {
     "placement_group_bundle_index", "max_concurrency", "runtime_env",
     "namespace", "get_if_exists", "max_pending_calls", "retry_exceptions",
     "concurrency_groups", "label_selector", "_stream_max_buffer",
+    "deadline_s", "on_overload",
 }
 
 
@@ -92,6 +93,16 @@ def validate_options(opts: Dict[str, Any], for_actor: bool) -> Dict[str, Any]:
         for k in ("max_restarts", "max_task_retries", "max_concurrency"):
             if opts.get(k) is not None:
                 raise ValueError(f"option {k!r} is only valid for actors")
+    else:
+        for k in ("deadline_s", "on_overload"):
+            if opts.get(k) is not None:
+                raise ValueError(f"option {k!r} is only valid for tasks")
+    d = opts.get("deadline_s")
+    if d is not None and (not isinstance(d, (int, float)) or d <= 0):
+        raise ValueError(f"deadline_s must be a positive number, got {d!r}")
+    oo = opts.get("on_overload")
+    if oo not in (None, "block", "fail"):
+        raise ValueError(f"on_overload must be 'block' or 'fail', got {oo!r}")
     return opts
 
 

@@ -254,6 +254,18 @@ const COLS = {
     ["Available", r => `<td>${fmtRes(r.resources_available
                                      || r.available)}</td>`],
     ["Queued", r => `<td>${esc(r.queue_depth ?? "")}</td>`],
+    ["Classes", r => `<td>${((r.sched || {}).classes || [])
+      .slice(0, 3)
+      .map(c => `${esc(c["class"])}:${esc(c.depth)}` +
+           (c.wait_p99_s != null ? ` (p99 ${esc(c.wait_p99_s)}s)` : ""))
+      .join(" ")}</td>`],
+    ["Warm pool", r => { const w = (r.sched || {}).warm || {};
+      const served = (w.warm_hits || 0) + (w.cold_spawns || 0);
+      if (!served && !w.idle && !w.floor) return "<td></td>";
+      const rate = served
+        ? ` hit ${Math.round(100 * (w.warm_hits || 0) / served)}%` : "";
+      return `<td>${esc(w.idle ?? 0)} idle / floor ${esc(w.floor ?? 0)}` +
+             `${rate}</td>`; }],
   ],
   actors: [
     ["Actor", r => `<td class="id">${esc(r.actor_id)}</td>`],

@@ -151,6 +151,42 @@ class WorkerCrashedError(RayTpuError):
     """The worker process executing the task died unexpectedly."""
 
 
+class BackpressureError(RayTpuError):
+    """The raylet bounced this submission: the task's scheduling class is
+    at its admission bound (``RT_MAX_QUEUED_PER_CLASS``) and the task
+    opted into fail-fast via ``.options(on_overload="fail")``. Default
+    submissions never see this — they block with backoff until the queue
+    drains."""
+
+    def __init__(self, message: str = "", queue_depth=None, limit=None):
+        self.queue_depth = queue_depth
+        self.limit = limit
+        super().__init__(message or "task rejected under overload "
+                                    "(scheduling-class queue at bound)")
+
+    def __reduce__(self):
+        return (BackpressureError,
+                (self.args[0] if self.args else "",
+                 self.queue_depth, self.limit))
+
+
+class SchedulingTimeoutError(RayTpuError):
+    """The task's ``deadline_s`` budget expired while it was still queued
+    in the raylet — the work was shed instead of executed late.
+    ``cause_info`` carries the structured ``scheduling_timeout`` cause
+    (core/failure.py wire dict) so the raised error and ``rt errors``
+    agree on why."""
+
+    def __init__(self, message: str = "", cause=None):
+        self.cause_info = dict(cause) if cause else None
+        super().__init__(message or "scheduling deadline exceeded in the "
+                                    "raylet queue")
+
+    def __reduce__(self):
+        return (SchedulingTimeoutError,
+                (self.args[0] if self.args else "", self.cause_info))
+
+
 class RuntimeEnvSetupError(RayTpuError):
     pass
 

@@ -207,6 +207,37 @@ def cmd_status(args: argparse.Namespace) -> int:
         role = n.get("labels", {}).get("node_role", "worker")
         print(f"  {n['node_id'][:8]} {state:5} {role:6} {n['address']:>21} "
               f"total={n['resources']} available={n['available']}")
+        # scheduling plane (heartbeat sched summary): per-class queue
+        # depth + warm-pool occupancy/hit-rate — the overload story at a
+        # glance (which class is deep, whether dispatch pays cold boots)
+        sched = n.get("sched") or {}
+        warm = sched.get("warm") or {}
+        if warm:
+            hits = warm.get("warm_hits", 0)
+            cold = warm.get("cold_spawns", 0)
+            rate = (f"{100.0 * hits / (hits + cold):.0f}%"
+                    if hits + cold else "n/a")
+            extras = []
+            if warm.get("actor_adoptions"):
+                extras.append(f"{warm['actor_adoptions']} actor adoption(s)")
+            if sched.get("backpressure_total"):
+                extras.append(
+                    f"{sched['backpressure_total']} backpressured")
+            if sched.get("deadline_evictions_total"):
+                extras.append(f"{sched['deadline_evictions_total']} "
+                              f"deadline-evicted")
+            print(f"           warm pool: {warm.get('idle', 0)} idle / "
+                  f"floor {warm.get('floor', 0)}, warm-hit rate {rate} "
+                  f"({hits} warm / {cold} cold)"
+                  + (f"; {', '.join(extras)}" if extras else ""))
+        classes = sched.get("classes") or []
+        if classes:
+            desc = ", ".join(
+                f"{c.get('class')}:{c.get('depth')}"
+                + (f" (p99 {c['wait_p99_s']}s)"
+                   if c.get("wait_p99_s") is not None else "")
+                for c in classes[:5])
+            print(f"           queued by class: {desc}")
     return 0
 
 
@@ -599,7 +630,9 @@ def cmd_doctor(args: argparse.Namespace) -> int:
               file=sys.stderr)
         return 2
     text, rc = doctor.run(gcs, window_s=args.window,
-                          queue_warn=args.queue_warn, as_json=args.json)
+                          queue_warn=args.queue_warn,
+                          queue_wait_warn_s=args.queue_wait_warn,
+                          as_json=args.json)
     print(text, file=sys.stderr if rc == 2 else sys.stdout)
     return rc
 
@@ -855,6 +888,9 @@ def main(argv=None) -> int:
                        help="recency window (s) for failure/OOM findings")
     p_doc.add_argument("--queue-warn", type=int, default=100,
                        help="raylet queue depth that warrants a warning")
+    p_doc.add_argument("--queue-wait-warn", type=float, default=10.0,
+                       help="per-scheduling-class queue-wait p99 (s) that "
+                            "grades the class as starving")
     p_doc.add_argument("--json", action="store_true")
     p_doc.set_defaults(fn=cmd_doctor)
 

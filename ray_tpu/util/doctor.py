@@ -51,6 +51,7 @@ async def _collect_async(gcs_address: str, window_s: float,
         async def probe_node(n):
             out = {"node_id": n["node_id"], "alive": n.get("alive", True),
                    "queue_depth": n.get("queue_depth", 0),
+                   "sched": n.get("sched"),
                    "address": n.get("address"),
                    "death_t": n.get("death_t"),
                    "death_reason": n.get("death_reason", "")}
@@ -128,7 +129,8 @@ def _recent(events: List[Dict], window_s: float,
 
 
 def diagnose(report: Dict[str, Any],
-             queue_warn: int = 100) -> List[Tuple[str, str]]:
+             queue_warn: int = 100,
+             queue_wait_warn_s: float = 10.0) -> List[Tuple[str, str]]:
     """Turn the raw report into ranked ``(level, message)`` findings.
     Any CRITICAL finding makes the cluster unhealthy (exit 1)."""
     findings: List[Tuple[str, str]] = []
@@ -223,6 +225,23 @@ def diagnose(report: Dict[str, Any],
                              f"node {n['node_id'][:8]} raylet queue depth "
                              f"{depth} (> {queue_warn}; tasks are waiting "
                              f"on resources)"))
+        # per-class starvation: sustained queue-wait p99 (or an oldest
+        # waiter aging past the threshold) names the starving class —
+        # aggregate depth alone can't tell a busy class from a starved one
+        for c in (n.get("sched") or {}).get("classes") or ():
+            p99 = c.get("wait_p99_s") or 0.0
+            oldest = c.get("oldest_wait_s") or 0.0
+            worst = max(p99, oldest)
+            if worst > queue_wait_warn_s:
+                measure = ("queue-wait p99" if p99 >= oldest
+                           else "oldest waiter")
+                findings.append((WARN,
+                                 f"node {n['node_id'][:8]} scheduling "
+                                 f"class {str(c.get('class'))!r} is "
+                                 f"starving: {measure} {worst:.1f}s "
+                                 f"(> {queue_wait_warn_s:.0f}s, "
+                                 f"{c.get('depth', 0)} queued — see "
+                                 f"per-class depth in `rt status`)"))
         store = (n.get("memory") or {}).get("store") or {}
         cap = store.get("capacity_bytes") or 0
         in_mem = store.get("in_mem_bytes") or 0
@@ -297,6 +316,7 @@ def format_report(report: Dict[str, Any],
 
 
 def run(gcs_address: str, window_s: float = 600.0, queue_warn: int = 100,
+        queue_wait_warn_s: float = 10.0,
         as_json: bool = False) -> Tuple[str, int]:
     """Collect + diagnose + render; returns (text, exit_code). Exit 2 when
     the GCS itself is unreachable."""
@@ -305,7 +325,8 @@ def run(gcs_address: str, window_s: float = 600.0, queue_warn: int = 100,
     except Exception as e:  # noqa: BLE001 — the cluster is the patient
         return (f"rt doctor: cannot reach GCS at {gcs_address}: "
                 f"{type(e).__name__}: {e}", 2)
-    findings = diagnose(report, queue_warn=queue_warn)
+    findings = diagnose(report, queue_warn=queue_warn,
+                        queue_wait_warn_s=queue_wait_warn_s)
     if as_json:
         rc = exit_code(findings)
         payload = dict(report,

@@ -56,4 +56,55 @@ d = json.load(sys.stdin)
 assert d["exit_code"] == 0 and d["healthy"], d["findings"]
 print("doctor healthy:", [f["message"] for f in d["findings"]])
 '
+
+echo "== overload leg: probe under a deep flood (fair dispatch) =="
+# Flood one scheduling class, then submit a 1-task probe in ANOTHER class:
+# round-robin dispatch must answer it in < 1 s instead of making it wait
+# out the whole backlog (the SCALE_r05 255 s FIFO pathology).
+FLOOD="${RT_SMOKE_FLOOD:-5000}"
+T0=$(python -c 'import time; print(time.time())')
+python - "$FLOOD" <<'EOF'
+import sys
+import time
+
+import ray_tpu
+
+flood_n = int(sys.argv[1])
+ray_tpu.init(address="auto")
+
+@ray_tpu.remote
+def bulk():
+    return 0
+
+@ray_tpu.remote
+def probe_task():
+    return 42
+
+refs = [bulk.remote() for _ in range(flood_n)]
+t0 = time.perf_counter()
+assert ray_tpu.get(probe_task.remote(), timeout=60) == 42
+probe_s = time.perf_counter() - t0
+print(f"probe under {flood_n}-deep flood: {probe_s * 1000:.0f} ms")
+assert probe_s < 1.0, f"probe took {probe_s:.2f}s behind {flood_n} tasks"
+ray_tpu.get(refs, timeout=900)  # full drain before the health checks
+ray_tpu.shutdown()
+EOF
+
+echo "== overload must leave no organic failures on the feed =="
+# scoped to the overload leg: the earlier kill-worker leg legitimately
+# left its (chaos-caused but organically-stamped) worker_crash residue
+$RT errors --origin organic --json | python -c "
+import json, sys
+t0 = float('$T0')
+events = [e for e in json.load(sys.stdin)
+          if e.get('last_t', e.get('t', 0)) >= t0]
+assert events == [], f'organic failures under overload: {events}'
+print('feed clean: no organic failures from the overload leg')
+"
+$RT doctor --window 5 --json | python -c '
+import json, sys
+d = json.load(sys.stdin)
+assert d["exit_code"] == 0 and d["healthy"], d["findings"]
+print("doctor healthy after overload")
+'
 echo "chaos smoke OK"
