@@ -1219,6 +1219,7 @@ class GcsServer:
     # a bounded in-memory event store behind the State API) -----------------
     _TASK_EVENTS_CAP = 10000
     _STEP_EVENTS_CAP = 4096
+    _SERVE_EVENTS_CAP = 4096
 
     async def rpc_task_event(self, p):
         self._apply_task_event(p)
@@ -1240,9 +1241,17 @@ class GcsServer:
             # profile run emits a record per token, and sharing the task
             # FIFO would evict the real task history
             self.step_events: "OrderedDict[str, Dict]" = OrderedDict()
+            # serve request spans likewise (serve/obs.py): heavy traffic
+            # emits several spans per request and must not crowd out tasks
+            self.serve_events: "OrderedDict[str, Dict]" = OrderedDict()
         is_step = p.get("profile") is not None
-        store = self.step_events if is_step else self.task_events
-        cap = self._STEP_EVENTS_CAP if is_step else self._TASK_EVENTS_CAP
+        is_serve = str(p.get("task_id", "")).startswith("serve:")
+        if is_step:
+            store, cap = self.step_events, self._STEP_EVENTS_CAP
+        elif is_serve:
+            store, cap = self.serve_events, self._SERVE_EVENTS_CAP
+        else:
+            store, cap = self.task_events, self._TASK_EVENTS_CAP
         ev = store.pop(p["task_id"], None)
         if ev is None and p.get("state") is None:
             # a phases-only partial for a task the FIFO already evicted:
@@ -1284,7 +1293,9 @@ class GcsServer:
         # "include" -> both lanes (the Perfetto timeline asks for this
         # explicitly); default EXCLUDES step records so legacy callers
         # (rt list tasks, the /metrics rt_tasks scrape, tracing) keep
-        # seeing real tasks only.
+        # seeing real tasks only. "serve": "include" additionally returns
+        # the serve request spans (rt trace and the timeline ask for them;
+        # the state API / dashboard Tasks tab stay real-tasks-only).
         mode = p.get("profile") or "exclude"
         limit = p.get("limit") or 1000
         events = []
@@ -1294,7 +1305,29 @@ class GcsServer:
             events += list(getattr(self, "task_events", {}).values())[-limit:]
         if mode != "exclude":
             events += list(getattr(self, "step_events", {}).values())[-limit:]
+        if p.get("serve") == "include" and mode != "only":
+            events += list(
+                getattr(self, "serve_events", {}).values())[-limit:]
         return events
+
+    # ---- serve events (autoscaler decision records; the store behind the
+    # timeline's serve lane and `rt serve status --verbose`) --------------
+    _SERVE_DECISIONS_CAP = 1024
+
+    async def rpc_serve_event(self, p):
+        if not hasattr(self, "serve_decisions"):
+            from collections import deque
+
+            self.serve_decisions: "deque" = deque(
+                maxlen=self._SERVE_DECISIONS_CAP)
+        p.setdefault("t", time.time())
+        self.serve_decisions.append(p)
+        return {"ok": True}
+
+    async def rpc_list_serve_events(self, p):
+        limit = p.get("limit") or 200
+        events = list(getattr(self, "serve_decisions", ()))
+        return events[-limit:]
 
     # ---- memory events (spill / restore / oom_kill instants; the store
     # behind `rt memory --oom` and the timeline's memory lane) -------------

@@ -25,6 +25,7 @@ dict operations per ref.
 
 from __future__ import annotations
 
+import collections
 import json
 import os
 import threading
@@ -76,6 +77,16 @@ class OwnershipLedger:
         self._lock = threading.Lock()
         self._pusher: Optional[threading.Thread] = None
         self._record_sites: Optional[bool] = None  # lazy config read
+        # deref backlog: ``_deref`` runs from weakref finalizers, which the
+        # cyclic GC can fire on ANY thread at ANY allocation — including on
+        # a thread that is inside one of the ``_lock`` regions below (e.g.
+        # ``_entry`` allocating). A finalizer that takes ``_lock`` would
+        # self-deadlock that thread and wedge every ObjectRef creation in
+        # the process forever (seen live: a chaos kill-storm froze the
+        # serve proxy's handle pool for 10+ minutes). Finalizers only
+        # append here (deque.append is atomic under the GIL); the backlog
+        # drains inside the next locked operation.
+        self._pending_derefs: "collections.deque[str]" = collections.deque()
 
     # ---- config -------------------------------------------------------------
     def _sites_enabled(self) -> bool:
@@ -120,6 +131,7 @@ class OwnershipLedger:
             oid_hex = ref.hex()
             site = self._call_site() if self._sites_enabled() else ""
             with self._lock:
+                self._drain_derefs_locked()
                 e = self._entry(oid_hex)
                 e.local_refs += 1
                 if ref.owner_address() and not e.owner:
@@ -131,7 +143,17 @@ class OwnershipLedger:
             pass
 
     def _deref(self, oid_hex: str) -> None:
-        with self._lock:
+        # weakref-finalizer context: NEVER take ``_lock`` here (the GC can
+        # fire this mid-allocation on a thread already holding it — see
+        # ``_pending_derefs``); just enqueue, the next locked op drains
+        self._pending_derefs.append(oid_hex)
+
+    def _drain_derefs_locked(self) -> None:
+        while True:
+            try:
+                oid_hex = self._pending_derefs.popleft()
+            except IndexError:
+                return
             e = self._entries.get(oid_hex)
             if e is not None and e.local_refs > 0:
                 e.local_refs -= 1
@@ -139,6 +161,7 @@ class OwnershipLedger:
     def record_put(self, oid_hex: str, size: int, where: str,
                    owner: Optional[str] = None) -> None:
         with self._lock:
+            self._drain_derefs_locked()
             e = self._entry(oid_hex)
             e.size = size
             e.where = where
@@ -147,12 +170,14 @@ class OwnershipLedger:
 
     def record_task_arg(self, oid_hex: str) -> None:
         with self._lock:
+            self._drain_derefs_locked()
             e = self._entries.get(oid_hex)
             if e is not None:
                 e.task_arg_uses += 1
 
     def record_get(self, oid_hex: str) -> None:
         with self._lock:
+            self._drain_derefs_locked()
             e = self._entries.get(oid_hex)
             if e is not None:
                 e.get_count += 1
@@ -160,6 +185,7 @@ class OwnershipLedger:
 
     def record_freed(self, oid_hex: str) -> None:
         with self._lock:
+            self._drain_derefs_locked()
             e = self._entries.get(oid_hex)
             if e is not None:
                 e.freed = True
@@ -167,6 +193,7 @@ class OwnershipLedger:
     # ---- access -------------------------------------------------------------
     def snapshot(self, cap: int = _SNAPSHOT_CAP) -> List[Dict[str, Any]]:
         with self._lock:
+            self._drain_derefs_locked()
             entries = [e.to_dict() for e in self._entries.values()
                        if not e.freed]
         entries.sort(key=lambda d: -d["size"])
@@ -182,6 +209,7 @@ class OwnershipLedger:
             age_s = get_config().memory_leak_age_s
         now = time.time()
         with self._lock:
+            self._drain_derefs_locked()
             out = []
             for e in self._entries.values():
                 if e.freed or e.local_refs <= 0:

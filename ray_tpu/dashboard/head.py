@@ -65,6 +65,7 @@ class DashboardActor:
         app.router.add_get("/api/cluster_resources", self._cluster_resources)
         app.router.add_get("/api/jobs", self._jobs)
         app.router.add_get("/api/serve/applications", self._serve_apps)
+        app.router.add_get("/api/serve", self._serve_detail)
         app.router.add_get("/api/stacks", self._stacks)
         app.router.add_get("/metrics", self._metrics)
         self._runner = web.AppRunner(app, access_log=None)
@@ -148,6 +149,24 @@ class DashboardActor:
                 return serve.status()
             except RuntimeError:  # serve not running
                 return {}
+
+        loop = asyncio.get_running_loop()
+        out = await loop.run_in_executor(None, fetch)
+        return web.json_response(out, dumps=_dumps)
+
+    async def _serve_detail(self, request):
+        """The Serve tab's payload: applications with per-deployment
+        windowed stats (ongoing / queue depth / p50 / p99 / QPS) plus the
+        autoscaler decision-log tail (serve/controller.py)."""
+        from aiohttp import web
+
+        def fetch():
+            from ray_tpu import serve
+
+            try:
+                return serve.detailed_status()
+            except RuntimeError:  # serve not running
+                return {"applications": {}, "decisions": []}
 
         loop = asyncio.get_running_loop()
         out = await loop.run_in_executor(None, fetch)

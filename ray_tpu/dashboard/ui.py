@@ -72,6 +72,7 @@ header button {
 .tile .value { font-size: 24px; font-weight: 650; margin-top: 2px;
   font-variant-numeric: tabular-nums; }
 .tile .detail { font-size: 11px; color: var(--text-secondary); }
+.muted { color: var(--text-muted); font-size: 12px; font-weight: 400; }
 .meter {
   margin-top: 6px; height: 6px; border-radius: 4px;
   background: color-mix(in srgb, var(--border) 60%, var(--surface-2));
@@ -204,7 +205,7 @@ const TABS = [
   {id: "objects", label: "Objects", url: "/api/objects?limit=200"},
   {id: "memory", label: "Memory", url: "/api/memory?limit=100"},
   {id: "logs", label: "Logs", url: "/api/logs?limit=300"},
-  {id: "serve", label: "Serve", url: "/api/serve/applications"},
+  {id: "serve", label: "Serve", url: "/api/serve"},
 ];
 let active = "nodes", paused = false, data = {};
 
@@ -588,23 +589,47 @@ function renderTable() {
   if (active === "memory") { renderMemory(el); return; }
   if (active === "logs") { renderLogs(el); return; }
   if (active === "serve") {
-    const apps = data.serve || {};
+    const payload = data.serve || {};
+    const apps = payload.applications || payload;
+    const decisions = payload.decisions || [];
     const names = Object.keys(apps);
-    if (!names.length) {
-      el.innerHTML = `<div class="empty">no serve applications</div>`;
-      return;
-    }
-    el.innerHTML = names.map(n => {
+    const ms = v => v ? (1e3 * v).toFixed(1) : "0.0";
+    el.innerHTML = (names.length ? "" :
+      `<div class="empty">no serve applications</div>`) + names.map(n => {
       const app = apps[n] || {};
       const deps = app.deployments || app;
-      return `<h3>${esc(n)} ${statusCell(app.status || "RUNNING")}</h3>` +
-        `<table><tr><th>Deployment</th><th>Status</th><th>Replicas</th>` +
-        `</tr>` + Object.entries(deps).map(([d, info]) =>
-          `<tr><td>${esc(d)}</td>` +
-          `<td>${statusCell((info && info.status) || "?")}</td>` +
-          `<td>${esc((info && (info.num_replicas ?? info.replicas))
-                     ?? "")}</td></tr>`).join("") + `</table>`;
-    }).join("");
+      return `<h3>${esc(n)} ${statusCell(app.status || "RUNNING")}` +
+        (app.route_prefix ? ` <span class="muted">${esc(app.route_prefix)}` +
+         `</span>` : ``) + `</h3>` +
+        `<table><tr><th>Deployment</th><th>Replicas</th><th>Target</th>` +
+        `<th>Ongoing</th><th>Queue</th><th>p50</th><th>p99</th>` +
+        `<th>QPS</th></tr>` + Object.entries(deps).map(([d, info]) => {
+          const s = (info && info.stats) || {};
+          return `<tr><td>${esc(d)}</td>` +
+            `<td>${esc((info && (info.num_replicas ?? info.replicas))
+                       ?? "")}</td>` +
+            `<td>${esc((info && info.target) ?? "")}</td>` +
+            `<td>${esc(s.ongoing ?? 0)}</td>` +
+            `<td>${esc(s.queue_depth ?? 0)}</td>` +
+            `<td>${ms(s.p50_s)} ms</td><td>${ms(s.p99_s)} ms</td>` +
+            `<td>${esc(s.qps ?? 0)}</td></tr>`;
+        }).join("") + `</table>`;
+    }).join("") +
+    `<h3>Autoscaler decisions</h3>` +
+    (decisions.length ? `<table><tr><th>When</th><th>Deployment</th>` +
+      `<th>Target</th><th>Why</th></tr>` +
+      decisions.slice().reverse().map(d => {
+        const trig = d.trigger || {};
+        const when = d.t ? new Date(d.t * 1000).toLocaleTimeString() : "";
+        return `<tr><td>${esc(when)}</td>` +
+          `<td>${esc(d.app)}/${esc(d.deployment)}</td>` +
+          `<td>${esc(d.old_target)} &rarr; ${esc(d.new_target)} ` +
+          `(${esc(d.direction || "")})</td>` +
+          `<td>ongoing_avg=${esc(trig.ongoing_avg ?? 0)} ` +
+          `queue=${esc(trig.queue_depth ?? 0)} ` +
+          `p99=${ms(trig.p99_s)}ms qps=${esc(trig.qps ?? 0)}</td></tr>`;
+      }).join("") + `</table>`
+      : `<div class="empty">none recorded</div>`);
     return;
   }
   let rows = data[active] || [];

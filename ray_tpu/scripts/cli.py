@@ -345,7 +345,49 @@ def cmd_serve(args: argparse.Namespace) -> int:
             print(f"deployed application {n!r}")
         return 0
     if args.serve_cmd == "status":
-        print(json.dumps(serve.status(), indent=2, default=str))
+        if getattr(args, "json", False):
+            print(json.dumps(serve.detailed_status(), indent=2, default=str))
+            return 0
+        st = serve.detailed_status()
+        apps = st.get("applications", {})
+        if not apps:
+            # the decision log outlives the apps it scaled (post-mortem of
+            # a deleted deployment) — only the non-verbose view can stop
+            print("no serve applications")
+            if not getattr(args, "verbose", False):
+                return 0
+        for app, meta in apps.items():
+            print(f"app {app!r}  route={meta.get('route_prefix')}  "
+                  f"ingress={meta.get('ingress')}")
+            for name, d in (meta.get("deployments") or {}).items():
+                s = d.get("stats") or {}
+                print(f"  {name:<24} replicas {d.get('replicas', 0)}/"
+                      f"{d.get('target', 0)}"
+                      f"{' (+%d starting)' % d['starting'] if d.get('starting') else ''}"
+                      f"  ongoing {s.get('ongoing', 0)}"
+                      f"  queue {s.get('queue_depth', 0)}"
+                      f"  p50 {1e3 * (s.get('p50_s') or 0):.1f}ms"
+                      f"  p99 {1e3 * (s.get('p99_s') or 0):.1f}ms"
+                      f"  qps {s.get('qps', 0)}")
+        if getattr(args, "verbose", False):
+            decisions = st.get("decisions") or []
+            print(f"\nautoscaler decisions ({len(decisions)} recent):")
+            for d in decisions:
+                trig = d.get("trigger") or {}
+                hyst = trig.get("hysteresis")
+                when = time.strftime("%H:%M:%S",
+                                     time.localtime(d.get("t", 0)))
+                line = (f"  [{when}] {d['app']}/{d['deployment']} "
+                        f"target {d.get('old_target')} -> "
+                        f"{d.get('new_target')} ({d.get('direction')}; "
+                        f"ongoing_avg={trig.get('ongoing_avg', 0)} "
+                        f"queue={trig.get('queue_depth', 0)} "
+                        f"p99={1e3 * (trig.get('p99_s') or 0):.1f}ms "
+                        f"qps={trig.get('qps', 0)})")
+                if hyst:
+                    line += (f" [held {hyst.get('held_s')}s of "
+                             f"{hyst.get('delay_s')}s]")
+                print(line)
         return 0
     if args.serve_cmd == "shutdown":
         serve.shutdown()
@@ -423,7 +465,8 @@ def cmd_trace(args: argparse.Namespace) -> int:
         print("no running cluster found (pass --address)", file=sys.stderr)
         return 1
     try:
-        events = _gcs_call(gcs, "list_tasks", {"limit": args.limit})
+        events = _gcs_call(gcs, "list_tasks",
+                           {"limit": args.limit, "serve": "include"})
     except Exception as e:  # noqa: BLE001 — one line, not a stack trace
         print(f"rt trace: cannot reach GCS at {gcs}: "
               f"{type(e).__name__}: {e}", file=sys.stderr)
@@ -632,6 +675,7 @@ def cmd_doctor(args: argparse.Namespace) -> int:
     text, rc = doctor.run(gcs, window_s=args.window,
                           queue_warn=args.queue_warn,
                           queue_wait_warn_s=args.queue_wait_warn,
+                          serve_p99_warn_s=args.serve_p99_warn,
                           as_json=args.json)
     print(text, file=sys.stderr if rc == 2 else sys.stdout)
     return rc
@@ -761,6 +805,11 @@ def main(argv=None) -> int:
     for name in ("status", "shutdown"):
         ps = serve_sub.add_parser(name)
         ps.add_argument("--address", default=None)
+        if name == "status":
+            ps.add_argument("-v", "--verbose", action="store_true",
+                            help="include the autoscaler decision log")
+            ps.add_argument("--json", action="store_true",
+                            help="full detailed-status payload as JSON")
     p_serve.set_defaults(fn=cmd_serve)
 
     p_rl = sub.add_parser("rl", help="train / evaluate RL algorithms")
@@ -891,6 +940,9 @@ def main(argv=None) -> int:
     p_doc.add_argument("--queue-wait-warn", type=float, default=10.0,
                        help="per-scheduling-class queue-wait p99 (s) that "
                             "grades the class as starving")
+    p_doc.add_argument("--serve-p99-warn", type=float, default=5.0,
+                       help="serve request p99 (s) that grades a "
+                            "deployment as degraded")
     p_doc.add_argument("--json", action="store_true")
     p_doc.set_defaults(fn=cmd_doctor)
 

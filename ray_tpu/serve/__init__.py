@@ -32,6 +32,8 @@ from ray_tpu.serve.handle import (DeploymentHandle, DeploymentResponse,
                                   DeploymentResponseGenerator)
 from ray_tpu.serve.multiplex import get_multiplexed_model_id, multiplexed
 from ray_tpu.serve.grpc_proxy import grpc_request
+from ray_tpu.serve.obs import get_serve_request_id
+from ray_tpu.serve.api import detailed_status
 from ray_tpu.serve.proxy import ServeRequest
 
 __all__ = [
@@ -39,9 +41,11 @@ __all__ = [
     "Application", "AutoscalingConfig", "Deployment", "DeploymentConfig",
     "DeploymentHandle", "DeploymentResponse", "DeploymentResponseGenerator",
     "HTTPOptions", "ServeRequest",
-    "asgi_app", "batch", "delete", "deployment", "get_app_handle",
+    "asgi_app", "batch", "delete", "deployment", "detailed_status",
+    "get_app_handle",
     "ingress",
     "get_deployment_handle", "get_multiplexed_model_id", "grpc_request",
+    "get_serve_request_id",
     "http_port", "multiplexed", "run", "shutdown", "start", "start_grpc",
     "status",
 ]

@@ -244,6 +244,14 @@ def status() -> Dict[str, Any]:
     return ray_tpu.get(controller.list_applications.remote())
 
 
+def detailed_status(decision_limit: int = 50) -> Dict[str, Any]:
+    """Applications + per-deployment windowed stats (p50/p99/QPS/queue
+    depth) + the autoscaler decision-log tail — what `rt serve status
+    --verbose` and the dashboard Serve tab render."""
+    controller = _get_controller()
+    return ray_tpu.get(controller.serve_status.remote(decision_limit))
+
+
 def delete(name: str) -> None:
     controller = _get_controller()
     ray_tpu.get(controller.delete_application.remote(name))

@@ -58,6 +58,17 @@ class _Batcher:
             items = [b[0] for b in batch]
             futs = [b[1] for b in batch]
             try:
+                from ray_tpu.serve import obs
+
+                # batch-formation telemetry: fused size and occupancy of
+                # the configured max — THE continuous-batching yardstick
+                tags = {"fn": getattr(self._wrapper, "__name__", "batch")}
+                obs.batch_size_hist().observe(len(batch), tags=tags)
+                obs.batch_occupancy_hist().observe(
+                    len(batch) / max(1, max_size), tags=tags)
+            except Exception:  # noqa: BLE001 — telemetry must not
+                pass  # fail the batch
+            try:
                 results = await self._fn(items)
                 if results is None or len(results) != len(items):
                     raise ValueError(

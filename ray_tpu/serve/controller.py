@@ -27,6 +27,7 @@ from __future__ import annotations
 import threading
 import time
 import traceback
+from collections import deque
 from typing import Any, Dict, List, Optional, Tuple
 
 import ray_tpu
@@ -35,6 +36,18 @@ from ray_tpu.serve.replica import ReplicaActor
 
 CONTROLLER_NAME = "RT_SERVE_CONTROLLER"
 RECONCILE_PERIOD_S = 0.25
+_METRICS_WINDOW_CAP = 512   # samples per deployment (one per reconcile tick)
+_DECISION_LOG_CAP = 256
+_STATUS_KV_KEY = "@serve/status"
+_STATUS_PUSH_PERIOD_S = 1.0
+_STATS_POLL_PERIOD_S = 1.0
+
+
+def _percentile(sorted_vals: List[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, int(q * (len(sorted_vals) - 1) + 0.5))
+    return sorted_vals[idx]
 
 
 class _ReplicaInfo:
@@ -61,35 +74,67 @@ class _DeploymentState:
         # it already knows.
         self.version = 0
         self.next_replica_idx = 0
-        # autoscaling bookkeeping
-        self.metrics: List[Tuple[float, int]] = []  # (t, total_ongoing)
+        # autoscaling bookkeeping: a bounded ring pruned in place — the
+        # old list rebuild ran on every poll AND every target_replicas
+        # call. Sized to cover the configured look-back at the per-tick
+        # sample rate, or a long look_back_period_s would silently
+        # average over a truncated window.
+        cap = _METRICS_WINDOW_CAP
+        ac = self.config.autoscaling_config
+        if ac is not None:
+            cap = max(cap, int(ac.look_back_period_s
+                               / RECONCILE_PERIOD_S) + 16)
+        self.metrics: "deque[Tuple[float, float]]" = deque(
+            maxlen=cap)  # (t, total_ongoing)
         self.wake_requested_at: Optional[float] = None
         self.scale_candidate: Optional[int] = None
         self.scale_candidate_since: float = 0.0
         self.last_target: int = 0
         self.starting: Dict[str, Any] = {}  # replica_id -> (handle, ready ref)
+        # windowed request stats from the last replica poll (the numbers
+        # the decision log records and `rt serve status` prints)
+        self.win_stats: Dict[str, Any] = {}
+        self.last_stats_poll: float = 0.0
+        # why the last target_replicas() returned what it did
+        self.last_trigger: Dict[str, Any] = {}
 
     @property
     def autoscaling(self) -> Optional[AutoscalingConfig]:
         return self.config.autoscaling_config
+
+    def _prune_metrics(self, now: float, keep_s: float) -> None:
+        while self.metrics and now - self.metrics[0][0] > keep_s:
+            self.metrics.popleft()
 
     def target_replicas(self, now: float) -> int:
         """Fixed num_replicas, or the autoscaler's desired count
         (reference ``calculate_desired_num_replicas``)."""
         ac = self.autoscaling
         if ac is None:
+            self.last_trigger = {"reason": "fixed",
+                                 "num_replicas": self.config.num_replicas}
             return self.config.num_replicas
         current = len(self.replicas) + len(self.starting)
-        window = [m for m in self.metrics
-                  if now - m[0] <= ac.look_back_period_s]
-        total_ongoing = (sum(m[1] for m in window) / len(window)
-                         if window else 0.0)
+        self._prune_metrics(now, ac.look_back_period_s)
+        total_ongoing = (sum(m[1] for m in self.metrics) / len(self.metrics)
+                         if self.metrics else 0.0)
         desired = int(-(-total_ongoing // ac.target_ongoing_requests))  # ceil
-        if (self.wake_requested_at is not None
-                and now - self.wake_requested_at < 30.0):
+        woke = (self.wake_requested_at is not None
+                and now - self.wake_requested_at < 30.0)
+        if woke:
             # cold-start demand: guarantee capacity even before metrics move
             desired = max(desired, 1)
         desired = max(ac.min_replicas, min(ac.max_replicas, desired))
+        self.last_trigger = {
+            "reason": "wake" if (woke and total_ongoing == 0) else "ongoing",
+            "ongoing_avg": round(total_ongoing, 3),
+            "target_ongoing_requests": ac.target_ongoing_requests,
+            "look_back_period_s": ac.look_back_period_s,
+            "queue_depth": self.win_stats.get("queue_depth", 0),
+            "p50_s": self.win_stats.get("p50_s", 0.0),
+            "p99_s": self.win_stats.get("p99_s", 0.0),
+            "qps": self.win_stats.get("qps", 0.0),
+        }
         if desired == current:
             self.scale_candidate = None
             return current
@@ -99,6 +144,10 @@ class _DeploymentState:
             self.scale_candidate_since = now
         delay = (ac.upscale_delay_s if desired > current
                  else ac.downscale_delay_s)
+        self.last_trigger["hysteresis"] = {
+            "candidate": desired, "held_s": round(
+                now - self.scale_candidate_since, 3),
+            "delay_s": delay}
         if now - self.scale_candidate_since >= delay:
             return desired
         return current
@@ -118,6 +167,11 @@ class ServeController:
         self._grpc_port = None
         self._proxy_port: Optional[int] = None
         self._shutdown = False
+        # autoscaler decision log: every applied target change, with the
+        # metric values that produced it (bounded; `rt serve status
+        # --verbose`, /api/serve and the timeline serve lane read it)
+        self._decisions: "deque" = deque(maxlen=_DECISION_LOG_CAP)
+        self._last_status_push = 0.0
         self._reconciler = threading.Thread(
             target=self._reconcile_loop, daemon=True, name="rt-serve-rec")
         self._reconciler.start()
@@ -232,7 +286,6 @@ class ServeController:
     def list_applications(self) -> Dict[str, Any]:
         with self._lock:
             out = {}
-            now = time.time()
             for app, meta in self._apps.items():
                 deps = {}
                 for (a, name), s in self._deployments.items():
@@ -243,10 +296,30 @@ class ServeController:
                         "starting": len(s.starting),
                         "target": s.last_target,
                         "autoscaling": s.autoscaling is not None,
+                        "stats": dict(s.win_stats),
                     }
                 out[app] = {"route_prefix": meta["route_prefix"],
                             "ingress": meta["ingress"], "deployments": deps}
             return out
+
+    def get_decisions(self, limit: int = 50) -> List[Dict[str, Any]]:
+        """Tail of the autoscaler decision log, oldest first."""
+        with self._lock:
+            return list(self._decisions)[-limit:]
+
+    def serve_status(self, decision_limit: int = 50) -> Dict[str, Any]:
+        """Everything `rt serve status` / the dashboard Serve tab renders:
+        applications with per-deployment windowed stats, plus the
+        decision-log tail."""
+        return {"applications": self.list_applications(),
+                "decisions": self.get_decisions(decision_limit),
+                "t": time.time()}
+
+    def flush_metrics(self) -> None:
+        """Push the controller's metric registry to the KV now (tests)."""
+        from ray_tpu.util import metrics
+
+        metrics.flush_now()
 
     def get_ingress(self, app_name: str):
         """Ingress deployment name of one application (gRPC proxy lookup)."""
@@ -297,16 +370,85 @@ class ServeController:
         for s in states:
             self._adopt_started(s)
             self._poll_metrics(s, now)
+            rec = None
             with self._lock:
+                old_target = s.last_target
                 target = s.target_replicas(now)
                 s.last_target = target
                 current = len(s.replicas) + len(s.starting)
+                if target != old_target:
+                    rec = self._record_decision(s, old_target, target, now)
                 if current < target:
                     for _ in range(target - current):
                         self._start_replica(s)
                 elif current > target:
                     self._remove_replicas(s, current - target)
+            if rec is not None:
+                # best-effort mirror into the GCS serve-event feed (the
+                # timeline serve lane joins decisions against the request
+                # spans) — a blocking RPC, so OUTSIDE the lock that
+                # routers' long-polls contend on
+                try:
+                    backend = ray_tpu.global_worker()._require_backend()
+                    if hasattr(backend, "_gcs"):
+                        backend.io.run(
+                            backend._gcs.call("serve_event", dict(rec)))
+                except Exception:  # noqa: BLE001
+                    pass
             self._health_check(s, now)
+        self._push_status_snapshot(now)
+
+    def _record_decision(self, s: _DeploymentState, old_target: int,
+                         new_target: int, now: float) -> Dict[str, Any]:
+        """Stamp one scaling decision (caller holds the lock): old->new
+        target, the triggering metric values, and the hysteresis state —
+        so "why did it scale?" is answerable after the fact."""
+        direction = ("deploy" if old_target == 0 and s.next_replica_idx == 0
+                     else "up" if new_target > old_target else "down")
+        rec = {"t": now, "kind": "autoscale_decision",
+               "app": s.app_name, "deployment": s.name,
+               "old_target": old_target, "new_target": new_target,
+               "direction": direction,
+               "trigger": dict(s.last_trigger),
+               "replicas": len(s.replicas), "starting": len(s.starting)}
+        self._decisions.append(rec)
+        try:
+            from ray_tpu.serve import obs
+
+            obs.autoscale_decisions_total().inc(tags={
+                "app": s.app_name, "deployment": s.name,
+                "direction": direction})
+        except Exception:  # noqa: BLE001 — telemetry best-effort
+            pass
+        return rec
+
+    def _push_status_snapshot(self, now: float) -> None:
+        """Throttled compact status snapshot into the GCS KV, so `rt
+        doctor` can grade serve health without attaching a driver."""
+        if now - self._last_status_push < _STATUS_PUSH_PERIOD_S:
+            return
+        self._last_status_push = now
+        try:
+            import json
+
+            backend = ray_tpu.global_worker()._require_backend()
+            if not hasattr(backend, "kv_put"):
+                return
+            with self._lock:
+                deployments = [
+                    {"app": s.app_name, "name": s.name,
+                     "replicas": len(s.replicas),
+                     "starting": len(s.starting),
+                     "target": s.last_target,
+                     "autoscaling": s.autoscaling is not None,
+                     **{k: s.win_stats.get(k, 0) for k in
+                        ("ongoing", "queue_depth", "p50_s", "p99_s",
+                         "qps")}}
+                    for s in self._deployments.values()]
+            backend.kv_put(_STATUS_KV_KEY, json.dumps(
+                {"t": now, "deployments": deployments}).encode())
+        except Exception:  # noqa: BLE001 — snapshot best-effort
+            pass
 
     def _start_replica(self, s: _DeploymentState) -> None:
         rid = f"{s.app_name}#{s.name}#{s.next_replica_idx}"
@@ -348,25 +490,78 @@ class ServeController:
                 self._bump_routing()
 
     def _poll_metrics(self, s: _DeploymentState, now: float) -> None:
-        if s.autoscaling is None:
-            return
+        """Windowed stats poll: every replica reports ongoing, executor
+        queue depth and its recent request latencies in ONE RPC; the merge
+        feeds the autoscaler, the decision log, the `rt_serve_ongoing` /
+        `rt_serve_queue_depth` gauges and `rt serve status`.
+
+        The stats poll re-ships up to 200 latency floats per replica, so
+        it runs at the 1 s status cadence (its consumers — snapshot,
+        gauges, decision log — are 1 s-grained), not per reconcile tick;
+        autoscaled deployments keep the cheap per-tick ``ongoing_count``
+        sample in between so the look-back average keeps its resolution."""
         with self._lock:
             reps = list(s.replicas.values())
-        total = 0
+        if now - s.last_stats_poll < _STATS_POLL_PERIOD_S:
+            if s.autoscaling is None:
+                return
+            total = 0
+            if reps:
+                refs = [r.handle.ongoing_count.remote() for r in reps]
+                ready, _ = ray_tpu.wait(refs, num_returns=len(refs),
+                                        timeout=2.0)
+                for r, ref in zip(reps, refs):
+                    if ref in ready:
+                        try:
+                            r.last_ongoing = ray_tpu.get(ref)
+                            total += r.last_ongoing
+                        except Exception:  # noqa: BLE001
+                            pass
+            with self._lock:
+                s.metrics.append((now, total))
+            return
+        s.last_stats_poll = now
+        total_ongoing = 0
+        total_queue = 0
+        completed = 0
+        qps = 0.0
+        window_s = 30.0
+        lats: List[float] = []
         if reps:
-            refs = [r.handle.ongoing_count.remote() for r in reps]
+            refs = [r.handle.stats_window.remote(window_s) for r in reps]
             ready, _ = ray_tpu.wait(refs, num_returns=len(refs), timeout=2.0)
             for r, ref in zip(reps, refs):
                 if ref in ready:
                     try:
-                        r.last_ongoing = ray_tpu.get(ref)
-                        total += r.last_ongoing
+                        st = ray_tpu.get(ref)
+                        r.last_ongoing = st.get("ongoing", 0)
+                        total_ongoing += r.last_ongoing
+                        total_queue += st.get("queue_depth", 0)
+                        completed += st.get("completed", 0)
+                        # per-replica effective window: a saturated latency
+                        # ring reports the shorter span it actually covers
+                        qps += (st.get("completed", 0)
+                                / max(1e-3, st.get("window_s", window_s)))
+                        lats.extend(st.get("latencies") or ())
                     except Exception:  # noqa: BLE001 — health check handles it
                         pass
+        lats.sort()
+        win = {"ongoing": total_ongoing, "queue_depth": total_queue,
+               "completed": completed, "window_s": window_s,
+               "qps": round(qps, 3),
+               "p50_s": round(_percentile(lats, 0.50), 6),
+               "p99_s": round(_percentile(lats, 0.99), 6)}
         with self._lock:
-            s.metrics.append((now, total))
-            s.metrics = [m for m in s.metrics
-                         if now - m[0] <= s.autoscaling.look_back_period_s]
+            s.win_stats = win
+            s.metrics.append((now, total_ongoing))
+        try:
+            from ray_tpu.serve import obs
+
+            tags = {"app": s.app_name, "deployment": s.name}
+            obs.ongoing_gauge().set(total_ongoing, tags=tags)
+            obs.queue_depth_gauge().set(total_queue, tags=tags)
+        except Exception:  # noqa: BLE001 — telemetry best-effort
+            pass
 
     def _health_check(self, s: _DeploymentState, now: float) -> None:
         with self._lock:
@@ -444,9 +639,27 @@ class ServeController:
         s.replicas.clear()
         s.version = self._next_version()
         self._bump_routing()
+        # stale-label removal: a deleted deployment's gauges must not
+        # linger on the Prometheus page forever
+        try:
+            from ray_tpu.serve import obs
+
+            tags = {"app": s.app_name, "deployment": s.name}
+            obs.ongoing_gauge().remove(tags=tags)
+            obs.queue_depth_gauge().remove(tags=tags)
+        except Exception:  # noqa: BLE001
+            pass
 
     def shutdown(self) -> None:
         self._shutdown = True
+        try:
+            # drop the status snapshot: doctor must not grade a dead
+            # serve instance's numbers (it also skips stale stamps)
+            backend = ray_tpu.global_worker()._require_backend()
+            if hasattr(backend, "kv_del"):
+                backend.kv_del(_STATUS_KV_KEY)
+        except Exception:  # noqa: BLE001
+            pass
         with self._update_cond:
             self._update_cond.notify_all()  # release blocked long-polls
         with self._lock:

@@ -12,11 +12,14 @@ the same picklable surface handle calls use internally. Clients use
 
 from __future__ import annotations
 
+import time
+from concurrent.futures import TimeoutError as _FuturesTimeout
 from typing import Any, Dict, Optional, Tuple
 
 import cloudpickle
 
 import ray_tpu
+from ray_tpu.serve import obs
 
 SERVICE = "rt.serve"
 
@@ -123,16 +126,62 @@ class GrpcProxyActor:
         handle = self._handles.get(target) or self._resolve_handle(target)
         args, kwargs = cloudpickle.loads(request_bytes) \
             if request_bytes else ((), {})
+        # gRPC is an ingress too: mint the request id / trace root here so
+        # `rt trace <request_id>` covers gRPC-originated requests as well
+        app, method = target
+        route = f"/{SERVICE}/{app}"
+        req_ctx = {"request_id": obs.mint_request_id(), "app": app,
+                   "deployment": handle.deployment_name, "route": route,
+                   "span_id": obs.new_span_id()}
+        t_epoch, t0 = time.time(), time.perf_counter()
+        code = "OK"
+        token = obs.activate_request(req_ctx)
         try:
-            result = handle.remote(*args, **kwargs).result(timeout=120)
-        except ActorError:
-            # Dead/redeployed ingress ONLY: re-resolve and retry once.
-            # Neither app exceptions (TaskError) nor timeouts retry — the
-            # first request may still be EXECUTING, and a retry would run
-            # user side effects twice.
-            self._handles.pop(target, None)
-            handle = self._resolve_handle(target)
-            result = handle.remote(*args, **kwargs).result(timeout=120)
+            try:
+                result = handle.remote(*args, **kwargs).result(timeout=120)
+            except ActorError:
+                # Dead/redeployed ingress ONLY: re-resolve and retry once.
+                # Neither app exceptions (TaskError) nor timeouts retry —
+                # the first request may still be EXECUTING, and a retry
+                # would run user side effects twice.
+                self._handles.pop(target, None)
+                handle = self._resolve_handle(target)
+                result = handle.remote(*args, **kwargs).result(timeout=120)
+        except _FuturesTimeout:
+            # the 120 s ingress budget fired with the handle call still
+            # in-flight (a wedged replica): nothing was counted yet —
+            # this is the one timeout this layer must record
+            # (py3.10: futures' timeout is NOT the builtin TimeoutError)
+            code = "DEADLINE_EXCEEDED"
+            obs.errors_total().inc(tags={
+                "app": app, "deployment": handle.deployment_name,
+                "kind": "rejected_timeout"})
+            raise
+        except TimeoutError:
+            # handle-layer deadline: _routed_call already counted
+            # rejected_timeout / replica_died for it
+            code = "DEADLINE_EXCEEDED"
+            raise
+        except Exception:
+            # kinds are counted once, at the handle layer (_routed_call
+            # stamps app_error / replica_died / rejected_timeout) — only
+            # the gRPC status code is this ingress's to record
+            code = "INTERNAL"
+            raise
+        finally:
+            obs.deactivate_request(token)
+            seconds = time.perf_counter() - t0
+            obs.request_seconds().observe(seconds, tags={
+                "app": app, "deployment": handle.deployment_name,
+                "route": route, "code": code})
+            obs.requests_total().inc(tags={"app": app, "code": code})
+            obs.emit_span(
+                f"serve:{req_ctx['request_id']}:g:{req_ctx['span_id'][:8]}",
+                f"grpc:{app}.{method}",
+                request_id=req_ctx["request_id"],
+                span_id=req_ctx["span_id"], parent_span_id=None,
+                t_start=t_epoch, t_end=t_epoch + seconds,
+                phases={"handle": seconds})
         return cloudpickle.dumps(result)
 
     async def shutdown(self) -> None:

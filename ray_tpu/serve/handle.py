@@ -23,6 +23,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import ray_tpu
 from ray_tpu.exceptions import ActorError
+from ray_tpu.serve import obs
 from ray_tpu.serve.replica import REJECTED
 
 _REFRESH_TTL_S = 30.0   # fallback only — the long-poll thread pushes updates
@@ -332,14 +333,57 @@ class DeploymentHandle:
         return self.options(method_name=item)
 
     def remote(self, *args, **kwargs) -> DeploymentResponse:
-        fut = _shared_pool().submit(self._call_blocking, args, kwargs)
+        # the pool thread does not inherit contextvars: capture the ambient
+        # request context HERE (proxy / enclosing replica), or mint one —
+        # a direct handle call is an ingress too, so every request carries
+        # an id and a trace from its very first hop
+        ctx = obs.current_request_context()
+        if ctx is None:
+            ctx = {"request_id": obs.mint_request_id(),
+                   "app": self.app_name,
+                   "deployment": self.deployment_name,
+                   "route": "handle", "span_id": None}
+        fut = _shared_pool().submit(self._call_blocking, args, kwargs, ctx)
         return DeploymentResponse(fut)
 
-    def _call_blocking(self, args: Tuple, kwargs: Dict) -> Any:
+    def _call_blocking(self, args: Tuple, kwargs: Dict,
+                       req_ctx: Optional[Dict] = None) -> Any:
         router = self._router
         backoff = _RETRY_BACKOFF_S
-        deadline = time.time() + _COLD_START_TIMEOUT_S
-        meta = {"model_id": self._model_id} if self._model_id else None
+        t_entry, t0 = time.time(), time.perf_counter()
+        deadline = t_entry + _COLD_START_TIMEOUT_S
+        meta: Dict[str, Any] = {}
+        if self._model_id:
+            meta["model_id"] = self._model_id
+        span_id = obs.new_span_id()
+        if req_ctx is not None:
+            meta["request"] = {"request_id": req_ctx["request_id"],
+                               "app": req_ctx.get("app", self.app_name),
+                               "route": req_ctx.get("route", "handle"),
+                               "span_id": span_id}
+        return self._routed_call(router, args, kwargs, meta or None,
+                                 req_ctx, span_id, t_entry, t0,
+                                 backoff, deadline)
+
+    def _routed_call(self, router, args, kwargs, meta, req_ctx, span_id,
+                     t_entry, t0, backoff, deadline) -> Any:
+        def emit(t_rpc0: Optional[float], streamed: bool = False) -> None:
+            if req_ctx is None:
+                return
+            t_end = time.perf_counter()
+            phases = {"route": (t_rpc0 if t_rpc0 is not None else t_end)
+                      - t0}
+            if t_rpc0 is not None:
+                phases["call" if not streamed else "call_stream"] = \
+                    t_end - t_rpc0
+            obs.emit_span(
+                f"serve:{req_ctx['request_id']}:h:{span_id[:8]}",
+                f"route:{self.app_name}/{self.deployment_name}",
+                request_id=req_ctx["request_id"], span_id=span_id,
+                parent_span_id=req_ctx.get("span_id"),
+                t_start=t_entry, t_end=t_entry + (t_end - t0),
+                phases=phases)
+
         while True:
             router.refresh()
             if not router.replicas:
@@ -348,15 +392,33 @@ class DeploymentHandle:
                 rid, actor = router.pick(self._model_id or None)
             except LookupError:
                 continue
+            t_rpc0 = time.perf_counter()
             try:
-                reply = ray_tpu.get(actor.handle_request.remote(
-                    self._method, args, kwargs, meta))
+                # activate ONLY around the replica call: the routed actor
+                # call becomes a child span of this handle span (trace id
+                # == request id) while the router's own control-plane RPCs
+                # (get_replicas refresh, wake) stay out of the request
+                # trace
+                token = obs.activate_request(
+                    dict(req_ctx, span_id=span_id)) \
+                    if req_ctx is not None else None
+                try:
+                    ref = actor.handle_request.remote(
+                        self._method, args, kwargs, meta)
+                finally:
+                    obs.deactivate_request(token)
+                reply = ray_tpu.get(ref)
             except ActorError:
                 # stale cache: drop this replica and re-route (with the same
                 # backoff/deadline as rejection — a dead replica stays in the
                 # cache until the controller's health check evicts it)
                 router.complete(rid)
+                obs.errors_total().inc(tags={
+                    "app": self.app_name,
+                    "deployment": self.deployment_name,
+                    "kind": "replica_died"})
                 if time.time() > deadline:
+                    emit(None)
                     raise TimeoutError(
                         f"{self.app_name}/{self.deployment_name}: replicas "
                         f"kept failing") from None
@@ -364,11 +426,29 @@ class DeploymentHandle:
                 backoff = min(backoff * 1.5, 0.25)
                 router.refresh(force=True)
                 continue
+            except Exception:
+                # user code raised (TaskError re-raised at get): the pick()
+                # slot must not stay in-flight forever — phantom load would
+                # make power-of-two routing shun whichever replica happened
+                # to serve the failing inputs — and the failed request
+                # still gets its route span and error count
+                router.complete(rid)
+                obs.errors_total().inc(tags={
+                    "app": self.app_name,
+                    "deployment": self.deployment_name,
+                    "kind": "app_error"})
+                emit(t_rpc0)
+                raise
             status, payload = reply[0], reply[1]
             models = reply[2] if len(reply) > 2 else None
             if status == REJECTED:
                 router.complete(rid, rejected_ongoing=payload)
                 if time.time() > deadline:
+                    obs.errors_total().inc(tags={
+                        "app": self.app_name,
+                        "deployment": self.deployment_name,
+                        "kind": "rejected_timeout"})
+                    emit(None)
                     raise TimeoutError(
                         f"{self.app_name}/{self.deployment_name}: all "
                         f"replicas at max_ongoing_requests")
@@ -379,8 +459,10 @@ class DeploymentHandle:
             if status == "stream":
                 # the generator keeps the in-flight slot until it completes
                 router.note_models(rid, models)
+                emit(t_rpc0, streamed=True)
                 return DeploymentResponseGenerator(router, rid, actor, payload)
             router.complete(rid, model_ids=models)
+            emit(t_rpc0)
             return payload
 
     def __reduce__(self):

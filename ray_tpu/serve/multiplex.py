@@ -59,16 +59,30 @@ def multiplexed(func: Optional[Callable] = None, *,
                     except Exception:  # noqa: BLE001 — eviction best-effort
                         pass
 
+        def _count(model_id: str, outcome: str) -> None:
+            # model id as a metric label: per-model traffic + cache
+            # hit/load split for the replica-pool LRU
+            try:
+                from ray_tpu.serve import obs
+
+                obs.mux_requests_total().inc(tags={
+                    "model_id": model_id or "_default",
+                    "outcome": outcome})
+            except Exception:  # noqa: BLE001 — telemetry best-effort
+                pass
+
         if is_async:
             async def wrapper(self, model_id: Optional[str] = None):
                 model_id = model_id or get_multiplexed_model_id()
                 cache = _cache(self)
                 if model_id in cache:
                     cache.move_to_end(model_id)
+                    _count(model_id, "hit")
                     return cache[model_id]
                 model = await fn(self, model_id)
                 cache[model_id] = model
                 _evict(cache)
+                _count(model_id, "load")
                 return model
         else:
             def wrapper(self, model_id: Optional[str] = None):
@@ -76,10 +90,12 @@ def multiplexed(func: Optional[Callable] = None, *,
                 cache = _cache(self)
                 if model_id in cache:
                     cache.move_to_end(model_id)
+                    _count(model_id, "hit")
                     return cache[model_id]
                 model = fn(self, model_id)
                 cache[model_id] = model
                 _evict(cache)
+                _count(model_id, "load")
                 return model
 
         wrapper.__name__ = getattr(fn, "__name__", "get_model")

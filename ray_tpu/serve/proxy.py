@@ -16,10 +16,12 @@ from __future__ import annotations
 
 import asyncio
 import json as _json
+import os
 import time
 from typing import Any, Dict, Optional, Tuple
 
 import ray_tpu
+from ray_tpu.serve import obs
 
 _ROUTE_TTL_S = 1.0
 
@@ -100,6 +102,13 @@ class ProxyActor:
         self._requests_served = 0
         self._poller_started = False
         self._stopped = False
+        # healthz honesty: a load balancer must see a proxy whose route
+        # table went stale (controller unreachable) as unhealthy
+        self._started_at = time.time()
+        self._last_route_ok = 0.0   # last successful routing-table fetch
+        self._poll_ok = True        # did the last fetch attempt succeed?
+        self._route_stale_s = float(
+            os.environ.get("RT_SERVE_ROUTE_STALE_S", "30"))
 
     async def start(self, host: str, port: int) -> int:
         from aiohttp import web
@@ -142,20 +151,24 @@ class ProxyActor:
         self._routes = table["routes"]
         self._routes_version = table["version"]
         self._routes_fetched = time.time()
+        self._last_route_ok = self._routes_fetched
+        self._poll_ok = True
 
     def _route_poll_loop(self) -> None:
         while not self._stopped:
             try:
                 self._apply_routes(self._fetch_routes_blocking(True))
             except Exception:
+                self._poll_ok = False
                 time.sleep(1.0)
 
     def _fetch_routes_blocking(self, wait: bool) -> Dict[str, Any]:
         return ray_tpu.get(self._controller().get_routing_table.remote(
             self._routes_version if wait else -1, wait, 10.0))
 
-    def _match(self, path: str) -> Optional[Tuple[str, str, str]]:
-        """Longest-prefix route match -> (app, ingress, stripped_path)."""
+    def _match(self, path: str) -> Optional[Tuple[str, str, str, str]]:
+        """Longest-prefix route match ->
+        (app, ingress, stripped_path, route_prefix)."""
         best = None
         for prefix, (app, ingress) in self._routes.items():
             norm = prefix.rstrip("/") or ""
@@ -165,23 +178,77 @@ class ProxyActor:
         if best is None:
             return None
         stripped = path[len(best[0]):] or "/"
-        return best[1], best[2], stripped
+        return best[1], best[2], stripped, best[0] or "/"
+
+    async def _healthz(self, request):
+        """Honest health: include route-table age and controller
+        reachability; 503 past the staleness threshold so a load balancer
+        drains a proxy whose controller went away. ``?verbose=1`` returns
+        the JSON body on 200 too; ``?stale_after=`` overrides the
+        threshold (tests / per-LB tuning)."""
+        from aiohttp import web
+
+        # probe on demand: an idle proxy must not go stale merely because
+        # no request has started the poller yet
+        if self._last_route_ok == 0.0:
+            try:
+                await self._refresh_routes()
+            except Exception:  # noqa: BLE001 — controller unreachable
+                self._poll_ok = False
+        now = time.time()
+        age = now - (self._last_route_ok or self._started_at)
+        try:
+            stale_after = float(request.rel_url.query.get(
+                "stale_after", self._route_stale_s))
+        except (TypeError, ValueError):
+            stale_after = self._route_stale_s
+        degraded = age > stale_after
+        payload = {"status": "degraded" if degraded else "ok",
+                   "route_table_age_s": round(age, 3),
+                   "stale_after_s": stale_after,
+                   "controller_reachable": self._poll_ok,
+                   "routes_version": self._routes_version}
+        if degraded:
+            return web.json_response(payload, status=503)
+        if request.rel_url.query.get("verbose"):
+            return web.json_response(payload)
+        return web.Response(text="ok")
+
+    def _observe_request(self, app: str, deployment: str, route: str,
+                         code: int, seconds: float) -> None:
+        obs.request_seconds().observe(seconds, tags={
+            "app": app, "deployment": deployment, "route": route,
+            "code": str(code)})
+        obs.requests_total().inc(tags={"app": app, "code": str(code)})
+        if code >= 500:
+            obs.errors_total().inc(tags={
+                "app": app, "deployment": deployment, "kind": "http_5xx"})
 
     async def _handle(self, request):
         from aiohttp import web
 
         path = "/" + request.match_info["tail"]
         if path == "/-/healthz":
-            return web.Response(text="ok")
+            return await self._healthz(request)
         if path == "/-/routes":
             await self._refresh_routes()
             return web.json_response(
                 {p: f"{a}:{i}" for p, (a, i) in self._routes.items()})
+        t_epoch, t0 = time.time(), time.perf_counter()
+        # ingress: mint (or adopt a well-formed upstream's) request id — it
+        # is the TRACE id every downstream hop joins
+        upstream_rid = request.headers.get(obs.REQUEST_ID_HEADER, "")
+        request_id = (upstream_rid if obs.valid_request_id(upstream_rid)
+                      else obs.mint_request_id())
+        rid_hdr = {obs.REQUEST_ID_HEADER: request_id}
         await self._refresh_routes()
         m = self._match(path)
         if m is None:
-            return web.Response(status=404, text=f"no app at {path}")
-        app_name, ingress, stripped = m
+            self._observe_request("", "", "_unmatched", 404,
+                                  time.perf_counter() - t0)
+            return web.Response(status=404, text=f"no app at {path}",
+                                headers=rid_hdr)
+        app_name, ingress, stripped, route = m
         key = (app_name, ingress)
         handle = self._handles.get(key)
         if handle is None:
@@ -189,40 +256,107 @@ class ProxyActor:
 
             handle = DeploymentHandle(app_name, ingress)
             self._handles[key] = handle
+        req_ctx = {"request_id": request_id, "app": app_name,
+                   "deployment": ingress, "route": route,
+                   "span_id": obs.new_span_id()}
         if (request.headers.get("Upgrade", "").lower() == "websocket"
                 and request.method == "GET"):
-            return await self._handle_websocket(request, handle, stripped)
+            # websockets are ingress traffic too: count the connection and
+            # give the trace its root span (101 = a completed WS session;
+            # error paths return plain responses with their own codes)
+            try:
+                resp = await self._handle_websocket(request, handle,
+                                                    stripped, req_ctx)
+                ws_code = getattr(resp, "status", 200)
+            except Exception:
+                ws_code = 500
+                raise
+            finally:
+                t_end = time.perf_counter()
+                self._observe_request(app_name, ingress, route, ws_code,
+                                      t_end - t0)
+                obs.emit_span(
+                    f"serve:{request_id}:p:{req_ctx['span_id'][:8]}",
+                    f"proxy:WS {route}",
+                    request_id=request_id, span_id=req_ctx["span_id"],
+                    parent_span_id=None, t_start=t_epoch,
+                    t_end=t_epoch + (t_end - t0),
+                    phases={"stream": t_end - t0})
+            try:
+                resp.headers.setdefault(obs.REQUEST_ID_HEADER, request_id)
+            except Exception:  # noqa: BLE001 — headers already sent
+                pass
+            return resp
         sreq = ServeRequest(
             method=request.method, path=stripped,
             query=dict(request.rel_url.query),
             headers=dict(request.headers), body=await request.read(),
             raw_query=request.rel_url.raw_query_string,
             raw_headers=[(k, v) for k, v in request.headers.items()])
+        t_route = time.perf_counter()
+
+        def finish(code: int, t_handle: float,
+                   extra_phases: Optional[Dict[str, float]] = None) -> None:
+            t_end = time.perf_counter()
+            phases = {"proxy_route": t_route - t0,
+                      "handle": t_handle - t_route}
+            phases.update(extra_phases or
+                          {"respond": t_end - t_handle})
+            self._observe_request(app_name, ingress, route, code,
+                                  t_end - t0)
+            obs.emit_span(
+                # unique store key per ATTEMPT: a client retrying with the
+                # same adopted request id must not clobber the first
+                # attempt's proxy span (rt trace joins on trace_id)
+                f"serve:{request_id}:p:{req_ctx['span_id'][:8]}",
+                f"proxy:{request.method} {route}",
+                request_id=request_id, span_id=req_ctx["span_id"],
+                parent_span_id=None, t_start=t_epoch,
+                t_end=t_epoch + (t_end - t0), phases=phases)
+
+        # activate while SUBMITTING: handle.remote captures the ambient
+        # request context synchronously; the await happens outside it
+        token = obs.activate_request(req_ctx)
         try:
-            result = await handle.remote(sreq)
+            pending = handle.remote(sreq)
+        finally:
+            obs.deactivate_request(token)
+        try:
+            result = await pending
         except TimeoutError as e:
-            return web.Response(status=503, text=f"overloaded: {e}")
+            finish(503, time.perf_counter())
+            return web.Response(status=503, text=f"overloaded: {e}",
+                                headers=rid_hdr)
         except Exception as e:  # noqa: BLE001 — user code raised
-            return web.Response(status=500, text=f"{type(e).__name__}: {e}")
+            finish(500, time.perf_counter())
+            return web.Response(status=500, text=f"{type(e).__name__}: {e}",
+                                headers=rid_hdr)
+        t_handle = time.perf_counter()
         self._requests_served += 1
         from ray_tpu.serve.asgi import ASGIResponse
         from ray_tpu.serve.handle import DeploymentResponseGenerator
 
         if isinstance(result, DeploymentResponseGenerator):
-            return await self._stream_response(request, result)
+            return await self._stream_response(
+                request, result, req_ctx=req_ctx, t0=t0,
+                t_handle=t_handle, finish=finish)
         if isinstance(result, ASGIResponse):
             # ASGI deployments control the full response surface; a
             # multidict preserves duplicate headers (Set-Cookie x2)
             from multidict import CIMultiDict
 
-            return web.Response(status=result.status,
-                                headers=CIMultiDict(result.headers),
+            headers = CIMultiDict(result.headers)
+            headers.setdefault(obs.REQUEST_ID_HEADER, request_id)
+            finish(result.status, t_handle)
+            return web.Response(status=result.status, headers=headers,
                                 body=result.body)
         status, ctype, payload = _to_response(result)
+        finish(status, t_handle)
         return web.Response(status=status, content_type=ctype.split(";")[0],
-                            body=payload)
+                            body=payload, headers=rid_hdr)
 
-    async def _handle_websocket(self, request, handle, stripped: str):
+    async def _handle_websocket(self, request, handle, stripped: str,
+                                req_ctx: Optional[Dict[str, str]] = None):
         """Bridge an aiohttp websocket to an ASGI deployment (reference:
         the uvicorn proxy's native WS path, ``serve/_private/http_proxy.py``).
 
@@ -246,9 +380,14 @@ class ProxyActor:
             headers=dict(request.headers), body=b"",
             raw_query=request.rel_url.raw_query_string,
             raw_headers=[(k, v) for k, v in request.headers.items()])
+        token = obs.activate_request(req_ctx)
         try:
-            gen = await handle.options(
+            pending = handle.options(
                 method_name="__ws_connect__").remote(sreq, conn_id)
+        finally:
+            obs.deactivate_request(token)
+        try:
+            gen = await pending
         except TimeoutError as e:
             return web.Response(status=503, text=f"overloaded: {e}")
         except Exception as e:  # noqa: BLE001
@@ -334,36 +473,61 @@ class ProxyActor:
                 pass
         return ws
 
-    async def _stream_response(self, request, gen):
+    async def _stream_response(self, request, gen, req_ctx=None, t0=None,
+                               t_handle=None, finish=None):
         """Chunked transfer of a streaming deployment response (reference:
         ``serve/_private/replica.py:346`` streamed ASGI messages). str/bytes
         chunks pass through; other values are JSON-encoded, one per line.
         An ASGI deployment's stream leads with ``ASGIResponseStart``, which
-        sets the response status/headers before the first body byte."""
+        sets the response status/headers before the first body byte.
+
+        Token-streaming telemetry (the series continuous batching and
+        spec-decode are judged against): TTFT is request receipt to the
+        first body chunk, every inter-chunk gap lands in the TPOT
+        histogram, and chunks count into ``rt_serve_tokens_total``."""
         from aiohttp import web
 
         from multidict import CIMultiDict
 
         from ray_tpu.serve.asgi import ASGIResponseStart
 
+        tok_tags = ({"app": req_ctx["app"],
+                     "deployment": req_ctx["deployment"]}
+                    if req_ctx else None)
         it = gen.__aiter__()
         status = 200
         headers = CIMultiDict({"Content-Type": "application/octet-stream"})
+        if req_ctx:
+            headers.setdefault(obs.REQUEST_ID_HEADER, req_ctx["request_id"])
         _NO_CHUNK = object()  # a literal None chunk is a valid stream item
         pending_first = _NO_CHUNK
         try:
             first = await it.__anext__()
             if isinstance(first, ASGIResponseStart):
                 status, headers = first.status, CIMultiDict(first.headers)
+                if req_ctx:
+                    headers.setdefault(obs.REQUEST_ID_HEADER,
+                                       req_ctx["request_id"])
             else:
                 pending_first = first
         except StopAsyncIteration:
             pass
         except Exception:  # noqa: BLE001 — failed before first chunk
             gen.cancel()
+            if finish is not None:
+                finish(500, time.perf_counter())
             return web.Response(status=500, text="stream failed")
         resp = web.StreamResponse(status=status, headers=headers)
-        await resp.prepare(request)
+        try:
+            await resp.prepare(request)
+        except Exception:
+            # client gone before the first byte: release the replica
+            # stream and the router's in-flight slot, and account the
+            # aborted request (499: client closed) before propagating
+            gen.cancel()
+            if finish is not None:
+                finish(499, time.perf_counter())
+            raise
 
         def encode(chunk):
             if isinstance(chunk, str):
@@ -372,16 +536,52 @@ class ProxyActor:
                 return _json.dumps(chunk, default=_np_default).encode() + b"\n"
             return chunk
 
+        n_chunks = 0
+        t_prev: Optional[float] = None
+
+        def note_chunk() -> None:
+            nonlocal n_chunks, t_prev
+            now = time.perf_counter()
+            if tok_tags is not None:
+                if n_chunks == 0 and t0 is not None:
+                    obs.ttft_seconds().observe(now - t0, tags=tok_tags)
+                elif t_prev is not None:
+                    obs.inter_token_seconds().observe(now - t_prev,
+                                                      tags=tok_tags)
+                obs.tokens_total().inc(tags=tok_tags)
+            n_chunks += 1
+            t_prev = now
+
         try:
             if pending_first is not _NO_CHUNK:
                 await resp.write(encode(pending_first))
+                note_chunk()
             async for chunk in it:
                 await resp.write(encode(chunk))
+                note_chunk()
         except Exception:  # noqa: BLE001 — mid-stream failure: cut the body
             gen.cancel()
         finally:
-            await resp.write_eof()
+            try:
+                await resp.write_eof()
+            except Exception:  # noqa: BLE001 — client gone mid-stream;
+                pass           # the aborted stream still gets accounted
+            if finish is not None:
+                t_end = time.perf_counter()
+                finish(status, t_handle if t_handle is not None else t_end,
+                       {"stream": t_end - (t_handle or t_end)})
         return resp
 
+    def flush_metrics(self) -> None:
+        """Push this proxy's metric registry + buffered serve spans now
+        (tests/ops — the background pushers run on an interval)."""
+        from ray_tpu.util import metrics
+
+        obs.flush_spans()
+        metrics.flush_now()
+
     def stats(self) -> Dict[str, Any]:
-        return {"port": self._port, "requests_served": self._requests_served}
+        return {"port": self._port, "requests_served": self._requests_served,
+                "route_table_age_s": time.time() - (self._last_route_ok
+                                                    or self._started_at),
+                "controller_reachable": self._poll_ok}

@@ -144,6 +144,19 @@ class ReplicaActor:
         self._ongoing = 0
         self._total_served = 0
         self._started_at = time.time()
+        # request observability (serve/obs.py): admitted-but-not-executing
+        # count and a bounded window of completed-request latencies — the
+        # controller's stats_window poll aggregates these into the
+        # per-deployment p50/p99 + QPS the autoscaler and `rt serve
+        # status` report
+        self._executing = 0
+        import threading
+        from collections import deque
+
+        # executor threads and the event loop both move the counter — a
+        # drifted count would misreport queue depth forever
+        self._exec_lock = threading.Lock()
+        self._lat_window: "deque" = deque(maxlen=512)  # (t_end, wall_s)
         # sync user callables run here, NOT on the worker's event loop — a
         # blocking body (the common case: a jitted forward pass) must not
         # stall the RPC server or sibling requests
@@ -188,6 +201,7 @@ class ReplicaActor:
             import contextvars
             import functools
 
+            from ray_tpu.serve import obs
             from ray_tpu.serve.multiplex import (
                 _current_model_id,
                 loaded_model_ids,
@@ -200,19 +214,84 @@ class ReplicaActor:
                     raise AttributeError(
                         f"deployment {self._deployment} has no method "
                         f"{method_name!r}")
+            req = (meta or {}).get("request")
+            replica_span = obs.new_span_id() if req else ""
+            req_token = None
+            if req:
+                # nested handle calls made by the user callable must join
+                # THIS request's trace: make the context ambient before the
+                # contextvars copy below snapshots it
+                req_token = obs.activate_request({
+                    "request_id": req["request_id"],
+                    "app": req.get("app", self._app),
+                    "deployment": self._deployment,
+                    "route": req.get("route", ""),
+                    "span_id": replica_span})
             token = _current_model_id.set((meta or {}).get("model_id", ""))
             t_epoch, t0 = time.time(), time.perf_counter()
+            exec_mark = [t0]  # executor thread stamps user-code start
+            failed = False
             try:
                 # copy AFTER setting so the executor thread sees the model id
                 ctx = contextvars.copy_context()
                 loop = asyncio.get_running_loop()
+
+                def invoke():
+                    # queue-wait ends HERE: the request held an admission
+                    # slot but waited for an executor thread (and the
+                    # loop's handoff) before user code ran
+                    exec_mark[0] = time.perf_counter()
+                    with self._exec_lock:
+                        self._executing += 1
+                    try:
+                        return target(*args, **kwargs)
+                    finally:
+                        with self._exec_lock:
+                            self._executing -= 1
+
                 result = await loop.run_in_executor(
-                    self._exec,
-                    functools.partial(ctx.run, target, *args, **kwargs))
+                    self._exec, functools.partial(ctx.run, invoke))
                 if inspect.isawaitable(result):
-                    result = await result
+                    with self._exec_lock:
+                        self._executing += 1
+                    try:
+                        result = await result
+                    finally:
+                        with self._exec_lock:
+                            self._executing -= 1
+            except BaseException:
+                failed = True
+                raise
             finally:
                 _current_model_id.reset(token)
+                obs.deactivate_request(req_token)
+                # telemetry runs for FAILING requests too: a deployment
+                # erroring after a slow forward pass must still feed the
+                # latency window (p50/p99/QPS, doctor's p99 warn), the
+                # queue/execute histograms and its trace span
+                t1 = time.perf_counter()
+                if method_name not in ("__ws_push__",):
+                    queue_wait_s = max(0.0, exec_mark[0] - t0)
+                    execute_s = max(0.0, t1 - exec_mark[0])
+                    tags = {"app": self._app,
+                            "deployment": self._deployment}
+                    obs.queue_wait_seconds().observe(queue_wait_s,
+                                                     tags=tags)
+                    obs.execute_seconds().observe(execute_s, tags=tags)
+                    with self._exec_lock:  # stats_window reads off-loop
+                        self._lat_window.append((time.time(), t1 - t0))
+                    if req:
+                        obs.emit_span(
+                            f"serve:{req['request_id']}:x:"
+                            f"{replica_span[:8]}",
+                            f"replica:{self._deployment}.{method_name}",
+                            request_id=req["request_id"],
+                            span_id=replica_span,
+                            parent_span_id=req.get("span_id"),
+                            t_start=t_epoch, t_end=t_epoch + (t1 - t0),
+                            phases={"queue_wait": queue_wait_s,
+                                    "execute": execute_s},
+                            state="FAILED" if failed else "FINISHED")
             if step_profiler.is_enabled():
                 # serve is a profiler hot path too: per-request wall time
                 # (the user callable's execution — a returned stream's
@@ -303,6 +382,41 @@ class ReplicaActor:
     # -- controller-facing ----------------------------------------------------
     def ongoing_count(self) -> int:
         return self._ongoing
+
+    def stats_window(self, window_s: float = 30.0) -> Dict[str, Any]:
+        """Windowed request stats for the controller's autoscaler poll:
+        ongoing count, executor queue depth, and the recent completed-
+        request latencies (the controller merges replicas and computes the
+        per-deployment p50/p99 + QPS the decision log records)."""
+        now = time.time()
+        with self._exec_lock:  # the event loop appends concurrently
+            window = list(self._lat_window)
+            saturated = len(window) == self._lat_window.maxlen
+        lats = [w for t, w in window if now - t <= window_s]
+        # a saturated ring evicted completions that were still inside the
+        # nominal window: report the span the retained samples actually
+        # cover, or the controller's completed/window_s rate math caps at
+        # maxlen/window_s qps under exactly the heavy traffic this plane
+        # is for
+        eff_window_s = window_s
+        if saturated and window:
+            eff_window_s = min(window_s, max(1e-3, now - window[0][0]))
+        return {"replica_id": self._replica_id,
+                "ongoing": self._ongoing,
+                "queue_depth": max(0, self._ongoing - self._executing
+                                   - len(self._streams)),
+                "completed": len(lats),
+                "window_s": eff_window_s,
+                "latencies": lats[-200:]}
+
+    def flush_metrics(self) -> None:
+        """Push this replica's metric registry + buffered serve spans now
+        (tests/ops — the background pushers run on an interval)."""
+        from ray_tpu.serve import obs
+        from ray_tpu.util import metrics
+
+        obs.flush_spans()
+        metrics.flush_now()
 
     def stats(self) -> Dict[str, Any]:
         from ray_tpu.serve.multiplex import loaded_model_ids

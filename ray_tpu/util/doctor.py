@@ -103,10 +103,22 @@ async def _collect_async(gcs_address: str, window_s: float,
         except Exception:  # noqa: BLE001 — ledger plane optional
             pass
 
+        # serve plane: the controller pushes a compact status snapshot to
+        # the KV every reconcile tick (serve/controller.py) — readable
+        # here without attaching a driver
+        serve_status = None
+        try:
+            raw = (await gcs.call("kv_get", {"key": "@serve/status"},
+                                  timeout=10.0)).get("value")
+            if raw:
+                serve_status = json.loads(raw)
+        except Exception:  # noqa: BLE001 — serve plane optional
+            pass
+
         return {"t": time.time(), "gcs_address": gcs_address,
                 "window_s": window_s, "nodes": probed, "actors": actors,
                 "failures": failures, "oom_kills": ooms,
-                "ledgers": ledgers}
+                "ledgers": ledgers, "serve": serve_status}
     finally:
         try:
             await gcs.close()
@@ -130,7 +142,8 @@ def _recent(events: List[Dict], window_s: float,
 
 def diagnose(report: Dict[str, Any],
              queue_warn: int = 100,
-             queue_wait_warn_s: float = 10.0) -> List[Tuple[str, str]]:
+             queue_wait_warn_s: float = 10.0,
+             serve_p99_warn_s: float = 5.0) -> List[Tuple[str, str]]:
     """Turn the raw report into ranked ``(level, message)`` findings.
     Any CRITICAL finding makes the cluster unhealthy (exit 1)."""
     findings: List[Tuple[str, str]] = []
@@ -258,6 +271,29 @@ def diagnose(report: Dict[str, Any],
                              f"({store['spilled_bytes']} bytes) — gets pay "
                              f"restore IO"))
 
+    # -- serve plane (controller status snapshot) ----------------------------
+    serve = report.get("serve") or {}
+    # stale snapshots describe a controller that's gone — skip rather
+    # than grade yesterday's numbers
+    if serve and now - serve.get("t", 0.0) <= 30.0:
+        for d in serve.get("deployments") or ():
+            name = f"{d.get('app')}/{d.get('name')}"
+            replicas, target = d.get("replicas", 0), d.get("target", 0)
+            if replicas < target:
+                findings.append((WARN,
+                                 f"serve deployment {name} has "
+                                 f"{replicas}/{target} replicas "
+                                 f"({d.get('starting', 0)} starting — "
+                                 f"unhealthy or missing; see "
+                                 f"`rt serve status`)"))
+            p99 = d.get("p99_s") or 0.0
+            if p99 > serve_p99_warn_s and (d.get("qps") or 0) > 0:
+                findings.append((WARN,
+                                 f"serve deployment {name} request p99 "
+                                 f"{p99:.2f}s (> {serve_p99_warn_s:.1f}s "
+                                 f"at {d.get('qps')} qps — sustained "
+                                 f"latency degradation)"))
+
     # -- leak suspects (memory plane) ----------------------------------------
     try:
         from ray_tpu.util.memory import (_merge_owner_info,
@@ -316,7 +352,7 @@ def format_report(report: Dict[str, Any],
 
 
 def run(gcs_address: str, window_s: float = 600.0, queue_warn: int = 100,
-        queue_wait_warn_s: float = 10.0,
+        queue_wait_warn_s: float = 10.0, serve_p99_warn_s: float = 5.0,
         as_json: bool = False) -> Tuple[str, int]:
     """Collect + diagnose + render; returns (text, exit_code). Exit 2 when
     the GCS itself is unreachable."""
@@ -326,7 +362,8 @@ def run(gcs_address: str, window_s: float = 600.0, queue_warn: int = 100,
         return (f"rt doctor: cannot reach GCS at {gcs_address}: "
                 f"{type(e).__name__}: {e}", 2)
     findings = diagnose(report, queue_warn=queue_warn,
-                        queue_wait_warn_s=queue_wait_warn_s)
+                        queue_wait_warn_s=queue_wait_warn_s,
+                        serve_p99_warn_s=serve_p99_warn_s)
     if as_json:
         rc = exit_code(findings)
         payload = dict(report,

@@ -31,13 +31,15 @@ def timeline(filename: Optional[str] = None) -> List[Dict[str, Any]]:
     """Build (and optionally write) Chrome trace events for recent tasks."""
     backend = ray_tpu.global_worker()._require_backend()
     events = backend.io.run(backend._gcs.call(
-        "list_tasks", {"limit": 10000, "profile": "include"}))
+        "list_tasks", {"limit": 10000, "profile": "include",
+                       "serve": "include"}))
     trace: List[Dict[str, Any]] = []
     for ev in events:
         prof = ev.get("profile")
         if prof:
             trace.extend(_step_lanes(ev, prof))
             continue
+        is_serve = str(ev.get("task_id", "")).startswith("serve:")
         times = ev.get("times", {})
         start = times.get("RUNNING") or times.get("PENDING")
         end = times.get("FINISHED") or times.get("FAILED")
@@ -47,12 +49,14 @@ def timeline(filename: Optional[str] = None) -> List[Dict[str, Any]]:
             end = start  # still running: zero-length marker
         trace.append({
             "name": ev.get("name") or "task",
-            "cat": "task",
+            "cat": "serve" if is_serve else "task",
             "ph": "X",
             "ts": start * 1e6,
             "dur": max(0.0, (end - start) * 1e6),
             "pid": ev.get("node_id") or "node",
-            "tid": ev["task_id"][:8],
+            # serve request spans share one lane so the proxy/route/
+            # replica hops of all requests line up against task lanes
+            "tid": "serve" if is_serve else ev["task_id"][:8],
             "args": {"task_id": ev["task_id"], "state": ev.get("state")},
         })
         pend = times.get("PENDING")
@@ -69,6 +73,7 @@ def timeline(filename: Optional[str] = None) -> List[Dict[str, Any]]:
             trace.extend(_phase_lanes(ev))
     trace.extend(_memory_instants(backend))
     trace.extend(_failure_instants(backend))
+    trace.extend(_serve_decision_instants(backend))
     if filename:
         with open(filename, "w") as f:
             json.dump(trace, f)
@@ -129,6 +134,29 @@ def _failure_instants(backend) -> List[Dict[str, Any]]:
             "name": name, "cat": "error", "ph": "i", "s": "t",
             "ts": ev.get("t", 0.0) * 1e6,
             "pid": ev.get("node_id") or "node", "tid": "errors",
+            "args": {k: v for k, v in ev.items() if k != "t"},
+        })
+    return out
+
+
+def _serve_decision_instants(backend) -> List[Dict[str, Any]]:
+    """Autoscaler decision records as instant markers on the ``serve``
+    lane (GCS ``serve_decisions`` store — the same records behind
+    ``rt serve status --verbose``), so "why did it scale?" lines up
+    against the request spans that produced the load."""
+    try:
+        events = backend.io.run(backend._gcs.call(
+            "list_serve_events", {"limit": 500}))
+    except Exception:  # noqa: BLE001 — older GCS / local backend
+        return []
+    out: List[Dict[str, Any]] = []
+    for ev in events or ():
+        out.append({
+            "name": (f"scale {ev.get('deployment')} "
+                     f"{ev.get('old_target')}->{ev.get('new_target')}"),
+            "cat": "serve", "ph": "i", "s": "t",
+            "ts": ev.get("t", 0.0) * 1e6,
+            "pid": "serve", "tid": "autoscaler",
             "args": {k: v for k, v in ev.items() if k != "t"},
         })
     return out

@@ -139,7 +139,8 @@ def get_trace(trace_id: str) -> List[Dict[str, Any]]:
 
     backend = ray_tpu.global_worker()._require_backend()
     events = backend.io.run(
-        backend._gcs.call("list_tasks", {"limit": 10000}))
+        backend._gcs.call("list_tasks",
+                          {"limit": 10000, "serve": "include"}))
     spans = [e for e in events
              if (e.get("trace") or {}).get("trace_id") == trace_id]
     by_span = {(s["trace"] or {}).get("span_id"): s for s in spans}
@@ -164,12 +165,19 @@ def get_trace(trace_id: str) -> List[Dict[str, Any]]:
 PHASE_ORDER = ("submit", "queue_wait", "worker_acquire", "transfer",
                "arg_fetch", "execute", "result_store", "driver_get")
 
+# Serve request spans (serve/obs.py) carry their own phase vocabulary —
+# ranked after the task partition, in causal order per hop (proxy:
+# route→handle→respond/stream; handle: route→call; replica:
+# queue_wait→execute, which reuses the task names above).
+SERVE_PHASE_ORDER = ("proxy_route", "handle", "route", "call",
+                     "call_stream", "respond", "stream")
+
 
 def sorted_phases(phases: Dict[str, float]) -> List[Any]:
     """(name, seconds) pairs in canonical phase order."""
-    rank = {p: i for i, p in enumerate(PHASE_ORDER)}
-    return sorted(phases.items(),
-                  key=lambda kv: (rank.get(kv[0], len(PHASE_ORDER)), kv[0]))
+    rank = {p: i for i, p in enumerate(PHASE_ORDER + SERVE_PHASE_ORDER)}
+    n = len(PHASE_ORDER) + len(SERVE_PHASE_ORDER)
+    return sorted(phases.items(), key=lambda kv: (rank.get(kv[0], n), kv[0]))
 
 
 def span_tree(spans: List[Dict[str, Any]]) -> List[Any]:

@@ -76,7 +76,7 @@ def test_dashboard_ui_page(rt_cluster):
     html = req.read().decode()
     # the page consumes the REST surface this same head serves
     for api in ("/api/nodes", "/api/actors", "/api/jobs",
-                "/api/cluster_resources", "/api/serve/applications"):
+                "/api/cluster_resources", "/api/serve"):
         assert api in html, api
     # zero-egress: no external scripts/styles/fonts
     assert "http://" not in html.replace("http://127.0.0.1", "")

@@ -357,3 +357,29 @@ def test_step_profiler_hbm_column_cpu_safe():
     finally:
         sp.disable()
         sp.reset()
+
+
+def test_ledger_deref_is_lock_free():
+    """A weakref finalizer can fire via the cyclic GC on a thread that is
+    ALREADY inside one of the ledger's locked regions (any allocation under
+    the lock can trigger collection). ``_deref`` must therefore never take
+    the lock — it enqueues, and the next locked operation drains. The old
+    locking ``_deref`` self-deadlocked the whole process (every
+    ``ObjectRef.__init__`` blocked forever) under replica-kill churn."""
+    import threading
+
+    led = object_ledger.OwnershipLedger()
+    with led._lock:
+        e = led._entry("deadbeef")
+        e.local_refs = 2
+        # simulate the GC firing the finalizer while THIS thread holds the
+        # lock; run it in a helper thread so a regression fails the test
+        # instead of hanging the whole session
+        t = threading.Thread(target=led._deref, args=("deadbeef",),
+                             daemon=True)
+        t.start()
+        t.join(timeout=5.0)
+        assert not t.is_alive(), "_deref blocked on the ledger lock"
+        assert e.local_refs == 2  # deferred, not applied in-finalizer
+    led.record_get("deadbeef")  # any locked op drains the backlog
+    assert led._entries["deadbeef"].local_refs == 1

@@ -1,0 +1,389 @@
+"""Serve request observability plane (serve/obs.py).
+
+Covers: request-id propagation proxy -> handle -> replica -> nested
+handle (one trace per request), TTFT/inter-token histograms on a
+streamed response, the replica queue-wait vs execute split, the
+autoscaler decision log, the dashboard /api/serve payload, the degraded
+healthz, @serve.batch occupancy histograms, the multiplex model-id
+counter, and the doctor's serve findings.
+
+Named test_zz_* so it sorts late (tier-1, `-m 'not slow'`-safe).
+"""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@pytest.fixture
+def serve_cluster():
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=6, num_tpus=4)
+    yield ray_tpu
+    try:
+        serve.shutdown()
+    finally:
+        serve._forget_controller_for_tests()
+        ray_tpu.shutdown()
+
+
+def _flush_serve_processes(app_deployment_ids=()):
+    """Force the proxy (and named replicas) to push spans + metrics now
+    instead of waiting out their background drain intervals."""
+    try:
+        proxy = ray_tpu.get_actor("RT_SERVE_PROXY")
+        ray_tpu.get(proxy.flush_metrics.remote(), timeout=30)
+    except Exception:  # noqa: BLE001 — no proxy in this test
+        pass
+    for rid in app_deployment_ids:
+        try:
+            rep = ray_tpu.get_actor(f"RT_SERVE:{rid}")
+            ray_tpu.get(rep.flush_metrics.remote(), timeout=30)
+        except Exception:  # noqa: BLE001 — replica may have moved
+            pass
+
+
+def _get_trace(request_id, min_spans, timeout_s=12.0):
+    from ray_tpu.util import tracing
+
+    deadline = time.time() + timeout_s
+    spans = []
+    while time.time() < deadline:
+        spans = tracing.get_trace(request_id)
+        if len(spans) >= min_spans:
+            return spans
+        time.sleep(0.5)
+    return spans
+
+
+def _hist_series(text, name):
+    """Parse `<name>_count{...} v` and `<name>_sum{...} v` lines from a
+    Prometheus page -> (total_count, total_sum)."""
+    count = total = 0.0
+    for ln in text.splitlines():
+        if ln.startswith(f"{name}_count"):
+            count += float(ln.rsplit(" ", 1)[1])
+        elif ln.startswith(f"{name}_sum"):
+            total += float(ln.rsplit(" ", 1)[1])
+    return count, total
+
+
+def test_request_id_propagation_and_trace(serve_cluster):
+    """One HTTP request yields one trace: proxy span, routing spans,
+    replica spans (queue/execute split) — including the NESTED handle
+    call a composed deployment makes — all under the request id the
+    response echoes."""
+    import requests
+
+    @serve.deployment(name="Inner")
+    def inner(x):
+        return x * 2
+
+    @serve.deployment
+    class Api:
+        def __init__(self, inner):
+            self.inner = inner
+
+        async def __call__(self, request):
+            return {"v": await self.inner.remote(21)}
+
+    serve.run(Api.bind(inner.bind()), name="ridprop", route_prefix="/rid")
+    port = serve.http_port()
+    requests.get(f"http://127.0.0.1:{port}/rid/x", timeout=30)  # warm
+    r = requests.get(f"http://127.0.0.1:{port}/rid/x", timeout=30)
+    assert r.status_code == 200 and r.json() == {"v": 42}
+    rid = r.headers.get("x-rt-request-id")
+    assert rid, "response must echo the minted request id"
+
+    _flush_serve_processes(["ridprop#Api#0", "ridprop#Inner#0"])
+    # proxy + 2x route + 2x replica serve spans + 2x actor-call spans
+    spans = _get_trace(rid, min_spans=5)
+    names = [s.get("name") or "" for s in spans]
+    assert any(n.startswith("proxy:GET") for n in names), names
+    assert any(n.startswith("route:ridprop/Api") for n in names), names
+    # the NESTED handle call joined the same request trace
+    assert any(n.startswith("route:ridprop/Inner") for n in names), names
+    assert any(n.startswith("replica:Inner") for n in names), names
+    # replica spans carry the queue-wait vs execute split
+    rep = next(s for s in spans
+               if (s.get("name") or "").startswith("replica:Api"))
+    assert set(rep["phases"]) == {"queue_wait", "execute"}
+    # the span tree renders (what `rt trace <request_id>` prints)
+    from ray_tpu.util import tracing
+
+    out = tracing.format_trace(spans)
+    assert rid in out and "proxy:GET" in out and "queue_wait" in out
+
+    # an upstream-provided id is adopted, not replaced
+    r2 = requests.get(f"http://127.0.0.1:{port}/rid/x", timeout=30,
+                      headers={"x-rt-request-id": "upstream123"})
+    assert r2.headers.get("x-rt-request-id") == "upstream123"
+
+
+def test_streaming_ttft_and_inter_token_metrics(serve_cluster):
+    """A streamed response populates the TTFT / inter-token histograms,
+    the tokens counter, the request histogram (closed at last byte), and
+    a proxy span with a stream phase."""
+    import requests
+
+    @serve.deployment
+    class Streamer:
+        async def __call__(self, request):
+            async def gen():
+                import asyncio
+
+                for i in range(5):
+                    yield f"tok{i} "
+                    await asyncio.sleep(0.02)
+
+            return gen()
+
+    serve.run(Streamer.bind(), name="stream", route_prefix="/stream")
+    port = serve.http_port()
+    r = requests.get(f"http://127.0.0.1:{port}/stream/", timeout=30)
+    assert r.status_code == 200
+    assert r.text == "tok0 tok1 tok2 tok3 tok4 "
+    rid = r.headers.get("x-rt-request-id")
+
+    _flush_serve_processes()
+    from ray_tpu.util.metrics import metrics_text
+
+    text = metrics_text()
+    ttft_n, _ = _hist_series(text, "rt_serve_ttft_seconds")
+    assert ttft_n >= 1, "TTFT histogram is empty"
+    tpot_n, tpot_sum = _hist_series(text, "rt_serve_inter_token_seconds")
+    assert tpot_n >= 4, "inter-token histogram must see the chunk gaps"
+    # at least one real ~20ms gap must register; under suite load the
+    # stream pull can batch several chunks into one write (gap ~0), so
+    # the full 4x sum is not a stable bound
+    assert tpot_sum >= 0.015, tpot_sum
+    assert any(ln.startswith("rt_serve_tokens_total")
+               and float(ln.rsplit(" ", 1)[1]) >= 5
+               for ln in text.splitlines()), "tokens counter did not move"
+    req_n, _ = _hist_series(text, "rt_serve_request_seconds")
+    assert req_n >= 1, "request histogram is empty"
+
+    spans = _get_trace(rid, min_spans=2)
+    proxy_span = next(s for s in spans
+                      if (s.get("name") or "").startswith("proxy:"))
+    assert "stream" in proxy_span["phases"], proxy_span["phases"]
+
+
+def test_queue_wait_vs_execute_split(serve_cluster):
+    """The replica splits request time into queue-wait (admission to
+    user-code start) and execute; both histograms fill and the split
+    partitions the replica span."""
+
+    @serve.deployment(max_ongoing_requests=4)
+    class Slow:
+        def __call__(self, request):
+            time.sleep(0.15)
+            return "ok"
+
+    handle = serve.run(Slow.bind(), name="qsplit", route_prefix=None)
+    rs = [handle.remote(None) for _ in range(4)]
+    assert [r.result(timeout=60) for r in rs] == ["ok"] * 4
+
+    _flush_serve_processes(["qsplit#Slow#0"])
+    from ray_tpu.util.metrics import metrics_text
+
+    text = metrics_text()
+    qw_n, qw_sum = _hist_series(text, "rt_serve_queue_wait_seconds")
+    ex_n, ex_sum = _hist_series(text, "rt_serve_execute_seconds")
+    assert qw_n >= 4 and ex_n >= 4
+    assert qw_n == ex_n, "every request must be split into both phases"
+    assert ex_sum >= 4 * 0.14, f"execute sum too small: {ex_sum}"
+    # direct handle calls are an ingress too: they minted request ids and
+    # emitted replica spans with the split
+    events = ray_tpu.global_worker()._require_backend()
+    spans = events.io.run(events._gcs.call(
+        "list_tasks", {"limit": 10000, "serve": "include"}))
+    rep_spans = [s for s in spans
+                 if (s.get("name") or "").startswith("replica:Slow")]
+    assert rep_spans, "direct handle call emitted no replica span"
+    ph = rep_spans[0]["phases"]
+    assert set(ph) == {"queue_wait", "execute"} and ph["execute"] > 0.1
+
+
+def test_autoscaler_decision_log(serve_cluster):
+    """Scaling decisions land in the bounded log with the metric values
+    and hysteresis state that produced them; stats show p50/p99 + QPS."""
+
+    @serve.deployment(
+        max_ongoing_requests=2,
+        autoscaling_config=dict(min_replicas=1, max_replicas=3,
+                                target_ongoing_requests=1.0,
+                                upscale_delay_s=0.5, downscale_delay_s=30.0,
+                                look_back_period_s=2.0))
+    class Work:
+        def __call__(self, x):
+            time.sleep(0.3)
+            return x
+
+    handle = serve.run(Work.bind(), name="adl", route_prefix=None)
+    # sustained load -> an upscale decision
+    stop_at = time.time() + 20.0
+    inflight = []
+    while time.time() < stop_at:
+        inflight = [r for r in inflight if not r._fut.done()]
+        while len(inflight) < 6:
+            inflight.append(handle.remote(1))
+        st = serve.status()["adl"]["deployments"]["Work"]
+        if st["replicas"] >= 2:
+            break
+        time.sleep(0.2)
+    for r in inflight:
+        try:
+            r.result(timeout=60)
+        except Exception:  # noqa: BLE001 — downscale may kill stragglers
+            pass
+
+    detail = serve.detailed_status()
+    decisions = detail["decisions"]
+    assert decisions, "no decision records at all"
+    # the deploy decision: 0 -> 1 at first reconcile
+    deploy = next(d for d in decisions if d["direction"] == "deploy")
+    assert deploy["old_target"] == 0 and deploy["new_target"] >= 1
+    # the upscale decision carries the trigger values + hysteresis state
+    up = next(d for d in decisions if d["direction"] == "up")
+    assert up["app"] == "adl" and up["deployment"] == "Work"
+    assert up["new_target"] > up["old_target"]
+    trig = up["trigger"]
+    assert trig["ongoing_avg"] > 0, trig
+    assert trig["target_ongoing_requests"] == 1.0
+    assert "p99_s" in trig and "queue_depth" in trig and "qps" in trig
+    hyst = trig.get("hysteresis")
+    assert hyst and hyst["delay_s"] == 0.5 and hyst["held_s"] >= 0.5
+    # per-deployment windowed stats back `rt serve status` lines
+    stats = detail["applications"]["adl"]["deployments"]["Work"]["stats"]
+    assert stats["qps"] > 0 and stats["p99_s"] >= stats["p50_s"] > 0
+
+
+def test_api_serve_payload_and_healthz_degraded(serve_cluster):
+    """/api/serve carries applications + per-deployment stats + the
+    decision log; the proxy healthz reports route-table age and answers
+    503 past the staleness threshold."""
+    import requests
+
+    from ray_tpu.dashboard import start_dashboard
+
+    @serve.deployment(num_replicas=1)
+    def app_fn(request):
+        return "hi"
+
+    handle = serve.run(app_fn.bind(), name="apisrv", route_prefix="/hi")
+    handle.remote(None).result(timeout=30)
+    time.sleep(1.5)  # one stats poll cycle
+
+    dash = start_dashboard()
+    payload = json.loads(urllib.request.urlopen(
+        f"http://127.0.0.1:{dash}/api/serve", timeout=30).read())
+    assert "applications" in payload and "decisions" in payload
+    dep = payload["applications"]["apisrv"]["deployments"]["app_fn"]
+    assert dep["replicas"] == 1 and "stats" in dep
+    assert {"ongoing", "queue_depth", "p50_s", "p99_s",
+            "qps"} <= set(dep["stats"])
+    assert any(d.get("kind") == "autoscale_decision"
+               for d in payload["decisions"])
+
+    # healthz: healthy stays a bare 200 "ok"; verbose returns the JSON;
+    # a zero staleness threshold deterministically degrades to 503
+    port = serve.http_port()
+    base = f"http://127.0.0.1:{port}/-/healthz"
+    assert requests.get(base, timeout=10).text == "ok"
+    v = requests.get(f"{base}?verbose=1", timeout=10).json()
+    assert v["status"] == "ok" and v["controller_reachable"] is True
+    assert v["route_table_age_s"] >= 0
+    d = requests.get(f"{base}?stale_after=0", timeout=10)
+    assert d.status_code == 503
+    body = d.json()
+    assert body["status"] == "degraded" and "route_table_age_s" in body
+
+
+def test_batch_occupancy_histograms(serve_cluster):
+    """@serve.batch flushes observe fused batch size and occupancy."""
+
+    @serve.deployment(max_ongoing_requests=32)
+    class Batched:
+        @serve.batch(max_batch_size=8, batch_wait_timeout_s=0.2)
+        async def predict(self, xs):
+            return [x * 2 for x in xs]
+
+        async def __call__(self, x):
+            return await self.predict(x)
+
+    handle = serve.run(Batched.bind(), name="bobs", route_prefix=None)
+    rs = [handle.remote(i) for i in range(8)]
+    assert sorted(r.result(timeout=30) for r in rs) == [
+        i * 2 for i in range(8)]
+
+    _flush_serve_processes(["bobs#Batched#0"])
+    from ray_tpu.util.metrics import metrics_text
+
+    text = metrics_text()
+    bs_n, bs_sum = _hist_series(text, "rt_serve_batch_size")
+    occ_n, _ = _hist_series(text, "rt_serve_batch_occupancy")
+    assert bs_n >= 1 and occ_n >= 1
+    assert bs_sum >= 8, "batch-size samples must cover all items"
+    assert any(ln.startswith("rt_serve_batch_size_bucket")
+               and 'fn="predict"' in ln for ln in text.splitlines())
+
+
+def test_multiplex_model_id_counter():
+    """The multiplex wrapper counts lookups per model id with the cache
+    outcome as a label (no cluster needed)."""
+    from ray_tpu.serve import obs
+    from ray_tpu.serve.multiplex import multiplexed
+
+    class Host:
+        @multiplexed(max_num_models_per_replica=2)
+        def get_model(self, model_id):
+            return f"model-{model_id}"
+
+    h = Host()
+    assert h.get_model("a") == "model-a"   # load
+    assert h.get_model("a") == "model-a"   # hit
+    assert h.get_model("b") == "model-b"   # load
+    snap = obs.mux_requests_total().to_dict()
+    by_key = {tuple(sorted(lbl.items())): v for lbl, v in snap["samples"]}
+    assert by_key[(("model_id", "a"), ("outcome", "load"))] >= 1
+    assert by_key[(("model_id", "a"), ("outcome", "hit"))] >= 1
+    assert by_key[(("model_id", "b"), ("outcome", "load"))] >= 1
+
+
+def test_doctor_serve_findings():
+    """Doctor grades missing replicas and sustained p99 as warn findings
+    naming the deployment (pure diagnose — no cluster)."""
+    from ray_tpu.util import doctor
+
+    now = time.time()
+    report = {
+        "window_s": 600.0,
+        "nodes": [{"node_id": "n1", "alive": True, "queue_depth": 0}],
+        "actors": [], "failures": [], "oom_kills": [], "ledgers": [],
+        "serve": {"t": now, "deployments": [
+            {"app": "a", "name": "Missing", "replicas": 1, "starting": 0,
+             "target": 2, "p99_s": 0.01, "qps": 3.0},
+            {"app": "a", "name": "SlowP99", "replicas": 2, "starting": 0,
+             "target": 2, "p99_s": 9.5, "qps": 2.0},
+            {"app": "a", "name": "Fine", "replicas": 2, "starting": 0,
+             "target": 2, "p99_s": 0.02, "qps": 5.0},
+        ]},
+    }
+    findings = doctor.diagnose(report, serve_p99_warn_s=5.0)
+    msgs = [m for level, m in findings if level == doctor.WARN]
+    assert any("a/Missing" in m and "1/2" in m for m in msgs), findings
+    assert any("a/SlowP99" in m and "9.5" in m for m in msgs), findings
+    assert not any("a/Fine" in m for m in msgs), findings
+    assert doctor.exit_code(findings) == 0  # warns don't fail CI
+
+    # a stale snapshot (controller gone) is skipped, not graded
+    report["serve"]["t"] = now - 120.0
+    findings = doctor.diagnose(report, serve_p99_warn_s=5.0)
+    assert not any("serve deployment" in m for _, m in findings)
