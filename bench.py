@@ -472,7 +472,8 @@ def _run_phase(env_var: str, prefix: str, timeout: float,
     # RT_BENCH_INNER=1 — a child inheriting it would recurse into
     # _inner_main instead of running its own phase).
     for marker in ("RT_BENCH_INNER", "RT_BENCH_SWEEP", "RT_BENCH_TRAIN",
-                   "RT_BENCH_DECODE", "RT_BENCH_RL", "RT_BENCH_SERVE"):
+                   "RT_BENCH_DECODE", "RT_BENCH_RL", "RT_BENCH_SERVE",
+                   "RT_BENCH_CB"):
         env.pop(marker, None)
     env[env_var] = "1"
     if extra_env:
@@ -702,35 +703,181 @@ def _decode_main() -> None:
         try:
             draft_preset = cfgd.get("draft_preset",
                                     {"410m": "160m", "1b": "160m",
-                                     "160m": "debug"}.get(preset, "debug"))
+                                     "160m": "debug",
+                                     "debug": "debug_draft"}.get(
+                                         preset, "debug_draft"))
             dcfg = _bench_cfg(draft_preset, "xla", 0, dtype)
-            dparams = llama.init_params(jax.random.key(9), dcfg)
-
-            def sp_timed(n_new: int, seed: int) -> float:
-                prompt = jax.random.randint(jax.random.key(seed),
-                                            (1, prompt_len), 0,
-                                            cfg.vocab_size,
-                                            dtype=jnp.int32)
-                t0 = time.perf_counter()
-                res = gen.generate_speculative(
-                    params, dparams, prompt, cfg, dcfg,
-                    max_new_tokens=n_new, speculate_k=4)
-                _np.asarray(res)
-                return time.perf_counter() - t0
-
-            sp_timed(new_tokens, seed=11)  # compile + warmup
-            dt_spec = sp_timed(new_tokens, seed=411)
-            timed(1, new_tokens, seed=412)  # ensure plain b1 compiled
-            dt_plain = timed(1, new_tokens, seed=413)
-            out["decode_spec_tok_s_b1"] = round(new_tokens / dt_spec, 1)
-            out["decode_plain_tok_s_b1"] = round(new_tokens / dt_plain, 1)
-            out["decode_spec_speedup_b1"] = round(dt_plain / dt_spec, 2)
             out["decode_spec_draft"] = draft_preset
+            if dcfg == cfg:
+                # A draft that IS the target measures nothing: every
+                # launch costs a full target forward, so the "speedup"
+                # is a guaranteed ~1/(k+1) slowdown dressed as data
+                # (r05 shipped 0.33 exactly this way). Refuse the key.
+                out["decode_spec_skipped"] = (
+                    f"draft preset {draft_preset!r} resolves to the "
+                    f"target config — no honest speedup measurable")
+            else:
+                out["decode_spec_draft_params_m"] = round(
+                    dcfg.num_params() / 1e6, 2)
+                dparams = llama.init_params(jax.random.key(9), dcfg)
+                spec_stats = {}
+                # B=1 latency comparison needs walls well above dispatch
+                # jitter: a handful of ms "measures" only noise (an r06
+                # dry run reported a 2x "speedup" at ZERO acceptance that
+                # way) — decode at least 64 tokens and take best-of-3
+                sp_n = max(new_tokens, 64)
+
+                def sp_timed(seed: int) -> float:
+                    prompt = jax.random.randint(jax.random.key(seed),
+                                                (1, prompt_len), 0,
+                                                cfg.vocab_size,
+                                                dtype=jnp.int32)
+                    t0 = time.perf_counter()
+                    res, st = gen.generate_speculative(
+                        params, dparams, prompt, cfg, dcfg,
+                        max_new_tokens=sp_n, speculate_k=4,
+                        return_stats=True)
+                    _np.asarray(res)
+                    dt = time.perf_counter() - t0
+                    spec_stats.update(st)
+                    return dt
+
+                sp_timed(seed=11)  # compile + warmup
+                dt_spec = min(sp_timed(seed=411 + i) for i in range(3))
+                timed(1, sp_n, seed=412)  # ensure plain b1 compiled
+                dt_plain = min(timed(1, sp_n, seed=413 + i)
+                               for i in range(3))
+                speedup = dt_plain / dt_spec
+                out["decode_spec_new_tokens"] = sp_n
+                out["decode_spec_tok_s_b1"] = round(sp_n / dt_spec, 1)
+                out["decode_plain_tok_s_b1"] = round(sp_n / dt_plain, 1)
+                out["decode_spec_speedup_b1"] = round(speedup, 3)
+                # the measured acceptance profile that EXPLAINS the
+                # speedup (or the honest lack of one): tokens per target
+                # launch minus the free correction token
+                out["decode_spec_rounds"] = spec_stats.get("rounds")
+                out["decode_spec_accept_per_round"] = spec_stats.get(
+                    "accept_per_round")
+                accept = spec_stats.get("accept_per_round") or 0.0
+                if speedup < 1.0:
+                    out["decode_spec_note"] = (
+                        "speculation lost: accept_per_round "
+                        f"{accept} means the randomly-initialized draft "
+                        "rarely matches the target's greedy choice, so "
+                        "each round pays k draft launches + one "
+                        "(k+1)-wide target launch for ~1 emitted token; "
+                        "spec-decode pays off only with a distilled/"
+                        "agreeing draft AND a launch- or HBM-bound "
+                        "target (not a compute-bound CPU forward)")
+                elif accept < 0.5:
+                    # a "speedup" that acceptance cannot explain must be
+                    # attributed honestly or it is the r05 lie again in
+                    # the other direction
+                    out["decode_spec_note"] = (
+                        f"speedup {round(speedup, 3)} at accept_per_round "
+                        f"{accept} is NOT draft agreement: with ~zero "
+                        "acceptance each round emits 1 token from one "
+                        "(k+1)-wide target forward, which on this "
+                        "overhead-dominated platform costs about the "
+                        "same as the plain loop's 1-wide step — the win "
+                        "is wide verification amortizing per-position "
+                        "overhead (plus a near-free draft), not "
+                        "speculation; a distilled draft is what would "
+                        "move accept_per_round and multiply this")
         except Exception as e:  # noqa: BLE001 — additive leg
             out["decode_spec_error"] = str(e)[:200]
     except Exception as e:  # noqa: BLE001
         out["decode_error"] = str(e)[:300]
     print("DECODEBENCH=" + json.dumps(out))
+
+
+def _cb_main() -> None:
+    """Continuous-batching serve phase (ROADMAP item 2's judged leg):
+    Poisson arrivals at EQUAL offered load against (a) the live
+    ContinuousBatcher behind a serve deployment (streamed tokens,
+    mid-flight admission) and (b) the static ``@serve.batch`` control
+    (batch-boundary fusion, lockstep decode). Reports throughput and
+    latency percentiles for both — ``decode_cb_tok_s`` and the p99
+    comparison are the headline keys. Config via RT_BENCH_CB_CFG.
+    Prints one JSON line CBBENCH={...}."""
+    import ray_tpu
+    from ray_tpu import serve
+
+    cfgd = json.loads(os.environ.get("RT_BENCH_CB_CFG", "{}"))
+    preset = cfgd.get("preset", "debug")
+    slots = int(cfgd.get("slots", 8))
+    prompt_len = int(cfgd.get("prompt_len", 8))
+    # heterogeneous decode lengths — the load shape continuous batching
+    # exists for: most requests want a few tokens, some want many. A
+    # batch-boundary system must provision EVERY fused generate for the
+    # longest admissible request; slot admission decodes only what each
+    # request asked for and frees the slot.
+    short_tokens = int(cfgd.get("short_tokens", 2))
+    long_tokens = int(cfgd.get("long_tokens", 256))
+    long_frac = float(cfgd.get("long_frac", 0.05))
+    rps = float(cfgd.get("rps", 15.0))
+    duration_s = float(cfgd.get("duration_s", 15.0))
+    max_len = int(cfgd.get("max_len", 384))
+    stride = int(cfgd.get("decode_stride", 16))
+    num_proxies = int(cfgd.get("num_proxies", 2))
+
+    out = {"decode_cb_preset": preset, "decode_cb_slots": slots,
+           "decode_cb_prompt_len": prompt_len,
+           "decode_cb_short_tokens": short_tokens,
+           "decode_cb_long_tokens": long_tokens,
+           "decode_cb_long_frac": long_frac,
+           "decode_cb_offered_rps": rps,
+           "decode_cb_duration_s": duration_s,
+           "decode_cb_stride": stride,
+           "decode_cb_proxies": num_proxies,
+           "decode_cb_methodology": (
+               "open-loop Poisson arrivals (serve/llm.py poisson_load) "
+               "round-robined across the HTTP proxy fleet at equal "
+               "offered load and an "
+               f"{int(100 * (1 - long_frac))}/{int(100 * long_frac)} "
+               f"short/long ({short_tokens}/{long_tokens} tok) request "
+               "mix; continuous = ContinuousEngine slot admission, "
+               "bucketed+K-fused rowwise decode, streamed per token; "
+               "static = @serve.batch fused generate provisioned at "
+               "max_new=long (a batch-boundary system decodes its "
+               "longest admissible request every flush — the waste "
+               "continuous admission avoids); p50/p99 are full request "
+               "walls; failed counts client-side sheds at "
+               "max_inflight=64")}
+    ray_tpu.init(num_cpus=4)
+    try:
+        from ray_tpu.serve.llm import cb_vs_static_load
+
+        legs = cb_vs_static_load(
+            preset=preset, slots=slots, max_len=max_len,
+            decode_stride=stride, prompt_len=prompt_len,
+            short_tokens=short_tokens, long_tokens=long_tokens,
+            long_frac=long_frac, rps=rps, duration_s=duration_s,
+            num_proxies=num_proxies, route_base="bench")
+        cb, st = legs["continuous"], legs["static"]
+        out["decode_cb_tok_s"] = cb["tok_s"]
+        out["decode_cb_rps"] = cb["rps"]
+        out["decode_cb_p50_ms"] = cb["p50_ms"]
+        out["decode_cb_p99_ms"] = cb["p99_ms"]
+        out["decode_cb_completed"] = cb["completed"]
+        out["decode_cb_failed"] = cb["failed"] + cb["shed"]
+        out["decode_static_tok_s"] = st["tok_s"]
+        out["decode_static_rps"] = st["rps"]
+        out["decode_static_p50_ms"] = st["p50_ms"]
+        out["decode_static_p99_ms"] = st["p99_ms"]
+        out["decode_static_failed"] = st["failed"] + st["shed"]
+        if st["p99_ms"]:
+            out["decode_cb_p99_vs_static"] = round(
+                cb["p99_ms"] / st["p99_ms"], 3)
+    except Exception as e:  # noqa: BLE001 — informative leg
+        out["decode_cb_error"] = str(e)[:300]
+    finally:
+        try:
+            serve.shutdown()
+        except Exception:  # noqa: BLE001
+            pass
+        ray_tpu.shutdown()
+    print("CBBENCH=" + json.dumps(out))
 
 
 def _data_main() -> None:
@@ -1184,6 +1331,9 @@ def main() -> None:
     if os.environ.get("RT_BENCH_SERVE"):
         _serve_main()
         return
+    if os.environ.get("RT_BENCH_CB"):
+        _cb_main()
+        return
     if os.environ.get("RT_BENCH_DATA"):
         _data_main()
         return
@@ -1289,6 +1439,27 @@ def main() -> None:
                     env=phase_env, extra_env=serve_extra)
     if sv:
         result.setdefault("details", {}).update(sv)
+        if on_chip:
+            _preserve(dict(result), path=preserve_path)
+
+    # Continuous-batching serve-under-load phase — the ROADMAP item 2
+    # judged leg (decode_cb_* keys). Model sized to the platform like the
+    # serve phase; offered load sized so the static control saturates
+    # while continuous admission keeps the tail bounded.
+    cb_cfg = json.dumps(
+        {"preset": "410m", "slots": 8, "prompt_len": 32,
+         "short_tokens": 8, "long_tokens": 256, "long_frac": 0.05,
+         "rps": 10.0, "duration_s": 20.0, "max_len": 512,
+         "decode_stride": 16}
+        if platform == "tpu" else
+        {"preset": "debug", "slots": 8, "prompt_len": 8,
+         "short_tokens": 2, "long_tokens": 256, "long_frac": 0.05,
+         "rps": 15.0, "duration_s": 15.0, "max_len": 384,
+         "decode_stride": 16})
+    cbr = _run_phase("RT_BENCH_CB", "CBBENCH", timeout=600, env=phase_env,
+                     extra_env={"RT_BENCH_CB_CFG": cb_cfg})
+    if cbr:
+        result.setdefault("details", {}).update(cbr)
         if on_chip:
             _preserve(dict(result), path=preserve_path)
 

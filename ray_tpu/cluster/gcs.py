@@ -1329,6 +1329,40 @@ class GcsServer:
         events = list(getattr(self, "serve_decisions", ()))
         return events[-limit:]
 
+    # ---- serve proxy registry (multi-proxy front doors): the controller
+    # registers every HTTP proxy it starts so load balancers / `rt serve
+    # status` / the dashboard can enumerate ingress endpoints without a
+    # serve driver attached --------------------------------------------------
+    _SERVE_PROXIES_CAP = 256
+
+    async def rpc_serve_proxy_register(self, p):
+        if not hasattr(self, "serve_proxies"):
+            self.serve_proxies: Dict[str, Dict[str, Any]] = {}
+        pid = str(p.get("proxy_id") or "")
+        if not pid:
+            return {"ok": False, "error": "proxy_id required"}
+        self.serve_proxies[pid] = {
+            "proxy_id": pid, "host": p.get("host"), "port": p.get("port"),
+            "registered_at": time.time()}
+        while len(self.serve_proxies) > self._SERVE_PROXIES_CAP:
+            self.serve_proxies.pop(next(iter(self.serve_proxies)))
+        return {"ok": True, "count": len(self.serve_proxies)}
+
+    async def rpc_serve_proxy_deregister(self, p):
+        """``proxy_id: "*"`` clears the registry (serve shutdown)."""
+        reg = getattr(self, "serve_proxies", None)
+        if not reg:
+            return {"ok": True, "count": 0}
+        pid = str(p.get("proxy_id") or "")
+        if pid == "*":
+            reg.clear()
+        else:
+            reg.pop(pid, None)
+        return {"ok": True, "count": len(reg)}
+
+    async def rpc_list_serve_proxies(self, p):
+        return list(getattr(self, "serve_proxies", {}).values())
+
     # ---- memory events (spill / restore / oom_kill instants; the store
     # behind `rt memory --oom` and the timeline's memory lane) -------------
     _MEM_EVENTS_CAP = 2048

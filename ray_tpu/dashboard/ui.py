@@ -592,9 +592,13 @@ function renderTable() {
     const payload = data.serve || {};
     const apps = payload.applications || payload;
     const decisions = payload.decisions || [];
+    const proxies = payload.proxies || [];
     const names = Object.keys(apps);
     const ms = v => v ? (1e3 * v).toFixed(1) : "0.0";
-    el.innerHTML = (names.length ? "" :
+    el.innerHTML = (proxies.length > 1 ?
+      `<div class="muted">proxies: ` + proxies.map(p =>
+        `${esc(p.proxy)}:${esc(p.port)}`).join(", ") + `</div>` : "") +
+    (names.length ? "" :
       `<div class="empty">no serve applications</div>`) + names.map(n => {
       const app = apps[n] || {};
       const deps = app.deployments || app;
@@ -602,15 +606,19 @@ function renderTable() {
         (app.route_prefix ? ` <span class="muted">${esc(app.route_prefix)}` +
          `</span>` : ``) + `</h3>` +
         `<table><tr><th>Deployment</th><th>Replicas</th><th>Target</th>` +
-        `<th>Ongoing</th><th>Queue</th><th>p50</th><th>p99</th>` +
-        `<th>QPS</th></tr>` + Object.entries(deps).map(([d, info]) => {
+        `<th>Ongoing</th><th>Queue</th><th>Slots</th><th>p50</th>` +
+        `<th>p99</th><th>QPS</th></tr>` +
+        Object.entries(deps).map(([d, info]) => {
           const s = (info && info.stats) || {};
+          const slots = s.cb_slots
+            ? `${esc(s.cb_active ?? 0)}/${esc(s.cb_slots)}` : "";
           return `<tr><td>${esc(d)}</td>` +
             `<td>${esc((info && (info.num_replicas ?? info.replicas))
                        ?? "")}</td>` +
             `<td>${esc((info && info.target) ?? "")}</td>` +
             `<td>${esc(s.ongoing ?? 0)}</td>` +
             `<td>${esc(s.queue_depth ?? 0)}</td>` +
+            `<td>${slots}</td>` +
             `<td>${ms(s.p50_s)} ms</td><td>${ms(s.p99_s)} ms</td>` +
             `<td>${esc(s.qps ?? 0)}</td></tr>`;
         }).join("") + `</table>`;

@@ -182,7 +182,8 @@ def _compiled_generate(cfg, b: int, s: int, total: int, max_new_tokens: int,
 def generate_speculative(params: Params, draft_params: Params,
                          prompt: jax.Array, cfg, draft_cfg,
                          *, max_new_tokens: int, speculate_k: int = 4,
-                         max_len: Optional[int] = None) -> jax.Array:
+                         max_len: Optional[int] = None,
+                         return_stats: bool = False) -> jax.Array:
     """Greedy speculative decoding: a small DRAFT model proposes
     ``speculate_k`` tokens per round; the TARGET verifies them in ONE
     forward (k+1 positions batched onto the MXU) and emits the longest
@@ -203,6 +204,13 @@ def generate_speculative(params: Params, draft_params: Params,
     ``lax.scan`` for the draft's proposals inside. Stale cache entries
     past a rejection are overwritten before they can be attended (each
     round's k+1-wide write starts exactly at the first stale position).
+
+    ``return_stats=True`` additionally returns
+    ``{"rounds", "accept_per_round"}`` — the measured acceptance profile
+    (tokens emitted per target launch minus the free correction token).
+    Speedup claims are only honest next to this number: a draft the
+    target never agrees with still "works" but pays k draft launches per
+    emitted token.
     """
     b, s = prompt.shape
     total = max_len or (s + max_new_tokens + speculate_k + 1)
@@ -212,20 +220,28 @@ def generate_speculative(params: Params, draft_params: Params,
     run = _compiled_speculative(cfg, draft_cfg, b, s, total,
                                 max_new_tokens, speculate_k)
     if not step_profiler.is_enabled():
-        return run(params, draft_params, prompt)
-    from ray_tpu.util import flops as F
+        out, rounds = run(params, draft_params, prompt)
+    else:
+        from ray_tpu.util import flops as F
 
-    # Analytic work: target prefill+decode plus the draft's proposals
-    # (the draft runs ~1 forward per emitted token too — acceptance only
-    # changes how many TARGET launches that took).
-    return step_profiler.profiled_call(
-        "speculative", run, (params, draft_params, prompt),
-        key=("speculative", cfg, draft_cfg, b, s, total, max_new_tokens,
-             speculate_k),
-        tokens=b * max_new_tokens,
-        flops=(F.generate_flops(cfg, b, s, max_new_tokens)
-               + F.generate_flops(draft_cfg, b, s, max_new_tokens)),
-        meta={"batch": b, "prompt_len": s, "speculate_k": speculate_k})
+        # Analytic work: target prefill+decode plus the draft's proposals
+        # (the draft runs ~1 forward per emitted token too — acceptance
+        # only changes how many TARGET launches that took).
+        out, rounds = step_profiler.profiled_call(
+            "speculative", run, (params, draft_params, prompt),
+            key=("speculative", cfg, draft_cfg, b, s, total, max_new_tokens,
+                 speculate_k),
+            tokens=b * max_new_tokens,
+            flops=(F.generate_flops(cfg, b, s, max_new_tokens)
+                   + F.generate_flops(draft_cfg, b, s, max_new_tokens)),
+            meta={"batch": b, "prompt_len": s, "speculate_k": speculate_k})
+    if not return_stats:
+        return out
+    n_rounds = int(rounds)
+    stats = {"rounds": n_rounds,
+             "accept_per_round": round(
+                 max(0.0, max_new_tokens / max(1, n_rounds) - 1.0), 3)}
+    return out, stats
 
 
 @functools.lru_cache(maxsize=64)
@@ -246,6 +262,8 @@ def _compiled_speculative(cfg, draft_cfg, b: int, s: int, total: int,
         # out[0] is cur (the first generated token)
         out = out.at[:, 0].set(cur.astype(jnp.int32))
 
+        rounds = jnp.int32(0)
+
         def cond(st):
             return st[0] < max_new_tokens
 
@@ -254,7 +272,7 @@ def _compiled_speculative(cfg, draft_cfg, b: int, s: int, total: int,
                 [d, jnp.zeros((b, 1), d.dtype)], axis=1)
 
         def body(st):
-            n, pos, cur, tcache, dcache, out = st
+            n, pos, cur, tcache, dcache, out, r = st
 
             # draft proposes k tokens autoregressively
             def dstep(carry, i):
@@ -287,12 +305,13 @@ def _compiled_speculative(cfg, draft_cfg, b: int, s: int, total: int,
             out = jax.lax.dynamic_update_slice(out, emit, (0, n + 1))
             cur = jax.lax.dynamic_index_in_dim(emit, a, axis=1,
                                                keepdims=False)
-            return (n + a + 1, pos + a + 1, cur, tcache, dcache, out)
+            return (n + a + 1, pos + a + 1, cur, tcache, dcache, out,
+                    r + 1)
 
-        n, _, _, _, _, out = jax.lax.while_loop(
+        n, _, _, _, _, out, rounds = jax.lax.while_loop(
             cond, body, (jnp.int32(0), jnp.int32(s), cur, tcache,
-                         dcache, out))
-        return out[:, :max_new_tokens]
+                         dcache, out, rounds))
+        return out[:, :max_new_tokens], rounds
 
     return run
 

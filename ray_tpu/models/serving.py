@@ -10,12 +10,16 @@ paged dynamic memory:
 - Admission is a per-request prefill that scatters the prompt's KV into
   the free slot (`dynamic_update_slice` on the slot axis) and returns
   the first generated token.
-- Every engine tick is ONE compiled step decoding ALL slots together:
-  the per-slot absolute position rides a [slots] vector, handled by
+- Every engine tick is ONE compiled launch decoding the ACTIVE slots
+  together: the per-slot absolute position rides a vector, handled by
   ``vmap``-ing the single-row cached forward (per-row rope positions,
   per-row cache writes become scatters, causal masking by each row's own
-  position). Free slots compute garbage that is never observed and is
-  overwritten from position 0 by the next admission's prefill.
+  position). Occupied rows are gathered into a {1, max_slots} bucket
+  (a lone straggler pays one row, not the whole engine) and a
+  ``lax.scan`` fuses K decode
+  steps per launch (dispatch overhead amortized K-fold — the decode-side
+  ``make_multi_step``). Stale KV in freed slots is never observed: the
+  next admission prefills the slot from position 0.
 - Greedy decoding — each request's output is EXACTLY
   ``generate.generate(...)`` on its own prompt, regardless of what else
   shares the batch (the test asserts this token-for-token).
@@ -29,7 +33,10 @@ from __future__ import annotations
 
 import functools
 import itertools
-from typing import Any, Dict, List, Optional, Tuple
+import queue as _queue
+import threading
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -69,7 +76,6 @@ class ContinuousBatcher:
         self._cur = np.zeros(max_slots, np.int32)   # token AT pos, per slot
         self._pos = np.zeros(max_slots, np.int32)   # absolute position
         self._ids = itertools.count()
-        self._step_fn = _compiled_rowwise_step(cfg, max_slots, max_len)
 
     # -- admission --------------------------------------------------------
 
@@ -77,6 +83,14 @@ class ContinuousBatcher:
         """Admit one request (prompt: int array [S]); returns req_id.
         Raises RuntimeError when no slot is free (caller queues/retries —
         admission control belongs to the serving layer)."""
+        return self.submit_ex(prompt, max_new_tokens)[0]
+
+    def submit_ex(self, prompt: np.ndarray,
+                  max_new_tokens: int) -> Tuple[int, int, bool]:
+        """``submit`` plus the prefill's first token: returns
+        (req_id, first_token, done) — the streaming engine needs the
+        token the admission itself produced (for a 1-token request the
+        slot is already freed and no ``step()`` will ever report it)."""
         if not self._free:
             raise RuntimeError("no free slots")
         s = len(prompt)
@@ -84,51 +98,137 @@ class ContinuousBatcher:
             raise ValueError(f"prompt {s} + new {max_new_tokens} exceeds "
                              f"max_len {self.max_len}")
         slot = self._free.pop()
-        fn = _compiled_slot_prefill(self.cfg, s, self.max_slots,
-                                    self.max_len)
-        self._ck, self._cv, first = fn(
-            self.params, self._ck, self._cv,
-            jnp.asarray(prompt, jnp.int32)[None, :], slot)
+        try:
+            fn = _compiled_slot_prefill(self.cfg, s, self.max_slots,
+                                        self.max_len)
+            self._ck, self._cv, first = fn(
+                self.params, self._ck, self._cv,
+                jnp.asarray(prompt, jnp.int32)[None, :], slot)
+        except BaseException:
+            # a failed prefill must not leak the slot: callers (the
+            # engine's admit loop) catch and continue, and a leaked slot
+            # per transient XLA error would silently shrink the engine
+            # to zero capacity with no recovery path
+            self._free.append(slot)
+            raise
         req = _Request(next(self._ids), slot, max_new_tokens)
         first_tok = int(first[0])
         req.tokens.append(first_tok)
         req.remaining -= 1
         self._cur[slot] = first_tok
         self._pos[slot] = s
-        if req.remaining <= 0:
+        done = req.remaining <= 0
+        if done:
             self._free.append(slot)
         else:
             self._active[slot] = req
-        return req.req_id
+        return req.req_id, first_tok, done
 
     # -- the engine tick --------------------------------------------------
 
     def step(self) -> List[Tuple[int, int, bool]]:
         """ONE decode step for every active slot; returns
         [(req_id, token, done)] for requests that produced a token."""
+        return [(rid, toks[0], done)
+                for rid, toks, done in self.step_many(1)]
+
+    def step_many(self, k: int = 1) -> List[Tuple[int, List[int], bool]]:
+        """Up to ``k`` FUSED decode steps for every active slot in ONE
+        compiled program; returns [(req_id, tokens, done)].
+
+        Two launch-amortization levers compose here (this runtime's
+        measured per-launch overhead is ~ms — the make_multi_step story,
+        applied to decode):
+
+        - Bucketed active-slot stepping: occupied slots are gathered,
+          stepped, scattered back — a lone straggler on an 8-slot engine
+          pays one row, not eight (buckets: {1, max_slots}).
+        - K-step fusion: a ``lax.scan`` decodes ``k`` tokens per launch,
+          so dispatch overhead is paid once per K tokens instead of per
+          token. A request finishing mid-tick just has its surplus
+          tokens discarded (its rows compute independently; the freed
+          slot's stale KV is overwritten by the next prefill).
+
+        Two programs (lone-row, full-engine) compile per distinct ``k``.
+        """
         if not self._active:
             return []
-        self._ck, self._cv, nxt = self._step_fn(
+        slots = sorted(self._active)
+        n = len(slots)
+        # two buckets only — a lone row or the full engine: K-fusion
+        # already amortizes dispatch, so finer occupancy buckets buy
+        # little compute but each costs a warmup compile (~seconds);
+        # the lone-straggler case is the one worth its own program
+        bucket = 1 if n == 1 else self.max_slots
+        # pad with a repeat of the first active slot: the duplicate
+        # rows compute the SAME update from the same inputs, so the
+        # duplicate scatter writes identical values (deterministic)
+        idx = np.asarray(slots + [slots[0]] * (bucket - n), np.int32)
+        fn = _compiled_bucket_scan(self.cfg, bucket, self.max_slots,
+                                   self.max_len, k)
+        self._ck, self._cv, toks = fn(
             self.params, self._ck, self._cv,
-            jnp.asarray(self._cur), jnp.asarray(self._pos))
-        nxt = np.asarray(nxt)
+            jnp.asarray(self._cur[idx]), jnp.asarray(self._pos[idx]),
+            jnp.asarray(idx))
+        toks = np.asarray(toks)  # [k, bucket]
         out = []
-        for slot, req in list(self._active.items()):
-            tok = int(nxt[slot])
-            req.tokens.append(tok)
-            req.remaining -= 1
-            self._cur[slot] = tok
-            self._pos[slot] += 1
+        for j, slot in enumerate(slots):
+            req = self._active[slot]
+            take = min(k, req.remaining)
+            mine = [int(t) for t in toks[:take, j]]
+            req.tokens.extend(mine)
+            req.remaining -= take
+            self._cur[slot] = mine[-1]
+            self._pos[slot] += take
             done = req.remaining <= 0
             if done:
                 del self._active[slot]
                 self._free.append(slot)
-            out.append((req.req_id, tok, done))
+            out.append((req.req_id, mine, done))
         return out
 
     @property
     def num_active(self) -> int:
         return len(self._active)
+
+    @property
+    def max_remaining(self) -> int:
+        return max((r.remaining for r in self._active.values()), default=0)
+
+    def warmup(self, prompt_lens: Tuple[int, ...] = (),
+               strides: Tuple[int, ...] = (1,)) -> None:
+        """Compile every decode program (the {1, max_slots} buckets
+        step_many uses, for each tick stride) and optionally the
+        prefills for the given prompt lengths, BEFORE traffic arrives.
+        Without this the first request at each new occupancy level pays
+        a mid-flight XLA compile that stalls every active stream —
+        under Poisson load the stall backlog saturates the slots and
+        never recovers. Keep this bucket set in lockstep with
+        step_many's choice."""
+        cur = jnp.asarray(self._cur)
+        pos = jnp.asarray(self._pos)
+        for k in sorted(set(strides)):
+            for bucket in sorted({1, self.max_slots}):
+                fn = _compiled_bucket_scan(self.cfg, bucket, self.max_slots,
+                                           self.max_len, int(k))
+                idx = jnp.zeros(bucket, jnp.int32)
+                np.asarray(fn(self.params, self._ck, self._cv,
+                              cur[:bucket], pos[:bucket], idx)[2])
+        for s in prompt_lens:
+            fn = _compiled_slot_prefill(self.cfg, int(s), self.max_slots,
+                                        self.max_len)
+            np.asarray(fn(self.params, self._ck, self._cv,
+                          jnp.zeros((1, int(s)), jnp.int32), 0)[2])
+
+    def cancel(self, req_id: int) -> bool:
+        """Free a request's slot mid-flight (client disconnect). The slot's
+        stale KV needs no scrub: the next admission prefills from 0."""
+        for slot, req in list(self._active.items()):
+            if req.req_id == req_id:
+                del self._active[slot]
+                self._free.append(slot)
+                return True
+        return False
 
     def run_to_completion(self) -> Dict[int, List[int]]:
         """Drain all active requests; returns req_id -> generated tokens
@@ -140,6 +240,300 @@ class ContinuousBatcher:
             for rid, tok, done in self.step():
                 results.setdefault(rid, reqs[rid].tokens)
         return results
+
+
+_STREAM_END = None  # sentinel a token stream's queue yields when done
+
+
+class _EngineRequest:
+    __slots__ = ("prompt", "max_new_tokens", "out", "on_token", "req_id",
+                 "cancelled")
+
+    def __init__(self, prompt: np.ndarray, max_new_tokens: int,
+                 on_token: Optional[Callable[[Optional[int]], None]] = None):
+        self.prompt = prompt
+        self.max_new_tokens = max_new_tokens
+        self.on_token = on_token
+        # at most max_new_tokens items + the end sentinel ever sit here,
+        # so an unbounded queue is bounded in practice and the shared
+        # engine thread can never block on a slow consumer
+        self.out: Optional["_queue.Queue"] = (
+            None if on_token is not None else _queue.Queue())
+        self.req_id: Optional[int] = None  # assigned at admission
+        self.cancelled = False
+
+    def emit(self, tok: Optional[int]) -> None:
+        self.emit_many([tok])
+
+    def emit_many(self, toks: List[Optional[int]]) -> None:
+        """Hand a tick's token burst to the consumer in ONE callback —
+        per-token cross-thread wakeups (call_soon_threadsafe pipe writes)
+        were a measurable share of the serve path's token ceiling."""
+        if self.on_token is not None:
+            try:
+                self.on_token(toks)
+            except Exception:  # noqa: BLE001 — a consumer callback must
+                pass           # never take the shared engine thread down
+        else:
+            for tok in toks:
+                self.out.put(tok)
+
+
+class ContinuousEngine:
+    """The slot-admission loop that makes :class:`ContinuousBatcher` live.
+
+    ONE background thread owns the model: it admits pending requests into
+    free slots (per-request prefill) and runs the rowwise decode step
+    across all active slots, pushing each token into the submitting
+    request's thread-safe queue the moment it is sampled. Serving wraps
+    the queue in an async generator, so tokens flow out through the
+    replica stream pump / proxy ``_stream_response`` path with per-token
+    latency — and admission happens MID-FLIGHT: a request arriving while
+    others decode joins the next tick instead of waiting for a batch
+    boundary (the continuous-batching property the static ``@serve.batch``
+    control lacks).
+
+    ``on_tick(active_slots, max_slots)`` fires after every decode step —
+    the serve layer hangs slot-occupancy telemetry on it without this
+    module importing serve.
+    """
+
+    def __init__(self, params: Params, cfg: llama.LlamaConfig, *,
+                 max_slots: int = 8, max_len: int = 512,
+                 decode_stride: int = 8,
+                 on_tick: Optional[Callable[[int, int], None]] = None,
+                 warmup: bool = True):
+        self._batcher = ContinuousBatcher(params, cfg, max_slots=max_slots,
+                                          max_len=max_len)
+        self.decode_stride = max(1, int(decode_stride))
+        if warmup:
+            # pay every decode-program compile HERE (replica init — the
+            # controller's readiness probe covers it) instead of at the
+            # first request of each occupancy level
+            self._batcher.warmup(
+                strides=(1, self.decode_stride) if self.decode_stride > 1
+                else (1,))
+        self.max_slots = max_slots
+        self.max_len = max_len
+        self._on_tick = on_tick
+        self._pending: "deque[_EngineRequest]" = deque()
+        self._live: Dict[int, _EngineRequest] = {}  # req_id -> request
+        self._admitting: Optional[_EngineRequest] = None  # mid-prefill
+        self._lock = threading.Lock()
+        self._work = threading.Condition(self._lock)
+        self._stopped = False
+        self._dead: Optional[str] = None  # fatal engine error, if any
+        self._steps = 0
+        self._admitted = 0
+        self._tokens_out = 0
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="rt-cb-engine")
+        self._thread.start()
+
+    # -- client side ------------------------------------------------------
+
+    def submit_stream(self, prompt: np.ndarray,
+                      max_new_tokens: int) -> "_queue.Queue":
+        """Queue one request; returns its token queue (ints, then the
+        ``None`` end sentinel). Admission control beyond the pending queue
+        belongs to the serving layer (``max_ongoing_requests``)."""
+        return self._submit(prompt, max_new_tokens, None).out
+
+    def submit_cb(self, prompt: np.ndarray, max_new_tokens: int,
+                  on_token: Callable[[List[Optional[int]]], None]):
+        """Callback form: ``on_token(burst)`` fires from the engine
+        thread with each tick's token burst (a list of ints; a ``None``
+        element marks end-of-stream). Zero consumer threads — an asyncio
+        server bridges with ONE ``loop.call_soon_threadsafe`` per burst
+        instead of parking an executor thread per stream on a queue (the
+        thread-starvation ceiling a 2-core box hits at ~6 streams).
+        Returns an opaque handle for :meth:`cancel`."""
+        return self._submit(prompt, max_new_tokens, on_token)
+
+    def _submit(self, prompt: np.ndarray, max_new_tokens: int,
+                on_token) -> "_EngineRequest":
+        s = len(prompt)
+        if s + max_new_tokens + 1 > self.max_len:
+            raise ValueError(f"prompt {s} + new {max_new_tokens} exceeds "
+                             f"max_len {self.max_len}")
+        req = _EngineRequest(np.asarray(prompt, np.int32), max_new_tokens,
+                             on_token)
+        with self._work:
+            if self._stopped:
+                raise RuntimeError("engine is shut down")
+            if self._dead is not None:
+                raise RuntimeError(f"engine died: {self._dead}")
+            self._pending.append(req)
+            self._work.notify()
+        return req
+
+    def cancel(self, handle) -> None:
+        """Drop a request (disconnect): pending requests unqueue, active
+        ones free their slot on the next tick. The stream still ends
+        with the ``None`` sentinel — a consumer that is NOT the
+        canceller (a supervisor thread timing the request out) must not
+        block on the queue forever. ``handle`` is the queue
+        ``submit_stream`` returned or the handle from ``submit_cb``."""
+        with self._work:
+            for req in list(self._pending):
+                if req is handle or req.out is handle:
+                    req.cancelled = True
+                    self._pending.remove(req)
+                    req.emit_many([_STREAM_END])
+                    return
+            admitting = self._admitting
+            if admitting is not None and (admitting is handle
+                                          or admitting.out is handle):
+                # mid-prefill (the engine thread runs admission outside
+                # the lock): flag it — the post-prefill bookkeeping
+                # frees the slot and ends the stream
+                admitting.cancelled = True
+                return
+            for req in self._live.values():
+                if req is handle or req.out is handle:
+                    req.cancelled = True
+                    self._work.notify()
+                    return
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            out = {"active": len(self._live),
+                   "pending": len(self._pending),
+                   "max_slots": self.max_slots,
+                   "steps": self._steps,
+                   "admitted": self._admitted,
+                   "tokens_out": self._tokens_out}
+            if self._dead is not None:
+                out["dead"] = self._dead
+            return out
+
+    def check_alive(self) -> None:
+        """Raise if the engine thread died on a fatal decode error — the
+        serve replica's health check calls this so the controller
+        replaces a wedged replica instead of routing into a black hole."""
+        with self._lock:
+            if self._dead is not None:
+                raise RuntimeError(f"continuous engine died: {self._dead}")
+
+    def shutdown(self, timeout_s: float = 5.0) -> None:
+        with self._work:
+            self._stopped = True
+            self._work.notify()
+        self._thread.join(timeout=timeout_s)
+
+    # -- the engine thread ------------------------------------------------
+
+    def _admit_all(self) -> None:
+        """Prefill pending requests into free slots. The jax prefill —
+        which can hide a multi-second XLA compile for a new prompt
+        length — runs OUTSIDE the lock, so submit/cancel/stats/
+        check_alive stay responsive while it compiles (the batcher
+        itself is engine-thread-owned and needs no lock); only the
+        pending/live bookkeeping is locked."""
+        while True:
+            with self._work:
+                # honor shutdown BEFORE paying another prefill (each can
+                # hide a multi-second compile) — the stopped branch in
+                # _run ends the remaining streams
+                if self._stopped:
+                    return
+                if not (self._pending and self._batcher._free):
+                    return
+                req = self._pending.popleft()
+                if req.cancelled:
+                    continue
+                self._admitting = req
+            try:
+                req_id, first_tok, done = self._batcher.submit_ex(
+                    req.prompt, req.max_new_tokens)
+            except Exception:  # noqa: BLE001 — ONE request's prefill
+                # failing (bad shape, transient XLA error) must fail that
+                # request, not wedge the shared engine thread
+                with self._work:
+                    self._admitting = None
+                req.emit_many([_STREAM_END])
+                continue
+            with self._work:
+                self._admitting = None
+                req.req_id = req_id
+                if req.cancelled:
+                    # cancelled mid-prefill: free the slot, end the stream
+                    if not done:
+                        self._batcher.cancel(req_id)
+                    req.emit_many([_STREAM_END])
+                    continue
+                self._admitted += 1
+                req.emit_many([first_tok, _STREAM_END] if done
+                              else [first_tok])
+                self._tokens_out += 1
+                if not done:
+                    self._live[req_id] = req
+
+    def _run(self) -> None:
+        while True:
+            with self._work:
+                # reap cancellations before admitting into their slots
+                for rid in [rid for rid, r in self._live.items()
+                            if r.cancelled]:
+                    self._batcher.cancel(rid)
+                    self._live[rid].emit_many([_STREAM_END])
+                    del self._live[rid]
+            self._admit_all()
+            with self._work:
+                if self._stopped:
+                    for req in list(self._live.values()):
+                        req.emit_many([_STREAM_END])
+                    self._live.clear()
+                    for req in list(self._pending):
+                        req.emit_many([_STREAM_END])
+                    self._pending.clear()
+                    return
+                if not self._live:
+                    self._work.wait(timeout=0.5)
+                    continue
+            # decode OUTSIDE the lock: submit/cancel stay responsive
+            # while the step runs (the jax call is the long pole).
+            # Tick stride: fuse decode_stride steps per launch while any
+            # active request still wants that many; drop to single steps
+            # for the stragglers' tail so no request overruns its budget
+            # by a whole stride of discarded work.
+            k = (self.decode_stride
+                 if self._batcher.max_remaining >= self.decode_stride
+                 else 1)
+            try:
+                emitted = self._batcher.step_many(k)
+            except Exception as e:  # noqa: BLE001 — a failed decode step
+                # poisons the shared cache state: end every stream NOW
+                # (clients see truncation, not a hang) and mark the
+                # engine dead so the replica health check fails and the
+                # controller replaces the replica
+                with self._work:
+                    self._dead = f"{type(e).__name__}: {e}"[:300]
+                    for req in list(self._live.values()):
+                        req.emit_many([_STREAM_END])
+                    self._live.clear()
+                    for req in list(self._pending):
+                        req.emit_many([_STREAM_END])
+                    self._pending.clear()
+                return
+            with self._work:
+                self._steps += 1
+                for rid, toks, done in emitted:
+                    req = self._live.get(rid)
+                    if req is None:
+                        continue  # cancelled between step and dispatch
+                    burst = [int(t) for t in toks]
+                    self._tokens_out += len(burst)
+                    if done:
+                        burst.append(_STREAM_END)
+                        del self._live[rid]
+                    req.emit_many(burst)
+                tick, cap = len(self._live), self.max_slots
+            if self._on_tick is not None:
+                try:
+                    self._on_tick(tick, cap)
+                except Exception:  # noqa: BLE001 — telemetry only
+                    pass
 
 
 @functools.lru_cache(maxsize=64)
@@ -162,11 +556,10 @@ def _compiled_slot_prefill(cfg, s: int, max_slots: int, max_len: int):
     return run
 
 
-@functools.lru_cache(maxsize=16)
-def _compiled_rowwise_step(cfg, max_slots: int, max_len: int):
-    """One decode step for ALL slots with PER-SLOT positions: vmap the
-    single-row cached forward over the slot axis — per-row rope, per-row
-    cache scatter, per-row causal masking, one compiled program."""
+def _one_row_step(cfg):
+    """The single-row cached decode body shared by the full-engine and
+    bucketed step programs: per-row rope, per-row cache scatter, per-row
+    causal masking."""
 
     def one_row(params, ck_row, cv_row, tok, pos):
         cache = {"k": ck_row[:, None], "v": cv_row[:, None]}
@@ -175,13 +568,35 @@ def _compiled_rowwise_step(cfg, max_slots: int, max_len: int):
         nxt = jnp.argmax(logits[0, -1, :]).astype(jnp.int32)
         return cache["k"][:, 0], cache["v"][:, 0], nxt
 
+    return one_row
+
+
+@functools.lru_cache(maxsize=128)
+def _compiled_bucket_scan(cfg, bucket: int, max_slots: int, max_len: int,
+                          k: int):
+    """``k`` fused decode steps for ``bucket`` ACTIVE slots out of
+    ``max_slots``: gather the occupied rows, ``lax.scan`` the vmapped
+    single-row forward ``k`` times, scatter the updated KV back, return
+    the [k, bucket] token block. One launch per K tokens per occupancy
+    bucket — the decode-side make_multi_step."""
+    one_row = _one_row_step(cfg)
+
     @jax.jit
-    def run(params, ck, cv, cur, pos):
-        ck_rows = ck.swapaxes(0, 1)  # [slots, L, T, hkv, hd]
-        cv_rows = cv.swapaxes(0, 1)
-        ck_rows, cv_rows, nxt = jax.vmap(
-            one_row, in_axes=(None, 0, 0, 0, 0))(
-            params, ck_rows, cv_rows, cur, pos)
-        return (ck_rows.swapaxes(0, 1), cv_rows.swapaxes(0, 1), nxt)
+    def run(params, ck, cv, cur, pos, idx):
+        ck_rows = ck.swapaxes(0, 1)[idx]  # [bucket, L, T, hkv, hd]
+        cv_rows = cv.swapaxes(0, 1)[idx]
+
+        def body(carry, _):
+            ck_r, cv_r, cur, pos = carry
+            ck_r, cv_r, nxt = jax.vmap(
+                one_row, in_axes=(None, 0, 0, 0, 0))(
+                params, ck_r, cv_r, cur, pos)
+            return (ck_r, cv_r, nxt, pos + 1), nxt
+
+        (ck_rows, cv_rows, _, _), toks = jax.lax.scan(
+            body, (ck_rows, cv_rows, cur, pos), None, length=k)
+        ck = ck.swapaxes(0, 1).at[idx].set(ck_rows).swapaxes(0, 1)
+        cv = cv.swapaxes(0, 1).at[idx].set(cv_rows).swapaxes(0, 1)
+        return ck, cv, toks  # [k, bucket]
 
     return run

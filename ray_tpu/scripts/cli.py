@@ -356,16 +356,23 @@ def cmd_serve(args: argparse.Namespace) -> int:
             print("no serve applications")
             if not getattr(args, "verbose", False):
                 return 0
+        proxies = st.get("proxies") or []
+        if len(proxies) > 1:
+            print("proxies: " + ", ".join(
+                f"{p.get('proxy')}:{p.get('port')}" for p in proxies))
         for app, meta in apps.items():
             print(f"app {app!r}  route={meta.get('route_prefix')}  "
                   f"ingress={meta.get('ingress')}")
             for name, d in (meta.get("deployments") or {}).items():
                 s = d.get("stats") or {}
+                cb = (f"  slots {s['cb_active']}/{s['cb_slots']}"
+                      if "cb_slots" in s else "")
                 print(f"  {name:<24} replicas {d.get('replicas', 0)}/"
                       f"{d.get('target', 0)}"
                       f"{' (+%d starting)' % d['starting'] if d.get('starting') else ''}"
                       f"  ongoing {s.get('ongoing', 0)}"
                       f"  queue {s.get('queue_depth', 0)}"
+                      f"{cb}"
                       f"  p50 {1e3 * (s.get('p50_s') or 0):.1f}ms"
                       f"  p99 {1e3 * (s.get('p99_s') or 0):.1f}ms"
                       f"  qps {s.get('qps', 0)}")
@@ -380,6 +387,7 @@ def cmd_serve(args: argparse.Namespace) -> int:
                 line = (f"  [{when}] {d['app']}/{d['deployment']} "
                         f"target {d.get('old_target')} -> "
                         f"{d.get('new_target')} ({d.get('direction')}; "
+                        f"signal={trig.get('signal', 'ongoing')} "
                         f"ongoing_avg={trig.get('ongoing_avg', 0)} "
                         f"queue={trig.get('queue_depth', 0)} "
                         f"p99={1e3 * (trig.get('p99_s') or 0):.1f}ms "

@@ -240,6 +240,45 @@ def run_envelope(actor_target: int = 1000, queued_target: int = 10_000,
             for a in actors:
                 ray_tpu.kill(a)
 
+        # ---- 6c. serve under load: continuous vs static batching --------
+        # The ROADMAP item 2 envelope leg: open-loop Poisson arrivals at
+        # equal offered load against (a) the live ContinuousBatcher
+        # deployment (slot admission, fused rowwise decode, streamed
+        # tokens) and (b) the @serve.batch control provisioned for its
+        # longest admissible request. Heterogeneous decode lengths are
+        # the point: the batch-boundary control decodes max_new for
+        # EVERY flush; slot admission decodes what each request asked.
+        with _scenario(out, "serve_under_load") as sc:
+            from ray_tpu import serve
+            from ray_tpu.serve.llm import cb_vs_static_load
+
+            short_t, long_t, frac = 2, 192, 0.08
+            rps = float(os.environ.get("RT_SCALE_SERVE_RPS", "10"))
+            secs = float(os.environ.get("RT_SCALE_SERVE_SECS", "10"))
+            try:
+                results = cb_vs_static_load(
+                    preset="debug", slots=8, max_len=256,
+                    decode_stride=16, prompt_len=8,
+                    short_tokens=short_t, long_tokens=long_t,
+                    long_frac=frac, rps=rps, duration_s=secs,
+                    num_proxies=2, route_base="env")
+                for leg, r in results.items():
+                    sc.record(**{f"{leg}_{k}": r[k] for k in
+                                 ("completed", "failed", "shed", "rps",
+                                  "tok_s", "p50_ms", "p99_ms")})
+                sc.record(offered_rps=rps, short_tokens=short_t,
+                          long_tokens=long_t, long_frac=frac,
+                          proxies=2,
+                          p99_ratio_cb_vs_static=round(
+                              results["continuous"]["p99_ms"]
+                              / max(1e-3, results["static"]["p99_ms"]),
+                              3))
+            finally:
+                try:
+                    serve.shutdown()
+                except Exception:  # noqa: BLE001
+                    pass
+
         # ---- 7. placement-group churn + simultaneous PGs ----------------
         from ray_tpu.util.placement_group import (placement_group,
                                                   remove_placement_group)

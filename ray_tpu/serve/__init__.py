@@ -33,19 +33,23 @@ from ray_tpu.serve.handle import (DeploymentHandle, DeploymentResponse,
 from ray_tpu.serve.multiplex import get_multiplexed_model_id, multiplexed
 from ray_tpu.serve.grpc_proxy import grpc_request
 from ray_tpu.serve.obs import get_serve_request_id
-from ray_tpu.serve.api import detailed_status
+from ray_tpu.serve.api import detailed_status, proxy_ports
 from ray_tpu.serve.proxy import ServeRequest
+from ray_tpu.serve.llm import (continuous_llm_app, poisson_load,
+                               static_llm_app)
 
 __all__ = [
     "ASGIResponse", "ASGIResponseStart",
     "Application", "AutoscalingConfig", "Deployment", "DeploymentConfig",
     "DeploymentHandle", "DeploymentResponse", "DeploymentResponseGenerator",
     "HTTPOptions", "ServeRequest",
-    "asgi_app", "batch", "delete", "deployment", "detailed_status",
+    "asgi_app", "batch", "continuous_llm_app", "delete", "deployment",
+    "detailed_status",
     "get_app_handle",
     "ingress",
     "get_deployment_handle", "get_multiplexed_model_id", "grpc_request",
     "get_serve_request_id",
-    "http_port", "multiplexed", "run", "shutdown", "start", "start_grpc",
+    "http_port", "multiplexed", "poisson_load", "proxy_ports", "run",
+    "shutdown", "start", "start_grpc", "static_llm_app",
     "status",
 ]

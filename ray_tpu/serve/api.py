@@ -199,17 +199,19 @@ def run(app: Application, *, name: str = "default",
         name, route_prefix or "/", ingress, deployments))
     if route_prefix is not None:
         opts = http_options or HTTPOptions()
-        ray_tpu.get(controller.ensure_proxy.remote(opts.host, opts.port))
+        ray_tpu.get(controller.ensure_proxy.remote(
+            opts.host, opts.port, opts.num_proxies))
     if _blocking:
         ray_tpu.get(controller.wait_healthy.remote(name), timeout=120)
     return DeploymentHandle(name, ingress)
 
 
 def start(http_options: Optional[HTTPOptions] = None) -> None:
-    """Start the controller (and proxy) without deploying anything."""
+    """Start the controller (and proxy fleet) without deploying anything."""
     controller = _get_controller(create=True)
     opts = http_options or HTTPOptions()
-    ray_tpu.get(controller.ensure_proxy.remote(opts.host, opts.port))
+    ray_tpu.get(controller.ensure_proxy.remote(
+        opts.host, opts.port, opts.num_proxies))
 
 
 def start_grpc(host: str = "127.0.0.1", port: int = 0) -> int:
@@ -221,9 +223,16 @@ def start_grpc(host: str = "127.0.0.1", port: int = 0) -> int:
 
 
 def http_port() -> int:
-    """The bound port of the HTTP proxy (after serve.run/start)."""
+    """The bound port of the (first) HTTP proxy (after serve.run/start)."""
     controller = _get_controller()
     return ray_tpu.get(controller.ensure_proxy.remote("127.0.0.1", 0))
+
+
+def proxy_ports() -> List[int]:
+    """Every bound HTTP proxy port, registry order (multi-proxy front
+    doors — point a load balancer at all of them)."""
+    controller = _get_controller()
+    return ray_tpu.get(controller.proxy_ports.remote())
 
 
 def get_app_handle(name: str = "default") -> DeploymentHandle:

@@ -19,12 +19,28 @@ DEFAULT_HTTP_PORT = 8123
 
 @dataclasses.dataclass
 class AutoscalingConfig:
-    """Ongoing-requests-driven autoscaling
-    (``serve/_private/autoscaling_policy.py:12``):
+    """Metrics-driven autoscaling.
+
+    Base policy (``serve/_private/autoscaling_policy.py:12``):
     desired = ceil(total_ongoing_requests / target_ongoing_requests),
     clamped to [min_replicas, max_replicas], with hysteresis delays.
     ``min_replicas=0`` enables scale-to-zero (a cold request wakes the
-    deployment through the router's wake RPC)."""
+    deployment through the router's wake RPC).
+
+    The optional signals below layer onto the windowed per-replica stats
+    the controller already polls (queue depth, latency percentiles, QPS
+    — the PR 8 observability plane); when several are set the autoscaler
+    takes the MAX desired count and the decision log records which
+    signal drove it:
+
+      - ``target_queue_depth``: admitted-but-waiting requests one
+        replica should carry; desired >= ceil(total_queue / target).
+      - ``max_p99_s``: sustained request p99 above this (at qps > 0)
+        asks for one replica more than current — a latency backstop for
+        load shapes ongoing-counts under-report (few, slow requests).
+      - ``target_qps_per_replica``: completed requests/s one replica
+        should serve; desired >= ceil(qps / target).
+    """
 
     min_replicas: int = 1
     max_replicas: int = 4
@@ -33,6 +49,9 @@ class AutoscalingConfig:
     downscale_delay_s: float = 10.0
     metrics_interval_s: float = 0.5
     look_back_period_s: float = 5.0
+    target_queue_depth: Optional[float] = None
+    max_p99_s: Optional[float] = None
+    target_qps_per_replica: Optional[float] = None
 
     def validate(self) -> None:
         if self.min_replicas < 0 or self.max_replicas < 1:
@@ -41,6 +60,11 @@ class AutoscalingConfig:
             raise ValueError("min_replicas must be <= max_replicas")
         if self.target_ongoing_requests <= 0:
             raise ValueError("target_ongoing_requests must be positive")
+        for name in ("target_queue_depth", "max_p99_s",
+                     "target_qps_per_replica"):
+            v = getattr(self, name)
+            if v is not None and v <= 0:
+                raise ValueError(f"{name} must be positive when set")
 
 
 @dataclasses.dataclass
@@ -73,3 +97,8 @@ class HTTPOptions:
     host: str = "127.0.0.1"
     port: int = DEFAULT_HTTP_PORT
     request_timeout_s: float = 60.0
+    # front-door scale-out: N independent aiohttp proxy processes (the
+    # first binds ``port``, the rest bind ephemeral ports) — every proxy
+    # registers in the GCS registry so an external LB can front them and
+    # one event loop stops being the ingress ceiling
+    num_proxies: int = 1

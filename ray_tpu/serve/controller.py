@@ -108,7 +108,10 @@ class _DeploymentState:
 
     def target_replicas(self, now: float) -> int:
         """Fixed num_replicas, or the autoscaler's desired count
-        (reference ``calculate_desired_num_replicas``)."""
+        (reference ``calculate_desired_num_replicas``), extended with the
+        optional queue-depth / p99 / QPS signals computed from the
+        windowed stats poll — desired is the MAX across enabled signals
+        and the trigger records which one drove it."""
         ac = self.autoscaling
         if ac is None:
             self.last_trigger = {"reason": "fixed",
@@ -119,6 +122,28 @@ class _DeploymentState:
         total_ongoing = (sum(m[1] for m in self.metrics) / len(self.metrics)
                          if self.metrics else 0.0)
         desired = int(-(-total_ongoing // ac.target_ongoing_requests))  # ceil
+        signal = "ongoing"
+        # continuous-batching replicas queue INSIDE the engine (every
+        # request is a stream, so the replica-level executor queue stays
+        # ~0) — the engine's pending count must feed the queue signal or
+        # the signal is blind on exactly the deployments it exists for
+        queue_depth = (self.win_stats.get("queue_depth", 0)
+                       + self.win_stats.get("cb_pending", 0))
+        p99_s = self.win_stats.get("p99_s", 0.0)
+        qps = self.win_stats.get("qps", 0.0)
+        if ac.target_queue_depth is not None and queue_depth:
+            by_queue = int(-(-queue_depth // ac.target_queue_depth))
+            if by_queue > desired:
+                desired, signal = by_queue, "queue_depth"
+        if ac.target_qps_per_replica is not None and qps:
+            by_qps = int(-(-qps // ac.target_qps_per_replica))
+            if by_qps > desired:
+                desired, signal = by_qps, "qps"
+        if (ac.max_p99_s is not None and qps > 0 and p99_s > ac.max_p99_s
+                and current + 1 > desired):
+            # latency backstop: ask for one more than we have; the
+            # hysteresis delay keeps a single slow window from thrashing
+            desired, signal = current + 1, "p99"
         woke = (self.wake_requested_at is not None
                 and now - self.wake_requested_at < 30.0)
         if woke:
@@ -127,13 +152,14 @@ class _DeploymentState:
         desired = max(ac.min_replicas, min(ac.max_replicas, desired))
         self.last_trigger = {
             "reason": "wake" if (woke and total_ongoing == 0) else "ongoing",
+            "signal": signal,
             "ongoing_avg": round(total_ongoing, 3),
             "target_ongoing_requests": ac.target_ongoing_requests,
             "look_back_period_s": ac.look_back_period_s,
-            "queue_depth": self.win_stats.get("queue_depth", 0),
+            "queue_depth": queue_depth,
             "p50_s": self.win_stats.get("p50_s", 0.0),
-            "p99_s": self.win_stats.get("p99_s", 0.0),
-            "qps": self.win_stats.get("qps", 0.0),
+            "p99_s": p99_s,
+            "qps": qps,
         }
         if desired == current:
             self.scale_candidate = None
@@ -166,6 +192,9 @@ class ServeController:
         self._grpc_proxy = None
         self._grpc_port = None
         self._proxy_port: Optional[int] = None
+        # multi-proxy scale-out: [(proxy_id, handle, port)]; entry 0 is
+        # the back-compat RT_SERVE_PROXY on the requested port
+        self._proxies: List[Tuple[str, Any, int]] = []
         self._shutdown = False
         # autoscaler decision log: every applied target change, with the
         # metric values that produced it (bounded; `rt serve status
@@ -313,6 +342,7 @@ class ServeController:
         decision-log tail."""
         return {"applications": self.list_applications(),
                 "decisions": self.get_decisions(decision_limit),
+                "proxies": self._proxy_rows(),
                 "t": time.time()}
 
     def flush_metrics(self) -> None:
@@ -342,17 +372,65 @@ class ServeController:
             return self._grpc_port
 
     # -- http proxy -----------------------------------------------------------
-    def ensure_proxy(self, host: str, port: int) -> int:
+    def ensure_proxy(self, host: str, port: int, count: int = 1) -> int:
+        """Start (up to) ``count`` HTTP proxy processes; idempotent and
+        grow-only — a later call with a larger ``count`` adds proxies,
+        a smaller one never tears running ones down (requests may be in
+        flight). The first proxy keeps the RT_SERVE_PROXY name and the
+        requested port; the rest bind ephemeral ports and register in
+        the GCS proxy registry so an external load balancer (or
+        ``serve.proxy_ports()``) can fan traffic across every event
+        loop instead of queueing behind one aiohttp process."""
         from ray_tpu.serve.proxy import ProxyActor
 
         with self._lock:
-            if self._proxy is None:
-                self._proxy = ProxyActor.options(
-                    name="RT_SERVE_PROXY", max_concurrency=256,
-                    num_cpus=0).remote()
-                self._proxy_port = ray_tpu.get(
-                    self._proxy.start.remote(host, port))
+            want = max(1, int(count))
+            while len(self._proxies) < want:
+                idx = len(self._proxies)
+                proxy_id = "proxy-0" if idx == 0 else f"proxy-{idx}"
+                name = ("RT_SERVE_PROXY" if idx == 0
+                        else f"RT_SERVE_PROXY_{idx}")
+                handle = ProxyActor.options(
+                    name=name, max_concurrency=256, num_cpus=0).remote()
+                bind_port = port if idx == 0 else 0
+                got = ray_tpu.get(handle.start.remote(host, bind_port,
+                                                      proxy_id))
+                self._proxies.append((proxy_id, handle, got))
+                if idx == 0:
+                    self._proxy, self._proxy_port = handle, got
+                self._register_proxy(proxy_id, host, got)
             return self._proxy_port
+
+    def proxy_ports(self) -> List[int]:
+        with self._lock:
+            return [p for _, _, p in self._proxies]
+
+    def _proxy_rows(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [{"proxy": pid, "port": port}
+                    for pid, _, port in self._proxies]
+
+    def _register_proxy(self, proxy_id: str, host: str, port: int) -> None:
+        """Best-effort row in the GCS proxy registry (``rt serve status``
+        and external LB config readers see every front door)."""
+        try:
+            backend = ray_tpu.global_worker()._require_backend()
+            if hasattr(backend, "_gcs"):
+                backend.io.run(backend._gcs.call(
+                    "serve_proxy_register",
+                    {"proxy_id": proxy_id, "host": host, "port": port,
+                     "pid": None}))
+        except Exception:  # noqa: BLE001 — registry is advisory
+            pass
+
+    def _deregister_proxies(self) -> None:
+        try:
+            backend = ray_tpu.global_worker()._require_backend()
+            if hasattr(backend, "_gcs"):
+                backend.io.run(backend._gcs.call(
+                    "serve_proxy_deregister", {"proxy_id": "*"}))
+        except Exception:  # noqa: BLE001
+            pass
 
     # -- reconcile ------------------------------------------------------------
     def _reconcile_loop(self) -> None:
@@ -527,6 +605,8 @@ class ServeController:
         qps = 0.0
         window_s = 30.0
         lats: List[float] = []
+        cb = {"active": 0, "max_slots": 0, "pending": 0}
+        cb_seen = False
         if reps:
             refs = [r.handle.stats_window.remote(window_s) for r in reps]
             ready, _ = ray_tpu.wait(refs, num_returns=len(refs), timeout=2.0)
@@ -543,6 +623,14 @@ class ServeController:
                         qps += (st.get("completed", 0)
                                 / max(1e-3, st.get("window_s", window_s)))
                         lats.extend(st.get("latencies") or ())
+                        eng = st.get("engine")
+                        if eng:
+                            # continuous-batching engines report slot
+                            # occupancy; the sum is the deployment's
+                            # live decode capacity picture
+                            cb_seen = True
+                            for k in cb:
+                                cb[k] += eng.get(k, 0)
                     except Exception:  # noqa: BLE001 — health check handles it
                         pass
         lats.sort()
@@ -551,6 +639,10 @@ class ServeController:
                "qps": round(qps, 3),
                "p50_s": round(_percentile(lats, 0.50), 6),
                "p99_s": round(_percentile(lats, 0.99), 6)}
+        if cb_seen:
+            win["cb_active"] = cb["active"]
+            win["cb_slots"] = cb["max_slots"]
+            win["cb_pending"] = cb["pending"]
         with self._lock:
             s.win_stats = win
             s.metrics.append((now, total_ongoing))
@@ -662,13 +754,15 @@ class ServeController:
             pass
         with self._update_cond:
             self._update_cond.notify_all()  # release blocked long-polls
+        self._deregister_proxies()
         with self._lock:
             for key in list(self._deployments):
                 self._stop_deployment(self._deployments.pop(key))
             self._apps.clear()
-            proxy, self._proxy = self._proxy, None
+            proxies, self._proxies = list(self._proxies), []
+            self._proxy = None
             gproxy, self._grpc_proxy = self._grpc_proxy, None
-        if proxy is not None:
+        for _, proxy, _ in proxies:
             try:
                 ray_tpu.get(proxy.stop.remote())
                 ray_tpu.kill(proxy)

@@ -225,14 +225,34 @@ def _shared_pool() -> ThreadPoolExecutor:
 
 
 def _reset_pool() -> None:
-    """Drop the shared pool on serve shutdown: calls stranded mid-RPC against
-    a dead cluster must not occupy slots and starve the next serve instance
-    (one bounded pool is shared process-wide)."""
-    global _pool
+    """Drop the shared pools on serve shutdown: calls stranded mid-RPC
+    against a dead cluster must not occupy slots and starve the next serve
+    instance (one bounded pool is shared process-wide)."""
+    global _pool, _stream_pool
     with _pool_lock:
         old, _pool = _pool, None
+        old_stream, _stream_pool = _stream_pool, None
     if old is not None:
         old.shutdown(wait=False)
+    if old_stream is not None:
+        old_stream.shutdown(wait=False)
+
+
+# streaming pulls get their OWN wide pool: each live stream parks one
+# thread in a blocking next_chunks RPC, and sharing the loop's default
+# executor (~cpu+4 threads) capped concurrent streams at a handful —
+# the proxy's token path would serialize under exactly the load the
+# continuous batcher exists to absorb
+_stream_pool: Optional[ThreadPoolExecutor] = None
+
+
+def _stream_executor() -> ThreadPoolExecutor:
+    global _stream_pool
+    with _pool_lock:
+        if _stream_pool is None:
+            _stream_pool = ThreadPoolExecutor(
+                max_workers=128, thread_name_prefix="rt-serve-stream")
+        return _stream_pool
 
 
 class DeploymentResponseGenerator:
@@ -257,8 +277,12 @@ class DeploymentResponseGenerator:
             if self._done:
                 raise StopIteration
             try:
+                # wide pulls: the replica returns whatever the stream has
+                # already produced (blocking only for the first item), so
+                # a large max_items batches token bursts into one RPC
+                # without delaying a steady trickle
                 items, done = ray_tpu.get(self._actor.next_chunks.remote(
-                    self._stream_id))
+                    self._stream_id, 64))
             except Exception:
                 self._done = True
                 self._router.complete(self._rid)
@@ -285,11 +309,24 @@ class DeploymentResponseGenerator:
             return self._END
 
     async def __anext__(self):
+        if self._buf:
+            # burst fast path: a wide pull buffered several chunks —
+            # hand them out without a thread hop per item (the executor
+            # round trip costs more than the token at streaming rates)
+            return self._buf.pop(0)
         loop = asyncio.get_running_loop()
-        item = await loop.run_in_executor(None, self._next_or_end)
+        item = await loop.run_in_executor(_stream_executor(),
+                                          self._next_or_end)
         if item is self._END:
             raise StopAsyncIteration
         return item
+
+    def drain_buffered(self) -> List[Any]:
+        """Chunks already pulled from the replica and buffered locally —
+        consumers that can write a burst at once (the proxy's stream
+        path) take them without per-item awaits."""
+        out, self._buf = self._buf, []
+        return out
 
     def cancel(self) -> None:
         if not self._done:

@@ -221,6 +221,21 @@ def batch_occupancy_hist() -> M.Histogram:
         tag_keys=("fn",)))
 
 
+def cb_slots_gauge() -> M.Gauge:
+    return _metric("cb_slots", lambda: M.get_or_create(
+        M.Gauge, "rt_serve_cb_slots_active",
+        "Continuous-batching decode slots occupied per engine tick "
+        "(serve/llm.py ContinuousLLM)",
+        tag_keys=("deployment",)))
+
+
+def proxy_requests_total() -> M.Counter:
+    return _metric("proxy_requests", lambda: M.get_or_create(
+        M.Counter, "rt_proxy_requests_total",
+        "Requests handled per HTTP proxy process (multi-proxy spread)",
+        tag_keys=("proxy",)))
+
+
 def mux_requests_total() -> M.Counter:
     return _metric("mux_requests", lambda: M.get_or_create(
         M.Counter, "rt_serve_mux_requests_total",

@@ -100,6 +100,7 @@ class ProxyActor:
         self._site = None
         self._port: Optional[int] = None
         self._requests_served = 0
+        self._proxy_id = "proxy-0"
         self._poller_started = False
         self._stopped = False
         # healthz honesty: a load balancer must see a proxy whose route
@@ -110,9 +111,11 @@ class ProxyActor:
         self._route_stale_s = float(
             os.environ.get("RT_SERVE_ROUTE_STALE_S", "30"))
 
-    async def start(self, host: str, port: int) -> int:
+    async def start(self, host: str, port: int,
+                    proxy_id: str = "proxy-0") -> int:
         from aiohttp import web
 
+        self._proxy_id = proxy_id
         app = web.Application(client_max_size=64 * 1024 * 1024)
         app.router.add_route("*", "/{tail:.*}", self._handle)
         self._runner = web.AppRunner(app, access_log=None)
@@ -220,6 +223,8 @@ class ProxyActor:
             "app": app, "deployment": deployment, "route": route,
             "code": str(code)})
         obs.requests_total().inc(tags={"app": app, "code": str(code)})
+        # per-process spread check for multi-proxy front doors
+        obs.proxy_requests_total().inc(tags={"proxy": self._proxy_id})
         if code >= 500:
             obs.errors_total().inc(tags={
                 "app": app, "deployment": deployment, "kind": "http_5xx"})
@@ -552,13 +557,23 @@ class ProxyActor:
             n_chunks += 1
             t_prev = now
 
+        drain = getattr(it, "drain_buffered", None)
         try:
             if pending_first is not _NO_CHUNK:
                 await resp.write(encode(pending_first))
                 note_chunk()
             async for chunk in it:
-                await resp.write(encode(chunk))
+                payload = encode(chunk)
                 note_chunk()
+                if drain is not None:
+                    # write coalescing: a continuous-batching engine
+                    # emits token BURSTS (one per fused decode tick) —
+                    # ship what is already buffered in ONE write instead
+                    # of a chunked-transfer frame + syscall per token
+                    for extra in drain():
+                        payload += encode(extra)
+                        note_chunk()
+                await resp.write(payload)
         except Exception:  # noqa: BLE001 — mid-stream failure: cut the body
             gen.cancel()
         finally:
@@ -581,7 +596,8 @@ class ProxyActor:
         metrics.flush_now()
 
     def stats(self) -> Dict[str, Any]:
-        return {"port": self._port, "requests_served": self._requests_served,
+        return {"port": self._port, "proxy_id": self._proxy_id,
+                "requests_served": self._requests_served,
                 "route_table_age_s": time.time() - (self._last_route_ok
                                                     or self._started_at),
                 "controller_reachable": self._poll_ok}

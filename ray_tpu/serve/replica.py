@@ -401,13 +401,23 @@ class ReplicaActor:
         eff_window_s = window_s
         if saturated and window:
             eff_window_s = min(window_s, max(1e-3, now - window[0][0]))
-        return {"replica_id": self._replica_id,
-                "ongoing": self._ongoing,
-                "queue_depth": max(0, self._ongoing - self._executing
-                                   - len(self._streams)),
-                "completed": len(lats),
-                "window_s": eff_window_s,
-                "latencies": lats[-200:]}
+        out = {"replica_id": self._replica_id,
+               "ongoing": self._ongoing,
+               "queue_depth": max(0, self._ongoing - self._executing
+                                  - len(self._streams)),
+               "completed": len(lats),
+               "window_s": eff_window_s,
+               "latencies": lats[-200:]}
+        # duck-typed engine surface (serve/llm.py ContinuousLLM): a
+        # continuous-batching instance reports slot occupancy, which the
+        # controller aggregates into win_stats / `rt serve status`
+        eng_fn = getattr(self._instance, "engine_stats", None)
+        if eng_fn is not None:
+            try:
+                out["engine"] = eng_fn()
+            except Exception:  # noqa: BLE001 — stats are advisory
+                pass
+        return out
 
     def flush_metrics(self) -> None:
         """Push this replica's metric registry + buffered serve spans now

@@ -120,6 +120,10 @@ def test_max_ongoing_rejection_and_retry(serve_cluster):
 
 
 def test_autoscaling_up_under_load_and_down(serve_cluster):
+    """Deterministic load ramp: sustained in-flight load scales the
+    deployment up; drain + hysteresis scales it back down — and BOTH
+    directions land in the decision log with their trigger values while
+    ``rt_serve_autoscale_decisions_total`` advances."""
     @serve.deployment(
         max_ongoing_requests=2,
         autoscaling_config=dict(min_replicas=1, max_replicas=3,
@@ -155,6 +159,108 @@ def test_autoscaling_up_under_load_and_down(serve_cluster):
             break
         time.sleep(0.5)
     assert serve.status()["auto"]["deployments"]["Work"]["replicas"] == 1
+
+    # the decision log carries both directions with trigger values
+    decisions = serve.detailed_status()["decisions"]
+    ups = [d for d in decisions if d["deployment"] == "Work"
+           and d["direction"] == "up"]
+    downs = [d for d in decisions if d["deployment"] == "Work"
+             and d["direction"] == "down"]
+    assert ups and downs, decisions
+    up_trig = ups[0]["trigger"]
+    assert up_trig.get("ongoing_avg", 0) > 0, up_trig
+    assert "signal" in up_trig and "qps" in up_trig, up_trig
+    assert downs[-1]["new_target"] == 1, downs[-1]
+    # the counter advanced for both directions
+    controller = serve.api._get_controller()
+    ray_tpu.get(controller.flush_metrics.remote())
+    from ray_tpu.util.metrics import metrics_text
+
+    lines = [ln for ln in metrics_text().splitlines()
+             if ln.startswith("rt_serve_autoscale_decisions_total")
+             and 'deployment="Work"' in ln]
+    by_dir = {("up" if 'direction="up"' in ln else
+               "down" if 'direction="down"' in ln else "other"):
+              float(ln.rsplit(" ", 1)[1]) for ln in lines}
+    assert by_dir.get("up", 0) >= 1 and by_dir.get("down", 0) >= 1, lines
+
+
+def test_autoscaler_metric_signals_unit():
+    """Queue-depth / p99 / QPS signals drive desired replicas (pure
+    unit: synthetic windowed stats, no cluster) and the trigger records
+    which signal won."""
+    from ray_tpu.serve.config import AutoscalingConfig, DeploymentConfig
+    from ray_tpu.serve.controller import _DeploymentState
+
+    def state(**ac):
+        cfg = DeploymentConfig(autoscaling_config=AutoscalingConfig(
+            min_replicas=1, max_replicas=8, target_ongoing_requests=100.0,
+            upscale_delay_s=0.0, downscale_delay_s=0.0, **ac))
+        cfg.validate()
+        return _DeploymentState("app", "d", cfg, None, (), {})
+
+    now = 1000.0
+    # queue depth: 9 queued / target 2 -> ceil = 5
+    s = state(target_queue_depth=2.0)
+    s.win_stats = {"queue_depth": 9, "p99_s": 0.0, "qps": 1.0}
+    assert s.target_replicas(now) == 5
+    assert s.last_trigger["signal"] == "queue_depth", s.last_trigger
+    assert s.last_trigger["queue_depth"] == 9
+
+    # qps: 70 qps / 20 per replica -> 4
+    s = state(target_qps_per_replica=20.0)
+    s.win_stats = {"queue_depth": 0, "p99_s": 0.0, "qps": 70.0}
+    assert s.target_replicas(now) == 4
+    assert s.last_trigger["signal"] == "qps"
+
+    # p99 backstop: sustained p99 over the bound asks for current+1
+    s = state(max_p99_s=0.5)
+    s.win_stats = {"queue_depth": 0, "p99_s": 1.2, "qps": 3.0}
+    s.replicas = {"r0": object(), "r1": object()}
+    assert s.target_replicas(now) == 3
+    assert s.last_trigger["signal"] == "p99"
+    assert s.last_trigger["p99_s"] == 1.2
+
+    # p99 at qps == 0 must NOT scale (idle deployments have no latency)
+    s = state(max_p99_s=0.5)
+    s.win_stats = {"queue_depth": 0, "p99_s": 9.9, "qps": 0.0}
+    assert s.target_replicas(now) == 1
+    assert s.last_trigger["signal"] == "ongoing"
+
+    # max_replicas clamps the strongest signal
+    s = state(target_queue_depth=1.0)
+    s.win_stats = {"queue_depth": 1000, "p99_s": 0.0, "qps": 0.0}
+    assert s.target_replicas(now) == 8
+
+    # validation rejects nonpositive signal targets
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError):
+        AutoscalingConfig(target_queue_depth=0).validate()
+
+
+def test_multi_proxy_front_doors(serve_cluster):
+    """num_proxies=2: both proxies serve the app, proxy_ports() lists
+    both, and detailed_status carries the registry rows."""
+    import requests
+
+    @serve.deployment
+    def hello(request):
+        return {"ok": True}
+
+    serve.run(hello.bind(), name="mp", route_prefix="/mp",
+              http_options=serve.HTTPOptions(port=0, num_proxies=2))
+    ports = serve.proxy_ports()
+    assert len(ports) == 2 and len(set(ports)) == 2, ports
+    assert serve.http_port() == ports[0]
+    for p in ports:
+        r = requests.get(f"http://127.0.0.1:{p}/mp/", timeout=30)
+        assert r.status_code == 200, (p, r.text)
+        assert requests.get(f"http://127.0.0.1:{p}/-/healthz",
+                            timeout=10).text == "ok"
+    rows = serve.detailed_status()["proxies"]
+    assert [r["port"] for r in rows] == ports, rows
+    assert rows[0]["proxy"] == "proxy-0"
 
 
 def test_scale_to_zero_and_wake(serve_cluster):

@@ -3,15 +3,23 @@
 The module docstring's claim — each request's output is EXACTLY
 ``generate.generate`` on its own prompt, regardless of what else shares the
 batch — asserted under interleaved admissions (ADVICE round 5: the engine
-must not ship as untested parity evidence)."""
+must not ship as untested parity evidence), through the fused K-step tick
+path (``step_many``), through the threaded ``ContinuousEngine``, and all
+the way through a serve deployment: N concurrent streamed requests with
+staggered arrivals must be byte-identical to sequential ``generate`` while
+the batch-occupancy histograms move."""
+
+import threading
+import time
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from ray_tpu.models import generate as G
 from ray_tpu.models import llama
-from ray_tpu.models.serving import ContinuousBatcher
+from ray_tpu.models.serving import ContinuousBatcher, ContinuousEngine
 
 
 def _expected(params, cfg, prompt: np.ndarray, n: int):
@@ -64,3 +72,154 @@ def test_continuous_batcher_slot_reuse_stays_exact():
 
     assert first[r1] == _expected(params, cfg, p1, 6)
     assert second[r2] == _expected(params, cfg, p2, 9)
+
+
+def test_step_many_fused_ticks_stay_exact():
+    """K fused decode steps per launch (the decode-side make_multi_step)
+    emit the same tokens as K single steps — including a short request
+    finishing mid-tick with its surplus tokens discarded."""
+    cfg = llama.PRESETS["debug"]
+    params = llama.init_params(jax.random.key(2), cfg)
+    eng = ContinuousBatcher(params, cfg, max_slots=4, max_len=64)
+    rng = np.random.default_rng(3)
+    p_long = rng.integers(0, cfg.vocab_size, size=6).astype(np.int32)
+    p_short = rng.integers(0, cfg.vocab_size, size=5).astype(np.int32)
+    r_long = eng.submit(p_long, 13)
+    r_short = eng.submit(p_short, 3)  # finishes mid-tick (k=4)
+
+    got = {r_long: [], r_short: []}
+    # re-read the prefill token the engine recorded
+    for req in eng._active.values():
+        got[req.req_id] = list(req.tokens)
+    while eng.num_active:
+        for rid, toks, _done in eng.step_many(4):
+            got[rid].extend(toks)
+    assert got[r_long] == _expected(params, cfg, p_long, 13)
+    assert got[r_short] == _expected(params, cfg, p_short, 3)
+
+
+def test_continuous_engine_concurrent_streams_exact():
+    """The threaded engine: concurrent submitters with staggered arrivals
+    each stream back exactly their own greedy continuation; cancel frees
+    the slot."""
+    cfg = llama.PRESETS["debug"]
+    params = llama.init_params(jax.random.key(4), cfg)
+    eng = ContinuousEngine(params, cfg, max_slots=2, max_len=64,
+                           decode_stride=4, warmup=False)
+    try:
+        rng = np.random.default_rng(5)
+        prompts = [rng.integers(0, cfg.vocab_size, size=s).astype(np.int32)
+                   for s in (5, 7, 6)]
+        wants = [9, 6, 11]
+        outs = {}
+
+        def consume(i, delay):
+            time.sleep(delay)
+            q = eng.submit_stream(prompts[i], wants[i])
+            toks = []
+            while True:
+                t = q.get(timeout=60)
+                if t is None:
+                    break
+                toks.append(t)
+            outs[i] = toks
+
+        # 3 requests, 2 slots: the third queues until a slot frees —
+        # admission happens mid-flight of the other streams
+        threads = [threading.Thread(target=consume, args=(i, d))
+                   for i, d in ((0, 0.0), (1, 0.05), (2, 0.1))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        for i in range(3):
+            assert outs[i] == _expected(params, cfg, prompts[i],
+                                        wants[i]), i
+        st = eng.stats()
+        assert st["admitted"] == 3 and st["active"] == 0
+        # cancel: a pending request unqueues without producing tokens
+        q_c = eng.submit_stream(prompts[0], 5)
+        eng.cancel(q_c)
+    finally:
+        eng.shutdown()
+
+
+@pytest.fixture
+def serve_cluster():
+    import ray_tpu
+    from ray_tpu import serve
+
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=6, num_tpus=4)
+    yield ray_tpu
+    try:
+        serve.shutdown()
+    finally:
+        serve._forget_controller_for_tests()
+        ray_tpu.shutdown()
+
+
+def test_serve_path_staggered_streams_token_exact(serve_cluster):
+    """The full serve deployment path (ISSUE 9 tentpole contract):
+    N concurrent streamed requests with staggered arrivals through a
+    ContinuousLLM deployment produce byte-identical token sequences to
+    sequential ``generate``, and the slot-occupancy histograms move."""
+    import ray_tpu
+    from ray_tpu import serve
+
+    cfg = llama.PRESETS["debug"]
+    params = llama.init_params(jax.random.key(0), cfg)
+
+    app = serve.continuous_llm_app(
+        "debug", max_slots=4, max_len=64, decode_stride=4, name="CB",
+        max_ongoing_requests=16, seed=0)
+    h = serve.run(app, name="cbx", route_prefix=None)
+
+    rng = np.random.default_rng(11)
+    prompts = [rng.integers(0, cfg.vocab_size, size=s).astype(np.int32)
+               for s in (5, 8, 6, 7, 4, 9)]
+    wants = [12, 5, 9, 1, 15, 7]
+    outs = {}
+
+    def consume(i, delay):
+        time.sleep(delay)
+        gen = h.remote({"tokens": prompts[i].tolist(),
+                        "max_new_tokens": wants[i]}).result(timeout=120)
+        outs[i] = list(gen)
+
+    threads = [threading.Thread(target=consume, args=(i, 0.08 * i))
+               for i in range(len(prompts))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=180)
+
+    for i in range(len(prompts)):
+        assert outs.get(i) == _expected(params, cfg, prompts[i],
+                                        wants[i]), i
+
+    # occupancy telemetry: the engine ticked with >0 slots busy and the
+    # cb:* batch histograms recorded it
+    rep = ray_tpu.get_actor("RT_SERVE:cbx#CB#0")
+    ray_tpu.get(rep.flush_metrics.remote(), timeout=30)
+    from ray_tpu.util.metrics import metrics_text
+
+    text = metrics_text()
+    occ = [ln for ln in text.splitlines()
+           if ln.startswith("rt_serve_batch_occupancy_count")
+           and 'fn="cb:CB"' in ln]
+    assert occ and any(float(ln.rsplit(" ", 1)[1]) > 0 for ln in occ), \
+        "cb occupancy histogram did not move"
+    slots = [ln for ln in text.splitlines()
+             if ln.startswith("rt_serve_cb_slots_active")]
+    assert slots, "cb slots gauge missing from the push"
+    # engine stats surfaced through the controller's windowed poll
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        stats = (serve.detailed_status()["applications"]["cbx"]
+                 ["deployments"]["CB"]["stats"])
+        if "cb_slots" in stats:
+            break
+        time.sleep(0.5)
+    assert stats.get("cb_slots") == 4, stats
