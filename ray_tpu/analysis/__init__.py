@@ -1,0 +1,43 @@
+"""``rt lint`` — the concurrency- and runtime-invariant static-analysis plane.
+
+Reference analog: the C++ core enforces its threading invariants with
+compile-time tooling (``ABSL_GUARDED_BY``, thread-check annotations,
+event-loop discipline lints); this package is the Python twin for a
+runtime whose worst bug classes have all been *invariant* violations a
+targeted AST pass would have caught before review:
+
+  - a lock acquired from weakref-finalizer/GC context (the object-ledger
+    self-deadlock that wedged a serve proxy for 10+ minutes),
+  - locks held across RPC / ``ray_tpu.get`` (the serve controller booting
+    proxies under the lock every status poll contends on),
+  - blocking calls on the event loop, swallowed ``CancelledError`` in
+    stream pumps, function-local imports on dispatch hot paths, and host
+    syncs inside ``jax.jit``-traced step functions.
+
+Layout:
+
+  - :mod:`ray_tpu.analysis.core` — ``Finding``, ``Checker`` registry,
+    ``ModuleInfo`` (AST + ``# rt:`` directive comments) with a per-file
+    mtime-keyed cache shared by every checker;
+  - :mod:`ray_tpu.analysis.baseline` — the committed suppression file
+    (``scripts/lint_baseline.json``): existing debt is *ratcheted* — new
+    findings fail, baselined ones are tracked and burned down;
+  - :mod:`ray_tpu.analysis.runner` — discovery + orchestration +
+    the ``rt lint [--json] [--baseline-update] [paths...]`` CLI;
+  - :mod:`ray_tpu.analysis.checkers` — the project-specific checkers.
+
+Inline escape hatch (for *deliberate, reviewed* idioms only — legacy debt
+belongs in the baseline where it stays visible):
+
+  some_call()  # rt: lint-allow(checker-name) why this is safe
+"""
+
+from ray_tpu.analysis.core import (  # noqa: F401
+    Checker,
+    Finding,
+    ModuleInfo,
+    all_checkers,
+    load_module,
+    register,
+)
+from ray_tpu.analysis.runner import run_lint  # noqa: F401

@@ -1,0 +1,84 @@
+"""Baseline suppression file: the ratchet.
+
+The committed file (``scripts/lint_baseline.json``) maps finding
+fingerprints to the count of pre-existing occurrences. A run fails only
+on occurrences *beyond* the baselined count — new debt can't land, old
+debt stays visible (``rt lint`` prints the suppressed tally) and burns
+down: ``--baseline-update`` rewrites the file to current reality, which
+CI diffs will only ever show shrinking unless a PR explicitly argues for
+new suppressions.
+
+Fingerprints are line-independent (checker/path/scope/detail), so
+mechanical edits that shift code don't churn the file.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+from typing import Dict, List, Tuple
+
+from ray_tpu.analysis.core import Finding, REPO_ROOT
+
+DEFAULT_BASELINE = os.path.join(REPO_ROOT, "scripts", "lint_baseline.json")
+
+
+def load(path: str) -> Dict[str, int]:
+    if not os.path.exists(path):
+        return {}
+    with open(path, encoding="utf-8") as f:
+        doc = json.load(f)
+    sup = doc.get("suppressions", {})
+    if not isinstance(sup, dict):
+        raise ValueError(f"{path}: 'suppressions' must be an object")
+    return {str(k): int(v) for k, v in sup.items()}
+
+
+def save(path: str, findings: List[Finding]) -> Dict[str, int]:
+    counts: Dict[str, int] = collections.Counter(
+        f.fingerprint() for f in findings)
+    doc = {
+        "comment": "rt lint ratchet: pre-existing findings, tracked for "
+                   "burn-down. New findings FAIL; shrink this file with "
+                   "`rt lint --baseline-update` after paying debt down. "
+                   "Growing it is a reviewed decision, not a reflex.",
+        "version": 1,
+        "suppressions": {k: counts[k] for k in sorted(counts)},
+    }
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(doc, f, indent=1, sort_keys=False)
+        f.write("\n")
+    os.replace(tmp, path)
+    return dict(counts)
+
+
+def split(findings: List[Finding], baseline: Dict[str, int]
+          ) -> Tuple[List[Finding], List[Finding], Dict[str, int]]:
+    """-> (new, suppressed, stale) against the baseline counts.
+
+    Occurrences of a fingerprint beyond its baselined count are *new*
+    (the ones with the highest line numbers — later additions — are the
+    ones reported). ``stale`` maps fingerprints whose baseline count
+    exceeds reality — debt that was paid down; ``--baseline-update``
+    clears it.
+    """
+    by_fp: Dict[str, List[Finding]] = collections.defaultdict(list)
+    for f in findings:
+        by_fp[f.fingerprint()].append(f)
+    new: List[Finding] = []
+    suppressed: List[Finding] = []
+    stale: Dict[str, int] = {}
+    for fp, group in by_fp.items():
+        allowed = baseline.get(fp, 0)
+        group.sort(key=lambda f: f.line)
+        suppressed.extend(group[:allowed])
+        new.extend(group[allowed:])
+        if allowed > len(group):
+            stale[fp] = allowed - len(group)
+    for fp, count in baseline.items():
+        if fp not in by_fp:
+            stale[fp] = count
+    new.sort(key=lambda f: (f.path, f.line))
+    return new, suppressed, stale

@@ -1,0 +1,12 @@
+"""Bundled checkers. Importing this package registers every checker with
+the framework registry (``core.all_checkers`` does it for you)."""
+
+from ray_tpu.analysis.checkers import (  # noqa: F401
+    event_loop,
+    except_discipline,
+    guarded_by,
+    hot_path,
+    jax_purity,
+    lock_discipline,
+    metrics_doc,
+)

@@ -1,0 +1,70 @@
+"""event-loop-blocking: no synchronous stalls inside ``async def``.
+
+A blocking call in a coroutine freezes every task sharing the loop — in
+the serve plane that's the proxy (all in-flight HTTP requests), the
+handle router, and the replica pump; in the control plane it's the
+raylet/GCS RPC servers. The rule flags known thread-blockers inside
+``async def`` bodies: ``time.sleep``, blocking ``ray_tpu.get``/``wait``,
+subprocess calls, synchronous sockets/HTTP, and (as a warning)
+synchronous file ``open`` — small local files usually survive review,
+but they belong in an executor on hot paths.
+
+Nested ``def``s inside the coroutine are skipped: they typically run in
+executors (``run_in_executor(None, fn)``), not on the loop.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from ray_tpu.analysis.core import (
+    BLOCKING_CALLS,
+    Checker,
+    Finding,
+    ModuleInfo,
+    call_name,
+    register,
+)
+from ray_tpu.analysis.checkers.lock_discipline import _body_walk_no_defs
+
+_BLOCKING = BLOCKING_CALLS  # shared with lock-discipline: one definition
+# of "blocking", two contexts (under a held lock / on the event loop)
+
+_WARN_ONLY = {
+    "open": "loop.run_in_executor for file IO on hot paths",
+}
+
+
+@register
+class EventLoopBlocking(Checker):
+    name = "event-loop-blocking"
+    description = ("time.sleep / blocking get / sync subprocess / sync "
+                   "file+socket IO inside async def bodies")
+
+    def check_module(self, mod: ModuleInfo) -> Iterable[Finding]:
+        for qual, fn in mod.functions():
+            if not isinstance(fn, ast.AsyncFunctionDef):
+                continue
+            for node in _body_walk_no_defs(fn.body):
+                if not isinstance(node, ast.Call):
+                    continue
+                cname = call_name(node)
+                if cname is None:
+                    continue
+                if cname in _BLOCKING:
+                    severity, hint = "error", _BLOCKING[cname]
+                elif cname in _WARN_ONLY:
+                    severity, hint = "warning", _WARN_ONLY[cname]
+                else:
+                    continue
+                if mod.allowed(node.lineno, self.name):
+                    continue
+                yield Finding(
+                    checker=self.name, path=mod.relpath, line=node.lineno,
+                    severity=severity,
+                    message=(f"{cname}() inside async def {qual!r} blocks "
+                             f"the event loop (every task on this loop "
+                             f"stalls with it)"),
+                    hint=f"use {hint}",
+                    scope=qual, detail=cname)

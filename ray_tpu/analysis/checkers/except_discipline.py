@@ -1,0 +1,156 @@
+"""except-discipline: handlers that swallow cancellation or Ctrl-C.
+
+The PR 2 stream-pump leak was one of these: a pump loop's broad handler
+ate ``asyncio.CancelledError``, so ``close()`` cancelling the pump turned
+into "stream ended normally" and the consumer waited forever. In Python
+3.8+ ``CancelledError`` and ``KeyboardInterrupt`` derive from
+``BaseException`` precisely so ``except Exception`` *can't* swallow them
+— so the rule targets the handlers that still can:
+
+  - bare ``except:`` (anywhere — it has no legitimate spelling here),
+  - ``except BaseException`` / ``except KeyboardInterrupt`` /
+    ``except ...CancelledError`` **without re-raise**, but only in code
+    where swallowing wedges something: ``async def`` bodies and
+    long-running loops (``while True``-style pumps, typically thread
+    targets).
+
+Sanctioned shapes that do NOT fire:
+
+  - the handler re-raises (bare ``raise`` anywhere in its body);
+  - an earlier ``except CancelledError: ...raise`` sibling already
+    peeled cancellation off (the replica-pump idiom);
+  - the ``try`` body is a single ``await`` reaping a task that was just
+    ``.cancel()``-ed (the standard child-teardown idiom).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional
+
+from ray_tpu.analysis.core import (
+    Checker,
+    Finding,
+    ModuleInfo,
+    dotted_name,
+    register,
+)
+
+_CANCELLED = {"CancelledError", "asyncio.CancelledError",
+              "futures.CancelledError",
+              "concurrent.futures.CancelledError"}
+_SWALLOWS_CANCEL = _CANCELLED | {"BaseException", "KeyboardInterrupt"}
+
+
+def _handler_types(handler: ast.ExceptHandler) -> List[str]:
+    t = handler.type
+    if t is None:
+        return []
+    elts = t.elts if isinstance(t, ast.Tuple) else [t]
+    return [dotted_name(e) or "?" for e in elts]
+
+
+def _reraises(handler: ast.ExceptHandler) -> bool:
+    """Only a genuine re-raise counts: bare ``raise`` or ``raise e`` of
+    the bound name. ``raise Other(...) from e`` *converts* cancellation
+    into an application error — exactly the bug class — and a raise
+    inside a nested def doesn't run in the handler at all."""
+    stack = list(handler.body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            continue
+        if isinstance(node, ast.Raise):
+            if node.exc is None:
+                return True
+            if isinstance(node.exc, ast.Name) and handler.name \
+                    and node.exc.id == handler.name:
+                return True
+        stack.extend(ast.iter_child_nodes(node))
+    return False
+
+
+def _single_await_try(try_node: ast.Try) -> bool:
+    """try body is one statement that awaits something (child-reap idiom:
+    ``task.cancel(); try: await task except CancelledError: pass``)."""
+    if len(try_node.body) != 1:
+        return False
+    stmt = try_node.body[0]
+    return isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Await)
+
+
+def _earlier_cancel_reraise(try_node: ast.Try,
+                            handler: ast.ExceptHandler) -> bool:
+    for h in try_node.handlers:
+        if h is handler:
+            return False
+        if any(t in _CANCELLED for t in _handler_types(h)) and _reraises(h):
+            return True
+    return False
+
+
+def _enclosing_context(mod: ModuleInfo, node: ast.AST) -> Optional[str]:
+    """'async' / 'loop' when the handler sits where swallowing wedges:
+    an async def, or inside a ``while True``-style pump loop."""
+    parents = mod.parents()
+    cur = parents.get(node)
+    while cur is not None:
+        if isinstance(cur, ast.While) and isinstance(cur.test,
+                                                     ast.Constant) \
+                and cur.test.value:
+            return "loop"
+        if isinstance(cur, ast.AsyncFunctionDef):
+            return "async"
+        if isinstance(cur, ast.FunctionDef):
+            return None  # sync one-shot scope: broad capture is idiomatic
+        cur = parents.get(cur)
+    return None
+
+
+@register
+class ExceptDiscipline(Checker):
+    name = "except-discipline"
+    description = ("bare except, and BaseException/KeyboardInterrupt/"
+                   "CancelledError swallowed without re-raise in async or "
+                   "pump-loop code")
+
+    def check_module(self, mod: ModuleInfo) -> Iterable[Finding]:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Try):
+                continue
+            for handler in node.handlers:
+                types = _handler_types(handler)
+                line = handler.lineno
+                if mod.allowed(line, self.name):
+                    continue
+                scope = mod.scope_of(handler)
+                if handler.type is None:
+                    yield Finding(
+                        checker=self.name, path=mod.relpath, line=line,
+                        message="bare `except:` swallows CancelledError "
+                                "and KeyboardInterrupt",
+                        hint="catch Exception (or name the types); "
+                             "re-raise BaseException if you must touch it",
+                        scope=scope, detail="bare-except")
+                    continue
+                bad = [t for t in types if t in _SWALLOWS_CANCEL]
+                if not bad or _reraises(handler):
+                    continue
+                if _single_await_try(node) \
+                        or _earlier_cancel_reraise(node, handler):
+                    continue
+                ctx = _enclosing_context(mod, handler)
+                if ctx is None:
+                    continue
+                where = "async code" if ctx == "async" else \
+                    "a long-running loop"
+                yield Finding(
+                    checker=self.name, path=mod.relpath, line=line,
+                    message=(f"`except {', '.join(bad)}` without re-raise "
+                             f"in {where} — cancellation/Ctrl-C becomes a "
+                             f"swallowed error and the consumer wedges "
+                             f"(the PR 2 stream-pump leak class)"),
+                    hint="peel CancelledError off first and `raise`, or "
+                         "re-raise after cleanup",
+                    scope=scope, detail=f"swallow:{','.join(sorted(bad))}")

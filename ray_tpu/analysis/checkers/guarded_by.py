@@ -1,0 +1,200 @@
+"""guarded-by: declared lock invariants, checked at every mutation site.
+
+The lightweight Python twin of ``ABSL_GUARDED_BY``: annotate a shared
+mutable attribute where it is initialized —
+
+    self._entries = {}   # rt: guarded-by(_lock)
+
+— and the checker flags any *mutation* of ``self._entries`` (assignment,
+augmented assignment, subscript store, or a mutating method call like
+``.append``/``.pop``/``.update``) that is not lexically inside
+``with self._lock:``. Helper methods whose names end in ``_locked``
+are assumed to be called with the lock held (the repo's existing idiom:
+``_evict_locked``, ``_drain_derefs_locked``); ``__init__`` is exempt
+(no concurrent alias exists yet). Reads are deliberately not checked —
+too noisy to enforce mechanically, and the writes are where lost-update
+races live.
+
+A declaration whose named lock doesn't exist on the class is itself a
+finding: annotations must not rot.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, Optional, Set, Tuple
+
+from ray_tpu.analysis.core import (
+    Checker,
+    Finding,
+    ModuleInfo,
+    register,
+)
+
+_MUTATORS = {
+    "append", "appendleft", "add", "clear", "discard", "extend", "insert",
+    "pop", "popitem", "popleft", "remove", "reverse", "rotate", "sort",
+    "setdefault", "update",
+}
+
+
+def _walk_skip_nested_classes(cls: ast.ClassDef):
+    """Walk a class body without descending into nested ClassDefs (a
+    nested class runs the whole check for itself — attributing its
+    declarations to the outer class would cross-wire the two)."""
+    stack: list = list(ast.iter_child_nodes(cls))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, ast.ClassDef):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name) \
+            and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _class_decls(mod: ModuleInfo, cls: ast.ClassDef
+                 ) -> Dict[str, Tuple[str, int]]:
+    """attr -> (lockname, decl_line) from ``# rt: guarded-by`` comments
+    attached to ``self.attr = ...`` (methods) or ``attr = ...`` /
+    ``attr: T = ...`` (class body) lines."""
+    decls: Dict[str, Tuple[str, int]] = {}
+    for node in _walk_skip_nested_classes(cls):
+        if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+            continue
+        lock = mod.guarded.get(node.lineno)
+        if not lock:
+            continue
+        targets = node.targets if isinstance(node, ast.Assign) \
+            else [node.target]
+        for tgt in targets:
+            attr = _self_attr(tgt)
+            if attr is None and isinstance(tgt, ast.Name):
+                attr = tgt.id
+            if attr:
+                decls[attr] = (lock, node.lineno)
+    return decls
+
+
+def _class_attrs(cls: ast.ClassDef) -> Set[str]:
+    out: Set[str] = set()
+    for node in _walk_skip_nested_classes(cls):
+        if isinstance(node, (ast.Assign, ast.AnnAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for tgt in targets:
+                attr = _self_attr(tgt)
+                if attr:
+                    out.add(attr)
+                elif isinstance(tgt, ast.Name):
+                    out.add(tgt.id)
+    return out
+
+
+def _under_lock(mod: ModuleInfo, node: ast.AST, lock: str,
+                method: ast.AST) -> bool:
+    """Is ``node`` lexically inside ``with self.<lock>:`` within
+    ``method``?"""
+    parents = mod.parents()
+    cur = parents.get(node)
+    while cur is not None and cur is not method:
+        if isinstance(cur, (ast.With, ast.AsyncWith)):
+            for item in cur.items:
+                ce = item.context_expr
+                if _self_attr(ce) == lock or (
+                        isinstance(ce, ast.Name) and ce.id == lock):
+                    return True
+        cur = parents.get(cur)
+    return False
+
+
+@register
+class GuardedBy(Checker):
+    name = "guarded-by"
+    description = ("mutations of `# rt: guarded-by(_lock)`-annotated "
+                   "attributes outside `with self._lock:`")
+
+    def check_module(self, mod: ModuleInfo) -> Iterable[Finding]:
+        if not mod.guarded:
+            return
+        qn = mod.qualnames()
+        for cls_node, cls_qual in list(qn.items()):
+            if not isinstance(cls_node, ast.ClassDef):
+                continue
+            decls = _class_decls(mod, cls_node)
+            if not decls:
+                continue
+            attrs = _class_attrs(cls_node)
+            for attr, (lock, decl_line) in decls.items():
+                if lock not in attrs:
+                    yield Finding(
+                        checker=self.name, path=mod.relpath,
+                        line=decl_line, severity="warning",
+                        message=(f"guarded-by({lock}) on {cls_qual}."
+                                 f"{attr}: the class has no attribute "
+                                 f"{lock!r} — stale annotation"),
+                        hint="point the annotation at the real lock (or "
+                             "delete it)",
+                        scope=f"{cls_qual}.{attr}",
+                        detail=f"stale:{attr}->{lock}")
+            # direct methods only: a nested class re-runs this loop itself
+            for method in cls_node.body:
+                if not isinstance(method, (ast.FunctionDef,
+                                           ast.AsyncFunctionDef)):
+                    continue
+                if method.name == "__init__" \
+                        or method.name.endswith("_locked"):
+                    continue
+                yield from self._check_method(mod, cls_qual, method, decls)
+
+    def _check_method(self, mod: ModuleInfo, cls_qual: str, method: ast.AST,
+                      decls: Dict[str, Tuple[str, int]]
+                      ) -> Iterable[Finding]:
+        mqual = f"{cls_qual}.{method.name}"
+        for node in ast.walk(method):
+            attr: Optional[str] = None
+            how = ""
+            if isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for tgt in targets:
+                    a = _self_attr(tgt)
+                    if a is None and isinstance(tgt, ast.Subscript):
+                        a = _self_attr(tgt.value)
+                        if a in decls:
+                            attr, how = a, "subscript store on"
+                    elif a in decls:
+                        attr, how = a, "assignment to"
+            elif isinstance(node, ast.Delete):
+                for tgt in node.targets:
+                    a = _self_attr(tgt) or (
+                        _self_attr(tgt.value)
+                        if isinstance(tgt, ast.Subscript) else None)
+                    if a in decls:
+                        attr, how = a, "del on"
+            elif isinstance(node, ast.Call) and isinstance(node.func,
+                                                           ast.Attribute):
+                if node.func.attr in _MUTATORS:
+                    a = _self_attr(node.func.value)
+                    if a in decls:
+                        attr, how = a, f".{node.func.attr}() on"
+            if attr is None:
+                continue
+            lock, _ = decls[attr]
+            line = node.lineno
+            if mod.allowed(line, self.name) \
+                    or _under_lock(mod, node, lock, method):
+                continue
+            yield Finding(
+                checker=self.name, path=mod.relpath, line=line,
+                message=(f"{how} self.{attr} outside `with self.{lock}:` "
+                         f"(declared guarded-by({lock}))"),
+                hint=f"take self.{lock}, rename the method *_locked if "
+                     f"it is only called under the lock, or annotate the "
+                     f"line `# rt: lint-allow(guarded-by) <why>`",
+                scope=mqual, detail=f"{attr}@{method.name}")

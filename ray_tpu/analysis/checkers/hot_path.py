@@ -1,0 +1,109 @@
+"""hot-path: per-call overhead in modules on the dispatch hot path.
+
+Some modules sit on the per-task / per-token critical path: a function-
+local ``import`` there is a dict lookup + lock round-trip *per dispatch*
+(PR 7 measured and hoisted a batch of these from the raylet's dispatch
+loop), a per-call ``re.compile`` re-parses the pattern every request,
+and constructing a fresh metric object per call defeats the registry.
+
+Hot modules are declared two ways: the curated list below (the paths the
+profiler keeps showing) and a ``# rt: hot-module`` comment in the file
+itself — new hot modules self-declare without touching the checker.
+
+Deliberate lazy imports (import-cycle breaks, heavy optional deps on
+cold paths) carry ``# rt: lint-allow(hot-path) <why>``; undecided legacy
+sits in the baseline where the ratchet keeps it visible.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from ray_tpu.analysis.core import (
+    Checker,
+    Finding,
+    ModuleInfo,
+    call_name,
+    in_type_checking_block,
+    register,
+)
+
+#: modules on the per-task / per-token critical path
+HOT_MODULES = {
+    "ray_tpu/cluster/raylet.py",
+    "ray_tpu/cluster/worker_core.py",
+    "ray_tpu/models/serving.py",
+    "ray_tpu/serve/handle.py",
+    "ray_tpu/serve/proxy.py",
+    "ray_tpu/serve/replica.py",
+}
+
+#: constructing one of these per call defeats the metrics registry;
+#: ``M.get_or_create(...)`` is the sanctioned per-call idiom and is not
+#: flagged.
+_METRIC_CTORS = {"M.Gauge", "M.Counter", "M.Histogram",
+                 "metrics.Gauge", "metrics.Counter", "metrics.Histogram"}
+
+_REGEX_CTORS = {"re.compile"}
+
+
+def _in_function(mod: ModuleInfo, node: ast.AST) -> bool:
+    parents = mod.parents()
+    cur = parents.get(node)
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return True
+        cur = parents.get(cur)
+    return False
+
+
+@register
+class HotPath(Checker):
+    name = "hot-path"
+    description = ("function-local imports and per-call re.compile / "
+                   "metric construction in declared-hot modules")
+
+    def check_module(self, mod: ModuleInfo) -> Iterable[Finding]:
+        if not (mod.hot or mod.relpath in HOT_MODULES):
+            return
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.Import, ast.ImportFrom)):
+                if not _in_function(mod, node) \
+                        or in_type_checking_block(mod, node) \
+                        or mod.allowed(node.lineno, self.name):
+                    continue
+                if isinstance(node, ast.ImportFrom):
+                    what = f"from {node.module or '.'} import " + \
+                        ", ".join(a.name for a in node.names)
+                    target = node.module or "."
+                else:
+                    what = "import " + ", ".join(a.name for a in node.names)
+                    target = node.names[0].name
+                yield Finding(
+                    checker=self.name, path=mod.relpath, line=node.lineno,
+                    message=(f"function-local `{what}` in hot module "
+                             f"(sys.modules lookup + import lock per call)"),
+                    hint="hoist to module level; if it breaks an import "
+                         "cycle, say so with "
+                         "`# rt: lint-allow(hot-path) <why>`",
+                    scope=mod.scope_of(node), detail=f"import:{target}")
+            elif isinstance(node, ast.Call):
+                cname = call_name(node)
+                if cname in _REGEX_CTORS:
+                    kind, hint = "re.compile", "compile once at module level"
+                elif cname in _METRIC_CTORS:
+                    kind, hint = cname, \
+                        "use M.get_or_create (the registry idiom) or " \
+                        "hoist the instrument to module/init scope"
+                else:
+                    continue
+                if not _in_function(mod, node) \
+                        or mod.allowed(node.lineno, self.name):
+                    continue
+                yield Finding(
+                    checker=self.name, path=mod.relpath, line=node.lineno,
+                    message=(f"per-call {kind}(...) in hot module — "
+                             f"constructed on every invocation"),
+                    hint=hint, scope=mod.scope_of(node),
+                    detail=f"ctor:{cname}")

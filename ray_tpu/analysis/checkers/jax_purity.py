@@ -1,0 +1,207 @@
+"""jax-purity: host syncs and nondeterminism inside traced step functions.
+
+A ``@jax.jit``/``pjit`` body runs at *trace* time: ``.item()`` /
+``np.asarray`` / ``block_until_ready`` force a device→host sync (or a
+ConcretizationError), ``time.time``/``random`` bake one trace-time value
+into the compiled program forever, and a Python ``if`` on a traced value
+can't be staged at all. On the MFU-gap arc the step path is exactly where
+an accidental host sync costs the most — a single ``.item()`` inside the
+fused train step serializes every dispatch behind a device round-trip.
+
+Detection: functions decorated with ``jax.jit``/``jit``/``pjit`` (bare,
+called, or via ``partial(jax.jit, ...)``) plus module-level
+``f = jax.jit(g)`` rebinds. Flags inside those bodies (nested helpers
+included — they inline into the same trace):
+
+  - host syncs: ``.item()``, ``.block_until_ready()``, ``np.asarray``,
+    ``np.array``, ``jax.device_get``, ``float()``/``int()`` casts;
+  - nondeterminism: ``time.time``/``perf_counter``, stdlib ``random.*``,
+    ``np.random.*`` (use ``jax.random`` with explicit keys);
+  - (warning) ``print`` — runs once at trace time; use
+    ``jax.debug.print``;
+  - (warning) a Python ``if``/``while`` testing a *parameter* of the
+    jitted function — a tracer there raises at trace time; hoist to
+    ``lax.cond``/``jnp.where`` or mark the arg static.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Set
+
+from ray_tpu.analysis.core import (
+    Checker,
+    Finding,
+    ModuleInfo,
+    call_name,
+    dotted_name,
+    register,
+)
+
+_JIT_NAMES = {"jax.jit", "jit", "pjit", "jax.pjit", "pjit.pjit"}
+_PARTIAL_NAMES = {"partial", "functools.partial"}
+
+_HOST_SYNC_CALLS = {"np.asarray", "np.array", "numpy.asarray",
+                    "numpy.array", "jax.device_get", "onp.asarray",
+                    "float", "int", "bool"}
+_HOST_SYNC_METHODS = {"item", "block_until_ready", "tolist"}
+_NONDET_CALLS = {"time.time", "time.perf_counter", "time.monotonic",
+                 "time.time_ns"}
+_NONDET_PREFIXES = ("random.", "np.random.", "numpy.random.")
+
+
+def _decorator_static_args(dec: ast.AST) -> Set[str]:
+    """static_argnames from a jit decorator call, when spelled literally."""
+    if not isinstance(dec, ast.Call):
+        return set()
+    out: Set[str] = set()
+    for kw in dec.keywords:
+        if kw.arg == "static_argnames":
+            v = kw.value
+            elts = v.elts if isinstance(v, (ast.Tuple, ast.List)) else [v]
+            for e in elts:
+                if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                    out.add(e.value)
+    return out
+
+
+def _is_jit_decorator(dec: ast.AST) -> bool:
+    name = dotted_name(dec)
+    if name in _JIT_NAMES:
+        return True
+    if isinstance(dec, ast.Call):
+        cname = call_name(dec)
+        if cname in _JIT_NAMES:
+            return True
+        if cname in _PARTIAL_NAMES and dec.args:
+            return dotted_name(dec.args[0]) in _JIT_NAMES
+    return False
+
+
+def _jitted_functions(mod: ModuleInfo) -> List[ast.AST]:
+    jitted: List[ast.AST] = []
+    by_name = {}
+    for qual, fn in mod.functions():
+        by_name.setdefault(fn.name, fn)
+        if any(_is_jit_decorator(d) for d in fn.decorator_list):
+            jitted.append(fn)
+    # f = jax.jit(g) rebinds (module or function scope)
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            cname = call_name(node.value)
+            if cname in _JIT_NAMES and node.value.args:
+                target = node.value.args[0]
+                if isinstance(target, ast.Name) and target.id in by_name:
+                    jitted.append(by_name[target.id])
+    return jitted
+
+
+@register
+class JaxPurity(Checker):
+    name = "jax-purity"
+    description = ("host syncs (.item/np.asarray/block_until_ready), "
+                   "nondeterminism (time/random) and Python control flow "
+                   "on tracers inside jit/pjit-traced functions")
+
+    def check_module(self, mod: ModuleInfo) -> Iterable[Finding]:
+        seen: Set[int] = set()
+        for fn in _jitted_functions(mod):
+            if id(fn) in seen:
+                continue
+            seen.add(id(fn))
+            yield from self._check_traced(mod, fn)
+
+    def _check_traced(self, mod: ModuleInfo, fn: ast.AST
+                      ) -> Iterable[Finding]:
+        qual = mod.qualnames().get(fn, fn.name)
+        static = set()
+        for dec in fn.decorator_list:
+            static |= _decorator_static_args(dec)
+        params = {a.arg for a in fn.args.args + fn.args.kwonlyargs
+                  if a.arg not in ("self", "cls")} - static
+
+        for node in ast.walk(fn):
+            line = getattr(node, "lineno", fn.lineno)
+            if mod.allowed(line, self.name):
+                continue
+            if isinstance(node, ast.Call):
+                cname = call_name(node)
+                mname = node.func.attr if isinstance(node.func,
+                                                     ast.Attribute) else None
+                if cname in _HOST_SYNC_CALLS:
+                    # float()/int() over literals/len() is static python —
+                    # only flag casts applied to a traced parameter
+                    if cname in ("float", "int", "bool"):
+                        if not (node.args and self._mentions(node.args[0],
+                                                             params)):
+                            continue
+                    yield Finding(
+                        checker=self.name, path=mod.relpath, line=line,
+                        message=(f"{cname}() inside traced {qual!r} forces "
+                                 f"a device->host sync (or fails to "
+                                 f"trace)"),
+                        hint="keep values on-device (jnp), or move the "
+                             "readback outside the jitted step",
+                        scope=qual, detail=f"host-sync:{cname}")
+                elif mname in _HOST_SYNC_METHODS:
+                    yield Finding(
+                        checker=self.name, path=mod.relpath, line=line,
+                        message=(f".{mname}() inside traced {qual!r} "
+                                 f"forces a device->host sync"),
+                        hint="return the array and read it back outside "
+                             "the traced step",
+                        scope=qual, detail=f"host-sync:.{mname}")
+                elif cname in _NONDET_CALLS or (
+                        cname and cname.startswith(_NONDET_PREFIXES)):
+                    yield Finding(
+                        checker=self.name, path=mod.relpath, line=line,
+                        message=(f"{cname}() inside traced {qual!r} is "
+                                 f"baked in at trace time — the compiled "
+                                 f"program replays one stale value"),
+                        hint="pass times in as arguments; use jax.random "
+                             "with explicit keys for randomness",
+                        scope=qual, detail=f"nondet:{cname}")
+                elif cname == "print":
+                    yield Finding(
+                        checker=self.name, path=mod.relpath, line=line,
+                        severity="warning",
+                        message=(f"print() inside traced {qual!r} runs "
+                                 f"once at trace time, not per step"),
+                        hint="use jax.debug.print", scope=qual,
+                        detail="print")
+            elif isinstance(node, (ast.If, ast.While)):
+                hit = self._tracer_test(node.test, params)
+                if hit:
+                    kind = "if" if isinstance(node, ast.If) else "while"
+                    yield Finding(
+                        checker=self.name, path=mod.relpath, line=line,
+                        severity="warning",
+                        message=(f"Python `{kind}` on parameter {hit!r} of "
+                                 f"traced {qual!r} — a tracer here raises "
+                                 f"at trace time"),
+                        hint="use lax.cond/jnp.where, or mark the arg in "
+                             "static_argnames",
+                        scope=qual, detail=f"tracer-{kind}:{hit}")
+
+    @staticmethod
+    def _mentions(node: ast.AST, params: Set[str]) -> Optional[str]:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Name) and sub.id in params:
+                return sub.id
+        return None
+
+    def _tracer_test(self, test: ast.AST, params: Set[str]
+                     ) -> Optional[str]:
+        """Conservative: a bare param, or a numeric comparison with a param
+        on either side. `is`/`is not`/isinstance/`len()` tests are static
+        structure checks and stay legal."""
+        if isinstance(test, ast.Name) and test.id in params:
+            return test.id
+        if isinstance(test, ast.Compare):
+            if any(isinstance(op, (ast.Is, ast.IsNot, ast.In, ast.NotIn))
+                   for op in test.ops):
+                return None
+            for side in [test.left] + list(test.comparators):
+                if isinstance(side, ast.Name) and side.id in params:
+                    return side.id
+        return None

@@ -1,0 +1,259 @@
+"""lock-discipline: locks and the contexts that must never take them.
+
+Two invariants, both paid for in blood:
+
+1. **No lock acquisition reachable from GC/finalizer/signal context.**
+   ``weakref.finalize`` callbacks (and ``__del__``, and signal handlers)
+   can run on *any* thread at *any* allocation — including a thread
+   already inside the lock they'd take. The PR 8 object-ledger deadlock
+   was exactly this: ``_deref`` (a finalizer) took ``_lock`` while the
+   cyclic GC fired it on a thread mid-``_entry()``, wedging every
+   ``ObjectRef.__init__`` in the serve proxy for 10+ minutes. The rule
+   walks an intra-module call graph so a finalizer that *calls into* a
+   lock-taking helper is caught too.
+
+2. **No blocking call while holding a lock.** ``ray_tpu.get`` /
+   ``time.sleep`` / subprocess / socket-dial under ``with self._lock``
+   turns every other acquirer into a convoy behind one slow RPC (the
+   serve controller used to boot proxy actors under the lock its status
+   getters share). ``await`` under a *threading* lock in an async def is
+   the same bug with the event loop as the victim.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from ray_tpu.analysis.core import (
+    BLOCKING_CALLS,
+    Checker,
+    Finding,
+    ModuleInfo,
+    call_name,
+    dotted_name,
+    register,
+)
+
+_LOCK_FACTORIES = {
+    "threading.Lock", "threading.RLock", "threading.Condition",
+    "threading.Semaphore", "threading.BoundedSemaphore", "Lock", "RLock",
+    "Condition",
+}
+
+#: dotted call names that block the calling thread (shared table — the
+#: event-loop checker flags the same set inside async defs)
+_BLOCKING_CALLS = set(BLOCKING_CALLS)
+
+_MAX_CALL_DEPTH = 6
+
+
+def _is_lockish(name: str, known: Set[str]) -> bool:
+    return name in known or "lock" in name.lower() or "_cv" in name
+
+
+def _lock_expr_name(expr: ast.AST, known: Set[str]) -> Optional[str]:
+    """Lock name if ``expr`` is ``self.X``/``X`` and X looks like a lock."""
+    if isinstance(expr, ast.Attribute) and isinstance(expr.value, ast.Name) \
+            and expr.value.id in ("self", "cls"):
+        return expr.attr if _is_lockish(expr.attr, known) else None
+    if isinstance(expr, ast.Name):
+        return expr.id if _is_lockish(expr.id, known) else None
+    return None
+
+
+def _body_walk_no_defs(nodes: Iterable[ast.AST]) -> Iterable[ast.AST]:
+    """Walk statements without descending into nested def/class bodies
+    (code in a nested def does not run while the lock is held)."""
+    stack = list(nodes)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _known_locks(mod: ModuleInfo) -> Set[str]:
+    """Names assigned from a lock factory anywhere in the module."""
+    known: Set[str] = set()
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            cname = call_name(node.value)
+            if cname in _LOCK_FACTORIES or (
+                    cname and cname.split(".")[-1] in ("Lock", "RLock",
+                                                       "Condition")):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Attribute):
+                        known.add(tgt.attr)
+                    elif isinstance(tgt, ast.Name):
+                        known.add(tgt.id)
+    return known
+
+
+def _acquired_locks(fn: ast.AST, known: Set[str]) -> List[Tuple[str, int]]:
+    """(lock_name, line) for every acquisition lexically in ``fn``."""
+    out: List[Tuple[str, int]] = []
+    for node in _body_walk_no_defs(getattr(fn, "body", ())):
+        if isinstance(node, ast.With):
+            for item in node.items:
+                name = _lock_expr_name(item.context_expr, known)
+                if name:
+                    out.append((name, node.lineno))
+        elif isinstance(node, ast.Call) and isinstance(node.func,
+                                                       ast.Attribute) \
+                and node.func.attr == "acquire":
+            name = _lock_expr_name(node.func.value, known)
+            if name:
+                out.append((name, node.lineno))
+    return out
+
+
+def _callee_qualname(call: ast.Call, caller_qual: str) -> Optional[str]:
+    """Resolve ``self.m()`` / ``m()`` to an intra-module qualname."""
+    f = call.func
+    if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name) \
+            and f.value.id in ("self", "cls"):
+        cls = caller_qual.rsplit(".", 1)[0] if "." in caller_qual else ""
+        return f"{cls}.{f.attr}" if cls else f.attr
+    if isinstance(f, ast.Name):
+        return f.id
+    return None
+
+
+def _callback_qualname(cb: ast.AST, site_scope: str) -> Optional[str]:
+    """Qualname a callback expression (``self._m`` / ``m``) points at."""
+    if isinstance(cb, ast.Attribute) and isinstance(cb.value, ast.Name) \
+            and cb.value.id in ("self", "cls"):
+        cls = site_scope.rsplit(".", 1)[0] if "." in site_scope else ""
+        return f"{cls}.{cb.attr}" if cls else cb.attr
+    if isinstance(cb, ast.Name):
+        return cb.id
+    return None
+
+
+@register
+class LockDiscipline(Checker):
+    name = "lock-discipline"
+    description = ("locks acquired from finalizer/__del__/signal context; "
+                   "blocking calls (RPC, get, sleep, await) under a held "
+                   "lock")
+
+    def check_module(self, mod: ModuleInfo) -> Iterable[Finding]:
+        known = _known_locks(mod)
+        acquires: Dict[str, List[Tuple[str, int]]] = {}
+        calls: Dict[str, Set[str]] = {}
+        fn_lines: Dict[str, int] = {}
+        for qual, fn in mod.functions():
+            acquires[qual] = _acquired_locks(fn, known)
+            fn_lines[qual] = fn.lineno
+            callees: Set[str] = set()
+            for node in _body_walk_no_defs(fn.body):
+                if isinstance(node, ast.Call):
+                    callee = _callee_qualname(node, qual)
+                    if callee:
+                        callees.add(callee)
+            calls[qual] = callees
+
+        yield from self._finalizer_rule(mod, known, acquires, calls,
+                                        fn_lines)
+        yield from self._held_across_blocking_rule(mod, known)
+
+    # -- rule 1: finalizer/GC/signal contexts ---------------------------------
+    def _finalizer_rule(self, mod, known, acquires, calls, fn_lines):
+        roots: List[Tuple[str, int, str]] = []  # (qualname, line, context)
+        for qual, fn in mod.functions():
+            if qual.split(".")[-1] == "__del__":
+                roots.append((qual, fn.lineno, "__del__"))
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            cname = call_name(node)
+            if cname in ("weakref.finalize", "finalize") \
+                    and len(node.args) >= 2:
+                cb = _callback_qualname(node.args[1], mod.scope_of(node))
+                if cb:
+                    roots.append((cb, node.lineno, "weakref.finalize"))
+            elif cname in ("signal.signal",) and len(node.args) >= 2:
+                cb = _callback_qualname(node.args[1], mod.scope_of(node))
+                if cb:
+                    roots.append((cb, node.lineno, "signal handler"))
+
+        for root, line, context in roots:
+            hit = self._reaches_lock(root, acquires, calls)
+            if hit is None or mod.allowed(line, self.name):
+                continue
+            lock, path = hit
+            via = "" if len(path) == 1 else \
+                f" via {' -> '.join(path[1:])}"
+            yield Finding(
+                checker=self.name, path=mod.relpath, line=line,
+                message=(f"{context} callback {root!r} acquires lock "
+                         f"{lock!r}{via} — GC/finalizer context can run on "
+                         f"a thread already holding it (self-deadlock)"),
+                hint="only touch atomic structures (deque.append) in "
+                     "finalizers; drain the backlog inside the next locked "
+                     "operation",
+                scope=root, detail=f"{context}->{lock}")
+
+    @staticmethod
+    def _reaches_lock(root: str, acquires, calls
+                      ) -> Optional[Tuple[str, List[str]]]:
+        seen: Set[str] = set()
+        stack: List[Tuple[str, List[str]]] = [(root, [root])]
+        while stack:
+            qual, path = stack.pop()
+            if qual in seen or len(path) > _MAX_CALL_DEPTH:
+                continue
+            seen.add(qual)
+            got = acquires.get(qual)
+            if got:
+                return got[0][0], path
+            for callee in calls.get(qual, ()):
+                if callee in acquires:  # known intra-module function
+                    stack.append((callee, path + [callee]))
+        return None
+
+    # -- rule 2: blocking call / await under a held lock ----------------------
+    def _held_across_blocking_rule(self, mod: ModuleInfo, known: Set[str]
+                                   ) -> Iterable[Finding]:
+        for qual, fn in mod.functions():
+            is_async = isinstance(fn, ast.AsyncFunctionDef)
+            for node in _body_walk_no_defs(fn.body):
+                if not isinstance(node, ast.With):
+                    continue
+                locks = [n for item in node.items
+                         if (n := _lock_expr_name(item.context_expr,
+                                                  known))]
+                if not locks:
+                    continue
+                lock = locks[0]
+                for sub in _body_walk_no_defs(node.body):
+                    if isinstance(sub, ast.Await) and is_async:
+                        if mod.allowed(sub.lineno, self.name):
+                            continue
+                        yield Finding(
+                            checker=self.name, path=mod.relpath,
+                            line=sub.lineno,
+                            message=(f"await while holding threading lock "
+                                     f"{lock!r} — blocks the event loop's "
+                                     f"other tasks behind this lock"),
+                            hint="use asyncio.Lock, or release before "
+                                 "awaiting",
+                            scope=qual, detail=f"{lock}@await")
+                    elif isinstance(sub, ast.Call):
+                        cname = call_name(sub)
+                        if cname not in _BLOCKING_CALLS:
+                            continue
+                        if mod.allowed(sub.lineno, self.name):
+                            continue
+                        yield Finding(
+                            checker=self.name, path=mod.relpath,
+                            line=sub.lineno,
+                            message=(f"blocking call {cname}() while "
+                                     f"holding lock {lock!r} — every other "
+                                     f"acquirer convoys behind it"),
+                            hint="move the blocking work outside the lock; "
+                                 "re-take it to publish the result",
+                            scope=qual, detail=f"{lock}@{cname}")

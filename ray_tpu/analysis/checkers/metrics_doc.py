@@ -1,0 +1,215 @@
+"""metrics-doc: every registered ``rt_*`` series documented, no drift.
+
+The PR 4 metrics-doc lint (``scripts/check_metrics.py``), folded into the
+framework as a cross-file checker — the script survives as a thin shim
+over this module so ``python scripts/check_metrics.py`` and the tier-1
+``tests/test_zz_metrics_doc.py`` keep working unchanged.
+
+Checks (unchanged semantics):
+
+  1. scan ``ray_tpu/**/*.py`` for ``M.get_or_create(M.<Kind>, "rt_...")``
+     registrations + the dashboard's ``SYSTEM_METRICS`` table;
+  2. no name under conflicting kinds (sharing a name with the same kind
+     is the one-series-many-processes idiom);
+  3. every name documented in README's "Metrics reference" table with the
+     matching kind; no stale rows;
+  4. every ``rt_*`` series a generated Grafana panel queries is
+     registered;
+  5. ``scripts/alert_rules.yml`` is structurally sound and references
+     only registered series.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Dict, List, Tuple
+
+from ray_tpu.analysis.core import (
+    Checker,
+    Finding,
+    ModuleInfo,
+    REPO_ROOT,
+    register,
+)
+
+_GET_OR_CREATE = re.compile(
+    r"get_or_create\(\s*M\.(Counter|Gauge|Histogram)\s*,\s*"
+    r"\"(rt_[a-z0-9_]+)\"", re.S)
+_SYSTEM_ROW = re.compile(
+    r"\"(rt_[a-z0-9_]+)\":\s*\(\"(gauge|counter|histogram)\"")
+_README_ROW = re.compile(
+    r"^\|\s*`(rt_[a-z0-9_]+)`\s*\|\s*(counter|gauge|histogram)\s*\|", re.M)
+_METRIC_NAME = re.compile(r"\b(rt_[a-z0-9_]+)")
+
+
+def registered_metrics(root: str = REPO_ROOT
+                       ) -> Dict[str, List[Tuple[str, str]]]:
+    """name -> [(kind, relpath), ...] across every registration site."""
+    regs: Dict[str, List[Tuple[str, str]]] = {}
+    pkg = os.path.join(root, "ray_tpu")
+    for dirpath, _, files in os.walk(pkg):
+        for fn in files:
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            rel = os.path.relpath(path, root)
+            try:
+                with open(path, encoding="utf-8") as f:
+                    src = f.read()
+            except OSError:
+                continue
+            for kind, name in _GET_OR_CREATE.findall(src):
+                regs.setdefault(name, []).append((kind.lower(), rel))
+            if "SYSTEM_METRICS" in src:
+                for name, kind in _SYSTEM_ROW.findall(src):
+                    regs.setdefault(name, []).append((kind, rel))
+    return regs
+
+
+def documented_metrics(root: str = REPO_ROOT) -> Dict[str, str]:
+    with open(os.path.join(root, "README.md"), encoding="utf-8") as f:
+        readme = f.read()
+    return {name: kind for name, kind in _README_ROW.findall(readme)}
+
+
+def _base_names(expr: str) -> List[str]:
+    """rt_* metric names in a PromQL expression, histogram exposition
+    suffixes stripped back to the registered base."""
+    out = []
+    for name in _METRIC_NAME.findall(expr):
+        for suffix in ("_bucket", "_sum", "_count"):
+            if name.endswith(suffix):
+                name = name[:-len(suffix)]
+                break
+        out.append(name)
+    return out
+
+
+def grafana_expr_metrics(root: str = REPO_ROOT) -> List[Tuple[str, str]]:
+    """(metric_name, panel_title) for every rt_* series the generated
+    Grafana dashboard queries (loaded standalone by file path — the
+    module only imports stdlib at top level)."""
+    import importlib.util
+
+    path = os.path.join(root, "ray_tpu", "dashboard", "grafana.py")
+    spec = importlib.util.spec_from_file_location("_rt_grafana_lint", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    out: List[Tuple[str, str]] = []
+    for panel in mod.build_cluster_dashboard()["panels"]:
+        for target in panel.get("targets", ()):
+            for name in _base_names(target.get("expr", "")):
+                out.append((name, panel.get("title", "?")))
+    return out
+
+
+def alert_rules_problems(regs: Dict[str, List[Tuple[str, str]]],
+                         root: str = REPO_ROOT) -> List[str]:
+    """Structural + metric-name lint of scripts/alert_rules.yml."""
+    path = os.path.join(root, "scripts", "alert_rules.yml")
+    if not os.path.exists(path):
+        return ["scripts/alert_rules.yml missing (the failure-plane "
+                "alerting rules ship with the repo)"]
+    with open(path, encoding="utf-8") as f:
+        text = f.read()
+    problems: List[str] = []
+    try:
+        import yaml
+
+        doc = yaml.safe_load(text)
+        groups = (doc or {}).get("groups")
+        if not isinstance(groups, list) or not groups:
+            return [f"{path}: no alerting groups defined"]
+        exprs: List[Tuple[str, str]] = []
+        for g in groups:
+            rules = (g or {}).get("rules")
+            if not isinstance(rules, list) or not rules:
+                problems.append(f"{path}: group {g.get('name')!r} has no "
+                                f"rules")
+                continue
+            for r in rules:
+                if not r.get("alert") or not r.get("expr"):
+                    problems.append(f"{path}: rule {r.get('alert')!r} "
+                                    f"needs both 'alert' and 'expr'")
+                    continue
+                exprs.append((str(r["expr"]), str(r["alert"])))
+    except ImportError:
+        # no pyyaml: degrade to a regex scan so the metric-name lint
+        # still runs (structure unchecked)
+        exprs = [(m, "alert_rules.yml")
+                 for m in re.findall(r"expr:\s*(.+)", text)]
+        if "groups:" not in text or "rules:" not in text:
+            problems.append(f"{path}: missing groups:/rules: structure")
+    except Exception as e:  # noqa: BLE001 — malformed YAML IS the finding
+        return [f"{path}: does not parse as YAML ({type(e).__name__}: "
+                f"{e})"]
+    for expr, alert in exprs:
+        for name in _base_names(expr):
+            if name not in regs:
+                problems.append(
+                    f"{path}: alert {alert!r} references {name}, which "
+                    f"is not a registered metric")
+    return problems
+
+
+def check(root: str = REPO_ROOT) -> List[str]:
+    """Every problem as one message string (the shim/test API)."""
+    problems: List[str] = []
+    regs = registered_metrics(root)
+    if not regs:
+        return ["no rt_* metric registrations found — the scanner regexes "
+                "no longer match the registration idiom"]
+    docs = documented_metrics(root)
+    if not docs:
+        problems.append("README.md has no 'Metrics reference' table rows "
+                        "(| `rt_name` | kind | description |)")
+    for name, sites in sorted(regs.items()):
+        kinds = {k for k, _ in sites}
+        if len(kinds) > 1:
+            problems.append(
+                f"{name}: registered under conflicting kinds "
+                f"{sorted(kinds)} at {sorted(p for _, p in sites)}")
+            continue
+        kind = next(iter(kinds))
+        if name not in docs:
+            problems.append(
+                f"{name} ({kind}, {sites[0][1]}): not documented in "
+                f"README.md's metrics table")
+        elif docs[name] != kind:
+            problems.append(
+                f"{name}: registered as {kind} ({sites[0][1]}) but "
+                f"documented as {docs[name]}")
+    for name in sorted(set(docs) - set(regs)):
+        problems.append(f"{name}: documented in README.md but never "
+                        f"registered in ray_tpu/ (stale row?)")
+    try:
+        for name, title in grafana_expr_metrics(root):
+            if name not in regs:
+                problems.append(
+                    f"grafana panel {title!r} queries {name}, which is "
+                    f"not a registered metric")
+    except Exception as e:  # noqa: BLE001 — a broken factory IS a finding
+        problems.append(f"grafana dashboard factory failed to load: "
+                        f"{type(e).__name__}: {e}")
+    problems.extend(alert_rules_problems(regs, root))
+    return problems
+
+
+@register
+class MetricsDoc(Checker):
+    name = "metrics-doc"
+    description = ("registered rt_* series vs README metrics table, "
+                   "Grafana panels, and alert rules (PR 4 lint, folded in)")
+
+    def finalize(self, mods: List[ModuleInfo], root: str
+                 ) -> List[Finding]:
+        # repo-level check: runs off the tree, not the scanned file set
+        return [
+            Finding(checker=self.name, path="README.md", line=1,
+                    message=problem,
+                    hint="python scripts/check_metrics.py for the "
+                         "standalone view",
+                    scope="metrics", detail=problem)
+            for problem in check(root)
+        ]

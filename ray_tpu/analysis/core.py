@@ -1,0 +1,292 @@
+"""Framework core: findings, the checker registry, and the shared
+per-file AST cache every checker reads from.
+
+One parse per file per run (mtime-keyed, so repeated ``rt lint`` calls in
+a session reparse only what changed); ``# rt:`` directive comments are
+extracted with ``tokenize`` in the same pass so checkers never rescan
+source text themselves.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Set, Tuple, Type
+
+#: repo root: ``<root>/ray_tpu/analysis/core.py`` -> ``<root>``
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+SEVERITIES = ("error", "warning")
+
+# Comment grammar (anchored at the start of the comment so prose that
+# merely *mentions* a directive can't arm one). Directives:
+#   ``rt: lint-allow(checker[, ...])`` — suppress findings on this line
+#   ``rt: guarded-by(_lock)``          — attr on this line is guarded
+#   ``rt: hot-module``                 — whole module is dispatch-hot
+_DIRECTIVE = re.compile(r"\A#+\s*rt:\s*([a-z-]+)\s*(?:\(([^)]*)\))?")
+
+
+@dataclass
+class Finding:
+    """One rule violation, printable as ``path:line: [checker] message``.
+
+    ``scope``/``detail`` (not line numbers) feed the baseline fingerprint,
+    so unrelated edits that shift lines don't invalidate the committed
+    suppressions — the ratchet tracks *what* is suppressed, not where it
+    happened to sit.
+    """
+
+    checker: str
+    path: str            # repo-relative, '/'-separated
+    line: int
+    message: str
+    severity: str = "error"
+    hint: str = ""       # how to fix it (one line)
+    scope: str = ""      # enclosing def/class qualname
+    detail: str = ""     # stable discriminator (lock name, import, ...)
+
+    def fingerprint(self) -> str:
+        return "::".join((self.checker, self.path, self.scope,
+                          self.detail or self.message))
+
+    def render(self) -> str:
+        sev = "" if self.severity == "error" else " (warning)"
+        out = f"{self.path}:{self.line}: [{self.checker}]{sev} {self.message}"
+        if self.hint:
+            out += f"\n    hint: {self.hint}"
+        return out
+
+    def to_dict(self) -> Dict:
+        return {"checker": self.checker, "path": self.path,
+                "line": self.line, "severity": self.severity,
+                "message": self.message, "hint": self.hint,
+                "scope": self.scope, "detail": self.detail,
+                "fingerprint": self.fingerprint()}
+
+
+@dataclass
+class ModuleInfo:
+    """Parsed view of one source file, shared across checkers."""
+
+    path: str                      # absolute
+    relpath: str                   # repo-relative, '/'-separated
+    source: str
+    tree: ast.Module
+    #: line -> checker names allowed there ('*' = all)
+    allow: Dict[int, Set[str]] = field(default_factory=dict)
+    #: line -> guarded-by lock name declared on that line
+    guarded: Dict[int, str] = field(default_factory=dict)
+    hot: bool = False              # '# rt: hot-module' seen
+    #: function/async-function node -> dotted qualname; built lazily
+    _qualnames: Optional[Dict[ast.AST, str]] = None
+    _parents: Optional[Dict[ast.AST, ast.AST]] = None
+    _lines: Optional[List[str]] = None
+
+    # -- scope helpers --------------------------------------------------------
+    def qualnames(self) -> Dict[ast.AST, str]:
+        """def/class node -> dotted qualname (``Cls.method.inner``)."""
+        if self._qualnames is None:
+            out: Dict[ast.AST, str] = {}
+
+            def walk(node: ast.AST, prefix: str) -> None:
+                for child in ast.iter_child_nodes(node):
+                    if isinstance(child, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef,
+                                          ast.ClassDef)):
+                        q = f"{prefix}.{child.name}" if prefix \
+                            else child.name
+                        out[child] = q
+                        walk(child, q)
+                    else:
+                        walk(child, prefix)
+
+            walk(self.tree, "")
+            self._qualnames = out
+        return self._qualnames
+
+    def parents(self) -> Dict[ast.AST, ast.AST]:
+        if self._parents is None:
+            self._parents = {c: p for p in ast.walk(self.tree)
+                             for c in ast.iter_child_nodes(p)}
+        return self._parents
+
+    def scope_of(self, node: ast.AST) -> str:
+        """Qualname of the innermost def/class enclosing ``node``."""
+        qn, parents = self.qualnames(), self.parents()
+        cur: Optional[ast.AST] = node
+        while cur is not None:
+            if cur in qn:
+                return qn[cur]
+            cur = parents.get(cur)
+        return "<module>"
+
+    def functions(self) -> List[Tuple[str, ast.AST]]:
+        """Every (qualname, def-node), methods and nested defs included."""
+        return [(q, n) for n, q in self.qualnames().items()
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+
+    def allowed(self, line: int, checker: str) -> bool:
+        """True when the line — or the contiguous comment block directly
+        above it (the natural home when the construct spans lines) —
+        carries a ``lint-allow`` for this checker."""
+        def hit(ln: int) -> bool:
+            names = self.allow.get(ln)
+            return bool(names) and (checker in names or "*" in names)
+
+        if hit(line):
+            return True
+        if self._lines is None:
+            self._lines = self.source.splitlines()
+        lines = self._lines
+        ln = line - 1
+        while 1 <= ln <= len(lines) and lines[ln - 1].lstrip().startswith(
+                "#"):
+            if hit(ln):
+                return True
+            ln -= 1
+        return False
+
+
+def _parse_directives(source: str, mod: ModuleInfo) -> None:
+    try:
+        tokens = tokenize.generate_tokens(io.StringIO(source).readline)
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _DIRECTIVE.match(tok.string)
+            if m:
+                name, args = m.group(1), (m.group(2) or "")
+                if name == "lint-allow":
+                    names = {a.strip() for a in args.split(",") if a.strip()}
+                    mod.allow.setdefault(tok.start[0], set()).update(
+                        names or {"*"})
+                elif name == "guarded-by" and args.strip():
+                    mod.guarded[tok.start[0]] = args.strip()
+                elif name == "hot-module":
+                    mod.hot = True
+    except tokenize.TokenizeError:
+        pass  # the ast parse above already succeeded; directives best-effort
+
+
+# -- per-file cache -----------------------------------------------------------
+_CACHE: Dict[str, Tuple[Tuple[float, int], ModuleInfo]] = {}
+
+
+def load_module(path: str) -> ModuleInfo:
+    """Parse ``path`` (or return the cached parse if unchanged)."""
+    path = os.path.abspath(path)
+    st = os.stat(path)
+    key = (st.st_mtime, st.st_size)
+    hit = _CACHE.get(path)
+    if hit is not None and hit[0] == key:
+        return hit[1]
+    with open(path, encoding="utf-8") as f:
+        source = f.read()
+    rel = os.path.relpath(path, REPO_ROOT).replace(os.sep, "/")
+    tree = ast.parse(source, filename=path)  # SyntaxError -> caller
+    mod = ModuleInfo(path=path, relpath=rel, source=source, tree=tree)
+    _parse_directives(source, mod)
+    _CACHE[path] = (key, mod)
+    return mod
+
+
+def clear_cache() -> None:
+    _CACHE.clear()
+
+
+# -- checker registry ---------------------------------------------------------
+class Checker:
+    """One invariant. Subclass, set ``name``/``description``, implement
+    ``check_module`` (per file) and/or ``finalize`` (cross-file, runs once
+    after every module was visited)."""
+
+    name: str = ""
+    description: str = ""
+    default_severity: str = "error"
+
+    def check_module(self, mod: ModuleInfo) -> Iterable[Finding]:
+        return ()
+
+    def finalize(self, mods: List[ModuleInfo],
+                 root: str) -> Iterable[Finding]:
+        return ()
+
+
+_REGISTRY: Dict[str, Type[Checker]] = {}
+
+
+def register(cls: Type[Checker]) -> Type[Checker]:
+    if not cls.name:
+        raise ValueError(f"checker {cls.__name__} needs a name")
+    if cls.name in _REGISTRY and _REGISTRY[cls.name] is not cls:
+        raise ValueError(f"duplicate checker name {cls.name!r}")
+    _REGISTRY[cls.name] = cls
+    return cls
+
+
+def all_checkers() -> Dict[str, Checker]:
+    """name -> instance, with the bundled checkers registered."""
+    from ray_tpu.analysis import checkers as _bundled  # noqa: F401
+
+    return {name: cls() for name, cls in sorted(_REGISTRY.items())}
+
+
+# -- shared rule tables -------------------------------------------------------
+#: thread-blocking calls, shared by lock-discipline (blocking under a held
+#: lock) and event-loop-blocking (blocking on the loop) so the two checkers
+#: can never diverge on what "blocking" means. name -> async-side fix hint.
+BLOCKING_CALLS: Dict[str, str] = {
+    "time.sleep": "asyncio.sleep",
+    "ray_tpu.get": "await the ref's future, or run_in_executor",
+    "ray_tpu.wait": "await, or run_in_executor",
+    "rt.get": "await the ref's future, or run_in_executor",
+    "rt.wait": "await, or run_in_executor",
+    "subprocess.run": "asyncio.create_subprocess_exec",
+    "subprocess.call": "asyncio.create_subprocess_exec",
+    "subprocess.check_output": "asyncio.create_subprocess_exec",
+    "subprocess.check_call": "asyncio.create_subprocess_exec",
+    "urllib.request.urlopen": "aiohttp (or run_in_executor)",
+    "urlopen": "aiohttp (or run_in_executor)",
+    "requests.get": "aiohttp",
+    "requests.post": "aiohttp",
+    "requests.put": "aiohttp",
+    "requests.delete": "aiohttp",
+    "requests.request": "aiohttp",
+    "socket.create_connection": "loop.sock_connect / open_connection",
+}
+
+
+# -- shared AST utilities -----------------------------------------------------
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for Name/Attribute chains, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_name(call: ast.Call) -> Optional[str]:
+    return dotted_name(call.func)
+
+
+def in_type_checking_block(mod: ModuleInfo, node: ast.AST) -> bool:
+    parents = mod.parents()
+    cur: Optional[ast.AST] = node
+    while cur is not None:
+        if isinstance(cur, ast.If):
+            t = cur.test
+            name = dotted_name(t) if isinstance(
+                t, (ast.Name, ast.Attribute)) else None
+            if name in ("TYPE_CHECKING", "typing.TYPE_CHECKING"):
+                return True
+        cur = parents.get(cur)
+    return False

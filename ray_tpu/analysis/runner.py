@@ -1,0 +1,188 @@
+"""Discovery + orchestration + the ``rt lint`` CLI.
+
+``rt lint`` with no paths scans ``ray_tpu/`` against the committed
+baseline and exits 0 only when no *new* finding exists — the tier-1 gate
+(``tests/test_zz_lint.py``) and the ``chaos_smoke.sh`` pre-flight both
+run exactly this. ``rt lint path/to/file.py`` scopes the scan (baseline
+still applies); ``--baseline-update`` rewrites the baseline to current
+reality after debt is paid down (or, rarely, consciously taken on).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, List, Optional, Sequence
+
+from ray_tpu.analysis import baseline as B
+from ray_tpu.analysis.core import (
+    Finding,
+    ModuleInfo,
+    REPO_ROOT,
+    all_checkers,
+    load_module,
+)
+
+_SKIP_DIRS = {"__pycache__", ".git", ".seedcheck", "node_modules"}
+DEFAULT_SCAN = os.path.join(REPO_ROOT, "ray_tpu")
+
+
+def discover(paths: Sequence[str]) -> List[str]:
+    # dict-as-ordered-set: overlapping arguments (`rt lint pkg pkg/f.py`)
+    # must not scan a file twice — duplicate findings would exceed the
+    # baseline's fingerprint counts and fail a clean tree
+    out: Dict[str, None] = {}
+    for p in paths:
+        p = os.path.abspath(p)
+        if not os.path.exists(p):
+            # a typo'd path scanning zero files would exit 0 as a false
+            # clean pass — refuse instead
+            raise SystemExit(f"rt lint: no such file or directory: {p}")
+        if os.path.isfile(p):
+            out[p] = None
+            continue
+        for dirpath, dirnames, files in os.walk(p):
+            dirnames[:] = sorted(d for d in dirnames
+                                 if d not in _SKIP_DIRS)
+            for fn in sorted(files):
+                if fn.endswith(".py"):
+                    out[os.path.join(dirpath, fn)] = None
+    return list(out)
+
+
+def run_lint(paths: Optional[Sequence[str]] = None,
+             select: Optional[Sequence[str]] = None,
+             baseline_path: str = B.DEFAULT_BASELINE,
+             use_baseline: bool = True) -> Dict:
+    """-> {'findings': [new Finding...], 'suppressed': [...], 'stale': {},
+    'all': [...], 'files': n, 'checkers': [names]}"""
+    full_run = paths is None or not list(paths)
+    files = discover([DEFAULT_SCAN] if full_run else list(paths))
+    checkers = all_checkers()
+    if select:
+        unknown = set(select) - set(checkers)
+        if unknown:
+            raise SystemExit(f"rt lint: unknown checker(s) "
+                             f"{sorted(unknown)}; known: "
+                             f"{sorted(checkers)}")
+        checkers = {k: v for k, v in checkers.items() if k in select}
+
+    mods: List[ModuleInfo] = []
+    findings: List[Finding] = []
+    for path in files:
+        try:
+            mod = load_module(path)
+        except SyntaxError as e:
+            rel = os.path.relpath(path, REPO_ROOT).replace(os.sep, "/")
+            findings.append(Finding(
+                checker="parse", path=rel, line=e.lineno or 1,
+                message=f"does not parse: {e.msg}", scope="<module>",
+                detail="syntax-error"))
+            continue
+        mods.append(mod)
+        for checker in checkers.values():
+            findings.extend(checker.check_module(mod))
+    # repo-level finalizers only make sense over the whole tree (or when
+    # the checker was asked for by name on a scoped run)
+    for name, checker in checkers.items():
+        if full_run or (select and name in select):
+            findings.extend(checker.finalize(mods, REPO_ROOT))
+
+    # central inline-allow enforcement (checkers also do this themselves,
+    # but a finding built without consulting the line must still respect
+    # the source's say-so)
+    by_path = {m.relpath: m for m in mods}
+    findings = [f for f in findings
+                if not (f.path in by_path
+                        and by_path[f.path].allowed(f.line, f.checker))]
+    findings.sort(key=lambda f: (f.path, f.line, f.checker))
+
+    base = B.load(baseline_path) if use_baseline else {}
+    new, suppressed, stale = B.split(findings, base)
+    if not full_run:
+        # a scoped scan only sees part of the tree: baseline entries for
+        # files outside the scope are not "debt paid down", they are
+        # simply out of view — stale is a full-tree verdict
+        stale = {}
+    return {"findings": new, "suppressed": suppressed, "stale": stale,
+            "all": findings, "files": len(files),
+            "checkers": sorted(all_checkers() if not select else select)}
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="rt lint",
+        description="concurrency- and runtime-invariant static analysis "
+                    "with a ratcheted baseline")
+    parser.add_argument("paths", nargs="*",
+                        help="files/dirs to scan (default: ray_tpu/)")
+    parser.add_argument("--json", action="store_true",
+                        help="machine-readable findings")
+    parser.add_argument("--baseline", default=B.DEFAULT_BASELINE,
+                        help="suppression file "
+                             "(default scripts/lint_baseline.json)")
+    parser.add_argument("--no-baseline", action="store_true",
+                        help="report every finding, suppressed or not")
+    parser.add_argument("--baseline-update", action="store_true",
+                        help="rewrite the baseline to current findings "
+                             "(full-tree scan) and exit 0")
+    parser.add_argument("--select", action="append", metavar="CHECKER",
+                        help="run only these checkers (repeatable)")
+    parser.add_argument("--list-checkers", action="store_true")
+    args = parser.parse_args(argv)
+
+    if args.list_checkers:
+        for name, checker in all_checkers().items():
+            print(f"{name:<22} {checker.description}")
+        return 0
+
+    if args.baseline_update and (args.paths or args.select):
+        # a partial scan sees a partial finding set: writing it out would
+        # silently wipe every out-of-scope suppression from the ratchet
+        print("rt lint: --baseline-update requires a full-tree, "
+              "all-checkers scan (drop the path arguments / --select)",
+              file=sys.stderr)
+        return 2
+
+    result = run_lint(paths=args.paths, select=args.select,
+                      baseline_path=args.baseline,
+                      use_baseline=not args.no_baseline
+                      and not args.baseline_update)
+
+    if args.baseline_update:
+        counts = B.save(args.baseline, result["all"])
+        print(f"baseline updated: {len(result['all'])} finding(s) across "
+              f"{len(counts)} fingerprint(s) -> {args.baseline}")
+        return 0
+
+    new: List[Finding] = result["findings"]
+    if args.json:
+        print(json.dumps({
+            "findings": [f.to_dict() for f in new],
+            "suppressed": len(result["suppressed"]),
+            "stale_baseline_entries": result["stale"],
+            "files_scanned": result["files"],
+            "checkers": result["checkers"],
+            "exit_code": 1 if new else 0,
+        }, indent=2))
+        return 1 if new else 0
+
+    for f in new:
+        print(f.render())
+    tail = (f"{result['files']} file(s), "
+            f"{len(result['checkers'])} checker(s): "
+            f"{len(new)} new finding(s), "
+            f"{len(result['suppressed'])} baselined")
+    if result["stale"]:
+        tail += (f", {sum(result['stale'].values())} stale baseline "
+                 f"entr(ies) — debt paid down; run --baseline-update to "
+                 f"shrink the file")
+    print(("FAIL: " if new else "OK: ") + tail,
+          file=sys.stderr if new else sys.stdout)
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

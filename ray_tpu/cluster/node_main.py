@@ -126,9 +126,12 @@ async def _amain(args: argparse.Namespace) -> None:
     }
     os.makedirs(state_dir(), exist_ok=True)
     state_path = os.path.join(state_dir(), f"{node_id}.json")
+    # rt: lint-allow(event-loop-blocking) one-shot boot bookkeeping: two
+    # tiny local writes before the daemon starts serving anything
     with open(state_path, "w") as f:
         json.dump(state, f)
     if args.head:
+        # rt: lint-allow(event-loop-blocking) same boot-time write
         with open(session_latest_path(), "w") as f:
             json.dump(state, f)
     # The launching `rt start` blocks on this line.

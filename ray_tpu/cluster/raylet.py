@@ -20,25 +20,35 @@ from __future__ import annotations
 import asyncio
 import collections
 import itertools
+import json
 import os
 import subprocess
 import sys
 import threading
 import time
+from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, List, Optional, Tuple
 
+import ray_tpu
+from ray_tpu import _native
 from ray_tpu._private.config import get_config
+from ray_tpu._private.ids import ObjectID
 from ray_tpu.core import failure as F
-from ray_tpu.core.resources import NodeResources, ResourceSet, TPU
+from ray_tpu.core.resources import CPU, NodeResources, ResourceSet, TPU
 from ray_tpu.cluster.object_store import PlasmaStore
 from ray_tpu.cluster.rpc import (
+    ConnectionLost,
     ConnectionPool,
     RpcClient,
     RpcServer,
+    cancel_and_wait,
     spawn_task,
 )
 from ray_tpu.exceptions import WorkerCrashedError
+from ray_tpu.scheduler.policy import strategy_allows_local
 from ray_tpu.util import chaos as C
+from ray_tpu.util import metrics as M
+from ray_tpu.util.profiling import format_current_stacks
 
 
 class _WorkerEntry:
@@ -302,8 +312,6 @@ class Raylet:
         # spill/restore file IO runs here, never on the event loop — the
         # raylet must keep dispatching while bytes hit the disk (reference:
         # dedicated Python IO workers in LocalObjectManager)
-        from concurrent.futures import ThreadPoolExecutor
-
         self._spill_exec = ThreadPoolExecutor(
             max_workers=2, thread_name_prefix="rt-spill")
         self._spill_lock = threading.Lock()
@@ -372,8 +380,6 @@ class Raylet:
 
     def _telemetry_metrics(self) -> Dict[str, Any]:
         if self._tele_metrics is None:
-            from ray_tpu.util import metrics as M
-
             self._tele_metrics = {
                 "queue_depth": M.get_or_create(
                     M.Gauge, "rt_raylet_queue_depth",
@@ -456,8 +462,6 @@ class Raylet:
 
     async def stop(self, destroy_store: bool = False) -> None:
         self._stopped = True
-        from ray_tpu.cluster.rpc import cancel_and_wait
-
         await cancel_and_wait(*self._tasks)
         self._tasks.clear()
         for w in list(self._workers.values()):
@@ -576,8 +580,6 @@ class Raylet:
         (in-process test cluster) its pusher covers the shared registry and
         this path skips the write (double-pushed histograms would double
         their counts in the merged Prometheus page)."""
-        import json as _json
-
         try:
             m = self._telemetry_metrics()
             m["queue_depth"].set(len(self._squeue),
@@ -590,15 +592,12 @@ class Raylet:
             self._set_store_gauges(m)
             self._set_class_gauges(m)
             self._update_worker_rss(m)
-            import ray_tpu
-            from ray_tpu.util import metrics as M
-
             if ray_tpu.is_initialized():
                 self._tele_pushed = now
                 return  # the driver's pusher owns this registry
             await self._gcs.call("kv_put", {
                 "key": f"{M._KV_PREFIX}raylet:{self.node_id}",
-                "value": _json.dumps({
+                "value": json.dumps({
                     "t": time.time(),
                     "metrics": M._registry.snapshot()}).encode()})
             self._tele_pushed = now
@@ -708,8 +707,6 @@ class Raylet:
     def _update_worker_rss(self, m: Dict[str, Any]) -> None:
         """rt_worker_rss_bytes per live worker; dead workers' samples are
         removed so the page doesn't accumulate stale series."""
-        from ray_tpu import _native
-
         by_pid = {e.proc.pid: e.worker_id
                   for e in self._workers.values() if e.proc.poll() is None}
         live: set = set()
@@ -816,8 +813,6 @@ class Raylet:
     _TRANSIENT_GCS_ERRORS = (OSError, asyncio.TimeoutError)
 
     def _is_transient(self, e: BaseException) -> bool:
-        from ray_tpu.cluster.rpc import ConnectionLost
-
         return isinstance(e, (ConnectionLost,) + self._TRANSIENT_GCS_ERRORS)
 
     def _defer(self, method: str, payload: Dict) -> None:
@@ -889,8 +884,6 @@ class Raylet:
     def _spawn_worker(self, key: Tuple, chips: List[int],
                       runtime_env: Optional[Dict] = None,
                       python_exe: Optional[str] = None) -> _WorkerEntry:
-        import json
-
         worker_id = os.urandom(8).hex()
         env = dict(os.environ)
         env["RT_WORKER_ID"] = worker_id
@@ -937,11 +930,9 @@ class Raylet:
             # a worker spawned just before a plan-rev change registered too
             # late for _sync_chaos's forward and too early for the spawn
             # env — hand it the CURRENT state so no worker runs stale
-            import json as _json
-
             pj = C.plan_json()
             spawn_task(self._call_quietly(entry.client, "chaos_arm", {
-                "plan": _json.loads(pj) if pj else None,
+                "plan": json.loads(pj) if pj else None,
                 "rev": C.current_rev()}))
         return {"ok": True, "node_id": self.node_id}
 
@@ -985,6 +976,8 @@ class Raylet:
                 # loop and boot the worker with its interpreter (reference:
                 # the agent's conda/container setup swapping
                 # context.py_executable)
+                # rt: lint-allow(hot-path) heavy venv machinery on the
+                # cold per-env boot path, not per-dispatch
                 from ray_tpu.runtime_env.runtime_env import ensure_venv
 
                 cache_root = os.path.join(get_config().session_dir_root,
@@ -1023,8 +1016,6 @@ class Raylet:
         """Detect dead worker processes (reference: worker death via local
         socket disconnect); also purges client uploads abandoned mid-stream
         (dead client) so unsealed store allocations can't pile up."""
-        from ray_tpu._private.ids import ObjectID
-
         self._last_pin_purge = 0.0
         while True:
             await asyncio.sleep(0.5)
@@ -1048,8 +1039,6 @@ class Raylet:
             # idle past the TTL are retired oldest-first — bounds process
             # growth when jobs cycle through many runtime envs
             cfg = get_config()
-            from ray_tpu.core.resources import CPU
-
             soft = cfg.num_workers_soft_limit or max(
                 1, int(self.node.total.get(CPU) or 1))
             all_idle = sorted(
@@ -1245,8 +1234,6 @@ class Raylet:
         ``memory_usage_threshold``, kill one worker — retriable task workers
         first, largest RSS — so the kernel OOM-killer never takes down the
         raylet or an arbitrary process."""
-        from ray_tpu import _native
-
         cfg = get_config()
         while True:
             await asyncio.sleep(cfg.memory_monitor_interval_s)
@@ -1317,8 +1304,6 @@ class Raylet:
                           else "in_memory"} for oid, m in top])
 
     def _pick_oom_victim(self) -> Optional[_WorkerEntry]:
-        from ray_tpu import _native
-
         idle_workers, task_workers, actor_workers = [], [], []
         for e in self._workers.values():
             if e.proc.poll() is not None or e.oom_killed:
@@ -1746,8 +1731,6 @@ class Raylet:
             pool = bundle.pool
         else:
             pool = self.node
-        from ray_tpu.scheduler.policy import strategy_allows_local
-
         local_ok = pg is not None or strategy_allows_local(
             payload.get("strategy"), self.node_id, self.node.labels)
         if local_ok and pool.can_fit(req):
@@ -1772,8 +1755,6 @@ class Raylet:
         are exempt), waited past the spillback delay, not expired."""
         cfg = get_config()
         now = time.monotonic()
-        from ray_tpu.scheduler.policy import strategy_allows_local
-
         budget = self._SPILL_CONC
         launch = []
         for item in self._squeue.window(key, self._SPILL_SCAN):
@@ -1972,8 +1953,6 @@ class Raylet:
         blocked-worker CPU release — prevents parent-waits-on-child
         deadlock). The CPU is not re-acquired on unblock; it flows back when
         the task finishes."""
-        from ray_tpu.core.resources import CPU
-
         state = self._inflight.get(p["task_id"])
         if state is None or not state["released"].is_empty():
             return {"ok": False}
@@ -2230,8 +2209,6 @@ class Raylet:
 
     def _spill_blocking(self) -> List[Tuple[str, int, float]]:
         """Returns [(oid_hex, size, io_seconds)] for each object spilled."""
-        from ray_tpu._private.ids import ObjectID
-
         cfg = get_config()
         threshold = self._store_capacity * cfg.object_spill_threshold
         out: List[Tuple[str, int, float]] = []
@@ -2260,12 +2237,13 @@ class Raylet:
                     # slow-disk injection (spill executor thread, so the
                     # stall hits the IO histogram, not the event loop)
                     self._chaos_stamp("spill.slow", fault, oid=oid_hex)
+                    # rt: lint-allow(lock-discipline) chaos injection: the
+                    # stall deliberately holds the spill lock like a real
+                    # slow disk would (spill executor thread, not the loop)
                     time.sleep(float(fault.get("delay_s", 0.2)))
                 tmp = self._spill_path(oid_hex) + ".tmp"
                 with open(tmp, "wb") as f:
                     f.write(view)
-                from ray_tpu import _native
-
                 meta["crc"] = _native.crc32c(view)
                 os.rename(tmp, self._spill_path(oid_hex))
                 self.store.delete(ObjectID.from_hex(oid_hex))
@@ -2300,8 +2278,6 @@ class Raylet:
         return restored
 
     def _restore_blocking(self, oid_hex: str) -> bool:
-        from ray_tpu._private.ids import ObjectID
-
         with self._spill_lock:
             path = self._spill_path(oid_hex)
             if not os.path.exists(path):
@@ -2310,8 +2286,6 @@ class Raylet:
                 payload = f.read()
             expected = self._object_meta.get(oid_hex, {}).get("crc")
             if expected is not None:
-                from ray_tpu import _native
-
                 if _native.crc32c(payload) != expected:
                     # corrupt spill file: drop it; the owner reconstructs
                     # from lineage (better loud loss than silent corruption)
@@ -2351,8 +2325,6 @@ class Raylet:
     def _drop_object_copies(self, oid_hex: str) -> None:
         """Delete every local copy of an object (shm + spill + meta) —
         the chaos object-loss effect."""
-        from ray_tpu._private.ids import ObjectID
-
         try:
             self.store.delete(ObjectID.from_hex(oid_hex))
         except Exception:  # noqa: BLE001
@@ -2366,8 +2338,6 @@ class Raylet:
             pass
 
     async def rpc_get_object_payload(self, p):
-        from ray_tpu._private.ids import ObjectID
-
         oid_hex = p["oid"]
         view = self.store.read(ObjectID.from_hex(oid_hex))
         if view is not None:
@@ -2383,8 +2353,6 @@ class Raylet:
         """Client-mode upload: a process WITHOUT shared shm (Ray-Client
         analog) streams an object into this node's store in bounded chunks;
         the final chunk seals + registers the location."""
-        from ray_tpu._private.ids import ObjectID
-
         oid_hex = p["oid"]
         oid = ObjectID.from_hex(oid_hex)
         off, total, data = p["offset"], p["total"], p["data"]
@@ -2423,10 +2391,6 @@ class Raylet:
         """Serve one bounded slice of an object (reference: chunked reads,
         ``object_manager/chunk_object_reader.h``); shm and spill-file copies
         both serve — the puller never needs the whole payload in one frame."""
-        from ray_tpu._private.ids import ObjectID
-
-        from ray_tpu import _native
-
         oid_hex, off, size = p["oid"], p["offset"], p["size"]
         kind = _native.checksum_kind()
         view = self.store.read(ObjectID.from_hex(oid_hex))
@@ -2450,8 +2414,6 @@ class Raylet:
         """Pull a remote object into local shm in bounded chunks, writing
         straight into the store's mmap (peak memory = one chunk). Returns
         the object size, or None if the source doesn't have it."""
-        from ray_tpu import _native
-
         def _checked(reply) -> Optional[bytes]:
             data = reply.get("data")
             if data is None:
@@ -2501,8 +2463,6 @@ class Raylet:
         """Pull an object to this node's store (reference: PullManager →
         remote ObjectManager chunked push). Resolution: local shm → local
         spill restore → remote node (which itself serves shm or spill)."""
-        from ray_tpu._private.ids import ObjectID
-
         oid_hex = p["oid"]
         oid = ObjectID.from_hex(oid_hex)
         if self.store.contains(oid):
@@ -2554,8 +2514,6 @@ class Raylet:
         return {"error": "unavailable", "oid": oid_hex}
 
     async def rpc_free_objects(self, p):
-        from ray_tpu._private.ids import ObjectID
-
         for oid_hex in p["oids"]:
             self.store.delete(ObjectID.from_hex(oid_hex))
             self._local_objects.discard(oid_hex)
@@ -2588,8 +2546,6 @@ class Raylet:
         per-object table (largest first, bounded by ``limit``) and live
         worker RSS (reference: the NodeManager stats behind
         ``ray memory`` / ``memory_summary``)."""
-        from ray_tpu import _native
-
         now_mono = time.monotonic()
         states = self._store_state_bytes()
         limit = p.get("limit") or 200
@@ -2644,8 +2600,6 @@ class Raylet:
         ``dump_stacks`` RPC. A worker that can't respond in time (GIL held
         by native code) is reported unreachable rather than hanging the
         whole capture."""
-        from ray_tpu.util.profiling import format_current_stacks
-
         out = [{"pid": os.getpid(), "role": "raylet",
                 "stacks": format_current_stacks()}]
 

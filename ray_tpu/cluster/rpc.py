@@ -227,6 +227,12 @@ class RpcServer:
                 raise RpcError(f"no handler for method {method!r}")
             reply = await handler(payload)
             kind, body = _REP, reply
+        except asyncio.CancelledError:
+            # server teardown cancelling in-flight handlers: cancellation
+            # must stay cancellation — pickling it across the wire as the
+            # "reply" would make shutdown look like an application error
+            # (and write to a closing transport)
+            raise
         except BaseException as e:  # noqa: BLE001 — errors cross the wire
             kind, body = _ERR, e
         try:
@@ -369,6 +375,8 @@ class RpcClient:
                                       else RpcError(str(body)))
                 else:
                     fut.set_result(body)
+        # rt: lint-allow(except-discipline) cancel == connection teardown
+        # here; the loop exits via finally, failing every pending future
         except (asyncio.IncompleteReadError, ConnectionResetError, BrokenPipeError,
                 asyncio.CancelledError):
             pass

@@ -20,13 +20,17 @@ from __future__ import annotations
 
 import asyncio
 import hashlib
+import json
 import logging
+import os
+import sys
 import threading
 import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import cloudpickle
 
+from ray_tpu import _native
 from ray_tpu._private.config import get_config
 from ray_tpu._private.ids import ActorID, JobID, ObjectID, TaskID
 from ray_tpu._private.serialization import SerializationContext, unpack_payload
@@ -34,8 +38,22 @@ from ray_tpu.core.actor import ActorHandle
 from ray_tpu.core.backend import RuntimeBackend
 from ray_tpu.core import object_ledger
 from ray_tpu.core.object_ref import ObjectRef
-from ray_tpu.core.task_spec import resources_from_options, validate_options
+from ray_tpu.core.task_spec import (
+    NodeLabelStrategy,
+    resources_from_options,
+    validate_options,
+)
+from ray_tpu.core.worker import global_worker
 from ray_tpu.cluster.object_store import PlasmaStore
+from ray_tpu.runtime_env import prepare_runtime_env
+from ray_tpu.util import chaos as _chaos
+from ray_tpu.util import metrics as M
+from ray_tpu.util import tracing
+from ray_tpu.util.placement_group import (
+    PlacementGroup,
+    PlacementGroupSchedulingStrategy,
+)
+from ray_tpu.util.tqdm_rt import maybe_render
 from ray_tpu.cluster.rpc import (
     ConnectionLost,
     ConnectionPool,
@@ -48,9 +66,12 @@ from ray_tpu.core import failure as F
 from ray_tpu.exceptions import (
     ActorDiedError,
     ActorUnschedulableError,
+    BackpressureError,
     GetTimeoutError,
     ObjectLostError,
+    OutOfMemoryError,
     OwnerDiedError,
+    SchedulingTimeoutError,
     TaskError,
     WorkerCrashedError,
 )
@@ -62,9 +83,7 @@ _SMALL = lambda: get_config().max_direct_call_object_size
 
 def _trace_ctx():
     """Child-span wire context when tracing is on or a span is ambient
-    (None otherwise) — lazy import keeps tracing off the hot path."""
-    from ray_tpu.util import tracing
-
+    (None otherwise)."""
     return tracing.context_for_submit()
 
 
@@ -77,8 +96,6 @@ def _observe_phases(phases: Dict[str, float]) -> None:
     live). Reached only for traced tasks — never on the untraced path."""
     global _phase_hist
     try:
-        from ray_tpu.util import metrics as M
-
         if _phase_hist is None:
             _phase_hist = M.get_or_create(
                 M.Histogram, "rt_task_phase_seconds",
@@ -118,8 +135,6 @@ def _observe_reconstruction(outcome: str, seconds: float) -> None:
 def _get_recovery_metrics() -> Dict[str, Any]:
     global _recovery_metrics
     if _recovery_metrics is None:
-        from ray_tpu.util import metrics as M
-
         _recovery_metrics = {
             "retries": M.get_or_create(
                 M.Counter, "rt_task_retries_total",
@@ -349,13 +364,9 @@ class ClusterBackend(RuntimeBackend):
         # before any RPC it issues can be a target (worker processes get
         # the plan injected by their raylet at spawn; a driver attaching to
         # a chaos run sets the same env explicitly)
-        import os as _os
-
-        plan_json = _os.environ.get("RT_CHAOS_PLAN_JSON")
+        plan_json = os.environ.get("RT_CHAOS_PLAN_JSON")
         armed_from_env = False
         if plan_json:
-            from ray_tpu.util import chaos as _chaos
-
             try:
                 _chaos.arm(plan_json)
                 armed_from_env = True
@@ -429,16 +440,10 @@ class ClusterBackend(RuntimeBackend):
         """Ship buffered rpc.* injection events so they reach
         `rt errors --origin chaos` (called from _chaos_drain_loop for
         env-armed drivers, and opportunistically from the log-poll tick)."""
-        from ray_tpu.util import chaos as _chaos
-
         for ev in _chaos.drain_events():
             F.emit_raw(spawn_task, self._gcs, ev)
 
     async def _poll_node_logs(self, address: str) -> None:
-        import sys
-
-        from ray_tpu.util.tqdm_rt import maybe_render
-
         try:
             client = await self._pool.get(address)
             head = await client.call("poll_logs", {"after": None},
@@ -483,8 +488,6 @@ class ClusterBackend(RuntimeBackend):
 
     def _put_payload_plasma(self, payload: bytes,
                             oid: Optional[ObjectID] = None) -> ObjectRef:
-        from ray_tpu.core.worker import global_worker
-
         oid = oid or global_worker().next_put_id()
         if not self.shared_store:
             self.io.run(self._upload_object(oid.hex(), payload))
@@ -522,8 +525,6 @@ class ClusterBackend(RuntimeBackend):
                                timeout) -> Optional[memoryview]:
         """Client mode: chunked download from the attached raylet (which
         serves shm and spill copies alike)."""
-        from ray_tpu import _native
-
         def _checked(reply) -> Optional[bytes]:
             data = reply.get("data")
             if data is None:
@@ -560,8 +561,6 @@ class ClusterBackend(RuntimeBackend):
 
     # ---- objects ------------------------------------------------------------
     def put(self, value: Any) -> ObjectRef:
-        from ray_tpu.core.worker import global_worker
-
         payload = self.serde.serialize(value).to_bytes()
         oid = global_worker().next_put_id()
         if len(payload) > _SMALL():
@@ -806,8 +805,6 @@ class ClusterBackend(RuntimeBackend):
 
         payloads = self.io.run(_gather(), timeout=None if timeout is None
                                else timeout + 5.0)
-        from ray_tpu.util import tracing
-
         if not (tracing.enabled() or tracing.current_context() is not None):
             return [self._deserialize_result(p) for p in payloads]
         # driver_get phase: post-reply deserialization in the caller,
@@ -960,11 +957,7 @@ class ClusterBackend(RuntimeBackend):
         env = options.get("runtime_env")
         if not env:
             return None
-        import json as _json
-
-        from ray_tpu.runtime_env import prepare_runtime_env
-
-        cache_key = _json.dumps(env, sort_keys=True, default=str)
+        cache_key = json.dumps(env, sort_keys=True, default=str)
         if cache_key not in self._prepared_envs:
             self._prepared_envs[cache_key] = prepare_runtime_env(
                 env, self.kv_put, self.kv_get)
@@ -975,10 +968,6 @@ class ClusterBackend(RuntimeBackend):
         """Returns (strategy_spec, pg_info) from the options surface, which
         accepts either scheduling_strategy=PlacementGroupSchedulingStrategy
         or the placement_group=... shorthand."""
-        from ray_tpu.util.placement_group import (
-            PlacementGroup,
-            PlacementGroupSchedulingStrategy,
-        )
 
         strategy = options.get("scheduling_strategy")
         selector = options.get("label_selector")
@@ -988,8 +977,6 @@ class ClusterBackend(RuntimeBackend):
                     "label_selector cannot be combined with "
                     "scheduling_strategy; put soft preferences in a "
                     "NodeLabelStrategy(hard=..., soft=...) instead")
-            from ray_tpu.core.task_spec import NodeLabelStrategy
-
             strategy = NodeLabelStrategy(hard=dict(selector))
         pg = options.get("placement_group")
         if pg is not None:
@@ -1022,8 +1009,6 @@ class ClusterBackend(RuntimeBackend):
             cfg.backpressure_retry_max_s))
 
     def _backpressure_error(self, reply: Dict, fn_name: str):
-        from ray_tpu.exceptions import BackpressureError
-
         return BackpressureError(
             f"task {fn_name} rejected under overload: scheduling-class "
             f"queue at its admission bound "
@@ -1083,8 +1068,6 @@ class ClusterBackend(RuntimeBackend):
             "trace": _trace_ctx(),
         }
         self._stamp_overload_options(payload, options)
-        from ray_tpu.util import tracing
-
         self.io.spawn(self._submit_and_collect(
             payload, refs, t_entry=tracing.take_submit_entry()))
         return refs[0] if num_returns == 1 else refs
@@ -1157,8 +1140,6 @@ class ClusterBackend(RuntimeBackend):
                     err: Exception = self._backpressure_error(
                         reply, payload["fn_name"])
                 elif reply["error"] == "deadline_exceeded":
-                    from ray_tpu.exceptions import SchedulingTimeoutError
-
                     err = SchedulingTimeoutError(
                         f"streaming task {payload['fn_name']} shed: "
                         f"{reply.get('message', reply['error'])}",
@@ -1223,8 +1204,6 @@ class ClusterBackend(RuntimeBackend):
                     return
                 if (bp_deadline is not None
                         and time.monotonic() >= bp_deadline):
-                    from ray_tpu.exceptions import SchedulingTimeoutError
-
                     msg, cause = self._deadline_shed(payload, "task")
                     err = SchedulingTimeoutError(
                         f"task {payload['fn_name']} shed: {msg}",
@@ -1304,14 +1283,10 @@ class ClusterBackend(RuntimeBackend):
         if reply.get("error"):
             msg = f"task {fn_name} failed: {reply.get('message', reply['error'])}"
             if reply["error"] == "oom_killed":
-                from ray_tpu.exceptions import OutOfMemoryError
-
                 err: Exception = OutOfMemoryError(msg)
             elif reply["error"] == "deadline_exceeded":
                 # the raylet shed the task (deadline_s budget expired in
                 # queue); get() raises the scheduling_timeout cause
-                from ray_tpu.exceptions import SchedulingTimeoutError
-
                 err = SchedulingTimeoutError(msg, cause=reply.get("cause"))
             elif reply["error"] == "backpressure":
                 # only reachable on paths that bypass the submit loop's
@@ -1463,8 +1438,6 @@ class ClusterBackend(RuntimeBackend):
             "owner": self.address,
             "trace": _trace_ctx(),
         }
-        from ray_tpu.util import tracing
-
         self.io.spawn(self._submit_actor_and_collect(
             payload, refs, method_name,
             t_entry=tracing.take_submit_entry()))

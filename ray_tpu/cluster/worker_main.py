@@ -337,6 +337,8 @@ class WorkerProcess:
                 v = next(it)
             except StopIteration:
                 return {"streaming_done": i}
+            # rt: lint-allow(except-discipline) error transport: the
+            # user generator's failure ships to the owner as stream_error
             except BaseException as e:  # noqa: BLE001
                 traceback.print_exc()
                 if not isinstance(e, TaskError):  # origin only
@@ -406,6 +408,8 @@ class WorkerProcess:
             self._actor_instance = await loop.run_in_executor(
                 self._actor_threads, build)
             return {"ok": True, "address": self.backend.server.address}
+        # rt: lint-allow(except-discipline) error transport: __init__
+        # failure crosses the wire as the create-actor reply
         except BaseException as e:  # noqa: BLE001
             traceback.print_exc()
             return {"ok": False, "error": f"__init__ failed: {e!r}"}
@@ -417,6 +421,13 @@ class WorkerProcess:
                 result = await coro
                 if not fut.done():
                     fut.set_result(result)
+            except asyncio.CancelledError:
+                # teardown cancelling the consumer mid-method: fail the
+                # waiter, then RE-RAISE — swallowing would leave this loop
+                # immortal with cancellation recorded as a method error
+                if not fut.done():
+                    fut.cancel()
+                raise
             except BaseException as e:  # noqa: BLE001
                 if not fut.done():
                     fut.set_exception(e)
@@ -483,6 +494,9 @@ class WorkerProcess:
                     self._emit_span_event(p, "FINISHED",
                                           phases=reply["worker_phases"])
                 return reply
+            # rt: lint-allow(except-discipline) error transport: the
+            # reply IS the unwind path — re-raising would strand the
+            # owner's future until connection loss
             except BaseException as e:  # noqa: BLE001
                 if traced:
                     self._emit_span_event(p, "FAILED")

@@ -498,6 +498,9 @@ class LocalBackend(RuntimeBackend):
                 rkwargs = {k: self._resolve(v) for k, v in kwargs.items()}
                 result = await bound(*rargs, **rkwargs)
                 self._seal_returns(refs, result)
+            # rt: lint-allow(except-discipline) error transport: sealing
+            # the error IS the unwind path — getters would hang forever
+            # on an unsealed ref (see _seal_error's "must seal something")
             except BaseException as e:  # noqa: BLE001
                 self._seal_error(refs, TaskError(method_name, e))
             finally:

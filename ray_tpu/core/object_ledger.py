@@ -73,7 +73,7 @@ class _Entry:
 
 class OwnershipLedger:
     def __init__(self):
-        self._entries: Dict[str, _Entry] = {}
+        self._entries: Dict[str, _Entry] = {}  # rt: guarded-by(_lock)
         self._lock = threading.Lock()
         self._pusher: Optional[threading.Thread] = None
         self._record_sites: Optional[bool] = None  # lazy config read
@@ -109,7 +109,7 @@ class OwnershipLedger:
         return ""
 
     # ---- recording ----------------------------------------------------------
-    def _entry(self, oid_hex: str) -> _Entry:
+    def _entry_locked(self, oid_hex: str) -> _Entry:
         e = self._entries.get(oid_hex)
         if e is None:
             if len(self._entries) >= _MAX_ENTRIES:
@@ -132,7 +132,7 @@ class OwnershipLedger:
             site = self._call_site() if self._sites_enabled() else ""
             with self._lock:
                 self._drain_derefs_locked()
-                e = self._entry(oid_hex)
+                e = self._entry_locked(oid_hex)
                 e.local_refs += 1
                 if ref.owner_address() and not e.owner:
                     e.owner = ref.owner_address()
@@ -162,7 +162,7 @@ class OwnershipLedger:
                    owner: Optional[str] = None) -> None:
         with self._lock:
             self._drain_derefs_locked()
-            e = self._entry(oid_hex)
+            e = self._entry_locked(oid_hex)
             e.size = size
             e.where = where
             if owner:

@@ -316,8 +316,8 @@ class ContinuousEngine:
         self.max_slots = max_slots
         self.max_len = max_len
         self._on_tick = on_tick
-        self._pending: "deque[_EngineRequest]" = deque()
-        self._live: Dict[int, _EngineRequest] = {}  # req_id -> request
+        self._pending: "deque[_EngineRequest]" = deque()  # rt: guarded-by(_work)
+        self._live: Dict[int, _EngineRequest] = {}  # rt: guarded-by(_work)
         self._admitting: Optional[_EngineRequest] = None  # mid-prefill
         self._lock = threading.Lock()
         self._work = threading.Condition(self._lock)

@@ -729,6 +729,12 @@ def main(argv=None) -> int:
         from ray_tpu.scripts import profile as _profile
 
         return _profile.main(argv[1:])
+    if argv[:1] == ["lint"]:
+        # passthrough like profile: analysis/runner.py owns the flag set
+        # (`rt lint [--json] [--baseline-update] [paths...]`)
+        from ray_tpu.analysis import runner as _lint
+
+        return _lint.main(argv[1:])
     parser = argparse.ArgumentParser(prog="rt")
     sub = parser.add_subparsers(dest="cmd", required=True)
 
@@ -783,6 +789,13 @@ def main(argv=None) -> int:
         "profile", add_help=False,
         help="step profiler: per-step wall/compile/sync breakdown + MFU "
              "over a model preset (util/step_profiler.py)")
+
+    # `rt lint` is routed in main() before parsing too (analysis/runner.py
+    # owns the flag set); stub for `rt --help` discoverability
+    sub.add_parser(
+        "lint", add_help=False,
+        help="concurrency/runtime-invariant static analysis with a "
+             "ratcheted baseline (ray_tpu/analysis)")
 
     p_micro = sub.add_parser("microbenchmark",
                              help="core-ops throughput sweep")

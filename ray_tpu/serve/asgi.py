@@ -346,6 +346,11 @@ async def _run_ws_asgi(app, request, conn_id: str, instance):
                 yield {"kind": "close", "code": msg.get("code", 1000),
                        "reason": msg.get("reason", "")}
                 return
+    except (asyncio.CancelledError, GeneratorExit):
+        # consumer torn down mid-stream: yielding a close frame from here
+        # would raise "async generator ignored GeneratorExit" — cleanup
+        # happens in finally, cancellation stays cancellation
+        raise
     except BaseException as e:  # noqa: BLE001 — app error -> 1011 close
         yield {"kind": "close", "code": 1011, "reason": str(e)[:120]}
         return

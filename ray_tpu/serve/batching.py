@@ -43,18 +43,27 @@ class _Batcher:
         while True:
             item, fut = await self._queue.get()
             batch = [(item, fut)]
-            max_size = self._wrapper._rt_max_batch_size
-            timeout = self._wrapper._rt_batch_wait_timeout_s
-            deadline = asyncio.get_running_loop().time() + timeout
-            while len(batch) < max_size:
-                remaining = deadline - asyncio.get_running_loop().time()
-                if remaining <= 0:
-                    break
-                try:
-                    batch.append(await asyncio.wait_for(
-                        self._queue.get(), timeout=remaining))
-                except asyncio.TimeoutError:
-                    break
+            try:
+                max_size = self._wrapper._rt_max_batch_size
+                timeout = self._wrapper._rt_batch_wait_timeout_s
+                deadline = asyncio.get_running_loop().time() + timeout
+                while len(batch) < max_size:
+                    remaining = deadline - asyncio.get_running_loop().time()
+                    if remaining <= 0:
+                        break
+                    try:
+                        batch.append(await asyncio.wait_for(
+                            self._queue.get(), timeout=remaining))
+                    except asyncio.TimeoutError:
+                        break
+            except asyncio.CancelledError:
+                # cancelled mid-COLLECTION (deployment stop): the pairs
+                # already dequeued would otherwise hang their callers
+                # forever — same PR 2 class as the flush-side handler below
+                for _, f in batch:
+                    if not f.done():
+                        f.cancel()
+                raise
             items = [b[0] for b in batch]
             futs = [b[1] for b in batch]
             try:
@@ -75,6 +84,15 @@ class _Batcher:
                         f"@serve.batch function must return a list of "
                         f"length {len(items)}, got "
                         f"{type(results).__name__}")
+            except asyncio.CancelledError:
+                # the flush task itself was cancelled (deployment stop):
+                # fail the collected waiters, then RE-RAISE — swallowing
+                # left the loop immortal with cancellation fanned out as
+                # an application error (the PR 2 pump-leak class)
+                for f in futs:
+                    if not f.done():
+                        f.cancel()
+                raise
             except BaseException as e:  # noqa: BLE001 — fan the error out
                 for f in futs:
                     if not f.done():

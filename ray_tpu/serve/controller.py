@@ -194,7 +194,11 @@ class ServeController:
         self._proxy_port: Optional[int] = None
         # multi-proxy scale-out: [(proxy_id, handle, port)]; entry 0 is
         # the back-compat RT_SERVE_PROXY on the requested port
-        self._proxies: List[Tuple[str, Any, int]] = []
+        self._proxies: List[Tuple[str, Any, int]] = []  # rt: guarded-by(_lock)
+        # serializes proxy *boots* only: actor creation + ready round-trips
+        # take seconds and must never run under self._lock, which every
+        # cheap status/routing getter shares (rt lint: lock-discipline)
+        self._proxy_boot_lock = threading.Lock()
         self._shutdown = False
         # autoscaler decision log: every applied target change, with the
         # metric values that produced it (bounded; `rt serve status
@@ -362,14 +366,35 @@ class ServeController:
         HTTP proxy."""
         from ray_tpu.serve.grpc_proxy import GrpcProxyActor
 
-        with self._lock:
-            if self._grpc_proxy is None:
-                self._grpc_proxy = GrpcProxyActor.options(
-                    name="RT_SERVE_GRPC_PROXY", max_concurrency=256,
-                    num_cpus=0).remote(host, port)
-                self._grpc_port = ray_tpu.get(
-                    self._grpc_proxy.ready.remote())
-            return self._grpc_port
+        with self._proxy_boot_lock:
+            with self._lock:
+                if self._grpc_proxy is not None:
+                    return self._grpc_port
+            if self._shutdown:
+                # shutdown held the boot lock first and already tore the
+                # proxies down — booting now would leak past teardown
+                raise RuntimeError("serve controller is shut down")
+            # boot OUTSIDE self._lock: the ready round-trip takes seconds
+            # and would convoy every status/routing getter behind it
+            handle = GrpcProxyActor.options(
+                name="RT_SERVE_GRPC_PROXY", max_concurrency=256,
+                num_cpus=0).remote(host, port)
+            try:
+                # rt: lint-allow(lock-discipline) the boot lock's whole
+                # job is to serialize this slow boot; nothing latency-
+                # sensitive contends on it (self._lock must stay free)
+                got = ray_tpu.get(handle.ready.remote())
+            except BaseException:
+                # a half-booted NAMED actor left alive would block every
+                # retry with "actor name taken" and escape shutdown
+                try:
+                    ray_tpu.kill(handle)
+                except Exception:  # noqa: BLE001 — best-effort reap
+                    pass
+                raise
+            with self._lock:
+                self._grpc_proxy, self._grpc_port = handle, got
+                return self._grpc_port
 
     # -- http proxy -----------------------------------------------------------
     def ensure_proxy(self, host: str, port: int, count: int = 1) -> int:
@@ -383,23 +408,45 @@ class ServeController:
         loop instead of queueing behind one aiohttp process."""
         from ray_tpu.serve.proxy import ProxyActor
 
-        with self._lock:
-            want = max(1, int(count))
-            while len(self._proxies) < want:
-                idx = len(self._proxies)
+        want = max(1, int(count))
+        # the boot lock (not self._lock) serializes concurrent growers:
+        # each actor boot + start round-trip takes seconds, and holding
+        # self._lock across it used to freeze every status/routing getter
+        with self._proxy_boot_lock:
+            while True:
+                with self._lock:
+                    idx = len(self._proxies)
+                    if idx >= want:
+                        return self._proxy_port
+                if self._shutdown:
+                    # shutdown held the boot lock first and already tore
+                    # the proxies down — booting now would leak past it
+                    raise RuntimeError("serve controller is shut down")
                 proxy_id = "proxy-0" if idx == 0 else f"proxy-{idx}"
                 name = ("RT_SERVE_PROXY" if idx == 0
                         else f"RT_SERVE_PROXY_{idx}")
                 handle = ProxyActor.options(
                     name=name, max_concurrency=256, num_cpus=0).remote()
                 bind_port = port if idx == 0 else 0
-                got = ray_tpu.get(handle.start.remote(host, bind_port,
-                                                      proxy_id))
-                self._proxies.append((proxy_id, handle, got))
-                if idx == 0:
-                    self._proxy, self._proxy_port = handle, got
+                try:
+                    # rt: lint-allow(lock-discipline) boot lock again:
+                    # held across the boot on purpose, cheap getters use
+                    # self._lock
+                    got = ray_tpu.get(handle.start.remote(host, bind_port,
+                                                          proxy_id))
+                except BaseException:
+                    # reap the half-booted named actor or its name blocks
+                    # every retry and it escapes shutdown teardown
+                    try:
+                        ray_tpu.kill(handle)
+                    except Exception:  # noqa: BLE001 — best-effort reap
+                        pass
+                    raise
+                with self._lock:
+                    self._proxies.append((proxy_id, handle, got))
+                    if idx == 0:
+                        self._proxy, self._proxy_port = handle, got
                 self._register_proxy(proxy_id, host, got)
-            return self._proxy_port
 
     def proxy_ports(self) -> List[int]:
         with self._lock:
@@ -754,23 +801,33 @@ class ServeController:
             pass
         with self._update_cond:
             self._update_cond.notify_all()  # release blocked long-polls
-        self._deregister_proxies()
-        with self._lock:
-            for key in list(self._deployments):
-                self._stop_deployment(self._deployments.pop(key))
-            self._apps.clear()
-            proxies, self._proxies = list(self._proxies), []
-            self._proxy = None
-            gproxy, self._grpc_proxy = self._grpc_proxy, None
-        for _, proxy, _ in proxies:
-            try:
-                ray_tpu.get(proxy.stop.remote())
-                ray_tpu.kill(proxy)
-            except Exception:  # noqa: BLE001
-                pass
-        if gproxy is not None:
-            try:
-                ray_tpu.get(gproxy.shutdown.remote())
-                ray_tpu.kill(gproxy)
-            except Exception:  # noqa: BLE001
-                pass
+        # the boot lock serializes against an in-flight ensure_proxy /
+        # ensure_grpc_proxy on another controller thread: without it, a
+        # proxy mid-boot would be appended+registered AFTER the teardown
+        # below swapped the list, leaking a live actor past shutdown
+        # rt: lint-allow(lock-discipline) boot lock: held across the
+        # proxy stop RPCs on purpose (see ensure_proxy)
+        with self._proxy_boot_lock:
+            self._deregister_proxies()
+            with self._lock:
+                for key in list(self._deployments):
+                    self._stop_deployment(self._deployments.pop(key))
+                self._apps.clear()
+                proxies, self._proxies = list(self._proxies), []
+                self._proxy = None
+                gproxy, self._grpc_proxy = self._grpc_proxy, None
+            for _, proxy, _ in proxies:
+                try:
+                    # rt: lint-allow(lock-discipline) shutdown stop RPC:
+                    # the boot lock is held on purpose (header comment)
+                    ray_tpu.get(proxy.stop.remote())
+                    ray_tpu.kill(proxy)
+                except Exception:  # noqa: BLE001
+                    pass
+            if gproxy is not None:
+                try:
+                    # rt: lint-allow(lock-discipline) same as above
+                    ray_tpu.get(gproxy.shutdown.remote())
+                    ray_tpu.kill(gproxy)
+                except Exception:  # noqa: BLE001
+                    pass

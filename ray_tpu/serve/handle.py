@@ -90,6 +90,8 @@ class _RouterState:
         self._poller_stop = threading.Event()
 
     def _controller(self):
+        # rt: lint-allow(hot-path) import-cycle break (serve.api imports
+        # this module); control-plane lookup, cached on the router state
         from ray_tpu.serve.api import _get_controller
 
         return _get_controller()

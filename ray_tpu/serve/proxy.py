@@ -18,10 +18,26 @@ import asyncio
 import json as _json
 import os
 import time
+import uuid
 from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
 
 import ray_tpu
 from ray_tpu.serve import obs
+from ray_tpu.serve.asgi import ASGIResponse, ASGIResponseStart
+from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponseGenerator
+from ray_tpu.serve.replica import REJECTED as REJECTED_STATUS
+from ray_tpu.util import metrics
+
+# aiohttp is the serve-ingress dependency; the module must stay importable
+# without it (start() raises the actionable error), but the web/multidict
+# lookups must not run per request — PR 10 hot-path rule
+try:
+    from aiohttp import WSMsgType, web
+    from multidict import CIMultiDict
+except ImportError:  # surfaced at start(); handlers never run without it
+    WSMsgType = web = CIMultiDict = None
 
 _ROUTE_TTL_S = 1.0
 
@@ -67,8 +83,6 @@ def _to_response(result: Any):
     if isinstance(result, str):
         return status, "text/plain; charset=utf-8", result.encode()
     try:
-        import numpy as np
-
         if isinstance(result, np.ndarray):
             result = result.tolist()
         payload = _json.dumps(result, default=_np_default).encode()
@@ -78,8 +92,6 @@ def _to_response(result: Any):
 
 
 def _np_default(o):
-    import numpy as np
-
     if isinstance(o, (np.integer,)):
         return int(o)
     if isinstance(o, (np.floating,)):
@@ -113,9 +125,10 @@ class ProxyActor:
 
     async def start(self, host: str, port: int,
                     proxy_id: str = "proxy-0") -> int:
-        from aiohttp import web
-
         self._proxy_id = proxy_id
+        if web is None:
+            raise ImportError("aiohttp is required for the serve HTTP "
+                              "proxy (pip install aiohttp)")
         app = web.Application(client_max_size=64 * 1024 * 1024)
         app.router.add_route("*", "/{tail:.*}", self._handle)
         self._runner = web.AppRunner(app, access_log=None)
@@ -132,6 +145,8 @@ class ProxyActor:
             self._runner = None
 
     def _controller(self):
+        # rt: lint-allow(hot-path) import-cycle break (serve.api imports
+        # this module); resolved once then cached on self
         from ray_tpu.serve.api import _get_controller
 
         return _get_controller()
@@ -189,8 +204,6 @@ class ProxyActor:
         drains a proxy whose controller went away. ``?verbose=1`` returns
         the JSON body on 200 too; ``?stale_after=`` overrides the
         threshold (tests / per-LB tuning)."""
-        from aiohttp import web
-
         # probe on demand: an idle proxy must not go stale merely because
         # no request has started the poller yet
         if self._last_route_ok == 0.0:
@@ -230,8 +243,6 @@ class ProxyActor:
                 "app": app, "deployment": deployment, "kind": "http_5xx"})
 
     async def _handle(self, request):
-        from aiohttp import web
-
         path = "/" + request.match_info["tail"]
         if path == "/-/healthz":
             return await self._healthz(request)
@@ -257,8 +268,6 @@ class ProxyActor:
         key = (app_name, ingress)
         handle = self._handles.get(key)
         if handle is None:
-            from ray_tpu.serve.handle import DeploymentHandle
-
             handle = DeploymentHandle(app_name, ingress)
             self._handles[key] = handle
         req_ctx = {"request_id": request_id, "app": app_name,
@@ -338,9 +347,6 @@ class ProxyActor:
                                 headers=rid_hdr)
         t_handle = time.perf_counter()
         self._requests_served += 1
-        from ray_tpu.serve.asgi import ASGIResponse
-        from ray_tpu.serve.handle import DeploymentResponseGenerator
-
         if isinstance(result, DeploymentResponseGenerator):
             return await self._stream_response(
                 request, result, req_ctx=req_ctx, t0=t0,
@@ -348,8 +354,6 @@ class ProxyActor:
         if isinstance(result, ASGIResponse):
             # ASGI deployments control the full response surface; a
             # multidict preserves duplicate headers (Set-Cookie x2)
-            from multidict import CIMultiDict
-
             headers = CIMultiDict(result.headers)
             headers.setdefault(obs.REQUEST_ID_HEADER, request_id)
             finish(result.status, t_handle)
@@ -371,13 +375,6 @@ class ProxyActor:
         generator's actor), so the per-caller actor FIFO preserves frame
         order. The 101 handshake is deferred until the app accepts; a
         close-before-accept surfaces as HTTP 403 (ASGI denial semantics)."""
-        import uuid
-
-        from aiohttp import WSMsgType, web
-
-        from ray_tpu.serve.handle import DeploymentResponseGenerator
-        from ray_tpu.serve.replica import REJECTED as REJECTED_STATUS
-
         conn_id = uuid.uuid4().hex
         sreq = ServeRequest(
             method="GET", path=stripped,
@@ -490,12 +487,6 @@ class ProxyActor:
         spec-decode are judged against): TTFT is request receipt to the
         first body chunk, every inter-chunk gap lands in the TPOT
         histogram, and chunks count into ``rt_serve_tokens_total``."""
-        from aiohttp import web
-
-        from multidict import CIMultiDict
-
-        from ray_tpu.serve.asgi import ASGIResponseStart
-
         tok_tags = ({"app": req_ctx["app"],
                      "deployment": req_ctx["deployment"]}
                     if req_ctx else None)
@@ -590,8 +581,6 @@ class ProxyActor:
     def flush_metrics(self) -> None:
         """Push this proxy's metric registry + buffered serve spans now
         (tests/ops — the background pushers run on an interval)."""
-        from ray_tpu.util import metrics
-
         obs.flush_spans()
         metrics.flush_now()
 

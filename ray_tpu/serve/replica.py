@@ -15,12 +15,19 @@ the autoscaler is effectively provisioning TPU slices.
 from __future__ import annotations
 
 import asyncio
+import contextvars
+import functools
 import inspect
+import threading
 import time
+from collections import deque
+from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, List, Optional, Tuple
 
 import ray_tpu
-from ray_tpu.util import step_profiler
+from ray_tpu.serve import obs
+from ray_tpu.serve.multiplex import loaded_model_ids
+from ray_tpu.util import metrics, step_profiler
 
 REJECTED = "__rt_serve_rejected__"
 
@@ -135,6 +142,8 @@ class ReplicaActor:
                  body_ref, init_args: Tuple, init_kwargs: Dict,
                  max_ongoing_requests: int,
                  user_config: Optional[Dict] = None):
+        # rt: lint-allow(hot-path) import-cycle break (handle.py imports
+        # REJECTED from this module); one lookup per replica boot
         from ray_tpu.serve.handle import _resolve_handle_markers
 
         self._deployment = deployment_name
@@ -150,9 +159,6 @@ class ReplicaActor:
         # per-deployment p50/p99 + QPS the autoscaler and `rt serve
         # status` report
         self._executing = 0
-        import threading
-        from collections import deque
-
         # executor threads and the event loop both move the counter — a
         # drifted count would misreport queue depth forever
         self._exec_lock = threading.Lock()
@@ -160,8 +166,6 @@ class ReplicaActor:
         # sync user callables run here, NOT on the worker's event loop — a
         # blocking body (the common case: a jitted forward pass) must not
         # stall the RPC server or sibling requests
-        from concurrent.futures import ThreadPoolExecutor
-
         self._exec = ThreadPoolExecutor(
             max_workers=max(1, max_ongoing_requests),
             thread_name_prefix="rt-replica")
@@ -198,14 +202,10 @@ class ReplicaActor:
             return (REJECTED, self._ongoing)
         self._ongoing += 1
         try:
-            import contextvars
-            import functools
-
-            from ray_tpu.serve import obs
-            from ray_tpu.serve.multiplex import (
-                _current_model_id,
-                loaded_model_ids,
-            )
+            # rt: lint-allow(hot-path) must stay function-local: a
+            # module-global ContextVar would ride cloudpickle's by-value
+            # capture of this actor class, and ContextVars don't pickle
+            from ray_tpu.serve.multiplex import _current_model_id
 
             target = self._instance
             if method_name != "__call__":
@@ -422,15 +422,10 @@ class ReplicaActor:
     def flush_metrics(self) -> None:
         """Push this replica's metric registry + buffered serve spans now
         (tests/ops — the background pushers run on an interval)."""
-        from ray_tpu.serve import obs
-        from ray_tpu.util import metrics
-
         obs.flush_spans()
         metrics.flush_now()
 
     def stats(self) -> Dict[str, Any]:
-        from ray_tpu.serve.multiplex import loaded_model_ids
-
         return {"replica_id": self._replica_id, "ongoing": self._ongoing,
                 "total_served": self._total_served,
                 "uptime_s": time.time() - self._started_at,

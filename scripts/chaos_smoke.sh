@@ -19,6 +19,11 @@ export RT_SESSION_DIR_ROOT="${RT_SESSION_DIR_ROOT:-$(mktemp -d /tmp/rt_chaos_smo
 cleanup() { $RT stop --force >/dev/null 2>&1 || true; }
 trap cleanup EXIT
 
+echo "== pre-flight: rt lint (static invariants, ratcheted baseline) =="
+# cheapest gate first: a concurrency/hot-path/purity violation fails in
+# seconds here instead of minutes into the chaos legs
+$RT lint
+
 echo "== start head node =="
 $RT start --head --num-cpus 4
 

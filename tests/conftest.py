@@ -75,6 +75,126 @@ def pytest_itemcollected(item):
         item.add_marker("slow_rotation")
 
 
+# ---- session leak guard ----------------------------------------------------
+# The chaos-smoke lesson (PR 7/9): a test that leaks a node daemon poisons
+# every LATER pytest run on the machine — silently. Fail THIS run loudly
+# instead: at session start record the already-running node daemons; at
+# session finish, any new daemon still alive (or any non-daemon thread a
+# test left running) flips the exit status and names the culprit. Leaked
+# daemons are then killed so the next run starts clean.
+# RT_LEAK_GUARD=0 disables; RT_LEAK_GUARD_KILL=0 reports without reaping.
+
+def _is_node_daemon(pid):
+    """cmdline-verified: never trust a bare PID (a stale state file's pid
+    can be recycled by the OS for an innocent process mid-session)."""
+    try:
+        with open(f"/proc/{pid}/cmdline", "rb") as f:
+            return b"ray_tpu.cluster.node_main" in f.read()
+    except OSError:
+        return False
+
+
+def _node_daemon_pids():
+    """PIDs verifiably running ray_tpu.cluster.node_main: the /proc scan
+    (Linux), cross-checked with the state-dir records — every candidate
+    must pass the cmdline check before it can be reported or reaped."""
+    pids = set()
+    try:
+        for name in os.listdir("/proc"):
+            if name.isdigit() and _is_node_daemon(int(name)):
+                pids.add(int(name))
+    except OSError:
+        pass
+    try:
+        from ray_tpu.cluster import node_main
+
+        for fn in os.listdir(node_main.state_dir()):
+            try:
+                import json
+
+                with open(os.path.join(node_main.state_dir(), fn)) as f:
+                    pid = json.load(f)["pid"]
+                if _is_node_daemon(pid):
+                    pids.add(pid)
+            except (OSError, ValueError, KeyError):
+                continue
+    except Exception:  # noqa: BLE001 — guard must never break collection
+        pass
+    return pids
+
+
+def _leaked_threads(baseline=()):
+    """Non-daemon threads a test left behind: everything except the main
+    thread, executor workers (ThreadPoolExecutor joins them at
+    interpreter exit — they are parked, not leaked), and threads that
+    were already alive before the session started (an embedding host
+    app's workers are not ours to report)."""
+    import threading
+
+    out = []
+    for t in threading.enumerate():
+        if t is threading.main_thread() or t.daemon or not t.is_alive():
+            continue
+        if any(t is b for b in baseline):
+            continue
+        target_mod = getattr(getattr(t, "_target", None), "__module__", "")
+        if target_mod.startswith("concurrent.futures"):
+            continue
+        out.append(t)
+    return out
+
+
+def pytest_sessionstart(session):
+    if os.environ.get("RT_LEAK_GUARD", "1") == "0":
+        return
+    import threading
+
+    session.config._rt_preexisting_daemons = _node_daemon_pids()
+    # Thread OBJECTS, not idents: the OS recycles idents, so a leaked
+    # thread could silently alias a dead baseline thread's ident
+    session.config._rt_preexisting_threads = list(threading.enumerate())
+
+
+def pytest_sessionfinish(session, exitstatus):
+    if os.environ.get("RT_LEAK_GUARD", "1") == "0":
+        return
+    import time
+
+    baseline = getattr(session.config, "_rt_preexisting_daemons", None)
+    if baseline is None:
+        return
+    thread_baseline = getattr(session.config,
+                              "_rt_preexisting_threads", set())
+    # wind-down grace: teardowns signal daemons/threads asynchronously
+    leaked_pids, leaked_thr = set(), []
+    for _ in range(8):
+        leaked_pids = _node_daemon_pids() - baseline
+        leaked_thr = _leaked_threads(thread_baseline)
+        if not leaked_pids and not leaked_thr:
+            return
+        time.sleep(0.25)
+    print("\n===== RT LEAK GUARD: this run leaked =====", file=sys.stderr)
+    for pid in sorted(leaked_pids):
+        print(f"  node daemon pid={pid} (ray_tpu.cluster.node_main) still "
+              f"alive — it would silently wedge every later pytest run",
+              file=sys.stderr)
+    for t in leaked_thr:
+        print(f"  non-daemon thread {t.name!r} still alive (target="
+              f"{getattr(t, '_target', None)!r})", file=sys.stderr)
+    if leaked_pids and os.environ.get("RT_LEAK_GUARD_KILL", "1") != "0":
+        import signal as _signal
+
+        for pid in leaked_pids:
+            try:
+                if _is_node_daemon(pid):  # re-verify at kill time
+                    os.kill(pid, _signal.SIGKILL)
+                    print(f"  reaped pid={pid}", file=sys.stderr)
+            except OSError:
+                pass
+    print("==========================================", file=sys.stderr)
+    session.exitstatus = 1
+
+
 @pytest.fixture(autouse=True)
 def _hang_watchdog(request):
     """A test that wedges past 50s first dumps the io-loop's asyncio task

@@ -1,0 +1,482 @@
+"""``rt lint`` as a tier-1 gate, plus fixture coverage for every checker.
+
+The gate test runs the real thing — full ``ray_tpu/`` scan against the
+committed baseline — so any new concurrency/runtime-invariant violation
+fails CI exactly like it fails ``rt lint``. The fixture tests prove each
+checker still *fires* on a minimal reproduction of the bug class it was
+built for (including the PR 8 finalizer deadlock and the PR 2
+cancel-swallow) and stays quiet on the sanctioned twin, so the gate can't
+rot into a vacuous pass. Named ``test_zz_*`` to sort late in the suite.
+"""
+
+import textwrap
+
+from ray_tpu.analysis import baseline as B
+from ray_tpu.analysis import runner
+from ray_tpu.analysis.core import Finding, all_checkers
+
+
+def _lint(tmp_path, source, select=None, name="case.py"):
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(source))
+    res = runner.run_lint(paths=[str(p)], select=select,
+                          use_baseline=False)
+    return res["findings"]
+
+
+# ---- the gate ---------------------------------------------------------------
+
+def test_lint_gate_repo_clean():
+    """Full-tree scan against the committed baseline: zero new findings.
+    A violation introduced anywhere in ray_tpu/ fails here first."""
+    res = runner.run_lint()  # default: ray_tpu/ + scripts/lint_baseline.json
+    assert len(res["checkers"]) >= 6, res["checkers"]
+    msgs = "\n".join(f.render() for f in res["findings"])
+    assert not res["findings"], f"new lint findings:\n{msgs}"
+    # the ratchet file must stay honest: no stale suppressions either
+    assert not res["stale"], (
+        f"baseline entries whose debt was paid down — shrink the file "
+        f"with `rt lint --baseline-update`: {res['stale']}")
+
+
+def test_bundled_checkers_registered():
+    names = set(all_checkers())
+    assert {"lock-discipline", "event-loop-blocking", "hot-path",
+            "except-discipline", "jax-purity", "guarded-by",
+            "metrics-doc"} <= names
+
+
+# ---- lock-discipline --------------------------------------------------------
+
+_PR8_FINALIZER_DEADLOCK = """
+    import threading
+    import weakref
+
+    class Ledger:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._entries = {}
+
+        def record(self, ref, key):
+            with self._lock:
+                self._entries[key] = 1
+            weakref.finalize(ref, self._deref, key)
+
+        def _deref(self, key):
+            with self._lock:
+                self._entries.pop(key, None)
+"""
+
+
+def test_lock_discipline_fires_on_pr8_finalizer_deadlock(tmp_path):
+    found = _lint(tmp_path, _PR8_FINALIZER_DEADLOCK,
+                  select=["lock-discipline"])
+    assert any("weakref.finalize" in f.message and "_lock" in f.message
+               for f in found), found
+
+
+def test_lock_discipline_transitive_and_del(tmp_path):
+    # __del__ -> helper -> lock: caught through the intra-module call graph
+    found = _lint(tmp_path, """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def _evict(self):
+                with self._lock:
+                    pass
+
+            def __del__(self):
+                self._evict()
+    """, select=["lock-discipline"])
+    assert any("__del__" in f.message for f in found), found
+
+
+def test_lock_discipline_clean_on_atomic_finalizer(tmp_path):
+    # the shipped fix: finalizers only touch an atomic deque
+    found = _lint(tmp_path, """
+        import collections
+        import threading
+        import weakref
+
+        class Ledger:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._pending = collections.deque()
+
+            def record(self, ref, key):
+                weakref.finalize(ref, self._deref, key)
+
+            def _deref(self, key):
+                self._pending.append(key)
+    """, select=["lock-discipline"])
+    assert found == [], found
+
+
+def test_lock_discipline_blocking_under_lock(tmp_path):
+    found = _lint(tmp_path, """
+        import threading
+        import ray_tpu
+
+        class Controller:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._port = None
+
+            def ensure(self, handle):
+                with self._lock:
+                    self._port = ray_tpu.get(handle.ready.remote())
+                return self._port
+    """, select=["lock-discipline"])
+    assert any("ray_tpu.get" in f.message for f in found), found
+
+
+def test_lock_discipline_await_under_sync_lock(tmp_path):
+    found = _lint(tmp_path, """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            async def update(self, client):
+                with self._lock:
+                    await client.call("x", {})
+    """, select=["lock-discipline"])
+    assert any("await" in f.message for f in found), found
+    # boot-outside-the-lock twin is clean
+    clean = _lint(tmp_path, """
+        import threading
+        import ray_tpu
+
+        class Controller:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._port = None
+
+            def ensure(self, handle):
+                got = ray_tpu.get(handle.ready.remote())
+                with self._lock:
+                    self._port = got
+                return self._port
+    """, select=["lock-discipline"], name="clean.py")
+    assert clean == [], clean
+
+
+# ---- event-loop-blocking ----------------------------------------------------
+
+def test_event_loop_blocking_fires_and_exempts_nested_defs(tmp_path):
+    found = _lint(tmp_path, """
+        import time
+
+        async def tick():
+            time.sleep(1.0)
+    """, select=["event-loop-blocking"])
+    assert any(f.detail == "time.sleep" for f in found), found
+    # a nested sync def runs in an executor, not on the loop
+    clean = _lint(tmp_path, """
+        import asyncio
+        import time
+
+        async def tick(loop):
+            def work():
+                time.sleep(1.0)
+            await loop.run_in_executor(None, work)
+            await asyncio.sleep(0.1)
+    """, select=["event-loop-blocking"], name="clean.py")
+    assert clean == [], clean
+
+
+# ---- hot-path ---------------------------------------------------------------
+
+def test_hot_path_fires_in_declared_hot_module(tmp_path):
+    found = _lint(tmp_path, """
+        # rt: hot-module
+
+        import re
+
+        def dispatch(payload):
+            import json
+            pat = re.compile(r"x+")
+            return json.dumps(payload), pat
+    """, select=["hot-path"])
+    details = {f.detail for f in found}
+    assert "import:json" in details and "ctor:re.compile" in details, found
+
+
+def test_hot_path_quiet_without_declaration_and_with_allow(tmp_path):
+    # same code, no hot-module marker: not flagged
+    clean = _lint(tmp_path, """
+        def dispatch(payload):
+            import json
+            return json.dumps(payload)
+    """, select=["hot-path"])
+    assert clean == [], clean
+    allowed = _lint(tmp_path, """
+        # rt: hot-module
+
+        def dispatch(payload):
+            # rt: lint-allow(hot-path) cycle break, boots once
+            import json
+            return json.dumps(payload)
+    """, select=["hot-path"], name="allowed.py")
+    assert allowed == [], allowed
+
+
+# ---- except-discipline ------------------------------------------------------
+
+_PR2_CANCEL_SWALLOW = """
+    import asyncio
+
+    class Pump:
+        async def run(self, agen, queue):
+            while True:
+                try:
+                    item = await agen.__anext__()
+                    await queue.put(item)
+                except StopAsyncIteration:
+                    return
+                except asyncio.CancelledError:
+                    pass
+"""
+
+
+def test_except_discipline_fires_on_pr2_cancel_swallow(tmp_path):
+    found = _lint(tmp_path, _PR2_CANCEL_SWALLOW,
+                  select=["except-discipline"])
+    assert any("CancelledError" in f.message for f in found), found
+
+
+def test_except_discipline_sanctioned_shapes_stay_quiet(tmp_path):
+    clean = _lint(tmp_path, """
+        import asyncio
+
+        class Pump:
+            async def run(self, agen, queue):
+                while True:
+                    try:
+                        await queue.put(await agen.__anext__())
+                    except StopAsyncIteration:
+                        return
+                    except asyncio.CancelledError:
+                        queue.put_nowait(None)
+                        raise
+
+            async def reap(self, task):
+                task.cancel()
+                try:
+                    await task
+                except asyncio.CancelledError:
+                    pass
+    """, select=["except-discipline"])
+    assert clean == [], clean
+
+
+def test_except_discipline_conversion_raise_still_fires(tmp_path):
+    """`raise Other(...) from e` CONVERTS cancellation into an app error —
+    the bug, not a re-raise; only bare `raise` / `raise e` sanctions."""
+    found = _lint(tmp_path, """
+        import asyncio
+
+        class Pump:
+            async def run(self, agen, q):
+                while True:
+                    try:
+                        item = await agen.__anext__()
+                        await q.put(item)
+                    except asyncio.CancelledError as e:
+                        raise RuntimeError("stream failed") from e
+    """, select=["except-discipline"])
+    assert any("CancelledError" in f.message for f in found), found
+    clean = _lint(tmp_path, """
+        import asyncio
+
+        class Pump:
+            async def run(self, agen, q):
+                while True:
+                    try:
+                        item = await agen.__anext__()
+                        await q.put(item)
+                    except asyncio.CancelledError as e:
+                        raise e
+    """, select=["except-discipline"], name="clean.py")
+    assert clean == [], clean
+
+
+def test_except_discipline_bare_except(tmp_path):
+    found = _lint(tmp_path, """
+        def f():
+            try:
+                return 1
+            except:
+                return 2
+    """, select=["except-discipline"])
+    assert any(f.detail == "bare-except" for f in found), found
+
+
+# ---- jax-purity -------------------------------------------------------------
+
+def test_jax_purity_fires_on_host_sync_and_nondet(tmp_path):
+    found = _lint(tmp_path, """
+        import time
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def step(params, batch):
+            loss = batch.sum()
+            host = loss.item()
+            arr = np.asarray(batch)
+            t = time.time()
+            if params > 0:
+                loss = loss + 1
+            return loss, host, arr, t
+    """, select=["jax-purity"])
+    details = {f.detail for f in found}
+    assert {"host-sync:.item", "host-sync:np.asarray",
+            "nondet:time.time", "tracer-if:params"} <= details, found
+
+
+def test_jax_purity_static_args_and_unjitted_stay_quiet(tmp_path):
+    clean = _lint(tmp_path, """
+        from functools import partial
+        import jax
+
+        @partial(jax.jit, static_argnames=("k",))
+        def step(x, k):
+            if k > 2:
+                x = x * 2
+            return x
+
+        def host_side(x):
+            return x.item()
+    """, select=["jax-purity"])
+    assert clean == [], clean
+
+
+def test_jax_purity_sees_jit_rebind(tmp_path):
+    found = _lint(tmp_path, """
+        import jax
+
+        def raw(x):
+            return x.item()
+
+        fast = jax.jit(raw)
+    """, select=["jax-purity"])
+    assert any(f.detail == "host-sync:.item" for f in found), found
+
+
+# ---- guarded-by -------------------------------------------------------------
+
+_GUARDED = """
+    import threading
+
+    class Table:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._rows = {{}}  # rt: guarded-by(_lock)
+
+        def put(self, k, v):
+            {put_body}
+
+        def _evict_locked(self):
+            self._rows.clear()
+"""
+
+
+def test_guarded_by_fires_on_unlocked_mutation(tmp_path):
+    found = _lint(tmp_path, _GUARDED.format(
+        put_body="self._rows[k] = v"), select=["guarded-by"])
+    assert any("_rows" in f.message and "_lock" in f.message
+               for f in found), found
+
+
+def test_guarded_by_locked_and_suffix_conventions_pass(tmp_path):
+    clean = _lint(tmp_path, _GUARDED.format(
+        put_body="with self._lock:\n                self._rows[k] = v"),
+        select=["guarded-by"])
+    assert clean == [], clean
+
+
+def test_guarded_by_annotated_lock_attr_not_stale(tmp_path):
+    """`self._lock: threading.Lock = threading.Lock()` (AnnAssign) must
+    count as the lock existing — no bogus stale-annotation finding."""
+    clean = _lint(tmp_path, """
+        import threading
+
+        class Table:
+            def __init__(self):
+                self._lock: threading.Lock = threading.Lock()
+                self._rows = {}  # rt: guarded-by(_lock)
+
+            def put(self, k, v):
+                with self._lock:
+                    self._rows[k] = v
+    """, select=["guarded-by"])
+    assert clean == [], clean
+
+
+def test_guarded_by_stale_annotation_is_a_finding(tmp_path):
+    found = _lint(tmp_path, """
+        class Table:
+            def __init__(self):
+                self._rows = {}  # rt: guarded-by(_missing_lock)
+    """, select=["guarded-by"])
+    assert any("stale" in f.detail for f in found), found
+
+
+# ---- metrics-doc ------------------------------------------------------------
+
+def test_metrics_doc_fires_on_undocumented_series(tmp_path):
+    """The folded-in PR 4 lint still detects an undocumented rt_* series
+    (synthetic repo root; the real tree is covered by the gate +
+    tests/test_zz_metrics_doc.py through the scripts/ shim)."""
+    from ray_tpu.analysis.checkers import metrics_doc
+
+    pkg = tmp_path / "ray_tpu"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text(
+        'x = M.get_or_create(M.Counter, "rt_fake_total")\n')
+    (tmp_path / "README.md").write_text("no metrics table here\n")
+    problems = metrics_doc.check(str(tmp_path))
+    assert any("rt_fake_total" in p and "not documented" in p
+               for p in problems), problems
+
+
+# ---- baseline ratchet -------------------------------------------------------
+
+def _finding(line=1, detail="d"):
+    return Finding(checker="c", path="p.py", line=line, message="m",
+                   scope="s", detail=detail)
+
+
+def test_baseline_ratchet_semantics(tmp_path):
+    path = str(tmp_path / "base.json")
+    # two occurrences baselined
+    B.save(path, [_finding(1), _finding(2)])
+    base = B.load(path)
+    # same two: all suppressed
+    new, sup, stale = B.split([_finding(1), _finding(2)], base)
+    assert not new and len(sup) == 2 and not stale
+    # a third occurrence of the same fingerprint: the NEWEST line fails
+    new, sup, stale = B.split([_finding(1), _finding(2), _finding(9)], base)
+    assert [f.line for f in new] == [9] and len(sup) == 2
+    # debt paid down: stale entry reported (the gate asserts none remain)
+    new, sup, stale = B.split([_finding(1)], base)
+    assert not new and stale
+    # distinct fingerprint: never suppressed
+    new, _, _ = B.split([_finding(1, detail="other")], base)
+    assert len(new) == 1
+
+
+def test_inline_allow_suppresses(tmp_path):
+    clean = _lint(tmp_path, """
+        import time
+
+        async def tick():
+            # rt: lint-allow(event-loop-blocking) test fixture says so
+            time.sleep(1.0)
+    """, select=["event-loop-blocking"])
+    assert clean == [], clean

@@ -370,7 +370,7 @@ def test_ledger_deref_is_lock_free():
 
     led = object_ledger.OwnershipLedger()
     with led._lock:
-        e = led._entry("deadbeef")
+        e = led._entry_locked("deadbeef")
         e.local_refs = 2
         # simulate the GC firing the finalizer while THIS thread holds the
         # lock; run it in a helper thread so a regression fails the test
