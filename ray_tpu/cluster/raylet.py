@@ -1335,52 +1335,69 @@ class Raylet:
     # (``log_to_driver``). File offsets persist across the pump's life so
     # each line is forwarded once.
 
+    @staticmethod
+    def _scan_worker_logs(log_dir: str, offsets: Dict[str, int]
+                          ) -> List[Tuple[str, List[str]]]:
+        """One tail pass over the worker log files (executor thread —
+        listdir/stat/open/read never touch the event loop). Mutates
+        ``offsets`` in place; returns [(worker_id, lines), ...]."""
+        out: List[Tuple[str, List[str]]] = []
+        try:
+            names = os.listdir(log_dir)
+        except FileNotFoundError:
+            return out
+        for name in names:
+            if not name.startswith("worker-"):
+                continue
+            path = os.path.join(log_dir, name)
+            off = offsets.get(name, 0)
+            try:
+                size = os.path.getsize(path)
+                if size <= off:
+                    continue
+                with open(path, "rb") as f:
+                    f.seek(off)
+                    chunk = f.read(256 * 1024)
+                # forward whole lines; keep a partial tail for next
+                # tick — unless the window is FULL with no newline (one
+                # giant line): forward it truncated and advance, or the
+                # pump would re-read the same window forever
+                cut = chunk.rfind(b"\n")
+                if cut < 0:
+                    if len(chunk) < 256 * 1024:
+                        continue  # incomplete line still being written
+                    cut = len(chunk)
+                offsets[name] = off + cut + (0 if cut == len(chunk)
+                                             else 1)
+                wid = name[len("worker-"):-len(".log")]
+                lines = chunk[:cut].decode(errors="replace").splitlines()
+                if lines:
+                    out.append((wid, lines))
+            except OSError:
+                continue
+        return out
+
     async def _log_pump_loop(self) -> None:
         offsets: Dict[str, int] = {}
         log_dir = os.path.join(get_config().session_dir_root,
                                self.session_name, "logs")
+        loop = asyncio.get_running_loop()
         while True:
             await asyncio.sleep(0.3)
-            try:
-                names = os.listdir(log_dir)
-            except FileNotFoundError:
-                continue
+            # the tail reads run on the spill/file-IO pool; only the ring
+            # append + waiter wakeup touch the loop
+            scanned = await loop.run_in_executor(
+                self._spill_exec, self._scan_worker_logs, log_dir, offsets)
             new_any = False
-            for name in names:
-                if not name.startswith("worker-"):
-                    continue
-                path = os.path.join(log_dir, name)
-                off = offsets.get(name, 0)
-                try:
-                    size = os.path.getsize(path)
-                    if size <= off:
-                        continue
-                    with open(path, "rb") as f:
-                        f.seek(off)
-                        chunk = f.read(256 * 1024)
-                    # forward whole lines; keep a partial tail for next
-                    # tick — unless the window is FULL with no newline (one
-                    # giant line): forward it truncated and advance, or the
-                    # pump would re-read the same window forever
-                    cut = chunk.rfind(b"\n")
-                    if cut < 0:
-                        if len(chunk) < 256 * 1024:
-                            continue  # incomplete line still being written
-                        cut = len(chunk)
-                    offsets[name] = off + cut + (0 if cut == len(chunk)
-                                                 else 1)
-                    wid = name[len("worker-"):-len(".log")]
-                    wentry = self._workers.get(wid)
-                    job = wentry.job_id if wentry is not None else None
-                    for line in chunk[:cut].decode(
-                            errors="replace").splitlines():
-                        self._log_seq += 1
-                        self._log_buf.append(
-                            {"seq": self._log_seq, "worker_id": wid,
-                             "job_id": job, "line": line})
-                        new_any = True
-                except OSError:
-                    continue
+            for wid, lines in scanned:
+                wentry = self._workers.get(wid)
+                job = wentry.job_id if wentry is not None else None
+                for line in lines:
+                    self._log_seq += 1
+                    self._log_buf.append(
+                        {"seq": self._log_seq, "worker_id": wid,
+                         "job_id": job, "line": line})
+                    new_any = True
             if new_any:
                 self._log_event.set()
                 self._log_event = asyncio.Event()
@@ -2344,9 +2361,21 @@ class Raylet:
             self._touch(oid_hex)
             return {"payload": bytes(view)}
         path = self._spill_path(oid_hex)
-        if os.path.exists(path):
-            with open(path, "rb") as f:
-                return {"payload": f.read()}
+
+        def read_spill():
+            # spill-file IO off the event loop: a slow disk must not
+            # stall heartbeats/dispatch (the spill pool already owns
+            # this discipline for writes)
+            try:
+                with open(path, "rb") as f:
+                    return f.read()
+            except OSError:
+                return None
+
+        payload = await asyncio.get_running_loop().run_in_executor(
+            self._spill_exec, read_spill)
+        if payload is not None:
+            return {"payload": payload}
         return {"error": "not found"}
 
     async def rpc_put_object_chunk(self, p):
@@ -2400,11 +2429,17 @@ class Raylet:
             return {"total": len(view), "data": data,
                     "crc": _native.crc32c(data), "crc_kind": kind}
         path = self._spill_path(oid_hex)
-        try:
+
+        def read_slice():
+            # spill-file IO off the event loop (see rpc_get_object_payload)
             total = os.path.getsize(path)
             with open(path, "rb") as f:
                 f.seek(off)
-                data = f.read(size)
+                return total, f.read(size)
+
+        try:
+            total, data = await asyncio.get_running_loop().run_in_executor(
+                self._spill_exec, read_slice)
             return {"total": total, "data": data,
                     "crc": _native.crc32c(data), "crc_kind": kind}
         except FileNotFoundError:

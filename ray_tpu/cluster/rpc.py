@@ -9,19 +9,34 @@ the async client for synchronous callers (the driver main thread, task code).
 Frame format: 4-byte LE length | pickled (kind, msg_id, method, payload).
 Payloads are plain picklable values — large tensors never travel here; they
 go through the shm object plane.
+
+Push-based streaming (the per-token-RPC killer, reference: Ray's core
+streaming generators pushing results over the worker's persistent
+connection instead of the caller polling): two ONE-WAY frame kinds ride
+the same connections. A server endpoint pushes ``_PUSH`` frames down an
+established connection keyed by channel id — no reply slot, no
+correlation future — and the client answers with ``_CREDIT`` frames
+carrying its cumulative consumed count, which is the backpressure window:
+a producer with ``sent - acked >= window`` parks until credit arrives
+instead of ballooning either side's buffers. ``StreamChannel`` is the
+client half; ``ServerConnection`` (exposed to handlers via
+``current_server_connection()``) is the server half. Connection loss
+fails every channel on it — consumers fall back to their pull path.
 """
 
 from __future__ import annotations
 
 import asyncio
+import contextvars
 import pickle
 import socket
 import struct
 import threading
+from collections import deque
 from typing import Any, Callable, Dict, Optional, Tuple
 
 _LEN = struct.Struct("<I")
-_REQ, _REP, _ERR = 0, 1, 2
+_REQ, _REP, _ERR, _PUSH, _CREDIT = 0, 1, 2, 3, 4
 
 MAX_FRAME = 512 * 1024 * 1024
 
@@ -70,6 +85,199 @@ async def cancel_and_wait(*tasks) -> None:
 
 class ConnectionLost(RpcError):
     pass
+
+
+class ChannelBroken(RpcError):
+    """The connection carrying a push stream died (or the producer
+    vanished). Consumers catch this and fall back to their pull path."""
+
+
+#: ``StreamChannel.take()`` returns this when the producer pushed its
+#: final frame — a sentinel, not an exception: takes cross thread/loop
+#: boundaries via run_coroutine_threadsafe, where exception identity blurs.
+CHANNEL_DONE = object()
+
+_STREAM_WINDOW_DEFAULT = 128
+
+
+class StreamChannel:
+    """Client half of one push stream: a bounded local buffer fed by
+    ``_PUSH`` frames from the server, drained by the consumer with zero
+    RPCs. Consuming items sends cumulative ``_CREDIT`` frames (one per
+    half-window, not per item) — the producer's backpressure signal.
+
+    Buffer bound: the producer stops at ``window`` unacked items, so the
+    deque here never holds more than ``window`` + one in-flight batch.
+    Thread-safety: ``_items`` is guarded for ``take_available`` callers on
+    foreign threads; the awaiting side (``take``) is affine to the owning
+    client's event loop.
+    """
+
+    def __init__(self, client: "RpcClient", channel_id: str, window: int):
+        self._client = client
+        self.id = channel_id
+        self.window = max(2, window)
+        self._lock = threading.Lock()
+        self._items: deque = deque()      # rt: guarded-by(_lock)
+        self._done = False                # rt: guarded-by(_lock)
+        self._broken: Optional[str] = None  # rt: guarded-by(_lock)
+        self._event = asyncio.Event()     # loop-affine wakeup
+        self._consumed = 0                # items handed to the consumer
+        self._credited = 0                # last cumulative credit sent
+        self._closed = False
+        self._final_credit_sent = False
+
+    # -- fed from the client read loop (client's event loop) --------------
+    def _feed(self, items, done: bool) -> None:
+        with self._lock:
+            self._items.extend(items)
+            if done:
+                self._done = True
+        self._event.set()
+
+    def _fail(self, reason: str) -> None:
+        with self._lock:
+            if not self._done:
+                self._broken = reason
+        self._event.set()
+
+    # -- consumer side ----------------------------------------------------
+    async def take(self):
+        """Next item, ``CHANNEL_DONE`` when the stream completed, or
+        raises :class:`ChannelBroken` when the connection died with the
+        stream unfinished. Must run on the owning client's loop."""
+        while True:
+            got = False
+            done_now = False
+            send_final = False
+            item = None
+            send_credit = False
+            with self._lock:
+                if self._items:
+                    item = self._items.popleft()
+                    got = True
+                    self._consumed += 1
+                    if (self._consumed - self._credited
+                            >= max(1, self.window // 2)):
+                        self._credited = self._consumed
+                        send_credit = True
+                elif self._done:
+                    done_now = True
+                    send_final = not self._final_credit_sent
+                    self._final_credit_sent = True
+                elif self._broken is not None:
+                    raise ChannelBroken(self._broken)
+                else:
+                    self._event.clear()
+            if done_now:
+                # final cumulative credit, closed: tells the producer
+                # every item was consumed so it can settle the stream
+                # (release the replica's in-flight slot) NOW instead of
+                # at consumer GC time
+                if send_final:
+                    await self._client._send_credit(
+                        self.id, self._consumed, closed=True)
+                return CHANNEL_DONE
+            if send_credit:
+                await self._client._send_credit(self.id, self._credited)
+            if got:
+                return item
+            await self._event.wait()
+
+    def is_done(self) -> bool:
+        """True once the final frame arrived AND the local buffer is
+        fully drained (thread-safe)."""
+        with self._lock:
+            return self._done and not self._items
+
+    def take_available(self):
+        """Drain everything already buffered, without awaiting — the
+        proxy's burst coalescing path. Thread-safe; credits are posted to
+        the client loop if a half-window was crossed."""
+        with self._lock:
+            out = list(self._items)
+            self._items.clear()
+            self._consumed += len(out)
+            send = (self._consumed - self._credited
+                    >= max(1, self.window // 2))
+            if send:
+                credited = self._credited = self._consumed
+        if send:
+            self._client._spawn_on_loop(
+                self._client._send_credit(self.id, credited))
+        return out
+
+    def close(self) -> None:
+        """Consumer abandons the stream: tell the producer to stop
+        (closed credit) and deregister. Safe from any thread."""
+        if self._closed:
+            return
+        self._closed = True
+        self._client._channels.pop(self.id, None)
+        self._client._spawn_on_loop(
+            self._client._send_credit(self.id, self._consumed, closed=True))
+
+
+class ServerConnection:
+    """Server half of one accepted connection: the writer a handler can
+    push one-way frames down, plus the endpoint registry ``_CREDIT``
+    frames dispatch into. Handlers reach their connection through
+    :func:`current_server_connection` — the subscribe RPC that opens a
+    push stream binds its producer to exactly the connection it arrived
+    on, so frames ride the consumer's existing socket."""
+
+    def __init__(self, writer: asyncio.StreamWriter, lock: asyncio.Lock):
+        self._writer = writer
+        self._lock = lock
+        self.alive = True
+        # channel_id -> endpoint with on_credit(consumed, closed) /
+        # on_disconnect(); mutated only on the server's event loop
+        self.endpoints: Dict[str, Any] = {}
+
+    async def push(self, channel_id: str, seq: int, items, done: bool
+                   ) -> int:
+        """One-way push of a frame batch; returns the wire size in bytes.
+        Raises ConnectionLost when the consumer's connection is gone."""
+        if not self.alive:
+            raise ConnectionLost("push connection closed")
+        try:
+            async with self._lock:
+                n = _write_frame(self._writer,
+                                 (_PUSH, seq, channel_id, (items, done)))
+                await self._writer.drain()
+            return n
+        except (ConnectionResetError, BrokenPipeError, RuntimeError) as e:
+            # RuntimeError: writer closed under us mid-drain
+            self.alive = False
+            raise ConnectionLost(f"push failed: {e!r}") from None
+
+    def _on_credit(self, channel_id: str, consumed: int, closed: bool
+                   ) -> None:
+        ep = self.endpoints.get(channel_id)
+        if ep is not None:
+            if closed:
+                self.endpoints.pop(channel_id, None)
+            ep.on_credit(consumed, closed)
+
+    def _on_disconnect(self) -> None:
+        self.alive = False
+        eps, self.endpoints = list(self.endpoints.values()), {}
+        for ep in eps:
+            try:
+                ep.on_disconnect()
+            except Exception:  # noqa: BLE001 — teardown fanout
+                pass
+
+
+_server_conn_var: "contextvars.ContextVar[Optional[ServerConnection]]" = \
+    contextvars.ContextVar("rt_server_conn", default=None)
+
+
+def current_server_connection() -> Optional[ServerConnection]:
+    """The connection the currently-executing RPC handler arrived on
+    (None outside a handler). Handler tasks inherit it via the context
+    snapshot taken when the per-request task is spawned."""
+    return _server_conn_var.get()
 
 
 # Lazily-bound chaos module (util/chaos.py): the rpc layer stays free of
@@ -146,9 +354,10 @@ async def _read_frame(reader: asyncio.StreamReader) -> Any:
     return pickle.loads(body)
 
 
-def _write_frame(writer: asyncio.StreamWriter, msg: Any) -> None:
+def _write_frame(writer: asyncio.StreamWriter, msg: Any) -> int:
     body = pickle.dumps(msg, protocol=5)
     writer.write(_LEN.pack(len(body)) + body)
+    return len(body)
 
 
 class RpcServer:
@@ -195,10 +404,19 @@ class RpcServer:
                            writer: asyncio.StreamWriter) -> None:
         peer_id: Optional[str] = None
         write_lock = asyncio.Lock()
+        conn = ServerConnection(writer, write_lock)
+        # handler tasks spawned below snapshot this context, so any
+        # handler can bind a push endpoint to ITS connection
+        _server_conn_var.set(conn)
         self._writers.add(writer)
         try:
             while True:
                 kind, msg_id, method, payload = await _read_frame(reader)
+                if kind == _CREDIT:
+                    # one-way consumer credit: no reply slot, no handler
+                    conn._on_credit(method, msg_id,
+                                    bool(payload and payload.get("closed")))
+                    continue
                 if method == "hello":
                     peer_id = payload.get("peer_id")
                 handler = self._handlers.get(method)
@@ -211,6 +429,7 @@ class RpcServer:
         except (asyncio.IncompleteReadError, ConnectionResetError, BrokenPipeError):
             pass
         finally:
+            conn._on_disconnect()
             self._writers.discard(writer)
             if peer_id and self._on_disconnect:
                 try:
@@ -282,6 +501,10 @@ class RpcClient:
         self._reader: Optional[asyncio.StreamReader] = None
         self._writer: Optional[asyncio.StreamWriter] = None
         self._pending: Dict[int, asyncio.Future] = {}
+        # push-stream channels multiplexed on this connection; fed by the
+        # read loop, failed as a group when the connection drops
+        self._channels: Dict[str, StreamChannel] = {}
+        self._next_channel = 0
         self._next_id = 0
         self._lock: Optional[asyncio.Lock] = None
         self._closed = False
@@ -293,6 +516,7 @@ class RpcClient:
         host, port = self.address.rsplit(":", 1)
         self._reader, self._writer = await asyncio.open_connection(host, int(port))
         self._lock = asyncio.Lock()
+        self._loop = asyncio.get_running_loop()
         self._closed = False
         self._read_task = asyncio.ensure_future(self._read_loop())
         if self._peer_id:
@@ -363,10 +587,62 @@ class RpcClient:
                 f"reconnect to {self.address} failed after {attempts} "
                 f"attempt(s): {last_err}") from None
 
+    def open_channel(self, window: int = _STREAM_WINDOW_DEFAULT
+                     ) -> StreamChannel:
+        """Allocate a push-stream channel on this connection. The caller
+        passes the returned ``channel.id`` to the server (via a normal
+        RPC) so the producer knows where to push."""
+        self._next_channel += 1
+        ch = StreamChannel(self, f"ch{id(self):x}-{self._next_channel}",
+                           window)
+        self._channels[ch.id] = ch
+        return ch
+
+    async def _send_credit(self, channel_id: str, consumed: int,
+                           closed: bool = False) -> None:
+        """One-way cumulative-consumed credit to the producer; never
+        raises — a dropped connection already fails the channel via the
+        read loop, and credit for a dead producer is moot."""
+        try:
+            async with self._lock:
+                _write_frame(self._writer,
+                             (_CREDIT, consumed, channel_id,
+                              {"closed": closed} if closed else None))
+                await self._writer.drain()
+        except Exception:  # noqa: BLE001 — connection gone; channel fails
+            pass
+
+    def _spawn_on_loop(self, coro) -> None:
+        """Schedule a coroutine on this client's loop from any thread."""
+        loop = getattr(self, "_loop", None)
+        if loop is None or loop.is_closed():
+            coro.close()
+            return
+        try:
+            if asyncio.get_running_loop() is loop:
+                spawn_task(coro)
+                return
+        except RuntimeError:
+            pass
+        loop.call_soon_threadsafe(spawn_task, coro)
+
     async def _read_loop(self) -> None:
         try:
             while True:
                 kind, msg_id, method, body = await _read_frame(self._reader)
+                if kind == _PUSH:
+                    ch = self._channels.get(method)
+                    if ch is not None:
+                        items, done = body
+                        ch._feed(items, done)
+                        if done:
+                            self._channels.pop(method, None)
+                    else:
+                        # consumer already closed the channel: tell the
+                        # producer to stop pushing into the void
+                        spawn_task(self._send_credit(method, msg_id,
+                                                     closed=True))
+                    continue
                 fut = self._pending.pop(msg_id, None)
                 if fut is None or fut.done():
                     continue
@@ -382,6 +658,11 @@ class RpcClient:
             pass
         finally:
             self._closed = True
+            # push channels die with the connection: wake every consumer
+            # with ChannelBroken so it can fall back to its pull path
+            chans, self._channels = list(self._channels.values()), {}
+            for ch in chans:
+                ch._fail(f"connection to {self.address} lost")
             for fut in self._pending.values():
                 try:
                     if not fut.done():

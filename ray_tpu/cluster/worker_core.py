@@ -44,6 +44,7 @@ from ray_tpu.core.task_spec import (
     validate_options,
 )
 from ray_tpu.core.worker import global_worker
+from ray_tpu.cluster import stream as rt_stream
 from ray_tpu.cluster.object_store import PlasmaStore
 from ray_tpu.runtime_env import prepare_runtime_env
 from ray_tpu.util import chaos as _chaos
@@ -330,6 +331,9 @@ class ClusterBackend(RuntimeBackend):
         self.server = RpcServer(self.loop)
         self.server.register("get_object", self._rpc_get_object)
         self.server.register("stream_item", self._rpc_stream_item)
+        # push-stream subscription (cluster/stream.py): a consumer binds a
+        # one-way push channel on its existing connection to this process
+        self.server.register("stream_subscribe", self._rpc_stream_subscribe)
         # task_id_hex -> _StreamState for in-flight streaming generators
         self._streams: Dict[str, _StreamState] = {}
         self._pool = ConnectionPool(peer_id=f"{role}:{job_id.hex()}")
@@ -882,6 +886,9 @@ class ClusterBackend(RuntimeBackend):
         if self.plasma.contains(ObjectID.from_hex(oid_hex)):
             return {"in_plasma": True}
         return {"not_found": True}
+
+    async def _rpc_stream_subscribe(self, p):
+        return await rt_stream.handle_subscribe(self, p)
 
     async def _rpc_stream_item(self, p):
         """Executor pushes one generator item (reference: item reporting,

@@ -1,0 +1,214 @@
+"""Stream-transport A/B bench: push vs pull at equal offered load.
+
+The ROADMAP item-1 acceptance leg: prove the RPC-per-token count on the
+streamed serve path collapses to O(1) per request (constant in token
+count), and that streamed serve tok/s at N concurrent streams lands
+within 1.5x of the raw engine rate on the same box.
+
+Three legs, same model/preset/slot budget:
+
+  1. **raw engine** — ``ContinuousEngine`` driven directly (no serve
+     layer): the ceiling the transport is judged against.
+  2. **push** — the default transport: one ``stream_subscribe`` RPC,
+     then one-way frames (``cluster/stream.py``).
+  3. **pull** — ``RT_STREAM_PULL=1``: the PR 9 wide-pull path
+     (one ``next_chunks`` actor RPC per 64-token burst).
+
+Plus an RPCs-vs-token-count sweep (the O(1) proof): mean RPCs per
+request at several ``max_new_tokens`` for both transports.
+
+Writes the committed artifact (default ``BENCH_STREAM_r07.json``);
+env knobs: RT_STREAM_BENCH_STREAMS / _TOKENS / _SLOTS / _OUT.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Dict, List
+
+
+def _engine_leg(preset: str, slots: int, max_len: int, stride: int,
+                streams: int, tokens: int) -> Dict[str, Any]:
+    """The raw ceiling: N concurrent requests straight into one engine."""
+    import jax
+
+    from ray_tpu.models import llama
+    from ray_tpu.models.serving import ContinuousEngine
+
+    cfg = llama.PRESETS[preset]
+    params = llama.init_params(jax.random.key(0), cfg)
+    engine = ContinuousEngine(params, cfg, max_slots=slots,
+                              max_len=max_len, decode_stride=stride)
+    prompt = list(range(1, 9))
+    counts = [0] * streams
+    events = [threading.Event() for _ in range(streams)]
+
+    def run_once() -> float:
+        for e in events:
+            e.clear()
+        for i in range(streams):
+            counts[i] = 0
+
+            def on_token(burst, i=i):
+                for t in burst:
+                    if t is None:
+                        events[i].set()
+                    else:
+                        counts[i] += 1
+
+            engine.submit_cb(prompt, tokens, on_token)
+        t0 = time.perf_counter()
+        for e in events:
+            e.wait(timeout=600)
+        wall = time.perf_counter() - t0
+        assert all(c == tokens for c in counts), counts
+        return streams * tokens / wall
+
+    run_once()  # warmup (XLA programs already compiled at engine init)
+    tok_s = max(run_once() for _ in range(2))
+    engine.shutdown()
+    return {"tok_s": round(tok_s, 1), "streams": streams,
+            "tokens_per_stream": tokens}
+
+
+def _serve_leg(handle, streams: int, tokens: int) -> Dict[str, Any]:
+    """N concurrent streamed handle requests at equal offered load;
+    reports tok/s plus the observed RPCs-per-request distribution."""
+    body = {"tokens": list(range(1, 9)), "max_new_tokens": tokens}
+    results: List[Dict[str, Any]] = []
+    lock = threading.Lock()
+
+    def one() -> None:
+        gen = handle.remote(body).result()
+        n = sum(1 for _ in gen)
+        with lock:
+            results.append({"tokens": n, "rpcs": gen._rpcs,
+                            "transport": gen._transport})
+
+    with ThreadPoolExecutor(max_workers=streams) as pool:
+        # warmup request (replica boot + route) outside the timed window
+        one()
+        results.clear()
+        t0 = time.perf_counter()
+        futs = [pool.submit(one) for _ in range(streams)]
+        for f in futs:
+            f.result()
+        wall = time.perf_counter() - t0
+    total = sum(r["tokens"] for r in results)
+    assert all(r["tokens"] == tokens for r in results), \
+        [r["tokens"] for r in results]
+    rpcs = sorted(r["rpcs"] for r in results)
+    return {"tok_s": round(total / wall, 1), "streams": streams,
+            "tokens_per_stream": tokens,
+            "transport": results[0]["transport"],
+            "rpcs_per_request_mean": round(sum(rpcs) / len(rpcs), 2),
+            "rpcs_per_request_max": rpcs[-1]}
+
+
+def _rpc_scaling(handle, token_counts: List[int], per_n: int = 4
+                 ) -> List[Dict[str, Any]]:
+    """Mean RPCs per request as token count grows — constant on push,
+    linear (1 + ceil(n/64)-ish) on pull."""
+    out = []
+    for n in token_counts:
+        body = {"tokens": list(range(1, 9)), "max_new_tokens": n}
+        rpcs = []
+        for _ in range(per_n):
+            gen = handle.remote(body).result()
+            got = sum(1 for _ in gen)
+            assert got == n, (got, n)
+            rpcs.append(gen._rpcs)
+        out.append({"tokens": n,
+                    "rpcs_mean": round(sum(rpcs) / len(rpcs), 2)})
+    return out
+
+
+def main(args=None) -> int:
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.serve.llm import continuous_llm_app
+
+    preset = os.environ.get("RT_STREAM_BENCH_PRESET", "debug")
+    streams = int(os.environ.get("RT_STREAM_BENCH_STREAMS", "64"))
+    tokens = int(os.environ.get("RT_STREAM_BENCH_TOKENS", "64"))
+    slots = int(os.environ.get("RT_STREAM_BENCH_SLOTS", "8"))
+    stride = int(os.environ.get("RT_STREAM_BENCH_STRIDE", "16"))
+    scaling_counts = [16, 64, 256]
+    max_len = 16 + max([tokens] + scaling_counts)
+    out_path = os.environ.get("RT_STREAM_BENCH_OUT",
+                              "BENCH_STREAM_r07.json")
+
+    started_here = False
+    if not ray_tpu.is_initialized():
+        ray_tpu.init()
+        started_here = True
+    artifact: Dict[str, Any] = {
+        "schema": "rt-stream-bench-1", "preset": preset,
+        "t": time.time(),
+        "note": ("push vs pull at equal offered load, one replica, "
+                 "same engine config; raw engine is the ceiling. "
+                 "rpcs_per_request counts handle_request + transport "
+                 "RPCs observed by the consumer."),
+    }
+    try:
+        print(f"== raw engine: {streams} streams x {tokens} tok ==")
+        artifact["raw_engine"] = _engine_leg(preset, slots, max_len,
+                                             stride, streams, tokens)
+        print(f"raw engine: {artifact['raw_engine']['tok_s']} tok/s")
+
+        for leg, env in (("push", None), ("pull", "1")):
+            if env is None:
+                os.environ.pop("RT_STREAM_PULL", None)
+            else:
+                os.environ["RT_STREAM_PULL"] = env
+            app = continuous_llm_app(
+                preset, max_slots=slots, max_len=max_len,
+                decode_stride=stride, name="CB",
+                max_ongoing_requests=2 * streams)
+            serve.run(app, name=f"sb-{leg}", route_prefix=f"/sb-{leg}")
+            handle = serve.get_deployment_handle("CB", f"sb-{leg}")
+            print(f"== serve leg: {leg} ==")
+            artifact[leg] = _serve_leg(handle, streams, tokens)
+            artifact[leg]["rpc_scaling"] = _rpc_scaling(
+                handle, scaling_counts)
+            print(f"{leg}: {artifact[leg]['tok_s']} tok/s, "
+                  f"rpcs/request mean "
+                  f"{artifact[leg]['rpcs_per_request_mean']} "
+                  f"scaling {artifact[leg]['rpc_scaling']}")
+            serve.delete(f"sb-{leg}")
+        os.environ.pop("RT_STREAM_PULL", None)
+
+        raw = artifact["raw_engine"]["tok_s"]
+        push = artifact["push"]["tok_s"]
+        artifact["push_vs_raw_ratio"] = round(raw / max(push, 1e-9), 3)
+        artifact["within_1p5x"] = bool(raw / max(push, 1e-9) <= 1.5)
+        sc = artifact["push"]["rpc_scaling"]
+        artifact["push_rpcs_constant"] = bool(
+            max(s["rpcs_mean"] for s in sc)
+            - min(s["rpcs_mean"] for s in sc) < 1.0)
+        print(f"push {push} tok/s vs raw {raw} tok/s "
+              f"(x{artifact['push_vs_raw_ratio']} gap, "
+              f"within 1.5x: {artifact['within_1p5x']}); "
+              f"push rpcs constant in token count: "
+              f"{artifact['push_rpcs_constant']}")
+        with open(out_path, "w", encoding="utf-8") as f:
+            json.dump(artifact, f, indent=1, sort_keys=True)
+        print(f"artifact -> {out_path}")
+    finally:
+        try:
+            serve.shutdown()
+        except Exception:  # noqa: BLE001 — bench teardown
+            pass
+        if started_here:
+            ray_tpu.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -22,6 +22,8 @@ from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Any, Dict, List, Optional, Tuple
 
 import ray_tpu
+from ray_tpu.cluster import stream as rt_stream
+from ray_tpu.cluster.rpc import ChannelBroken
 from ray_tpu.exceptions import ActorError
 from ray_tpu.serve import obs
 from ray_tpu.serve.replica import REJECTED
@@ -240,11 +242,12 @@ def _reset_pool() -> None:
         old_stream.shutdown(wait=False)
 
 
-# streaming pulls get their OWN wide pool: each live stream parks one
-# thread in a blocking next_chunks RPC, and sharing the loop's default
-# executor (~cpu+4 threads) capped concurrent streams at a handful —
-# the proxy's token path would serialize under exactly the load the
-# continuous batcher exists to absorb
+# the PULL path's wide thread pool (PR 9): each live pulled stream parks
+# one thread in a blocking next_chunks RPC. With the push transport this
+# pool is the FALLBACK only — it is created lazily the first time a
+# stream actually runs pull mode (RT_STREAM_PULL=1, a producer that
+# refused the subscription, or a broken push channel), so the default
+# push path holds zero stream threads.
 _stream_pool: Optional[ThreadPoolExecutor] = None
 
 
@@ -258,9 +261,20 @@ def _stream_executor() -> ThreadPoolExecutor:
 
 
 class DeploymentResponseGenerator:
-    """Iterator over a streaming deployment response: pulls chunk batches
-    from the replica's response stream (reference: streamed handle results,
-    ``serve/_private/replica.py:346``)."""
+    """Iterator over a streaming deployment response.
+
+    Default transport is PUSH (cluster/stream.py): one
+    ``stream_subscribe`` RPC binds the replica's pump to a one-way frame
+    channel on the existing connection, and ``__anext__`` drains a local
+    queue — no executor hop, no per-burst actor RPC, O(1) RPCs per
+    request regardless of token count. The PR 9 pull path
+    (``next_chunks`` batches through the wide stream pool) remains as
+    the fallback: primary under ``RT_STREAM_PULL=1``, automatic when the
+    push channel breaks (reconnect) — ``resume_pull`` replays the
+    undelivered tail so the switch is token-exact."""
+
+    _END = object()
+    _PULL = object()  # transport decided: caller should run the pull path
 
     def __init__(self, router: "_RouterState", rid: str, actor,
                  stream_id: str):
@@ -268,39 +282,192 @@ class DeploymentResponseGenerator:
         self._rid = rid
         self._actor = actor
         self._stream_id = stream_id
-        self._buf: List[Any] = []
+        self._buf: List[Any] = []   # decoded items not yet handed out
+        self._wire: List[Any] = []  # raw non-inline frames awaiting decode
         self._done = False
+        self._delivered = 0         # items handed to the consumer
+        self._rpcs = 1              # the handle_request RPC itself
+        self._transport: Optional[str] = None  # push | pull | fallback
+        self._channel = None
+        self._backend = None
+        self._reported = False
 
+    # -- transport ---------------------------------------------------------
+    def _backend_ref(self):
+        if self._backend is None:
+            self._backend = ray_tpu.global_worker()._require_backend()
+        return self._backend
+
+    async def _subscribe_on_io(self) -> None:
+        """One-time transport decision; runs on the backend io loop."""
+        if self._transport is not None:
+            return
+        if not rt_stream.push_enabled():
+            self._transport = "pull"
+            return
+        backend = self._backend_ref()
+        conn = backend._actor_conns.get(self._actor._actor_id.hex())
+        addr = getattr(conn, "address", None)
+        if addr is None:
+            self._transport = "pull"
+            return
+        try:
+            self._rpcs += 1
+            ch = await rt_stream.subscribe(backend, addr, self._stream_id)
+        except Exception:  # noqa: BLE001 — any transport hiccup: pull
+            self._transport = "pull"
+            return
+        if ch is None:
+            self._transport = "pull"
+            return
+        self._channel = ch
+        self._transport = "push"
+
+    async def _take_on_io(self):
+        """One blocking channel take, then an opportunistic drain of
+        whatever the producer already pushed: returns ``(first, rest)``
+        so the caller pays ONE loop hop per burst, not per token (the
+        push twin of the pull path's wide next_chunks batches). Also
+        ``_END`` or ``_PULL`` (transport decided against push); runs on
+        the backend io loop. Raises ChannelBroken to trigger the pull
+        fallback."""
+        await self._subscribe_on_io()
+        if self._transport != "push":
+            return self._PULL
+        backend = self._backend_ref()
+        if self._wire:
+            item, _ = await rt_stream.take_decoded_wire(
+                backend, self._wire.pop(0))
+            return (item, [])
+        item, done = await rt_stream.take_decoded(backend, self._channel)
+        if done:
+            return self._END
+        rest, parked = rt_stream.inline_values(
+            self._channel.take_available())
+        self._wire.extend(parked)
+        return (item, rest)
+
+    async def _drain_decoded_on_io(self) -> Tuple[List[Any], bool]:
+        """Fallback prologue: decode everything already received locally
+        (channel buffer + parked wire frames) so the resume point counts
+        every item we physically possess."""
+        wire, self._wire = self._wire, []
+        return await rt_stream.decode_backlog(self._backend_ref(),
+                                              self._channel, wire)
+
+    def _begin_fallback_blocking(self) -> None:
+        """The push channel broke: close it, reclaim the undelivered tail
+        from the replica (one RPC), and continue on the pull path."""
+        self._transport = "fallback"
+        backend = self._backend_ref()
+        # generous bound: a parked plasma-oid frame may legitimately take
+        # up to its 60s resolve inside the drain
+        drained, done = asyncio.run_coroutine_threadsafe(
+            self._drain_decoded_on_io(), backend.loop).result(120)
+        self._buf.extend(drained)
+        ch, self._channel = self._channel, None
+        if ch is not None:
+            ch.close()
+        if done:
+            self._mark_done()
+            return
+        possessed = self._delivered + len(self._buf)
+        try:
+            self._rpcs += 1
+            items, done = ray_tpu.get(self._actor.resume_pull.remote(
+                self._stream_id, possessed))
+        except Exception:
+            self._done = True
+            self._router.complete(self._rid)
+            self._finish_metrics()
+            raise
+        self._buf.extend(items)
+        if done:
+            self._mark_done()
+
+    def _mark_done(self) -> None:
+        if not self._done:
+            self._done = True
+            self._router.complete(self._rid)
+
+    def _abort_stream(self) -> None:
+        """Stream failed while push was live: the producer settles on a
+        closed-credit it will never get (the consumer stops iterating on
+        the raised error), so the replica slot must be released
+        explicitly — close the channel and cancel the replica stream
+        (idempotent against an already-finished stream)."""
+        ch, self._channel = self._channel, None
+        if ch is not None:
+            ch.close()
+        try:
+            self._actor.cancel_stream.remote(self._stream_id)
+        except Exception:  # noqa: BLE001 — actor already gone
+            pass
+
+    def _finish_metrics(self) -> None:
+        if self._reported:
+            return
+        self._reported = True
+        rt_stream.observe_request_rpcs(self._transport or "pull",
+                                       self._rpcs)
+
+    # -- iteration ---------------------------------------------------------
     def __iter__(self):
         return self
 
     def __next__(self):
-        while not self._buf:
+        while True:
+            if self._buf:
+                self._delivered += 1
+                return self._buf.pop(0)
             if self._done:
+                self._finish_metrics()
                 raise StopIteration
-            try:
-                # wide pulls: the replica returns whatever the stream has
-                # already produced (blocking only for the first item), so
-                # a large max_items batches token bursts into one RPC
-                # without delaying a steady trickle
-                items, done = ray_tpu.get(self._actor.next_chunks.remote(
-                    self._stream_id, 64))
-            except Exception:
-                self._done = True
-                self._router.complete(self._rid)
-                raise
-            self._buf.extend(items)
-            if done:
-                self._done = True
-                self._router.complete(self._rid)
-                if not self._buf:
-                    raise StopIteration
-        return self._buf.pop(0)
+            if self._transport in (None, "push"):
+                backend = self._backend_ref()
+                try:
+                    res = asyncio.run_coroutine_threadsafe(
+                        self._take_on_io(), backend.loop).result()
+                except ChannelBroken:
+                    self._begin_fallback_blocking()
+                    continue
+                except Exception:
+                    self._done = True
+                    self._router.complete(self._rid)
+                    self._abort_stream()
+                    self._finish_metrics()
+                    raise
+                if res is self._PULL:
+                    continue
+                if res is self._END:
+                    self._mark_done()
+                    continue
+                first, rest = res
+                self._buf.extend(rest)
+                self._delivered += 1
+                return first
+            self._pull_once_blocking()
+
+    def _pull_once_blocking(self) -> None:
+        try:
+            # wide pulls: the replica returns whatever the stream has
+            # already produced (blocking only for the first item), so
+            # a large max_items batches token bursts into one RPC
+            # without delaying a steady trickle
+            self._rpcs += 1
+            items, done = ray_tpu.get(self._actor.next_chunks.remote(
+                self._stream_id, 64))
+        except Exception:
+            self._done = True
+            self._router.complete(self._rid)
+            self._finish_metrics()
+            raise
+        self._buf.extend(items)
+        if done:
+            self._mark_done()
 
     def __aiter__(self):
         return self
-
-    _END = object()
 
     def _next_or_end(self):
         # StopIteration cannot cross an executor future (py3.12 turns it
@@ -311,30 +478,77 @@ class DeploymentResponseGenerator:
             return self._END
 
     async def __anext__(self):
-        if self._buf:
-            # burst fast path: a wide pull buffered several chunks —
-            # hand them out without a thread hop per item (the executor
-            # round trip costs more than the token at streaming rates)
-            return self._buf.pop(0)
-        loop = asyncio.get_running_loop()
-        item = await loop.run_in_executor(_stream_executor(),
-                                          self._next_or_end)
-        if item is self._END:
-            raise StopAsyncIteration
-        return item
+        while True:
+            if self._buf:
+                # burst fast path: pushed/pulled chunks already buffered —
+                # hand them out without a hop per item
+                self._delivered += 1
+                return self._buf.pop(0)
+            if self._done:
+                self._finish_metrics()
+                raise StopAsyncIteration
+            loop = asyncio.get_running_loop()
+            if self._transport in (None, "push"):
+                backend = self._backend_ref()
+                try:
+                    if loop is backend.loop:
+                        # the proxy hot path: __anext__ runs ON the io
+                        # loop — await the channel directly, zero hops
+                        res = await self._take_on_io()
+                    else:
+                        res = await asyncio.wrap_future(
+                            asyncio.run_coroutine_threadsafe(
+                                self._take_on_io(), backend.loop))
+                except ChannelBroken:
+                    await loop.run_in_executor(
+                        _stream_executor(), self._begin_fallback_blocking)
+                    continue
+                except Exception:
+                    self._done = True
+                    self._router.complete(self._rid)
+                    self._abort_stream()
+                    self._finish_metrics()
+                    raise
+                if res is self._PULL:
+                    continue
+                if res is self._END:
+                    self._mark_done()
+                    continue
+                first, rest = res
+                self._buf.extend(rest)
+                self._delivered += 1
+                return first
+            item = await loop.run_in_executor(_stream_executor(),
+                                              self._next_or_end)
+            if item is self._END:
+                raise StopAsyncIteration
+            return item
 
     def drain_buffered(self) -> List[Any]:
-        """Chunks already pulled from the replica and buffered locally —
-        consumers that can write a burst at once (the proxy's stream
-        path) take them without per-item awaits."""
+        """Chunks already received and buffered locally — consumers that
+        can write a burst at once (the proxy's stream path) take them
+        without per-item awaits. On the push path this drains the
+        channel's frame buffer directly (inline values only; rare
+        non-inline frames park for the decoding path)."""
         out, self._buf = self._buf, []
+        if (self._transport == "push" and self._channel is not None
+                and not self._wire):
+            values, rest = rt_stream.inline_values(
+                self._channel.take_available())
+            out.extend(values)
+            self._wire.extend(rest)
+        self._delivered += len(out)
         return out
 
     def cancel(self) -> None:
         if not self._done:
             self._done = True
             self._router.complete(self._rid)
+            ch, self._channel = self._channel, None
+            if ch is not None:
+                ch.close()
             self._actor.cancel_stream.remote(self._stream_id)
+        self._finish_metrics()
 
     def __del__(self):
         # abandoned mid-iteration (early break): release the router's

@@ -25,6 +25,7 @@ from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, List, Optional, Tuple
 
 import ray_tpu
+from ray_tpu.cluster import stream as rt_stream
 from ray_tpu.serve import obs
 from ray_tpu.serve.multiplex import loaded_model_ids
 from ray_tpu.util import metrics, step_profiler
@@ -117,6 +118,37 @@ class _AsyncStreamPump:
         self._loop.call_soon_threadsafe(_do)
 
 
+class _SyncStreamPump:
+    """Gives a plain (sync) generator the pump interface (``async take``)
+    so the push transport and the pull path share one stream surface;
+    pulls run on the replica executor, so a blocking user generator never
+    stalls the event loop (same economics as the old next_chunks sync
+    branch: items batch up to ``max_items`` per take)."""
+
+    def __init__(self, gen, executor):
+        self._gen = gen
+        self._exec = executor
+
+    async def take(self, max_items: int) -> Tuple[List[Any], bool]:
+        loop = asyncio.get_running_loop()
+
+        def pull():
+            out: List[Any] = []
+            for _ in range(max_items):
+                try:
+                    out.append(next(self._gen))
+                except StopIteration:
+                    return out, True
+            return out, False
+
+        return await loop.run_in_executor(self._exec, pull)
+
+    def close(self) -> None:
+        closer = getattr(self._gen, "close", None)
+        if closer is not None:
+            closer()
+
+
 class _FunctionWrapper:
     """Adapts a plain function deployment to the class-callable protocol.
 
@@ -170,6 +202,9 @@ class ReplicaActor:
             max_workers=max(1, max_ongoing_requests),
             thread_name_prefix="rt-replica")
         self._streams: Dict[str, Any] = {}  # response streams being consumed
+        # pull-fallback error handoff: a pushed stream that failed after a
+        # broken channel parks its error here for the next pull to raise
+        self._stream_errors: Dict[str, BaseException] = {}
         self._next_stream_id = 0
 
         body = body_ref
@@ -309,13 +344,21 @@ class ReplicaActor:
                 self._next_stream_id += 1
                 if inspect.isasyncgen(result):
                     # async gens are drained by a pump task into a queue so
-                    # next_chunks returns each item AS IT IS PRODUCED — a
+                    # take() returns each item AS IT IS PRODUCED — a
                     # batched pull that awaited __anext__ max_items times
                     # would hold back SSE tokens / websocket frames until
                     # the batch filled
-                    self._streams[sid] = _AsyncStreamPump(result)
+                    pump: Any = _AsyncStreamPump(result)
                 else:
-                    self._streams[sid] = result
+                    pump = _SyncStreamPump(result, self._exec)
+                self._streams[sid] = pump
+                # push transport (cluster/stream.py): the consumer's ONE
+                # stream_subscribe RPC binds this pump to a push channel;
+                # every subsequent token burst is a one-way frame. The
+                # pull path below stays as the fallback.
+                rt_stream.register_source(
+                    sid, pump,
+                    on_done=functools.partial(self._finish_stream, sid))
                 # the stream HOLDS the in-flight slot until exhausted or
                 # cancelled: +1 here cancels the finally's -1, so ongoing
                 # counts active streams (admission control, autoscaler
@@ -334,40 +377,49 @@ class ReplicaActor:
         are taken opportunistically (whatever the pump already produced) —
         incremental streams (SSE, websocket frames) flow with per-item
         latency while bursty producers still batch."""
+        err = self._stream_errors.pop(stream_id, None)
+        if err is not None:
+            self._finish_stream(stream_id)
+            raise err
         it = self._streams.get(stream_id)
         if it is None:
             return ([], True)
-        items: List[Any] = []
-        loop = asyncio.get_running_loop()
         try:
-            if isinstance(it, _AsyncStreamPump):
-                items, done = await it.take(max_items)
-                if done:
-                    self._finish_stream(stream_id)
-                return (items, done)
-            else:
-                def pull():
-                    out = []
-                    for _ in range(max_items):
-                        try:
-                            out.append(next(it))
-                        except StopIteration:
-                            return out, True
-                    return out, False
-
-                items, done = await loop.run_in_executor(
-                    self._exec, pull)
-                if done:
-                    self._finish_stream(stream_id)
-                    return (items, True)
+            items, done = await it.take(max_items)
         except Exception:
             self._finish_stream(stream_id)
             raise
+        rt_stream.count_pull_frames(len(items))
+        if done:
+            self._finish_stream(stream_id)
+        return (items, done)
+
+    async def resume_pull(self, stream_id: str, delivered: int) -> Tuple:
+        """Pull-fallback handoff after a broken push channel: detach the
+        push binding and return the replayed tail past the consumer's
+        ``delivered`` count — token-exact across the transport switch.
+        The consumer continues on ``next_chunks`` from here. Async so it
+        runs on the event loop the push binding lives on."""
+        items, source_done, err = await rt_stream.reclaim(
+            stream_id, delivered)
+        if err is not None:
+            if items:
+                # pull-path contract: collected items now, the error as
+                # the next pull's failure
+                self._stream_errors[stream_id] = err
+                return (items, False)
+            self._finish_stream(stream_id)
+            raise err
+        if source_done:
+            self._finish_stream(stream_id)
+            return (items, True)
         return (items, False)
 
     def _finish_stream(self, stream_id: str) -> None:
         if self._streams.pop(stream_id, None) is not None:
             self._ongoing -= 1  # release the slot the stream was holding
+            self._stream_errors.pop(stream_id, None)
+            rt_stream.unregister_source(stream_id)
 
     def cancel_stream(self, stream_id: str) -> None:
         it = self._streams.get(stream_id)

@@ -204,6 +204,65 @@ $RT serve status | tee /dev/stderr | grep -q "replicas 2/2" \
     || { echo "FAIL: rt serve status does not show recovery"; exit 1; }
 $RT serve shutdown
 
+echo "== stream leg: pushed stream falls back to pull under rpc.drop =="
+# Arm rpc.drop against the live push channel (target stream_push): the
+# channel breaks mid-stream, the consumer transparently falls back to
+# the pull path, and the stream completes token-exact (the push
+# binding's replay buffer + resume_pull hand the tail over).
+python - <<'EOF'
+import json
+import os
+import time
+
+# the consumer (this driver) is the process the push site fires in:
+# arm from env so connect() also starts the chaos-event drain loop
+os.environ["RT_CHAOS_PLAN_JSON"] = json.dumps({
+    "seed": 3, "faults": [{"site": "rpc.drop", "target": "stream_push",
+                           "at": 25, "max_fires": 1}]})
+import ray_tpu
+from ray_tpu import serve
+
+ray_tpu.init(address="auto")
+
+@serve.deployment
+class TokenStream:
+    async def __call__(self, n: int):
+        import asyncio
+
+        async def gen():
+            for i in range(n):
+                await asyncio.sleep(0.01)
+                yield i
+
+        return gen()
+
+serve.run(TokenStream.bind(), name="stream-smoke",
+          route_prefix="/streamsmoke")
+h = serve.get_deployment_handle("TokenStream", "stream-smoke")
+assert list(h.remote(3).result()) == [0, 1, 2]  # warm: replica + conn
+gen = h.remote(60).result()
+toks = list(gen)
+assert toks == list(range(60)), f"token drift through fallback: {toks[:10]}"
+assert gen._transport == "fallback", gen._transport
+print(f"stream leg: 60/60 tokens exact through '{gen._transport}' "
+      f"({gen._rpcs} rpcs)")
+time.sleep(2.5)  # the driver's chaos drain loop ships the buffered event
+serve.delete("stream-smoke")
+ray_tpu.shutdown()
+EOF
+
+$RT errors --origin chaos | grep -q "rpc.drop" \
+    || { echo "FAIL: stream-leg rpc.drop not on the chaos feed"; exit 1; }
+
+echo "== doctor must exit 0 after the stream leg drains =="
+sleep 3
+$RT doctor --window 2 --json | python -c '
+import json, sys
+d = json.load(sys.stdin)
+assert d["exit_code"] == 0 and d["healthy"], d["findings"]
+print("doctor healthy after stream leg")
+'
+
 echo "== serve-load leg: continuous batching bounded while static degrades =="
 # Poisson traffic at equal offered load against the live ContinuousBatcher
 # app and the static @serve.batch control (provisioned for its longest
