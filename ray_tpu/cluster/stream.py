@@ -47,9 +47,9 @@ from ray_tpu.util import chaos as _chaos
 from ray_tpu.util import metrics as M
 
 __all__ = [
-    "register_source", "unregister_source", "reclaim", "push_enabled",
-    "subscribe", "take_decoded", "handle_subscribe", "stream_window",
-    "observe_request_rpcs", "count_pull_frames",
+    "register_source", "unregister_source", "reclaim", "drain_source",
+    "push_enabled", "subscribe", "take_decoded", "handle_subscribe",
+    "stream_window", "observe_request_rpcs", "count_pull_frames",
 ]
 
 _PUMP_BATCH = 64
@@ -205,6 +205,32 @@ async def reclaim(sid: str, delivered: int
         err = (decoded if isinstance(decoded, BaseException)
                else RuntimeError(f"stream failed: {decoded!r}"))
     return (items, binding.source_done, err)
+
+
+async def drain_source(sid: str, delivered: int
+                       ) -> Tuple[List[Any], bool, Optional[BaseException]]:
+    """One-shot pull fallback for FINITE sources (weight shipments):
+    :func:`reclaim` the pushed-but-undelivered tail, then drain the
+    pump to exhaustion and deregister the source. Returns
+    ``(items, known, error)`` — ``known`` False when ``sid`` names no
+    registered source and nothing was replayed (spent or never
+    shipped). Unlike the serve path's resume_pull (which keeps the
+    stream open for further next_chunks pulls), this settles the whole
+    stream in one reply. Runs on the producer's event loop."""
+    items, done, err = await reclaim(sid, delivered)
+    if err is not None:
+        unregister_source(sid)
+        return (items, True, err)
+    with _reg_lock:
+        rs = _sources.get(sid)
+    if rs is None and not items and not done:
+        return ([], False, None)
+    pump = rs.pump if rs is not None else None
+    while pump is not None and not done:
+        more, done = await pump.take(_PUMP_BATCH)
+        items.extend(more)
+    unregister_source(sid)
+    return (items, True, None)
 
 
 class _PushBinding:

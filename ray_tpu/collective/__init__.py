@@ -21,12 +21,15 @@ from ray_tpu.collective.collective import (  # noqa: F401
     allreduce,
     barrier,
     broadcast,
+    cancel_shipment,
     create_collective_group,
     destroy_collective_group,
+    fetch_params,
     group_stats,
     init_collective_group,
     recv,
     reducescatter,
     send,
+    ship_params,
 )
 from ray_tpu.collective.rendezvous import bootstrap_jax_distributed  # noqa: F401

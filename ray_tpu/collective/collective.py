@@ -21,6 +21,8 @@ link delivers FIFO (single pooled connection, ordered writes).
 from __future__ import annotations
 
 import asyncio
+import itertools
+import os
 import threading
 from collections import deque
 from typing import Any, Dict, List, Optional, Tuple
@@ -391,6 +393,214 @@ def group_stats(group_name: str = "default") -> Dict[str, int]:
 
     h = _handle(group_name)
     return ray_tpu.get(h.actor.stats.remote())
+
+
+# ---------------------------------------------------------------------------
+# Weight shipping over the push-stream object plane (RLHF weight sync)
+# ---------------------------------------------------------------------------
+#
+# ``ship_params`` / ``fetch_params`` move one parameter pytree between two
+# processes over ``cluster/stream.py``: the producer registers the
+# shipment as a stream source (meta frame + one frame per leaf — large
+# leaves spill to plasma and travel as oid references, so a same-node
+# consumer mmaps them zero-copy and the bytes land on the
+# ``rt_stream_*`` series); the consumer subscribes and drains one-way
+# push frames. When the channel breaks mid-shipment (reconnect, chaos
+# ``rpc.drop``), the consumer falls back to ONE ``coll_param_reclaim``
+# RPC that replays the undelivered tail from the producer's replay
+# buffer and drains the rest of the pump — leaf-exact across the
+# transport switch, the same contract the serve stream fallback keeps.
+
+_PARAM_RPC = "coll_param_reclaim"
+
+_ship_lock = threading.Lock()
+_ship_ids = itertools.count()  # rt: guarded-by(_ship_lock)
+
+
+class _ParamsPump:
+    """Finite list pump for one shipment (the stream-source contract)."""
+
+    def __init__(self, items: List[Any]):
+        self._items = list(items)
+        self._pos = 0
+
+    async def take(self, n: int) -> Tuple[List[Any], bool]:
+        out = self._items[self._pos:self._pos + n]
+        self._pos += len(out)
+        return out, self._pos >= len(self._items)
+
+    def close(self) -> None:
+        self._items = []
+
+
+def _params_backend():
+    from ray_tpu.core.worker import global_worker
+
+    backend = global_worker()._require_backend()
+    if not (hasattr(backend, "server") and hasattr(backend, "io")):
+        raise RuntimeError(
+            "ship_params/fetch_params need the cluster backend (a real "
+            "ray_tpu.init() session; the threaded local backend has no "
+            "stream transport)")
+    return backend
+
+
+def _ensure_reclaim_rpc(backend) -> None:
+    with _ship_lock:
+        if getattr(backend, "_rt_param_reclaim", False):
+            return
+
+        async def _rpc(p):
+            return await _reclaim_shipment(p["sid"], int(p["delivered"]))
+
+        backend.server.register(_PARAM_RPC, _rpc)
+        backend._rt_param_reclaim = True
+
+
+async def _reclaim_shipment(sid: str, delivered: int) -> Dict[str, Any]:
+    """Producer-side pull fallback: replay the pushed-but-undelivered
+    tail, then drain the rest of the pump (shipments are finite, so one
+    reply completes the stream). Runs on the producer's event loop."""
+    from ray_tpu.cluster import stream as rt_stream
+
+    items, known, err = await rt_stream.drain_source(sid, delivered)
+    if err is not None:
+        return {"error": repr(err)}
+    if not known:
+        return {"error": f"shipment {sid!r} unknown "
+                         f"(already fetched or cancelled)"}
+    return {"items": items, "done": True}
+
+
+def ship_params(params: Any, *, sid: Optional[str] = None) -> Dict[str, Any]:
+    """Register one parameter pytree for streaming to a consumer.
+
+    Returns the shipment TICKET — ``{"address", "sid", "n_leaves",
+    "nbytes"}`` — which the caller hands to the consumer (an actor-call
+    argument); the consumer redeems it with :func:`fetch_params`. The
+    tensor bytes never ride the actor call: they travel as push-stream
+    frames (plasma oid references above the inline threshold) when the
+    consumer subscribes.
+
+    One ticket is redeemable ONCE — the shipment deregisters when the
+    consumer completes it (push or fallback). Ship again for each sync
+    round; an unredeemed shipment is dropped with
+    :func:`cancel_shipment`.
+    """
+    import jax
+
+    from ray_tpu.cluster import stream as rt_stream
+
+    backend = _params_backend()
+    _ensure_reclaim_rpc(backend)
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    np_leaves = [np.asarray(leaf) for leaf in leaves]
+    nbytes = int(sum(leaf.nbytes for leaf in np_leaves))
+    if sid is None:
+        with _ship_lock:
+            sid = f"params-{os.getpid()}-{next(_ship_ids)}"
+    meta = {"treedef": treedef, "n_leaves": len(np_leaves),
+            "nbytes": nbytes}
+    rt_stream.register_source(sid, _ParamsPump([meta] + np_leaves))
+    return {"address": backend.address, "sid": sid,
+            "n_leaves": len(np_leaves), "nbytes": nbytes}
+
+
+def cancel_shipment(ticket: Dict[str, Any]) -> None:
+    """Drop an unredeemed shipment (producer side)."""
+    from ray_tpu.cluster import stream as rt_stream
+
+    rt_stream.unregister_source(ticket["sid"])
+
+
+async def _fetch_async(backend, address: str, sid: str,
+                       window: Optional[int]) -> Tuple[List[Any], str, int]:
+    from ray_tpu.cluster import stream as rt_stream
+    from ray_tpu.cluster.rpc import ChannelBroken
+
+    items: List[Any] = []
+    transport = "push"
+    rpcs = 1  # the subscribe (or the reclaim, on the no-push path)
+    ch = None
+    done = False
+    try:
+        ch = await rt_stream.subscribe(backend, address, sid, window)
+    except Exception:  # noqa: BLE001 — no push service: pull instead
+        ch = None
+    if ch is None:
+        transport = "pull"
+    else:
+        try:
+            while True:
+                item, d = await rt_stream.take_decoded(backend, ch)
+                if d:
+                    done = True
+                    break
+                items.append(item)
+        except ChannelBroken:
+            # undecoded frames still parked in the channel are DISCARDED
+            # here — the producer's replay buffer holds every unacked
+            # item, and the reclaim below filters by our delivered count
+            transport = "fallback"
+    if not done:
+        if ch is not None:
+            ch.close()
+            ch = None
+        client = await backend._pool.get(address)
+        reply = await client.call(
+            _PARAM_RPC, {"sid": sid, "delivered": len(items)},
+            timeout=120.0)
+        rpcs += 1
+        if reply.get("error"):
+            raise RuntimeError(f"param shipment {sid!r} failed: "
+                               f"{reply['error']}")
+        items.extend(reply["items"])
+    if ch is not None:
+        ch.close()
+    return items, transport, rpcs
+
+
+def fetch_params(ticket: Dict[str, Any], *,
+                 window: Optional[int] = None
+                 ) -> Tuple[Any, Dict[str, Any]]:
+    """Redeem a :func:`ship_params` ticket: subscribe to the producer's
+    shipment stream, drain it (push frames; oid frames resolve through
+    the object plane — same-node zero-copy), rebuild the pytree.
+    Falls back to the one-RPC reclaim path on a broken channel,
+    leaf-exact. Returns ``(params, info)`` where info carries
+    ``transport`` (push / fallback / pull), ``rpcs`` and ``nbytes``."""
+    import jax
+
+    from ray_tpu.cluster import stream as rt_stream
+
+    backend = _params_backend()
+    items, transport, rpcs = backend.io.run(
+        _fetch_async(backend, ticket["address"], ticket["sid"], window))
+    try:
+        rt_stream.observe_request_rpcs(transport, rpcs)
+    except Exception:  # noqa: BLE001 — telemetry never fails the fetch
+        pass
+    if not items or not isinstance(items[0], dict) \
+            or "treedef" not in items[0]:
+        raise RuntimeError(
+            f"param shipment {ticket['sid']!r}: missing meta frame")
+    meta, leaves = items[0], items[1:]
+    if len(leaves) != meta["n_leaves"]:
+        raise RuntimeError(
+            f"param shipment {ticket['sid']!r}: {len(leaves)} leaves "
+            f"arrived, expected {meta['n_leaves']} (transport drop?)")
+    params = jax.tree_util.tree_unflatten(meta["treedef"], leaves)
+    # leaves above the inline threshold travelled as plasma oid frames
+    # on the push path (deterministic encode rule — see _PushBinding.
+    # _encode): report the count so benches/tests can assert the object
+    # plane was actually exercised
+    thresh = rt_stream.inline_max_bytes()
+    oid_leaves = sum(1 for leaf in leaves
+                     if getattr(leaf, "nbytes", 0) > thresh)
+    return params, {"transport": transport, "rpcs": rpcs,
+                    "nbytes": meta["nbytes"],
+                    "n_leaves": meta["n_leaves"],
+                    "oid_leaves": oid_leaves}
 
 
 def destroy_collective_group(group_name: str = "default") -> None:

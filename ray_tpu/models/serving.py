@@ -35,6 +35,7 @@ import functools
 import itertools
 import queue as _queue
 import threading
+import time
 from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -326,6 +327,11 @@ class ContinuousEngine:
         self._steps = 0
         self._admitted = 0
         self._tokens_out = 0
+        self._requests_completed = 0  # rt: guarded-by(_work)
+        self._weight_swaps = 0  # rt: guarded-by(_work)
+        # (new_params, state dict) queued by load_params; applied by the
+        # engine thread once every active slot has drained
+        self._pending_swap: Optional[Tuple] = None  # rt: guarded-by(_work)
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="rt-cb-engine")
         self._thread.start()
@@ -402,10 +408,62 @@ class ContinuousEngine:
                    "max_slots": self.max_slots,
                    "steps": self._steps,
                    "admitted": self._admitted,
-                   "tokens_out": self._tokens_out}
+                   "tokens_out": self._tokens_out,
+                   # monotonic counters (never reset for the engine's
+                   # lifetime): the RLHF bench and `rt serve status`
+                   # difference these across polls instead of sampling
+                   # instantaneous slot occupancy
+                   "tokens_generated": self._tokens_out,
+                   "requests_completed": self._requests_completed,
+                   "weight_swaps": self._weight_swaps}
             if self._dead is not None:
                 out["dead"] = self._dead
             return out
+
+    def load_params(self, params: Params,
+                    timeout_s: float = 120.0) -> Dict[str, Any]:
+        """Drain-barrier weight swap: queue ``params`` as the engine's
+        next weights and block until the engine thread has applied them.
+
+        The swap CANNOT be immediate — every active slot's KV cache was
+        prefilled with the old weights, and decoding old-KV rows under
+        new weights would produce tokens belonging to neither model. So
+        the engine thread (a) stops admitting new requests the moment a
+        swap is queued (pending requests stay queued, nothing is
+        dropped), (b) decodes the active slots to completion under the
+        OLD weights — in-flight streams stay token-exact — and then
+        (c) swaps and resumes admission, so every later request runs
+        token-exact under the NEW weights. A second ``load_params``
+        racing the first simply replaces the queued weights (latest
+        wins; both callers unblock when the final swap lands).
+        """
+        state = {"event": threading.Event(), "applied": False,
+                 "error": None}
+        t0 = time.perf_counter()
+        # commit the leaves to the device HERE, once: shipped weights
+        # arrive as numpy arrays, and installing those raw would make
+        # every subsequent decode tick re-transfer the full model
+        # host-to-device when jit commits its arguments
+        params = jax.tree_util.tree_map(jnp.asarray, params)
+        with self._work:
+            if self._stopped:
+                raise RuntimeError("engine is shut down")
+            if self._dead is not None:
+                raise RuntimeError(f"engine died: {self._dead}")
+            prev = self._pending_swap
+            self._pending_swap = (params, [state])
+            if prev is not None:
+                # coalesce: the superseded swap's waiters ride this one
+                self._pending_swap[1].extend(prev[1])
+            self._work.notify()
+        if not state["event"].wait(timeout_s):
+            raise TimeoutError(
+                f"weight swap did not drain within {timeout_s}s "
+                f"(active requests still decoding)")
+        if state["error"] is not None:
+            raise RuntimeError(f"weight swap failed: {state['error']}")
+        return {"drain_s": round(time.perf_counter() - t0, 4),
+                "weight_swaps": self._weight_swaps}
 
     def check_alive(self) -> None:
         """Raise if the engine thread died on a fatal decode error — the
@@ -437,6 +495,11 @@ class ContinuousEngine:
                 # _run ends the remaining streams
                 if self._stopped:
                     return
+                if self._pending_swap is not None:
+                    # drain barrier: a queued weight swap holds admission
+                    # (a prefill under the old weights admitted now would
+                    # decode under the new ones after the swap)
+                    return
                 if not (self._pending and self._batcher._free):
                     return
                 req = self._pending.popleft()
@@ -466,8 +529,35 @@ class ContinuousEngine:
                 req.emit_many([first_tok, _STREAM_END] if done
                               else [first_tok])
                 self._tokens_out += 1
-                if not done:
+                if done:
+                    self._requests_completed += 1
+                else:
                     self._live[req_id] = req
+
+    def _maybe_swap_locked(self) -> None:
+        """Apply a queued weight swap once the engine is fully drained
+        (no active slots, no prefill in flight). Caller holds _work."""
+        if (self._pending_swap is None or self._live
+                or self._admitting is not None):
+            return
+        params, waiters = self._pending_swap
+        self._pending_swap = None
+        self._batcher.params = params
+        self._weight_swaps += 1
+        for st in waiters:
+            st["applied"] = True
+            st["event"].set()
+
+    def _fail_swap_locked(self, reason: str) -> None:
+        """Unblock load_params waiters when the engine stops or dies
+        before their swap could land. Caller holds _work."""
+        if self._pending_swap is None:
+            return
+        _, waiters = self._pending_swap
+        self._pending_swap = None
+        for st in waiters:
+            st["error"] = reason
+            st["event"].set()
 
     def _run(self) -> None:
         while True:
@@ -478,9 +568,11 @@ class ContinuousEngine:
                     self._batcher.cancel(rid)
                     self._live[rid].emit_many([_STREAM_END])
                     del self._live[rid]
+                self._maybe_swap_locked()
             self._admit_all()
             with self._work:
                 if self._stopped:
+                    self._fail_swap_locked("engine shut down mid-drain")
                     for req in list(self._live.values()):
                         req.emit_many([_STREAM_END])
                     self._live.clear()
@@ -489,6 +581,9 @@ class ContinuousEngine:
                     self._pending.clear()
                     return
                 if not self._live:
+                    self._maybe_swap_locked()
+                    if self._pending or self._pending_swap is not None:
+                        continue  # freshly unblocked work: no idle wait
                     self._work.wait(timeout=0.5)
                     continue
             # decode OUTSIDE the lock: submit/cancel stay responsive
@@ -509,6 +604,7 @@ class ContinuousEngine:
                 # controller replaces the replica
                 with self._work:
                     self._dead = f"{type(e).__name__}: {e}"[:300]
+                    self._fail_swap_locked(self._dead)
                     for req in list(self._live.values()):
                         req.emit_many([_STREAM_END])
                     self._live.clear()
@@ -527,6 +623,7 @@ class ContinuousEngine:
                     if done:
                         burst.append(_STREAM_END)
                         del self._live[rid]
+                        self._requests_completed += 1
                     req.emit_many(burst)
                 tick, cap = len(self._live), self.max_slots
             if self._on_tick is not None:

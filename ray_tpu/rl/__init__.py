@@ -67,6 +67,8 @@ from ray_tpu.rl.algorithms import (  # noqa: F401
     MaskedCartPole,
     SAC,
     SACConfig,
+    SimpleQ,
+    SimpleQConfig,
     RecSlateEnv,
     SlateQ,
     SlateQConfig,

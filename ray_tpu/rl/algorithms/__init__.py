@@ -46,7 +46,12 @@ from ray_tpu.rl.algorithms.ddpg import (  # noqa: F401
     TD3,
     TD3Config,
 )
-from ray_tpu.rl.algorithms.dqn import DQN, DQNConfig  # noqa: F401
+from ray_tpu.rl.algorithms.dqn import (  # noqa: F401
+    DQN,
+    DQNConfig,
+    SimpleQ,
+    SimpleQConfig,
+)
 from ray_tpu.rl.algorithms.es import ES, ESConfig  # noqa: F401
 from ray_tpu.rl.algorithms.impala import IMPALA, IMPALAConfig  # noqa: F401
 from ray_tpu.rl.algorithms.offline import (  # noqa: F401

@@ -153,3 +153,20 @@ class DQNConfig(AlgorithmConfig):
         super().__init__(algo_class=DQN, **kwargs)
         self.lr = 1e-3
         self.minibatch_size = 64
+
+
+class SimpleQ(DQN):
+    """Reference ``rllib/algorithms/simple_q``: DQN stripped of the
+    DQN-paper add-ons (no double-Q, no prioritized replay). A real
+    class — not a registry alias to DQN — so checkpoints, ``rt rl
+    train --run SIMPLEQ`` output and ``type(algo).__name__`` all report
+    the algorithm that actually ran."""
+
+
+class SimpleQConfig(AlgorithmConfig):
+    def __init__(self, **kwargs):
+        super().__init__(algo_class=SimpleQ, **kwargs)
+        self.lr = 1e-3
+        self.minibatch_size = 64
+        self.double_q = False
+        self.prioritized_replay = False

@@ -1,0 +1,296 @@
+"""Anakin-fused rollouts: env + policy + learner in ONE compiled launch.
+
+The Podracer architecture (arxiv 2104.06272) applied to this RL stack:
+instead of the host loop in ``env_runner.py`` (numpy env steps
+interleaved with per-step jitted inference — one dispatch per env step),
+the whole iteration compiles into a single XLA program:
+
+    rollout (``lax.scan`` over T steps, ``vmap`` over B envs)
+      → GAE advantages (reverse ``lax.scan``)
+        → PPO update (``lax.scan`` over epochs)
+
+Zero host↔device transfers inside the iteration; the host only sees the
+final metrics pytree. On a TPU mesh the same program shards over chips
+(the batch axis is embarrassingly parallel); on CPU it still wins by
+amortizing dispatch — the A/B bench (``bench_fused_vs_host``) measures
+env-steps/s against the host-loop ``EnvRunner.sample`` path.
+
+The fused step is compiled EXACTLY ONCE per (config, shapes):
+``AnakinRunner.compile_count()`` exposes the jit cache size so tests can
+assert the single-launch property instead of trusting the docstring.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rl import models
+from ray_tpu.rl.algorithms.ppo import make_ppo_loss
+from ray_tpu.rl.jax_env import make_jax_env
+
+
+@dataclasses.dataclass
+class AnakinConfig:
+    """One fused-iteration recipe (PPO on a pure-JAX env)."""
+
+    env: str = "CartPole-v1"
+    num_envs: int = 64
+    rollout_len: int = 32
+    hidden: Tuple[int, ...] = (64, 64)
+    lr: float = 3e-4
+    gamma: float = 0.99
+    lam: float = 0.95
+    clip_param: float = 0.2
+    vf_coeff: float = 0.5
+    entropy_coeff: float = 0.01
+    num_epochs: int = 2
+    grad_clip: float = 0.5
+    seed: int = 0
+
+    @property
+    def env_steps_per_iter(self) -> int:
+        return self.num_envs * self.rollout_len
+
+
+def make_anakin_step(cfg: AnakinConfig, env_cls=None):
+    """Build the fused iteration: ``step(carry) -> (carry, metrics)``.
+
+    ``carry`` = (params, opt_state, env_state, obs, key). The function is
+    pure and jit-ready; :class:`AnakinRunner` owns the single ``jax.jit``
+    wrapping so the compile count is observable.
+    """
+    env_cls = env_cls or make_jax_env(cfg.env)
+    spec = env_cls.spec
+    loss_fn = make_ppo_loss(spec, cfg.clip_param, cfg.vf_coeff,
+                            cfg.entropy_coeff)
+    opt = optax.chain(optax.clip_by_global_norm(cfg.grad_clip),
+                      optax.adam(cfg.lr))
+    T = cfg.rollout_len
+
+    def step(carry):
+        params, opt_state, env_state, obs, key = carry
+
+        def rollout_body(c, _):
+            env_state, obs, key = c
+            key, sub = jax.random.split(key)
+            logits = models.policy_logits(params, obs)
+            vals = models.value(params, obs)
+            actions = models.categorical_sample(sub, logits)
+            logp = models.categorical_logp(logits, actions)
+            env_state, next_obs, rew, done = env_cls.step_batch(
+                env_state, actions)
+            return ((env_state, next_obs, key),
+                    (obs, actions, logp, vals, rew, done))
+
+        (env_state, obs, key), traj = jax.lax.scan(
+            rollout_body, (env_state, obs, key), None, length=T)
+        obs_t, act_t, logp_t, val_t, rew_t, done_t = traj
+        last_val = models.value(params, obs)
+
+        def gae_body(c, inp):
+            last_gae, next_val = c
+            rew, val, done = inp
+            nonterminal = 1.0 - done.astype(jnp.float32)
+            delta = rew + cfg.gamma * next_val * nonterminal - val
+            last_gae = delta + cfg.gamma * cfg.lam * nonterminal * last_gae
+            return (last_gae, val), last_gae
+
+        (_, _), adv_t = jax.lax.scan(
+            gae_body, (jnp.zeros_like(last_val), last_val),
+            (rew_t, val_t, done_t), reverse=True)
+        ret_t = adv_t + val_t
+
+        flat = lambda a: a.reshape((T * cfg.num_envs,) + a.shape[2:])  # noqa: E731
+        batch = {"obs": flat(obs_t), "actions": flat(act_t),
+                 "logp": flat(logp_t), "advantages": flat(adv_t),
+                 "value_targets": flat(ret_t)}
+
+        def update_body(c, _):
+            params, opt_state = c
+            (loss, aux), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, batch, None)
+            updates, opt_state = opt.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return (params, opt_state), (loss, aux["entropy"], aux["kl"])
+
+        (params, opt_state), (losses, entropies, kls) = jax.lax.scan(
+            update_body, (params, opt_state), None, length=cfg.num_epochs)
+
+        metrics = {
+            "reward_mean_per_step": jnp.mean(rew_t),
+            "episodes_done": jnp.sum(done_t),
+            "loss": losses[-1],
+            "entropy": entropies[-1],
+            "kl": kls[-1],
+            "value_mean": jnp.mean(val_t),
+        }
+        return (params, opt_state, env_state, obs, key), metrics
+
+    return step
+
+
+class AnakinRunner:
+    """Owns the fused step's single jit + the training carry.
+
+    The entire iteration — rollout, advantage, update — is ONE launch;
+    host code only converts the returned metrics. ``compile_count()``
+    reports how many programs the jit cache holds (the fusion test
+    asserts it stays at 1 across iterations).
+    """
+
+    def __init__(self, cfg: Optional[AnakinConfig] = None, **overrides):
+        self.cfg = cfg or AnakinConfig(**overrides)
+        env_cls = make_jax_env(self.cfg.env)
+        self._env_cls = env_cls
+        key = jax.random.key(self.cfg.seed)
+        k_params, k_env, k_run = jax.random.split(key, 3)
+        params = jax.tree_util.tree_map(
+            jnp.asarray,
+            models.init_policy(k_params, env_cls.spec,
+                               hidden=self.cfg.hidden))
+        opt = optax.chain(optax.clip_by_global_norm(self.cfg.grad_clip),
+                          optax.adam(self.cfg.lr))
+        opt_state = opt.init(params)
+        env_state, obs = env_cls.reset_batch(k_env, self.cfg.num_envs)
+        self._carry = (params, opt_state, env_state, obs, k_run)
+        self._step_fn = jax.jit(make_anakin_step(self.cfg, env_cls))
+        self.iterations = 0
+        self.env_steps_total = 0
+
+    @property
+    def params(self):
+        return self._carry[0]
+
+    def compile_count(self) -> int:
+        """Programs in the fused step's jit cache (1 == fully fused)."""
+        return int(self._step_fn._cache_size())
+
+    def train(self, iterations: int = 1) -> Dict[str, Any]:
+        """Run N fused iterations; returns the LAST iteration's metrics
+        (converted host-side, outside the compiled program)."""
+        metrics = None
+        for _ in range(iterations):
+            self._carry, metrics = self._step_fn(self._carry)
+        self.iterations += iterations
+        self.env_steps_total += iterations * self.cfg.env_steps_per_iter
+        out = {k: float(np.asarray(v)) for k, v in metrics.items()}
+        out["env_steps_total"] = self.env_steps_total
+        out["iterations"] = self.iterations
+        return out
+
+    def block(self) -> None:
+        """Device-sync the carry (bench timing boundary)."""
+        jax.block_until_ready(self._carry)
+
+
+# ---------------------------------------------------------------------------
+# A/B bench: fused Anakin vs the host-loop EnvRunner path
+# ---------------------------------------------------------------------------
+
+
+def bench_fused_vs_host(*, num_envs: int = 64, rollout_len: int = 32,
+                        iters: int = 20, warmup: int = 3,
+                        seed: int = 0) -> Dict[str, Any]:
+    """env-steps/s of the fused Anakin iteration vs the host-loop
+    ``EnvRunner`` path running the SAME work at the SAME (B, T) shape.
+
+    Both legs execute one full PPO iteration per fragment — rollout,
+    GAE, ``num_epochs`` full-batch updates with the identical loss and
+    optimizer. The fused leg runs it all as ONE launch; the host leg is
+    the existing architecture: numpy env stepped under per-step jitted
+    inference (one dispatch + device→host readback per env step, numpy
+    GAE), then the batch shipped host→device for a separately-launched
+    update. The delta is therefore exactly the per-step ping-pong and
+    launch overhead Anakin removes, not a difference in algorithm work.
+
+    Methodology (stamped into the result): ``warmup`` untimed iterations
+    first (XLA compiles + CPU dispatch-jitter dry runs), then ``iters``
+    timed; the fused leg blocks on its carry before and after timing so
+    async dispatch cannot hide work.
+    """
+    cfg = AnakinConfig(num_envs=num_envs, rollout_len=rollout_len,
+                       seed=seed)
+    runner = AnakinRunner(cfg)
+    runner.train(warmup)
+    runner.block()
+    t0 = time.perf_counter()
+    runner.train(iters)
+    runner.block()
+    fused_s = time.perf_counter() - t0
+    fused_steps = iters * cfg.env_steps_per_iter
+
+    # host loop: the plain EnvRunner class (no actor hop — this measures
+    # the per-step host↔device architecture, not RPC overhead), plus the
+    # same PPO update jitted as its own launch (batch crosses the host
+    # boundary, as the existing Algorithm.training_step path does)
+    from ray_tpu.rl.env_runner import EnvRunner
+
+    host_cls = getattr(EnvRunner, "_cls", EnvRunner)
+    host = host_cls("CartPole-v1", num_envs, rollout_len, seed=seed)
+    host_params = jax.tree_util.tree_map(
+        jnp.asarray, models.init_policy(jax.random.key(seed), host.spec,
+                                        hidden=cfg.hidden))
+    loss_fn = make_ppo_loss(host.spec, cfg.clip_param, cfg.vf_coeff,
+                            cfg.entropy_coeff)
+    opt = optax.chain(optax.clip_by_global_norm(cfg.grad_clip),
+                      optax.adam(cfg.lr))
+    opt_state = opt.init(host_params)
+
+    @jax.jit
+    def host_update(params, opt_state, batch):
+        def body(c, _):
+            params, opt_state = c
+            (_, _), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, batch, None)
+            updates, opt_state = opt.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return (params, opt_state), None
+
+        (params, opt_state), _ = jax.lax.scan(
+            body, (params, opt_state), None, length=cfg.num_epochs)
+        return params, opt_state
+
+    def host_iter(params, opt_state):
+        frag = host.sample(params)
+        batch = {k: jnp.asarray(frag[k])
+                 for k in ("obs", "actions", "logp", "advantages",
+                           "value_targets")}
+        params, opt_state = host_update(params, opt_state, batch)
+        return params, opt_state
+
+    for _ in range(warmup):
+        host_params, opt_state = host_iter(host_params, opt_state)
+    jax.block_until_ready(host_params)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        host_params, opt_state = host_iter(host_params, opt_state)
+    jax.block_until_ready(host_params)
+    host_s = time.perf_counter() - t0
+    host_steps = iters * num_envs * rollout_len
+
+    fused_sps = fused_steps / max(fused_s, 1e-9)
+    host_sps = host_steps / max(host_s, 1e-9)
+    return {
+        "num_envs": num_envs, "rollout_len": rollout_len,
+        "iters": iters, "warmup": warmup,
+        "fused_env_steps_per_s": round(fused_sps, 1),
+        "host_env_steps_per_s": round(host_sps, 1),
+        "fused_vs_host_ratio": round(fused_sps / max(host_sps, 1e-9), 2),
+        "fused_compile_count": runner.compile_count(),
+        "methodology": (
+            "equal work both legs (rollout + GAE + {e}-epoch PPO update "
+            "at B={b}, T={t}): {w} warmup iters (compiles + CPU "
+            "dispatch-jitter dry runs) then {n} timed; fused leg is one "
+            "launch per iter, block_until_ready-bounded; host leg is "
+            "EnvRunner.sample (per-step jitted inference + numpy env + "
+            "numpy GAE) + a separately-launched jitted update".format(
+                e=cfg.num_epochs, w=warmup, n=iters, b=num_envs,
+                t=rollout_len)),
+    }

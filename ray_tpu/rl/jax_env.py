@@ -1,0 +1,129 @@
+"""Pure-JAX vectorized environments for mesh-fused (Anakin) rollouts.
+
+The device-side twin of ``rl/env.py``: the same classic-control dynamics
+re-expressed as *pure functions* over explicit state pytrees, so a whole
+rollout compiles into one XLA program — ``lax.scan`` over T steps,
+``vmap`` over B env copies, zero host↔device ping-pong per step (the
+Podracer/Anakin architecture, arxiv 2104.06272).
+
+Parity contract with the host envs: ``JaxCartPole.step`` applies the
+SAME Euler-integrated dynamics, termination bounds, and +1/step reward
+as ``env.CartPole`` (float32 instead of float64 — tests assert
+trajectory agreement to ~1e-4 over a fragment). Auto-reset on done uses
+a jax.random key carried in the state, matching the host env's
+re-randomized [-0.05, 0.05] init.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.rl.env import EnvSpec
+
+
+class CartPoleState(NamedTuple):
+    """Per-env-copy state pytree (leading axis = env batch after vmap)."""
+
+    x: jax.Array        # [4] physical state (x, x_dot, theta, theta_dot)
+    t: jax.Array        # scalar int32 step count in the episode
+    key: jax.Array      # per-env PRNG key driving auto-reset inits
+
+
+class JaxCartPole:
+    """CartPole-v1 dynamics as pure jittable functions.
+
+    All methods are static over a single env copy; callers ``vmap`` them
+    over the batch axis (``reset_batch`` does it for you). No Python
+    state — the env "instance" only carries the spec.
+    """
+
+    spec = EnvSpec(obs_dim=4, num_actions=2)
+
+    # dynamics constants — identical to env.CartPole
+    _GRAVITY, _MC, _MP = 9.8, 1.0, 0.1
+    _L, _FMAG, _DT = 0.5, 10.0, 0.02
+    _THETA_LIM = 12 * 2 * jnp.pi / 360
+    _X_LIM = 2.4
+    _MAX_T = 500
+
+    @classmethod
+    def reset(cls, key: jax.Array) -> Tuple[CartPoleState, jax.Array]:
+        """One env copy: fresh state + its observation."""
+        key, sub = jax.random.split(key)
+        x = jax.random.uniform(sub, (4,), jnp.float32, -0.05, 0.05)
+        state = CartPoleState(x=x, t=jnp.zeros((), jnp.int32), key=key)
+        return state, x
+
+    @classmethod
+    def reset_batch(cls, key: jax.Array, num_envs: int
+                    ) -> Tuple[CartPoleState, jax.Array]:
+        """B independent env copies: batched state pytree + obs [B, 4]."""
+        keys = jax.random.split(key, num_envs)
+        return jax.vmap(cls.reset)(keys)
+
+    @classmethod
+    def step(cls, state: CartPoleState, action: jax.Array
+             ) -> Tuple[CartPoleState, jax.Array, jax.Array, jax.Array]:
+        """One env copy, one transition: (state', obs', reward, done).
+
+        Done envs are already reset in ``state'`` (the returned obs is
+        the POST-reset observation, matching the host ``VectorEnv.step``
+        contract); the reward/done flags describe the transition that
+        ended.
+        """
+        x, x_dot, th, th_dot = state.x
+        force = jnp.where(action == 1, cls._FMAG, -cls._FMAG)
+        cos, sin = jnp.cos(th), jnp.sin(th)
+        total_m = cls._MC + cls._MP
+        pm_l = cls._MP * cls._L
+        temp = (force + pm_l * th_dot ** 2 * sin) / total_m
+        th_acc = (cls._GRAVITY * sin - cos * temp) / (
+            cls._L * (4.0 / 3.0 - cls._MP * cos ** 2 / total_m))
+        x_acc = temp - pm_l * th_acc * cos / total_m
+        x = x + cls._DT * x_dot
+        x_dot = x_dot + cls._DT * x_acc
+        th = th + cls._DT * th_dot
+        th_dot = th_dot + cls._DT * th_acc
+        nxt = jnp.stack([x, x_dot, th, th_dot])
+        t = state.t + 1
+        done = ((jnp.abs(x) > cls._X_LIM)
+                | (jnp.abs(th) > cls._THETA_LIM)
+                | (t >= cls._MAX_T))
+        reward = jnp.float32(1.0)
+        # auto-reset: branchless select between the stepped state and a
+        # fresh init (both sides compute — cheap at this state size, and
+        # the select keeps the whole step traceable with static shapes)
+        key, sub = jax.random.split(state.key)
+        fresh = jax.random.uniform(sub, (4,), jnp.float32, -0.05, 0.05)
+        nxt = jnp.where(done, fresh, nxt)
+        t = jnp.where(done, jnp.zeros((), jnp.int32), t)
+        new_state = CartPoleState(x=nxt, t=t, key=key)
+        return new_state, nxt, reward, done
+
+    @classmethod
+    def step_batch(cls, state: CartPoleState, actions: jax.Array):
+        """Batched transition: vmapped ``step`` over the env axis."""
+        return jax.vmap(cls.step)(state, actions)
+
+    @classmethod
+    def from_host_state(cls, x, key: jax.Array, t=None) -> CartPoleState:
+        """Adopt a host env's raw state [B, 4] (parity tests drive the
+        numpy and JAX dynamics from the same initial conditions)."""
+        x = jnp.asarray(x, jnp.float32)
+        b = x.shape[0]
+        t_arr = (jnp.zeros((b,), jnp.int32) if t is None
+                 else jnp.asarray(t, jnp.int32))
+        return CartPoleState(x=x, t=t_arr, key=jax.random.split(key, b))
+
+
+JAX_ENVS = {"CartPole-v1": JaxCartPole}
+
+
+def make_jax_env(name: str):
+    if name not in JAX_ENVS:
+        raise KeyError(f"no pure-JAX env {name!r}; available: "
+                       f"{sorted(JAX_ENVS)}")
+    return JAX_ENVS[name]

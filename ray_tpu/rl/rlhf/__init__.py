@@ -1,0 +1,28 @@
+"""TPU-native RLHF: policy/reference/reward placement, ContinuousEngine
+generation, PPO-style sequence updates, streamed weight sync.
+
+The end-to-end pipeline ROADMAP item 5 names (arxiv 2312.11819 adaptive
+placement + interleaved generate/train; MindSpeed RL 2507.19017):
+
+- ``pipeline.RLHFPipeline`` — the driver: places the policy learner,
+  reference model, reward model and generation engine as role actors
+  (one per placement-group bundle, ``train/worker_group.RoleGroup``),
+  then interleaves generate → score → update → weight-sync phases.
+- ``models`` — the llama-backed reward model and sequence-logprob
+  utilities the roles share.
+
+The generate phase runs on ``models/serving.ContinuousEngine`` slots;
+fresh learner weights travel over ``cluster/stream.py`` oid frames via
+``collective.ship_params`` and land through the engine's drain-barrier
+``load_params`` swap.
+"""
+
+from ray_tpu.rl.rlhf.models import (  # noqa: F401
+    init_reward_params,
+    reward_score,
+    sequence_logprobs,
+)
+from ray_tpu.rl.rlhf.pipeline import (  # noqa: F401
+    RLHFConfig,
+    RLHFPipeline,
+)

@@ -1,0 +1,93 @@
+"""RLHF model pieces over the llama trunk: reward scoring + sequence
+logprobs.
+
+The three RLHF roles share one architecture family (``models/llama.py``
+presets) so placement is a pure resource decision:
+
+- the REWARD model is a llama trunk with a scalar head read at the last
+  position (the standard preference-model shape);
+- the REFERENCE model is a frozen copy of the initial policy — its
+  per-token logprobs anchor the KL penalty;
+- the POLICY is the llama LM itself (the generation engine decodes it,
+  the learner updates it).
+
+Compiled entry points are ``lru_cache``-keyed by (config, shape) — the
+same one-program-per-shape idiom as ``models/serving.py`` — so repeated
+pipeline iterations at fixed batch shapes pay zero retrace.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.models import llama
+
+Params = Dict[str, Any]
+
+
+def init_reward_params(rng: jax.Array, cfg: llama.LlamaConfig) -> Params:
+    """Llama trunk + scalar reward head (read at the final position)."""
+    k_lm, k_head = jax.random.split(rng)
+    head = (jax.random.normal(k_head, (cfg.d_model, 1), jnp.float32)
+            * (1.0 / jnp.sqrt(cfg.d_model))).astype(cfg.param_dtype)
+    return {"lm": llama.init_params(k_lm, cfg), "head": head}
+
+
+@functools.lru_cache(maxsize=32)
+def _compiled_reward(cfg, b: int, s: int):
+    @jax.jit
+    def run(rm: Params, tokens: jax.Array) -> jax.Array:
+        hidden, _ = llama.forward_hidden(rm["lm"], tokens, cfg)
+        # scalar score from the last position's hidden state
+        return (hidden[:, -1, :] @ rm["head"].astype(hidden.dtype)
+                ).astype(jnp.float32)[:, 0]
+
+    return run
+
+
+def reward_score(rm: Params, tokens: jax.Array,
+                 cfg: llama.LlamaConfig) -> jax.Array:
+    """tokens [B, S] -> reward [B] (fp32)."""
+    b, s = tokens.shape
+    return _compiled_reward(cfg, b, s)(rm, tokens)
+
+
+def seq_logprob_body(params: Params, tokens: jax.Array, prompt_len: int,
+                     cfg: llama.LlamaConfig) -> jax.Array:
+    """The traceable core of :func:`sequence_logprobs` (``prompt_len``
+    must be a static python int) — the learner inlines this inside its
+    jitted update so the logprob forward fuses into the loss trace."""
+    logits = llama.forward(params, tokens[:, :-1], cfg)
+    logp_all = jax.nn.log_softmax(logits, axis=-1)
+    # position i of logits predicts token i+1: the generated span
+    # tokens[prompt_len:] is scored by logits[prompt_len-1:]
+    targets = tokens[:, prompt_len:]
+    preds = logp_all[:, prompt_len - 1:, :]
+    return jnp.take_along_axis(preds, targets[..., None], axis=-1)[..., 0]
+
+
+@functools.lru_cache(maxsize=32)
+def _compiled_seq_logprobs(cfg, b: int, s: int, prompt_len: int):
+    @jax.jit
+    def run(params: Params, tokens: jax.Array) -> jax.Array:
+        return seq_logprob_body(params, tokens, prompt_len, cfg)
+
+    return run
+
+
+def sequence_logprobs(params: Params, tokens: jax.Array, prompt_len: int,
+                      cfg: llama.LlamaConfig) -> jax.Array:
+    """Per-token logprob of the GENERATED span under ``params``.
+
+    tokens [B, S] (prompt + generation, S > prompt_len) -> [B, S -
+    prompt_len] logprobs of tokens[:, prompt_len:] given their prefixes.
+    """
+    b, s = tokens.shape
+    if prompt_len < 1 or prompt_len >= s:
+        raise ValueError(f"prompt_len {prompt_len} out of range for "
+                         f"sequence length {s}")
+    return _compiled_seq_logprobs(cfg, b, s, prompt_len)(params, tokens)

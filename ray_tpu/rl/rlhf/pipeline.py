@@ -1,0 +1,496 @@
+"""The RLHF pipeline driver: placed roles, interleaved phases, streamed
+weight sync.
+
+One iteration is four phases over four PLACED role actors (one
+placement-group bundle each, ``train/worker_group.RoleGroup``):
+
+  generate   prompts decode on the generator's ContinuousEngine slots
+             (``models/serving.py`` — mid-flight admission, K-fused
+             ticks; the same engine the serve path runs)
+  score      the reward model scores full sequences; the frozen
+             reference model logprobs the generated spans (both fire in
+             parallel — they are independent reads)
+  update     the policy learner runs a PPO-style clipped update on the
+             sampled sequences (sequence-level advantage = reward −
+             kl_coeff · KL(policy‖reference), batch-normalized)
+  sync       fresh learner weights ship to the generator over
+             ``cluster/stream.py`` oid frames (``collective.
+             ship_params`` — plasma spill above the inline threshold,
+             pull fallback on a broken channel) and land through the
+             engine's drain-barrier ``load_params`` swap, so in-flight
+             streams finish token-exact under the old weights and the
+             next generate phase decodes the new ones
+
+Every phase call runs under ONE ambient trace span, so ``rt trace
+<pipeline.trace_id>`` shows the whole story: role creation (placement),
+then each iteration's generate/score/update/sync hops.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+import uuid
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu.util import metrics as M
+
+# ---------------------------------------------------------------------------
+# metrics (lazy — the registry must not be touched at import time)
+# ---------------------------------------------------------------------------
+
+_metrics_lock = threading.Lock()
+_metrics: Dict[str, Any] = {}  # rt: guarded-by(_metrics_lock)
+
+_PHASE_BUCKETS = (0.01, 0.03, 0.1, 0.3, 1.0, 3.0, 10.0, 30.0, 120.0)
+
+
+def _metric(key: str, factory: Callable[[], Any]) -> Any:
+    with _metrics_lock:
+        m = _metrics.get(key)
+        if m is None:
+            m = _metrics[key] = factory()
+        return m
+
+
+def iterations_total() -> "M.Counter":
+    return _metric("iters", lambda: M.get_or_create(
+        M.Counter, "rt_rlhf_iterations_total",
+        "RLHF pipeline iterations completed (generate -> score -> "
+        "update -> weight-sync)"))
+
+
+def tokens_generated_total() -> "M.Counter":
+    return _metric("toks", lambda: M.get_or_create(
+        M.Counter, "rt_rlhf_tokens_generated_total",
+        "Tokens decoded by the RLHF generate phase on the continuous "
+        "engine"))
+
+
+def reward_mean_gauge() -> "M.Gauge":
+    return _metric("reward", lambda: M.get_or_create(
+        M.Gauge, "rt_rlhf_reward_mean",
+        "Mean reward-model score of the last RLHF iteration's batch"))
+
+
+def phase_seconds() -> "M.Histogram":
+    return _metric("phase", lambda: M.get_or_create(
+        M.Histogram, "rt_rlhf_phase_seconds",
+        "Wall seconds per RLHF pipeline phase, phase= (generate / "
+        "score / update / sync)",
+        tag_keys=("phase",), boundaries=_PHASE_BUCKETS))
+
+
+def weight_sync_bytes_total() -> "M.Counter":
+    return _metric("sync_bytes", lambda: M.get_or_create(
+        M.Counter, "rt_rlhf_weight_sync_bytes_total",
+        "Parameter bytes shipped learner -> generation engine per "
+        "weight sync, transport= (push / fallback / pull)",
+        tag_keys=("transport",)))
+
+
+def weight_sync_seconds() -> "M.Histogram":
+    return _metric("sync_s", lambda: M.get_or_create(
+        M.Histogram, "rt_rlhf_weight_sync_seconds",
+        "Wall seconds of one weight sync (ship + fetch + drain-barrier "
+        "engine swap)", boundaries=_PHASE_BUCKETS))
+
+
+# ---------------------------------------------------------------------------
+# config
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class RLHFConfig:
+    preset: str = "debug"
+    num_prompts: int = 4          # sequences per iteration
+    prompt_len: int = 8
+    max_new_tokens: int = 16
+    max_slots: int = 4            # generation engine decode slots
+    decode_stride: int = 4
+    lr: float = 1e-4
+    kl_coeff: float = 0.1
+    clip_param: float = 0.2
+    num_epochs: int = 2
+    seed: int = 0
+    cpus_per_role: float = 1.0
+
+    @property
+    def max_len(self) -> int:
+        return self.prompt_len + self.max_new_tokens + 2
+
+
+# ---------------------------------------------------------------------------
+# role actors
+# ---------------------------------------------------------------------------
+
+
+class RLHFLearner:
+    """The policy owner: holds the ONLY writable copy of the policy and
+    runs the PPO-style sequence update; ships weights by ticket."""
+
+    def __init__(self, preset: str, seed: int, lr: float, kl_coeff: float,
+                 clip_param: float, num_epochs: int):
+        import functools
+
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        from ray_tpu.models import llama
+        from ray_tpu.rl.rlhf import models as rlhf_models
+
+        self.cfg = llama.PRESETS[preset]
+        self._params = llama.init_params(jax.random.key(seed), self.cfg)
+        self._kl_coeff = kl_coeff
+        self._num_epochs = num_epochs
+        self._opt = optax.adam(lr)
+        self._opt_state = self._opt.init(self._params)
+        self._updates = 0
+        cfg, opt = self.cfg, self._opt
+
+        @functools.partial(jax.jit, static_argnums=(5,))
+        def update_step(params, opt_state, tokens, old_logp, adv,
+                        prompt_len):
+            def loss_fn(p):
+                logp = rlhf_models.seq_logprob_body(
+                    p, tokens, prompt_len, cfg)
+                ratio = jnp.exp(logp - old_logp)
+                a = adv[:, None]
+                surr = jnp.minimum(
+                    ratio * a,
+                    jnp.clip(ratio, 1 - clip_param, 1 + clip_param) * a)
+                return -jnp.mean(surr), jnp.mean(ratio)
+
+            (loss, ratio_mean), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            updates, opt_state = opt.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss, ratio_mean
+
+        self._update_step = update_step
+
+    def ping(self) -> str:
+        return "learner"
+
+    def update(self, sequences, rewards, ref_logps,
+               prompt_len: int) -> Dict[str, Any]:
+        """One PPO-style update on the sampled sequences; returns
+        iteration metrics."""
+        import jax.numpy as jnp
+
+        from ray_tpu.rl.rlhf import models as rlhf_models
+
+        tokens = jnp.asarray(np.asarray(sequences, np.int32))
+        rewards = jnp.asarray(np.asarray(rewards, np.float32))
+        ref_logps = jnp.asarray(np.asarray(ref_logps, np.float32))
+        old_logp = rlhf_models.sequence_logprobs(
+            self._params, tokens, prompt_len, self.cfg)
+        # sequence-level objective: reward-model score minus the KL
+        # anchor to the reference policy, normalized across the batch
+        kl_seq = jnp.sum(old_logp - ref_logps, axis=-1)
+        adj = rewards - self._kl_coeff * kl_seq
+        adv = (adj - adj.mean()) / (adj.std() + 1e-6)
+        loss = ratio = 0.0
+        for _ in range(self._num_epochs):
+            (self._params, self._opt_state, loss,
+             ratio) = self._update_step(
+                self._params, self._opt_state, tokens,
+                old_logp, adv, prompt_len)
+        self._updates += 1
+        return {"loss": float(loss), "ratio_mean": float(ratio),
+                "kl_mean": float(jnp.mean(kl_seq)),
+                "reward_mean": float(jnp.mean(rewards)),
+                "updates": self._updates}
+
+    def ship_weights(self) -> Dict[str, Any]:
+        """Ship the current policy: returns the stream ticket the
+        generator redeems (tensor bytes travel as oid frames, not
+        through this actor call's reply)."""
+        from ray_tpu import collective
+
+        return collective.ship_params(self._params)
+
+    def cancel_shipment(self, ticket: Dict[str, Any]) -> None:
+        """Drop an unredeemed shipment (the pipeline calls this when
+        the generator's sync fails — otherwise each failed round
+        strands a full parameter copy in this process's registry)."""
+        from ray_tpu import collective
+
+        collective.cancel_shipment(ticket)
+
+    def get_params(self):
+        return self._params
+
+
+class RLHFReference:
+    """Frozen copy of the initial policy: the KL anchor."""
+
+    def __init__(self, preset: str, seed: int):
+        import jax
+
+        from ray_tpu.models import llama
+
+        self.cfg = llama.PRESETS[preset]
+        # seed matches the learner's init — the reference IS the initial
+        # policy, per the standard RLHF recipe
+        self._params = llama.init_params(jax.random.key(seed), self.cfg)
+
+    def ping(self) -> str:
+        return "reference"
+
+    def logprobs(self, sequences, prompt_len: int) -> np.ndarray:
+        import jax.numpy as jnp
+
+        from ray_tpu.rl.rlhf import models as rlhf_models
+
+        tokens = jnp.asarray(np.asarray(sequences, np.int32))
+        return np.asarray(rlhf_models.sequence_logprobs(
+            self._params, tokens, prompt_len, self.cfg))
+
+
+class RLHFReward:
+    """The preference model: scalar score per full sequence."""
+
+    def __init__(self, preset: str, seed: int):
+        import jax
+
+        from ray_tpu.models import llama
+        from ray_tpu.rl.rlhf import models as rlhf_models
+
+        self.cfg = llama.PRESETS[preset]
+        self._params = rlhf_models.init_reward_params(
+            jax.random.key(seed), self.cfg)
+
+    def ping(self) -> str:
+        return "reward"
+
+    def score(self, sequences) -> np.ndarray:
+        import jax.numpy as jnp
+
+        from ray_tpu.rl.rlhf import models as rlhf_models
+
+        tokens = jnp.asarray(np.asarray(sequences, np.int32))
+        return np.asarray(rlhf_models.reward_score(
+            self._params, tokens, self.cfg))
+
+
+class RLHFGenerator:
+    """The generation engine role: one ContinuousEngine (the serve
+    path's continuous batcher) decoding the policy; weight syncs land
+    through the drain-barrier swap."""
+
+    def __init__(self, preset: str, seed: int, max_slots: int,
+                 max_len: int, decode_stride: int):
+        import jax
+
+        from ray_tpu.models import llama
+        from ray_tpu.models.serving import ContinuousEngine
+
+        self.cfg = llama.PRESETS[preset]
+        # same seed as the learner: generation starts on the SAME
+        # initial policy the learner will update
+        params = llama.init_params(jax.random.key(seed), self.cfg)
+        self.engine = ContinuousEngine(
+            params, self.cfg, max_slots=max_slots, max_len=max_len,
+            decode_stride=decode_stride)
+
+    def ping(self) -> str:
+        return "generator"
+
+    def generate(self, prompts, max_new_tokens: int) -> Dict[str, Any]:
+        """Decode every prompt through the engine's slots (mid-flight
+        admission; the engine queues past the slot budget). Returns
+        full sequences (prompt + generation) and engine counters."""
+        t0 = time.perf_counter()
+        queues = [self.engine.submit_stream(
+            np.asarray(p, np.int32), max_new_tokens) for p in prompts]
+        seqs = []
+        for p, q in zip(prompts, queues):
+            toks = [t for t in iter(q.get, None)]
+            seqs.append(list(p) + toks)
+        dt = time.perf_counter() - t0
+        n_new = sum(len(s) - len(p) for s, p in zip(seqs, prompts))
+        return {"sequences": np.asarray(seqs, np.int32),
+                "tokens_generated": n_new,
+                "tok_s": round(n_new / max(dt, 1e-9), 1),
+                "wall_s": round(dt, 4),
+                "engine": self.engine.stats()}
+
+    def sync_weights(self, ticket: Dict[str, Any]) -> Dict[str, Any]:
+        """Redeem the learner's ticket: fetch the shipped weights over
+        the stream plane, swap them in behind the drain barrier."""
+        from ray_tpu import collective
+
+        t0 = time.perf_counter()
+        params, info = collective.fetch_params(ticket)
+        swap = self.engine.load_params(params)
+        info.update(swap)
+        info["sync_s"] = round(time.perf_counter() - t0, 4)
+        return info
+
+    def engine_stats(self) -> Dict[str, Any]:
+        return self.engine.stats()
+
+
+# ---------------------------------------------------------------------------
+# the pipeline driver
+# ---------------------------------------------------------------------------
+
+
+class RLHFPipeline:
+    """Places the four roles and interleaves generate/score/update/sync.
+
+    Requires an initialized ray_tpu session. ``trace_id`` identifies the
+    pipeline's span tree (`rt trace <trace_id>` shows role placement and
+    every phase hop).
+    """
+
+    def __init__(self, cfg: Optional[RLHFConfig] = None, **overrides):
+        from ray_tpu.train.worker_group import RoleGroup
+        from ray_tpu.util import tracing
+
+        self.cfg = cfg or RLHFConfig(**overrides)
+        c = self.cfg
+        self._rng = np.random.default_rng(c.seed)
+        self._lock = threading.Lock()
+        self._iterations = 0       # rt: guarded-by(_lock)
+        self._tokens_generated = 0  # rt: guarded-by(_lock)
+        self._sync_bytes = 0       # rt: guarded-by(_lock)
+        self._last: Dict[str, Any] = {}  # rt: guarded-by(_lock)
+        # ONE ambient span for the pipeline's lifetime: role creation
+        # and every phase call become children of this synthetic root,
+        # so the whole story lands under one trace id
+        self._trace_ctx = {"trace_id": uuid.uuid4().hex,
+                           "span_id": uuid.uuid4().hex[:16]}
+        self.trace_id = self._trace_ctx["trace_id"]
+        self.group = RoleGroup(f"rlhf-{self.trace_id[:8]}",
+                               strategy="PACK")
+        self.group.add_role(
+            "learner", RLHFLearner, c.preset, c.seed, c.lr, c.kl_coeff,
+            c.clip_param, c.num_epochs, num_cpus=c.cpus_per_role)
+        self.group.add_role("reference", RLHFReference, c.preset, c.seed,
+                            num_cpus=c.cpus_per_role)
+        self.group.add_role("reward", RLHFReward, c.preset, c.seed + 1,
+                            num_cpus=c.cpus_per_role)
+        self.group.add_role(
+            "generator", RLHFGenerator, c.preset, c.seed, c.max_slots,
+            c.max_len, c.decode_stride, num_cpus=c.cpus_per_role)
+        token = tracing.activate(self._trace_ctx)
+        try:
+            self.group.start()
+        except BaseException:
+            tracing.deactivate(token)
+            raise
+        tracing.deactivate(token)
+
+    # -- phases -----------------------------------------------------------
+
+    def _sample_prompts(self) -> List[List[int]]:
+        from ray_tpu.models import llama
+
+        c = self.cfg
+        vocab = llama.PRESETS[c.preset].vocab_size
+        return [[int(t) for t in
+                 self._rng.integers(1, vocab, size=c.prompt_len)]
+                for _ in range(c.num_prompts)]
+
+    def run_iteration(self) -> Dict[str, Any]:
+        """One generate -> score -> update -> sync round; returns the
+        iteration's metrics (also pushed onto the ``rt_rlhf_*`` series).
+        """
+        import ray_tpu
+        from ray_tpu.util import tracing
+
+        c = self.cfg
+        g = self.group
+        token = tracing.activate(self._trace_ctx)
+        try:
+            phases: Dict[str, float] = {}
+            t0 = time.perf_counter()
+            gen = ray_tpu.get(g["generator"].generate.remote(
+                self._sample_prompts(), c.max_new_tokens))
+            phases["generate"] = time.perf_counter() - t0
+            seqs = gen["sequences"]
+
+            t0 = time.perf_counter()
+            # reward + reference fire in parallel: independent reads
+            reward_ref = g["reward"].score.remote(seqs)
+            ref_ref = g["reference"].logprobs.remote(seqs, c.prompt_len)
+            rewards, ref_logps = ray_tpu.get([reward_ref, ref_ref])
+            phases["score"] = time.perf_counter() - t0
+
+            t0 = time.perf_counter()
+            update = ray_tpu.get(g["learner"].update.remote(
+                seqs, rewards, ref_logps, c.prompt_len))
+            phases["update"] = time.perf_counter() - t0
+
+            t0 = time.perf_counter()
+            ticket = ray_tpu.get(g["learner"].ship_weights.remote())
+            try:
+                sync = ray_tpu.get(
+                    g["generator"].sync_weights.remote(ticket))
+            except BaseException:
+                # the shipment was never redeemed: drop it, or every
+                # failed round strands a full parameter copy in the
+                # learner's source registry
+                try:
+                    ray_tpu.get(
+                        g["learner"].cancel_shipment.remote(ticket))
+                except Exception:  # noqa: BLE001 — best-effort cleanup
+                    pass
+                raise
+            phases["sync"] = time.perf_counter() - t0
+        finally:
+            tracing.deactivate(token)
+
+        result = {
+            "iteration": None,  # filled under the lock below
+            "tokens_generated": int(gen["tokens_generated"]),
+            "generate_tok_s": gen["tok_s"],
+            "reward_mean": update["reward_mean"],
+            "kl_mean": update["kl_mean"],
+            "loss": update["loss"],
+            "sync_transport": sync["transport"],
+            "sync_bytes": int(sync["nbytes"]),
+            "sync_oid_leaves": int(sync.get("oid_leaves", 0)),
+            "sync_s": sync["sync_s"],
+            "swap_drain_s": sync["drain_s"],
+            "phases_s": {k: round(v, 4) for k, v in phases.items()},
+            "trace_id": self.trace_id,
+        }
+        with self._lock:
+            self._iterations += 1
+            self._tokens_generated += result["tokens_generated"]
+            self._sync_bytes += result["sync_bytes"]
+            result["iteration"] = self._iterations
+            self._last = result
+        try:
+            iterations_total().inc()
+            tokens_generated_total().inc(result["tokens_generated"])
+            reward_mean_gauge().set(result["reward_mean"])
+            for phase, secs in phases.items():
+                phase_seconds().observe(secs, tags={"phase": phase})
+            weight_sync_bytes_total().inc(
+                result["sync_bytes"],
+                {"transport": result["sync_transport"]})
+            weight_sync_seconds().observe(result["sync_s"])
+        except Exception:  # noqa: BLE001 — telemetry never fails a round
+            pass
+        return result
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {"iterations": self._iterations,
+                    "tokens_generated": self._tokens_generated,
+                    "sync_bytes_total": self._sync_bytes,
+                    "trace_id": self.trace_id,
+                    "placement": self.group.describe(),
+                    "last": dict(self._last)}
+
+    def shutdown(self) -> None:
+        self.group.shutdown()

@@ -31,7 +31,7 @@ def algorithm_registry() -> Dict[str, type]:
         "MBMPO": rl.MBMPOConfig,
         "DQN": rl.DQNConfig, "APEXDQN": rl.ApexDQNConfig,
         "APEXDDPG": rl.ApexDDPGConfig,
-        "SIMPLEQ": rl.DQNConfig,
+        "SIMPLEQ": rl.SimpleQConfig,
         "SAC": rl.SACConfig,
         "DDPG": rl.DDPGConfig, "TD3": rl.TD3Config,
         "BC": rl.BCConfig, "MARWIL": rl.MARWILConfig,
@@ -59,10 +59,6 @@ def get_algorithm_config(run: str):
 
         cfg.algo_class = (rl.BanditLinTS if key == "BANDITLINTS"
                           else rl.BanditLinUCB)
-    elif key == "SIMPLEQ":
-        # reference SimpleQ = DQN without the DQN-paper add-ons
-        cfg.double_q = False
-        cfg.prioritized_replay = False
     return cfg
 
 

@@ -366,6 +366,8 @@ def cmd_serve(args: argparse.Namespace) -> int:
             for name, d in (meta.get("deployments") or {}).items():
                 s = d.get("stats") or {}
                 cb = (f"  slots {s['cb_active']}/{s['cb_slots']}"
+                      f"  tokens {s.get('cb_tokens_generated', 0)}"
+                      f"  completed {s.get('cb_requests_completed', 0)}"
                       if "cb_slots" in s else "")
                 print(f"  {name:<24} replicas {d.get('replicas', 0)}/"
                       f"{d.get('target', 0)}"
@@ -414,6 +416,8 @@ def cmd_rl(args: argparse.Namespace) -> int:
         for name in rl_train.list_tuned_examples():
             print(name)
         return 0
+    if args.rl_cmd == "rlhf":
+        return _run_rlhf(args)
     if args.rl_cmd == "train" and not args.run \
             and not getattr(args, "file", None):
         print("rt rl train: pass --run ALGO or -f TUNED_EXAMPLE",
@@ -458,6 +462,51 @@ def cmd_rl(args: argparse.Namespace) -> int:
         return 1
     finally:
         if owns_session:  # don't tear down a borrowed live session
+            ray_tpu.shutdown()
+
+
+def _run_rlhf(args: argparse.Namespace) -> int:
+    """rt rl rlhf: the end-to-end RLHF pipeline (placed policy /
+    reference / reward / generation roles, ContinuousEngine generate
+    phase, streamed weight sync) for N iterations, one JSON line per
+    iteration. The printed trace id replays the placement + phase story
+    through `rt trace <id>`."""
+    import json as _json
+
+    import ray_tpu
+    from ray_tpu.rl.rlhf import RLHFPipeline
+
+    owns_session = False
+    if args.address:
+        _attach_driver(args.address)
+        owns_session = True
+    elif not ray_tpu.is_initialized():
+        # a standalone session must be able to reserve the four
+        # one-CPU role bundles even on a small box (init()'s default
+        # CPU count is the machine's core count — 1 in CI)
+        ray_tpu.init(num_cpus=6)
+        owns_session = True
+    pipeline = None
+    try:
+        pipeline = RLHFPipeline(
+            preset=args.preset, num_prompts=args.prompts,
+            prompt_len=args.prompt_len, max_new_tokens=args.max_new,
+            max_slots=args.slots, seed=args.seed)
+        print(f"rlhf: roles placed "
+              f"({', '.join(r['role'] for r in pipeline.group.describe())})"
+              f"; trace {pipeline.trace_id}", flush=True)
+        for _ in range(args.iters):
+            print(_json.dumps(pipeline.run_iteration()), flush=True)
+        s = pipeline.stats()
+        print(f"rlhf: {s['iterations']} iteration(s), "
+              f"{s['tokens_generated']} tokens generated, "
+              f"{s['sync_bytes_total']} weight-sync bytes; "
+              f"rt trace {s['trace_id']} shows the placement story")
+        return 0
+    finally:
+        if pipeline is not None:
+            pipeline.shutdown()
+        if owns_session:
             ray_tpu.shutdown()
 
 
@@ -858,6 +907,21 @@ def main(argv=None) -> int:
     pr_eval.add_argument("--run", default=None)
     pr_eval.add_argument("--episodes", type=int, default=10)
     pr_eval.add_argument("--address", default=None)
+    pr_rlhf = rl_sub.add_parser(
+        "rlhf", help="run the end-to-end RLHF pipeline (placed roles, "
+                     "continuous-engine generation, streamed weight sync)")
+    pr_rlhf.add_argument("--address", default=None)
+    pr_rlhf.add_argument("--preset", default="debug",
+                         help="llama preset for all roles (default debug)")
+    pr_rlhf.add_argument("--iters", type=int, default=2)
+    pr_rlhf.add_argument("--prompts", type=int, default=4,
+                         help="sequences per iteration")
+    pr_rlhf.add_argument("--prompt-len", type=int, default=8)
+    pr_rlhf.add_argument("--max-new", type=int, default=16)
+    pr_rlhf.add_argument("--slots", type=int, default=4,
+                         help="generation engine decode slots")
+    pr_rlhf.add_argument("--seed", type=int, default=0)
+
     pr_ex = rl_sub.add_parser("examples",
                               help="list bundled tuned examples")
     pr_ex.add_argument("--address", default=None)

@@ -652,7 +652,8 @@ class ServeController:
         qps = 0.0
         window_s = 30.0
         lats: List[float] = []
-        cb = {"active": 0, "max_slots": 0, "pending": 0}
+        cb = {"active": 0, "max_slots": 0, "pending": 0,
+              "tokens_generated": 0, "requests_completed": 0}
         cb_seen = False
         if reps:
             refs = [r.handle.stats_window.remote(window_s) for r in reps]
@@ -690,6 +691,11 @@ class ServeController:
             win["cb_active"] = cb["active"]
             win["cb_slots"] = cb["max_slots"]
             win["cb_pending"] = cb["pending"]
+            # monotonic engine counters (summed over replicas): `rt
+            # serve status` and pollers difference these across windows
+            # instead of inferring load from instantaneous occupancy
+            win["cb_tokens_generated"] = cb["tokens_generated"]
+            win["cb_requests_completed"] = cb["requests_completed"]
         with self._lock:
             s.win_stats = win
             s.metrics.append((now, total_ongoing))

@@ -96,6 +96,94 @@ def _takes_config(fn: Callable) -> bool:
         return True
 
 
+class RoleGroup:
+    """A heterogeneous gang: one NAMED role actor per placement-group
+    bundle (the RLHF shape: policy learner / reference / reward /
+    generation engine placed together, reference arxiv 2312.11819's
+    adaptive placement).
+
+    Unlike :class:`WorkerGroup` (N identical ranks running one train
+    fn), each role brings its own actor class, resources and ctor args.
+    The group reserves ONE placement group shaped by the roles' bundles,
+    so the whole pipeline lands atomically (or not at all), and
+    ``describe()`` reports which bundle each role occupies — the
+    placement story ``rt trace`` shows when the creating driver runs
+    under a span (`RLHFPipeline` enables tracing around ``start()`` so
+    every ``<Role>.__init__`` + readiness ping becomes a span).
+    """
+
+    def __init__(self, name: str, strategy: str = "PACK"):
+        self.name = name
+        self.strategy = strategy
+        self.pg = None
+        self.actors: Dict[str, Any] = {}
+        self._roles: List[Dict[str, Any]] = []
+
+    def add_role(self, role: str, actor_cls: type, *args,
+                 num_cpus: float = 1, options: Optional[Dict] = None,
+                 **kwargs) -> "RoleGroup":
+        """Declare one role (call before ``start``); chainable."""
+        if any(r["role"] == role for r in self._roles):
+            raise ValueError(f"duplicate role {role!r}")
+        self._roles.append({"role": role, "cls": actor_cls, "args": args,
+                            "kwargs": kwargs, "num_cpus": num_cpus,
+                            "options": dict(options or {})})
+        return self
+
+    def start(self, timeout: float = 300) -> None:
+        if not self._roles:
+            raise ValueError("no roles declared")
+        bundles = [{"CPU": r["num_cpus"]} for r in self._roles]
+        self.pg = placement_group(bundles, strategy=self.strategy,
+                                  name=self.name)
+        if not self.pg.wait(timeout=timeout):
+            remove_placement_group(self.pg)
+            self.pg = None
+            raise TimeoutError(
+                f"role group {self.name!r}: could not reserve {bundles}")
+        try:
+            for i, r in enumerate(self._roles):
+                opts = dict(r["options"])
+                opts.setdefault("num_cpus", r["num_cpus"])
+                opts["scheduling_strategy"] = \
+                    PlacementGroupSchedulingStrategy(self.pg, i)
+                handle = ray_tpu.remote(r["cls"]).options(**opts).remote(
+                    *r["args"], **r["kwargs"])
+                self.actors[r["role"]] = handle
+            # readiness barrier: every role constructed (and its span
+            # recorded) before the pipeline starts issuing phases
+            ray_tpu.get([a.ping.remote() for a in self.actors.values()],
+                        timeout=timeout)
+        except BaseException:
+            self.shutdown()
+            raise
+
+    def __getitem__(self, role: str):
+        return self.actors[role]
+
+    def describe(self) -> List[Dict[str, Any]]:
+        """role -> bundle placement (the `rt trace` companion table)."""
+        return [{"role": r["role"], "bundle_index": i,
+                 "num_cpus": r["num_cpus"],
+                 "actor": type(r["cls"]).__name__
+                 if not isinstance(r["cls"], type) else r["cls"].__name__}
+                for i, r in enumerate(self._roles)]
+
+    def shutdown(self) -> None:
+        for handle in self.actors.values():
+            try:
+                ray_tpu.kill(handle)
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
+        self.actors = {}
+        if self.pg is not None:
+            try:
+                remove_placement_group(self.pg)
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
+            self.pg = None
+
+
 class WorkerGroup:
     def __init__(self, scaling: ScalingConfig, experiment_name: str):
         self.scaling = scaling

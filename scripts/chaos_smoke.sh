@@ -324,4 +324,47 @@ d = json.load(sys.stdin)
 assert d["exit_code"] == 0 and d["healthy"], d["findings"]
 print("doctor healthy after serve leg")
 '
+
+echo "== rlhf leg: weight sync survives rpc.drop on the oid-frame fetch =="
+# One full generate -> train -> weight-sync iteration with rpc.drop armed
+# against the push channel the generator fetches the shipped weights
+# over: the fetch must fall back to the reclaim RPC leaf-exact, the
+# engine swap must still land, and the iteration must complete.
+$RT chaos arm --site rpc.drop --target stream_push --at 1 --max-fires 1 --seed 11
+sleep 2.5  # plan rides the heartbeat to raylet + live workers
+python - <<'EOF'
+import ray_tpu
+from ray_tpu.rl.rlhf import RLHFPipeline
+
+ray_tpu.init(address="auto")
+p = RLHFPipeline(preset="debug", num_prompts=3, prompt_len=6,
+                 max_new_tokens=8, max_slots=2, decode_stride=2)
+try:
+    r = p.run_iteration()
+    print(f"rlhf iteration through the drop: reward={r['reward_mean']:.4f} "
+          f"sync_transport={r['sync_transport']} "
+          f"sync_bytes={r['sync_bytes']}")
+    assert r["tokens_generated"] == 3 * 8, r
+    assert r["sync_transport"] == "fallback", \
+        f"expected the armed drop to force the pull fallback: {r}"
+    eng = ray_tpu.get(p.group["generator"].engine_stats.remote())
+    assert eng["weight_swaps"] == 1, eng
+    print("rlhf leg OK: weights landed leaf-exact through the fallback, "
+          "drain-barrier swap applied")
+finally:
+    p.shutdown()
+    ray_tpu.shutdown()
+EOF
+$RT chaos disarm
+$RT errors --origin chaos | grep -q "rpc.drop" \
+    || { echo "FAIL: rlhf-leg rpc.drop not on the chaos feed"; exit 1; }
+
+echo "== doctor must exit 0 after the rlhf leg drains =="
+sleep 3
+$RT doctor --window 2 --json | python -c '
+import json, sys
+d = json.load(sys.stdin)
+assert d["exit_code"] == 0 and d["healthy"], d["findings"]
+print("doctor healthy after rlhf leg")
+'
 echo "chaos smoke OK"

@@ -1,0 +1,276 @@
+"""RLHF subsystem + Anakin fused rollouts (rl/anakin.py, rl/rlhf/,
+collective.ship_params, ContinuousEngine.load_params).
+
+Covers: pure-JAX env dynamics parity with the host env, single-launch
+fusion of the Anakin iteration (compile-count), fused-vs-host rollout
+reward parity on fixed seeds, the drain-barrier weight swap staying
+token-exact mid-serve, ship_params/fetch_params leaf-exact over push AND
+through the chaos-armed pull fallback, and one end-to-end RLHF iteration
+on CPU (placed roles, ContinuousEngine generate, streamed sync).
+
+Named test_zz_* so it sorts late (tier-1, `-m 'not slow'`-safe).
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.util import chaos
+
+
+@pytest.fixture
+def cluster():
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=6)
+    yield ray_tpu
+    try:
+        chaos.disarm()
+    finally:
+        ray_tpu.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Anakin leg
+# ---------------------------------------------------------------------------
+
+
+def test_jax_env_matches_host_dynamics():
+    """JaxCartPole.step applies the SAME dynamics as the numpy CartPole:
+    identical initial states + identical actions -> identical
+    trajectories (up to fp32/fp64) until the first auto-reset."""
+    import jax
+
+    from ray_tpu.rl.env import CartPole
+    from ray_tpu.rl.jax_env import JaxCartPole
+
+    n = 8
+    host = CartPole(n, seed=3)
+    host_obs = host.reset()
+    state = JaxCartPole.from_host_state(host._state.copy(),
+                                        jax.random.key(0))
+    rng = np.random.default_rng(7)
+    compared = 0
+    for t in range(60):
+        actions = rng.integers(0, 2, size=n)
+        state, obs, rew, done = JaxCartPole.step_batch(
+            state, np.asarray(actions, np.int32))
+        h_obs, h_rew, h_done = host.step(actions)
+        np.testing.assert_array_equal(np.asarray(done), h_done)
+        np.testing.assert_allclose(np.asarray(rew), h_rew)
+        if h_done.any():
+            # past the first reset the two RNGs diverge by design:
+            # compare only the still-running envs this step, then stop
+            live = ~h_done
+            np.testing.assert_allclose(np.asarray(obs)[live],
+                                       h_obs[live], atol=1e-4)
+            compared = t + 1
+            break
+        np.testing.assert_allclose(np.asarray(obs), h_obs, atol=1e-4)
+        compared = t + 1
+    assert compared >= 10, f"only {compared} comparable steps"
+
+
+def test_anakin_single_launch_fusion():
+    """The whole iteration (rollout -> GAE -> update) is ONE compiled
+    program: the jit cache holds exactly one entry no matter how many
+    iterations run."""
+    from ray_tpu.rl.anakin import AnakinRunner
+
+    r = AnakinRunner(num_envs=8, rollout_len=8, num_epochs=1)
+    m1 = r.train(3)
+    assert r.compile_count() == 1, r.compile_count()
+    m2 = r.train(2)
+    assert r.compile_count() == 1, r.compile_count()
+    assert m2["env_steps_total"] == 5 * 8 * 8
+    assert np.isfinite(m1["loss"]) and np.isfinite(m2["loss"])
+
+
+def test_anakin_fused_vs_host_reward_parity():
+    """Fixed seeds: the fused rollout sees the same environment the host
+    loop does — reward per step identical (CartPole pays +1/step) and
+    the episode-termination RATE agrees within sampling tolerance (the
+    two implementations draw different RNG streams, so exact trajectory
+    equality is not expected — the dynamics-parity test covers that)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.rl import models
+    from ray_tpu.rl.anakin import AnakinRunner
+    from ray_tpu.rl.env_runner import EnvRunner
+
+    B, T = 64, 64
+    r = AnakinRunner(num_envs=B, rollout_len=T, num_epochs=1, seed=5)
+    fused = r.train(4)
+    assert fused["reward_mean_per_step"] == 1.0
+    fused_done_rate = fused["episodes_done"] / (B * T)
+
+    host_cls = getattr(EnvRunner, "_cls", EnvRunner)
+    host = host_cls("CartPole-v1", B, T, seed=5)
+    params = jax.tree_util.tree_map(
+        jnp.asarray, models.init_policy(jax.random.key(5), host.spec))
+    done_total = 0
+    for _ in range(4):
+        frag = host.sample(params)
+        done_total += int(frag["dones"].sum())
+    host_done_rate = done_total / (4 * B * T)
+    assert host_done_rate > 0 and fused_done_rate > 0
+    ratio = fused_done_rate / host_done_rate
+    assert 0.5 < ratio < 2.0, (
+        f"fused done-rate {fused_done_rate:.4f} vs host "
+        f"{host_done_rate:.4f} (ratio {ratio:.2f})")
+
+
+# ---------------------------------------------------------------------------
+# weight plane
+# ---------------------------------------------------------------------------
+
+
+def test_weight_swap_mid_serve_token_exact():
+    """load_params mid-serve: in-flight requests finish EXACTLY as the
+    old weights' generate() would, post-swap requests exactly as the
+    new weights' — the drain barrier never mixes weights within one
+    request's KV."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models import generate as G
+    from ray_tpu.models import llama
+    from ray_tpu.models.serving import ContinuousEngine
+
+    cfg = llama.PRESETS["debug"]
+    pa = llama.init_params(jax.random.key(0), cfg)
+    pb = llama.init_params(jax.random.key(1), cfg)
+    eng = ContinuousEngine(pa, cfg, max_slots=4, max_len=64,
+                           decode_stride=4)
+    try:
+        prompt = np.arange(1, 9, dtype=np.int32)
+        q1 = eng.submit_stream(prompt, 24)
+        time.sleep(0.05)  # let decoding start before the swap queues
+        swap = eng.load_params(pb, timeout_s=120)
+        assert swap["weight_swaps"] == 1
+        q2 = eng.submit_stream(prompt, 24)
+        t1 = list(iter(q1.get, None))
+        t2 = list(iter(q2.get, None))
+        ga = np.asarray(G.generate(pa, jnp.asarray(prompt)[None, :], cfg,
+                                   max_new_tokens=24))[0].tolist()
+        gb = np.asarray(G.generate(pb, jnp.asarray(prompt)[None, :], cfg,
+                                   max_new_tokens=24))[0].tolist()
+        assert t1 == ga, "pre-swap stream not token-exact on OLD weights"
+        assert t2 == gb, "post-swap stream not token-exact on NEW weights"
+        st = eng.stats()
+        assert st["weight_swaps"] == 1
+        assert st["requests_completed"] == 2
+        assert st["tokens_generated"] == 48
+        # the two param sets genuinely differ (the assertion above would
+        # be vacuous otherwise)
+        assert t1 != t2
+    finally:
+        eng.shutdown()
+
+
+def test_ship_params_roundtrip_and_chaos_fallback(cluster):
+    """ship_params -> fetch_params is leaf-exact over push frames (large
+    leaves as plasma oids), and stays leaf-exact through the pull
+    fallback when chaos breaks the push channel mid-shipment."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu import collective
+
+    def tree_equal(a, b):
+        return jax.tree_util.tree_all(jax.tree_util.tree_map(
+            lambda x, y: np.array_equal(np.asarray(x), np.asarray(y)),
+            a, b))
+
+    params = {"w": jnp.arange(200 * 1024, dtype=jnp.float32),
+              "layers": {"b": jnp.ones((17,)), "n": jnp.int32(7)},
+              "scalars": [jnp.float32(1.5), jnp.zeros((3, 3))]}
+    ticket = collective.ship_params(params)
+    assert ticket["nbytes"] > 200 * 1024 * 4
+    got, info = collective.fetch_params(ticket)
+    assert info["transport"] == "push"
+    assert tree_equal(params, got)
+
+    # chaos: break the push channel on the very first take -> the
+    # reclaim RPC must hand over every leaf, exactly
+    ticket2 = collective.ship_params(params)
+    chaos.arm('{"seed": 1, "faults": [{"site": "rpc.drop", '
+              '"target": "stream_push", "at": 1, "max_fires": 1}]}')
+    try:
+        got2, info2 = collective.fetch_params(ticket2)
+    finally:
+        chaos.disarm()
+    assert info2["transport"] == "fallback"
+    assert tree_equal(params, got2)
+
+    # a redeemed ticket is spent
+    with pytest.raises(RuntimeError):
+        collective.fetch_params(ticket)
+
+
+# ---------------------------------------------------------------------------
+# the pipeline, end to end
+# ---------------------------------------------------------------------------
+
+
+def test_rlhf_end_to_end_iteration(cluster):
+    """One full generate -> score -> update -> sync round on CPU: roles
+    placed one-per-bundle, generation through ContinuousEngine slots,
+    weights shipped over the stream plane, rt_rlhf_* series advancing,
+    and the whole story under one trace id."""
+    from ray_tpu.rl.rlhf import RLHFPipeline
+    from ray_tpu.util import metrics
+    from ray_tpu.util.metrics import metrics_text
+    from ray_tpu.util import tracing
+
+    p = RLHFPipeline(preset="debug", num_prompts=3, prompt_len=6,
+                     max_new_tokens=8, max_slots=2, decode_stride=2)
+    try:
+        r = p.run_iteration()
+        assert r["iteration"] == 1
+        assert r["tokens_generated"] == 3 * 8
+        assert np.isfinite(r["reward_mean"]) and np.isfinite(r["loss"])
+        assert r["sync_bytes"] > 0
+        assert r["sync_transport"] in ("push", "fallback", "pull")
+        assert set(r["phases_s"]) == {"generate", "score", "update",
+                                      "sync"}
+
+        eng = ray_tpu.get(p.group["generator"].engine_stats.remote())
+        assert eng["tokens_generated"] == 3 * 8
+        assert eng["requests_completed"] == 3
+        assert eng["weight_swaps"] == 1
+
+        st = p.stats()
+        assert [row["role"] for row in st["placement"]] == [
+            "learner", "reference", "reward", "generator"]
+
+        # the trace shows the story: placement pings + phase hops
+        spans = tracing.get_trace(p.trace_id)
+        names = {s.get("name") for s in spans}
+        assert any("generate" in (n or "") for n in names), names
+        assert any("sync_weights" in (n or "") for n in names), names
+
+        metrics.flush_now()
+        text = metrics_text()
+        assert "rt_rlhf_iterations_total" in text
+        assert "rt_rlhf_weight_sync_bytes_total" in text
+    finally:
+        p.shutdown()
+
+
+def test_simpleq_is_a_real_algorithm():
+    """SIMPLEQ resolves to its own config + algorithm class (not a
+    silently-aliased DQNConfig), still stripped of the DQN add-ons."""
+    from ray_tpu.rl.train import algorithm_registry, get_algorithm_config
+
+    assert algorithm_registry()["SIMPLEQ"].__name__ == "SimpleQConfig"
+    cfg = get_algorithm_config("SIMPLEQ")
+    assert type(cfg).__name__ == "SimpleQConfig"
+    assert cfg.algo_class.__name__ == "SimpleQ"
+    assert cfg.double_q is False and cfg.prioritized_replay is False
+    # DQN itself is untouched
+    dqn = get_algorithm_config("DQN")
+    assert type(dqn).__name__ == "DQNConfig" and dqn.double_q is True
