@@ -22,6 +22,10 @@ _EXPORTS = {
     "make_train_step": "ray_tpu.parallel.train_step",
     "make_multi_step": "ray_tpu.parallel.train_step",
     "shard_batch": "ray_tpu.parallel.train_step",
+    "supports_multi_step": "ray_tpu.parallel.train_step",
+    "Plan": "ray_tpu.parallel.plan",
+    "compile_plan": "ray_tpu.parallel.plan",
+    "compile_step": "ray_tpu.parallel.plan",
 }
 
 __all__ = list(_EXPORTS)

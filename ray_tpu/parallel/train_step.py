@@ -16,10 +16,11 @@ from typing import Any, Callable, Dict, Optional, Tuple
 import jax
 import jax.numpy as jnp
 import optax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh
 
 from ray_tpu.models import llama
 from ray_tpu.parallel.mesh import MeshConfig, make_mesh
+from ray_tpu.parallel.plan import Plan, compile_plan, compile_step, placement_plan
 from ray_tpu.parallel.sharding import ShardingRules
 from ray_tpu.util import step_profiler
 
@@ -65,15 +66,19 @@ def init_sharded_state(rng: jax.Array, cfg: llama.LlamaConfig, mesh: Mesh,
 def make_train_step(cfg: llama.LlamaConfig,
                     optimizer: optax.GradientTransformation,
                     loss_fn: Callable = None,
-                    mesh: Optional[Mesh] = None) -> Callable:
+                    mesh: Optional[Mesh] = None,
+                    plan: Optional[Plan] = None) -> Callable:
     """(params, opt_state, batch) -> (params, opt_state, metrics), donated.
 
     ``mesh`` makes itself ambient during tracing (``context.mesh_scope``) so
     model-internal shard_map regions (ring attention, pipeline stages) can
-    find it.
+    find it. With a mesh (or an explicit ``plan``), the step compiles
+    through the sharding :class:`Plan` — pjit with pinned in/out shardings
+    for pure-GSPMD bodies, the shard_map fallback for manual-region bodies
+    — instead of re-deriving placement per call site.
     """
-    use_1f1b = (getattr(cfg, "pipeline_axis", None) is not None
-                and getattr(cfg, "pipeline_schedule", "gpipe") == "1f1b")
+    custom_loss = loss_fn is not None
+    use_1f1b = not supports_multi_step(cfg)
     if use_1f1b:
         if loss_fn is not None:
             raise ValueError("1f1b computes its own loss inside the "
@@ -97,8 +102,37 @@ def make_train_step(cfg: llama.LlamaConfig,
         gnorm = optax.global_norm(grads)
         return params, opt_state, {"loss": loss, "grad_norm": gnorm}
 
-    jstep = jax.jit(step, donate_argnums=(0, 1))
-    return _instrumented(jstep, cfg, mesh)
+    if plan is None and mesh is not None:
+        plan = compile_plan(cfg, mesh)
+    jstep = compile_step(step, plan,
+                         **_plan_shardings(plan, optimizer, custom_loss,
+                                           stacked=False))
+    return _instrumented(jstep, cfg, mesh, plan=plan)
+
+
+def _plan_shardings(plan: Optional[Plan], optimizer, custom_loss: bool,
+                    stacked: bool) -> Dict[str, Any]:
+    """compile_step kwargs for the pjit path: explicit state shardings from
+    the plan, the batch pinned by a prefix sharding (batch dim over
+    (dp, fsdp) — the same placement ``shard_batch`` applies), metrics
+    replicated. A custom loss_fn may train custom params the family rules
+    don't describe, so it stays on sharding inference."""
+    from ray_tpu.parallel.plan import PJIT
+
+    if plan is None or plan.mode != PJIT or custom_loss:
+        return {}
+    params_sh, opt_sh = plan.state_shardings(optimizer)
+    batch_sh = plan.batch_sharding(2, False, stacked)
+    return {"in_shardings": (params_sh, opt_sh, batch_sh),
+            "out_shardings": (params_sh, opt_sh, plan.replicated())}
+
+
+def supports_multi_step(cfg) -> bool:
+    """Whether ``make_multi_step`` can fuse K steps for this config — the
+    1f1b schedule's manual interleave cannot ride a ``lax.scan`` carry, so
+    fused drivers must degrade to single-step there."""
+    return not (getattr(cfg, "pipeline_axis", None) is not None
+                and getattr(cfg, "pipeline_schedule", "gpipe") == "1f1b")
 
 
 def _batch_tokens(batch, stacked: bool = False) -> Tuple[int, int]:
@@ -125,7 +159,8 @@ def _batch_tokens(batch, stacked: bool = False) -> Tuple[int, int]:
 _PROGRAM_IDS = __import__("itertools").count()
 
 
-def _instrumented(jstep, cfg, mesh, stacked: bool = False):
+def _instrumented(jstep, cfg, mesh, stacked: bool = False,
+                  steps_per_launch: int = 1, plan: Optional[Plan] = None):
     """The (params, opt_state, batch) entry point every trainer calls:
     ambient-mesh plumbing plus the step profiler's per-step record (wall /
     compile / dispatch / device-sync split, analytic MFU). Disabled
@@ -151,8 +186,13 @@ def _instrumented(jstep, cfg, mesh, stacked: bool = False):
         return step_profiler.profiled_call(
             "train", call, (params, opt_state, batch),
             key=("train", program_id), tokens=tokens,
+            steps=steps_per_launch,
             flops=tokens * F.train_flops_per_token(cfg, seq))
 
+    # the compiled program and plan ride along so drivers can assert
+    # single-launch fusion via the jit cache and reuse the placement plan
+    run._jit = jstep
+    run._plan = plan
     return run
 
 
@@ -160,7 +200,8 @@ def make_multi_step(cfg: llama.LlamaConfig,
                     optimizer: optax.GradientTransformation,
                     n_steps: int,
                     loss_fn: Callable = None,
-                    mesh: Optional[Mesh] = None) -> Callable:
+                    mesh: Optional[Mesh] = None,
+                    plan: Optional[Plan] = None) -> Callable:
     """K train steps fused into ONE compiled program via ``lax.scan``.
 
     (params, opt_state, batches) -> (params, opt_state, metrics) where each
@@ -176,10 +217,11 @@ def make_multi_step(cfg: llama.LlamaConfig,
     Works under any mesh: the scanned body is the same sharded step GSPMD
     already compiles.
     """
-    if getattr(cfg, "pipeline_axis", None) is not None and \
-            getattr(cfg, "pipeline_schedule", "gpipe") == "1f1b":
+    if not supports_multi_step(cfg):
         raise NotImplementedError("multi-step scan over the 1f1b schedule "
-                                  "is unsupported; use gpipe or single-step")
+                                  "is unsupported; use gpipe or single-step "
+                                  "(StepDriver degrades automatically)")
+    custom_loss = loss_fn is not None
     loss_fn = loss_fn or model_family(cfg).lm_loss
 
     def body(carry, batch):
@@ -196,8 +238,13 @@ def make_multi_step(cfg: llama.LlamaConfig,
             body, (params, opt_state), batches, length=n_steps)
         return params, opt_state, metrics
 
-    jsteps = jax.jit(steps, donate_argnums=(0, 1))
-    return _instrumented(jsteps, cfg, mesh, stacked=True)
+    if plan is None and mesh is not None:
+        plan = compile_plan(cfg, mesh)
+    jsteps = compile_step(steps, plan,
+                          **_plan_shardings(plan, optimizer, custom_loss,
+                                            stacked=True))
+    return _instrumented(jsteps, cfg, mesh, stacked=True,
+                         steps_per_launch=n_steps, plan=plan)
 
 
 def shard_batch(batch: Dict[str, jax.Array], mesh: Mesh,
@@ -205,22 +252,14 @@ def shard_batch(batch: Dict[str, jax.Array], mesh: Mesh,
     """Place a host batch onto the mesh: batch dim over (dp, fsdp), sequence
     over sp when the mesh has a non-trivial sp axis (context parallelism).
     ``stacked=True`` handles multi-step batches [K, B, ...] (make_multi_step):
-    the leading step axis stays replicated, batch/seq shard as usual."""
-    sp = mesh.shape.get("sp", 1)
-    lead = (None,) if stacked else ()
-    bdim = 1 if stacked else 0
+    the leading step axis stays replicated, batch/seq shard as usual.
 
-    def place(x):
-        # Sequence rides sp only when it divides evenly (token batches are
-        # [B, S+1] — odd — so they stay seq-replicated; GSPMD re-shards the
-        # [B, S] slice at the shard_map boundary).
-        if x.ndim >= bdim + 2 and sp > 1 and x.shape[bdim + 1] % sp == 0:
-            spec = P(*lead, ("dp", "fsdp"), "sp")
-        else:
-            spec = P(*lead, ("dp", "fsdp"))
-        return jax.device_put(x, NamedSharding(mesh, spec))
-
-    return jax.tree.map(place, batch)
+    Delegates to the per-mesh cached :class:`Plan` (``plan.placement_plan``)
+    so the NamedShardings are derived once per mesh, not per call.
+    Sequence rides sp only when it divides evenly (token batches are
+    [B, S+1] — odd — so they stay seq-replicated; GSPMD re-shards the
+    [B, S] slice at the shard_map boundary)."""
+    return placement_plan(mesh).place_batch(batch, stacked=stacked)
 
 
 def auto_mesh(n_devices: int, devices=None, *, tp: Optional[int] = None,

@@ -12,14 +12,17 @@ checkpoints, and restarts the gang on failure.
 from ray_tpu.train.config import (  # noqa: F401
     CheckpointConfig,
     FailureConfig,
+    FastPathConfig,
     RunConfig,
     ScalingConfig,
 )
 from ray_tpu.train.checkpoint import Checkpoint  # noqa: F401
+from ray_tpu.train.driver import StepDriver  # noqa: F401
 from ray_tpu.train.session import (  # noqa: F401
     get_checkpoint,
     get_context,
     get_dataset_shard,
+    get_fast_path,
     report,
 )
 from ray_tpu.train.trainer import (  # noqa: F401

@@ -52,6 +52,32 @@ class ScalingConfig:
 
 
 @dataclasses.dataclass
+class FastPathConfig:
+    """The fused-K training fast path (ROADMAP item 2).
+
+    ``steps_per_launch`` is the launch-amortization knob: the
+    :class:`~ray_tpu.train.driver.StepDriver` stacks K data-plane batches
+    and runs ONE compiled launch per K optimizer steps
+    (``parallel/train_step.make_multi_step``'s ``lax.scan``), degrading to
+    single-step for the 1f1b pipeline schedule and for ragged tail
+    batches. ``async_report`` / ``async_checkpoint`` keep ``session.report``
+    metric coercion and checkpoint serialization on the session's drainer
+    thread instead of the step loop; ``prefetch_batches`` bounds the data
+    plane's lookahead (host pull + device put ahead of the consuming step).
+    """
+
+    steps_per_launch: int = 1
+    prefetch_batches: int = 2
+    async_report: bool = True
+    async_checkpoint: bool = True
+
+    def __post_init__(self):
+        if self.steps_per_launch < 1:
+            raise ValueError(
+                f"steps_per_launch must be >= 1, got {self.steps_per_launch}")
+
+
+@dataclasses.dataclass
 class FailureConfig:
     max_failures: int = 0  # gang restarts from last checkpoint
 
@@ -69,6 +95,7 @@ class RunConfig:
     storage_path: Optional[str] = None  # default: /tmp/ray_tpu_results
     failure_config: FailureConfig = dataclasses.field(default_factory=FailureConfig)
     checkpoint_config: CheckpointConfig = dataclasses.field(default_factory=CheckpointConfig)
+    fast_path: FastPathConfig = dataclasses.field(default_factory=FastPathConfig)
     # Tune stop criteria: {"training_iteration": N, "<metric>": value} or
     # callable(trial_id, result) -> bool (reference: air.RunConfig.stop)
     stop: Optional[Any] = None

@@ -118,7 +118,8 @@ class JaxTrainer:
                 ray_tpu.get([
                     w.start.remote(self.train_fn, self.train_config,
                                    latest_checkpoint,
-                                   self._shard_datasets(i, n))
+                                   self._shard_datasets(i, n),
+                                   self.run_config.fast_path)
                     for i, w in enumerate(group.workers)], timeout=300)
                 error = self._drain_results(group, manager, metrics_history)
                 if error is None:

@@ -51,11 +51,13 @@ class TrainWorker:
 
     def start(self, train_fn: Callable, config: Dict[str, Any],
               checkpoint: Optional[Checkpoint],
-              dataset_shards: Optional[Dict[str, Any]]) -> None:
+              dataset_shards: Optional[Dict[str, Any]],
+              fast_path=None) -> None:
         ctx = TrainContext(self.rank, self.world_size,
                            experiment_name=self.experiment_name)
         self.session = TrainSession(ctx, checkpoint=checkpoint,
-                                    dataset_shards=dataset_shards)
+                                    dataset_shards=dataset_shards,
+                                    fast_path=fast_path)
         session_mod.init_session(self.session)
 
         def run():

@@ -111,6 +111,10 @@ class StepRecord:
     first_call: bool
     meta: Dict[str, Any]
     hbm_peak_bytes: int = 0  # max per-device peak HBM (0 = no accounting)
+    # optimizer/model steps this record covers: a fused-K train launch has
+    # launches=1, steps=K — the per-launch vs per-step attribution the
+    # launch-amortization summary divides by
+    steps: int = 1
 
     def to_dict(self) -> Dict[str, Any]:
         return dataclasses.asdict(self)
@@ -171,6 +175,7 @@ def record(kind: str, *, name: str = "", t_start: Optional[float] = None,
            wall_s: float, compile_s: float = 0.0, dispatch_s: float = 0.0,
            execute_s: float = 0.0, launches: int = 1, tokens: int = 0,
            flops: float = 0.0, first_call: bool = False,
+           steps: int = 1,
            meta: Optional[Dict[str, Any]] = None) -> "StepRecord":
     """Append one step record (hot paths that time themselves — the serve
     replica — call this directly; JAX steps go through ``profiled_call``)."""
@@ -197,7 +202,7 @@ def record(kind: str, *, name: str = "", t_start: Optional[float] = None,
             execute_s=execute_s, launches=launches, tokens=tokens,
             flops=flops, tokens_per_s=tok_s, mfu=mfu,
             first_call=first_call, meta=dict(meta or {}),
-            hbm_peak_bytes=hbm_peak)
+            hbm_peak_bytes=hbm_peak, steps=max(1, steps))
         _records.append(rec)
     _observe_metrics(rec)
     _ensure_drainer()
@@ -206,7 +211,7 @@ def record(kind: str, *, name: str = "", t_start: Optional[float] = None,
 
 def profiled_call(kind: str, fn, args: Tuple = (), kwargs=None, *,
                   key: Any = None, name: str = "", tokens: int = 0,
-                  flops: float = 0.0, launches: int = 1,
+                  flops: float = 0.0, launches: int = 1, steps: int = 1,
                   meta: Optional[Dict[str, Any]] = None):
     """Run ``fn(*args, **kwargs)`` as one profiled step.
 
@@ -245,7 +250,7 @@ def profiled_call(kind: str, fn, args: Tuple = (), kwargs=None, *,
            compile_s=(t1 - t0) if first else 0.0,
            dispatch_s=0.0 if first else (t1 - t0),
            execute_s=t2 - t1, launches=launches, tokens=tokens,
-           flops=flops, first_call=first, meta=meta)
+           flops=flops, first_call=first, steps=steps, meta=meta)
     return out
 
 
@@ -266,13 +271,22 @@ def summary(kind: Optional[str] = None) -> Dict[str, Any]:
     steady = [r for r in rs if not r.first_call] or rs
     n = len(steady)
     wall = sum(r.wall_s for r in steady)
+    launches = sum(r.launches for r in rs)
+    steps = sum(getattr(r, "steps", 1) for r in rs)
     return {
         "records": len(rs),
         "compile_s": sum(r.compile_s for r in rs),
         "mean_wall_s": wall / n,
         "mean_dispatch_s": sum(r.dispatch_s for r in steady) / n,
         "mean_execute_s": sum(r.execute_s for r in steady) / n,
-        "launches": sum(r.launches for r in rs),
+        "launches": launches,
+        "steps": steps,
+        # fused-K attribution: how many optimizer steps each device launch
+        # amortizes, and the true per-STEP wall once fused (mean_wall_s is
+        # per RECORD — one launch — so divide by the fusion factor)
+        "mean_steps_per_launch": steps / max(1, launches),
+        "per_step_wall_s": (wall / sum(getattr(r, "steps", 1)
+                                       for r in steady)) if n else 0.0,
         "tokens": sum(r.tokens for r in rs),
         "tokens_per_s": (sum(r.tokens for r in steady) / wall
                          if wall > 0 else 0.0),

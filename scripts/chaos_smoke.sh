@@ -62,6 +62,97 @@ assert d["exit_code"] == 0 and d["healthy"], d["findings"]
 print("doctor healthy:", [f["message"] for f in d["findings"]])
 '
 
+echo "== train leg: fused-K gang restart recovers from the last FENCED checkpoint =="
+# Arm worker.kill against the gang worker's next_result entry: the actor
+# dies while its training thread runs fused-K launches; JaxTrainer's
+# drain sees the death and FailureConfig restarts the gang from the last
+# checkpoint the async-save FENCE acked into the CheckpointManager (an
+# unfinished orbax save must never be a recovery source — load_pytree on
+# a partial dir would fail the resume). at=5 → 4 launches ack per
+# attempt, so the run makes progress through repeated kills (the plan
+# re-arms in each restarted worker process).
+$RT chaos arm --site worker.kill --target next_result --at 5 --max-fires 1 --seed 5
+sleep 2.5  # the plan rides the next heartbeat to raylet + live workers
+python - <<'EOF'
+import ray_tpu
+from ray_tpu.train import (FailureConfig, FastPathConfig, JaxTrainer,
+                           RunConfig, ScalingConfig)
+
+ray_tpu.init(address="auto")
+
+
+def loop(config):
+    import jax
+    import numpy as np
+
+    from ray_tpu import train
+    from ray_tpu.models import llama
+    from ray_tpu.parallel import train_step as ts
+    from ray_tpu.parallel.mesh import MeshConfig, make_mesh
+    from ray_tpu.train.checkpoint import Checkpoint
+    from ray_tpu.train.driver import StepDriver
+
+    K, total, batch, seq = 4, 8, 2, 32
+    cfg = llama.PRESETS["debug"]
+    mesh = make_mesh(MeshConfig(), jax.devices())
+    opt = ts.default_optimizer(total_steps=1000)
+    params, opt_state = ts.init_sharded_state(jax.random.key(0), cfg,
+                                              mesh, opt)
+    start = 0
+    ck = train.get_checkpoint()
+    if ck is not None:
+        start = ck.to_dict()["launch"] + 1
+        abstract = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                           sharding=x.sharding), params)
+        # a partial (unfenced) orbax dir would fail right here — restoring
+        # proves the manager only ever acked completed saves
+        params = ck.load_pytree("state", abstract)
+    driver = StepDriver(cfg, opt, mesh=mesh, steps_per_launch=K)
+    rng = np.random.default_rng(start)
+    for launch in range(start, total):
+        batches = ({"tokens": rng.integers(
+            0, cfg.vocab_size, (batch, seq + 1)).astype(np.int32)}
+            for _ in range(K))
+        params, opt_state, m = driver.run(params, opt_state, batches)
+        ckpt = Checkpoint.from_dict({"launch": launch})
+        ckpt.save_pytree(driver.state[0], "state", blocking=False)
+        train.report({"launch": launch, "loss": m["loss"][-1],
+                      "resumed_from": start}, checkpoint=ckpt)
+    train.report({"launches_done": total, "resumed_from": start,
+                  "complete": True})
+
+
+result = JaxTrainer(
+    loop,
+    scaling_config=ScalingConfig(num_workers=1, cpus_per_worker=1),
+    run_config=RunConfig(
+        name="chaos-train-fast",
+        failure_config=FailureConfig(max_failures=2),
+        fast_path=FastPathConfig(steps_per_launch=4)),
+).fit()
+assert result.error is None, result.error
+assert result.metrics.get("complete") is True, result.metrics
+assert result.metrics["resumed_from"] > 0, \
+    f"no restart-resume happened: {result.metrics}"
+print(f"train leg OK: fused-K run completed through the kills, "
+      f"final attempt resumed at launch {result.metrics['resumed_from']} "
+      f"from a fenced checkpoint")
+ray_tpu.shutdown()
+EOF
+$RT chaos disarm
+$RT errors --origin chaos | grep -q "worker.kill" \
+    || { echo "FAIL: train-leg worker.kill not on the chaos feed"; exit 1; }
+
+echo "== doctor must exit 0 after the train leg drains =="
+sleep 3
+$RT doctor --window 2 --json | python -c '
+import json, sys
+d = json.load(sys.stdin)
+assert d["exit_code"] == 0 and d["healthy"], d["findings"]
+print("doctor healthy after train leg")
+'
+
 echo "== overload leg: probe under a deep flood (fair dispatch) =="
 # Flood one scheduling class, then submit a 1-task probe in ANOTHER class:
 # round-robin dispatch must answer it in < 1 s instead of making it wait
