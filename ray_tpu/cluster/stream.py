@@ -29,6 +29,7 @@ from __future__ import annotations
 import asyncio
 import os
 import threading
+import time
 from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -48,8 +49,9 @@ from ray_tpu.util import metrics as M
 
 __all__ = [
     "register_source", "unregister_source", "reclaim", "drain_source",
-    "push_enabled", "subscribe", "take_decoded", "handle_subscribe",
-    "stream_window", "observe_request_rpcs", "count_pull_frames",
+    "settle_source", "peek_unacked", "push_enabled", "subscribe",
+    "take_decoded", "handle_subscribe", "stream_window",
+    "observe_request_rpcs", "count_pull_frames",
 ]
 
 _PUMP_BATCH = 64
@@ -233,6 +235,62 @@ async def drain_source(sid: str, delivered: int
     return (items, True, None)
 
 
+async def settle_source(sid: str, grace_s: float = 5.0
+                        ) -> Optional[List[Any]]:
+    """Producer-side settlement for FINITE pushed streams (the
+    streaming-generator path): wait for the pump to land and (briefly)
+    for the consumer's final credit. Returns None when the stream
+    COMPLETED over push (every item acked — nothing left to do), else
+    the unacked tail items to redeliver over the legacy acked path
+    (redelivery is idempotent there: the owner stores by index). Always
+    deregisters the source on the non-completed path. Runs on the
+    producer's event loop."""
+    with _reg_lock:
+        rs = _sources.get(sid)
+    if rs is None:
+        return None  # already completed (on_done popped it)
+    binding = rs.binding
+    if binding is None:
+        rt_items = []  # registered but never subscribed: nothing pushed
+        unregister_source(sid)
+        return rt_items
+    await binding.wait_finished()
+    deadline = asyncio.get_running_loop().time() + grace_s
+    while (not binding.completed and binding.source_done
+           and binding.conn.alive and not binding._stop
+           and asyncio.get_running_loop().time() < deadline):
+        await asyncio.sleep(0.02)
+    if binding.completed:
+        return None
+    tail = [it for seq, it in binding.replay if seq >= binding.acked]
+    unregister_source(sid)
+    return tail
+
+
+def peek_unacked(sid: str) -> List[Any]:
+    """Producer-THREAD escape hatch for a wedged event loop: a racy
+    snapshot of ``sid``'s pushed-but-unacked replay items. The replay
+    deque is loop-confined, so reading it off-loop can at worst observe
+    a stale acked watermark and over-return — safe for the generator
+    path, where redelivery is idempotent by index; dropping a
+    pushed-but-unacked item would hole the stream instead."""
+    with _reg_lock:
+        rs = _sources.get(sid)
+    binding = rs.binding if rs is not None else None
+    if binding is None:
+        return []
+    for _ in range(50):
+        try:
+            acked = binding.acked
+            return [it for seq, it in list(binding.replay) if seq >= acked]
+        except RuntimeError:
+            # deque mutated mid-snapshot (loop overloaded, not dead):
+            # retry — raising here would fail the very task this
+            # fallback exists to rescue
+            time.sleep(0.01)
+    return []
+
+
 class _PushBinding:
     """Producer half of one subscribed channel: the pump task, the
     credit window, and the replay buffer fallback reclaims from.
@@ -284,16 +342,20 @@ class _PushBinding:
             self._stop = True
             if self.source_done and self.acked >= self.sent:
                 self._complete()
+            else:
+                self._notify_pump_stop()
         self._credit_event.set()
 
     def on_disconnect(self) -> None:
         self._stop = True
+        self._notify_pump_stop()
         self._credit_event.set()
 
     def request_stop(self) -> None:
         """Safe from any thread (cancel_stream runs on executor threads):
         the event wakeup is routed to the producer's loop."""
         self._stop = True
+        self._notify_pump_stop()
         loop = self.backend.loop
         try:
             running = asyncio.get_running_loop()
@@ -303,6 +365,19 @@ class _PushBinding:
             self._credit_event.set()
         elif not loop.is_closed():
             loop.call_soon_threadsafe(self._credit_event.set)
+
+    def _notify_pump_stop(self) -> None:
+        """A detached/broken binding may strand a pump ``take`` parked on
+        a quiet source: pumps exposing ``binding_stopped()`` (the
+        streaming-generator push pump) are woken so the producer thread
+        can settle and fall back. Serve pumps don't define it — their
+        settlement runs through reclaim/resume_pull instead."""
+        fn = getattr(self.rs.pump, "binding_stopped", None)
+        if fn is not None:
+            try:
+                fn()
+            except Exception:  # noqa: BLE001 — wake is best-effort
+                pass
 
     def _complete(self) -> None:
         """The consumer saw the final frame and acked every item: settle

@@ -56,6 +56,7 @@ from ray_tpu.util.placement_group import (
 )
 from ray_tpu.util.tqdm_rt import maybe_render
 from ray_tpu.cluster.rpc import (
+    ChannelBroken,
     ConnectionLost,
     ConnectionPool,
     EventLoopThread,
@@ -334,6 +335,10 @@ class ClusterBackend(RuntimeBackend):
         # push-stream subscription (cluster/stream.py): a consumer binds a
         # one-way push channel on its existing connection to this process
         self.server.register("stream_subscribe", self._rpc_stream_subscribe)
+        # streaming-generator push handshake: the EXECUTING worker
+        # announces its stream source; this owner subscribes and drains
+        # items over one-way frames instead of one acked RPC per item
+        self.server.register("stream_begin", self._rpc_stream_begin)
         # task_id_hex -> _StreamState for in-flight streaming generators
         self._streams: Dict[str, _StreamState] = {}
         self._pool = ConnectionPool(peer_id=f"{role}:{job_id.hex()}")
@@ -889,6 +894,133 @@ class ClusterBackend(RuntimeBackend):
 
     async def _rpc_stream_subscribe(self, p):
         return await rt_stream.handle_subscribe(self, p)
+
+    # generator streams with a hard small producer-lag bound stay on the
+    # acked per-item path: push batching (frame window + producer pump)
+    # would loosen the bound `_stream_max_buffer` promises
+    _GEN_PUSH_MIN_BUFFER = 16
+
+    async def _rpc_stream_begin(self, p):
+        """Streaming-generator push handshake (PR 11's named unclaimed
+        stretch): the executor worker registered stream ``sid``; if this
+        owner still wants the stream and push is enabled, subscribe a
+        one-way frame channel back to the worker and drain it from a
+        background task. The legacy acked ``stream_item`` path remains
+        the fallback — the worker reverts to it (and redelivers the
+        unacked tail, idempotent by index) whenever the channel breaks
+        or this reply says no."""
+        st = self._streams.get(p["task_id"])
+        if st is None or st.closed:
+            return {"push": False, "gone": True}
+        if (not rt_stream.push_enabled()
+                or st.max_buffer < self._GEN_PUSH_MIN_BUFFER):
+            return {"push": False}
+        # max_buffer is the consumer's MEMORY bound, so it must cover the
+        # whole pipeline, not gate each stage independently: half goes to
+        # the credit window (channel buffer + producer replay), half to
+        # the stored-but-unconsumed gate in the drain task — the producer
+        # pump adds window//4 on top, keeping the total within ~1.1x the
+        # bound the acked per-item path promises
+        window = max(2, st.max_buffer // 2)
+        gate = max(1, st.max_buffer - window)
+        try:
+            ch = await rt_stream.subscribe(self, p["address"], p["sid"],
+                                           window=window)
+        except Exception:  # noqa: BLE001 — transport hiccup: stay on pull
+            return {"push": False}
+        if ch is None:
+            return {"push": False}
+        spawn_task(self._drain_generator_push(st, ch, p["task_id"], gate))
+        return {"push": True, "window": window}
+
+    async def _drain_generator_push(self, st: "_StreamState", ch,
+                                    task_id_hex: str, gate: int) -> None:
+        """Owner half of a pushed generator stream: decode each frame
+        ``(index, payload|None)`` into the per-index object slot (the
+        exact stores ``_rpc_stream_item`` would have made; plasma items
+        were sealed node-side and travel as index-only markers), bounded
+        by the same ``max_buffer`` consumer-lag wait. Exits on the done
+        frame, on consumer close, on a broken channel (the worker detects
+        the stop through the binding and resends the unacked tail over
+        the acked path), and on ``st.done`` — when the producer settles
+        through the acked fallback no done frame ever arrives, so the
+        take must be raced against the stream-state event or this task
+        (and the channel endpoint) would park in ``take`` forever."""
+        task_id = TaskID.from_hex(task_id_hex)
+        take_fut: Optional[asyncio.Future] = None
+
+        def _store(item) -> None:
+            idx, payload = item
+            if payload is not None:
+                self.memory_store.put(
+                    ObjectID.for_return(task_id, idx).hex(), payload)
+            st.produced = max(st.produced, idx + 1)
+            st.notify()
+
+        def _flush_take() -> None:
+            # a completed take holds an item the channel already
+            # CREDITED as consumed — the producer's fallback excludes
+            # acked items from the redelivered tail, so dropping it
+            # here would hole the stream permanently
+            nonlocal take_fut
+            if take_fut is not None and take_fut.done():
+                try:
+                    item, done = take_fut.result()
+                except Exception:  # noqa: BLE001 — broken channel /
+                    pass           # error frame: nothing was taken
+                else:
+                    if not done and item is not None:
+                        _store(item)
+                take_fut = None
+
+        try:
+            while True:
+                if st.done or st.closed:
+                    # settled via the task reply (the unacked tail was
+                    # redelivered by index) or consumer abandon: flush
+                    # any credited in-flight take, closed credit stops
+                    # the producer
+                    _flush_take()
+                    ch.close()
+                    return
+                while (st.produced - st.consumed > gate
+                       and not st.done and not st.closed):
+                    st._space.clear()
+                    await st._space.wait()
+                if st.done or st.closed:
+                    _flush_take()
+                    ch.close()
+                    return
+                if take_fut is None:
+                    take_fut = asyncio.ensure_future(
+                        rt_stream.take_decoded(self, ch))
+                st._event.clear()
+                waiter = asyncio.ensure_future(st._event.wait())
+                await asyncio.wait({take_fut, waiter},
+                                   return_when=asyncio.FIRST_COMPLETED)
+                waiter.cancel()
+                if not take_fut.done():
+                    continue  # stream-state change: loop re-checks done
+                item, done = take_fut.result()
+                take_fut = None
+                if done:
+                    return
+                _store(item)
+        except ChannelBroken:
+            try:
+                ch.close()
+            except Exception:  # noqa: BLE001 — channel already dead
+                pass
+        except Exception:  # noqa: BLE001 — decode failure: the worker's
+            # binding sees the closed channel and falls back to the
+            # acked path, which redelivers everything unacked
+            try:
+                ch.close()
+            except Exception:  # noqa: BLE001
+                pass
+        finally:
+            if take_fut is not None and not take_fut.done():
+                take_fut.cancel()
 
     async def _rpc_stream_item(self, p):
         """Executor pushes one generator item (reference: item reporting,

@@ -606,12 +606,15 @@ function renderTable() {
         (app.route_prefix ? ` <span class="muted">${esc(app.route_prefix)}` +
          `</span>` : ``) + `</h3>` +
         `<table><tr><th>Deployment</th><th>Replicas</th><th>Target</th>` +
-        `<th>Ongoing</th><th>Queue</th><th>Slots</th><th>p50</th>` +
-        `<th>p99</th><th>QPS</th></tr>` +
+        `<th>Ongoing</th><th>Queue</th><th>Slots</th><th>KV hit</th>` +
+        `<th>p50</th><th>p99</th><th>QPS</th></tr>` +
         Object.entries(deps).map(([d, info]) => {
           const s = (info && info.stats) || {};
           const slots = s.cb_slots
             ? `${esc(s.cb_active ?? 0)}/${esc(s.cb_slots)}` : "";
+          const kv = ("kv_hit_rate" in s)
+            ? `${Math.round(100 * s.kv_hit_rate)}% ` +
+              `${((s.kv_bytes || 0) / 1e6).toFixed(1)}MB` : "";
           return `<tr><td>${esc(d)}</td>` +
             `<td>${esc((info && (info.num_replicas ?? info.replicas))
                        ?? "")}</td>` +
@@ -619,6 +622,7 @@ function renderTable() {
             `<td>${esc(s.ongoing ?? 0)}</td>` +
             `<td>${esc(s.queue_depth ?? 0)}</td>` +
             `<td>${slots}</td>` +
+            `<td>${kv}</td>` +
             `<td>${ms(s.p50_s)} ms</td><td>${ms(s.p99_s)} ms</td>` +
             `<td>${esc(s.qps ?? 0)}</td></tr>`;
         }).join("") + `</table>`;

@@ -33,10 +33,12 @@ from __future__ import annotations
 
 import functools
 import itertools
+import os
 import queue as _queue
 import threading
 import time
-from collections import deque
+import weakref
+from collections import OrderedDict, deque
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
@@ -45,25 +47,268 @@ import numpy as np
 
 from ray_tpu.models import generate as G
 from ray_tpu.models import llama
+from ray_tpu.util import prefix_hash as PH
 
 Params = Dict[str, Any]
 
 
-class _Request:
-    __slots__ = ("req_id", "slot", "remaining", "tokens")
+# ---------------------------------------------------------------------------
+# Prefix/KV-cache reuse (ROADMAP item 4): retain completed slots' KV pages,
+# admit shared-prefix requests by restoring them so prefill runs only on
+# the uncached suffix.
+# ---------------------------------------------------------------------------
 
-    def __init__(self, req_id: int, slot: int, remaining: int):
+
+class _PrefixEntry:
+    __slots__ = ("key", "length", "k", "v", "nbytes", "chunk_keys",
+                 "chunk_digests", "created_at")
+
+    def __init__(self, key: bytes, length: int, k: np.ndarray, v: np.ndarray,
+                 chunk_keys: List[bytes], chunk_digests: List[str]):
+        self.key = key
+        self.length = length
+        self.k = k
+        self.v = v
+        self.nbytes = int(k.nbytes + v.nbytes)
+        self.chunk_keys = chunk_keys
+        self.chunk_digests = chunk_digests
+        self.created_at = time.time()
+
+
+# live caches in this process, for `rt memory` (util/memory.py reads this
+# registry for the local view; remote replicas publish @memkv/ snapshots)
+_kv_registry_lock = threading.Lock()
+_kv_registry: "weakref.WeakSet" = weakref.WeakSet()  # rt: guarded-by(_kv_registry_lock)
+
+
+def live_kv_cache_stats() -> List[Dict[str, Any]]:
+    """Stats of every live PrefixKVCache in this process (memory plane)."""
+    with _kv_registry_lock:
+        caches = list(_kv_registry)
+    return [c.stats() for c in caches]
+
+
+class PrefixKVCache:
+    """Bytes-budgeted LRU of chunk-aligned token-prefix KV pages.
+
+    Pages are host numpy copies ``[L, c, hkv, hd]`` of a slot row's first
+    ``c`` positions, keyed by the EXACT token bytes of the prefix (no
+    hash-collision risk; equality is byte equality). One entry of length
+    ``n`` serves every chunk-aligned prefix ``c <= n`` through the chunk
+    index, so a multi-turn session's growing context is one entry, not a
+    ladder of copies. Eviction is LRU by entry under a bytes budget
+    (``RT_KV_CACHE_BYTES`` default when unset); a weight swap must
+    :meth:`clear` the whole cache — every page was computed under the old
+    weights and would silently corrupt post-swap prefills.
+
+    Thread-safe: the engine thread mutates, stats/digest readers come
+    from replica RPC threads.
+    """
+
+    def __init__(self, *, chunk: Optional[int] = None,
+                 max_bytes: Optional[int] = None, label: str = ""):
+        self.chunk = int(chunk or PH.chunk_size())
+        if max_bytes is None:
+            max_bytes = int(os.environ.get("RT_KV_CACHE_BYTES",
+                                           str(256 * 1024 * 1024)))
+        self.max_bytes = int(max_bytes)
+        self.label = label
+        self._lock = threading.Lock()
+        # full-prefix key -> entry, in LRU order (oldest first)
+        self._entries: "OrderedDict[bytes, _PrefixEntry]" = \
+            OrderedDict()  # rt: guarded-by(_lock)
+        # chunk-aligned prefix key -> full key of an entry covering it
+        self._index: Dict[bytes, bytes] = {}  # rt: guarded-by(_lock)
+        self._bytes = 0  # rt: guarded-by(_lock)
+        self._hits = 0  # rt: guarded-by(_lock)
+        self._misses = 0  # rt: guarded-by(_lock)
+        self._evictions = 0  # rt: guarded-by(_lock)
+        self._inserts = 0  # rt: guarded-by(_lock)
+        self._invalidations = 0  # rt: guarded-by(_lock)
+        self._hit_tokens = 0  # rt: guarded-by(_lock)
+        with _kv_registry_lock:
+            _kv_registry.add(self)
+
+    def aligned(self, n: int) -> int:
+        return PH.aligned_len(n, self.chunk)
+
+    def lookup(self, tokens: np.ndarray
+               ) -> Optional[Tuple[int, np.ndarray, np.ndarray]]:
+        """Longest cached QUANTIZED prefix of ``tokens``:
+        ``(c, k_pages[L, c, hkv, hd], v_pages)`` or None. ``c`` is capped
+        at ``len(tokens) - 1`` — admission always prefills at least one
+        suffix token (the first generated token comes from the last
+        prompt position's logits) — and the probe ladder is GEOMETRIC
+        (power-of-two multiples of the chunk): the warm prefill compiles
+        one XLA program per (cached, suffix) shape on the engine thread,
+        where a mid-serve compile stalls every live stream, so restore
+        lengths are quantized to bound the program set at O(log) per
+        prompt length instead of one per chunk multiple."""
+        cmax = self.aligned(len(tokens) - 1)
+        if cmax < self.chunk:
+            return None
+        # largest power-of-two multiple of chunk <= cmax
+        c = self.chunk * (1 << ((cmax // self.chunk).bit_length() - 1))
+        buf = PH.token_key(tokens, c)  # pack once, slice per length
+        with self._lock:
+            while c >= self.chunk:
+                key = buf[:PH.TOKEN_WIDTH * c]
+                fk = self._index.get(key)
+                if fk is None:
+                    c //= 2
+                    continue
+                e = self._entries.get(fk)
+                if e is None or e.length < c or not e.key.startswith(key):
+                    self._index.pop(key, None)  # stale index row
+                    c //= 2
+                    continue
+                self._entries.move_to_end(fk)
+                self._hits += 1
+                self._hit_tokens += c
+                return (c, e.k[:, :c], e.v[:, :c])
+            self._misses += 1
+        return None
+
+    def cached_len(self, tokens: np.ndarray) -> int:
+        """Longest cached aligned prefix length WITHOUT touching hit/miss
+        counters or LRU order (capture-skip probe)."""
+        cmax = self.aligned(len(tokens))
+        if cmax < self.chunk:
+            return 0
+        buf = PH.token_key(tokens, cmax)
+        with self._lock:
+            for c in range(cmax, 0, -self.chunk):
+                fk = self._index.get(buf[:PH.TOKEN_WIDTH * c])
+                if fk is None:
+                    continue
+                e = self._entries.get(fk)
+                if e is not None and e.length >= c:
+                    return c
+        return 0
+
+    def insert(self, tokens: np.ndarray, k_pages: np.ndarray,
+               v_pages: np.ndarray) -> bool:
+        """Retain ``tokens``' KV pages (length must be chunk-aligned).
+        Returns False when already resident or larger than the budget."""
+        n = len(tokens)
+        key = PH.token_key(tokens, n)
+        nbytes = int(k_pages.nbytes + v_pages.nbytes)
+        with self._lock:
+            if key in self._entries:
+                self._entries.move_to_end(key)
+                return False
+            if nbytes > self.max_bytes:
+                return False
+            chunk_keys = [key[:PH.TOKEN_WIDTH * c]
+                          for c in range(self.chunk, n + 1, self.chunk)]
+            chunk_digests = PH.chunked_digests(key, self.chunk)
+            # coalesce: an older entry that IS a prefix of this one is now
+            # fully covered — drop it, or a growing session would retain a
+            # ladder of duplicate unreachable pages against the budget
+            ck_set = set(chunk_keys)
+            for fk in [fk for fk in self._entries if fk in ck_set]:
+                covered = self._entries.pop(fk)
+                self._bytes -= covered.nbytes
+            e = _PrefixEntry(key, n, k_pages, v_pages, chunk_keys,
+                             chunk_digests)
+            self._entries[key] = e
+            self._bytes += nbytes
+            self._inserts += 1
+            for ck in chunk_keys:
+                self._index[ck] = key  # newest entry serves the prefix
+            while self._bytes > self.max_bytes and len(self._entries) > 1:
+                self._evict_one_locked()
+            if self._bytes > self.max_bytes:  # lone oversized survivor
+                self._evict_one_locked()
+                return False
+        return True
+
+    def _evict_one_locked(self) -> None:
+        _, old = self._entries.popitem(last=False)
+        self._bytes -= old.nbytes
+        self._evictions += 1
+        for ck in old.chunk_keys:
+            if self._index.get(ck) != old.key:
+                continue
+            # repoint to a surviving covering entry (sessions that share
+            # only a short prefix overlap on its chunk rows) — deleting
+            # outright would stop resident entries serving those hits.
+            # token_key is fixed-width per token, so byte-prefix equality
+            # IS token-prefix equality.
+            for fk in reversed(self._entries):  # MRU first
+                if fk.startswith(ck):
+                    self._index[ck] = fk
+                    break
+            else:
+                del self._index[ck]
+
+    def clear(self) -> int:
+        """Weight-swap invalidation: every page was computed under the
+        old weights — poisoned, drop them all. Returns pages dropped."""
+        with self._lock:
+            n = len(self._entries)
+            self._entries.clear()
+            self._index.clear()
+            self._bytes = 0
+            self._invalidations += n
+        return n
+
+    def digests(self, limit: int = 2 * PH.MAX_PROBE_CHUNKS) -> List[str]:
+        """Chunk digests of resident entries (residency report for
+        cache-affinity routing), bounded. INTERLEAVED round-robin across
+        entries in MRU order, longest-prefix-first within each — one
+        long entry (64 chunks fills the whole report) must not hide
+        every other resident context from the router; the router scores
+        by set membership, so coverage beats order."""
+        per_entry: List[List[str]] = []
+        with self._lock:
+            # this runs on EVERY handle_request reply: bound the work
+            # under the lock to O(limit^2) worst case — at most `limit`
+            # MRU entries, at most `limit` digests each (reverse slice,
+            # not a whole-list copy)
+            for e in reversed(self._entries.values()):
+                if len(per_entry) >= limit:
+                    break
+                per_entry.append(e.chunk_digests[:-limit - 1:-1])
+        out: List[str] = []
+        for i in range(max((len(d) for d in per_entry), default=0)):
+            for d in per_entry:
+                if i < len(d):
+                    out.append(d[i])
+                    if len(out) >= limit:
+                        return out
+        return out
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {"label": self.label, "chunk": self.chunk,
+                    "bytes": self._bytes, "max_bytes": self.max_bytes,
+                    "pages": len(self._entries),
+                    "hits": self._hits, "misses": self._misses,
+                    "evictions": self._evictions, "inserts": self._inserts,
+                    "invalidations": self._invalidations,
+                    "hit_tokens": self._hit_tokens}
+
+
+class _Request:
+    __slots__ = ("req_id", "slot", "remaining", "tokens", "prompt")
+
+    def __init__(self, req_id: int, slot: int, remaining: int,
+                 prompt: Optional[np.ndarray] = None):
         self.req_id = req_id
         self.slot = slot
         self.remaining = remaining
         self.tokens: List[int] = []
+        self.prompt = prompt
 
 
 class ContinuousBatcher:
     """Slot-based continuous batching engine around one model."""
 
     def __init__(self, params: Params, cfg: llama.LlamaConfig, *,
-                 max_slots: int = 8, max_len: int = 512):
+                 max_slots: int = 8, max_len: int = 512,
+                 prefix_cache: Optional[PrefixKVCache] = None,
+                 sampling: bool = False):
         self.params = params
         self.cfg = cfg
         self.max_slots = max_slots
@@ -77,34 +322,83 @@ class ContinuousBatcher:
         self._cur = np.zeros(max_slots, np.int32)   # token AT pos, per slot
         self._pos = np.zeros(max_slots, np.int32)   # absolute position
         self._ids = itertools.count()
+        # prefix/KV reuse: retained pages of completed/cancelled slots
+        self.prefix_cache = prefix_cache
+        # sampling decode: per-slot temperature / top-k / PRNG-key chain.
+        # Built into the compiled programs only when enabled — a greedy
+        # engine compiles the exact PR 9 programs.
+        self.sampling = bool(sampling)
+        self._temp = np.zeros(max_slots, np.float32)
+        self._topk = np.zeros(max_slots, np.int32)
+        self._keys = np.zeros((max_slots, 2), np.uint32)
+        # set by every submit_ex: admission telemetry the engine reads
+        # (cached_tokens rides the request span; TTFT-collapse evidence)
+        self.last_admission: Dict[str, int] = {}
 
     # -- admission --------------------------------------------------------
 
-    def submit(self, prompt: np.ndarray, max_new_tokens: int) -> int:
+    def submit(self, prompt: np.ndarray, max_new_tokens: int, *,
+               temperature: float = 0.0, top_k: int = 0,
+               seed: int = 0) -> int:
         """Admit one request (prompt: int array [S]); returns req_id.
         Raises RuntimeError when no slot is free (caller queues/retries —
         admission control belongs to the serving layer)."""
-        return self.submit_ex(prompt, max_new_tokens)[0]
+        return self.submit_ex(prompt, max_new_tokens,
+                              temperature=temperature, top_k=top_k,
+                              seed=seed)[0]
 
-    def submit_ex(self, prompt: np.ndarray,
-                  max_new_tokens: int) -> Tuple[int, int, bool]:
+    def submit_ex(self, prompt: np.ndarray, max_new_tokens: int, *,
+                  temperature: float = 0.0, top_k: int = 0,
+                  seed: int = 0) -> Tuple[int, int, bool]:
         """``submit`` plus the prefill's first token: returns
         (req_id, first_token, done) — the streaming engine needs the
         token the admission itself produced (for a 1-token request the
-        slot is already freed and no ``step()`` will ever report it)."""
+        slot is already freed and no ``step()`` will ever report it).
+
+        With a prefix cache attached, admission restores the longest
+        cached chunk-aligned prefix into the slot and prefills ONLY the
+        uncached suffix — the TTFT-collapse path. The restored pages were
+        produced by the identical per-position math (K/V at position i
+        depends only on tokens <= i and every op is row-independent), so
+        warm output is token-exact vs a cold prefill (asserted in
+        tests/test_zz_kv_cache.py)."""
         if not self._free:
             raise RuntimeError("no free slots")
         s = len(prompt)
         if s + max_new_tokens + 1 > self.max_len:
             raise ValueError(f"prompt {s} + new {max_new_tokens} exceeds "
                              f"max_len {self.max_len}")
+        if (temperature > 0 or top_k > 0) and not self.sampling:
+            raise ValueError(
+                "sampling request on a greedy engine: construct the "
+                "batcher/engine with sampling=True")
         slot = self._free.pop()
+        prompt_arr = np.asarray(prompt, np.int32)
+        cached = 0
+        hit = (self.prefix_cache.lookup(prompt_arr)
+               if self.prefix_cache is not None else None)
         try:
-            fn = _compiled_slot_prefill(self.cfg, s, self.max_slots,
-                                        self.max_len)
-            self._ck, self._cv, first = fn(
-                self.params, self._ck, self._cv,
-                jnp.asarray(prompt, jnp.int32)[None, :], slot)
+            if hit is not None:
+                cached, pk, pv = hit
+                fn = _compiled_cached_prefill(
+                    self.cfg, cached, s - cached, self.max_slots,
+                    self.max_len, self.sampling)
+                args = (self.params, self._ck, self._cv,
+                        jnp.asarray(pk), jnp.asarray(pv),
+                        jnp.asarray(prompt_arr[cached:])[None, :], slot)
+            else:
+                fn = _compiled_slot_prefill(self.cfg, s, self.max_slots,
+                                            self.max_len, self.sampling)
+                args = (self.params, self._ck, self._cv,
+                        jnp.asarray(prompt_arr)[None, :], slot)
+            if self.sampling:
+                key0 = jnp.asarray(
+                    np.asarray(jax.random.PRNGKey(int(seed)), np.uint32))
+                self._ck, self._cv, first, new_key = fn(
+                    *args, jnp.float32(temperature), jnp.int32(top_k),
+                    key0)
+            else:
+                self._ck, self._cv, first = fn(*args)
         except BaseException:
             # a failed prefill must not leak the slot: callers (the
             # engine's admit loop) catch and continue, and a leaked slot
@@ -112,18 +406,53 @@ class ContinuousBatcher:
             # to zero capacity with no recovery path
             self._free.append(slot)
             raise
-        req = _Request(next(self._ids), slot, max_new_tokens)
+        req = _Request(next(self._ids), slot, max_new_tokens, prompt_arr)
         first_tok = int(first[0])
         req.tokens.append(first_tok)
         req.remaining -= 1
         self._cur[slot] = first_tok
         self._pos[slot] = s
+        if self.sampling:
+            self._temp[slot] = temperature
+            self._topk[slot] = top_k
+            self._keys[slot] = np.asarray(new_key)
+        self.last_admission = {"cached_tokens": cached, "prompt_tokens": s}
         done = req.remaining <= 0
         if done:
+            self._capture(slot, req)
             self._free.append(slot)
         else:
             self._active[slot] = req
         return req.req_id, first_tok, done
+
+    def _capture(self, slot: int, req: _Request) -> None:
+        """Retain the freed slot's KV pages: the valid span is
+        ``[0, pos)`` — prompt plus the generated tokens whose KV a decode
+        step actually wrote (the final emitted token's KV is only written
+        by the step that would produce its successor). Skipped when the
+        aligned prefix is already resident (the common warm-hit case —
+        re-capturing the shared system prompt per request would be pure
+        copy overhead)."""
+        cache = self.prefix_cache
+        if cache is None or req.prompt is None:
+            return
+        pos = int(self._pos[slot])
+        cap = cache.aligned(min(pos, self.max_len))
+        if cap < cache.chunk:
+            return
+        gen_used = max(0, pos - len(req.prompt))
+        tokens = req.prompt
+        if gen_used:
+            tokens = np.concatenate(
+                [req.prompt, np.asarray(req.tokens[:gen_used], np.int32)])
+        tokens = tokens[:cap]
+        if cache.cached_len(tokens) >= cap:
+            return
+        # one gather per aligned length (bounded program count): host
+        # copies so retained pages survive slot reuse and weight swaps
+        k = np.asarray(self._ck[:, slot, :cap])
+        v = np.asarray(self._cv[:, slot, :cap])
+        cache.insert(tokens, k, v)
 
     # -- the engine tick --------------------------------------------------
 
@@ -166,11 +495,21 @@ class ContinuousBatcher:
         # duplicate scatter writes identical values (deterministic)
         idx = np.asarray(slots + [slots[0]] * (bucket - n), np.int32)
         fn = _compiled_bucket_scan(self.cfg, bucket, self.max_slots,
-                                   self.max_len, k)
-        self._ck, self._cv, toks = fn(
-            self.params, self._ck, self._cv,
-            jnp.asarray(self._cur[idx]), jnp.asarray(self._pos[idx]),
-            jnp.asarray(idx))
+                                   self.max_len, k, self.sampling)
+        if self.sampling:
+            self._ck, self._cv, toks, new_keys = fn(
+                self.params, self._ck, self._cv,
+                jnp.asarray(self._cur[idx]), jnp.asarray(self._pos[idx]),
+                jnp.asarray(idx), jnp.asarray(self._temp[idx]),
+                jnp.asarray(self._topk[idx]), jnp.asarray(self._keys[idx]))
+            # duplicate padding rows carry the same key and compute the
+            # same split chain, so the repeated write is identical
+            self._keys[idx] = np.asarray(new_keys)
+        else:
+            self._ck, self._cv, toks = fn(
+                self.params, self._ck, self._cv,
+                jnp.asarray(self._cur[idx]), jnp.asarray(self._pos[idx]),
+                jnp.asarray(idx))
         toks = np.asarray(toks)  # [k, bucket]
         out = []
         for j, slot in enumerate(slots):
@@ -183,6 +522,7 @@ class ContinuousBatcher:
             self._pos[slot] += take
             done = req.remaining <= 0
             if done:
+                self._capture(slot, req)
                 del self._active[slot]
                 self._free.append(slot)
             out.append((req.req_id, mine, done))
@@ -211,21 +551,34 @@ class ContinuousBatcher:
         for k in sorted(set(strides)):
             for bucket in sorted({1, self.max_slots}):
                 fn = _compiled_bucket_scan(self.cfg, bucket, self.max_slots,
-                                           self.max_len, int(k))
+                                           self.max_len, int(k),
+                                           self.sampling)
                 idx = jnp.zeros(bucket, jnp.int32)
-                np.asarray(fn(self.params, self._ck, self._cv,
-                              cur[:bucket], pos[:bucket], idx)[2])
+                args = (self.params, self._ck, self._cv,
+                        cur[:bucket], pos[:bucket], idx)
+                if self.sampling:
+                    args += (jnp.asarray(self._temp[:bucket]),
+                             jnp.asarray(self._topk[:bucket]),
+                             jnp.asarray(self._keys[:bucket]))
+                np.asarray(fn(*args)[2])
         for s in prompt_lens:
             fn = _compiled_slot_prefill(self.cfg, int(s), self.max_slots,
-                                        self.max_len)
-            np.asarray(fn(self.params, self._ck, self._cv,
-                          jnp.zeros((1, int(s)), jnp.int32), 0)[2])
+                                        self.max_len, self.sampling)
+            args = (self.params, self._ck, self._cv,
+                    jnp.zeros((1, int(s)), jnp.int32), 0)
+            if self.sampling:
+                args += (jnp.float32(0.0), jnp.int32(0),
+                         jnp.asarray(self._keys[0]))
+            np.asarray(fn(*args)[2])
 
     def cancel(self, req_id: int) -> bool:
         """Free a request's slot mid-flight (client disconnect). The slot's
-        stale KV needs no scrub: the next admission prefills from 0."""
+        stale KV needs no scrub: the next admission prefills from 0. The
+        written span is still retained in the prefix cache — a dropped
+        multi-turn session's context stays warm for its next turn."""
         for slot, req in list(self._active.items()):
             if req.req_id == req_id:
+                self._capture(slot, req)
                 del self._active[slot]
                 self._free.append(slot)
                 return True
@@ -248,13 +601,19 @@ _STREAM_END = None  # sentinel a token stream's queue yields when done
 
 class _EngineRequest:
     __slots__ = ("prompt", "max_new_tokens", "out", "on_token", "req_id",
-                 "cancelled")
+                 "cancelled", "temperature", "top_k", "seed",
+                 "cached_tokens")
 
     def __init__(self, prompt: np.ndarray, max_new_tokens: int,
-                 on_token: Optional[Callable[[Optional[int]], None]] = None):
+                 on_token: Optional[Callable[[Optional[int]], None]] = None,
+                 temperature: float = 0.0, top_k: int = 0, seed: int = 0):
         self.prompt = prompt
         self.max_new_tokens = max_new_tokens
         self.on_token = on_token
+        self.temperature = temperature
+        self.top_k = top_k
+        self.seed = seed
+        self.cached_tokens: Optional[int] = None  # set at admission
         # at most max_new_tokens items + the end sentinel ever sit here,
         # so an unbounded queue is bounded in practice and the shared
         # engine thread can never block on a slow consumer
@@ -303,9 +662,26 @@ class ContinuousEngine:
                  max_slots: int = 8, max_len: int = 512,
                  decode_stride: int = 8,
                  on_tick: Optional[Callable[[int, int], None]] = None,
-                 warmup: bool = True):
+                 warmup: bool = True,
+                 kv_cache_bytes: Optional[int] = None,
+                 kv_label: str = "", sampling: bool = False):
+        # kv_cache_bytes > 0 attaches the prefix/KV reuse plane (retained
+        # pages budgeted in bytes, LRU-evicted, weight-swap-invalidated);
+        # 0 keeps the exact PR 9 cold-prefill engine; None reads
+        # RT_KV_CACHE_BYTES (default 0) so bare engines get the
+        # documented env knob without the serve layer's explicit sizing.
+        # The chunk size is
+        # deliberately NOT a per-engine knob: the handle router hashes
+        # request prefixes at the global RT_KV_CHUNK granularity, and a
+        # drifting engine chunk would silently zero the affinity scores.
+        if kv_cache_bytes is None:
+            kv_cache_bytes = int(os.environ.get("RT_KV_CACHE_BYTES", "0"))
+        cache = (PrefixKVCache(max_bytes=kv_cache_bytes, label=kv_label)
+                 if kv_cache_bytes > 0 else None)
         self._batcher = ContinuousBatcher(params, cfg, max_slots=max_slots,
-                                          max_len=max_len)
+                                          max_len=max_len,
+                                          prefix_cache=cache,
+                                          sampling=sampling)
         self.decode_stride = max(1, int(decode_stride))
         if warmup:
             # pay every decode-program compile HERE (replica init — the
@@ -338,15 +714,22 @@ class ContinuousEngine:
 
     # -- client side ------------------------------------------------------
 
-    def submit_stream(self, prompt: np.ndarray,
-                      max_new_tokens: int) -> "_queue.Queue":
+    def submit_stream(self, prompt: np.ndarray, max_new_tokens: int, *,
+                      temperature: float = 0.0, top_k: int = 0,
+                      seed: int = 0) -> "_queue.Queue":
         """Queue one request; returns its token queue (ints, then the
         ``None`` end sentinel). Admission control beyond the pending queue
-        belongs to the serving layer (``max_ongoing_requests``)."""
-        return self._submit(prompt, max_new_tokens, None).out
+        belongs to the serving layer (``max_ongoing_requests``).
+        ``temperature``/``top_k``/``seed`` select sampled decode (engine
+        must be built with ``sampling=True``); the default stays greedy."""
+        return self._submit(prompt, max_new_tokens, None,
+                            temperature=temperature, top_k=top_k,
+                            seed=seed).out
 
     def submit_cb(self, prompt: np.ndarray, max_new_tokens: int,
-                  on_token: Callable[[List[Optional[int]]], None]):
+                  on_token: Callable[[List[Optional[int]]], None], *,
+                  temperature: float = 0.0, top_k: int = 0,
+                  seed: int = 0):
         """Callback form: ``on_token(burst)`` fires from the engine
         thread with each tick's token burst (a list of ints; a ``None``
         element marks end-of-stream). Zero consumer threads — an asyncio
@@ -354,16 +737,23 @@ class ContinuousEngine:
         instead of parking an executor thread per stream on a queue (the
         thread-starvation ceiling a 2-core box hits at ~6 streams).
         Returns an opaque handle for :meth:`cancel`."""
-        return self._submit(prompt, max_new_tokens, on_token)
+        return self._submit(prompt, max_new_tokens, on_token,
+                            temperature=temperature, top_k=top_k,
+                            seed=seed)
 
     def _submit(self, prompt: np.ndarray, max_new_tokens: int,
-                on_token) -> "_EngineRequest":
+                on_token, *, temperature: float = 0.0, top_k: int = 0,
+                seed: int = 0) -> "_EngineRequest":
         s = len(prompt)
         if s + max_new_tokens + 1 > self.max_len:
             raise ValueError(f"prompt {s} + new {max_new_tokens} exceeds "
                              f"max_len {self.max_len}")
+        if (temperature > 0 or top_k > 0) and not self._batcher.sampling:
+            raise ValueError("sampling request on a greedy engine: pass "
+                             "sampling=True at engine construction")
         req = _EngineRequest(np.asarray(prompt, np.int32), max_new_tokens,
-                             on_token)
+                             on_token, temperature=float(temperature),
+                             top_k=int(top_k), seed=int(seed))
         with self._work:
             if self._stopped:
                 raise RuntimeError("engine is shut down")
@@ -418,7 +808,28 @@ class ContinuousEngine:
                    "weight_swaps": self._weight_swaps}
             if self._dead is not None:
                 out["dead"] = self._dead
-            return out
+        cache = self._batcher.prefix_cache
+        if cache is not None:
+            # kv stats ride replica stats_window -> controller win_stats
+            # -> `rt serve status` hit-rate column / dashboard Serve tab
+            out["kv"] = cache.stats()
+        return out
+
+    def kv_stats(self) -> Optional[Dict[str, Any]]:
+        """Prefix-cache counters WITHOUT touching the engine lock (the
+        cache has its own): the per-tick metric publisher reads this —
+        taking ``_work`` there would contend with submit/cancel on every
+        decode launch for four numbers the cache already exposes."""
+        cache = self._batcher.prefix_cache
+        return cache.stats() if cache is not None else None
+
+    def kv_residency(self) -> List[str]:
+        """Chunk digests of the prefixes this engine holds warm — the
+        replica reports these so the handle router can bias power-of-two
+        choice toward the replica whose cache already covers a request's
+        prompt (cache-affinity routing)."""
+        cache = self._batcher.prefix_cache
+        return cache.digests() if cache is not None else []
 
     def load_params(self, params: Params,
                     timeout_s: float = 120.0) -> Dict[str, Any]:
@@ -473,6 +884,13 @@ class ContinuousEngine:
             if self._dead is not None:
                 raise RuntimeError(f"continuous engine died: {self._dead}")
 
+    def stopped(self) -> bool:
+        """True once the engine was shut down or its thread died — loops
+        keyed on the engine's lifetime (the replica's kv-push thread)
+        use this as their exit condition."""
+        with self._lock:
+            return self._stopped or self._dead is not None
+
     def shutdown(self, timeout_s: float = 5.0) -> None:
         with self._work:
             self._stopped = True
@@ -508,7 +926,11 @@ class ContinuousEngine:
                 self._admitting = req
             try:
                 req_id, first_tok, done = self._batcher.submit_ex(
-                    req.prompt, req.max_new_tokens)
+                    req.prompt, req.max_new_tokens,
+                    temperature=req.temperature, top_k=req.top_k,
+                    seed=req.seed)
+                req.cached_tokens = self._batcher.last_admission.get(
+                    "cached_tokens", 0)
             except Exception:  # noqa: BLE001 — ONE request's prefill
                 # failing (bad shape, transient XLA error) must fail that
                 # request, not wedge the shared engine thread
@@ -543,6 +965,11 @@ class ContinuousEngine:
         params, waiters = self._pending_swap
         self._pending_swap = None
         self._batcher.params = params
+        if self._batcher.prefix_cache is not None:
+            # every retained page was computed under the OLD weights: a
+            # post-swap prefill restoring one would emit tokens belonging
+            # to neither model — invalidate the whole cache at the swap
+            self._batcher.prefix_cache.clear()
         self._weight_swaps += 1
         for st in waiters:
             st["applied"] = True
@@ -563,11 +990,21 @@ class ContinuousEngine:
         while True:
             with self._work:
                 # reap cancellations before admitting into their slots
-                for rid in [rid for rid, r in self._live.items()
-                            if r.cancelled]:
-                    self._batcher.cancel(rid)
+                doomed = [rid for rid, r in self._live.items()
+                          if r.cancelled]
+                for rid in doomed:
                     self._live[rid].emit_many([_STREAM_END])
                     del self._live[rid]
+            # slot free + KV capture OUTSIDE the lock: _capture syncs
+            # the device and copies the slot's pages to host — under
+            # _work that stall would block every submit/cancel (the
+            # batcher itself is engine-thread-confined, like step_many).
+            # Captures must land BEFORE the swap check: a swap clears
+            # the cache, and a doomed slot's pages are old-weight poison
+            # the moment it applies.
+            for rid in doomed:
+                self._batcher.cancel(rid)
+            with self._work:
                 self._maybe_swap_locked()
             self._admit_all()
             with self._work:
@@ -633,30 +1070,106 @@ class ContinuousEngine:
                     pass
 
 
-@functools.lru_cache(maxsize=64)
-def _compiled_slot_prefill(cfg, s: int, max_slots: int, max_len: int):
-    """Prefill ONE prompt into ONE slot of the shared cache; returns the
-    updated cache and the first greedy token."""
+def _row_sample(logits, temp, top_k, sub):
+    """One row's token rule: greedy when ``temp <= 0`` (selected by
+    ``where`` so a greedy row in a sampling engine is bit-identical to
+    the greedy program), else temperature softmax sampling, optionally
+    top-k truncated (``top_k`` is a traced per-row value; 0 disables).
+    The one sampling rule of ``generate._sample_token``, per-row."""
+    greedy = jnp.argmax(logits).astype(jnp.int32)
+    scaled = logits / jnp.where(temp > 0, temp, 1.0)
+    v = logits.shape[-1]
+    srt = jnp.sort(scaled)  # ascending
+    kth = srt[jnp.clip(v - top_k, 0, v - 1)]
+    thresh = jnp.where(top_k > 0, kth, -jnp.inf)
+    masked = jnp.where(scaled < thresh, -jnp.inf, scaled)
+    sampled = jax.random.categorical(sub, masked).astype(jnp.int32)
+    return jnp.where(temp > 0, sampled, greedy)
 
-    @jax.jit
-    def run(params, ck, cv, prompt, slot):
+
+def _first_token(logits_last, sample: bool, temp=None, top_k=None,
+                 key=None):
+    """Admission's first token from the last prompt position's logits
+    ([1, V]); sampling consumes one split of the request's key chain."""
+    if not sample:
+        return jnp.argmax(logits_last, axis=-1).astype(jnp.int32), None
+    key, sub = jax.random.split(key)
+    return _row_sample(logits_last[0], temp, top_k, sub)[None], key
+
+
+@functools.lru_cache(maxsize=64)
+def _compiled_slot_prefill(cfg, s: int, max_slots: int, max_len: int,
+                           sample: bool = False):
+    """Prefill ONE prompt into ONE slot of the shared cache; returns the
+    updated cache and the first token (greedy, or sampled off the
+    request's key when the engine runs the sampling programs)."""
+
+    def body(params, ck, cv, prompt, slot, temp=None, top_k=None,
+             key=None):
         row = {"k": jnp.zeros((cfg.n_layers, 1, max_len, cfg.n_kv_heads,
                                cfg.head_dim), cfg.compute_dtype),
                "v": jnp.zeros((cfg.n_layers, 1, max_len, cfg.n_kv_heads,
                                cfg.head_dim), cfg.compute_dtype)}
         logits, row = G._forward_with_cache(params, prompt, cfg, row, 0)
-        first = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
+        first, key = _first_token(logits[:, -1, :], sample, temp, top_k,
+                                  key)
         ck = jax.lax.dynamic_update_slice(ck, row["k"], (0, slot, 0, 0, 0))
         cv = jax.lax.dynamic_update_slice(cv, row["v"], (0, slot, 0, 0, 0))
-        return ck, cv, first
+        return (ck, cv, first, key) if sample else (ck, cv, first)
+
+    if sample:
+        @jax.jit
+        def run(params, ck, cv, prompt, slot, temp, top_k, key):
+            return body(params, ck, cv, prompt, slot, temp, top_k, key)
+    else:
+        @jax.jit
+        def run(params, ck, cv, prompt, slot):
+            return body(params, ck, cv, prompt, slot)
 
     return run
 
 
-def _one_row_step(cfg):
+@functools.lru_cache(maxsize=256)
+def _compiled_cached_prefill(cfg, c: int, sl: int, max_slots: int,
+                             max_len: int, sample: bool = False):
+    """Warm admission: restore ``c`` cached prefix positions into the
+    slot row and prefill ONLY the ``sl``-token suffix at offset ``c`` —
+    prefill compute scales with the uncached suffix, which is the TTFT
+    collapse on shared-prefix traffic. Token-exact vs the cold path: the
+    restored K/V are the same per-position values a full prefill would
+    recompute (each position's K/V depends only on tokens <= it, and
+    attention always masks over the same full-length row cache)."""
+
+    def body(params, ck, cv, pk, pv, suffix, slot, temp=None, top_k=None,
+             key=None):
+        zk = jnp.zeros((cfg.n_layers, 1, max_len, cfg.n_kv_heads,
+                        cfg.head_dim), cfg.compute_dtype)
+        row = {"k": zk.at[:, 0, :c].set(pk.astype(cfg.compute_dtype)),
+               "v": zk.at[:, 0, :c].set(pv.astype(cfg.compute_dtype))}
+        logits, row = G._forward_with_cache(params, suffix, cfg, row, c)
+        first, key = _first_token(logits[:, -1, :], sample, temp, top_k,
+                                  key)
+        ck = jax.lax.dynamic_update_slice(ck, row["k"], (0, slot, 0, 0, 0))
+        cv = jax.lax.dynamic_update_slice(cv, row["v"], (0, slot, 0, 0, 0))
+        return (ck, cv, first, key) if sample else (ck, cv, first)
+
+    if sample:
+        @jax.jit
+        def run(params, ck, cv, pk, pv, suffix, slot, temp, top_k, key):
+            return body(params, ck, cv, pk, pv, suffix, slot, temp, top_k,
+                        key)
+    else:
+        @jax.jit
+        def run(params, ck, cv, pk, pv, suffix, slot):
+            return body(params, ck, cv, pk, pv, suffix, slot)
+
+    return run
+
+
+def _one_row_step(cfg, sample: bool = False):
     """The single-row cached decode body shared by the full-engine and
     bucketed step programs: per-row rope, per-row cache scatter, per-row
-    causal masking."""
+    causal masking — plus per-row sampling state when enabled."""
 
     def one_row(params, ck_row, cv_row, tok, pos):
         cache = {"k": ck_row[:, None], "v": cv_row[:, None]}
@@ -665,35 +1178,66 @@ def _one_row_step(cfg):
         nxt = jnp.argmax(logits[0, -1, :]).astype(jnp.int32)
         return cache["k"][:, 0], cache["v"][:, 0], nxt
 
-    return one_row
+    def one_row_sampled(params, ck_row, cv_row, tok, pos, temp, top_k,
+                        key):
+        cache = {"k": ck_row[:, None], "v": cv_row[:, None]}
+        logits, cache = G._forward_with_cache(
+            params, tok[None, None], cfg, cache, pos)
+        key, sub = jax.random.split(key)
+        nxt = _row_sample(logits[0, -1, :], temp, top_k, sub)
+        return cache["k"][:, 0], cache["v"][:, 0], nxt, key
+
+    return one_row_sampled if sample else one_row
 
 
 @functools.lru_cache(maxsize=128)
 def _compiled_bucket_scan(cfg, bucket: int, max_slots: int, max_len: int,
-                          k: int):
+                          k: int, sample: bool = False):
     """``k`` fused decode steps for ``bucket`` ACTIVE slots out of
     ``max_slots``: gather the occupied rows, ``lax.scan`` the vmapped
     single-row forward ``k`` times, scatter the updated KV back, return
     the [k, bucket] token block. One launch per K tokens per occupancy
-    bucket — the decode-side make_multi_step."""
-    one_row = _one_row_step(cfg)
+    bucket — the decode-side make_multi_step. The sampling variant
+    additionally carries each row's PRNG key through the scan (one split
+    per token, so a request's draw chain is independent of batch
+    composition and tick stride — seeded determinism)."""
+    one_row = _one_row_step(cfg, sample)
 
-    @jax.jit
-    def run(params, ck, cv, cur, pos, idx):
-        ck_rows = ck.swapaxes(0, 1)[idx]  # [bucket, L, T, hkv, hd]
-        cv_rows = cv.swapaxes(0, 1)[idx]
+    if sample:
+        @jax.jit
+        def run(params, ck, cv, cur, pos, idx, temp, topk, keys):
+            ck_rows = ck.swapaxes(0, 1)[idx]  # [bucket, L, T, hkv, hd]
+            cv_rows = cv.swapaxes(0, 1)[idx]
 
-        def body(carry, _):
-            ck_r, cv_r, cur, pos = carry
-            ck_r, cv_r, nxt = jax.vmap(
-                one_row, in_axes=(None, 0, 0, 0, 0))(
-                params, ck_r, cv_r, cur, pos)
-            return (ck_r, cv_r, nxt, pos + 1), nxt
+            def body(carry, _):
+                ck_r, cv_r, cur, pos, keys = carry
+                ck_r, cv_r, nxt, keys = jax.vmap(
+                    one_row, in_axes=(None, 0, 0, 0, 0, 0, 0, 0))(
+                    params, ck_r, cv_r, cur, pos, temp, topk, keys)
+                return (ck_r, cv_r, nxt, pos + 1, keys), nxt
 
-        (ck_rows, cv_rows, _, _), toks = jax.lax.scan(
-            body, (ck_rows, cv_rows, cur, pos), None, length=k)
-        ck = ck.swapaxes(0, 1).at[idx].set(ck_rows).swapaxes(0, 1)
-        cv = cv.swapaxes(0, 1).at[idx].set(cv_rows).swapaxes(0, 1)
-        return ck, cv, toks  # [k, bucket]
+            (ck_rows, cv_rows, _, _, keys), toks = jax.lax.scan(
+                body, (ck_rows, cv_rows, cur, pos, keys), None, length=k)
+            ck = ck.swapaxes(0, 1).at[idx].set(ck_rows).swapaxes(0, 1)
+            cv = cv.swapaxes(0, 1).at[idx].set(cv_rows).swapaxes(0, 1)
+            return ck, cv, toks, keys  # [k, bucket], [bucket, 2]
+    else:
+        @jax.jit
+        def run(params, ck, cv, cur, pos, idx):
+            ck_rows = ck.swapaxes(0, 1)[idx]  # [bucket, L, T, hkv, hd]
+            cv_rows = cv.swapaxes(0, 1)[idx]
+
+            def body(carry, _):
+                ck_r, cv_r, cur, pos = carry
+                ck_r, cv_r, nxt = jax.vmap(
+                    one_row, in_axes=(None, 0, 0, 0, 0))(
+                    params, ck_r, cv_r, cur, pos)
+                return (ck_r, cv_r, nxt, pos + 1), nxt
+
+            (ck_rows, cv_rows, _, _), toks = jax.lax.scan(
+                body, (ck_rows, cv_rows, cur, pos), None, length=k)
+            ck = ck.swapaxes(0, 1).at[idx].set(ck_rows).swapaxes(0, 1)
+            cv = cv.swapaxes(0, 1).at[idx].set(cv_rows).swapaxes(0, 1)
+            return ck, cv, toks  # [k, bucket]
 
     return run

@@ -369,6 +369,9 @@ def cmd_serve(args: argparse.Namespace) -> int:
                       f"  tokens {s.get('cb_tokens_generated', 0)}"
                       f"  completed {s.get('cb_requests_completed', 0)}"
                       if "cb_slots" in s else "")
+                if "kv_hit_rate" in s:
+                    cb += (f"  kv {100 * s['kv_hit_rate']:.0f}%"
+                           f" {s.get('kv_bytes', 0) / 1e6:.1f}MB")
                 print(f"  {name:<24} replicas {d.get('replicas', 0)}/"
                       f"{d.get('target', 0)}"
                       f"{' (+%d starting)' % d['starting'] if d.get('starting') else ''}"

@@ -1,0 +1,369 @@
+"""Cache-aware serving bench: shared-prefix trace, warm vs cold A/B.
+
+The ROADMAP item-4 acceptance leg (ISSUE 15): a realistic shared-prefix
+trace — 95% of requests share a long system prompt, each with a unique
+user tail, plus multi-turn session replay — against the SAME engine
+config with the prefix/KV cache on (warm) and off (cold control), at
+equal offered load. Three legs:
+
+  1. **engine TTFT A/B** — sequential requests straight into one
+     ContinuousEngine, timed submit -> first token: the TTFT-collapse
+     number with no serve-transport noise (warm prefill touches only
+     the uncached suffix). Headline: ``ttft_collapse_x`` (>= 5x bar).
+  2. **serve trace at equal load** — open-loop Poisson of the trace via
+     deployment handles against warm and cold apps at the same rps:
+     per-request TTFT percentiles + full-wall p99, hits advancing.
+  3. **warm at 2x offered load** — the capacity claim: the warm app at
+     DOUBLE the cold control's rps must hold p99 at or under the cold
+     control's and shed no more (equal shed budget).
+
+Session replay rides leg 2: a fraction of arrivals continue a session
+(prompt = previous prompt + previous output + new user tokens), which
+the capture-on-completion path keeps warm turn over turn.
+
+Writes the committed artifact (default ``BENCH_KV_r10.json``); env
+knobs: RT_KV_BENCH_{PREFIX,SUFFIX,NEW,RPS,SECS,SLOTS,OUT}.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+
+def _engine_ttft_leg(preset: str, prefix_len: int, suffix_len: int,
+                     max_new: int, slots: int, stride: int,
+                     reqs: int = 24) -> Dict[str, Any]:
+    """Leg 1: median submit->first-token wall, warm vs cold, one engine
+    each (same compiled programs warmed outside the timed window)."""
+    import jax
+    import numpy as np
+
+    from ray_tpu.models import llama
+    from ray_tpu.models.serving import ContinuousEngine
+
+    max_len = prefix_len + suffix_len + max_new + 8
+    cfg = llama.PRESETS[preset]
+    params = llama.init_params(jax.random.key(0), cfg)
+    prefix = list(range(1, prefix_len + 1))
+    rng = random.Random(3)
+
+    def ttfts(engine, n: int, seed_cache: bool) -> List[float]:
+        out: List[float] = []
+        # one throwaway per distinct program (prefill shapes) + cache
+        # seeding, outside the timed window
+        for warmup in (True, False):
+            rounds = 2 if warmup else n
+            for i in range(rounds):
+                tail = [200 + rng.randrange(1000) for _ in range(suffix_len)]
+                ev = threading.Event()
+                first_t = [0.0]
+
+                def on_token(burst, ev=ev, first_t=first_t):
+                    if not ev.is_set() and burst:
+                        first_t[0] = time.perf_counter()
+                        ev.set()
+
+                t0 = time.perf_counter()
+                h = engine.submit_cb(np.asarray(prefix + tail, np.int32),
+                                     max_new, on_token)
+                assert ev.wait(timeout=120)
+                # drain to completion so the slot frees + pages capture
+                while True:
+                    st = engine.stats()
+                    if st["active"] == 0 and st["pending"] == 0:
+                        break
+                    time.sleep(0.002)
+                if not warmup:
+                    out.append(first_t[0] - t0)
+                del h
+        return out
+
+    res: Dict[str, Any] = {"requests": reqs, "prefix_tokens": prefix_len,
+                           "suffix_tokens": suffix_len}
+    for leg, kv_bytes in (("cold", 0), ("warm", 256 << 20)):
+        engine = ContinuousEngine(params, cfg, max_slots=slots,
+                                  max_len=max_len, decode_stride=stride,
+                                  kv_cache_bytes=kv_bytes, kv_label=leg)
+        vals = sorted(ttfts(engine, reqs, kv_bytes > 0))
+        res[leg] = {
+            "ttft_p50_ms": round(1e3 * vals[len(vals) // 2], 3),
+            "ttft_mean_ms": round(1e3 * sum(vals) / len(vals), 3)}
+        if kv_bytes > 0:
+            st = engine.stats()["kv"]
+            res[leg]["kv"] = {k: st[k] for k in
+                              ("hits", "misses", "bytes", "pages",
+                               "evictions")}
+        engine.shutdown()
+    res["ttft_collapse_x"] = round(
+        res["cold"]["ttft_p50_ms"] / max(res["warm"]["ttft_p50_ms"], 1e-6),
+        2)
+    return res
+
+
+class _Trace:
+    """The shared-prefix request mix: 95% system-prompt + unique tail,
+    5% unrelated cold prompts, plus multi-turn session continuations.
+    Deterministic per seed so warm and cold legs see the same multiset."""
+
+    def __init__(self, prefix: List[int], suffix_len: int, max_new: int,
+                 seed: int, shared_frac: float = 0.95,
+                 session_frac: float = 0.25, max_sessions: int = 8,
+                 max_ctx: int = 0):
+        self.prefix = prefix
+        self.suffix_len = suffix_len
+        self.max_new = max_new
+        self.shared_frac = shared_frac
+        self.session_frac = session_frac
+        self.max_ctx = max_ctx
+        self.rng = random.Random(seed)
+        self.lock = threading.Lock()
+        self.sessions: List[List[int]] = [list(prefix)
+                                          for _ in range(max_sessions)]
+
+    def next_body(self) -> Dict[str, Any]:
+        with self.lock:
+            r = self.rng.random()
+            if r > self.shared_frac:
+                # cold minority: unrelated prompt, no reuse possible
+                toks = [5000 + self.rng.randrange(20000)
+                        for _ in range(len(self.prefix) // 2)]
+                return {"tokens": toks, "max_new_tokens": self.max_new}
+            tail = [200 + self.rng.randrange(1000)
+                    for _ in range(self.suffix_len)]
+            if r < self.shared_frac * self.session_frac:
+                # session replay: continue a growing context
+                i = self.rng.randrange(len(self.sessions))
+                ctx = self.sessions[i]
+                if self.max_ctx and len(ctx) + self.suffix_len + \
+                        self.max_new + 2 > self.max_ctx:
+                    ctx = self.sessions[i] = list(self.prefix)
+                prompt = ctx + tail
+                return {"tokens": prompt, "max_new_tokens": self.max_new,
+                        "_session": i}
+            return {"tokens": self.prefix + tail,
+                    "max_new_tokens": self.max_new}
+
+    def record(self, body: Dict[str, Any], out: List[int]) -> None:
+        i = body.get("_session")
+        if i is None:
+            return
+        with self.lock:
+            # next turn extends this turn's prompt + output
+            self.sessions[i] = list(body["tokens"]) + list(out)
+
+
+def _serve_leg(handle, trace: _Trace, rps: float, secs: float,
+               seed: int) -> Dict[str, Any]:
+    from ray_tpu.serve.llm import poisson_load
+
+    def fire():
+        body = dict(trace.next_body())
+        sess = body.pop("_session", None)
+        if sess is not None:
+            body["_session"] = sess  # record() needs it; replica ignores
+        send = {k: v for k, v in body.items() if not k.startswith("_")}
+        t0 = time.perf_counter()
+        gen = handle.remote(send).result()
+        toks = []
+        ttft: Optional[float] = None
+        for t in gen:
+            if ttft is None:
+                ttft = time.perf_counter() - t0
+            toks.append(t)
+        trace.record(body, toks)
+        return (len(toks), ttft if ttft is not None else 0.0)
+
+    return poisson_load(fire, rps=rps, duration_s=secs, seed=seed)
+
+
+def main(args=None) -> int:
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.serve.llm import continuous_llm_app
+
+    preset = os.environ.get("RT_KV_BENCH_PRESET", "debug")
+    # the realistic shared-prefix regime is a LONG system prompt (RAG /
+    # agent preambles run 1-2k tokens) with short per-request tails:
+    # prefill dominates per-request engine cost, which is exactly the
+    # cost the cache removes — at short prefixes the shared decode
+    # ceiling caps the warm leg's capacity gain instead
+    prefix_len = int(os.environ.get("RT_KV_BENCH_PREFIX", "1024"))
+    # suffix + max_new together make ONE chunk (64), so a session
+    # context grows exactly chunk-aligned turn over turn: the restore
+    # point c stays a small set of chunk multiples and the uncached
+    # suffix keeps ONE shape — the (cached_len, suffix_len)-keyed
+    # prefill program set stays bounded instead of compiling a fresh
+    # XLA program per session depth (prompt-length bucketing, the
+    # admission-cost discipline the engine docstring prescribes)
+    suffix_len = int(os.environ.get("RT_KV_BENCH_SUFFIX", "56"))
+    max_new = int(os.environ.get("RT_KV_BENCH_NEW", "8"))
+    # leg 1 wants prefill compute the cache visibly removes: a longer
+    # shared prefix than the serve legs need (its engines size max_len
+    # independently)
+    eng_prefix = int(os.environ.get("RT_KV_BENCH_ENG_PREFIX",
+                                    str(max(512, prefix_len))))
+    slots = int(os.environ.get("RT_KV_BENCH_SLOTS", "8"))
+    stride = int(os.environ.get("RT_KV_BENCH_STRIDE", "4"))
+    rps = float(os.environ.get("RT_KV_BENCH_RPS", "5"))
+    secs = float(os.environ.get("RT_KV_BENCH_SECS", "12"))
+    out_path = os.environ.get("RT_KV_BENCH_OUT", "BENCH_KV_r10.json")
+    # session contexts grow turn over turn: size max_len for a couple
+    # of turns (each extra depth is another (cached_len, suffix) prefill
+    # program every leg must compile during its replay warmup)
+    max_len = int(os.environ.get(
+        "RT_KV_BENCH_MAX_LEN",
+        str(prefix_len + 2 * (suffix_len + max_new) + 64)))
+
+    started_here = False
+    if not ray_tpu.is_initialized():
+        ray_tpu.init(num_cpus=4)
+        started_here = True
+
+    artifact: Dict[str, Any] = {
+        "schema": "rt-kv-bench-1", "preset": preset, "t": time.time(),
+        "trace": {"shared_prefix_frac": 0.95, "session_frac": 0.25,
+                  "prefix_tokens": prefix_len, "suffix_tokens": suffix_len,
+                  "max_new_tokens": max_new},
+        "note": ("warm = prefix/KV cache on, cold = kv_cache_bytes=0, "
+                 "SAME engine/serve config and the same deterministic "
+                 "trace; leg 1 is engine-level TTFT (no transport "
+                 "noise), legs 2/3 are open-loop Poisson through serve "
+                 "handles (ttft = first streamed token at the client)"),
+    }
+    try:
+        # short fixed suffix for leg 1: the TTFT-collapse ceiling is the
+        # cold-prefill compute the cache removes, so keep the uncached
+        # tail minimal (the serve trace uses the chunk-sized tail)
+        print(f"== leg 1: engine TTFT A/B ({eng_prefix}+4 tok prompts) ==")
+        artifact["engine_ttft"] = _engine_ttft_leg(
+            preset, eng_prefix, 4, max_new, slots, stride)
+        e = artifact["engine_ttft"]
+        print(f"cold p50 {e['cold']['ttft_p50_ms']}ms vs warm p50 "
+              f"{e['warm']['ttft_p50_ms']}ms -> collapse x"
+              f"{e['ttft_collapse_x']}")
+
+        def fresh_trace():
+            return _Trace(list(range(1, prefix_len + 1)), suffix_len,
+                          max_new, seed=17, max_ctx=max_len - 8)
+
+        # serve legs run as INTERLEAVED rounds, not one sequential pass
+        # per leg: on a shared CPU box, ambient load drifts on a tens-of-
+        # seconds scale — a sequential A/B hands one leg a quiet machine
+        # and the other a noisy one (observed: the same leg's p99 moved
+        # 181ms -> 691ms between back-to-back runs). Cycling
+        # cold/warm/warm_2x in short slices and taking the MEDIAN across
+        # rounds pins the comparison to the same ambient conditions.
+        rounds = int(os.environ.get("RT_KV_BENCH_ROUNDS", "3"))
+        handles = {}
+        for leg, kv_bytes, leg_rps in (("cold", 0, rps),
+                                       ("warm", 256 << 20, rps),
+                                       ("warm_2x", 256 << 20, 2 * rps)):
+            app = continuous_llm_app(
+                preset, max_slots=slots, max_len=max_len,
+                decode_stride=stride, name="KV",
+                max_ongoing_requests=4 * slots, kv_cache_bytes=kv_bytes)
+            name = f"kvb-{leg}"
+            serve.run(app, name=name, route_prefix=f"/{name}")
+            h = serve.get_deployment_handle("KV", name)
+            # warmup: one boot request, then an UNTIMED replay of the
+            # leg's full deterministic schedule (same seed -> same
+            # prompt multiset, greedy decode -> same session turns).
+            # Every prefill/restore shape the timed rounds will see is
+            # compiled here, and the warm legs reach their steady-state
+            # cache — a single mid-round XLA compile is a 1-2 s stall
+            # that owns the p99 at these walls.
+            list(h.remote({"tokens": list(range(1, prefix_len + 1)),
+                           "max_new_tokens": 2}).result())
+            _serve_leg(h, fresh_trace(), leg_rps, secs, seed=29)
+            handles[leg] = (name, h, leg_rps)
+
+        per_round: Dict[str, List[Dict[str, Any]]] = \
+            {leg: [] for leg in handles}
+        for rnd in range(rounds):
+            for leg, (name, h, leg_rps) in handles.items():
+                print(f"== round {rnd + 1}/{rounds} {leg} @ {leg_rps} "
+                      f"rps x {secs}s ==")
+                r = _serve_leg(h, fresh_trace(), leg_rps, secs,
+                               seed=101 + rnd)
+                print(f"  {leg}: {r}")
+                per_round[leg].append(r)
+
+        def med(vals: List[float]) -> float:
+            vals = sorted(vals)
+            return vals[len(vals) // 2]
+
+        legs = {}
+        for leg, (name, h, leg_rps) in handles.items():
+            rs = per_round[leg]
+            agg = {}
+            for k in ("p50_ms", "p99_ms", "ttft_p50_ms", "ttft_p99_ms",
+                      "rps", "tok_s"):
+                # a fully-shed/failed round emits no ttft_* keys
+                # (poisson_load saw no streamed completion): aggregate
+                # over the rounds that have the series instead of
+                # crashing the whole multi-minute run at the end
+                vals = [r[k] for r in rs if k in r]
+                agg[k] = med(vals) if vals else None
+            agg["offered"] = sum(r["offered"] for r in rs)
+            agg["completed"] = sum(r["completed"] for r in rs)
+            agg["failed"] = sum(r["failed"] for r in rs)
+            agg["shed"] = sum(r["shed"] for r in rs)
+            agg["rounds"] = rs
+            st = serve.detailed_status()["applications"][name][
+                "deployments"]["KV"]["stats"]
+            for k in ("kv_hits", "kv_misses", "kv_hit_rate", "kv_bytes",
+                      "kv_evictions"):
+                if k in st:
+                    agg[k] = st[k]
+            legs[leg] = agg
+            print(f"{leg} (median of {rounds}): "
+                  f"{ {k: v for k, v in agg.items() if k != 'rounds'} }")
+            serve.delete(name)
+        artifact["serve"] = legs
+        artifact["serve_method"] = (
+            f"{rounds} interleaved cold/warm/warm_2x rounds of {secs}s "
+            "each; per-leg stats are the MEDIAN across rounds (ambient "
+            "load on the shared CPU box drifts slice-to-slice; "
+            "interleaving + median keeps the A/B at equal conditions)")
+
+        cold, warm, warm2 = legs["cold"], legs["warm"], legs["warm_2x"]
+        artifact["ttft_collapse_x_serve"] = round(
+            (cold.get("ttft_p50_ms") or 0.0)
+            / max(warm.get("ttft_p50_ms") or 1e-9, 1e-9), 2)
+        artifact["hits_advancing"] = bool(warm.get("kv_hits", 0) > 0)
+        shed_budget = cold["failed"] + cold["shed"]
+        artifact["p99_held_at_2x"] = bool(
+            warm2["p99_ms"] is not None and cold["p99_ms"] is not None
+            and warm2["p99_ms"] <= max(cold["p99_ms"], 1.0)
+            and warm2["failed"] + warm2["shed"] <= shed_budget)
+        artifact["ttft_collapse_x_engine"] = \
+            artifact["engine_ttft"]["ttft_collapse_x"]
+        artifact["collapse_ge_5x"] = bool(
+            artifact["engine_ttft"]["ttft_collapse_x"] >= 5.0)
+        print(f"engine collapse x{artifact['ttft_collapse_x_engine']} "
+              f"(>=5x: {artifact['collapse_ge_5x']}); serve collapse "
+              f"x{artifact['ttft_collapse_x_serve']}; hits advancing: "
+              f"{artifact['hits_advancing']}; p99 held at 2x load: "
+              f"{artifact['p99_held_at_2x']}")
+        with open(out_path, "w", encoding="utf-8") as f:
+            json.dump(artifact, f, indent=1, sort_keys=True)
+        print(f"artifact -> {out_path}")
+    finally:
+        try:
+            serve.shutdown()
+        except Exception:  # noqa: BLE001 — bench teardown
+            pass
+        if started_here:
+            ray_tpu.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

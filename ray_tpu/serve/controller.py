@@ -655,6 +655,9 @@ class ServeController:
         cb = {"active": 0, "max_slots": 0, "pending": 0,
               "tokens_generated": 0, "requests_completed": 0}
         cb_seen = False
+        kv = {"hits": 0, "misses": 0, "evictions": 0, "bytes": 0,
+              "pages": 0, "hit_tokens": 0}
+        kv_seen = False
         if reps:
             refs = [r.handle.stats_window.remote(window_s) for r in reps]
             ready, _ = ray_tpu.wait(refs, num_returns=len(refs), timeout=2.0)
@@ -679,6 +682,14 @@ class ServeController:
                             cb_seen = True
                             for k in cb:
                                 cb[k] += eng.get(k, 0)
+                            ekv = eng.get("kv")
+                            if ekv:
+                                # prefix/KV-cache plane: summed over the
+                                # replica fleet (monotonic counters +
+                                # live bytes/pages)
+                                kv_seen = True
+                                for k in kv:
+                                    kv[k] += ekv.get(k, 0)
                     except Exception:  # noqa: BLE001 — health check handles it
                         pass
         lats.sort()
@@ -696,6 +707,19 @@ class ServeController:
             # instead of inferring load from instantaneous occupancy
             win["cb_tokens_generated"] = cb["tokens_generated"]
             win["cb_requests_completed"] = cb["requests_completed"]
+        if kv_seen:
+            win["kv_hits"] = kv["hits"]
+            win["kv_misses"] = kv["misses"]
+            win["kv_evictions"] = kv["evictions"]
+            win["kv_bytes"] = kv["bytes"]
+            win["kv_pages"] = kv["pages"]
+            win["kv_hit_tokens"] = kv["hit_tokens"]
+            lookups = kv["hits"] + kv["misses"]
+            # lifetime hit rate: `rt serve status` / the dashboard show
+            # this as the hit-rate column; pollers wanting a windowed
+            # rate difference the monotonic hits/misses across polls
+            win["kv_hit_rate"] = round(kv["hits"] / lookups, 4) \
+                if lookups else 0.0
         with self._lock:
             s.win_stats = win
             s.metrics.append((now, total_ongoing))

@@ -15,6 +15,7 @@ proxies and replicas — (`await handle.remote(...)`).
 from __future__ import annotations
 
 import asyncio
+import os
 import random
 import threading
 import time
@@ -27,11 +28,17 @@ from ray_tpu.cluster.rpc import ChannelBroken
 from ray_tpu.exceptions import ActorError
 from ray_tpu.serve import obs
 from ray_tpu.serve.replica import REJECTED
+from ray_tpu.util import prefix_hash as _prefix
 
 _REFRESH_TTL_S = 30.0   # fallback only — the long-poll thread pushes updates
 _LONG_POLL_TIMEOUT_S = 10.0
 _RETRY_BACKOFF_S = 0.02
 _COLD_START_TIMEOUT_S = 60.0
+# cache-affinity routing: how much MORE in-flight load the residency-
+# preferred replica may carry before the router reverts to load-only —
+# affinity is a bias, not an override (a warm replica at its admission
+# ceiling still sheds to the cold one; the cold one then warms up)
+_AFFINITY_SLACK = int(os.environ.get("RT_KV_AFFINITY_SLACK", "4"))
 
 
 class _HandleMarker:
@@ -86,6 +93,9 @@ class _RouterState:
         self.replicas: List[Tuple[str, Any]] = []  # (replica_id, actor handle)
         self.counts: Dict[str, int] = {}
         self.model_ids: Dict[str, List[str]] = {}  # replica -> loaded models
+        # replica -> warm prefix digests (kv_residency piggybacked on
+        # replies, like model_ids) — the cache-affinity routing signal
+        self.kv_digests: Dict[str, frozenset] = {}
         self.fetched_at = 0.0
         self.lock = threading.Lock()
         self._poller: Optional[threading.Thread] = None
@@ -148,6 +158,9 @@ class _RouterState:
                 self.model_ids = {
                     rid: self.model_ids.get(rid, [])
                     for rid, _ in self.replicas}
+                self.kv_digests = {
+                    rid: self.kv_digests.get(rid, frozenset())
+                    for rid, _ in self.replicas}
 
     def refresh(self, force: bool = False) -> None:
         self._ensure_poller()
@@ -173,10 +186,36 @@ class _RouterState:
             f"no replicas for {self.app}/{self.deployment} after "
             f"{_COLD_START_TIMEOUT_S}s")
 
-    def pick(self, model_id: Optional[str] = None) -> Tuple[str, Any]:
+    def _kv_score(self, replica_id: str,
+                  prefix_digests: Optional[List[str]]) -> int:
+        """Residency score: how long a prefix of the request this replica
+        holds warm. ``prefix_digests`` is longest-first, so the FIRST
+        digest the replica's reported set contains wins; 0 = no known
+        residency (unknown replicas fall back to load-only). Caller holds
+        the lock."""
+        if not prefix_digests:
+            return 0
+        held = self.kv_digests.get(replica_id)
+        if not held:
+            return 0
+        n = len(prefix_digests)
+        for i, d in enumerate(prefix_digests):
+            if d in held:
+                return n - i
+        return 0
+
+    def pick(self, model_id: Optional[str] = None,
+             prefix_digests: Optional[List[str]] = None) -> Tuple[str, Any]:
         """Power-of-two-choices by local in-flight count; with a multiplexed
         model id, replicas already holding the model win (reference:
-        model-id-aware routing in the handle, ``serve/multiplex.py``)."""
+        model-id-aware routing in the handle, ``serve/multiplex.py``).
+
+        Cache-affinity bias: when the request carries prompt-prefix
+        digests (the LLM protocol) and the sampled pair's residency
+        scores differ, the replica holding the longer warm prefix wins —
+        unless it is already ``_AFFINITY_SLACK`` requests busier than the
+        alternative, where load-only resumes (Ray's locality-aware
+        scheduling idea applied to KV residency at the router)."""
         with self.lock:
             reps = self.replicas
             if not reps:
@@ -190,13 +229,23 @@ class _RouterState:
                 choice = reps[0]
             else:
                 a, b = random.sample(reps, 2)
-                choice = a if (self.counts.get(a[0], 0)
-                               <= self.counts.get(b[0], 0)) else b
+                ca = self.counts.get(a[0], 0)
+                cb = self.counts.get(b[0], 0)
+                sa = self._kv_score(a[0], prefix_digests)
+                sb = self._kv_score(b[0], prefix_digests)
+                if sa != sb:
+                    warm, cold = (a, b) if sa > sb else (b, a)
+                    cw = ca if warm is a else cb
+                    cc = cb if warm is a else ca
+                    choice = warm if cw - cc <= _AFFINITY_SLACK else cold
+                else:
+                    choice = a if ca <= cb else b
             self.counts[choice[0]] = self.counts.get(choice[0], 0) + 1
             return choice
 
     def complete(self, replica_id: str, rejected_ongoing: Optional[int] = None,
-                 model_ids: Optional[List[str]] = None):
+                 model_ids: Optional[List[str]] = None,
+                 kv_digests: Optional[List[str]] = None):
         with self.lock:
             if rejected_ongoing is not None:
                 # replica told us its real queue depth — adopt it
@@ -206,12 +255,16 @@ class _RouterState:
                     0, self.counts.get(replica_id, 1) - 1)
             if model_ids is not None:
                 self.model_ids[replica_id] = model_ids
+            if kv_digests is not None:
+                self.kv_digests[replica_id] = frozenset(kv_digests)
 
-    def note_models(self, replica_id: str, model_ids: Optional[List[str]]):
-        if model_ids is None:
-            return
+    def note_models(self, replica_id: str, model_ids: Optional[List[str]],
+                    kv_digests: Optional[List[str]] = None):
         with self.lock:
-            self.model_ids[replica_id] = model_ids
+            if model_ids is not None:
+                self.model_ids[replica_id] = model_ids
+            if kv_digests is not None:
+                self.kv_digests[replica_id] = frozenset(kv_digests)
 
 
 # one shared pool for all sync-path handle calls in this process
@@ -637,12 +690,17 @@ class DeploymentHandle:
                 t_start=t_entry, t_end=t_entry + (t_end - t0),
                 phases=phases)
 
+        # one prefix probe per call (not per retry): LLM-protocol bodies
+        # yield their prompt's chunk digests for cache-affinity routing;
+        # anything else routes load-only (digests None)
+        prefix_digests = _prefix.request_prefix_digests(args, kwargs)
         while True:
             router.refresh()
             if not router.replicas:
                 router.wake_and_wait()
             try:
-                rid, actor = router.pick(self._model_id or None)
+                rid, actor = router.pick(self._model_id or None,
+                                         prefix_digests)
             except LookupError:
                 continue
             t_rpc0 = time.perf_counter()
@@ -694,6 +752,7 @@ class DeploymentHandle:
                 raise
             status, payload = reply[0], reply[1]
             models = reply[2] if len(reply) > 2 else None
+            kv = reply[3] if len(reply) > 3 else None
             if status == REJECTED:
                 router.complete(rid, rejected_ongoing=payload)
                 if time.time() > deadline:
@@ -711,10 +770,10 @@ class DeploymentHandle:
                 continue
             if status == "stream":
                 # the generator keeps the in-flight slot until it completes
-                router.note_models(rid, models)
+                router.note_models(rid, models, kv)
                 emit(t_rpc0, streamed=True)
                 return DeploymentResponseGenerator(router, rid, actor, payload)
-            router.complete(rid, model_ids=models)
+            router.complete(rid, model_ids=models, kv_digests=kv)
             emit(t_rpc0)
             return payload
 

@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import asyncio
 import json
+import os
 import random
 import threading
 import time
@@ -52,11 +53,22 @@ def _parse_request(request: Any) -> Dict[str, Any]:
 
 
 class ContinuousLLM:
-    """One continuous-batching engine per replica; streams token ids."""
+    """One continuous-batching engine per replica; streams token ids.
+
+    Cache-aware by default: the engine retains completed slots' KV pages
+    in a bytes-budgeted prefix cache (``kv_cache_bytes``; 0 disables), so
+    shared-prefix admission prefills only the uncached suffix and TTFT
+    collapses on hits. Residency is reported through ``kv_residency`` so
+    the handle router can bias power-of-two choice toward the warm
+    replica; hit/miss/eviction/bytes land on the ``rt_serve_kv_cache_*``
+    series.
+    """
 
     def __init__(self, preset: str = "debug", *, max_slots: int = 8,
                  max_len: int = 256, decode_stride: int = 8,
-                 seed: int = 0, name: str = ""):
+                 seed: int = 0, name: str = "",
+                 kv_cache_bytes: int = 64 * 1024 * 1024,
+                 sampling: bool = False):
         import jax
 
         from ray_tpu.models import llama
@@ -69,6 +81,10 @@ class ContinuousLLM:
         self.params = llama.init_params(jax.random.key(seed), self.cfg)
         tags = {"fn": f"cb:{self._name}"}
         gauge_tags = {"deployment": self._name}
+        # counter snapshots: kv metrics are cumulative in the engine;
+        # the tick publishes deltas so the Prometheus counters advance
+        self._kv_seen = {"hits": 0, "misses": 0, "evictions": 0}
+        self._kv_pub_lock = threading.Lock()
 
         def on_tick(active: int, slots: int) -> None:
             # the continuous-batching yardstick: fused rows per decode
@@ -77,17 +93,79 @@ class ContinuousLLM:
             obs.batch_occupancy_hist().observe(active / max(1, slots),
                                                tags=tags)
             obs.cb_slots_gauge().set(active, tags=gauge_tags)
+            self._publish_kv()
 
         self.engine = ContinuousEngine(self.params, self.cfg,
                                        max_slots=max_slots, max_len=max_len,
                                        decode_stride=decode_stride,
-                                       on_tick=on_tick)
+                                       on_tick=on_tick,
+                                       kv_cache_bytes=kv_cache_bytes,
+                                       kv_label=self._name,
+                                       sampling=sampling)
+        self._kv_push_s = float(os.environ.get("RT_KV_PUSH_S", "5"))
+        if kv_cache_bytes and self._kv_push_s > 0:
+            # @memkv/ pushes go through a blocking GCS RPC — NEVER from
+            # on_tick: the tick callback runs on the engine thread, and
+            # a multi-second kv_put stall there freezes admission AND
+            # decode for every live slot (measured: warm-leg p99 went
+            # 181ms -> 2.6s in the kv bench before this moved off-tick)
+            threading.Thread(target=self._kv_push_loop,
+                             name=f"kv-push:{self._name}",
+                             daemon=True).start()
+
+    def _kv_push_loop(self) -> None:
+        """Throttled ``@memkv/`` snapshots so ``rt memory`` (any
+        process) sees this replica's retained pages like it sees object
+        ledgers. Dies with the engine (daemon; exits on shutdown);
+        ``RT_KV_PUSH_S`` tunes the cadence (<= 0 disables)."""
+        import ray_tpu
+
+        while not self.engine.stopped():
+            time.sleep(self._kv_push_s)
+            try:
+                from ray_tpu.util import memory as rt_memory
+
+                if ray_tpu.is_initialized():
+                    rt_memory.publish_kv_snapshot(
+                        ray_tpu.global_worker()._require_backend())
+            except Exception:  # noqa: BLE001 — telemetry best-effort
+                pass
+
+    def _publish_kv(self) -> None:
+        """Engine-tick kv telemetry: counter deltas onto the
+        ``rt_serve_kv_cache_*`` series (in-process metric writes only —
+        the cross-process snapshot push lives on its own thread)."""
+        kv = self.engine.kv_stats()
+        if not kv:
+            return
+        from ray_tpu.serve import obs
+
+        tags = {"deployment": self._name}
+        with self._kv_pub_lock:
+            d_hits = kv["hits"] - self._kv_seen["hits"]
+            d_miss = kv["misses"] - self._kv_seen["misses"]
+            d_evic = kv["evictions"] - self._kv_seen["evictions"]
+            self._kv_seen = {"hits": kv["hits"], "misses": kv["misses"],
+                             "evictions": kv["evictions"]}
+        if d_hits > 0:
+            obs.kv_cache_hits().inc(d_hits, tags=tags)
+        if d_miss > 0:
+            obs.kv_cache_misses().inc(d_miss, tags=tags)
+        if d_evic > 0:
+            obs.kv_cache_evictions().inc(d_evic, tags=tags)
+        obs.kv_cache_bytes().set(kv["bytes"], tags=tags)
 
     def engine_stats(self) -> Dict[str, Any]:
         """Duck-typed surface the replica's ``stats_window`` picks up —
-        slot occupancy travels to the controller, `rt serve status` and
-        the autoscaler decision log."""
+        slot occupancy and kv-cache stats travel to the controller,
+        `rt serve status` and the autoscaler decision log."""
         return self.engine.stats()
+
+    def kv_residency(self) -> List[str]:
+        """Duck-typed surface the replica reports on every reply: the
+        warm prefix digests the router matches request prompts against
+        (cache-affinity routing)."""
+        return self.engine.kv_residency()
 
     def check_health(self) -> None:
         """A dead engine thread must fail the replica health check so
@@ -96,9 +174,19 @@ class ContinuousLLM:
         self.engine.check_alive()
 
     async def __call__(self, request: Any):
+        from ray_tpu.serve import obs
+
         body = _parse_request(request)
         prompt = body["tokens"]
         n_new = int(body.get("max_new_tokens", 16))
+        temperature = float(body.get("temperature", 0.0))
+        top_k = int(body.get("top_k", 0))
+        sample_seed = int(body.get("seed", 0))
+        # the request context is ambient here (handle_request runs the
+        # callable under it); the admission span is emitted once the
+        # engine reports how many prompt tokens the prefix cache covered
+        req_ctx = obs.current_request_context()
+        t_req = time.time()
         loop = asyncio.get_running_loop()
         aq: "asyncio.Queue" = asyncio.Queue()
 
@@ -112,15 +200,37 @@ class ContinuousLLM:
 
         handle = self.engine.submit_cb(
             prompt, n_new,
-            lambda burst: loop.call_soon_threadsafe(deliver, burst))
+            lambda burst: loop.call_soon_threadsafe(deliver, burst),
+            temperature=temperature, top_k=top_k, seed=sample_seed)
         engine = self.engine
+        name = self._name
 
         async def stream():
+            first = True
             try:
                 while True:
                     tok = await aq.get()
                     if tok is None:
                         return
+                    if first:
+                        first = False
+                        if req_ctx is not None:
+                            # cached-token count on the request span: how
+                            # much of THIS prompt's prefill the kv cache
+                            # absorbed (rt trace <rid> shows it next to
+                            # the proxy's ttft phase)
+                            span = obs.new_span_id()
+                            obs.emit_span(
+                                f"serve:{req_ctx['request_id']}:kv:"
+                                f"{span[:8]}",
+                                f"kv:{name}",
+                                request_id=req_ctx["request_id"],
+                                span_id=span,
+                                parent_span_id=req_ctx.get("span_id"),
+                                t_start=t_req, t_end=time.time(),
+                                phases={"cached_tokens": float(
+                                    handle.cached_tokens or 0),
+                                    "prompt_tokens": float(len(prompt))})
                     yield tok
             finally:
                 # client gone mid-stream: free the slot for the next
@@ -192,10 +302,14 @@ def continuous_llm_app(preset: str = "debug", *, max_slots: int = 8,
                        max_ongoing_requests: Optional[int] = None,
                        autoscaling_config=None,
                        ray_actor_options: Optional[Dict] = None,
-                       num_replicas: int = 1, seed: int = 0):
+                       num_replicas: int = 1, seed: int = 0,
+                       kv_cache_bytes: int = 64 * 1024 * 1024,
+                       sampling: bool = False):
     """A ready-to-run continuous-batching Application. ``max_ongoing``
     defaults to 2x the slot count: the engine's pending queue absorbs a
-    burst while slots drain, and the replica rejects beyond that."""
+    burst while slots drain, and the replica rejects beyond that.
+    ``kv_cache_bytes=0`` disables prefix/KV reuse (the cold-prefill
+    control the cache bench compares against)."""
     from ray_tpu import serve
 
     dep = serve.deployment(ContinuousLLM).options(
@@ -205,7 +319,8 @@ def continuous_llm_app(preset: str = "debug", *, max_slots: int = 8,
         autoscaling_config=autoscaling_config,
         ray_actor_options=ray_actor_options)
     return dep.bind(preset, max_slots=max_slots, max_len=max_len,
-                    decode_stride=decode_stride, seed=seed, name=name)
+                    decode_stride=decode_stride, seed=seed, name=name,
+                    kv_cache_bytes=kv_cache_bytes, sampling=sampling)
 
 
 def static_llm_app(preset: str = "debug", *, max_batch: int = 8,
@@ -342,6 +457,10 @@ def poisson_load(request_fn: Callable[[], int], *, rps: float,
     hide its queueing by slowing the client down — here late requests
     keep arriving on schedule (up to ``max_inflight``), so p99 reflects
     what an independent client population would see.
+
+    ``request_fn`` returns the token count, or ``(token_count,
+    ttft_seconds)`` — the KV-cache bench's streamed closures report
+    time-to-first-token, surfaced as ``ttft_p50_ms``/``ttft_p99_ms``.
     """
     from concurrent.futures import ThreadPoolExecutor
 
@@ -353,6 +472,7 @@ def poisson_load(request_fn: Callable[[], int], *, rps: float,
         if t < duration_s:
             arrivals.append(t)
     lat: List[float] = []
+    ttfts: List[float] = []
     toks = [0]
     failed = [0]
     shed = [0]
@@ -370,9 +490,14 @@ def poisson_load(request_fn: Callable[[], int], *, rps: float,
         finally:
             sem.release()
         dt = time.perf_counter() - t0
+        ttft = None
+        if isinstance(n, tuple):
+            n, ttft = n
         with lock:
             lat.append(dt)
             toks[0] += n
+            if ttft is not None:
+                ttfts.append(ttft)
 
     t_start = time.perf_counter()
     with ThreadPoolExecutor(max_workers=max_inflight + 4) as pool:
@@ -388,18 +513,23 @@ def poisson_load(request_fn: Callable[[], int], *, rps: float,
             pool.submit(one)
     wall = time.perf_counter() - t_start
     lat.sort()
+    ttfts.sort()
 
-    def pct(q: float) -> float:
-        if not lat:
+    def pct(vals: List[float], q: float) -> float:
+        if not vals:
             return 0.0
-        return lat[min(len(lat) - 1, int(q * (len(lat) - 1) + 0.5))]
+        return vals[min(len(vals) - 1, int(q * (len(vals) - 1) + 0.5))]
 
-    return {"offered": len(arrivals),
-            "offered_rps": round(len(arrivals) / duration_s, 2),
-            "completed": len(lat), "failed": failed[0], "shed": shed[0],
-            "wall_s": round(wall, 3),
-            "rps": round(len(lat) / wall, 2),
-            "tok_s": round(toks[0] / wall, 1),
-            "tokens": toks[0],
-            "p50_ms": round(pct(0.50) * 1e3, 1),
-            "p99_ms": round(pct(0.99) * 1e3, 1)}
+    out = {"offered": len(arrivals),
+           "offered_rps": round(len(arrivals) / duration_s, 2),
+           "completed": len(lat), "failed": failed[0], "shed": shed[0],
+           "wall_s": round(wall, 3),
+           "rps": round(len(lat) / wall, 2),
+           "tok_s": round(toks[0] / wall, 1),
+           "tokens": toks[0],
+           "p50_ms": round(pct(lat, 0.50) * 1e3, 1),
+           "p99_ms": round(pct(lat, 0.99) * 1e3, 1)}
+    if ttfts:
+        out["ttft_p50_ms"] = round(pct(ttfts, 0.50) * 1e3, 1)
+        out["ttft_p99_ms"] = round(pct(ttfts, 0.99) * 1e3, 1)
+    return out

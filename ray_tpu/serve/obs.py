@@ -229,6 +229,36 @@ def cb_slots_gauge() -> M.Gauge:
         tag_keys=("deployment",)))
 
 
+def kv_cache_hits() -> M.Counter:
+    return _metric("kv_hits", lambda: M.get_or_create(
+        M.Counter, "rt_serve_kv_cache_hits",
+        "Prefix/KV-cache admission hits (prefill ran only on the "
+        "uncached suffix)",
+        tag_keys=("deployment",)))
+
+
+def kv_cache_misses() -> M.Counter:
+    return _metric("kv_misses", lambda: M.get_or_create(
+        M.Counter, "rt_serve_kv_cache_misses",
+        "Prefix/KV-cache admission misses (full cold prefill)",
+        tag_keys=("deployment",)))
+
+
+def kv_cache_evictions() -> M.Counter:
+    return _metric("kv_evictions", lambda: M.get_or_create(
+        M.Counter, "rt_serve_kv_cache_evictions",
+        "Prefix/KV-cache pages evicted by the bytes-budget LRU",
+        tag_keys=("deployment",)))
+
+
+def kv_cache_bytes() -> M.Gauge:
+    return _metric("kv_bytes", lambda: M.get_or_create(
+        M.Gauge, "rt_serve_kv_cache_bytes",
+        "Retained prefix/KV-cache page bytes per engine (LRU budget "
+        "from RT_KV_CACHE_BYTES / kv_cache_bytes)",
+        tag_keys=("deployment",)))
+
+
 def proxy_requests_total() -> M.Counter:
     return _metric("proxy_requests", lambda: M.get_or_create(
         M.Counter, "rt_proxy_requests_total",

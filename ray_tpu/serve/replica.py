@@ -225,9 +225,9 @@ class ReplicaActor:
     async def handle_request(self, method_name: str, args: Tuple,
                              kwargs: Dict,
                              meta: Optional[Dict] = None) -> Tuple:
-        """Returns ("ok", result, loaded_model_ids),
-        ("stream", stream_id, loaded_model_ids) for generator results, or
-        (REJECTED, ongoing_count)."""
+        """Returns ("ok", result, loaded_model_ids, kv_residency),
+        ("stream", stream_id, loaded_model_ids, kv_residency) for
+        generator results, or (REJECTED, ongoing_count)."""
         # websocket inbound frames bypass admission control: the
         # connection's __ws_connect__ stream already holds a slot, and
         # rejecting its own frames would wedge every connection on a
@@ -339,6 +339,17 @@ class ReplicaActor:
                           "replica_id": self._replica_id})
             self._total_served += 1
             models = loaded_model_ids(self._instance)
+            kv = None
+            kv_fn = getattr(self._instance, "kv_residency", None)
+            if kv_fn is not None:
+                # duck-typed like loaded_model_ids: a cache-aware engine
+                # reports its warm prefix digests on every reply, so the
+                # router's residency view is as fresh as its last call
+                # to this replica (no extra RPC, no controller round)
+                try:
+                    kv = kv_fn()
+                except Exception:  # noqa: BLE001 — residency is advisory
+                    pass
             if inspect.isgenerator(result) or inspect.isasyncgen(result):
                 sid = f"s{self._next_stream_id}"
                 self._next_stream_id += 1
@@ -364,8 +375,8 @@ class ReplicaActor:
                 # counts active streams (admission control, autoscaler
                 # metrics, and prepare_shutdown draining all depend on it)
                 self._ongoing += 1
-                return ("stream", sid, models)
-            return ("ok", result, models)
+                return ("stream", sid, models, kv)
+            return ("ok", result, models, kv)
         finally:
             self._ongoing -= 1
 

@@ -30,6 +30,7 @@ from typing import Any, Dict, List, Optional
 from ray_tpu.core import object_ledger
 
 _LEDGER_KV_PREFIX = "@memobj/"
+_KVCACHE_KV_PREFIX = "@memkv/"
 
 
 def _fmt_bytes(n: Optional[float]) -> str:
@@ -159,6 +160,60 @@ def _kv_ledgers(backend) -> List[Dict[str, Any]]:
     return out
 
 
+def publish_kv_snapshot(backend) -> None:
+    """Push this process's live prefix/KV-cache stats to the GCS KV
+    (``@memkv/<address>``) — serve replicas call this on a throttle so
+    ``rt memory`` run from ANY process sees the serving plane's retained
+    KV pages next to the object ledgers."""
+    try:
+        from ray_tpu.models import serving
+
+        caches = serving.live_kv_cache_stats()
+    except Exception:  # noqa: BLE001 — no jax/serving in this process
+        return
+    if not caches:
+        return
+    owner = getattr(backend, "address", "local")
+    try:
+        backend.kv_put(f"{_KVCACHE_KV_PREFIX}{owner}",
+                       json.dumps({"t": time.time(), "owner": owner,
+                                   "caches": caches}))
+    except Exception:  # noqa: BLE001 — KV unavailable (local backend)
+        pass
+
+
+def _kv_cache_snapshots(backend) -> List[Dict[str, Any]]:
+    """Every live process's pushed KV-cache snapshot plus this process's
+    live registry (fresher than its last push), stale entries dropped —
+    the ledger pattern, applied to serving KV pages."""
+    out: List[Dict[str, Any]] = []
+    now = time.time()
+    try:
+        for key in backend.kv_keys(_KVCACHE_KV_PREFIX):
+            raw = backend.kv_get(key)
+            if not raw:
+                continue
+            try:
+                snap = json.loads(raw)
+            except (ValueError, KeyError):
+                continue
+            if now - snap.get("t", 0.0) <= _LEDGER_STALE_S:
+                out.append(snap)
+    except Exception:  # noqa: BLE001 — KV unavailable (local backend)
+        pass
+    own = getattr(backend, "address", "local")
+    out = [s for s in out if s.get("owner") != own]
+    try:
+        from ray_tpu.models import serving
+
+        caches = serving.live_kv_cache_stats()
+        if caches:
+            out.append({"t": now, "owner": own, "caches": caches})
+    except Exception:  # noqa: BLE001 — serving not imported here
+        pass
+    return out
+
+
 def _merge_owner_info(ledgers: List[Dict[str, Any]]
                       ) -> Dict[str, Dict[str, Any]]:
     """oid -> best-known ledger entry across processes. The OWNER's entry
@@ -247,6 +302,7 @@ def memory_snapshot(limit: int = 200,
         "t": time.time(),
         "nodes": nodes,
         "ledgers": ledgers,
+        "kv_caches": _kv_cache_snapshots(backend),
         "leak_suspects": suspects,
     }
     if include_devices:
@@ -347,6 +403,24 @@ def memory_summary(limit: int = 200, top_n: int = 10,
             f"{_fmt_bytes(o['size']):>12} {o.get('state', '?'):<10} "
             f"{o.get('age_s', 0.0):>8.1f}s  "
             f"owner={o.get('owner', '?')} {o.get('call_site', '')}")
+
+    kv_snaps = snap.get("kv_caches") or []
+    if any(s.get("caches") for s in kv_snaps):
+        lines.append("")
+        lines.append("=== Serving prefix/KV-cache pages ===")
+        lines.append(f"{'owner':<28} {'engine':<16} {'pages':>6} "
+                     f"{'bytes':>12} {'budget':>12} {'hits':>8} "
+                     f"{'misses':>8} {'evict':>6}")
+        for s in kv_snaps:
+            for c in s.get("caches", ()):
+                lines.append(
+                    f"{str(s.get('owner', '?')):<28} "
+                    f"{str(c.get('label') or '?'):<16} "
+                    f"{c.get('pages', 0):>6} "
+                    f"{_fmt_bytes(c.get('bytes')):>12} "
+                    f"{_fmt_bytes(c.get('max_bytes')):>12} "
+                    f"{c.get('hits', 0):>8} {c.get('misses', 0):>8} "
+                    f"{c.get('evictions', 0):>6}")
 
     lines.append("")
     suspects = snap["leak_suspects"]

@@ -416,6 +416,126 @@ assert d["exit_code"] == 0 and d["healthy"], d["findings"]
 print("doctor healthy after serve leg")
 '
 
+echo "== kv-cache leg: kill the warm replica — cold serves exact, hit-rate recovers =="
+# Warm one replica's prefix cache with shared-prefix traffic (affinity
+# routing concentrates it), arm worker.kill against handle_request so
+# the NEXT shared-prefix request kills exactly the warm replica, then
+# assert: traffic continues on the cold replica with byte-identical
+# tokens (misses counted — a cold cache must never mean wrong output),
+# and after the controller restarts the replica the hit-rate recovers.
+python - <<'EOF'
+import subprocess
+import sys
+import time
+
+import ray_tpu
+from ray_tpu import serve
+from ray_tpu.serve.llm import continuous_llm_app
+
+RT = [sys.executable, "-m", "ray_tpu.scripts.cli"]
+ray_tpu.init(address="auto")
+
+app = continuous_llm_app(
+    "debug", max_slots=4, max_len=192, decode_stride=4, name="KV",
+    num_replicas=2, kv_cache_bytes=32 << 20)
+serve.run(app, name="kv-smoke", route_prefix="/kvsmoke")
+h = serve.get_deployment_handle("KV", "kv-smoke")
+
+PROBE = {"tokens": list(range(1, 129)) + [200, 201, 202, 203],
+         "max_new_tokens": 8}
+
+
+def probe(retries=1):
+    last = None
+    for _ in range(retries):
+        try:
+            return list(h.remote(dict(PROBE)).result())
+        except Exception as e:  # noqa: BLE001 — retry through failover
+            last = e
+            time.sleep(0.5)
+    raise last
+
+
+def kv_stats():
+    d = serve.detailed_status()["applications"]["kv-smoke"]
+    return d["deployments"]["KV"]["stats"]
+
+
+def wait_kv(cond, what, timeout=45.0):
+    # the controller's stats window is a polled snapshot — give the
+    # poll cadence time to surface the engines' monotonic counters
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        st = kv_stats()
+        if cond(st):
+            return st
+        time.sleep(0.5)
+    raise AssertionError(f"{what}: {kv_stats()}")
+
+
+ref = probe()
+assert len(ref) == 8, ref
+for _ in range(4):  # warm + concentrate: residency biases the router
+    assert probe() == ref, "warm-path token drift"
+st = wait_kv(lambda s: s.get("kv_hits", 0) > 0, "cache never warmed")
+print(f"warm: hits {st['kv_hits']}, misses {st['kv_misses']}, "
+      f"hit-rate {st['kv_hit_rate']}")
+
+# the next shared-prefix request routes to the warm replica (affinity)
+# and dies at handle_request entry
+subprocess.run(RT + ["chaos", "arm", "--site", "worker.kill",
+                     "--target", "handle_request", "--at", "1",
+                     "--max-fires", "1", "--seed", "23"], check=True)
+time.sleep(2.5)  # plan rides the heartbeat to raylet + live workers
+try:
+    probe()
+    print("kill-probe: reply arrived (kill may land on teardown)")
+except Exception as e:  # noqa: BLE001 — the kill surfaces here
+    print("kill-probe raised:", type(e).__name__)
+subprocess.run(RT + ["chaos", "disarm"], check=True)
+time.sleep(2.5)  # disarm rides the heartbeat too
+
+# traffic continues on the cold replica: token-exact (greedy decode on
+# identical seed-0 params — a cold cache means misses, never drift)
+for i in range(6):
+    assert probe(retries=6) == ref, f"cold-replica token drift at {i}"
+st = wait_kv(lambda s: s.get("kv_misses", 0) > 0,
+             "cold replica counted no misses")
+print(f"traffic continued cold: 6/6 token-exact "
+      f"(misses now {st['kv_misses']})")
+
+# the controller restarts the killed replica; its re-warmed cache +
+# the survivor's make the hit-rate recover
+deadline = time.time() + 60
+while time.time() < deadline:
+    deps = serve.status()["kv-smoke"]["deployments"]["KV"]
+    if deps["replicas"] == 2:
+        break
+    time.sleep(0.5)
+assert deps["replicas"] == 2, deps
+before = wait_kv(lambda s: s.get("kv_hits", 0) > 0,
+                 "no settled post-restart snapshot")["kv_hits"]
+for _ in range(6):
+    assert probe(retries=6) == ref, "post-restart token drift"
+st = wait_kv(lambda s: s.get("kv_hits", 0) >= before + 4,
+             f"hit-rate did not recover past {before}")
+print(f"recovered: 2/2 replicas, hits {before} -> {st['kv_hits']}, "
+      f"hit-rate {st['kv_hit_rate']}")
+serve.delete("kv-smoke")
+ray_tpu.shutdown()
+EOF
+$RT errors --origin chaos | grep -q "worker.kill" \
+    || { echo "FAIL: kv-leg worker.kill not on the chaos feed"; exit 1; }
+
+echo "== doctor must exit 0 after the kv-cache leg drains =="
+sleep 3
+$RT doctor --window 2 --json | python -c '
+import json, sys
+d = json.load(sys.stdin)
+assert d["exit_code"] == 0 and d["healthy"], d["findings"]
+print("doctor healthy after kv-cache leg")
+'
+
 echo "== rlhf leg: weight sync survives rpc.drop on the oid-frame fetch =="
 # One full generate -> train -> weight-sync iteration with rpc.drop armed
 # against the push channel the generator fetches the shipped weights

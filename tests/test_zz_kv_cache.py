@@ -1,0 +1,477 @@
+"""Cache-aware serving: prefix/KV-cache reuse + affinity routing.
+
+Covers the tentpole's correctness surface:
+
+  - shared-prefix TOKEN EXACTNESS: a warm admission (cached prefix
+    restored, prefill only on the suffix) emits byte-identical tokens to
+    a cold prefill — for fresh suffixes, multi-turn session replay, and
+    under concurrent co-batched traffic;
+  - LRU eviction under a tight bytes budget (and the oversize guard);
+  - weight-swap invalidation through the drain-barrier ``load_params``
+    (a post-swap request must NOT restore pages computed under the old
+    weights);
+  - cache-affinity routing: power-of-two biased by reported residency,
+    the slack guard, and the load-only fallback when residency is
+    unknown;
+  - sampling decode (PR 12's unclaimed stretch): seeded determinism,
+    greedy rows bit-exact beside sampled ones, engine flag guard;
+  - serve-level integration: kv stats travel engine -> replica ->
+    controller win_stats; `rt_serve_kv_cache_*` series advance.
+
+Named test_zz_* so it sorts late (tier-1, `-m 'not slow'`-safe).
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.util import prefix_hash as PH
+
+
+def _mk(sampling=False, cache=None, max_slots=4, max_len=160):
+    import jax
+
+    from ray_tpu.models import llama
+    from ray_tpu.models.serving import ContinuousBatcher
+
+    cfg = llama.PRESETS["debug"]
+    params = llama.init_params(jax.random.key(0), cfg)
+    return ContinuousBatcher(params, cfg, max_slots=max_slots,
+                             max_len=max_len, prefix_cache=cache,
+                             sampling=sampling)
+
+
+def _run_one(b, prompt, n=8, **kw):
+    rid, first, done = b.submit_ex(np.asarray(prompt, np.int32), n, **kw)
+    toks = [first]
+    while not done:
+        for r, t, d in b.step():
+            if r == rid:
+                toks.append(t)
+                done = d
+    return toks
+
+
+PREFIX = list(range(1, 49))  # 48 tokens = 3 chunks at chunk=16
+
+
+def _cache(max_bytes=64 << 20, chunk=16, label="t"):
+    from ray_tpu.models.serving import PrefixKVCache
+
+    return PrefixKVCache(chunk=chunk, max_bytes=max_bytes, label=label)
+
+
+# ---------------------------------------------------------------------------
+# token exactness
+# ---------------------------------------------------------------------------
+
+
+def test_warm_equals_cold_shared_prefix():
+    """The headline invariant: warm-hit output == cold-prefill output,
+    byte-identical, across different suffixes sharing one prefix."""
+    cold = _mk()
+    cache = _cache()
+    warm = _mk(cache=cache)
+    for suffix in ([60, 61, 62, 63], [70, 71], [90]):
+        prompt = PREFIX + suffix
+        out_cold = _run_one(cold, prompt)
+        out_warm_miss = _run_one(warm, prompt)   # first sight: may miss
+        out_warm_hit = _run_one(warm, prompt)    # resident now: hit
+        assert out_warm_miss == out_cold
+        assert out_warm_hit == out_cold
+    st = cache.stats()
+    assert st["hits"] >= 3, st
+    # restore lengths are quantized to power-of-two chunk multiples
+    # (48 tokens -> 32 restored), bounding warm-prefill program count
+    assert st["hit_tokens"] >= 3 * 32, st
+
+
+def test_multi_turn_session_replay_exact():
+    """Turn N+1's prompt extends turn N's prompt + output: the cache
+    serves the growing context (captured pages include generated-token
+    KV), token-exact vs cold at every turn."""
+    cold = _mk(max_len=200)
+    cache = _cache()
+    warm = _mk(cache=cache, max_len=200)
+    history = list(PREFIX)
+    for turn in range(3):
+        prompt = history + [200 + turn]
+        out_cold = _run_one(cold, prompt, n=8)
+        out_warm = _run_one(warm, prompt, n=8)
+        assert out_warm == out_cold, f"turn {turn} drifted"
+        history = prompt + out_cold
+    st = cache.stats()
+    assert st["hits"] >= 2, st  # turns 1, 2 hit the prior turn's pages
+
+
+def test_warm_exact_under_cobatched_traffic():
+    """A warm admission joining slots mid-flight emits the same tokens
+    as a solo cold run — cache restore must not perturb neighbors and
+    vice versa."""
+    cold = _mk()
+    cache = _cache()
+    warm = _mk(cache=cache)
+    p_a = PREFIX + [60, 61, 62, 63]
+    p_b = list(range(101, 131))  # unrelated prompt
+    want_a = _run_one(cold, p_a, n=10)
+    want_b = _run_one(cold, p_b, n=10)
+    _run_one(warm, p_a, n=10)  # seed the cache
+    ra, _, _ = warm.submit_ex(np.asarray(p_b, np.int32), 10)
+    rb, _, _ = warm.submit_ex(np.asarray(p_a, np.int32), 10)  # warm hit
+    got = {ra: [want_b[0]], rb: [want_a[0]]}
+    while warm.num_active:
+        for r, t, d in warm.step():
+            got[r].append(t)
+    assert got[rb] == want_a
+    assert got[ra] == want_b
+    assert cache.stats()["hits"] >= 1
+
+
+def test_prefill_restores_only_suffix():
+    """The perf mechanism itself: a warm admission runs the suffix-only
+    prefill program (cached_tokens recorded on last_admission)."""
+    cache = _cache()
+    warm = _mk(cache=cache)
+    prompt = PREFIX + [60, 61, 62, 63]
+    _run_one(warm, prompt)
+    assert warm.last_admission["cached_tokens"] == 0
+    _run_one(warm, prompt)
+    # 48 cached tokens restore at the quantized length 32 (largest
+    # power-of-two chunk multiple): suffix prefill covers the rest
+    assert warm.last_admission["cached_tokens"] == 32
+    assert warm.last_admission["prompt_tokens"] == 52
+
+
+# ---------------------------------------------------------------------------
+# eviction / budget
+# ---------------------------------------------------------------------------
+
+
+def test_lru_eviction_under_tight_budget():
+    """Budget for ~2 entries: inserting a third evicts the least
+    recently used; a touched entry survives."""
+    cache = _cache()
+    probe = _mk(cache=cache)
+    _run_one(probe, [600 + i for i in range(32)] + [1])  # 32-token prefix
+    one_entry_bytes = cache.stats()["bytes"]
+    assert one_entry_bytes > 0
+
+    tight = _cache(max_bytes=int(2.5 * one_entry_bytes))
+    b = _mk(cache=tight)
+    p1, p2, p3 = ([300 + i for i in range(32)],
+                  [400 + i for i in range(32)],
+                  [500 + i for i in range(32)])
+    _run_one(b, p1 + [1])
+    _run_one(b, p2 + [1])
+    assert tight.stats()["pages"] == 2
+    _run_one(b, p1 + [2])  # touch p1 -> p2 becomes LRU
+    _run_one(b, p3 + [1])  # evicts p2
+    st = tight.stats()
+    assert st["evictions"] >= 1, st
+    assert st["bytes"] <= tight.max_bytes, st
+    assert tight.cached_len(np.asarray(p1, np.int32)) == 32
+    assert tight.cached_len(np.asarray(p2, np.int32)) == 0
+    assert tight.cached_len(np.asarray(p3, np.int32)) == 32
+
+
+def test_oversized_entry_rejected():
+    """An entry larger than the whole budget must not wedge the LRU."""
+    tiny = _cache(max_bytes=64)  # smaller than any page set
+    b = _mk(cache=tiny)
+    out = _run_one(b, PREFIX + [60])
+    assert len(out) == 8
+    st = tiny.stats()
+    assert st["pages"] == 0, st
+    assert st["bytes"] == 0, st
+
+
+def _pages(n):
+    """Dummy KV page arrays [L, n, hkv, hd] for direct-insert tests."""
+    return (np.zeros((2, n, 2, 4), np.float32),
+            np.zeros((2, n, 2, 4), np.float32))
+
+
+def test_superset_insert_coalesces_covered_entry():
+    """A superset insert absorbs the prefix entry it covers: a growing
+    session is ONE entry's bytes, not a ladder of duplicate pages."""
+    cache = _cache(max_bytes=1 << 20)
+    toks = np.asarray(list(range(1, 97)), np.int32)  # 96 tokens
+    k32, v32 = _pages(32)
+    assert cache.insert(toks[:32], k32, v32)
+    k96, v96 = _pages(96)
+    assert cache.insert(toks, k96, v96)
+    st = cache.stats()
+    assert st["pages"] == 1, st
+    assert st["bytes"] == int(k96.nbytes + v96.nbytes), st
+    # the shared prefix still hits, served by the surviving superset
+    hit = cache.lookup(np.asarray(list(toks[:32]) + [999], np.int32))
+    assert hit is not None and hit[0] == 32
+
+
+def test_eviction_repoints_shared_chunk_rows():
+    """Evicting one of two entries that share only a short prefix must
+    repoint the shared chunk rows to a survivor covering them — not
+    orphan them, which would stop the resident entry serving hits."""
+    shared = list(range(1, 17))  # one 16-token shared chunk
+    a = np.asarray(shared + list(range(100, 116)), np.int32)
+    b = np.asarray(shared + list(range(200, 216)), np.int32)
+    c = np.asarray(list(range(300, 332)), np.int32)  # unrelated
+    ka, va = _pages(32)
+    entry_bytes = int(ka.nbytes + va.nbytes)
+    cache = _cache(max_bytes=int(2.5 * entry_bytes))
+    assert cache.insert(b, *_pages(32))
+    assert cache.insert(a, *_pages(32))  # a now owns the shared row
+    # touch b so a becomes LRU, then force one eviction
+    assert cache.lookup(np.asarray(list(b) + [999], np.int32))[0] == 32
+    assert cache.insert(c, *_pages(32))
+    st = cache.stats()
+    assert st["evictions"] == 1, st
+    assert cache.cached_len(a) == 16   # a gone; shared chunk survives
+    hit = cache.lookup(np.asarray(shared + [999], np.int32))
+    assert hit is not None and hit[0] == 16, "shared row was orphaned"
+
+
+# ---------------------------------------------------------------------------
+# weight-swap invalidation
+# ---------------------------------------------------------------------------
+
+
+def test_weight_swap_invalidates_cache():
+    """PR 12's drain-barrier ``load_params`` swap poisons every cached
+    page: post-swap requests must run a cold prefill under the NEW
+    weights and match a fresh new-weights engine exactly."""
+    import jax
+
+    from ray_tpu.models import llama
+    from ray_tpu.models.serving import ContinuousEngine
+
+    cfg = llama.PRESETS["debug"]
+    p_old = llama.init_params(jax.random.key(0), cfg)
+    p_new = llama.init_params(jax.random.key(9), cfg)
+    prompt = PREFIX + [60, 61]
+
+    def collect(engine, prompt, n=8):
+        q = engine.submit_stream(prompt, n)
+        toks = []
+        while True:
+            t = q.get(timeout=60)
+            if t is None:
+                return toks
+            toks.append(t)
+
+    eng = ContinuousEngine(p_old, cfg, max_slots=2, max_len=160,
+                           decode_stride=2, kv_cache_bytes=64 << 20,
+                           kv_label="swap")
+    try:
+        collect(eng, prompt)  # seed pages under OLD weights
+        cache = eng._batcher.prefix_cache
+        assert cache.stats()["pages"] >= 1
+        eng.load_params(jax.tree_util.tree_map(np.asarray, p_new))
+        st = cache.stats()
+        assert st["pages"] == 0, st
+        assert st["invalidations"] >= 1, st
+        got = collect(eng, prompt)
+    finally:
+        eng.shutdown()
+
+    ref = ContinuousEngine(p_new, cfg, max_slots=2, max_len=160,
+                           decode_stride=2, warmup=False)
+    try:
+        want = collect(ref, prompt)
+    finally:
+        ref.shutdown()
+    assert got == want, "post-swap output came from poisoned pages"
+
+
+# ---------------------------------------------------------------------------
+# sampling decode (satellite: PR 12's unclaimed stretch)
+# ---------------------------------------------------------------------------
+
+
+def test_sampling_seeded_determinism():
+    b = _mk(sampling=True)
+    prompt = PREFIX + [60]
+    a1 = _run_one(b, prompt, temperature=0.8, top_k=7, seed=11)
+    a2 = _run_one(b, prompt, temperature=0.8, top_k=7, seed=11)
+    b1 = _run_one(b, prompt, temperature=0.8, top_k=7, seed=12)
+    assert a1 == a2, "same seed must replay the same draw chain"
+    assert a1 != b1 or len(set(a1)) <= 1  # different seed: different draws
+
+
+def test_sampling_independent_of_cobatching():
+    """A sampled request's draw chain is per-slot: the same seed emits
+    the same tokens whether it decodes alone or beside other traffic."""
+    b = _mk(sampling=True)
+    prompt = PREFIX + [60]
+    solo = _run_one(b, prompt, n=8, temperature=0.9, seed=5)
+    ra, _, _ = b.submit_ex(np.asarray(list(range(101, 121)), np.int32), 8)
+    rb, _, _ = b.submit_ex(np.asarray(prompt, np.int32), 8,
+                           temperature=0.9, seed=5)
+    got = {ra: [], rb: []}
+    first = {r.req_id: r.tokens[0] for r in b._active.values()}
+    got[ra].append(first[ra])
+    got[rb].append(first[rb])
+    while b.num_active:
+        for r, t, d in b.step():
+            got[r].append(t)
+    assert got[rb] == solo
+
+
+def test_greedy_rows_exact_on_sampling_engine():
+    """temperature=0 rows on a sampling engine match the greedy engine
+    bit-for-bit — token-exactness tests stay meaningful."""
+    greedy = _mk()
+    samp = _mk(sampling=True)
+    prompt = PREFIX + [60, 61]
+    assert _run_one(samp, prompt) == _run_one(greedy, prompt)
+
+
+def test_sampling_requires_engine_flag():
+    b = _mk(sampling=False)
+    with pytest.raises(ValueError, match="sampling"):
+        b.submit_ex(np.asarray(PREFIX, np.int32), 4, temperature=0.5)
+
+
+# ---------------------------------------------------------------------------
+# affinity routing (router unit level — no cluster needed)
+# ---------------------------------------------------------------------------
+
+
+def _router_with(replicas, counts, digests):
+    from ray_tpu.serve.handle import _RouterState
+
+    r = _RouterState("app", "dep")
+    r.replicas = [(rid, object()) for rid in replicas]
+    r.counts = dict(counts)
+    r.kv_digests = {k: frozenset(v) for k, v in digests.items()}
+    return r
+
+
+def test_affinity_bias_prefers_resident_replica():
+    prompt = PREFIX + [60, 61]
+    digests = PH.prompt_digests(prompt)
+    warm_set = digests  # replica A holds the full prefix
+    picks = {"a": 0, "b": 0}
+    r = _router_with(["a", "b"], {"a": 0, "b": 0},
+                     {"a": warm_set, "b": []})
+    for _ in range(32):
+        rid, _ = r.pick(None, digests)
+        picks[rid] += 1
+        r.complete(rid)  # release the slot so load stays equal
+    assert picks["a"] == 32, picks  # residency wins every two-choice
+
+
+def test_affinity_falls_back_to_load_only_when_unknown():
+    """No residency info on either replica -> pure power-of-two by
+    load: the idle replica must win."""
+    r = _router_with(["a", "b"], {"a": 5, "b": 0}, {})
+    for _ in range(16):
+        rid, _ = r.pick(None, PH.prompt_digests(PREFIX + [1]))
+        assert rid == "b"
+        r.complete(rid)
+
+
+def test_affinity_slack_guard_sheds_to_cold_replica():
+    """A warm replica already _AFFINITY_SLACK busier than the cold one
+    loses the bias — affinity must not pile load onto one replica."""
+    from ray_tpu.serve import handle as H
+
+    prompt = PREFIX + [60]
+    digests = PH.prompt_digests(prompt)
+    r = _router_with(["warm", "cold"],
+                     {"warm": H._AFFINITY_SLACK + 3, "cold": 0},
+                     {"warm": digests, "cold": []})
+    for _ in range(8):
+        rid, _ = r.pick(None, digests)
+        assert rid == "cold", "slack guard must shed to the cold replica"
+        r.complete(rid)
+
+
+def test_longer_prefix_match_wins():
+    prompt = PREFIX + [60, 61]
+    digests = PH.prompt_digests(prompt)  # longest first
+    short_only = [digests[-1]]           # replica b holds 1 chunk
+    r = _router_with(["a", "b"], {"a": 0, "b": 0},
+                     {"a": digests, "b": short_only})
+    for _ in range(16):
+        rid, _ = r.pick(None, digests)
+        assert rid == "a"
+        r.complete(rid)
+
+
+def test_request_prefix_digests_protocol():
+    body = {"tokens": PREFIX + [60], "max_new_tokens": 4}
+    digests = PH.request_prefix_digests((body,), {})
+    assert digests == PH.prompt_digests(PREFIX + [60])
+    assert PH.request_prefix_digests(("not-llm",), {}) is None
+    assert PH.request_prefix_digests((), {"x": {"tokens": []}}) is None
+
+
+# ---------------------------------------------------------------------------
+# serve-level integration: stats plumbing + metrics
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def serve_cluster():
+    from ray_tpu import serve
+    from ray_tpu.util import chaos
+
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=6)
+    yield ray_tpu
+    try:
+        serve.shutdown()
+    finally:
+        serve._forget_controller_for_tests()
+        chaos.disarm()
+        ray_tpu.shutdown()
+
+
+def test_serve_kv_cache_end_to_end(serve_cluster):
+    """Warm vs cold through a real deployment: hits advance, warm output
+    == cold output, kv stats reach the controller's win_stats, and the
+    rt_serve_kv_cache_* series move."""
+    from ray_tpu import serve
+    from ray_tpu.serve.llm import continuous_llm_app
+
+    app = continuous_llm_app("debug", max_slots=4, max_len=160,
+                             decode_stride=2, name="KV",
+                             kv_cache_bytes=32 << 20)
+    serve.run(app, name="kv", route_prefix="/kv")
+    h = serve.get_deployment_handle("KV", "kv")
+    body = {"tokens": PREFIX + [60, 61], "max_new_tokens": 8}
+
+    def one():
+        return list(h.remote(body).result())
+
+    cold = one()
+    warm = one()
+    assert warm == cold, "warm admission drifted from cold output"
+
+    # kv stats travel replica -> controller win_stats (stats poll ~1s)
+    import time
+
+    deadline = time.time() + 30
+    stats = {}
+    while time.time() < deadline:
+        st = serve.detailed_status()["applications"]["kv"]["deployments"]
+        stats = st["KV"]["stats"]
+        if stats.get("kv_hits", 0) >= 1:
+            break
+        time.sleep(0.5)
+    assert stats.get("kv_hits", 0) >= 1, stats
+    assert "kv_hit_rate" in stats, stats
+    assert stats.get("kv_bytes", 0) > 0, stats
+
+    # the Prometheus series advanced on the replica process
+    rep = h._router.replicas[0][1]
+    ray_tpu.get(rep.flush_metrics.remote())
+    from ray_tpu.util.metrics import metrics_text
+
+    text = metrics_text()
+    assert any(ln.startswith("rt_serve_kv_cache_hits")
+               and float(ln.rsplit(" ", 1)[1]) >= 1
+               for ln in text.splitlines()), \
+        "rt_serve_kv_cache_hits did not advance"
