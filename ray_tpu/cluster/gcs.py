@@ -99,6 +99,33 @@ class _PgEntry:
                 "bundle_nodes": list(self.bundle_nodes)}
 
 
+def _strategy_kind(strategy: Any) -> str:
+    """Reason token for a placement receipt: the scheduling strategy's kind
+    (a strategy arrives over RPC as an object or a plain dict)."""
+    if strategy is None:
+        return "default"
+    if isinstance(strategy, dict):
+        return str(strategy.get("kind", "default")).lower()
+    return str(getattr(strategy, "kind", strategy)).lower()
+
+
+def imbalance_cov(loads: List[float]) -> float:
+    """Population coefficient of variation (std/mean) of per-node load.
+
+    0.0 means perfectly balanced; degenerate inputs (fewer than two nodes,
+    or an idle cluster with zero mean) are defined as balanced rather than
+    undefined — a one-node cluster can't be imbalanced.
+    """
+    vals = [float(v) for v in loads]
+    if len(vals) < 2:
+        return 0.0
+    mean = sum(vals) / len(vals)
+    if mean <= 0:
+        return 0.0
+    var = sum((v - mean) ** 2 for v in vals) / len(vals)
+    return (var ** 0.5) / mean
+
+
 class GcsServer:
     def __init__(self, persist_path: Optional[str] = None):
         self.nodes: Dict[str, _NodeEntry] = {}
@@ -476,6 +503,13 @@ class GcsServer:
             for entry in list(self.nodes.values()):
                 if entry.alive and now - entry.last_heartbeat > cfg.node_death_timeout_s:
                     await self._mark_node_dead(entry, "heartbeat timeout")
+            try:
+                # per-tick cross-node balance sample: feeds the
+                # rt_sched_node_imbalance gauge, `rt sched balance` and the
+                # doctor's sustained-imbalance grading
+                self._update_balance()
+            except Exception:  # noqa: BLE001 — telemetry only
+                pass
             # Restored-ALIVE actors whose node never (re-)registered: after a
             # grace window for surviving raylets to reattach (they re-register
             # under their old node id on an "unknown" heartbeat reply), the
@@ -716,6 +750,20 @@ class GcsServer:
                 await asyncio.sleep(0.2)  # infeasible now; wait for nodes
                 continue
             node = self.nodes[node_id]
+            # placement receipt: which candidates were considered and why
+            # this node won (bundle pin for PG actors, strategy pick
+            # otherwise). Create-side failures retry through this loop and
+            # restamp; the store's dedup folds the repeats.
+            self._record_placement({
+                "kind": "actor_place",
+                "actor_id": entry.actor_id,
+                "name": entry.spec.get("class_name"),
+                "node_id": node_id,
+                "reason": ("pg_bundle" if pg_info is not None
+                           else _strategy_kind(strategy)),
+                "candidates": [self._node_features(nid) for nid in (
+                    [node_id] if pg_info is not None else list(views)[:8])],
+            })
             try:
                 client = await self._pool.get(node.address)
                 # Bounded: a wedged raylet must fail over to another node,
@@ -1133,6 +1181,22 @@ class GcsServer:
                 continue
             entry.state = PG_CREATED
             self.mark_dirty()
+            # placement receipt: one record per gang commit — gang_place
+            # for multi-bundle groups (the TPU slice_group case), pg_place
+            # for a single reserved bundle — with the committed
+            # bundle→node map as the decision payload
+            self._record_placement({
+                "kind": ("gang_place" if len(entry.bundles) > 1
+                         else "pg_place"),
+                "pg_id": entry.pg_id,
+                "name": entry.name,
+                "node_id": next((n for n in entry.bundle_nodes if n), None),
+                "reason": str(entry.strategy or "PACK").lower(),
+                "bundle_nodes": list(entry.bundle_nodes),
+                "candidates": [self._node_features(nid) for nid in
+                               dict.fromkeys(n for n in entry.bundle_nodes
+                                             if n)],
+            })
             for fut in entry.waiters:
                 if not fut.done():
                     fut.set_result(True)
@@ -1233,6 +1297,13 @@ class GcsServer:
         return {"ok": True, "count": len(p.get("events") or ())}
 
     def _apply_task_event(self, p):
+        if p.get("placement") is not None:
+            # placement receipts ride the coalesced task_events channel
+            # (one batched drain, no second RPC path) but land in their own
+            # bounded deduping store — a dispatch flood must never evict
+            # real task history
+            self._record_placement(p["placement"])
+            return
         if not hasattr(self, "task_events"):
             from collections import OrderedDict
 
@@ -1274,6 +1345,13 @@ class GcsServer:
             ev.setdefault("phases", {}).update(p["phases"])
         if p.get("worker_source") is not None:
             ev["worker_source"] = p["worker_source"]
+        # spillback hop chain (from-node → to-node → reason) joins the
+        # task's trace: `rt trace` renders it on the spillback phase row.
+        # Bounded — spillback_max_hops caps real chains far below this.
+        if p.get("spill_hop"):
+            hops = ev.setdefault("spill_hops", [])
+            if len(hops) < 8:
+                hops.append(p["spill_hop"])
         # step-profiler records ride the same store: a breakdown payload
         # plus caller-supplied span times (the profiler measured the real
         # start/end; server receive-time would misplace the lane)
@@ -1328,6 +1406,184 @@ class GcsServer:
         limit = p.get("limit") or 200
         events = list(getattr(self, "serve_decisions", ()))
         return events[-limit:]
+
+    # ---- placement events (scheduling decision receipts: the store behind
+    # `rt sched decisions`, `/api/sched` and the timeline's placement lane;
+    # the instrument-first layer ROADMAP item 1's learned-placement work
+    # scores against — Placeto-style features, recorded not discarded) -----
+    _PLACEMENT_EVENTS_CAP = 2048
+    _PLACEMENT_DEDUP_WINDOW_S = 5.0
+    PLACEMENT_KINDS = ("dispatch_local", "spillback", "actor_place",
+                       "pg_place", "warm_adopt", "gang_place")
+
+    def _record_placement(self, p: Dict) -> None:
+        """Store one placement decision record. Repeated identical decisions
+        (same kind/node/reason/name) inside the dedup window collapse into
+        the existing record's ``count`` — a 5k-task flood of local
+        dispatches folds into one row instead of evicting the rest of the
+        feed — and every report, deduped or not, increments
+        ``rt_sched_placement_decisions_total{kind=}`` exactly once, here
+        (single counting site: emitters never double-count)."""
+        if not hasattr(self, "placement_events"):
+            from collections import deque
+
+            # GCS runs a single asyncio loop; these are loop-only (no lock)
+            self.placement_events: "deque" = deque(
+                maxlen=self._PLACEMENT_EVENTS_CAP)
+            self._placement_last: Dict[Tuple, Dict] = {}
+            self._placement_seq = 0
+        p.setdefault("t", time.time())
+        kind = p.setdefault("kind", "unknown")
+        self._observe_placement(kind, p.get("hops"))
+        # task_id deliberately NOT in the key: same-shaped decisions fold
+        # into one row (count=N, first ids kept)
+        key = (kind, p.get("node_id"), p.get("reason"), p.get("name"))
+        last = self._placement_last.get(key)
+        if (last is not None
+                and p["t"] - last.get("last_t", last["t"])
+                <= self._PLACEMENT_DEDUP_WINDOW_S):
+            last["count"] = last.get("count", 1) + 1
+            last["last_t"] = p["t"]
+            # keep the freshest candidate features on the folded row — the
+            # point of the record is the scheduler's CURRENT view
+            if p.get("candidates"):
+                last["candidates"] = p["candidates"]
+            if (not self.placement_events
+                    or last["seq"] < self.placement_events[0]["seq"]):
+                self._placement_seq += 1
+                last["seq"] = self._placement_seq
+                self.placement_events.append(last)
+            return
+        p.setdefault("count", 1)
+        self._placement_seq += 1
+        p["seq"] = self._placement_seq
+        self.placement_events.append(p)
+        self._placement_last[key] = p
+        if len(self._placement_last) > 2 * self._PLACEMENT_EVENTS_CAP:
+            cutoff = p["t"] - self._PLACEMENT_DEDUP_WINDOW_S
+            kept = {k: e for k, e in self._placement_last.items()
+                    if e.get("last_t", e["t"]) > cutoff}
+            if len(kept) > self._PLACEMENT_EVENTS_CAP:
+                kept = dict(sorted(
+                    kept.items(),
+                    key=lambda kv: kv[1].get("last_t", kv[1]["t"])
+                )[-self._PLACEMENT_EVENTS_CAP:])
+            self._placement_last = kept
+
+    def _observe_placement(self, kind: str, hops) -> None:
+        """Decision counter + spillback-hop histogram. Registry-local;
+        shipped by the co-resident pusher (driver, or the head raylet's)."""
+        try:
+            from ray_tpu.util import metrics as M
+
+            if not hasattr(self, "_placement_counter"):
+                self._placement_counter = M.get_or_create(
+                    M.Counter, "rt_sched_placement_decisions_total",
+                    "Placement decisions recorded, by decision kind",
+                    tag_keys=("kind",))
+                self._spillback_hops_hist = M.get_or_create(
+                    M.Histogram, "rt_sched_spillback_hops",
+                    "Spillback hops a task took before dispatching",
+                    boundaries=(1.0, 2.0, 3.0, 5.0, 8.0))
+            self._placement_counter.inc(1, {"kind": kind})
+            if kind == "spillback" and hops:
+                self._spillback_hops_hist.observe(float(hops))
+        except Exception:  # noqa: BLE001 — telemetry only
+            pass
+
+    def _node_features(self, nid: str) -> Dict[str, Any]:
+        """Per-node scheduling feature vector for a placement receipt's
+        candidate set (queue state, warm pool, resource headroom — from the
+        node's last heartbeat ``sched`` summary): the inputs a learned
+        placement policy would score."""
+        n = self.nodes.get(nid)
+        if n is None:
+            return {"node_id": nid}
+        sched = getattr(n, "sched", None) or {}
+        classes = sched.get("classes") or []
+        warm = sched.get("warm") or {}
+        return {
+            "node_id": nid,
+            "queue_depth": getattr(n, "queue_depth", 0),
+            "running": sched.get("running", 0),
+            "oldest_wait_s": round(max(
+                (c.get("oldest_wait_s") or 0.0 for c in classes),
+                default=0.0), 3),
+            "warm_idle": warm.get("idle", 0),
+            "headroom": n.view.available.to_dict(),
+        }
+
+    async def rpc_placement_event(self, p):
+        self._record_placement(p)
+        return {"ok": True}
+
+    async def rpc_list_placement_events(self, p):
+        events = list(getattr(self, "placement_events", ()))
+        kind = p.get("kind")
+        if kind:
+            events = [e for e in events if e.get("kind") == kind]
+        node = p.get("node")
+        if node:  # prefix match on chosen OR origin node (spillback hops)
+            events = [e for e in events
+                      if str(e.get("node_id") or "").startswith(node)
+                      or str(e.get("from_node") or "").startswith(node)]
+        since = p.get("since")
+        if since:
+            events = [e for e in events
+                      if e.get("last_t", e.get("t", 0)) >= since]
+        limit = p.get("limit") or 200
+        return events[-limit:]
+
+    # ---- cross-node balance telemetry (rt_sched_node_imbalance) ----------
+    _BALANCE_HIST_CAP = 128
+
+    def _update_balance(self) -> None:
+        """Sample cross-node imbalance: the coefficient of variation over
+        per-node queued+running load from the heartbeat ``sched``
+        summaries. Called each monitor tick; ROADMAP item 1's bar is this
+        series trending flat."""
+        rows = []
+        for n in self.nodes.values():
+            if not n.alive:
+                continue
+            sched = getattr(n, "sched", None) or {}
+            queued = getattr(n, "queue_depth", 0) or 0
+            running = sched.get("running", 0) or 0
+            rows.append({"node_id": n.node_id, "queued": queued,
+                         "running": running, "load": queued + running})
+        cov = imbalance_cov([r["load"] for r in rows])
+        self._balance_now = {"cov": round(cov, 4), "nodes": rows}
+        if not hasattr(self, "_balance_hist"):
+            from collections import deque
+
+            self._balance_hist: "deque" = deque(
+                maxlen=self._BALANCE_HIST_CAP)
+        self._balance_hist.append(
+            {"t": time.time(), "cov": round(cov, 4),
+             "loads": {r["node_id"]: r["load"] for r in rows}})
+        try:
+            from ray_tpu.util import metrics as M
+
+            if not hasattr(self, "_imbalance_gauge"):
+                # Registry-local; shipped by the co-resident pusher
+                self._imbalance_gauge = M.get_or_create(
+                    M.Gauge, "rt_sched_node_imbalance",
+                    "Coefficient of variation of per-node queued+running "
+                    "load (0 = balanced)")
+            self._imbalance_gauge.set(cov)
+        except Exception:  # noqa: BLE001 — telemetry only
+            pass
+
+    async def rpc_sched_balance(self, p):
+        """Balance snapshot + recent per-tick history: `rt sched balance`,
+        `/api/sched` and the doctor's sustained-imbalance grading."""
+        snap = getattr(self, "_balance_now", None)
+        if snap is None:
+            self._update_balance()
+            snap = self._balance_now
+        limit = p.get("limit") or 60
+        return {"cov": snap["cov"], "nodes": snap["nodes"],
+                "history": list(getattr(self, "_balance_hist", ()))[-limit:]}
 
     # ---- serve proxy registry (multi-proxy front doors): the controller
     # registers every HTTP proxy it starts so load balancers / `rt serve
@@ -1492,7 +1748,15 @@ class GcsServer:
                             preferred=p.get("preferred"))
         if node_id is None:
             return {"error": "infeasible", "node_id": None}
-        return {"node_id": node_id, "address": self.nodes[node_id].address}
+        reply = {"node_id": node_id, "address": self.nodes[node_id].address}
+        if p.get("features"):
+            # spillback receipts: ship the considered candidates' feature
+            # vectors back so the origin raylet can stamp a truthful
+            # record. Bounded — a wide cluster must not turn every route
+            # reply into a telemetry payload.
+            reply["candidates"] = [self._node_features(nid)
+                                   for nid in list(views)[:8]]
+        return reply
 
     # ---- cluster info -------------------------------------------------------
     async def rpc_cluster_resources(self, p):

@@ -702,6 +702,9 @@ class Raylet:
                 else None},
             "backpressure_total": s["backpressure"],
             "deadline_evictions_total": s["deadline_evictions"],
+            # queued+running is the load number the GCS's cross-node
+            # imbalance CoV (rt_sched_node_imbalance) is computed over
+            "running": len(self._inflight),
         }
 
     def _update_worker_rss(self, m: Dict[str, Any]) -> None:
@@ -1512,10 +1515,69 @@ class Raylet:
         self._dispatch_event.set()
         return await asyncio.shield(fut)
 
+    def _local_features(self, skey=None, payload=None) -> Dict[str, Any]:
+        """This node's feature vector for a placement receipt's candidate
+        set: the local half of what rpc_route_task's candidates carry for
+        peers (queue state, warm pool, resource headroom) plus the one
+        feature only the origin raylet knows — how many bytes of the
+        task's args are already plasma-resident here (the locality input a
+        learned placement policy would weigh)."""
+        out: Dict[str, Any] = {
+            "node_id": self.node_id,
+            "queue_depth": len(self._squeue),
+            "warm_idle": len(self._idle.get(_WARM_KEY, ())),
+            "headroom": self.node.available.to_dict(),
+        }
+        if skey is not None:
+            out["class_depth"] = self._squeue.depth(skey)
+            head = self._squeue.head(skey)
+            out["oldest_wait_s"] = round(max(
+                0.0, time.monotonic() - head["t_enq"]), 3) if head else 0.0
+        if payload is not None:
+            locality = 0
+            entries = list(payload.get("args") or ())
+            entries += list((payload.get("kwargs") or {}).values())
+            for ent in entries:
+                try:
+                    kind, val = ent
+                    if kind != "ref":
+                        continue
+                    oid = val[0].hex()
+                    if oid in self._local_objects:
+                        meta = self._object_meta.get(oid) or {}
+                        if not meta.get("spilled"):
+                            locality += int(meta.get("size", 0))
+                except Exception:  # noqa: BLE001 — telemetry only
+                    continue
+            out["locality_bytes"] = locality
+        return out
+
+    def _placement_event(self, rec: Dict[str, Any]) -> None:
+        """Placement decision receipt (kind, chosen node, reason, candidate
+        features) bound for the GCS ``placement_events`` store. Rides the
+        SAME coalesced ``task_events`` channel as state events — one
+        batched drain, no second RPC path — and is routed to its own store
+        on arrival. Observability only: never blocks the dispatch path."""
+        msg = {"task_id": rec.get("task_id"), "placement": rec}
+        if get_config().task_event_flush_s <= 0:
+            async def _send(m=rec):
+                try:
+                    await self._gcs.call("placement_event", m)
+                except Exception:  # noqa: BLE001 — observability only
+                    pass
+
+            spawn_task(_send())
+            return
+        self._task_event_buf.append(msg)
+        if not self._task_event_flushing:
+            self._task_event_flushing = True
+            spawn_task(self._flush_task_events())
+
     def _task_event(self, task_id: str, name, state: str,
                     trace: "Optional[Dict]" = None,
                     phases: "Optional[Dict]" = None,
-                    worker_source: Optional[str] = None) -> None:
+                    worker_source: Optional[str] = None,
+                    spill_hop: "Optional[Dict]" = None) -> None:
         """Buffered state event to the GCS task store (reference:
         TaskEventBuffer -> GcsTaskManager); observability only, never blocks
         or fails the task path. Events COALESCE into one batched
@@ -1540,6 +1602,8 @@ class Raylet:
             msg["phases"] = phases
         if worker_source is not None:
             msg["worker_source"] = worker_source
+        if spill_hop is not None:
+            msg["spill_hop"] = spill_hop
         if get_config().task_event_flush_s <= 0:
             # batching off: ship each event on its own fire-and-forget RPC
             async def _send(m=msg):
@@ -1608,12 +1672,24 @@ class Raylet:
         local dispatch can still claim it if the attempt finds nothing."""
         payload = dict(item["payload"])
         payload["spill_count"] = payload.get("spill_count", 0) + 1
+        # Acyclic hop chain: a spilled task must never return to a node it
+        # already visited. Two loaded nodes ping-ponging one task would each
+        # hit the peer's duplicate-task_id guard and JOIN the other's
+        # held-open original future while the task sits in NEITHER queue —
+        # a distributed deadlock (both futures wait on each other forever).
+        path = [n for n in (item["payload"].get("spill_path") or ())
+                if n != self.node_id]
+        path.append(self.node_id)
+        payload["spill_path"] = path
         payload.pop("spillback_hint", None)
         try:
             route = await self._gcs.call("route_task", {
                 "resources": payload["resources"],
                 "strategy": payload.get("strategy"),
-                "require_available": True, "exclude": [self.node_id]})
+                "require_available": True, "exclude": list(path),
+                # placement receipts: ship the considered candidates'
+                # feature vectors back so the hop record is truthful
+                "features": True})
         except Exception:
             route = {}
         if not route.get("address"):
@@ -1623,6 +1699,12 @@ class Raylet:
         if not self._squeue.remove(item):
             item["spilling"] = False
             return  # local dispatch already claimed it
+        # hop hand-off time, captured BEFORE the forward: the forward's
+        # submit_task is held open until the task COMPLETES remotely, so
+        # measuring after the call would fold the whole remote execution
+        # into the hop. The spillback phase = local wait + routing overhead
+        # up to hand-off (the remote raylet owns queue_wait onward).
+        hop_s = time.monotonic() - item.get("t_enq", item["t"])
         try:
             client = await self._pool.get(route["address"])
             reply = await client.call("submit_task", payload)
@@ -1640,12 +1722,43 @@ class Raylet:
             # the peer's admission bound is its own: this task was already
             # admitted HERE — requeue locally instead of propagating a
             # bounce the owner never earned (fail-fast callers would raise
-            # BackpressureError for a node they never overloaded)
+            # BackpressureError for a node they never overloaded).
+            # Deliberately NO placement receipt on this requeue (nor on the
+            # no-target / forward-failure paths above): the task did not
+            # move, and stamping a bounced attempt would double-count the
+            # eventual successful hop.
             item["spilling"] = False
             item["t"] = time.monotonic()
             self._squeue.push(item)
             self._dispatch_event.set()
             return
+        # the task moved: THE one spillback stamp site. reason carries why
+        # the local node was rejected (_maybe_spill_class stamped it on the
+        # item); candidates = this node's features + the GCS's view of the
+        # peers it considered.
+        reason = item.get("spill_reason") or "queue_bound"
+        self._placement_event({
+            "kind": "spillback",
+            "task_id": payload.get("task_id"),
+            "name": payload.get("fn_name"),
+            "from_node": self.node_id,
+            "node_id": route.get("node_id"),
+            "reason": reason,
+            "hops": payload["spill_count"],
+            "path": path + [route.get("node_id")],
+            "candidates": ([self._local_features(item.get("skey"),
+                                                 payload)]
+                           + (route.get("candidates") or [])),
+        })
+        if payload.get("trace") is not None:
+            # the hop joins the task's phase breakdown: a phases-only
+            # partial merging into the event the executing node owns
+            self._task_event(
+                payload["task_id"], payload.get("fn_name"), None,
+                phases={"spillback": hop_s},
+                spill_hop={"from": self.node_id,
+                           "to": route.get("node_id"),
+                           "reason": reason})
         fut = item["future"]
         if not fut.done():
             fut.set_result(reply)
@@ -1752,6 +1865,18 @@ class Raylet:
             payload.get("strategy"), self.node_id, self.node.labels)
         if local_ok and pool.can_fit(req):
             assignment = pool.allocate(req)
+            # placement receipt: local dispatches flood, but the GCS store
+            # dedups same-shaped decisions into one counted row, so this
+            # stays one cheap dict per dispatch on the wire at worst
+            self._placement_event({
+                "kind": "dispatch_local",
+                "task_id": payload.get("task_id"),
+                "name": payload.get("fn_name"),
+                "node_id": self.node_id,
+                "reason": "pg_bundle" if pg is not None else "local_fit",
+                "candidates": [self._local_features(item.get("skey"),
+                                                    payload)],
+            })
             spawn_task(self._run_task(item, req, assignment, pool))
             return "dispatched"
         # Load-based spillback (reference: spillback replies in
@@ -1791,6 +1916,18 @@ class Raylet:
             if ((not local_ok
                  or payload.get("spill_count", 0) < cfg.spillback_max_hops)
                     and now - item.get("t", 0) > cfg.spillback_delay_s):
+                # stamp WHY the local node was rejected, while the local
+                # view that rejected it is still in hand — the decision
+                # record's reason must be truthful, not reconstructed
+                if not local_ok:
+                    item["spill_reason"] = "strategy_ineligible"
+                elif not self.node.is_feasible(
+                        ResourceSet(payload["resources"])):
+                    item["spill_reason"] = "resource_infeasible"
+                elif item.get("expires") is not None:
+                    item["spill_reason"] = "deadline_pressure"
+                else:
+                    item["spill_reason"] = "queue_bound"
                 launch.append(item)
                 budget -= 1
                 if budget <= 0:
@@ -2053,6 +2190,17 @@ class Raylet:
                                 pass
                         break
                     self._workers.pop(cand.worker_id, None)
+                if worker is not None:
+                    # placement receipt: adoption is a placement decision —
+                    # the warm pool won over a cold spawn on this node
+                    self._placement_event({
+                        "kind": "warm_adopt",
+                        "actor_id": p["actor_id"],
+                        "name": spec.get("class_name"),
+                        "node_id": self.node_id,
+                        "reason": "warm_pool_hit",
+                        "candidates": [self._local_features()],
+                    })
             if worker is None:
                 self._sched_stats["cold_spawns"] += 1
                 worker = self._spawn_worker((("actor", p["actor_id"]),),

@@ -16,6 +16,7 @@ State API), ``dashboard/modules/metrics`` (Prometheus). Routes:
   GET /api/logs                 worker log rings (?node=&worker=&limit=)
   GET /api/jobs                 submitted jobs
   GET /api/serve/applications   serve app states
+  GET /api/sched                placement decisions + cross-node balance
   GET /api/cluster_resources    total/available
   GET /metrics                  Prometheus text page
   GET /-/healthz                liveness
@@ -66,6 +67,9 @@ class DashboardActor:
         app.router.add_get("/api/jobs", self._jobs)
         app.router.add_get("/api/serve/applications", self._serve_apps)
         app.router.add_get("/api/serve", self._serve_detail)
+        # the placement-receipt plane: decision records + the cross-node
+        # balance snapshot (GCS placement_events store / sched_balance)
+        app.router.add_get("/api/sched", self._sched)
         app.router.add_get("/api/stacks", self._stacks)
         app.router.add_get("/metrics", self._metrics)
         self._runner = web.AppRunner(app, access_log=None)
@@ -167,6 +171,34 @@ class DashboardActor:
                 return serve.detailed_status()
             except RuntimeError:  # serve not running
                 return {"applications": {}, "decisions": []}
+
+        loop = asyncio.get_running_loop()
+        out = await loop.run_in_executor(None, fetch)
+        return web.json_response(out, dumps=_dumps)
+
+    async def _sched(self, request):
+        """The Scheduling tab's payload: the placement decision feed (kind,
+        chosen node, reason, candidate feature vectors) joined with the
+        cross-node balance snapshot (per-node queued+running load + the
+        imbalance CoV behind rt_sched_node_imbalance)."""
+        from aiohttp import web
+
+        limit = int(request.query.get("limit", 200))
+        kind = request.query.get("kind")
+
+        def fetch():
+            backend = self._backend()
+
+            async def run():
+                payload: Dict[str, Any] = {"limit": limit}
+                if kind:
+                    payload["kind"] = kind
+                decisions, balance = await asyncio.gather(
+                    backend._gcs.call("list_placement_events", payload),
+                    backend._gcs.call("sched_balance", {}))
+                return {"decisions": decisions, "balance": balance}
+
+            return backend.io.run(run())
 
         loop = asyncio.get_running_loop()
         out = await loop.run_in_executor(None, fetch)

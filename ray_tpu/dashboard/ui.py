@@ -206,6 +206,7 @@ const TABS = [
   {id: "memory", label: "Memory", url: "/api/memory?limit=100"},
   {id: "logs", label: "Logs", url: "/api/logs?limit=300"},
   {id: "serve", label: "Serve", url: "/api/serve"},
+  {id: "sched", label: "Scheduling", url: "/api/sched?limit=200"},
 ];
 let active = "nodes", paused = false, data = {};
 
@@ -359,8 +360,8 @@ const STEP_LEGEND = `<div class="legend">` +
   `<span><span class="chip bk-sync"></span>device sync</span></div>`;
 
 // task-lifecycle phase drill-down (traced tasks; util/tracing.PHASE_ORDER)
-const PHASE_ORDER = ["submit", "queue_wait", "worker_acquire", "transfer",
-  "arg_fetch", "execute", "result_store", "driver_get"];
+const PHASE_ORDER = ["submit", "queue_wait", "spillback", "worker_acquire",
+  "transfer", "arg_fetch", "execute", "result_store", "driver_get"];
 const PHASE_CLASS = {queue_wait: "ph-queue_wait",
   worker_acquire: "ph-worker_acquire", execute: "ph-execute",
   arg_fetch: "ph-arg_fetch", result_store: "ph-result_store"};
@@ -583,11 +584,54 @@ function renderLogs(el) {
       .join("\n") + `</pre>`;
 }
 
+// --- scheduling tab: placement decision receipts + cross-node balance ---
+function renderSched(el) {
+  const payload = data.sched || {};
+  const bal = payload.balance || {};
+  const nodes = bal.nodes || [];
+  const maxLoad = Math.max(1, ...nodes.map(n => n.load || 0));
+  const bars = nodes.map(n =>
+    `<tr><td class="id">${esc((n.node_id || "").slice(0, 8))}</td>` +
+    `<td>${esc(n.queued ?? 0)}</td><td>${esc(n.running ?? 0)}</td>` +
+    `<td style="min-width:180px"><div class="meter"><div ` +
+    `style="width:${Math.round(100 * (n.load || 0) / maxLoad)}%">` +
+    `</div></div></td><td>${esc(n.load ?? 0)}</td></tr>`).join("");
+  const rows = (payload.decisions || []).slice().reverse().map(d => {
+    const when = d.last_t || d.t
+      ? new Date(1000 * (d.last_t || d.t)).toLocaleTimeString() : "";
+    const who = d.name || d.task_id || d.actor_id || d.pg_id || "";
+    const hop = d.kind === "spillback"
+      ? `${esc(String(d.from_node || "").slice(0, 8))} &rarr; ` +
+        `${esc(String(d.node_id || "").slice(0, 8))} ` +
+        `(hops ${esc(d.hops ?? 1)})` : "";
+    return `<tr><td>${esc(when)}</td>` +
+      `<td>${esc(d.kind || "")}</td>` +
+      `<td class="id">${esc(String(d.node_id || "").slice(0, 8))}</td>` +
+      `<td>${esc(d.reason || "")}</td>` +
+      `<td class="id">${esc(String(who).slice(0, 16))}</td>` +
+      `<td>${esc(d.count ?? 1)}</td><td>${hop}</td>` +
+      `<td>${esc((d.candidates || []).length)}</td></tr>`;
+  }).join("");
+  const cov = typeof bal.cov === "number" ? bal.cov.toFixed(3) : "?";
+  el.innerHTML =
+    `<h3>Cross-node balance <span class="muted">load CoV ${esc(cov)}` +
+    `</span></h3>` +
+    (nodes.length ? `<table><tr><th>Node</th><th>Queued</th>` +
+      `<th>Running</th><th>Load</th><th></th></tr>${bars}</table>`
+      : `<div class="empty">no balance samples yet</div>`) +
+    `<h3>Placement decisions</h3>` +
+    (rows ? `<table><tr><th>When</th><th>Kind</th><th>Node</th>` +
+      `<th>Reason</th><th>What</th><th>Count</th><th>Hop</th>` +
+      `<th>Candidates</th></tr>${rows}</table>`
+      : `<div class="empty">none recorded</div>`);
+}
+
 function renderTable() {
   const el = document.getElementById("content");
   if (active === "timeline") { renderTimeline(el); return; }
   if (active === "memory") { renderMemory(el); return; }
   if (active === "logs") { renderLogs(el); return; }
+  if (active === "sched") { renderSched(el); return; }
   if (active === "serve") {
     const payload = data.serve || {};
     const apps = payload.applications || payload;

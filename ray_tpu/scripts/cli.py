@@ -657,6 +657,82 @@ def cmd_errors(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_sched(args: argparse.Namespace) -> int:
+    """rt sched decisions/balance: the placement-receipt plane — every
+    scheduling decision's record (kind, chosen node, reason, candidate
+    feature vectors; GCS placement_events store) and the cross-node
+    queued+running balance snapshot behind rt_sched_node_imbalance.
+    Reads the GCS directly, no driver attach."""
+    kinds = ("dispatch_local", "spillback", "actor_place", "pg_place",
+             "warm_adopt", "gang_place")
+    if (args.sched_cmd == "decisions" and args.kind
+            and args.kind not in kinds):
+        # local usage errors must not masquerade as cluster unreachability
+        print(f"rt sched decisions: unknown --kind {args.kind!r} "
+              f"(one of: {', '.join(kinds)})", file=sys.stderr)
+        return 2
+    gcs = _resolve_gcs(args.address)
+    if gcs is None:
+        print("rt sched: no running cluster found (pass --address)",
+              file=sys.stderr)
+        return 1
+    try:
+        if args.sched_cmd == "balance":
+            reply = _gcs_call(gcs, "sched_balance", {})
+            if args.json:
+                print(json.dumps(reply, indent=2, default=str))
+                return 0
+            print(f"cross-node imbalance (CoV of queued+running load): "
+                  f"{reply.get('cov', 0.0):.3f}")
+            for row in reply.get("nodes") or ():
+                print(f"  {str(row.get('node_id', '?'))[:8]:<8} "
+                      f"queued={row.get('queued', 0):<6} "
+                      f"running={row.get('running', 0):<6} "
+                      f"load={row.get('load', 0)}")
+            hist = reply.get("history") or []
+            if hist:
+                series = " ".join(f"{h.get('cov', 0.0):.2f}"
+                                  for h in hist[-10:])
+                print(f"recent ticks: {series}")
+            return 0
+        # decisions
+        payload: Dict = {"limit": args.limit}
+        if args.kind:
+            payload["kind"] = args.kind
+        if args.node:
+            payload["node"] = args.node
+        events = _gcs_call(gcs, "list_placement_events", payload)
+        if args.json:
+            print(json.dumps(events, indent=2, default=str))
+            return 0
+        if not events:
+            what = f"kind {args.kind!r}" if args.kind else "any kind"
+            print(f"(no placement decisions recorded for {what})")
+            return 0
+        for ev in events:
+            when = time.strftime("%H:%M:%S", time.localtime(
+                ev.get("last_t", ev.get("t", 0))))
+            who = " ".join(
+                f"{k}={str(ev[k])[:12]}" for k in
+                ("name", "task_id", "actor_id", "pg_id") if ev.get(k))
+            count = f" x{ev['count']}" if ev.get("count", 1) > 1 else ""
+            hop = ""
+            if ev.get("kind") == "spillback":
+                hop = (f" {str(ev.get('from_node', '?'))[:8]}"
+                       f"->{str(ev.get('node_id', '?'))[:8]}"
+                       f" hops={ev.get('hops', 1)}")
+            print(f"{when}  {str(ev.get('node_id', '?'))[:8]:<8} "
+                  f"{ev.get('kind', '?'):<15}{count:<7} "
+                  f"reason={ev.get('reason', '?'):<20}"
+                  f"{hop} {who}  "
+                  f"candidates={len(ev.get('candidates') or ())}")
+        return 0
+    except Exception as e:  # noqa: BLE001 — one line, no stack trace
+        print(f"rt sched: cannot reach GCS at {gcs}: "
+              f"{type(e).__name__}: {e}", file=sys.stderr)
+        return 1
+
+
 def cmd_chaos(args: argparse.Namespace) -> int:
     """rt chaos arm/disarm/status: drive the fault-injection plane
     (util/chaos.py) against a live cluster. The plan ships through the GCS
@@ -736,6 +812,7 @@ def cmd_doctor(args: argparse.Namespace) -> int:
                           queue_warn=args.queue_warn,
                           queue_wait_warn_s=args.queue_wait_warn,
                           serve_p99_warn_s=args.serve_p99_warn,
+                          imbalance_warn=args.imbalance_warn,
                           as_json=args.json)
     print(text, file=sys.stderr if rc == 2 else sys.stdout)
     return rc
@@ -982,6 +1059,29 @@ def main(argv=None) -> int:
                             "organic failures")
     p_err.set_defaults(fn=cmd_errors)
 
+    p_sched = sub.add_parser(
+        "sched",
+        help="placement receipts: scheduling decision records and the "
+             "cross-node balance snapshot (GCS placement_events store)")
+    sched_sub = p_sched.add_subparsers(dest="sched_cmd", required=True)
+    ps_dec = sched_sub.add_parser(
+        "decisions", help="tail the placement decision feed")
+    ps_dec.add_argument("--address", default=None)
+    ps_dec.add_argument("--kind", default=None,
+                        help="only this decision kind (dispatch_local, "
+                             "spillback, actor_place, pg_place, "
+                             "warm_adopt, gang_place)")
+    ps_dec.add_argument("--node", default=None,
+                        help="only decisions whose chosen or origin node "
+                             "id starts with this prefix")
+    ps_dec.add_argument("--limit", type=int, default=200)
+    ps_dec.add_argument("--json", action="store_true")
+    ps_bal = sched_sub.add_parser(
+        "balance", help="per-node queued+running load + imbalance CoV")
+    ps_bal.add_argument("--address", default=None)
+    ps_bal.add_argument("--json", action="store_true")
+    p_sched.set_defaults(fn=cmd_sched)
+
     p_chaos = sub.add_parser(
         "chaos",
         help="fault injection: arm/disarm a seeded ChaosPlan against the "
@@ -1031,6 +1131,9 @@ def main(argv=None) -> int:
     p_doc.add_argument("--serve-p99-warn", type=float, default=5.0,
                        help="serve request p99 (s) that grades a "
                             "deployment as degraded")
+    p_doc.add_argument("--imbalance-warn", type=float, default=0.5,
+                       help="cross-node load CoV that, sustained over 3 "
+                            "ticks, grades the cluster as imbalanced")
     p_doc.add_argument("--json", action="store_true")
     p_doc.set_defaults(fn=cmd_doctor)
 

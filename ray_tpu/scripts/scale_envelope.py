@@ -70,10 +70,73 @@ def _scenario(out: Dict[str, Any], name: str):
     return _Ctx()
 
 
+def _placement_balance(out: Dict[str, Any]) -> None:
+    """Scenario 8: skewed submit across a 2-node fake-resource cluster.
+
+    Every driver submission lands on the small head raylet; the per-class
+    spill heuristic must shed the excess to the big node. While the flood
+    drains we sample the GCS balance tick (``sched_balance`` — the same
+    series behind ``rt_sched_node_imbalance`` and ``rt sched balance``):
+    the committed evidence is the imbalance-CoV series plus the spillback
+    placement receipts the hops left behind."""
+    import ray_tpu
+    from ray_tpu.cluster.cluster_utils import Cluster
+
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    with _scenario(out, "placement_balance") as sc:
+        cluster = Cluster(initialize_head=True,
+                          head_node_args={"num_cpus": 1})
+        try:
+            cluster.add_node(num_cpus=4)
+            cluster.connect_driver()
+
+            @ray_tpu.remote
+            def spin():
+                # long enough that the skewed backlog spans several 1 s
+                # balance ticks — the series must show spike AND recovery
+                time.sleep(0.2)
+                return 0
+
+            backend = ray_tpu.global_worker()._require_backend()
+
+            def _gcs(method, payload):
+                return backend.io.run(backend._gcs.call(method, payload))
+
+            n = int(os.environ.get("RT_SCALE_BALANCE_TASKS", "300"))
+            pending = [spin.remote() for _ in range(n)]
+            covs = [float(_gcs("sched_balance", {}).get("cov") or 0.0)]
+            deadline = time.perf_counter() + 90.0
+            while pending and time.perf_counter() < deadline:
+                _, pending = ray_tpu.wait(
+                    pending, num_returns=len(pending), timeout=1.0)
+                covs.append(
+                    float(_gcs("sched_balance", {}).get("cov") or 0.0))
+            bal = _gcs("sched_balance", {"limit": 120})
+            series = [round(float(h.get("cov") or 0.0), 3)
+                      for h in bal.get("history") or ()]
+            spills = _gcs("list_placement_events",
+                          {"kind": "spillback", "limit": 1000}) or []
+            sc.record(
+                nodes=2, tasks=n, drained=n - len(pending),
+                cov_peak=round(max(covs), 3),
+                cov_final=round(covs[-1], 3),
+                cov_series=series[-40:],
+                spillback_records=len(spills),
+                spillback_tasks=sum(int(e.get("count", 1))
+                                    for e in spills),
+                decisions_total=len(_gcs("list_placement_events",
+                                         {"limit": 2000}) or []),
+            )
+        finally:
+            cluster.shutdown()
+
+
 def run_envelope(actor_target: int = 1000, queued_target: int = 10_000,
                  get_objects: int = 1000, pg_target: int = 100,
                  task_args_target: int = 1000,
-                 actor_budget_s: float = 120.0) -> Dict[str, Any]:
+                 actor_budget_s: float = 120.0,
+                 placement_only: bool = False) -> Dict[str, Any]:
     import numpy as np
 
     import ray_tpu
@@ -90,6 +153,10 @@ def run_envelope(actor_target: int = 1000, queued_target: int = 10_000,
             psutil.virtual_memory().total / 1e9, 1)
     except Exception:  # noqa: BLE001
         pass
+
+    if placement_only:
+        _placement_balance(out)
+        return out
 
     # Generous fake resources: the envelope exercises the CONTROL PLANE
     # (scheduler, GCS, object plane), not arithmetic — same trick as the
@@ -301,6 +368,9 @@ def run_envelope(actor_target: int = 1000, queued_target: int = 10_000,
                       remove_per_sec=round(n_live / remove_dt, 1))
     finally:
         ray_tpu.shutdown()
+
+    # ---- 8. cross-node placement balance (own 2-node cluster) -----------
+    _placement_balance(out)
     return out
 
 
@@ -314,13 +384,17 @@ def main(args=None) -> int:
     ap.add_argument("--pgs", type=int, default=100)
     ap.add_argument("--task-args", type=int, default=1000)
     ap.add_argument("--actor-budget-s", type=float, default=120.0)
+    ap.add_argument("--placement-only", action="store_true",
+                    help="run only the placement_balance scenario "
+                         "(2-node skewed-submit cluster)")
     ap.add_argument("--out", type=str, default="")
     ns = ap.parse_args(args)
 
     result = run_envelope(actor_target=ns.actors, queued_target=ns.queued,
                           get_objects=ns.objects, pg_target=ns.pgs,
                           task_args_target=ns.task_args,
-                          actor_budget_s=ns.actor_budget_s)
+                          actor_budget_s=ns.actor_budget_s,
+                          placement_only=ns.placement_only)
     doc = json.dumps(result, indent=2)
     if ns.out:
         with open(ns.out, "w") as f:

@@ -48,6 +48,15 @@ async def _collect_async(gcs_address: str, window_s: float,
             gcs.call("list_mem_events",
                      {"kind": "oom_kill", "limit": 50}, timeout=10.0))
 
+        # cross-node balance plane (placement receipts PR): CoV snapshot +
+        # recent per-tick history for the sustained-imbalance grading
+        sched_balance = None
+        try:
+            sched_balance = await gcs.call("sched_balance", {"limit": 60},
+                                           timeout=10.0)
+        except Exception:  # noqa: BLE001 — older GCS
+            pass
+
         async def probe_node(n):
             out = {"node_id": n["node_id"], "alive": n.get("alive", True),
                    "queue_depth": n.get("queue_depth", 0),
@@ -118,7 +127,8 @@ async def _collect_async(gcs_address: str, window_s: float,
         return {"t": time.time(), "gcs_address": gcs_address,
                 "window_s": window_s, "nodes": probed, "actors": actors,
                 "failures": failures, "oom_kills": ooms,
-                "ledgers": ledgers, "serve": serve_status}
+                "ledgers": ledgers, "serve": serve_status,
+                "sched_balance": sched_balance}
     finally:
         try:
             await gcs.close()
@@ -143,7 +153,8 @@ def _recent(events: List[Dict], window_s: float,
 def diagnose(report: Dict[str, Any],
              queue_warn: int = 100,
              queue_wait_warn_s: float = 10.0,
-             serve_p99_warn_s: float = 5.0) -> List[Tuple[str, str]]:
+             serve_p99_warn_s: float = 5.0,
+             imbalance_warn: float = 0.5) -> List[Tuple[str, str]]:
     """Turn the raw report into ranked ``(level, message)`` findings.
     Any CRITICAL finding makes the cluster unhealthy (exit 1)."""
     findings: List[Tuple[str, str]] = []
@@ -271,6 +282,24 @@ def diagnose(report: Dict[str, Any],
                              f"({store['spilled_bytes']} bytes) — gets pay "
                              f"restore IO"))
 
+    # -- cross-node balance (placement receipts plane) -----------------------
+    # SUSTAINED imbalance only: one skewed tick is normal scheduling churn,
+    # three consecutive ticks above the threshold names a hot node the
+    # spillback path isn't draining (see `rt sched balance`)
+    balance = report.get("sched_balance") or {}
+    hist = balance.get("history") or []
+    recent_cov = [h.get("cov", 0.0) for h in hist[-3:]]
+    if (len(balance.get("nodes") or ()) >= 2 and len(recent_cov) >= 3
+            and all(c > imbalance_warn for c in recent_cov)):
+        hot = max(balance["nodes"], key=lambda r: r.get("load", 0))
+        findings.append((WARN,
+                         f"cross-node load imbalance sustained: CoV "
+                         f"{balance.get('cov', recent_cov[-1]):.2f} over "
+                         f"{len(recent_cov)} ticks (> {imbalance_warn:.2f}"
+                         f"); hot node {str(hot.get('node_id'))[:8]} holds "
+                         f"{hot.get('load', 0)} queued+running task(s) — "
+                         f"see `rt sched balance`"))
+
     # -- serve plane (controller status snapshot) ----------------------------
     serve = report.get("serve") or {}
     # stale snapshots describe a controller that's gone — skip rather
@@ -353,7 +382,8 @@ def format_report(report: Dict[str, Any],
 
 def run(gcs_address: str, window_s: float = 600.0, queue_warn: int = 100,
         queue_wait_warn_s: float = 10.0, serve_p99_warn_s: float = 5.0,
-        as_json: bool = False) -> Tuple[str, int]:
+        imbalance_warn: float = 0.5, as_json: bool = False
+        ) -> Tuple[str, int]:
     """Collect + diagnose + render; returns (text, exit_code). Exit 2 when
     the GCS itself is unreachable."""
     try:
@@ -363,7 +393,8 @@ def run(gcs_address: str, window_s: float = 600.0, queue_warn: int = 100,
                 f"{type(e).__name__}: {e}", 2)
     findings = diagnose(report, queue_warn=queue_warn,
                         queue_wait_warn_s=queue_wait_warn_s,
-                        serve_p99_warn_s=serve_p99_warn_s)
+                        serve_p99_warn_s=serve_p99_warn_s,
+                        imbalance_warn=imbalance_warn)
     if as_json:
         rc = exit_code(findings)
         payload = dict(report,

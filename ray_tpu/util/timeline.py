@@ -74,6 +74,7 @@ def timeline(filename: Optional[str] = None) -> List[Dict[str, Any]]:
     trace.extend(_memory_instants(backend))
     trace.extend(_failure_instants(backend))
     trace.extend(_serve_decision_instants(backend))
+    trace.extend(_placement_instants(backend))
     if filename:
         with open(filename, "w") as f:
             json.dump(trace, f)
@@ -157,6 +158,37 @@ def _serve_decision_instants(backend) -> List[Dict[str, Any]]:
             "cat": "serve", "ph": "i", "s": "t",
             "ts": ev.get("t", 0.0) * 1e6,
             "pid": "serve", "tid": "autoscaler",
+            "args": {k: v for k, v in ev.items() if k != "t"},
+        })
+    return out
+
+
+def _placement_instants(backend) -> List[Dict[str, Any]]:
+    """Placement decision receipts as instant markers on a per-node
+    ``placement`` lane (GCS ``placement_events`` store — the same records
+    behind ``rt sched decisions`` and ``/api/sched``), so "why did this
+    task land here / hop there?" lines up against the task lanes."""
+    try:
+        events = backend.io.run(backend._gcs.call(
+            "list_placement_events", {"limit": 500}))
+    except Exception:  # noqa: BLE001 — older GCS / local backend
+        return []
+    out: List[Dict[str, Any]] = []
+    for ev in events or ():
+        kind = ev.get("kind", "place")
+        who = (ev.get("name") or ev.get("task_id") or ev.get("actor_id")
+               or ev.get("pg_id") or "")
+        name = f"{kind} {str(who)[:12]}".strip()
+        if ev.get("kind") == "spillback":
+            name += (f" {str(ev.get('from_node', ''))[:8]}"
+                     f"→{str(ev.get('node_id', ''))[:8]}")
+        count = ev.get("count", 1)
+        if count > 1:
+            name += f" x{count}"
+        out.append({
+            "name": name, "cat": "placement", "ph": "i", "s": "t",
+            "ts": ev.get("t", 0.0) * 1e6,
+            "pid": ev.get("node_id") or "node", "tid": "placement",
             "args": {k: v for k, v in ev.items() if k != "t"},
         })
     return out

@@ -18,6 +18,11 @@ span's GCS event as ``phases`` — a partition of the submit→reply interval:
   submit          driver-side residual: arg serialization + submit RPC + wire
   queue_wait      raylet queue time (enqueue → dispatch claim, including
                   dispatch-loop latency)
+  spillback       present only when the task moved nodes: the ORIGIN
+                  raylet's wait + routing overhead up to hand-off (the
+                  executing node's queue_wait starts after the hop); the
+                  span's ``spill_hops`` list names each from→to hop and
+                  why the origin was rejected
   worker_acquire  worker checkout (``worker_source`` says spawn vs warm)
   transfer        push RPC + payload marshalling around the worker's span
   arg_fetch       dependency resolution + deserialization in the worker
@@ -162,8 +167,9 @@ def get_trace(trace_id: str) -> List[Dict[str, Any]]:
 # Wall-clock partition of one task's submit→reply interval, in causal order
 # (driver_get trails the reply). ``format_trace`` and the dashboard render
 # phases in this order; unknown keys sort after.
-PHASE_ORDER = ("submit", "queue_wait", "worker_acquire", "transfer",
-               "arg_fetch", "execute", "result_store", "driver_get")
+PHASE_ORDER = ("submit", "queue_wait", "spillback", "worker_acquire",
+               "transfer", "arg_fetch", "execute", "result_store",
+               "driver_get")
 
 # Serve request spans (serve/obs.py) carry their own phase vocabulary —
 # ranked after the task partition, in causal order per hop (proxy:
@@ -258,6 +264,12 @@ def format_trace(spans: List[Dict[str, Any]]) -> str:
                 extra = ""
                 if pname == "worker_acquire" and span.get("worker_source"):
                     extra = f" ({span['worker_source']})"
+                elif pname == "spillback" and span.get("spill_hops"):
+                    # the hop chain: from-node → to-node (why)
+                    extra = " (" + " -> ".join(
+                        f"{(h.get('from') or '?')[:8]}→"
+                        f"{(h.get('to') or '?')[:8]} {h.get('reason', '')}"
+                        for h in span["spill_hops"]) + ")"
                 lines.append(f"{pad}     {pname:<15}{secs * 1e3:>10.2f} ms"
                              f"  {bar}{extra}")
         for child in sorted(children,

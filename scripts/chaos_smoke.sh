@@ -578,4 +578,77 @@ d = json.load(sys.stdin)
 assert d["exit_code"] == 0 and d["healthy"], d["findings"]
 print("doctor healthy after rlhf leg")
 '
+
+echo "== placement leg: spillback receipts + cross-node balance under rpc.delay =="
+# A second 1-CPU host joins; the whole flood submits to the 4-CPU head, so
+# the backlog is one-sided and the spill heuristic must shed it. rpc.delay
+# armed against the raylet's submit_task forwards stretches the hand-offs,
+# keeping the skew visible across several 1 s balance ticks.
+GCS_ADDR=$(python - <<'EOF'
+from ray_tpu.scripts.cli import _resolve_gcs
+print(_resolve_gcs(None))
+EOF
+)
+$RT start --address "$GCS_ADDR" --num-cpus 1
+$RT chaos arm --site rpc.delay --target submit_task --after 0 \
+    --max-fires 30 --delay 0.05 --seed 7
+sleep 2  # plan rides the heartbeat to the raylets
+python - <<'EOF'
+import time
+
+import ray_tpu
+
+ray_tpu.init(address="auto")
+backend = ray_tpu.global_worker()._require_backend()
+
+
+def balance():
+    return backend.io.run(backend._gcs.call("sched_balance", {"limit": 120}))
+
+
+@ray_tpu.remote
+def spin():
+    time.sleep(0.15)
+    return 0
+
+
+pending = [spin.remote() for _ in range(120)]
+peak = 0.0
+deadline = time.time() + 120
+while pending and time.time() < deadline:
+    _, pending = ray_tpu.wait(pending, num_returns=len(pending), timeout=1.0)
+    peak = max(peak, float(balance()["cov"] or 0.0))
+assert not pending, f"flood did not drain: {len(pending)} left"
+assert peak > 0.3, f"imbalance gauge never moved (peak cov {peak})"
+# recovery: once drained, the balance tick must come back down
+cov = peak
+for _ in range(12):
+    cov = float(balance()["cov"] or 0.0)
+    if cov < 0.3:
+        break
+    time.sleep(1.0)
+assert cov < 0.3, f"imbalance did not recover after the drain: cov {cov}"
+sp = backend.io.run(backend._gcs.call(
+    "list_placement_events", {"kind": "spillback", "limit": 100}))
+assert sp, "no spillback receipts after the skewed flood"
+hops = sum(int(e.get("count", 1)) for e in sp)
+assert all(e.get("candidates") for e in sp), "receipt without candidates"
+print(f"placement leg: peak cov {peak:.2f} recovered to {cov:.2f}, "
+      f"{hops} spillback hop(s) across {len(sp)} receipt(s)")
+ray_tpu.shutdown()
+EOF
+$RT chaos disarm
+$RT sched decisions --kind spillback | grep -q "spillback" \
+    || { echo "FAIL: rt sched decisions --kind spillback is empty"; exit 1; }
+$RT sched balance >/dev/null \
+    || { echo "FAIL: rt sched balance unreachable"; exit 1; }
+
+echo "== doctor must exit 0 after the placement leg drains =="
+sleep 3
+$RT doctor --window 2 --json | python -c '
+import json, sys
+d = json.load(sys.stdin)
+assert d["exit_code"] == 0 and d["healthy"], d["findings"]
+print("doctor healthy after placement leg")
+'
 echo "chaos smoke OK"

@@ -1,0 +1,434 @@
+"""Placement receipts: scheduling decision records, cross-node balance
+telemetry, and spillback-traced placement.
+
+Covers the placement-observability tentpole: every placement kind stamps a
+bounded, deduped decision record into the GCS ``placement_events`` store
+(candidate feature vectors included), the balance tick exports
+``rt_sched_node_imbalance`` and feeds the doctor's sustained-imbalance
+grading, spillback hops join the per-task phase breakdown, and the
+``rt sched`` / ``/api/sched`` surfaces read it all back. Also guards the
+acyclic ``spill_path`` fix: a 2-node spill ping-pong used to deadlock via
+the duplicate-task_id join on the peer's held-open future. Named
+``test_zz_*`` so it sorts late in tier-1 collection.
+"""
+
+import asyncio
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private import config as config_mod
+from ray_tpu.cluster.gcs import imbalance_cov
+from ray_tpu.util.doctor import diagnose
+
+
+@pytest.fixture(autouse=True)
+def _fresh():
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    yield
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    config_mod.reset_config_for_tests()
+
+
+def _backend():
+    return ray_tpu.global_worker()._require_backend()
+
+
+def _gcs(method, payload):
+    b = _backend()
+    return b.io.run(b._gcs.call(method, payload))
+
+
+def _poll_events(want, deadline_s=20.0, **payload):
+    """Poll list_placement_events until ``want(events)`` or timeout."""
+    payload.setdefault("limit", 500)
+    deadline = time.time() + deadline_s
+    events = []
+    while time.time() < deadline:
+        events = _gcs("list_placement_events", payload)
+        if want(events):
+            return events
+        time.sleep(0.2)
+    return events
+
+
+# ---- pure units ------------------------------------------------------------
+
+def test_imbalance_cov_unit():
+    """Population CoV of per-node load; degenerate inputs read as
+    balanced (a 1-node cluster can't be imbalanced)."""
+    assert imbalance_cov([]) == 0.0
+    assert imbalance_cov([7]) == 0.0
+    assert imbalance_cov([0, 0]) == 0.0
+    assert imbalance_cov([5, 5, 5]) == 0.0
+    assert imbalance_cov([2, 0]) == pytest.approx(1.0)
+    # [4,0,0,0]: mean 1, std sqrt(3) — one hot node in four
+    assert imbalance_cov([4, 0, 0, 0]) == pytest.approx(3 ** 0.5)
+    assert imbalance_cov([1, 3]) == pytest.approx(0.5)
+
+
+def test_doctor_imbalance_warn_and_clear():
+    """Sustained (3-tick) CoV above the threshold on a 2+ node cluster
+    warns and names the hot node; a recovered tick or a 1-node cluster
+    clears it."""
+    nodes = [{"node_id": "aaaa1111", "alive": True},
+             {"node_id": "bbbb2222", "alive": True}]
+
+    def report(covs, balance_nodes):
+        return {"window_s": 600.0, "nodes": nodes,
+                "sched_balance": {
+                    "cov": covs[-1],
+                    "nodes": balance_nodes,
+                    "history": [{"t": 0.0, "cov": c} for c in covs]}}
+
+    rows = [{"node_id": "aaaa1111", "queued": 9, "running": 1, "load": 10},
+            {"node_id": "bbbb2222", "queued": 0, "running": 0, "load": 0}]
+    warn = [m for lvl, m in diagnose(report([0.9, 0.8, 0.9], rows))
+            if "imbalance" in m]
+    assert warn, "sustained imbalance did not warn"
+    assert "aaaa1111" in warn[0]  # the hot node is named
+    assert "rt sched balance" in warn[0]
+
+    # one recovered tick inside the window clears it (not sustained)
+    assert not [m for _, m in diagnose(report([0.9, 0.1, 0.9], rows))
+                if "imbalance" in m]
+    # below a raised threshold: clean
+    assert not [m for _, m in diagnose(report([0.9, 0.9, 0.9], rows),
+                                       imbalance_warn=0.95)
+                if "imbalance" in m]
+    # a single-node cluster never grades as imbalanced
+    assert not [m for _, m in diagnose(report([2.0, 2.0, 2.0], rows[:1]))
+                if "imbalance" in m]
+
+
+def test_cli_sched_unknown_kind_exits_nonzero(capsys):
+    """`rt sched decisions --kind bogus` is a usage error: nonzero exit,
+    one-line stderr naming the valid kinds — before any GCS dial."""
+    from ray_tpu.scripts.cli import main
+
+    rc = main(["sched", "decisions", "--kind", "bogus"])
+    assert rc != 0
+    err = capsys.readouterr().err.strip()
+    assert len(err.splitlines()) == 1
+    assert "unknown --kind 'bogus'" in err and "spillback" in err
+
+
+# ---- decision records end-to-end (single node) -----------------------------
+
+def test_dispatch_local_receipt_with_locality_bytes():
+    """A local dispatch stamps a dispatch_local receipt whose candidate
+    feature vector reflects the plasma-resident bytes of the task's args
+    (the locality input a placement policy would weigh)."""
+    import numpy as np
+
+    ray_tpu.init(num_cpus=2)
+    big = np.zeros(1_000_000, dtype=np.uint8)
+    ref = ray_tpu.put(big)
+
+    @ray_tpu.remote
+    def consume(arr):
+        return arr.nbytes
+
+    assert ray_tpu.get(consume.remote(ref), timeout=60) == 1_000_000
+    events = _poll_events(
+        lambda evs: any(e.get("name") == "consume" for e in evs),
+        kind="dispatch_local")
+    rec = next(e for e in events if e.get("name") == "consume")
+    assert rec["reason"] == "local_fit"
+    assert rec["node_id"]
+    cands = rec.get("candidates")
+    assert cands, "dispatch receipt shipped no candidate features"
+    feat = cands[0]
+    for key in ("node_id", "queue_depth", "warm_idle", "headroom"):
+        assert key in feat, (key, feat)
+    assert feat["locality_bytes"] >= 1_000_000
+
+
+def test_actor_warm_adopt_and_pg_receipts():
+    """actor_place (GCS-side), warm_adopt (raylet adoption of a pooled
+    worker) and pg_place/gang_place receipts all land with candidates."""
+    ray_tpu.init(num_cpus=4)
+
+    @ray_tpu.remote
+    def nop():
+        return 0
+
+    # a task round releases workers into the idle pool → adoption path
+    ray_tpu.get([nop.remote() for _ in range(4)], timeout=60)
+    time.sleep(0.3)
+
+    @ray_tpu.remote(num_cpus=0)
+    class A:
+        def ping(self):
+            return 1
+
+    a = A.remote()
+    assert ray_tpu.get(a.ping.remote(), timeout=60) == 1
+
+    placed = _poll_events(lambda evs: bool(evs), kind="actor_place")
+    assert placed, "no actor_place receipt"
+    assert placed[-1].get("candidates")
+    adopted = _poll_events(lambda evs: bool(evs), kind="warm_adopt")
+    assert adopted, "no warm_adopt receipt"
+    assert adopted[-1]["reason"] == "warm_pool_hit"
+
+    from ray_tpu.util.placement_group import (placement_group,
+                                              remove_placement_group)
+
+    pg = placement_group([{"CPU": 0.1}], strategy="PACK")
+    assert pg.wait(timeout=30)
+    single = _poll_events(lambda evs: bool(evs), kind="pg_place")
+    assert single, "no pg_place receipt"
+    assert single[-1].get("candidates")
+
+    gang = placement_group([{"CPU": 0.1}, {"CPU": 0.1}], strategy="PACK")
+    assert gang.wait(timeout=30)
+    multi = _poll_events(lambda evs: bool(evs), kind="gang_place")
+    assert multi, "no gang_place receipt (2-bundle PG)"
+    assert multi[-1].get("bundle_nodes")
+    remove_placement_group(pg)
+    remove_placement_group(gang)
+
+
+def test_receipts_dedup_and_bounded():
+    """Identical decisions fold into one record with a count instead of
+    growing the store; the kind counter still counts every decision."""
+    ray_tpu.init(num_cpus=1)
+
+    @ray_tpu.remote
+    def rep():
+        return 0
+
+    ray_tpu.get([rep.remote() for _ in range(12)], timeout=60)
+    events = _poll_events(
+        lambda evs: sum(e.get("count", 1) for e in evs
+                        if e.get("name") == "rep") >= 12,
+        kind="dispatch_local")
+    mine = [e for e in events if e.get("name") == "rep"]
+    assert sum(e.get("count", 1) for e in mine) >= 12
+    # the 5 s dedup window folds a burst of identical decisions
+    assert len(mine) < 12, "burst of identical decisions did not dedup"
+
+
+# ---- spillback: trace join, acyclic path, bounce regression ----------------
+
+def _two_node_cluster(head_cpus=1, big_cpus=4):
+    from ray_tpu.cluster.cluster_utils import Cluster
+
+    c = Cluster(initialize_head=True,
+                head_node_args={"num_cpus": head_cpus})
+    big = c.add_node(num_cpus=big_cpus)
+    c.connect_driver()
+    return c, big
+
+
+def test_spillback_receipt_and_trace_join():
+    """A skewed flood spills; the receipts carry from→to, the acyclic hop
+    path and candidate features, and a traced spilled task's phase
+    breakdown gains the ``spillback`` phase with its hop chain."""
+    from ray_tpu.util import tracing
+
+    c, big = _two_node_cluster()
+    try:
+        @ray_tpu.remote
+        def spin():
+            time.sleep(0.05)
+            return 0
+
+        tracing.enable()
+        try:
+            ray_tpu.get([spin.remote() for _ in range(40)], timeout=120)
+        finally:
+            tracing.disable()
+
+        spills = _poll_events(lambda evs: bool(evs), kind="spillback")
+        assert spills, "skewed flood produced no spillback receipts"
+        rec = spills[-1]
+        assert rec["from_node"] != rec["node_id"]
+        assert rec["reason"] == "queue_bound"
+        assert rec.get("candidates"), "spillback receipt without candidates"
+        # acyclic hop chain: origin first, no repeats, target last
+        path = rec.get("path")
+        assert path and path[0] == rec["from_node"]
+        assert path[-1] == rec["node_id"]
+        assert len(set(path)) == len(path)
+
+        # the hop joined a traced task's phase breakdown
+        deadline = time.time() + 20
+        spilled_ev = None
+        while time.time() < deadline and spilled_ev is None:
+            for ev in _gcs("list_tasks", {"limit": 1000}):
+                if (ev.get("spill_hops")
+                        and "spillback" in (ev.get("phases") or {})):
+                    spilled_ev = ev
+                    break
+            time.sleep(0.3)
+        assert spilled_ev, "no traced task carries the spillback phase"
+        hop = spilled_ev["spill_hops"][0]
+        assert hop["from"] and hop["to"] and hop["reason"]
+        assert spilled_ev["phases"]["spillback"] >= 0.0
+        # the phase slots into the canonical order, post-queue_wait
+        from ray_tpu.util.tracing import PHASE_ORDER
+        assert PHASE_ORDER.index("spillback") \
+            == PHASE_ORDER.index("queue_wait") + 1
+    finally:
+        c.shutdown()
+
+
+def test_skewed_flood_drains_without_spill_pingpong_deadlock():
+    """Regression for the acyclic spill_path fix: a flood submitted
+    entirely to a small node used to wedge — both raylets spilled the
+    backlog at each other, each forward JOINed the peer's held-open
+    original future (duplicate task_id) and the task left BOTH queues.
+    The flood must fully drain, and the imbalance tick must recover."""
+    c, _ = _two_node_cluster()
+    try:
+        @ray_tpu.remote
+        def spin():
+            time.sleep(0.05)
+            return 0
+
+        refs = [spin.remote() for _ in range(60)]
+        assert ray_tpu.get(refs, timeout=90) == [0] * 60
+        # balance snapshot exists and reads drained within a few ticks
+        deadline = time.time() + 15
+        cov = None
+        while time.time() < deadline:
+            bal = _gcs("sched_balance", {"limit": 30})
+            cov = bal["cov"]
+            if cov < 0.3 and all(r["load"] == 0 for r in bal["nodes"]):
+                break
+            time.sleep(0.5)
+        assert cov is not None and cov < 0.3, f"imbalance stuck at {cov}"
+    finally:
+        c.shutdown()
+
+
+def test_backpressure_bounce_emits_no_duplicate_receipt():
+    """Satellite regression: a spillback forward bounced by the peer's
+    admission bound requeues locally and stamps NO decision record (the
+    task did not move); a successful forward stamps exactly one."""
+    from ray_tpu.cluster.raylet import Raylet, _SchedQueues
+
+    receipts, task_events, route_calls = [], [], []
+
+    class FakeQueue(_SchedQueues):
+        pass
+
+    class FakeGcs:
+        def __init__(self, route_reply):
+            self._route_reply = route_reply
+
+        async def call(self, method, payload, **kw):
+            assert method == "route_task"
+            route_calls.append(payload)
+            return self._route_reply
+
+    class FakeClient:
+        def __init__(self, reply):
+            self._reply = reply
+
+        async def call(self, method, payload, **kw):
+            return self._reply
+
+    class FakePool:
+        def __init__(self, reply):
+            self._reply = reply
+
+        async def get(self, address):
+            return FakeClient(self._reply)
+
+    class Host:
+        """Just enough raylet surface for Raylet._try_spillback."""
+        node_id = "origin-node"
+        _try_spillback = Raylet._try_spillback
+
+        def __init__(self, route_reply, peer_reply):
+            self._gcs = FakeGcs(route_reply)
+            self._pool = FakePool(peer_reply)
+            self._squeue = FakeQueue()
+            self._dispatch_event = asyncio.Event()
+
+        def _placement_event(self, rec):
+            receipts.append(rec)
+
+        def _task_event(self, *a, **kw):
+            task_events.append((a, kw))
+
+        def _local_features(self, skey=None, payload=None):
+            return {"node_id": self.node_id, "queue_depth": 0}
+
+    def make_item(spill_path=None):
+        loop = asyncio.new_event_loop()
+        p = {"task_id": "t1", "fn_name": "f", "owner": "o",
+             "resources": {"CPU": 1}}
+        if spill_path:
+            p["spill_path"] = spill_path
+        item = {"payload": p, "skey": _SchedQueues.class_key(p),
+                "label": "f", "t": time.monotonic(),
+                "t_enq": time.monotonic(), "spilling": True,
+                "future": loop.create_future()}
+        loop.close()
+        return item
+
+    route = {"node_id": "peer-node", "address": "peer:1"}
+
+    # 1) bounced: requeued locally, NO receipt, future unresolved
+    host = Host(route, {"error": "backpressure"})
+    item = make_item()
+    host._squeue.push(item)
+    asyncio.run(host._try_spillback(item))
+    assert receipts == [], "bounced spillback stamped a decision record"
+    assert task_events == []
+    assert host._squeue.depth(item["skey"]) == 1  # requeued
+    assert not item["spilling"]
+
+    # 2) accepted: exactly one receipt; route excluded the visited path
+    host = Host(route, {"ok": True})
+    item = make_item(spill_path=["earlier-node"])
+    host._squeue.push(item)
+    asyncio.run(host._try_spillback(item))
+    assert len(receipts) == 1
+    assert receipts[0]["kind"] == "spillback"
+    assert receipts[0]["path"] == ["earlier-node", "origin-node",
+                                   "peer-node"]
+    assert set(route_calls[-1]["exclude"]) == {"earlier-node",
+                                               "origin-node"}
+    assert host._squeue.depth(item["skey"]) == 0  # moved, not requeued
+
+
+# ---- surfaces: /api/sched --------------------------------------------------
+
+def test_api_sched_payload():
+    """The dashboard Scheduling tab's payload: decisions joined with the
+    balance snapshot, kind filter honored."""
+    import json
+    import urllib.request
+
+    from ray_tpu.dashboard import start_dashboard
+
+    ray_tpu.init(num_cpus=2)
+
+    @ray_tpu.remote
+    def probe():
+        return 0
+
+    ray_tpu.get(probe.remote(), timeout=60)
+    _poll_events(lambda evs: bool(evs), kind="dispatch_local")
+    port = start_dashboard(port=0)
+
+    def get(path):
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=30) as r:
+            return json.loads(r.read())
+
+    out = get("/api/sched?limit=50")
+    assert set(out) == {"decisions", "balance"}
+    assert any(d.get("kind") == "dispatch_local" for d in out["decisions"])
+    assert "cov" in out["balance"] and "nodes" in out["balance"]
+    assert out["balance"]["nodes"], "balance snapshot lists no nodes"
+    filtered = get("/api/sched?limit=50&kind=spillback")
+    assert all(d.get("kind") == "spillback" for d in filtered["decisions"])
