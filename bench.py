@@ -858,7 +858,7 @@ def _run_phase(env_var: str, prefix: str, timeout: float,
     for marker in ("RT_BENCH_INNER", "RT_BENCH_SWEEP", "RT_BENCH_TRAIN",
                    "RT_BENCH_TRAIN_FAST", "RT_BENCH_DECODE", "RT_BENCH_RL",
                    "RT_BENCH_SERVE", "RT_BENCH_CB", "RT_BENCH_DATA",
-                   "RT_BENCH_RLHF"):
+                   "RT_BENCH_RLHF", "RT_BENCH_ENGINE"):
         env.pop(marker, None)
     env[env_var] = "1"
     if extra_env:
@@ -1263,6 +1263,245 @@ def _cb_main() -> None:
             pass
         ray_tpu.shutdown()
     print("CBBENCH=" + json.dumps(out))
+
+
+def _engine_main() -> None:
+    """Engine flight-recorder phase (RT_BENCH_ENGINE): Poisson decode
+    traffic on a ContinuousEngine, then an injected long-prompt prefill
+    burst on the colocated engine, then recovery. The recorder's
+    ``window_summary`` carves the three legs; SLO targets are calibrated
+    from the steady leg (p99 x margin) so the burst's TPOT dip is a
+    measured attainment drop, not a hand-picked threshold. Prints one
+    JSON line ENGINEBENCH={...}. Config via RT_BENCH_ENGINE_CFG."""
+    # the recorder's ring capacity is read at module import: size it
+    # before ray_tpu comes in so every steady-leg tick survives until
+    # the end-of-run window carve
+    os.environ.setdefault("RT_ENGINE_RECORDER_CAP", "16384")
+    import random
+    import threading
+
+    import numpy as np
+    import jax
+
+    from ray_tpu.models import llama, serving
+
+    cfgd = json.loads(os.environ.get("RT_BENCH_ENGINE_CFG", "{}"))
+    preset = cfgd.get("preset", "bench")
+    steady_s = float(cfgd.get("steady_s", 8.0))
+    recovery_s = float(cfgd.get("recovery_s", 8.0))
+    rate_hz = float(cfgd.get("rate_hz", 4.0))
+    new_tokens = int(cfgd.get("new_tokens", 32))
+    burst_s = float(cfgd.get("burst_s", 2.5))
+    burst_gap_s = float(cfgd.get("burst_gap_s", 0.15))
+    burst_new = int(cfgd.get("burst_new_tokens", 8))
+    max_slots = int(cfgd.get("max_slots", 4))
+    max_len = int(cfgd.get("max_len", 512))
+    short_len = int(cfgd.get("short_len", 16))
+    long_len = int(cfgd.get("long_len", max_len - new_tokens - 8))
+
+    if preset == "bench":
+        # wide enough that a long-prompt prefill costs MANY decode
+        # launches (the asymmetry this phase measures); "debug" prefills
+        # in ~1 decode launch and the burst would vanish into noise
+        cfg = llama.LlamaConfig(vocab_size=2048, d_model=256, n_layers=4,
+                                n_heads=8, n_kv_heads=4, d_ff=1024,
+                                max_seq_len=max(max_len, 256))
+    else:
+        cfg = llama.PRESETS[preset]
+        max_len = min(max_len, cfg.max_seq_len)
+        long_len = min(long_len, max_len - new_tokens - 8)
+    params = llama.init_params(jax.random.key(0), cfg)
+    # kv_cache_bytes=0: cold prefill every time — a prefix cache would
+    # absorb the repeated long prompts and hide the stall being measured
+    eng = serving.ContinuousEngine(params, cfg, max_slots=max_slots,
+                                   max_len=max_len, decode_stride=4,
+                                   warmup=True, kv_cache_bytes=0,
+                                   kv_label="bench-engine")
+    rec = eng._recorder
+
+    def _short_prompt(i: int) -> np.ndarray:
+        # ONE fixed length: prefill compiles per exact prompt length, and
+        # a mid-leg XLA compile would masquerade as a prefill stall
+        return ((np.arange(short_len, dtype=np.int64) * (i * 131 + 7))
+                % cfg.vocab_size).astype(np.int32)
+
+    def _long_prompt(i: int) -> np.ndarray:
+        return ((np.arange(long_len, dtype=np.int64) * (i * 17 + 3))
+                % cfg.vocab_size).astype(np.int32)
+
+    def _drain(q, evt=None):
+        while q.get() is not None:
+            pass
+        if evt is not None:
+            evt.set()
+
+    def _request(prompt: np.ndarray, n: int):
+        evt = threading.Event()
+        q = eng.submit_stream(prompt, n)
+        t = threading.Thread(target=_drain, args=(q, evt), daemon=True)
+        t.start()
+        return evt
+
+    # pre-warm BOTH prompt-length shapes outside the measured windows so
+    # the burst leg charges prefill wall, not one-time XLA compiles
+    for warm in (_short_prompt(0), _long_prompt(0)):
+        _request(warm, 4).wait(timeout=60)
+    time.sleep(0.2)
+
+    stop = threading.Event()
+    pause = threading.Event()
+    done_evts: list = []
+    evts_lock = threading.Lock()
+
+    def _generator():
+        rng = random.Random(42)
+        i = 1
+        while not stop.is_set():
+            time.sleep(min(rng.expovariate(rate_hz), 1.0))
+            if stop.is_set() or pause.is_set():
+                continue
+            evt = _request(_short_prompt(i), new_tokens)
+            with evts_lock:
+                done_evts.append(evt)
+            i += 1
+
+    gen = threading.Thread(target=_generator, daemon=True)
+    gen.start()
+
+    # leg 1: steady Poisson decode traffic
+    t0 = time.time()
+    time.sleep(steady_s)
+    t1 = time.time()
+
+    # leg 2: sustained long-prompt prefill burst injected into live
+    # decode traffic — each admission's cold prefill stalls the decode
+    # launches of every active stream, over and over for burst_s
+    burst_evts = []
+    while time.time() - t1 < burst_s:
+        burst_evts.append(
+            _request(_long_prompt(len(burst_evts) + 1), burst_new))
+        time.sleep(burst_gap_s)
+    for evt in burst_evts:
+        evt.wait(timeout=120)
+    time.sleep(0.3)  # let the stalled decodes finish inside the window
+    t2 = time.time()
+
+    # drain the short-request backlog the burst queued up before opening
+    # the recovery window: recovery measures the post-burst steady state,
+    # not the transition (drain_s reports how long the transition took).
+    # Arrivals pause during the drain — otherwise fresh requests keep
+    # queueing FIFO behind the backlog and the queue never catches up.
+    pause.set()
+    with evts_lock:
+        backlog = list(done_evts)
+    for evt in backlog:
+        evt.wait(timeout=120)
+    time.sleep(0.5)
+    pause.clear()
+    t2b = time.time()
+
+    # leg 3: steady traffic only — attainment should recover
+    time.sleep(recovery_s)
+    t3 = time.time()
+    stop.set()
+    gen.join(timeout=5)
+    with evts_lock:
+        tail = list(done_evts)
+    for evt in tail:
+        evt.wait(timeout=60)
+
+    # calibrate SLOs from the steady leg's RAW percentiles, then carve
+    # all three windows against those targets (attainment is computed at
+    # summary time, so set_slo applies retroactively and uniformly)
+    raw = rec.window_summary(t0, t1)
+    ttft_slo_s = max(raw.get("ttft_p99_s", 0.0) * 1.5, 0.050)
+    tpot_slo_s = max(raw.get("tpot_p99_s", 0.0) * 1.25, 0.0005)
+    rec.set_slo(ttft_slo_s=ttft_slo_s, tpot_slo_s=tpot_slo_s)
+    steady = rec.window_summary(t0, t1)
+    burst = rec.window_summary(t1, t2)
+    recovery = rec.window_summary(t2b, t3)
+    overall = rec.summary()
+    eng.shutdown()
+
+    gap_base = max(steady.get("tick_gap_p99_s", 0.0), 1e-6)
+    out = {
+        "config": {"preset": preset, "max_slots": max_slots,
+                   "max_len": max_len, "short_len": short_len,
+                   "long_len": long_len, "rate_hz": rate_hz,
+                   "new_tokens": new_tokens,
+                   "burst_prompts": len(burst_evts),
+                   "burst_s": burst_s, "burst_new_tokens": burst_new,
+                   "steady_s": steady_s, "recovery_s": recovery_s},
+        "slo": {"ttft_slo_ms": round(ttft_slo_s * 1e3, 3),
+                "tpot_slo_ms": round(tpot_slo_s * 1e3, 3),
+                "calibration": "steady p99 x 1.5 (TTFT) / x 1.25 (TPOT)"},
+        "steady": steady,
+        "burst": burst,
+        "recovery": recovery,
+        "drain_s": round(t2b - t2, 3),
+        "burst_gap_spike_x": round(
+            burst.get("tick_gap_p99_s", 0.0) / gap_base, 1),
+        "burst_tpot_dip": round(
+            steady.get("tpot_attainment", 0.0)
+            - burst.get("tpot_attainment", 1.0), 4),
+        "phase_sum_ratio": overall.get("phase_sum_ratio", 0.0),
+        "overhead_frac": overall.get("overhead_frac", 0.0),
+        "ticks_total": overall.get("ticks_total", 0),
+        "requests_total": overall.get("requests_total", 0),
+    }
+    _preserve({"engine_phase": out},
+              path=os.environ.get("RT_BENCH_ENGINE_OUT", ""))
+    print("ENGINEBENCH=" + json.dumps(out))
+
+
+def _engine_obs_round() -> None:
+    """Focused ``python bench.py --engine-obs`` round: run the engine
+    flight-recorder phase in a scrubbed-CPU subprocess and commit the
+    measured legs as ENGINE_r08.json (the artifact the bench-trajectory
+    checker tracks for summary.steady/recovery series)."""
+    import sys
+
+    res = _run_phase("RT_BENCH_ENGINE", "ENGINEBENCH", timeout=900)
+    if not res:
+        print("bench: engine-obs phase produced no result", file=sys.stderr)
+        sys.exit(1)
+    notes = [
+        "Colocated prefill burst: {}x tick-gap p99 spike over steady, "
+        "TPOT attainment dip of {} during the burst leg.".format(
+            res.get("burst_gap_spike_x"), res.get("burst_tpot_dip")),
+        "Recovery leg TPOT attainment {} (steady {}).".format(
+            res.get("recovery", {}).get("tpot_attainment"),
+            res.get("steady", {}).get("tpot_attainment")),
+        "Recorder overhead {} of engine-thread tick wall; per-tick phase "
+        "sums cover {} of it.".format(
+            res.get("overhead_frac"), res.get("phase_sum_ratio")),
+        "SLO targets calibrated from the steady leg, applied "
+        "retroactively to all three windows.",
+    ]
+    art = {
+        "round": "r08",
+        "artifact": "ENGINE_r08",
+        "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "platform": os.environ.get("RT_BENCH_PLATFORM", "cpu"),
+        "summary": res,
+        "notes": notes,
+    }
+    path = os.environ.get("RT_BENCH_ENGINE_OUT") or os.path.join(
+        _REPO_ROOT, "ENGINE_r08.json")
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(art, f, indent=1)
+        f.write("\n")
+    os.replace(tmp, path)
+    print(f"bench: engine-obs round written to {path}")
+    print("ENGINEOBS=" + json.dumps(
+        {"steady_goodput_tok_s": res.get("steady", {}).get("goodput_tok_s"),
+         "burst_tpot_attainment": res.get("burst", {}).get(
+             "tpot_attainment"),
+         "recovery_tpot_attainment": res.get("recovery", {}).get(
+             "tpot_attainment"),
+         "burst_gap_spike_x": res.get("burst_gap_spike_x"),
+         "overhead_frac": res.get("overhead_frac")}))
 
 
 def _data_main() -> None:
@@ -1750,6 +1989,12 @@ def main() -> None:
         return
     if os.environ.get("RT_BENCH_DATA"):
         _data_main()
+        return
+    if os.environ.get("RT_BENCH_ENGINE"):
+        _engine_main()
+        return
+    if "--engine-obs" in sys.argv[1:]:
+        _engine_obs_round()
         return
 
     # TPU perf flags (latency-hiding scheduler, async collectives) must be

@@ -101,6 +101,10 @@ def bootstrap_jax_distributed(world_size: int, rank: int,
 
     _shutdown_previous_gang()
 
+    try:  # jax 0.4.x gates CPU cross-process collectives behind gloo opt-in
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except AttributeError:  # newer jax: on by default, option removed
+        pass
     kwargs = dict(coordinator_address=address,
                   num_processes=world_size,
                   process_id=rank,

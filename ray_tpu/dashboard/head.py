@@ -17,6 +17,7 @@ State API), ``dashboard/modules/metrics`` (Prometheus). Routes:
   GET /api/jobs                 submitted jobs
   GET /api/serve/applications   serve app states
   GET /api/sched                placement decisions + cross-node balance
+  GET /api/engine               engine flight-recorder snapshots
   GET /api/cluster_resources    total/available
   GET /metrics                  Prometheus text page
   GET /-/healthz                liveness
@@ -70,6 +71,9 @@ class DashboardActor:
         # the placement-receipt plane: decision records + the cross-node
         # balance snapshot (GCS placement_events store / sched_balance)
         app.router.add_get("/api/sched", self._sched)
+        # the engine plane: flight-recorder snapshots (@engine/ KV —
+        # tick phases, request lifecycles, SLO/goodput rollups)
+        app.router.add_get("/api/engine", self._engine)
         app.router.add_get("/api/stacks", self._stacks)
         app.router.add_get("/metrics", self._metrics)
         self._runner = web.AppRunner(app, access_log=None)
@@ -197,6 +201,39 @@ class DashboardActor:
                     backend._gcs.call("list_placement_events", payload),
                     backend._gcs.call("sched_balance", {}))
                 return {"decisions": decisions, "balance": balance}
+
+            return backend.io.run(run())
+
+        loop = asyncio.get_running_loop()
+        out = await loop.run_in_executor(None, fetch)
+        return web.json_response(out, dumps=_dumps)
+
+    async def _engine(self, request):
+        """The Engine tab's payload: every live ContinuousEngine's
+        flight-recorder snapshot (util/engine_recorder.py drain pushes
+        them to the ``@engine/`` KV) — summary SLO/goodput rollup plus
+        the tick-phase and request-lifecycle record tails."""
+        from aiohttp import web
+
+        def fetch():
+            backend = self._backend()
+
+            async def run():
+                keys = (await backend._gcs.call(
+                    "kv_keys", {"prefix": "@engine/"})).get("keys") or []
+                replies = await asyncio.gather(
+                    *(backend._gcs.call("kv_get", {"key": k})
+                      for k in sorted(keys)[:50]))
+                engines = []
+                for reply in replies:
+                    raw = reply.get("value")
+                    if not raw:
+                        continue
+                    try:
+                        engines.append(json.loads(raw))
+                    except ValueError:
+                        continue
+                return {"engines": engines}
 
             return backend.io.run(run())
 

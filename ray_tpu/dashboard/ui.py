@@ -101,6 +101,17 @@ header button {
 .ph-arg_fetch { background: var(--series-3); }
 .ph-result_store { background: var(--series-2); }
 .ph-other { background: var(--text-muted); }
+/* engine tick-phase bar: admission/prefill warm-ish, decode cool */
+.phase-bar { display: flex; gap: 2px; height: 10px; margin: 6px 0 10px;
+  max-width: 420px; }
+.phase-bar .ph { display: inline-block; height: 100%; border-radius: 2px;
+  background: var(--text-muted); }
+.ph-admission { background: var(--warning); }
+.ph-kv_restore { background: var(--series-3); }
+.ph-prefill { background: var(--series-2); }
+.ph-decode_step { background: var(--series-1); }
+.ph-token_delivery { background: var(--serious); }
+.ph-swap_barrier { background: var(--critical, #d33); }
 .legend { display: flex; gap: 14px; margin: 0 0 10px;
   font-size: 12px; color: var(--text-secondary); }
 .legend .chip { display: inline-block; width: 9px; height: 9px;
@@ -207,6 +218,7 @@ const TABS = [
   {id: "logs", label: "Logs", url: "/api/logs?limit=300"},
   {id: "serve", label: "Serve", url: "/api/serve"},
   {id: "sched", label: "Scheduling", url: "/api/sched?limit=200"},
+  {id: "engine", label: "Engine", url: "/api/engine"},
 ];
 let active = "nodes", paused = false, data = {};
 
@@ -626,12 +638,66 @@ function renderSched(el) {
       : `<div class="empty">none recorded</div>`);
 }
 
+// --- engine tab: ContinuousEngine flight-recorder snapshots ---
+const ENGINE_PHASES = ["admission", "kv_restore", "prefill", "decode_step",
+                       "token_delivery", "swap_barrier"];
+function renderEngine(el) {
+  const payload = data.engine || {};
+  const engines = payload.engines || [];
+  if (!engines.length) {
+    el.innerHTML = `<div class="empty">no engine flight-recorder ` +
+      `snapshots — start a ContinuousEngine (RT_ENGINE_RECORDER=1)</div>`;
+    return;
+  }
+  const ms = v => v == null ? "" : (1e3 * v).toFixed(1);
+  el.innerHTML = engines.map(snap => {
+    const s = snap.summary || {};
+    const phases = s.phase_s || {};
+    const wall = Math.max(1e-9, s.tick_wall_s || 0);
+    const bar = ENGINE_PHASES.filter(p => phases[p] > 0).map(p =>
+      `<span class="ph ph-${esc(p)}" title="${esc(p)} ` +
+      `${(100 * phases[p] / wall).toFixed(1)}%" style="width:` +
+      `${Math.max(1, Math.round(100 * phases[p] / wall))}px"></span>`)
+      .join("");
+    const att = (label, v, p99, tgt) => v == null ? "" :
+      `${label} ${(100 * v).toFixed(1)}%` +
+      (p99 != null ? ` (p99 ${ms(p99)}ms / tgt ${ms(tgt)}ms)` : "");
+    const reqs = (snap.requests || []).slice().reverse().map(r =>
+      `<tr><td class="id">${esc(String(r.request_id ?? r.rid ?? "")
+        .slice(0, 16))}</td>` +
+      `<td>${statusCell(String(r.state || "").toUpperCase())}</td>` +
+      `<td>${esc(r.queue_wait_ms ?? "")}</td>` +
+      `<td>${esc(r.prompt_tokens ?? 0)}/${esc(r.cached_tokens ?? 0)}</td>` +
+      `<td>${esc(r.tokens ?? 0)}</td><td>${esc(r.decode_ticks ?? 0)}</td>` +
+      `<td>${esc(r.ttft_ms ?? "")}</td><td>${esc(r.tpot_ms ?? "")}</td>` +
+      `</tr>`).join("");
+    return `<h3>${esc(snap.name || "engine")} <span class="muted">` +
+      `${esc(String(snap.node || "").slice(0, 8))}:${esc(snap.pid || "")}` +
+      `</span></h3>` +
+      `<div class="muted">ticks ${esc(s.window_ticks ?? 0)} · active ` +
+      `${esc(s.active ?? 0)}/${esc(s.max_slots ?? "?")} · ` +
+      `${att("TTFT", s.ttft_attainment, s.ttft_p99_s, s.ttft_slo_s)} · ` +
+      `${att("TPOT", s.tpot_attainment, s.tpot_p99_s, s.tpot_slo_s)} · ` +
+      `goodput ${(s.goodput_tok_s || 0).toFixed(1)} tok/s ` +
+      `(capacity ${(s.capacity_tok_s || 0).toFixed(1)}) · ` +
+      `decode-eff ${((s.decode_efficiency || 0) * 100).toFixed(1)}% · ` +
+      `gap p99 ${ms(s.tick_gap_p99_s)}ms · overhead ` +
+      `${((s.overhead_frac || 0) * 100).toFixed(3)}%</div>` +
+      `<div class="phase-bar">${bar}</div>` +
+      (reqs ? `<table><tr><th>Request</th><th>State</th>` +
+        `<th>Queue ms</th><th>Prompt/cached</th><th>Tokens</th>` +
+        `<th>Ticks</th><th>TTFT ms</th><th>TPOT ms</th></tr>${reqs}` +
+        `</table>` : `<div class="empty">no request records yet</div>`);
+  }).join("");
+}
+
 function renderTable() {
   const el = document.getElementById("content");
   if (active === "timeline") { renderTimeline(el); return; }
   if (active === "memory") { renderMemory(el); return; }
   if (active === "logs") { renderLogs(el); return; }
   if (active === "sched") { renderSched(el); return; }
+  if (active === "engine") { renderEngine(el); return; }
   if (active === "serve") {
     const payload = data.serve || {};
     const apps = payload.applications || payload;

@@ -47,6 +47,7 @@ import numpy as np
 
 from ray_tpu.models import generate as G
 from ray_tpu.models import llama
+from ray_tpu.util import engine_recorder as _rec
 from ray_tpu.util import prefix_hash as PH
 
 Params = Dict[str, Any]
@@ -332,8 +333,9 @@ class ContinuousBatcher:
         self._topk = np.zeros(max_slots, np.int32)
         self._keys = np.zeros((max_slots, 2), np.uint32)
         # set by every submit_ex: admission telemetry the engine reads
-        # (cached_tokens rides the request span; TTFT-collapse evidence)
-        self.last_admission: Dict[str, int] = {}
+        # (cached_tokens rides the request span; TTFT-collapse evidence;
+        # kv_restore_s/prefill_s feed the flight recorder's tick phases)
+        self.last_admission: Dict[str, Any] = {}
 
     # -- admission --------------------------------------------------------
 
@@ -375,8 +377,10 @@ class ContinuousBatcher:
         slot = self._free.pop()
         prompt_arr = np.asarray(prompt, np.int32)
         cached = 0
+        t_kv0 = time.perf_counter()
         hit = (self.prefix_cache.lookup(prompt_arr)
                if self.prefix_cache is not None else None)
+        kv_restore_s = 0.0
         try:
             if hit is not None:
                 cached, pk, pv = hit
@@ -386,11 +390,15 @@ class ContinuousBatcher:
                 args = (self.params, self._ck, self._cv,
                         jnp.asarray(pk), jnp.asarray(pv),
                         jnp.asarray(prompt_arr[cached:])[None, :], slot)
+                # warm admission's restore cost: the lookup + uploading
+                # the retained pages (the compiled call scatters them)
+                kv_restore_s = time.perf_counter() - t_kv0
             else:
                 fn = _compiled_slot_prefill(self.cfg, s, self.max_slots,
                                             self.max_len, self.sampling)
                 args = (self.params, self._ck, self._cv,
                         jnp.asarray(prompt_arr)[None, :], slot)
+            t_pf0 = time.perf_counter()
             if self.sampling:
                 key0 = jnp.asarray(
                     np.asarray(jax.random.PRNGKey(int(seed)), np.uint32))
@@ -399,6 +407,7 @@ class ContinuousBatcher:
                     key0)
             else:
                 self._ck, self._cv, first = fn(*args)
+            prefill_s = time.perf_counter() - t_pf0
         except BaseException:
             # a failed prefill must not leak the slot: callers (the
             # engine's admit loop) catch and continue, and a leaked slot
@@ -416,7 +425,9 @@ class ContinuousBatcher:
             self._temp[slot] = temperature
             self._topk[slot] = top_k
             self._keys[slot] = np.asarray(new_key)
-        self.last_admission = {"cached_tokens": cached, "prompt_tokens": s}
+        self.last_admission = {"cached_tokens": cached, "prompt_tokens": s,
+                               "slot": slot, "kv_restore_s": kv_restore_s,
+                               "prefill_s": prefill_s}
         done = req.remaining <= 0
         if done:
             self._capture(slot, req)
@@ -602,11 +613,12 @@ _STREAM_END = None  # sentinel a token stream's queue yields when done
 class _EngineRequest:
     __slots__ = ("prompt", "max_new_tokens", "out", "on_token", "req_id",
                  "cancelled", "temperature", "top_k", "seed",
-                 "cached_tokens")
+                 "cached_tokens", "t_submit", "obs_ctx")
 
     def __init__(self, prompt: np.ndarray, max_new_tokens: int,
                  on_token: Optional[Callable[[Optional[int]], None]] = None,
-                 temperature: float = 0.0, top_k: int = 0, seed: int = 0):
+                 temperature: float = 0.0, top_k: int = 0, seed: int = 0,
+                 obs_ctx: Optional[Dict[str, str]] = None):
         self.prompt = prompt
         self.max_new_tokens = max_new_tokens
         self.on_token = on_token
@@ -614,6 +626,12 @@ class _EngineRequest:
         self.top_k = top_k
         self.seed = seed
         self.cached_tokens: Optional[int] = None  # set at admission
+        self.t_submit = time.time()  # queue-wait starts here
+        # ambient serve span context ({request_id, span_id}), when the
+        # submitter rode a serve request — the flight recorder parents
+        # the engine lifecycle span on it so `rt trace <rid>` descends
+        # from proxy/replica into engine phases
+        self.obs_ctx = obs_ctx
         # at most max_new_tokens items + the end sentinel ever sit here,
         # so an unbounded queue is bounded in practice and the shared
         # engine thread can never block on a slow consumer
@@ -708,6 +726,17 @@ class ContinuousEngine:
         # (new_params, state dict) queued by load_params; applied by the
         # engine thread once every active slot has drained
         self._pending_swap: Optional[Tuple] = None  # rt: guarded-by(_work)
+        # flight recorder: the engine thread stamps tick/request records
+        # into its bounded deques; a separate drain thread ships metrics/
+        # spans/KV snapshots (NO GCS or metrics I/O on the tick path)
+        self._recorder = _rec.EngineRecorder(kv_label or "engine",
+                                             max_slots=max_slots)
+        # engine-thread-confined tick state (never touched off-thread):
+        # end of the previous decode launch (the tick-gap anchor; reset
+        # to None when the engine goes idle) and the wall spent applying
+        # a weight swap since the last recorded tick
+        self._last_decode_end: Optional[float] = None
+        self._tick_swap_s = 0.0
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="rt-cb-engine")
         self._thread.start()
@@ -716,20 +745,23 @@ class ContinuousEngine:
 
     def submit_stream(self, prompt: np.ndarray, max_new_tokens: int, *,
                       temperature: float = 0.0, top_k: int = 0,
-                      seed: int = 0) -> "_queue.Queue":
+                      seed: int = 0, obs_ctx: Optional[Dict] = None
+                      ) -> "_queue.Queue":
         """Queue one request; returns its token queue (ints, then the
         ``None`` end sentinel). Admission control beyond the pending queue
         belongs to the serving layer (``max_ongoing_requests``).
         ``temperature``/``top_k``/``seed`` select sampled decode (engine
-        must be built with ``sampling=True``); the default stays greedy."""
+        must be built with ``sampling=True``); the default stays greedy.
+        ``obs_ctx`` (a serve {request_id, span_id}) joins the request's
+        flight-recorder lifecycle to the serve span tree."""
         return self._submit(prompt, max_new_tokens, None,
                             temperature=temperature, top_k=top_k,
-                            seed=seed).out
+                            seed=seed, obs_ctx=obs_ctx).out
 
     def submit_cb(self, prompt: np.ndarray, max_new_tokens: int,
                   on_token: Callable[[List[Optional[int]]], None], *,
                   temperature: float = 0.0, top_k: int = 0,
-                  seed: int = 0):
+                  seed: int = 0, obs_ctx: Optional[Dict] = None):
         """Callback form: ``on_token(burst)`` fires from the engine
         thread with each tick's token burst (a list of ints; a ``None``
         element marks end-of-stream). Zero consumer threads — an asyncio
@@ -739,11 +771,12 @@ class ContinuousEngine:
         Returns an opaque handle for :meth:`cancel`."""
         return self._submit(prompt, max_new_tokens, on_token,
                             temperature=temperature, top_k=top_k,
-                            seed=seed)
+                            seed=seed, obs_ctx=obs_ctx)
 
     def _submit(self, prompt: np.ndarray, max_new_tokens: int,
                 on_token, *, temperature: float = 0.0, top_k: int = 0,
-                seed: int = 0) -> "_EngineRequest":
+                seed: int = 0,
+                obs_ctx: Optional[Dict] = None) -> "_EngineRequest":
         s = len(prompt)
         if s + max_new_tokens + 1 > self.max_len:
             raise ValueError(f"prompt {s} + new {max_new_tokens} exceeds "
@@ -753,7 +786,8 @@ class ContinuousEngine:
                              "sampling=True at engine construction")
         req = _EngineRequest(np.asarray(prompt, np.int32), max_new_tokens,
                              on_token, temperature=float(temperature),
-                             top_k=int(top_k), seed=int(seed))
+                             top_k=int(top_k), seed=int(seed),
+                             obs_ctx=obs_ctx)
         with self._work:
             if self._stopped:
                 raise RuntimeError("engine is shut down")
@@ -813,6 +847,11 @@ class ContinuousEngine:
             # kv stats ride replica stats_window -> controller win_stats
             # -> `rt serve status` hit-rate column / dashboard Serve tab
             out["kv"] = cache.stats()
+        if self._recorder.enabled:
+            # flight-recorder rollup (tick phases, tick-gap, SLO
+            # attainment, goodput) — computed off the engine lock; rides
+            # the same replica stats_window path into `rt serve status`
+            out["recorder"] = self._recorder.summary()
         return out
 
     def kv_stats(self) -> Optional[Dict[str, Any]]:
@@ -896,30 +935,38 @@ class ContinuousEngine:
             self._stopped = True
             self._work.notify()
         self._thread.join(timeout=timeout_s)
+        # stop the drain thread and drop the @engine/ KV snapshot — the
+        # doctor must not grade a dead engine's numbers
+        self._recorder.close()
 
     # -- the engine thread ------------------------------------------------
 
-    def _admit_all(self) -> None:
+    def _admit_all(self) -> Dict[str, Any]:
         """Prefill pending requests into free slots. The jax prefill —
         which can hide a multi-second XLA compile for a new prompt
         length — runs OUTSIDE the lock, so submit/cancel/stats/
         check_alive stay responsive while it compiles (the batcher
         itself is engine-thread-owned and needs no lock); only the
-        pending/live bookkeeping is locked."""
+        pending/live bookkeeping is locked.
+
+        Returns the tick's admission accounting for the flight recorder:
+        {kv_restore, prefill, admitted} — the caller attributes its own
+        wall minus these to the ``admission`` phase."""
+        adm = {"kv_restore": 0.0, "prefill": 0.0, "admitted": 0}
         while True:
             with self._work:
                 # honor shutdown BEFORE paying another prefill (each can
                 # hide a multi-second compile) — the stopped branch in
                 # _run ends the remaining streams
                 if self._stopped:
-                    return
+                    return adm
                 if self._pending_swap is not None:
                     # drain barrier: a queued weight swap holds admission
                     # (a prefill under the old weights admitted now would
                     # decode under the new ones after the swap)
-                    return
+                    return adm
                 if not (self._pending and self._batcher._free):
-                    return
+                    return adm
                 req = self._pending.popleft()
                 if req.cancelled:
                     continue
@@ -929,8 +976,8 @@ class ContinuousEngine:
                     req.prompt, req.max_new_tokens,
                     temperature=req.temperature, top_k=req.top_k,
                     seed=req.seed)
-                req.cached_tokens = self._batcher.last_admission.get(
-                    "cached_tokens", 0)
+                la = self._batcher.last_admission
+                req.cached_tokens = la.get("cached_tokens", 0)
             except Exception:  # noqa: BLE001 — ONE request's prefill
                 # failing (bad shape, transient XLA error) must fail that
                 # request, not wedge the shared engine thread
@@ -941,20 +988,39 @@ class ContinuousEngine:
             with self._work:
                 self._admitting = None
                 req.req_id = req_id
-                if req.cancelled:
+                cancelled = req.cancelled
+                if cancelled:
                     # cancelled mid-prefill: free the slot, end the stream
                     if not done:
                         self._batcher.cancel(req_id)
                     req.emit_many([_STREAM_END])
-                    continue
-                self._admitted += 1
-                req.emit_many([first_tok, _STREAM_END] if done
-                              else [first_tok])
-                self._tokens_out += 1
-                if done:
-                    self._requests_completed += 1
                 else:
-                    self._live[req_id] = req
+                    self._admitted += 1
+                    req.emit_many([first_tok, _STREAM_END] if done
+                                  else [first_tok])
+                    self._tokens_out += 1
+                    if done:
+                        self._requests_completed += 1
+                    else:
+                        self._live[req_id] = req
+            # lifecycle record, OUTSIDE the engine lock: admission just
+            # produced the first token, so this stamp is the TTFT stamp
+            adm["kv_restore"] += la.get("kv_restore_s", 0.0)
+            adm["prefill"] += la.get("prefill_s", 0.0)
+            adm["admitted"] += 1
+            now = time.time()
+            self._recorder.request_admitted(
+                req_id, t_submit=req.t_submit, t_admit=now,
+                prompt_tokens=len(req.prompt),
+                cached_tokens=req.cached_tokens or 0,
+                prefill_s=la.get("prefill_s", 0.0),
+                kv_restore_s=la.get("kv_restore_s", 0.0),
+                slot=la.get("slot", -1), obs_ctx=req.obs_ctx)
+            if cancelled:
+                self._recorder.request_done(req_id, t=now,
+                                            state="cancelled")
+            elif done:
+                self._recorder.request_done(req_id, t=now, state="done")
 
     def _maybe_swap_locked(self) -> None:
         """Apply a queued weight swap once the engine is fully drained
@@ -962,6 +1028,7 @@ class ContinuousEngine:
         if (self._pending_swap is None or self._live
                 or self._admitting is not None):
             return
+        t_swap0 = time.perf_counter()
         params, waiters = self._pending_swap
         self._pending_swap = None
         self._batcher.params = params
@@ -974,6 +1041,11 @@ class ContinuousEngine:
         for st in waiters:
             st["applied"] = True
             st["event"].set()
+        # swap-barrier phase: the apply wall (drain time shows up as the
+        # preceding ticks' shrinking active counts, not here); consumed
+        # by the next record_tick (engine-thread-confined accumulator)
+        self._tick_swap_s += time.perf_counter() - t_swap0
+        self._recorder.record_swap(time.perf_counter() - t_swap0)
 
     def _fail_swap_locked(self, reason: str) -> None:
         """Unblock load_params waiters when the engine stops or dies
@@ -987,7 +1059,10 @@ class ContinuousEngine:
             st["event"].set()
 
     def _run(self) -> None:
+        rec = self._recorder
         while True:
+            t_tick0 = time.perf_counter()
+            t_wall0 = time.time()
             with self._work:
                 # reap cancellations before admitting into their slots
                 doomed = [rid for rid, r in self._live.items()
@@ -1004,9 +1079,16 @@ class ContinuousEngine:
             # the moment it applies.
             for rid in doomed:
                 self._batcher.cancel(rid)
+                rec.request_done(rid, t=t_wall0, state="cancelled")
             with self._work:
                 self._maybe_swap_locked()
-            self._admit_all()
+            t_adm0 = time.perf_counter()
+            adm = self._admit_all()
+            # admission phase = this tick's admission wall minus the
+            # batcher-attributed kv-restore/prefill shares (slot
+            # bookkeeping, cancel checks, first-token delivery)
+            adm_phase = max(0.0, (time.perf_counter() - t_adm0)
+                            - adm["kv_restore"] - adm["prefill"])
             with self._work:
                 if self._stopped:
                     self._fail_swap_locked("engine shut down mid-drain")
@@ -1019,6 +1101,24 @@ class ContinuousEngine:
                     return
                 if not self._live:
                     self._maybe_swap_locked()
+                    swap_s = self._tick_swap_s
+                    self._tick_swap_s = 0.0
+                    if adm["admitted"] or swap_s > 0.0:
+                        # admission-only tick (every admitted request
+                        # finished at its first token, or a swap landed)
+                        rec.record_tick(
+                            t_start=t_wall0,
+                            wall_s=time.perf_counter() - t_tick0,
+                            phases={"admission": adm_phase,
+                                    "kv_restore": adm["kv_restore"],
+                                    "prefill": adm["prefill"],
+                                    "swap_barrier": swap_s},
+                            active=0, pending=len(self._pending),
+                            bucket=0, k=0, tokens=adm["admitted"],
+                            admitted=adm["admitted"], gap_s=None)
+                    # engine going idle: the next decode launch starts a
+                    # fresh gap baseline (an idle engine is not starved)
+                    self._last_decode_end = None
                     if self._pending or self._pending_swap is not None:
                         continue  # freshly unblocked work: no idle wait
                     self._work.wait(timeout=0.5)
@@ -1032,6 +1132,14 @@ class ContinuousEngine:
             k = (self.decode_stride
                  if self._batcher.max_remaining >= self.decode_stride
                  else 1)
+            n_active = self._batcher.num_active
+            bucket = 1 if n_active == 1 else self.max_slots
+            t_dec0 = time.perf_counter()
+            # tick-gap: decode-launch start minus the previous launch's
+            # end, while slots stayed active — THE starvation signal (a
+            # long-prompt prefill burst between launches shows up here)
+            gap_s = (t_dec0 - self._last_decode_end
+                     if self._last_decode_end is not None else None)
             try:
                 emitted = self._batcher.step_many(k)
             except Exception as e:  # noqa: BLE001 — a failed decode step
@@ -1049,6 +1157,10 @@ class ContinuousEngine:
                         req.emit_many([_STREAM_END])
                     self._pending.clear()
                 return
+            t_dec1 = time.perf_counter()
+            self._last_decode_end = t_dec1
+            tick_tokens = adm["admitted"]
+            tok_events: List[Tuple[int, int, bool]] = []
             with self._work:
                 self._steps += 1
                 for rid, toks, done in emitted:
@@ -1057,12 +1169,31 @@ class ContinuousEngine:
                         continue  # cancelled between step and dispatch
                     burst = [int(t) for t in toks]
                     self._tokens_out += len(burst)
+                    tick_tokens += len(burst)
+                    tok_events.append((rid, len(burst), done))
                     if done:
                         burst.append(_STREAM_END)
                         del self._live[rid]
                         self._requests_completed += 1
                     req.emit_many(burst)
                 tick, cap = len(self._live), self.max_slots
+                pending_n = len(self._pending)
+            t_emit1 = time.perf_counter()
+            swap_s = self._tick_swap_s
+            self._tick_swap_s = 0.0
+            now = time.time()
+            for rid, nburst, done in tok_events:
+                rec.request_tokens(rid, nburst, now, done)
+            rec.record_tick(
+                t_start=t_wall0, wall_s=t_emit1 - t_tick0,
+                phases={"admission": adm_phase,
+                        "kv_restore": adm["kv_restore"],
+                        "prefill": adm["prefill"],
+                        "decode_step": t_dec1 - t_dec0,
+                        "token_delivery": t_emit1 - t_dec1,
+                        "swap_barrier": swap_s},
+                active=n_active, pending=pending_n, bucket=bucket, k=k,
+                tokens=tick_tokens, admitted=adm["admitted"], gap_s=gap_s)
             if self._on_tick is not None:
                 try:
                     self._on_tick(tick, cap)

@@ -28,6 +28,9 @@ from jax.experimental.pallas import tpu as pltpu
 
 from ray_tpu.ops.attention import NEG_INF
 
+# jax >= 0.6 spells it CompilerParams; 0.4.x TPUCompilerParams (same kwargs).
+_CompilerParams = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+
 
 def _needs_interpret() -> bool:
     return jax.default_backend() != "tpu"
@@ -123,7 +126,7 @@ def _flash_fwd_bhsd(q, k, v, q_offset, *, scale, causal, kv_len,
             pltpu.VMEM((block_q, 1), jnp.float32),
             pltpu.VMEM((block_q, d), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(q_offset, q, k, v)
@@ -235,7 +238,7 @@ def _flash_bwd_bhsd(q, k, v, o, lse, do, q_offset, *, scale, causal, kv_len,
         out_specs=[qspec],
         out_shape=[jax.ShapeDtypeStruct((bh, sq, d), q.dtype)],
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(q_offset, q, k, v, do, lse, delta)[0]
@@ -256,7 +259,7 @@ def _flash_bwd_bhsd(q, k, v, o, lse, do, q_offset, *, scale, causal, kv_len,
                    jax.ShapeDtypeStruct((bh, sk, d), v.dtype)],
         scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
                         pltpu.VMEM((block_k, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(q_offset, q, k, v, do, lse, delta)

@@ -38,6 +38,21 @@ from ray_tpu.ops.pallas.flash import (
 _CURRENT_MESH: contextvars.ContextVar[Optional[Mesh]] = contextvars.ContextVar(
     "ray_tpu_mesh", default=None)
 
+if hasattr(jax, "shard_map"):  # jax >= 0.6: top-level, check_vma spelling
+    shard_map = jax.shard_map
+else:  # jax 0.4.x: experimental module, check_rep spelling
+    from jax.experimental.shard_map import shard_map as _shard_map_old
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True):
+        return _shard_map_old(f, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, check_rep=check_vma)
+
+if hasattr(lax, "axis_size"):  # jax >= 0.6
+    axis_size = lax.axis_size
+else:  # jax 0.4.x: psum of the literal 1 constant-folds to a concrete int
+    def axis_size(axis_name):
+        return lax.psum(1, axis_name)
+
 
 @contextlib.contextmanager
 def mesh_scope(mesh: Mesh):
@@ -68,7 +83,7 @@ def _merge(o1, lse1, o2, lse2):
 
 
 def _ring_perm(axis_name):
-    p = lax.axis_size(axis_name)
+    p = axis_size(axis_name)
     return [(i, (i + 1) % p) for i in range(p)]
 
 
@@ -87,7 +102,7 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = True,
 
 
 def _ring_fwd_loop(q, k, v, axis_name, causal, scale):
-    p = lax.axis_size(axis_name)
+    p = axis_size(axis_name)
     my = lax.axis_index(axis_name)
     s_loc = q.shape[1]
     b, _, hq, d = q.shape
@@ -119,7 +134,7 @@ def _ring_fwd(q, k, v, axis_name, causal, scale):
 
 def _ring_bwd(axis_name, causal, scale, res, do):
     q, k, v, o, lse = res
-    p = lax.axis_size(axis_name)
+    p = axis_size(axis_name)
     my = lax.axis_index(axis_name)
     s_loc = q.shape[1]
     perm = _ring_perm(axis_name)
@@ -162,7 +177,7 @@ def ulysses_attention(q, k, v, axis_name: str, causal: bool = True,
     repeated up to hq first if P doesn't divide them (GQA). Differentiable
     through ``lax.all_to_all``.
     """
-    p = lax.axis_size(axis_name)
+    p = axis_size(axis_name)
     hq, hkv = q.shape[2], k.shape[2]
     if hq % p:
         raise ValueError(f"ulysses: q heads {hq} not divisible by sp={p}")
@@ -208,7 +223,7 @@ def sequence_parallel_attention(q, k, v, *,
             return ulysses_attention(qq, kk, vv, axis_name, causal, scale)
         raise ValueError(f"unknown sp impl {impl!r}")
 
-    return jax.shard_map(
+    return shard_map(
         local, mesh=mesh,
         in_specs=(qspec, qspec, qspec),
         out_specs=qspec,

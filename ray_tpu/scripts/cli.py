@@ -372,6 +372,14 @@ def cmd_serve(args: argparse.Namespace) -> int:
                 if "kv_hit_rate" in s:
                     cb += (f"  kv {100 * s['kv_hit_rate']:.0f}%"
                            f" {s.get('kv_bytes', 0) / 1e6:.1f}MB")
+                if "eng_ttft_att" in s:
+                    # engine flight-recorder rollup: SLO attainment +
+                    # goodput + worst decode tick-gap across the fleet
+                    cb += (f"  slo {s['eng_ttft_att']:.2f}/"
+                           f"{s['eng_tpot_att']:.2f}"
+                           f"  goodput {s.get('eng_goodput_tok_s', 0):.0f}"
+                           f"tok/s"
+                           f"  gap {1e3 * s.get('eng_gap_p99_s', 0):.0f}ms")
                 print(f"  {name:<24} replicas {d.get('replicas', 0)}/"
                       f"{d.get('target', 0)}"
                       f"{' (+%d starting)' % d['starting'] if d.get('starting') else ''}"
@@ -813,9 +821,123 @@ def cmd_doctor(args: argparse.Namespace) -> int:
                           queue_wait_warn_s=args.queue_wait_warn,
                           serve_p99_warn_s=args.serve_p99_warn,
                           imbalance_warn=args.imbalance_warn,
+                          tick_gap_warn_s=args.tick_gap_warn,
+                          slo_warn=args.slo_warn,
                           as_json=args.json)
     print(text, file=sys.stderr if rc == 2 else sys.stdout)
     return rc
+
+
+def cmd_engine(args: argparse.Namespace) -> int:
+    """rt engine stats/ticks/requests: the ContinuousEngine flight-
+    recorder plane (util/engine_recorder.py). Each live engine's drain
+    thread pushes an @engine/ KV snapshot (summary + tick/request record
+    tails); this reads them straight off the GCS — no driver attach, so
+    it works while the engine is saturated."""
+    gcs = _resolve_gcs(args.address)
+    if gcs is None:
+        print("rt engine: no running cluster found (pass --address)",
+              file=sys.stderr)
+        return 1
+    try:
+        keys = _gcs_call(gcs, "kv_keys",
+                         {"prefix": "@engine/"}).get("keys") or []
+        snaps = []
+        for k in sorted(keys):
+            raw = _gcs_call(gcs, "kv_get", {"key": k}).get("value")
+            if not raw:
+                continue
+            try:
+                snaps.append(json.loads(raw))
+            except ValueError:
+                continue
+    except Exception as e:  # noqa: BLE001 — one line, no stack trace
+        print(f"rt engine: cannot reach GCS at {gcs}: "
+              f"{type(e).__name__}: {e}", file=sys.stderr)
+        return 1
+    if args.name:
+        snaps = [s for s in snaps
+                 if args.name in f"{s.get('node')}:{s.get('name')}"]
+    if args.json:
+        if args.engine_cmd == "stats":
+            print(json.dumps(snaps, indent=2, default=str))
+        else:
+            key = "ticks" if args.engine_cmd == "ticks" else "requests"
+            print(json.dumps(
+                [{"engine": f"{s.get('node')}:{s.get('name')}",
+                  key: (s.get(key) or [])[-args.limit:]} for s in snaps],
+                indent=2, default=str))
+        return 0
+    if not snaps:
+        print("(no engine flight-recorder snapshots — no live "
+              "ContinuousEngine, or RT_ENGINE_RECORDER=0)")
+        return 0
+    for s in snaps:
+        label = f"{s.get('node')}:{s.get('pid')}:{s.get('name')}"
+        summ = s.get("summary") or {}
+        if args.engine_cmd == "stats":
+            print(f"engine {label}")
+            print(f"  ticks {summ.get('ticks_total', 0)}  active "
+                  f"{summ.get('active', 0)}  requests "
+                  f"{summ.get('requests_total', 0)} "
+                  f"({summ.get('cancelled_total', 0)} cancelled)  swaps "
+                  f"{summ.get('swaps', 0)}")
+            phases = summ.get("phase_s") or {}
+            if phases:
+                total = sum(phases.values()) or 1.0
+                parts = "  ".join(f"{p}={1e3 * v:.1f}ms"
+                                  f"({100 * v / total:.0f}%)"
+                                  for p, v in phases.items())
+                print(f"  phases [{summ.get('window_ticks', 0)} ticks, "
+                      f"sum/wall {summ.get('phase_sum_ratio', 0):.2f}]: "
+                      f"{parts}")
+            print(f"  tick-gap p50 {1e3 * summ.get('tick_gap_p50_s', 0):.2f}"
+                  f"ms  p99 {1e3 * summ.get('tick_gap_p99_s', 0):.2f}ms  "
+                  f"max {1e3 * summ.get('tick_gap_max_s', 0):.2f}ms")
+            if summ.get("window_completed"):
+                print(f"  slo[{summ['window_completed']} reqs]: ttft "
+                      f"{summ.get('ttft_attainment', 0):.2f} "
+                      f"(p99 {1e3 * summ.get('ttft_p99_s', 0):.0f}ms vs "
+                      f"{1e3 * summ.get('ttft_slo_s', 0):.0f}ms)  tpot "
+                      f"{summ.get('tpot_attainment', 0):.2f} "
+                      f"(p99 {1e3 * summ.get('tpot_p99_s', 0):.1f}ms vs "
+                      f"{1e3 * summ.get('tpot_slo_s', 0):.1f}ms)")
+                print(f"  goodput {summ.get('goodput_tok_s', 0):.1f} tok/s"
+                      f" of {summ.get('window_tok_s', 0):.1f} tok/s "
+                      f"(capacity est {summ.get('capacity_tok_s', 0):.1f})"
+                      f"  decode-eff {summ.get('decode_efficiency', 0):.2f}"
+                      f"  occupancy {summ.get('occupancy', 0):.2f}")
+            print(f"  recorder overhead "
+                  f"{100 * summ.get('overhead_frac', 0):.3f}% of tick wall")
+        elif args.engine_cmd == "ticks":
+            print(f"engine {label} — last {args.limit} tick(s)")
+            for t in (s.get("ticks") or [])[-args.limit:]:
+                when = time.strftime("%H:%M:%S",
+                                     time.localtime(t.get("t", 0)))
+                phases = "  ".join(f"{p}={v:.1f}"
+                                   for p, v in (t.get("phases_ms")
+                                                or {}).items())
+                gap = (f"  gap={t['gap_ms']:.1f}ms"
+                       if "gap_ms" in t else "")
+                print(f"  {when} #{t.get('seq'):<6} "
+                      f"wall={t.get('wall_ms', 0):.1f}ms "
+                      f"active={t.get('active')}/{t.get('bucket')} "
+                      f"k={t.get('k')} tok={t.get('tokens')}{gap}  "
+                      f"[{phases}]")
+        else:  # requests
+            print(f"engine {label} — last {args.limit} request(s)")
+            for r in (s.get("requests") or [])[-args.limit:]:
+                rid_note = (f" rid={r['request_id'][:8]}"
+                            if r.get("request_id") else "")
+                print(f"  #{r.get('rid'):<5} {r.get('state'):<9} "
+                      f"queue={r.get('queue_wait_ms', 0):.1f}ms "
+                      f"prompt={r.get('prompt_tokens')} "
+                      f"(cached {r.get('cached_tokens')}) "
+                      f"tok={r.get('tokens')} "
+                      f"ticks={r.get('decode_ticks')} "
+                      f"ttft={r.get('ttft_ms', 0):.1f}ms "
+                      f"tpot={r.get('tpot_ms', 0):.2f}ms{rid_note}")
+    return 0
 
 
 def cmd_metrics(args: argparse.Namespace) -> int:
@@ -1134,8 +1256,31 @@ def main(argv=None) -> int:
     p_doc.add_argument("--imbalance-warn", type=float, default=0.5,
                        help="cross-node load CoV that, sustained over 3 "
                             "ticks, grades the cluster as imbalanced")
+    p_doc.add_argument("--tick-gap-warn", type=float, default=0.5,
+                       help="engine decode tick-gap (s) that, sustained "
+                            "over 3 launches, grades decode as starved")
+    p_doc.add_argument("--slo-warn", type=float, default=0.9,
+                       help="engine TTFT/TPOT SLO-attainment ratio below "
+                            "which a loaded engine is graded degraded")
     p_doc.add_argument("--json", action="store_true")
     p_doc.set_defaults(fn=cmd_doctor)
+
+    p_eng = sub.add_parser(
+        "engine",
+        help="ContinuousEngine flight recorder: tick phase attribution, "
+             "request lifecycles, SLO/goodput rollup (@engine/ KV "
+             "snapshots, util/engine_recorder.py)")
+    eng_sub = p_eng.add_subparsers(dest="engine_cmd", required=True)
+    for name, what in (("stats", "per-engine SLO/goodput/phase rollup"),
+                       ("ticks", "tail the per-tick phase records"),
+                       ("requests", "tail the request lifecycle records")):
+        pe = eng_sub.add_parser(name, help=what)
+        pe.add_argument("--address", default=None)
+        pe.add_argument("--name", default=None,
+                        help="only engines whose node:name contains this")
+        pe.add_argument("--limit", type=int, default=20)
+        pe.add_argument("--json", action="store_true")
+    p_eng.set_defaults(fn=cmd_engine)
 
     p_trace = sub.add_parser(
         "trace",

@@ -130,6 +130,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--new-tokens", type=int, default=16)
     parser.add_argument("--out", default=None,
                         help="write the Perfetto trace JSON here")
+    parser.add_argument("--json", default=None, metavar="FILE",
+                        help="write a machine-readable profile artifact "
+                             "(records + summary + MFU/steps-per-launch) "
+                             "here; '-' for stdout")
     parser.add_argument("--jax-trace", default=None, metavar="DIR",
                         help="also capture a jax.profiler device trace "
                              "into DIR (best-effort; the real per-kernel "
@@ -216,6 +220,30 @@ def main(argv: Optional[List[str]] = None) -> int:
                       f"{summ['per_step_wall_s'] * 1e3:.2f} ms")
         print(f"drained {drained} step record(s) into the event store")
 
+        if args.json:
+            import json
+            import time
+
+            payload = {
+                "schema": "rt-profile-v1",
+                "t": time.time(),
+                "config": {"preset": args.preset, "mode": args.mode,
+                           "steps": args.steps, "batch": args.batch,
+                           "seq": args.seq, "new_tokens": args.new_tokens,
+                           "steps_per_launch": args.steps_per_launch},
+                "platform": {"backend": probe["backend"],
+                             "devices": probe["devices"]},
+                "records": [r.to_dict() for r in records],
+                "summary": summ or {},
+            }
+            if args.json == "-":
+                print(json.dumps(payload, indent=2, sort_keys=True))
+            else:
+                with open(args.json, "w") as f:
+                    json.dump(payload, f, indent=2, sort_keys=True)
+                    f.write("\n")
+                print(f"wrote {args.json}: {len(records)} record(s) + "
+                      f"summary")
         if args.out:
             trace = ray_tpu.timeline(args.out)
             cats = sorted({t.get("cat") for t in trace})

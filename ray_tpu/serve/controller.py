@@ -658,6 +658,12 @@ class ServeController:
         kv = {"hits": 0, "misses": 0, "evictions": 0, "bytes": 0,
               "pages": 0, "hit_tokens": 0}
         kv_seen = False
+        # engine flight-recorder rollup (attainment/goodput averaged,
+        # gap p99 worst-of-fleet): the replica's engine_stats() carries
+        # its recorder summary, and `rt serve status` shows the fleet
+        # SLO picture without a second RPC
+        eng_roll = {"ttft_att": 0.0, "tpot_att": 0.0, "goodput": 0.0,
+                    "gap_p99": 0.0, "n": 0}
         if reps:
             refs = [r.handle.stats_window.remote(window_s) for r in reps]
             ready, _ = ray_tpu.wait(refs, num_returns=len(refs), timeout=2.0)
@@ -690,6 +696,18 @@ class ServeController:
                                 kv_seen = True
                                 for k in kv:
                                     kv[k] += ekv.get(k, 0)
+                            rec = eng.get("recorder")
+                            if rec and rec.get("window_completed"):
+                                eng_roll["n"] += 1
+                                eng_roll["ttft_att"] += rec.get(
+                                    "ttft_attainment", 0.0)
+                                eng_roll["tpot_att"] += rec.get(
+                                    "tpot_attainment", 0.0)
+                                eng_roll["goodput"] += rec.get(
+                                    "goodput_tok_s", 0.0)
+                                eng_roll["gap_p99"] = max(
+                                    eng_roll["gap_p99"],
+                                    rec.get("tick_gap_p99_s", 0.0))
                     except Exception:  # noqa: BLE001 — health check handles it
                         pass
         lats.sort()
@@ -707,6 +725,12 @@ class ServeController:
             # instead of inferring load from instantaneous occupancy
             win["cb_tokens_generated"] = cb["tokens_generated"]
             win["cb_requests_completed"] = cb["requests_completed"]
+        if eng_roll["n"]:
+            n = eng_roll["n"]
+            win["eng_ttft_att"] = round(eng_roll["ttft_att"] / n, 4)
+            win["eng_tpot_att"] = round(eng_roll["tpot_att"] / n, 4)
+            win["eng_goodput_tok_s"] = round(eng_roll["goodput"], 1)
+            win["eng_gap_p99_s"] = round(eng_roll["gap_p99"], 6)
         if kv_seen:
             win["kv_hits"] = kv["hits"]
             win["kv_misses"] = kv["misses"]

@@ -201,7 +201,11 @@ class ContinuousLLM:
         handle = self.engine.submit_cb(
             prompt, n_new,
             lambda burst: loop.call_soon_threadsafe(deliver, burst),
-            temperature=temperature, top_k=top_k, seed=sample_seed)
+            temperature=temperature, top_k=top_k, seed=sample_seed,
+            # the flight recorder parents the engine lifecycle span on
+            # the serve request span — rt trace <rid> descends into
+            # queue_wait/kv_restore/prefill/decode
+            obs_ctx=req_ctx)
         engine = self.engine
         name = self._name
 

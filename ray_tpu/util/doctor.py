@@ -10,7 +10,10 @@ direct GCS/raylet RPCs, so it works against a wedged cluster too):
     (``cluster/gcs.py`` ``failure_events`` store, `rt errors`' feed),
   - the memory plane (PR 4): OOM post-mortems, spill pressure and leak
     suspects (raylet ``memory_report`` + the ``@memobj/`` KV ledgers),
-  - scheduler pressure: per-node raylet queue depth.
+  - scheduler pressure: per-node raylet queue depth,
+  - the engine plane: flight-recorder snapshots (``@engine/`` KV,
+    ``util/engine_recorder.py``) — sustained decode tick-gap and
+    TTFT/TPOT SLO-attainment findings at nonzero load.
 
 Exit codes: 0 healthy, 1 unhealthy (any critical finding), 2 cluster
 unreachable. ``collect()`` returns the raw report; ``diagnose()`` turns it
@@ -112,6 +115,28 @@ async def _collect_async(gcs_address: str, window_s: float,
         except Exception:  # noqa: BLE001 — ledger plane optional
             pass
 
+        # engine plane: each ContinuousEngine's flight recorder pushes a
+        # compact @engine/ snapshot from its drain thread
+        # (util/engine_recorder.py) and deletes it at shutdown — stale
+        # ones (a crashed pusher) are skipped at diagnose time
+        engines: List[Dict] = []
+        try:
+            keys = (await gcs.call("kv_keys", {"prefix": "@engine/"},
+                                   timeout=10.0))["keys"]
+            replies = await asyncio.gather(
+                *(gcs.call("kv_get", {"key": k}, timeout=10.0)
+                  for k in keys[:50]))
+            for reply in replies:
+                raw = reply.get("value")
+                if not raw:
+                    continue
+                try:
+                    engines.append(json.loads(raw))
+                except ValueError:
+                    continue
+        except Exception:  # noqa: BLE001 — engine plane optional
+            pass
+
         # serve plane: the controller pushes a compact status snapshot to
         # the KV every reconcile tick (serve/controller.py) — readable
         # here without attaching a driver
@@ -128,7 +153,7 @@ async def _collect_async(gcs_address: str, window_s: float,
                 "window_s": window_s, "nodes": probed, "actors": actors,
                 "failures": failures, "oom_kills": ooms,
                 "ledgers": ledgers, "serve": serve_status,
-                "sched_balance": sched_balance}
+                "engines": engines, "sched_balance": sched_balance}
     finally:
         try:
             await gcs.close()
@@ -154,7 +179,9 @@ def diagnose(report: Dict[str, Any],
              queue_warn: int = 100,
              queue_wait_warn_s: float = 10.0,
              serve_p99_warn_s: float = 5.0,
-             imbalance_warn: float = 0.5) -> List[Tuple[str, str]]:
+             imbalance_warn: float = 0.5,
+             tick_gap_warn_s: float = 0.5,
+             slo_warn: float = 0.9) -> List[Tuple[str, str]]:
     """Turn the raw report into ranked ``(level, message)`` findings.
     Any CRITICAL finding makes the cluster unhealthy (exit 1)."""
     findings: List[Tuple[str, str]] = []
@@ -323,6 +350,40 @@ def diagnose(report: Dict[str, Any],
                                  f"at {d.get('qps')} qps — sustained "
                                  f"latency degradation)"))
 
+    # -- engine flight recorder (@engine/ snapshots) -------------------------
+    # SUSTAINED starvation only: one wide decode tick-gap is a normal
+    # admission prefill; the last three gaps all above the threshold means
+    # decode is being starved tick after tick. SLO findings need nonzero
+    # load (completed requests in the rolling window) — an idle engine
+    # attains nothing and that's fine. Stale snapshots (dead pusher)
+    # are skipped, like the serve findings.
+    for snap in report.get("engines") or ():
+        if now - snap.get("t", 0.0) > 30.0:
+            continue
+        s = snap.get("summary") or {}
+        label = (f"{str(snap.get('node', '?'))[:12]}:"
+                 f"{snap.get('name', 'engine')}")
+        gaps = (s.get("gap_recent") or [])[-3:]
+        if len(gaps) >= 3 and all(g > tick_gap_warn_s for g in gaps):
+            findings.append((WARN,
+                             f"engine {label} decode tick-gap sustained at "
+                             f"{max(gaps):.3f}s (> {tick_gap_warn_s:.3f}s "
+                             f"over {len(gaps)} launches — prefill or swap "
+                             f"work is starving decode; see `rt engine "
+                             f"ticks`)"))
+        if (s.get("window_completed") or 0) > 0:
+            for slo in ("ttft", "tpot"):
+                att = s.get(f"{slo}_attainment")
+                if att is not None and att < slo_warn:
+                    target = s.get(f"{slo}_slo_s", 0.0)
+                    findings.append((WARN,
+                                     f"engine {label} {slo.upper()} SLO "
+                                     f"attainment {att:.2f} (< "
+                                     f"{slo_warn:.2f} against a "
+                                     f"{target * 1e3:.0f}ms target over "
+                                     f"{s['window_completed']} completed "
+                                     f"request(s); see `rt engine stats`)"))
+
     # -- leak suspects (memory plane) ----------------------------------------
     try:
         from ray_tpu.util.memory import (_merge_owner_info,
@@ -382,7 +443,8 @@ def format_report(report: Dict[str, Any],
 
 def run(gcs_address: str, window_s: float = 600.0, queue_warn: int = 100,
         queue_wait_warn_s: float = 10.0, serve_p99_warn_s: float = 5.0,
-        imbalance_warn: float = 0.5, as_json: bool = False
+        imbalance_warn: float = 0.5, tick_gap_warn_s: float = 0.5,
+        slo_warn: float = 0.9, as_json: bool = False
         ) -> Tuple[str, int]:
     """Collect + diagnose + render; returns (text, exit_code). Exit 2 when
     the GCS itself is unreachable."""
@@ -394,7 +456,9 @@ def run(gcs_address: str, window_s: float = 600.0, queue_warn: int = 100,
     findings = diagnose(report, queue_warn=queue_warn,
                         queue_wait_warn_s=queue_wait_warn_s,
                         serve_p99_warn_s=serve_p99_warn_s,
-                        imbalance_warn=imbalance_warn)
+                        imbalance_warn=imbalance_warn,
+                        tick_gap_warn_s=tick_gap_warn_s,
+                        slo_warn=slo_warn)
     if as_json:
         rc = exit_code(findings)
         payload = dict(report,

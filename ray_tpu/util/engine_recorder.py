@@ -1,0 +1,696 @@
+"""Engine flight recorder: per-tick phase attribution, request lifecycle
+records, and SLO/goodput accounting for ``ContinuousEngine``.
+
+Every observability layer so far (step profiler, task phase tracing,
+memory/failure planes, serve request spans, placement receipts) stops at
+the engine boundary — the continuous-batching tick loop is a black-box
+background thread. This module is the missing lens: the engine thread
+stamps bounded, lock-light records on every tick and every request, and a
+separate drain thread ships the derived telemetry everywhere the other
+planes already live.
+
+What one TICK record holds — a partition of the tick's wall into the
+phases the loop actually runs (``models/serving.py`` stamps them):
+
+  admission       slot bookkeeping around admitting pending requests
+                  (queue pop, cancel checks, emit of the first token)
+  kv_restore      prefix-cache lookup + retained-page upload for warm
+                  admissions (the TTFT-collapse path)
+  prefill         the compiled prefill call for the uncached suffix
+  decode_step     the fused ``step_many(k)`` launch across active slots
+  token_delivery  handing each tick's token bursts to their consumers
+  swap_barrier    applying a drain-barrier weight swap, when one landed
+
+plus active-slot count, the bucket the decode launch compiled for
+(lone-row vs full-engine), the k-step fusion stride, and the decode
+TICK-GAP: the wall between consecutive decode launches while slots were
+active — the single number that spikes when a long-prompt prefill (or
+anything else) starves decode, and the diagnostic baseline the
+prefill/decode disaggregation arc is judged against.
+
+What one REQUEST record holds: queue-wait, cached-vs-computed prefill
+tokens (from the batcher's ``last_admission``), decode ticks, TTFT, TPOT,
+and the terminal state (done / cancelled). Requests submitted under an
+ambient serve request context JOIN the request span tree: the drain emits
+an ``engine:<name>`` span parented on the serve span, so ``rt trace
+<request-id>`` descends from proxy→replica into engine phases.
+
+Derived SLO/goodput accounting (``summary()``): rolling TTFT/TPOT
+SLO-attainment ratios against configurable targets
+(``RT_ENGINE_TTFT_SLO_MS`` / ``RT_ENGINE_TPOT_SLO_MS``), goodput tok/s
+(tokens of SLO-attaining requests) vs the raw-capacity estimate
+(``bucket × k`` tokens per decode launch), and occupancy-weighted decode
+efficiency (tokens actually emitted / slot-tokens the launches paid for).
+
+Discipline (the PR 15 ``@memkv/`` lesson, measured: a blocking GCS push
+on the tick path froze admission AND decode, warm p99 181 ms → 2.6 s):
+the tick path ONLY appends to bounded in-process deques under a
+microsecond lock — metrics observation, span emission, the ``@engine/``
+KV snapshot and the timeline event push all happen on the drain thread.
+The recorder times itself: ``overhead_s`` accumulates the wall spent
+inside recorder calls on the engine thread, and ``summary()`` reports it
+as a fraction of recorded tick wall (the bench gate holds it ≤ 2%).
+
+Disable with ``RT_ENGINE_RECORDER=0`` — every hook then costs one
+predicate check per tick.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import OrderedDict, deque
+from typing import Any, Dict, List, Optional
+
+_ENABLED_DEFAULT = os.environ.get("RT_ENGINE_RECORDER", "1") \
+    not in ("", "0", "false")
+_CAP = int(os.environ.get("RT_ENGINE_RECORDER_CAP", "2048"))
+_SLO_WINDOW = int(os.environ.get("RT_ENGINE_SLO_WINDOW", "256"))
+_DRAIN_S = float(os.environ.get("RT_ENGINE_DRAIN_S", "2.0"))
+_KV_PREFIX = "@engine/"
+
+#: canonical tick-phase vocabulary, in tick-loop order (the timeline
+#: tick lane and ``rt engine ticks`` render phases in this order)
+TICK_PHASES = ("admission", "kv_restore", "prefill", "decode_step",
+               "token_delivery", "swap_barrier")
+
+_recorders: "OrderedDict[int, Any]" = OrderedDict()  # rt: guarded-by(_recorders_lock)
+_recorders_lock = threading.Lock()
+
+
+def live_recorders() -> List["EngineRecorder"]:
+    """Every recorder constructed in this process and not yet closed —
+    the local engine_stats path and tests read through this."""
+    with _recorders_lock:
+        return list(_recorders.values())
+
+
+def _pct(sorted_vals: List[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, int(q * (len(sorted_vals) - 1) + 0.5))
+    return sorted_vals[idx]
+
+
+class EngineRecorder:
+    """Bounded flight recorder for one ``ContinuousEngine``.
+
+    The ENGINE THREAD is the only writer of tick records and the only
+    caller of ``request_admitted`` / ``request_tokens``; ``request_done``
+    may additionally fire from client threads (cancel). All shared state
+    lives behind one lock held for O(1) appends — never across a device
+    call, an RPC, or a metrics observation.
+    """
+
+    def __init__(self, name: str = "engine", *, max_slots: int = 8,
+                 ttft_slo_s: Optional[float] = None,
+                 tpot_slo_s: Optional[float] = None,
+                 cap: int = _CAP, enabled: Optional[bool] = None):
+        self.name = name or "engine"
+        self.max_slots = max(1, int(max_slots))
+        self.enabled = _ENABLED_DEFAULT if enabled is None else bool(enabled)
+        self.ttft_slo_s = float(
+            os.environ.get("RT_ENGINE_TTFT_SLO_MS", "1500")) / 1e3 \
+            if ttft_slo_s is None else float(ttft_slo_s)
+        self.tpot_slo_s = float(
+            os.environ.get("RT_ENGINE_TPOT_SLO_MS", "150")) / 1e3 \
+            if tpot_slo_s is None else float(tpot_slo_s)
+        cap = max(64, int(cap))
+        self._lock = threading.Lock()
+        self._ticks: "deque[Dict[str, Any]]" = deque(maxlen=cap)  # rt: guarded-by(_lock)
+        self._active: "OrderedDict[int, Dict[str, Any]]" = \
+            OrderedDict()  # rt: guarded-by(_lock)
+        self._done: "deque[Dict[str, Any]]" = deque(maxlen=cap)  # rt: guarded-by(_lock)
+        self._window: "deque[Dict[str, Any]]" = \
+            deque(maxlen=_SLO_WINDOW)  # rt: guarded-by(_lock)
+        self._tick_seq = 0  # rt: guarded-by(_lock)
+        self._req_seq = 0  # rt: guarded-by(_lock)
+        self._overhead_s = 0.0  # rt: guarded-by(_lock)
+        self._wall_total_s = 0.0  # rt: guarded-by(_lock)
+        self._swaps = 0  # rt: guarded-by(_lock)
+        self._requests_total = 0  # rt: guarded-by(_lock)
+        self._cancelled_total = 0  # rt: guarded-by(_lock)
+        # drain-side watermarks (drain thread only; the lock still guards
+        # the snapshot reads that feed them)
+        self._metrics_tick_wm = 0
+        self._metrics_req_wm = 0
+        self._span_req_wm = 0
+        self._event_tick_wm = 0
+        self._event_req_wm = 0
+        self._closed = False  # rt: guarded-by(_lock)
+        self._drainer: Optional[threading.Thread] = None  # rt: guarded-by(_lock)
+        self._kv_key = f"{_KV_PREFIX}{os.uname().nodename}:{os.getpid()}:" \
+                       f"{self.name}"
+        with _recorders_lock:
+            _recorders[id(self)] = self
+            while len(_recorders) > 64:  # bound the registry itself
+                _recorders.popitem(last=False)
+
+    # -- tick path (engine thread) ---------------------------------------
+
+    def record_tick(self, *, t_start: float, wall_s: float,
+                    phases: Dict[str, float], active: int, pending: int,
+                    bucket: int, k: int, tokens: int, admitted: int,
+                    gap_s: Optional[float]) -> None:
+        """One engine tick: phase partition + the decode tick-gap. The
+        ONLY thing this does is append to a bounded deque — no metrics,
+        no I/O (drained off-thread)."""
+        if not self.enabled:
+            return
+        t0 = time.perf_counter()
+        rec = {"t": t_start, "wall_s": wall_s,
+               "phases": {p: phases.get(p, 0.0) for p in TICK_PHASES
+                          if phases.get(p, 0.0) > 0.0},
+               "active": active, "pending": pending, "bucket": bucket,
+               "k": k, "tokens": tokens, "admitted": admitted}
+        if gap_s is not None:
+            rec["gap_s"] = gap_s
+        with self._lock:
+            self._tick_seq += 1
+            rec["seq"] = self._tick_seq
+            self._ticks.append(rec)
+            self._wall_total_s += wall_s
+            self._overhead_s += time.perf_counter() - t0
+        self._ensure_drainer()
+
+    def request_admitted(self, rid: int, *, t_submit: float, t_admit: float,
+                         prompt_tokens: int, cached_tokens: int,
+                         prefill_s: float, kv_restore_s: float,
+                         slot: int = -1,
+                         obs_ctx: Optional[Dict[str, str]] = None) -> None:
+        """Lifecycle start: admission produced the first token, so this
+        stamp IS the TTFT stamp (queue_wait = admission - submit)."""
+        if not self.enabled:
+            return
+        t0 = time.perf_counter()
+        rec = {"rid": rid, "t_submit": t_submit, "t_admit": t_admit,
+               "t_first": t_admit, "queue_wait_s": max(0.0,
+                                                       t_admit - t_submit),
+               "prompt_tokens": int(prompt_tokens),
+               "cached_tokens": int(cached_tokens),
+               "computed_tokens": int(prompt_tokens) - int(cached_tokens),
+               "prefill_s": prefill_s, "kv_restore_s": kv_restore_s,
+               "slot": slot, "tokens": 1, "decode_ticks": 0,
+               "state": "active"}
+        if obs_ctx:
+            rec["request_id"] = obs_ctx.get("request_id")
+            rec["parent_span_id"] = obs_ctx.get("span_id")
+        with self._lock:
+            self._requests_total += 1
+            self._active[rid] = rec
+            while len(self._active) > self._done.maxlen:
+                self._active.popitem(last=False)  # runaway-leak backstop
+            self._overhead_s += time.perf_counter() - t0
+
+    def request_tokens(self, rid: int, n: int, t: float,
+                       done: bool = False) -> None:
+        """A decode tick delivered ``n`` tokens to request ``rid``."""
+        if not self.enabled:
+            return
+        t0 = time.perf_counter()
+        with self._lock:
+            rec = self._active.get(rid)
+            if rec is not None:
+                rec["tokens"] += n
+                rec["decode_ticks"] += 1
+                rec["t_last"] = t
+            self._overhead_s += time.perf_counter() - t0
+        if done:
+            self.request_done(rid, t=t, state="done")
+
+    def request_done(self, rid: int, *, t: float,
+                     state: str = "done") -> None:
+        """Finalize a lifecycle record: compute TTFT/TPOT, move it to the
+        done ring, and enter it into the rolling SLO window."""
+        if not self.enabled:
+            return
+        t0 = time.perf_counter()
+        with self._lock:
+            rec = self._active.pop(rid, None)
+            if rec is None:
+                self._overhead_s += time.perf_counter() - t0
+                return
+            rec["state"] = state
+            rec["t_done"] = t
+            rec["ttft_s"] = max(0.0, rec["t_first"] - rec["t_submit"])
+            n = rec["tokens"]
+            rec["tpot_s"] = (max(0.0, t - rec["t_first"]) / (n - 1)
+                             if n > 1 else 0.0)
+            self._req_seq += 1
+            rec["seq"] = self._req_seq
+            self._done.append(rec)
+            if state == "done":
+                self._window.append({"t": t, "ttft_s": rec["ttft_s"],
+                                     "tpot_s": rec["tpot_s"],
+                                     "tokens": n,
+                                     "decode_ticks": rec["decode_ticks"]})
+            else:
+                self._cancelled_total += 1
+            self._overhead_s += time.perf_counter() - t0
+
+    def record_swap(self, apply_s: float, drained_reqs: int = 0) -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            self._swaps += 1
+
+    def set_slo(self, *, ttft_slo_s: Optional[float] = None,
+                tpot_slo_s: Optional[float] = None) -> None:
+        """Retune the SLO targets; attainment is computed against the
+        CURRENT targets at summary time, so this applies retroactively
+        to the rolling window (bench calibration uses it)."""
+        if ttft_slo_s is not None:
+            self.ttft_slo_s = float(ttft_slo_s)
+        if tpot_slo_s is not None:
+            self.tpot_slo_s = float(tpot_slo_s)
+
+    # -- derived accounting ----------------------------------------------
+
+    def ticks(self, limit: int = 0) -> List[Dict[str, Any]]:
+        with self._lock:
+            out = list(self._ticks)
+        return out[-limit:] if limit else out
+
+    def requests(self, limit: int = 0) -> List[Dict[str, Any]]:
+        with self._lock:
+            out = list(self._done)
+        return out[-limit:] if limit else out
+
+    def summary(self) -> Dict[str, Any]:
+        """The rolling SLO/goodput picture: what ``engine_stats()``,
+        ``rt engine stats``, the doctor findings and the gauges read."""
+        with self._lock:
+            ticks = list(self._ticks)
+            window = list(self._window)
+            wall_total = self._wall_total_s
+            overhead = self._overhead_s
+            active = len(self._active)
+            base = {"requests_total": self._requests_total,
+                    "cancelled_total": self._cancelled_total,
+                    "swaps": self._swaps, "ticks_total": self._tick_seq}
+        out = self._aggregate(ticks, window)
+        out.update(base)
+        out["name"] = self.name
+        out["active"] = active
+        out["max_slots"] = self.max_slots
+        out["ttft_slo_s"] = self.ttft_slo_s
+        out["tpot_slo_s"] = self.tpot_slo_s
+        out["overhead_s"] = round(overhead, 6)
+        out["recorded_wall_s"] = round(wall_total, 6)
+        out["overhead_frac"] = round(overhead / wall_total, 6) \
+            if wall_total > 0 else 0.0
+        return out
+
+    def window_summary(self, t0: float, t1: float) -> Dict[str, Any]:
+        """Same aggregates restricted to records stamped in [t0, t1) —
+        the bench legs carve steady/burst/recovery windows with this."""
+        with self._lock:
+            ticks = [t for t in self._ticks if t0 <= t["t"] < t1]
+            window = [{"t": r["t_done"], "ttft_s": r["ttft_s"],
+                       "tpot_s": r["tpot_s"], "tokens": r["tokens"],
+                       "decode_ticks": r["decode_ticks"]}
+                      for r in self._done
+                      if r["state"] == "done" and t0 <= r["t_done"] < t1]
+        return self._aggregate(ticks, window)
+
+    def _aggregate(self, ticks: List[Dict[str, Any]],
+                   window: List[Dict[str, Any]]) -> Dict[str, Any]:
+        phase_totals = {p: 0.0 for p in TICK_PHASES}
+        wall = 0.0
+        gaps: List[float] = []
+        cap_tokens = 0
+        tokens_emitted = 0
+        occ_weighted = 0.0
+        decode_wall = 0.0
+        for t in ticks:
+            wall += t["wall_s"]
+            for p, v in t["phases"].items():
+                phase_totals[p] = phase_totals.get(p, 0.0) + v
+            if "gap_s" in t:
+                gaps.append(t["gap_s"])
+            d = t["phases"].get("decode_step", 0.0)
+            if d > 0.0:
+                # capacity this launch paid for: bucket rows × k fused
+                # steps would emit bucket*k tokens at full occupancy
+                cap_tokens += t["bucket"] * t["k"]
+                decode_wall += d
+                occ_weighted += d * (t["active"] / self.max_slots)
+        tokens_emitted = sum(t["tokens"] for t in ticks)
+        gaps.sort()
+        phase_sum = sum(phase_totals.values())
+        out: Dict[str, Any] = {
+            "window_ticks": len(ticks),
+            "tick_wall_s": round(wall, 6),
+            "phase_s": {p: round(v, 6) for p, v in phase_totals.items()
+                        if v > 0.0},
+            "phase_sum_ratio": round(phase_sum / wall, 4) if wall > 0
+            else 0.0,
+            "tick_gap_p50_s": round(_pct(gaps, 0.50), 6),
+            "tick_gap_p99_s": round(_pct(gaps, 0.99), 6),
+            "tick_gap_max_s": round(gaps[-1], 6) if gaps else 0.0,
+            # the doctor's "sustained" signal: the last few gaps, newest
+            # last (all above the warn threshold = sustained starvation)
+            "gap_recent": [round(t["gap_s"], 6) for t in ticks
+                           if "gap_s" in t][-8:],
+            "tokens": tokens_emitted,
+            "decode_wall_s": round(decode_wall, 6),
+            "decode_efficiency": round(tokens_emitted / cap_tokens, 4)
+            if cap_tokens else 0.0,
+            "occupancy": round(occ_weighted / decode_wall, 4)
+            if decode_wall > 0 else 0.0,
+            "capacity_tok_s": round(cap_tokens / decode_wall, 1)
+            if decode_wall > 0 else 0.0,
+        }
+        n = len(window)
+        out["window_completed"] = n
+        if n:
+            ttft_ok = sum(1 for w in window
+                          if w["ttft_s"] <= self.ttft_slo_s)
+            # single-token requests have no inter-token interval; they
+            # trivially attain TPOT
+            tpot_ok = sum(1 for w in window
+                          if w["tpot_s"] <= self.tpot_slo_s)
+            out["ttft_attainment"] = round(ttft_ok / n, 4)
+            out["tpot_attainment"] = round(tpot_ok / n, 4)
+            ttfts = sorted(w["ttft_s"] for w in window)
+            tpots = sorted(w["tpot_s"] for w in window)
+            out["ttft_p50_s"] = round(_pct(ttfts, 0.50), 6)
+            out["ttft_p99_s"] = round(_pct(ttfts, 0.99), 6)
+            out["tpot_p50_s"] = round(_pct(tpots, 0.50), 6)
+            out["tpot_p99_s"] = round(_pct(tpots, 0.99), 6)
+            span = max(w["t"] for w in window) - min(w["t"] for w in window)
+            good = sum(w["tokens"] for w in window
+                       if w["ttft_s"] <= self.ttft_slo_s
+                       and w["tpot_s"] <= self.tpot_slo_s)
+            total = sum(w["tokens"] for w in window)
+            if span > 0:
+                out["goodput_tok_s"] = round(good / span, 1)
+                out["window_tok_s"] = round(total / span, 1)
+            out["goodput_frac"] = round(good / total, 4) if total else 0.0
+        return out
+
+    def snapshot(self, ticks_limit: int = 64,
+                 requests_limit: int = 64) -> Dict[str, Any]:
+        """The ``@engine/`` KV payload: summary + record tails, compact
+        enough to push every couple of seconds."""
+        return {"t": time.time(), "name": self.name,
+                "node": os.uname().nodename, "pid": os.getpid(),
+                "summary": self.summary(),
+                "ticks": [self._compact_tick(t)
+                          for t in self.ticks(ticks_limit)],
+                "requests": [self._compact_req(r)
+                             for r in self.requests(requests_limit)]}
+
+    @staticmethod
+    def _compact_tick(t: Dict[str, Any]) -> Dict[str, Any]:
+        out = {"seq": t["seq"], "t": round(t["t"], 4),
+               "wall_ms": round(t["wall_s"] * 1e3, 3),
+               "phases_ms": {p: round(v * 1e3, 3)
+                             for p, v in t["phases"].items()},
+               "active": t["active"], "pending": t["pending"],
+               "bucket": t["bucket"], "k": t["k"], "tokens": t["tokens"],
+               "admitted": t["admitted"]}
+        if "gap_s" in t:
+            out["gap_ms"] = round(t["gap_s"] * 1e3, 3)
+        return out
+
+    @staticmethod
+    def _compact_req(r: Dict[str, Any]) -> Dict[str, Any]:
+        out = {"rid": r["rid"], "state": r["state"],
+               "queue_wait_ms": round(r["queue_wait_s"] * 1e3, 3),
+               "prompt_tokens": r["prompt_tokens"],
+               "cached_tokens": r["cached_tokens"],
+               "computed_tokens": r["computed_tokens"],
+               "tokens": r["tokens"], "decode_ticks": r["decode_ticks"],
+               "slot": r["slot"]}
+        if "ttft_s" in r:
+            out["ttft_ms"] = round(r["ttft_s"] * 1e3, 3)
+            out["tpot_ms"] = round(r["tpot_s"] * 1e3, 3)
+        if r.get("request_id"):
+            out["request_id"] = r["request_id"]
+        return out
+
+    # -- off-tick drain ----------------------------------------------------
+
+    def _ensure_drainer(self) -> None:
+        if self._drainer is not None and self._drainer.is_alive():
+            return
+        with self._lock:
+            if self._closed or (self._drainer is not None
+                                and self._drainer.is_alive()):
+                return
+            self._drainer = threading.Thread(
+                target=self._drain_loop, daemon=True,
+                name=f"rt-engine-rec:{self.name}")
+            self._drainer.start()
+
+    def _drain_loop(self) -> None:
+        while True:
+            time.sleep(_DRAIN_S)
+            with self._lock:
+                if self._closed:
+                    return
+            try:
+                self.drain_now()
+            except Exception:  # noqa: BLE001 — observability must never
+                pass           # take the engine down
+
+    def drain_now(self) -> Dict[str, int]:
+        """One drain pass (tests call this instead of waiting out the
+        interval): metrics observation, span emission for completed
+        requests carrying a serve context, the ``@engine/`` KV snapshot,
+        and tick/request events into the GCS task-event store."""
+        counts = {"metrics": self._drain_metrics(),
+                  "spans": self._drain_spans()}
+        counts.update(self._drain_gcs())
+        return counts
+
+    def _pending_since(self, wm_attr: str, ticks: bool) -> List[Dict]:
+        with self._lock:
+            src = self._ticks if ticks else self._done
+            wm = getattr(self, wm_attr)
+            return [r for r in src if r.get("seq", 0) > wm]
+
+    def _drain_metrics(self) -> int:
+        try:
+            from ray_tpu.util import metrics as M
+        except Exception:  # noqa: BLE001
+            return 0
+        h = _metric_handles(M)
+        tags = {"engine": self.name}
+        new_ticks = self._pending_since("_metrics_tick_wm", ticks=True)
+        for t in new_ticks:
+            for p, v in t["phases"].items():
+                h["phase"].observe(v, tags={"engine": self.name,
+                                            "phase": p})
+            if "gap_s" in t:
+                h["gap"].observe(t["gap_s"], tags=tags)
+            h["ticks"].inc(1.0, tags=tags)
+        new_reqs = self._pending_since("_metrics_req_wm", ticks=False)
+        for r in new_reqs:
+            h["requests"].inc(1.0, tags={"engine": self.name,
+                                         "state": r["state"]})
+            if "ttft_s" in r and r["state"] == "done":
+                h["ttft"].observe(r["ttft_s"], tags=tags)
+                if r["tokens"] > 1:
+                    h["tpot"].observe(r["tpot_s"], tags=tags)
+        if new_ticks:
+            self._metrics_tick_wm = new_ticks[-1]["seq"]
+        if new_reqs:
+            self._metrics_req_wm = new_reqs[-1]["seq"]
+        summ = self.summary()
+        if summ.get("window_completed"):
+            h["slo"].set(summ["ttft_attainment"],
+                         tags={"engine": self.name, "slo": "ttft"})
+            h["slo"].set(summ["tpot_attainment"],
+                         tags={"engine": self.name, "slo": "tpot"})
+            h["goodput"].set(summ.get("goodput_tok_s", 0.0), tags=tags)
+        if summ.get("window_ticks"):
+            h["eff"].set(summ["decode_efficiency"], tags=tags)
+            h["overhead"].set(summ["overhead_frac"], tags=tags)
+        return len(new_ticks) + len(new_reqs)
+
+    def _drain_spans(self) -> int:
+        """Completed requests with a serve context become children of
+        their serve span — ``rt trace <rid>`` descends into the engine."""
+        pending = self._pending_since("_span_req_wm", ticks=False)
+        if not pending:
+            return 0
+        # advance past everything seen (context-less requests included) so
+        # a cluster-less drain doesn't re-emit the same spans every pass
+        self._span_req_wm = pending[-1]["seq"]
+        new_reqs = [r for r in pending if r.get("request_id")]
+        if not new_reqs:
+            return 0
+        try:
+            from ray_tpu.serve import obs
+        except Exception:  # noqa: BLE001
+            return 0
+        n = 0
+        for r in new_reqs:
+            try:
+                span = obs.new_span_id()
+                phases = {"queue_wait": r["queue_wait_s"],
+                          "prefill": r["prefill_s"]}
+                if r["kv_restore_s"] > 0:
+                    phases["kv_restore"] = r["kv_restore_s"]
+                if "t_done" in r:
+                    phases["decode"] = max(0.0, r["t_done"] - r["t_first"])
+                obs.emit_span(
+                    f"serve:{r['request_id']}:engine:{span[:8]}",
+                    f"engine:{self.name}",
+                    request_id=r["request_id"], span_id=span,
+                    parent_span_id=r.get("parent_span_id"),
+                    t_start=r["t_submit"],
+                    t_end=r.get("t_done", r["t_first"]),
+                    phases=phases,
+                    state="FINISHED" if r["state"] == "done"
+                    else "CANCELLED")
+                n += 1
+            except Exception:  # noqa: BLE001 — span plane best-effort
+                pass
+        return n
+
+    def _drain_gcs(self) -> Dict[str, int]:
+        """KV snapshot + timeline events; both best-effort, both skipped
+        cleanly outside an initialized cluster runtime."""
+        out = {"kv": 0, "events": 0}
+        try:
+            import ray_tpu
+
+            if not ray_tpu.is_initialized():
+                return out
+            backend = ray_tpu.global_worker()._require_backend()
+        except Exception:  # noqa: BLE001
+            return out
+        try:
+            if hasattr(backend, "kv_put"):
+                backend.kv_put(self._kv_key,
+                               json.dumps(self.snapshot()).encode())
+                out["kv"] = 1
+        except Exception:  # noqa: BLE001
+            pass
+        if not hasattr(backend, "_gcs"):
+            return out
+        node = os.uname().nodename
+        pid = os.getpid()
+        events = []
+        new_ticks = self._pending_since("_event_tick_wm", ticks=True)
+        for t in new_ticks[-256:]:
+            events.append({
+                "task_id": f"engtick:{node}:{pid}:{self.name}:{t['seq']}",
+                "name": f"tick:{self.name}", "state": "FINISHED",
+                "node_id": node,
+                "times": {"RUNNING": t["t"],
+                          "FINISHED": t["t"] + t["wall_s"]},
+                "engine_tick": {**t, "engine": self.name}})
+        new_reqs = self._pending_since("_event_req_wm", ticks=False)
+        for r in new_reqs[-256:]:
+            events.append({
+                "task_id": f"engreq:{node}:{pid}:{self.name}:{r['seq']}",
+                "name": f"req:{r['rid']}", "state": "FINISHED",
+                "node_id": node,
+                "times": {"RUNNING": r["t_submit"],
+                          "FINISHED": r.get("t_done", r["t_first"])},
+                "engine_request": {**{k: v for k, v in r.items()
+                                      if not k.startswith("parent_")},
+                                   "engine": self.name}})
+        if not events:
+            return out
+        try:
+            backend.io.run(backend._gcs.call("task_events",
+                                             {"events": events}))
+            if new_ticks:
+                self._event_tick_wm = new_ticks[-1]["seq"]
+            if new_reqs:
+                self._event_req_wm = new_reqs[-1]["seq"]
+            out["events"] = len(events)
+        except Exception:  # noqa: BLE001
+            pass
+        return out
+
+    def close(self) -> None:
+        """Stop the drain thread and drop the KV snapshot (the doctor
+        must not grade a dead engine's numbers — same discipline as the
+        serve controller's shutdown)."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+        with _recorders_lock:
+            _recorders.pop(id(self), None)
+        try:
+            import ray_tpu
+
+            if ray_tpu.is_initialized():
+                backend = ray_tpu.global_worker()._require_backend()
+                if hasattr(backend, "kv_del"):
+                    backend.kv_del(self._kv_key)
+        except Exception:  # noqa: BLE001
+            pass
+
+
+_metric_cache: Optional[Dict[str, Any]] = None
+_GAP_BUCKETS = (0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0,
+                2.5, 5.0)
+_TTFT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+                 5.0, 10.0)
+_TPOT_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                 0.5, 1.0)
+
+
+def _metric_handles(M) -> Dict[str, Any]:
+    """Lazily registered ``rt_engine_*`` series (drain thread only)."""
+    global _metric_cache
+    if _metric_cache is None:
+        _metric_cache = {
+            "phase": M.get_or_create(
+                M.Histogram, "rt_engine_tick_phase_seconds",
+                "Per-tick engine phase wall (admission / kv_restore / "
+                "prefill / decode_step / token_delivery / swap_barrier)",
+                boundaries=_GAP_BUCKETS, tag_keys=("engine", "phase")),
+            "gap": M.get_or_create(
+                M.Histogram, "rt_engine_tick_gap_seconds",
+                "Wall between consecutive decode launches while slots "
+                "were active (spikes when prefill starves decode)",
+                boundaries=_GAP_BUCKETS, tag_keys=("engine",)),
+            "ticks": M.get_or_create(
+                M.Counter, "rt_engine_ticks_total",
+                "Engine ticks recorded by the flight recorder",
+                tag_keys=("engine",)),
+            "requests": M.get_or_create(
+                M.Counter, "rt_engine_requests_total",
+                "Engine request lifecycles completed, by terminal state",
+                tag_keys=("engine", "state")),
+            "ttft": M.get_or_create(
+                M.Histogram, "rt_engine_ttft_seconds",
+                "Engine-level time to first token (submit to admission's "
+                "first token, transport excluded)",
+                boundaries=_TTFT_BUCKETS, tag_keys=("engine",)),
+            "tpot": M.get_or_create(
+                M.Histogram, "rt_engine_tpot_seconds",
+                "Engine-level time per output token (mean inter-token "
+                "interval per completed request)",
+                boundaries=_TPOT_BUCKETS, tag_keys=("engine",)),
+            "slo": M.get_or_create(
+                M.Gauge, "rt_engine_slo_attainment",
+                "Rolling fraction of completed requests meeting the SLO "
+                "target, slo=ttft|tpot",
+                tag_keys=("engine", "slo")),
+            "goodput": M.get_or_create(
+                M.Gauge, "rt_engine_goodput_tokens_per_s",
+                "Rolling tok/s from requests that met BOTH SLO targets",
+                tag_keys=("engine",)),
+            "eff": M.get_or_create(
+                M.Gauge, "rt_engine_decode_efficiency",
+                "Tokens emitted / slot-tokens the decode launches paid "
+                "for (occupancy-weighted decode efficiency)",
+                tag_keys=("engine",)),
+            "overhead": M.get_or_create(
+                M.Gauge, "rt_engine_recorder_overhead_ratio",
+                "Recorder self-time as a fraction of recorded tick wall",
+                tag_keys=("engine",)),
+        }
+    return _metric_cache

@@ -17,6 +17,13 @@ Traced tasks additionally carry a per-phase breakdown (``util/tracing.py``
 track, laid out consecutively from the task's enqueue time — queue-wait,
 worker-acquire (spawn vs warm), arg-fetch, execute, result-store line up
 under the task's main lane.
+
+Engine flight-recorder records (``util/engine_recorder.py``) export as
+``engine:<name>:*`` lanes: the tick-phase lane (admission / kv_restore /
+prefill / decode_step / token_delivery / swap_barrier partition per
+tick, with decode tick-gap stalls as their own spans) and per-slot
+request lanes (queued + decode span per lifecycle) — a prefill burst
+starving decode is visible as a widening gap between decode launches.
 """
 
 from __future__ import annotations
@@ -38,6 +45,14 @@ def timeline(filename: Optional[str] = None) -> List[Dict[str, Any]]:
         prof = ev.get("profile")
         if prof:
             trace.extend(_step_lanes(ev, prof))
+            continue
+        etick = ev.get("engine_tick")
+        if etick:
+            trace.extend(_engine_tick_lanes(ev, etick))
+            continue
+        ereq = ev.get("engine_request")
+        if ereq:
+            trace.extend(_engine_request_lanes(ev, ereq))
             continue
         is_serve = str(ev.get("task_id", "")).startswith("serve:")
         times = ev.get("times", {})
@@ -191,6 +206,86 @@ def _placement_instants(backend) -> List[Dict[str, Any]]:
             "pid": ev.get("node_id") or "node", "tid": "placement",
             "args": {k: v for k, v in ev.items() if k != "t"},
         })
+    return out
+
+
+def _engine_tick_lanes(ev: Dict[str, Any], tick: Dict[str, Any]
+                       ) -> List[Dict[str, Any]]:
+    """One engine tick (util/engine_recorder.py) -> the tick-phase lane:
+    the full tick span on ``engine:<name>:ticks`` with its phase
+    partition laid out consecutively underneath on ``...:phases``, plus
+    a ``gap`` span BEFORE the tick when the decode tick-gap was nonzero —
+    a prefill-burst starvation stall is visible as a widening gap span
+    between decode launches."""
+    pid = ev.get("node_id") or "node"
+    name = tick.get("engine", "engine")
+    ts = tick["t"] * 1e6
+    out = [{
+        "name": f"tick k={tick.get('k', 0)}",
+        "cat": "engine", "ph": "X", "ts": ts,
+        "dur": max(0.0, tick.get("wall_s", 0.0)) * 1e6,
+        "pid": pid, "tid": f"engine:{name}:ticks",
+        "args": {"active": tick.get("active"),
+                 "pending": tick.get("pending"),
+                 "bucket": tick.get("bucket"), "k": tick.get("k"),
+                 "tokens": tick.get("tokens"),
+                 "admitted": tick.get("admitted"),
+                 "gap_s": tick.get("gap_s")},
+    }]
+    gap = tick.get("gap_s") or 0.0
+    if gap > 0:
+        out.append({"name": "gap", "cat": "engine", "ph": "X",
+                    "ts": ts - gap * 1e6, "dur": gap * 1e6,
+                    "pid": pid, "tid": f"engine:{name}:gap"})
+    from ray_tpu.util.tracing import sorted_phases
+
+    t = ts
+    for pname, secs in sorted_phases(tick.get("phases") or {}):
+        dur = max(0.0, secs) * 1e6
+        out.append({"name": pname, "cat": "engine_phase", "ph": "X",
+                    "ts": t, "dur": dur, "pid": pid,
+                    "tid": f"engine:{name}:phases",
+                    "args": {"seconds": secs}})
+        t += dur
+    return out
+
+
+def _engine_request_lanes(ev: Dict[str, Any], req: Dict[str, Any]
+                          ) -> List[Dict[str, Any]]:
+    """One engine request lifecycle -> its slot's lane: a ``queued``
+    span (submit -> admission) followed by the decode span on
+    ``engine:<name>:slot<N>`` — per-slot occupancy reads directly off
+    the lane, and a starved slot shows its queued span stretching."""
+    pid = ev.get("node_id") or "node"
+    name = req.get("engine", "engine")
+    slot = req.get("slot", -1)
+    tid = f"engine:{name}:slot{slot}" if slot >= 0 \
+        else f"engine:{name}:requests"
+    t_submit = req.get("t_submit")
+    t_admit = req.get("t_admit")
+    t_done = req.get("t_done") or req.get("t_first") or t_admit
+    if t_admit is None:
+        return []
+    out = []
+    if t_submit is not None and t_admit > t_submit:
+        out.append({"name": f"req {req.get('rid')}:queued",
+                    "cat": "engine", "ph": "X", "ts": t_submit * 1e6,
+                    "dur": (t_admit - t_submit) * 1e6,
+                    "pid": pid, "tid": tid})
+    out.append({
+        "name": f"req {req.get('rid')} [{req.get('state', '?')}]",
+        "cat": "engine", "ph": "X", "ts": t_admit * 1e6,
+        "dur": max(0.0, (t_done - t_admit)) * 1e6,
+        "pid": pid, "tid": tid,
+        "args": {"rid": req.get("rid"), "state": req.get("state"),
+                 "prompt_tokens": req.get("prompt_tokens"),
+                 "cached_tokens": req.get("cached_tokens"),
+                 "tokens": req.get("tokens"),
+                 "decode_ticks": req.get("decode_ticks"),
+                 "ttft_s": req.get("ttft_s"),
+                 "tpot_s": req.get("tpot_s"),
+                 "request_id": req.get("request_id")},
+    })
     return out
 
 

@@ -178,11 +178,23 @@ PHASE_ORDER = ("submit", "queue_wait", "spillback", "worker_acquire",
 SERVE_PHASE_ORDER = ("proxy_route", "handle", "route", "call",
                      "call_stream", "respond", "stream")
 
+# Engine flight-recorder spans (util/engine_recorder.py) — the request
+# lifecycle inside ContinuousEngine, in causal order (queue-wait until a
+# slot frees, KV restore of the cached prefix, prefill of the suffix,
+# then the decode ticks until the last token). Tick records additionally
+# use decode_step/token_delivery/swap_barrier.
+ENGINE_PHASE_ORDER = ("queue_wait", "kv_restore", "prefill",
+                      "decode_step", "decode", "token_delivery",
+                      "swap_barrier")
+
 
 def sorted_phases(phases: Dict[str, float]) -> List[Any]:
     """(name, seconds) pairs in canonical phase order."""
-    rank = {p: i for i, p in enumerate(PHASE_ORDER + SERVE_PHASE_ORDER)}
-    n = len(PHASE_ORDER) + len(SERVE_PHASE_ORDER)
+    _all = PHASE_ORDER + SERVE_PHASE_ORDER + tuple(
+        p for p in ENGINE_PHASE_ORDER
+        if p not in PHASE_ORDER + SERVE_PHASE_ORDER)
+    rank = {p: i for i, p in enumerate(_all)}
+    n = len(_all)
     return sorted(phases.items(), key=lambda kv: (rank.get(kv[0], n), kv[0]))
 
 

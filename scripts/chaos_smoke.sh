@@ -651,4 +651,127 @@ d = json.load(sys.stdin)
 assert d["exit_code"] == 0 and d["healthy"], d["findings"]
 print("doctor healthy after placement leg")
 '
+echo "== engine leg: prefill burst dips SLO attainment on the flight recorder, then recovers =="
+# The fault here is workload-shaped, not injected: a dense long-prompt
+# burst on a colocated 2-slot engine starves the decode launches. The
+# flight recorder must show it (tick-gap spike + TPOT attainment dip in
+# rt engine stats) and show the recovery, with the doctor back to exit 0
+# once the burst drains.
+python - <<'EOF'
+import threading
+import time
+
+import numpy as np
+import jax
+
+import ray_tpu
+from ray_tpu.models import llama, serving
+
+ray_tpu.init(address="auto")
+cfg = llama.PRESETS["debug"]
+params = llama.init_params(jax.random.key(0), cfg)
+eng = serving.ContinuousEngine(params, cfg, max_slots=2, max_len=96,
+                               decode_stride=4, warmup=True,
+                               kv_cache_bytes=0, kv_label="chaos-engine")
+rec = eng._recorder
+assert rec.enabled, "flight recorder disabled (RT_ENGINE_RECORDER=0?)"
+
+short = (np.arange(16) % cfg.vocab_size).astype(np.int32)
+long_p = (np.arange(80) % cfg.vocab_size).astype(np.int32)
+
+
+def run(prompt, n):
+    q = eng.submit_stream(prompt, n)
+
+    def drain():
+        while q.get() is not None:
+            pass
+
+    t = threading.Thread(target=drain, daemon=True)
+    t.start()
+    return t
+
+
+# warm both prompt-length shapes so XLA compiles stay out of the windows
+for warm in (short, long_p):
+    run(warm, 4).join(60)
+time.sleep(0.2)
+
+# steady leg: short decode traffic only
+t0 = time.time()
+threads = []
+for i in range(10):
+    threads.append(run(short, 16))
+    time.sleep(0.06)
+for t in threads:
+    t.join(60)
+t1 = time.time()
+steady = rec.window_summary(t0, t1)
+assert steady["window_completed"] >= 8, steady
+rec.set_slo(ttft_slo_s=max(steady["ttft_p99_s"] * 1.5, 0.020),
+            tpot_slo_s=max(steady["tpot_p99_s"] * 1.25, 0.0005))
+steady = rec.window_summary(t0, t1)
+assert steady["tpot_attainment"] == 1.0, steady
+
+# burst leg: the whole long-prompt queue lands at once on live short
+# decodes — staggering would let this (tiny) engine drain each long
+# before the next arrives and never show the stall
+threads = [run(short, 16) for _ in range(4)]
+threads += [run(long_p, 4) for _ in range(18)]
+threads += [run(short, 16) for _ in range(4)]
+for t in threads:
+    t.join(60)
+time.sleep(0.2)
+t2 = time.time()
+burst = rec.window_summary(t1, t2)
+spike = burst["tick_gap_p99_s"] / max(steady["tick_gap_p99_s"], 1e-6)
+assert spike > 3.0, (steady, burst)
+assert burst["tpot_attainment"] < 0.9, burst
+
+# recovery leg: steady traffic again — attainment must come back
+t2b = time.time()
+threads = []
+for i in range(10):
+    threads.append(run(short, 16))
+    time.sleep(0.06)
+for t in threads:
+    t.join(60)
+t3 = time.time()
+recovery = rec.window_summary(t2b, t3)
+assert recovery["tpot_attainment"] >= 0.9, recovery
+assert recovery["tpot_attainment"] > burst["tpot_attainment"], (
+    burst, recovery)
+
+counts = rec.drain_now()
+assert counts["kv"] >= 1, counts  # snapshot visible to rt engine / doctor
+print(f"engine leg: gap spike {spike:.1f}x, TPOT attainment "
+      f"{steady['tpot_attainment']} -> {burst['tpot_attainment']} -> "
+      f"{recovery['tpot_attainment']}")
+# deliberately NO eng.shutdown(): close() drops the @engine/ KV snapshot,
+# and the next check reads it postmortem through the GCS — the whole
+# point of the no-driver-attach path
+ray_tpu.shutdown()
+EOF
+
+echo "== burst visible + recovered on rt engine stats =="
+$RT engine stats --json | python -c '
+import json, sys
+snaps = json.load(sys.stdin)
+eng = [s for s in snaps if s.get("name") == "chaos-engine"]
+assert eng, [s.get("name") for s in snaps]
+s = eng[0]["summary"]
+assert s["ticks_total"] > 0 and s["requests_total"] > 0, s
+assert s.get("window_completed", 0) > 0 and "tpot_attainment" in s, s
+print("rt engine stats sees the chaos-engine snapshot")
+'
+
+echo "== doctor must exit 0 after the engine leg drains =="
+sleep 3
+$RT doctor --window 2 --json | python -c '
+import json, sys
+d = json.load(sys.stdin)
+assert d["exit_code"] == 0 and d["healthy"], d["findings"]
+print("doctor healthy after engine leg")
+'
+
 echo "chaos smoke OK"

@@ -1,0 +1,184 @@
+#!/usr/bin/env python3
+"""Bench-trajectory gate: the committed ``BENCH_*``/``TRAIN_*``/
+``ENGINE_*`` artifacts must keep their key series present and (under
+``--strict``) non-regressing.
+
+Every perf PR commits a measured JSON artifact at the repo root; this
+script is the cheap cross-round sanity pass over that history:
+
+  * For each artifact *family* (``ENGINE_r*.json``, ``TRAIN_r*.json``, ...)
+    the registry below names the key numeric series (dotted JSON paths)
+    and the direction that counts as "better".
+  * Every registered series must appear in at least one round of its
+    family and every artifact must parse as JSON — a series no round
+    carries, or a malformed file, is an error (exit 1). Rounds may
+    legitimately skip a series (focused re-runs measure one scenario),
+    so resolution uses the newest round that carries it.
+  * That value is compared against the most recent earlier round that
+    also has the series; a move of more than ``--tolerance`` (default
+    10%) in the wrong direction is flagged. By default that is a WARN —
+    the committed history spans different CPU boxes, so noise is expected
+    and the tier-1 wire (``tests/test_zz_bench_trajectory.py``) must not
+    fail on it. ``--strict`` turns regressions into exit-code failures
+    for use on same-hardware trajectories.
+
+Run: ``python scripts/check_bench.py [--repo DIR] [--strict]``
+(exit 0 = every registered series present; regressions are warnings
+unless ``--strict``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# family glob -> [(dotted path, direction)] with direction one of
+# "higher" (bigger is better) / "lower" (smaller is better).
+KEY_SERIES: Dict[str, List[Tuple[str, str]]] = {
+    "ENGINE_r*.json": [
+        ("summary.steady.goodput_tok_s", "higher"),
+        ("summary.steady.tpot_attainment", "higher"),
+        ("summary.recovery.tpot_attainment", "higher"),
+        ("summary.overhead_frac", "lower"),
+    ],
+    "TRAIN_r*.json": [
+        ("offload.async.sustained_tok_s_chip", "higher"),
+        ("offload.speedup", "higher"),
+    ],
+    "RLHF_r*.json": [
+        ("measured.anakin.fused_env_steps_per_s", "higher"),
+        ("measured.rlhf.generate_tok_s", "higher"),
+    ],
+    "BENCH_KV_r*.json": [
+        ("engine_ttft.ttft_collapse_x", "higher"),
+        ("engine_ttft.warm.ttft_p50_ms", "lower"),
+    ],
+    "BENCH_STREAM_r*.json": [
+        ("pull.tok_s", "higher"),
+        ("push.rpcs_per_request_mean", "lower"),
+    ],
+    "SCALE_r*.json": [
+        ("scenarios.tasks_per_sec.tasks_per_sec", "higher"),
+    ],
+}
+
+_ROUND_RE = re.compile(r"_r(\d+)\.json$")
+
+
+def _round_of(path: str) -> int:
+    m = _ROUND_RE.search(os.path.basename(path))
+    return int(m.group(1)) if m else -1
+
+
+def _lookup(doc: Any, dotted: str) -> Optional[float]:
+    """Resolve a dotted path to a numeric leaf; None when absent."""
+    cur = doc
+    for part in dotted.split("."):
+        if isinstance(cur, list):
+            try:
+                cur = cur[int(part)]
+            except (ValueError, IndexError):
+                return None
+        elif isinstance(cur, dict):
+            if part not in cur:
+                return None
+            cur = cur[part]
+        else:
+            return None
+    if isinstance(cur, bool) or not isinstance(cur, (int, float)):
+        return None
+    return float(cur)
+
+
+def check(repo: str, tolerance: float = 0.10):
+    """Returns (errors, regressions, notes) — lists of message strings."""
+    errors: List[str] = []
+    regressions: List[str] = []
+    notes: List[str] = []
+    for pattern, series in sorted(KEY_SERIES.items()):
+        paths = sorted(glob.glob(os.path.join(repo, pattern)),
+                       key=_round_of)
+        if not paths:
+            notes.append(f"{pattern}: no artifacts committed yet (skip)")
+            continue
+        docs: List[Tuple[str, Any]] = []
+        for p in paths:
+            name = os.path.basename(p)
+            try:
+                with open(p) as f:
+                    docs.append((name, json.load(f)))
+            except (OSError, ValueError) as e:
+                errors.append(f"{name}: malformed artifact ({e})")
+        if not docs:
+            continue
+        for dotted, direction in series:
+            # newest round that carries the series; rounds may skip it
+            # (focused re-runs), but SOME round must have it
+            carriers = [(name, _lookup(doc, dotted))
+                        for name, doc in docs]
+            carriers = [(n, v) for n, v in carriers if v is not None]
+            if not carriers:
+                errors.append(f"{pattern}: no round carries series "
+                              f"{dotted}")
+                continue
+            latest_name, cur = carriers[-1]
+            if latest_name != docs[-1][0]:
+                notes.append(f"{dotted}: resolved from {latest_name} "
+                             f"({docs[-1][0]} lacks it)")
+            prev = carriers[-2] if len(carriers) > 1 else None
+            if prev is None:
+                notes.append(f"{latest_name}: {dotted}={cur:g} "
+                             f"(first round with this series)")
+                continue
+            prev_name, prev_v = prev
+            if prev_v == 0:
+                notes.append(f"{latest_name}: {dotted}={cur:g} "
+                             f"(prior {prev_name} was 0; no ratio)")
+                continue
+            delta = (cur - prev_v) / abs(prev_v)
+            worse = -delta if direction == "higher" else delta
+            line = (f"{dotted}: {prev_name}={prev_v:g} -> "
+                    f"{latest_name}={cur:g} ({delta:+.1%})")
+            if worse > tolerance:
+                regressions.append(line + f" [worse by >{tolerance:.0%}]")
+            else:
+                notes.append(line)
+    return errors, regressions, notes
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        description="bench-artifact trajectory gate")
+    parser.add_argument("--repo", default=ROOT,
+                        help="directory holding the *_rNN.json artifacts")
+    parser.add_argument("--tolerance", type=float, default=0.10,
+                        help="fractional wrong-direction move that counts "
+                             "as a regression (default 0.10)")
+    parser.add_argument("--strict", action="store_true",
+                        help="regressions fail the exit code too (default: "
+                             "only missing/malformed series do)")
+    args = parser.parse_args(argv)
+
+    errors, regressions, notes = check(args.repo, args.tolerance)
+    for n in notes:
+        print(f"  ok   {n}")
+    for r in regressions:
+        print(f"  WARN {r}")
+    for e in errors:
+        print(f"  FAIL {e}", file=sys.stderr)
+    bad = bool(errors) or (args.strict and bool(regressions))
+    print(f"check_bench: {len(errors)} error(s), "
+          f"{len(regressions)} regression(s), {len(notes)} series ok"
+          + (" [strict]" if args.strict else ""))
+    return 1 if bad else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

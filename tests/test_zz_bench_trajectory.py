@@ -1,0 +1,103 @@
+"""Bench-trajectory gate as tier-1: the committed ``*_rNN.json`` perf
+artifacts must keep parsing and keep carrying their key series
+(``scripts/check_bench.py``). Regressions between rounds stay warnings
+here — the history spans different CPU boxes — but the regression
+*detector* itself is unit-tested against synthetic artifacts so a >10%
+wrong-direction move can't silently stop being flagged. Named
+``test_zz_*`` so it sorts late in the suite."""
+
+import importlib.util
+import json
+import os
+
+
+def _load_checker():
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(root, "scripts", "check_bench.py")
+    spec = importlib.util.spec_from_file_location("check_bench", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_committed_artifacts_keep_key_series():
+    cb = _load_checker()
+    errors, _regressions, notes = cb.check(cb.ROOT)
+    assert not errors, "bench-trajectory gate failed:\n" + "\n".join(
+        f"  - {e}" for e in errors)
+    # the registry must actually resolve something, else the gate is vacuous
+    assert notes, "check_bench resolved zero series from the repo artifacts"
+
+
+def test_default_exit_is_zero_on_repo(capsys):
+    cb = _load_checker()
+    assert cb.main([]) == 0
+    out = capsys.readouterr().out
+    assert "check_bench:" in out
+
+
+def _write(tmp_path, name, doc):
+    (tmp_path / name).write_text(json.dumps(doc))
+
+
+def test_regression_flagged_on_synthetic_rounds(tmp_path):
+    """A 50% goodput drop between ENGINE rounds must be flagged as a
+    regression (WARN by default, exit 1 under --strict)."""
+    cb = _load_checker()
+    base = {"summary": {"steady": {"goodput_tok_s": 100.0,
+                                   "tpot_attainment": 0.95},
+                        "recovery": {"tpot_attainment": 0.95},
+                        "overhead_frac": 0.001}}
+    worse = json.loads(json.dumps(base))
+    worse["summary"]["steady"]["goodput_tok_s"] = 50.0
+    _write(tmp_path, "ENGINE_r01.json", base)
+    _write(tmp_path, "ENGINE_r02.json", worse)
+    errors, regressions, _ = cb.check(str(tmp_path))
+    assert not errors
+    assert any("goodput_tok_s" in r for r in regressions), regressions
+    assert cb.main(["--repo", str(tmp_path)]) == 0
+    assert cb.main(["--repo", str(tmp_path), "--strict"]) == 1
+
+
+def test_lower_is_better_direction(tmp_path):
+    """overhead_frac growing >10% must flag; shrinking must not."""
+    cb = _load_checker()
+    mk = lambda ov: {"summary": {"steady": {"goodput_tok_s": 100.0,
+                                            "tpot_attainment": 0.95},
+                                 "recovery": {"tpot_attainment": 0.95},
+                                 "overhead_frac": ov}}
+    _write(tmp_path, "ENGINE_r01.json", mk(0.010))
+    _write(tmp_path, "ENGINE_r02.json", mk(0.020))
+    _, regressions, _ = cb.check(str(tmp_path))
+    assert any("overhead_frac" in r for r in regressions), regressions
+    _write(tmp_path, "ENGINE_r02.json", mk(0.005))
+    _, regressions, _ = cb.check(str(tmp_path))
+    assert not any("overhead_frac" in r for r in regressions), regressions
+
+
+def test_missing_series_and_malformed_are_errors(tmp_path):
+    cb = _load_checker()
+    _write(tmp_path, "ENGINE_r01.json", {"summary": {}})
+    errors, _, _ = cb.check(str(tmp_path))
+    assert any("no round carries" in e for e in errors), errors
+    (tmp_path / "ENGINE_r02.json").write_text("{not json")
+    errors, _, _ = cb.check(str(tmp_path))
+    assert any("malformed" in e for e in errors), errors
+    assert cb.main(["--repo", str(tmp_path)]) == 1
+
+
+def test_series_resolves_from_newest_carrier(tmp_path):
+    """A focused later round that skips a series must not fail the gate —
+    the series resolves from the newest round that carries it."""
+    cb = _load_checker()
+    full = {"summary": {"steady": {"goodput_tok_s": 100.0,
+                                   "tpot_attainment": 0.95},
+                        "recovery": {"tpot_attainment": 0.95},
+                        "overhead_frac": 0.001}}
+    _write(tmp_path, "ENGINE_r01.json", full)
+    _write(tmp_path, "ENGINE_r02.json",
+           {"summary": {"steady": {"goodput_tok_s": 101.0,
+                                   "tpot_attainment": 0.95}}})
+    errors, regressions, notes = cb.check(str(tmp_path))
+    assert not errors, errors
+    assert any("resolved from ENGINE_r01.json" in n for n in notes), notes

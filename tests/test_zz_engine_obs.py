@@ -1,0 +1,352 @@
+"""Engine flight recorder (``util/engine_recorder.py``): per-tick phase
+attribution, request lifecycle records joining the serve span tree,
+SLO/goodput math, the ``/api/engine`` + ``rt engine`` surfaces, and the
+bounded-memory property. Named ``test_zz_*`` so it sorts late."""
+
+import contextlib
+import io
+import json
+import time
+import urllib.request
+from argparse import Namespace
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from ray_tpu.models import llama, serving  # noqa: E402
+from ray_tpu.util import engine_recorder as ER  # noqa: E402
+
+
+# ---------------------------------------------------------------------------
+# one shared engine run: cold request, weight swap, warm (prefix-cached)
+# request — the record set the engine-level tests read
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def engine_run():
+    cfg = llama.PRESETS["debug"]
+    params = llama.init_params(jax.random.key(0), cfg)
+    eng = serving.ContinuousEngine(params, cfg, max_slots=2, max_len=96,
+                                   decode_stride=4, warmup=True,
+                                   kv_cache_bytes=64 << 20,
+                                   kv_label="obs-test")
+    prompt = (np.arange(24) % cfg.vocab_size).astype(np.int32)
+    q1 = eng.submit_stream(prompt, 8)
+    toks1 = list(iter(q1.get, None))
+    # same prompt again -> prefix-cache hit (the swap comes AFTER: a
+    # weight swap invalidates every cached page by design)
+    q2 = eng.submit_stream(prompt, 8, obs_ctx={"request_id": "req-obs-2",
+                                               "span_id": "parentspan01"})
+    toks2 = list(iter(q2.get, None))
+    la = dict(eng._batcher.last_admission)
+    eng.load_params(params)  # swap -> swap_barrier tick
+    time.sleep(0.3)  # the final record_tick lands just after the tokens
+    yield eng, la, toks1, toks2
+    eng.shutdown()
+
+
+def test_tick_phase_sum_within_tolerance(engine_run):
+    """The six phases partition each tick: their sum must account for the
+    tick wall to within 10% (unattributed time = reap + lock waits)."""
+    eng, _, toks1, toks2 = engine_run
+    assert len(toks1) == 8 and len(toks2) == 8
+    rec = eng._recorder
+    ticks = rec.ticks()
+    assert ticks, "engine produced no tick records"
+    for t in ticks:
+        phase_sum = sum(t["phases"].values())
+        assert phase_sum <= t["wall_s"] * 1.02, (t["phases"], t["wall_s"])
+    summ = rec.summary()
+    assert 0.90 <= summ["phase_sum_ratio"] <= 1.02, summ
+    # decode ticks carry the launch geometry the efficiency math needs
+    decoded = [t for t in ticks if t["phases"].get("decode_step")]
+    assert decoded and all(t["bucket"] >= 1 and t["k"] >= 1
+                           for t in decoded)
+    assert summ["recorded_wall_s"] > 0
+    assert summ["overhead_frac"] < 0.02  # the ISSUE's overhead budget
+
+
+def test_cached_prefill_attribution_matches_last_admission(engine_run):
+    """The warm request's lifecycle record must carry the SAME cached/
+    computed split the batcher attributed at admission."""
+    eng, la, _, _ = engine_run
+    assert la["cached_tokens"] > 0, "prefix cache never hit"
+    reqs = eng._recorder.requests()
+    warm = [r for r in reqs if r.get("request_id") == "req-obs-2"]
+    assert warm, [r.get("request_id") for r in reqs]
+    r = warm[-1]
+    assert r["cached_tokens"] == la["cached_tokens"]
+    assert r["prompt_tokens"] == la["prompt_tokens"]
+    assert r["computed_tokens"] == r["prompt_tokens"] - r["cached_tokens"]
+    assert r["kv_restore_s"] >= 0 and r["prefill_s"] > 0
+    # 8 delivered tokens total: the first lands at admission, the rest
+    # over decode ticks
+    assert r["state"] == "done" and r["tokens"] == 8
+    assert r["decode_ticks"] >= 1
+    assert r["ttft_s"] >= 0 and r["tpot_s"] >= 0
+
+
+def test_swap_barrier_phase_visible(engine_run):
+    """load_params between requests must surface as a swap_barrier phase
+    on some tick (and count in the summary)."""
+    eng, _, _, _ = engine_run
+    summ = eng._recorder.summary()
+    assert summ["swaps"] >= 1
+    assert summ["phase_s"].get("swap_barrier", 0.0) > 0.0, summ["phase_s"]
+
+
+def test_request_record_joins_serve_span_tree(engine_run):
+    """Draining a completed request that carries a serve obs_ctx emits a
+    child span under the serve request's span tree (same request_id,
+    parent_span_id = the serve span) — `rt trace <rid>` descends."""
+    from ray_tpu.serve import obs
+
+    eng, _, _, _ = engine_run
+    n = eng._recorder._drain_spans()
+    assert n >= 1
+    with obs._span_lock:
+        spans = [dict(e) for e in obs._span_buf]
+    mine = [e for e in spans
+            if e["trace"]["trace_id"] == "req-obs-2"]
+    assert mine, [e.get("task_id") for e in spans]
+    ev = mine[-1]
+    assert ev["task_id"].startswith("serve:req-obs-2:engine:")
+    assert ev["trace"]["parent_span_id"] == "parentspan01"
+    assert ev["name"] == "engine:obs-test"
+    ph = ev["phases"]
+    assert set(ph) >= {"queue_wait", "prefill", "decode"}
+    # watermarked: a second drain pass must not duplicate the span
+    assert eng._recorder._drain_spans() == 0
+
+
+# ---------------------------------------------------------------------------
+# SLO/goodput math (synthetic records — no engine, no jax dispatch)
+# ---------------------------------------------------------------------------
+
+def _synthetic_recorder():
+    rec = ER.EngineRecorder("slo-math", max_slots=4, enabled=True,
+                            ttft_slo_s=0.100, tpot_slo_s=0.010)
+    t0 = 1000.0
+    # req 1: TTFT 50ms ok, TPOT 5ms ok (11 tokens over 50ms decode)
+    rec.request_admitted(1, t_submit=t0, t_admit=t0 + 0.050,
+                         prompt_tokens=8, cached_tokens=0,
+                         prefill_s=0.04, kv_restore_s=0.0)
+    rec.request_tokens(1, 10, t0 + 0.100, done=True)
+    # req 2: TTFT 200ms violates; TPOT 5ms ok
+    rec.request_admitted(2, t_submit=t0, t_admit=t0 + 0.200,
+                         prompt_tokens=8, cached_tokens=0,
+                         prefill_s=0.19, kv_restore_s=0.0)
+    rec.request_tokens(2, 10, t0 + 0.250, done=True)
+    # req 3: TTFT 50ms ok; TPOT 50ms violates (11 tokens over 500ms)
+    rec.request_admitted(3, t_submit=t0, t_admit=t0 + 0.050,
+                         prompt_tokens=8, cached_tokens=0,
+                         prefill_s=0.04, kv_restore_s=0.0)
+    rec.request_tokens(3, 10, t0 + 0.550, done=True)
+    # req 4: cancelled — must NOT enter the SLO window
+    rec.request_admitted(4, t_submit=t0, t_admit=t0 + 0.010,
+                         prompt_tokens=8, cached_tokens=0,
+                         prefill_s=0.005, kv_restore_s=0.0)
+    rec.request_done(4, t=t0 + 0.020, state="cancelled")
+    return rec
+
+
+def test_slo_attainment_math():
+    rec = _synthetic_recorder()
+    try:
+        s = rec.summary()
+        assert s["window_completed"] == 3  # the cancel is excluded
+        assert s["requests_total"] == 4 and s["cancelled_total"] == 1
+        assert s["ttft_attainment"] == pytest.approx(2 / 3, abs=1e-4)
+        assert s["tpot_attainment"] == pytest.approx(2 / 3, abs=1e-4)
+        # goodput: only req 1 meets BOTH SLOs -> 11 tokens over the
+        # window span (first done t0+0.1 .. last done t0+0.55 = 0.45s)
+        assert s["goodput_tok_s"] == pytest.approx(11 / 0.45, abs=0.06)
+        assert s["window_tok_s"] == pytest.approx(33 / 0.45, abs=0.06)
+        assert s["goodput_frac"] == pytest.approx(11 / 33, abs=1e-4)
+        # retroactive retune: loosening both SLOs lifts attainment to 1.0
+        # over the SAME window (bench calibration depends on this)
+        rec.set_slo(ttft_slo_s=1.0, tpot_slo_s=1.0)
+        s2 = rec.summary()
+        assert s2["ttft_attainment"] == 1.0
+        assert s2["tpot_attainment"] == 1.0
+        assert s2["goodput_frac"] == 1.0
+    finally:
+        rec.close()
+
+
+def test_window_summary_carves_time_ranges():
+    rec = _synthetic_recorder()
+    try:
+        # ticks at t=1000 and t=2000; only the first lands in [999, 1500)
+        rec.record_tick(t_start=1000.0, wall_s=0.010,
+                        phases={"decode_step": 0.008,
+                                "token_delivery": 0.002},
+                        active=2, pending=0, bucket=4, k=4, tokens=8,
+                        admitted=0, gap_s=0.001)
+        rec.record_tick(t_start=2000.0, wall_s=0.010,
+                        phases={"decode_step": 0.008}, active=1,
+                        pending=0, bucket=4, k=4, tokens=4, admitted=0,
+                        gap_s=0.5)
+        w = rec.window_summary(999.0, 1500.0)
+        assert w["window_ticks"] == 1 and w["tokens"] == 8
+        assert w["tick_gap_max_s"] == pytest.approx(0.001)
+        # capacity: bucket*k=16 possible, 8 emitted -> efficiency 0.5;
+        # occupancy = active/max_slots = 2/4
+        assert w["decode_efficiency"] == pytest.approx(0.5)
+        assert w["occupancy"] == pytest.approx(0.5)
+        assert w["window_completed"] == 3  # dones at t0+0.1..0.55
+        w2 = rec.window_summary(1500.0, 2500.0)
+        assert w2["window_ticks"] == 1 and w2["window_completed"] == 0
+        assert w2["tick_gap_max_s"] == pytest.approx(0.5)
+    finally:
+        rec.close()
+
+
+def test_recorder_bounded_under_sustained_load():
+    """The flight recorder is a ring: unbounded traffic must not grow it
+    past its cap (ticks, done ring, SLO window, leaked actives)."""
+    rec = ER.EngineRecorder("bounded", max_slots=4, cap=128, enabled=True)
+    try:
+        for i in range(5000):
+            rec.record_tick(t_start=float(i), wall_s=0.001,
+                            phases={"decode_step": 0.001}, active=1,
+                            pending=0, bucket=4, k=1, tokens=1,
+                            admitted=0, gap_s=None)
+            rec.request_admitted(i, t_submit=float(i), t_admit=float(i),
+                                 prompt_tokens=4, cached_tokens=0,
+                                 prefill_s=0.0, kv_restore_s=0.0)
+            if i % 2 == 0:
+                rec.request_tokens(i, 4, float(i) + 0.01, done=True)
+            # odd rids never finish: the _active backstop must bound them
+        assert len(rec.ticks()) <= 128
+        assert len(rec.requests()) <= 128
+        assert len(rec._active) <= 128
+        assert len(rec._window) <= ER._SLO_WINDOW
+        s = rec.summary()
+        assert s["ticks_total"] == 5000 and s["requests_total"] == 5000
+        # snapshot stays compact enough for the 2s KV push cadence
+        assert len(json.dumps(rec.snapshot())) < 64_000
+    finally:
+        rec.close()
+
+
+def test_kill_switch_records_nothing():
+    rec = ER.EngineRecorder("off", max_slots=2, enabled=False)
+    try:
+        rec.record_tick(t_start=0.0, wall_s=1.0, phases={}, active=0,
+                        pending=0, bucket=0, k=0, tokens=0, admitted=0,
+                        gap_s=None)
+        rec.request_admitted(1, t_submit=0.0, t_admit=0.0,
+                             prompt_tokens=1, cached_tokens=0,
+                             prefill_s=0.0, kv_restore_s=0.0)
+        assert not rec.ticks() and not rec.requests()
+        assert rec.summary()["ticks_total"] == 0
+    finally:
+        rec.close()
+
+
+def test_doctor_engine_findings():
+    """Sustained tick-gap and SLO-attainment findings from a synthetic
+    report; stale snapshots skipped; WARN level (doctor stays exit 0)."""
+    from ray_tpu.util import doctor
+
+    now = time.time()
+    snap = {"t": now, "node": "n1", "name": "eng", "summary": {
+        "gap_recent": [0.6, 0.7, 0.8], "window_completed": 10,
+        "ttft_attainment": 0.5, "tpot_attainment": 0.95,
+        "ttft_slo_s": 1.5, "tpot_slo_s": 0.15}}
+    node = {"node_id": "n1deadbeef", "alive": True, "resources": {},
+            "available": {}}
+    report = {"nodes": [node], "actors": [], "failures": [], "ooms": [],
+              "engines": [snap], "window_s": 600.0}
+    findings = doctor.diagnose(report)
+    msgs = [m for lvl, m in findings if lvl == doctor.WARN]
+    assert any("tick-gap sustained" in m for m in msgs), findings
+    assert any("TTFT SLO attainment 0.50" in m for m in msgs), findings
+    assert not any("TPOT SLO" in m for m in msgs)  # 0.95 attains
+    assert not any(lvl == doctor.CRITICAL for lvl, _ in findings)
+    # healthy gaps below the threshold: no finding
+    snap2 = dict(snap, summary=dict(snap["summary"],
+                                    gap_recent=[0.01, 0.02, 0.01],
+                                    ttft_attainment=0.99))
+    findings = doctor.diagnose(dict(report, engines=[snap2]))
+    assert not any("tick-gap" in m for _, m in findings)
+    # stale snapshot (dead pusher): skipped entirely
+    stale = dict(snap, t=now - 120.0)
+    findings = doctor.diagnose(dict(report, engines=[stale]))
+    assert not any("engine" in m for _, m in findings), findings
+    # idle engine (zero completed): no SLO grading
+    idle = dict(snap, summary=dict(snap["summary"], window_completed=0,
+                                   gap_recent=[]))
+    findings = doctor.diagnose(dict(report, engines=[idle]))
+    assert not any("SLO" in m for _, m in findings)
+
+
+# ---------------------------------------------------------------------------
+# the cluster surfaces: @engine/ KV -> /api/engine + rt engine --json
+# ---------------------------------------------------------------------------
+
+def _get_json(port, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=30) as resp:
+        return json.loads(resp.read())
+
+
+def test_api_engine_and_cli_json(rt_cluster):
+    from ray_tpu.dashboard import start_dashboard
+    from ray_tpu.scripts import cli
+    import ray_tpu
+
+    rec = ER.EngineRecorder("surfaced", max_slots=2, enabled=True)
+    try:
+        rec.record_tick(t_start=time.time(), wall_s=0.010,
+                        phases={"decode_step": 0.008,
+                                "token_delivery": 0.002},
+                        active=1, pending=0, bucket=2, k=4, tokens=4,
+                        admitted=0, gap_s=0.003)
+        rec.request_admitted(7, t_submit=time.time() - 0.05,
+                             t_admit=time.time(), prompt_tokens=16,
+                             cached_tokens=8, prefill_s=0.01,
+                             kv_restore_s=0.002)
+        rec.request_tokens(7, 4, time.time(), done=True)
+        counts = rec.drain_now()
+        assert counts["kv"] == 1, counts  # the @engine/ snapshot landed
+
+        port = start_dashboard()
+        payload = _get_json(port, "/api/engine")
+        snaps = [s for s in payload["engines"]
+                 if s.get("name") == "surfaced"]
+        assert snaps, payload
+        snap = snaps[-1]
+        assert snap["summary"]["window_ticks"] == 1
+        assert snap["ticks"] and snap["ticks"][-1]["phases_ms"]
+        assert snap["requests"][-1]["cached_tokens"] == 8
+
+        b = ray_tpu.global_worker()._require_backend()
+        out = io.StringIO()
+        with contextlib.redirect_stdout(out):
+            rc = cli.cmd_engine(Namespace(address=b.gcs_address,
+                                          name="surfaced", limit=5,
+                                          json=True, engine_cmd="stats"))
+        assert rc == 0
+        stats = json.loads(out.getvalue())
+        assert stats and stats[0]["summary"]["window_completed"] == 1
+        out = io.StringIO()
+        with contextlib.redirect_stdout(out):
+            rc = cli.cmd_engine(Namespace(address=b.gcs_address,
+                                          name="surfaced", limit=5,
+                                          json=True, engine_cmd="ticks"))
+        assert rc == 0
+        ticks = json.loads(out.getvalue())
+        assert ticks[0]["ticks"][-1]["gap_ms"] == pytest.approx(3.0)
+        # human rendering smoke (no --json): one line per surface
+        out = io.StringIO()
+        with contextlib.redirect_stdout(out):
+            rc = cli.cmd_engine(Namespace(address=b.gcs_address,
+                                          name="surfaced", limit=5,
+                                          json=False, engine_cmd="stats"))
+        assert rc == 0 and "recorder overhead" in out.getvalue()
+    finally:
+        rec.close()
