@@ -781,6 +781,11 @@ def _rlhf_main() -> None:
                 last = iters[-1]
                 eng = ray_tpu.get(
                     pipeline.group["generator"].engine_stats.remote())
+                # flight-recorder evidence (util/pipeline_recorder.py):
+                # bubble fraction, per-role idle attribution, staleness
+                # profile, the joined ship->fetch->barrier->swap receipt
+                # and the recorder's own self-timed overhead
+                rec = pipeline.recorder.summary()
                 out["rlhf"] = {
                     "preset": pipeline.cfg.preset,
                     "iterations": len(iters),
@@ -796,6 +801,21 @@ def _rlhf_main() -> None:
                     "sync_s": last["sync_s"],
                     "swap_drain_s": last["swap_drain_s"],
                     "phases_s": last["phases_s"],
+                    "phases_actor_s": last.get("phases_actor_s", {}),
+                    "bubble_fraction": last.get("bubble_fraction"),
+                    "coverage": last.get("coverage"),
+                    "staleness": last.get("staleness"),
+                    "receipt": last.get("receipt", {}),
+                    "recorder": {
+                        "bubble_fraction": rec.get("bubble_fraction"),
+                        "bubble_last": rec.get("bubble_last"),
+                        "coverage": rec.get("coverage"),
+                        "role_busy_frac": rec.get("role_busy_frac"),
+                        "role_idle_frac": rec.get("role_idle_frac"),
+                        "tax_s": rec.get("tax_s"),
+                        "staleness": rec.get("staleness"),
+                        "overhead_frac": rec.get("overhead_frac"),
+                    },
                     "trace_id": pipeline.trace_id,
                     "placement": pipeline.group.describe(),
                 }
@@ -1504,6 +1524,90 @@ def _engine_obs_round() -> None:
          "overhead_frac": res.get("overhead_frac")}))
 
 
+def _rlhf_obs_round() -> None:
+    """Focused ``python bench.py --rlhf-obs`` round: re-run the RLHF
+    phase with the pipeline flight recorder live and commit the measured
+    strict-phase bubble fraction + staleness profile as RLHF_r11.json —
+    the baseline ROADMAP item 4's interleave claim will be judged
+    against (the trajectory checker tracks summary.bubble_fraction /
+    summary.staleness_p99 / summary.sync_wall_s)."""
+    import sys
+
+    # a workload big enough that the per-iteration phase work dominates
+    # the fixed RPC orchestration latency — the coverage acceptance
+    # (role intervals >= 95% of iteration wall) grades the recorder's
+    # join, and a debug-sized run would grade the RPC stack instead
+    os.environ.setdefault("RT_BENCH_RLHF_CFG", json.dumps(
+        {"prompts": 16, "prompt_len": 32, "max_new": 128, "slots": 8,
+         "rlhf_iters": 3}))
+    res = _run_phase("RT_BENCH_RLHF", "RLHFBENCH", timeout=1200)
+    if not res or "rlhf" not in res:
+        print("bench: rlhf-obs phase produced no rlhf leg", file=sys.stderr)
+        sys.exit(1)
+    leg = res["rlhf"]
+    rec = leg.get("recorder", {})
+    stale = rec.get("staleness", {}) or {}
+    idle = rec.get("role_idle_frac", {}) or {}
+    receipt = leg.get("receipt", {}) or {}
+    summary = {
+        "bubble_fraction": rec.get("bubble_fraction"),
+        "bubble_last": rec.get("bubble_last"),
+        "coverage": rec.get("coverage"),
+        "staleness_p99": stale.get("p99", 0),
+        "staleness_max": stale.get("max", 0),
+        "sync_wall_s": leg.get("sync_s"),
+        "generate_tok_s": leg.get("generate_tok_s"),
+        "role_idle_frac": idle,
+        "orchestration_tax_s": rec.get("tax_s"),
+        "transfer": {k: receipt.get(k) for k in (
+            "nbytes", "n_leaves", "oid_leaves", "inline_leaves",
+            "transport", "pump_wall_s", "fetch_wall_s",
+            "barrier_drain_s", "swap_apply_s") if k in receipt},
+        "recorder_overhead_frac": rec.get("overhead_frac"),
+    }
+    notes = [
+        "Strict-phase bubble fraction {} (role-seconds idle while any "
+        "other role works / total role-seconds); idlest role {}.".format(
+            summary["bubble_fraction"],
+            max(idle, key=idle.get) if idle else "?"),
+        "Role intervals cover {} of iteration wall (acceptance floor "
+        "0.95); staleness p99 {} versions — strict phases decode the "
+        "just-shipped weights, so nonzero staleness means overlap.".format(
+            summary["coverage"], summary["staleness_p99"]),
+        "Joined transfer receipt: ship pump {}s, fetch {}s, barrier "
+        "drain {}s, swap apply {}s over {} bytes.".format(
+            receipt.get("pump_wall_s"), receipt.get("fetch_wall_s"),
+            receipt.get("barrier_drain_s"), receipt.get("swap_apply_s"),
+            receipt.get("nbytes")),
+        "Recorder self-measured overhead {} of iteration wall "
+        "(budget 0.02).".format(summary["recorder_overhead_frac"]),
+    ]
+    art = {
+        "round": "r11",
+        "artifact": "RLHF_r11",
+        "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "platform": res.get("platform",
+                            os.environ.get("RT_BENCH_PLATFORM", "cpu")),
+        "summary": summary,
+        "notes": notes,
+        "measured": res,
+    }
+    path = os.environ.get("RT_BENCH_RLHF_OUT") or os.path.join(
+        _REPO_ROOT, "RLHF_r11.json")
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(art, f, indent=1)
+        f.write("\n")
+    os.replace(tmp, path)
+    print(f"bench: rlhf-obs round written to {path}")
+    print("RLHFOBS=" + json.dumps(
+        {"bubble_fraction": summary["bubble_fraction"],
+         "coverage": summary["coverage"],
+         "staleness_p99": summary["staleness_p99"],
+         "sync_wall_s": summary["sync_wall_s"],
+         "recorder_overhead_frac": summary["recorder_overhead_frac"]}))
+
+
 def _data_main() -> None:
     """Data-ingestion phase (VERDICT r4 #6): parquet -> fused map pipeline
     -> iter_batches, the host-side input path that keeps chips fed. Reports
@@ -1995,6 +2099,9 @@ def main() -> None:
         return
     if "--engine-obs" in sys.argv[1:]:
         _engine_obs_round()
+        return
+    if "--rlhf-obs" in sys.argv[1:]:
+        _rlhf_obs_round()
         return
 
     # TPU perf flags (latency-hiding scheduler, async collectives) must be

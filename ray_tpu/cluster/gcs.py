@@ -1284,6 +1284,12 @@ class GcsServer:
     _TASK_EVENTS_CAP = 10000
     _STEP_EVENTS_CAP = 4096
     _SERVE_EVENTS_CAP = 4096
+    _RECORDER_EVENTS_CAP = 4096
+
+    #: payload keys of flight-recorder events (engine ticks/requests,
+    #: rlhf pipeline iterations) — opaque to the GCS, rendered into
+    #: timeline lanes client-side (util/timeline.py)
+    _RECORDER_KEYS = ("engine_tick", "engine_request", "rlhf_iter")
 
     async def rpc_task_event(self, p):
         self._apply_task_event(p)
@@ -1315,10 +1321,17 @@ class GcsServer:
             # serve request spans likewise (serve/obs.py): heavy traffic
             # emits several spans per request and must not crowd out tasks
             self.serve_events: "OrderedDict[str, Dict]" = OrderedDict()
+            # flight-recorder events (engine ticks/requests, rlhf
+            # iterations) likewise: a busy engine drains up to 256 ticks
+            # per cadence and would flush the real task history
+            self.recorder_events: "OrderedDict[str, Dict]" = OrderedDict()
         is_step = p.get("profile") is not None
         is_serve = str(p.get("task_id", "")).startswith("serve:")
+        is_recorder = any(p.get(k) is not None for k in self._RECORDER_KEYS)
         if is_step:
             store, cap = self.step_events, self._STEP_EVENTS_CAP
+        elif is_recorder:
+            store, cap = self.recorder_events, self._RECORDER_EVENTS_CAP
         elif is_serve:
             store, cap = self.serve_events, self._SERVE_EVENTS_CAP
         else:
@@ -1357,6 +1370,9 @@ class GcsServer:
         # start/end; server receive-time would misplace the lane)
         if p.get("profile") is not None:
             ev["profile"] = p["profile"]
+        for key in self._RECORDER_KEYS:
+            if p.get(key) is not None:
+                ev[key] = p[key]
         # per-state transition times feed ray_tpu.timeline()'s Chrome trace
         if p.get("times"):
             ev.setdefault("times", {}).update(p["times"])
@@ -1383,6 +1399,13 @@ class GcsServer:
             events += list(getattr(self, "task_events", {}).values())[-limit:]
         if mode != "exclude":
             events += list(getattr(self, "step_events", {}).values())[-limit:]
+            # flight-recorder lanes ride the same opt-in: only the
+            # timeline (profile "include") wants them — the state API,
+            # `rt list tasks`, and the Steps page must not see
+            # engtick/engreq/rlhfit pseudo-tasks
+            if mode == "include":
+                events += list(
+                    getattr(self, "recorder_events", {}).values())[-limit:]
         if p.get("serve") == "include" and mode != "only":
             events += list(
                 getattr(self, "serve_events", {}).values())[-limit:]

@@ -31,5 +31,6 @@ from ray_tpu.collective.collective import (  # noqa: F401
     reducescatter,
     send,
     ship_params,
+    shipment_receipt,
 )
 from ray_tpu.collective.rendezvous import bootstrap_jax_distributed  # noqa: F401

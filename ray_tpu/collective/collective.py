@@ -24,7 +24,8 @@ import asyncio
 import itertools
 import os
 import threading
-from collections import deque
+import time
+from collections import OrderedDict, deque
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -416,21 +417,57 @@ _PARAM_RPC = "coll_param_reclaim"
 _ship_lock = threading.Lock()
 _ship_ids = itertools.count()  # rt: guarded-by(_ship_lock)
 
+#: producer-side transfer receipts, keyed by sid — the pump stamps its
+#: first/last ``take`` so the RLHF flight recorder can join the pump
+#: wall with the consumer's fetch wall and the engine's swap barrier
+_receipts: "OrderedDict[str, Dict[str, Any]]" = \
+    OrderedDict()  # rt: guarded-by(_ship_lock)
+
 
 class _ParamsPump:
-    """Finite list pump for one shipment (the stream-source contract)."""
+    """Finite list pump for one shipment (the stream-source contract).
+    Stamps its receipt on every ``take`` — both the push path and the
+    reclaim fallback drain through here, so the pump wall is
+    transport-agnostic."""
 
-    def __init__(self, items: List[Any]):
+    def __init__(self, items: List[Any],
+                 receipt: Optional[Dict[str, Any]] = None):
         self._items = list(items)
         self._pos = 0
+        self._receipt = receipt
 
     async def take(self, n: int) -> Tuple[List[Any], bool]:
         out = self._items[self._pos:self._pos + n]
         self._pos += len(out)
-        return out, self._pos >= len(self._items)
+        done = self._pos >= len(self._items)
+        if self._receipt is not None and out:
+            now = time.time()
+            with _ship_lock:
+                self._receipt.setdefault("t_pump0", now)
+                self._receipt["t_pump1"] = now
+                self._receipt["frames_taken"] = \
+                    self._receipt.get("frames_taken", 0) + len(out)
+                if done:
+                    self._receipt["pump_done"] = True
+        return out, done
 
     def close(self) -> None:
         self._items = []
+
+
+def shipment_receipt(sid: str) -> Optional[Dict[str, Any]]:
+    """Producer-side transfer receipt for one shipment: frames pumped
+    and the pump wall (first ``take`` to last ``take``). Survives the
+    shipment's deregistration so the driver can read it AFTER the
+    consumer redeemed the ticket; the registry keeps the last 32."""
+    with _ship_lock:
+        r = _receipts.get(sid)
+        if r is None:
+            return None
+        out = dict(r)
+    if "t_pump0" in out and "t_pump1" in out:
+        out["pump_wall_s"] = round(out["t_pump1"] - out["t_pump0"], 6)
+    return out
 
 
 def _params_backend():
@@ -501,7 +538,14 @@ def ship_params(params: Any, *, sid: Optional[str] = None) -> Dict[str, Any]:
             sid = f"params-{os.getpid()}-{next(_ship_ids)}"
     meta = {"treedef": treedef, "n_leaves": len(np_leaves),
             "nbytes": nbytes}
-    rt_stream.register_source(sid, _ParamsPump([meta] + np_leaves))
+    receipt = {"sid": sid, "t_ship": time.time(), "nbytes": nbytes,
+               "n_leaves": len(np_leaves)}
+    with _ship_lock:
+        _receipts[sid] = receipt
+        while len(_receipts) > 32:  # bound the receipt registry
+            _receipts.popitem(last=False)
+    rt_stream.register_source(sid, _ParamsPump([meta] + np_leaves,
+                                               receipt=receipt))
     return {"address": backend.address, "sid": sid,
             "n_leaves": len(np_leaves), "nbytes": nbytes}
 
@@ -574,8 +618,10 @@ def fetch_params(ticket: Dict[str, Any], *,
     from ray_tpu.cluster import stream as rt_stream
 
     backend = _params_backend()
+    t_fetch0 = time.perf_counter()
     items, transport, rpcs = backend.io.run(
         _fetch_async(backend, ticket["address"], ticket["sid"], window))
+    fetch_wall_s = time.perf_counter() - t_fetch0
     try:
         rt_stream.observe_request_rpcs(transport, rpcs)
     except Exception:  # noqa: BLE001 — telemetry never fails the fetch
@@ -600,7 +646,9 @@ def fetch_params(ticket: Dict[str, Any], *,
     return params, {"transport": transport, "rpcs": rpcs,
                     "nbytes": meta["nbytes"],
                     "n_leaves": meta["n_leaves"],
-                    "oid_leaves": oid_leaves}
+                    "oid_leaves": oid_leaves,
+                    "inline_leaves": meta["n_leaves"] - oid_leaves,
+                    "fetch_wall_s": round(fetch_wall_s, 6)}
 
 
 def destroy_collective_group(group_name: str = "default") -> None:

@@ -18,6 +18,7 @@ State API), ``dashboard/modules/metrics`` (Prometheus). Routes:
   GET /api/serve/applications   serve app states
   GET /api/sched                placement decisions + cross-node balance
   GET /api/engine               engine flight-recorder snapshots
+  GET /api/rlhf                 RLHF pipeline flight-recorder snapshots
   GET /api/cluster_resources    total/available
   GET /metrics                  Prometheus text page
   GET /-/healthz                liveness
@@ -74,6 +75,9 @@ class DashboardActor:
         # the engine plane: flight-recorder snapshots (@engine/ KV —
         # tick phases, request lifecycles, SLO/goodput rollups)
         app.router.add_get("/api/engine", self._engine)
+        # the RLHF plane: pipeline flight-recorder snapshots (@rlhf/ KV —
+        # per-role bubble attribution, staleness, transfer receipts)
+        app.router.add_get("/api/rlhf", self._rlhf)
         app.router.add_get("/api/stacks", self._stacks)
         app.router.add_get("/metrics", self._metrics)
         self._runner = web.AppRunner(app, access_log=None)
@@ -234,6 +238,39 @@ class DashboardActor:
                     except ValueError:
                         continue
                 return {"engines": engines}
+
+            return backend.io.run(run())
+
+        loop = asyncio.get_running_loop()
+        out = await loop.run_in_executor(None, fetch)
+        return web.json_response(out, dumps=_dumps)
+
+    async def _rlhf(self, request):
+        """The RLHF tab's payload: every live RLHF pipeline's
+        flight-recorder snapshot (util/pipeline_recorder.py drain pushes
+        them to the ``@rlhf/`` KV) — bubble fraction, per-role idle
+        attribution, staleness profile, and the last transfer receipt."""
+        from aiohttp import web
+
+        def fetch():
+            backend = self._backend()
+
+            async def run():
+                keys = (await backend._gcs.call(
+                    "kv_keys", {"prefix": "@rlhf/"})).get("keys") or []
+                replies = await asyncio.gather(
+                    *(backend._gcs.call("kv_get", {"key": k})
+                      for k in sorted(keys)[:50]))
+                pipelines = []
+                for reply in replies:
+                    raw = reply.get("value")
+                    if not raw:
+                        continue
+                    try:
+                        pipelines.append(json.loads(raw))
+                    except ValueError:
+                        continue
+                return {"pipelines": pipelines}
 
             return backend.io.run(run())
 

@@ -219,6 +219,7 @@ const TABS = [
   {id: "serve", label: "Serve", url: "/api/serve"},
   {id: "sched", label: "Scheduling", url: "/api/sched?limit=200"},
   {id: "engine", label: "Engine", url: "/api/engine"},
+  {id: "rlhf", label: "RLHF", url: "/api/rlhf"},
 ];
 let active = "nodes", paused = false, data = {};
 
@@ -691,6 +692,62 @@ function renderEngine(el) {
   }).join("");
 }
 
+// --- rlhf tab: RLHFPipeline flight-recorder snapshots ---
+const RLHF_ROLES = ["generator", "reference", "reward", "learner"];
+function renderRlhf(el) {
+  const payload = data.rlhf || {};
+  const pipes = payload.pipelines || [];
+  if (!pipes.length) {
+    el.innerHTML = `<div class="empty">no RLHF flight-recorder ` +
+      `snapshots — run an RLHFPipeline (RT_RLHF_RECORDER=1)</div>`;
+    return;
+  }
+  const pct = v => v == null ? "" : (100 * v).toFixed(1) + "%";
+  el.innerHTML = pipes.map(snap => {
+    const s = snap.summary || {};
+    const stale = s.staleness || {};
+    const busy = s.role_busy_frac || {};
+    const idle = s.role_idle_frac || {};
+    const roles = RLHF_ROLES.filter(r => r in busy || r in idle).map(r =>
+      `<tr><td>${esc(r)}</td><td>${pct(busy[r])}</td>` +
+      `<td>${pct(idle[r])}</td></tr>`).join("");
+    const rc = s.receipt_last || {};
+    const receipt = Object.keys(rc).length ?
+      `<div class="muted">last shipment v${esc(rc.version ?? "?")} · ` +
+      `${((rc.nbytes || 0) / 1e6).toFixed(1)}MB/${esc(rc.n_leaves ?? 0)} ` +
+      `leaves · pump ${((rc.pump_wall_s || 0) * 1e3).toFixed(1)}ms · ` +
+      `fetch ${((rc.fetch_wall_s || 0) * 1e3).toFixed(1)}ms · barrier ` +
+      `${((rc.barrier_drain_s || 0) * 1e3).toFixed(1)}ms · swap ` +
+      `${((rc.swap_apply_s || 0) * 1e3).toFixed(1)}ms</div>` : "";
+    const iters = (snap.iterations || []).slice().reverse().map(r =>
+      r.state === "interrupted" ?
+        `<tr><td>${esc(r.seq ?? "")}</td>` +
+        `<td>${statusCell("FAILED")}</td>` +
+        `<td colspan="5">interrupted in ${esc(r.phase || "?")} ` +
+        `${esc(String(r.error || "").slice(0, 60))}</td></tr>` :
+        `<tr><td>${esc(r.iteration ?? r.seq ?? "")}</td>` +
+        `<td>${statusCell("FINISHED")}</td>` +
+        `<td>${esc(r.wall_ms ?? "")}</td><td>${pct(r.bubble_fraction)}</td>` +
+        `<td>${pct(r.coverage)}</td><td>${esc(r.staleness ?? 0)}</td>` +
+        `<td>${esc(r.tokens ?? 0)}</td></tr>`).join("");
+    return `<h3>${esc(snap.name || "rlhf")} <span class="muted">` +
+      `${esc(String(snap.node || "").slice(0, 8))}:${esc(snap.pid || "")}` +
+      `</span></h3>` +
+      `<div class="muted">iterations ${esc(s.iterations_total ?? 0)} ` +
+      `(${esc(s.interrupted_total ?? 0)} interrupted) · bubble ` +
+      `${pct(s.bubble_fraction)} (last ${pct(s.bubble_last)}) · coverage ` +
+      `${pct(s.coverage)} · staleness p99 ${esc(stale.p99 ?? 0)} ` +
+      `(max ${esc(stale.max ?? 0)}) · overhead ` +
+      `${((s.overhead_frac || 0) * 100).toFixed(3)}%</div>` +
+      (roles ? `<table><tr><th>Role</th><th>Busy</th><th>Idle</th></tr>` +
+        `${roles}</table>` : "") + receipt +
+      (iters ? `<table><tr><th>Iter</th><th>State</th><th>Wall ms</th>` +
+        `<th>Bubble</th><th>Coverage</th><th>Staleness</th><th>Tokens</th>` +
+        `</tr>${iters}</table>` :
+        `<div class="empty">no iteration records yet</div>`);
+  }).join("");
+}
+
 function renderTable() {
   const el = document.getElementById("content");
   if (active === "timeline") { renderTimeline(el); return; }
@@ -698,6 +755,7 @@ function renderTable() {
   if (active === "logs") { renderLogs(el); return; }
   if (active === "sched") { renderSched(el); return; }
   if (active === "engine") { renderEngine(el); return; }
+  if (active === "rlhf") { renderRlhf(el); return; }
   if (active === "serve") {
     const payload = data.serve || {};
     const apps = payload.applications || payload;

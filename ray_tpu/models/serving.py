@@ -913,6 +913,7 @@ class ContinuousEngine:
         if state["error"] is not None:
             raise RuntimeError(f"weight swap failed: {state['error']}")
         return {"drain_s": round(time.perf_counter() - t0, 4),
+                "apply_s": round(state.get("apply_s", 0.0), 6),
                 "weight_swaps": self._weight_swaps}
 
     def check_alive(self) -> None:
@@ -1038,14 +1039,16 @@ class ContinuousEngine:
             # to neither model — invalidate the whole cache at the swap
             self._batcher.prefix_cache.clear()
         self._weight_swaps += 1
+        apply_s = time.perf_counter() - t_swap0
         for st in waiters:
+            st["apply_s"] = apply_s
             st["applied"] = True
             st["event"].set()
         # swap-barrier phase: the apply wall (drain time shows up as the
         # preceding ticks' shrinking active counts, not here); consumed
         # by the next record_tick (engine-thread-confined accumulator)
-        self._tick_swap_s += time.perf_counter() - t_swap0
-        self._recorder.record_swap(time.perf_counter() - t_swap0)
+        self._tick_swap_s += apply_s
+        self._recorder.record_swap(apply_s)
 
     def _fail_swap_locked(self, reason: str) -> None:
         """Unblock load_params waiters when the engine stops or dies

@@ -24,6 +24,17 @@ placement-group bundle each, ``train/worker_group.RoleGroup``):
 Every phase call runs under ONE ambient trace span, so ``rt trace
 <pipeline.trace_id>`` shows the whole story: role creation (placement),
 then each iteration's generate/score/update/sync hops.
+
+Each role additionally stamps its phase interval ACTOR-SIDE (wall-clock
+``t0``/``t1`` inside the method, returned with the result) and the
+driver joins those intervals into one iteration record on the pipeline
+flight recorder (``util/pipeline_recorder.py``): per-role busy/idle and
+the strict-phase bubble fraction, the orchestration tax (driver wall
+minus actor wall per phase), the learner's monotonic weights-version vs
+the version each generate batch decoded under (measured staleness), and
+the joined ship→fetch→barrier→swap transfer receipt. Read it live via
+``rt rlhf stats`` / the dashboard RLHF tab, postmortem off the ``@rlhf/``
+GCS snapshot.
 """
 
 from __future__ import annotations
@@ -80,8 +91,10 @@ def phase_seconds() -> "M.Histogram":
     return _metric("phase", lambda: M.get_or_create(
         M.Histogram, "rt_rlhf_phase_seconds",
         "Wall seconds per RLHF pipeline phase, phase= (generate / "
-        "score / update / sync)",
-        tag_keys=("phase",), boundaries=_PHASE_BUCKETS))
+        "score / update / ship / sync / sync_swap ...) x side= (driver "
+        "= driver-observed, actor = stamped inside the role's method; "
+        "the gap is the orchestration tax)",
+        tag_keys=("phase", "side"), boundaries=_PHASE_BUCKETS))
 
 
 def weight_sync_bytes_total() -> "M.Counter":
@@ -180,11 +193,13 @@ class RLHFLearner:
     def update(self, sequences, rewards, ref_logps,
                prompt_len: int) -> Dict[str, Any]:
         """One PPO-style update on the sampled sequences; returns
-        iteration metrics."""
+        iteration metrics (plus the actor-side phase stamp and the new
+        monotonic weights-version)."""
         import jax.numpy as jnp
 
         from ray_tpu.rl.rlhf import models as rlhf_models
 
+        t0 = time.time()
         tokens = jnp.asarray(np.asarray(sequences, np.int32))
         rewards = jnp.asarray(np.asarray(rewards, np.float32))
         ref_logps = jnp.asarray(np.asarray(ref_logps, np.float32))
@@ -202,18 +217,47 @@ class RLHFLearner:
                 self._params, self._opt_state, tokens,
                 old_logp, adv, prompt_len)
         self._updates += 1
-        return {"loss": float(loss), "ratio_mean": float(ratio),
-                "kl_mean": float(jnp.mean(kl_seq)),
-                "reward_mean": float(jnp.mean(rewards)),
-                "updates": self._updates}
+        # force the async-dispatched update chain to completion BEFORE
+        # stamping t1: the float() conversions block on the final
+        # epoch's computation, and stamping first would hide the real
+        # compute from the actor-side interval (the driver would then
+        # book it as orchestration tax)
+        loss_f, ratio_f = float(loss), float(ratio)
+        kl_f = float(jnp.mean(kl_seq))
+        reward_f = float(jnp.mean(rewards))
+        t1 = time.time()
+        # self._updates IS the monotonic weights-version: each update
+        # produces a new version, and the ship ticket carries it so the
+        # generator can stamp which version every batch decoded under
+        return {"loss": loss_f, "ratio_mean": ratio_f,
+                "kl_mean": kl_f, "reward_mean": reward_f,
+                "updates": self._updates, "version": self._updates,
+                "t0": t0, "t1": t1, "wall_s": round(t1 - t0, 6)}
 
     def ship_weights(self) -> Dict[str, Any]:
         """Ship the current policy: returns the stream ticket the
         generator redeems (tensor bytes travel as oid frames, not
-        through this actor call's reply)."""
+        through this actor call's reply). The ticket additionally
+        carries the weights-version and the actor-side ship stamp —
+        ``fetch_params`` reads only address/sid, extra keys ride free."""
         from ray_tpu import collective
 
-        return collective.ship_params(self._params)
+        t0 = time.time()
+        ticket = collective.ship_params(self._params)
+        t1 = time.time()
+        ticket["version"] = self._updates
+        ticket["t0"] = t0
+        ticket["t1"] = t1
+        ticket["wall_s"] = round(t1 - t0, 6)
+        return ticket
+
+    def shipment_receipt(self, sid: str) -> Optional[Dict[str, Any]]:
+        """Producer-side pump receipt for one shipment (first/last
+        ``take`` wall) — the driver joins it with the consumer's fetch
+        wall into the transfer receipt."""
+        from ray_tpu import collective
+
+        return collective.shipment_receipt(sid)
 
     def cancel_shipment(self, ticket: Dict[str, Any]) -> None:
         """Drop an unredeemed shipment (the pipeline calls this when
@@ -243,14 +287,18 @@ class RLHFReference:
     def ping(self) -> str:
         return "reference"
 
-    def logprobs(self, sequences, prompt_len: int) -> np.ndarray:
+    def logprobs(self, sequences, prompt_len: int) -> Dict[str, Any]:
         import jax.numpy as jnp
 
         from ray_tpu.rl.rlhf import models as rlhf_models
 
+        t0 = time.time()
         tokens = jnp.asarray(np.asarray(sequences, np.int32))
-        return np.asarray(rlhf_models.sequence_logprobs(
+        out = np.asarray(rlhf_models.sequence_logprobs(
             self._params, tokens, prompt_len, self.cfg))
+        t1 = time.time()
+        return {"logprobs": out, "t0": t0, "t1": t1,
+                "wall_s": round(t1 - t0, 6)}
 
 
 class RLHFReward:
@@ -269,14 +317,18 @@ class RLHFReward:
     def ping(self) -> str:
         return "reward"
 
-    def score(self, sequences) -> np.ndarray:
+    def score(self, sequences) -> Dict[str, Any]:
         import jax.numpy as jnp
 
         from ray_tpu.rl.rlhf import models as rlhf_models
 
+        t0 = time.time()
         tokens = jnp.asarray(np.asarray(sequences, np.int32))
-        return np.asarray(rlhf_models.reward_score(
+        out = np.asarray(rlhf_models.reward_score(
             self._params, tokens, self.cfg))
+        t1 = time.time()
+        return {"scores": out, "t0": t0, "t1": t1,
+                "wall_s": round(t1 - t0, 6)}
 
 
 class RLHFGenerator:
@@ -298,6 +350,11 @@ class RLHFGenerator:
         self.engine = ContinuousEngine(
             params, self.cfg, max_slots=max_slots, max_len=max_len,
             decode_stride=decode_stride)
+        # version of the weights currently decoding (0 = the seed init;
+        # each sync stamps the learner version its ticket carried) — an
+        # actor restart resets this to 0, which is exactly right: the
+        # rebuilt engine decodes the seed weights again
+        self._weights_version = 0
 
     def ping(self) -> str:
         return "generator"
@@ -305,32 +362,47 @@ class RLHFGenerator:
     def generate(self, prompts, max_new_tokens: int) -> Dict[str, Any]:
         """Decode every prompt through the engine's slots (mid-flight
         admission; the engine queues past the slot budget). Returns
-        full sequences (prompt + generation) and engine counters."""
-        t0 = time.perf_counter()
+        full sequences (prompt + generation), engine counters, and the
+        weights-version the batch decoded under (a mid-generate swap
+        shows as start != end)."""
+        t0 = time.time()
+        tp0 = time.perf_counter()
+        version_start = self._weights_version
         queues = [self.engine.submit_stream(
             np.asarray(p, np.int32), max_new_tokens) for p in prompts]
         seqs = []
         for p, q in zip(prompts, queues):
             toks = [t for t in iter(q.get, None)]
             seqs.append(list(p) + toks)
-        dt = time.perf_counter() - t0
+        dt = time.perf_counter() - tp0
+        t1 = time.time()
         n_new = sum(len(s) - len(p) for s, p in zip(seqs, prompts))
         return {"sequences": np.asarray(seqs, np.int32),
                 "tokens_generated": n_new,
                 "tok_s": round(n_new / max(dt, 1e-9), 1),
                 "wall_s": round(dt, 4),
+                "t0": t0, "t1": t1,
+                "weights_version_start": version_start,
+                "weights_version": self._weights_version,
                 "engine": self.engine.stats()}
 
     def sync_weights(self, ticket: Dict[str, Any]) -> Dict[str, Any]:
         """Redeem the learner's ticket: fetch the shipped weights over
-        the stream plane, swap them in behind the drain barrier."""
+        the stream plane, swap them in behind the drain barrier. Stamps
+        the actor-side sync interval and the version now decoding."""
         from ray_tpu import collective
 
-        t0 = time.perf_counter()
+        t0 = time.time()
+        tp0 = time.perf_counter()
         params, info = collective.fetch_params(ticket)
         swap = self.engine.load_params(params)
+        self._weights_version = int(
+            ticket.get("version", self._weights_version + 1))
         info.update(swap)
-        info["sync_s"] = round(time.perf_counter() - t0, 4)
+        info["version"] = self._weights_version
+        info["sync_s"] = round(time.perf_counter() - tp0, 4)
+        info["t0"] = t0
+        info["t1"] = time.time()
         return info
 
     def engine_stats(self) -> Dict[str, Any]:
@@ -352,6 +424,7 @@ class RLHFPipeline:
 
     def __init__(self, cfg: Optional[RLHFConfig] = None, **overrides):
         from ray_tpu.train.worker_group import RoleGroup
+        from ray_tpu.util import pipeline_recorder as _prec
         from ray_tpu.util import tracing
 
         self.cfg = cfg or RLHFConfig(**overrides)
@@ -362,6 +435,10 @@ class RLHFPipeline:
         self._tokens_generated = 0  # rt: guarded-by(_lock)
         self._sync_bytes = 0       # rt: guarded-by(_lock)
         self._last: Dict[str, Any] = {}  # rt: guarded-by(_lock)
+        # the learner's weights-version as of the LAST completed update
+        # (what the learner held while this iteration's batch decoded) —
+        # staleness = this minus the version the generator decoded under
+        self._learner_version = 0  # rt: guarded-by(_lock)
         # ONE ambient span for the pipeline's lifetime: role creation
         # and every phase call become children of this synthetic root,
         # so the whole story lands under one trace id
@@ -377,9 +454,15 @@ class RLHFPipeline:
                             num_cpus=c.cpus_per_role)
         self.group.add_role("reward", RLHFReward, c.preset, c.seed + 1,
                             num_cpus=c.cpus_per_role)
+        # the generator survives one chaos/crash restart: a killed
+        # engine rebuilds on the seed weights (weights-version 0) and
+        # the next iteration's staleness stamp shows the regression
         self.group.add_role(
             "generator", RLHFGenerator, c.preset, c.seed, c.max_slots,
-            c.max_len, c.decode_stride, num_cpus=c.cpus_per_role)
+            c.max_len, c.decode_stride, num_cpus=c.cpus_per_role,
+            options={"max_restarts": 1})
+        self.recorder = _prec.PipelineRecorder(
+            f"rlhf-{self.trace_id[:8]}")
         token = tracing.activate(self._trace_ctx)
         try:
             self.group.start()
@@ -401,13 +484,21 @@ class RLHFPipeline:
 
     def run_iteration(self) -> Dict[str, Any]:
         """One generate -> score -> update -> sync round; returns the
-        iteration's metrics (also pushed onto the ``rt_rlhf_*`` series).
+        iteration's metrics (also pushed onto the ``rt_rlhf_*`` series
+        and joined onto the pipeline flight recorder). A round that dies
+        mid-phase stamps the interrupted phase on the recorder before
+        re-raising, so the postmortem snapshot names where it stopped.
         """
         import ray_tpu
         from ray_tpu.util import tracing
 
         c = self.cfg
         g = self.group
+        with self._lock:
+            learner_version = self._learner_version
+        iter_t0 = time.time()
+        iter_p0 = time.perf_counter()
+        cur_phase = "generate"
         token = tracing.activate(self._trace_ctx)
         try:
             phases: Dict[str, float] = {}
@@ -417,20 +508,27 @@ class RLHFPipeline:
             phases["generate"] = time.perf_counter() - t0
             seqs = gen["sequences"]
 
+            cur_phase = "score"
             t0 = time.perf_counter()
             # reward + reference fire in parallel: independent reads
             reward_ref = g["reward"].score.remote(seqs)
             ref_ref = g["reference"].logprobs.remote(seqs, c.prompt_len)
-            rewards, ref_logps = ray_tpu.get([reward_ref, ref_ref])
+            reward_out, ref_out = ray_tpu.get([reward_ref, ref_ref])
+            rewards = reward_out["scores"]
+            ref_logps = ref_out["logprobs"]
             phases["score"] = time.perf_counter() - t0
 
+            cur_phase = "update"
             t0 = time.perf_counter()
             update = ray_tpu.get(g["learner"].update.remote(
                 seqs, rewards, ref_logps, c.prompt_len))
             phases["update"] = time.perf_counter() - t0
 
+            cur_phase = "ship"
             t0 = time.perf_counter()
             ticket = ray_tpu.get(g["learner"].ship_weights.remote())
+            d_ship = time.perf_counter() - t0
+            cur_phase = "sync_swap"
             try:
                 sync = ray_tpu.get(
                     g["generator"].sync_weights.remote(ticket))
@@ -444,9 +542,67 @@ class RLHFPipeline:
                 except Exception:  # noqa: BLE001 — best-effort cleanup
                     pass
                 raise
+            d_swap = time.perf_counter() - t0 - d_ship
             phases["sync"] = time.perf_counter() - t0
+            # the iteration's WORK ends here: the pump-receipt read
+            # below is recorder telemetry, not pipeline dataflow, so it
+            # stays out of the wall the coverage ratio divides by
+            iter_p1 = time.perf_counter()
+            # producer-side pump receipt, read AFTER the consumer
+            # redeemed the ticket (the receipt registry outlives the
+            # shipment's deregistration)
+            try:
+                pump = ray_tpu.get(g["learner"].shipment_receipt.remote(
+                    ticket["sid"]))
+            except Exception:  # noqa: BLE001 — receipt is telemetry
+                pump = None
+        except BaseException as exc:
+            try:
+                self.recorder.record_interrupt(
+                    phase=cur_phase, t=time.time(), error=repr(exc))
+            except Exception:  # noqa: BLE001 — recorder never masks
+                pass           # the real failure
+            raise
         finally:
             tracing.deactivate(token)
+        iter_wall = iter_p1 - iter_p0
+
+        # join the actor-side stamps (all roles share the host clock —
+        # PACK placement) into the recorder's iteration record
+        intervals = [
+            {"role": "generator", "phase": "generate",
+             "t0": gen["t0"], "t1": gen["t1"]},
+            {"role": "reward", "phase": "score_reward",
+             "t0": reward_out["t0"], "t1": reward_out["t1"]},
+            {"role": "reference", "phase": "score_ref",
+             "t0": ref_out["t0"], "t1": ref_out["t1"]},
+            {"role": "learner", "phase": "update",
+             "t0": update["t0"], "t1": update["t1"]},
+            {"role": "learner", "phase": "ship",
+             "t0": ticket["t0"], "t1": ticket["t1"]},
+            {"role": "generator", "phase": "sync_swap",
+             "t0": sync["t0"], "t1": sync["t1"]},
+        ]
+        driver_s = {"generate": phases["generate"],
+                    "score": phases["score"],
+                    "update": phases["update"],
+                    "ship": d_ship, "sync_swap": d_swap}
+        receipt = {"version": int(ticket.get("version", 0)),
+                   "nbytes": int(sync["nbytes"]),
+                   "n_leaves": int(sync.get("n_leaves", 0)),
+                   "oid_leaves": int(sync.get("oid_leaves", 0)),
+                   "inline_leaves": int(sync.get("inline_leaves", 0)),
+                   "transport": sync["transport"],
+                   "rpcs": int(sync.get("rpcs", 0)),
+                   "ship_wall_s": ticket.get("wall_s", 0.0),
+                   "fetch_wall_s": sync.get("fetch_wall_s", 0.0),
+                   "barrier_drain_s": sync["drain_s"],
+                   "swap_apply_s": sync.get("apply_s", 0.0)}
+        if pump and "pump_wall_s" in pump:
+            receipt["pump_wall_s"] = pump["pump_wall_s"]
+            receipt["frames_taken"] = int(pump.get("frames_taken", 0))
+        decoded_version = int(gen.get("weights_version", 0))
+        staleness = max(0, learner_version - decoded_version)
 
         result = {
             "iteration": None,  # filled under the lock below
@@ -461,20 +617,47 @@ class RLHFPipeline:
             "sync_s": sync["sync_s"],
             "swap_drain_s": sync["drain_s"],
             "phases_s": {k: round(v, 4) for k, v in phases.items()},
+            "phases_actor_s": {iv["phase"]: round(
+                max(0.0, iv["t1"] - iv["t0"]), 4) for iv in intervals},
+            "weights_version": int(update.get("version", 0)),
+            "decoded_version": decoded_version,
+            "staleness": staleness,
+            "receipt": receipt,
             "trace_id": self.trace_id,
         }
         with self._lock:
             self._iterations += 1
             self._tokens_generated += result["tokens_generated"]
             self._sync_bytes += result["sync_bytes"]
+            self._learner_version = result["weights_version"]
             result["iteration"] = self._iterations
             self._last = result
+        try:
+            derived = self.recorder.record_iteration(
+                iteration=result["iteration"], t0=iter_t0,
+                wall_s=iter_wall, intervals=intervals,
+                driver_s=driver_s,
+                tokens=result["tokens_generated"],
+                learner_version=learner_version,
+                decoded_version=decoded_version, receipt=receipt)
+            result["bubble_fraction"] = derived.get("bubble_fraction")
+            result["coverage"] = derived.get("coverage")
+            result["tax_s"] = derived.get("tax_s")
+            if derived.get("restart_gap_s") is not None:
+                result["restart_gap_s"] = derived["restart_gap_s"]
+        except Exception:  # noqa: BLE001 — recorder never fails a round
+            pass
         try:
             iterations_total().inc()
             tokens_generated_total().inc(result["tokens_generated"])
             reward_mean_gauge().set(result["reward_mean"])
             for phase, secs in phases.items():
-                phase_seconds().observe(secs, tags={"phase": phase})
+                phase_seconds().observe(secs, tags={"phase": phase,
+                                                    "side": "driver"})
+            for iv in intervals:
+                phase_seconds().observe(
+                    max(0.0, iv["t1"] - iv["t0"]),
+                    tags={"phase": iv["phase"], "side": "actor"})
             weight_sync_bytes_total().inc(
                 result["sync_bytes"],
                 {"transport": result["sync_transport"]})
@@ -485,12 +668,21 @@ class RLHFPipeline:
 
     def stats(self) -> Dict[str, Any]:
         with self._lock:
-            return {"iterations": self._iterations,
-                    "tokens_generated": self._tokens_generated,
-                    "sync_bytes_total": self._sync_bytes,
-                    "trace_id": self.trace_id,
-                    "placement": self.group.describe(),
-                    "last": dict(self._last)}
+            out = {"iterations": self._iterations,
+                   "tokens_generated": self._tokens_generated,
+                   "sync_bytes_total": self._sync_bytes,
+                   "trace_id": self.trace_id,
+                   "placement": self.group.describe(),
+                   "last": dict(self._last)}
+        try:
+            out["recorder"] = self.recorder.summary()
+        except Exception:  # noqa: BLE001 — stats never fail on telemetry
+            pass
+        return out
 
     def shutdown(self) -> None:
+        try:
+            self.recorder.close()
+        except Exception:  # noqa: BLE001 — teardown best-effort
+            pass
         self.group.shutdown()

@@ -823,6 +823,7 @@ def cmd_doctor(args: argparse.Namespace) -> int:
                           imbalance_warn=args.imbalance_warn,
                           tick_gap_warn_s=args.tick_gap_warn,
                           slo_warn=args.slo_warn,
+                          bubble_warn=args.bubble_warn,
                           as_json=args.json)
     print(text, file=sys.stderr if rc == 2 else sys.stdout)
     return rc
@@ -937,6 +938,120 @@ def cmd_engine(args: argparse.Namespace) -> int:
                       f"ticks={r.get('decode_ticks')} "
                       f"ttft={r.get('ttft_ms', 0):.1f}ms "
                       f"tpot={r.get('tpot_ms', 0):.2f}ms{rid_note}")
+    return 0
+
+
+def cmd_rlhf(args: argparse.Namespace) -> int:
+    """rt rlhf stats: the RLHF pipeline flight-recorder plane
+    (util/pipeline_recorder.py). The driver's drain thread pushes an
+    @rlhf/ KV snapshot (bubble/staleness/transfer rollup + iteration
+    record tail); this reads it straight off the GCS — so it works
+    POSTMORTEM, after the pipeline driver exited. A missing snapshot is
+    an ERROR here (exit 1), unlike `rt engine stats`: you run this to
+    grade a pipeline, and grading nothing is a mistake worth failing."""
+    gcs = _resolve_gcs(args.address)
+    if gcs is None:
+        print("rt rlhf: no running cluster found (pass --address)",
+              file=sys.stderr)
+        return 1
+    try:
+        keys = _gcs_call(gcs, "kv_keys",
+                         {"prefix": "@rlhf/"}).get("keys") or []
+        snaps = []
+        for k in sorted(keys):
+            raw = _gcs_call(gcs, "kv_get", {"key": k}).get("value")
+            if not raw:
+                continue
+            try:
+                snaps.append(json.loads(raw))
+            except ValueError:
+                continue
+    except Exception as e:  # noqa: BLE001 — one line, no stack trace
+        print(f"rt rlhf: cannot reach GCS at {gcs}: "
+              f"{type(e).__name__}: {e}", file=sys.stderr)
+        return 1
+    if args.name:
+        snaps = [s for s in snaps
+                 if args.name in f"{s.get('node')}:{s.get('name')}"]
+    if not snaps:
+        what = (f"matching {args.name!r} " if args.name else "")
+        print(f"rt rlhf: no pipeline flight-recorder snapshot {what}"
+              f"under @rlhf/ (pipeline never ran, recorder closed, or "
+              f"RT_RLHF_RECORDER=0)", file=sys.stderr)
+        return 1
+    if args.json:
+        print(json.dumps(snaps, indent=2, default=str))
+        return 0
+    now = time.time()
+    for s in snaps:
+        label = f"{s.get('node')}:{s.get('pid')}:{s.get('name')}"
+        summ = s.get("summary") or {}
+        age = max(0.0, now - (s.get("t") or now))
+        print(f"rlhf {label}  (snapshot {age:.0f}s old)")
+        stale = summ.get("staleness") or {}
+        print(f"  iterations {summ.get('iterations_total', 0)} "
+              f"({summ.get('interrupted_total', 0)} interrupted)  "
+              f"tokens {summ.get('tokens', 0)}  bubble "
+              f"{summ.get('bubble_fraction', 0):.3f} (last "
+              f"{summ.get('bubble_last', 0):.3f})  coverage "
+              f"{summ.get('coverage', 0):.3f}  staleness last "
+              f"{stale.get('last', 0)} p99 {stale.get('p99', 0)} "
+              f"max {stale.get('max', 0)}")
+        busy = summ.get("role_busy_frac") or {}
+        if busy:
+            parts = "  ".join(f"{r}={100 * v:.0f}%"
+                              for r, v in busy.items())
+            print(f"  role busy share of pipeline span: {parts}")
+        actor = summ.get("actor_s") or {}
+        driver = summ.get("driver_s") or {}
+        tax = summ.get("tax_s") or {}
+        if driver:
+            parts = "  ".join(
+                f"{p}={1e3 * driver.get(p, 0):.0f}ms"
+                f"(tax {1e3 * tax.get(p, 0):.0f}ms)" for p in driver)
+            print(f"  driver phases (orchestration tax): {parts}")
+        if actor:
+            parts = "  ".join(f"{p}={1e3 * v:.0f}ms"
+                              for p, v in actor.items())
+            print(f"  actor phases: {parts}")
+        rcpt = summ.get("receipt_last") or {}
+        if rcpt:
+            print(f"  transfer[v{rcpt.get('version', 0)} "
+                  f"{rcpt.get('transport', '?')}]: "
+                  f"{rcpt.get('nbytes', 0) / 1e6:.2f}MB "
+                  f"{rcpt.get('n_leaves', 0)} leaves "
+                  f"({rcpt.get('oid_leaves', 0)} oid / "
+                  f"{rcpt.get('inline_leaves', 0)} inline)  pump "
+                  f"{1e3 * rcpt.get('pump_wall_s', 0):.1f}ms  fetch "
+                  f"{1e3 * rcpt.get('fetch_wall_s', 0):.1f}ms  barrier "
+                  f"{1e3 * rcpt.get('barrier_drain_s', 0):.1f}ms  swap "
+                  f"{1e3 * rcpt.get('swap_apply_s', 0):.2f}ms")
+        intr = summ.get("interrupted_last")
+        if intr:
+            when = time.strftime("%H:%M:%S",
+                                 time.localtime(intr.get("t", 0)))
+            gaps = summ.get("restart_gaps_s") or []
+            gap_note = (f"  restart gap {gaps[-1]:.2f}s"
+                        if gaps else "")
+            print(f"  last interrupt: {intr.get('phase')} @ {when} "
+                  f"({intr.get('error', '')[:60]}){gap_note}")
+        print(f"  recorder overhead "
+              f"{100 * summ.get('overhead_frac', 0):.3f}% of iteration "
+              f"wall")
+        for r in (s.get("iterations") or [])[-args.limit:]:
+            when = time.strftime("%H:%M:%S",
+                                 time.localtime(r.get("t", 0)))
+            if r.get("state") == "interrupted":
+                print(f"  {when} #{r.get('seq'):<4} INTERRUPTED in "
+                      f"{r.get('phase')} ({r.get('error', '')[:50]})")
+                continue
+            gap = (f" gap={r['restart_gap_s']:.2f}s"
+                   if "restart_gap_s" in r else "")
+            print(f"  {when} #{r.get('seq'):<4} iter "
+                  f"{r.get('iteration')} wall={r.get('wall_ms', 0):.0f}"
+                  f"ms bubble={r.get('bubble_fraction', 0):.3f} "
+                  f"cov={r.get('coverage', 0):.2f} "
+                  f"stale={r.get('staleness', 0)}{gap}")
     return 0
 
 
@@ -1262,6 +1377,10 @@ def main(argv=None) -> int:
     p_doc.add_argument("--slo-warn", type=float, default=0.9,
                        help="engine TTFT/TPOT SLO-attainment ratio below "
                             "which a loaded engine is graded degraded")
+    p_doc.add_argument("--bubble-warn", type=float, default=0.75,
+                       help="RLHF pipeline bubble fraction that, "
+                            "sustained over 3 iterations, grades the "
+                            "dataflow as phase-serialized waste")
     p_doc.add_argument("--json", action="store_true")
     p_doc.set_defaults(fn=cmd_doctor)
 
@@ -1282,6 +1401,24 @@ def main(argv=None) -> int:
         pe.add_argument("--json", action="store_true")
     p_eng.set_defaults(fn=cmd_engine)
 
+    p_rlhf_top = sub.add_parser(
+        "rlhf",
+        help="RLHF pipeline flight recorder: per-role bubble "
+             "attribution, orchestration tax, staleness and transfer "
+             "receipts (@rlhf/ KV snapshots, util/pipeline_recorder.py)")
+    rlhf_sub = p_rlhf_top.add_subparsers(dest="rlhf_cmd", required=True)
+    pr_stats = rlhf_sub.add_parser(
+        "stats", help="per-pipeline bubble/staleness/transfer rollup "
+                      "(works postmortem — reads the GCS snapshot)")
+    pr_stats.add_argument("--address", default=None)
+    pr_stats.add_argument("--name", default=None,
+                          help="only pipelines whose node:name contains "
+                               "this")
+    pr_stats.add_argument("--limit", type=int, default=8,
+                          help="iteration-record tail to render")
+    pr_stats.add_argument("--json", action="store_true")
+    p_rlhf_top.set_defaults(fn=cmd_rlhf)
+
     p_trace = sub.add_parser(
         "trace",
         help="span tree + per-phase latency tables for a task or trace "
@@ -1295,7 +1432,14 @@ def main(argv=None) -> int:
     args = parser.parse_args(argv)
     if args.cmd == "start" and not args.head and not args.address:
         parser.error("rt start needs --head or --address=<gcs>")
-    return args.fn(args)
+    try:
+        return args.fn(args)
+    except BrokenPipeError:
+        # downstream reader (grep -q, head) closed the pipe after it got
+        # what it wanted — success, not failure; repoint stdout at
+        # /dev/null so the interpreter's exit-time flush can't re-raise
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        return 0
 
 
 if __name__ == "__main__":

@@ -13,7 +13,10 @@ direct GCS/raylet RPCs, so it works against a wedged cluster too):
   - scheduler pressure: per-node raylet queue depth,
   - the engine plane: flight-recorder snapshots (``@engine/`` KV,
     ``util/engine_recorder.py``) — sustained decode tick-gap and
-    TTFT/TPOT SLO-attainment findings at nonzero load.
+    TTFT/TPOT SLO-attainment findings at nonzero load,
+  - the RLHF plane: pipeline flight-recorder snapshots (``@rlhf/`` KV,
+    ``util/pipeline_recorder.py``) — sustained bubble-fraction findings
+    (role-seconds idling while another role works).
 
 Exit codes: 0 healthy, 1 unhealthy (any critical finding), 2 cluster
 unreachable. ``collect()`` returns the raw report; ``diagnose()`` turns it
@@ -137,6 +140,27 @@ async def _collect_async(gcs_address: str, window_s: float,
         except Exception:  # noqa: BLE001 — engine plane optional
             pass
 
+        # RLHF plane: each pipeline driver's flight recorder pushes a
+        # compact @rlhf/ snapshot (util/pipeline_recorder.py); stale
+        # ones (finished/crashed driver) are skipped at diagnose time
+        rlhf: List[Dict] = []
+        try:
+            keys = (await gcs.call("kv_keys", {"prefix": "@rlhf/"},
+                                   timeout=10.0))["keys"]
+            replies = await asyncio.gather(
+                *(gcs.call("kv_get", {"key": k}, timeout=10.0)
+                  for k in keys[:50]))
+            for reply in replies:
+                raw = reply.get("value")
+                if not raw:
+                    continue
+                try:
+                    rlhf.append(json.loads(raw))
+                except ValueError:
+                    continue
+        except Exception:  # noqa: BLE001 — RLHF plane optional
+            pass
+
         # serve plane: the controller pushes a compact status snapshot to
         # the KV every reconcile tick (serve/controller.py) — readable
         # here without attaching a driver
@@ -153,7 +177,8 @@ async def _collect_async(gcs_address: str, window_s: float,
                 "window_s": window_s, "nodes": probed, "actors": actors,
                 "failures": failures, "oom_kills": ooms,
                 "ledgers": ledgers, "serve": serve_status,
-                "engines": engines, "sched_balance": sched_balance}
+                "engines": engines, "rlhf": rlhf,
+                "sched_balance": sched_balance}
     finally:
         try:
             await gcs.close()
@@ -181,7 +206,8 @@ def diagnose(report: Dict[str, Any],
              serve_p99_warn_s: float = 5.0,
              imbalance_warn: float = 0.5,
              tick_gap_warn_s: float = 0.5,
-             slo_warn: float = 0.9) -> List[Tuple[str, str]]:
+             slo_warn: float = 0.9,
+             bubble_warn: float = 0.75) -> List[Tuple[str, str]]:
     """Turn the raw report into ranked ``(level, message)`` findings.
     Any CRITICAL finding makes the cluster unhealthy (exit 1)."""
     findings: List[Tuple[str, str]] = []
@@ -384,6 +410,41 @@ def diagnose(report: Dict[str, Any],
                                      f"{s['window_completed']} completed "
                                      f"request(s); see `rt engine stats`)"))
 
+    # -- RLHF flight recorder (@rlhf/ snapshots) -----------------------------
+    # SUSTAINED bubble only: one bubbly iteration is warm-up noise; the
+    # last three per-iteration bubble fractions all above the threshold
+    # means the dataflow is running phase-serialized waste iteration
+    # after iteration. Stale snapshots (finished driver) are skipped.
+    for snap in report.get("rlhf") or ():
+        if now - snap.get("t", 0.0) > 30.0:
+            continue
+        s = snap.get("summary") or {}
+        label = (f"{str(snap.get('node', '?'))[:12]}:"
+                 f"{snap.get('name', 'rlhf')}")
+        recent_b = (s.get("bubble_recent") or [])[-3:]
+        if len(recent_b) >= 3 and all(b > bubble_warn for b in recent_b):
+            idle = s.get("role_idle_frac") or {}
+            worst = max(idle, key=idle.get) if idle else "?"
+            findings.append((WARN,
+                             f"rlhf pipeline {label} bubble fraction "
+                             f"sustained at {max(recent_b):.2f} (> "
+                             f"{bubble_warn:.2f} over {len(recent_b)} "
+                             f"iterations — roles idle while others "
+                             f"work; idlest role: {worst}; see "
+                             f"`rt rlhf stats`)"))
+        if (s.get("interrupted_total") or 0) > 0 \
+                and s.get("interrupted_last") \
+                and now - s["interrupted_last"].get("t", 0.0) <= window_s \
+                and not s.get("restart_gaps_s"):
+            # an interrupt with NO later successful iteration = the
+            # pipeline died mid-phase and never recovered
+            intr = s["interrupted_last"]
+            findings.append((WARN,
+                             f"rlhf pipeline {label} interrupted in "
+                             f"phase {intr.get('phase')!r} with no "
+                             f"completed iteration since (see `rt rlhf "
+                             f"stats`)"))
+
     # -- leak suspects (memory plane) ----------------------------------------
     try:
         from ray_tpu.util.memory import (_merge_owner_info,
@@ -444,7 +505,8 @@ def format_report(report: Dict[str, Any],
 def run(gcs_address: str, window_s: float = 600.0, queue_warn: int = 100,
         queue_wait_warn_s: float = 10.0, serve_p99_warn_s: float = 5.0,
         imbalance_warn: float = 0.5, tick_gap_warn_s: float = 0.5,
-        slo_warn: float = 0.9, as_json: bool = False
+        slo_warn: float = 0.9, bubble_warn: float = 0.75,
+        as_json: bool = False
         ) -> Tuple[str, int]:
     """Collect + diagnose + render; returns (text, exit_code). Exit 2 when
     the GCS itself is unreachable."""
@@ -458,7 +520,7 @@ def run(gcs_address: str, window_s: float = 600.0, queue_warn: int = 100,
                         serve_p99_warn_s=serve_p99_warn_s,
                         imbalance_warn=imbalance_warn,
                         tick_gap_warn_s=tick_gap_warn_s,
-                        slo_warn=slo_warn)
+                        slo_warn=slo_warn, bubble_warn=bubble_warn)
     if as_json:
         rc = exit_code(findings)
         payload = dict(report,

@@ -130,6 +130,7 @@ class EngineRecorder:
         self._overhead_s = 0.0  # rt: guarded-by(_lock)
         self._wall_total_s = 0.0  # rt: guarded-by(_lock)
         self._swaps = 0  # rt: guarded-by(_lock)
+        self._last_swap: Optional[Dict[str, Any]] = None  # rt: guarded-by(_lock)
         self._requests_total = 0  # rt: guarded-by(_lock)
         self._cancelled_total = 0  # rt: guarded-by(_lock)
         # drain-side watermarks (drain thread only; the lock still guards
@@ -255,6 +256,11 @@ class EngineRecorder:
             return
         with self._lock:
             self._swaps += 1
+            # the swap-barrier join: the RLHF transfer receipt reads this
+            # back so one record shows ship -> fetch -> barrier -> swap
+            self._last_swap = {"t": time.time(),
+                               "apply_s": round(apply_s, 6),
+                               "drained_reqs": int(drained_reqs)}
 
     def set_slo(self, *, ttft_slo_s: Optional[float] = None,
                 tpot_slo_s: Optional[float] = None) -> None:
@@ -290,6 +296,8 @@ class EngineRecorder:
             base = {"requests_total": self._requests_total,
                     "cancelled_total": self._cancelled_total,
                     "swaps": self._swaps, "ticks_total": self._tick_seq}
+            if self._last_swap is not None:
+                base["last_swap"] = dict(self._last_swap)
         out = self._aggregate(ticks, window)
         out.update(base)
         out["name"] = self.name

@@ -1,0 +1,601 @@
+"""RLHF dataflow flight recorder: per-role bubble attribution,
+weight-plane transfer receipts, and staleness accounting for
+``RLHFPipeline``.
+
+The strict-phase RLHF pipeline (generate → score → update → sync) is the
+one open ROADMAP item with no measurement substrate: `rt_rlhf_phase_seconds`
+is stamped driver-side only, so nothing says how much ROLE time is wasted
+while one role works and three idle — the scaling waste the adaptive-
+placement RLHF paper (arxiv 2312.11819) and MindSpeed RL's disaggregated
+dataflow analysis (arxiv 2507.19017) both identify. This module is the
+lens the interleave arc will be judged against.
+
+What one ITERATION record holds:
+
+  intervals   per-role phase intervals stamped ACTOR-SIDE inside each
+              role's method (generate / score_ref / score_reward /
+              update / ship / sync_swap), joined to the driver's record
+  driver_s    the driver-observed wall per driver phase (generate /
+              score / update / ship / sync_swap)
+  tax_s       orchestration tax per phase: driver wall minus actor wall
+              (RPC submit/get, serialization, scheduling — what
+              `rt_rlhf_phase_seconds` used to silently conflate)
+  bubble      role-seconds idle while ANY other role works ÷ total
+              role-seconds over the busy span (interval sweep — the
+              strict-phase pipeline's headline waste number)
+  staleness   learner weights-version minus the version the generate
+              batch decoded under (strict phases measure 0; the
+              interleave arc trades bounded staleness for throughput)
+  receipt     the joined weight-plane transfer record:
+              ship→fetch→barrier→swap in one dict (bytes, leaves,
+              inline-vs-oid frames, transport push/fallback, pump wall,
+              fetch/drain wall, drain-barrier wall, swap apply wall)
+
+Discipline (the engine recorder's, verbatim): the driver path ONLY
+appends to bounded in-process deques under a microsecond lock — metrics
+observation, the ``@rlhf/`` KV snapshot and the timeline event push all
+happen on a separate drain thread. The recorder times itself:
+``overhead_s`` accumulates wall spent inside recorder calls and
+``summary()`` reports it as a fraction of recorded iteration wall (the
+bench gate holds it ≤ 2%).
+
+Disable with ``RT_RLHF_RECORDER=0`` — every hook then costs one
+predicate check per iteration.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import OrderedDict, deque
+from typing import Any, Dict, List, Optional
+
+_ENABLED_DEFAULT = os.environ.get("RT_RLHF_RECORDER", "1") \
+    not in ("", "0", "false")
+_CAP = int(os.environ.get("RT_RLHF_RECORDER_CAP", "1024"))
+_DRAIN_S = float(os.environ.get("RT_RLHF_DRAIN_S", "2.0"))
+_KV_PREFIX = "@rlhf/"
+
+#: canonical actor-side phase vocabulary, in strict-phase order (the
+#: timeline role lanes and ``rt rlhf stats`` render phases in this order)
+PIPE_PHASES = ("generate", "score_ref", "score_reward", "update",
+               "ship", "sync_swap")
+
+#: which role executes each phase (one lane per role in the timeline)
+PHASE_ROLE = {"generate": "generator", "score_ref": "reference",
+              "score_reward": "reward", "update": "learner",
+              "ship": "learner", "sync_swap": "generator"}
+
+#: the driver's phase vocabulary and which actor phases each one covers
+#: (the driver's "score" wall spans BOTH parallel scoring roles, so its
+#: orchestration tax is measured against their union span)
+DRIVER_PHASES = ("generate", "score", "update", "ship", "sync_swap")
+DRIVER_PHASE_ACTORS = {"generate": ("generate",),
+                       "score": ("score_ref", "score_reward"),
+                       "update": ("update",),
+                       "ship": ("ship",),
+                       "sync_swap": ("sync_swap",)}
+
+ROLES = ("generator", "reference", "reward", "learner")
+
+_recorders: "OrderedDict[int, Any]" = OrderedDict()  # rt: guarded-by(_recorders_lock)
+_recorders_lock = threading.Lock()
+
+
+def live_recorders() -> List["PipelineRecorder"]:
+    """Every recorder constructed in this process and not yet closed."""
+    with _recorders_lock:
+        return list(_recorders.values())
+
+
+def _pct(sorted_vals: List[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, int(q * (len(sorted_vals) - 1) + 0.5))
+    return sorted_vals[idx]
+
+
+def bubble_attribution(intervals: List[Dict[str, Any]],
+                       roles: Optional[List[str]] = None) -> Dict[str, Any]:
+    """Interval-sweep bubble accounting over one iteration's role
+    intervals (``{"role", "phase", "t0", "t1"}`` each).
+
+    Over every elementary segment where AT LEAST one role is busy, a
+    role not busy in that segment contributes idle role-seconds — the
+    pipeline bubble. ``bubble_fraction`` = idle role-seconds ÷ total
+    role-seconds over the busy span. A perfectly overlapped pipeline
+    scores 0.0; a 4-role strict-phase pipeline whose score phase runs
+    two roles concurrently lands around 0.7.
+    """
+    role_list = list(roles) if roles else sorted(
+        {iv["role"] for iv in intervals}) or list(ROLES)
+    n_roles = max(1, len(role_list))
+    by_role: Dict[str, List] = {r: [] for r in role_list}
+    points: List[float] = []
+    for iv in intervals:
+        t0, t1 = float(iv["t0"]), float(iv["t1"])
+        if t1 <= t0 or iv["role"] not in by_role:
+            continue
+        by_role[iv["role"]].append((t0, t1))
+        points.append(t0)
+        points.append(t1)
+    points = sorted(set(points))
+    busy_s = {r: 0.0 for r in role_list}
+    idle_s = {r: 0.0 for r in role_list}
+    span_busy = 0.0
+    bubble = 0.0
+    for a, b in zip(points, points[1:]):
+        seg = b - a
+        if seg <= 0:
+            continue
+        busy = [r for r in role_list
+                if any(t0 <= a and b <= t1 for t0, t1 in by_role[r])]
+        if not busy:
+            continue
+        span_busy += seg
+        for r in role_list:
+            if r in busy:
+                busy_s[r] += seg
+            else:
+                idle_s[r] += seg
+                bubble += seg
+    total_role_s = n_roles * span_busy
+    return {
+        "bubble_fraction": round(bubble / total_role_s, 4)
+        if total_role_s > 0 else 0.0,
+        "bubble_role_s": round(bubble, 6),
+        "total_role_s": round(total_role_s, 6),
+        "span_busy_s": round(span_busy, 6),
+        "role_busy_s": {r: round(v, 6) for r, v in busy_s.items()},
+        "role_idle_s": {r: round(v, 6) for r, v in idle_s.items()},
+    }
+
+
+class PipelineRecorder:
+    """Bounded flight recorder for one ``RLHFPipeline``.
+
+    The DRIVER THREAD is the only writer (`record_iteration` /
+    `record_interrupt` fire from `run_iteration`); the drain thread only
+    reads. All shared state lives behind one lock held for O(1) appends
+    plus a ~10-interval sweep — never across an RPC or a metrics
+    observation.
+    """
+
+    def __init__(self, name: str = "rlhf", *, cap: int = _CAP,
+                 enabled: Optional[bool] = None):
+        self.name = name or "rlhf"
+        self.enabled = _ENABLED_DEFAULT if enabled is None else bool(enabled)
+        cap = max(64, int(cap))
+        self._lock = threading.Lock()
+        self._iters: "deque[Dict[str, Any]]" = deque(maxlen=cap)  # rt: guarded-by(_lock)
+        self._seq = 0  # rt: guarded-by(_lock)
+        self._overhead_s = 0.0  # rt: guarded-by(_lock)
+        self._wall_total_s = 0.0  # rt: guarded-by(_lock)
+        self._interrupted_total = 0  # rt: guarded-by(_lock)
+        self._last_interrupt_t: Optional[float] = None  # rt: guarded-by(_lock)
+        # drain-side watermarks (drain thread only; the lock still guards
+        # the snapshot reads that feed them)
+        self._metrics_wm = 0
+        self._event_wm = 0
+        self._closed = False  # rt: guarded-by(_lock)
+        self._drainer: Optional[threading.Thread] = None  # rt: guarded-by(_lock)
+        self._kv_key = f"{_KV_PREFIX}{os.uname().nodename}:{os.getpid()}:" \
+                       f"{self.name}"
+        with _recorders_lock:
+            _recorders[id(self)] = self
+            while len(_recorders) > 64:  # bound the registry itself
+                _recorders.popitem(last=False)
+
+    # -- driver path -------------------------------------------------------
+
+    def record_iteration(self, *, iteration: int, t0: float, wall_s: float,
+                         intervals: List[Dict[str, Any]],
+                         driver_s: Dict[str, float],
+                         tokens: int = 0,
+                         learner_version: int = 0,
+                         decoded_version: int = 0,
+                         receipt: Optional[Dict[str, Any]] = None
+                         ) -> Dict[str, Any]:
+        """One completed pipeline iteration: the driver's record joined
+        with the actor-side intervals every role stamped. Appends to a
+        bounded deque plus one O(k log k) sweep over ~10 intervals — no
+        metrics, no I/O (drained off-thread). Returns the derived fields
+        (bubble / coverage / tax / staleness) so the driver can surface
+        them in its own result dict without recomputing."""
+        if not self.enabled:
+            return {}
+        t_in = time.perf_counter()
+        actor_s = {p: 0.0 for p in PIPE_PHASES}
+        for iv in intervals:
+            w = iv.get("wall_s")
+            if w is None:
+                w = max(0.0, float(iv["t1"]) - float(iv["t0"]))
+            actor_s[iv["phase"]] = actor_s.get(iv["phase"], 0.0) + float(w)
+        tax_s: Dict[str, float] = {}
+        for p, dv in driver_s.items():
+            sub = [iv for iv in intervals
+                   if iv["phase"] in DRIVER_PHASE_ACTORS.get(p, (p,))]
+            span = max(float(iv["t1"]) for iv in sub) \
+                - min(float(iv["t0"]) for iv in sub) if sub else 0.0
+            tax_s[p] = round(max(0.0, float(dv) - span), 6)
+        bub = bubble_attribution(intervals, roles=list(ROLES))
+        coverage = round(bub["span_busy_s"] / wall_s, 4) if wall_s > 0 \
+            else 0.0
+        staleness = max(0, int(learner_version) - int(decoded_version))
+        rec = {"t": t0, "t1": t0 + wall_s, "wall_s": round(wall_s, 6),
+               "state": "ok", "iteration": int(iteration),
+               "tokens": int(tokens),
+               "learner_version": int(learner_version),
+               "decoded_version": int(decoded_version),
+               "staleness": staleness,
+               "intervals": [{"role": iv["role"], "phase": iv["phase"],
+                              "t0": float(iv["t0"]), "t1": float(iv["t1"])}
+                             for iv in intervals],
+               "actor_s": {p: round(v, 6) for p, v in actor_s.items()
+                           if v > 0.0},
+               "driver_s": {p: round(float(v), 6)
+                            for p, v in driver_s.items()},
+               "tax_s": tax_s,
+               "bubble_fraction": bub["bubble_fraction"],
+               "coverage": coverage,
+               "role_busy_s": bub["role_busy_s"],
+               "role_idle_s": bub["role_idle_s"],
+               "span_busy_s": bub["span_busy_s"]}
+        if receipt:
+            rec["receipt"] = dict(receipt)
+        with self._lock:
+            if self._last_interrupt_t is not None:
+                rec["restart_gap_s"] = round(
+                    max(0.0, t0 - self._last_interrupt_t), 6)
+                self._last_interrupt_t = None
+            self._seq += 1
+            rec["seq"] = self._seq
+            self._iters.append(rec)
+            self._wall_total_s += wall_s
+            self._overhead_s += time.perf_counter() - t_in
+        self._ensure_drainer()
+        return {"bubble_fraction": rec["bubble_fraction"],
+                "coverage": coverage, "staleness": staleness,
+                "tax_s": tax_s,
+                "restart_gap_s": rec.get("restart_gap_s")}
+
+    def record_interrupt(self, *, phase: str, t: float,
+                         error: str = "") -> None:
+        """An iteration died mid-phase (chaos kill, actor crash): stamp
+        the interrupted phase so the postmortem snapshot names where the
+        pipeline stopped. The next successful iteration stamps its
+        ``restart_gap_s`` against this timestamp."""
+        if not self.enabled:
+            return
+        t_in = time.perf_counter()
+        rec = {"t": t, "state": "interrupted", "phase": phase,
+               "error": str(error)[:200]}
+        with self._lock:
+            self._seq += 1
+            rec["seq"] = self._seq
+            self._iters.append(rec)
+            self._interrupted_total += 1
+            self._last_interrupt_t = t
+            self._overhead_s += time.perf_counter() - t_in
+        self._ensure_drainer()
+
+    # -- derived accounting ------------------------------------------------
+
+    def iterations(self, limit: int = 0) -> List[Dict[str, Any]]:
+        with self._lock:
+            out = list(self._iters)
+        return out[-limit:] if limit else out
+
+    def summary(self) -> Dict[str, Any]:
+        """The strict-phase waste picture: what ``rt rlhf stats``, the
+        doctor bubble finding and the gauges read."""
+        with self._lock:
+            recs = list(self._iters)
+            overhead = self._overhead_s
+            wall_total = self._wall_total_s
+            interrupted = self._interrupted_total
+            total = self._seq
+        ok = [r for r in recs if r["state"] == "ok"]
+        out: Dict[str, Any] = {"name": self.name,
+                               "iterations_total": total,
+                               "interrupted_total": interrupted,
+                               "window_iterations": len(ok)}
+        actor_tot = {p: 0.0 for p in PIPE_PHASES}
+        driver_tot: Dict[str, float] = {}
+        tax_tot: Dict[str, float] = {}
+        busy_tot = {r: 0.0 for r in ROLES}
+        idle_tot = {r: 0.0 for r in ROLES}
+        span_tot = 0.0
+        bubbles: List[float] = []
+        coverages: List[float] = []
+        stalenesses: List[int] = []
+        gaps: List[float] = []
+        tokens = 0
+        receipt_last = None
+        for r in ok:
+            for p, v in r["actor_s"].items():
+                actor_tot[p] = actor_tot.get(p, 0.0) + v
+            for p, v in r["driver_s"].items():
+                driver_tot[p] = driver_tot.get(p, 0.0) + v
+            for p, v in r["tax_s"].items():
+                tax_tot[p] = tax_tot.get(p, 0.0) + v
+            for role, v in r["role_busy_s"].items():
+                busy_tot[role] = busy_tot.get(role, 0.0) + v
+            for role, v in r["role_idle_s"].items():
+                idle_tot[role] = idle_tot.get(role, 0.0) + v
+            span_tot += r["span_busy_s"]
+            bubbles.append(r["bubble_fraction"])
+            coverages.append(r["coverage"])
+            stalenesses.append(r["staleness"])
+            tokens += r["tokens"]
+            if "restart_gap_s" in r:
+                gaps.append(r["restart_gap_s"])
+            if "receipt" in r:
+                receipt_last = r["receipt"]
+        out["tokens"] = tokens
+        out["actor_s"] = {p: round(v, 6) for p, v in actor_tot.items()
+                          if v > 0.0}
+        out["driver_s"] = {p: round(v, 6) for p, v in driver_tot.items()}
+        out["tax_s"] = {p: round(v, 6) for p, v in tax_tot.items()}
+        if span_tot > 0:
+            out["role_busy_frac"] = {r: round(busy_tot[r] / span_tot, 4)
+                                     for r in busy_tot}
+            out["role_idle_frac"] = {r: round(idle_tot[r] / span_tot, 4)
+                                     for r in idle_tot}
+        if ok:
+            out["bubble_fraction"] = round(sum(bubbles) / len(bubbles), 4)
+            out["bubble_last"] = bubbles[-1]
+            # the doctor's "sustained" signal: the last few per-iteration
+            # bubble fractions, newest last
+            out["bubble_recent"] = bubbles[-8:]
+            out["coverage"] = round(sum(coverages) / len(coverages), 4)
+            srt = sorted(stalenesses)
+            out["staleness"] = {"last": stalenesses[-1],
+                                "p50": _pct(srt, 0.50),
+                                "p99": _pct(srt, 0.99),
+                                "max": srt[-1]}
+        if gaps:
+            out["restart_gaps_s"] = [round(g, 4) for g in gaps[-4:]]
+        if receipt_last:
+            out["receipt_last"] = receipt_last
+        last_int = [r for r in recs if r["state"] == "interrupted"]
+        if last_int:
+            out["interrupted_last"] = {"phase": last_int[-1]["phase"],
+                                       "t": last_int[-1]["t"],
+                                       "error": last_int[-1]["error"]}
+        out["overhead_s"] = round(overhead, 6)
+        out["recorded_wall_s"] = round(wall_total, 6)
+        out["overhead_frac"] = round(overhead / wall_total, 6) \
+            if wall_total > 0 else 0.0
+        return out
+
+    def snapshot(self, iters_limit: int = 32) -> Dict[str, Any]:
+        """The ``@rlhf/`` KV payload: summary + iteration-record tail,
+        compact enough to push every couple of seconds."""
+        return {"t": time.time(), "name": self.name,
+                "node": os.uname().nodename, "pid": os.getpid(),
+                "summary": self.summary(),
+                "iterations": [self._compact_iter(r)
+                               for r in self.iterations(iters_limit)]}
+
+    @staticmethod
+    def _compact_iter(r: Dict[str, Any]) -> Dict[str, Any]:
+        if r["state"] == "interrupted":
+            return {"seq": r["seq"], "t": round(r["t"], 4),
+                    "state": "interrupted", "phase": r["phase"],
+                    "error": r["error"]}
+        out = {"seq": r["seq"], "t": round(r["t"], 4),
+               "state": "ok", "iteration": r["iteration"],
+               "wall_ms": round(r["wall_s"] * 1e3, 3),
+               "bubble_fraction": r["bubble_fraction"],
+               "coverage": r["coverage"], "staleness": r["staleness"],
+               "tokens": r["tokens"],
+               "actor_ms": {p: round(v * 1e3, 3)
+                            for p, v in r["actor_s"].items()},
+               "tax_ms": {p: round(v * 1e3, 3)
+                          for p, v in r["tax_s"].items()}}
+        if "restart_gap_s" in r:
+            out["restart_gap_s"] = r["restart_gap_s"]
+        if "receipt" in r:
+            out["receipt"] = r["receipt"]
+        return out
+
+    # -- off-driver drain --------------------------------------------------
+
+    def _ensure_drainer(self) -> None:
+        if self._drainer is not None and self._drainer.is_alive():
+            return
+        with self._lock:
+            if self._closed or (self._drainer is not None
+                                and self._drainer.is_alive()):
+                return
+            self._drainer = threading.Thread(
+                target=self._drain_loop, daemon=True,
+                name=f"rt-rlhf-rec:{self.name}")
+            self._drainer.start()
+
+    def _drain_loop(self) -> None:
+        while True:
+            time.sleep(_DRAIN_S)
+            with self._lock:
+                if self._closed:
+                    return
+            try:
+                self.drain_now()
+            except Exception:  # noqa: BLE001 — observability must never
+                pass           # take the pipeline down
+
+    def drain_now(self) -> Dict[str, int]:
+        """One drain pass (tests call this instead of waiting out the
+        interval): metrics observation, the ``@rlhf/`` KV snapshot, and
+        iteration events into the GCS task-event store."""
+        counts = {"metrics": self._drain_metrics()}
+        counts.update(self._drain_gcs())
+        return counts
+
+    def _pending_since(self, wm_attr: str) -> List[Dict]:
+        with self._lock:
+            wm = getattr(self, wm_attr)
+            return [r for r in self._iters if r.get("seq", 0) > wm]
+
+    def _drain_metrics(self) -> int:
+        try:
+            from ray_tpu.util import metrics as M
+        except Exception:  # noqa: BLE001
+            return 0
+        h = _metric_handles(M)
+        tags = {"pipeline": self.name}
+        new = self._pending_since("_metrics_wm")
+        for r in new:
+            if r["state"] != "ok":
+                continue
+            for p, v in r["tax_s"].items():
+                h["tax"].observe(v, tags={"pipeline": self.name,
+                                          "phase": p})
+            h["staleness"].observe(float(r["staleness"]), tags=tags)
+            rcpt = r.get("receipt") or {}
+            for stage, key in (("pump", "pump_wall_s"),
+                               ("fetch", "fetch_wall_s"),
+                               ("barrier", "barrier_drain_s"),
+                               ("swap", "swap_apply_s")):
+                v = rcpt.get(key)
+                if v is not None:
+                    h["transfer"].observe(float(v),
+                                          tags={"pipeline": self.name,
+                                                "stage": stage})
+        if new:
+            self._metrics_wm = new[-1]["seq"]
+        summ = self.summary()
+        if summ.get("window_iterations"):
+            h["bubble"].set(summ["bubble_last"], tags=tags)
+            for role, v in summ.get("role_idle_frac", {}).items():
+                h["idle"].set(v, tags={"pipeline": self.name,
+                                       "role": role})
+            h["overhead"].set(summ["overhead_frac"], tags=tags)
+        return len(new)
+
+    def _drain_gcs(self) -> Dict[str, int]:
+        """KV snapshot + timeline events; both best-effort, both skipped
+        cleanly outside an initialized cluster runtime."""
+        out = {"kv": 0, "events": 0}
+        try:
+            import ray_tpu
+
+            if not ray_tpu.is_initialized():
+                return out
+            backend = ray_tpu.global_worker()._require_backend()
+        except Exception:  # noqa: BLE001
+            return out
+        try:
+            if hasattr(backend, "kv_put"):
+                backend.kv_put(self._kv_key,
+                               json.dumps(self.snapshot()).encode())
+                out["kv"] = 1
+        except Exception:  # noqa: BLE001
+            pass
+        if not hasattr(backend, "_gcs"):
+            return out
+        node = os.uname().nodename
+        pid = os.getpid()
+        events = []
+        new = self._pending_since("_event_wm")
+        for r in new[-128:]:
+            if r["state"] == "interrupted":
+                events.append({
+                    "task_id": f"rlhfit:{node}:{pid}:{self.name}:"
+                               f"{r['seq']}",
+                    "name": f"rlhf:{self.name}:interrupt",
+                    "state": "FAILED", "node_id": node,
+                    "times": {"RUNNING": r["t"], "FAILED": r["t"]},
+                    "rlhf_iter": {**r, "pipeline": self.name}})
+                continue
+            events.append({
+                "task_id": f"rlhfit:{node}:{pid}:{self.name}:{r['seq']}",
+                "name": f"rlhf:{self.name}:iter{r['iteration']}",
+                "state": "FINISHED", "node_id": node,
+                "times": {"RUNNING": r["t"], "FINISHED": r["t1"]},
+                "rlhf_iter": {**{k: v for k, v in r.items()
+                                 if k not in ("role_busy_s",
+                                              "role_idle_s")},
+                              "pipeline": self.name}})
+        if not events:
+            return out
+        try:
+            backend.io.run(backend._gcs.call("task_events",
+                                             {"events": events}))
+            self._event_wm = new[-1]["seq"]
+            out["events"] = len(events)
+        except Exception:  # noqa: BLE001
+            pass
+        return out
+
+    def close(self) -> None:
+        """Stop the drain thread and drop the KV snapshot (the doctor
+        must not grade a dead pipeline's numbers)."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+        with _recorders_lock:
+            _recorders.pop(id(self), None)
+        try:
+            import ray_tpu
+
+            if ray_tpu.is_initialized():
+                backend = ray_tpu.global_worker()._require_backend()
+                if hasattr(backend, "kv_del"):
+                    backend.kv_del(self._kv_key)
+        except Exception:  # noqa: BLE001
+            pass
+
+
+_metric_cache: Optional[Dict[str, Any]] = None
+_TAX_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                0.25, 0.5, 1.0)
+_STALE_BUCKETS = (0.0, 1.0, 2.0, 4.0, 8.0, 16.0)
+_XFER_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                 0.5, 1.0, 2.5, 5.0)
+
+
+def _metric_handles(M) -> Dict[str, Any]:
+    """Lazily registered ``rt_rlhf_*`` recorder series (drain thread
+    only)."""
+    global _metric_cache
+    if _metric_cache is None:
+        _metric_cache = {
+            "bubble": M.get_or_create(
+                M.Gauge, "rt_rlhf_bubble_fraction",
+                "Role-seconds idle while any other role works / total "
+                "role-seconds, last iteration (strict phases ~0.7; the "
+                "interleave arc drives this down)",
+                tag_keys=("pipeline",)),
+            "idle": M.get_or_create(
+                M.Gauge, "rt_rlhf_role_idle_fraction",
+                "Fraction of the pipeline's busy span each role spent "
+                "idle while another role worked, role= "
+                "(generator / reference / reward / learner)",
+                tag_keys=("pipeline", "role")),
+            "tax": M.get_or_create(
+                M.Histogram, "rt_rlhf_orchestration_tax_seconds",
+                "Driver-observed phase wall minus actor-side phase wall "
+                "(RPC submit/get + serialization + scheduling), phase=",
+                boundaries=_TAX_BUCKETS, tag_keys=("pipeline", "phase")),
+            "staleness": M.get_or_create(
+                M.Histogram, "rt_rlhf_staleness_versions",
+                "Learner weights-version minus the version each generate "
+                "batch decoded under (strict phases measure 0)",
+                boundaries=_STALE_BUCKETS, tag_keys=("pipeline",)),
+            "transfer": M.get_or_create(
+                M.Histogram, "rt_rlhf_transfer_seconds",
+                "Weight-plane transfer receipt walls, stage= "
+                "(pump / fetch / barrier / swap)",
+                boundaries=_XFER_BUCKETS, tag_keys=("pipeline", "stage")),
+            "overhead": M.get_or_create(
+                M.Gauge, "rt_rlhf_recorder_overhead_ratio",
+                "Recorder self-time as a fraction of recorded iteration "
+                "wall",
+                tag_keys=("pipeline",)),
+        }
+    return _metric_cache

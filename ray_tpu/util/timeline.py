@@ -24,6 +24,14 @@ prefill / decode_step / token_delivery / swap_barrier partition per
 tick, with decode tick-gap stalls as their own spans) and per-slot
 request lanes (queued + decode span per lifecycle) — a prefill burst
 starving decode is visible as a widening gap between decode launches.
+
+RLHF flight-recorder records (``util/pipeline_recorder.py``) export as
+``rlhf:<name>:*`` lanes: one PER-ROLE lane (generator / reference /
+reward / learner) carrying each role's actor-side phase intervals, plus
+an iteration lane with the driver's full-round span — the strict-phase
+bubble is literally visible as the white space on three role lanes while
+the fourth works, and an interrupted iteration (chaos kill) lands as an
+instant marker at the phase it died in.
 """
 
 from __future__ import annotations
@@ -53,6 +61,10 @@ def timeline(filename: Optional[str] = None) -> List[Dict[str, Any]]:
         ereq = ev.get("engine_request")
         if ereq:
             trace.extend(_engine_request_lanes(ev, ereq))
+            continue
+        rit = ev.get("rlhf_iter")
+        if rit:
+            trace.extend(_rlhf_iter_lanes(ev, rit))
             continue
         is_serve = str(ev.get("task_id", "")).startswith("serve:")
         times = ev.get("times", {})
@@ -286,6 +298,47 @@ def _engine_request_lanes(ev: Dict[str, Any], req: Dict[str, Any]
                  "tpot_s": req.get("tpot_s"),
                  "request_id": req.get("request_id")},
     })
+    return out
+
+
+def _rlhf_iter_lanes(ev: Dict[str, Any], rit: Dict[str, Any]
+                     ) -> List[Dict[str, Any]]:
+    """One RLHF pipeline iteration (util/pipeline_recorder.py) -> its
+    per-role lanes: each actor-side interval becomes a phase span on
+    ``rlhf:<name>:<role>``, the driver's full round lands on
+    ``rlhf:<name>:iters``, and an interrupted record becomes an instant
+    marker naming the phase it died in. Three idle role lanes under one
+    busy one IS the strict-phase bubble, visually."""
+    pid = ev.get("node_id") or "node"
+    name = rit.get("pipeline", "rlhf")
+    if rit.get("state") == "interrupted":
+        return [{"name": f"interrupt:{rit.get('phase', '?')}",
+                 "cat": "rlhf", "ph": "i", "s": "t",
+                 "ts": rit.get("t", 0.0) * 1e6,
+                 "pid": pid, "tid": f"rlhf:{name}:iters",
+                 "args": {"phase": rit.get("phase"),
+                          "error": rit.get("error")}}]
+    out = [{
+        "name": f"iter {rit.get('iteration')}",
+        "cat": "rlhf", "ph": "X", "ts": rit.get("t", 0.0) * 1e6,
+        "dur": max(0.0, rit.get("wall_s", 0.0)) * 1e6,
+        "pid": pid, "tid": f"rlhf:{name}:iters",
+        "args": {"iteration": rit.get("iteration"),
+                 "bubble_fraction": rit.get("bubble_fraction"),
+                 "coverage": rit.get("coverage"),
+                 "staleness": rit.get("staleness"),
+                 "tokens": rit.get("tokens"),
+                 "restart_gap_s": rit.get("restart_gap_s")},
+    }]
+    for iv in rit.get("intervals") or ():
+        t0, t1 = iv.get("t0"), iv.get("t1")
+        if t0 is None or t1 is None:
+            continue
+        out.append({"name": iv.get("phase", "phase"), "cat": "rlhf",
+                    "ph": "X", "ts": t0 * 1e6,
+                    "dur": max(0.0, t1 - t0) * 1e6, "pid": pid,
+                    "tid": f"rlhf:{name}:{iv.get('role', 'role')}",
+                    "args": {"seconds": round(max(0.0, t1 - t0), 6)}})
     return out
 
 

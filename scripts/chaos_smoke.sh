@@ -774,4 +774,88 @@ assert d["exit_code"] == 0 and d["healthy"], d["findings"]
 print("doctor healthy after engine leg")
 '
 
+echo "== rlhf-obs leg: kill the generator mid-iteration — recorder stamps the interrupted phase, restart gap, and staleness =="
+# Iteration 1 completes clean (learner ships v1, generator swaps to it).
+# Then worker.kill lands on the generator's next generate entry: the
+# iteration dies mid-phase and the flight recorder stamps
+# phase="generate" interrupted. max_restarts=1 rebuilds the generator on
+# the SEED weights (decoded version back to 0), so iteration 3's
+# staleness stamp must read 1 — the restart silently regressed the
+# decode weights, and only the recorder makes that visible. The driver
+# exits WITHOUT shutdown so the @rlhf/ snapshot survives for the
+# postmortem `rt rlhf stats` read below (no-driver-attach path).
+python - <<'EOF'
+import subprocess
+import sys
+import time
+
+import ray_tpu
+from ray_tpu.rl.rlhf import RLHFPipeline
+
+RT = [sys.executable, "-m", "ray_tpu.scripts.cli"]
+ray_tpu.init(address="auto")
+p = RLHFPipeline(preset="debug", num_prompts=3, prompt_len=6,
+                 max_new_tokens=8, max_slots=2, decode_stride=2)
+r1 = p.run_iteration()
+assert r1["staleness"] == 0 and r1["weights_version"] == 1, r1
+
+# arm AFTER the clean iteration: the next generate entry dies
+subprocess.run(RT + ["chaos", "arm", "--site", "worker.kill",
+                     "--target", "generate", "--at", "1",
+                     "--max-fires", "1", "--seed", "19"], check=True)
+time.sleep(2.5)  # plan rides the heartbeat to raylet + live workers
+try:
+    p.run_iteration()
+    raise SystemExit("FAIL: armed kill did not interrupt the iteration")
+except Exception as e:  # noqa: BLE001 — the kill surfaces here
+    print("iteration 2 interrupted:", type(e).__name__)
+subprocess.run(RT + ["chaos", "disarm"], check=True)
+time.sleep(2.5)  # disarm rides the heartbeat too
+
+r3 = p.run_iteration()  # restarted generator decodes the SEED weights
+assert r3["staleness"] == 1, \
+    f"restart weight regression not stamped: {r3['staleness']}"
+assert r3["decoded_version"] == 0 and r3["weights_version"] == 2, r3
+summ = p.stats()["recorder"]
+assert summ["interrupted_total"] == 1, summ
+assert summ["interrupted_last"]["phase"] == "generate", summ
+assert summ["restart_gaps_s"] and summ["restart_gaps_s"][-1] > 0, summ
+counts = p.recorder.drain_now()
+assert counts["kv"] >= 1, counts
+print(f"rlhf-obs leg: interrupted in 'generate', restart gap "
+      f"{summ['restart_gaps_s'][-1]:.2f}s, staleness {r3['staleness']} "
+      f"after the seed-weight restart")
+# deliberately NO p.shutdown(): close() drops the @rlhf/ KV snapshot,
+# and the next check reads it postmortem through the GCS
+ray_tpu.shutdown()
+EOF
+$RT errors --origin chaos | grep -q "worker.kill" \
+    || { echo "FAIL: rlhf-obs worker.kill not on the chaos feed"; exit 1; }
+
+echo "== interrupt + restart gap visible postmortem on rt rlhf stats =="
+$RT rlhf stats --json | python -c '
+import json, sys
+snaps = json.load(sys.stdin)
+assert snaps, "no @rlhf/ snapshot survived the driver exit"
+s = snaps[-1]["summary"]
+assert s["interrupted_total"] == 1, s
+assert s["interrupted_last"]["phase"] == "generate", s
+assert s["restart_gaps_s"], s
+assert s["staleness"]["last"] == 1, s["staleness"]
+states = [r["state"] for r in snaps[-1]["iterations"]]
+assert "interrupted" in states and states[-1] == "ok", states
+print("rt rlhf stats sees the interrupt, restart gap, and staleness")
+'
+
+echo "== doctor must exit 0 after the rlhf-obs leg drains =="
+# the interrupt WAS recovered (a later iteration stamped the restart
+# gap), so the unrecovered-interrupt finding must NOT fire
+sleep 3
+$RT doctor --window 2 --json | python -c '
+import json, sys
+d = json.load(sys.stdin)
+assert d["exit_code"] == 0 and d["healthy"], d["findings"]
+print("doctor healthy after rlhf-obs leg")
+'
+
 echo "chaos smoke OK"

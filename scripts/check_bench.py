@@ -55,6 +55,12 @@ KEY_SERIES: Dict[str, List[Tuple[str, str]]] = {
     "RLHF_r*.json": [
         ("measured.anakin.fused_env_steps_per_s", "higher"),
         ("measured.rlhf.generate_tok_s", "higher"),
+        # flight-recorder rounds (RLHF_r11+): strict-phase bubble, decode
+        # staleness and weight-sync wall — the baseline the item-4
+        # interleave claim is judged against
+        ("summary.bubble_fraction", "lower"),
+        ("summary.staleness_p99", "lower"),
+        ("summary.sync_wall_s", "lower"),
     ],
     "BENCH_KV_r*.json": [
         ("engine_ttft.ttft_collapse_x", "higher"),

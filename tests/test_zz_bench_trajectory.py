@@ -86,6 +86,32 @@ def test_missing_series_and_malformed_are_errors(tmp_path):
     assert cb.main(["--repo", str(tmp_path)]) == 1
 
 
+def test_rlhf_recorder_series_registered_and_guarded(tmp_path):
+    """The RLHF family must register the flight-recorder series (bubble
+    fraction / staleness p99 / sync wall, all lower-is-better) and flag a
+    wrong-direction move on each."""
+    cb = _load_checker()
+    rlhf_keys = dict(cb.KEY_SERIES["RLHF_r*.json"])
+    for key in ("summary.bubble_fraction", "summary.staleness_p99",
+                "summary.sync_wall_s"):
+        assert rlhf_keys.get(key) == "lower", (key, rlhf_keys)
+    mk = lambda bub, p99, sync: {
+        "summary": {"bubble_fraction": bub, "staleness_p99": p99,
+                    "sync_wall_s": sync},
+        "measured": {"anakin": {"fused_env_steps_per_s": 1000.0},
+                     "rlhf": {"generate_tok_s": 50.0}}}
+    _write(tmp_path, "RLHF_r01.json", mk(0.70, 1.0, 0.20))
+    _write(tmp_path, "RLHF_r02.json", mk(0.90, 4.0, 0.50))
+    errors, regressions, _ = cb.check(str(tmp_path))
+    assert not errors, errors
+    for key in ("bubble_fraction", "staleness_p99", "sync_wall_s"):
+        assert any(key in r for r in regressions), (key, regressions)
+    # a round that improves every recorder series must be clean
+    _write(tmp_path, "RLHF_r02.json", mk(0.55, 0.0, 0.15))
+    errors, regressions, _ = cb.check(str(tmp_path))
+    assert not errors and not regressions, (errors, regressions)
+
+
 def test_series_resolves_from_newest_carrier(tmp_path):
     """A focused later round that skips a series must not fail the gate —
     the series resolves from the newest round that carries it."""

@@ -1,0 +1,311 @@
+"""RLHF pipeline flight recorder (``util/pipeline_recorder.py``):
+per-role bubble attribution, orchestration-tax join, staleness
+accounting, the ship→fetch→barrier→swap transfer receipt, doctor
+bubble findings, and the postmortem ``rt rlhf stats`` surface. Named
+``test_zz_*`` so it sorts late in the suite."""
+
+import contextlib
+import io
+import json
+import time
+from argparse import Namespace
+
+import pytest
+
+from ray_tpu.util import pipeline_recorder as PR
+
+
+# ---------------------------------------------------------------------------
+# bubble math on synthetic intervals (no cluster, no jax dispatch)
+# ---------------------------------------------------------------------------
+
+def _iv(role, phase, t0, t1):
+    return {"role": role, "phase": phase, "t0": t0, "t1": t1}
+
+
+def test_bubble_attribution_strict_phases():
+    """A perfectly serialized 4-role pipeline: at any instant exactly one
+    role works, so 3 of 4 role-seconds are bubble -> fraction 0.75."""
+    ivs = [_iv("generator", "generate", 0.0, 4.0),
+           _iv("reference", "score_ref", 4.0, 6.0),
+           _iv("reward", "score_reward", 6.0, 8.0),
+           _iv("learner", "update", 8.0, 12.0)]
+    out = PR.bubble_attribution(ivs, roles=list(PR.ROLES))
+    assert out["span_busy_s"] == pytest.approx(12.0)
+    assert out["total_role_s"] == pytest.approx(48.0)
+    assert out["bubble_fraction"] == pytest.approx(0.75)
+    assert out["role_busy_s"]["generator"] == pytest.approx(4.0)
+    assert out["role_idle_s"]["generator"] == pytest.approx(8.0)
+
+
+def test_bubble_attribution_overlap_and_gaps():
+    """Concurrent scoring roles cut the bubble; dead time where NO role
+    works is excluded from the busy span entirely (it is orchestration
+    tax, not role idleness)."""
+    ivs = [_iv("generator", "generate", 0.0, 4.0),
+           # both scoring roles concurrent -> 2 busy / 2 idle for 2s
+           _iv("reference", "score_ref", 4.0, 6.0),
+           _iv("reward", "score_reward", 4.0, 6.0),
+           # 2s gap (4 roles idle) must NOT count as bubble
+           _iv("learner", "update", 8.0, 12.0)]
+    out = PR.bubble_attribution(ivs, roles=list(PR.ROLES))
+    assert out["span_busy_s"] == pytest.approx(10.0)  # the gap excluded
+    # generate: 3 idle x 4s; score: 2 idle x 2s; update: 3 idle x 4s
+    assert out["bubble_role_s"] == pytest.approx(28.0)
+    assert out["bubble_fraction"] == pytest.approx(28.0 / 40.0)
+    # a fully-overlapped pipeline scores 0
+    full = [_iv(r, p, 0.0, 5.0) for r, p in
+            (("generator", "generate"), ("reference", "score_ref"),
+             ("reward", "score_reward"), ("learner", "update"))]
+    assert PR.bubble_attribution(full)["bubble_fraction"] == 0.0
+    # degenerate input: no intervals -> zeros, no division error
+    assert PR.bubble_attribution([])["bubble_fraction"] == 0.0
+
+
+# ---------------------------------------------------------------------------
+# the recorder: join, tax, staleness, restart gaps, bounds, kill switch
+# ---------------------------------------------------------------------------
+
+def _record_one(rec, *, iteration=1, t0=100.0, staleness_skew=0,
+                receipt=None):
+    """One synthetic strict-phase iteration: 1s generate, 0.5s score
+    pair, 1s update, ship+sync — driver walls carry 0.1s tax each."""
+    ivs = [_iv("generator", "generate", t0, t0 + 1.0),
+           _iv("reference", "score_ref", t0 + 1.1, t0 + 1.6),
+           _iv("reward", "score_reward", t0 + 1.1, t0 + 1.6),
+           _iv("learner", "update", t0 + 1.7, t0 + 2.7),
+           _iv("learner", "ship", t0 + 2.8, t0 + 2.9),
+           _iv("generator", "sync_swap", t0 + 2.9, t0 + 3.0)]
+    return rec.record_iteration(
+        iteration=iteration, t0=t0, wall_s=3.2, intervals=ivs,
+        driver_s={"generate": 1.1, "score": 0.6, "update": 1.1,
+                  "ship": 0.15, "sync_swap": 0.15},
+        tokens=64, learner_version=iteration + staleness_skew,
+        decoded_version=iteration, receipt=receipt)
+
+
+def test_record_iteration_derives_tax_coverage_staleness():
+    rec = PR.PipelineRecorder("t-derive", enabled=True)
+    try:
+        receipt = {"version": 1, "nbytes": 1 << 20, "n_leaves": 12,
+                   "oid_leaves": 7, "inline_leaves": 5,
+                   "transport": "push", "pump_wall_s": 0.01,
+                   "fetch_wall_s": 0.02, "barrier_drain_s": 0.005,
+                   "swap_apply_s": 0.001}
+        d = _record_one(rec, receipt=receipt)
+        # driver "score" wall graded against the UNION span of both
+        # scoring roles (0.5s), not their 1.0s sum
+        assert d["tax_s"]["score"] == pytest.approx(0.1)
+        assert d["tax_s"]["generate"] == pytest.approx(0.1)
+        assert d["staleness"] == 0
+        # busy span 3.0s minus the 3 x 0.1s inter-phase gaps = 2.7s
+        assert d["coverage"] == pytest.approx(2.7 / 3.2, abs=1e-3)
+        s = rec.summary()
+        assert s["window_iterations"] == 1 and s["tokens"] == 64
+        assert s["receipt_last"]["barrier_drain_s"] == pytest.approx(0.005)
+        assert s["staleness"]["max"] == 0
+        # per-role busy fractions sum across roles to (1 - bubble)*n
+        assert s["role_busy_frac"]["learner"] > 0
+        assert s["overhead_frac"] < 0.02  # the ISSUE's overhead budget
+        assert s["recorded_wall_s"] == pytest.approx(3.2)
+    finally:
+        rec.close()
+
+
+def test_staleness_stamped_across_version_skew():
+    """The learner moved 2 versions past what the generator decoded
+    under (an actor restart resets the decoded version): staleness > 0
+    and the summary profile reflects it."""
+    rec = PR.PipelineRecorder("t-stale", enabled=True)
+    try:
+        d0 = _record_one(rec, iteration=1, staleness_skew=0)
+        assert d0["staleness"] == 0
+        d2 = _record_one(rec, iteration=2, staleness_skew=2, t0=110.0)
+        assert d2["staleness"] == 2
+        s = rec.summary()
+        assert s["staleness"]["last"] == 2 and s["staleness"]["max"] == 2
+        # decoded version AHEAD of the learner clamps to 0, never negative
+        d = rec.record_iteration(
+            iteration=3, t0=120.0, wall_s=1.0,
+            intervals=[_iv("generator", "generate", 120.0, 120.9)],
+            driver_s={"generate": 0.95}, learner_version=1,
+            decoded_version=5)
+        assert d["staleness"] == 0
+    finally:
+        rec.close()
+
+
+def test_interrupt_then_restart_gap():
+    rec = PR.PipelineRecorder("t-intr", enabled=True)
+    try:
+        rec.record_interrupt(phase="generate", t=100.0,
+                             error="ActorDiedError('gen')")
+        d = _record_one(rec, iteration=1, t0=103.5)
+        assert d["restart_gap_s"] == pytest.approx(3.5)
+        s = rec.summary()
+        assert s["interrupted_total"] == 1
+        assert s["interrupted_last"]["phase"] == "generate"
+        assert s["restart_gaps_s"] == [pytest.approx(3.5)]
+        # the gap is consumed: the next iteration carries none
+        d2 = _record_one(rec, iteration=2, t0=110.0)
+        assert d2["restart_gap_s"] is None
+        snap = rec.snapshot()
+        states = [r["state"] for r in snap["iterations"]]
+        assert states == ["interrupted", "ok", "ok"]
+    finally:
+        rec.close()
+
+
+def test_recorder_bounded_and_snapshot_compact():
+    rec = PR.PipelineRecorder("t-bound", cap=128, enabled=True)
+    try:
+        for i in range(2000):
+            _record_one(rec, iteration=i, t0=float(i * 4))
+        assert len(rec.iterations()) <= 128
+        s = rec.summary()
+        assert s["iterations_total"] == 2000
+        # snapshot stays compact enough for the 2s KV push cadence
+        assert len(json.dumps(rec.snapshot())) < 64_000
+    finally:
+        rec.close()
+
+
+def test_kill_switch_records_nothing():
+    rec = PR.PipelineRecorder("t-off", enabled=False)
+    try:
+        assert _record_one(rec) == {}
+        rec.record_interrupt(phase="update", t=1.0)
+        assert not rec.iterations()
+        assert rec.summary()["iterations_total"] == 0
+    finally:
+        rec.close()
+
+
+# ---------------------------------------------------------------------------
+# doctor: sustained-bubble warn + unrecovered-interrupt warn
+# ---------------------------------------------------------------------------
+
+def _doctor_report(summary, t=None):
+    node = {"node_id": "n1deadbeef", "alive": True, "resources": {},
+            "available": {}}
+    snap = {"t": time.time() if t is None else t, "node": "n1",
+            "name": "pipe", "summary": summary}
+    return {"nodes": [node], "actors": [], "failures": [], "ooms": [],
+            "rlhf": [snap], "window_s": 600.0}
+
+
+def test_doctor_bubble_warn_and_clear():
+    from ray_tpu.util import doctor
+
+    bubbly = {"bubble_recent": [0.8, 0.82, 0.85],
+              "role_idle_frac": {"generator": 0.9, "learner": 0.4}}
+    findings = doctor.diagnose(_doctor_report(bubbly))
+    msgs = [m for lvl, m in findings if lvl == doctor.WARN]
+    assert any("bubble fraction sustained" in m for m in msgs), findings
+    assert any("idlest role: generator" in m for m in msgs), findings
+    assert not any(lvl == doctor.CRITICAL for lvl, _ in findings)
+    # one bubbly iteration among healthy ones: NOT sustained, no finding
+    warm = dict(bubbly, bubble_recent=[0.9, 0.3, 0.4])
+    findings = doctor.diagnose(_doctor_report(warm))
+    assert not any("bubble" in m for _, m in findings), findings
+    # threshold is tunable: healthy strict-phase 0.7 passes the default
+    # 0.75 but trips a tightened gate
+    strict = dict(bubbly, bubble_recent=[0.70, 0.71, 0.70])
+    assert not any("bubble" in m for _, m in
+                   doctor.diagnose(_doctor_report(strict)))
+    assert any("bubble" in m for _, m in
+               doctor.diagnose(_doctor_report(strict), bubble_warn=0.5))
+    # stale snapshot (driver exited): skipped entirely
+    findings = doctor.diagnose(_doctor_report(bubbly,
+                                              t=time.time() - 120.0))
+    assert not any("rlhf" in m for _, m in findings), findings
+
+
+def test_doctor_unrecovered_interrupt():
+    from ray_tpu.util import doctor
+
+    dead = {"interrupted_total": 1,
+            "interrupted_last": {"phase": "generate", "t": time.time(),
+                                 "error": "ActorDiedError"}}
+    findings = doctor.diagnose(_doctor_report(dead))
+    assert any("interrupted in phase 'generate' with no completed"
+               in m for _, m in findings), findings
+    # a later successful iteration stamped a restart gap: recovered
+    ok = dict(dead, restart_gaps_s=[2.5])
+    findings = doctor.diagnose(_doctor_report(ok))
+    assert not any("no completed iteration" in m
+                   for _, m in findings), findings
+
+
+# ---------------------------------------------------------------------------
+# the cluster surfaces: live pipeline -> @rlhf/ KV -> rt rlhf stats
+# ---------------------------------------------------------------------------
+
+def test_pipeline_recorder_cluster_surfaces(rt_cluster):
+    jax = pytest.importorskip("jax")  # noqa: F841
+    import ray_tpu
+    from ray_tpu.rl.rlhf import RLHFPipeline
+    from ray_tpu.scripts import cli
+
+    p = RLHFPipeline(preset="debug", num_prompts=2, prompt_len=8,
+                     max_new_tokens=8, max_slots=2)
+    gcs = ray_tpu.global_worker()._require_backend().gcs_address
+    try:
+        r = p.run_iteration()
+        # the public phase contract holds AND the actor-side split rides
+        # along (6 actor phases vs the driver's 4)
+        assert set(r["phases_s"]) == {"generate", "score", "update",
+                                      "sync"}
+        assert set(r["phases_actor_s"]) <= set(PR.PIPE_PHASES)
+        assert 0.0 <= r["bubble_fraction"] <= 1.0
+        assert r["coverage"] > 0.0
+        # strict phases: iteration 1 generates under the initial weights
+        # (v0) while the learner is still at v0, so staleness is 0; the
+        # learner bumps to v1 only afterwards in this same iteration
+        assert r["staleness"] == 0
+        assert r["decoded_version"] == 0 and r["weights_version"] == 1
+        # the joined transfer receipt: ship -> fetch -> barrier -> swap
+        rc = r["receipt"]
+        assert rc["nbytes"] > 0 and rc["n_leaves"] > 0
+        assert rc["fetch_wall_s"] > 0
+        assert rc["barrier_drain_s"] >= 0 and rc["swap_apply_s"] >= 0
+        # ...joined to the ENGINE recorder's swap_barrier on the
+        # generator side: the same swap the receipt stamps
+        eng = ray_tpu.get(p.group["generator"].engine_stats.remote())
+        assert eng["weight_swaps"] == 1
+        # recorder summary surfaced through pipeline.stats()
+        summ = p.stats()["recorder"]
+        assert summ["window_iterations"] == 1
+        assert summ["receipt_last"]["nbytes"] == rc["nbytes"]
+        # drain pushes the @rlhf/ snapshot the CLI reads postmortem
+        counts = p.recorder.drain_now()
+        assert counts["kv"] == 1, counts
+
+        out = io.StringIO()
+        with contextlib.redirect_stdout(out):
+            code = cli.cmd_rlhf(Namespace(address=gcs, name=None,
+                                          limit=8, json=True,
+                                          rlhf_cmd="stats"))
+        assert code == 0
+        snaps = json.loads(out.getvalue())
+        assert snaps and snaps[-1]["summary"]["window_iterations"] == 1
+        assert snaps[-1]["iterations"][-1]["state"] == "ok"
+        # human rendering smoke
+        out = io.StringIO()
+        with contextlib.redirect_stdout(out):
+            code = cli.cmd_rlhf(Namespace(address=gcs, name=None,
+                                          limit=8, json=False,
+                                          rlhf_cmd="stats"))
+        assert code == 0 and "bubble" in out.getvalue()
+        assert "transfer[v1" in out.getvalue()
+    finally:
+        p.shutdown()
+    # CLI error discipline: after shutdown the recorder deleted its
+    # @rlhf/ key — stats on nothing is ONE stderr line and exit 1
+    err = io.StringIO()
+    with contextlib.redirect_stderr(err):
+        code = cli.cmd_rlhf(Namespace(address=gcs, name=None, limit=8,
+                                      json=True, rlhf_cmd="stats"))
+    assert code == 1
+    msg = err.getvalue().strip()
+    assert msg.startswith("rt rlhf:") and len(msg.splitlines()) == 1
