@@ -878,7 +878,8 @@ def _run_phase(env_var: str, prefix: str, timeout: float,
     for marker in ("RT_BENCH_INNER", "RT_BENCH_SWEEP", "RT_BENCH_TRAIN",
                    "RT_BENCH_TRAIN_FAST", "RT_BENCH_DECODE", "RT_BENCH_RL",
                    "RT_BENCH_SERVE", "RT_BENCH_CB", "RT_BENCH_DATA",
-                   "RT_BENCH_RLHF", "RT_BENCH_ENGINE"):
+                   "RT_BENCH_RLHF", "RT_BENCH_ENGINE",
+                   "RT_BENCH_TRAIN_OBS"):
         env.pop(marker, None)
     env[env_var] = "1"
     if extra_env:
@@ -1608,6 +1609,211 @@ def _rlhf_obs_round() -> None:
          "recorder_overhead_frac": summary["recorder_overhead_frac"]}))
 
 
+def _train_obs_main() -> None:
+    """Train flight-recorder phase (RT_BENCH_TRAIN_OBS): one fused-K
+    StepDriver run with three legs carved by
+    ``TrainRecorder.window_summary`` — steady (loader keeps up),
+    data-starved (loader throttled via RT_TRAIN_LOADER_THROTTLE_S, read
+    per batch so a live run can be throttled from outside), and
+    checkpoint-heavy (blocking device->host state pull + disk write per
+    launch). The grading is the recorder's own: phase sums vs launch
+    wall, the launch-gap series, and the MFU-gap waterfall per leg.
+    Prints TRAINOBSBENCH={...}."""
+    import tempfile
+
+    import numpy as np
+
+    import jax
+
+    from ray_tpu.parallel import train_step as ts
+    from ray_tpu.parallel.mesh import MeshConfig, make_mesh
+    from ray_tpu.train.driver import StepDriver
+
+    cfgd = json.loads(os.environ.get("RT_BENCH_TRAIN_OBS_CFG", "{}"))
+    preset = cfgd.get("preset", "debug")
+    batch = cfgd.get("batch", 4)
+    k = cfgd.get("k", 8)
+    leg_launches = cfgd.get("leg_launches", 10)
+    throttle_s = cfgd.get("throttle_s", 0.03)
+
+    cfg = _bench_cfg(preset, "xla", 0)
+    seq = min(cfgd.get("seq", 32), cfg.max_seq_len)
+    devices = jax.devices()
+    mesh = make_mesh(MeshConfig(), devices)
+    optimizer = ts.default_optimizer(total_steps=10000)
+    params, opt_state = ts.init_sharded_state(jax.random.key(0), cfg,
+                                              mesh, optimizer)
+    driver = StepDriver(cfg, optimizer, mesh=mesh, steps_per_launch=k)
+    rec = driver.recorder
+    assert rec is not None and rec.enabled, \
+        "train-obs phase needs the recorder live (RT_TRAIN_RECORDER)"
+    rng = np.random.default_rng(2)
+
+    def batches(n):
+        for _ in range(n):
+            thr = float(os.environ.get("RT_TRAIN_LOADER_THROTTLE_S",
+                                       "0") or 0)
+            if thr > 0:
+                time.sleep(thr)  # the env-throttled loader
+            yield {"tokens": rng.integers(
+                0, cfg.vocab_size, (batch, seq + 1)).astype(np.int32)}
+
+    def settle(timeout: float = 10.0) -> None:
+        # wait for the done-hook watcher to close in-flight records so
+        # the window carve sees every launch of the leg it just timed
+        t_end = time.perf_counter() + timeout
+        while time.perf_counter() < t_end:
+            if not rec.summary().get("in_flight"):
+                return
+            time.sleep(0.01)
+
+    # warmup: two launch cycles (first compiles, second runs on
+    # post-update leaf types) — the legs grade the steady state
+    params, opt_state, m = driver.run(params, opt_state, batches(2 * k))
+    float(jax.numpy.ravel(m["loss"])[-1])
+    settle()
+
+    legs: dict = {}
+
+    def leg(name: str, on_launch=None) -> None:
+        nonlocal params, opt_state
+        t0 = time.time()
+        params, opt_state, _m = driver.run(
+            params, opt_state, batches(leg_launches * k),
+            on_launch=on_launch)
+        settle()
+        legs[name] = rec.window_summary(t0, time.time())
+
+    leg("steady")
+    os.environ["RT_TRAIN_LOADER_THROTTLE_S"] = str(throttle_s)
+    try:
+        leg("starved")
+    finally:
+        os.environ.pop("RT_TRAIN_LOADER_THROTTLE_S", None)
+
+    ckpt_dir = tempfile.mkdtemp(prefix="rt_tobs_")
+
+    def save_ckpt(_metrics):
+        # a real checkpoint fence: device->host pull of the post-launch
+        # params (blocks on the launch) + a disk write, on the loop
+        flat = jax.device_get(jax.tree.leaves(driver.state[0]))
+        np.savez(os.path.join(ckpt_dir, "state.npz"),
+                 *[np.asarray(x) for x in flat])
+
+    leg("ckpt_heavy", on_launch=save_ckpt)
+
+    full = rec.summary()
+    keep = ("window_launches", "launch_wall_s", "span_s", "tokens_per_s",
+            "phase_s", "phase_sum_ratio", "launch_gap_p50_s",
+            "launch_gap_p99_s", "launch_gap_max_s", "data_wait_frac",
+            "raw_mfu", "achieved_mfu", "mfu_gap_frac",
+            "marginal_mfu_mean", "waterfall")
+
+    def trim(s):
+        return {key: s[key] for key in keep if key in s}
+
+    steady_dw = legs["steady"].get("data_wait_frac", 0.0)
+    starved_dw = legs["starved"].get("data_wait_frac", 0.0)
+    starved_buckets = (legs["starved"].get("waterfall") or {}) \
+        .get("buckets_s") or {}
+    out = {
+        "preset": preset, "batch": batch, "seq": seq, "k": k,
+        "leg_launches": leg_launches, "throttle_s": throttle_s,
+        "platform": jax.default_backend(), "n_devices": len(devices),
+        "steady": trim(legs["steady"]),
+        "starved": trim(legs["starved"]),
+        "ckpt_heavy": trim(legs["ckpt_heavy"]),
+        # the honesty gates: stamped phases must explain the launch wall
+        # in EVERY leg, and the recorder must not tax what it measures
+        "phase_sum_ratio": round(min(
+            legs[n].get("phase_sum_ratio", 0.0) for n in legs), 4),
+        "overhead_frac": full.get("overhead_frac", 0.0),
+        "data_wait_spike_x": round(
+            starved_dw / max(steady_dw, 0.005), 2),
+        "dominant_starved_bucket": (max(starved_buckets,
+                                        key=starved_buckets.get)
+                                    if starved_buckets else None),
+        "dry_resets": full.get("dry_resets", 0),
+    }
+    _preserve({"train_obs_phase": out})
+    print("TRAINOBSBENCH=" + json.dumps(out))
+
+
+def _train_obs_round() -> None:
+    """Focused ``python bench.py --train-obs`` round: run the train
+    flight-recorder phase in a scrubbed-CPU subprocess and commit the
+    measured legs as TRAIN_r12.json — the measurement substrate ROADMAP
+    item 2's MFU-gap claim is judged against (the trajectory checker
+    tracks summary.mfu_gap_frac / summary.launch_gap_p99_s /
+    summary.data_wait_frac)."""
+    import sys
+
+    res = _run_phase("RT_BENCH_TRAIN_OBS", "TRAINOBSBENCH", timeout=900)
+    if not res or "steady" not in res:
+        print("bench: train-obs phase produced no result", file=sys.stderr)
+        sys.exit(1)
+    steady = res.get("steady") or {}
+    starved = res.get("starved") or {}
+    ckpt = res.get("ckpt_heavy") or {}
+    summary = {
+        # headline series (steady leg): what the trajectory checker holds
+        "mfu_gap_frac": steady.get("mfu_gap_frac"),
+        "launch_gap_p99_s": steady.get("launch_gap_p99_s"),
+        "data_wait_frac": steady.get("data_wait_frac"),
+        "phase_sum_ratio": res.get("phase_sum_ratio"),
+        "overhead_frac": res.get("overhead_frac"),
+        "data_wait_spike_x": res.get("data_wait_spike_x"),
+        "dominant_starved_bucket": res.get("dominant_starved_bucket"),
+        "steady": steady, "starved": starved, "ckpt_heavy": ckpt,
+    }
+    notes = [
+        "Per-launch phase sums cover {} of launch wall across all three "
+        "legs (acceptance floor 0.95); recorder overhead {} of recorded "
+        "wall (budget 0.02).".format(res.get("phase_sum_ratio"),
+                                     res.get("overhead_frac")),
+        "Throttled-loader leg: data_wait share {} vs steady {} "
+        "({}x spike); dominant waterfall bucket {} — starvation "
+        "attributed to the loader, not the devices (dry-resets "
+        "suppressed the launch-gap stamp {} times).".format(
+            starved.get("data_wait_frac"), steady.get("data_wait_frac"),
+            res.get("data_wait_spike_x"),
+            res.get("dominant_starved_bucket"), res.get("dry_resets")),
+        "Checkpoint-heavy leg: host_tax sum {}s vs steady {}s — the "
+        "blocking state pull + disk write lands in one bucket.".format(
+            (ckpt.get("phase_s") or {}).get("host_tax"),
+            (steady.get("phase_s") or {}).get("host_tax")),
+        "MFU-gap waterfall (steady): raw {} -> achieved {}; gap "
+        "fraction {}.".format(steady.get("raw_mfu"),
+                              steady.get("achieved_mfu"),
+                              steady.get("mfu_gap_frac")),
+    ]
+    art = {
+        "round": "r12",
+        "artifact": "TRAIN_r12",
+        "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "platform": res.get("platform",
+                            os.environ.get("RT_BENCH_PLATFORM", "cpu")),
+        "summary": summary,
+        "notes": notes,
+        "measured": res,
+    }
+    path = os.environ.get("RT_BENCH_TRAIN_OBS_OUT") or os.path.join(
+        _REPO_ROOT, "TRAIN_r12.json")
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(art, f, indent=1)
+        f.write("\n")
+    os.replace(tmp, path)
+    print(f"bench: train-obs round written to {path}")
+    print("TRAINOBS=" + json.dumps(
+        {"mfu_gap_frac": summary["mfu_gap_frac"],
+         "launch_gap_p99_s": summary["launch_gap_p99_s"],
+         "data_wait_frac": summary["data_wait_frac"],
+         "phase_sum_ratio": summary["phase_sum_ratio"],
+         "overhead_frac": summary["overhead_frac"],
+         "data_wait_spike_x": summary["data_wait_spike_x"]}))
+
+
 def _data_main() -> None:
     """Data-ingestion phase (VERDICT r4 #6): parquet -> fused map pipeline
     -> iter_batches, the host-side input path that keeps chips fed. Reports
@@ -2097,11 +2303,17 @@ def main() -> None:
     if os.environ.get("RT_BENCH_ENGINE"):
         _engine_main()
         return
+    if os.environ.get("RT_BENCH_TRAIN_OBS"):
+        _train_obs_main()
+        return
     if "--engine-obs" in sys.argv[1:]:
         _engine_obs_round()
         return
     if "--rlhf-obs" in sys.argv[1:]:
         _rlhf_obs_round()
+        return
+    if "--train-obs" in sys.argv[1:]:
+        _train_obs_round()
         return
 
     # TPU perf flags (latency-hiding scheduler, async collectives) must be

@@ -10,13 +10,19 @@ augmented assignment, subscript store, or a mutating method call like
 ``.append``/``.pop``/``.update``) that is not lexically inside
 ``with self._lock:``. Helper methods whose names end in ``_locked``
 are assumed to be called with the lock held (the repo's existing idiom:
-``_evict_locked``, ``_drain_derefs_locked``); ``__init__`` is exempt
-(no concurrent alias exists yet). Reads are deliberately not checked —
-too noisy to enforce mechanically, and the writes are where lost-update
+``_evict_locked``, ``_drain_derefs_locked``); ``__init__`` and
+``_init_*`` constructor-extension helpers (the recorder-core idiom:
+``_init_core``, called from subclass ``__init__`` before any concurrent
+alias exists) are exempt. Reads are deliberately not checked — too
+noisy to enforce mechanically, and the writes are where lost-update
 races live.
 
 A declaration whose named lock doesn't exist on the class is itself a
-finding: annotations must not rot.
+finding: annotations must not rot. The lock may live on a base class —
+in-module bases are resolved transitively; when a base is imported from
+another module the attribute set is unknowable here, so the stale
+warning is suppressed rather than guessed (mutation checks still run:
+they only need the declaration, not the lock's home).
 """
 
 from __future__ import annotations
@@ -96,6 +102,33 @@ def _class_attrs(cls: ast.ClassDef) -> Set[str]:
     return out
 
 
+def _attrs_with_bases(cls: ast.ClassDef,
+                      by_name: Dict[str, ast.ClassDef],
+                      seen: Set[str]) -> Tuple[Set[str], bool]:
+    """Attributes assigned on ``cls`` plus any base class resolvable in
+    this module (transitively). The second element is False when a base
+    is imported / not resolvable here — the attribute set is then a
+    lower bound and "the lock doesn't exist" cannot be proven."""
+    attrs = _class_attrs(cls)
+    complete = True
+    for b in cls.bases:
+        if isinstance(b, ast.Name):
+            if b.id == "object":
+                continue
+            base = by_name.get(b.id)
+            if base is None:
+                complete = False
+            elif base.name not in seen:
+                seen.add(base.name)
+                battrs, bcomplete = _attrs_with_bases(base, by_name, seen)
+                attrs |= battrs
+                complete = complete and bcomplete
+        else:
+            # ast.Attribute (module.Base), Subscript (Generic[T]), ...
+            complete = False
+    return attrs, complete
+
+
 def _under_lock(mod: ModuleInfo, node: ast.AST, lock: str,
                 method: ast.AST) -> bool:
     """Is ``node`` lexically inside ``with self.<lock>:`` within
@@ -123,15 +156,18 @@ class GuardedBy(Checker):
         if not mod.guarded:
             return
         qn = mod.qualnames()
+        by_name = {node.name: node for node in qn
+                   if isinstance(node, ast.ClassDef)}
         for cls_node, cls_qual in list(qn.items()):
             if not isinstance(cls_node, ast.ClassDef):
                 continue
             decls = _class_decls(mod, cls_node)
             if not decls:
                 continue
-            attrs = _class_attrs(cls_node)
+            attrs, complete = _attrs_with_bases(cls_node, by_name,
+                                                {cls_node.name})
             for attr, (lock, decl_line) in decls.items():
-                if lock not in attrs:
+                if complete and lock not in attrs:
                     yield Finding(
                         checker=self.name, path=mod.relpath,
                         line=decl_line, severity="warning",
@@ -148,6 +184,7 @@ class GuardedBy(Checker):
                                            ast.AsyncFunctionDef)):
                     continue
                 if method.name == "__init__" \
+                        or method.name.startswith("_init") \
                         or method.name.endswith("_locked"):
                     continue
                 yield from self._check_method(mod, cls_qual, method, decls)

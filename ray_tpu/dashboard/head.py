@@ -78,6 +78,10 @@ class DashboardActor:
         # the RLHF plane: pipeline flight-recorder snapshots (@rlhf/ KV —
         # per-role bubble attribution, staleness, transfer receipts)
         app.router.add_get("/api/rlhf", self._rlhf)
+        # the train plane: StepDriver flight-recorder snapshots (@train/
+        # KV — launch phase attribution, launch-gap/data-starvation
+        # accounting, the MFU-gap waterfall)
+        app.router.add_get("/api/train", self._train)
         app.router.add_get("/api/stacks", self._stacks)
         app.router.add_get("/metrics", self._metrics)
         self._runner = web.AppRunner(app, access_log=None)
@@ -271,6 +275,40 @@ class DashboardActor:
                     except ValueError:
                         continue
                 return {"pipelines": pipelines}
+
+            return backend.io.run(run())
+
+        loop = asyncio.get_running_loop()
+        out = await loop.run_in_executor(None, fetch)
+        return web.json_response(out, dumps=_dumps)
+
+    async def _train(self, request):
+        """The Train tab's payload: every StepDriver's flight-recorder
+        snapshot (util/train_recorder.py drain pushes them to the
+        ``@train/`` KV) — per-launch phase walls, launch-gap accounting
+        and the MFU-gap waterfall. Snapshots survive the driver, so a
+        finished run stays inspectable here until the cluster dies."""
+        from aiohttp import web
+
+        def fetch():
+            backend = self._backend()
+
+            async def run():
+                keys = (await backend._gcs.call(
+                    "kv_keys", {"prefix": "@train/"})).get("keys") or []
+                replies = await asyncio.gather(
+                    *(backend._gcs.call("kv_get", {"key": k})
+                      for k in sorted(keys)[:50]))
+                drivers = []
+                for reply in replies:
+                    raw = reply.get("value")
+                    if not raw:
+                        continue
+                    try:
+                        drivers.append(json.loads(raw))
+                    except ValueError:
+                        continue
+                return {"drivers": drivers}
 
             return backend.io.run(run())
 

@@ -220,6 +220,7 @@ const TABS = [
   {id: "sched", label: "Scheduling", url: "/api/sched?limit=200"},
   {id: "engine", label: "Engine", url: "/api/engine"},
   {id: "rlhf", label: "RLHF", url: "/api/rlhf"},
+  {id: "train", label: "Train", url: "/api/train"},
 ];
 let active = "nodes", paused = false, data = {};
 
@@ -748,6 +749,62 @@ function renderRlhf(el) {
   }).join("");
 }
 
+// --- train tab: StepDriver flight-recorder snapshots ---
+function renderTrain(el) {
+  const payload = data.train || {};
+  const drivers = payload.drivers || [];
+  if (!drivers.length) {
+    el.innerHTML = `<div class="empty">no train flight-recorder ` +
+      `snapshots — run a fused StepDriver (RT_TRAIN_RECORDER=1)</div>`;
+    return;
+  }
+  const pct = v => v == null ? "" : (100 * v).toFixed(1) + "%";
+  const mfu = v => v == null ? "" : v.toFixed(4);
+  el.innerHTML = drivers.map(snap => {
+    const s = snap.summary || {};
+    const wf = s.waterfall || {};
+    const cost = wf.mfu_cost || {};
+    const buckets = Object.entries(cost).filter(([, v]) => v > 0).map(
+      ([b, v]) => `<tr><td>${esc(b)}</td>` +
+        `<td>${esc(((wf.buckets_s || {})[b] ?? wf.uncovered_s ?? 0)
+          .toFixed(3))}s</td><td>${mfu(v)}</td></tr>`).join("");
+    const launches = (snap.launches || []).slice().reverse().map(r => {
+      const pm = r.phases_ms || {};
+      return `<tr><td>${esc(r.seq ?? "")}</td>` +
+        `<td>${statusCell(r.done ? "FINISHED" : "RUNNING")}</td>` +
+        `<td>${esc(r.k ?? "")}</td>` +
+        `<td>${esc((r.wall_ms ?? 0).toFixed(1))}</td>` +
+        `<td>${esc((pm.data_wait ?? 0).toFixed(1))}</td>` +
+        `<td>${esc((pm.dispatch ?? 0).toFixed(1))}</td>` +
+        `<td>${esc((pm.device_compute ?? 0).toFixed(1))}</td>` +
+        `<td>${esc((pm.host_tax ?? 0).toFixed(1))}</td>` +
+        `<td>${r.gap_ms != null ? esc(r.gap_ms.toFixed(1)) : ""}</td>` +
+        `<td>${esc(r.tokens ?? 0)}</td></tr>`;
+    }).join("");
+    return `<h3>${esc(snap.name || "train")} <span class="muted">` +
+      `${esc(String(snap.node || "").slice(0, 8))}:${esc(snap.pid || "")}` +
+      `</span></h3>` +
+      `<div class="muted">launches ${esc(s.launches_total ?? 0)} ` +
+      `(${esc(s.compiles ?? 0)} compiled) · steps ` +
+      `${esc(s.steps_total ?? 0)} · ${esc(s.tokens_per_s ?? 0)} tok/s · ` +
+      `phase coverage ${pct(s.phase_sum_ratio)} · gap p99 ` +
+      `${((s.launch_gap_p99_s || 0) * 1e3).toFixed(1)}ms · data_wait ` +
+      `${pct(s.data_wait_frac)} · overhead ` +
+      `${((s.overhead_frac || 0) * 100).toFixed(3)}%</div>` +
+      (wf.raw_mfu != null ?
+        `<div class="muted">MFU waterfall: raw ${mfu(wf.raw_mfu)} → ` +
+        `achieved ${mfu(wf.achieved_mfu)} (gap ${pct(s.mfu_gap_frac)}, ` +
+        `marginal ${mfu(s.marginal_mfu)})</div>` : "") +
+      (buckets ? `<table><tr><th>Lost to</th><th>Wall</th>` +
+        `<th>MFU cost</th></tr>${buckets}</table>` : "") +
+      (launches ? `<table><tr><th>Launch</th><th>State</th><th>K</th>` +
+        `<th>Wall ms</th><th>Data ms</th><th>Dispatch ms</th>` +
+        `<th>Device ms</th><th>Host-tax ms</th><th>Gap ms</th>` +
+        `<th>Tokens</th></tr>${launches}</table>` :
+        `<div class="empty">no launch records yet</div>`);
+  }).join("");
+}
+
 function renderTable() {
   const el = document.getElementById("content");
   if (active === "timeline") { renderTimeline(el); return; }
@@ -756,6 +813,7 @@ function renderTable() {
   if (active === "sched") { renderSched(el); return; }
   if (active === "engine") { renderEngine(el); return; }
   if (active === "rlhf") { renderRlhf(el); return; }
+  if (active === "train") { renderTrain(el); return; }
   if (active === "serve") {
     const payload = data.serve || {};
     const apps = payload.applications || payload;

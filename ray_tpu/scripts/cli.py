@@ -824,6 +824,8 @@ def cmd_doctor(args: argparse.Namespace) -> int:
                           tick_gap_warn_s=args.tick_gap_warn,
                           slo_warn=args.slo_warn,
                           bubble_warn=args.bubble_warn,
+                          launch_gap_warn_s=args.launch_gap_warn,
+                          data_wait_warn=args.data_wait_warn,
                           as_json=args.json)
     print(text, file=sys.stderr if rc == 2 else sys.stdout)
     return rc
@@ -1052,6 +1054,99 @@ def cmd_rlhf(args: argparse.Namespace) -> int:
                   f"ms bubble={r.get('bubble_fraction', 0):.3f} "
                   f"cov={r.get('coverage', 0):.2f} "
                   f"stale={r.get('staleness', 0)}{gap}")
+    return 0
+
+
+def cmd_train(args: argparse.Namespace) -> int:
+    """rt train stats: the StepDriver flight-recorder plane
+    (util/train_recorder.py). The driver's drain thread pushes an
+    @train/ KV snapshot (phase rollup, launch-gap accounting, the
+    MFU-gap waterfall + launch record tail); this reads it straight off
+    the GCS — so it works POSTMORTEM, after the training run finished
+    (the @train/ key deliberately survives the recorder). A missing
+    snapshot is an ERROR (exit 1), same discipline as `rt rlhf stats`:
+    you run this to grade a training run, and grading nothing is a
+    mistake worth failing."""
+    gcs = _resolve_gcs(args.address)
+    if gcs is None:
+        print("rt train: no running cluster found (pass --address)",
+              file=sys.stderr)
+        return 1
+    try:
+        keys = _gcs_call(gcs, "kv_keys",
+                         {"prefix": "@train/"}).get("keys") or []
+        snaps = []
+        for k in sorted(keys):
+            raw = _gcs_call(gcs, "kv_get", {"key": k}).get("value")
+            if not raw:
+                continue
+            try:
+                snaps.append(json.loads(raw))
+            except ValueError:
+                continue
+    except Exception as e:  # noqa: BLE001 — one line, no stack trace
+        print(f"rt train: cannot reach GCS at {gcs}: "
+              f"{type(e).__name__}: {e}", file=sys.stderr)
+        return 1
+    if args.name:
+        snaps = [s for s in snaps
+                 if args.name in f"{s.get('node')}:{s.get('name')}"]
+    if not snaps:
+        what = (f"matching {args.name!r} " if args.name else "")
+        print(f"rt train: no train flight-recorder snapshot {what}"
+              f"under @train/ (no fused launch ran, or "
+              f"RT_TRAIN_RECORDER=0)", file=sys.stderr)
+        return 1
+    if args.json:
+        print(json.dumps(snaps, indent=2, default=str))
+        return 0
+    now = time.time()
+    for s in snaps:
+        label = f"{s.get('node')}:{s.get('pid')}:{s.get('name')}"
+        summ = s.get("summary") or {}
+        age = max(0.0, now - (s.get("t") or now))
+        print(f"train {label}  (snapshot {age:.0f}s old)")
+        print(f"  launches {summ.get('launches_total', 0)} "
+              f"({summ.get('compiles', 0)} compiled)  steps "
+              f"{summ.get('steps_total', 0)}  tokens "
+              f"{summ.get('tokens', 0)}  "
+              f"{summ.get('tokens_per_s', 0):.0f} tok/s  phase coverage "
+              f"{summ.get('phase_sum_ratio', 0):.3f} of launch wall")
+        phases = summ.get("phase_s") or {}
+        if phases:
+            parts = "  ".join(f"{p}={1e3 * v:.1f}ms"
+                              for p, v in phases.items())
+            print(f"  phases (window sums): {parts}")
+        gp50 = 1e3 * summ.get("launch_gap_p50_s", 0)
+        gp99 = 1e3 * summ.get("launch_gap_p99_s", 0)
+        gmax = 1e3 * summ.get("launch_gap_max_s", 0)
+        print(f"  launch gap p50={gp50:.1f}ms p99={gp99:.1f}ms "
+              f"max={gmax:.1f}ms  dry-resets {summ.get('dry_resets', 0)}"
+              f"  data_wait {100 * summ.get('data_wait_frac', 0):.1f}% "
+              f"of wall")
+        wf = summ.get("waterfall") or {}
+        if wf:
+            print(f"  MFU waterfall: raw {wf.get('raw_mfu', 0):.4f} -> "
+                  f"achieved {wf.get('achieved_mfu', 0):.4f}  (gap "
+                  f"{100 * summ.get('mfu_gap_frac', 0):.1f}%, marginal "
+                  f"{summ.get('marginal_mfu', 0):.4f})")
+            cost = wf.get("mfu_cost") or {}
+            parts = "  ".join(f"{b}={v:.4f}"
+                              for b, v in cost.items() if v > 0)
+            if parts:
+                print(f"  gap attribution (MFU cost): {parts}")
+        print(f"  recorder overhead "
+              f"{100 * summ.get('overhead_frac', 0):.3f}% of launch wall")
+        for r in (s.get("launches") or [])[-args.limit:]:
+            when = time.strftime("%H:%M:%S",
+                                 time.localtime(r.get("t", 0)))
+            pm = r.get("phases_ms") or {}
+            parts = " ".join(f"{p}={v:.1f}" for p, v in pm.items())
+            gap = (f" gap={r['gap_ms']:.1f}ms" if "gap_ms" in r else "")
+            done = "" if r.get("done") else "  IN-FLIGHT"
+            print(f"  {when} #{r.get('seq'):<4} k={r.get('k')} "
+                  f"wall={r.get('wall_ms', 0):.1f}ms [{parts}]"
+                  f"{gap}{done}")
     return 0
 
 
@@ -1381,6 +1476,13 @@ def main(argv=None) -> int:
                        help="RLHF pipeline bubble fraction that, "
                             "sustained over 3 iterations, grades the "
                             "dataflow as phase-serialized waste")
+    p_doc.add_argument("--launch-gap-warn", type=float, default=0.25,
+                       help="train launch-gap (s) that, sustained over 3 "
+                            "launches with a stacked batch available, "
+                            "grades the devices as host-starved")
+    p_doc.add_argument("--data-wait-warn", type=float, default=0.25,
+                       help="train data_wait share of window wall above "
+                            "which the driver is graded data-starved")
     p_doc.add_argument("--json", action="store_true")
     p_doc.set_defaults(fn=cmd_doctor)
 
@@ -1418,6 +1520,25 @@ def main(argv=None) -> int:
                           help="iteration-record tail to render")
     pr_stats.add_argument("--json", action="store_true")
     p_rlhf_top.set_defaults(fn=cmd_rlhf)
+
+    p_train_top = sub.add_parser(
+        "train",
+        help="StepDriver flight recorder: per-launch phase attribution, "
+             "launch-gap/data-starvation accounting, MFU-gap waterfall "
+             "(@train/ KV snapshots, util/train_recorder.py)")
+    train_sub = p_train_top.add_subparsers(dest="train_cmd", required=True)
+    pt_stats = train_sub.add_parser(
+        "stats", help="per-driver phase/gap/MFU-waterfall rollup (works "
+                      "postmortem — the @train/ snapshot survives the "
+                      "run)")
+    pt_stats.add_argument("--address", default=None)
+    pt_stats.add_argument("--name", default=None,
+                          help="only drivers whose node:name contains "
+                               "this")
+    pt_stats.add_argument("--limit", type=int, default=8,
+                          help="launch-record tail to render")
+    pt_stats.add_argument("--json", action="store_true")
+    p_train_top.set_defaults(fn=cmd_train)
 
     p_trace = sub.add_parser(
         "trace",

@@ -22,6 +22,13 @@ One driver owns the whole step path:
   publishes ``rt_train_steps_per_launch`` / ``rt_train_host_overhead_ratio``
   so "is the orchestration touching the gradient path?" is a metric, not
   a bench archaeology project.
+- **Flight recorder**: every fused-K launch stamps its phase walls
+  {data_wait, h2d, dispatch, device_compute, host_tax, compile} plus
+  K/tokens/shape/analytic-FLOPs into :class:`~ray_tpu.util.train_recorder.
+  TrainRecorder` (``self.recorder``) — device-done lands via an async
+  done-hook on the launch's metrics buffers, never a ``block_until_ready``
+  on the step path. ``RT_TRAIN_RECORDER=0`` reduces this to one predicate
+  per launch.
 
 The K knob comes from ``FastPathConfig.steps_per_launch``
 (``RunConfig.fast_path``) when the driver is built inside a
@@ -82,6 +89,17 @@ class StepDriver:
         self.plan = plan
         self.cfg = cfg
         self._mesh = mesh
+        self._ts = ts
+        # the training flight recorder (PR 20): per-launch phase records,
+        # launch-gap accounting and the MFU-gap waterfall — only the fused
+        # path stamps it, so single-step drivers carry a dormant recorder
+        try:
+            from ray_tpu.util.train_recorder import TrainRecorder
+
+            self.recorder: Optional[Any] = TrainRecorder()
+        except Exception:  # noqa: BLE001 — observability must not block
+            self.recorder = None
+        self._fpt_cache: Dict[int, float] = {}
         self._single = ts.make_train_step(cfg, optimizer, loss_fn, mesh,
                                           plan=plan)
         self._multi = (ts.make_multi_step(cfg, optimizer,
@@ -148,6 +166,34 @@ class StepDriver:
         leaves = jax.tree.leaves(batch)
         return leaves[0].shape[0] if leaves else 0
 
+    def _launch_meta(self, batch: Any) -> Tuple[int, int, Tuple[int, ...]]:
+        """(tokens, seq, lead-leaf shape) of a stacked batch — the
+        recorder's FLOPs join reads these (shape inspection only, no
+        device sync)."""
+        import jax
+
+        leaves = jax.tree.leaves(batch)
+        shape = tuple(int(d) for d in leaves[0].shape) if leaves else ()
+        tokens, seq = self._ts._batch_tokens(batch, stacked=True)
+        return tokens, seq, shape
+
+    def _launch_flops(self, tokens: int, seq: int) -> float:
+        """Analytic FLOPs for one fused launch via ``util.flops`` —
+        per-token cost cached per seq length (custom-loss configs without
+        transformer geometry record launches without an MFU join)."""
+        if tokens <= 0:
+            return 0.0
+        fpt = self._fpt_cache.get(seq)
+        if fpt is None:
+            try:
+                from ray_tpu.util import flops as F
+
+                fpt = float(F.train_flops_per_token(self.cfg, seq))
+            except Exception:  # noqa: BLE001 — non-transformer cfg
+                fpt = 0.0
+            self._fpt_cache[seq] = fpt
+        return tokens * fpt
+
     # ---- the loop -----------------------------------------------------------
     def run(self, params: Any, opt_state: Any, batches: Iterable[Any],
             on_launch: Optional[Callable[[Dict[str, Any]], None]] = None,
@@ -172,18 +218,31 @@ class StepDriver:
         last_metrics: Optional[Dict[str, Any]] = None
         pend: List[Dict[str, Any]] = []
         it = iter(batches)
+        rec = self.recorder if (self.recorder is not None
+                                and self.recorder.enabled
+                                and self.fused) else None
+        rec_data_s = 0.0  # data_wait accumulated toward the pending launch
+        rec_t0: Optional[float] = None  # epoch start of its wall
         while True:
+            if rec is not None and rec_t0 is None:
+                rec_t0 = time.time()
             t0 = time.perf_counter()
             try:
                 batch = next(it)
             except StopIteration:
                 batch = None
+            if rec is not None and batch is not None:
+                rec_data_s += time.perf_counter() - t0
             if batch is not None and not prestacked and K > 1:
                 pend.append(batch)
                 if len(pend) < K:
                     self.host_s += time.perf_counter() - t0
                     continue
+                t_stack = time.perf_counter()
                 batch, pend = self._stack(pend), []
+                if rec is not None:
+                    # the K-batch np.stack is the loader's wall too
+                    rec_data_s += time.perf_counter() - t_stack
                 stacked = True
             elif batch is not None:
                 stacked = prestacked and self._lead(batch) >= 1
@@ -198,26 +257,57 @@ class StepDriver:
                 self.host_s += time.perf_counter() - t0
                 break
             if stacked and self._lead(batch) == K and self._multi is not None:
+                if rec is not None:
+                    data_ready_t = time.time()  # stacked batch in hand
+                    tokens, seq_len, shape = self._launch_meta(batch)
+                t_h2d = time.perf_counter()
                 placed = self._place(batch, stacked=True)
+                h2d_s = time.perf_counter() - t_h2d
                 self.host_s += time.perf_counter() - t0
+                n_exec = self.compile_count() if rec is not None else 0
                 t1 = time.perf_counter()
                 params, opt_state, metrics = self._multi(
                     params, opt_state, placed)
-                self.step_s += time.perf_counter() - t1
+                dispatch_s = time.perf_counter() - t1
+                t_disp_end = time.time() if rec is not None else 0.0
+                self.step_s += dispatch_s
                 self.launches += 1
                 self.steps += K
                 self._observe(K)
                 last_metrics = metrics
                 self.state = (params, opt_state)
+                seq = 0
+                if rec is not None:
+                    # a call that grew the jit cache spent its wall
+                    # tracing+compiling — book it as compile, not dispatch
+                    # (step-profiler convention, so the two can't drift)
+                    compiled = self.compile_count() > n_exec
+                    seq = rec.record_launch(
+                        t_start=rec_t0, data_wait_s=rec_data_s,
+                        h2d_s=h2d_s,
+                        dispatch_s=0.0 if compiled else dispatch_s,
+                        compile_s=dispatch_s if compiled else 0.0,
+                        data_ready_t=data_ready_t,
+                        t_dispatch_end=t_disp_end, k=K, tokens=tokens,
+                        batch_shape=shape,
+                        flops=self._launch_flops(tokens, seq_len))
+                    # async done-hook: the watcher blocks on the METRICS
+                    # leaves (never the donated params) off the step path
+                    rec.watch_outputs(seq, metrics)
+                    rec_data_s, rec_t0 = 0.0, None
                 if on_launch is not None:
                     # callback work (report handoff, checkpoint snapshot
                     # dispatch) is host-side loop time — attribute it
                     tc = time.perf_counter()
                     on_launch(metrics)
-                    self.host_s += time.perf_counter() - tc
+                    tax = time.perf_counter() - tc
+                    self.host_s += tax
+                    if rec is not None and seq:
+                        rec.add_host_tax(seq, tax)
             elif stacked:
                 # pre-stacked ragged tail (k < K, or any stacked input
                 # once the driver degraded to K=1) — slice and single-step
+                rec_data_s, rec_t0 = 0.0, None
                 import jax
 
                 k = self._lead(batch)
@@ -235,6 +325,7 @@ class StepDriver:
                     params, opt_state, last_metrics = self._run_single(
                         params, opt_state, b, on_launch=on_launch)
             else:
+                rec_data_s, rec_t0 = 0.0, None
                 params, opt_state, last_metrics = self._run_single(
                     params, opt_state, batch, t_host0=t0,
                     on_launch=on_launch)

@@ -161,6 +161,28 @@ async def _collect_async(gcs_address: str, window_s: float,
         except Exception:  # noqa: BLE001 — RLHF plane optional
             pass
 
+        # train plane: each StepDriver's flight recorder pushes a compact
+        # @train/ snapshot (util/train_recorder.py); the key SURVIVES the
+        # driver (postmortem reads) so staleness is decided at diagnose
+        # time, not collection time
+        trains: List[Dict] = []
+        try:
+            keys = (await gcs.call("kv_keys", {"prefix": "@train/"},
+                                   timeout=10.0))["keys"]
+            replies = await asyncio.gather(
+                *(gcs.call("kv_get", {"key": k}, timeout=10.0)
+                  for k in keys[:50]))
+            for reply in replies:
+                raw = reply.get("value")
+                if not raw:
+                    continue
+                try:
+                    trains.append(json.loads(raw))
+                except ValueError:
+                    continue
+        except Exception:  # noqa: BLE001 — train plane optional
+            pass
+
         # serve plane: the controller pushes a compact status snapshot to
         # the KV every reconcile tick (serve/controller.py) — readable
         # here without attaching a driver
@@ -177,7 +199,7 @@ async def _collect_async(gcs_address: str, window_s: float,
                 "window_s": window_s, "nodes": probed, "actors": actors,
                 "failures": failures, "oom_kills": ooms,
                 "ledgers": ledgers, "serve": serve_status,
-                "engines": engines, "rlhf": rlhf,
+                "engines": engines, "rlhf": rlhf, "trains": trains,
                 "sched_balance": sched_balance}
     finally:
         try:
@@ -207,7 +229,9 @@ def diagnose(report: Dict[str, Any],
              imbalance_warn: float = 0.5,
              tick_gap_warn_s: float = 0.5,
              slo_warn: float = 0.9,
-             bubble_warn: float = 0.75) -> List[Tuple[str, str]]:
+             bubble_warn: float = 0.75,
+             launch_gap_warn_s: float = 0.25,
+             data_wait_warn: float = 0.25) -> List[Tuple[str, str]]:
     """Turn the raw report into ranked ``(level, message)`` findings.
     Any CRITICAL finding makes the cluster unhealthy (exit 1)."""
     findings: List[Tuple[str, str]] = []
@@ -445,6 +469,44 @@ def diagnose(report: Dict[str, Any],
                              f"completed iteration since (see `rt rlhf "
                              f"stats`)"))
 
+    # -- train flight recorder (@train/ snapshots) ---------------------------
+    # SUSTAINED signals only, same discipline as the engine findings: one
+    # wide launch gap is a checkpoint fence; the last three gaps all above
+    # the threshold means the devices idle launch after launch with a
+    # stacked batch in hand. data_wait grading needs a nonzero window —
+    # an idle driver (no launches in the ring) trains nothing and that's
+    # fine. Stale snapshots are skipped, NOT failed: the @train/ key
+    # deliberately survives the driver for postmortem reads.
+    for snap in report.get("trains") or ():
+        if now - snap.get("t", 0.0) > 30.0:
+            continue
+        s = snap.get("summary") or {}
+        if not (s.get("window_launches") or 0):
+            continue  # idle driver — nothing to grade
+        label = (f"{str(snap.get('node', '?'))[:12]}:"
+                 f"{snap.get('name', 'train')}")
+        lgaps = (s.get("gap_recent") or [])[-3:]
+        if len(lgaps) >= 3 and all(g > launch_gap_warn_s for g in lgaps):
+            findings.append((WARN,
+                             f"train driver {label} launch-gap sustained "
+                             f"at {max(lgaps):.3f}s (> "
+                             f"{launch_gap_warn_s:.3f}s over {len(lgaps)} "
+                             f"launches — devices idle between launches "
+                             f"with a stacked batch available; see `rt "
+                             f"train stats`)"))
+        dw = s.get("data_wait_frac")
+        if dw is not None and dw > data_wait_warn:
+            wf = (s.get("waterfall") or {}).get("mfu_cost") or {}
+            cost = wf.get("data_wait")
+            cost_note = (f", costing {cost:.3f} MFU"
+                         if cost is not None else "")
+            findings.append((WARN,
+                             f"train driver {label} data-starved: "
+                             f"data_wait is {dw:.0%} of the window wall "
+                             f"(> {data_wait_warn:.0%}{cost_note} — the "
+                             f"loader, not the devices, bounds "
+                             f"throughput; see `rt train stats`)"))
+
     # -- leak suspects (memory plane) ----------------------------------------
     try:
         from ray_tpu.util.memory import (_merge_owner_info,
@@ -506,6 +568,7 @@ def run(gcs_address: str, window_s: float = 600.0, queue_warn: int = 100,
         queue_wait_warn_s: float = 10.0, serve_p99_warn_s: float = 5.0,
         imbalance_warn: float = 0.5, tick_gap_warn_s: float = 0.5,
         slo_warn: float = 0.9, bubble_warn: float = 0.75,
+        launch_gap_warn_s: float = 0.25, data_wait_warn: float = 0.25,
         as_json: bool = False
         ) -> Tuple[str, int]:
     """Collect + diagnose + render; returns (text, exit_code). Exit 2 when
@@ -520,7 +583,9 @@ def run(gcs_address: str, window_s: float = 600.0, queue_warn: int = 100,
                         serve_p99_warn_s=serve_p99_warn_s,
                         imbalance_warn=imbalance_warn,
                         tick_gap_warn_s=tick_gap_warn_s,
-                        slo_warn=slo_warn, bubble_warn=bubble_warn)
+                        slo_warn=slo_warn, bubble_warn=bubble_warn,
+                        launch_gap_warn_s=launch_gap_warn_s,
+                        data_wait_warn=data_wait_warn)
     if as_json:
         rc = exit_code(findings)
         payload = dict(report,

@@ -47,6 +47,9 @@ on the tick path froze admission AND decode, warm p99 181 ms → 2.6 s):
 the tick path ONLY appends to bounded in-process deques under a
 microsecond lock — metrics observation, span emission, the ``@engine/``
 KV snapshot and the timeline event push all happen on the drain thread.
+The ring-buffer + watermark-drain + self-timing substrate lives in
+``util/recorder_core.py`` (shared with the RLHF and train recorders);
+this module owns only the engine-specific vocabulary and accounting.
 The recorder times itself: ``overhead_s`` accumulates the wall spent
 inside recorder calls on the engine thread, and ``summary()`` reports it
 as a fraction of recorded tick wall (the bench gate holds it ≤ 2%).
@@ -57,12 +60,13 @@ predicate check per tick.
 
 from __future__ import annotations
 
-import json
 import os
-import threading
 import time
 from collections import OrderedDict, deque
 from typing import Any, Dict, List, Optional
+
+from ray_tpu.util.recorder_core import (RecorderCore, RecorderRegistry,
+                                        pct as _pct)
 
 _ENABLED_DEFAULT = os.environ.get("RT_ENGINE_RECORDER", "1") \
     not in ("", "0", "false")
@@ -76,25 +80,16 @@ _KV_PREFIX = "@engine/"
 TICK_PHASES = ("admission", "kv_restore", "prefill", "decode_step",
                "token_delivery", "swap_barrier")
 
-_recorders: "OrderedDict[int, Any]" = OrderedDict()  # rt: guarded-by(_recorders_lock)
-_recorders_lock = threading.Lock()
+_REGISTRY = RecorderRegistry()
 
 
 def live_recorders() -> List["EngineRecorder"]:
     """Every recorder constructed in this process and not yet closed —
     the local engine_stats path and tests read through this."""
-    with _recorders_lock:
-        return list(_recorders.values())
+    return _REGISTRY.live()
 
 
-def _pct(sorted_vals: List[float], q: float) -> float:
-    if not sorted_vals:
-        return 0.0
-    idx = min(len(sorted_vals) - 1, int(q * (len(sorted_vals) - 1) + 0.5))
-    return sorted_vals[idx]
-
-
-class EngineRecorder:
+class EngineRecorder(RecorderCore):
     """Bounded flight recorder for one ``ContinuousEngine``.
 
     The ENGINE THREAD is the only writer of tick records and the only
@@ -103,6 +98,11 @@ class EngineRecorder:
     lives behind one lock held for O(1) appends — never across a device
     call, an RPC, or a metrics observation.
     """
+
+    KV_PREFIX = _KV_PREFIX
+    DRAIN_S = _DRAIN_S
+    THREAD_NAME = "rt-engine-rec"
+    REGISTRY = _REGISTRY
 
     def __init__(self, name: str = "engine", *, max_slots: int = 8,
                  ttft_slo_s: Optional[float] = None,
@@ -118,7 +118,7 @@ class EngineRecorder:
             os.environ.get("RT_ENGINE_TPOT_SLO_MS", "150")) / 1e3 \
             if tpot_slo_s is None else float(tpot_slo_s)
         cap = max(64, int(cap))
-        self._lock = threading.Lock()
+        self._init_core(self.name)
         self._ticks: "deque[Dict[str, Any]]" = deque(maxlen=cap)  # rt: guarded-by(_lock)
         self._active: "OrderedDict[int, Dict[str, Any]]" = \
             OrderedDict()  # rt: guarded-by(_lock)
@@ -127,8 +127,6 @@ class EngineRecorder:
             deque(maxlen=_SLO_WINDOW)  # rt: guarded-by(_lock)
         self._tick_seq = 0  # rt: guarded-by(_lock)
         self._req_seq = 0  # rt: guarded-by(_lock)
-        self._overhead_s = 0.0  # rt: guarded-by(_lock)
-        self._wall_total_s = 0.0  # rt: guarded-by(_lock)
         self._swaps = 0  # rt: guarded-by(_lock)
         self._last_swap: Optional[Dict[str, Any]] = None  # rt: guarded-by(_lock)
         self._requests_total = 0  # rt: guarded-by(_lock)
@@ -140,14 +138,6 @@ class EngineRecorder:
         self._span_req_wm = 0
         self._event_tick_wm = 0
         self._event_req_wm = 0
-        self._closed = False  # rt: guarded-by(_lock)
-        self._drainer: Optional[threading.Thread] = None  # rt: guarded-by(_lock)
-        self._kv_key = f"{_KV_PREFIX}{os.uname().nodename}:{os.getpid()}:" \
-                       f"{self.name}"
-        with _recorders_lock:
-            _recorders[id(self)] = self
-            while len(_recorders) > 64:  # bound the registry itself
-                _recorders.popitem(last=False)
 
     # -- tick path (engine thread) ---------------------------------------
 
@@ -290,8 +280,6 @@ class EngineRecorder:
         with self._lock:
             ticks = list(self._ticks)
             window = list(self._window)
-            wall_total = self._wall_total_s
-            overhead = self._overhead_s
             active = len(self._active)
             base = {"requests_total": self._requests_total,
                     "cancelled_total": self._cancelled_total,
@@ -305,10 +293,7 @@ class EngineRecorder:
         out["max_slots"] = self.max_slots
         out["ttft_slo_s"] = self.ttft_slo_s
         out["tpot_slo_s"] = self.tpot_slo_s
-        out["overhead_s"] = round(overhead, 6)
-        out["recorded_wall_s"] = round(wall_total, 6)
-        out["overhead_frac"] = round(overhead / wall_total, 6) \
-            if wall_total > 0 else 0.0
+        self._overhead_fields(out)
         return out
 
     def window_summary(self, t0: float, t1: float) -> Dict[str, Any]:
@@ -403,13 +388,13 @@ class EngineRecorder:
                  requests_limit: int = 64) -> Dict[str, Any]:
         """The ``@engine/`` KV payload: summary + record tails, compact
         enough to push every couple of seconds."""
-        return {"t": time.time(), "name": self.name,
-                "node": os.uname().nodename, "pid": os.getpid(),
-                "summary": self.summary(),
-                "ticks": [self._compact_tick(t)
-                          for t in self.ticks(ticks_limit)],
-                "requests": [self._compact_req(r)
-                             for r in self.requests(requests_limit)]}
+        out = self._snapshot_header()
+        out["summary"] = self.summary()
+        out["ticks"] = [self._compact_tick(t)
+                        for t in self.ticks(ticks_limit)]
+        out["requests"] = [self._compact_req(r)
+                           for r in self.requests(requests_limit)]
+        return out
 
     @staticmethod
     def _compact_tick(t: Dict[str, Any]) -> Dict[str, Any]:
@@ -440,40 +425,7 @@ class EngineRecorder:
             out["request_id"] = r["request_id"]
         return out
 
-    # -- off-tick drain ----------------------------------------------------
-
-    def _ensure_drainer(self) -> None:
-        if self._drainer is not None and self._drainer.is_alive():
-            return
-        with self._lock:
-            if self._closed or (self._drainer is not None
-                                and self._drainer.is_alive()):
-                return
-            self._drainer = threading.Thread(
-                target=self._drain_loop, daemon=True,
-                name=f"rt-engine-rec:{self.name}")
-            self._drainer.start()
-
-    def _drain_loop(self) -> None:
-        while True:
-            time.sleep(_DRAIN_S)
-            with self._lock:
-                if self._closed:
-                    return
-            try:
-                self.drain_now()
-            except Exception:  # noqa: BLE001 — observability must never
-                pass           # take the engine down
-
-    def drain_now(self) -> Dict[str, int]:
-        """One drain pass (tests call this instead of waiting out the
-        interval): metrics observation, span emission for completed
-        requests carrying a serve context, the ``@engine/`` KV snapshot,
-        and tick/request events into the GCS task-event store."""
-        counts = {"metrics": self._drain_metrics(),
-                  "spans": self._drain_spans()}
-        counts.update(self._drain_gcs())
-        return counts
+    # -- off-tick drain (template in recorder_core; hooks below) ----------
 
     def _pending_since(self, wm_attr: str, ticks: bool) -> List[Dict]:
         with self._lock:
@@ -561,29 +513,9 @@ class EngineRecorder:
                 pass
         return n
 
-    def _drain_gcs(self) -> Dict[str, int]:
-        """KV snapshot + timeline events; both best-effort, both skipped
-        cleanly outside an initialized cluster runtime."""
-        out = {"kv": 0, "events": 0}
-        try:
-            import ray_tpu
-
-            if not ray_tpu.is_initialized():
-                return out
-            backend = ray_tpu.global_worker()._require_backend()
-        except Exception:  # noqa: BLE001
-            return out
-        try:
-            if hasattr(backend, "kv_put"):
-                backend.kv_put(self._kv_key,
-                               json.dumps(self.snapshot()).encode())
-                out["kv"] = 1
-        except Exception:  # noqa: BLE001
-            pass
-        if not hasattr(backend, "_gcs"):
-            return out
-        node = os.uname().nodename
-        pid = os.getpid()
+    def _build_events(self, node: str, pid: int):
+        """Tick + request records as GCS task events; the advance
+        closure runs only after a successful push."""
         events = []
         new_ticks = self._pending_since("_event_tick_wm", ticks=True)
         for t in new_ticks[-256:]:
@@ -605,39 +537,14 @@ class EngineRecorder:
                 "engine_request": {**{k: v for k, v in r.items()
                                       if not k.startswith("parent_")},
                                    "engine": self.name}})
-        if not events:
-            return out
-        try:
-            backend.io.run(backend._gcs.call("task_events",
-                                             {"events": events}))
+
+        def advance() -> None:
             if new_ticks:
                 self._event_tick_wm = new_ticks[-1]["seq"]
             if new_reqs:
                 self._event_req_wm = new_reqs[-1]["seq"]
-            out["events"] = len(events)
-        except Exception:  # noqa: BLE001
-            pass
-        return out
 
-    def close(self) -> None:
-        """Stop the drain thread and drop the KV snapshot (the doctor
-        must not grade a dead engine's numbers — same discipline as the
-        serve controller's shutdown)."""
-        with self._lock:
-            if self._closed:
-                return
-            self._closed = True
-        with _recorders_lock:
-            _recorders.pop(id(self), None)
-        try:
-            import ray_tpu
-
-            if ray_tpu.is_initialized():
-                backend = ray_tpu.global_worker()._require_backend()
-                if hasattr(backend, "kv_del"):
-                    backend.kv_del(self._kv_key)
-        except Exception:  # noqa: BLE001
-            pass
+        return events, advance
 
 
 _metric_cache: Optional[Dict[str, Any]] = None

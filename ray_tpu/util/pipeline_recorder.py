@@ -34,8 +34,11 @@ What one ITERATION record holds:
 Discipline (the engine recorder's, verbatim): the driver path ONLY
 appends to bounded in-process deques under a microsecond lock — metrics
 observation, the ``@rlhf/`` KV snapshot and the timeline event push all
-happen on a separate drain thread. The recorder times itself:
-``overhead_s`` accumulates wall spent inside recorder calls and
+happen on a separate drain thread. The ring-buffer + watermark-drain +
+self-timing substrate lives in ``util/recorder_core.py`` (shared with
+the engine and train recorders); this module owns only the RLHF
+vocabulary and the bubble/tax/staleness accounting. The recorder times
+itself: ``overhead_s`` accumulates wall spent inside recorder calls and
 ``summary()`` reports it as a fraction of recorded iteration wall (the
 bench gate holds it ≤ 2%).
 
@@ -45,12 +48,13 @@ predicate check per iteration.
 
 from __future__ import annotations
 
-import json
 import os
-import threading
 import time
-from collections import OrderedDict, deque
+from collections import deque
 from typing import Any, Dict, List, Optional
+
+from ray_tpu.util.recorder_core import (RecorderCore, RecorderRegistry,
+                                        pct as _pct)
 
 _ENABLED_DEFAULT = os.environ.get("RT_RLHF_RECORDER", "1") \
     not in ("", "0", "false")
@@ -80,21 +84,12 @@ DRIVER_PHASE_ACTORS = {"generate": ("generate",),
 
 ROLES = ("generator", "reference", "reward", "learner")
 
-_recorders: "OrderedDict[int, Any]" = OrderedDict()  # rt: guarded-by(_recorders_lock)
-_recorders_lock = threading.Lock()
+_REGISTRY = RecorderRegistry()
 
 
 def live_recorders() -> List["PipelineRecorder"]:
     """Every recorder constructed in this process and not yet closed."""
-    with _recorders_lock:
-        return list(_recorders.values())
-
-
-def _pct(sorted_vals: List[float], q: float) -> float:
-    if not sorted_vals:
-        return 0.0
-    idx = min(len(sorted_vals) - 1, int(q * (len(sorted_vals) - 1) + 0.5))
-    return sorted_vals[idx]
+    return _REGISTRY.live()
 
 
 def bubble_attribution(intervals: List[Dict[str, Any]],
@@ -153,7 +148,7 @@ def bubble_attribution(intervals: List[Dict[str, Any]],
     }
 
 
-class PipelineRecorder:
+class PipelineRecorder(RecorderCore):
     """Bounded flight recorder for one ``RLHFPipeline``.
 
     The DRIVER THREAD is the only writer (`record_iteration` /
@@ -163,30 +158,25 @@ class PipelineRecorder:
     observation.
     """
 
+    KV_PREFIX = _KV_PREFIX
+    DRAIN_S = _DRAIN_S
+    THREAD_NAME = "rt-rlhf-rec"
+    REGISTRY = _REGISTRY
+
     def __init__(self, name: str = "rlhf", *, cap: int = _CAP,
                  enabled: Optional[bool] = None):
         self.name = name or "rlhf"
         self.enabled = _ENABLED_DEFAULT if enabled is None else bool(enabled)
         cap = max(64, int(cap))
-        self._lock = threading.Lock()
+        self._init_core(self.name)
         self._iters: "deque[Dict[str, Any]]" = deque(maxlen=cap)  # rt: guarded-by(_lock)
         self._seq = 0  # rt: guarded-by(_lock)
-        self._overhead_s = 0.0  # rt: guarded-by(_lock)
-        self._wall_total_s = 0.0  # rt: guarded-by(_lock)
         self._interrupted_total = 0  # rt: guarded-by(_lock)
         self._last_interrupt_t: Optional[float] = None  # rt: guarded-by(_lock)
         # drain-side watermarks (drain thread only; the lock still guards
         # the snapshot reads that feed them)
         self._metrics_wm = 0
         self._event_wm = 0
-        self._closed = False  # rt: guarded-by(_lock)
-        self._drainer: Optional[threading.Thread] = None  # rt: guarded-by(_lock)
-        self._kv_key = f"{_KV_PREFIX}{os.uname().nodename}:{os.getpid()}:" \
-                       f"{self.name}"
-        with _recorders_lock:
-            _recorders[id(self)] = self
-            while len(_recorders) > 64:  # bound the registry itself
-                _recorders.popitem(last=False)
 
     # -- driver path -------------------------------------------------------
 
@@ -293,8 +283,6 @@ class PipelineRecorder:
         doctor bubble finding and the gauges read."""
         with self._lock:
             recs = list(self._iters)
-            overhead = self._overhead_s
-            wall_total = self._wall_total_s
             interrupted = self._interrupted_total
             total = self._seq
         ok = [r for r in recs if r["state"] == "ok"]
@@ -365,20 +353,17 @@ class PipelineRecorder:
             out["interrupted_last"] = {"phase": last_int[-1]["phase"],
                                        "t": last_int[-1]["t"],
                                        "error": last_int[-1]["error"]}
-        out["overhead_s"] = round(overhead, 6)
-        out["recorded_wall_s"] = round(wall_total, 6)
-        out["overhead_frac"] = round(overhead / wall_total, 6) \
-            if wall_total > 0 else 0.0
+        self._overhead_fields(out)
         return out
 
     def snapshot(self, iters_limit: int = 32) -> Dict[str, Any]:
         """The ``@rlhf/`` KV payload: summary + iteration-record tail,
         compact enough to push every couple of seconds."""
-        return {"t": time.time(), "name": self.name,
-                "node": os.uname().nodename, "pid": os.getpid(),
-                "summary": self.summary(),
-                "iterations": [self._compact_iter(r)
-                               for r in self.iterations(iters_limit)]}
+        out = self._snapshot_header()
+        out["summary"] = self.summary()
+        out["iterations"] = [self._compact_iter(r)
+                             for r in self.iterations(iters_limit)]
+        return out
 
     @staticmethod
     def _compact_iter(r: Dict[str, Any]) -> Dict[str, Any]:
@@ -402,38 +387,7 @@ class PipelineRecorder:
             out["receipt"] = r["receipt"]
         return out
 
-    # -- off-driver drain --------------------------------------------------
-
-    def _ensure_drainer(self) -> None:
-        if self._drainer is not None and self._drainer.is_alive():
-            return
-        with self._lock:
-            if self._closed or (self._drainer is not None
-                                and self._drainer.is_alive()):
-                return
-            self._drainer = threading.Thread(
-                target=self._drain_loop, daemon=True,
-                name=f"rt-rlhf-rec:{self.name}")
-            self._drainer.start()
-
-    def _drain_loop(self) -> None:
-        while True:
-            time.sleep(_DRAIN_S)
-            with self._lock:
-                if self._closed:
-                    return
-            try:
-                self.drain_now()
-            except Exception:  # noqa: BLE001 — observability must never
-                pass           # take the pipeline down
-
-    def drain_now(self) -> Dict[str, int]:
-        """One drain pass (tests call this instead of waiting out the
-        interval): metrics observation, the ``@rlhf/`` KV snapshot, and
-        iteration events into the GCS task-event store."""
-        counts = {"metrics": self._drain_metrics()}
-        counts.update(self._drain_gcs())
-        return counts
+    # -- off-driver drain (template in recorder_core; hooks below) ---------
 
     def _pending_since(self, wm_attr: str) -> List[Dict]:
         with self._lock:
@@ -476,29 +430,9 @@ class PipelineRecorder:
             h["overhead"].set(summ["overhead_frac"], tags=tags)
         return len(new)
 
-    def _drain_gcs(self) -> Dict[str, int]:
-        """KV snapshot + timeline events; both best-effort, both skipped
-        cleanly outside an initialized cluster runtime."""
-        out = {"kv": 0, "events": 0}
-        try:
-            import ray_tpu
-
-            if not ray_tpu.is_initialized():
-                return out
-            backend = ray_tpu.global_worker()._require_backend()
-        except Exception:  # noqa: BLE001
-            return out
-        try:
-            if hasattr(backend, "kv_put"):
-                backend.kv_put(self._kv_key,
-                               json.dumps(self.snapshot()).encode())
-                out["kv"] = 1
-        except Exception:  # noqa: BLE001
-            pass
-        if not hasattr(backend, "_gcs"):
-            return out
-        node = os.uname().nodename
-        pid = os.getpid()
+    def _build_events(self, node: str, pid: int):
+        """Iteration records as GCS task events; the advance closure
+        runs only after a successful push."""
         events = []
         new = self._pending_since("_event_wm")
         for r in new[-128:]:
@@ -520,35 +454,12 @@ class PipelineRecorder:
                                  if k not in ("role_busy_s",
                                               "role_idle_s")},
                               "pipeline": self.name}})
-        if not events:
-            return out
-        try:
-            backend.io.run(backend._gcs.call("task_events",
-                                             {"events": events}))
-            self._event_wm = new[-1]["seq"]
-            out["events"] = len(events)
-        except Exception:  # noqa: BLE001
-            pass
-        return out
 
-    def close(self) -> None:
-        """Stop the drain thread and drop the KV snapshot (the doctor
-        must not grade a dead pipeline's numbers)."""
-        with self._lock:
-            if self._closed:
-                return
-            self._closed = True
-        with _recorders_lock:
-            _recorders.pop(id(self), None)
-        try:
-            import ray_tpu
+        def advance() -> None:
+            if new:
+                self._event_wm = new[-1]["seq"]
 
-            if ray_tpu.is_initialized():
-                backend = ray_tpu.global_worker()._require_backend()
-                if hasattr(backend, "kv_del"):
-                    backend.kv_del(self._kv_key)
-        except Exception:  # noqa: BLE001
-            pass
+        return events, advance
 
 
 _metric_cache: Optional[Dict[str, Any]] = None

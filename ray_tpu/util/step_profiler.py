@@ -61,6 +61,20 @@ _seq = 0
 _drained_seq = 0
 _epoch = 0
 _per_kind_step: Dict[str, int] = {}
+# per-kind authoritative launch/step counters (PR 20 reconciliation):
+# when a flight recorder owns the instrumentation point it registers a
+# source here and summary(kind) reads ITS join, so `rt profile`'s st/ln
+# column and `rt train stats` can never drift apart
+_launch_sources: Dict[str, Any] = {}  # rt: guarded-by(_lock)
+
+
+def register_launch_source(kind: str, fn: Any) -> None:
+    """Register ``fn() -> Optional[{"launches": int, "steps": int}]`` as
+    the authoritative launch/step counter for ``kind``. Idempotent; a
+    source returning None (nothing recorded yet) defers back to the
+    profiler's own records."""
+    with _lock:
+        _launch_sources[kind] = fn
 
 
 def is_enabled() -> bool:
@@ -273,7 +287,21 @@ def summary(kind: Optional[str] = None) -> Dict[str, Any]:
     wall = sum(r.wall_s for r in steady)
     launches = sum(r.launches for r in rs)
     steps = sum(getattr(r, "steps", 1) for r in rs)
+    launch_source = None
+    if kind is not None:
+        with _lock:
+            src = _launch_sources.get(kind)
+        if src is not None:
+            try:
+                joined = src()
+            except Exception:  # noqa: BLE001 — a broken source must not
+                joined = None  # take the profile table down
+            if joined and joined.get("launches"):
+                launches = int(joined["launches"])
+                steps = int(joined.get("steps", steps))
+                launch_source = "recorder"
     return {
+        **({"launch_source": launch_source} if launch_source else {}),
         "records": len(rs),
         "compile_s": sum(r.compile_s for r in rs),
         "mean_wall_s": wall / n,

@@ -66,6 +66,10 @@ def timeline(filename: Optional[str] = None) -> List[Dict[str, Any]]:
         if rit:
             trace.extend(_rlhf_iter_lanes(ev, rit))
             continue
+        tl = ev.get("train_launch")
+        if tl:
+            trace.extend(_train_launch_lanes(ev, tl))
+            continue
         is_serve = str(ev.get("task_id", "")).startswith("serve:")
         times = ev.get("times", {})
         start = times.get("RUNNING") or times.get("PENDING")
@@ -339,6 +343,67 @@ def _rlhf_iter_lanes(ev: Dict[str, Any], rit: Dict[str, Any]
                     "dur": max(0.0, t1 - t0) * 1e6, "pid": pid,
                     "tid": f"rlhf:{name}:{iv.get('role', 'role')}",
                     "args": {"seconds": round(max(0.0, t1 - t0), 6)}})
+    return out
+
+
+def _train_launch_lanes(ev: Dict[str, Any], tl: Dict[str, Any]
+                        ) -> List[Dict[str, Any]]:
+    """One fused-K train launch (util/train_recorder.py) -> its lanes:
+    the full launch span on ``train:<name>:launches`` with the phase
+    partition laid out consecutively on ``...:phases`` (launch order:
+    data_wait -> h2d -> dispatch/compile -> device_compute), plus a
+    ``gap`` span BEFORE the launch when dispatch starvation was stamped —
+    a data-starved run reads as wide data_wait spans, a host-bound run
+    as gap spans between back-to-back launches."""
+    pid = ev.get("node_id") or "node"
+    name = tl.get("driver", "train")
+    ts = tl.get("t", 0.0) * 1e6
+    phases = tl.get("phases") or {}
+    out = [{
+        "name": f"launch k={tl.get('k', 0)}",
+        "cat": "train", "ph": "X", "ts": ts,
+        "dur": max(0.0, tl.get("wall_s", 0.0)) * 1e6,
+        "pid": pid, "tid": f"train:{name}:launches",
+        "args": {"seq": tl.get("seq"), "k": tl.get("k"),
+                 "tokens": tl.get("tokens"),
+                 "batch_shape": tl.get("batch_shape"),
+                 "flops": tl.get("flops"), "gap_s": tl.get("gap_s")},
+    }]
+    gap = tl.get("gap_s") or 0.0
+    if gap > 0:
+        # the devices idled for `gap` before this dispatch with a stacked
+        # batch in hand — anchor the span at dispatch start, minus gap
+        disp_t = ts + (phases.get("data_wait", 0.0)
+                       + phases.get("h2d", 0.0)) * 1e6
+        out.append({"name": "gap", "cat": "train", "ph": "X",
+                    "ts": disp_t - gap * 1e6, "dur": gap * 1e6,
+                    "pid": pid, "tid": f"train:{name}:gap"})
+    from ray_tpu.util.train_recorder import LAUNCH_PHASES
+
+    t = ts
+    for pname in LAUNCH_PHASES:
+        if pname == "host_tax":
+            continue  # overlaps device_compute — not part of the chain
+        secs = phases.get(pname) or 0.0
+        if secs <= 0.0:
+            continue
+        dur = secs * 1e6
+        out.append({"name": pname, "cat": "train_phase", "ph": "X",
+                    "ts": t, "dur": dur, "pid": pid,
+                    "tid": f"train:{name}:phases",
+                    "args": {"seconds": secs}})
+        t += dur
+    tax = phases.get("host_tax") or 0.0
+    if tax > 0:
+        # host_tax runs concurrently with device_compute (the callback
+        # fires after dispatch returns) — its own lane, not the chain
+        disp_end = ts + sum((phases.get(p) or 0.0) * 1e6
+                            for p in ("data_wait", "h2d", "dispatch",
+                                      "compile"))
+        out.append({"name": "host_tax", "cat": "train_phase", "ph": "X",
+                    "ts": disp_end, "dur": tax * 1e6, "pid": pid,
+                    "tid": f"train:{name}:host_tax",
+                    "args": {"seconds": tax}})
     return out
 
 

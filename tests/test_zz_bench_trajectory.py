@@ -112,6 +112,32 @@ def test_rlhf_recorder_series_registered_and_guarded(tmp_path):
     assert not errors and not regressions, (errors, regressions)
 
 
+def test_train_recorder_series_registered_and_guarded(tmp_path):
+    """The TRAIN family must register the train flight-recorder series
+    (MFU gap / launch-gap p99 / data-wait share, all lower-is-better)
+    and flag a wrong-direction move on each."""
+    cb = _load_checker()
+    train_keys = dict(cb.KEY_SERIES["TRAIN_r*.json"])
+    for key in ("summary.mfu_gap_frac", "summary.launch_gap_p99_s",
+                "summary.data_wait_frac"):
+        assert train_keys.get(key) == "lower", (key, train_keys)
+    mk = lambda gap, p99, dw: {
+        "summary": {"mfu_gap_frac": gap, "launch_gap_p99_s": p99,
+                    "data_wait_frac": dw},
+        "offload": {"async": {"sustained_tok_s_chip": 1000.0},
+                    "speedup": 1.5}}
+    _write(tmp_path, "TRAIN_r01.json", mk(0.10, 0.05, 0.02))
+    _write(tmp_path, "TRAIN_r02.json", mk(0.30, 0.20, 0.10))
+    errors, regressions, _ = cb.check(str(tmp_path))
+    assert not errors, errors
+    for key in ("mfu_gap_frac", "launch_gap_p99_s", "data_wait_frac"):
+        assert any(key in r for r in regressions), (key, regressions)
+    # a round that improves every recorder series must be clean
+    _write(tmp_path, "TRAIN_r02.json", mk(0.05, 0.0, 0.01))
+    errors, regressions, _ = cb.check(str(tmp_path))
+    assert not errors and not regressions, (errors, regressions)
+
+
 def test_series_resolves_from_newest_carrier(tmp_path):
     """A focused later round that skips a series must not fail the gate —
     the series resolves from the newest round that carries it."""

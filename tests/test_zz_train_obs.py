@@ -1,0 +1,504 @@
+"""Train flight recorder (``util/train_recorder.py``): per-launch phase
+attribution on a real fused StepDriver run, launch-gap semantics, the
+MFU-gap waterfall math, the ``/api/train`` + ``rt train`` surfaces,
+doctor findings, and the bounded-memory property. Named ``test_zz_*`` so
+it sorts late."""
+
+import contextlib
+import io
+import json
+import time
+import urllib.request
+from argparse import Namespace
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from ray_tpu.models import llama  # noqa: E402
+from ray_tpu.util import train_recorder as TR  # noqa: E402
+
+
+# ---------------------------------------------------------------------------
+# one shared fused-K run on the real driver — the record set the
+# end-to-end attribution tests read
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def driver_run():
+    from ray_tpu.parallel import train_step as ts
+    from ray_tpu.train.driver import StepDriver
+
+    cfg = llama.PRESETS["debug"]
+    K, BATCH, SEQ = 2, 2, 16
+    opt = ts.default_optimizer(total_steps=100)
+    params = llama.init_params(jax.random.key(0), cfg)
+    opt_state = jax.jit(opt.init)(params)
+    driver = StepDriver(cfg, opt, steps_per_launch=K)
+    assert driver.fused and driver.recorder is not None
+    rng = np.random.default_rng(3)
+
+    def batches(n):
+        for _ in range(n):
+            yield {"tokens": rng.integers(
+                0, cfg.vocab_size, (BATCH, SEQ + 1)).astype(np.int32)}
+
+    taxes = []
+    params, opt_state, _m = driver.run(
+        params, opt_state, batches(4 * K),
+        on_launch=lambda m: taxes.append(
+            float(np.asarray(m["loss"]).ravel()[-1])))
+    rec = driver.recorder
+    deadline = time.time() + 10.0
+    while time.time() < deadline and rec.summary().get("in_flight"):
+        time.sleep(0.01)  # let the done-hook watcher close the records
+    yield driver, rec, taxes
+    rec.close()
+
+
+def test_launch_phase_sums_and_overhead(driver_run):
+    """The stamped phases partition each launch's wall to within the
+    tentpole's ±5%/10% honesty bar, every record closes through the
+    async done-hook, and the recorder's self-timed overhead stays under
+    the 2% budget."""
+    driver, rec, taxes = driver_run
+    assert len(taxes) == 4  # 4 fused launches of K=2
+    summ = rec.summary()
+    assert summ["in_flight"] == 0, summ  # the watcher closed every record
+    assert summ["window_launches"] == 4 and summ["steps"] == 8
+    assert summ["launches_total"] == 4 and summ["steps_total"] == 8
+    assert 0.90 <= summ["phase_sum_ratio"] <= 1.05, summ
+    assert summ["overhead_frac"] < 0.02, summ  # the ISSUE's overhead bar
+    recs = rec.launches()
+    assert all("t_done" in r and r["wall_s"] > 0 for r in recs)
+    for r in recs:
+        assert sum(r["phases"].values()) <= r["wall_s"] * 1.10, r
+    # the first launch compiles: its host call wall books as compile,
+    # not dispatch (step-profiler convention); warm launches invert
+    assert summ["compiles"] >= 1
+    assert recs[0]["phases"]["compile"] > 0.0
+    assert recs[0]["phases"]["dispatch"] == 0.0
+    assert recs[-1]["phases"]["dispatch"] > 0.0
+    assert recs[-1]["phases"]["compile"] == 0.0
+    # host_tax merged in from the on_launch callback wall
+    assert summ["phase_s"].get("host_tax", 0.0) >= 0.0
+    # K/tokens/shape geometry: [K, B, S+1] at K=2, B=2, S=16
+    assert all(r["k"] == 2 and r["batch_shape"] == [2, 2, 17]
+               for r in recs)
+    assert all(r["tokens"] == 2 * 2 * 16 and r["flops"] > 0 for r in recs)
+
+
+def test_profiler_launch_counts_join_recorder(driver_run):
+    """``rt profile``'s train row reads launch/step counts from the
+    recorder's registered source — one instrumentation point, so the two
+    surfaces cannot drift."""
+    from ray_tpu.util import step_profiler as SP
+
+    _driver, rec, _ = driver_run
+    with SP._lock:
+        assert "train" in SP._launch_sources
+    joined = TR._profiler_launch_join()
+    assert joined is not None
+    assert joined["launches"] >= 4 and joined["steps"] >= 8
+    # the profiler's own record count disagrees (it never saw these
+    # launches) — summary(kind) must prefer the recorder's join
+    SP.reset()
+    try:
+        SP.record("train", wall_s=0.01, launches=1, steps=1)
+        s = SP.summary("train")
+        assert s["launch_source"] == "recorder"
+        assert s["launches"] == joined["launches"]
+        assert s["steps"] == joined["steps"]
+        assert s["mean_steps_per_launch"] == pytest.approx(
+            joined["steps"] / joined["launches"])
+    finally:
+        SP.reset()
+
+
+# ---------------------------------------------------------------------------
+# launch-gap + waterfall math (synthetic records — no driver, no jax
+# dispatch; n_devices/peak pinned so the MFU arithmetic is exact)
+# ---------------------------------------------------------------------------
+
+def _synthetic(name="synth", cap=2048):
+    return TR.TrainRecorder(name, cap=cap, n_devices=1, peak_flops=1e9,
+                            enabled=True)
+
+
+def test_launch_gap_semantics_and_dry_reset():
+    """A gap is stamped ONLY when the stacked batch was ready before the
+    previous launch's device-done; a late batch is a dry reset (the
+    loader's fault, counted, never blamed on the devices)."""
+    rec = _synthetic("gap")
+    try:
+        s1 = rec.record_launch(t_start=1000.0, data_wait_s=0.01,
+                               h2d_s=0.01, dispatch_s=0.02,
+                               t_dispatch_end=1000.04)
+        recs = rec.launches()
+        assert "gap_s" not in recs[-1]  # first launch: nothing to gap to
+        rec.finalize_launch(s1, 1000.10)
+        # batch ready at 1000.05 < prev_done 1000.10, dispatch starts at
+        # 1000.22 -> the devices idled 0.12s with data in hand
+        rec.record_launch(t_start=1000.20, data_wait_s=0.01, h2d_s=0.01,
+                          dispatch_s=0.02, data_ready_t=1000.05,
+                          t_dispatch_end=1000.24)
+        r2 = rec.launches()[-1]
+        assert r2["gap_s"] == pytest.approx(0.12)
+        rec.finalize_launch(r2["seq"], 1000.30)
+        # batch only ready AFTER prev_done: genuinely dry -> no gap
+        rec.record_launch(t_start=1000.40, data_wait_s=0.05, h2d_s=0.01,
+                          dispatch_s=0.02, data_ready_t=1000.45,
+                          t_dispatch_end=1000.48)
+        r3 = rec.launches()[-1]
+        assert "gap_s" not in r3
+        rec.finalize_launch(r3["seq"], 1000.50)
+        assert rec.summary()["dry_resets"] == 1
+        # explicit loader_dry (epoch boundary): the next launch must not
+        # stamp a gap even with an early data_ready_t
+        rec.loader_dry()
+        rec.record_launch(t_start=1000.60, data_wait_s=0.01, h2d_s=0.01,
+                          dispatch_s=0.02, data_ready_t=1000.40,
+                          t_dispatch_end=1000.64)
+        assert "gap_s" not in rec.launches()[-1]
+        summ = rec.summary()
+        assert summ["dry_resets"] == 2
+        assert summ["launch_gap_max_s"] == pytest.approx(0.12)
+        assert summ["gap_recent"] == [pytest.approx(0.12)]
+    finally:
+        rec.close()
+
+
+def test_mfu_waterfall_math():
+    """raw -> achieved decomposes exactly: each bucket's MFU cost is
+    raw_mfu * bucket_s / span, attributions over-explaining the measured
+    lost wall are scaled down onto it, and the bucket costs + uncovered
+    sum back to the raw-achieved gap."""
+    rec = _synthetic("wf")
+    try:
+        # L1: 0.2s data_wait, 0.1 h2d, 0.1 dispatch, 0.1 device -> 0.5s
+        s1 = rec.record_launch(t_start=1000.0, data_wait_s=0.2,
+                               h2d_s=0.1, dispatch_s=0.1,
+                               t_dispatch_end=1000.4, flops=0.2e9,
+                               k=2, tokens=100)
+        rec.finalize_launch(s1, 1000.5)
+        # L2: batch ready early -> 0.1s gap; 0.1 data_wait, 0.1 dispatch,
+        # 0.2 device
+        s2 = rec.record_launch(t_start=1000.5, data_wait_s=0.1,
+                               h2d_s=0.0, dispatch_s=0.1,
+                               data_ready_t=1000.45,
+                               t_dispatch_end=1000.7, flops=0.3e9,
+                               k=2, tokens=100)
+        rec.add_host_tax(s2, 0.05)
+        rec.finalize_launch(s2, 1000.9)
+
+        s = rec.summary()
+        # span 0.9s; device busy = dispatch 0.2 + device_compute 0.3
+        assert s["span_s"] == pytest.approx(0.9)
+        assert s["device_s"] == pytest.approx(0.5)
+        # raw = 0.5e9 / (0.5 * 1e9) = 1.0; achieved = 0.5e9 / 0.9e9
+        assert s["raw_mfu"] == pytest.approx(1.0)
+        assert s["achieved_mfu"] == pytest.approx(0.5 / 0.9, abs=1e-4)
+        assert s["mfu_gap_frac"] == pytest.approx(1 - 0.5 / 0.9, abs=1e-3)
+        wf = s["waterfall"]
+        # lost wall 0.4s; raw attributions 0.3 dw + 0.1 gap + 0.05 tax
+        # = 0.45 over-explain it -> scaled by 0.4/0.45
+        assert wf["lost_s"] == pytest.approx(0.4)
+        scale = 0.4 / 0.45
+        assert wf["buckets_s"]["data_wait"] == pytest.approx(0.3 * scale,
+                                                            abs=1e-4)
+        assert wf["buckets_s"]["launch_gap"] == pytest.approx(0.1 * scale,
+                                                             abs=1e-4)
+        assert wf["buckets_s"]["host_tax"] == pytest.approx(0.05 * scale,
+                                                           abs=1e-4)
+        assert wf["buckets_s"]["compile"] == 0.0
+        assert wf["uncovered_s"] == pytest.approx(0.0, abs=1e-4)
+        # the exact decomposition: bucket costs + uncovered = raw - achieved
+        total_cost = sum(wf["mfu_cost"].values())
+        assert total_cost == pytest.approx(
+            s["raw_mfu"] - s["achieved_mfu"], abs=1e-3)
+        assert wf["mfu_cost"]["data_wait"] == pytest.approx(
+            1.0 * 0.3 * scale / 0.9, abs=1e-3)
+        # marginal series: per-launch flops / (wall * peak)
+        assert s["marginal_mfu"] == pytest.approx(0.3 / 0.4, abs=1e-3)
+        assert len(s["marginal_mfu_recent"]) == 2
+    finally:
+        rec.close()
+
+
+def test_waterfall_uncovered_residual():
+    """Attributions UNDER-explaining the lost wall surface the residual
+    as ``uncovered`` — the waterfall never stretches blame to fit."""
+    rec = _synthetic("uncov")
+    try:
+        # fully-covered case first: 0.05s lost, 0.05s attributed
+        s1 = rec.record_launch(t_start=2000.0, data_wait_s=0.05,
+                               h2d_s=0.0, dispatch_s=0.1,
+                               t_dispatch_end=2000.15, flops=0.1e9)
+        rec.finalize_launch(s1, 2000.5)  # 0.35s device_compute
+        s = rec.summary()
+        # device = 0.1 dispatch + 0.35 device_compute = 0.45; span 0.5
+        assert s["device_s"] == pytest.approx(0.45)
+        wf = s["waterfall"]
+        assert wf["lost_s"] == pytest.approx(0.05)
+        assert wf["buckets_s"]["data_wait"] == pytest.approx(0.05)
+        assert wf["uncovered_s"] == pytest.approx(0.0, abs=1e-6)
+        rec2 = _synthetic("uncov2")
+        try:
+            # a launch whose wall is mostly unattributed host wall: the
+            # derived dispatch-end fallback books it as device_compute,
+            # so here we pin dispatch-end late and stamp nothing for it
+            t1 = rec2.record_launch(t_start=3000.0, data_wait_s=0.02,
+                                    h2d_s=0.0, dispatch_s=0.1,
+                                    t_dispatch_end=3000.4, flops=0.1e9)
+            rec2.finalize_launch(t1, 3000.5)
+            s2 = rec2.summary()
+            wf2 = s2["waterfall"]
+            # lost = 0.5 - (0.1 + 0.1) = 0.3; only 0.02 attributed
+            assert wf2["lost_s"] == pytest.approx(0.3)
+            assert wf2["uncovered_s"] == pytest.approx(0.28, abs=1e-4)
+            assert wf2["mfu_cost"]["uncovered"] > 0
+        finally:
+            rec2.close()
+    finally:
+        rec.close()
+
+
+def test_window_summary_carves_launches():
+    rec = _synthetic("win")
+    try:
+        s1 = rec.record_launch(t_start=1000.0, data_wait_s=0.01,
+                               h2d_s=0.0, dispatch_s=0.05,
+                               t_dispatch_end=1000.06, tokens=64, k=2)
+        rec.finalize_launch(s1, 1000.1)
+        s2 = rec.record_launch(t_start=2000.0, data_wait_s=0.20,
+                               h2d_s=0.0, dispatch_s=0.05,
+                               t_dispatch_end=2000.25, tokens=32, k=2)
+        rec.finalize_launch(s2, 2000.3)
+        w = rec.window_summary(999.0, 1500.0)
+        assert w["window_launches"] == 1 and w["tokens"] == 64
+        assert w["phase_s"]["data_wait"] == pytest.approx(0.01)
+        w2 = rec.window_summary(1500.0, 2500.0)
+        assert w2["window_launches"] == 1 and w2["tokens"] == 32
+        assert w2["data_wait_frac"] == pytest.approx(0.2 / 0.3, abs=1e-3)
+        assert rec.window_summary(0.0, 999.0) == {"window_launches": 0}
+        # full summary spans both
+        assert rec.summary()["window_launches"] == 2
+    finally:
+        rec.close()
+
+
+def test_recorder_bounded_and_snapshot_compact():
+    """The ring must not grow past its cap under unbounded launches —
+    including records whose done-hook never fires — and the @train/ KV
+    snapshot stays under the 64 KB push budget."""
+    rec = TR.TrainRecorder("bounded", cap=64, n_devices=1,
+                           peak_flops=1e9, enabled=True)
+    try:
+        for i in range(2000):
+            seq = rec.record_launch(t_start=float(i), data_wait_s=0.001,
+                                    h2d_s=0.001, dispatch_s=0.002,
+                                    t_dispatch_end=float(i) + 0.004,
+                                    k=4, tokens=128, flops=1e6,
+                                    batch_shape=(4, 2, 17))
+            if i % 2 == 0:
+                rec.finalize_launch(seq, float(i) + 0.01)
+            # odd seqs never finalize: the _open backstop must bound them
+        assert len(rec.launches()) <= 64
+        with rec._lock:
+            assert len(rec._open) <= 64
+        s = rec.summary()
+        assert s["launches_total"] == 2000 and s["steps_total"] == 8000
+        assert len(json.dumps(rec.snapshot())) < 64_000
+    finally:
+        rec.close()
+
+
+def test_kill_switch_records_nothing():
+    rec = TR.TrainRecorder("off", enabled=False)
+    try:
+        seq = rec.record_launch(t_start=0.0, data_wait_s=1.0, h2d_s=0.0,
+                                dispatch_s=1.0)
+        assert seq == 0  # the driver's hooks all no-op on seq 0
+        rec.watch_outputs(seq, {"loss": 1.0})
+        rec.add_host_tax(seq, 1.0)
+        rec.finalize_launch(seq, 2.0)
+        rec.loader_dry()
+        assert not rec.launches()
+        s = rec.summary()
+        assert s["launches_total"] == 0 and s["window_launches"] == 0
+        assert s["dry_resets"] == 0
+    finally:
+        rec.close()
+
+
+def test_doctor_train_findings():
+    """Sustained launch-gap and data-starvation findings from a synthetic
+    report; stale and idle snapshots skipped; WARN level only (doctor
+    stays exit 0)."""
+    from ray_tpu.util import doctor
+
+    now = time.time()
+    snap = {"t": now, "node": "n1", "name": "drv", "summary": {
+        "window_launches": 6, "gap_recent": [0.01, 0.3, 0.4, 0.5],
+        "data_wait_frac": 0.40,
+        "waterfall": {"mfu_cost": {"data_wait": 0.120}}}}
+    node = {"node_id": "n1deadbeef", "alive": True, "resources": {},
+            "available": {}}
+    report = {"nodes": [node], "actors": [], "failures": [], "ooms": [],
+              "trains": [snap], "window_s": 600.0}
+    findings = doctor.diagnose(report)
+    msgs = [m for lvl, m in findings if lvl == doctor.WARN]
+    assert any("launch-gap sustained" in m for m in msgs), findings
+    assert any("data-starved" in m and "costing 0.120 MFU" in m
+               for m in msgs), findings
+    assert not any(lvl == doctor.CRITICAL for lvl, _ in findings)
+    # thresholds are tunable from the CLI flags
+    f2 = doctor.diagnose(report, launch_gap_warn_s=0.6,
+                         data_wait_warn=0.5)
+    assert not any("train driver" in m for _, m in f2), f2
+    # one wide gap is a checkpoint fence, not sustained starvation
+    healthy = dict(snap, summary=dict(snap["summary"],
+                                      gap_recent=[0.01, 0.5, 0.01],
+                                      data_wait_frac=0.05))
+    f3 = doctor.diagnose(dict(report, trains=[healthy]))
+    assert not any("train driver" in m for _, m in f3), f3
+    # stale snapshot (the @train/ key deliberately outlives the driver):
+    # skipped entirely, never failed
+    stale = dict(snap, t=now - 120.0)
+    f4 = doctor.diagnose(dict(report, trains=[stale]))
+    assert not any("train driver" in m for _, m in f4), f4
+    # idle driver (no launches in the window): nothing to grade
+    idle = dict(snap, summary=dict(snap["summary"], window_launches=0))
+    f5 = doctor.diagnose(dict(report, trains=[idle]))
+    assert not any("train driver" in m for _, m in f5), f5
+
+
+def test_timeline_launch_lanes():
+    """A drained train_launch event renders as Perfetto lanes: the launch
+    span, the consecutive phase partition, and the gap span anchored
+    BEFORE dispatch."""
+    from ray_tpu.util.timeline import _train_launch_lanes
+
+    rec_payload = {"seq": 3, "t": 1000.0, "k": 2, "tokens": 64,
+                   "wall_s": 0.5, "gap_s": 0.1, "driver": "tl",
+                   "flops": 1e9, "batch_shape": [2, 2, 17],
+                   "t_done": 1000.5,
+                   "phases": {"data_wait": 0.2, "h2d": 0.05,
+                              "dispatch": 0.05, "device_compute": 0.15,
+                              "host_tax": 0.02, "compile": 0.0}}
+    ev = {"task_id": "trainlaunch:n1:1:tl:3", "node_id": "n1",
+          "times": {"RUNNING": 1000.0, "FINISHED": 1000.5}}
+    lanes = _train_launch_lanes(ev, rec_payload)
+    tids = {s["tid"] for s in lanes}
+    assert {"train:tl:launches", "train:tl:phases",
+            "train:tl:gap"} <= tids
+    launch = [s for s in lanes if s["tid"] == "train:tl:launches"][0]
+    assert launch["ts"] == pytest.approx(1000.0 * 1e6)
+    assert launch["dur"] == pytest.approx(0.5 * 1e6)
+    # the gap span sits before dispatch start (t + data_wait + h2d)
+    gap = [s for s in lanes if s["tid"] == "train:tl:gap"][0]
+    assert gap["dur"] == pytest.approx(0.1 * 1e6)
+    assert gap["ts"] + gap["dur"] == pytest.approx(
+        (1000.0 + 0.2 + 0.05) * 1e6)
+    # phases partition consecutively in launch order
+    phases = sorted((s for s in lanes if s["tid"] == "train:tl:phases"),
+                    key=lambda s: s["ts"])
+    assert [p["name"] for p in phases] == ["data_wait", "h2d",
+                                           "dispatch", "device_compute"]
+    for a, b in zip(phases, phases[1:]):
+        assert a["ts"] + a["dur"] == pytest.approx(b["ts"])
+
+
+# ---------------------------------------------------------------------------
+# the cluster surfaces: @train/ KV -> /api/train + rt train --json, and
+# the postmortem error discipline
+# ---------------------------------------------------------------------------
+
+def _get_json(port, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=30) as resp:
+        return json.loads(resp.read())
+
+
+def test_train_stats_missing_snapshot_is_an_error(rt_cluster):
+    """Grading a run that never recorded is a mistake worth failing:
+    exactly one stderr line, exit 1, nothing on stdout."""
+    import ray_tpu
+    from ray_tpu.scripts import cli
+
+    b = ray_tpu.global_worker()._require_backend()
+    out, err = io.StringIO(), io.StringIO()
+    with contextlib.redirect_stdout(out), contextlib.redirect_stderr(err):
+        rc = cli.cmd_train(Namespace(address=b.gcs_address, name=None,
+                                     limit=8, json=False,
+                                     train_cmd="stats"))
+    assert rc == 1
+    assert out.getvalue() == ""
+    lines = [ln for ln in err.getvalue().splitlines() if ln]
+    assert len(lines) == 1, lines
+    assert "no train flight-recorder snapshot" in lines[0]
+    assert "RT_TRAIN_RECORDER=0" in lines[0]
+
+
+def test_api_train_and_cli_json(rt_cluster):
+    import ray_tpu
+    from ray_tpu.dashboard import start_dashboard
+    from ray_tpu.scripts import cli
+
+    rec = TR.TrainRecorder("surfaced", n_devices=1, peak_flops=1e9,
+                           enabled=True)
+    try:
+        s1 = rec.record_launch(t_start=time.time() - 0.3,
+                               data_wait_s=0.05, h2d_s=0.01,
+                               dispatch_s=0.1, k=4, tokens=256,
+                               batch_shape=(4, 2, 17), flops=5e7)
+        rec.finalize_launch(s1, time.time())
+        counts = rec.drain_now()
+        assert counts["kv"] == 1, counts  # the @train/ snapshot landed
+        assert counts["events"] >= 1, counts  # the timeline lane shipped
+
+        port = start_dashboard()
+        payload = _get_json(port, "/api/train")
+        snaps = [s for s in payload["drivers"]
+                 if s.get("name") == "surfaced"]
+        assert snaps, payload
+        snap = snaps[-1]
+        assert snap["summary"]["window_launches"] == 1
+        assert snap["launches"][-1]["done"]
+        assert snap["launches"][-1]["phases_ms"]["data_wait"] == \
+            pytest.approx(50.0, abs=1.0)
+
+        b = ray_tpu.global_worker()._require_backend()
+        out = io.StringIO()
+        with contextlib.redirect_stdout(out):
+            rc = cli.cmd_train(Namespace(address=b.gcs_address,
+                                         name="surfaced", limit=8,
+                                         json=True, train_cmd="stats"))
+        assert rc == 0
+        stats = json.loads(out.getvalue())
+        assert stats and stats[-1]["summary"]["launches_total"] == 1
+        assert stats[-1]["summary"]["steps_total"] == 4
+        # human rendering smoke: the waterfall + overhead lines print
+        out = io.StringIO()
+        with contextlib.redirect_stdout(out):
+            rc = cli.cmd_train(Namespace(address=b.gcs_address,
+                                         name="surfaced", limit=8,
+                                         json=False, train_cmd="stats"))
+        text = out.getvalue()
+        assert rc == 0
+        assert "MFU waterfall" in text and "recorder overhead" in text
+        assert "launch gap" in text
+        # the postmortem property: the snapshot SURVIVES close() —
+        # `rt train stats` works after the driver is gone
+        rec.close()
+        out = io.StringIO()
+        with contextlib.redirect_stdout(out):
+            rc = cli.cmd_train(Namespace(address=b.gcs_address,
+                                         name="surfaced", limit=8,
+                                         json=True, train_cmd="stats"))
+        assert rc == 0
+        assert json.loads(out.getvalue())[-1]["summary"][
+            "launches_total"] == 1
+    finally:
+        rec.close()
